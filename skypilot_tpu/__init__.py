@@ -1,0 +1,56 @@
+"""skypilot_tpu: a TPU-native AI-workload orchestrator.
+
+A brand-new framework with the capabilities of SkyPilot (the reference),
+re-designed TPU-first: multi-host TPU slices are atomic, gang-scheduled
+resources; the runtime wires `jax.distributed` process groups over ICI/DCN
+instead of Ray placement groups + NCCL; serving targets continuous-batched
+JAX LLM inference; and the bundled model/ops/parallel layers provide the
+Llama-family training and inference stack the examples run.
+
+Public API (mirrors the reference's `sky.*` surface, reference
+sky/client/sdk.py):
+
+    import skypilot_tpu as sky
+    task = sky.Task.from_yaml('examples/minimal.yaml')
+    sky.launch(task, cluster_name='dev')
+    sky.status()
+    sky.down('dev')
+"""
+from typing import TYPE_CHECKING
+
+__version__ = '0.1.0'
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.topology import TpuSlice, parse_tpu
+
+if TYPE_CHECKING:
+    pass
+
+
+def __getattr__(name: str):
+    # Engine entrypoints are imported lazily to keep `import skypilot_tpu`
+    # light (no jax import, no sqlite open) — same motivation as the
+    # reference's LazyImport adaptors (reference sky/adaptors/common.py:10).
+    _engine_api = {
+        'launch', 'exec', 'status', 'stop', 'start', 'down', 'autostop',
+        'queue', 'cancel', 'tail_logs', 'cost_report', 'optimize',
+    }
+    try:
+        if name in _engine_api:
+            from skypilot_tpu import core
+            return getattr(core, name)
+        if name == 'jobs':
+            from skypilot_tpu import jobs
+            return jobs
+        if name == 'serve':
+            from skypilot_tpu import serve
+            return serve
+    except ImportError as e:
+        # Keep hasattr()/getattr(default) semantics intact.
+        raise AttributeError(
+            f'module {__name__!r} attribute {name!r} unavailable: {e}'
+        ) from e
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

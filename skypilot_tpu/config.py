@@ -1,0 +1,108 @@
+"""Layered, immutable configuration system.
+
+Counterpart of the reference's ``sky/skypilot_config.py`` (module doc :1-30):
+a global YAML (``~/.sky_tpu/config.yaml``), overridden by per-task
+``config:`` blocks, overridden by an in-process override context (used by the
+API server to apply server-side config per request — reference
+sky/server/requests/executor.py:354).
+
+Access is by dotted path: ``config.get_nested(('jobs', 'controller',
+'resources'), default)``.
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import yaml
+
+CONFIG_ENV_VAR = 'SKY_TPU_CONFIG'
+DEFAULT_CONFIG_PATH = '~/.sky_tpu/config.yaml'
+
+_lock = threading.Lock()
+_global_config: Optional[Dict[str, Any]] = None
+_local = threading.local()
+
+
+def _load_global() -> Dict[str, Any]:
+    global _global_config
+    with _lock:
+        if _global_config is None:
+            path = os.path.expanduser(
+                os.environ.get(CONFIG_ENV_VAR, DEFAULT_CONFIG_PATH))
+            if os.path.exists(path):
+                with open(path, 'r', encoding='utf-8') as f:
+                    _global_config = yaml.safe_load(f) or {}
+            else:
+                _global_config = {}
+        return _global_config
+
+
+def reload() -> None:
+    """Drop the cached global config (tests and `api start` use this)."""
+    global _global_config
+    with _lock:
+        _global_config = None
+
+
+def loaded() -> bool:
+    return bool(_load_global())
+
+
+def _merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
+    out = copy.deepcopy(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def _effective() -> Dict[str, Any]:
+    """Merged view of all layers. Always a fresh copy — callers may mutate
+    the result without corrupting the cached global config."""
+    cfg = copy.deepcopy(_load_global())
+    for layer in getattr(_local, 'overrides', []):
+        cfg = _merge(cfg, layer)
+    return cfg
+
+
+def get_nested(keys: Tuple[str, ...], default: Any = None) -> Any:
+    node: Any = _effective()
+    for k in keys:
+        if not isinstance(node, dict) or k not in node:
+            return default
+        node = node[k]
+    return copy.deepcopy(node)
+
+
+def set_nested(keys: Tuple[str, ...], value: Any) -> Dict[str, Any]:
+    """Returns a *new* config dict with the value set (configs are
+    immutable in place, like the reference)."""
+    cfg = _effective()
+    node = cfg
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+    node[keys[-1]] = value
+    return cfg
+
+
+def to_dict() -> Dict[str, Any]:
+    return _effective()
+
+
+@contextlib.contextmanager
+def override(config: Dict[str, Any]) -> Iterator[None]:
+    """Apply a config layer for the duration of the context (per-request /
+    per-task overrides)."""
+    if not hasattr(_local, 'overrides'):
+        _local.overrides = []
+    _local.overrides.append(config or {})
+    try:
+        yield
+    finally:
+        _local.overrides.pop()

@@ -1,0 +1,149 @@
+"""Task DAG container (reference sky/dag.py: ``Dag`` at :26, ``is_chain``
+at :159, thread-local ``_DagContext`` at :202).
+
+The optimizer consumes this: chain DAGs get the DP solver, general DAGs the
+exhaustive/greedy solver (reference uses ILP via pulp; pulp is not available
+here so the general case is solved exactly for small DAGs — see
+``skypilot_tpu/optimizer.py``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from skypilot_tpu import task as task_lib
+
+
+class Dag:
+    """A directed acyclic graph of Tasks."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.tasks: List[task_lib.Task] = []
+        self._edges: Dict[int, Set[int]] = {}  # task index -> child indices
+
+    # ---- construction ----------------------------------------------------
+    def add(self, t: task_lib.Task) -> 'Dag':
+        if t not in self.tasks:
+            self.tasks.append(t)
+            self._edges.setdefault(self.tasks.index(t), set())
+        return self
+
+    def add_edge(self, parent: task_lib.Task, child: task_lib.Task) -> None:
+        self.add(parent)
+        self.add(child)
+        pi, ci = self.tasks.index(parent), self.tasks.index(child)
+        self._edges.setdefault(pi, set()).add(ci)
+        if self._has_cycle():
+            self._edges[pi].discard(ci)
+            raise ValueError('Adding this edge would create a cycle')
+
+    def remove(self, t: task_lib.Task) -> None:
+        idx = self.tasks.index(t)
+        self.tasks.pop(idx)
+        new_edges: Dict[int, Set[int]] = {}
+        for p, children in self._edges.items():
+            if p == idx:
+                continue
+            np_ = p - 1 if p > idx else p
+            new_edges[np_] = {c - 1 if c > idx else c
+                              for c in children if c != idx}
+        self._edges = new_edges
+
+    # ---- queries ---------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __iter__(self):
+        return iter(self.tasks)
+
+    def children(self, t: task_lib.Task) -> List[task_lib.Task]:
+        return [self.tasks[c] for c in self._edges.get(
+            self.tasks.index(t), set())]
+
+    def parents(self, t: task_lib.Task) -> List[task_lib.Task]:
+        idx = self.tasks.index(t)
+        return [self.tasks[p] for p, cs in self._edges.items() if idx in cs]
+
+    def is_chain(self) -> bool:
+        """True for a *connected* linear chain: every degree <= 1, exactly
+        one source and one sink (reference sky/dag.py:159 has the same
+        single-source/single-sink requirement; without it two disconnected
+        tasks would be mis-routed to the chain DP solver)."""
+        if len(self.tasks) <= 1:
+            return True
+        out_deg = {i: len(self._edges.get(i, set()))
+                   for i in range(len(self.tasks))}
+        in_deg: Dict[int, int] = {i: 0 for i in range(len(self.tasks))}
+        for cs in self._edges.values():
+            for c in cs:
+                in_deg[c] += 1
+        return (all(d <= 1 for d in out_deg.values()) and
+                all(d <= 1 for d in in_deg.values()) and
+                sum(1 for d in out_deg.values() if d == 0) == 1 and
+                sum(1 for d in in_deg.values() if d == 0) == 1)
+
+    def topological_order(self) -> List[task_lib.Task]:
+        in_deg: Dict[int, int] = {i: 0 for i in range(len(self.tasks))}
+        for cs in self._edges.values():
+            for c in cs:
+                in_deg[c] += 1
+        ready = [i for i, d in in_deg.items() if d == 0]
+        order: List[int] = []
+        while ready:
+            i = ready.pop(0)
+            order.append(i)
+            for c in sorted(self._edges.get(i, set())):
+                in_deg[c] -= 1
+                if in_deg[c] == 0:
+                    ready.append(c)
+        if len(order) != len(self.tasks):
+            raise ValueError('DAG has a cycle')
+        return [self.tasks[i] for i in order]
+
+    def _has_cycle(self) -> bool:
+        try:
+            self.topological_order()
+            return False
+        except ValueError:
+            return True
+
+    def __repr__(self) -> str:
+        return f'Dag({self.name or "<unnamed>"}, {len(self.tasks)} tasks)'
+
+
+class _DagContext(threading.local):
+    """Thread-local `with Dag()` support (reference sky/dag.py:202)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._stack: List[Dag] = []
+
+    def push(self, dag: Dag) -> None:
+        self._stack.append(dag)
+
+    def pop(self) -> Dag:
+        return self._stack.pop()
+
+    def current(self) -> Optional[Dag]:
+        return self._stack[-1] if self._stack else None
+
+
+_dag_context = _DagContext()
+
+
+def get_current_dag() -> Optional[Dag]:
+    return _dag_context.current()
+
+
+def _dag_enter(self: Dag) -> Dag:
+    _dag_context.push(self)
+    return self
+
+
+def _dag_exit(self: Dag, *_args) -> None:
+    _dag_context.pop()
+
+
+Dag.__enter__ = _dag_enter  # type: ignore[attr-defined]
+Dag.__exit__ = _dag_exit  # type: ignore[attr-defined]

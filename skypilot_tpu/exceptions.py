@@ -1,0 +1,140 @@
+"""Typed exception hierarchy for skypilot_tpu.
+
+Counterpart of the reference's ``sky/exceptions.py`` (745 LoC): the important
+design element preserved is ``ResourcesUnavailableError.failover_history`` —
+the provisioner's failover loop appends each failed attempt so callers (and
+the managed-jobs recovery strategies) can reason about *why* placement failed.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No cloud/region/zone could satisfy the resource request.
+
+    Carries the full failover history (one entry per failed attempt) like the
+    reference's ``sky.exceptions.ResourcesUnavailableError`` (used by
+    ``RetryingVmProvisioner``, reference cloud_vm_ray_backend.py:1661).
+    """
+
+    def __init__(self, message: str,
+                 failover_history: Optional[List[Exception]] = None):
+        super().__init__(message)
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources cannot run on the target cluster."""
+
+
+class InvalidTaskError(SkyTpuError):
+    """Malformed task spec (YAML or programmatic)."""
+
+
+class InvalidResourcesError(SkyTpuError):
+    """Malformed or unsatisfiable resources spec."""
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster not found in the state store."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster belongs to a different user/identity."""
+
+
+class ProvisionError(SkyTpuError):
+    """A single provisioning attempt failed (retryable via failover)."""
+
+    def __init__(self, message: str, *, retryable: bool = True,
+                 blocked_region: Optional[str] = None,
+                 blocked_zone: Optional[str] = None):
+        super().__init__(message)
+        self.retryable = retryable
+        self.blocked_region = blocked_region
+        self.blocked_zone = blocked_zone
+
+
+class ProvisionTimeoutError(ProvisionError):
+    """Slice did not become ready in time (e.g. TPU QUEUED/PROVISIONING)."""
+
+
+class QuotaExceededError(ProvisionError):
+    """Out of quota in a region — block the whole region on failover."""
+
+    def __init__(self, message: str, **kwargs):
+        super().__init__(message, **kwargs)
+        self.retryable = True
+
+
+class CapacityError(ProvisionError):
+    """Stockout / no capacity in a zone — block the zone on failover."""
+
+
+class CommandError(SkyTpuError):
+    """A remote/local command exited non-zero."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str = '',
+                 detailed_reason: str = ''):
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        super().__init__(
+            f'Command failed with return code {returncode}: {command}\n'
+            f'{error_msg}')
+
+
+class JobNotFoundError(SkyTpuError):
+    """Job id not present in a cluster's job queue."""
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job exhausted its recovery budget."""
+
+
+class ManagedJobStatusError(SkyTpuError):
+    """Managed job is in a state that does not permit the operation."""
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    """Service was torn down by the user while an operation was in flight."""
+
+
+class RequestCancelled(SkyTpuError):
+    """An async API request was cancelled by the client."""
+
+
+class ApiServerConnectionError(SkyTpuError):
+    """Client could not reach the API server."""
+
+    def __init__(self, server_url: str):
+        super().__init__(
+            f'Could not connect to API server at {server_url}. '
+            'Start one with `sky-tpu api start`.')
+
+
+class StorageError(SkyTpuError):
+    """Object-store/storage mount failure."""
+
+
+class CheckpointError(SkyTpuError):
+    """Checkpoint save/restore failure."""
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud credentials are available for the requested operation."""

@@ -1,0 +1,239 @@
+"""Global control-plane state store (sqlite, stdlib).
+
+Counterpart of the reference's ``sky/global_user_state.py`` (2,904 LoC,
+SQLAlchemy): tables for clusters, cluster events, and managed-request
+bookkeeping. SQLAlchemy is not available in this environment, so this is
+plain ``sqlite3`` with WAL mode — the same concurrency discipline the
+reference relies on (sqlite WAL + per-cluster file locks, reference
+sky/utils/locks.py).
+
+Cluster "handles" (provisioned host metadata) are stored as JSON, not
+pickles — they are plain dataclass dumps from
+``skypilot_tpu/provision/common.py``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS clusters (
+    name TEXT PRIMARY KEY,
+    launched_at REAL,
+    last_use TEXT,
+    status TEXT,
+    autostop_minutes INTEGER DEFAULT -1,
+    autostop_down INTEGER DEFAULT 0,
+    resources_json TEXT,
+    cluster_info_json TEXT,
+    task_yaml TEXT,
+    user TEXT,
+    workspace TEXT DEFAULT 'default',
+    status_updated_at REAL
+);
+CREATE TABLE IF NOT EXISTS cluster_events (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    cluster_name TEXT,
+    timestamp REAL,
+    event_type TEXT,
+    message TEXT
+);
+CREATE TABLE IF NOT EXISTS cluster_history (
+    name TEXT,
+    launched_at REAL,
+    duration_s REAL,
+    resources_json TEXT,
+    num_hosts INTEGER,
+    cost_per_hour REAL,
+    down_at REAL
+);
+CREATE TABLE IF NOT EXISTS storage (
+    name TEXT PRIMARY KEY,
+    launched_at REAL,
+    handle_json TEXT,
+    status TEXT
+);
+CREATE TABLE IF NOT EXISTS enabled_clouds (
+    cloud TEXT PRIMARY KEY,
+    enabled_at REAL
+);
+"""
+
+
+class _Db:
+    """Process-wide sqlite connection with WAL and a lock."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._local = threading.local()
+
+    @property
+    def conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, 'conn', None)
+        if conn is None:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            conn = sqlite3.connect(self.path, timeout=30.0)
+            conn.execute('PRAGMA journal_mode=WAL')
+            conn.executescript(_SCHEMA)
+            conn.row_factory = sqlite3.Row
+            self._local.conn = conn
+        return conn
+
+
+_dbs: Dict[str, _Db] = {}
+_dbs_lock = threading.Lock()
+
+
+def _db() -> _Db:
+    path = os.path.join(common.base_dir(), 'state.db')
+    with _dbs_lock:
+        if path not in _dbs:
+            _dbs[path] = _Db(path)
+        return _dbs[path]
+
+
+# ---- clusters ------------------------------------------------------------
+def add_or_update_cluster(name: str,
+                          status: common.ClusterStatus,
+                          *,
+                          resources_config: Optional[Dict[str, Any]] = None,
+                          cluster_info: Optional[Dict[str, Any]] = None,
+                          task_yaml: Optional[str] = None,
+                          user: Optional[str] = None) -> None:
+    """Reference sky/global_user_state.py:611."""
+    conn = _db().conn
+    now = time.time()
+    # Atomic upsert: concurrent callers for the same name must not race a
+    # check-then-insert (WAL does not serialize read-modify-write). NULL
+    # values mean "keep the existing column on update".
+    conn.execute(
+        'INSERT INTO clusters (name, launched_at, last_use, status, '
+        'resources_json, cluster_info_json, task_yaml, user, '
+        'status_updated_at) VALUES (?,?,?,?,?,?,?,?,?) '
+        'ON CONFLICT(name) DO UPDATE SET '
+        'status=excluded.status, '
+        'status_updated_at=excluded.status_updated_at, '
+        'resources_json=COALESCE(excluded.resources_json, '
+        '  clusters.resources_json), '
+        'cluster_info_json=COALESCE(excluded.cluster_info_json, '
+        '  clusters.cluster_info_json), '
+        'task_yaml=COALESCE(excluded.task_yaml, clusters.task_yaml)',
+        (name, now, '', status.value,
+         json.dumps(resources_config) if resources_config is not None
+         else None,
+         json.dumps(cluster_info) if cluster_info is not None else None,
+         task_yaml,
+         user or os.environ.get('USER', 'unknown'), now))
+    conn.commit()
+
+
+def set_cluster_status(name: str, status: common.ClusterStatus) -> None:
+    conn = _db().conn
+    conn.execute(
+        'UPDATE clusters SET status=?, status_updated_at=? WHERE name=?',
+        (status.value, time.time(), name))
+    conn.commit()
+
+
+def set_cluster_autostop(name: str, idle_minutes: int, down: bool) -> None:
+    conn = _db().conn
+    conn.execute(
+        'UPDATE clusters SET autostop_minutes=?, autostop_down=? '
+        'WHERE name=?', (idle_minutes, int(down), name))
+    conn.commit()
+
+
+def update_last_use(name: str, command: str) -> None:
+    conn = _db().conn
+    conn.execute('UPDATE clusters SET last_use=? WHERE name=?',
+                 (command, name))
+    conn.commit()
+
+
+def get_cluster(name: str) -> Optional[Dict[str, Any]]:
+    """Reference sky/global_user_state.py:1739."""
+    row = _db().conn.execute('SELECT * FROM clusters WHERE name=?',
+                             (name,)).fetchone()
+    return _cluster_row_to_dict(row) if row else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    rows = _db().conn.execute(
+        'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    return [_cluster_row_to_dict(r) for r in rows]
+
+
+def remove_cluster(name: str) -> None:
+    conn = _db().conn
+    row = get_cluster(name)
+    if row is not None:
+        conn.execute(
+            'INSERT INTO cluster_history (name, launched_at, duration_s, '
+            'resources_json, num_hosts, cost_per_hour, down_at) '
+            'VALUES (?,?,?,?,?,?,?)',
+            (name, row['launched_at'], time.time() - row['launched_at'],
+             json.dumps(row['resources']),
+             len((row['cluster_info'] or {}).get('hosts', [])) or 1,
+             (row['cluster_info'] or {}).get('cost_per_hour', 0.0),
+             time.time()))
+    conn.execute('DELETE FROM clusters WHERE name=?', (name,))
+    conn.commit()
+
+
+def _cluster_row_to_dict(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d['resources'] = json.loads(d.pop('resources_json') or '{}')
+    d['cluster_info'] = json.loads(d.pop('cluster_info_json') or '{}')
+    d['status'] = common.ClusterStatus(d['status'])
+    return d
+
+
+# ---- events (reference sky/global_user_state.py:878) ---------------------
+def add_cluster_event(cluster_name: str, event_type: str,
+                      message: str) -> None:
+    conn = _db().conn
+    conn.execute(
+        'INSERT INTO cluster_events (cluster_name, timestamp, event_type, '
+        'message) VALUES (?,?,?,?)',
+        (cluster_name, time.time(), event_type, message))
+    conn.commit()
+
+
+def get_cluster_events(cluster_name: str) -> List[Dict[str, Any]]:
+    rows = _db().conn.execute(
+        'SELECT * FROM cluster_events WHERE cluster_name=? ORDER BY id',
+        (cluster_name,)).fetchall()
+    return [dict(r) for r in rows]
+
+
+# ---- cost report ---------------------------------------------------------
+def get_cluster_history() -> List[Dict[str, Any]]:
+    rows = _db().conn.execute(
+        'SELECT * FROM cluster_history ORDER BY down_at DESC').fetchall()
+    out = []
+    for r in rows:
+        d = dict(r)
+        d['resources'] = json.loads(d.pop('resources_json') or '{}')
+        out.append(d)
+    return out
+
+
+# ---- enabled clouds ------------------------------------------------------
+def set_enabled_clouds(clouds: List[str]) -> None:
+    conn = _db().conn
+    conn.execute('DELETE FROM enabled_clouds')
+    conn.executemany(
+        'INSERT INTO enabled_clouds (cloud, enabled_at) VALUES (?,?)',
+        [(c, time.time()) for c in clouds])
+    conn.commit()
+
+
+def get_enabled_clouds() -> List[str]:
+    rows = _db().conn.execute('SELECT cloud FROM enabled_clouds').fetchall()
+    return [r['cloud'] for r in rows]

@@ -1,0 +1,227 @@
+"""User job specification (Task) with YAML round-trip.
+
+Counterpart of the reference's ``sky/task.py`` (``Task`` at :286,
+``Task.from_yaml_config`` at :602). Differences driven by TPU-first design:
+
+- ``num_nodes`` is optional for TPU tasks: a multi-host slice implies its own
+  host count (``Resources.num_hosts``); specifying both and disagreeing is an
+  error rather than silently Ray-scheduling N ranks.
+- The runtime injects `jax.distributed` env (coordinator address, process id,
+  process count) instead of torchrun/NCCL env — see
+  ``skypilot_tpu/runtime/distributed_env.py``.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional, Union
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+
+_VALID_NAME_RE = re.compile(r'^[a-zA-Z0-9]([a-zA-Z0-9._-]*[a-zA-Z0-9])?$')
+
+_TASK_FIELDS = {
+    'name', 'workdir', 'setup', 'run', 'num_nodes', 'envs', 'secrets',
+    'resources', 'file_mounts', 'storage_mounts', 'service', 'config',
+}
+
+
+def _expand_env_vars(text: str, envs: Dict[str, str]) -> str:
+    """${VAR}-style substitution in YAML strings (reference does the same
+    for task YAML env interpolation)."""
+    def repl(m: 're.Match[str]') -> str:
+        var = m.group(1)
+        return envs.get(var, os.environ.get(var, m.group(0)))
+    return re.sub(r'\$\{([A-Za-z_][A-Za-z0-9_]*)\}', repl, text)
+
+
+class Task:
+    """A unit of work: setup + run commands on a (possibly multi-host) slice."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: Optional[str] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        envs: Optional[Dict[str, str]] = None,
+        secrets: Optional[Dict[str, str]] = None,
+        resources: Optional[resources_lib.Resources] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+        storage_mounts: Optional[Dict[str, Dict[str, Any]]] = None,
+        service: Optional[Dict[str, Any]] = None,
+        config_overrides: Optional[Dict[str, Any]] = None,
+    ):
+        if name is not None and not _VALID_NAME_RE.match(name):
+            raise exceptions.InvalidTaskError(
+                f'Invalid task name {name!r}: must match '
+                f'{_VALID_NAME_RE.pattern}')
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self._explicit_num_nodes = num_nodes
+        self.envs: Dict[str, str] = {
+            str(k): str(v) if v is not None else ''
+            for k, v in (envs or {}).items()}
+        self.secrets: Dict[str, str] = {
+            str(k): str(v) if v is not None else ''
+            for k, v in (secrets or {}).items()}
+        self.resources = resources or resources_lib.Resources()
+        # local/remote path -> local path or storage URI (gs://...)
+        self.file_mounts: Dict[str, str] = dict(file_mounts or {})
+        # mount point -> {'source': gs://..., 'mode': MOUNT|COPY}
+        self.storage_mounts: Dict[str, Dict[str, Any]] = {
+            k: dict(v) for k, v in (storage_mounts or {}).items()}
+        self.service = dict(service) if service else None
+        self.config_overrides = dict(config_overrides or {})
+        # Filled by the optimizer (reference: best_resources on Task).
+        self.best_resources: Optional[resources_lib.Resources] = None
+        self._validate()
+
+    # ------------------------------------------------------------------
+    def _validate(self) -> None:
+        n = self._explicit_num_nodes
+        if n is not None and n < 1:
+            raise exceptions.InvalidTaskError(
+                f'num_nodes must be >= 1, got {n}')
+        tpu = self.resources.tpu
+        if tpu is not None and n is not None and n != tpu.num_hosts:
+            raise exceptions.InvalidTaskError(
+                f'num_nodes={n} conflicts with {tpu.name} which is a '
+                f'{tpu.num_hosts}-host slice. Omit num_nodes for TPU tasks '
+                f'(the slice implies it), or set it to {tpu.num_hosts}.')
+        if self.workdir is not None and not isinstance(self.workdir, str):
+            raise exceptions.InvalidTaskError('workdir must be a path string')
+        for dst, src in self.file_mounts.items():
+            if not isinstance(dst, str) or not isinstance(src, str):
+                raise exceptions.InvalidTaskError(
+                    f'file_mounts entries must be str->str: {dst!r}: {src!r}')
+        for mp, spec in self.storage_mounts.items():
+            if 'source' not in spec:
+                raise exceptions.InvalidTaskError(
+                    f'storage_mounts[{mp!r}] missing "source"')
+            mode = spec.get('mode', 'MOUNT')
+            if mode not in ('MOUNT', 'COPY', 'MOUNT_CACHED'):
+                raise exceptions.InvalidTaskError(
+                    f'storage_mounts[{mp!r}].mode must be MOUNT/COPY/'
+                    f'MOUNT_CACHED, got {mode!r}')
+
+    @property
+    def num_nodes(self) -> int:
+        """Host count: explicit, or derived from the TPU slice."""
+        if self.resources.tpu is not None:
+            return self.resources.tpu.num_hosts
+        return self._explicit_num_nodes or 1
+
+    @num_nodes.setter
+    def num_nodes(self, n: Optional[int]) -> None:
+        self._explicit_num_nodes = n
+        self._validate()
+
+    def set_resources(self, res: resources_lib.Resources) -> 'Task':
+        self.resources = res
+        self._validate()
+        return self
+
+    def update_envs(self, envs: Dict[str, str]) -> 'Task':
+        self.envs.update({str(k): str(v) for k, v in envs.items()})
+        return self
+
+    @property
+    def is_service(self) -> bool:
+        return self.service is not None
+
+    # ---- YAML ---------------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None
+                         ) -> 'Task':
+        config = dict(config or {})
+        unknown = set(config) - _TASK_FIELDS
+        if unknown:
+            raise exceptions.InvalidTaskError(
+                f'Unknown task fields: {sorted(unknown)}. '
+                f'Valid: {sorted(_TASK_FIELDS)}')
+        envs = {str(k): str(v) if v is not None else ''
+                for k, v in (config.get('envs') or {}).items()}
+        if env_overrides:
+            envs.update({str(k): str(v) for k, v in env_overrides.items()})
+        # Env interpolation in run/setup (after overrides are applied).
+        run = config.get('run')
+        setup = config.get('setup')
+        if isinstance(run, str):
+            run = _expand_env_vars(run, envs)
+        if isinstance(setup, str):
+            setup = _expand_env_vars(setup, envs)
+        res_cfg = config.get('resources') or {}
+        return cls(
+            name=config.get('name'),
+            setup=setup,
+            run=run,
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+            envs=envs,
+            secrets=config.get('secrets'),
+            resources=resources_lib.Resources.from_yaml_config(res_cfg),
+            file_mounts=config.get('file_mounts'),
+            storage_mounts=config.get('storage_mounts'),
+            service=config.get('service'),
+            config_overrides=config.get('config'),
+        )
+
+    @classmethod
+    def from_yaml(cls, path: str,
+                  env_overrides: Optional[Dict[str, str]] = None) -> 'Task':
+        with open(os.path.expanduser(path), 'r', encoding='utf-8') as f:
+            config = yaml.safe_load(f)
+        if config is None:
+            config = {}
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'{path}: task YAML must be a mapping')
+        return cls.from_yaml_config(config, env_overrides)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        if self.name:
+            cfg['name'] = self.name
+        if self.workdir:
+            cfg['workdir'] = self.workdir
+        res = self.resources.to_yaml_config()
+        if res:
+            cfg['resources'] = res
+        if self._explicit_num_nodes is not None:
+            cfg['num_nodes'] = self._explicit_num_nodes
+        if self.envs:
+            cfg['envs'] = dict(self.envs)
+        if self.secrets:
+            cfg['secrets'] = dict(self.secrets)
+        if self.file_mounts:
+            cfg['file_mounts'] = dict(self.file_mounts)
+        if self.storage_mounts:
+            cfg['storage_mounts'] = {
+                k: dict(v) for k, v in self.storage_mounts.items()}
+        if self.setup:
+            cfg['setup'] = self.setup
+        if self.run:
+            cfg['run'] = self.run
+        if self.service:
+            cfg['service'] = dict(self.service)
+        if self.config_overrides:
+            cfg['config'] = dict(self.config_overrides)
+        return cfg
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_yaml_config(), sort_keys=False)
+
+    def __repr__(self) -> str:
+        bits = [f'Task({self.name or "<unnamed>"}']
+        bits.append(f', nodes={self.num_nodes}')
+        bits.append(f', {self.resources!r})')
+        return ''.join(bits)

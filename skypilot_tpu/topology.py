@@ -1,0 +1,226 @@
+"""TPU slice topology as a first-class object.
+
+This is the central TPU-first design decision of the framework: where the
+reference bolts TPU metadata onto GPU-shaped resources via string flags
+(``accelerator_args['tpu_vm']``, reference sky/clouds/gcp.py:564-577 and
+catalog grouping gcp_catalog.py:486-566), here every accelerator request
+resolves to a :class:`TpuSlice` that *derives* host count, chips-per-host,
+ICI torus dimensions, and the per-host `jax.distributed` wiring from the
+slice name. The provisioner gang-allocates `slice.num_hosts` VMs atomically
+(the slice *is* the gang — no Ray placement group needed), and the runtime
+emits coordinator/process-id env from the same object.
+
+Naming convention (mirrors GCP accelerator types):
+  - ``v2-8 / v3-8``      : suffix counts TensorCores (2 cores/chip)
+  - ``v4-N / v5p-N``     : suffix counts TensorCores (2 cores/chip, megacore)
+  - ``v5e-N / v6e-N``    : suffix counts chips directly
+Accepts an optional ``tpu-`` prefix (``tpu-v5e-8``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+# Per-generation hardware constants.
+#   chips_per_host: chips attached to one host VM in *multi-host* slices.
+#   max_chips_single_host: largest slice still served by a single host VM.
+#   ici_dims: 2 for a 2D torus (v2/v3/v5e/v6e), 3 for a 3D torus (v4/v5p).
+#   hbm/flops: per-chip, for the optimizer's time model and bench reporting.
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    name: str
+    cores_per_chip: int
+    suffix_counts_cores: bool
+    chips_per_host: int
+    max_chips_single_host: int
+    ici_dims: int
+    hbm_gib: float
+    bf16_tflops: float
+    # Per-chip ICI bandwidth (GB/s, one direction, all links) — drives the
+    # collective-time estimates in the optimizer.
+    ici_gbps: float
+
+
+TPU_GENERATIONS: Dict[str, TpuGeneration] = {
+    'v2': TpuGeneration('v2', 2, True, 4, 4, 2, 8, 46, 62),
+    'v3': TpuGeneration('v3', 2, True, 4, 4, 2, 16, 123, 112),
+    'v4': TpuGeneration('v4', 2, True, 4, 4, 3, 32, 275, 268),
+    'v5e': TpuGeneration('v5e', 1, False, 4, 8, 2, 16, 197, 186),
+    'v5p': TpuGeneration('v5p', 2, True, 4, 4, 3, 95, 459, 537),
+    'v6e': TpuGeneration('v6e', 1, False, 4, 8, 2, 32, 918, 448),
+}
+
+_TPU_NAME_RE = re.compile(r'^(?:tpu-)?(v\d+[ep]?(?:litepod)?)-(\d+)$')
+_GEN_ALIASES = {'v5litepod': 'v5e', 'v5lite': 'v5e'}
+
+
+def _torus_dims(chips: int, ndims: int) -> Tuple[int, ...]:
+    """Factor `chips` into a near-cubic/near-square torus shape.
+
+    Real slices have fixed catalogued topologies (e.g. v5p-64 → 2x4x4); this
+    produces the same shapes for power-of-two sizes, which is what the
+    catalog contains.
+    """
+    if chips == 1:
+        return (1,) * ndims
+    dims = [1] * ndims
+    remaining = chips
+    # Greedily split factors largest-first onto the smallest dimension.
+    factors = []
+    n = remaining
+    for p in (2, 3, 5, 7):
+        while n % p == 0:
+            factors.append(p)
+            n //= p
+    if n > 1:
+        factors.append(n)
+    for f in sorted(factors, reverse=True):
+        dims[dims.index(min(dims))] *= f
+    return tuple(sorted(dims))
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSlice:
+    """A fully-resolved TPU slice request.
+
+    Everything the provisioner and runtime need: ``num_hosts`` VMs to
+    gang-allocate, ``chips_per_host`` for per-host device expectations,
+    ``ici_topology`` for mesh construction, and the accelerator_type string
+    for the TPU API (``tpu.googleapis.com`` — reference
+    sky/provision/gcp/instance_utils.py:1222-1226 shows the API shape).
+    """
+    generation: str           # 'v5e', 'v5p', ...
+    num_chips: int
+    num_hosts: int
+    chips_per_host: int
+    num_cores: int
+    ici_topology: Tuple[int, ...]   # physical torus dims, e.g. (2, 4, 4)
+    hbm_gib_per_chip: float
+    bf16_tflops_per_chip: float
+    ici_gbps: float
+
+    @property
+    def name(self) -> str:
+        gen = TPU_GENERATIONS[self.generation]
+        suffix = self.num_cores if gen.suffix_counts_cores else self.num_chips
+        return f'{self.generation}-{suffix}'
+
+    @property
+    def accelerator_type(self) -> str:
+        """GCP TPU API acceleratorType string."""
+        if self.generation == 'v5e':
+            return f'v5litepod-{self.num_chips}'
+        return self.name
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def is_pod(self) -> bool:
+        return self.is_multi_host
+
+    @property
+    def total_hbm_gib(self) -> float:
+        return self.hbm_gib_per_chip * self.num_chips
+
+    @property
+    def total_bf16_tflops(self) -> float:
+        return self.bf16_tflops_per_chip * self.num_chips
+
+    @property
+    def topology_str(self) -> str:
+        return 'x'.join(str(d) for d in self.ici_topology)
+
+    def host_bounds(self) -> Tuple[int, ...]:
+        """How hosts tile the torus (TPU_HOST_BOUNDS-style metadata).
+
+        A host owns a contiguous near-square block (2x2(x1) for the standard
+        4-chip hosts), so the per-host block's prime factors are spread as
+        evenly as possible across the trailing torus dimensions rather than
+        consuming one whole dimension.
+        """
+        bounds = list(self.ici_topology)
+        block = [1] * len(bounds)
+        n = self.chips_per_host
+        factors = []
+        d = 2
+        while d * d <= n:
+            while n % d == 0:
+                factors.append(d)
+                n //= d
+            d += 1
+        if n > 1:
+            factors.append(n)
+        for f in sorted(factors, reverse=True):
+            cands = [i for i in range(len(bounds))
+                     if bounds[i] % (block[i] * f) == 0]
+            if not cands:
+                break
+            # Smallest current block wins; ties prefer trailing dims.
+            i = min(cands, key=lambda i: (block[i], -i))
+            block[i] *= f
+        return tuple(b // blk for b, blk in zip(bounds, block))
+
+    def devices_per_process(self) -> int:
+        """Local device count each `jax.distributed` process sees."""
+        return self.chips_per_host
+
+    def __str__(self) -> str:
+        return (f'{self.name} ({self.num_chips} chips, {self.num_hosts} '
+                f'host{"s" if self.num_hosts > 1 else ""}, '
+                f'topo {self.topology_str})')
+
+
+def parse_tpu(name: str) -> Optional[TpuSlice]:
+    """Parse ``[tpu-]v5e-8``-style names; None if not a TPU accelerator."""
+    m = _TPU_NAME_RE.match(name.strip().lower())
+    if m is None:
+        return None
+    gen_name, count = m.group(1), int(m.group(2))
+    gen_name = _GEN_ALIASES.get(gen_name, gen_name)
+    gen = TPU_GENERATIONS.get(gen_name)
+    if gen is None:
+        raise exceptions.InvalidResourcesError(
+            f'Unknown TPU generation in accelerator {name!r}. Known: '
+            f'{sorted(TPU_GENERATIONS)}')
+    if count <= 0:
+        raise exceptions.InvalidResourcesError(
+            f'Invalid TPU size in {name!r}')
+    if gen.suffix_counts_cores:
+        if count % gen.cores_per_chip != 0:
+            raise exceptions.InvalidResourcesError(
+                f'{name!r}: core count must be a multiple of '
+                f'{gen.cores_per_chip}')
+        num_chips = count // gen.cores_per_chip
+    else:
+        num_chips = count
+    num_cores = num_chips * gen.cores_per_chip
+    if num_chips <= gen.max_chips_single_host:
+        num_hosts, chips_per_host = 1, num_chips
+    else:
+        if num_chips % gen.chips_per_host != 0:
+            raise exceptions.InvalidResourcesError(
+                f'{name!r}: multi-host slice must be a multiple of '
+                f'{gen.chips_per_host} chips')
+        chips_per_host = gen.chips_per_host
+        num_hosts = num_chips // chips_per_host
+    return TpuSlice(
+        generation=gen.name,
+        num_chips=num_chips,
+        num_hosts=num_hosts,
+        chips_per_host=chips_per_host,
+        num_cores=num_cores,
+        ici_topology=_torus_dims(num_chips, gen.ici_dims),
+        hbm_gib_per_chip=gen.hbm_gib,
+        bf16_tflops_per_chip=gen.bf16_tflops,
+        ici_gbps=gen.ici_gbps,
+    )
+
+
+def is_tpu(accelerator_name: str) -> bool:
+    return _TPU_NAME_RE.match(accelerator_name.strip().lower()) is not None

@@ -1,0 +1,68 @@
+"""Shared paths, enums, and small helpers."""
+from __future__ import annotations
+
+import enum
+import os
+import time
+import uuid
+
+HOME_ENV_VAR = 'SKY_TPU_HOME'
+
+
+def base_dir() -> str:
+    """Framework state root (~/.sky_tpu, overridable for tests)."""
+    d = os.path.expanduser(os.environ.get(HOME_ENV_VAR, '~/.sky_tpu'))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def logs_dir() -> str:
+    d = os.path.join(base_dir(), 'logs')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def clusters_dir() -> str:
+    d = os.path.join(base_dir(), 'clusters')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class ClusterStatus(enum.Enum):
+    """Lifecycle of a cluster (reference sky/utils/status_lib.py semantics)."""
+    INIT = 'INIT'          # provisioning in progress or unknown
+    UP = 'UP'              # all hosts running, runtime healthy
+    STOPPED = 'STOPPED'    # hosts stopped, disk kept
+
+
+class JobStatus(enum.Enum):
+    """Per-cluster job queue states (reference sky/skylet/job_lib.py:156)."""
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                        JobStatus.FAILED_SETUP, JobStatus.CANCELLED)
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def now() -> float:
+    return time.time()
+
+
+def readable_time_duration(seconds: float) -> str:
+    seconds = int(seconds)
+    if seconds < 60:
+        return f'{seconds}s'
+    if seconds < 3600:
+        return f'{seconds // 60}m {seconds % 60}s'
+    return f'{seconds // 3600}h {(seconds % 3600) // 60}m'

@@ -1,0 +1,28 @@
+"""Test harness configuration.
+
+Mirrors the reference's offline test strategy (reference
+tests/common_test_fixtures.py): everything runs with zero cloud credentials.
+JAX tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPUs (the driver separately dry-runs the multichip path).
+"""
+import os
+
+# Must happen before any jax import anywhere in the test session.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import tempfile
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def sky_tpu_home(tmp_path, monkeypatch):
+    """Isolate all state (sqlite DB, logs, cluster dirs) per test."""
+    home = tmp_path / 'sky_tpu_home'
+    home.mkdir()
+    monkeypatch.setenv('SKY_TPU_HOME', str(home))
+    yield str(home)

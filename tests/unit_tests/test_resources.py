@@ -1,0 +1,80 @@
+"""Resources model: parsing, TPU derivation, round-trip, comparisons."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.resources import AutostopConfig, Resources, parse_accelerator
+
+
+def test_tpu_resources_derive_hosts():
+    r = Resources(accelerators='tpu-v5p-64')
+    assert r.is_tpu
+    assert r.num_hosts == 8
+    assert r.tpu.num_chips == 32
+
+
+def test_gpu_accelerator_count():
+    r = Resources(accelerators='H100:8')
+    assert not r.is_tpu
+    assert r.accelerator_count == 8
+    assert r.num_hosts == 1
+
+
+def test_accelerator_dict_form():
+    assert parse_accelerator({'A100': 4}) == ('A100', 4)
+
+
+def test_tpu_count_suffix_rejected():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(accelerators='tpu-v5e-8:2')
+
+
+def test_unknown_cloud_rejected():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(cloud='aws')
+
+
+def test_yaml_round_trip():
+    r = Resources(cloud='gcp', region='us-central2', accelerators='v5p-16',
+                  use_spot=True, disk_size_gb=512, ports=[8080, 22],
+                  autostop={'idle_minutes': 10, 'down': True},
+                  labels={'team': 'ml'})
+    r2 = Resources.from_yaml_config(r.to_yaml_config())
+    assert r == r2
+    assert r2.autostop.idle_minutes == 10
+    assert r2.autostop.down
+
+
+def test_cpus_plus_syntax():
+    r = Resources(cpus='8+')
+    assert r.cpus == (8.0, True)
+
+
+def test_less_demanding_than():
+    small = Resources(accelerators='v5e-4')
+    big = Resources(accelerators='v5e-16')
+    assert small.less_demanding_than(big)
+    assert not big.less_demanding_than(small)
+    # Cross-generation never satisfies.
+    v5p = Resources(accelerators='v5p-8')
+    assert not v5p.less_demanding_than(big)
+    # GPU vs TPU never satisfies.
+    gpu = Resources(accelerators='H100:1')
+    assert not gpu.less_demanding_than(big)
+    assert not small.less_demanding_than(gpu)
+
+
+def test_spot_demands_spot():
+    spot = Resources(use_spot=True)
+    ondemand = Resources()
+    assert not spot.less_demanding_than(ondemand)
+    assert ondemand.less_demanding_than(spot)
+
+
+def test_autostop_forms():
+    assert AutostopConfig.from_value(None) is None
+    a = AutostopConfig.from_value(10)
+    assert a.enabled and a.idle_minutes == 10 and not a.down
+    b = AutostopConfig.from_value(True)
+    assert b.enabled
+    c = AutostopConfig.from_value(False)
+    assert not c.enabled

@@ -1,0 +1,61 @@
+"""State store: cluster CRUD, events, history."""
+from skypilot_tpu import state
+from skypilot_tpu.utils import common
+
+
+def test_cluster_crud():
+    state.add_or_update_cluster(
+        'c1', common.ClusterStatus.INIT,
+        resources_config={'accelerators': 'v5e-8'},
+        cluster_info={'hosts': [{'ip': '10.0.0.1'}]})
+    c = state.get_cluster('c1')
+    assert c['status'] == common.ClusterStatus.INIT
+    assert c['resources'] == {'accelerators': 'v5e-8'}
+
+    state.set_cluster_status('c1', common.ClusterStatus.UP)
+    assert state.get_cluster('c1')['status'] == common.ClusterStatus.UP
+
+    assert len(state.get_clusters()) == 1
+    state.remove_cluster('c1')
+    assert state.get_cluster('c1') is None
+    # History recorded on teardown.
+    hist = state.get_cluster_history()
+    assert len(hist) == 1
+    assert hist[0]['name'] == 'c1'
+
+
+def test_events():
+    state.add_or_update_cluster('c2', common.ClusterStatus.INIT)
+    state.add_cluster_event('c2', 'PROVISION', 'started provisioning')
+    state.add_cluster_event('c2', 'PROVISION', 'done')
+    evs = state.get_cluster_events('c2')
+    assert [e['message'] for e in evs] == ['started provisioning', 'done']
+
+
+def test_autostop():
+    state.add_or_update_cluster('c3', common.ClusterStatus.UP)
+    state.set_cluster_autostop('c3', 10, True)
+    c = state.get_cluster('c3')
+    assert c['autostop_minutes'] == 10
+    assert c['autostop_down'] == 1
+
+
+def test_enabled_clouds():
+    state.set_enabled_clouds(['gcp', 'local'])
+    assert set(state.get_enabled_clouds()) == {'gcp', 'local'}
+
+
+def test_config_layering(monkeypatch, tmp_path):
+    from skypilot_tpu import config
+    p = tmp_path / 'cfg.yaml'
+    p.write_text('jobs:\n  max_retries: 3\n')
+    monkeypatch.setenv(config.CONFIG_ENV_VAR, str(p))
+    config.reload()
+    assert config.get_nested(('jobs', 'max_retries')) == 3
+    with config.override({'jobs': {'max_retries': 7}}):
+        assert config.get_nested(('jobs', 'max_retries')) == 7
+        with config.override({'jobs': {'extra': 1}}):
+            assert config.get_nested(('jobs', 'max_retries')) == 7
+            assert config.get_nested(('jobs', 'extra')) == 1
+    assert config.get_nested(('jobs', 'max_retries')) == 3
+    config.reload()

@@ -1,0 +1,93 @@
+"""Task YAML round-trip + num_nodes derivation; Dag structure."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.dag import Dag, get_current_dag
+from skypilot_tpu.task import Task
+
+
+def test_task_from_yaml(tmp_path):
+    p = tmp_path / 'task.yaml'
+    p.write_text(textwrap.dedent("""\
+        name: train
+        resources:
+          accelerators: tpu-v5e-16
+          use_spot: true
+        envs:
+          MODEL: llama3-8b
+        setup: pip list
+        run: |
+          python train.py --model ${MODEL}
+    """))
+    t = Task.from_yaml(str(p))
+    assert t.name == 'train'
+    assert t.num_nodes == 4          # derived from v5e-16
+    assert 'llama3-8b' in t.run      # env interpolation
+    assert t.resources.use_spot
+
+
+def test_num_nodes_conflict():
+    from skypilot_tpu.resources import Resources
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task(run='x', num_nodes=2,
+             resources=Resources(accelerators='v5e-16'))  # 4 hosts != 2
+
+
+def test_num_nodes_matching_ok():
+    from skypilot_tpu.resources import Resources
+    t = Task(run='x', num_nodes=4, resources=Resources(accelerators='v5e-16'))
+    assert t.num_nodes == 4
+
+
+def test_round_trip():
+    t = Task('t1', run='echo hi', setup='echo setup',
+             envs={'A': '1'}, file_mounts={'/remote': './local'})
+    t2 = Task.from_yaml_config(t.to_yaml_config())
+    assert t2.name == 't1'
+    assert t2.run == 'echo hi'
+    assert t2.file_mounts == {'/remote': './local'}
+
+
+def test_env_overrides():
+    t = Task.from_yaml_config(
+        {'run': 'echo ${X}', 'envs': {'X': 'a'}}, env_overrides={'X': 'b'})
+    assert t.run == 'echo b'
+    assert t.envs['X'] == 'b'
+
+
+def test_unknown_field():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml_config({'runn': 'typo'})
+
+
+def test_dag_chain_and_topo():
+    a, b, c = Task('a', run='a'), Task('b', run='b'), Task('c', run='c')
+    dag = Dag('chain')
+    dag.add_edge(a, b)
+    dag.add_edge(b, c)
+    assert dag.is_chain()
+    assert [t.name for t in dag.topological_order()] == ['a', 'b', 'c']
+
+
+def test_dag_not_chain():
+    a, b, c = Task('a', run='a'), Task('b', run='b'), Task('c', run='c')
+    dag = Dag()
+    dag.add_edge(a, b)
+    dag.add_edge(a, c)
+    assert not dag.is_chain()
+
+
+def test_dag_cycle_rejected():
+    a, b = Task('a', run='a'), Task('b', run='b')
+    dag = Dag()
+    dag.add_edge(a, b)
+    with pytest.raises(ValueError):
+        dag.add_edge(b, a)
+
+
+def test_dag_context():
+    with Dag('ctx') as dag:
+        assert get_current_dag() is dag
+    assert get_current_dag() is None

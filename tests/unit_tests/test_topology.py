@@ -1,0 +1,80 @@
+"""TPU slice topology parsing and derivation."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology
+
+
+def test_v5e_single_host():
+    s = topology.parse_tpu('tpu-v5e-8')
+    assert s.generation == 'v5e'
+    assert s.num_chips == 8
+    assert s.num_hosts == 1        # v5e serves up to 8 chips per host
+    assert s.chips_per_host == 8
+    assert s.num_cores == 8
+    assert not s.is_multi_host
+    assert s.accelerator_type == 'v5litepod-8'
+
+
+def test_v5e_multi_host():
+    s = topology.parse_tpu('v5e-16')
+    assert s.num_chips == 16
+    assert s.num_hosts == 4
+    assert s.chips_per_host == 4
+    assert s.ici_topology == (4, 4)
+
+
+def test_v5p_64():
+    # v5p-64: 64 TensorCores = 32 chips, 4 chips/host = 8 hosts, 3D torus.
+    s = topology.parse_tpu('v5p-64')
+    assert s.num_chips == 32
+    assert s.num_hosts == 8
+    assert s.num_cores == 64
+    assert len(s.ici_topology) == 3
+    assert s.is_multi_host
+    import math
+    assert math.prod(s.ici_topology) == 32
+
+
+def test_v4_8_single_host():
+    s = topology.parse_tpu('v4-8')
+    assert s.num_chips == 4
+    assert s.num_hosts == 1
+    assert s.accelerator_type == 'v4-8'
+
+
+def test_v2_v3():
+    assert topology.parse_tpu('v2-8').num_chips == 4
+    assert topology.parse_tpu('v3-32').num_hosts == 4
+
+
+def test_v5litepod_alias():
+    s = topology.parse_tpu('v5litepod-4')
+    assert s.generation == 'v5e'
+    assert s.num_chips == 4
+
+
+def test_not_tpu():
+    assert topology.parse_tpu('H100') is None
+    assert topology.parse_tpu('A100-80GB') is None
+    assert not topology.is_tpu('H100')
+    assert topology.is_tpu('tpu-v5e-8')
+
+
+def test_invalid():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        topology.parse_tpu('v5p-7')  # odd core count
+    with pytest.raises(exceptions.InvalidResourcesError):
+        topology.parse_tpu('v9-8')  # unknown generation
+
+
+def test_host_bounds_cover_topology():
+    import math
+    s = topology.parse_tpu('v5e-16')
+    assert math.prod(s.host_bounds()) == s.num_hosts
+    # Hosts own contiguous near-square 2x2 blocks, not 1x4 lines.
+    assert s.host_bounds() == (2, 2)
+    # Single-host slice: trivially (1, 1).
+    assert topology.parse_tpu('v5e-8').host_bounds() == (1, 1)
+    p = topology.parse_tpu('v5p-64')
+    assert math.prod(p.host_bounds()) == p.num_hosts
