@@ -1,0 +1,247 @@
+"""Accelerator/instance catalog with TPU slices priced parametrically.
+
+Counterpart of the reference's ``sky/catalog/`` (12,146 LoC of per-cloud CSV
+loaders; TPU grouping/pricing in gcp_catalog.py:486-566). Two structural
+changes for the TPU-first design:
+
+1. TPU entries are stored **per chip-hour per generation+region**, and slice
+   prices are computed from :class:`~skypilot_tpu.topology.TpuSlice` chip
+   counts — every valid slice size is automatically priced, instead of the
+   reference's approach of materializing one CSV row per slice size.
+2. The catalog is bundled (no hosted-catalog fetch, reference
+   sky/skylet/constants.py:614) — prices are a static snapshot; a
+   ``refresh()`` hook exists for wiring a fetcher later.
+
+The `local` cloud is always feasible and free: it provisions fake slices of
+local processes (the test/E2E backend, reference analog mock_aws_backend).
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+import functools
+import os
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
+
+# Egress $/GiB (reference models this in sky/optimizer.py's egress cost).
+SAME_REGION_EGRESS = 0.0
+CROSS_REGION_EGRESS = 0.01
+CROSS_CLOUD_EGRESS = 0.09
+
+
+@dataclasses.dataclass(frozen=True)
+class CatalogEntry:
+    """One raw catalog row."""
+    cloud: str
+    kind: str                 # 'tpu' | 'gpu' | 'cpu'
+    name: str                 # tpu generation / gpu name / instance type
+    region: str
+    zone: str
+    price: float              # per chip-hour (tpu), per gpu-hour (gpu),
+                              # per instance-hour (cpu)
+    spot_price: float
+    vcpus: Optional[float]
+    memory_gb: Optional[float]
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """A launchable placement candidate produced for the optimizer."""
+    cloud: str
+    region: str
+    zone: str
+    instance_type: str        # e.g. 'tpu-v5e-16', 'a3-highgpu-8g-ish', cpu type
+    accelerator_name: Optional[str]
+    accelerator_count: int
+    use_spot: bool
+    cost_per_hour: float      # whole-allocation (all hosts of a slice)
+    num_hosts: int
+    tpu: Optional[topology.TpuSlice] = None
+
+    def __str__(self) -> str:
+        spot = '[spot]' if self.use_spot else ''
+        acc = (f', {self.accelerator_name}:{self.accelerator_count}'
+               if self.accelerator_name else '')
+        return (f'{self.cloud}({self.region}/{self.zone}, '
+                f'{self.instance_type}{acc}){spot} '
+                f'${self.cost_per_hour:.2f}/hr')
+
+
+@functools.lru_cache(maxsize=None)
+def _load(cloud: str) -> List[CatalogEntry]:
+    path = os.path.join(_DATA_DIR, f'{cloud}.csv')
+    if not os.path.exists(path):
+        return []
+    out: List[CatalogEntry] = []
+    with open(path, newline='', encoding='utf-8') as f:
+        for row in csv.DictReader(f):
+            out.append(CatalogEntry(
+                cloud=cloud,
+                kind=row['kind'].strip(),
+                name=row['name'].strip(),
+                region=row['region'].strip(),
+                zone=row['zone'].strip(),
+                price=float(row['price']),
+                spot_price=float(row['spot_price'] or row['price']),
+                vcpus=float(row['vcpus']) if row.get('vcpus') else None,
+                memory_gb=(float(row['memory_gb'])
+                           if row.get('memory_gb') else None),
+            ))
+    return out
+
+
+def refresh() -> None:
+    """Drop cached catalog data (hook for a future hosted-catalog fetcher)."""
+    _load.cache_clear()
+
+
+def list_accelerators(name_filter: Optional[str] = None,
+                      clouds: Optional[List[str]] = None
+                      ) -> Dict[str, List[Dict]]:
+    """`sky-tpu show-accelerators` backing data.
+
+    For TPUs, expands each generation into its standard slice sizes with
+    whole-slice pricing.
+    """
+    result: Dict[str, List[Dict]] = {}
+    for cloud in clouds or ['gcp']:
+        for e in _load(cloud):
+            if e.kind == 'cpu':
+                continue
+            if e.kind == 'tpu':
+                gen = topology.TPU_GENERATIONS[e.name]
+                sizes = [1, 2, 4, 8, 16, 32, 64, 128, 256]
+                for chips in sizes:
+                    suffix = (chips * gen.cores_per_chip
+                              if gen.suffix_counts_cores else chips)
+                    try:
+                        s = topology.parse_tpu(f'{e.name}-{suffix}')
+                    except exceptions.InvalidResourcesError:
+                        continue
+                    if name_filter and name_filter.lower() not in s.name:
+                        continue
+                    result.setdefault(s.name, []).append({
+                        'cloud': cloud, 'region': e.region,
+                        'price': e.price * s.num_chips,
+                        'spot_price': e.spot_price * s.num_chips,
+                        'num_hosts': s.num_hosts,
+                        'chips': s.num_chips,
+                        'topology': s.topology_str,
+                    })
+            else:
+                if name_filter and name_filter.lower() not in e.name.lower():
+                    continue
+                result.setdefault(e.name, []).append({
+                    'cloud': cloud, 'region': e.region, 'price': e.price,
+                    'spot_price': e.spot_price, 'num_hosts': 1,
+                })
+    return result
+
+
+def get_candidates(resources: 'Resources') -> List[Candidate]:  # noqa: F821
+    """All feasible (cloud, region, zone, instance) placements for a request.
+
+    The optimizer's feasibility+pricing source (reference
+    sky/optimizer.py:1664 ``_fill_in_launchable_resources``).
+    """
+    from skypilot_tpu import resources as resources_lib
+    assert isinstance(resources, resources_lib.Resources)
+    out: List[Candidate] = []
+    if resources.cloud:
+        clouds = [resources.cloud]
+    else:
+        # Unpinned requests consider enabled real clouds only; the free
+        # in-process 'local' fake must be requested explicitly (cloud: local)
+        # or via `sky-tpu check` enabling it — otherwise its $0.00/hr would
+        # win every cost ranking.
+        from skypilot_tpu import state
+        enabled = [c for c in state.get_enabled_clouds() if c != 'local']
+        clouds = enabled or ['gcp']
+
+    for cloud in clouds:
+        if cloud == 'local':
+            out.append(_local_candidate(resources))
+            continue
+        for e in _load(cloud):
+            if resources.region and e.region != resources.region:
+                continue
+            if resources.zone and e.zone != resources.zone:
+                continue
+            price = e.spot_price if resources.use_spot else e.price
+            if resources.is_tpu:
+                s = resources.tpu
+                if e.kind != 'tpu' or e.name != s.generation:
+                    continue
+                out.append(Candidate(
+                    cloud=cloud, region=e.region, zone=e.zone,
+                    instance_type=f'tpu-{s.name}',
+                    accelerator_name=s.name, accelerator_count=1,
+                    use_spot=resources.use_spot,
+                    cost_per_hour=price * s.num_chips,
+                    num_hosts=s.num_hosts, tpu=s))
+            elif resources.accelerator_name is not None:
+                if (e.kind != 'gpu' or
+                        e.name.lower() !=
+                        resources.accelerator_name.lower()):
+                    continue
+                n = resources.accelerator_count
+                if resources.cpus and (e.vcpus or 0) * n < resources.cpus[0]:
+                    continue
+                if (resources.memory and
+                        (e.memory_gb or 0) * n < resources.memory[0]):
+                    continue
+                out.append(Candidate(
+                    cloud=cloud, region=e.region, zone=e.zone,
+                    instance_type=f'{e.name.lower()}x{n}',
+                    accelerator_name=e.name, accelerator_count=n,
+                    use_spot=resources.use_spot,
+                    cost_per_hour=price * n, num_hosts=1))
+            else:
+                if e.kind != 'cpu':
+                    continue
+                if resources.instance_type and e.name != \
+                        resources.instance_type:
+                    continue
+                if resources.cpus:
+                    amount, _ = resources.cpus
+                    if (e.vcpus or 0) < amount:
+                        continue
+                if resources.memory:
+                    amount, _ = resources.memory
+                    if (e.memory_gb or 0) < amount:
+                        continue
+                out.append(Candidate(
+                    cloud=cloud, region=e.region, zone=e.zone,
+                    instance_type=e.name, accelerator_name=None,
+                    accelerator_count=0, use_spot=resources.use_spot,
+                    cost_per_hour=price, num_hosts=1))
+    return out
+
+
+def _local_candidate(resources: 'Resources') -> Candidate:  # noqa: F821
+    """The local fake cloud: free, any shape, N-host slices become N local
+    processes."""
+    tpu = resources.tpu
+    return Candidate(
+        cloud='local', region='local', zone='local',
+        instance_type=(f'tpu-{tpu.name}' if tpu else
+                       resources.instance_type or 'local-vm'),
+        accelerator_name=resources.accelerator_name,
+        accelerator_count=resources.accelerator_count,
+        use_spot=resources.use_spot,
+        cost_per_hour=0.0,
+        num_hosts=tpu.num_hosts if tpu else 1,
+        tpu=tpu)
+
+
+def egress_cost_per_gib(src: Candidate, dst: Candidate) -> float:
+    if src.cloud != dst.cloud:
+        return CROSS_CLOUD_EGRESS
+    if src.region != dst.region:
+        return CROSS_REGION_EGRESS
+    return SAME_REGION_EGRESS

@@ -86,7 +86,9 @@ def set_nested(keys: Tuple[str, ...], value: Any) -> Dict[str, Any]:
     cfg = _effective()
     node = cfg
     for k in keys[:-1]:
-        node = node.setdefault(k, {})
+        if not isinstance(node.get(k), dict):
+            node[k] = {}
+        node = node[k]
     node[keys[-1]] = value
     return cfg
 

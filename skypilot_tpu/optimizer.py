@@ -1,0 +1,266 @@
+"""Cost/time-minimizing placement optimizer.
+
+Counterpart of the reference's ``sky/optimizer.py`` (``Optimizer.optimize``
+at :109, ``_optimize_by_dp`` at :429 for chain DAGs, ``_optimize_by_ilp``
+at :490 via pulp for general DAGs, ``_fill_in_launchable_resources``
+at :1664). pulp is not available in this environment, so general DAGs use an
+exact exhaustive search over per-task top-K candidates (small DAGs — the
+reference's own ILP instances are tiny) with a greedy fallback beyond that.
+
+Time estimates for TPU candidates are FLOPs-aware: if a task carries
+``estimated_runtime_hours`` it is assumed to be measured on the *requested*
+slice; candidate slices of other sizes in `any_of` requests scale runtime by
+relative total bf16 TFLOPs — a TPU-first touch the GPU reference lacks.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+
+_DEFAULT_RUNTIME_HOURS = 1.0
+# Exhaustive product cap for general DAGs; beyond this fall back to greedy.
+_EXHAUSTIVE_LIMIT = 200_000
+_TOP_K = 8
+
+
+class OptimizeTarget(enum.Enum):
+    COST = 'cost'
+    TIME = 'time'
+
+
+@dataclasses.dataclass
+class TaskPlan:
+    task: task_lib.Task
+    candidate: catalog.Candidate
+    run_hours: float
+    run_cost: float
+    egress_cost: float = 0.0
+
+    @property
+    def total_cost(self) -> float:
+        return self.run_cost + self.egress_cost
+
+
+@dataclasses.dataclass
+class Plan:
+    per_task: List[TaskPlan]
+    # Wall-clock = longest path through the DAG (parallel branches overlap),
+    # filled by Optimizer.optimize.
+    critical_path_hours: float = 0.0
+
+    @property
+    def total_cost(self) -> float:
+        return sum(p.total_cost for p in self.per_task)
+
+    @property
+    def total_hours(self) -> float:
+        return self.critical_path_hours
+
+
+def _candidate_resources(t: task_lib.Task) -> List[resources_lib.Resources]:
+    """Expand `any_of` alternatives (multi-resource failover requests)."""
+    base = t.resources
+    if base.any_of:
+        return [base.copy(any_of=None, **alt) for alt in base.any_of]
+    return [base]
+
+
+def _run_hours(t: task_lib.Task, ref_tpu, cand: catalog.Candidate) -> float:
+    hours = t.estimated_runtime_hours or _DEFAULT_RUNTIME_HOURS
+    # FLOPs-aware rescale across TPU slice sizes. `ref_tpu` is the slice the
+    # estimate was made on (the task's base request / first alternative).
+    if ref_tpu is not None and cand.tpu is not None:
+        cand_flops = cand.tpu.total_bf16_tflops
+        if cand_flops > 0:
+            hours = hours * ref_tpu.total_bf16_tflops / cand_flops
+    return hours
+
+
+def _fill_candidates(t: task_lib.Task,
+                     target: OptimizeTarget,
+                     blocked: Optional[List[catalog.Candidate]] = None
+                     ) -> List[TaskPlan]:
+    """Feasible, priced, sorted placements for one task
+    (reference _fill_in_launchable_resources, sky/optimizer.py:1664)."""
+    plans: List[TaskPlan] = []
+    blocked_keys = {(b.cloud, b.region, b.zone, b.instance_type)
+                    for b in (blocked or [])}
+    alternatives = _candidate_resources(t)
+    # Runtime estimates are anchored to the first alternative's slice.
+    ref_tpu = next((r.tpu for r in alternatives if r.tpu is not None), None)
+    for req in alternatives:
+        for cand in catalog.get_candidates(req):
+            if (cand.cloud, cand.region, cand.zone,
+                    cand.instance_type) in blocked_keys:
+                continue
+            hours = _run_hours(t, ref_tpu, cand)
+            plans.append(TaskPlan(task=t, candidate=cand, run_hours=hours,
+                                  run_cost=hours * cand.cost_per_hour))
+    if not plans:
+        raise exceptions.ResourcesUnavailableError(
+            f'No feasible placement for task {t.name or "<unnamed>"} '
+            f'with resources {t.resources!r}. Check the catalog/regions.')
+    key = ((lambda p: (p.run_cost, p.run_hours))
+           if target is OptimizeTarget.COST
+           else (lambda p: (p.run_hours, p.run_cost)))
+    plans.sort(key=key)
+    return plans
+
+
+def _egress(src: TaskPlan, dst: TaskPlan) -> float:
+    gib = src.task.estimated_output_gib or 0.0
+    return gib * catalog.egress_cost_per_gib(src.candidate, dst.candidate)
+
+
+def _optimize_chain(order: List[task_lib.Task],
+                    cands: Dict[int, List[TaskPlan]],
+                    target: OptimizeTarget) -> List[TaskPlan]:
+    """DP over a chain (reference _optimize_by_dp, sky/optimizer.py:429)."""
+    # dp[j] = best objective ending with candidate j of current task.
+    def obj(p: TaskPlan) -> float:
+        return p.total_cost if target is OptimizeTarget.COST else p.run_hours
+
+    prev_plans = cands[0]
+    dp: List[Tuple[float, List[TaskPlan]]] = [
+        (obj(p), [p]) for p in prev_plans]
+    for i in range(1, len(order)):
+        new_dp: List[Tuple[float, List[TaskPlan]]] = []
+        for p in cands[i]:
+            best: Optional[Tuple[float, List[TaskPlan]]] = None
+            for (score, path) in dp:
+                e = _egress(path[-1], p)
+                cand_plan = dataclasses.replace(p, egress_cost=e)
+                s = score + obj(cand_plan)
+                if best is None or s < best[0]:
+                    best = (s, path + [cand_plan])
+            assert best is not None
+            new_dp.append(best)
+        dp = new_dp
+    return min(dp, key=lambda sp: sp[0])[1]
+
+
+def _optimize_general(dag: dag_lib.Dag,
+                      order: List[task_lib.Task],
+                      cands: Dict[int, List[TaskPlan]],
+                      target: OptimizeTarget) -> List[TaskPlan]:
+    """Exact search over top-K candidates per task; greedy fallback.
+
+    Replaces the reference's pulp ILP (sky/optimizer.py:490) — exact for the
+    DAG sizes the reference itself solves (tens of tasks would exceed its
+    ILP too).
+    """
+    idx_of = {id(t): i for i, t in enumerate(order)}
+    parents: Dict[int, List[int]] = {
+        i: [idx_of[id(p)] for p in dag.parents(t)]
+        for i, t in enumerate(order)}
+
+    def obj(p: TaskPlan) -> float:
+        return p.total_cost if target is OptimizeTarget.COST else p.run_hours
+
+    tops = {i: cands[i][:_TOP_K] for i in range(len(order))}
+    space = 1
+    for i in tops:
+        space *= len(tops[i])
+    if space <= _EXHAUSTIVE_LIMIT:
+        best_score, best_sel = float('inf'), None
+        for sel in itertools.product(*[tops[i] for i in range(len(order))]):
+            score = 0.0
+            sel_list = list(sel)
+            for i, p in enumerate(sel_list):
+                e = sum(_egress(sel_list[pi], p) for pi in parents[i])
+                score += obj(dataclasses.replace(p, egress_cost=e))
+            if score < best_score:
+                best_score, best_sel = score, sel_list
+        assert best_sel is not None
+        return [
+            dataclasses.replace(
+                p, egress_cost=sum(_egress(best_sel[pi], p)
+                                   for pi in parents[i]))
+            for i, p in enumerate(best_sel)
+        ]
+    # Greedy: pick each task's best given already-placed parents.
+    chosen: List[TaskPlan] = []
+    for i in range(len(order)):
+        best = None
+        for p in tops[i]:
+            e = sum(_egress(chosen[pi], p) for pi in parents[i])
+            scored = dataclasses.replace(p, egress_cost=e)
+            if best is None or obj(scored) < obj(best):
+                best = scored
+        chosen.append(best)
+    return chosen
+
+
+class Optimizer:
+    """Reference sky/optimizer.py:109 ``Optimizer.optimize``."""
+
+    @staticmethod
+    def optimize(dag: dag_lib.Dag,
+                 target: OptimizeTarget = OptimizeTarget.COST,
+                 blocked: Optional[List[catalog.Candidate]] = None,
+                 quiet: bool = False) -> Plan:
+        order = dag.topological_order()
+        cands = {i: _fill_candidates(t, target, blocked)
+                 for i, t in enumerate(order)}
+        if dag.is_chain() or len(order) == 1:
+            chosen = _optimize_chain(order, cands, target)
+        else:
+            chosen = _optimize_general(dag, order, cands, target)
+        for p in chosen:
+            c = p.candidate
+            cfg = {
+                'cloud': c.cloud,
+                'region': c.region,
+                'zone': c.zone,
+                'use_spot': c.use_spot,
+            }
+            if c.tpu is not None:
+                cfg['accelerators'] = c.tpu.name
+            elif c.accelerator_name:
+                cfg['accelerators'] = (
+                    f'{c.accelerator_name}:{c.accelerator_count}')
+            else:
+                cfg['instance_type'] = c.instance_type
+            p.task.best_resources = resources_lib.Resources.from_yaml_config(
+                cfg)
+        # Critical path over the DAG (longest run_hours chain).
+        hours_of = {id(p.task): p.run_hours for p in chosen}
+        finish: Dict[int, float] = {}
+        for t in order:
+            start = max((finish[id(p)] for p in dag.parents(t)), default=0.0)
+            finish[id(t)] = start + hours_of[id(t)]
+        plan = Plan(per_task=chosen,
+                    critical_path_hours=max(finish.values(), default=0.0))
+        if not quiet:
+            print(format_plan(plan))
+        return plan
+
+
+def format_plan(plan: Plan) -> str:
+    lines = ['Optimizer plan:']
+    for p in plan.per_task:
+        lines.append(
+            f'  {p.task.name or "<task>"}: {p.candidate} '
+            f'~{p.run_hours:.2f}h  run ${p.run_cost:.2f}'
+            + (f'  egress ${p.egress_cost:.2f}' if p.egress_cost else ''))
+    lines.append(f'  total: ${plan.total_cost:.2f} '
+                 f'(~{plan.total_hours:.2f}h)')
+    return '\n'.join(lines)
+
+
+def optimize(dag_or_task, target: OptimizeTarget = OptimizeTarget.COST,
+             quiet: bool = False) -> Plan:
+    """Convenience wrapper accepting a Task or a Dag."""
+    if isinstance(dag_or_task, task_lib.Task):
+        d = dag_lib.Dag()
+        d.add(dag_or_task)
+        dag_or_task = d
+    return Optimizer.optimize(dag_or_task, target, quiet=quiet)

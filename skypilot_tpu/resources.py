@@ -125,6 +125,10 @@ class Resources:
         self._tpu: Optional[topology.TpuSlice] = (
             topology.parse_tpu(self._accelerator_name)
             if self._accelerator_name else None)
+        if self._tpu is not None:
+            # Canonicalize spelling ('tpu-v5e-8'/'v5litepod-8' → 'v5e-8') so
+            # __eq__/__hash__/round-trip treat identical slices identically.
+            self._accelerator_name = self._tpu.name
         self._cpus = self._parse_scalar(cpus, 'cpus')
         self._memory = self._parse_scalar(memory, 'memory')
         self._instance_type = instance_type

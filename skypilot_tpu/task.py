@@ -82,6 +82,11 @@ class Task:
         self.config_overrides = dict(config_overrides or {})
         # Filled by the optimizer (reference: best_resources on Task).
         self.best_resources: Optional[resources_lib.Resources] = None
+        # Optional optimizer hints (reference Task.set_time_estimator /
+        # outputs-size analogs): estimated runtime at the requested shape,
+        # and output artifact size for egress cost between DAG stages.
+        self.estimated_runtime_hours: Optional[float] = None
+        self.estimated_output_gib: Optional[float] = None
         self._validate()
 
     # ------------------------------------------------------------------

@@ -19,7 +19,6 @@ Accepts an optional ``tpu-`` prefix (``tpu-v5e-8``).
 from __future__ import annotations
 
 import dataclasses
-import math
 import re
 from typing import Dict, Optional, Tuple
 
@@ -200,13 +199,34 @@ def parse_tpu(name: str) -> Optional[TpuSlice]:
     else:
         num_chips = count
     num_cores = num_chips * gen.cores_per_chip
+    def _unit(chips: int) -> str:
+        # Error messages speak the user's units (cores for v2-v4/v5p names).
+        if gen.suffix_counts_cores:
+            return f'{gen.name}-{chips * gen.cores_per_chip}'
+        return f'{gen.name}-{chips}'
+
     if num_chips <= gen.max_chips_single_host:
+        if num_chips & (num_chips - 1) != 0:
+            valid = [_unit(c) for c in (1, 2, 4, 8)
+                     if c <= gen.max_chips_single_host]
+            raise exceptions.InvalidResourcesError(
+                f'{name!r}: single-host {gen.name} slices must have a '
+                f'power-of-two chip count; valid single-host sizes: '
+                f'{", ".join(valid)}')
         num_hosts, chips_per_host = 1, num_chips
     else:
+        if gen.ici_dims == 2 and num_chips & (num_chips - 1) != 0:
+            # 2D-torus generations (v2/v3/v5e/v6e) are catalogued only at
+            # power-of-two sizes; 3D generations (v4/v5p) support
+            # rectangular topologies like 2x2x6 (v5p-48).
+            raise exceptions.InvalidResourcesError(
+                f'{name!r}: multi-host {gen.name} slices must have a '
+                f'power-of-two chip count (e.g. {_unit(16)}, {_unit(32)})')
         if num_chips % gen.chips_per_host != 0:
             raise exceptions.InvalidResourcesError(
                 f'{name!r}: multi-host slice must be a multiple of '
-                f'{gen.chips_per_host} chips')
+                f'{gen.chips_per_host} chips ({_unit(gen.chips_per_host)} '
+                f'increments)')
         chips_per_host = gen.chips_per_host
         num_hosts = num_chips // chips_per_host
     return TpuSlice(

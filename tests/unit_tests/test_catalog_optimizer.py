@@ -1,0 +1,161 @@
+"""Catalog feasibility + optimizer placement tests.
+
+Offline by design — the reference's strongest test asset is the
+`enable_all_clouds` fixture running the real optimizer against bundled
+catalog CSVs with zero credentials (reference
+tests/common_test_fixtures.py:194); this suite does the same against the
+bundled snapshot catalog.
+"""
+import pytest
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.optimizer import Optimizer, OptimizeTarget, optimize
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+def test_tpu_candidates_parametric_pricing():
+    cands = catalog.get_candidates(Resources(cloud='gcp',
+                                             accelerators='v5e-16'))
+    assert cands, 'v5e must be available'
+    us = [c for c in cands if c.region == 'us-central1'][0]
+    assert us.cost_per_hour == pytest.approx(1.2 * 16)
+    assert us.num_hosts == 4
+    assert us.tpu.num_chips == 16
+
+
+def test_spot_pricing():
+    on = catalog.get_candidates(Resources(cloud='gcp', accelerators='v5p-8'))
+    sp = catalog.get_candidates(
+        Resources(cloud='gcp', accelerators='v5p-8', use_spot=True))
+    assert sp[0].cost_per_hour < on[0].cost_per_hour
+
+
+def test_region_filter():
+    cands = catalog.get_candidates(
+        Resources(cloud='gcp', accelerators='v5e-8', region='europe-west4'))
+    assert all(c.region == 'europe-west4' for c in cands)
+    assert len(cands) == 1
+
+
+def test_cpu_feasibility():
+    cands = catalog.get_candidates(Resources(cloud='gcp', cpus='16+'))
+    assert cands
+    assert all((c.accelerator_name is None) for c in cands)
+    # All must have >= 16 vcpus: n2-standard-16/32 only.
+    assert {c.instance_type for c in cands} == {'n2-standard-16',
+                                                'n2-standard-32'}
+
+
+def test_local_cloud_free():
+    cands = catalog.get_candidates(
+        Resources(cloud='local', accelerators='v5e-8'))
+    assert len(cands) == 1
+    assert cands[0].cost_per_hour == 0.0
+    assert cands[0].num_hosts == 1
+
+
+def test_optimizer_picks_cheapest():
+    t = Task('t', run='x', resources=Resources(cloud='gcp',
+                                               accelerators='v5e-8'))
+    t.estimated_runtime_hours = 2.0
+    plan = optimize(t, quiet=True)
+    # us regions at $1.2/chip-hr beat europe at $1.32.
+    assert plan.per_task[0].candidate.region.startswith('us')
+    assert plan.per_task[0].run_cost == pytest.approx(2.0 * 1.2 * 8)
+    assert t.best_resources is not None
+    assert t.best_resources.region.startswith('us')
+
+
+def test_optimizer_infeasible():
+    t = Task('t', run='x',
+             resources=Resources(cloud='gcp', accelerators='v5e-8',
+                                 region='nowhere-east1'))
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        optimize(t, quiet=True)
+
+
+def test_chain_dp_avoids_egress():
+    # Producer emits 1000 GiB; cross-region egress ($0.01/GiB = $10) should
+    # pull the consumer into the producer's region even if slightly pricier
+    # elsewhere... construct: producer pinned to europe-west4, consumer free.
+    a = Task('a', run='x', resources=Resources(
+        cloud='gcp', accelerators='v5e-8', region='europe-west4'))
+    a.estimated_runtime_hours = 1.0
+    a.estimated_output_gib = 1000.0
+    b = Task('b', run='y', resources=Resources(cloud='gcp',
+                                               accelerators='v5e-8'))
+    b.estimated_runtime_hours = 1.0
+    dag = Dag()
+    dag.add_edge(a, b)
+    plan = Optimizer.optimize(dag, quiet=True)
+    # Same-region v5e-8 costs 1.32*8=$10.56 vs us 1.2*8=$9.6+$10 egress.
+    assert plan.per_task[1].candidate.region == 'europe-west4'
+    assert plan.per_task[1].egress_cost == 0.0
+
+    # With tiny output, consumer should flee to the cheaper US region.
+    a.estimated_output_gib = 1.0
+    plan2 = Optimizer.optimize(dag, quiet=True)
+    assert plan2.per_task[1].candidate.region.startswith('us')
+
+
+def test_time_target_prefers_bigger_flops():
+    # any_of across slice sizes: TIME target picks the larger slice.
+    t = Task('t', run='x', resources=Resources.from_yaml_config({
+        'cloud': 'gcp',
+        'any_of': [{'accelerators': 'v5e-8'}, {'accelerators': 'v5e-16'}],
+    }))
+    t.estimated_runtime_hours = 4.0
+    plan_cost = optimize(t, target=OptimizeTarget.COST, quiet=True)
+    t2 = Task('t2', run='x', resources=t.resources)
+    t2.estimated_runtime_hours = 4.0
+    plan_time = optimize(t2, target=OptimizeTarget.TIME, quiet=True)
+    assert plan_time.per_task[0].candidate.tpu.num_chips == 16
+    # COST target: same $/chip-hr, FLOPs-aware runtime scaling makes the
+    # bigger slice equal cost; either acceptable, but runtime halves.
+    assert plan_time.per_task[0].run_hours < 4.0
+    assert plan_cost.per_task[0].run_cost == pytest.approx(
+        plan_time.per_task[0].run_cost)
+
+
+def test_tpu_vs_gpu_ranking():
+    # The north-star scenario: optimizer cost-ranks TPU vs GPU candidates
+    # for the same job (BASELINE.json north_star).
+    t = Task('t', run='x', resources=Resources.from_yaml_config({
+        'cloud': 'gcp',
+        'any_of': [{'accelerators': 'tpu-v5e-8'}, {'accelerators': 'H100:8'}],
+    }))
+    t.estimated_runtime_hours = 1.0
+    plan = optimize(t, quiet=True)
+    # v5e-8: $9.6/hr vs H100:8: $88.5/hr (same assumed runtime).
+    assert plan.per_task[0].candidate.tpu is not None
+
+
+def test_general_dag_exact():
+    # Diamond DAG: a -> b, a -> c, b -> d, c -> d.
+    mk = lambda n: Task(n, run=n, resources=Resources(
+        cloud='gcp', accelerators='v5e-4'))
+    a, b, c, d = mk('a'), mk('b'), mk('c'), mk('d')
+    a.estimated_output_gib = 500.0
+    b.estimated_output_gib = 500.0
+    c.estimated_output_gib = 500.0
+    dag = Dag()
+    dag.add_edge(a, b)
+    dag.add_edge(a, c)
+    dag.add_edge(b, d)
+    dag.add_edge(c, d)
+    assert not dag.is_chain()
+    plan = Optimizer.optimize(dag, quiet=True)
+    regions = {p.candidate.region for p in plan.per_task}
+    # Heavy egress → all four co-located.
+    assert len(regions) == 1
+
+
+def test_list_accelerators():
+    accs = catalog.list_accelerators(name_filter='v5p')
+    assert any(k.startswith('v5p') for k in accs)
+    v5p8 = accs['v5p-8'][0]
+    assert v5p8['chips'] == 4
+    assert v5p8['price'] == pytest.approx(4.2 * 4)
