@@ -1,0 +1,62 @@
+"""Pluggable admin policy hook (reference sky/admin_policy.py).
+
+Every launch passes its task through the configured policy, which may
+mutate or reject it (reference applies it at sky/execution.py:252).
+Configure with::
+
+    admin_policy: mypkg.mymodule.MyPolicy
+
+in the layered config; the class must implement
+``validate_and_mutate(user_request) -> MutatedUserRequest``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+
+
+@dataclasses.dataclass
+class UserRequest:
+    task: task_lib.Task
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    task: task_lib.Task
+
+
+class AdminPolicy:
+    """Base class: identity policy."""
+
+    def validate_and_mutate(self,
+                            user_request: UserRequest) -> MutatedUserRequest:
+        return MutatedUserRequest(task=user_request.task)
+
+
+def _load_policy() -> Optional[AdminPolicy]:
+    path = config_lib.get_nested(('admin_policy',))
+    if not path:
+        return None
+    module_name, _, cls_name = str(path).rpartition('.')
+    try:
+        cls = getattr(importlib.import_module(module_name), cls_name)
+        policy = cls()
+    except (ImportError, AttributeError) as e:
+        raise exceptions.InvalidTaskError(
+            f'admin_policy {path!r} could not be loaded: {e}') from e
+    if not isinstance(policy, AdminPolicy):
+        raise exceptions.InvalidTaskError(
+            f'admin_policy {path!r} is not an AdminPolicy subclass')
+    return policy
+
+
+def apply(task: task_lib.Task) -> task_lib.Task:
+    policy = _load_policy()
+    if policy is None:
+        return task
+    return policy.validate_and_mutate(UserRequest(task=task)).task

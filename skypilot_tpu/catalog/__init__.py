@@ -155,10 +155,10 @@ def get_candidates(resources: 'Resources') -> List[Candidate]:  # noqa: F821
     if resources.cloud:
         clouds = [resources.cloud]
     else:
-        # Unpinned requests consider enabled real clouds only; the free
-        # in-process 'local' fake must be requested explicitly (cloud: local)
-        # or via `sky-tpu check` enabling it — otherwise its $0.00/hr would
-        # win every cost ranking.
+        # Unpinned requests consider enabled *real* clouds only. The free
+        # in-process 'local' fake is never auto-selected — its $0.00/hr
+        # would win every cost ranking — it must be pinned explicitly with
+        # `cloud: local`.
         from skypilot_tpu import state
         enabled = [c for c in state.get_enabled_clouds() if c != 'local']
         clouds = enabled or ['gcp']
@@ -207,13 +207,17 @@ def get_candidates(resources: 'Resources') -> List[Candidate]:  # noqa: F821
                 if resources.instance_type and e.name != \
                         resources.instance_type:
                     continue
+                # '8+' is a minimum; bare '8' means exactly 8 (the
+                # reference's cpus/memory semantics).
                 if resources.cpus:
-                    amount, _ = resources.cpus
-                    if (e.vcpus or 0) < amount:
+                    amount, is_min = resources.cpus
+                    have = e.vcpus or 0
+                    if have < amount or (not is_min and have != amount):
                         continue
                 if resources.memory:
-                    amount, _ = resources.memory
-                    if (e.memory_gb or 0) < amount:
+                    amount, is_min = resources.memory
+                    have = e.memory_gb or 0
+                    if have < amount or (not is_min and have != amount):
                         continue
                 out.append(Candidate(
                     cloud=cloud, region=e.region, zone=e.zone,

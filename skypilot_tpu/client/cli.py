@@ -1,0 +1,250 @@
+"""`sky-tpu` command-line interface.
+
+Counterpart of the reference's click CLI (reference sky/client/cli/
+command.py, 7,856 LoC). Commands call the engine directly when no API
+server is configured, or go through the SDK/API server when
+``SKY_TPU_API_SERVER`` is set (reference architecture: CLI → SDK → server;
+the direct path matches the reference's early engine-only mode that
+SURVEY.md §7 stage 4 recommends building first).
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+import click
+
+import skypilot_tpu as sky
+from skypilot_tpu.utils import common
+
+
+def _engine():
+    """Engine facade: direct or via SDK depending on config."""
+    if os.environ.get('SKY_TPU_API_SERVER'):
+        from skypilot_tpu.client import sdk
+        return sdk
+    from skypilot_tpu import core
+    return core
+
+
+@click.group()
+@click.version_option(sky.__version__)
+def cli() -> None:
+    """sky-tpu: TPU-native workload orchestrator."""
+
+
+def _load_task(yaml_path: str, env: tuple) -> 'sky.Task':
+    overrides = {}
+    for e in env:
+        k, _, v = e.partition('=')
+        overrides[k] = v
+    return sky.Task.from_yaml(yaml_path, env_overrides=overrides or None)
+
+
+@cli.command()
+@click.argument('task_yaml')
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--cloud', default=None, help='Override cloud.')
+@click.option('--env', multiple=True, help='KEY=VALUE env override.')
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+@click.option('--down', 'autodown', is_flag=True, default=False,
+              help='Autodown the cluster when the job finishes.')
+def launch(task_yaml: str, cluster: Optional[str], cloud: Optional[str],
+           env: tuple, detach_run: bool, yes: bool, autodown: bool) -> None:
+    """Launch a task from a YAML spec (provision + run)."""
+    task = _load_task(task_yaml, env)
+    if cloud:
+        task.set_resources(task.resources.copy(cloud=cloud))
+    if not yes:
+        click.confirm(
+            f'Launching {task.name or task_yaml} '
+            f'({task.resources!r}, {task.num_nodes} host(s)). Proceed?',
+            abort=True)
+    engine = _engine()
+    job_id, info = engine.launch(task, cluster_name=cluster, quiet=False)
+    name = info.cluster_name
+    click.echo(f'Cluster: {name}  job: {job_id}')
+    if job_id >= 0 and not detach_run:
+        for chunk in engine.tail_logs(name, job_id, follow=True):
+            sys.stdout.buffer.write(chunk)
+            sys.stdout.buffer.flush()
+        st = engine.job_status(name, job_id)
+        click.echo(f'Job {job_id}: {st.value}')
+        if autodown:
+            engine.down(name)
+            click.echo(f'Cluster {name} downed.')
+        if st != common.JobStatus.SUCCEEDED:
+            sys.exit(100)
+
+
+@cli.command('exec')
+@click.argument('cluster')
+@click.argument('task_yaml')
+@click.option('--env', multiple=True)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+def exec_cmd(cluster: str, task_yaml: str, env: tuple,
+             detach_run: bool) -> None:
+    """Run a task on an existing cluster (skips provision/setup)."""
+    task = _load_task(task_yaml, env)
+    engine = _engine()
+    job_id, _ = engine.exec(task, cluster)
+    click.echo(f'Job: {job_id}')
+    if not detach_run:
+        for chunk in engine.tail_logs(cluster, job_id, follow=True):
+            sys.stdout.buffer.write(chunk)
+            sys.stdout.buffer.flush()
+
+
+@cli.command()
+@click.option('--refresh', '-r', is_flag=True, default=False)
+def status(refresh: bool) -> None:
+    """Show clusters."""
+    records = _engine().status(refresh=refresh)
+    if not records:
+        click.echo('No clusters.')
+        return
+    fmt = '{:<18} {:<10} {:<26} {:<8} {:<14}'
+    click.echo(fmt.format('NAME', 'STATUS', 'RESOURCES', 'HOSTS',
+                          'AUTOSTOP'))
+    for r in records:
+        res = r['resources']
+        acc = res.get('accelerators') or res.get('instance_type', '-')
+        hosts = len((r['cluster_info'] or {}).get('hosts', [])) or 1
+        astop = (f"{r['autostop_minutes']}m"
+                 f"{' (down)' if r['autostop_down'] else ''}"
+                 if r['autostop_minutes'] >= 0 else '-')
+        click.echo(fmt.format(r['name'], r['status'].value,
+                              f"{res.get('cloud', '?')}:{acc}", hosts,
+                              astop))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+@click.option('--rank', type=int, default=0,
+              help='Which host rank log to stream.')
+def logs(cluster: str, job_id: int, no_follow: bool, rank: int) -> None:
+    """Stream a job's logs."""
+    for chunk in _engine().tail_logs(cluster, job_id,
+                                     follow=not no_follow, rank=rank):
+        sys.stdout.buffer.write(chunk)
+        sys.stdout.buffer.flush()
+
+
+@cli.command()
+@click.argument('cluster')
+def queue(cluster: str) -> None:
+    """Show a cluster's job queue."""
+    jobs = _engine().queue(cluster)
+    fmt = '{:<6} {:<16} {:<12} {:<8}'
+    click.echo(fmt.format('ID', 'NAME', 'STATUS', 'HOSTS'))
+    for j in jobs:
+        click.echo(fmt.format(j['job_id'], j['name'], j['status'],
+                              j['num_hosts']))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int)
+def cancel(cluster: str, job_id: int) -> None:
+    """Cancel a job."""
+    _engine().cancel(cluster, job_id)
+    click.echo(f'Cancelled job {job_id} on {cluster}.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def stop(cluster: str, yes: bool) -> None:
+    """Stop a cluster (keep disk)."""
+    if not yes:
+        click.confirm(f'Stop cluster {cluster}?', abort=True)
+    _engine().stop(cluster)
+    click.echo(f'Cluster {cluster} stopped.')
+
+
+@cli.command()
+@click.argument('cluster')
+def start(cluster: str) -> None:
+    """Restart a stopped cluster."""
+    _engine().start(cluster)
+    click.echo(f'Cluster {cluster} started.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def down(cluster: str, yes: bool) -> None:
+    """Terminate a cluster."""
+    if not yes:
+        click.confirm(f'Terminate cluster {cluster}?', abort=True)
+    _engine().down(cluster)
+    click.echo(f'Cluster {cluster} terminated.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, required=True)
+@click.option('--down', 'down_', is_flag=True, default=False)
+def autostop(cluster: str, idle_minutes: int, down_: bool) -> None:
+    """Set autostop/autodown after idleness."""
+    _engine().autostop(cluster, idle_minutes, down_)
+    click.echo(f'{cluster}: autostop {idle_minutes}m'
+               f'{" then down" if down_ else ""}.')
+
+
+@cli.command()
+def check() -> None:
+    """Probe cloud credentials."""
+    results = _engine().check()
+    for cloud, ok in results.items():
+        click.echo(f'  {cloud}: {"enabled" if ok else "disabled"}')
+
+
+@cli.command('show-accelerators')
+@click.option('--filter', 'name_filter', default=None)
+def show_accelerators(name_filter: Optional[str]) -> None:
+    """List accelerators with pricing."""
+    from skypilot_tpu import catalog
+    accs = catalog.list_accelerators(name_filter=name_filter)
+    fmt = '{:<12} {:<8} {:<6} {:<10} {:>10} {:>10}'
+    click.echo(fmt.format('ACCELERATOR', 'CLOUD', 'HOSTS', 'TOPOLOGY',
+                          '$/HR', 'SPOT $/HR'))
+    for name in sorted(accs):
+        for o in accs[name]:
+            click.echo(fmt.format(
+                name, o['cloud'], o.get('num_hosts', 1),
+                o.get('topology', '-'),
+                f"{o['price']:.2f}", f"{o['spot_price']:.2f}"))
+
+
+@cli.command('cost-report')
+def cost_report() -> None:
+    """Cost of terminated clusters."""
+    rows = _engine().cost_report()
+    fmt = '{:<18} {:>10} {:>10}'
+    click.echo(fmt.format('CLUSTER', 'HOURS', 'COST $'))
+    for r in rows:
+        click.echo(fmt.format(r['name'], f"{r['duration_hours']:.2f}",
+                              f"{r['cost']:.2f}"))
+
+
+def main() -> None:
+    try:
+        cli(standalone_mode=False)
+    except click.Abort:
+        click.echo('Aborted.')
+        sys.exit(1)
+    except click.ClickException as e:
+        e.show()
+        sys.exit(e.exit_code)
+    except sky.exceptions.SkyTpuError as e:
+        click.echo(f'Error: {e}', err=True)
+        sys.exit(1)
+
+
+if __name__ == '__main__':
+    main()

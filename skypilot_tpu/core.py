@@ -1,0 +1,188 @@
+"""Server-side core operations (reference sky/core.py).
+
+status/start/stop/down/autostop/queue/cancel/tail_logs/cost_report — thin
+over state + provision + backend, each under the cluster lock.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Iterator, List, Optional
+
+from skypilot_tpu import backend as backend_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu import state
+from skypilot_tpu.execution import exec as exec_  # noqa: F401 (re-export)
+from skypilot_tpu.execution import launch  # noqa: F401 (re-export)
+
+exec = exec_  # noqa: A001 — public API name matches the reference's sky.exec
+from skypilot_tpu.optimizer import optimize  # noqa: F401 (re-export)
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.runtime import agent_client
+from skypilot_tpu.utils import common
+from skypilot_tpu.utils import locks
+
+logger = logging.getLogger(__name__)
+
+
+def _info_of(record: Dict[str, Any]) -> ClusterInfo:
+    return ClusterInfo.from_dict(record['cluster_info'])
+
+
+def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Reconcile DB status with the provider's truth (reference
+    backend_utils status refresh; autostop self-teardown shows up here)."""
+    name = record['name']
+    if not record['cluster_info']:
+        return record
+    info = _info_of(record)
+    live = provision.get_cluster_info(info.cloud, name, info.provider_config)
+    if live is None:
+        # Self-terminated (autodown) or externally deleted.
+        state.remove_cluster(name)
+        record = dict(record)
+        record['status'] = None
+        return record
+    states = {h.state for h in live.hosts}
+    if states == {'RUNNING'}:
+        new = common.ClusterStatus.UP
+    elif 'TERMINATED' in states or 'PREEMPTED' in states:
+        # Partial death of a gang = the slice is gone for scheduling
+        # purposes (atomicity).
+        new = common.ClusterStatus.INIT
+    else:
+        new = common.ClusterStatus.STOPPED
+    if new != record['status']:
+        state.add_or_update_cluster(name, new, cluster_info=live.to_dict())
+        record = state.get_cluster(name)
+    return record
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Reference sky/core.py:112."""
+    records = state.get_clusters()
+    if cluster_names:
+        records = [r for r in records if r['name'] in cluster_names]
+    if refresh:
+        records = [r for r in (_refresh_one(r) for r in records)
+                   if r.get('status') is not None]
+    return records
+
+
+def _get_record(cluster_name: str) -> Dict[str, Any]:
+    record = state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist '
+            f'(`sky-tpu status` lists live clusters).')
+    return record
+
+
+def start(cluster_name: str) -> None:
+    """Reference sky/core.py:647."""
+    with locks.cluster_lock(cluster_name):
+        record = _get_record(cluster_name)
+        info = _info_of(record)
+        new_info = provision.start_instances(info.cloud, cluster_name,
+                                             info.provider_config)
+        state.add_or_update_cluster(cluster_name, common.ClusterStatus.UP,
+                                    cluster_info=new_info.to_dict())
+        state.add_cluster_event(cluster_name, 'STARTED', 'restarted')
+
+
+def stop(cluster_name: str) -> None:
+    """Reference sky/core.py:847."""
+    with locks.cluster_lock(cluster_name):
+        record = _get_record(cluster_name)
+        backend_lib.TpuVmBackend().teardown(_info_of(record),
+                                            terminate=False)
+
+
+def down(cluster_name: str) -> None:
+    """Reference sky/core.py:798."""
+    with locks.cluster_lock(cluster_name):
+        record = _get_record(cluster_name)
+        backend_lib.TpuVmBackend().teardown(_info_of(record),
+                                            terminate=True)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_: bool = False) -> None:
+    """Reference sky/core.py:926."""
+    with locks.cluster_lock(cluster_name):
+        record = _get_record(cluster_name)
+        backend_lib.TpuVmBackend().set_autostop(
+            _info_of(record), idle_minutes, down_)
+
+
+def _client_for(cluster_name: str) -> agent_client.AgentClient:
+    record = _get_record(cluster_name)
+    info = _info_of(record)
+    if not info.head.agent_url:
+        raise exceptions.ClusterNotUpError(
+            f'{cluster_name} has no live agent')
+    return agent_client.AgentClient(info.head.agent_url)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    """Job queue of a cluster (reference sky/core.py queue)."""
+    return _client_for(cluster_name).jobs()
+
+
+def cancel(cluster_name: str, job_id: int) -> None:
+    """Reference sky/core.py:1146."""
+    _client_for(cluster_name).cancel(job_id)
+
+
+def tail_logs(cluster_name: str, job_id: int, *, follow: bool = True,
+              rank: int = 0) -> Iterator[bytes]:
+    """Reference sky/core.py:1243."""
+    yield from _client_for(cluster_name).tail_logs(job_id, follow=follow,
+                                                   rank=rank)
+
+
+def job_status(cluster_name: str, job_id: int) -> common.JobStatus:
+    return _client_for(cluster_name).job_status(job_id)
+
+
+def wait_job(cluster_name: str, job_id: int,
+             timeout: float = 3600.0) -> common.JobStatus:
+    return _client_for(cluster_name).wait_job(job_id, timeout)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Historical cluster costs (reference sky/core.py cost-report)."""
+    out = []
+    for h in state.get_cluster_history():
+        hours = h['duration_s'] / 3600.0
+        out.append({
+            'name': h['name'],
+            'duration_hours': round(hours, 3),
+            'cost': round(hours * (h['cost_per_hour'] or 0.0), 4),
+            'resources': h['resources'],
+            'num_hosts': h['num_hosts'],
+        })
+    return out
+
+
+def check(clouds: Optional[List[str]] = None) -> Dict[str, bool]:
+    """Probe cloud credentials and record enabled clouds (reference
+    sky/check.py: `sky check`)."""
+    results: Dict[str, bool] = {}
+    for cloud in clouds or ['local', 'gcp']:
+        if cloud == 'local':
+            results[cloud] = True
+            continue
+        if cloud == 'gcp':
+            try:
+                import google.auth
+                google.auth.default(scopes=[
+                    'https://www.googleapis.com/auth/cloud-platform'])
+                results[cloud] = True
+            except Exception:  # noqa: BLE001
+                results[cloud] = False
+            continue
+        results[cloud] = False
+    state.set_enabled_clouds([c for c, ok in results.items() if ok])
+    return results

@@ -1,0 +1,142 @@
+"""Object-store storage: buckets as task inputs/outputs + cluster mounts.
+
+Counterpart of the reference's ``sky/data/storage.py`` (Storage +
+AbstractStore impls, S3/GCS/... at :515-4386) and ``mounting_utils.py``.
+GCS-first (the TPU cloud); the store abstraction keeps the same three
+mount modes. Bucket ops use ``gsutil``/``gcloud storage`` CLI when
+credentials exist; everything degrades to clear errors offline.
+
+The managed-jobs checkpoint/resume convention (reference pattern:
+llm/llama-3_1-finetuning/lora.yaml:27-31) builds on ``MOUNT`` mode: jobs
+write Orbax checkpoints into a mounted bucket; recovery re-runs the task
+which resumes from the bucket.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.runtime import agent_client
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'              # FUSE mount (gcsfuse)
+    COPY = 'COPY'                # one-time copy onto disk
+    MOUNT_CACHED = 'MOUNT_CACHED'  # FUSE with local cache
+
+
+class StoreType(enum.Enum):
+    GCS = 'gcs'
+    LOCAL = 'local'              # file:// — used by tests and fake slices
+
+
+def _store_type(source: str) -> StoreType:
+    if source.startswith('gs://'):
+        return StoreType.GCS
+    if source.startswith('file://') or source.startswith('/'):
+        return StoreType.LOCAL
+    raise exceptions.StorageError(
+        f'Unsupported storage source {source!r} (gs:// or file:// paths)')
+
+
+def mount_command(dst: str, source: str,
+                  mode: StorageMode = StorageMode.MOUNT) -> str:
+    """Shell command that makes `source` visible at `dst` on a host.
+
+    Runs via the agent on every host (reference mounting_utils.py builds
+    the same commands for its SSH runner).
+    """
+    st = _store_type(source)
+    if st == StoreType.LOCAL:
+        src_path = source[len('file://'):] if source.startswith(
+            'file://') else source
+        # Fake-slice hosts: a symlink stands in for a FUSE mount.
+        return (f'mkdir -p "$(dirname {dst})" && '
+                f'rm -rf {dst} && ln -s {src_path} {dst}')
+    bucket_path = source[len('gs://'):]
+    bucket = bucket_path.split('/', 1)[0]
+    subpath = (bucket_path.split('/', 1)[1]
+               if '/' in bucket_path else '')
+    if mode == StorageMode.COPY:
+        return (f'mkdir -p {dst} && '
+                f'gsutil -m rsync -r gs://{bucket_path} {dst}')
+    only_dir = f'--only-dir {subpath} ' if subpath else ''
+    cache = ('--file-cache-max-size-mb 10240 '
+             if mode == StorageMode.MOUNT_CACHED else '')
+    return (f'mkdir -p {dst} && '
+            f'(mountpoint -q {dst} || '
+            f'gcsfuse {only_dir}{cache}--implicit-dirs {bucket} {dst})')
+
+
+def mount_on_cluster(info: ClusterInfo, dst: str, source: str,
+                     mode: StorageMode = StorageMode.MOUNT) -> None:
+    client = agent_client.AgentClient(info.head.agent_url)
+    cmd = mount_command(dst, source, mode)
+    result = client.exec_sync(cmd)
+    if any(rc != 0 for rc in result['returncodes']):
+        raise exceptions.StorageError(
+            f'Mounting {source} at {dst} failed: {result["tails"]}')
+
+
+class Storage:
+    """A named bucket-backed storage object (reference Storage :515)."""
+
+    def __init__(self, name: str, *, source: Optional[str] = None,
+                 store: StoreType = StoreType.GCS,
+                 mode: StorageMode = StorageMode.MOUNT):
+        self.name = name
+        self.source = source
+        self.store = store
+        self.mode = mode
+
+    @property
+    def url(self) -> str:
+        if self.store == StoreType.GCS:
+            return f'gs://{self.name}'
+        return f'file://{os.path.expanduser(self.name)}'
+
+    def create(self) -> None:
+        if self.store == StoreType.LOCAL:
+            os.makedirs(os.path.expanduser(self.name), exist_ok=True)
+            return
+        rc = subprocess.run(
+            ['gsutil', 'mb', f'gs://{self.name}'],
+            capture_output=True, text=True)
+        if rc.returncode != 0 and 'already exists' not in rc.stderr:
+            raise exceptions.StorageError(
+                f'Could not create bucket {self.name}: {rc.stderr}')
+
+    def upload(self, local_path: str, sub_path: str = '') -> None:
+        if self.store == StoreType.LOCAL:
+            dst = os.path.join(os.path.expanduser(self.name), sub_path)
+            os.makedirs(os.path.dirname(dst) or dst, exist_ok=True)
+            if os.path.isdir(local_path):
+                shutil.copytree(local_path, dst, dirs_exist_ok=True)
+            else:
+                shutil.copy2(local_path, dst)
+            return
+        target = f'{self.url}/{sub_path}' if sub_path else self.url
+        rc = subprocess.run(
+            ['gsutil', '-m', 'rsync' if os.path.isdir(local_path) else 'cp',
+             '-r', local_path, target],
+            capture_output=True, text=True)
+        if rc.returncode != 0:
+            raise exceptions.StorageError(
+                f'Upload to {target} failed: {rc.stderr}')
+
+    def delete(self) -> None:
+        if self.store == StoreType.LOCAL:
+            shutil.rmtree(os.path.expanduser(self.name), ignore_errors=True)
+            return
+        subprocess.run(['gsutil', '-m', 'rm', '-r', self.url],
+                       capture_output=True, text=True, check=False)
+
+
+def to_dict(s: Storage) -> Dict[str, Any]:
+    return {'name': s.name, 'source': s.source, 'store': s.store.value,
+            'mode': s.mode.value}

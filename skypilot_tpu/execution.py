@@ -1,0 +1,165 @@
+"""Launch/exec stage machine — the engine's entrypoints.
+
+Counterpart of the reference's ``sky/execution.py`` (``Stage`` enum :48,
+``_execute`` :158, ``launch`` :602, ``exec`` :825). Stages:
+
+    OPTIMIZE → PROVISION → SYNC_WORKDIR → SYNC_FILE_MOUNTS → SETUP → EXEC
+    (→ DOWN for autodown)
+
+Cluster reuse: launching onto an existing UP cluster skips PROVISION if the
+cluster satisfies the request (``Resources.less_demanding_than``); `exec`
+skips straight to SYNC_WORKDIR+EXEC (reference exec semantics).
+The whole plan runs under the per-cluster lock (planner-under-lock,
+reference sky/execution.py:469-487).
+"""
+from __future__ import annotations
+
+import enum
+import logging
+import uuid
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import admin_policy as admin_policy_lib
+from skypilot_tpu import backend as backend_lib
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.utils import common
+from skypilot_tpu.utils import locks
+
+logger = logging.getLogger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = 'OPTIMIZE'
+    PROVISION = 'PROVISION'
+    SYNC_WORKDIR = 'SYNC_WORKDIR'
+    SYNC_FILE_MOUNTS = 'SYNC_FILE_MOUNTS'
+    SETUP = 'SETUP'
+    EXEC = 'EXEC'
+    DOWN = 'DOWN'
+
+
+def _generate_cluster_name() -> str:
+    return f'sky-{uuid.uuid4().hex[:8]}'
+
+
+def _existing_cluster_info(
+        cluster_name: str,
+        res: resources_lib.Resources) -> Optional[ClusterInfo]:
+    """Return ClusterInfo if an UP cluster satisfies the request."""
+    record = state.get_cluster(cluster_name)
+    if record is None:
+        return None
+    if record['status'] != common.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}. '
+            f'`sky-tpu start {cluster_name}` it first, or choose another '
+            f'name.')
+    existing = resources_lib.Resources.from_yaml_config(record['resources'])
+    if not res.less_demanding_than(existing):
+        raise exceptions.ResourcesMismatchError(
+            f'Cluster {cluster_name!r} ({existing!r}) cannot satisfy the '
+            f'requested {res!r}. Launch a new cluster or relax the request.')
+    return ClusterInfo.from_dict(record['cluster_info'])
+
+
+def launch(
+    task: task_lib.Task,
+    cluster_name: Optional[str] = None,
+    *,
+    backend: Optional[backend_lib.Backend] = None,
+    optimize_target: optimizer_lib.OptimizeTarget =
+        optimizer_lib.OptimizeTarget.COST,
+    detach_run: bool = True,
+    stages: Optional[List[Stage]] = None,
+    quiet: bool = True,
+) -> Tuple[int, ClusterInfo]:
+    """Provision (or reuse) a cluster and run the task on it.
+
+    Returns (job_id, cluster_info); job_id is -1 for run-less tasks.
+    """
+    task = admin_policy_lib.apply(task)
+    cluster_name = cluster_name or _generate_cluster_name()
+    backend = backend or backend_lib.TpuVmBackend()
+    run_stages = stages or [
+        Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
+        Stage.SYNC_FILE_MOUNTS, Stage.SETUP, Stage.EXEC,
+    ]
+    with locks.cluster_lock(cluster_name):
+        info = _existing_cluster_info(cluster_name, task.resources)
+        if info is not None:
+            logger.info('Reusing cluster %s', cluster_name)
+        else:
+            if Stage.PROVISION not in run_stages:
+                raise exceptions.ClusterDoesNotExist(cluster_name)
+            if Stage.OPTIMIZE in run_stages:
+                optimizer_lib.optimize(task, target=optimize_target,
+                                       quiet=quiet)
+            # Best-first candidate list feeds the failover loop (reference:
+            # the optimizer's output seeds RetryingVmProvisioner's zones).
+            candidates = _failover_candidates(task, optimize_target)
+            info = backend.provision(task, cluster_name, candidates)
+
+        if Stage.SYNC_WORKDIR in run_stages and task.workdir:
+            backend.sync_workdir(info, task.workdir)
+        if Stage.SYNC_FILE_MOUNTS in run_stages and (task.file_mounts or
+                                                     task.storage_mounts):
+            mounts = dict(task.file_mounts)
+            for mp, spec in task.storage_mounts.items():
+                mounts[mp] = spec['source']
+            backend.sync_file_mounts(info, mounts)
+        if Stage.SETUP in run_stages:
+            backend.setup(info, task)
+        job_id = -1
+        if Stage.EXEC in run_stages and task.run:
+            job_id = backend.execute(info, task, detach=detach_run)
+        # Apply requested autostop.
+        auto = task.resources.autostop
+        if auto is not None and auto.enabled and hasattr(backend,
+                                                         'set_autostop'):
+            backend.set_autostop(info, auto.idle_minutes, auto.down)
+    return job_id, info
+
+
+def _failover_candidates(
+        task: task_lib.Task,
+        target: optimizer_lib.OptimizeTarget) -> List[catalog.Candidate]:
+    """Best-first candidate list for the failover loop."""
+    plans = optimizer_lib._fill_candidates(task, target)  # noqa: SLF001
+    seen = set()
+    out = []
+    for p in plans:
+        key = (p.candidate.cloud, p.candidate.region, p.candidate.zone,
+               p.candidate.instance_type)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(p.candidate)
+    return out
+
+
+def exec(  # noqa: A001 — mirrors the reference's public name
+    task: task_lib.Task,
+    cluster_name: str,
+    *,
+    backend: Optional[backend_lib.Backend] = None,
+    detach_run: bool = True,
+) -> Tuple[int, ClusterInfo]:
+    """Run a task on an existing cluster, skipping provision/setup
+    (reference sky/execution.py:825)."""
+    backend = backend or backend_lib.TpuVmBackend()
+    with locks.cluster_lock(cluster_name):
+        record = state.get_cluster(cluster_name)
+        if record is None:
+            raise exceptions.ClusterDoesNotExist(cluster_name)
+        info = _existing_cluster_info(cluster_name, task.resources)
+        assert info is not None
+        if task.workdir:
+            backend.sync_workdir(info, task.workdir)
+        job_id = backend.execute(info, task, detach=detach_run)
+    return job_id, info

@@ -43,6 +43,10 @@ class TaskPlan:
     run_hours: float
     run_cost: float
     egress_cost: float = 0.0
+    # The Resources alternative this candidate satisfies — best_resources is
+    # derived from it so non-placement fields (disk, image, ports, ...)
+    # survive optimization.
+    req: Optional[resources_lib.Resources] = None
 
     @property
     def total_cost(self) -> float:
@@ -103,7 +107,8 @@ def _fill_candidates(t: task_lib.Task,
                 continue
             hours = _run_hours(t, ref_tpu, cand)
             plans.append(TaskPlan(task=t, candidate=cand, run_hours=hours,
-                                  run_cost=hours * cand.cost_per_hour))
+                                  run_cost=hours * cand.cost_per_hour,
+                                  req=req))
     if not plans:
         raise exceptions.ResourcesUnavailableError(
             f'No feasible placement for task {t.name or "<unnamed>"} '
@@ -216,21 +221,22 @@ class Optimizer:
             chosen = _optimize_general(dag, order, cands, target)
         for p in chosen:
             c = p.candidate
-            cfg = {
+            base = p.req if p.req is not None else p.task.resources
+            override = {
                 'cloud': c.cloud,
                 'region': c.region,
                 'zone': c.zone,
                 'use_spot': c.use_spot,
+                'any_of': None,
             }
             if c.tpu is not None:
-                cfg['accelerators'] = c.tpu.name
+                override['accelerators'] = c.tpu.name
             elif c.accelerator_name:
-                cfg['accelerators'] = (
+                override['accelerators'] = (
                     f'{c.accelerator_name}:{c.accelerator_count}')
             else:
-                cfg['instance_type'] = c.instance_type
-            p.task.best_resources = resources_lib.Resources.from_yaml_config(
-                cfg)
+                override['instance_type'] = c.instance_type
+            p.task.best_resources = base.copy(**override)
         # Critical path over the DAG (longest run_hours chain).
         hours_of = {id(p.task): p.run_hours for p in chosen}
         finish: Dict[int, float] = {}

@@ -1,0 +1,71 @@
+"""Provider-routed provisioning API.
+
+Counterpart of the reference's ``sky/provision/__init__.py`` (function
+registry dispatched by cloud name via ``@_route_to_cloud_impl``, :48, ops
+at :81-345). Each provider module exposes the same function set; dispatch
+is by module lookup so adding a cloud is dropping in a module.
+
+Provider contract (all take/return plain data, no cloud SDK types leak):
+    run_instances(config: ProvisionConfig) -> ClusterInfo
+    stop_instances(cluster_name, provider_config) -> None
+    terminate_instances(cluster_name, provider_config) -> None
+    wait_instances(cluster_name, provider_config, state) -> None
+    get_cluster_info(cluster_name, provider_config) -> Optional[ClusterInfo]
+    open_ports(cluster_name, ports, provider_config) -> None
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import ClusterInfo, ProvisionConfig
+
+_PROVIDERS = {
+    'local': 'skypilot_tpu.provision.local.instance',
+    'gcp': 'skypilot_tpu.provision.gcp.instance',
+}
+
+
+def _impl(cloud: str):
+    if cloud not in _PROVIDERS:
+        raise exceptions.ProvisionError(
+            f'No provisioner for cloud {cloud!r}', retryable=False)
+    return importlib.import_module(_PROVIDERS[cloud])
+
+
+def run_instances(cloud: str, config: ProvisionConfig) -> ClusterInfo:
+    return _impl(cloud).run_instances(config)
+
+
+def stop_instances(cloud: str, cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    return _impl(cloud).stop_instances(cluster_name, provider_config)
+
+
+def terminate_instances(cloud: str, cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    return _impl(cloud).terminate_instances(cluster_name, provider_config)
+
+
+def wait_instances(cloud: str, cluster_name: str,
+                   provider_config: Dict[str, Any],
+                   state: str = 'RUNNING') -> None:
+    return _impl(cloud).wait_instances(cluster_name, provider_config, state)
+
+
+def get_cluster_info(cloud: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> Optional[ClusterInfo]:
+    return _impl(cloud).get_cluster_info(cluster_name, provider_config)
+
+
+def open_ports(cloud: str, cluster_name: str, ports,
+               provider_config: Dict[str, Any]) -> None:
+    return _impl(cloud).open_ports(cluster_name, ports, provider_config)
+
+
+def start_instances(cloud: str, cluster_name: str,
+                    provider_config: Dict[str, Any]) -> ClusterInfo:
+    """Restart a STOPPED cluster."""
+    return _impl(cloud).start_instances(cluster_name, provider_config)

@@ -1,0 +1,83 @@
+"""Cloud-agnostic provisioning dataclasses.
+
+Counterpart of the reference's ``sky/provision/common.py`` (``ClusterInfo``/
+``InstanceInfo``). TPU-first difference: a cluster *is* one slice (or one
+VM); hosts are the slice's workers, gang-allocated atomically — there is no
+per-node scale-up path.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class HostInfo:
+    """One host (worker VM) of a slice."""
+    host_id: str
+    internal_ip: str
+    external_ip: Optional[str] = None
+    state: str = 'RUNNING'
+    # Where the on-host agent listens (http://ip:port). For the local fake
+    # cloud every host shares one agent that simulates the slice.
+    agent_url: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'HostInfo':
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Everything the backend needs to reach a provisioned slice."""
+    cluster_name: str
+    cloud: str
+    region: str
+    zone: str
+    hosts: List[HostInfo]
+    # TPU metadata (None for CPU/GPU clusters).
+    tpu_slice: Optional[str] = None        # canonical slice name, 'v5e-16'
+    instance_type: Optional[str] = None
+    use_spot: bool = False
+    cost_per_hour: float = 0.0
+    # Provider-specific extras (GCP project id, node name, local slice dir).
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def head(self) -> HostInfo:
+        return self.hosts[0]
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'ClusterInfo':
+        d = dict(d)
+        d['hosts'] = [HostInfo.from_dict(h) for h in d.get('hosts', [])]
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Input to a provider's run_instances."""
+    cluster_name: str
+    region: str
+    zone: str
+    instance_type: str
+    num_hosts: int
+    tpu_slice: Optional[str] = None        # canonical slice name
+    use_spot: bool = False
+    disk_size_gb: int = 256
+    image_id: Optional[str] = None
+    runtime_version: Optional[str] = None  # TPU software version
+    ports: List[int] = dataclasses.field(default_factory=list)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)

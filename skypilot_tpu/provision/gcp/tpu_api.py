@@ -1,0 +1,161 @@
+"""Thin REST client for the Cloud TPU API (tpu.googleapis.com, v2).
+
+The reference drives this API through its Ray-autoscaler-derived handler
+``GCPTPUVMInstance`` (reference sky/provision/gcp/instance_utils.py:1208,
+API constants :1222-1226, operation polling :1234). Here the client is
+standalone: one TPU *node* is one slice (all hosts), which is exactly the
+gang-allocation unit — no per-VM bookkeeping.
+
+Auth: Application Default Credentials via google-auth. All calls raise
+ProvisionError subclasses the failover loop understands.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+
+TPU_API = 'https://tpu.googleapis.com/v2'
+OPERATION_POLL_INTERVAL = 5.0
+OPERATION_TIMEOUT = 1800.0
+
+
+class TpuApiClient:
+    def __init__(self, project: str):
+        self.project = project
+        self._creds = None
+
+    # -- auth ------------------------------------------------------------
+    def _token(self) -> str:
+        try:
+            import google.auth
+            import google.auth.transport.requests
+        except ImportError as e:
+            raise exceptions.NoCloudAccessError(
+                f'google-auth unavailable: {e}') from e
+        if self._creds is None:
+            try:
+                self._creds, _ = google.auth.default(
+                    scopes=['https://www.googleapis.com/auth/cloud-platform'])
+            except Exception as e:  # noqa: BLE001
+                raise exceptions.NoCloudAccessError(
+                    f'No GCP credentials: {e}') from e
+        if not self._creds.valid:
+            self._creds.refresh(
+                google.auth.transport.requests.Request())
+        return self._creds.token
+
+    def _headers(self) -> Dict[str, str]:
+        return {'Authorization': f'Bearer {self._token()}',
+                'Content-Type': 'application/json'}
+
+    def _request(self, method: str, url: str,
+                 json_body: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+        resp = requests.request(method, url, headers=self._headers(),
+                                json=json_body, timeout=60)
+        if resp.status_code >= 400:
+            self._raise_for(resp)
+        return resp.json() if resp.text else {}
+
+    @staticmethod
+    def _raise_for(resp: requests.Response) -> None:
+        try:
+            err = resp.json().get('error', {})
+            message = err.get('message', resp.text)
+        except ValueError:
+            message = resp.text
+        low = message.lower()
+        if resp.status_code == 429 or 'quota' in low:
+            raise exceptions.QuotaExceededError(f'TPU API quota: {message}')
+        if ('no more capacity' in low or 'stockout' in low or
+                'resource_exhausted' in low or resp.status_code == 409 and
+                'capacity' in low):
+            raise exceptions.CapacityError(f'TPU capacity: {message}')
+        if resp.status_code == 404:
+            raise exceptions.ClusterDoesNotExist(message)
+        if resp.status_code in (401, 403):
+            raise exceptions.NoCloudAccessError(message)
+        raise exceptions.ProvisionError(
+            f'TPU API error {resp.status_code}: {message}')
+
+    # -- nodes -----------------------------------------------------------
+    def _node_url(self, zone: str, node_id: str) -> str:
+        return (f'{TPU_API}/projects/{self.project}/locations/{zone}'
+                f'/nodes/{node_id}')
+
+    def create_node(self, zone: str, node_id: str, *,
+                    accelerator_type: str,
+                    runtime_version: str,
+                    spot: bool = False,
+                    labels: Optional[Dict[str, str]] = None,
+                    startup_script: Optional[str] = None,
+                    network: Optional[str] = None) -> Dict[str, Any]:
+        body: Dict[str, Any] = {
+            'acceleratorType': accelerator_type,
+            'runtimeVersion': runtime_version,
+            'networkConfig': {'enableExternalIps': True},
+            'labels': labels or {},
+        }
+        if network:
+            body['networkConfig']['network'] = network
+        if spot:
+            body['schedulingConfig'] = {'spot': True}
+        if startup_script:
+            body['metadata'] = {'startup-script': startup_script}
+        url = (f'{TPU_API}/projects/{self.project}/locations/{zone}'
+               f'/nodes?nodeId={node_id}')
+        op = self._request('POST', url, body)
+        return self.wait_operation(op)
+
+    def get_node(self, zone: str, node_id: str) -> Dict[str, Any]:
+        return self._request('GET', self._node_url(zone, node_id))
+
+    def delete_node(self, zone: str, node_id: str) -> None:
+        try:
+            op = self._request('DELETE', self._node_url(zone, node_id))
+        except exceptions.ClusterDoesNotExist:
+            return
+        self.wait_operation(op)
+
+    def stop_node(self, zone: str, node_id: str) -> None:
+        op = self._request('POST',
+                           f'{self._node_url(zone, node_id)}:stop', {})
+        self.wait_operation(op)
+
+    def start_node(self, zone: str, node_id: str) -> None:
+        op = self._request('POST',
+                           f'{self._node_url(zone, node_id)}:start', {})
+        self.wait_operation(op)
+
+    def list_nodes(self, zone: str) -> List[Dict[str, Any]]:
+        out = self._request(
+            'GET',
+            f'{TPU_API}/projects/{self.project}/locations/{zone}/nodes')
+        return out.get('nodes', [])
+
+    # -- operations (reference instance_utils.py:1234) -------------------
+    def wait_operation(self, op: Dict[str, Any],
+                       timeout: float = OPERATION_TIMEOUT) -> Dict[str, Any]:
+        name = op.get('name')
+        if name is None or op.get('done'):
+            return op.get('response', op)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            cur = self._request('GET', f'{TPU_API}/{name}')
+            if cur.get('done'):
+                if 'error' in cur:
+                    msg = cur['error'].get('message', str(cur['error']))
+                    low = msg.lower()
+                    if 'capacity' in low or 'stockout' in low:
+                        raise exceptions.CapacityError(msg)
+                    if 'quota' in low:
+                        raise exceptions.QuotaExceededError(msg)
+                    raise exceptions.ProvisionError(msg)
+                return cur.get('response', cur)
+            time.sleep(OPERATION_POLL_INTERVAL)
+        raise exceptions.ProvisionTimeoutError(
+            f'TPU operation {name} timed out after {timeout}s')

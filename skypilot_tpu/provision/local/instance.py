@@ -1,0 +1,253 @@
+"""Local fake-slice provisioner: N-host TPU slices as local process groups.
+
+The reference's offline-testing analog is ``mock_aws_backend`` (reference
+tests/conftest.py:33) — a moto-mocked cloud. Here the fake cloud is a
+first-class provider: a "slice" is a directory tree under
+``$SKY_TPU_HOME/clusters/<name>/`` with one ``host<i>/`` dir per worker, and
+one agent process (local-slice mode) that simulates gang execution by
+spawning one subprocess per host with full `jax.distributed` env injected.
+This makes multi-host gang logic, failover, autostop, managed jobs, and
+serving testable on a laptop — SURVEY.md §4's "fake TPU slice" strategy.
+
+Failure injection (for failover tests): set provider_config
+``fail_regions`` to a list of regions that raise CapacityError, or create
+the file ``<clusters_root>/fail_<region>`` at runtime.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology
+from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
+                                           ProvisionConfig)
+from skypilot_tpu.utils import common
+
+AGENT_START_TIMEOUT = 30.0
+
+
+def _cluster_dir(cluster_name: str) -> str:
+    return os.path.join(common.clusters_dir(), cluster_name)
+
+
+def _check_injected_failure(config: ProvisionConfig) -> None:
+    fail_regions = config.provider_config.get('fail_regions', [])
+    marker = os.path.join(common.clusters_dir(), f'fail_{config.region}')
+    if config.region in fail_regions or os.path.exists(marker):
+        raise exceptions.CapacityError(
+            f'[local] injected stockout in {config.region}/{config.zone}',
+            blocked_zone=config.zone, blocked_region=config.region)
+
+
+def run_instances(config: ProvisionConfig) -> ClusterInfo:
+    _check_injected_failure(config)
+    cdir = _cluster_dir(config.cluster_name)
+    os.makedirs(cdir, exist_ok=True)
+    num_hosts = config.num_hosts
+    for r in range(num_hosts):
+        hd = os.path.join(cdir, f'host{r}')
+        os.makedirs(os.path.join(hd, 'workdir'), exist_ok=True)
+        with open(os.path.join(hd, 'state'), 'w', encoding='utf-8') as f:
+            f.write('RUNNING')
+    meta = {
+        'cluster_name': config.cluster_name,
+        'region': config.region,
+        'zone': config.zone,
+        'instance_type': config.instance_type,
+        'tpu_slice': config.tpu_slice,
+        'num_hosts': num_hosts,
+        'use_spot': config.use_spot,
+        'created_at': time.time(),
+    }
+    with open(os.path.join(cdir, 'meta.json'), 'w', encoding='utf-8') as f:
+        json.dump(meta, f)
+    _start_agent(config.cluster_name)
+    return get_cluster_info(config.cluster_name, config.provider_config)
+
+
+def _start_agent(cluster_name: str) -> None:
+    cdir = _cluster_dir(cluster_name)
+    # Idempotent: reuse a live agent.
+    existing = _agent_info(cdir)
+    if existing is not None and _pid_alive(existing.get('pid', -1)):
+        return
+    with open(os.path.join(cdir, 'meta.json'), encoding='utf-8') as f:
+        meta = json.load(f)
+    agent_config = {
+        'cluster_name': cluster_name,
+        'mode': 'local-slice',
+        'host_rank': 0,
+        'host_ips': ['127.0.0.1'] * meta['num_hosts'],
+        'num_hosts': meta['num_hosts'],
+        'tpu_slice': meta.get('tpu_slice'),
+    }
+    with open(os.path.join(cdir, 'agent_config.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump(agent_config, f)
+    agent_json = os.path.join(cdir, 'agent.json')
+    if os.path.exists(agent_json):
+        os.unlink(agent_json)
+    log = open(os.path.join(cdir, 'agent.log'), 'ab')
+    subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.runtime.agent',
+         '--cluster-dir', cdir],
+        stdout=log, stderr=subprocess.STDOUT,
+        start_new_session=True,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'},
+    )
+    deadline = time.time() + AGENT_START_TIMEOUT
+    while time.time() < deadline:
+        if os.path.exists(agent_json):
+            return
+        time.sleep(0.1)
+    raise exceptions.ProvisionError(
+        f'[local] agent for {cluster_name} failed to start '
+        f'(see {cdir}/agent.log)', retryable=False)
+
+
+def _agent_info(cdir: str) -> Optional[Dict[str, Any]]:
+    p = os.path.join(cdir, 'agent.json')
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p, encoding='utf-8') as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def _kill_agent(cdir: str, timeout: float = 5.0) -> None:
+    info = _agent_info(cdir)
+    if not info:
+        return
+    pid = info.get('pid', -1)
+    if _pid_alive(pid):
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        # Wait for actual death: a subsequent start must not observe a
+        # half-dead agent and reuse its soon-to-be-closed port.
+        deadline = time.time() + timeout
+        while time.time() < deadline and _pid_alive(pid):
+            time.sleep(0.05)
+        if _pid_alive(pid):
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+    # Stale agent.json must not be mistaken for a live agent later.
+    try:
+        os.unlink(os.path.join(cdir, 'agent.json'))
+    except FileNotFoundError:
+        pass
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    cdir = _cluster_dir(cluster_name)
+    _kill_agent(cdir)
+    for entry in os.listdir(cdir) if os.path.isdir(cdir) else []:
+        if entry.startswith('host'):
+            with open(os.path.join(cdir, entry, 'state'), 'w',
+                      encoding='utf-8') as f:
+                f.write('STOPPED')
+
+
+def start_instances(cluster_name: str,
+                    provider_config: Dict[str, Any]) -> ClusterInfo:
+    cdir = _cluster_dir(cluster_name)
+    if not os.path.isdir(cdir):
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    for entry in os.listdir(cdir):
+        if entry.startswith('host'):
+            with open(os.path.join(cdir, entry, 'state'), 'w',
+                      encoding='utf-8') as f:
+                f.write('RUNNING')
+    trig = os.path.join(cdir, 'autostop_triggered.json')
+    if os.path.exists(trig):
+        os.unlink(trig)
+    _start_agent(cluster_name)
+    return get_cluster_info(cluster_name, provider_config)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    cdir = _cluster_dir(cluster_name)
+    _kill_agent(cdir)
+    if os.path.isdir(cdir):
+        shutil.rmtree(cdir, ignore_errors=True)
+
+
+def wait_instances(cluster_name: str, provider_config: Dict[str, Any],
+                   state: str = 'RUNNING') -> None:
+    info = get_cluster_info(cluster_name, provider_config)
+    if info is None:
+        raise exceptions.ProvisionError(
+            f'[local] cluster {cluster_name} does not exist')
+    bad = [h for h in info.hosts if h.state != state]
+    if bad:
+        raise exceptions.ProvisionError(
+            f'[local] hosts not {state}: {[h.host_id for h in bad]}')
+
+
+def get_cluster_info(cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> Optional[ClusterInfo]:
+    cdir = _cluster_dir(cluster_name)
+    meta_path = os.path.join(cdir, 'meta.json')
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path, encoding='utf-8') as f:
+        meta = json.load(f)
+    agent = _agent_info(cdir)
+    agent_url = agent['url'] if agent else None
+    hosts: List[HostInfo] = []
+    for r in range(meta['num_hosts']):
+        state_p = os.path.join(cdir, f'host{r}', 'state')
+        st = 'TERMINATED'
+        if os.path.exists(state_p):
+            with open(state_p, encoding='utf-8') as f:
+                st = f.read().strip()
+        hosts.append(HostInfo(
+            host_id=f'{cluster_name}-host{r}',
+            internal_ip='127.0.0.1',
+            external_ip='127.0.0.1',
+            state=st,
+            agent_url=agent_url))
+    return ClusterInfo(
+        cluster_name=cluster_name,
+        cloud='local',
+        region=meta['region'],
+        zone=meta['zone'],
+        hosts=hosts,
+        tpu_slice=meta.get('tpu_slice'),
+        instance_type=meta['instance_type'],
+        use_spot=meta.get('use_spot', False),
+        cost_per_hour=0.0,
+        provider_config={'cluster_dir': cdir})
+
+
+def open_ports(cluster_name: str, ports,
+               provider_config: Dict[str, Any]) -> None:
+    del cluster_name, ports, provider_config  # no-op locally

@@ -1,0 +1,140 @@
+"""Provision orchestration: candidate loop with failover + cleanup.
+
+Counterpart of the reference's two-layer structure: ``bulk_provision``
+(reference sky/provision/provisioner.py:122) for one attempt, and the
+``RetryingVmProvisioner`` failover loop (reference
+cloud_vm_ray_backend.py:736, ``_retry_zones`` :942,
+``provision_with_retries`` :1661) that walks optimizer candidates, blocks
+failed zones/regions, and aggregates the failover history into
+``ResourcesUnavailableError``.
+
+TPU-first simplification: a slice allocates atomically, so there is no
+partial-gang cleanup *within* a zone attempt — either the node exists
+(terminate it on later failure) or it does not. Retry granularity is
+whole-slice (SURVEY.md §7 "hard parts").
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.provision.common import ClusterInfo, ProvisionConfig
+from skypilot_tpu.runtime import agent_client
+
+logger = logging.getLogger(__name__)
+
+
+def _make_config(candidate: catalog.Candidate,
+                 cluster_name: str,
+                 res: resources_lib.Resources) -> ProvisionConfig:
+    from skypilot_tpu import config as config_lib
+    provider_config = dict(
+        config_lib.get_nested((candidate.cloud,), {}) or {})
+    provider_config['zone'] = candidate.zone
+    return ProvisionConfig(
+        cluster_name=cluster_name,
+        region=candidate.region,
+        zone=candidate.zone,
+        instance_type=candidate.instance_type,
+        num_hosts=candidate.num_hosts,
+        tpu_slice=candidate.tpu.name if candidate.tpu else None,
+        use_spot=candidate.use_spot,
+        disk_size_gb=res.disk_size_gb,
+        image_id=res.image_id,
+        runtime_version=res.runtime_version,
+        ports=res.ports,
+        labels=res.labels,
+        provider_config=provider_config,
+    )
+
+
+def bulk_provision(candidate: catalog.Candidate,
+                   cluster_name: str,
+                   res: resources_lib.Resources,
+                   *,
+                   wait_agent: bool = True) -> ClusterInfo:
+    """One atomic provisioning attempt: create slice, wait for hosts, wait
+    for the head agent (reference provisioner.py:122 + wait_for_ssh :389 —
+    the agent replaces SSH-wait as the readiness signal)."""
+    config = _make_config(candidate, cluster_name, res)
+    info = provision.run_instances(candidate.cloud, config)
+    provision.wait_instances(candidate.cloud, cluster_name,
+                             info.provider_config)
+    info.cost_per_hour = candidate.cost_per_hour
+    if wait_agent and info.head.agent_url:
+        agent_client.AgentClient(info.head.agent_url).wait_healthy()
+    if res.ports:
+        provision.open_ports(candidate.cloud, cluster_name, res.ports,
+                             info.provider_config)
+    return info
+
+
+def provision_with_retries(
+    cluster_name: str,
+    res: resources_lib.Resources,
+    candidates: List[catalog.Candidate],
+) -> Tuple[ClusterInfo, catalog.Candidate]:
+    """Walk candidates cheapest-first with zone/region blocklisting.
+
+    Raises ResourcesUnavailableError carrying the full failover history
+    when every candidate fails (consumed by managed-jobs recovery
+    strategies).
+    """
+    failover_history: List[Exception] = []
+    blocked_zones: set = set()
+    blocked_regions: set = set()
+    last_err: Optional[Exception] = None
+    for cand in candidates:
+        if (cand.cloud, cand.region) in blocked_regions:
+            continue
+        if (cand.cloud, cand.region, cand.zone) in blocked_zones:
+            continue
+        try:
+            logger.info('Provisioning %s as %s', cand, cluster_name)
+            info = bulk_provision(cand, cluster_name, res)
+            return info, cand
+        except exceptions.QuotaExceededError as e:
+            # Quota is regional: block the whole region.
+            failover_history.append(e)
+            blocked_regions.add((cand.cloud, cand.region))
+            last_err = e
+        except exceptions.ProvisionError as e:
+            failover_history.append(e)
+            if not e.retryable:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Non-retryable provisioning failure for '
+                    f'{cluster_name}: {e}',
+                    failover_history=failover_history) from e
+            blocked_zones.add((cand.cloud, cand.region, cand.zone))
+            if e.blocked_region:
+                blocked_regions.add((cand.cloud, e.blocked_region))
+            last_err = e
+            _cleanup_partial(cand.cloud, cluster_name)
+        except exceptions.NoCloudAccessError as e:
+            failover_history.append(e)
+            # Credentials missing: no point trying other zones of the
+            # same cloud.
+            blocked_regions.update(
+                {(cand.cloud, c.region) for c in candidates
+                 if c.cloud == cand.cloud})
+            last_err = e
+    raise exceptions.ResourcesUnavailableError(
+        f'Failed to provision {cluster_name!r} on all '
+        f'{len(candidates)} candidate placements. Last error: {last_err}',
+        failover_history=failover_history)
+
+
+def _cleanup_partial(cloud: str, cluster_name: str) -> None:
+    """Best-effort teardown of a half-created slice before failover."""
+    try:
+        info = provision.get_cluster_info(cloud, cluster_name, {})
+        if info is not None:
+            provision.terminate_instances(cloud, cluster_name,
+                                          info.provider_config)
+    except Exception:  # noqa: BLE001 — cleanup must not mask the cause
+        logger.warning('Partial-cleanup of %s failed', cluster_name,
+                       exc_info=True)

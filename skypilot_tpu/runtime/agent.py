@@ -1,0 +1,439 @@
+"""On-host agent daemon — the skylet equivalent.
+
+Counterpart of the reference's ``sky/skylet/skylet.py`` (gRPC server for
+autostop/jobs services + periodic event loop, :45-85). Differences:
+
+- HTTP/JSON over aiohttp instead of gRPC+protobuf (fastapi/protoc stubs are
+  not part of this environment; the wire format is a private detail behind
+  ``AgentClient``).
+- **No Ray.** Gang execution is native: the agent knows its slice's host
+  list and fans a job out to every host simultaneously with
+  `jax.distributed` env injected per rank
+  (``runtime/distributed_env.py``) — replacing the reference's generated
+  Ray placement-group driver program (reference
+  sky/backends/task_codegen.py:439-465,559).
+
+Modes:
+- ``local-slice``: one agent simulates all N hosts of a fake slice by
+  spawning N local subprocesses per job (the test/E2E backend).
+- ``host``: one agent per real TPU host; the head host's agent fans out to
+  peer agents' /run_rank endpoint over the slice's internal network.
+
+Run: ``python -m skypilot_tpu.runtime.agent --cluster-dir DIR``
+(config read from DIR/agent_config.json; chosen port written to
+DIR/agent.json).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+from skypilot_tpu import topology
+from skypilot_tpu.runtime import distributed_env
+from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.utils import common
+
+POLL_INTERVAL = 1.0
+AUTOSTOP_CHECK_INTERVAL = 5.0
+
+
+class Agent:
+    def __init__(self, cluster_dir: str):
+        self.cluster_dir = os.path.abspath(cluster_dir)
+        with open(os.path.join(self.cluster_dir, 'agent_config.json'),
+                  encoding='utf-8') as f:
+            self.config: Dict[str, Any] = json.load(f)
+        self.mode: str = self.config.get('mode', 'local-slice')
+        self.host_rank: int = int(self.config.get('host_rank', 0))
+        self.host_ips: List[str] = self.config.get('host_ips', ['127.0.0.1'])
+        self.peer_agent_urls: List[str] = self.config.get(
+            'peer_agent_urls', [])
+        slice_name = self.config.get('tpu_slice')
+        self.tpu_slice: Optional[topology.TpuSlice] = (
+            topology.parse_tpu(slice_name) if slice_name else None)
+        self.num_hosts: int = int(self.config.get(
+            'num_hosts', self.tpu_slice.num_hosts if self.tpu_slice else 1))
+        self.jobs = job_lib.JobTable(
+            os.path.join(self.cluster_dir, 'jobs.db'))
+        self.started_at = time.time()
+        # autostop state (reference sky/skylet/autostop_lib.py)
+        self._autostop_file = os.path.join(self.cluster_dir, 'autostop.json')
+        # job_id -> list of subprocess handles (local-slice mode)
+        self._procs: Dict[int, List[asyncio.subprocess.Process]] = {}
+        self._cancelled: set = set()
+
+    # ---------------- job execution --------------------------------------
+    def _rank_env(self, rank: int, job_envs: Dict[str, str],
+                  job_id: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(distributed_env.make_env(self.host_ips, rank,
+                                            self.tpu_slice))
+        env.update(job_envs)
+        env['SKY_TPU_JOB_ID'] = str(job_id)
+        if self.mode == 'local-slice':
+            # Fake-slice sandbox root: absolute file-mount destinations land
+            # under this dir (a real host would use / directly).
+            env['SKY_TPU_HOST_ROOT'] = os.path.join(self.cluster_dir,
+                                                    f'host{rank}')
+            # Fake slices must not grab a real TPU. Overridden (not
+            # setdefault): the inherited environment may pin a TPU platform,
+            # and both selection variables must agree for every jax version.
+            env['JAX_PLATFORMS'] = 'cpu'
+            env['JAX_PLATFORM_NAME'] = 'cpu'
+            if self.tpu_slice is not None:
+                flag = ('--xla_force_host_platform_device_count='
+                        f'{self.tpu_slice.chips_per_host}')
+                prior = env.get('XLA_FLAGS', '')
+                if '--xla_force_host_platform_device_count' not in prior:
+                    env['XLA_FLAGS'] = f'{prior} {flag}'.strip()
+        return env
+
+    def _rank_cwd(self, rank: int) -> str:
+        if self.mode == 'local-slice':
+            d = os.path.join(self.cluster_dir, f'host{rank}', 'workdir')
+        else:
+            d = os.path.join(self.cluster_dir, 'workdir')
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    async def _run_rank(self, job_id: int, rank: int, cmd: str,
+                        envs: Dict[str, str], log_path: str) -> int:
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        with open(log_path, 'ab') as logf:
+            proc = await asyncio.create_subprocess_shell(
+                cmd,
+                cwd=self._rank_cwd(rank),
+                env=self._rank_env(rank, envs, job_id),
+                stdout=logf,
+                stderr=asyncio.subprocess.STDOUT,
+                start_new_session=True,
+            )
+        self._procs.setdefault(job_id, []).append(proc)
+        return await proc.wait()
+
+    async def _run_job(self, job: Dict[str, Any]) -> None:
+        job_id = job['job_id']
+        log_dir = job['log_dir']
+        os.makedirs(log_dir, exist_ok=True)
+        try:
+            if job['setup_cmd']:
+                self.jobs.set_status(job_id, job_lib.JobStatus.SETTING_UP)
+                rcs = await self._fan_out(job_id, job['setup_cmd'],
+                                          job['envs'], log_dir, 'setup')
+                if any(rc != 0 for rc in rcs):
+                    self.jobs.set_status(job_id,
+                                         job_lib.JobStatus.FAILED_SETUP)
+                    return
+            self.jobs.set_status(job_id, job_lib.JobStatus.RUNNING)
+            rcs = await self._fan_out(job_id, job['run_cmd'], job['envs'],
+                                      log_dir, 'run')
+            if job_id in self._cancelled:
+                self.jobs.set_status(job_id, job_lib.JobStatus.CANCELLED)
+            elif all(rc == 0 for rc in rcs):
+                self.jobs.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
+            else:
+                self.jobs.set_status(job_id, job_lib.JobStatus.FAILED)
+        except Exception as e:  # noqa: BLE001 — agent must not die on a job
+            with open(os.path.join(log_dir, 'agent_error.log'), 'a',
+                      encoding='utf-8') as f:
+                f.write(f'{e!r}\n')
+            self.jobs.set_status(job_id, job_lib.JobStatus.FAILED)
+        finally:
+            self._procs.pop(job_id, None)
+
+    async def _fan_out(self, job_id: int, cmd: str, envs: Dict[str, str],
+                       log_dir: str, phase: str) -> List[int]:
+        """Run `cmd` on every host of the slice simultaneously."""
+        if self.mode == 'local-slice':
+            tasks = [
+                self._run_rank(job_id, r, cmd, envs,
+                               os.path.join(log_dir, f'rank{r}_{phase}.log'))
+                for r in range(self.num_hosts)
+            ]
+            return list(await asyncio.gather(*tasks))
+        # host mode: this agent runs its own rank; peers run theirs.
+        import aiohttp
+        my = self._run_rank(job_id, self.host_rank, cmd, envs,
+                            os.path.join(log_dir,
+                                         f'rank{self.host_rank}_{phase}.log'))
+        peer_calls = []
+        async with aiohttp.ClientSession() as sess:
+            for url in self.peer_agent_urls:
+                peer_calls.append(sess.post(f'{url}/run_rank', json={
+                    'job_id': job_id, 'cmd': cmd, 'envs': envs,
+                    'phase': phase,
+                }, timeout=aiohttp.ClientTimeout(total=None)))
+            results = await asyncio.gather(my, *peer_calls,
+                                           return_exceptions=True)
+        rcs: List[int] = []
+        for res in results:
+            if isinstance(res, Exception):
+                rcs.append(255)
+            elif isinstance(res, int):
+                rcs.append(res)
+            else:
+                body = await res.json()
+                rcs.append(int(body.get('returncode', 255)))
+        return rcs
+
+    async def scheduler_loop(self) -> None:
+        """FIFO, one job at a time (reference JobSchedulerEvent,
+        sky/skylet/events.py:69)."""
+        while True:
+            try:
+                if not self.jobs.running_jobs():
+                    nxt = self.jobs.next_pending()
+                    if nxt is not None:
+                        self.jobs.set_status(nxt['job_id'],
+                                             job_lib.JobStatus.INIT)
+                        asyncio.get_event_loop().create_task(
+                            self._run_job(nxt))
+            except Exception:  # noqa: BLE001
+                pass
+            await asyncio.sleep(POLL_INTERVAL)
+
+    # ---------------- autostop -------------------------------------------
+    def _autostop_config(self) -> Dict[str, Any]:
+        if os.path.exists(self._autostop_file):
+            with open(self._autostop_file, encoding='utf-8') as f:
+                return json.load(f)
+        return {'idle_minutes': -1, 'down': False}
+
+    async def autostop_loop(self) -> None:
+        """Reference AutostopEvent (sky/skylet/events.py:161): the cluster
+        tears *itself* down after idling."""
+        while True:
+            await asyncio.sleep(AUTOSTOP_CHECK_INTERVAL)
+            try:
+                cfg = self._autostop_config()
+                idle_min = cfg.get('idle_minutes', -1)
+                if idle_min is None or idle_min < 0:
+                    continue
+                if not self.jobs.is_idle():
+                    continue
+                anchor = max(self.jobs.last_activity(), self.started_at,
+                             cfg.get('set_at', 0.0))
+                if time.time() - anchor >= idle_min * 60:
+                    self._trigger_autostop(bool(cfg.get('down', False)))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _trigger_autostop(self, down: bool) -> None:
+        marker = {
+            'triggered_at': time.time(),
+            'action': 'down' if down else 'stop',
+        }
+        with open(os.path.join(self.cluster_dir, 'autostop_triggered.json'),
+                  'w', encoding='utf-8') as f:
+            json.dump(marker, f)
+        if self.mode == 'host':
+            # Real cloud: the agent deletes/stops its own slice via the
+            # provider API (reference autostop_lib self-teardown).
+            try:
+                from skypilot_tpu.provision.gcp import instance as gcp
+                pc = self.config.get('provider_config', {})
+                if down:
+                    gcp.terminate_instances(self.config['cluster_name'], pc)
+                else:
+                    gcp.stop_instances(self.config['cluster_name'], pc)
+            except Exception:  # noqa: BLE001
+                pass
+        else:
+            # Local fake slice: mark hosts stopped; the engine's status
+            # refresh reconciles.
+            for r in range(self.num_hosts):
+                hd = os.path.join(self.cluster_dir, f'host{r}')
+                if os.path.isdir(hd):
+                    with open(os.path.join(hd, 'state'), 'w',
+                              encoding='utf-8') as f:
+                        f.write('STOPPED' if not down else 'TERMINATED')
+
+    # ---------------- HTTP handlers --------------------------------------
+    async def h_health(self, _req: web.Request) -> web.Response:
+        return web.json_response({
+            'status': 'healthy',
+            'uptime_s': time.time() - self.started_at,
+            'idle': self.jobs.is_idle(),
+            'mode': self.mode,
+            'num_hosts': self.num_hosts,
+        })
+
+    async def h_submit(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        log_dir = os.path.join(self.cluster_dir, 'job_logs')
+        job_id = self.jobs.add_job(
+            name=body.get('name', 'job'),
+            run_cmd=body['run'],
+            setup_cmd=body.get('setup'),
+            envs=body.get('envs', {}),
+            num_hosts=self.num_hosts,
+            log_dir='')
+        log_dir = os.path.join(log_dir, str(job_id))
+        self.jobs._conn.execute(  # set final log dir now that id is known
+            'UPDATE jobs SET log_dir=? WHERE job_id=?', (log_dir, job_id))
+        self.jobs._conn.commit()
+        return web.json_response({'job_id': job_id})
+
+    async def h_jobs(self, _req: web.Request) -> web.Response:
+        out = []
+        for j in self.jobs.list_jobs():
+            j = dict(j)
+            j['status'] = j['status'].value
+            out.append(j)
+        return web.json_response({'jobs': out})
+
+    async def h_job(self, req: web.Request) -> web.Response:
+        job = self.jobs.get(int(req.match_info['job_id']))
+        if job is None:
+            return web.json_response({'error': 'not found'}, status=404)
+        job = dict(job)
+        job['status'] = job['status'].value
+        return web.json_response(job)
+
+    async def h_cancel(self, req: web.Request) -> web.Response:
+        job_id = int(req.match_info['job_id'])
+        job = self.jobs.get(job_id)
+        if job is None:
+            return web.json_response({'error': 'not found'}, status=404)
+        self._cancelled.add(job_id)
+        for proc in self._procs.get(job_id, []):
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        if job['status'] in (job_lib.JobStatus.PENDING,):
+            self.jobs.set_status(job_id, job_lib.JobStatus.CANCELLED)
+        return web.json_response({'cancelled': job_id})
+
+    async def h_logs(self, req: web.Request) -> web.StreamResponse:
+        """Stream rank logs; ?follow=1 tails until the job ends
+        (reference sky/skylet/log_lib.py tailing)."""
+        job_id = int(req.match_info['job_id'])
+        job = self.jobs.get(job_id)
+        if job is None:
+            return web.json_response({'error': 'not found'}, status=404)
+        follow = req.query.get('follow', '0') == '1'
+        rank = int(req.query.get('rank', 0))
+        resp = web.StreamResponse()
+        resp.content_type = 'text/plain'
+        await resp.prepare(req)
+        log_dir = job['log_dir']
+        paths = [os.path.join(log_dir, f'rank{rank}_setup.log'),
+                 os.path.join(log_dir, f'rank{rank}_run.log')]
+        for path in paths:
+            pos = 0
+            while True:
+                job = self.jobs.get(job_id)
+                if os.path.exists(path):
+                    with open(path, 'rb') as f:
+                        f.seek(pos)
+                        chunk = f.read()
+                        if chunk:
+                            pos += len(chunk)
+                            await resp.write(chunk)
+                done = job['status'].is_terminal()
+                if not follow or done:
+                    # Drain any remainder written between read and check.
+                    if os.path.exists(path):
+                        with open(path, 'rb') as f:
+                            f.seek(pos)
+                            chunk = f.read()
+                            if chunk:
+                                pos += len(chunk)
+                                await resp.write(chunk)
+                    break
+                await asyncio.sleep(0.2)
+        await resp.write_eof()
+        return resp
+
+    async def h_exec(self, req: web.Request) -> web.Response:
+        """Synchronous command on all hosts (setup / pre-exec stages)."""
+        body = await req.json()
+        log_dir = os.path.join(self.cluster_dir, 'exec_logs',
+                               str(int(time.time() * 1000)))
+        rcs = await self._fan_out(-1, body['cmd'], body.get('envs', {}),
+                                  log_dir, 'exec')
+        tails = {}
+        for r in range(len(rcs)):
+            p = os.path.join(log_dir, f'rank{r}_exec.log')
+            if os.path.exists(p):
+                with open(p, encoding='utf-8', errors='replace') as f:
+                    tails[r] = f.read()[-2000:]
+        return web.json_response({'returncodes': rcs, 'tails': tails})
+
+    async def h_run_rank(self, req: web.Request) -> web.Response:
+        """Peer-host execution endpoint (host mode fan-out target)."""
+        body = await req.json()
+        log_dir = os.path.join(self.cluster_dir, 'job_logs',
+                               str(body['job_id']))
+        rc = await self._run_rank(
+            int(body['job_id']), self.host_rank, body['cmd'],
+            body.get('envs', {}),
+            os.path.join(log_dir,
+                         f'rank{self.host_rank}_{body["phase"]}.log'))
+        return web.json_response({'returncode': rc})
+
+    async def h_autostop(self, req: web.Request) -> web.Response:
+        if req.method == 'POST':
+            body = await req.json()
+            body['set_at'] = time.time()
+            with open(self._autostop_file, 'w', encoding='utf-8') as f:
+                json.dump(body, f)
+            return web.json_response({'ok': True})
+        return web.json_response(self._autostop_config())
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get('/health', self.h_health)
+        app.router.add_post('/submit', self.h_submit)
+        app.router.add_get('/jobs', self.h_jobs)
+        app.router.add_get('/jobs/{job_id}', self.h_job)
+        app.router.add_post('/cancel/{job_id}', self.h_cancel)
+        app.router.add_get('/logs/{job_id}', self.h_logs)
+        app.router.add_post('/exec', self.h_exec)
+        app.router.add_post('/run_rank', self.h_run_rank)
+        app.router.add_route('*', '/autostop', self.h_autostop)
+        return app
+
+
+async def _main(cluster_dir: str, host: str, port: int) -> None:
+    agent = Agent(cluster_dir)
+    app = agent.make_app()
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    actual_port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+    with open(os.path.join(cluster_dir, 'agent.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump({'url': f'http://{host}:{actual_port}',
+                   'pid': os.getpid()}, f)
+    loop = asyncio.get_event_loop()
+    loop.create_task(agent.scheduler_loop())
+    loop.create_task(agent.autostop_loop())
+    while True:
+        await asyncio.sleep(3600)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cluster-dir', required=True)
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=0)
+    args = parser.parse_args()
+    try:
+        asyncio.run(_main(args.cluster_dir, args.host, args.port))
+    except KeyboardInterrupt:
+        sys.exit(0)
+
+
+if __name__ == '__main__':
+    main()

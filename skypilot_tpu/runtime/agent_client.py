@@ -1,0 +1,104 @@
+"""Synchronous client for the on-host agent.
+
+Counterpart of the reference's ``SkyletClient`` (reference
+cloud_vm_ray_backend.py:2718, gRPC over an SSH tunnel at :2305). Here the
+transport is plain HTTP to the head host's agent; on GCP the agent port is
+reachable over the VPC (or an SSH tunnel, handled by the backend).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common
+
+
+class AgentClient:
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.url = url.rstrip('/')
+        self.timeout = timeout
+
+    def wait_healthy(self, timeout: float = 60.0) -> Dict[str, Any]:
+        deadline = time.time() + timeout
+        last_err: Optional[Exception] = None
+        while time.time() < deadline:
+            try:
+                r = requests.get(f'{self.url}/health', timeout=5)
+                if r.ok:
+                    return r.json()
+            except requests.RequestException as e:
+                last_err = e
+            time.sleep(0.5)
+        raise exceptions.ClusterNotUpError(
+            f'Agent at {self.url} not healthy after {timeout}s: {last_err}')
+
+    def health(self) -> Dict[str, Any]:
+        r = requests.get(f'{self.url}/health', timeout=self.timeout)
+        r.raise_for_status()
+        return r.json()
+
+    def submit(self, name: str, run: str, setup: Optional[str] = None,
+               envs: Optional[Dict[str, str]] = None) -> int:
+        r = requests.post(f'{self.url}/submit', json={
+            'name': name, 'run': run, 'setup': setup, 'envs': envs or {},
+        }, timeout=self.timeout)
+        r.raise_for_status()
+        return int(r.json()['job_id'])
+
+    def job_status(self, job_id: int) -> common.JobStatus:
+        r = requests.get(f'{self.url}/jobs/{job_id}', timeout=self.timeout)
+        if r.status_code == 404:
+            raise exceptions.JobNotFoundError(f'job {job_id}')
+        r.raise_for_status()
+        return common.JobStatus(r.json()['status'])
+
+    def jobs(self) -> List[Dict[str, Any]]:
+        r = requests.get(f'{self.url}/jobs', timeout=self.timeout)
+        r.raise_for_status()
+        return r.json()['jobs']
+
+    def cancel(self, job_id: int) -> None:
+        r = requests.post(f'{self.url}/cancel/{job_id}',
+                          timeout=self.timeout)
+        if r.status_code == 404:
+            raise exceptions.JobNotFoundError(f'job {job_id}')
+        r.raise_for_status()
+
+    def exec_sync(self, cmd: str,
+                  envs: Optional[Dict[str, str]] = None,
+                  timeout: float = 600.0) -> Dict[str, Any]:
+        r = requests.post(f'{self.url}/exec',
+                          json={'cmd': cmd, 'envs': envs or {}},
+                          timeout=timeout)
+        r.raise_for_status()
+        return r.json()
+
+    def tail_logs(self, job_id: int, *, follow: bool = True,
+                  rank: int = 0) -> Iterator[bytes]:
+        with requests.get(
+                f'{self.url}/logs/{job_id}',
+                params={'follow': '1' if follow else '0', 'rank': rank},
+                stream=True, timeout=None) as r:
+            if r.status_code == 404:
+                raise exceptions.JobNotFoundError(f'job {job_id}')
+            r.raise_for_status()
+            yield from r.iter_content(chunk_size=None)
+
+    def wait_job(self, job_id: int,
+                 timeout: float = 3600.0) -> common.JobStatus:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            st = self.job_status(job_id)
+            if st.is_terminal():
+                return st
+            time.sleep(0.5)
+        raise TimeoutError(f'job {job_id} still running after {timeout}s')
+
+    def set_autostop(self, idle_minutes: int, down: bool = False) -> None:
+        r = requests.post(f'{self.url}/autostop', json={
+            'idle_minutes': idle_minutes, 'down': down,
+        }, timeout=self.timeout)
+        r.raise_for_status()

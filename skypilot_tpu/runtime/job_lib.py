@@ -1,0 +1,120 @@
+"""Per-cluster job queue (sqlite) — the agent's bookkeeping.
+
+Counterpart of the reference's ``sky/skylet/job_lib.py`` (JobStatus at :156,
+FIFOScheduler at :353, ``update_job_status`` at :814 with PID-based
+liveness, ``is_cluster_idle`` at :981). Lives on the head host (or in the
+fake slice's cluster dir locally); the agent is the only writer.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common
+
+JobStatus = common.JobStatus
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT,
+    status TEXT,
+    submitted_at REAL,
+    started_at REAL,
+    ended_at REAL,
+    run_cmd TEXT,
+    setup_cmd TEXT,
+    envs_json TEXT,
+    num_hosts INTEGER,
+    log_dir TEXT,
+    pids_json TEXT
+);
+"""
+
+
+class JobTable:
+    def __init__(self, db_path: str):
+        os.makedirs(os.path.dirname(db_path), exist_ok=True)
+        self._conn = sqlite3.connect(db_path, timeout=30.0,
+                                     check_same_thread=False)
+        self._conn.execute('PRAGMA journal_mode=WAL')
+        self._conn.executescript(_SCHEMA)
+        self._conn.row_factory = sqlite3.Row
+
+    def add_job(self, name: str, run_cmd: str, setup_cmd: Optional[str],
+                envs: Dict[str, str], num_hosts: int, log_dir: str) -> int:
+        cur = self._conn.execute(
+            'INSERT INTO jobs (name, status, submitted_at, run_cmd, '
+            'setup_cmd, envs_json, num_hosts, log_dir, pids_json) '
+            'VALUES (?,?,?,?,?,?,?,?,?)',
+            (name, JobStatus.PENDING.value, time.time(), run_cmd,
+             setup_cmd or '', json.dumps(envs), num_hosts, log_dir, '[]'))
+        self._conn.commit()
+        return int(cur.lastrowid)
+
+    def set_status(self, job_id: int, status: JobStatus) -> None:
+        cols = {'status': status.value}
+        if status == JobStatus.RUNNING:
+            cols['started_at'] = time.time()
+        elif status.is_terminal():
+            cols['ended_at'] = time.time()
+        sets = ', '.join(f'{k}=?' for k in cols)
+        self._conn.execute(f'UPDATE jobs SET {sets} WHERE job_id=?',
+                           (*cols.values(), job_id))
+        self._conn.commit()
+
+    def set_pids(self, job_id: int, pids: List[int]) -> None:
+        self._conn.execute('UPDATE jobs SET pids_json=? WHERE job_id=?',
+                           (json.dumps(pids), job_id))
+        self._conn.commit()
+
+    def get(self, job_id: int) -> Optional[Dict[str, Any]]:
+        row = self._conn.execute('SELECT * FROM jobs WHERE job_id=?',
+                                 (job_id,)).fetchone()
+        return self._to_dict(row) if row else None
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        rows = self._conn.execute(
+            'SELECT * FROM jobs ORDER BY job_id DESC').fetchall()
+        return [self._to_dict(r) for r in rows]
+
+    def next_pending(self) -> Optional[Dict[str, Any]]:
+        """FIFO: oldest PENDING job (reference FIFOScheduler, job_lib.py:353)."""
+        row = self._conn.execute(
+            "SELECT * FROM jobs WHERE status=? ORDER BY job_id LIMIT 1",
+            (JobStatus.PENDING.value,)).fetchone()
+        return self._to_dict(row) if row else None
+
+    def running_jobs(self) -> List[Dict[str, Any]]:
+        rows = self._conn.execute(
+            'SELECT * FROM jobs WHERE status IN (?,?,?)',
+            (JobStatus.RUNNING.value, JobStatus.SETTING_UP.value,
+             JobStatus.INIT.value)).fetchall()
+        return [self._to_dict(r) for r in rows]
+
+    def is_idle(self) -> bool:
+        """No pending or running jobs (reference is_cluster_idle,
+        job_lib.py:981)."""
+        row = self._conn.execute(
+            'SELECT COUNT(*) c FROM jobs WHERE status IN (?,?,?,?)',
+            (JobStatus.PENDING.value, JobStatus.RUNNING.value,
+             JobStatus.SETTING_UP.value, JobStatus.INIT.value)).fetchone()
+        return row['c'] == 0
+
+    def last_activity(self) -> float:
+        """Most recent job end/submit time (autostop idleness anchor)."""
+        row = self._conn.execute(
+            'SELECT MAX(MAX(COALESCE(ended_at,0)), '
+            'MAX(COALESCE(submitted_at,0))) m FROM jobs').fetchone()
+        return float(row['m'] or 0.0)
+
+    @staticmethod
+    def _to_dict(row: sqlite3.Row) -> Dict[str, Any]:
+        d = dict(row)
+        d['envs'] = json.loads(d.pop('envs_json') or '{}')
+        d['pids'] = json.loads(d.pop('pids_json') or '[]')
+        d['status'] = JobStatus(d['status'])
+        return d

@@ -1,0 +1,116 @@
+"""Command runners: run commands / sync files on cluster hosts.
+
+Counterpart of the reference's ``sky/utils/command_runner.py`` (base :329,
+``SSHCommandRunner`` :875 with ControlMaster + rsync,
+``LocalProcessCommandRunner`` :1690). The TPU backend prefers the on-host
+agent for *execution* (SSH-free, SURVEY.md §7 hard-parts note); runners are
+used for file *sync* and as the SSH fallback for debugging.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+_SSH_OPTS = [
+    '-o', 'StrictHostKeyChecking=no',
+    '-o', 'UserKnownHostsFile=/dev/null',
+    '-o', 'ConnectTimeout=10',
+    '-o', 'ControlMaster=auto',
+    '-o', 'ControlPath=~/.sky_tpu/ssh_control/%C',
+    '-o', 'ControlPersist=120s',
+]
+
+
+class CommandRunner:
+    """Run a command on one host and rsync files to it."""
+
+    def run(self, cmd: str, *, timeout: Optional[float] = None,
+            check: bool = False) -> Tuple[int, str, str]:
+        raise NotImplementedError
+
+    def rsync(self, src: str, dst: str, *, up: bool = True) -> None:
+        raise NotImplementedError
+
+    def _check(self, rc: int, cmd: str, stderr: str, check: bool) -> None:
+        if check and rc != 0:
+            raise exceptions.CommandError(rc, cmd, stderr)
+
+
+class LocalProcessCommandRunner(CommandRunner):
+    """Runs on this machine, rooted at a host dir (fake-slice hosts)."""
+
+    def __init__(self, host_dir: str):
+        self.host_dir = host_dir
+        os.makedirs(os.path.join(host_dir, 'workdir'), exist_ok=True)
+
+    def run(self, cmd: str, *, timeout: Optional[float] = None,
+            check: bool = False) -> Tuple[int, str, str]:
+        proc = subprocess.run(
+            cmd, shell=True, cwd=os.path.join(self.host_dir, 'workdir'),
+            capture_output=True, text=True, timeout=timeout)
+        self._check(proc.returncode, cmd, proc.stderr, check)
+        return proc.returncode, proc.stdout, proc.stderr
+
+    def rsync(self, src: str, dst: str, *, up: bool = True) -> None:
+        """`dst` is interpreted relative to the host dir (absolute remote
+        paths map into the host's sandbox)."""
+        target = os.path.join(self.host_dir, dst.lstrip('/'))
+        if not up:
+            src, target = target, src
+        src = os.path.expanduser(src)
+        if os.path.isdir(src):
+            # Trailing-slash rsync semantics: copy contents into target.
+            copy_contents = src.endswith('/')
+            os.makedirs(target if copy_contents
+                        else os.path.dirname(target) or '.', exist_ok=True)
+            dest = target if copy_contents else target
+            shutil.copytree(src, dest, dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(target) or '.', exist_ok=True)
+            shutil.copy2(src, target)
+
+
+class SSHCommandRunner(CommandRunner):
+    """SSH/rsync to a real host (reference :875). Used for TPU VMs when the
+    agent path is unavailable and for file sync."""
+
+    def __init__(self, ip: str, user: str = 'root',
+                 key_path: Optional[str] = None, port: int = 22):
+        self.ip = ip
+        self.user = user
+        self.key_path = key_path
+        self.port = port
+        os.makedirs(os.path.expanduser('~/.sky_tpu/ssh_control'),
+                    exist_ok=True)
+
+    def _ssh_base(self) -> List[str]:
+        cmd = ['ssh', *_SSH_OPTS, '-p', str(self.port)]
+        if self.key_path:
+            cmd += ['-i', os.path.expanduser(self.key_path)]
+        cmd.append(f'{self.user}@{self.ip}')
+        return cmd
+
+    def run(self, cmd: str, *, timeout: Optional[float] = None,
+            check: bool = False) -> Tuple[int, str, str]:
+        full = self._ssh_base() + [f'bash -lc {shlex.quote(cmd)}']
+        proc = subprocess.run(full, capture_output=True, text=True,
+                              timeout=timeout)
+        self._check(proc.returncode, cmd, proc.stderr, check)
+        return proc.returncode, proc.stdout, proc.stderr
+
+    def rsync(self, src: str, dst: str, *, up: bool = True) -> None:
+        ssh_cmd = ' '.join(['ssh', *_SSH_OPTS, '-p', str(self.port)] +
+                           (['-i', self.key_path] if self.key_path else []))
+        remote = f'{self.user}@{self.ip}:{dst}'
+        pair = [src, remote] if up else [remote, src]
+        proc = subprocess.run(
+            ['rsync', '-az', '--delete', '-e', ssh_cmd, *pair],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise exceptions.CommandError(proc.returncode,
+                                          f'rsync {src} {dst}', proc.stderr)

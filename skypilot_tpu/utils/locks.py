@@ -1,0 +1,30 @@
+"""Per-cluster file locks (reference sky/utils/locks.py).
+
+The engine's planner-under-lock discipline (reference
+sky/execution.py:469-487): every state-mutating operation on a cluster takes
+its lock so concurrent launches/downs serialize.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+import filelock
+
+from skypilot_tpu.utils import common
+
+
+def _lock_path(name: str) -> str:
+    d = os.path.join(common.base_dir(), 'locks')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'{name}.lock')
+
+
+@contextlib.contextmanager
+def cluster_lock(cluster_name: str,
+                 timeout: float = 60.0) -> Iterator[None]:
+    lock = filelock.FileLock(_lock_path(f'cluster_{cluster_name}'),
+                             timeout=timeout)
+    with lock:
+        yield

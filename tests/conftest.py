@@ -26,3 +26,10 @@ def sky_tpu_home(tmp_path, monkeypatch):
     home.mkdir()
     monkeypatch.setenv('SKY_TPU_HOME', str(home))
     yield str(home)
+    # Reap any agent daemons a failed test left behind (liveness-checked
+    # SIGTERM→SIGKILL, same path production teardown uses).
+    from skypilot_tpu.provision.local import instance as local_instance
+    clusters = home / 'clusters'
+    if clusters.is_dir():
+        for agent_json in clusters.glob('*/agent.json'):
+            local_instance._kill_agent(str(agent_json.parent), timeout=1.0)

@@ -1,0 +1,189 @@
+"""End-to-end on the local fake slice: launch → logs → queue → down.
+
+This is SURVEY.md §4(c): multi-host gang logic and jax.distributed wiring
+tested without TPUs — N "hosts" are N local subprocesses spawned by the
+agent with full rank/coordinator env injected.
+"""
+import os
+import textwrap
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu import state
+from skypilot_tpu.utils import common
+
+
+def _mk_task(run, name='t', accelerators='v5e-16', **kw):
+    return sky.Task(name, run=run,
+                    resources=sky.Resources(cloud='local',
+                                            accelerators=accelerators, **kw))
+
+
+def test_launch_multihost_env_wiring():
+    """A 4-host slice: every rank sees correct jax.distributed env."""
+    task = _mk_task(
+        'echo RANK=$SKY_TPU_NODE_RANK '
+        'NPROC=$JAX_NUM_PROCESSES PID=$JAX_PROCESS_ID '
+        'COORD=$JAX_COORDINATOR_ADDRESS TPUW=$TPU_WORKER_ID '
+        'ACC=$TPU_ACCELERATOR_TYPE')
+    job_id, info = core.launch(task, cluster_name='e2e', quiet=True)
+    assert job_id >= 1
+    assert info.num_hosts == 4
+    st = core.wait_job('e2e', job_id, timeout=60)
+    assert st == common.JobStatus.SUCCEEDED
+
+    # Each rank's log shows its own rank id and the shared coordinator.
+    ranks_seen = set()
+    for rank in range(4):
+        log = b''.join(core.tail_logs('e2e', job_id, follow=False,
+                                      rank=rank)).decode()
+        assert f'PID={rank}' in log, log
+        assert 'NPROC=4' in log
+        assert 'COORD=127.0.0.1:8476' in log
+        assert f'TPUW={rank}' in log
+        assert 'ACC=v5litepod-16' in log
+        ranks_seen.add(rank)
+    assert ranks_seen == {0, 1, 2, 3}
+
+    # Cluster is UP in state DB with cost/history bookkeeping.
+    rec = state.get_cluster('e2e')
+    assert rec['status'] == common.ClusterStatus.UP
+    core.down('e2e')
+    assert state.get_cluster('e2e') is None
+
+
+def test_setup_then_run_and_failed_setup():
+    task = sky.Task('with-setup', setup='echo SETUP_DONE > setup_marker',
+                    run='cat setup_marker',
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-1'))
+    job_id, _ = core.launch(task, cluster_name='setup-c', quiet=True)
+    assert core.wait_job('setup-c', job_id, timeout=60) == \
+        common.JobStatus.SUCCEEDED
+    log = b''.join(core.tail_logs('setup-c', job_id,
+                                  follow=False)).decode()
+    assert 'SETUP_DONE' in log
+
+    # Failing setup surfaces with host tails.
+    bad = sky.Task('bad-setup', setup='echo BOOM >&2; exit 3', run='true',
+                   resources=sky.Resources(cloud='local',
+                                           accelerators='v5e-1'))
+    with pytest.raises(sky.exceptions.CommandError) as ei:
+        core.launch(bad, cluster_name='setup-c', quiet=True)
+    assert 'BOOM' in str(ei.value)
+    core.down('setup-c')
+
+
+def test_exec_reuse_and_queue():
+    t1 = _mk_task('echo first', accelerators='v5e-4')
+    job1, _ = core.launch(t1, cluster_name='reuse', quiet=True)
+    core.wait_job('reuse', job1)
+    # exec onto the same cluster (no re-provision).
+    t2 = _mk_task('echo second', accelerators='v5e-4', )
+    job2, _ = core.exec_(t2, 'reuse')
+    assert job2 == job1 + 1
+    core.wait_job('reuse', job2)
+    q = core.queue('reuse')
+    assert len(q) == 2
+    assert {j['status'] for j in q} == {'SUCCEEDED'}
+    core.down('reuse')
+
+
+def test_oversubscribed_exec_rejected():
+    t1 = _mk_task('true', accelerators='v5e-4')
+    core.launch(t1, cluster_name='small', quiet=True)
+    big = _mk_task('true', accelerators='v5e-16')
+    with pytest.raises(sky.exceptions.ResourcesMismatchError):
+        core.exec_(big, 'small')
+    core.down('small')
+
+
+def test_cancel_running_job():
+    t = _mk_task('sleep 300', accelerators='v5e-1')
+    job_id, _ = core.launch(t, cluster_name='cancelme', quiet=True)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if core.job_status('cancelme', job_id) == common.JobStatus.RUNNING:
+            break
+        time.sleep(0.3)
+    core.cancel('cancelme', job_id)
+    st = core.wait_job('cancelme', job_id, timeout=30)
+    assert st == common.JobStatus.CANCELLED
+    core.down('cancelme')
+
+
+def test_stop_start_cycle():
+    t = _mk_task('echo alive', accelerators='v5e-1')
+    core.launch(t, cluster_name='ss', quiet=True)
+    core.stop('ss')
+    assert state.get_cluster('ss')['status'] == common.ClusterStatus.STOPPED
+    # Launch onto a stopped cluster is a clear error.
+    with pytest.raises(sky.exceptions.ClusterNotUpError):
+        core.launch(t, cluster_name='ss', quiet=True)
+    core.start('ss')
+    rec = state.get_cluster('ss')
+    assert rec['status'] == common.ClusterStatus.UP
+    # Agent is back: run a job.
+    job, _ = core.exec_(t, 'ss')
+    assert core.wait_job('ss', job) == common.JobStatus.SUCCEEDED
+    core.down('ss')
+
+
+def test_failover_on_injected_stockout(monkeypatch, tmp_path):
+    """Provisioning fails over across zones/regions on capacity errors."""
+    from skypilot_tpu import catalog
+    from skypilot_tpu.provision import provisioner
+    from skypilot_tpu.resources import Resources
+
+    res = Resources(cloud='local', accelerators='v5e-4')
+    good = catalog.Candidate(
+        cloud='local', region='region-b', zone='zone-b1',
+        instance_type='tpu-v5e-4', accelerator_name='v5e-4',
+        accelerator_count=1, use_spot=False, cost_per_hour=0.0,
+        num_hosts=1, tpu=res.tpu)
+    bad = catalog.Candidate(
+        cloud='local', region='region-a', zone='zone-a1',
+        instance_type='tpu-v5e-4', accelerator_name='v5e-4',
+        accelerator_count=1, use_spot=False, cost_per_hour=0.0,
+        num_hosts=1, tpu=res.tpu)
+    # Inject stockout in region-a via the marker file.
+    marker = os.path.join(common.clusters_dir(), 'fail_region-a')
+    with open(marker, 'w') as f:
+        f.write('1')
+    info, cand = provisioner.provision_with_retries(
+        'failover-c', res, [bad, good])
+    assert cand.region == 'region-b'
+    assert info.num_hosts == 1
+    from skypilot_tpu import provision
+    provision.terminate_instances('local', 'failover-c',
+                                  info.provider_config)
+
+    # All candidates fail -> ResourcesUnavailableError with history.
+    with open(marker, 'w') as f:
+        f.write('1')
+    with pytest.raises(sky.exceptions.ResourcesUnavailableError) as ei:
+        provisioner.provision_with_retries('failover-d', res, [bad])
+    assert len(ei.value.failover_history) == 1
+
+
+def test_workdir_and_file_mounts(tmp_path):
+    wd = tmp_path / 'proj'
+    wd.mkdir()
+    (wd / 'train.py').write_text('print("TRAINED")')
+    extra = tmp_path / 'data.txt'
+    extra.write_text('DATA123')
+    task = sky.Task('wd',
+                    run='python train.py && '
+                        'cat $SKY_TPU_HOST_ROOT/inputs/data.txt',
+                    workdir=str(wd),
+                    file_mounts={'/inputs/data.txt': str(extra)},
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4'))
+    job_id, _ = core.launch(task, cluster_name='wd-c', quiet=True)
+    assert core.wait_job('wd-c', job_id) == common.JobStatus.SUCCEEDED
+    log = b''.join(core.tail_logs('wd-c', job_id, follow=False)).decode()
+    assert 'TRAINED' in log and 'DATA123' in log
+    core.down('wd-c')

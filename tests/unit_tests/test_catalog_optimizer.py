@@ -159,3 +159,30 @@ def test_list_accelerators():
     v5p8 = accs['v5p-8'][0]
     assert v5p8['chips'] == 4
     assert v5p8['price'] == pytest.approx(4.2 * 4)
+
+
+def test_best_resources_preserves_fields():
+    # Non-placement fields must survive optimization (disk/ports/image).
+    t = Task('t', run='x', resources=Resources(
+        cloud='gcp', accelerators='v5e-8', disk_size_gb=512,
+        ports=[8080], image_id='my-image', runtime_version='v2-alpha'))
+    optimize(t, quiet=True)
+    br = t.best_resources
+    assert br.disk_size_gb == 512
+    assert br.ports == [8080]
+    assert br.image_id == 'my-image'
+    assert br.runtime_version == 'v2-alpha'
+    assert br.region is not None and br.zone is not None
+
+
+def test_exact_cpus_no_match():
+    import pytest as _pytest
+    from skypilot_tpu import exceptions as exc
+    t = Task('t', run='x', resources=Resources(cloud='gcp', cpus=12))
+    with _pytest.raises(exc.ResourcesUnavailableError):
+        optimize(t, quiet=True)
+    # minimum form matches larger instances
+    t2 = Task('t2', run='x', resources=Resources(cloud='gcp', cpus='12+'))
+    plan = optimize(t2, quiet=True)
+    assert plan.per_task[0].candidate.instance_type in (
+        'n2-standard-16', 'n2-standard-32')
