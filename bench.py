@@ -1,0 +1,100 @@
+"""Benchmark: Llama train-step throughput on the local TPU chip.
+
+Prints ONE JSON line:
+    {"metric": "train_tokens_per_sec_per_chip", "value": N,
+     "unit": "tokens/s/chip", "vs_baseline": M, ...}
+
+Methodology (documented because the reference publishes no model-level
+numbers — BASELINE.md): a ~350M-param Llama (bf16, remat, flash attention)
+trains with Adam on one chip; value = tokens/sec/chip. ``vs_baseline`` is
+model FLOPs utilization (MFU) divided by 0.40 — the tokens/sec/$-parity
+proxy from BASELINE.json: reference-class GPU frameworks sustain ~40% MFU
+on this workload, so vs_baseline > 1.0 means this framework extracts more
+of its hardware than the reference stack does of its H100s.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.train import trainer
+
+BATCH = 4
+SEQ = 2048
+WARMUP = 2
+STEPS = 5
+REFERENCE_MFU = 0.40
+
+PEAK_BF16_TFLOPS = {
+    'v5 lite': 197.0, 'v5litepod': 197.0, 'v5e': 197.0,
+    'v4': 275.0, 'v5p': 459.0, 'v6e': 918.0,
+}
+
+
+def _peak_tflops(device) -> float:
+    kind = getattr(device, 'device_kind', '').lower()
+    for key, val in PEAK_BF16_TFLOPS.items():
+        if key in kind:
+            return val
+    return 197.0   # assume v5e-class if unknown
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    on_tpu = jax.default_backend() == 'tpu'
+    steps = STEPS if on_tpu else 1
+    config = llama.LlamaConfig.bench_350m(
+        max_seq_len=SEQ, attention_impl='auto')
+    print(f'[bench] device={dev.device_kind} params={config.num_params/1e6:.0f}M '
+          f'batch={BATCH} seq={SEQ} backend={jax.default_backend()}',
+          file=sys.stderr)
+
+    opt = trainer.make_optimizer(total_steps=1000)
+    state = trainer.init_train_state(config, jax.random.PRNGKey(0), opt)
+    step = trainer.make_train_step(config, opt)
+    batch = trainer.synthetic_batch(config, BATCH, SEQ,
+                                    jax.random.PRNGKey(1))
+
+    t_compile = time.perf_counter()
+    for _ in range(WARMUP):
+        state, metrics = step(state, batch)
+    # float() forces a device->host transfer — a hard sync even on backends
+    # where block_until_ready returns early (e.g. tunneled devices).
+    float(metrics['loss'])
+    print(f'[bench] warmup+compile: {time.perf_counter() - t_compile:.1f}s',
+          file=sys.stderr)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    final_loss = float(metrics['loss'])
+    dt = time.perf_counter() - t0
+
+    tokens = BATCH * SEQ * steps
+    tok_per_sec = tokens / dt
+    flops_per_tok = llama.flops_per_token(
+        llama.LlamaConfig.bench_350m(max_seq_len=SEQ))
+    mfu = tok_per_sec * flops_per_tok / (_peak_tflops(dev) * 1e12)
+    print(f'[bench] {tok_per_sec:.0f} tok/s  step={dt/steps*1e3:.0f}ms  '
+          f'loss={final_loss:.3f}  MFU={mfu:.3f}',
+          file=sys.stderr)
+
+    print(json.dumps({
+        'metric': 'train_tokens_per_sec_per_chip',
+        'value': round(tok_per_sec, 1),
+        'unit': 'tokens/s/chip',
+        'vs_baseline': round(mfu / REFERENCE_MFU, 3),
+        'mfu': round(mfu, 4),
+        'model_params_m': round(config.num_params / 1e6),
+        'batch': BATCH, 'seq': SEQ,
+        'device': dev.device_kind,
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -100,14 +100,25 @@ class TpuVmBackend(Backend):
             h.external_ip or h.internal_ip, user=ssh_user, key_path=key)
             for h in info.hosts]
 
+    def _remote_workdir(self, info: ClusterInfo) -> str:
+        """The directory jobs run in — must match the agent's _rank_cwd.
+
+        Local fake slices: relative to each host sandbox. Real hosts: the
+        agent's cluster dir (gcp instance.py AGENT_CLUSTER_DIR).
+        """
+        if info.cloud == 'local':
+            return 'workdir/'
+        return '/opt/sky_tpu/cluster/workdir/'
+
     def sync_workdir(self, info: ClusterInfo, workdir: str) -> None:
         """Rsync the user's workdir to every host (reference
         sync_workdir, backend.py:93)."""
         src = os.path.expanduser(workdir)
         if not src.endswith('/'):
             src += '/'
+        dst = self._remote_workdir(info)
         for runner in self._runners(info):
-            runner.rsync(src, 'workdir/')
+            runner.rsync(src, dst)
 
     def sync_file_mounts(self, info: ClusterInfo,
                          file_mounts: Dict[str, str]) -> None:

@@ -22,8 +22,13 @@ from skypilot_tpu.utils import common
 def _engine():
     """Engine facade: direct or via SDK depending on config."""
     if os.environ.get('SKY_TPU_API_SERVER'):
-        from skypilot_tpu.client import sdk
-        return sdk
+        try:
+            from skypilot_tpu.client import sdk
+            return sdk
+        except ImportError as e:
+            raise click.ClickException(
+                f'SKY_TPU_API_SERVER is set but the SDK is unavailable: '
+                f'{e}') from e
     from skypilot_tpu import core
     return core
 
@@ -66,15 +71,17 @@ def launch(task_yaml: str, cluster: Optional[str], cloud: Optional[str],
     job_id, info = engine.launch(task, cluster_name=cluster, quiet=False)
     name = info.cluster_name
     click.echo(f'Cluster: {name}  job: {job_id}')
+    if autodown:
+        # Server-side: the agent downs the cluster once its queue idles —
+        # works detached and survives a client crash mid-tail.
+        engine.autostop(name, 0, True)
+        click.echo(f'{name}: will autodown when idle.')
     if job_id >= 0 and not detach_run:
         for chunk in engine.tail_logs(name, job_id, follow=True):
             sys.stdout.buffer.write(chunk)
             sys.stdout.buffer.flush()
         st = engine.job_status(name, job_id)
         click.echo(f'Job {job_id}: {st.value}')
-        if autodown:
-            engine.down(name)
-            click.echo(f'Cluster {name} downed.')
         if st != common.JobStatus.SUCCEEDED:
             sys.exit(100)
 

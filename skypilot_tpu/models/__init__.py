@@ -1,0 +1,1 @@
+"""Model zoo: Llama-family transformer (flagship) + ResNet example."""

@@ -1,0 +1,187 @@
+"""Llama-family decoder-only transformer, pure JAX, scan-over-layers.
+
+The framework's flagship model (BASELINE.md: Llama-3-8B finetune is the
+north-star workload). Design choices for TPU/XLA:
+
+- **Params are a pytree of stacked arrays** ([n_layers, ...] leading axis)
+  consumed by ``lax.scan`` — one layer gets compiled once, not n_layers
+  times, and remat applies per scan step.
+- **bf16 params/activations, fp32 softmax/norm internals** — MXU-native.
+- GQA (n_kv_heads < n_heads), SwiGLU MLP, RMSNorm, RoPE — Llama-3
+  architecture.
+- Attention dispatches to the Pallas flash kernel on TPU
+  (``ops/attention.py``) and dense elsewhere.
+
+Sharding of these params is defined in ``parallel/sharding.py`` (the model
+is sharding-agnostic; `jit` + NamedSharding do the work).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.ops import attention as attention_lib
+from skypilot_tpu.ops import norms
+from skypilot_tpu.ops import rope as rope_lib
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14_336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: str = 'bfloat16'
+    attention_impl: str = 'auto'    # 'auto' | 'flash' | 'dense'
+    remat: bool = True              # rematerialize each layer in backward
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def num_params(self) -> int:
+        d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        per_layer = (d * self.n_heads * self.head_dim            # wq
+                     + 2 * d * self.n_kv_heads * self.head_dim   # wk, wv
+                     + self.n_heads * self.head_dim * d          # wo
+                     + 3 * d * f                                 # gate/up/down
+                     + 2 * d)                                    # norms
+        return self.n_layers * per_layer + 2 * v * d + d
+
+    # ---- presets --------------------------------------------------------
+    @staticmethod
+    def llama3_8b(**kw) -> 'LlamaConfig':
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama3_70b(**kw) -> 'LlamaConfig':
+        return LlamaConfig(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                           ffn_dim=28_672, **kw)
+
+    @staticmethod
+    def bench_350m(**kw) -> 'LlamaConfig':
+        """~350M params: fits one v5e chip with Adam states for bench."""
+        base = dict(vocab_size=32_768, dim=1024, n_layers=16,
+                    n_heads=16, n_kv_heads=8, ffn_dim=4096,
+                    max_seq_len=2048)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def tiny(**kw) -> 'LlamaConfig':
+        """Test-sized config (CPU-fast)."""
+        base = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+                    dtype='float32')
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Params:
+    """Scaled-normal init, layers stacked on axis 0."""
+    dtype = jnp.dtype(config.dtype)
+    d, hd = config.dim, config.head_dim
+    L = config.n_layers
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(
+            dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    scale = d ** -0.5
+    out_scale = scale / (2 * L) ** 0.5   # GPT-2-style residual scaling
+    layers = {
+        'attn_norm': jnp.ones((L, d), dtype),
+        'wq': normal(ks[0], (L, d, config.n_heads * hd), scale),
+        'wk': normal(ks[1], (L, d, config.n_kv_heads * hd), scale),
+        'wv': normal(ks[2], (L, d, config.n_kv_heads * hd), scale),
+        'wo': normal(ks[3], (L, config.n_heads * hd, d), out_scale),
+        'mlp_norm': jnp.ones((L, d), dtype),
+        'w_gate': normal(ks[4], (L, d, config.ffn_dim), scale),
+        'w_up': normal(ks[5], (L, d, config.ffn_dim), scale),
+        'w_down': normal(ks[6], (L, config.ffn_dim, d), out_scale),
+    }
+    return {
+        'embed': normal(k_embed, (config.vocab_size, d), 1.0),
+        'layers': layers,
+        'final_norm': jnp.ones((d,), dtype),
+        'lm_head': normal(k_head, (d, config.vocab_size), scale),
+    }
+
+
+def _layer(config: LlamaConfig, x: jnp.ndarray, layer: Params,
+           cos: jnp.ndarray, sin: jnp.ndarray,
+           positions: Optional[jnp.ndarray]) -> jnp.ndarray:
+    b, s, d = x.shape
+    hq, hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+
+    h = norms.rms_norm(x, layer['attn_norm'], config.norm_eps)
+    q = (h @ layer['wq']).reshape(b, s, hq, hd)
+    k = (h @ layer['wk']).reshape(b, s, hkv, hd)
+    v = (h @ layer['wv']).reshape(b, s, hkv, hd)
+    q = rope_lib.apply_rope(q, cos, sin, positions)
+    k = rope_lib.apply_rope(k, cos, sin, positions)
+    # [b, s, h, hd] -> [b, h, s, hd] for the attention kernels.
+    att = attention_lib.attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal=True,
+        impl=config.attention_impl)
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, hq * hd)
+    x = x + att @ layer['wo']
+
+    h = norms.rms_norm(x, layer['mlp_norm'], config.norm_eps)
+    gate = jax.nn.silu(h @ layer['w_gate'])
+    x = x + (gate * (h @ layer['w_up'])) @ layer['w_down']
+    return x
+
+
+def forward(config: LlamaConfig, params: Params, tokens: jnp.ndarray,
+            positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """tokens [b, s] int32 -> logits [b, s, vocab] (fp32)."""
+    x = params['embed'][tokens]
+    cos, sin = rope_lib.rope_frequencies(config.head_dim,
+                                         config.max_seq_len,
+                                         config.rope_theta)
+
+    def body(carry, layer):
+        fn = _layer
+        if config.remat:
+            fn = jax.checkpoint(_layer, static_argnums=(0,))
+        return fn(config, carry, layer, cos, sin, positions), None
+
+    x, _ = jax.lax.scan(body, x, params['layers'])
+    x = norms.rms_norm(x, params['final_norm'], config.norm_eps)
+    return (x @ params['lm_head']).astype(jnp.float32)
+
+
+def loss_fn(config: LlamaConfig, params: Params, tokens: jnp.ndarray,
+            targets: jnp.ndarray,
+            mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Causal LM cross-entropy (fp32 logits)."""
+    logits = forward(config, params, tokens)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)
+
+
+def flops_per_token(config: LlamaConfig) -> float:
+    """Training FLOPs/token ~ 6 * params + attention quadratic term
+    (2*2*3*s*d per token at seq s, fwd+bwd)."""
+    base = 6.0 * config.num_params
+    attn = 12.0 * config.n_layers * config.max_seq_len * config.head_dim \
+        * config.n_heads
+    return base + attn

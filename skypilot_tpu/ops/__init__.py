@@ -1,0 +1,1 @@
+"""TPU-native neural net ops: fused-friendly primitives + Pallas kernels."""

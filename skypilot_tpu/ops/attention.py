@@ -1,0 +1,203 @@
+"""Attention: dense reference + Pallas TPU flash-attention kernel.
+
+The hot op of every model in the framework. Two implementations with one
+numerically-identical contract (inputs [batch, heads, seq, head_dim], GQA
+via fewer KV heads):
+
+- ``dense_attention``: O(seq^2)-memory einsum+softmax. XLA fuses this well;
+  it is the differentiable training fallback and the ground truth in tests.
+- ``flash_attention``: Pallas kernel, online-softmax over KV blocks, causal
+  block skipping, fp32 accumulators, O(seq) memory. Forward only; its
+  custom VJP recomputes through the dense path (a dedicated backward
+  kernel is the planned next step — see ROADMAP).
+
+Kernel design notes (per /opt/skills/guides/pallas_guide.md):
+- grid (batch, q_heads, seq/block_q); K/V blocks for the mapped KV head are
+  resident in VMEM; the inner fori_loop walks KV blocks with an early upper
+  bound under causality (skips fully-masked blocks, ~2x for causal).
+- GQA is folded into the BlockSpec index_map (head -> head // group), so no
+  KV replication is materialized in HBM.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30
+
+
+def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    *, causal: bool = True,
+                    sm_scale: Optional[float] = None) -> jnp.ndarray:
+    """Reference attention. q: [b, hq, s, d]; k/v: [b, hkv, s, d]."""
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    if hkv != hq:
+        assert hq % hkv == 0
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    logits = jnp.einsum('bhqd,bhkd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum('bhqk,bhkd->bhqd', probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention (forward)
+# ---------------------------------------------------------------------------
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *,
+                      sm_scale: float, causal: bool,
+                      block_q: int, block_k: int, seq_len: int):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale    # [block_q, d]
+    head_dim = q.shape[-1]
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        # Last KV block that any row of this Q block can see.
+        upper = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        upper = jnp.minimum(upper, num_k_blocks)
+    else:
+        upper = num_k_blocks
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
+            jnp.float32)                                   # [block_k, d]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    init = (
+        jnp.zeros((block_q, head_dim), jnp.float32),
+        jnp.full((block_q, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+    )
+    acc, _, l = jax.lax.fori_loop(0, upper, body, init)
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   causal: bool, sm_scale: float,
+                   block_q: int, block_k: int,
+                   interpret: bool) -> jnp.ndarray:
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0, (
+        f'seq_len {s} must be a multiple of block sizes '
+        f'({block_q}, {block_k})')
+    grid = (b, hq, s // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=s)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d),
+                         lambda bi, hi, qi, g=group: (bi, hi // g, 0, 0)),
+            pl.BlockSpec((1, 1, s, d),
+                         lambda bi, hi, qi, g=group: (bi, hi // g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k,
+                     interpret):
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret,
+                    residuals, g):
+    # Recompute-through-dense backward: correct, O(s^2) transient memory.
+    # A blocked Pallas backward kernel replaces this (ROADMAP).
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: dense_attention(q_, k_, v_, causal=causal,
+                                           sm_scale=sm_scale), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention. q: [b, hq, s, d]; k/v: [b, hkv, s, d] (GQA).
+
+    `interpret` defaults to True off-TPU so tests run on CPU.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != 'tpu'
+    return _flash_attention(q, k, v, causal, sm_scale, block_q, block_k,
+                            interpret)
+
+
+def attention(q, k, v, *, causal: bool = True,
+              sm_scale: Optional[float] = None,
+              impl: str = 'auto') -> jnp.ndarray:
+    """Dispatch: 'dense', 'flash', or 'auto' (flash on TPU when shapes
+    allow, else dense)."""
+    if impl == 'dense':
+        return dense_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if impl == 'flash':
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    s = q.shape[2]
+    on_tpu = jax.default_backend() == 'tpu'
+    if on_tpu and s % 128 == 0 and s >= 256:
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               block_q=min(DEFAULT_BLOCK_Q, s),
+                               block_k=min(DEFAULT_BLOCK_K, s))
+    return dense_attention(q, k, v, causal=causal, sm_scale=sm_scale)

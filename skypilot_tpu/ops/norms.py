@@ -1,0 +1,21 @@
+"""Normalization ops.
+
+RMSNorm in plain jnp: XLA fuses the reduction + rescale into neighbouring
+ops on TPU; a Pallas kernel buys nothing here (bandwidth-bound elementwise,
+already fused), so the idiomatic-TPU choice is to leave it to the compiler.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray,
+             eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm (Llama-style, no bias). Computes the variance in fp32
+    regardless of input dtype — required for bf16 stability."""
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(orig_dtype)

@@ -1,0 +1,37 @@
+"""Rotary position embeddings (RoPE), Llama-3 style."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int,
+                     theta: float = 500_000.0) -> Tuple[jnp.ndarray,
+                                                        jnp.ndarray]:
+    """Precomputed (cos, sin) tables, shape [max_seq_len, head_dim//2],
+    fp32 (precision matters at long context)."""
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               positions: jnp.ndarray = None) -> jnp.ndarray:
+    """Rotate pairs of channels. x: [..., seq, heads, head_dim].
+
+    `positions`: optional [..., seq] absolute positions (used by
+    sequence-parallel shards and decode caches); defaults to arange.
+    """
+    seq = x.shape[-3]
+    if positions is None:
+        c = cos[:seq][..., None, :]   # [seq, 1, hd/2]
+        s = sin[:seq][..., None, :]
+    else:
+        c = cos[positions][..., None, :]
+        s = sin[positions][..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,70 @@
+"""Device mesh construction for TPU slices.
+
+Axes convention (the scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert collectives):
+
+- ``dp``  : pure data parallel (replicated params) — outermost, rides DCN
+            across slices.
+- ``fsdp``: data parallel with sharded params/optimizer (ZeRO-3-style via
+            NamedSharding) — rides ICI.
+- ``tp``  : tensor parallel (megatron-style column/row sharding) —
+            innermost, highest-bandwidth ICI dimension.
+
+``mesh_from_slice`` maps a :class:`~skypilot_tpu.topology.TpuSlice`'s
+physical torus onto these logical axes so tp stays within a host's chips
+where possible.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from skypilot_tpu import topology
+
+AXES = ('dp', 'fsdp', 'tp')
+
+
+def make_mesh(dp: int = 1, fsdp: int = 1, tp: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    want = dp * fsdp * tp
+    if want != len(devices):
+        raise ValueError(
+            f'mesh {dp}x{fsdp}x{tp}={want} != {len(devices)} devices')
+    arr = np.array(devices).reshape(dp, fsdp, tp)
+    return Mesh(arr, AXES)
+
+
+def auto_mesh(n_devices: Optional[int] = None, *,
+              tp: Optional[int] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Reasonable default: all-FSDP, with optional tp factor.
+
+    FSDP-dominant is the right default on TPU pods (ICI makes per-layer
+    all-gathers cheap; pure dp wastes HBM on replicas).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    tp = tp or 1
+    if n % tp != 0:
+        raise ValueError(f'tp={tp} does not divide {n} devices')
+    return make_mesh(dp=1, fsdp=n // tp, tp=tp, devices=devices)
+
+
+def mesh_from_slice(s: topology.TpuSlice, *,
+                    tp: Optional[int] = None,
+                    dp: int = 1,
+                    devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Mesh for a whole slice. Default tp = chips_per_host (tensor parallel
+    within a host's chips — lowest-latency ICI), fsdp = the rest."""
+    if tp is None:
+        tp = min(s.chips_per_host, s.num_chips)
+    total = s.num_chips
+    if total % (tp * dp) != 0:
+        raise ValueError(f'dp={dp} * tp={tp} must divide {total} chips')
+    return make_mesh(dp=dp, fsdp=total // (tp * dp), tp=tp,
+                     devices=devices)

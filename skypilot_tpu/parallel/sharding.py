@@ -1,0 +1,84 @@
+"""Sharding rules: PartitionSpecs for model params, optimizer state, data.
+
+Megatron-style TP composed with ZeRO-3-style FSDP, expressed as
+NamedShardings (XLA inserts the all-gathers/reduce-scatters):
+
+- attention qkv projections: column-parallel (heads over ``tp``), fsdp on
+  the input dim.
+- attention output / MLP down: row-parallel (``tp`` on input dim).
+- MLP gate/up: column-parallel.
+- embed: vocab over ``tp`` (vocab-parallel embedding), model dim over
+  ``fsdp``; lm_head the transpose.
+- Optimizer state inherits its parameter's sharding (ZeRO-3).
+- Batch data: sharded over (``dp``, ``fsdp``) jointly — fsdp is also a data
+  axis.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+LLAMA_PARAM_SPECS: Dict[str, Any] = {
+    'embed': P('tp', 'fsdp'),
+    'layers': {
+        'attn_norm': P(None, None),
+        'wq': P(None, 'fsdp', 'tp'),
+        'wk': P(None, 'fsdp', 'tp'),
+        'wv': P(None, 'fsdp', 'tp'),
+        'wo': P(None, 'tp', 'fsdp'),
+        'mlp_norm': P(None, None),
+        'w_gate': P(None, 'fsdp', 'tp'),
+        'w_up': P(None, 'fsdp', 'tp'),
+        'w_down': P(None, 'tp', 'fsdp'),
+    },
+    'final_norm': P(None),
+    'lm_head': P('fsdp', 'tp'),
+}
+
+BATCH_SPEC = P(('dp', 'fsdp'), None)           # [batch, seq]
+
+
+def param_shardings(mesh: Mesh, params: Any) -> Any:
+    """NamedShardings matching the params pytree (LLAMA_PARAM_SPECS
+    broadcast over identical tree structure)."""
+    specs = LLAMA_PARAM_SPECS
+
+    def to_sharding(path, leaf):
+        node = specs
+        for p in path:
+            key = p.key if hasattr(p, 'key') else p.idx
+            node = node[key]
+        return NamedSharding(mesh, node)
+
+    return jax.tree_util.tree_map_with_path(to_sharding, params)
+
+
+def opt_state_shardings(mesh: Mesh, opt_state: Any, params: Any) -> Any:
+    """Optimizer state shards like its parameter (ZeRO-3). Non-pytree-of-
+    params leaves (step counters etc.) are replicated."""
+    p_shard = param_shardings(mesh, params)
+    flat_params, _ = jax.tree_util.tree_flatten(params)
+    flat_shards, _ = jax.tree_util.tree_flatten(p_shard)
+    shard_by_shape = {}
+    for p, s in zip(flat_params, flat_shards):
+        shard_by_shape.setdefault((p.shape, p.dtype), s)
+
+    def to_sharding(leaf):
+        key = (getattr(leaf, 'shape', ()), getattr(leaf, 'dtype', None))
+        if key in shard_by_shape:
+            return shard_by_shape[key]
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(to_sharding, opt_state)
+
+
+def shard_pytree(tree: Any, shardings: Any) -> Any:
+    """Place a host pytree onto the mesh with the given shardings."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), tree, shardings)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, BATCH_SPEC)

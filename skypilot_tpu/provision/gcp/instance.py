@@ -30,25 +30,16 @@ DEFAULT_RUNTIME_VERSIONS = {
 }
 
 AGENT_PORT = 46590
+AGENT_CLUSTER_DIR = '/opt/sky_tpu/cluster'
+# The startup script only prepares the host (deps + dirs). The agent config
+# needs the slice's internal IPs, which exist only after the node is READY —
+# so `_install_agents` pushes the per-host config and starts the agent over
+# SSH once `get_cluster_info` reports the endpoints.
 _STARTUP_SCRIPT = """#!/bin/bash
-# skypilot_tpu agent bootstrap (runs on every TPU host).
 set -e
 mkdir -p /opt/sky_tpu/cluster
-cd /opt/sky_tpu
 if ! command -v python3 >/dev/null; then apt-get update && apt-get install -y python3 python3-pip; fi
 python3 -m pip install -q aiohttp requests pyyaml 2>/dev/null || true
-# The framework wheel is synced by the backend on first connect; the agent
-# config is written from TPU metadata below.
-WORKER_ID=$(curl -s -H 'Metadata-Flavor: Google' \
-  'http://metadata.google.internal/computeMetadata/v1/instance/attributes/agent-worker-id' || echo 0)
-cat > /opt/sky_tpu/cluster/agent_config.json <<EOF
-{"cluster_name": "%(cluster_name)s", "mode": "host",
- "host_rank": ${WORKER_ID}, "host_ips": %(host_ips_json)s,
- "num_hosts": %(num_hosts)d, "tpu_slice": "%(tpu_slice)s"}
-EOF
-nohup python3 -m skypilot_tpu.runtime.agent \
-  --cluster-dir /opt/sky_tpu/cluster --host 0.0.0.0 --port %(agent_port)d \
-  >/opt/sky_tpu/agent.log 2>&1 &
 """
 
 
@@ -81,19 +72,57 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         runtime_version=runtime_version,
         spot=config.use_spot,
         labels={**config.labels, 'sky-tpu-cluster': config.cluster_name},
-        startup_script=_STARTUP_SCRIPT % {
-            'cluster_name': config.cluster_name,
-            'host_ips_json': '[]',  # filled post-create via metadata update
-            'num_hosts': s.num_hosts,
-            'tpu_slice': s.name,
-            'agent_port': AGENT_PORT,
-        })
+        startup_script=_STARTUP_SCRIPT)
     info = get_cluster_info(config.cluster_name, {
         **config.provider_config, 'zone': config.zone})
     if info is None:
         raise exceptions.ProvisionError(
             f'TPU node {config.cluster_name} vanished after create')
+    _install_agents(info, config)
     return info
+
+
+def _install_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
+    """Push per-host agent config + the framework itself, start agents.
+
+    Host 0 is head; its agent fans out to the peers' /run_rank. Runs over
+    SSH (the TPU VM's metadata-managed keys).
+    """
+    import json
+
+    from skypilot_tpu.utils import command_runner
+    ssh_user = config.provider_config.get('ssh_user', 'sky')
+    key = config.provider_config.get('ssh_key', '~/.sky_tpu/keys/sky-key')
+    internal_ips = [h.internal_ip for h in info.hosts]
+    for rank, host in enumerate(info.hosts):
+        agent_config = {
+            'cluster_name': info.cluster_name,
+            'mode': 'host',
+            'host_rank': rank,
+            'host_ips': internal_ips,
+            'num_hosts': len(info.hosts),
+            'tpu_slice': info.tpu_slice,
+            'peer_agent_urls': [
+                f'http://{ip}:{AGENT_PORT}'
+                for i, ip in enumerate(internal_ips) if i != rank
+            ] if rank == 0 else [],
+            'provider_config': dict(config.provider_config),
+        }
+        runner = command_runner.SSHCommandRunner(
+            host.external_ip or host.internal_ip, user=ssh_user,
+            key_path=key)
+        cfg_json = json.dumps(agent_config).replace("'", "'\\''")
+        runner.run(
+            f"sudo mkdir -p {AGENT_CLUSTER_DIR} && "
+            f"sudo chown -R $(whoami) /opt/sky_tpu && "
+            f"echo '{cfg_json}' > {AGENT_CLUSTER_DIR}/agent_config.json && "
+            f"(python3 -m pip show skypilot-tpu >/dev/null 2>&1 || "
+            f"python3 -m pip install -q skypilot-tpu || true) && "
+            f"pgrep -f 'skypilot_tpu.runtime.agent' >/dev/null || "
+            f"nohup python3 -m skypilot_tpu.runtime.agent "
+            f"--cluster-dir {AGENT_CLUSTER_DIR} --host 0.0.0.0 "
+            f"--port {AGENT_PORT} >/opt/sky_tpu/agent.log 2>&1 &",
+            check=True, timeout=120)
 
 
 def get_cluster_info(cluster_name: str,

@@ -113,7 +113,9 @@ def provision_with_retries(
             if e.blocked_region:
                 blocked_regions.add((cand.cloud, e.blocked_region))
             last_err = e
-            _cleanup_partial(cand.cloud, cluster_name)
+            _cleanup_partial(cand.cloud, cluster_name,
+                             _make_config(cand, cluster_name,
+                                          res).provider_config)
         except exceptions.NoCloudAccessError as e:
             failover_history.append(e)
             # Credentials missing: no point trying other zones of the
@@ -128,10 +130,16 @@ def provision_with_retries(
         failover_history=failover_history)
 
 
-def _cleanup_partial(cloud: str, cluster_name: str) -> None:
-    """Best-effort teardown of a half-created slice before failover."""
+def _cleanup_partial(cloud: str, cluster_name: str,
+                     provider_config: dict) -> None:
+    """Best-effort teardown of a half-created slice before failover.
+
+    `provider_config` must carry the attempt's zone/project — an empty
+    config would make GCP lookup fail silently and leak a billed node.
+    """
     try:
-        info = provision.get_cluster_info(cloud, cluster_name, {})
+        info = provision.get_cluster_info(cloud, cluster_name,
+                                          provider_config)
         if info is not None:
             provision.terminate_instances(cloud, cluster_name,
                                           info.provider_config)

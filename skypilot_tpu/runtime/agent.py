@@ -164,25 +164,22 @@ class Agent:
         my = self._run_rank(job_id, self.host_rank, cmd, envs,
                             os.path.join(log_dir,
                                          f'rank{self.host_rank}_{phase}.log'))
-        peer_calls = []
-        async with aiohttp.ClientSession() as sess:
-            for url in self.peer_agent_urls:
-                peer_calls.append(sess.post(f'{url}/run_rank', json={
+
+        async def call_peer(sess: 'aiohttp.ClientSession', url: str) -> int:
+            # Response body must be read while the session is open.
+            async with sess.post(f'{url}/run_rank', json={
                     'job_id': job_id, 'cmd': cmd, 'envs': envs,
                     'phase': phase,
-                }, timeout=aiohttp.ClientTimeout(total=None)))
-            results = await asyncio.gather(my, *peer_calls,
-                                           return_exceptions=True)
-        rcs: List[int] = []
-        for res in results:
-            if isinstance(res, Exception):
-                rcs.append(255)
-            elif isinstance(res, int):
-                rcs.append(res)
-            else:
+            }, timeout=aiohttp.ClientTimeout(total=None)) as res:
                 body = await res.json()
-                rcs.append(int(body.get('returncode', 255)))
-        return rcs
+                return int(body.get('returncode', 255))
+
+        async with aiohttp.ClientSession() as sess:
+            results = await asyncio.gather(
+                my, *(call_peer(sess, url) for url in self.peer_agent_urls),
+                return_exceptions=True)
+        return [255 if isinstance(r, BaseException) else int(r)
+                for r in results]
 
     async def scheduler_loop(self) -> None:
         """FIFO, one job at a time (reference JobSchedulerEvent,
@@ -326,31 +323,35 @@ class Agent:
         resp.content_type = 'text/plain'
         await resp.prepare(req)
         log_dir = job['log_dir']
-        paths = [os.path.join(log_dir, f'rank{rank}_setup.log'),
-                 os.path.join(log_dir, f'rank{rank}_run.log')]
-        for path in paths:
-            pos = 0
-            while True:
-                job = self.jobs.get(job_id)
-                if os.path.exists(path):
-                    with open(path, 'rb') as f:
-                        f.seek(pos)
-                        chunk = f.read()
-                        if chunk:
-                            pos += len(chunk)
-                            await resp.write(chunk)
-                done = job['status'].is_terminal()
-                if not follow or done:
-                    # Drain any remainder written between read and check.
-                    if os.path.exists(path):
-                        with open(path, 'rb') as f:
-                            f.seek(pos)
-                            chunk = f.read()
-                            if chunk:
-                                pos += len(chunk)
-                                await resp.write(chunk)
-                    break
-                await asyncio.sleep(0.2)
+        setup_path = os.path.join(log_dir, f'rank{rank}_setup.log')
+        run_path = os.path.join(log_dir, f'rank{rank}_run.log')
+        # Stream both files concurrently by position: the setup phase only
+        # writes the setup log, the run phase only the run log, so a single
+        # interleaved pass moves from one to the other as the job advances
+        # (a pure per-file loop would sit on the setup log until the job
+        # *ends* and never show live run output).
+        pos = {setup_path: 0, run_path: 0}
+
+        async def drain(path: str) -> None:
+            if not os.path.exists(path):
+                return
+            with open(path, 'rb') as f:
+                f.seek(pos[path])
+                chunk = f.read()
+            if chunk:
+                pos[path] += len(chunk)
+                await resp.write(chunk)
+
+        while True:
+            job = self.jobs.get(job_id)
+            await drain(setup_path)
+            await drain(run_path)
+            if not follow or job['status'].is_terminal():
+                # Final drain catches writes between read and status check.
+                await drain(setup_path)
+                await drain(run_path)
+                break
+            await asyncio.sleep(0.2)
         await resp.write_eof()
         return resp
 

@@ -7,12 +7,19 @@ exercised without TPUs (the driver separately dry-runs the multichip path).
 """
 import os
 
-# Must happen before any jax import anywhere in the test session.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Must happen before any jax usage in the test session. The env vars alone
+# are not enough: a sitecustomize may pin a TPU platform via jax.config at
+# interpreter startup, so the config is forced again post-import.
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ['JAX_PLATFORM_NAME'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import tempfile
 

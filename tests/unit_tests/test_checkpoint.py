@@ -1,0 +1,54 @@
+"""Orbax checkpoint save/restore round-trip + resume convention."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.train import checkpoint, trainer
+
+
+def test_save_restore_roundtrip(tmp_path):
+    cfg = llama.LlamaConfig.tiny()
+    opt = trainer.make_optimizer(warmup_steps=1, total_steps=10)
+    state = trainer.init_train_state(cfg, jax.random.PRNGKey(0), opt)
+    step = trainer.make_train_step(cfg, opt)
+    batch = trainer.synthetic_batch(cfg, 2, 16, jax.random.PRNGKey(1))
+    state, _ = step(state, batch)
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path / 'ckpt'))
+    assert mgr.save(1, state)
+    mgr.wait()
+    assert mgr.latest_step() == 1
+
+    restored = mgr.restore(target=state)
+    np.testing.assert_array_equal(np.asarray(restored.step),
+                                  np.asarray(state.step))
+    a = jax.tree_util.tree_leaves(restored.params)
+    b = jax.tree_util.tree_leaves(state.params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    mgr.close()
+
+
+def test_restore_or_init(tmp_path):
+    cfg = llama.LlamaConfig.tiny()
+    opt = trainer.make_optimizer(warmup_steps=1, total_steps=10)
+    ckpt_dir = str(tmp_path / 'ckpt2')
+
+    def init():
+        return trainer.init_train_state(cfg, jax.random.PRNGKey(0), opt)
+
+    state, restored = checkpoint.restore_or_init(ckpt_dir, init)
+    assert not restored
+
+    # Simulate progress then a preemption + recovery.
+    state = trainer.TrainState(step=state.step + 5, params=state.params,
+                               opt_state=state.opt_state)
+    mgr = checkpoint.CheckpointManager(ckpt_dir)
+    mgr.save(5, state)
+    mgr.wait()
+    mgr.close()
+
+    state2, restored2 = checkpoint.restore_or_init(ckpt_dir, init)
+    assert restored2
+    assert int(state2.step) == 5

@@ -1,0 +1,92 @@
+"""Numerics for ops: rms_norm, rope, dense vs flash attention."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.ops import attention, norms, rope
+
+
+def test_rms_norm_matches_manual():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32), jnp.float32)
+    w = jnp.ones((32,)) * 2.0
+    out = norms.rms_norm(x, w)
+    expected = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True)
+                           + 1e-5) * 2.0
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_rms_norm_bf16_stable():
+    x = (jax.random.normal(jax.random.PRNGKey(0), (4, 64)) * 100).astype(
+        jnp.bfloat16)
+    out = norms.rms_norm(x, jnp.ones((64,), jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16
+    assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+
+
+def test_rope_rotation_preserves_norm():
+    cos, sin = rope.rope_frequencies(64, 128)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 4, 64))
+    out = rope.apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-4)
+    # Position 0 is unrotated.
+    np.testing.assert_allclose(out[:, 0], x[:, 0], rtol=1e-5)
+
+
+def test_rope_relative_property():
+    # <rope(q,m), rope(k,n)> depends only on m-n: shift both by 5.
+    cos, sin = rope.rope_frequencies(32, 64)
+    q = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 1, 32))
+    k = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, 32))
+    def dot_at(m, n):
+        pm = jnp.array([[m]])
+        pn = jnp.array([[n]])
+        qr = rope.apply_rope(q, cos, sin, positions=pm)
+        kr = rope.apply_rope(k, cos, sin, positions=pn)
+        return float(jnp.sum(qr * kr))
+    assert dot_at(7, 3) == pytest.approx(dot_at(12, 8), rel=1e-4)
+
+
+@pytest.mark.parametrize('causal', [True, False])
+@pytest.mark.parametrize('hq,hkv', [(4, 4), (8, 2)])
+def test_flash_matches_dense(causal, hq, hkv):
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, s, d = 2, 256, 64
+    q = jax.random.normal(kq, (b, hq, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.float32)
+    # Pin full precision: the environment's default matmul precision may be
+    # bf16-class, which would make the *dense* path the imprecise one.
+    with jax.default_matmul_precision('float32'):
+        ref = attention.dense_attention(q, k, v, causal=causal)
+        out = attention.flash_attention(q, k, v, causal=causal,
+                                        block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_grad_matches_dense_grad():
+    b, h, s, d = 1, 2, 128, 32
+    key = jax.random.PRNGKey(1)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in
+               jax.random.split(key, 3))
+    def loss_flash(q, k, v):
+        return jnp.sum(attention.flash_attention(
+            q, k, v, causal=True, block_q=64, block_k=64) ** 2)
+    def loss_dense(q, k, v):
+        return jnp.sum(attention.dense_attention(q, k, v, causal=True) ** 2)
+    with jax.default_matmul_precision('float32'):
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_attention_dispatch_cpu_uses_dense():
+    q = jnp.zeros((1, 2, 64, 32))
+    out = attention.attention(q, q, q, impl='auto')
+    assert out.shape == q.shape
