@@ -38,16 +38,15 @@ def __getattr__(name: str):
         'launch', 'exec', 'status', 'stop', 'start', 'down', 'autostop',
         'queue', 'cancel', 'tail_logs', 'cost_report', 'optimize',
     }
+    import importlib
     try:
         if name in _engine_api:
-            from skypilot_tpu import core
+            core = importlib.import_module('skypilot_tpu.core')
             return getattr(core, name)
-        if name == 'jobs':
-            from skypilot_tpu import jobs
-            return jobs
-        if name == 'serve':
-            from skypilot_tpu import serve
-            return serve
+        if name in ('jobs', 'serve'):
+            # importlib, not from-import: a from-import falls back to this
+            # very __getattr__ and recurses when the submodule is missing.
+            return importlib.import_module(f'skypilot_tpu.{name}')
     except ImportError as e:
         # Keep hasattr()/getattr(default) semantics intact.
         raise AttributeError(

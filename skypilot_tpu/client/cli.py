@@ -239,6 +239,77 @@ def cost_report() -> None:
                               f"{r['cost']:.2f}"))
 
 
+@cli.group()
+def api() -> None:
+    """Manage the local API server."""
+
+
+@api.command('start')
+@click.option('--host', default='127.0.0.1')
+@click.option('--port', type=int, default=common.DEFAULT_API_PORT)
+@click.option('--foreground', is_flag=True, default=False)
+def api_start(host: str, port: int, foreground: bool) -> None:
+    """Start the API server (background daemon by default)."""
+    import subprocess
+    import time as time_lib
+
+    from skypilot_tpu.utils import common as common_lib
+    if foreground:
+        from skypilot_tpu.server import app as server_app
+        sys.argv = ['app', '--host', host, '--port', str(port)]
+        server_app.main()
+        return
+    log = open(os.path.join(common_lib.base_dir(), 'api_server.log'), 'ab')
+    subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.app',
+         '--host', host, '--port', str(port)],
+        stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
+    url = f'http://{host}:{port}'
+    deadline = time_lib.time() + 15
+    import requests as requests_lib
+    while time_lib.time() < deadline:
+        try:
+            if requests_lib.get(f'{url}/api/health', timeout=1).ok:
+                click.echo(f'API server running at {url}')
+                click.echo(f'Point clients at it: '
+                           f'export SKY_TPU_API_SERVER={url}')
+                return
+        except requests_lib.RequestException:
+            time_lib.sleep(0.3)
+    raise click.ClickException('API server failed to start (see '
+                               '~/.sky_tpu/api_server.log)')
+
+
+@api.command('stop')
+def api_stop() -> None:
+    """Stop the background API server."""
+    import json as json_lib
+    import signal
+
+    from skypilot_tpu.utils import common as common_lib
+    meta_path = os.path.join(common_lib.base_dir(), 'api_server.json')
+    if not os.path.exists(meta_path):
+        click.echo('No API server metadata found.')
+        return
+    with open(meta_path, encoding='utf-8') as f:
+        meta = json_lib.load(f)
+    try:
+        os.kill(meta['pid'], signal.SIGTERM)
+        click.echo(f'Stopped API server (pid {meta["pid"]}).')
+    except ProcessLookupError:
+        click.echo('API server not running.')
+    os.unlink(meta_path)
+
+
+@api.command('status')
+def api_status() -> None:
+    """Probe the API server's health."""
+    from skypilot_tpu.client import sdk
+    health = sdk.api_health()
+    click.echo(f'{sdk.server_url()}: {health["status"]} '
+               f'(v{health["version"]}, api {health["api_version"]})')
+
+
 def main() -> None:
     try:
         cli(standalone_mode=False)

@@ -1,0 +1,179 @@
+"""Python SDK over the REST API server.
+
+Counterpart of the reference's ``sky/client/sdk.py`` (3,210 LoC): the same
+async request pattern — every call POSTs, gets a ``request_id``, then
+``stream_and_get``/``get`` resolve it (reference sdk.py:2150/:2226). The
+function surface mirrors ``skypilot_tpu.core`` so the CLI can swap between
+direct-engine and server mode transparently.
+
+Server discovery: ``SKY_TPU_API_SERVER`` env var, or ``api_server.endpoint``
+in the layered config, else http://127.0.0.1:46580.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import requests as requests_lib
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.utils import common
+
+
+def server_url() -> str:
+    url = os.environ.get('SKY_TPU_API_SERVER')
+    if not url:
+        url = config_lib.get_nested(('api_server', 'endpoint'))
+    return (url or
+            f'http://127.0.0.1:{common.DEFAULT_API_PORT}').rstrip('/')
+
+
+def _post(op: str, payload: Dict[str, Any]) -> str:
+    url = server_url()
+    try:
+        r = requests_lib.post(f'{url}/{op}', json=payload, timeout=30)
+    except requests_lib.RequestException as e:
+        raise exceptions.ApiServerConnectionError(url) from e
+    if r.status_code == 400:
+        raise exceptions.SkyTpuError(r.json().get('error', r.text))
+    r.raise_for_status()
+    return r.json()['request_id']
+
+
+def get(request_id: str) -> Any:
+    """Resolve a finished request's result (blocks by polling)."""
+    url = server_url()
+    while True:
+        r = requests_lib.get(f'{url}/api/get/{request_id}', timeout=30)
+        r.raise_for_status()
+        body = r.json()
+        status = body['status']
+        if status == 'SUCCEEDED':
+            return body['result']
+        if status in ('FAILED', 'CANCELLED'):
+            raise exceptions.SkyTpuError(
+                body.get('error') or f'request {request_id} {status}')
+        time.sleep(0.3)
+
+
+def stream_and_get(request_id: str, *, quiet: bool = False) -> Any:
+    """Stream the request's server-side log, then return its result."""
+    url = server_url()
+    with requests_lib.get(f'{url}/api/stream/{request_id}', stream=True,
+                          timeout=None) as r:
+        r.raise_for_status()
+        for chunk in r.iter_content(chunk_size=None):
+            if not quiet and chunk:
+                import sys
+                sys.stdout.buffer.write(chunk)
+                sys.stdout.buffer.flush()
+    return get(request_id)
+
+
+def api_health() -> Dict[str, Any]:
+    url = server_url()
+    try:
+        r = requests_lib.get(f'{url}/api/health', timeout=5)
+        r.raise_for_status()
+        return r.json()
+    except requests_lib.RequestException as e:
+        raise exceptions.ApiServerConnectionError(url) from e
+
+
+def api_requests() -> List[Dict[str, Any]]:
+    r = requests_lib.get(f'{server_url()}/api/requests', timeout=30)
+    r.raise_for_status()
+    return r.json()['requests']
+
+
+# ---- core-mirroring surface ---------------------------------------------
+def launch(task: task_lib.Task, cluster_name: Optional[str] = None,
+           *, quiet: bool = True, **_kw) -> Tuple[int, ClusterInfo]:
+    rid = _post('launch', {'task': task.to_yaml_config(),
+                           'cluster_name': cluster_name})
+    result = stream_and_get(rid, quiet=quiet)
+    return result['job_id'], ClusterInfo.from_dict(result['cluster_info'])
+
+
+def exec(task: task_lib.Task, cluster_name: str,  # noqa: A001
+         **_kw) -> Tuple[int, ClusterInfo]:
+    rid = _post('exec', {'task': task.to_yaml_config(),
+                         'cluster_name': cluster_name})
+    result = get(rid)
+    return result['job_id'], ClusterInfo.from_dict(result['cluster_info'])
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    rid = _post('status', {'cluster_names': cluster_names,
+                           'refresh': refresh})
+    records = get(rid)
+    for r in records:
+        r['status'] = common.ClusterStatus(r['status'])
+    return records
+
+
+def down(cluster_name: str) -> None:
+    get(_post('down', {'cluster_name': cluster_name}))
+
+
+def stop(cluster_name: str) -> None:
+    get(_post('stop', {'cluster_name': cluster_name}))
+
+
+def start(cluster_name: str) -> None:
+    get(_post('start', {'cluster_name': cluster_name}))
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_: bool = False) -> None:
+    get(_post('autostop', {'cluster_name': cluster_name,
+                           'idle_minutes': idle_minutes, 'down': down_}))
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    return get(_post('queue', {'cluster_name': cluster_name}))
+
+
+def cancel(cluster_name: str, job_id: int) -> None:
+    get(_post('cancel', {'cluster_name': cluster_name, 'job_id': job_id}))
+
+
+def job_status(cluster_name: str, job_id: int) -> common.JobStatus:
+    return common.JobStatus(get(_post('job_status', {
+        'cluster_name': cluster_name, 'job_id': job_id})))
+
+
+def wait_job(cluster_name: str, job_id: int,
+             timeout: float = 3600.0) -> common.JobStatus:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = job_status(cluster_name, job_id)
+        if st.is_terminal():
+            return st
+        time.sleep(0.5)
+    raise TimeoutError(f'job {job_id} still running after {timeout}s')
+
+
+def tail_logs(cluster_name: str, job_id: int, *, follow: bool = True,
+              rank: int = 0) -> Iterator[bytes]:
+    url = server_url()
+    with requests_lib.get(
+            f'{url}/logs/{cluster_name}/{job_id}',
+            params={'follow': '1' if follow else '0', 'rank': rank},
+            stream=True, timeout=None) as r:
+        r.raise_for_status()
+        yield from r.iter_content(chunk_size=None)
+
+
+def check(clouds: Optional[List[str]] = None) -> Dict[str, bool]:
+    return get(_post('check', {'clouds': clouds}))
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    return get(_post('cost_report', {}))

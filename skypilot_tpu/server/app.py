@@ -1,0 +1,383 @@
+"""REST API server (aiohttp).
+
+Counterpart of the reference's FastAPI server (reference
+sky/server/server.py, 3,302 LoC, ~70 endpoints) with the same async
+architecture: every mutating call returns a ``request_id`` immediately;
+clients poll ``/api/get`` or stream ``/api/stream``. fastapi/uvicorn are
+not in this environment — aiohttp serves the same role; the wire protocol
+is a private detail behind ``client/sdk.py``.
+
+Two executor lanes (reference's long/short queues,
+sky/server/requests/executor.py:1-20): LONG ops (launch/down/start/stop)
+and SHORT ops (status/queue/...) run on separate thread pools so a slow
+provision never starves a status call. Ops are IO-bound (cloud APIs, agent
+HTTP), so threads — not processes — are the right worker model here.
+
+Run: ``sky-tpu api start`` (spawns ``python -m skypilot_tpu.server.app``).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import functools
+import io
+import json
+import logging
+import os
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict
+
+from aiohttp import web
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.server.requests_store import RequestStatus, RequestStore
+from skypilot_tpu.utils import common
+
+DEFAULT_PORT = common.DEFAULT_API_PORT
+API_VERSION = 1
+
+logger = logging.getLogger(__name__)
+
+LONG_OPS = {'launch', 'exec', 'down', 'stop', 'start', 'jobs.launch',
+            'serve.up', 'serve.down', 'serve.update'}
+
+
+class _ThreadRoutedWriter(io.TextIOBase):
+    """stdout/stderr proxy routing writes to the current thread's log file.
+
+    ``contextlib.redirect_stdout`` mutates process-global state and
+    corrupts concurrent workers (thread A's restore re-points thread B's
+    output at a closed file). This proxy is installed once; each request
+    thread registers its own sink.
+    """
+
+    def __init__(self, fallback):
+        self._fallback = fallback
+        self._local = threading.local()
+
+    def register(self, f) -> None:
+        self._local.sink = f
+
+    def unregister(self) -> None:
+        self._local.sink = None
+
+    def _sink(self):
+        return getattr(self._local, 'sink', None) or self._fallback
+
+    def write(self, s: str) -> int:
+        return self._sink().write(s)
+
+    def flush(self) -> None:
+        self._sink().flush()
+
+
+class Server:
+    def __init__(self) -> None:
+        self.store = RequestStore()
+        self.store.interrupted_to_failed()
+        self.long_pool = ThreadPoolExecutor(max_workers=4,
+                                            thread_name_prefix='long')
+        self.short_pool = ThreadPoolExecutor(max_workers=8,
+                                             thread_name_prefix='short')
+        # Log tails can pin a worker for a job's entire runtime — they get
+        # their own pool so they never starve status/queue ops.
+        self.logs_pool = ThreadPoolExecutor(max_workers=16,
+                                            thread_name_prefix='logs')
+        self._stdout_router = _ThreadRoutedWriter(sys.stdout)
+        self._stderr_router = _ThreadRoutedWriter(sys.stderr)
+        sys.stdout = self._stdout_router
+        sys.stderr = self._stderr_router
+
+    # ---- request execution ---------------------------------------------
+    def _run_request(self, request_id: str, fn: Callable[[], Any]) -> None:
+        req = self.store.get(request_id)
+        log_path = req['log_path']
+        self.store.set_status(request_id, RequestStatus.RUNNING)
+        try:
+            with open(log_path, 'a', encoding='utf-8') as logf:
+                self._stdout_router.register(logf)
+                self._stderr_router.register(logf)
+                try:
+                    result = fn()
+                finally:
+                    self._stdout_router.unregister()
+                    self._stderr_router.unregister()
+            self.store.set_status(request_id, RequestStatus.SUCCEEDED,
+                                  result=result)
+        except Exception as e:  # noqa: BLE001 — errors go to the client
+            with open(log_path, 'a', encoding='utf-8') as logf:
+                traceback.print_exc(file=logf)
+            self.store.set_status(
+                request_id, RequestStatus.FAILED,
+                error=f'{type(e).__name__}: {e}')
+
+    def submit(self, name: str, payload: Dict[str, Any],
+               fn: Callable[[], Any]) -> str:
+        request_id = self.store.create(name, payload)
+        pool = self.long_pool if name in LONG_OPS else self.short_pool
+        pool.submit(self._run_request, request_id, fn)
+        return request_id
+
+    # ---- op payload -> engine call --------------------------------------
+    @staticmethod
+    def _task_from_payload(payload: Dict[str, Any]) -> task_lib.Task:
+        return task_lib.Task.from_yaml_config(payload['task'])
+
+    def _dispatch(self, name: str, payload: Dict[str, Any]
+                  ) -> Callable[[], Any]:
+        if name in ('launch', 'exec') and 'task' not in payload:
+            raise KeyError("'task'")
+        if name == 'launch':
+            def fn():
+                job_id, info = core.launch(
+                    self._task_from_payload(payload),
+                    cluster_name=payload.get('cluster_name'),
+                    quiet=False)
+                return {'job_id': job_id, 'cluster_info': info.to_dict()}
+            return fn
+        if name == 'exec':
+            def fn():
+                job_id, info = core.exec(
+                    self._task_from_payload(payload),
+                    payload['cluster_name'])
+                return {'job_id': job_id, 'cluster_info': info.to_dict()}
+            return fn
+        if name == 'status':
+            def fn():
+                out = []
+                for r in core.status(payload.get('cluster_names'),
+                                     refresh=payload.get('refresh', False)):
+                    r = dict(r)
+                    r['status'] = r['status'].value
+                    out.append(r)
+                return out
+            return fn
+        if name in ('down', 'stop', 'start'):
+            return functools.partial(getattr(core, name),
+                                     payload['cluster_name'])
+        if name == 'autostop':
+            return functools.partial(core.autostop, payload['cluster_name'],
+                                     payload['idle_minutes'],
+                                     payload.get('down', False))
+        if name == 'queue':
+            return functools.partial(core.queue, payload['cluster_name'])
+        if name == 'cancel':
+            return functools.partial(core.cancel, payload['cluster_name'],
+                                     payload['job_id'])
+        if name == 'job_status':
+            return lambda: core.job_status(payload['cluster_name'],
+                                           payload['job_id']).value
+        if name == 'check':
+            return functools.partial(core.check, payload.get('clouds'))
+        if name == 'cost_report':
+            return core.cost_report
+        if name.startswith('jobs.') or name.startswith('serve.'):
+            try:
+                if name.startswith('jobs.'):
+                    from skypilot_tpu import jobs as jobs_lib
+                    return self._dispatch_jobs(name, payload, jobs_lib)
+                from skypilot_tpu import serve as serve_lib
+                return self._dispatch_serve(name, payload, serve_lib)
+            except (ImportError, AttributeError) as e:
+                raise web.HTTPNotImplemented(
+                    text=f'op {name} not available: {e}') from e
+        raise web.HTTPNotFound(text=f'unknown op {name}')
+
+    def _dispatch_jobs(self, name, payload, jobs_lib):
+        if name == 'jobs.launch':
+            return functools.partial(
+                jobs_lib.launch, self._task_from_payload(payload),
+                name=payload.get('name'))
+        if name == 'jobs.queue':
+            return jobs_lib.queue
+        if name == 'jobs.cancel':
+            return functools.partial(jobs_lib.cancel, payload['job_id'])
+        raise web.HTTPNotFound(text=f'unknown op {name}')
+
+    def _dispatch_serve(self, name, payload, serve_lib):
+        if name == 'serve.up':
+            return functools.partial(
+                serve_lib.up, self._task_from_payload(payload),
+                service_name=payload.get('service_name'))
+        if name == 'serve.down':
+            return functools.partial(serve_lib.down,
+                                     payload['service_name'])
+        if name == 'serve.status':
+            return functools.partial(serve_lib.status,
+                                     payload.get('service_name'))
+        raise web.HTTPNotFound(text=f'unknown op {name}')
+
+    # ---- HTTP handlers ---------------------------------------------------
+    async def h_op(self, req: web.Request) -> web.Response:
+        name = req.match_info['op']
+        try:
+            payload = await req.json() if req.can_read_body else {}
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            return web.json_response(
+                {'error': f'malformed JSON body: {e}'}, status=400)
+        try:
+            fn = self._dispatch(name, payload)
+        except web.HTTPException:
+            raise
+        except KeyError as e:
+            return web.json_response(
+                {'error': f'missing field {e}'}, status=400)
+        request_id = self.submit(name, payload, fn)
+        return web.json_response({'request_id': request_id})
+
+    async def h_get(self, req: web.Request) -> web.Response:
+        r = self.store.get(req.match_info['request_id'])
+        if r is None:
+            return web.json_response({'error': 'unknown request'},
+                                     status=404)
+        return web.json_response({
+            'request_id': r['request_id'],
+            'name': r['name'],
+            'status': r['status'].value,
+            'result': r['result'],
+            'error': r['error'],
+        })
+
+    async def h_stream(self, req: web.Request) -> web.StreamResponse:
+        """Tail a request's log until it finishes (reference
+        /api/stream, server.py:2201)."""
+        request_id = req.match_info['request_id']
+        r = self.store.get(request_id)
+        if r is None:
+            return web.json_response({'error': 'unknown request'},
+                                     status=404)
+        resp = web.StreamResponse()
+        resp.content_type = 'text/plain'
+        await resp.prepare(req)
+        loop = asyncio.get_event_loop()
+
+        def read_state(pos: int):
+            # sqlite (30s lock timeout) + file IO must not block the event
+            # loop — one stuck poll would freeze every endpoint.
+            r = self.store.get(request_id)
+            chunk = b''
+            path = r['log_path']
+            if path and os.path.exists(path):
+                with open(path, 'rb') as f:
+                    f.seek(pos)
+                    chunk = f.read()
+            return r, chunk
+
+        pos = 0
+        while True:
+            r, chunk = await loop.run_in_executor(self.short_pool,
+                                                  read_state, pos)
+            if chunk:
+                pos += len(chunk)
+                await resp.write(chunk)
+            if r['status'].is_terminal():
+                break
+            await asyncio.sleep(0.2)
+        await resp.write_eof()
+        return resp
+
+    async def h_job_logs(self, req: web.Request) -> web.StreamResponse:
+        """Proxy a cluster job's logs through the server."""
+        cluster = req.match_info['cluster']
+        job_id = int(req.match_info['job_id'])
+        follow = req.query.get('follow', '1') == '1'
+        rank = int(req.query.get('rank', 0))
+        resp = web.StreamResponse()
+        resp.content_type = 'text/plain'
+        await resp.prepare(req)
+        loop = asyncio.get_event_loop()
+        queue: asyncio.Queue = asyncio.Queue(maxsize=64)
+        stop = threading.Event()
+
+        def pump():
+            try:
+                for chunk in core.tail_logs(cluster, job_id, follow=follow,
+                                            rank=rank):
+                    if stop.is_set():
+                        break
+                    asyncio.run_coroutine_threadsafe(queue.put(chunk),
+                                                     loop).result()
+            except exceptions.SkyTpuError as e:
+                if not stop.is_set():
+                    asyncio.run_coroutine_threadsafe(
+                        queue.put(f'error: {e}'.encode()), loop).result()
+            except Exception:  # noqa: BLE001 — loop may be closing
+                pass
+            finally:
+                with contextlib.suppress(Exception):
+                    asyncio.run_coroutine_threadsafe(queue.put(None),
+                                                     loop).result(timeout=5)
+
+        self.logs_pool.submit(pump)
+        try:
+            while True:
+                chunk = await queue.get()
+                if chunk is None:
+                    break
+                await resp.write(chunk)
+        finally:
+            # Client disconnect (or any write error) cancels the pump so it
+            # does not tail an orphaned stream for the rest of the job.
+            stop.set()
+            while not queue.empty():
+                queue.get_nowait()
+        await resp.write_eof()
+        return resp
+
+    async def h_health(self, _req: web.Request) -> web.Response:
+        return web.json_response({
+            'status': 'healthy',
+            'api_version': API_VERSION,
+            'version': __import__('skypilot_tpu').__version__,
+        })
+
+    async def h_requests(self, _req: web.Request) -> web.Response:
+        return web.json_response({'requests': self.store.list_requests()})
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get('/api/health', self.h_health)
+        app.router.add_get('/api/requests', self.h_requests)
+        app.router.add_get('/api/get/{request_id}', self.h_get)
+        app.router.add_get('/api/stream/{request_id}', self.h_stream)
+        app.router.add_get('/logs/{cluster}/{job_id}', self.h_job_logs)
+        app.router.add_post('/{op:[a-z_.]+}', self.h_op)
+        return app
+
+
+async def _serve(host: str, port: int) -> None:
+    server = Server()
+    runner = web.AppRunner(server.make_app())
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    # Bind FIRST: a failed bind (port busy) must not clobber a live
+    # server's metadata with a dead pid.
+    await site.start()
+    with open(os.path.join(common.base_dir(), 'api_server.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump({'url': f'http://{host}:{port}', 'pid': os.getpid()}, f)
+    logger.info('API server on %s:%s', host, port)
+    while True:
+        await asyncio.sleep(3600)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    try:
+        asyncio.run(_serve(args.host, args.port))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == '__main__':
+    main()

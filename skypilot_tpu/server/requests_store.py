@@ -1,0 +1,123 @@
+"""Async request bookkeeping for the API server.
+
+Counterpart of the reference's ``sky/server/requests/`` (RequestQueue/
+RequestWorker, executor.py): every API call becomes a persistent request
+row; clients poll/stream by request id. sqlite-backed so requests survive
+server restarts (reference keeps a requests DB for the same reason).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common
+
+
+class RequestStatus(enum.Enum):
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS requests (
+    request_id TEXT PRIMARY KEY,
+    name TEXT,
+    status TEXT,
+    created_at REAL,
+    finished_at REAL,
+    payload_json TEXT,
+    result_json TEXT,
+    error TEXT,
+    log_path TEXT,
+    pid INTEGER
+);
+"""
+
+
+class RequestStore:
+    def __init__(self, db_path: Optional[str] = None):
+        self.db_path = db_path or os.path.join(common.base_dir(),
+                                               'server_requests.db')
+        self._local = threading.local()
+
+    @property
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, 'conn', None)
+        if conn is None:
+            os.makedirs(os.path.dirname(self.db_path), exist_ok=True)
+            conn = sqlite3.connect(self.db_path, timeout=30.0)
+            conn.execute('PRAGMA journal_mode=WAL')
+            conn.executescript(_SCHEMA)
+            conn.row_factory = sqlite3.Row
+            self._local.conn = conn
+        return conn
+
+    def create(self, name: str, payload: Dict[str, Any]) -> str:
+        request_id = common.new_request_id()
+        log_dir = os.path.join(common.base_dir(), 'server_logs')
+        os.makedirs(log_dir, exist_ok=True)
+        self._conn.execute(
+            'INSERT INTO requests (request_id, name, status, created_at, '
+            'payload_json, log_path) VALUES (?,?,?,?,?,?)',
+            (request_id, name, RequestStatus.PENDING.value, time.time(),
+             json.dumps(payload),
+             os.path.join(log_dir, f'{request_id}.log')))
+        self._conn.commit()
+        return request_id
+
+    def set_status(self, request_id: str, status: RequestStatus,
+                   *, result: Any = None, error: Optional[str] = None
+                   ) -> None:
+        cols: Dict[str, Any] = {'status': status.value}
+        if status.is_terminal():
+            cols['finished_at'] = time.time()
+        if result is not None:
+            cols['result_json'] = json.dumps(result)
+        if error is not None:
+            cols['error'] = error
+        sets = ', '.join(f'{k}=?' for k in cols)
+        self._conn.execute(
+            f'UPDATE requests SET {sets} WHERE request_id=?',
+            (*cols.values(), request_id))
+        self._conn.commit()
+
+    def get(self, request_id: str) -> Optional[Dict[str, Any]]:
+        row = self._conn.execute(
+            'SELECT * FROM requests WHERE request_id=?',
+            (request_id,)).fetchone()
+        if row is None:
+            return None
+        d = dict(row)
+        d['status'] = RequestStatus(d['status'])
+        d['payload'] = json.loads(d.pop('payload_json') or '{}')
+        rj = d.pop('result_json')
+        d['result'] = json.loads(rj) if rj else None
+        return d
+
+    def list_requests(self, limit: int = 100) -> List[Dict[str, Any]]:
+        rows = self._conn.execute(
+            'SELECT request_id, name, status, created_at, finished_at, '
+            'error FROM requests ORDER BY created_at DESC LIMIT ?',
+            (limit,)).fetchall()
+        return [dict(r) for r in rows]
+
+    def interrupted_to_failed(self) -> None:
+        """On server restart: RUNNING requests from a dead server are
+        failed (their worker thread is gone)."""
+        self._conn.execute(
+            'UPDATE requests SET status=?, error=? WHERE status IN (?,?)',
+            (RequestStatus.FAILED.value, 'server restarted mid-request',
+             RequestStatus.RUNNING.value, RequestStatus.PENDING.value))
+        self._conn.commit()

@@ -7,6 +7,7 @@ import time
 import uuid
 
 HOME_ENV_VAR = 'SKY_TPU_HOME'
+DEFAULT_API_PORT = 46580
 
 
 def base_dir() -> str:
