@@ -240,6 +240,108 @@ def cost_report() -> None:
 
 
 @cli.group()
+def jobs() -> None:
+    """Managed jobs: auto-recovering (spot) task execution."""
+
+
+def _jobs_engine():
+    """jobs facade: direct engine or SDK (mirrors _engine())."""
+    if os.environ.get('SKY_TPU_API_SERVER'):
+        from skypilot_tpu.client import sdk
+
+        class _SdkJobs:
+            launch = staticmethod(
+                lambda task, name=None: sdk.jobs_launch(task, name))
+            queue = staticmethod(sdk.jobs_queue)
+            cancel = staticmethod(sdk.jobs_cancel)
+        return _SdkJobs
+    from skypilot_tpu import jobs as jobs_lib
+    return jobs_lib
+
+
+@jobs.command('launch')
+@click.argument('task_yaml')
+@click.option('--name', '-n', default=None, help='Job name.')
+@click.option('--env', multiple=True, help='KEY=VALUE env override.')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_launch(task_yaml: str, name: Optional[str], env: tuple,
+                yes: bool) -> None:
+    """Submit a managed job (auto-recovers on preemption)."""
+    task = _load_task(task_yaml, env)
+    if not yes:
+        click.confirm(
+            f'Submitting managed job {name or task.name or task_yaml} '
+            f'({task.resources!r}). Proceed?', abort=True)
+    job_id = _jobs_engine().launch(task, name=name)
+    click.echo(f'Managed job: {job_id}')
+    click.echo(f'Watch: sky-tpu jobs queue   '
+               f'logs: sky-tpu jobs logs {job_id}')
+
+
+@jobs.command('queue')
+def jobs_queue() -> None:
+    """List managed jobs."""
+    rows = _jobs_engine().queue()
+    fmt = '{:<6} {:<18} {:<16} {:>4} {:<20}'
+    click.echo(fmt.format('ID', 'NAME', 'STATUS', 'REC', 'CLUSTER'))
+    for j in rows:
+        click.echo(fmt.format(j['job_id'], (j['name'] or '')[:18],
+                              j['status'], j['recovery_count'],
+                              j['cluster_name'] or '-'))
+
+
+@jobs.command('cancel')
+@click.argument('job_id', type=int)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_cancel(job_id: int, yes: bool) -> None:
+    """Cancel a managed job (tears its cluster down)."""
+    if not yes:
+        click.confirm(f'Cancel managed job {job_id}?', abort=True)
+    _jobs_engine().cancel(job_id)
+    click.echo(f'Cancellation requested for job {job_id}.')
+
+
+@jobs.command('logs')
+@click.argument('job_id', type=int)
+@click.option('--follow/--no-follow', default=True)
+@click.option('--controller', is_flag=True, default=False,
+              help='Show the controller log instead of the job output.')
+def jobs_logs(job_id: int, follow: bool, controller: bool) -> None:
+    """Tail a managed job's output (or its controller's log)."""
+    server_mode = bool(os.environ.get('SKY_TPU_API_SERVER'))
+    if controller:
+        if server_mode:
+            raise click.ClickException(
+                '--controller logs live on the API-server host; run there '
+                'without SKY_TPU_API_SERVER set.')
+        from skypilot_tpu import jobs as jobs_lib
+        for chunk in jobs_lib.tail_controller_logs(job_id, follow=follow):
+            sys.stdout.buffer.write(chunk)
+            sys.stdout.buffer.flush()
+        return
+    if server_mode:
+        # The server's DB owns managed jobs; resolve the cluster through
+        # it and stream via the server's log proxy.
+        from skypilot_tpu.client import sdk
+        records = [j for j in sdk.jobs_queue() if j['job_id'] == job_id]
+        if not records:
+            raise click.ClickException(f'No managed job {job_id}.')
+        record, tail = records[0], sdk.tail_logs
+    else:
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu import jobs as jobs_lib
+        record, tail = jobs_lib.get(job_id), core_lib.tail_logs
+    cluster, cjid = record['cluster_name'], record['cluster_job_id']
+    if not cluster or cjid < 0:
+        raise click.ClickException(
+            f'Job {job_id} has no cluster yet ({record["status"]}); try '
+            f'--controller for the launch narration.')
+    for chunk in tail(cluster, cjid, follow=follow):
+        sys.stdout.buffer.write(chunk)
+        sys.stdout.buffer.flush()
+
+
+@cli.group()
 def api() -> None:
     """Manage the local API server."""
 

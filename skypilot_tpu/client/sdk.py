@@ -45,13 +45,33 @@ def _post(op: str, payload: Dict[str, Any]) -> str:
     return r.json()['request_id']
 
 
+def _http_get(path: str, *, timeout=30, stream: bool = False):
+    """GET with the same error contract as _post: connection trouble and
+    HTTP errors surface as SkyTpuError subclasses, never raw requests
+    exceptions (clients catch SkyTpuError only)."""
+    url = server_url()
+    try:
+        r = requests_lib.get(f'{url}{path}', timeout=timeout,
+                             stream=stream)
+        r.raise_for_status()
+        return r
+    except requests_lib.HTTPError as e:
+        detail = ''
+        try:
+            detail = e.response.json().get('error', '')
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            pass
+        raise exceptions.SkyTpuError(
+            f'API server error for GET {path}: '
+            f'{detail or e}') from e
+    except requests_lib.RequestException as e:
+        raise exceptions.ApiServerConnectionError(url) from e
+
+
 def get(request_id: str) -> Any:
     """Resolve a finished request's result (blocks by polling)."""
-    url = server_url()
     while True:
-        r = requests_lib.get(f'{url}/api/get/{request_id}', timeout=30)
-        r.raise_for_status()
-        body = r.json()
+        body = _http_get(f'/api/get/{request_id}').json()
         status = body['status']
         if status == 'SUCCEEDED':
             return body['result']
@@ -63,10 +83,8 @@ def get(request_id: str) -> Any:
 
 def stream_and_get(request_id: str, *, quiet: bool = False) -> Any:
     """Stream the request's server-side log, then return its result."""
-    url = server_url()
-    with requests_lib.get(f'{url}/api/stream/{request_id}', stream=True,
-                          timeout=None) as r:
-        r.raise_for_status()
+    with _http_get(f'/api/stream/{request_id}', stream=True,
+                   timeout=None) as r:
         for chunk in r.iter_content(chunk_size=None):
             if not quiet and chunk:
                 import sys
@@ -86,9 +104,7 @@ def api_health() -> Dict[str, Any]:
 
 
 def api_requests() -> List[Dict[str, Any]]:
-    r = requests_lib.get(f'{server_url()}/api/requests', timeout=30)
-    r.raise_for_status()
-    return r.json()['requests']
+    return _http_get('/api/requests').json()['requests']
 
 
 # ---- core-mirroring surface ---------------------------------------------
@@ -162,12 +178,10 @@ def wait_job(cluster_name: str, job_id: int,
 
 def tail_logs(cluster_name: str, job_id: int, *, follow: bool = True,
               rank: int = 0) -> Iterator[bytes]:
-    url = server_url()
-    with requests_lib.get(
-            f'{url}/logs/{cluster_name}/{job_id}',
-            params={'follow': '1' if follow else '0', 'rank': rank},
-            stream=True, timeout=None) as r:
-        r.raise_for_status()
+    follow_q = '1' if follow else '0'
+    with _http_get(f'/logs/{cluster_name}/{job_id}'
+                   f'?follow={follow_q}&rank={rank}',
+                   stream=True, timeout=None) as r:
         yield from r.iter_content(chunk_size=None)
 
 
@@ -177,3 +191,17 @@ def check(clouds: Optional[List[str]] = None) -> Dict[str, bool]:
 
 def cost_report() -> List[Dict[str, Any]]:
     return get(_post('cost_report', {}))
+
+
+# ---- managed jobs (reference sky/jobs/client/sdk.py) ---------------------
+def jobs_launch(task: task_lib.Task, name: Optional[str] = None) -> int:
+    return get(_post('jobs.launch', {'task': task.to_yaml_config(),
+                                     'name': name}))
+
+
+def jobs_queue() -> List[Dict[str, Any]]:
+    return get(_post('jobs.queue', {}))
+
+
+def jobs_cancel(job_id: int) -> bool:
+    return get(_post('jobs.cancel', {'job_id': job_id}))

@@ -78,6 +78,7 @@ def launch(
     detach_run: bool = True,
     stages: Optional[List[Stage]] = None,
     quiet: bool = True,
+    blocked_placements: Optional[List[Tuple[str, str]]] = None,
 ) -> Tuple[int, ClusterInfo]:
     """Provision (or reuse) a cluster and run the task on it.
 
@@ -103,6 +104,13 @@ def launch(
             # Best-first candidate list feeds the failover loop (reference:
             # the optimizer's output seeds RetryingVmProvisioner's zones).
             candidates = _failover_candidates(task, optimize_target)
+            if blocked_placements:
+                blocked_set = set(blocked_placements)
+                keep = [c for c in candidates
+                        if (c.region, c.zone) not in blocked_set]
+                # An all-blocked list means capacity moved on — fall back
+                # to the full list rather than failing the launch.
+                candidates = keep or candidates
             info = backend.provision(task, cluster_name, candidates)
 
         if Stage.SYNC_WORKDIR in run_stages and task.workdir:

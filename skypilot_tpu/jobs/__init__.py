@@ -1,0 +1,96 @@
+"""Managed jobs: auto-recovering task execution.
+
+Counterpart of the reference's ``sky/jobs/`` (§2.5 of SURVEY.md):
+``launch`` (reference sky/jobs/server/core.py:500) submits a job whose
+detached controller provisions a (typically spot) TPU slice, monitors it,
+and relaunches on preemption per the task's recovery strategy.
+
+The reference launches a dedicated controller *cluster* and recursively
+``sky.launch``es from there; the TPU-native design runs controllers as
+local daemon processes of the API server host — same state machine, no
+controller-cluster cold start. The jobs themselves still run on real
+(or local fake) slices.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus  # noqa: F401 (public)
+
+
+def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
+    """Submit a managed job; returns its job id immediately.
+
+    Reference sky/jobs/server/core.py:500 (minus the controller-cluster
+    provisioning, see module doc).
+    """
+    job_name = name or task.name or 'managed-job'
+    task.name = job_name
+    return scheduler.submit_job(job_name, task.to_yaml(),
+                                resources_str=repr(task.resources))
+
+
+def queue(refresh: bool = True) -> List[Dict[str, Any]]:
+    """All managed jobs, newest first (reference jobs queue)."""
+    if refresh:
+        scheduler.reconcile()
+    return [jobs_state.to_json(j) for j in jobs_state.get_jobs()]
+
+
+def get(job_id: int) -> Dict[str, Any]:
+    record = jobs_state.get_job(job_id)
+    if record is None:
+        raise exceptions.JobNotFoundError(f'managed job {job_id}')
+    return jobs_state.to_json(record)
+
+
+def cancel(job_id: int) -> bool:
+    """Request cancellation; the controller tears the cluster down."""
+    record = jobs_state.get_job(job_id)
+    if record is None:
+        raise exceptions.JobNotFoundError(f'managed job {job_id}')
+    return jobs_state.request_cancel(job_id)
+
+
+def wait(job_id: int, timeout: float = 3600.0,
+         poll_s: float = 0.2) -> ManagedJobStatus:
+    """Block until the job reaches a terminal state (test/SDK helper)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = jobs_state.get_job(job_id)
+        if record is None:
+            raise exceptions.JobNotFoundError(f'managed job {job_id}')
+        if record['status'].is_terminal():
+            return record['status']
+        time.sleep(poll_s)
+    raise TimeoutError(f'managed job {job_id} not terminal '
+                       f'after {timeout}s')
+
+
+def tail_controller_logs(job_id: int, follow: bool = False
+                         ) -> Iterator[bytes]:
+    """The controller's own log (launch/recovery narration)."""
+    path = jobs_state.controller_log_path(job_id)
+    pos = 0
+    while True:
+        try:
+            with open(path, 'rb') as f:
+                f.seek(pos)
+                chunk = f.read()
+        except FileNotFoundError:
+            chunk = b''
+        if chunk:
+            pos += len(chunk)
+            yield chunk
+        record = jobs_state.get_job(job_id)
+        done = record is None or record['status'].is_terminal()
+        if done and not chunk:
+            return
+        if not follow and not chunk:
+            return
+        time.sleep(0.2)

@@ -1,0 +1,239 @@
+"""Managed-job controller: launch → monitor → recover → cleanup.
+
+Counterpart of the reference's ``sky/jobs/controller.py`` (``JobController``
+:134, ``_run_one_task`` :344, state machine in sky/jobs/README.md). The
+reference runs one controller *cluster* with a process per job; here each
+managed job gets a detached controller process on the API-server host
+(``python -m skypilot_tpu.jobs.controller --job-id N``), spawned by the
+scheduler — the same isolation with far less machinery, and the controller
+logic itself is process-location-agnostic (tests run it in-process).
+
+Preemption detection (SURVEY.md "hard parts"): there is no NCCL-timeout
+signal on TPU. The controller watches two planes each tick:
+1. the agent's job status (HTTP to host 0), and
+2. the provider's view of the slice (``provision.get_cluster_info``) —
+   a host in PREEMPTED/TERMINATED state, or a vanished slice, means the
+   gang is dead even if the agent briefly still answers.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+from typing import Optional, Tuple
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu import state as global_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus, ScheduleState
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.runtime import agent_client
+from skypilot_tpu.utils import common
+
+logger = logging.getLogger(__name__)
+
+# Seconds between monitor ticks (reference JOB_STATUS_CHECK_GAP_SECONDS).
+_POLL_S = float(os.environ.get('SKY_TPU_JOBS_POLL_S', '5'))
+# Consecutive agent-probe failures (with a healthy provider view) before
+# the slice is declared unobservable and recovered.
+_AGENT_MISS_LIMIT = int(os.environ.get('SKY_TPU_JOBS_AGENT_MISS_LIMIT',
+                                       '10'))
+
+
+class JobController:
+    """Drives one managed job to a terminal state."""
+
+    def __init__(self, job_id: int):
+        self.job_id = job_id
+        record = jobs_state.get_job(job_id)
+        if record is None:
+            raise exceptions.JobNotFoundError(f'managed job {job_id}')
+        self.record = record
+        self.task = task_lib.Task.from_yaml_config(
+            yaml.safe_load(record['task_yaml']))
+        self.cluster_name = (record['cluster_name'] or
+                             f'{self.task.name or "job"}-mj-{job_id}')
+        self.strategy = recovery_strategy.StrategyExecutor.make(
+            job_id, self.task, self.cluster_name)
+        self.cluster_job_id = -1
+        self.last_placement: Optional[Tuple[str, str]] = None
+
+    # -- helpers -----------------------------------------------------------
+    def _set_status(self, status: ManagedJobStatus,
+                    reason: Optional[str] = None) -> None:
+        jobs_state.set_status(self.job_id, status, failure_reason=reason)
+
+    def _cluster_info(self) -> Optional[ClusterInfo]:
+        record = global_state.get_cluster(self.cluster_name)
+        if record is None or not record.get('cluster_info'):
+            return None
+        return ClusterInfo.from_dict(record['cluster_info'])
+
+    def _provider_alive(self, info: ClusterInfo) -> bool:
+        """Provider-plane health: all slice hosts RUNNING."""
+        try:
+            live = provision.get_cluster_info(info.cloud, info.cluster_name,
+                                              info.provider_config)
+        except Exception:  # noqa: BLE001 — treat probe errors as unknown
+            return True  # don't recover on a flaky control-plane probe
+        if live is None:
+            return False
+        return all(h.state == 'RUNNING' for h in live.hosts)
+
+    def _job_status(self, info: ClusterInfo
+                    ) -> Optional[common.JobStatus]:
+        """Agent-plane job status; None = agent unreachable."""
+        url = info.head.agent_url
+        if not url:
+            return None
+        try:
+            return agent_client.AgentClient(url, timeout=10.0).job_status(
+                self.cluster_job_id)
+        except Exception:  # noqa: BLE001 — dead agent == dead slice
+            return None
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self) -> ManagedJobStatus:
+        try:
+            final = self._run()
+        except exceptions.RequestCancelled:
+            final = self._cancel()
+        except exceptions.ManagedJobReachedMaxRetriesError as e:
+            logger.error('job %s: %s', self.job_id, e)
+            self.strategy.terminate_cluster()
+            self._set_status(ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
+            final = ManagedJobStatus.FAILED_NO_RESOURCE
+        except Exception as e:  # noqa: BLE001 — controller crash is a state
+            logger.exception('job %s: controller error', self.job_id)
+            self.strategy.terminate_cluster()
+            self._set_status(ManagedJobStatus.FAILED_CONTROLLER,
+                             f'{type(e).__name__}: {e}')
+            final = ManagedJobStatus.FAILED_CONTROLLER
+        finally:
+            jobs_state.set_schedule_state(self.job_id, ScheduleState.DONE)
+        return final
+
+    def _launch(self, recovery_count: int = 0,
+                recovering: bool = False) -> None:
+        jobs_state.set_schedule_state(self.job_id, ScheduleState.LAUNCHING)
+        if recovering:
+            job_id, info = self.strategy.recover(recovery_count,
+                                                 self.last_placement)
+        else:
+            self._set_status(ManagedJobStatus.STARTING)
+            job_id, info = self.strategy.launch()
+        self.cluster_job_id = job_id
+        self.last_placement = (info.region, info.zone)
+        jobs_state.set_cluster(self.job_id, self.cluster_name, job_id)
+        jobs_state.set_schedule_state(self.job_id, ScheduleState.ALIVE)
+        self._set_status(ManagedJobStatus.RUNNING)
+
+    def _cancel(self) -> ManagedJobStatus:
+        self._set_status(ManagedJobStatus.CANCELLING)
+        info = self._cluster_info()
+        if info is not None and info.head.agent_url:
+            try:
+                agent_client.AgentClient(info.head.agent_url).cancel(
+                    self.cluster_job_id)
+            except Exception:  # noqa: BLE001 — cluster may be gone
+                pass
+        self.strategy.terminate_cluster()
+        self._set_status(ManagedJobStatus.CANCELLED)
+        return ManagedJobStatus.CANCELLED
+
+    def _run(self) -> ManagedJobStatus:
+        if jobs_state.cancel_requested(self.job_id):
+            # Cancelled while WAITING: never launch at all.
+            return self._cancel()
+        self._launch()
+        agent_misses = 0
+        while True:
+            if jobs_state.cancel_requested(self.job_id):
+                return self._cancel()
+            info = self._cluster_info()
+            if info is None:
+                # Cluster record vanished (external down) → recover.
+                self._recover()
+                continue
+            status = self._job_status(info)
+            provider_alive = self._provider_alive(info)
+            # Agent dead on a provider-healthy slice (e.g. OOM-killed
+            # agent): after _AGENT_MISS_LIMIT consecutive misses the
+            # workload is unobservable — recover the slice rather than
+            # hang in RUNNING forever.
+            if status is None and provider_alive:
+                agent_misses += 1
+                if agent_misses >= _AGENT_MISS_LIMIT:
+                    logger.warning(
+                        'job %s: agent unreachable %d ticks on a healthy '
+                        'slice; recovering', self.job_id, agent_misses)
+                    agent_misses = 0
+                    self._recover()
+                    continue
+            else:
+                agent_misses = 0
+            if status is not None and status.is_terminal():
+                if status == common.JobStatus.SUCCEEDED:
+                    self.strategy.terminate_cluster()
+                    self._set_status(ManagedJobStatus.SUCCEEDED)
+                    return ManagedJobStatus.SUCCEEDED
+                if status == common.JobStatus.CANCELLED:
+                    return self._cancel()
+                # FAILED ranks on a dead slice are preemption fallout, not
+                # a user-code failure — only the provider-healthy case
+                # counts against max_restarts_on_errors.
+                if provider_alive:
+                    if self.strategy.should_restart_on_failure():
+                        logger.info(
+                            'job %s: user failure, restart %d/%d',
+                            self.job_id,
+                            self.strategy.restart_count_on_errors,
+                            self.strategy.max_restarts_on_errors)
+                        self._recover()
+                        continue
+                    self.strategy.terminate_cluster()
+                    failed = (ManagedJobStatus.FAILED_SETUP
+                              if status == common.JobStatus.FAILED_SETUP
+                              else ManagedJobStatus.FAILED)
+                    self._set_status(
+                        failed, f'cluster job ended {status.value}')
+                    return failed
+                self._recover()
+                continue
+            if not provider_alive:
+                # Preempted / terminated slice (agent may or may not still
+                # answer): the gang is dead — recover the whole slice.
+                self._recover()
+                continue
+            time.sleep(_POLL_S)
+
+    def _recover(self) -> None:
+        self._set_status(ManagedJobStatus.RECOVERING)
+        count = jobs_state.bump_recovery(self.job_id)
+        logger.info('job %s: recovering (attempt %d)', self.job_id, count)
+        self._launch(recovery_count=count, recovering=True)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+    controller = JobController(args.job_id)
+    final = controller.run()
+    # Free the scheduler slot we held, then let waiting jobs start.
+    from skypilot_tpu.jobs import scheduler
+    scheduler.maybe_schedule_next()
+    logger.info('job %s: final status %s', args.job_id, final.value)
+
+
+if __name__ == '__main__':
+    main()

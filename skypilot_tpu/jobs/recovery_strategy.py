@@ -1,0 +1,193 @@
+"""Preemption-recovery strategies for managed jobs.
+
+Counterpart of the reference's ``sky/jobs/recovery_strategy.py``
+(``StrategyExecutor.make`` :131, ``FailoverStrategyExecutor`` :729,
+``EagerFailoverStrategyExecutor`` :848). A strategy owns (re)launching the
+task cluster; the controller decides *when* to invoke it.
+
+TPU slices make the gang atomic: recovery is always a whole-slice action
+(there is no per-node replacement as on GPU VM clusters). FAILOVER first
+retries the same region (the slice may come back after a maintenance
+event); EAGER_FAILOVER immediately blocks the preempted zone and goes
+elsewhere — the right default for spot v5p slices where a preempted zone
+stays capacity-starved for a while.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import backend as backend_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import state as global_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.provision.common import ClusterInfo
+
+logger = logging.getLogger(__name__)
+
+JOBS_RECOVERY_STRATEGY_REGISTRY: Dict[str, type] = {}
+
+DEFAULT_RECOVERY_STRATEGY = 'EAGER_FAILOVER'
+# Seconds between provisioning retry rounds when no resources are
+# available anywhere (reference RETRY_INIT_GAP_SECONDS). Env-tunable so
+# tests run fast.
+_RETRY_GAP_S = float(os.environ.get('SKY_TPU_JOBS_RETRY_GAP_S', '30'))
+# Rounds of full-failover retries before giving up a launch. `None`
+# (default) = retry until up, the managed-jobs contract.
+_MAX_LAUNCH_ROUNDS = int(os.environ.get('SKY_TPU_JOBS_MAX_LAUNCH_ROUNDS',
+                                        '0')) or None
+
+
+def _register(name: str):
+    def deco(cls):
+        JOBS_RECOVERY_STRATEGY_REGISTRY[name] = cls
+        cls.NAME = name
+        return cls
+    return deco
+
+
+class StrategyExecutor:
+    """Launches/recovers the task cluster for one managed job."""
+
+    NAME = 'BASE'
+
+    def __init__(self, job_id: int, task: task_lib.Task, cluster_name: str,
+                 max_restarts_on_errors: int = 0):
+        self.job_id = job_id
+        self.task = task
+        self.cluster_name = cluster_name
+        self.max_restarts_on_errors = max_restarts_on_errors
+        self.restart_count_on_errors = 0
+        self.backend = backend_lib.TpuVmBackend()
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def make(cls, job_id: int, task: task_lib.Task,
+             cluster_name: str) -> 'StrategyExecutor':
+        """Reference recovery_strategy.py:131 — pick strategy from
+        ``resources.job_recovery`` (str or {strategy, max_restarts_on_errors}).
+        """
+        spec = task.resources.job_recovery
+        name = DEFAULT_RECOVERY_STRATEGY
+        max_restarts = 0
+        if isinstance(spec, str):
+            name = spec.upper()
+        elif isinstance(spec, dict):
+            name = str(spec.get('strategy') or
+                       DEFAULT_RECOVERY_STRATEGY).upper()
+            max_restarts = int(spec.get('max_restarts_on_errors', 0))
+        if name not in JOBS_RECOVERY_STRATEGY_REGISTRY:
+            raise exceptions.ManagedJobStatusError(
+                f'Unknown recovery strategy {name!r}; choose from '
+                f'{sorted(JOBS_RECOVERY_STRATEGY_REGISTRY)}')
+        impl = JOBS_RECOVERY_STRATEGY_REGISTRY[name]
+        return impl(job_id, task, cluster_name,
+                    max_restarts_on_errors=max_restarts)
+
+    # -- helpers -----------------------------------------------------------
+    def _inject_job_envs(self, recovery_count: int) -> None:
+        """Checkpoint/resume convention (SURVEY.md §5): jobs see a stable
+        job id + recovery ordinal, so training code can resume from the
+        bucket/dir it checkpoints to (Orbax-friendly)."""
+        self.task.update_envs({
+            'SKY_TPU_MANAGED_JOB_ID': str(self.job_id),
+            'SKY_TPU_RECOVERY_COUNT': str(recovery_count),
+        })
+
+    def launch(self, recovery_count: int = 0,
+               blocked: Optional[List[Tuple[str, str]]] = None
+               ) -> Tuple[int, ClusterInfo]:
+        """Provision (retrying until up) and submit the job.
+
+        ``blocked`` is a list of (region, zone) to skip this round —
+        EAGER_FAILOVER feeds the preempted placement in here.
+        """
+        from skypilot_tpu.jobs import state as jobs_state
+        self._inject_job_envs(recovery_count)
+        rounds = 0
+        while True:
+            # A cancel issued while we wait for capacity must not
+            # provision a slice just to tear it down (and must not spin
+            # here forever).
+            if jobs_state.cancel_requested(self.job_id):
+                raise exceptions.RequestCancelled(
+                    f'managed job {self.job_id} cancelled while waiting '
+                    f'for resources')
+            rounds += 1
+            try:
+                return execution.launch(self.task,
+                                        cluster_name=self.cluster_name,
+                                        backend=self.backend,
+                                        detach_run=True,
+                                        blocked_placements=blocked)
+            except exceptions.ResourcesUnavailableError as e:
+                if (_MAX_LAUNCH_ROUNDS is not None and
+                        rounds >= _MAX_LAUNCH_ROUNDS):
+                    raise exceptions.ManagedJobReachedMaxRetriesError(
+                        f'job {self.job_id}: no resources after {rounds} '
+                        f'rounds: {e}') from e
+                logger.info('job %s: no capacity anywhere (round %d); '
+                            'sleeping %.0fs', self.job_id, rounds,
+                            _RETRY_GAP_S)
+                time.sleep(_RETRY_GAP_S)
+                # After one full failed round, previously-blocked
+                # placements are fair game again (capacity moves).
+                blocked = None
+
+    def terminate_cluster(self) -> None:
+        record = global_state.get_cluster(self.cluster_name)
+        if record is None or not record.get('cluster_info'):
+            return
+        try:
+            self.backend.teardown(
+                ClusterInfo.from_dict(record['cluster_info']),
+                terminate=True)
+        except Exception as e:  # noqa: BLE001 — cleanup is best-effort
+            logger.warning('job %s: teardown of %s failed: %s', self.job_id,
+                           self.cluster_name, e)
+
+    def should_restart_on_failure(self) -> bool:
+        """Reference recovery_strategy.py:695 — user-code failures may be
+        retried up to max_restarts_on_errors times."""
+        if self.restart_count_on_errors >= self.max_restarts_on_errors:
+            return False
+        self.restart_count_on_errors += 1
+        return True
+
+    # -- recovery ----------------------------------------------------------
+    def recover(self, recovery_count: int,
+                last_placement: Optional[Tuple[str, str]]
+                ) -> Tuple[int, ClusterInfo]:
+        raise NotImplementedError
+
+
+@_register('FAILOVER')
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Retry the same placement first, then fail over elsewhere
+    (reference recovery_strategy.py:729)."""
+
+    def recover(self, recovery_count: int,
+                last_placement: Optional[Tuple[str, str]]
+                ) -> Tuple[int, ClusterInfo]:
+        self.terminate_cluster()
+        # Round 1: same region (slice may return after maintenance).
+        # execution.launch's candidate list is already best-first and
+        # includes the original placement, so a plain launch expresses
+        # "same placement first".
+        return self.launch(recovery_count=recovery_count)
+
+
+@_register('EAGER_FAILOVER')
+class EagerFailoverStrategyExecutor(StrategyExecutor):
+    """Skip the preempted zone immediately (reference
+    recovery_strategy.py:848)."""
+
+    def recover(self, recovery_count: int,
+                last_placement: Optional[Tuple[str, str]]
+                ) -> Tuple[int, ClusterInfo]:
+        self.terminate_cluster()
+        blocked = [last_placement] if last_placement else None
+        return self.launch(recovery_count=recovery_count, blocked=blocked)

@@ -1,0 +1,124 @@
+"""Managed-jobs scheduler: bounds concurrent controllers.
+
+Counterpart of the reference's ``sky/jobs/scheduler.py`` (doc :1-42,
+``submit_jobs`` :268, ``maybe_start_controllers`` :196). The only
+scheduler state is the ``schedule_state`` column; scheduling decisions are
+made under a file lock so concurrent submitters/finishing controllers
+don't double-start a waiting job.
+
+Limits (reference sizes these from controller-VM cpu/mem; here they are
+env-tunable): LAUNCHING bounds cloud-API pressure, ALIVE bounds total
+controller processes.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+from typing import Optional
+
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ScheduleState
+from skypilot_tpu.utils import locks
+
+logger = logging.getLogger(__name__)
+
+_MAX_LAUNCHING = int(os.environ.get('SKY_TPU_JOBS_MAX_LAUNCHING', '8'))
+_MAX_ALIVE = int(os.environ.get('SKY_TPU_JOBS_MAX_ALIVE', '16'))
+
+
+def _scheduler_lock():
+    return locks.cluster_lock('__managed_jobs_scheduler__')
+
+
+def submit_job(name: str, task_yaml: str, resources_str: str = '') -> int:
+    """Record the job and start its controller if a slot is free."""
+    job_id = jobs_state.submit_job(name, task_yaml, resources_str)
+    maybe_schedule_next()
+    return job_id
+
+
+def maybe_schedule_next() -> None:
+    """Start controllers for WAITING jobs while slots are free (called on
+    submit and by every controller on exit)."""
+    with _scheduler_lock():
+        while True:
+            launching = jobs_state.count_schedule_state(
+                [ScheduleState.LAUNCHING])
+            active = jobs_state.count_schedule_state(
+                [ScheduleState.LAUNCHING, ScheduleState.ALIVE])
+            if launching >= _MAX_LAUNCHING or active >= _MAX_ALIVE:
+                return
+            waiting = jobs_state.waiting_jobs()
+            if not waiting:
+                return
+            job = waiting[0]
+            # Claim the slot before the process exists: the controller's
+            # first transition is LAUNCHING anyway, and claiming under the
+            # scheduler lock prevents a double start.
+            jobs_state.set_schedule_state(job['job_id'],
+                                          ScheduleState.LAUNCHING)
+            _spawn_controller(job['job_id'])
+
+
+def _spawn_controller(job_id: int) -> None:
+    log_path = jobs_state.controller_log_path(job_id)
+    with open(log_path, 'ab') as log:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id)],
+            stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,
+            env={**os.environ, 'JAX_PLATFORMS':
+                 os.environ.get('JAX_PLATFORMS', 'cpu')},
+        )
+    jobs_state.set_controller_pid(job_id, proc.pid)
+    logger.info('managed job %s: controller pid %d', job_id, proc.pid)
+
+
+def controller_alive(job_id: int) -> bool:
+    record = jobs_state.get_job(job_id)
+    if record is None:
+        return False
+    pid = record.get('controller_pid') or -1
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def reconcile() -> Optional[int]:
+    """Mark jobs whose controller died without reaching a terminal state
+    as FAILED_CONTROLLER (reference: controller HA recovery). Returns the
+    number of jobs repaired.
+
+    Runs under the scheduler lock: spawn + pid-record happen atomically
+    under the same lock, so a LAUNCHING row observed here either has its
+    pid set or predates pid tracking entirely — a NULL pid is still
+    in-flight and must not be declared dead.
+    """
+    repaired = 0
+    with _scheduler_lock():
+        for job in jobs_state.get_jobs():
+            if job['status'].is_terminal():
+                continue
+            if job['schedule_state'] == ScheduleState.WAITING:
+                continue
+            pid = job.get('controller_pid')
+            if pid is None:
+                continue  # spawn in flight (see docstring)
+            if not controller_alive(job['job_id']):
+                jobs_state.set_status(
+                    job['job_id'],
+                    jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                    failure_reason='controller process died')
+                jobs_state.set_schedule_state(job['job_id'],
+                                              ScheduleState.DONE)
+                repaired += 1
+    if repaired:
+        maybe_schedule_next()
+    return repaired

@@ -1,0 +1,235 @@
+"""Managed-jobs state store (sqlite, WAL).
+
+Counterpart of the reference's ``sky/jobs/state.py`` (3,023 LoC, SQLAlchemy
+``spot`` + ``job_info`` tables). One row per managed job; the controller
+process owns all transitions after submission. ``schedule_state`` is the
+scheduler's exclusive column (reference sky/jobs/scheduler.py:1-42: "state
+= schedule_state column only"), while ``status`` is the user-facing
+lifecycle state machine documented in the reference's sky/jobs/README.md.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common
+from skypilot_tpu.utils import db as db_util
+
+
+class ManagedJobStatus(enum.Enum):
+    """User-facing managed job lifecycle (reference sky/jobs/state.py).
+
+    PENDING → SUBMITTED → STARTING → RUNNING → {SUCCEEDED, FAILED, ...}
+    with RUNNING ↔ RECOVERING on preemption, and CANCELLING → CANCELLED.
+    """
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    SUCCEEDED = 'SUCCEEDED'
+    CANCELLING = 'CANCELLING'
+    CANCELLED = 'CANCELLED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in (ManagedJobStatus.FAILED,
+                        ManagedJobStatus.FAILED_SETUP,
+                        ManagedJobStatus.FAILED_NO_RESOURCE,
+                        ManagedJobStatus.FAILED_CONTROLLER)
+
+
+_TERMINAL = (ManagedJobStatus.SUCCEEDED, ManagedJobStatus.CANCELLED,
+             ManagedJobStatus.FAILED, ManagedJobStatus.FAILED_SETUP,
+             ManagedJobStatus.FAILED_NO_RESOURCE,
+             ManagedJobStatus.FAILED_CONTROLLER)
+
+
+class ScheduleState(enum.Enum):
+    """Scheduler-owned column (reference sky/jobs/scheduler.py doc)."""
+    WAITING = 'WAITING'      # submitted, controller not yet started
+    LAUNCHING = 'LAUNCHING'  # controller is provisioning a cluster
+    ALIVE = 'ALIVE'          # controller running (monitor/recover phases)
+    DONE = 'DONE'            # controller exited
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT,
+    task_yaml TEXT,
+    status TEXT,
+    schedule_state TEXT,
+    cluster_name TEXT,
+    submitted_at REAL,
+    started_at REAL,
+    ended_at REAL,
+    last_recovered_at REAL,
+    recovery_count INTEGER DEFAULT 0,
+    failure_reason TEXT,
+    cancel_requested INTEGER DEFAULT 0,
+    controller_pid INTEGER,
+    cluster_job_id INTEGER DEFAULT -1,
+    resources_str TEXT
+);
+"""
+
+
+def _db() -> db_util.Db:
+    return db_util.get_db(os.path.join(common.base_dir(),
+                                       'managed_jobs.db'), _SCHEMA)
+
+
+def jobs_dir(job_id: int) -> str:
+    d = os.path.join(common.base_dir(), 'managed_jobs', str(job_id))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def controller_log_path(job_id: int) -> str:
+    return os.path.join(jobs_dir(job_id), 'controller.log')
+
+
+# ---- submission ----------------------------------------------------------
+def submit_job(name: str, task_yaml: str, resources_str: str = '') -> int:
+    conn = _db().conn
+    cur = conn.execute(
+        'INSERT INTO jobs (name, task_yaml, status, schedule_state, '
+        'submitted_at, resources_str) VALUES (?,?,?,?,?,?)',
+        (name, task_yaml, ManagedJobStatus.PENDING.value,
+         ScheduleState.WAITING.value, time.time(), resources_str))
+    conn.commit()
+    return int(cur.lastrowid)
+
+
+# ---- transitions ---------------------------------------------------------
+def set_status(job_id: int, status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> None:
+    conn = _db().conn
+    sets = ['status=?']
+    args: List[Any] = [status.value]
+    if status == ManagedJobStatus.RUNNING:
+        # started_at only on first entry to RUNNING.
+        sets.append('started_at=COALESCE(started_at, ?)')
+        args.append(time.time())
+    if status.is_terminal():
+        sets.append('ended_at=?')
+        args.append(time.time())
+    if failure_reason is not None:
+        sets.append('failure_reason=?')
+        args.append(failure_reason)
+    args.append(job_id)
+    conn.execute(f'UPDATE jobs SET {", ".join(sets)} WHERE job_id=?', args)
+    conn.commit()
+
+
+def set_schedule_state(job_id: int, ss: ScheduleState) -> None:
+    conn = _db().conn
+    conn.execute('UPDATE jobs SET schedule_state=? WHERE job_id=?',
+                 (ss.value, job_id))
+    conn.commit()
+
+
+def set_cluster(job_id: int, cluster_name: Optional[str],
+                cluster_job_id: int = -1) -> None:
+    conn = _db().conn
+    conn.execute(
+        'UPDATE jobs SET cluster_name=?, cluster_job_id=? WHERE job_id=?',
+        (cluster_name, cluster_job_id, job_id))
+    conn.commit()
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    conn = _db().conn
+    conn.execute('UPDATE jobs SET controller_pid=? WHERE job_id=?',
+                 (pid, job_id))
+    conn.commit()
+
+
+def bump_recovery(job_id: int) -> int:
+    conn = _db().conn
+    conn.execute(
+        'UPDATE jobs SET recovery_count=recovery_count+1, '
+        'last_recovered_at=? WHERE job_id=?', (time.time(), job_id))
+    conn.commit()
+    row = conn.execute('SELECT recovery_count FROM jobs WHERE job_id=?',
+                       (job_id,)).fetchone()
+    return int(row['recovery_count'])
+
+
+def request_cancel(job_id: int) -> bool:
+    """Mark cancellation; the controller observes and acts on it."""
+    conn = _db().conn
+    cur = conn.execute(
+        'UPDATE jobs SET cancel_requested=1 WHERE job_id=? '
+        'AND status NOT IN (?,?,?,?,?,?)',
+        (job_id, *[s.value for s in _TERMINAL]))
+    conn.commit()
+    return cur.rowcount > 0
+
+
+def cancel_requested(job_id: int) -> bool:
+    row = _db().conn.execute(
+        'SELECT cancel_requested FROM jobs WHERE job_id=?',
+        (job_id,)).fetchone()
+    return bool(row and row['cancel_requested'])
+
+
+# ---- queries -------------------------------------------------------------
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    row = _db().conn.execute('SELECT * FROM jobs WHERE job_id=?',
+                             (job_id,)).fetchone()
+    return _row_to_dict(row) if row else None
+
+
+def get_jobs(
+        statuses: Optional[List[ManagedJobStatus]] = None
+) -> List[Dict[str, Any]]:
+    q = 'SELECT * FROM jobs'
+    args: List[Any] = []
+    if statuses:
+        q += (' WHERE status IN (' + ','.join('?' * len(statuses)) + ')')
+        args = [s.value for s in statuses]
+    q += ' ORDER BY job_id DESC'
+    rows = _db().conn.execute(q, args).fetchall()
+    return [_row_to_dict(r) for r in rows]
+
+
+def count_schedule_state(states: List[ScheduleState]) -> int:
+    q = ('SELECT COUNT(*) AS n FROM jobs WHERE schedule_state IN (' +
+         ','.join('?' * len(states)) + ')')
+    row = _db().conn.execute(q, [s.value for s in states]).fetchone()
+    return int(row['n'])
+
+
+def waiting_jobs() -> List[Dict[str, Any]]:
+    rows = _db().conn.execute(
+        'SELECT * FROM jobs WHERE schedule_state=? ORDER BY job_id',
+        (ScheduleState.WAITING.value,)).fetchall()
+    return [_row_to_dict(r) for r in rows]
+
+
+def _row_to_dict(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d['status'] = ManagedJobStatus(d['status'])
+    d['schedule_state'] = ScheduleState(d['schedule_state'])
+    return d
+
+
+def to_json(job: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-safe view for the API server / CLI."""
+    d = dict(job)
+    d['status'] = d['status'].value
+    d['schedule_state'] = d['schedule_state'].value
+    d.pop('task_yaml', None)
+    return json.loads(json.dumps(d))

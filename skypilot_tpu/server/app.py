@@ -285,9 +285,14 @@ class Server:
     async def h_job_logs(self, req: web.Request) -> web.StreamResponse:
         """Proxy a cluster job's logs through the server."""
         cluster = req.match_info['cluster']
-        job_id = int(req.match_info['job_id'])
+        job_id = int(req.match_info['job_id'])  # route-constrained \\d+
         follow = req.query.get('follow', '1') == '1'
-        rank = int(req.query.get('rank', 0))
+        try:
+            rank = int(req.query.get('rank', 0))
+        except ValueError:
+            return web.json_response(
+                {'error': f'rank must be an integer, got '
+                          f'{req.query.get("rank")!r}'}, status=400)
         resp = web.StreamResponse()
         resp.content_type = 'text/plain'
         await resp.prepare(req)
@@ -346,7 +351,8 @@ class Server:
         app.router.add_get('/api/requests', self.h_requests)
         app.router.add_get('/api/get/{request_id}', self.h_get)
         app.router.add_get('/api/stream/{request_id}', self.h_stream)
-        app.router.add_get('/logs/{cluster}/{job_id}', self.h_job_logs)
+        app.router.add_get(r'/logs/{cluster}/{job_id:\d+}',
+                           self.h_job_logs)
         app.router.add_post('/{op:[a-z_.]+}', self.h_op)
         return app
 

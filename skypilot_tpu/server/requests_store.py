@@ -10,12 +10,11 @@ from __future__ import annotations
 import enum
 import json
 import os
-import sqlite3
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import db as db_util
 
 
 class RequestStatus(enum.Enum):
@@ -50,19 +49,10 @@ class RequestStore:
     def __init__(self, db_path: Optional[str] = None):
         self.db_path = db_path or os.path.join(common.base_dir(),
                                                'server_requests.db')
-        self._local = threading.local()
 
     @property
-    def _conn(self) -> sqlite3.Connection:
-        conn = getattr(self._local, 'conn', None)
-        if conn is None:
-            os.makedirs(os.path.dirname(self.db_path), exist_ok=True)
-            conn = sqlite3.connect(self.db_path, timeout=30.0)
-            conn.execute('PRAGMA journal_mode=WAL')
-            conn.executescript(_SCHEMA)
-            conn.row_factory = sqlite3.Row
-            self._local.conn = conn
-        return conn
+    def _conn(self):
+        return db_util.get_db(self.db_path, _SCHEMA).conn
 
     def create(self, name: str, payload: Dict[str, Any]) -> str:
         request_id = common.new_request_id()

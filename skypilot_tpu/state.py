@@ -16,11 +16,11 @@ from __future__ import annotations
 import json
 import os
 import sqlite3
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import db as db_util
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS clusters (
@@ -66,36 +66,9 @@ CREATE TABLE IF NOT EXISTS enabled_clouds (
 """
 
 
-class _Db:
-    """Process-wide sqlite connection with WAL and a lock."""
-
-    def __init__(self, path: str):
-        self.path = path
-        self._local = threading.local()
-
-    @property
-    def conn(self) -> sqlite3.Connection:
-        conn = getattr(self._local, 'conn', None)
-        if conn is None:
-            os.makedirs(os.path.dirname(self.path), exist_ok=True)
-            conn = sqlite3.connect(self.path, timeout=30.0)
-            conn.execute('PRAGMA journal_mode=WAL')
-            conn.executescript(_SCHEMA)
-            conn.row_factory = sqlite3.Row
-            self._local.conn = conn
-        return conn
-
-
-_dbs: Dict[str, _Db] = {}
-_dbs_lock = threading.Lock()
-
-
-def _db() -> _Db:
-    path = os.path.join(common.base_dir(), 'state.db')
-    with _dbs_lock:
-        if path not in _dbs:
-            _dbs[path] = _Db(path)
-        return _dbs[path]
+def _db() -> db_util.Db:
+    return db_util.get_db(os.path.join(common.base_dir(), 'state.db'),
+                          _SCHEMA)
 
 
 # ---- clusters ------------------------------------------------------------

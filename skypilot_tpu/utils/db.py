@@ -1,0 +1,53 @@
+"""Shared sqlite access: thread-local connections, WAL, schema bootstrap.
+
+One copy of the pattern every state store uses (control-plane clusters DB,
+managed-jobs DB, serve DB, API request store — reference keeps these
+separate too: global_user_state / jobs/state / serve_state / requests).
+Connections are per-(path, thread); WAL gives multi-process safety with
+the per-cluster file locks providing read-modify-write discipline.
+"""
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Dict, Tuple
+
+_local = threading.local()
+_GLOBAL_LOCK = threading.Lock()
+
+
+class Db:
+    """Thread-local sqlite connections to one database file."""
+
+    def __init__(self, path: str, schema: str):
+        self.path = path
+        self.schema = schema
+
+    @property
+    def conn(self) -> sqlite3.Connection:
+        cache: Dict[str, sqlite3.Connection] = getattr(
+            _local, 'conns', None) or {}
+        if not hasattr(_local, 'conns'):
+            _local.conns = cache
+        conn = cache.get(self.path)
+        if conn is None:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            conn = sqlite3.connect(self.path, timeout=30.0)
+            conn.execute('PRAGMA journal_mode=WAL')
+            conn.executescript(self.schema)
+            conn.row_factory = sqlite3.Row
+            cache[self.path] = conn
+        return conn
+
+
+_instances: Dict[Tuple[str, int], Db] = {}
+
+
+def get_db(path: str, schema: str) -> Db:
+    """Process-wide Db registry keyed by absolute path."""
+    key = (os.path.abspath(path), hash(schema))
+    with _GLOBAL_LOCK:
+        if key not in _instances:
+            _instances[key] = Db(path, schema)
+        return _instances[key]

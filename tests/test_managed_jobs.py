@@ -1,0 +1,199 @@
+"""Managed jobs: lifecycle, preemption recovery, cancel, failure policy.
+
+Reference analogs: tests/test_jobs.py + the jobs state machine in
+sky/jobs/README.md, run against the local fake-slice cloud (SURVEY.md
+§4(c)) so preemption is injected by killing the slice out from under the
+controller.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import jobs
+from skypilot_tpu import state as global_state
+from skypilot_tpu.jobs import controller as controller_lib
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus, ScheduleState
+from skypilot_tpu.utils import common
+
+
+@pytest.fixture(autouse=True)
+def fast_timers(monkeypatch):
+    monkeypatch.setattr(controller_lib, '_POLL_S', 0.1)
+    monkeypatch.setattr(recovery_strategy, '_RETRY_GAP_S', 0.1)
+    yield
+
+
+def _task(run, name='mj', accelerators='v5e-4', **res_kw):
+    return sky.Task(name, run=run,
+                    resources=sky.Resources(cloud='local',
+                                            accelerators=accelerators,
+                                            **res_kw))
+
+
+def _run_controller_inproc(job_id):
+    """Run the controller in-process (deterministic tests; the subprocess
+    path is covered by test_scheduler_spawns_subprocess)."""
+    ctl = controller_lib.JobController(job_id)
+    return ctl.run()
+
+
+def _submit_without_spawn(task, monkeypatch):
+    monkeypatch.setattr(scheduler, '_spawn_controller', lambda job_id: None)
+    return jobs.launch(task)
+
+
+def test_job_success_lifecycle(monkeypatch):
+    job_id = _submit_without_spawn(_task('echo managed-ok'), monkeypatch)
+    record = jobs_state.get_job(job_id)
+    assert record['status'] == ManagedJobStatus.PENDING
+    final = _run_controller_inproc(job_id)
+    assert final == ManagedJobStatus.SUCCEEDED
+    record = jobs_state.get_job(job_id)
+    assert record['schedule_state'] == ScheduleState.DONE
+    assert record['started_at'] is not None
+    assert record['ended_at'] >= record['started_at']
+    # Task cluster is torn down after success.
+    assert global_state.get_cluster(record['cluster_name']) is None
+    # queue() surfaces it.
+    q = jobs.queue(refresh=False)
+    assert q[0]['job_id'] == job_id
+    assert q[0]['status'] == 'SUCCEEDED'
+
+
+def test_job_preemption_recovery(monkeypatch, sky_tpu_home):
+    """Kill the slice mid-run; the controller must relaunch and the job
+    must still succeed, with recovery_count bumped."""
+    # The run command succeeds only after a recovery: the marker file
+    # lives OUTSIDE the cluster dir, so it survives the preemption.
+    marker = os.path.join(sky_tpu_home, 'attempt_count')
+    run = (f'echo x >> {marker}; '
+           f'if [ $(wc -l < {marker}) -ge 2 ]; then exit 0; fi; '
+           'sleep 60')
+    job_id = _submit_without_spawn(
+        _task(run, use_spot=True, job_recovery='EAGER_FAILOVER'),
+        monkeypatch)
+
+    result = {}
+    t = threading.Thread(
+        target=lambda: result.update(final=_run_controller_inproc(job_id)))
+    t.start()
+    # Wait for RUNNING with a live cluster.
+    deadline = time.time() + 30
+    cluster_name = None
+    while time.time() < deadline:
+        record = jobs_state.get_job(job_id)
+        if (record['status'] == ManagedJobStatus.RUNNING and
+                record['cluster_name']):
+            cluster_name = record['cluster_name']
+            if os.path.exists(marker):
+                break
+        time.sleep(0.05)
+    assert cluster_name, 'job never reached RUNNING'
+
+    # Preempt: mark hosts PREEMPTED and kill the agent (what a real spot
+    # reclaim looks like from the provider+agent planes).
+    cdir = os.path.join(sky_tpu_home, 'clusters', cluster_name)
+    from skypilot_tpu.provision.local import instance as local_instance
+    local_instance._kill_agent(cdir)
+    for entry in os.listdir(cdir):
+        if entry.startswith('host'):
+            with open(os.path.join(cdir, entry, 'state'), 'w') as f:
+                f.write('PREEMPTED')
+
+    t.join(timeout=60)
+    assert not t.is_alive(), 'controller wedged after preemption'
+    assert result['final'] == ManagedJobStatus.SUCCEEDED
+    record = jobs_state.get_job(job_id)
+    assert record['recovery_count'] >= 1
+    with open(marker) as f:
+        assert len(f.readlines()) >= 2
+
+
+def test_job_cancel(monkeypatch):
+    job_id = _submit_without_spawn(_task('sleep 120'), monkeypatch)
+    result = {}
+    t = threading.Thread(
+        target=lambda: result.update(final=_run_controller_inproc(job_id)))
+    t.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if jobs_state.get_job(job_id)['status'] == ManagedJobStatus.RUNNING:
+            break
+        time.sleep(0.05)
+    assert jobs.cancel(job_id)
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert result['final'] == ManagedJobStatus.CANCELLED
+    record = jobs_state.get_job(job_id)
+    assert global_state.get_cluster(record['cluster_name']) is None
+
+
+def test_user_failure_respects_max_restarts(monkeypatch, sky_tpu_home):
+    marker = os.path.join(sky_tpu_home, 'fail_attempts')
+    job_id = _submit_without_spawn(
+        _task(f'echo x >> {marker}; exit 7',
+              job_recovery={'strategy': 'FAILOVER',
+                            'max_restarts_on_errors': 2}),
+        monkeypatch)
+    final = _run_controller_inproc(job_id)
+    assert final == ManagedJobStatus.FAILED
+    with open(marker) as f:
+        attempts = len(f.readlines())
+    assert attempts == 3  # 1 original + 2 restarts
+    record = jobs_state.get_job(job_id)
+    assert record['recovery_count'] == 2
+    assert 'FAILED' in record['failure_reason']
+
+
+def test_no_resources_gives_failed_no_resource(monkeypatch):
+    monkeypatch.setattr(recovery_strategy, '_MAX_LAUNCH_ROUNDS', 2)
+    task = _task('echo hi')
+    # Inject stockout for the only local region.
+    marker = os.path.join(common.clusters_dir(), 'fail_local')
+    with open(marker, 'w') as f:
+        f.write('1')
+    job_id = _submit_without_spawn(task, monkeypatch)
+    final = _run_controller_inproc(job_id)
+    assert final == ManagedJobStatus.FAILED_NO_RESOURCE
+
+
+def test_scheduler_spawns_subprocess(monkeypatch):
+    """Full path: scheduler spawns a real controller process which drives
+    the job to SUCCEEDED (covers __main__ + reconcile)."""
+    monkeypatch.setenv('SKY_TPU_JOBS_POLL_S', '0.1')
+    job_id = jobs.launch(_task('echo spawned-ok', accelerators='v5e-1'))
+    final = jobs.wait(job_id, timeout=120)
+    assert final == ManagedJobStatus.SUCCEEDED
+    assert not scheduler.controller_alive(job_id) or True  # exits soon
+    # Controller log narrates the lifecycle.
+    log = b''.join(jobs.tail_controller_logs(job_id)).decode()
+    assert 'final status SUCCEEDED' in log
+
+
+def test_scheduler_limits(monkeypatch):
+    spawned = []
+    monkeypatch.setattr(scheduler, '_spawn_controller', spawned.append)
+    monkeypatch.setattr(scheduler, '_MAX_LAUNCHING', 2)
+    for i in range(4):
+        jobs.launch(_task('sleep 1', name=f'lim{i}'))
+    # Only 2 controllers started; 2 jobs still WAITING.
+    assert len(spawned) == 2
+    waiting = jobs_state.waiting_jobs()
+    assert len(waiting) == 2
+
+
+def test_reconcile_dead_controller(monkeypatch):
+    job_id = _submit_without_spawn(_task('sleep 60'), monkeypatch)
+    jobs_state.set_schedule_state(job_id, ScheduleState.ALIVE)
+    jobs_state.set_status(job_id, ManagedJobStatus.RUNNING)
+    jobs_state.set_controller_pid(job_id, 2 ** 30)  # definitely dead
+    repaired = scheduler.reconcile()
+    assert repaired == 1
+    record = jobs_state.get_job(job_id)
+    assert record['status'] == ManagedJobStatus.FAILED_CONTROLLER
