@@ -342,6 +342,87 @@ def jobs_logs(job_id: int, follow: bool, controller: bool) -> None:
 
 
 @cli.group()
+def serve() -> None:
+    """Serving: replicated, auto-scaled services behind a load balancer."""
+
+
+def _serve_engine():
+    """serve facade: direct engine or SDK (mirrors _engine())."""
+    if os.environ.get('SKY_TPU_API_SERVER'):
+        from skypilot_tpu.client import sdk
+
+        class _SdkServe:
+            up = staticmethod(
+                lambda task, service_name=None: sdk.serve_up(
+                    task, service_name))
+            update = staticmethod(sdk.serve_update)
+            down = staticmethod(lambda name: sdk.serve_down(name))
+            status = staticmethod(sdk.serve_status)
+        return _SdkServe
+    from skypilot_tpu import serve as serve_lib
+    return serve_lib
+
+
+@serve.command('up')
+@click.argument('task_yaml')
+@click.option('--service-name', '-n', default=None)
+@click.option('--env', multiple=True, help='KEY=VALUE env override.')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_up(task_yaml: str, service_name: Optional[str], env: tuple,
+             yes: bool) -> None:
+    """Start a service from a YAML with a `service:` section."""
+    task = _load_task(task_yaml, env)
+    if not yes:
+        click.confirm(
+            f'Starting service {service_name or task.name or task_yaml} '
+            f'({task.resources!r} per replica). Proceed?', abort=True)
+    out = _serve_engine().up(task, service_name)
+    click.echo(f'Service: {out["name"]}  endpoint: {out["endpoint"]}')
+    click.echo(f'Watch replicas: sky-tpu serve status {out["name"]}')
+
+
+@serve.command('update')
+@click.argument('service_name')
+@click.argument('task_yaml')
+@click.option('--env', multiple=True)
+def serve_update(service_name: str, task_yaml: str, env: tuple) -> None:
+    """Roll a service to a new task version (zero-downtime)."""
+    task = _load_task(task_yaml, env)
+    version = _serve_engine().update(task, service_name)
+    click.echo(f'Service {service_name} rolling to version {version}.')
+
+
+@serve.command('down')
+@click.argument('service_name')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_down(service_name: str, yes: bool) -> None:
+    """Tear down a service and all its replicas."""
+    if not yes:
+        click.confirm(f'Tear down service {service_name}?', abort=True)
+    _serve_engine().down(service_name)
+    click.echo(f'Service {service_name} torn down.')
+
+
+@serve.command('status')
+@click.argument('service_name', required=False)
+def serve_status(service_name: Optional[str]) -> None:
+    """Show services and their replicas."""
+    snaps = _serve_engine().status(service_name)
+    if not snaps:
+        click.echo('No services.')
+        return
+    for s in snaps:
+        click.echo(f'{s["name"]}: {s["status"]} v{s["version"]} '
+                   f'endpoint={s["endpoint"]} policy={s["policy"]}')
+        fmt = '  {:<4} {:<22} {:<14} {:<4} {:<24}'
+        click.echo(fmt.format('ID', 'CLUSTER', 'STATUS', 'VER', 'URL'))
+        for r in s['replicas']:
+            click.echo(fmt.format(r['replica_id'], r['cluster_name'],
+                                  r['status'], r['version'],
+                                  r['url'] or '-'))
+
+
+@cli.group()
 def api() -> None:
     """Manage the local API server."""
 

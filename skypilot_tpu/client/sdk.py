@@ -205,3 +205,24 @@ def jobs_queue() -> List[Dict[str, Any]]:
 
 def jobs_cancel(job_id: int) -> bool:
     return get(_post('jobs.cancel', {'job_id': job_id}))
+
+
+# ---- serve (reference sky/serve/client/sdk.py) ---------------------------
+def serve_up(task: task_lib.Task,
+             service_name: Optional[str] = None) -> Dict[str, Any]:
+    return get(_post('serve.up', {'task': task.to_yaml_config(),
+                                  'service_name': service_name}))
+
+
+def serve_update(task: task_lib.Task, service_name: str) -> int:
+    return get(_post('serve.update', {'task': task.to_yaml_config(),
+                                      'service_name': service_name}))
+
+
+def serve_down(service_name: str) -> None:
+    get(_post('serve.down', {'service_name': service_name}))
+
+
+def serve_status(service_name: Optional[str] = None
+                 ) -> List[Dict[str, Any]]:
+    return get(_post('serve.status', {'service_name': service_name}))

@@ -104,7 +104,8 @@ def _start_agent(cluster_name: str) -> None:
     )
     deadline = time.time() + AGENT_START_TIMEOUT
     while time.time() < deadline:
-        if os.path.exists(agent_json):
+        info = _agent_info(cdir)
+        if info is not None and info.get('url'):
             return
         time.sleep(0.1)
     raise exceptions.ProvisionError(

@@ -413,10 +413,14 @@ async def _main(cluster_dir: str, host: str, port: int) -> None:
     site = web.TCPSite(runner, host, port)
     await site.start()
     actual_port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
-    with open(os.path.join(cluster_dir, 'agent.json'), 'w',
-              encoding='utf-8') as f:
+    # Atomic publish: provisioners poll for this file and JSON-parse it the
+    # moment it appears, so a plain open/write races with the reader.
+    agent_json = os.path.join(cluster_dir, 'agent.json')
+    tmp = agent_json + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
         json.dump({'url': f'http://{host}:{actual_port}',
                    'pid': os.getpid()}, f)
+    os.replace(tmp, agent_json)
     loop = asyncio.get_event_loop()
     loop.create_task(agent.scheduler_loop())
     loop.create_task(agent.autostop_loop())

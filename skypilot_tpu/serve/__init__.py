@@ -1,0 +1,154 @@
+"""Serving: replicated, auto-scaled, load-balanced services on TPU slices.
+
+Counterpart of the reference's ``sky/serve/`` (SURVEY.md §2.6):
+``up`` validates the task's ``service:`` section and starts a detached
+service process (controller reconcile loop + HTTP load balancer); the
+controller launches replica clusters through the same engine `launch`
+path user tasks use. The reference provisions a controller *cluster*
+first (sky/serve/server/core.py:28 → impl.py:293); the TPU-native design
+runs the controller as a host process — identical state machine, no
+cold-start, and the serve state DB is the single control surface.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import service as service_lib
+from skypilot_tpu.serve import spec as spec_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.serve.state import ReplicaStatus, ServiceStatus  # noqa: F401
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _validate(task: task_lib.Task) -> spec_lib.ServiceSpec:
+    if not task.is_service:
+        raise exceptions.InvalidTaskError(
+            'task has no `service:` section; `serve.up` needs one '
+            '(readiness_probe + replica_policy)')
+    if not task.run:
+        raise exceptions.InvalidTaskError(
+            'a service task needs a `run` command that starts the '
+            'workload server')
+    return spec_lib.ServiceSpec.from_config(task.service)
+
+
+def up(task: task_lib.Task, service_name: Optional[str] = None,
+       *, _spawn: bool = True) -> Dict[str, Any]:
+    """Start a service; returns {name, endpoint} immediately.
+
+    Reference sky/serve/server/core.py:28. ``_spawn=False`` leaves the
+    controller to the caller (tests run it in-process).
+    """
+    spec = _validate(task)
+    name = service_name or task.name or 'service'
+    lb_port = _free_port()
+    ok = serve_state.add_service(
+        name, json.dumps(spec.to_config()), task.to_yaml(), lb_port,
+        spec.load_balancing_policy)
+    if not ok:
+        raise exceptions.InvalidTaskError(
+            f'service {name!r} already exists; use `serve.update` to '
+            f'roll it, or pick another name')
+    if _spawn:
+        service_lib.spawn_detached(name)
+    return {'name': name, 'endpoint': f'http://127.0.0.1:{lb_port}'}
+
+
+def update(task: task_lib.Task, service_name: str) -> int:
+    """Roll the service to a new task/spec version (reference
+    sky/serve/server/core.py:49). Returns the new version."""
+    spec = _validate(task)
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.JobNotFoundError(f'service {service_name!r}')
+    version = serve_state.update_service_spec(
+        service_name, json.dumps(spec.to_config()), task.to_yaml())
+    return version
+
+
+def down(service_name: str, *, purge: bool = False,
+         timeout: float = 120.0) -> None:
+    """Tear a service down: replicas, then the service row itself."""
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.JobNotFoundError(f'service {service_name!r}')
+    serve_state.request_shutdown(service_name)
+    pid = record.get('controller_pid')
+    alive = _pid_alive(pid)
+    if not alive or purge:
+        # No controller to do it — clean up here.
+        from skypilot_tpu.serve import replica_managers
+        rm = replica_managers.ReplicaManager(
+            service_name,
+            spec_lib.ServiceSpec.from_config(record['spec']),
+            record['task_yaml'])
+        rm.terminate_all()
+        rm.shutdown()
+        if alive and purge:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        serve_state.remove_service(service_name)
+        return
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if serve_state.get_service(service_name) is None:
+            return
+        time.sleep(0.2)
+    raise TimeoutError(
+        f'service {service_name!r} still shutting down after {timeout}s; '
+        f'retry with purge=True to force')
+
+
+def _pid_alive(pid: Optional[int]) -> bool:
+    if not pid or pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Snapshot of one or all services (reference serve status)."""
+    if service_name is not None:
+        snap = controller_lib.service_snapshot(service_name)
+        if snap is None:
+            raise exceptions.JobNotFoundError(f'service {service_name!r}')
+        return [snap]
+    return [controller_lib.service_snapshot(s['name'])
+            for s in serve_state.get_services()]
+
+
+def wait_ready(service_name: str, timeout: float = 300.0,
+               poll_s: float = 0.5) -> Dict[str, Any]:
+    """Block until the service is READY (SDK/test helper)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = serve_state.get_service(service_name)
+        if record is None:
+            raise exceptions.JobNotFoundError(f'service {service_name!r}')
+        if record['status'] == ServiceStatus.READY:
+            return controller_lib.service_snapshot(service_name)
+        if record['status'] == ServiceStatus.FAILED:
+            raise exceptions.SkyTpuError(
+                f'service {service_name!r} FAILED: '
+                f'{record["failure_reason"]}')
+        time.sleep(poll_s)
+    raise TimeoutError(f'service {service_name!r} not READY '
+                       f'after {timeout}s')

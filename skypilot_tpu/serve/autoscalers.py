@@ -1,0 +1,135 @@
+"""Autoscalers: decide the target replica count each controller tick.
+
+Counterpart of the reference's ``sky/serve/autoscalers.py`` (``Autoscaler``
+:117, ``RequestRateAutoscaler`` :458) — QPS-based scaling with hysteresis:
+an upscale fires only after the overloaded condition persists for
+``upscale_delay_seconds``, a downscale after ``downscale_delay_seconds``.
+Decisions are pure (state in the object, inputs passed per tick) so tests
+drive them with a fake clock.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import time
+from typing import List, Optional
+
+from skypilot_tpu.serve import spec as spec_lib
+from skypilot_tpu.serve import state as serve_state
+
+logger = logging.getLogger(__name__)
+
+# Window over which QPS is measured (reference qps_window_size=60).
+QPS_WINDOW_S = 60.0
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    target_num_replicas: int
+    reason: str = ''
+
+
+class Autoscaler:
+    """Base: fixed replica count (min_replicas)."""
+
+    def __init__(self, service_name: str,
+                 policy: spec_lib.ReplicaPolicy) -> None:
+        self.service_name = service_name
+        self.policy = policy
+        self.target_num_replicas = policy.min_replicas
+
+    def update_policy(self, policy: spec_lib.ReplicaPolicy) -> None:
+        self.policy = policy
+
+    def evaluate(self, num_ready: int,
+                 now: Optional[float] = None) -> AutoscalerDecision:
+        del num_ready, now
+        return AutoscalerDecision(
+            self.policy.min_replicas + self.policy.num_overprovision,
+            reason='fixed')
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """Scale on measured QPS vs target_qps_per_replica (reference :458)."""
+
+    def __init__(self, service_name: str,
+                 policy: spec_lib.ReplicaPolicy) -> None:
+        super().__init__(service_name, policy)
+        self._overload_since: Optional[float] = None
+        self._underload_since: Optional[float] = None
+
+    def _measure_qps(self, now: float) -> float:
+        n = serve_state.request_count_since(self.service_name,
+                                            now - QPS_WINDOW_S)
+        return n / QPS_WINDOW_S
+
+    def evaluate(self, num_ready: int,
+                 now: Optional[float] = None) -> AutoscalerDecision:
+        now = time.time() if now is None else now
+        pol = self.policy
+        if not pol.autoscaling or pol.target_qps_per_replica is None:
+            return AutoscalerDecision(
+                pol.min_replicas + pol.num_overprovision, reason='fixed')
+        qps = self._measure_qps(now)
+        demand = math.ceil(qps / pol.target_qps_per_replica)
+        lo = pol.min_replicas
+        hi = pol.max_replicas if pol.max_replicas is not None else demand
+        desired = max(lo, min(hi, demand)) + pol.num_overprovision
+        current = self.target_num_replicas
+
+        if desired > current:
+            self._underload_since = None
+            if self._overload_since is None:
+                self._overload_since = now
+            if now - self._overload_since >= pol.upscale_delay_seconds:
+                self._overload_since = None
+                self.target_num_replicas = desired
+                return AutoscalerDecision(
+                    desired, reason=f'upscale: qps={qps:.2f} '
+                    f'demand={demand}')
+        elif desired < current:
+            self._overload_since = None
+            if self._underload_since is None:
+                self._underload_since = now
+            if now - self._underload_since >= pol.downscale_delay_seconds:
+                self._underload_since = None
+                self.target_num_replicas = desired
+                return AutoscalerDecision(
+                    desired, reason=f'downscale: qps={qps:.2f} '
+                    f'demand={demand}')
+        else:
+            self._overload_since = None
+            self._underload_since = None
+        return AutoscalerDecision(current, reason='steady')
+
+
+def make(service_name: str,
+         policy: spec_lib.ReplicaPolicy) -> Autoscaler:
+    if policy.autoscaling:
+        return RequestRateAutoscaler(service_name, policy)
+    return Autoscaler(service_name, policy)
+
+
+def select_replicas_to_scale_down(
+        replicas: List[dict], num: int) -> List[int]:
+    """Pick replica_ids to terminate: prefer old versions, then
+    launching/not-ready, then newest-ready-last (reference
+    _select_replicas_to_scale_down semantics)."""
+    def sort_key(r: dict):
+        status: serve_state.ReplicaStatus = r['status']
+        status_rank = {
+            serve_state.ReplicaStatus.FAILED: 0,
+            serve_state.ReplicaStatus.PREEMPTED: 1,
+            serve_state.ReplicaStatus.NOT_READY: 2,
+            serve_state.ReplicaStatus.PENDING: 3,
+            serve_state.ReplicaStatus.PROVISIONING: 4,
+            serve_state.ReplicaStatus.STARTING: 5,
+            serve_state.ReplicaStatus.READY: 6,
+        }.get(status, 3)
+        return (r['version'], status_rank, -(r['launched_at'] or 0))
+
+    eligible = [r for r in replicas
+                if r['status'] != serve_state.ReplicaStatus.SHUTTING_DOWN]
+    chosen = sorted(eligible, key=sort_key)[:num]
+    return [r['replica_id'] for r in chosen]

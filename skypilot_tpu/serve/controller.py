@@ -1,0 +1,200 @@
+"""Serve controller: the per-service reconcile loop.
+
+Counterpart of the reference's ``sky/serve/controller.py``
+(``SkyServeController`` :40) — each tick it syncs replica health, asks the
+autoscaler for a target count, launches/terminates replicas to match, and
+rolls replicas forward across versions. The reference runs this as a
+FastAPI app on a controller cluster; here it is a plain loop inside the
+detached service process (``serve/service.py``) — the control surface
+(shutdown, update) goes through the serve state DB instead of HTTP, so
+the controller keeps working even if the API server restarts.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu.serve import autoscalers as autoscalers_lib
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import spec as spec_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.serve.state import ReplicaStatus, ServiceStatus
+
+logger = logging.getLogger(__name__)
+
+_TICK_S = float(os.environ.get('SKY_TPU_SERVE_TICK_S', '2'))
+
+
+class ServeController:
+    """Drives one service until shutdown is requested."""
+
+    def __init__(self, service_name: str) -> None:
+        record = serve_state.get_service(service_name)
+        if record is None:
+            raise ValueError(f'service {service_name!r} not in state DB')
+        self.service_name = service_name
+        self.version = record['version']
+        self.spec = spec_lib.ServiceSpec.from_config(record['spec'])
+        self.rm = replica_managers.ReplicaManager(
+            service_name, self.spec, record['task_yaml'])
+        self.autoscaler = autoscalers_lib.make(
+            service_name, self.spec.replica_policy)
+
+    # -- version rollout ---------------------------------------------------
+    def _refresh_version(self) -> None:
+        record = serve_state.get_service(self.service_name)
+        if record is None:
+            return
+        if record['version'] != self.version:
+            logger.info('service %s: rolling to version %d',
+                        self.service_name, record['version'])
+            self.version = record['version']
+            self.spec = spec_lib.ServiceSpec.from_config(record['spec'])
+            self.rm.update_version(self.spec, record['task_yaml'])
+            self.autoscaler.update_policy(self.spec.replica_policy)
+
+    # -- one tick ----------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> None:
+        self._refresh_version()
+        self.rm.sync()
+
+        live = self.rm.live_replicas()
+        num_ready = sum(1 for r in live
+                        if r['status'] == ReplicaStatus.READY)
+        decision = self.autoscaler.evaluate(num_ready, now=now)
+        target = decision.target_num_replicas
+
+        current = [r for r in live if r['version'] == self.version]
+        stale = [r for r in live if r['version'] != self.version]
+        stale_ready = [r for r in stale
+                       if r['status'] == ReplicaStatus.READY]
+        ready_current = sum(1 for r in current
+                            if r['status'] == ReplicaStatus.READY)
+
+        # Launch up to target on the current version.
+        to_launch = target - len(current)
+        for _ in range(max(0, to_launch)):
+            rid = self.rm.launch_replica(self.version)
+            logger.info('service %s: launching replica %d (v%d) [%s]',
+                        self.service_name, rid, self.version,
+                        decision.reason)
+        # Rolling update: drain stale replicas only once the current
+        # version can carry the FULL load (or there is nothing stale/ready
+        # worth preserving) — never collapse capacity mid-roll.
+        if stale and (ready_current >= target or not stale_ready):
+            for r in stale:
+                self.rm.terminate_replica(r['replica_id'],
+                                          'superseded version')
+        # Scale down excess current-version replicas.
+        if to_launch < 0:
+            victims = autoscalers_lib.select_replicas_to_scale_down(
+                current, -to_launch)
+            for rid in victims:
+                logger.info('service %s: scaling down replica %d [%s]',
+                            self.service_name, rid, decision.reason)
+                self.rm.terminate_replica(rid, decision.reason)
+
+        # Service-level status.
+        if (self.rm.launch_failures >=
+                replica_managers.MAX_CONSECUTIVE_LAUNCH_FAILURES):
+            serve_state.set_service_status(
+                self.service_name, ServiceStatus.FAILED,
+                f'{self.rm.launch_failures} consecutive replica launch '
+                f'failures')
+            return
+        total_ready = num_ready
+        if total_ready > 0:
+            serve_state.set_service_status(self.service_name,
+                                           ServiceStatus.READY)
+        elif any(r['status'].is_launching() for r in live):
+            serve_state.set_service_status(self.service_name,
+                                           ServiceStatus.REPLICA_INIT)
+        else:
+            serve_state.set_service_status(self.service_name,
+                                           ServiceStatus.NO_REPLICA)
+        # Trim LB stats older than the QPS window.
+        serve_state.prune_stats(
+            self.service_name,
+            time.time() - 2 * autoscalers_lib.QPS_WINDOW_S)
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self) -> None:
+        logger.info('service %s: controller up (pid %d)',
+                    self.service_name, os.getpid())
+        serve_state.set_controller_pid(self.service_name, os.getpid())
+        try:
+            while True:
+                if serve_state.shutdown_requested(self.service_name):
+                    self._shutdown()
+                    return
+                record = serve_state.get_service(self.service_name)
+                if record is None:
+                    logger.info('service %s: row deleted; exiting',
+                                self.service_name)
+                    return
+                if record['status'] == ServiceStatus.FAILED:
+                    # Keep replicas down, stay alive for `down`.
+                    self.rm.terminate_all()
+                    time.sleep(_TICK_S)
+                    continue
+                self.tick()
+                time.sleep(_TICK_S)
+        except Exception:  # noqa: BLE001 — a controller crash is a state
+            logger.exception('service %s: controller crashed',
+                             self.service_name)
+            serve_state.set_service_status(
+                self.service_name, ServiceStatus.FAILED,
+                'controller crashed (see controller.log)')
+            raise
+
+    def _shutdown(self) -> None:
+        logger.info('service %s: shutting down', self.service_name)
+        serve_state.set_service_status(self.service_name,
+                                       ServiceStatus.SHUTTING_DOWN)
+        self.rm.terminate_all()
+        self.rm.shutdown()
+        serve_state.remove_service(self.service_name)
+
+
+def service_snapshot(name: str) -> Optional[dict]:
+    """JSON-ready view of one service + its replicas (CLI/SDK surface)."""
+    record = serve_state.get_service(name)
+    if record is None:
+        return None
+    replicas = serve_state.get_replicas(name)
+    return {
+        'name': record['name'],
+        'status': record['status'].value,
+        'version': record['version'],
+        'endpoint': f'http://127.0.0.1:{record["lb_port"]}'
+                    if record['lb_port'] else None,
+        'policy': record['lb_policy'],
+        'failure_reason': record['failure_reason'],
+        'replicas': [{
+            'replica_id': r['replica_id'],
+            'cluster_name': r['cluster_name'],
+            'status': r['status'].value,
+            'version': r['version'],
+            'url': r['url'],
+            'is_spot': r['is_spot'],
+            'zone': r['zone'],
+            'launched_at': r['launched_at'],
+        } for r in replicas],
+    }
+
+
+def main() -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+    ServeController(args.service_name).run()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,137 @@
+"""Serve load balancer: HTTP proxy over the ready replica set.
+
+Counterpart of the reference's ``sky/serve/load_balancer.py``
+(``SkyServeLoadBalancer`` :24, ``run_load_balancer`` :289). aiohttp on
+both sides: an aiohttp server accepts user requests, an aiohttp client
+session streams them to the selected replica. The ready-replica set is
+refreshed from the serve state DB every second (the reference syncs it
+from the controller over HTTP); request counts are flushed back to the DB
+as the autoscaler's QPS signal.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from skypilot_tpu.serve import load_balancing_policies as lbp
+from skypilot_tpu.serve import state as serve_state
+
+logger = logging.getLogger(__name__)
+
+SYNC_INTERVAL_S = 1.0
+STATS_FLUSH_S = 2.0
+# Hop-by-hop headers never forwarded by proxies (RFC 9110 §7.6.1).
+_HOP_HEADERS = frozenset((
+    'connection', 'keep-alive', 'proxy-authenticate',
+    'proxy-authorization', 'te', 'trailers', 'transfer-encoding',
+    'upgrade', 'host', 'content-length'))
+
+
+class LoadBalancer:
+    def __init__(self, service_name: str, policy_name: str) -> None:
+        self.service_name = service_name
+        self.policy = lbp.make(policy_name)
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._pending_requests = 0
+        self._running = True
+
+    # -- background sync ---------------------------------------------------
+    async def _sync_loop(self) -> None:
+        while self._running:
+            try:
+                urls = await asyncio.to_thread(
+                    serve_state.ready_replica_urls, self.service_name)
+                self.policy.set_ready_replicas(urls)
+            except Exception:  # noqa: BLE001 — keep serving on DB hiccup
+                logger.warning('replica sync failed', exc_info=True)
+            await asyncio.sleep(SYNC_INTERVAL_S)
+
+    async def _stats_loop(self) -> None:
+        while self._running:
+            await asyncio.sleep(STATS_FLUSH_S)
+            n, self._pending_requests = self._pending_requests, 0
+            if n:
+                try:
+                    await asyncio.to_thread(
+                        serve_state.record_requests, self.service_name, n,
+                        time.time())
+                except Exception:  # noqa: BLE001
+                    logger.warning('stats flush failed', exc_info=True)
+
+    # -- request path ------------------------------------------------------
+    async def handle(self, request: web.Request) -> web.StreamResponse:
+        if request.path == '/-/urls':   # introspection endpoint
+            return web.json_response(
+                {'ready_replica_urls': list(self.policy.ready_urls)})
+        url = self.policy.select_replica()
+        if url is None:
+            return web.Response(
+                status=503,
+                text=f'No ready replicas for service '
+                     f'{self.service_name!r}. Use `sky-tpu serve status` '
+                     f'to check replica health.\n')
+        self._pending_requests += 1
+        self.policy.pre_execute(url)
+        try:
+            target = url.rstrip('/') + request.path_qs
+            headers = {k: v for k, v in request.headers.items()
+                       if k.lower() not in _HOP_HEADERS}
+            body = await request.read()
+            assert self._session is not None
+            async with self._session.request(
+                    request.method, target, headers=headers,
+                    data=body or None,
+                    allow_redirects=False) as upstream:
+                resp = web.StreamResponse(
+                    status=upstream.status,
+                    headers={k: v for k, v in upstream.headers.items()
+                             if k.lower() not in _HOP_HEADERS})
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_chunked(64 * 1024):
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            return web.Response(
+                status=502,
+                text=f'Replica {url} failed: {type(e).__name__}: {e}\n')
+        finally:
+            self.policy.post_execute(url)
+
+    # -- lifecycle ---------------------------------------------------------
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_route('*', '/{tail:.*}', self.handle)
+        return app
+
+    async def run(self, host: str, port: int) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=600))
+        runner = web.AppRunner(self.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        logger.info('service %s: load balancer on %s:%d',
+                    self.service_name, host, port)
+        tasks = [asyncio.create_task(self._sync_loop()),
+                 asyncio.create_task(self._stats_loop())]
+        try:
+            while self._running:
+                await asyncio.sleep(0.2)
+        finally:
+            for t in tasks:
+                t.cancel()
+            await self._session.close()
+            await runner.cleanup()
+
+
+def run_load_balancer(service_name: str, policy_name: str, host: str,
+                      port: int) -> None:
+    """Blocking entry (reference run_load_balancer :289)."""
+    lb = LoadBalancer(service_name, policy_name)
+    asyncio.run(lb.run(host, port))

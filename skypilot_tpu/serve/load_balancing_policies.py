@@ -1,0 +1,89 @@
+"""Load-balancing policies: pick a ready replica per request.
+
+Counterpart of the reference's ``sky/serve/load_balancing_policies.py``
+(RoundRobinPolicy :85, LeastLoadPolicy :111 — the default). Policies are
+synchronous and in-memory; the LB serializes calls through the asyncio
+event loop so no locking is needed.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class LoadBalancingPolicy:
+    """Tracks the ready-replica set and selects one per request."""
+
+    def __init__(self) -> None:
+        self.ready_urls: List[str] = []
+        self._lock = threading.Lock()
+
+    def set_ready_replicas(self, urls: List[str]) -> None:
+        with self._lock:
+            if set(urls) != set(self.ready_urls):
+                self._on_replica_change(urls)
+            self.ready_urls = list(urls)
+
+    def _on_replica_change(self, new_urls: List[str]) -> None:
+        pass
+
+    def select_replica(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def pre_execute(self, url: str) -> None:
+        """Called before proxying a request to ``url``."""
+
+    def post_execute(self, url: str) -> None:
+        """Called after the proxied request finishes (any outcome)."""
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+    """Cycle through ready replicas (reference :85)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._index = 0
+
+    def _on_replica_change(self, new_urls: List[str]) -> None:
+        self._index = 0
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_urls:
+                return None
+            url = self.ready_urls[self._index % len(self.ready_urls)]
+            self._index = (self._index + 1) % len(self.ready_urls)
+            return url
+
+
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Fewest in-flight requests wins (reference :111, the default)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._inflight: Dict[str, int] = {}
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_urls:
+                return None
+            return min(self.ready_urls,
+                       key=lambda u: self._inflight.get(u, 0))
+
+    def pre_execute(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = self._inflight.get(url, 0) + 1
+
+    def post_execute(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = max(0, self._inflight.get(url, 0) - 1)
+
+
+POLICIES = {
+    'round_robin': RoundRobinPolicy,
+    'least_load': LeastLoadPolicy,
+}
+
+
+def make(name: str) -> LoadBalancingPolicy:
+    return POLICIES[name]()

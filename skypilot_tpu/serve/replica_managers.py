@@ -1,0 +1,312 @@
+"""Replica manager: launch/probe/recover/terminate replica slices.
+
+Counterpart of the reference's ``sky/serve/replica_managers.py``
+(``SkyPilotReplicaManager`` :731, ``launch_cluster`` :67, ``ReplicaInfo``
+:440). Each replica is a full cluster launched through
+``execution.launch`` (recursion into the engine, as in the reference);
+launches and teardowns run on a thread pool so the controller tick never
+blocks on provisioning.
+
+Preemption detection follows the managed-jobs controller: the provider's
+view of the slice (``provision.get_cluster_info``) is authoritative — a
+vanished or non-RUNNING slice is a dead replica even if its HTTP port
+still answers.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import socket
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+import yaml
+
+from skypilot_tpu import execution
+from skypilot_tpu import provision
+from skypilot_tpu import state as global_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.serve import spec as spec_lib
+from skypilot_tpu.serve import spot_placer as spot_placer_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.serve.state import ReplicaStatus
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_REPLICA_PORT = 8080
+# A replica that failed provisioning this many times consecutively marks
+# the service FAILED (reference: _FAILED_TO_PROVISION thresholds).
+MAX_CONSECUTIVE_LAUNCH_FAILURES = 3
+# A NOT_READY replica is torn down (and thereby replaced) after this many
+# failure_thresholds' worth of consecutive failed probes.
+NOT_READY_TERMINATE_FACTOR = 5
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+class ReplicaManager:
+    """Owns the replica set of one service."""
+
+    def __init__(self, service_name: str, spec: spec_lib.ServiceSpec,
+                 task_yaml: str) -> None:
+        self.service_name = service_name
+        self.spec = spec
+        self.task_yaml = task_yaml
+        self.spot_placer = spot_placer_lib.SpotPlacer(service_name)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix=f'serve-{service_name}')
+        self._launching: Dict[int, concurrent.futures.Future] = {}
+        self._terminating: Dict[int, concurrent.futures.Future] = {}
+        self._probe_ok_streak: Dict[int, int] = {}
+        self.launch_failures = 0
+
+    def update_version(self, spec: spec_lib.ServiceSpec,
+                       task_yaml: str) -> None:
+        self.spec = spec
+        self.task_yaml = task_yaml
+
+    # -- scale up ----------------------------------------------------------
+    def launch_replica(self, version: int) -> int:
+        task = task_lib.Task.from_yaml_config(
+            yaml.safe_load(self.task_yaml))
+        if task.resources.cloud == 'local':
+            # Replicas share the host's network namespace locally — each
+            # needs its own port.
+            port = _free_port()
+        else:
+            port = self.spec.replica_port or DEFAULT_REPLICA_PORT
+        cluster_name = None  # assigned after the row gives us an id
+        replica_id = serve_state.add_replica(
+            self.service_name, cluster_name or '', version,
+            is_spot=task.resources.use_spot)
+        cluster_name = f'{self.service_name}-r{replica_id}'
+        conn = serve_state._db().conn  # noqa: SLF001 — same-module family
+        conn.execute(
+            'UPDATE replicas SET cluster_name = ? WHERE replica_id = ?',
+            (cluster_name, replica_id))
+        conn.commit()
+        serve_state.set_replica_status(replica_id,
+                                       ReplicaStatus.PROVISIONING)
+        task.envs['SKYPILOT_SERVE_PORT'] = str(port)
+        task.envs['SKYPILOT_SERVE_REPLICA_ID'] = str(replica_id)
+        fut = self._pool.submit(self._do_launch, replica_id, cluster_name,
+                                task, port)
+        self._launching[replica_id] = fut
+        return replica_id
+
+    def _do_launch(self, replica_id: int, cluster_name: str,
+                   task: task_lib.Task, port: int) -> None:
+        blocked = (self.spot_placer.blocked_placements()
+                   if task.resources.use_spot else None)
+        _, info = execution.launch(task, cluster_name,
+                                   blocked_placements=blocked)
+        ip = info.head.external_ip or info.head.internal_ip or '127.0.0.1'
+        serve_state.set_replica_url(replica_id, f'http://{ip}:{port}')
+        conn = serve_state._db().conn  # noqa: SLF001
+        # starting_at anchors the readiness grace period: provisioning can
+        # take arbitrarily long and must not eat initial_delay_seconds.
+        conn.execute(
+            'UPDATE replicas SET zone = ?, starting_at = ? '
+            'WHERE replica_id = ?',
+            (f'{info.region}/{info.zone}', time.time(), replica_id))
+        conn.commit()
+        serve_state.set_replica_status(replica_id, ReplicaStatus.STARTING)
+
+    # -- scale down --------------------------------------------------------
+    def terminate_replica(self, replica_id: int,
+                          reason: str = 'scale-down') -> None:
+        if replica_id in self._terminating:
+            return
+        record = serve_state.get_replica(replica_id)
+        if record is None:
+            return
+        serve_state.set_replica_status(replica_id,
+                                       ReplicaStatus.SHUTTING_DOWN, reason)
+        launch_fut = self._launching.pop(replica_id, None)
+        fut = self._pool.submit(self._do_terminate, replica_id,
+                                record['cluster_name'], launch_fut)
+        self._terminating[replica_id] = fut
+
+    def _do_terminate(
+            self, replica_id: int, cluster_name: str,
+            launch_fut: Optional[concurrent.futures.Future] = None
+    ) -> None:
+        if launch_fut is not None:
+            # An in-flight launch must finish (or fail) before teardown,
+            # or the freshly-provisioned slice would leak with its
+            # replica row already gone.
+            try:
+                launch_fut.result(timeout=600)
+            except Exception:  # noqa: BLE001 — failed launch, fine
+                pass
+        record = global_state.get_cluster(cluster_name)
+        if record is not None and record.get('cluster_info'):
+            info = ClusterInfo.from_dict(record['cluster_info'])
+            try:
+                provision.terminate_instances(info.cloud, cluster_name,
+                                              info.provider_config)
+            except Exception:  # noqa: BLE001 — already-gone is success
+                logger.warning('terminate %s: provider call failed',
+                               cluster_name, exc_info=True)
+            global_state.remove_cluster(cluster_name)
+        serve_state.remove_replica(replica_id)
+
+    def terminate_all(self) -> None:
+        for r in serve_state.get_replicas(self.service_name):
+            if r['status'] != ReplicaStatus.SHUTTING_DOWN:
+                self.terminate_replica(r['replica_id'], 'service down')
+        self.wait_terminations()
+
+    def wait_terminations(self, timeout: float = 120.0) -> None:
+        done, _ = concurrent.futures.wait(
+            list(self._terminating.values()), timeout=timeout)
+        del done
+        self._terminating = {rid: f for rid, f in
+                             self._terminating.items() if not f.done()}
+
+    # -- health ------------------------------------------------------------
+    def _probe_url(self, url: str) -> bool:
+        probe = self.spec.readiness_probe
+        full = url.rstrip('/') + probe.path
+        try:
+            with urllib.request.urlopen(
+                    full, timeout=probe.timeout_seconds) as resp:
+                return 200 <= resp.status < 300
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    def _provider_alive(self, cluster_name: str) -> Optional[bool]:
+        """True/False = provider verdict; None = no cluster record."""
+        record = global_state.get_cluster(cluster_name)
+        if record is None or not record.get('cluster_info'):
+            return None
+        info = ClusterInfo.from_dict(record['cluster_info'])
+        try:
+            live = provision.get_cluster_info(info.cloud, cluster_name,
+                                              info.provider_config)
+        except Exception:  # noqa: BLE001 — flaky probe ≠ dead slice
+            return True
+        if live is None:
+            return False
+        return all(h.state == 'RUNNING' for h in live.hosts)
+
+    # -- the tick ----------------------------------------------------------
+    def sync(self) -> None:
+        """One controller tick: reap launches, probe readiness, detect
+        preemption/failure."""
+        now = time.time()
+        # Reap finished launch futures.
+        for rid, fut in list(self._launching.items()):
+            if not fut.done():
+                continue
+            del self._launching[rid]
+            exc = fut.exception()
+            if exc is not None:
+                self.launch_failures += 1
+                logger.warning('replica %d: launch failed: %s', rid, exc)
+                serve_state.set_replica_status(
+                    rid, ReplicaStatus.FAILED, f'launch failed: {exc}')
+            else:
+                self.launch_failures = 0
+        self.wait_terminations(timeout=0)
+
+        for r in serve_state.get_replicas(self.service_name):
+            rid, status = r['replica_id'], r['status']
+            if status in (ReplicaStatus.PENDING,
+                          ReplicaStatus.PROVISIONING,
+                          ReplicaStatus.SHUTTING_DOWN,
+                          ReplicaStatus.FAILED,
+                          ReplicaStatus.PREEMPTED):
+                continue
+            # STARTING / READY / NOT_READY: check provider plane first.
+            alive = self._provider_alive(r['cluster_name'])
+            if alive is False or alive is None:
+                logger.info('replica %d: slice dead (provider view)', rid)
+                region, _, zone = (r['zone'] or '/').partition('/')
+                if r['is_spot']:
+                    self.spot_placer.report_preemption(region, zone)
+                serve_state.set_replica_status(
+                    rid, ReplicaStatus.PREEMPTED, 'slice not RUNNING')
+                # Clean up the carcass asynchronously.
+                self._pool.submit(self._cleanup_carcass,
+                                  r['cluster_name'])
+                continue
+            if not r['url']:
+                continue
+            probe_ok = self._probe_url(r['url'])
+            if status == ReplicaStatus.STARTING:
+                anchor = r.get('starting_at') or r['launched_at'] or now
+                in_grace = (now - anchor <
+                            self.spec.readiness_probe.initial_delay_seconds)
+                if probe_ok:
+                    streak = self._probe_ok_streak.get(rid, 0) + 1
+                    self._probe_ok_streak[rid] = streak
+                    if (streak >=
+                            self.spec.readiness_probe.success_threshold):
+                        serve_state.set_replica_status(
+                            rid, ReplicaStatus.READY)
+                        serve_state.reset_replica_failures(rid)
+                        logger.info('replica %d: READY', rid)
+                else:
+                    self._probe_ok_streak[rid] = 0
+                    if not in_grace:
+                        fails = serve_state.bump_replica_failures(rid)
+                        if (fails >=
+                                self.spec.readiness_probe.failure_threshold):
+                            serve_state.set_replica_status(
+                                rid, ReplicaStatus.FAILED,
+                                'readiness probe never succeeded')
+                            self.terminate_replica(rid, 'probe timeout')
+            elif status in (ReplicaStatus.READY, ReplicaStatus.NOT_READY):
+                if probe_ok:
+                    if status == ReplicaStatus.NOT_READY:
+                        serve_state.set_replica_status(
+                            rid, ReplicaStatus.READY)
+                    serve_state.reset_replica_failures(rid)
+                else:
+                    fails = serve_state.bump_replica_failures(rid)
+                    threshold = self.spec.readiness_probe.failure_threshold
+                    if fails >= threshold and status == ReplicaStatus.READY:
+                        serve_state.set_replica_status(
+                            rid, ReplicaStatus.NOT_READY,
+                            'readiness probes failing')
+                    elif fails >= threshold * NOT_READY_TERMINATE_FACTOR:
+                        # Persistently unhealthy on a healthy slice: give
+                        # up and replace, or a single wedged server pins
+                        # the service at NO_REPLICA forever.
+                        logger.warning(
+                            'replica %d: unhealthy for %d probes; '
+                            'replacing', rid, fails)
+                        self.terminate_replica(rid, 'unhealthy too long')
+
+    def _cleanup_carcass(self, cluster_name: str) -> None:
+        record = global_state.get_cluster(cluster_name)
+        if record is None:
+            return
+        if record.get('cluster_info'):
+            info = ClusterInfo.from_dict(record['cluster_info'])
+            try:
+                provision.terminate_instances(info.cloud, cluster_name,
+                                              info.provider_config)
+            except Exception:  # noqa: BLE001
+                pass
+        global_state.remove_cluster(cluster_name)
+
+    # -- views -------------------------------------------------------------
+    def live_replicas(self) -> List[dict]:
+        """Replicas that count toward the target (not terminal/shutting)."""
+        return serve_state.get_replicas(
+            self.service_name,
+            [ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+             ReplicaStatus.STARTING, ReplicaStatus.READY,
+             ReplicaStatus.NOT_READY])
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)

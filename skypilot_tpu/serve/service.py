@@ -1,0 +1,79 @@
+"""Service bootstrap: one detached process = controller loop + LB.
+
+Counterpart of the reference's ``sky/serve/service.py`` (``_start`` :238)
+which forks controller and load-balancer processes on the controller
+cluster. Here both run inside one process on the API-server host: the
+load balancer owns the asyncio loop, the controller reconciles in a
+daemon thread. The process exits when `down` is requested (controller
+deletes the service row and stops the loop).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import state as serve_state
+
+logger = logging.getLogger(__name__)
+
+
+def run_service(service_name: str) -> None:
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise ValueError(f'service {service_name!r} not found')
+    ctl = controller_lib.ServeController(service_name)
+    lb = lb_lib.LoadBalancer(service_name, record['lb_policy'])
+
+    def controller_thread() -> None:
+        try:
+            ctl.run()
+        finally:
+            lb._running = False  # noqa: SLF001 — shutdown signal
+            os._exit(0)          # controller done ⇒ service process done
+
+    t = threading.Thread(target=controller_thread, daemon=True,
+                         name=f'controller-{service_name}')
+    t.start()
+    import asyncio
+    try:
+        asyncio.run(lb.run('127.0.0.1', record['lb_port']))
+    except Exception as e:  # noqa: BLE001 — e.g. LB port stolen pre-bind
+        logger.exception('service %s: load balancer died', service_name)
+        serve_state.set_service_status(
+            service_name, serve_state.ServiceStatus.FAILED,
+            f'load balancer failed: {type(e).__name__}: {e}')
+        raise
+
+
+def spawn_detached(service_name: str) -> int:
+    """Start the service process, detached; returns its pid."""
+    import subprocess
+    log = open(serve_state.controller_log_path(service_name), 'ab')
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.serve.service',
+         '--service-name', service_name],
+        stdout=log, stderr=subprocess.STDOUT,
+        start_new_session=True,
+        env={**os.environ, 'JAX_PLATFORMS': os.environ.get(
+            'JAX_PLATFORMS', 'cpu')},
+    )
+    return proc.pid
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+    run_service(args.service_name)
+
+
+if __name__ == '__main__':
+    main()

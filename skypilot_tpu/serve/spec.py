@@ -1,0 +1,164 @@
+"""Service spec: the ``service:`` section of a task YAML.
+
+Counterpart of the reference's ``sky/serve/service_spec.py`` — readiness
+probe + replica policy, validated and round-tripped. The TPU-native spec
+adds nothing exotic; the shape is:
+
+    service:
+      readiness_probe:
+        path: /health
+        initial_delay_seconds: 60
+        timeout_seconds: 5
+      replica_policy:
+        min_replicas: 1
+        max_replicas: 4
+        target_qps_per_replica: 10
+        upscale_delay_seconds: 30
+        downscale_delay_seconds: 120
+      load_balancing_policy: least_load   # or round_robin
+
+``readiness_probe: /health`` (a bare string) is accepted shorthand, as in
+the reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass
+class ReadinessProbe:
+    path: str = '/'
+    initial_delay_seconds: float = 60.0
+    timeout_seconds: float = 5.0
+    # Consecutive successful probes before READY (debounce).
+    success_threshold: int = 1
+    # Consecutive failed probes on a READY replica before NOT_READY.
+    failure_threshold: int = 3
+
+    @classmethod
+    def from_config(cls, config: Any) -> 'ReadinessProbe':
+        if config is None:
+            return cls()
+        if isinstance(config, str):
+            return cls(path=config)
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'readiness_probe must be a path or a mapping, got '
+                f'{type(config).__name__}')
+        return cls(
+            path=config.get('path', '/'),
+            initial_delay_seconds=float(
+                config.get('initial_delay_seconds', 60.0)),
+            timeout_seconds=float(config.get('timeout_seconds', 5.0)),
+            success_threshold=int(config.get('success_threshold', 1)),
+            failure_threshold=int(config.get('failure_threshold', 3)),
+        )
+
+    def to_config(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ReplicaPolicy:
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None   # None → fixed at min_replicas
+    target_qps_per_replica: Optional[float] = None
+    upscale_delay_seconds: float = 300.0
+    downscale_delay_seconds: float = 1200.0
+    # Extra replicas beyond demand, absorbing preemption churn when the
+    # replicas are spot (reference: spot "base on-demand fallback").
+    num_overprovision: int = 0
+
+    @classmethod
+    def from_config(cls, config: Any) -> 'ReplicaPolicy':
+        if config is None:
+            return cls()
+        if isinstance(config, int):
+            return cls(min_replicas=config)
+        pol = cls(
+            min_replicas=int(config.get('min_replicas', 1)),
+            max_replicas=(int(config['max_replicas'])
+                          if config.get('max_replicas') is not None
+                          else None),
+            target_qps_per_replica=(
+                float(config['target_qps_per_replica'])
+                if config.get('target_qps_per_replica') is not None
+                else None),
+            upscale_delay_seconds=float(
+                config.get('upscale_delay_seconds', 300.0)),
+            downscale_delay_seconds=float(
+                config.get('downscale_delay_seconds', 1200.0)),
+            num_overprovision=int(config.get('num_overprovision', 0)),
+        )
+        if pol.min_replicas < 0:
+            raise exceptions.InvalidTaskError('min_replicas must be >= 0')
+        if (pol.max_replicas is not None
+                and pol.max_replicas < pol.min_replicas):
+            raise exceptions.InvalidTaskError(
+                'max_replicas must be >= min_replicas')
+        if (pol.max_replicas is not None
+                and pol.max_replicas > pol.min_replicas
+                and pol.target_qps_per_replica is None):
+            raise exceptions.InvalidTaskError(
+                'autoscaling (max_replicas > min_replicas) requires '
+                'target_qps_per_replica')
+        return pol
+
+    def to_config(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @property
+    def autoscaling(self) -> bool:
+        return (self.max_replicas is not None
+                and self.max_replicas > self.min_replicas)
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    readiness_probe: ReadinessProbe
+    replica_policy: ReplicaPolicy
+    load_balancing_policy: str = 'least_load'
+    # Port the replica's workload listens on. The replica manager injects
+    # it as $SKYPILOT_SERVE_PORT (locally each replica gets a unique one).
+    replica_port: Optional[int] = None
+
+    @classmethod
+    def from_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'service must be a mapping, got {type(config).__name__}')
+        known = {'readiness_probe', 'replica_policy', 'replicas',
+                 'load_balancing_policy', 'replica_port'}
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidTaskError(
+                f'unknown service fields: {sorted(unknown)}')
+        policy_cfg = config.get('replica_policy')
+        if policy_cfg is None and 'replicas' in config:
+            policy_cfg = int(config['replicas'])   # fixed-size shorthand
+        lb = config.get('load_balancing_policy', 'least_load')
+        from skypilot_tpu.serve import load_balancing_policies as lbp
+        if lb not in lbp.POLICIES:
+            raise exceptions.InvalidTaskError(
+                f'unknown load_balancing_policy {lb!r}; '
+                f'choose from {sorted(lbp.POLICIES)}')
+        return cls(
+            readiness_probe=ReadinessProbe.from_config(
+                config.get('readiness_probe')),
+            replica_policy=ReplicaPolicy.from_config(policy_cfg),
+            load_balancing_policy=lb,
+            replica_port=(int(config['replica_port'])
+                          if config.get('replica_port') is not None
+                          else None),
+        )
+
+    def to_config(self) -> Dict[str, Any]:
+        return {
+            'readiness_probe': self.readiness_probe.to_config(),
+            'replica_policy': self.replica_policy.to_config(),
+            'load_balancing_policy': self.load_balancing_policy,
+            'replica_port': self.replica_port,
+        }

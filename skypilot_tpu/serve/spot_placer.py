@@ -1,0 +1,51 @@
+"""Spot placer: de-correlate spot replica preemptions across zones.
+
+Counterpart of the reference's ``sky/serve/spot_placer.py`` — spot
+capacity reclaims are zone-correlated, so spreading replicas over zones
+bounds the blast radius of one reclaim. Implementation detail that
+differs: rather than rewriting the task's zone, the placer emits a
+*blocked placement list* for ``execution.launch`` — the same mechanism
+the failover loop already honors — steering the optimizer's best-first
+candidate order away from zones that already host (or recently lost)
+replicas of this service.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.serve import state as serve_state
+
+# A zone that preempted a replica is avoided for this long.
+PREEMPTION_COOLDOWN_S = 600.0
+
+
+class SpotPlacer:
+    def __init__(self, service_name: str) -> None:
+        self.service_name = service_name
+        self._preempted_at: Dict[Tuple[str, str], float] = {}
+
+    def report_preemption(self, region: Optional[str],
+                          zone: Optional[str]) -> None:
+        if zone is None:
+            return
+        self._preempted_at[(region or '', zone)] = time.time()
+
+    def blocked_placements(self) -> List[Tuple[str, str]]:
+        """Zones to steer away from: active-replica zones + recently
+        preempted zones. launch() falls back to the full candidate list
+        if everything is blocked, so this can never strand a launch."""
+        now = time.time()
+        blocked: List[Tuple[str, str]] = [
+            k for k, t in self._preempted_at.items()
+            if now - t < PREEMPTION_COOLDOWN_S]
+        active = serve_state.get_replicas(
+            self.service_name,
+            [serve_state.ReplicaStatus.PROVISIONING,
+             serve_state.ReplicaStatus.STARTING,
+             serve_state.ReplicaStatus.READY])
+        for r in active:
+            if r['zone']:
+                region, _, zone = r['zone'].partition('/')
+                blocked.append((region, zone))
+        return blocked

@@ -1,0 +1,326 @@
+"""Serve state: sqlite service/replica/version tables + LB request stats.
+
+Counterpart of the reference's ``sky/serve/serve_state.py`` (service +
+replica + version tables). One deliberate addition: the load balancer
+aggregates request counts into ``lb_stats`` rows here, which is how the
+autoscaler observes QPS — the reference ships these in-memory via an HTTP
+sync between LB and controller processes; a WAL sqlite row is the same
+contract with crash persistence for free.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common
+from skypilot_tpu.utils import db as db_util
+
+
+class ServiceStatus(enum.Enum):
+    """Reference serve_state.ServiceStatus semantics."""
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'    # replicas launching, none ready yet
+    READY = 'READY'                  # >=1 ready replica
+    NO_REPLICA = 'NO_REPLICA'        # running but zero ready replicas
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+
+    def is_terminal(self) -> bool:
+        return self == ServiceStatus.FAILED
+
+
+class ReplicaStatus(enum.Enum):
+    """Reference serve_state.ReplicaStatus semantics."""
+    PENDING = 'PENDING'              # decided, not yet provisioning
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'            # provisioned; waiting on readiness
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'          # was ready; probes now failing
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    PREEMPTED = 'PREEMPTED'
+    FAILED = 'FAILED'
+
+    def is_terminal(self) -> bool:
+        return self in (ReplicaStatus.FAILED,)
+
+    def is_launching(self) -> bool:
+        return self in (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+                        ReplicaStatus.STARTING)
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS services (
+    name TEXT PRIMARY KEY,
+    status TEXT,
+    spec_json TEXT,
+    task_yaml TEXT,
+    version INTEGER DEFAULT 1,
+    lb_port INTEGER,
+    lb_policy TEXT,
+    controller_pid INTEGER,
+    requested_at REAL,
+    shutdown_requested INTEGER DEFAULT 0,
+    failure_reason TEXT
+);
+CREATE TABLE IF NOT EXISTS replicas (
+    replica_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    service_name TEXT,
+    cluster_name TEXT,
+    status TEXT,
+    version INTEGER,
+    url TEXT,
+    is_spot INTEGER DEFAULT 0,
+    zone TEXT,
+    launched_at REAL,
+    starting_at REAL,
+    ready_at REAL,
+    terminated_at REAL,
+    consecutive_failures INTEGER DEFAULT 0,
+    failure_reason TEXT
+);
+CREATE TABLE IF NOT EXISTS lb_stats (
+    service_name TEXT,
+    window_start REAL,
+    num_requests INTEGER
+);
+CREATE INDEX IF NOT EXISTS idx_replicas_service
+    ON replicas (service_name);
+CREATE INDEX IF NOT EXISTS idx_lb_stats_service
+    ON lb_stats (service_name, window_start);
+"""
+
+
+def _db() -> db_util.Db:
+    return db_util.get_db(os.path.join(common.base_dir(), 'serve.db'),
+                          _SCHEMA)
+
+
+def service_dir(name: str) -> str:
+    d = os.path.join(common.base_dir(), 'services', name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def controller_log_path(name: str) -> str:
+    return os.path.join(service_dir(name), 'controller.log')
+
+
+# ---- services ------------------------------------------------------------
+def add_service(name: str, spec_json: str, task_yaml: str, lb_port: int,
+                lb_policy: str) -> bool:
+    """Insert a new service row; False if the name is taken."""
+    conn = _db().conn
+    try:
+        conn.execute(
+            'INSERT INTO services (name, status, spec_json, task_yaml, '
+            'version, lb_port, lb_policy, requested_at) '
+            'VALUES (?,?,?,?,1,?,?,?)',
+            (name, ServiceStatus.CONTROLLER_INIT.value, spec_json,
+             task_yaml, lb_port, lb_policy, time.time()))
+        conn.commit()
+        return True
+    except sqlite3.IntegrityError:
+        return False
+
+
+def update_service_spec(name: str, spec_json: str,
+                        task_yaml: str) -> int:
+    """Record a new target version (rolling update); returns it."""
+    conn = _db().conn
+    cur = conn.execute(
+        'UPDATE services SET spec_json = ?, task_yaml = ?, '
+        'version = version + 1 WHERE name = ?',
+        (spec_json, task_yaml, name))
+    conn.commit()
+    if cur.rowcount == 0:
+        return -1
+    row = conn.execute('SELECT version FROM services WHERE name = ?',
+                       (name,)).fetchone()
+    return int(row['version'])
+
+
+def set_service_status(name: str, status: ServiceStatus,
+                       failure_reason: Optional[str] = None) -> None:
+    conn = _db().conn
+    conn.execute(
+        'UPDATE services SET status = ?, failure_reason = '
+        'COALESCE(?, failure_reason) WHERE name = ?',
+        (status.value, failure_reason, name))
+    conn.commit()
+
+
+def set_controller_pid(name: str, pid: int) -> None:
+    conn = _db().conn
+    conn.execute('UPDATE services SET controller_pid = ? WHERE name = ?',
+                 (pid, name))
+    conn.commit()
+
+
+def request_shutdown(name: str) -> bool:
+    conn = _db().conn
+    cur = conn.execute(
+        'UPDATE services SET shutdown_requested = 1 WHERE name = ?',
+        (name,))
+    conn.commit()
+    return cur.rowcount > 0
+
+
+def shutdown_requested(name: str) -> bool:
+    row = _db().conn.execute(
+        'SELECT shutdown_requested FROM services WHERE name = ?',
+        (name,)).fetchone()
+    return bool(row and row['shutdown_requested'])
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    row = _db().conn.execute('SELECT * FROM services WHERE name = ?',
+                             (name,)).fetchone()
+    return _service_row(row) if row else None
+
+
+def get_services() -> List[Dict[str, Any]]:
+    rows = _db().conn.execute(
+        'SELECT * FROM services ORDER BY requested_at').fetchall()
+    return [_service_row(r) for r in rows]
+
+
+def remove_service(name: str) -> None:
+    conn = _db().conn
+    conn.execute('DELETE FROM services WHERE name = ?', (name,))
+    conn.execute('DELETE FROM replicas WHERE service_name = ?', (name,))
+    conn.execute('DELETE FROM lb_stats WHERE service_name = ?', (name,))
+    conn.commit()
+
+
+def _service_row(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d['status'] = ServiceStatus(d['status'])
+    d['spec'] = json.loads(d.pop('spec_json'))
+    return d
+
+
+# ---- replicas ------------------------------------------------------------
+def add_replica(service_name: str, cluster_name: str, version: int,
+                is_spot: bool = False,
+                zone: Optional[str] = None) -> int:
+    conn = _db().conn
+    cur = conn.execute(
+        'INSERT INTO replicas (service_name, cluster_name, status, '
+        'version, is_spot, zone, launched_at) VALUES (?,?,?,?,?,?,?)',
+        (service_name, cluster_name, ReplicaStatus.PENDING.value, version,
+         int(is_spot), zone, time.time()))
+    conn.commit()
+    return int(cur.lastrowid)
+
+
+def set_replica_status(replica_id: int, status: ReplicaStatus,
+                       failure_reason: Optional[str] = None) -> None:
+    conn = _db().conn
+    extra = ''
+    if status == ReplicaStatus.READY:
+        extra = ', ready_at = COALESCE(ready_at, strftime("%s","now"))'
+    elif status in (ReplicaStatus.SHUTTING_DOWN, ReplicaStatus.FAILED,
+                    ReplicaStatus.PREEMPTED):
+        extra = ', terminated_at = COALESCE(terminated_at, ' \
+                'strftime("%s","now"))'
+    conn.execute(
+        f'UPDATE replicas SET status = ?, failure_reason = '
+        f'COALESCE(?, failure_reason){extra} WHERE replica_id = ?',
+        (status.value, failure_reason, replica_id))
+    conn.commit()
+
+
+def set_replica_url(replica_id: int, url: str) -> None:
+    conn = _db().conn
+    conn.execute('UPDATE replicas SET url = ? WHERE replica_id = ?',
+                 (url, replica_id))
+    conn.commit()
+
+
+def bump_replica_failures(replica_id: int) -> int:
+    conn = _db().conn
+    conn.execute(
+        'UPDATE replicas SET consecutive_failures = '
+        'consecutive_failures + 1 WHERE replica_id = ?', (replica_id,))
+    conn.commit()
+    row = conn.execute(
+        'SELECT consecutive_failures FROM replicas WHERE replica_id = ?',
+        (replica_id,)).fetchone()
+    return int(row['consecutive_failures'])
+
+
+def reset_replica_failures(replica_id: int) -> None:
+    conn = _db().conn
+    conn.execute(
+        'UPDATE replicas SET consecutive_failures = 0 '
+        'WHERE replica_id = ?', (replica_id,))
+    conn.commit()
+
+
+def remove_replica(replica_id: int) -> None:
+    conn = _db().conn
+    conn.execute('DELETE FROM replicas WHERE replica_id = ?',
+                 (replica_id,))
+    conn.commit()
+
+
+def get_replica(replica_id: int) -> Optional[Dict[str, Any]]:
+    row = _db().conn.execute(
+        'SELECT * FROM replicas WHERE replica_id = ?',
+        (replica_id,)).fetchone()
+    return _replica_row(row) if row else None
+
+
+def get_replicas(service_name: str,
+                 statuses: Optional[List[ReplicaStatus]] = None
+                 ) -> List[Dict[str, Any]]:
+    q = 'SELECT * FROM replicas WHERE service_name = ?'
+    args: List[Any] = [service_name]
+    if statuses:
+        q += f' AND status IN ({",".join("?" * len(statuses))})'
+        args += [s.value for s in statuses]
+    rows = _db().conn.execute(q + ' ORDER BY replica_id', args).fetchall()
+    return [_replica_row(r) for r in rows]
+
+
+def ready_replica_urls(service_name: str) -> List[str]:
+    rows = get_replicas(service_name, [ReplicaStatus.READY])
+    return [r['url'] for r in rows if r['url']]
+
+
+def _replica_row(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d['status'] = ReplicaStatus(d['status'])
+    d['is_spot'] = bool(d['is_spot'])
+    return d
+
+
+# ---- LB request stats (autoscaler input) ---------------------------------
+def record_requests(service_name: str, num: int,
+                    window_start: Optional[float] = None) -> None:
+    conn = _db().conn
+    conn.execute(
+        'INSERT INTO lb_stats (service_name, window_start, num_requests) '
+        'VALUES (?,?,?)',
+        (service_name, window_start or time.time(), num))
+    conn.commit()
+
+
+def request_count_since(service_name: str, since: float) -> int:
+    row = _db().conn.execute(
+        'SELECT COALESCE(SUM(num_requests), 0) AS n FROM lb_stats '
+        'WHERE service_name = ? AND window_start >= ?',
+        (service_name, since)).fetchone()
+    return int(row['n'])
+
+
+def prune_stats(service_name: str, older_than: float) -> None:
+    conn = _db().conn
+    conn.execute(
+        'DELETE FROM lb_stats WHERE service_name = ? AND window_start < ?',
+        (service_name, older_than))
+    conn.commit()

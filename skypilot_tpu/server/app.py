@@ -211,6 +211,10 @@ class Server:
         if name == 'serve.status':
             return functools.partial(serve_lib.status,
                                      payload.get('service_name'))
+        if name == 'serve.update':
+            return functools.partial(
+                serve_lib.update, self._task_from_payload(payload),
+                payload['service_name'])
         raise web.HTTPNotFound(text=f'unknown op {name}')
 
     # ---- HTTP handlers ---------------------------------------------------

@@ -91,3 +91,42 @@ def test_requests_listing(api_server):
     assert any(r['name'] == 'check' for r in reqs)
     assert all(r['status'] in ('PENDING', 'RUNNING', 'SUCCEEDED',
                                'FAILED', 'CANCELLED') for r in reqs)
+
+
+def test_serve_roundtrip_via_server(api_server):
+    """serve.up through the API server spawns a real detached service
+    process (controller + LB) whose replicas are local fake slices."""
+    from skypilot_tpu import Resources, Task
+    from skypilot_tpu.client import sdk
+
+    task = Task('svc-api',
+                run='exec python3 -m http.server $SKYPILOT_SERVE_PORT',
+                resources=Resources(cloud='local', accelerators='v5e-4'),
+                service={'readiness_probe': {
+                    'path': '/', 'initial_delay_seconds': 30},
+                    'replicas': 1})
+    out = sdk.serve_up(task)
+    assert out['name'] == 'svc-api'
+
+    deadline = time.time() + 90
+    snap = None
+    while time.time() < deadline:
+        snap = sdk.serve_status('svc-api')[0]
+        if snap['status'] == 'READY':
+            break
+        time.sleep(1)
+    assert snap is not None and snap['status'] == 'READY', snap
+
+    # The detached LB proxies end-user requests to the replica (its
+    # replica-set sync runs every second, so allow a short catch-up).
+    deadline = time.time() + 15
+    status_code = None
+    while time.time() < deadline:
+        status_code = requests.get(snap['endpoint'], timeout=10).status_code
+        if status_code == 200:
+            break
+        time.sleep(0.5)
+    assert status_code == 200
+
+    sdk.serve_down('svc-api')
+    assert sdk.serve_status() == []

@@ -1,0 +1,245 @@
+"""Serve subsystem: spec, policies, autoscaler, replica lifecycle, LB.
+
+Reference analogs: tests/test_jobs_and_serve.py +
+tests/unit_tests/test_serve_utils.py, run against the local fake-slice
+cloud so replica clusters are real (local) slices running a real HTTP
+server, and preemption is injected by terminating the slice underneath
+the controller.
+"""
+import asyncio
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu import serve
+from skypilot_tpu import state as global_state
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import load_balancing_policies as lbp
+from skypilot_tpu.serve import spec as spec_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.serve.state import ReplicaStatus, ServiceStatus
+
+# The replica workload: a real HTTP server on the injected port.
+_SERVER_CMD = 'exec python3 -m http.server $SKYPILOT_SERVE_PORT'
+
+
+def _service_task(run=_SERVER_CMD, name='svc', replicas=1, policy=None,
+                  **res_kw):
+    service = {
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 30,
+                            'timeout_seconds': 2},
+    }
+    if policy is not None:
+        service['replica_policy'] = policy
+    else:
+        service['replicas'] = replicas
+    return sky.Task(name, run=run,
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4',
+                                            **res_kw),
+                    service=service)
+
+
+def _tick_until(ctl, predicate, timeout=60.0, tick_s=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        ctl.tick()
+        if predicate():
+            return
+        time.sleep(tick_s)
+    raise TimeoutError('condition not reached; replicas: '
+                       f'{serve_state.get_replicas(ctl.service_name)}')
+
+
+def _num_ready(name):
+    return len(serve_state.get_replicas(name, [ReplicaStatus.READY]))
+
+
+# ---------- spec ----------------------------------------------------------
+def test_spec_parsing_and_validation():
+    spec = spec_lib.ServiceSpec.from_config({
+        'readiness_probe': '/health',
+        'replica_policy': {'min_replicas': 1, 'max_replicas': 4,
+                           'target_qps_per_replica': 2.5},
+    })
+    assert spec.readiness_probe.path == '/health'
+    assert spec.replica_policy.autoscaling
+    # Round trip.
+    again = spec_lib.ServiceSpec.from_config(spec.to_config())
+    assert again.replica_policy.max_replicas == 4
+
+    with pytest.raises(exceptions.InvalidTaskError):
+        spec_lib.ServiceSpec.from_config(
+            {'replica_policy': {'min_replicas': 2, 'max_replicas': 1}})
+    with pytest.raises(exceptions.InvalidTaskError):
+        # Autoscaling needs a QPS target.
+        spec_lib.ServiceSpec.from_config(
+            {'replica_policy': {'min_replicas': 1, 'max_replicas': 3}})
+    with pytest.raises(exceptions.InvalidTaskError):
+        spec_lib.ServiceSpec.from_config({'bogus_field': 1})
+    with pytest.raises(exceptions.InvalidTaskError):
+        spec_lib.ServiceSpec.from_config(
+            {'load_balancing_policy': 'wat'})
+
+
+# ---------- LB policies ---------------------------------------------------
+def test_round_robin_policy():
+    p = lbp.RoundRobinPolicy()
+    assert p.select_replica() is None
+    p.set_ready_replicas(['a', 'b', 'c'])
+    assert [p.select_replica() for _ in range(4)] == ['a', 'b', 'c', 'a']
+
+
+def test_least_load_policy():
+    p = lbp.LeastLoadPolicy()
+    p.set_ready_replicas(['a', 'b'])
+    first = p.select_replica()
+    p.pre_execute(first)
+    other = p.select_replica()   # the idle one
+    assert other != first
+    p.post_execute(first)
+    assert p.select_replica() in ('a', 'b')
+
+
+# ---------- autoscaler ----------------------------------------------------
+def test_request_rate_autoscaler_hysteresis():
+    name = 'as-svc'
+    pol = spec_lib.ReplicaPolicy(
+        min_replicas=1, max_replicas=4, target_qps_per_replica=1.0,
+        upscale_delay_seconds=10.0, downscale_delay_seconds=20.0)
+    scaler = autoscalers.RequestRateAutoscaler(name, pol)
+    t0 = time.time()
+    # 120 requests in the window → 2 qps → demand 2.
+    serve_state.record_requests(name, 120, window_start=t0 - 1)
+    # Overload seen but within upscale delay: stay at 1.
+    assert scaler.evaluate(1, now=t0).target_num_replicas == 1
+    # Still overloaded past the delay: scale to 2.
+    assert scaler.evaluate(1, now=t0 + 11).target_num_replicas == 2
+    # Load vanishes (window moves on): hold during downscale delay...
+    t1 = t0 + autoscalers.QPS_WINDOW_S + 30
+    assert scaler.evaluate(2, now=t1).target_num_replicas == 2
+    # ...then drop back to min.
+    assert scaler.evaluate(2, now=t1 + 21).target_num_replicas == 1
+
+
+def test_scale_down_selection_prefers_old_and_unready():
+    replicas = [
+        {'replica_id': 1, 'version': 2,
+         'status': ReplicaStatus.READY, 'launched_at': 100.0},
+        {'replica_id': 2, 'version': 1,
+         'status': ReplicaStatus.READY, 'launched_at': 50.0},
+        {'replica_id': 3, 'version': 2,
+         'status': ReplicaStatus.PROVISIONING, 'launched_at': 200.0},
+    ]
+    # Old version goes first, then the still-launching one.
+    assert autoscalers.select_replicas_to_scale_down(replicas, 2) == [2, 3]
+
+
+# ---------- end-to-end on the local fake cloud ----------------------------
+def test_service_up_ready_proxy_down():
+    task = _service_task(name='svc-e2e')
+    out = serve.up(task, _spawn=False)
+    assert out['name'] == 'svc-e2e'
+    ctl = controller_lib.ServeController('svc-e2e')
+    _tick_until(ctl, lambda: _num_ready('svc-e2e') >= 1)
+    assert (serve_state.get_service('svc-e2e')['status'] ==
+            ServiceStatus.READY)
+
+    # Replica answers directly.
+    [url] = serve_state.ready_replica_urls('svc-e2e')
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        assert resp.status == 200
+
+    # Load balancer proxies to it.
+    record = serve_state.get_service('svc-e2e')
+    lb = lb_lib.LoadBalancer('svc-e2e', record['lb_policy'])
+    t = threading.Thread(
+        target=lambda: asyncio.run(lb.run('127.0.0.1',
+                                          record['lb_port'])),
+        daemon=True)
+    t.start()
+    lb_url = f'http://127.0.0.1:{record["lb_port"]}'
+    deadline = time.time() + 20
+    ok = False
+    while time.time() < deadline and not ok:
+        try:
+            with urllib.request.urlopen(lb_url, timeout=5) as resp:
+                ok = resp.status == 200
+        except Exception:
+            time.sleep(0.3)
+    assert ok, 'LB never proxied a request'
+    lb._running = False  # noqa: SLF001
+
+    # status() surfaces it; down() cleans everything.
+    snap = serve.status('svc-e2e')[0]
+    assert snap['status'] == 'READY'
+    assert len(snap['replicas']) == 1
+    serve.down('svc-e2e')   # no controller process → in-process cleanup
+    assert serve_state.get_service('svc-e2e') is None
+    assert serve_state.get_replicas('svc-e2e') == []
+    # Replica cluster is gone from global state too.
+    assert all(not c['name'].startswith('svc-e2e-r')
+               for c in global_state.get_clusters())
+
+
+def test_replica_preemption_recovery():
+    task = _service_task(name='svc-rec')
+    serve.up(task, _spawn=False)
+    ctl = controller_lib.ServeController('svc-rec')
+    _tick_until(ctl, lambda: _num_ready('svc-rec') >= 1)
+    [old] = serve_state.get_replicas('svc-rec', [ReplicaStatus.READY])
+
+    # Preempt: terminate the slice underneath the service.
+    record = global_state.get_cluster(old['cluster_name'])
+    info = ClusterInfo.from_dict(record['cluster_info'])
+    provision.terminate_instances('local', old['cluster_name'],
+                                  info.provider_config)
+
+    _tick_until(ctl, lambda: any(
+        r['replica_id'] != old['replica_id']
+        and r['status'] == ReplicaStatus.READY
+        for r in serve_state.get_replicas('svc-rec')))
+    serve.down('svc-rec')
+
+
+def test_rolling_update():
+    task = _service_task(name='svc-roll')
+    serve.up(task, _spawn=False)
+    ctl = controller_lib.ServeController('svc-roll')
+    _tick_until(ctl, lambda: _num_ready('svc-roll') >= 1)
+
+    new_task = _service_task(
+        name='svc-roll',
+        run='echo v2 > marker.txt && ' + _SERVER_CMD)
+    version = serve.update(new_task, 'svc-roll')
+    assert version == 2
+
+    def rolled():
+        reps = serve_state.get_replicas('svc-roll')
+        return (any(r['version'] == 2
+                    and r['status'] == ReplicaStatus.READY
+                    for r in reps)
+                and all(r['version'] == 2 for r in reps))
+    _tick_until(ctl, rolled, timeout=90)
+    serve.down('svc-roll')
+
+
+def test_up_rejects_duplicates_and_missing_spec():
+    task = _service_task(name='svc-dup')
+    serve.up(task, _spawn=False)
+    with pytest.raises(exceptions.InvalidTaskError):
+        serve.up(task, _spawn=False)
+    serve.down('svc-dup')
+    plain = sky.Task('no-svc', run='echo hi',
+                     resources=sky.Resources(cloud='local',
+                                             accelerators='v5e-4'))
+    with pytest.raises(exceptions.InvalidTaskError):
+        serve.up(plain, _spawn=False)
