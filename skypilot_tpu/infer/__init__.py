@@ -1,0 +1,19 @@
+"""TPU-native LLM inference: continuous batching over a slotted KV cache.
+
+The serving payload for `sky-tpu serve` (BASELINE.md config #4 — the
+reference delegates this to vLLM/JetStream on GPU; here it is first-party,
+built TPU-first):
+
+- ``cache``: static-shape slotted KV cache (XLA-friendly; no dynamic
+  shapes anywhere).
+- ``model``: prefill + single-token decode paths over the Llama params
+  from ``models/llama.py``.
+- ``sampling``: greedy / temperature / top-k, jitted.
+- ``engine``: the continuous-batching orchestrator (slot refill, EOS
+  handling, TTFT/throughput metrics).
+- ``server``: aiohttp HTTP front end replicas run under `sky-tpu serve`.
+"""
+from skypilot_tpu.infer.engine import (EngineConfig, InferenceEngine,
+                                       Request)
+
+__all__ = ['EngineConfig', 'InferenceEngine', 'Request']

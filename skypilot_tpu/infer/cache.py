@@ -1,0 +1,87 @@
+"""Slotted KV cache: the static-shape heart of continuous batching.
+
+Layout (all shapes static, per XLA's compilation model):
+
+    k, v: [n_layers, n_slots, max_seq_len, n_kv_heads, head_dim]
+    lengths: [n_slots] int32   — tokens currently cached per slot
+
+One running sequence owns one slot; finishing frees the slot for the next
+request with **no recompilation** — insertion is `dynamic_update_slice`
+at a traced slot index, appending during decode is a vmapped
+`dynamic_update_slice` at per-slot positions (XLA lowers both to
+scatters). seq-len axis placed before heads so a slot's cache lines are
+contiguous per position — the decode gather walks positions linearly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    k: jnp.ndarray        # [L, slots, S, kv_heads, head_dim]
+    v: jnp.ndarray        # [L, slots, S, kv_heads, head_dim]
+    lengths: jnp.ndarray  # [slots] int32
+
+    @property
+    def n_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(n_layers: int, n_slots: int, max_seq_len: int,
+               n_kv_heads: int, head_dim: int,
+               dtype=jnp.bfloat16) -> KVCache:
+    shape = (n_layers, n_slots, max_seq_len, n_kv_heads, head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((n_slots,), jnp.int32))
+
+
+def insert_prefill(cache: KVCache, slot: jnp.ndarray, k_new: jnp.ndarray,
+                   v_new: jnp.ndarray, true_len: jnp.ndarray) -> KVCache:
+    """Write a prefilled prompt's K/V into ``slot``.
+
+    k_new/v_new: [L, P, kv_heads, head_dim] (P = padded prompt bucket;
+    only the first ``true_len`` positions are meaningful — the garbage
+    tail is never attended to because lengths[slot] = true_len).
+    """
+    k = jax.lax.dynamic_update_slice(
+        cache.k, k_new[:, None].astype(cache.k.dtype), (0, slot, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(
+        cache.v, v_new[:, None].astype(cache.v.dtype), (0, slot, 0, 0, 0))
+    lengths = cache.lengths.at[slot].set(true_len.astype(jnp.int32))
+    return KVCache(k=k, v=v, lengths=lengths)
+
+
+def append_token(cache_k_layer: jnp.ndarray, cache_v_layer: jnp.ndarray,
+                 k_new: jnp.ndarray, v_new: jnp.ndarray,
+                 positions: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Append one token's K/V at per-slot ``positions``.
+
+    cache_*_layer: [slots, S, kv, hd]; k_new/v_new: [slots, kv, hd];
+    positions: [slots] int32 (the write offset = current length).
+    """
+    def upd(cache_slot, new, pos):
+        return jax.lax.dynamic_update_slice(
+            cache_slot, new[None].astype(cache_slot.dtype), (pos, 0, 0))
+    k = jax.vmap(upd)(cache_k_layer, k_new, positions)
+    v = jax.vmap(upd)(cache_v_layer, v_new, positions)
+    return k, v
+
+
+def free_slot(cache: KVCache, slot: int) -> KVCache:
+    """Mark a slot reusable. K/V bytes are left in place — lengths=0
+    makes them unreachable, so no memset traffic on the hot path."""
+    return KVCache(k=cache.k, v=cache.v,
+                   lengths=cache.lengths.at[slot].set(0))

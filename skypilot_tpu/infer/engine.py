@@ -1,0 +1,285 @@
+"""Continuous-batching inference engine.
+
+The orchestrator the reference delegates to vLLM/JetStream (reference
+llm/vllm example YAMLs; SURVEY.md §2.6 — serving is GPU-delegated there).
+TPU-first structure:
+
+- All device work is TWO compiled programs: ``prefill`` (per prompt
+  bucket) and ``decode+sample`` (one token for every slot, fused). Static
+  shapes everywhere; slot refill never recompiles.
+- The KV cache is donated through the decode step, so XLA updates it in
+  place in HBM (no copy of the multi-GB cache per token).
+- Decode crosses the host boundary as [slots] int32 — sampling happens
+  on-device (``sampling.py``).
+- Prompt lengths are bucketed (powers of two) to bound prefill
+  compilations.
+
+Metrics: per-request TTFT (submit → first token on host) and decode
+throughput, surfaced by ``metrics()`` for the serve layer's p50-TTFT
+target (BASELINE.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.infer import cache as cache_lib
+from skypilot_tpu.infer import model as model_lib
+from skypilot_tpu.infer import sampling as sampling_lib
+from skypilot_tpu.models import llama
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    n_slots: int = 8
+    max_seq_len: int = 2048
+    prefill_buckets: Sequence[int] = (16, 64, 256, 1024, 2048)
+    eos_id: Optional[int] = None
+    max_new_tokens: int = 256
+    top_k: int = 0
+    cache_dtype: str = 'bfloat16'
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: int
+    prompt_tokens: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    output_tokens: List[int] = dataclasses.field(default_factory=list)
+    submitted_at: float = dataclasses.field(default_factory=time.time)
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    finish_reason: Optional[str] = None
+
+    @property
+    def done(self) -> bool:
+        return self.finished_at is not None
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+class InferenceEngine:
+    """Slot-based continuous batching over one model replica."""
+
+    def __init__(self, config: llama.LlamaConfig, params: llama.Params,
+                 engine_config: Optional[EngineConfig] = None,
+                 seed: int = 0) -> None:
+        self.config = config
+        self.ecfg = engine_config or EngineConfig()
+        if self.ecfg.max_seq_len > config.max_seq_len:
+            raise ValueError(
+                f'cache max_seq_len {self.ecfg.max_seq_len} exceeds model '
+                f'max_seq_len {config.max_seq_len}')
+        # Buckets clamp to the cache length and always include it, so any
+        # prompt submit() accepts has a bucket that fits the cache.
+        self._buckets = sorted(
+            {min(b, self.ecfg.max_seq_len)
+             for b in self.ecfg.prefill_buckets}
+            | {self.ecfg.max_seq_len})
+        self.params = params
+        self.cache = cache_lib.init_cache(
+            config.n_layers, self.ecfg.n_slots, self.ecfg.max_seq_len,
+            config.n_kv_heads, config.head_dim,
+            dtype=jnp.dtype(self.ecfg.cache_dtype))
+        self._key = jax.random.PRNGKey(seed)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._waiting: List[Request] = []
+        self._slots: List[Optional[Request]] = [None] * self.ecfg.n_slots
+        # Host mirrors of device state (avoid device reads on the hot path)
+        self._last_token = np.zeros((self.ecfg.n_slots,), np.int32)
+        self._slot_len = np.zeros((self.ecfg.n_slots,), np.int64)
+        self._temps = np.zeros((self.ecfg.n_slots,), np.float32)
+        self._decode_steps = 0
+        self._decode_tokens = 0
+        self._decode_time = 0.0
+        self._ttfts: List[float] = []
+
+        # ---- compiled programs ------------------------------------------
+        @functools.partial(jax.jit, static_argnums=(0,))
+        def _prefill(bucket_is_static, tokens, true_len):
+            del bucket_is_static
+            return model_lib.prefill(config, self.params, tokens, true_len)
+        self._prefill = _prefill
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _insert(kv_cache, slot, ks, vs, true_len):
+            return cache_lib.insert_prefill(kv_cache, slot, ks, vs,
+                                            true_len)
+        self._insert = _insert
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _decode(kv_cache, tokens, key, temps):
+            logits, new_cache = model_lib.decode_step(
+                config, self.params, kv_cache, tokens)
+            toks = sampling_lib.sample(logits, key, temps,
+                                       top_k=self.ecfg.top_k)
+            return toks, new_cache
+        self._decode = _decode
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _free(kv_cache, slot):
+            return cache_lib.free_slot(kv_cache, slot)
+        self._free = _free
+
+        @jax.jit
+        def _sample_first(logits, key, temp):
+            return sampling_lib.sample(logits[None], key, temp[None],
+                                       top_k=self.ecfg.top_k)[0]
+        self._sample_first = _sample_first
+
+    # ---- submission ------------------------------------------------------
+    def submit(self, prompt_tokens: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0) -> Request:
+        if not prompt_tokens:
+            raise ValueError('empty prompt')
+        if len(prompt_tokens) > self.ecfg.max_seq_len - 1:
+            raise ValueError(
+                f'prompt ({len(prompt_tokens)} tokens) exceeds cache '
+                f'capacity ({self.ecfg.max_seq_len - 1})')
+        req = Request(
+            request_id=next(self._ids),
+            prompt_tokens=list(map(int, prompt_tokens)),
+            max_new_tokens=max_new_tokens or self.ecfg.max_new_tokens,
+            temperature=float(temperature))
+        with self._lock:
+            self._waiting.append(req)
+        return req
+
+    # ---- internals -------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        raise AssertionError(
+            f'prompt length {n} has no bucket (max {self._buckets[-1]}) — '
+            f'submit() should have rejected it')
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _do_prefill(self, req: Request, slot: int) -> None:
+        n = len(req.prompt_tokens)
+        bucket = self._bucket(n)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:n] = req.prompt_tokens
+        ks, vs, logits = self._prefill(bucket, jnp.asarray(padded),
+                                       jnp.int32(n))
+        self.cache = self._insert(self.cache, jnp.int32(slot), ks, vs,
+                                  jnp.int32(n))
+        first = int(self._sample_first(
+            logits, self._next_key(), jnp.float32(req.temperature)))
+        req.first_token_at = time.time()
+        req.output_tokens.append(first)
+        self._ttfts.append(req.first_token_at - req.submitted_at)
+        self._last_token[slot] = first
+        self._slot_len[slot] = n
+        self._temps[slot] = req.temperature
+        if self._finished(req, slot, first):
+            self._finish(slot, req)
+
+    def _finished(self, req: Request, slot: int, token: int) -> bool:
+        if self.ecfg.eos_id is not None and token == self.ecfg.eos_id:
+            req.finish_reason = 'eos'
+            return True
+        if len(req.output_tokens) >= req.max_new_tokens:
+            req.finish_reason = 'max_tokens'
+            return True
+        if self._slot_len[slot] + 1 >= self.ecfg.max_seq_len:
+            req.finish_reason = 'cache_full'
+            return True
+        return False
+
+    def _finish(self, slot: int, req: Request) -> None:
+        req.finished_at = time.time()
+        self._slots[slot] = None
+        self._slot_len[slot] = 0
+        self.cache = self._free(self.cache, jnp.int32(slot))
+
+    # ---- the step --------------------------------------------------------
+    def step(self) -> int:
+        """Refill free slots, then decode one token for all active slots.
+        Returns the number of active slots stepped.
+
+        The lock guards only the waiting queue — prefill compiles/executes
+        on-device and must not block submit() (which HTTP handlers call
+        from the event loop)."""
+        refill: List[tuple] = []
+        with self._lock:
+            for slot in range(self.ecfg.n_slots):
+                if self._slots[slot] is None and self._waiting:
+                    req = self._waiting.pop(0)
+                    self._slots[slot] = req   # reserve before releasing
+                    refill.append((req, slot))
+        for req, slot in refill:
+            self._do_prefill(req, slot)
+        active = [s for s, r in enumerate(self._slots) if r is not None]
+        if not active:
+            return 0
+        t0 = time.perf_counter()
+        toks, self.cache = self._decode(
+            self.cache, jnp.asarray(self._last_token),
+            self._next_key(), jnp.asarray(self._temps))
+        toks_host = np.asarray(toks)
+        self._decode_time += time.perf_counter() - t0
+        self._decode_steps += 1
+        self._decode_tokens += len(active)
+        for slot in active:
+            req = self._slots[slot]
+            token = int(toks_host[slot])
+            req.output_tokens.append(token)
+            self._last_token[slot] = token
+            self._slot_len[slot] += 1
+            if self._finished(req, slot, token):
+                self._finish(slot, req)
+        return len(active)
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._waiting and all(
+                r is None for r in self._slots)
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        for _ in range(max_steps):
+            if self.idle():
+                return
+            self.step()
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0) -> List[Request]:
+        """Batch convenience: submit all, run to completion."""
+        reqs = [self.submit(p, max_new_tokens, temperature)
+                for p in prompts]
+        self.run_until_idle()
+        return reqs
+
+    # ---- metrics ---------------------------------------------------------
+    def metrics(self) -> Dict[str, Any]:
+        ttfts = sorted(self._ttfts)
+        p50 = ttfts[len(ttfts) // 2] if ttfts else None
+        return {
+            'decode_steps': self._decode_steps,
+            'decode_tokens': self._decode_tokens,
+            'decode_tokens_per_sec': (
+                self._decode_tokens / self._decode_time
+                if self._decode_time else 0.0),
+            'ttft_p50_s': p50,
+            'num_waiting': len(self._waiting),
+            'num_active': sum(1 for r in self._slots if r is not None),
+        }

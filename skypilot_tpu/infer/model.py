@@ -1,0 +1,146 @@
+"""Prefill + decode paths over ``models/llama.py`` parameters.
+
+Same weights, two execution shapes:
+
+- **prefill**: the full prompt in one pass (MXU-bound, flash attention),
+  emitting every position's K/V for cache insertion plus the last
+  position's logits.
+- **decode**: ONE token for every slot in one fused step
+  (HBM-bandwidth-bound: the work is streaming the KV cache through the
+  chip once). Attention is computed dense over the static cache with a
+  length mask — at seq=1 there is nothing for a flash kernel to tile, so
+  the einsum form is the fast form.
+
+Both are pure functions jitted by the engine with buffer donation on the
+cache (XLA updates it in place).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.infer import cache as cache_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import norms
+from skypilot_tpu.ops import rope as rope_lib
+
+
+def prefill(config: llama.LlamaConfig, params: llama.Params,
+            tokens: jnp.ndarray, true_len: jnp.ndarray
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run the prompt; return (k [L,P,kv,hd], v [L,P,kv,hd],
+    last_logits [vocab]).
+
+    tokens: [P] int32, padded to a bucket size; true_len: scalar int32.
+    The pad tail's K/V are garbage but unreachable (cache lengths stop at
+    true_len); last_logits reads position true_len-1.
+    """
+    x = params['embed'][tokens][None]          # [1, P, d]
+    cos, sin = rope_lib.rope_frequencies(config.head_dim,
+                                         config.max_seq_len,
+                                         config.rope_theta)
+
+    def body(carry, layer):
+        h, kv = _prefill_layer(config, carry, layer, cos, sin)
+        return h, kv
+
+    x, (ks, vs) = jax.lax.scan(body, x, params['layers'])
+    x = norms.rms_norm(x, params['final_norm'], config.norm_eps)
+    last = jax.lax.dynamic_index_in_dim(x[0], true_len - 1, axis=0,
+                                        keepdims=False)
+    logits = (last @ params['lm_head']).astype(jnp.float32)
+    return ks, vs, logits
+
+
+def _prefill_layer(config, x, layer, cos, sin):
+    b, s, d = x.shape
+    hq, hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    h = norms.rms_norm(x, layer['attn_norm'], config.norm_eps)
+    q = (h @ layer['wq']).reshape(b, s, hq, hd)
+    k = (h @ layer['wk']).reshape(b, s, hkv, hd)
+    v = (h @ layer['wv']).reshape(b, s, hkv, hd)
+    q = rope_lib.apply_rope(q, cos, sin)
+    k = rope_lib.apply_rope(k, cos, sin)
+    from skypilot_tpu.ops import attention as attention_lib
+    att = attention_lib.attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal=True,
+        impl=config.attention_impl)
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, hq * hd)
+    x = x + att @ layer['wo']
+    h = norms.rms_norm(x, layer['mlp_norm'], config.norm_eps)
+    gate = jax.nn.silu(h @ layer['w_gate'])
+    x = x + (gate * (h @ layer['w_up'])) @ layer['w_down']
+    # [s, kv, hd] for the cache (batch=1 squeezed).
+    return x, (k[0], v[0])
+
+
+def decode_step(config: llama.LlamaConfig, params: llama.Params,
+                kv: cache_lib.KVCache, tokens: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, cache_lib.KVCache]:
+    """One decode token for every slot.
+
+    tokens: [slots] int32 (last sampled token per slot). Returns
+    (logits [slots, vocab] fp32, cache with K/V appended and lengths+1).
+    Inactive slots (length 0) compute garbage that the engine ignores —
+    uniform work keeps the step a single static program.
+    """
+    positions = kv.lengths                       # write offset = length
+    x = params['embed'][tokens][:, None]         # [slots, 1, d]
+    cos, sin = rope_lib.rope_frequencies(config.head_dim,
+                                         config.max_seq_len,
+                                         config.rope_theta)
+    S = kv.max_seq_len
+    # mask [slots, S]: attend to cached positions 0..len-1 plus the new
+    # token at position len.
+    mask = jnp.arange(S)[None, :] <= positions[:, None]
+
+    def body(carry, xs):
+        layer, k_layer, v_layer = xs
+        h, k_new, v_new = _decode_layer(config, carry, layer, cos, sin,
+                                        k_layer, v_layer, positions, mask)
+        return h, (k_new, v_new)
+
+    x, (k_upd, v_upd) = jax.lax.scan(
+        body, x, (params['layers'], kv.k, kv.v))
+    x = norms.rms_norm(x, params['final_norm'], config.norm_eps)
+    logits = (x[:, 0] @ params['lm_head']).astype(jnp.float32)
+    new_cache = cache_lib.KVCache(k=k_upd, v=v_upd,
+                                  lengths=kv.lengths + 1)
+    return logits, new_cache
+
+
+def _decode_layer(config, x, layer, cos, sin, k_cache, v_cache,
+                  positions, mask):
+    slots, _, d = x.shape
+    hq, hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    group = hq // hkv
+
+    h = norms.rms_norm(x, layer['attn_norm'], config.norm_eps)
+    q = (h @ layer['wq']).reshape(slots, 1, hq, hd)
+    k = (h @ layer['wk']).reshape(slots, 1, hkv, hd)
+    v = (h @ layer['wv']).reshape(slots, 1, hkv, hd)
+    q = rope_lib.apply_rope(q, cos, sin, positions[:, None])
+    k = rope_lib.apply_rope(k, cos, sin, positions[:, None])
+
+    # Write the new K/V into the cache FIRST, then attend over the cache —
+    # the new token sees itself through the mask (pos <= length).
+    k_cache, v_cache = cache_lib.append_token(
+        k_cache, v_cache, k[:, 0], v[:, 0], positions)
+
+    qg = q[:, 0].reshape(slots, hkv, group, hd).astype(jnp.float32)
+    kc = k_cache.astype(jnp.float32)             # [slots, S, kv, hd]
+    vc = v_cache.astype(jnp.float32)
+    scores = jnp.einsum('bkgd,bskd->bkgs', qg, kc) * (hd ** -0.5)
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    att = jnp.einsum('bkgs,bskd->bkgd', probs, vc)
+    att = att.reshape(slots, 1, hq * hd).astype(x.dtype)
+    x = x + att @ layer['wo']
+
+    h = norms.rms_norm(x, layer['mlp_norm'], config.norm_eps)
+    gate = jax.nn.silu(h @ layer['w_gate'])
+    x = x + (gate * (h @ layer['w_up'])) @ layer['w_down']
+    return x, k_cache, v_cache

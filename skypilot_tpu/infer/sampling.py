@@ -1,0 +1,35 @@
+"""Token sampling: greedy / temperature / top-k, jitted with the decode
+step so sampled ids (not logits) cross the host boundary — [slots] int32
+per step instead of [slots, vocab] fp32."""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0     # 0 → greedy
+    top_k: int = 0               # 0 → no truncation
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError('temperature must be >= 0')
+
+
+def sample(logits: jnp.ndarray, key: jax.Array,
+           temperature: jnp.ndarray, top_k: int = 0) -> jnp.ndarray:
+    """logits [slots, vocab], temperature [slots] → tokens [slots].
+
+    Per-slot temperature is a traced array (mixed greedy/sampled batches
+    in one compiled step); top_k is static (it changes the program).
+    """
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    greedy = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, logits / temp, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -1,0 +1,181 @@
+"""HTTP inference server — the workload `sky-tpu serve` replicas run.
+
+Endpoints (shape follows the reference's vLLM-serving examples,
+reference llm/vllm/serve.yaml):
+
+- ``GET  /health``     → 200 once the engine is warm (readiness probe).
+- ``POST /generate``   → {"prompt": str | "tokens": [int], and optional
+  "max_new_tokens", "temperature"} → completion JSON.
+- ``GET  /metrics``    → engine metrics (TTFT p50, decode throughput).
+
+A background thread drives ``engine.step()`` continuously; HTTP handlers
+only enqueue requests and wait — many concurrent requests batch onto the
+same decode steps (continuous batching).
+
+Without a real checkpoint the server runs randomly-initialized weights
+sized by ``--model`` (tiny/350m/8b) — enough for serving-layer load tests
+and TTFT benchmarking; ``--checkpoint`` loads Orbax weights from
+``train/checkpoint.py``.
+
+Run: ``python -m skypilot_tpu.infer.server --port $SKYPILOT_SERVE_PORT``
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import threading
+import time
+from typing import List
+
+import jax
+from aiohttp import web
+
+from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.models import llama
+
+logger = logging.getLogger(__name__)
+
+MODELS = {
+    'tiny': llama.LlamaConfig.tiny,
+    '350m': llama.LlamaConfig.bench_350m,
+    '8b': llama.LlamaConfig.llama3_8b,
+}
+
+
+def _encode(prompt: str) -> List[int]:
+    """Byte-level fallback tokenizer (real deployments pass `tokens`)."""
+    return list(prompt.encode('utf-8'))
+
+
+def _decode_bytes(tokens: List[int]) -> str:
+    try:
+        return bytes(t for t in tokens if 0 <= t < 256).decode(
+            'utf-8', errors='replace')
+    except ValueError:
+        return ''
+
+
+class InferenceServer:
+    def __init__(self, engine: engine_lib.InferenceEngine) -> None:
+        self.engine = engine
+        self.ready = False
+        self.dead: str = ''
+        self._stop = threading.Event()
+        self._woken = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name='engine-loop')
+
+    def _loop(self) -> None:
+        try:
+            # Warm the decode program once so /health flips only when
+            # real traffic would not hit a multi-second compile.
+            t0 = time.time()
+            warm = self.engine.submit([1], max_new_tokens=2)
+            while not warm.done:
+                self.engine.step()
+            logger.info('engine warm in %.1fs', time.time() - t0)
+            self.ready = True
+            while not self._stop.is_set():
+                if self.engine.step() == 0:
+                    # Idle: sleep until a request arrives.
+                    self._woken.wait(timeout=0.005)
+                    self._woken.clear()
+        except Exception as e:  # noqa: BLE001 — a dead loop must unready
+            logger.exception('engine loop died')
+            # /health flips to 503 so the serve layer replaces this
+            # replica instead of routing into a wedged engine.
+            self.dead = f'{type(e).__name__}: {e}'
+            self.ready = False
+
+    async def h_health(self, _req: web.Request) -> web.Response:
+        if self.dead:
+            return web.json_response(
+                {'status': 'dead', 'error': self.dead}, status=503)
+        if not self.ready:
+            return web.json_response({'status': 'warming'}, status=503)
+        return web.json_response({'status': 'ok'})
+
+    async def h_metrics(self, _req: web.Request) -> web.Response:
+        return web.json_response(self.engine.metrics())
+
+    async def h_generate(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response({'error': 'malformed JSON'},
+                                     status=400)
+        if 'tokens' in body:
+            tokens = [int(t) for t in body['tokens']]
+        elif 'prompt' in body:
+            tokens = _encode(str(body['prompt']))
+        else:
+            return web.json_response(
+                {'error': 'need "tokens" or "prompt"'}, status=400)
+        try:
+            req = self.engine.submit(
+                tokens,
+                max_new_tokens=body.get('max_new_tokens'),
+                temperature=float(body.get('temperature', 0.0)))
+        except ValueError as e:
+            return web.json_response({'error': str(e)}, status=400)
+        self._woken.set()
+        while not req.done:
+            if self.dead:
+                return web.json_response(
+                    {'error': f'engine died: {self.dead}'}, status=500)
+            await asyncio.sleep(0.005)
+        return web.json_response({
+            'request_id': req.request_id,
+            'tokens': req.output_tokens,
+            'text': _decode_bytes(req.output_tokens),
+            'finish_reason': req.finish_reason,
+            'ttft_s': req.ttft,
+        })
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get('/health', self.h_health)
+        app.router.add_get('/metrics', self.h_metrics)
+        app.router.add_post('/generate', self.h_generate)
+        return app
+
+    def run(self, host: str, port: int) -> None:
+        self._thread.start()
+        web.run_app(self.make_app(), host=host, port=port,
+                    print=lambda *_: None)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--host', default='0.0.0.0')
+    parser.add_argument('--port', type=int, required=True)
+    parser.add_argument('--model', default='tiny', choices=sorted(MODELS))
+    parser.add_argument('--checkpoint', default=None,
+                        help='Orbax checkpoint dir (train/checkpoint.py)')
+    parser.add_argument('--slots', type=int, default=8)
+    parser.add_argument('--max-seq-len', type=int, default=1024)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    config = MODELS[args.model]()
+    if args.checkpoint:
+        from skypilot_tpu.train import checkpoint as ckpt_lib
+        restored = ckpt_lib.CheckpointManager(args.checkpoint).restore()
+        # Accept either a bare params pytree or a full train state.
+        params = restored.get('params', restored) if isinstance(
+            restored, dict) else restored.params
+    else:
+        logger.warning('no --checkpoint: serving random weights (%s)',
+                       args.model)
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+    engine = engine_lib.InferenceEngine(
+        config, params,
+        engine_lib.EngineConfig(
+            n_slots=args.slots,
+            max_seq_len=min(args.max_seq_len, config.max_seq_len)))
+    InferenceServer(engine).run(args.host, args.port)
+
+
+if __name__ == '__main__':
+    main()

@@ -94,14 +94,14 @@ def _start_agent(cluster_name: str) -> None:
     agent_json = os.path.join(cdir, 'agent.json')
     if os.path.exists(agent_json):
         os.unlink(agent_json)
-    log = open(os.path.join(cdir, 'agent.log'), 'ab')
-    subprocess.Popen(
-        [sys.executable, '-m', 'skypilot_tpu.runtime.agent',
-         '--cluster-dir', cdir],
-        stdout=log, stderr=subprocess.STDOUT,
-        start_new_session=True,
-        env={**os.environ, 'JAX_PLATFORMS': 'cpu'},
-    )
+    with open(os.path.join(cdir, 'agent.log'), 'ab') as log:
+        subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.runtime.agent',
+             '--cluster-dir', cdir],
+            stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,
+            env={**os.environ, 'JAX_PLATFORMS': 'cpu'},
+        )
     deadline = time.time() + AGENT_START_TIMEOUT
     while time.time() < deadline:
         info = _agent_info(cdir)

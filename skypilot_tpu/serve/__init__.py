@@ -14,7 +14,6 @@ from __future__ import annotations
 import json
 import os
 import signal
-import socket
 import time
 from typing import Any, Dict, List, Optional
 
@@ -25,12 +24,7 @@ from skypilot_tpu.serve import service as service_lib
 from skypilot_tpu.serve import spec as spec_lib
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.serve.state import ReplicaStatus, ServiceStatus  # noqa: F401
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(('127.0.0.1', 0))
-        return s.getsockname()[1]
+from skypilot_tpu.utils import common
 
 
 def _validate(task: task_lib.Task) -> spec_lib.ServiceSpec:
@@ -54,7 +48,7 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
     """
     spec = _validate(task)
     name = service_name or task.name or 'service'
-    lb_port = _free_port()
+    lb_port = common.free_port()
     ok = serve_state.add_service(
         name, json.dumps(spec.to_config()), task.to_yaml(), lb_port,
         spec.load_balancing_policy)
@@ -87,7 +81,7 @@ def down(service_name: str, *, purge: bool = False,
         raise exceptions.JobNotFoundError(f'service {service_name!r}')
     serve_state.request_shutdown(service_name)
     pid = record.get('controller_pid')
-    alive = _pid_alive(pid)
+    alive = common.pid_alive(pid)
     if not alive or purge:
         # No controller to do it — clean up here.
         from skypilot_tpu.serve import replica_managers
@@ -114,16 +108,6 @@ def down(service_name: str, *, purge: bool = False,
         f'retry with purge=True to force')
 
 
-def _pid_alive(pid: Optional[int]) -> bool:
-    if not pid or pid <= 0:
-        return False
-    try:
-        os.kill(pid, 0)
-        return True
-    except (ProcessLookupError, PermissionError):
-        return False
-
-
 def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
     """Snapshot of one or all services (reference serve status)."""
     if service_name is not None:
@@ -131,8 +115,11 @@ def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
         if snap is None:
             raise exceptions.JobNotFoundError(f'service {service_name!r}')
         return [snap]
-    return [controller_lib.service_snapshot(s['name'])
-            for s in serve_state.get_services()]
+    snaps = (controller_lib.service_snapshot(s['name'])
+             for s in serve_state.get_services())
+    # A service removed between the listing and the snapshot read (e.g. a
+    # controller finishing `down`) yields None — drop it.
+    return [s for s in snaps if s is not None]
 
 
 def wait_ready(service_name: str, timeout: float = 300.0,

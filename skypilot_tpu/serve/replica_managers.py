@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
-import socket
 import time
 import urllib.error
 import urllib.request
@@ -33,6 +32,7 @@ from skypilot_tpu.serve import spec as spec_lib
 from skypilot_tpu.serve import spot_placer as spot_placer_lib
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.serve.state import ReplicaStatus
+from skypilot_tpu.utils import common
 
 logger = logging.getLogger(__name__)
 
@@ -45,10 +45,7 @@ MAX_CONSECUTIVE_LAUNCH_FAILURES = 3
 NOT_READY_TERMINATE_FACTOR = 5
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(('127.0.0.1', 0))
-        return s.getsockname()[1]
+_free_port = common.free_port
 
 
 class ReplicaManager:
@@ -160,8 +157,16 @@ class ReplicaManager:
 
     def terminate_all(self) -> None:
         for r in serve_state.get_replicas(self.service_name):
+            rid = r['replica_id']
             if r['status'] != ReplicaStatus.SHUTTING_DOWN:
-                self.terminate_replica(r['replica_id'], 'service down')
+                self.terminate_replica(rid, 'service down')
+            elif rid not in self._terminating:
+                # SHUTTING_DOWN row with no in-flight teardown: a previous
+                # controller died mid-teardown — finish the job here or
+                # the slice leaks after remove_service() drops the row.
+                fut = self._pool.submit(self._do_terminate, rid,
+                                        r['cluster_name'])
+                self._terminating[rid] = fut
         self.wait_terminations()
 
     def wait_terminations(self, timeout: float = 120.0) -> None:

@@ -53,15 +53,15 @@ def run_service(service_name: str) -> None:
 def spawn_detached(service_name: str) -> int:
     """Start the service process, detached; returns its pid."""
     import subprocess
-    log = open(serve_state.controller_log_path(service_name), 'ab')
-    proc = subprocess.Popen(
-        [sys.executable, '-m', 'skypilot_tpu.serve.service',
-         '--service-name', service_name],
-        stdout=log, stderr=subprocess.STDOUT,
-        start_new_session=True,
-        env={**os.environ, 'JAX_PLATFORMS': os.environ.get(
-            'JAX_PLATFORMS', 'cpu')},
-    )
+    with open(serve_state.controller_log_path(service_name), 'ab') as log:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.serve.service',
+             '--service-name', service_name],
+            stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,
+            env={**os.environ, 'JAX_PLATFORMS': os.environ.get(
+                'JAX_PLATFORMS', 'cpu')},
+        )
     return proc.pid
 
 

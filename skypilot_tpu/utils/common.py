@@ -67,3 +67,21 @@ def readable_time_duration(seconds: float) -> str:
     if seconds < 3600:
         return f'{seconds // 60}m {seconds % 60}s'
     return f'{seconds // 3600}h {(seconds % 3600) // 60}m'
+
+
+def free_port() -> int:
+    """An ephemeral port that was free at probe time."""
+    import socket
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def pid_alive(pid: int) -> bool:
+    if not pid or pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False

@@ -243,3 +243,36 @@ def test_up_rejects_duplicates_and_missing_spec():
                                              accelerators='v5e-4'))
     with pytest.raises(exceptions.InvalidTaskError):
         serve.up(plain, _spawn=False)
+
+
+def test_llm_inference_replica_e2e():
+    """Baseline config #4: the first-party continuous-batching inference
+    server as a serve replica, probed via /health and queried through the
+    replica URL."""
+    import json
+    import urllib.request as ur
+    task = sky.Task(
+        'llm-svc',
+        run=('exec python3 -m skypilot_tpu.infer.server '
+             '--port $SKYPILOT_SERVE_PORT --model tiny --slots 2 '
+             '--max-seq-len 64'),
+        resources=sky.Resources(cloud='local', accelerators='v5e-4'),
+        service={'readiness_probe': {'path': '/health',
+                                     'initial_delay_seconds': 60},
+                 'replicas': 1})
+    serve.up(task, _spawn=False)
+    ctl = controller_lib.ServeController('llm-svc')
+    _tick_until(ctl, lambda: _num_ready('llm-svc') >= 1, timeout=120)
+    [url] = serve_state.ready_replica_urls('llm-svc')
+    body = json.dumps({'tokens': [1, 2, 3],
+                       'max_new_tokens': 4}).encode()
+    req = ur.Request(url + '/generate', data=body,
+                     headers={'Content-Type': 'application/json'})
+    with ur.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read())
+    assert len(out['tokens']) == 4
+    assert out['ttft_s'] >= 0
+    with ur.urlopen(url + '/metrics', timeout=10) as resp:
+        m = json.loads(resp.read())
+    assert m['decode_tokens'] > 0
+    serve.down('llm-svc')
