@@ -20,6 +20,7 @@ target (BASELINE.md).
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import itertools
@@ -106,7 +107,9 @@ class InferenceEngine:
         self._decode_steps = 0
         self._decode_tokens = 0
         self._decode_time = 0.0
-        self._ttfts: List[float] = []
+        # Recent-window TTFTs: bounded so a long-lived replica's /metrics
+        # stays O(1) in memory and p50 reflects current behavior.
+        self._ttfts: collections.deque = collections.deque(maxlen=1024)
 
         # ---- compiled programs ------------------------------------------
         @functools.partial(jax.jit, static_argnums=(0,))
@@ -151,10 +154,14 @@ class InferenceEngine:
             raise ValueError(
                 f'prompt ({len(prompt_tokens)} tokens) exceeds cache '
                 f'capacity ({self.ecfg.max_seq_len - 1})')
+        if max_new_tokens is None:
+            max_new_tokens = self.ecfg.max_new_tokens
+        if max_new_tokens < 1:
+            raise ValueError('max_new_tokens must be >= 1')
         req = Request(
             request_id=next(self._ids),
             prompt_tokens=list(map(int, prompt_tokens)),
-            max_new_tokens=max_new_tokens or self.ecfg.max_new_tokens,
+            max_new_tokens=max_new_tokens,
             temperature=float(temperature))
         with self._lock:
             self._waiting.append(req)

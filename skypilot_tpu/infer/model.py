@@ -55,21 +55,7 @@ def prefill(config: llama.LlamaConfig, params: llama.Params,
 
 
 def _prefill_layer(config, x, layer, cos, sin):
-    b, s, d = x.shape
-    hq, hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
-    h = norms.rms_norm(x, layer['attn_norm'], config.norm_eps)
-    q = (h @ layer['wq']).reshape(b, s, hq, hd)
-    k = (h @ layer['wk']).reshape(b, s, hkv, hd)
-    v = (h @ layer['wv']).reshape(b, s, hkv, hd)
-    q = rope_lib.apply_rope(q, cos, sin)
-    k = rope_lib.apply_rope(k, cos, sin)
-    from skypilot_tpu.ops import attention as attention_lib
-    att = attention_lib.attention(
-        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3), causal=True,
-        impl=config.attention_impl)
-    att = att.transpose(0, 2, 1, 3).reshape(b, s, hq * hd)
-    x = x + att @ layer['wo']
+    x, k, v = llama.attention_block(config, x, layer, cos, sin, None)
     h = norms.rms_norm(x, layer['mlp_norm'], config.norm_eps)
     gate = jax.nn.silu(h @ layer['w_gate'])
     x = x + (gate * (h @ layer['w_up'])) @ layer['w_down']

@@ -29,6 +29,14 @@ from skypilot_tpu.ops import rope as rope_lib
 
 Params = Dict[str, Any]
 
+# Tree skeleton of one stacked layer group (leaves are placeholders) —
+# lets sharding/pipeline code tree_map PartitionSpecs over the layer dict
+# without materializing params.
+LLAMA_LAYER_TREE: Dict[str, int] = {
+    'attn_norm': 0, 'wq': 0, 'wk': 0, 'wv': 0, 'wo': 0,
+    'mlp_norm': 0, 'w_gate': 0, 'w_up': 0, 'w_down': 0,
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
@@ -121,9 +129,14 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Params:
     }
 
 
-def _layer(config: LlamaConfig, x: jnp.ndarray, layer: Params,
-           cos: jnp.ndarray, sin: jnp.ndarray,
-           positions: Optional[jnp.ndarray]) -> jnp.ndarray:
+def attention_block(config: LlamaConfig, x: jnp.ndarray, layer: Params,
+                    cos: jnp.ndarray, sin: jnp.ndarray,
+                    positions: Optional[jnp.ndarray]
+                    ) -> tuple:
+    """norm → QKV → RoPE → attention → residual. THE shared attention
+    block — MoE layers and the inference prefill path reuse it so the
+    attention math exists exactly once. Returns (x, k, v) with k/v
+    post-RoPE [b, s, kv_heads, head_dim] (cache insertion needs them)."""
     b, s, d = x.shape
     hq, hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
 
@@ -139,8 +152,13 @@ def _layer(config: LlamaConfig, x: jnp.ndarray, layer: Params,
         v.transpose(0, 2, 1, 3), causal=True,
         impl=config.attention_impl)
     att = att.transpose(0, 2, 1, 3).reshape(b, s, hq * hd)
-    x = x + att @ layer['wo']
+    return x + att @ layer['wo'], k, v
 
+
+def _layer(config: LlamaConfig, x: jnp.ndarray, layer: Params,
+           cos: jnp.ndarray, sin: jnp.ndarray,
+           positions: Optional[jnp.ndarray]) -> jnp.ndarray:
+    x, _, _ = attention_block(config, x, layer, cos, sin, positions)
     h = norms.rms_norm(x, layer['mlp_norm'], config.norm_eps)
     gate = jax.nn.silu(h @ layer['w_gate'])
     x = x + (gate * (h @ layer['w_up'])) @ layer['w_down']

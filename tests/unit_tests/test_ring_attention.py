@@ -4,7 +4,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from skypilot_tpu.parallel import shard_map
 
 from skypilot_tpu.ops import attention, ring_attention
 
