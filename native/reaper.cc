@@ -1,0 +1,108 @@
+// Orphan-process reaper: native watchdog for the on-host agent.
+//
+// Counterpart of the reference's sky/skylet/subprocess_daemon.py (:184) —
+// there a Python daemon polls for orphaned job processes. Here it is a
+// ~150-line C++ supervisor with zero Python runtime dependency: if the
+// agent is SIGKILLed or OOM-killed mid-job, the rank process groups it
+// spawned must not linger on the TPU host holding libtpu open (a leaked
+// rank wedges the whole chip for the next job).
+//
+// Protocol:
+//   reaper --parent-pid <pid> --pgid-file <path> [--poll-ms N]
+//
+// The agent appends one process-group id per line to <path> as it spawns
+// rank processes (and the file is truncated per job). The reaper polls
+// the parent pid; on parent death it SIGTERMs every recorded pgid, waits
+// a grace period, SIGKILLs survivors, then exits.
+//
+// Build: `make -C native` (g++ -O2, no deps) — or automatically via
+// skypilot_tpu/runtime/native_build.py on first use.
+
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <set>
+#include <string>
+#include <vector>
+
+#include <errno.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int kDefaultPollMs = 500;
+constexpr int kGraceMs = 5000;
+
+bool pid_alive(pid_t pid) {
+  if (kill(pid, 0) == 0) return true;
+  return errno == EPERM;  // exists but not ours — still alive
+}
+
+std::set<pid_t> read_pgids(const std::string& path) {
+  std::set<pid_t> pgids;
+  std::ifstream f(path);
+  std::string line;
+  while (std::getline(f, line)) {
+    if (line.empty()) continue;
+    char* end = nullptr;
+    long v = strtol(line.c_str(), &end, 10);
+    if (end != line.c_str() && v > 1) pgids.insert(static_cast<pid_t>(v));
+  }
+  return pgids;
+}
+
+// Signal every recorded process group; returns groups that still exist.
+std::set<pid_t> signal_groups(const std::set<pid_t>& pgids, int sig) {
+  std::set<pid_t> alive;
+  for (pid_t pg : pgids) {
+    if (killpg(pg, sig) == 0 || errno == EPERM) alive.insert(pg);
+    // ESRCH: already gone — drop it.
+  }
+  return alive;
+}
+
+void msleep(int ms) { usleep(static_cast<useconds_t>(ms) * 1000); }
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  pid_t parent = 0;
+  std::string pgid_file;
+  int poll_ms = kDefaultPollMs;
+
+  for (int i = 1; i + 1 < argc; i += 2) {
+    if (!strcmp(argv[i], "--parent-pid")) {
+      parent = static_cast<pid_t>(atoi(argv[i + 1]));
+    } else if (!strcmp(argv[i], "--pgid-file")) {
+      pgid_file = argv[i + 1];
+    } else if (!strcmp(argv[i], "--poll-ms")) {
+      poll_ms = atoi(argv[i + 1]);
+    }
+  }
+  if (parent <= 0 || pgid_file.empty()) {
+    fprintf(stderr,
+            "usage: reaper --parent-pid P --pgid-file F [--poll-ms N]\n");
+    return 2;
+  }
+
+  // Detach from the agent's group so the agent's own death (or a blanket
+  // killpg on its group) does not take the reaper down with it.
+  setsid();
+
+  while (pid_alive(parent)) msleep(poll_ms);
+
+  std::set<pid_t> pgids = read_pgids(pgid_file);
+  if (pgids.empty()) return 0;
+
+  std::set<pid_t> alive = signal_groups(pgids, SIGTERM);
+  int waited = 0;
+  while (!alive.empty() && waited < kGraceMs) {
+    msleep(poll_ms);
+    waited += poll_ms;
+    alive = signal_groups(alive, 0);  // liveness probe
+  }
+  if (!alive.empty()) signal_groups(alive, SIGKILL);
+  return 0;
+}

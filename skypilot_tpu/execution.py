@@ -28,8 +28,10 @@ from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import state
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu import usage
 from skypilot_tpu.utils import common
 from skypilot_tpu.utils import locks
+from skypilot_tpu.utils import timeline
 
 logger = logging.getLogger(__name__)
 
@@ -68,6 +70,8 @@ def _existing_cluster_info(
     return ClusterInfo.from_dict(record['cluster_info'])
 
 
+@usage.entrypoint(name='launch')
+@timeline.event(name='execution.launch')
 def launch(
     task: task_lib.Task,
     cluster_name: Optional[str] = None,
@@ -151,6 +155,8 @@ def _failover_candidates(
     return out
 
 
+@usage.entrypoint(name='exec')
+@timeline.event(name='execution.exec')
 def exec(  # noqa: A001 — mirrors the reference's public name
     task: task_lib.Task,
     cluster_name: str,

@@ -1,0 +1,142 @@
+"""ResNet (v1.5) in pure JAX — the vision workload for DDP baselines.
+
+BASELINE.md config #2 replaces the reference's
+``examples/resnet_distributed_torch.yaml`` (torchrun DDP) with a
+TPU-first equivalent. Design notes:
+
+- Pure-JAX pytree params like the other model families (no flax module
+  state to thread through pjit).
+- **GroupNorm instead of BatchNorm**: no running statistics means the
+  model stays a pure function — no cross-replica stat sync, no
+  train/eval mode flag — and GN matches BN accuracy at ResNet scale.
+- NHWC layout + lax.conv_general_dilated: the layout XLA:TPU prefers
+  (channels minor → MXU-friendly im2col).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)     # resnet18
+    num_classes: int = 1000
+    width: int = 64
+    groups: int = 32                                # groupnorm groups
+    dtype: str = 'bfloat16'
+
+    @staticmethod
+    def resnet18(**kw) -> 'ResNetConfig':
+        return ResNetConfig(**kw)
+
+    @staticmethod
+    def resnet50(**kw) -> 'ResNetConfig':
+        base = dict(stage_sizes=(3, 4, 6, 3))
+        base.update(kw)
+        return ResNetConfig(**base)
+
+    @staticmethod
+    def tiny(**kw) -> 'ResNetConfig':
+        base = dict(stage_sizes=(1, 1), num_classes=10, width=8,
+                    groups=4, dtype='float32')
+        base.update(kw)
+        return ResNetConfig(**base)
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    w = jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+    return (w * (2.0 / fan_in) ** 0.5).astype(dtype)
+
+
+def init_params(config: ResNetConfig, key: jax.Array) -> Params:
+    dtype = jnp.dtype(config.dtype)
+    keys = iter(jax.random.split(key, 256))
+    w = config.width
+    params: Params = {
+        'stem': _conv_init(next(keys), 7, 7, 3, w, dtype),
+        'stem_gn': {'scale': jnp.ones((w,), dtype),
+                    'bias': jnp.zeros((w,), dtype)},
+        'stages': [],
+    }
+    cin = w
+    for i, blocks in enumerate(config.stage_sizes):
+        cout = w * (2 ** i)
+        stage: List[Dict[str, Any]] = []
+        for b in range(blocks):
+            stride = 2 if (b == 0 and i > 0) else 1
+            # stride is derived from block position in forward(), never a
+            # pytree leaf (int leaves break grad/tree_map).
+            block = {
+                'conv1': _conv_init(next(keys), 3, 3, cin, cout, dtype),
+                'gn1': {'scale': jnp.ones((cout,), dtype),
+                        'bias': jnp.zeros((cout,), dtype)},
+                'conv2': _conv_init(next(keys), 3, 3, cout, cout, dtype),
+                'gn2': {'scale': jnp.ones((cout,), dtype),
+                        'bias': jnp.zeros((cout,), dtype)},
+            }
+            if stride != 1 or cin != cout:
+                block['proj'] = _conv_init(next(keys), 1, 1, cin, cout,
+                                           dtype)
+            stage.append(block)
+            cin = cout
+        params['stages'].append(stage)
+    params['head'] = (jax.random.normal(
+        next(keys), (cin, config.num_classes), jnp.float32)
+        * cin ** -0.5).astype(dtype)
+    return params
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding='SAME',
+        dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+
+
+def _group_norm(x, gn, groups, eps=1e-5):
+    n, h, w, c = x.shape
+    g = min(groups, c)
+    xf = x.astype(jnp.float32).reshape(n, h, w, g, c // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    xf = xf.reshape(n, h, w, c)
+    return (xf * gn['scale'].astype(jnp.float32)
+            + gn['bias'].astype(jnp.float32)).astype(x.dtype)
+
+
+def forward(config: ResNetConfig, params: Params,
+            images: jnp.ndarray) -> jnp.ndarray:
+    """images [n, h, w, 3] -> logits [n, classes] (fp32)."""
+    gn = functools.partial(_group_norm, groups=config.groups)
+    x = images.astype(jnp.dtype(config.dtype))
+    x = _conv(x, params['stem'], stride=2)
+    x = jax.nn.relu(gn(x, params['stem_gn']))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), 'SAME')
+    for i, stage in enumerate(params['stages']):
+        for b, block in enumerate(stage):
+            stride = 2 if (b == 0 and i > 0) else 1
+            h = jax.nn.relu(gn(_conv(x, block['conv1'], stride),
+                               block['gn1']))
+            h = gn(_conv(h, block['conv2']), block['gn2'])
+            shortcut = (_conv(x, block['proj'], stride)
+                        if 'proj' in block else x)
+            x = jax.nn.relu(shortcut + h)
+    x = x.mean(axis=(1, 2))                        # global avg pool
+    return (x @ params['head']).astype(jnp.float32)
+
+
+def loss_fn(config: ResNetConfig, params: Params, images: jnp.ndarray,
+            labels: jnp.ndarray) -> jnp.ndarray:
+    logits = forward(config, params, images)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None],
+                                         axis=-1))

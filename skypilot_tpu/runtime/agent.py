@@ -69,6 +69,32 @@ class Agent:
         # job_id -> list of subprocess handles (local-slice mode)
         self._procs: Dict[int, List[asyncio.subprocess.Process]] = {}
         self._cancelled: set = set()
+        # Native orphan reaper (native/reaper.cc): if this agent is
+        # SIGKILLed mid-job, the rank process groups recorded in the
+        # pgid file are torn down so no leaked rank wedges the TPU chip
+        # (reference subprocess_daemon.py:184, rebuilt native).
+        self._pgid_file = os.path.join(self.cluster_dir, 'job_pgids')
+        open(self._pgid_file, 'w', encoding='utf-8').close()
+        self._start_reaper()
+
+    def _start_reaper(self) -> None:
+        import subprocess as sp
+
+        from skypilot_tpu.runtime import native_build
+        reaper = native_build.ensure_reaper()
+        if reaper is None:
+            return
+        sp.Popen([reaper, '--parent-pid', str(os.getpid()),
+                  '--pgid-file', self._pgid_file],
+                 stdout=sp.DEVNULL, stderr=sp.DEVNULL,
+                 start_new_session=True)
+
+    def _record_pgid(self, pid: int) -> None:
+        try:
+            with open(self._pgid_file, 'a', encoding='utf-8') as f:
+                f.write(f'{pid}\n')
+        except OSError:
+            pass
 
     # ---------------- job execution --------------------------------------
     def _rank_env(self, rank: int, job_envs: Dict[str, str],
@@ -117,6 +143,8 @@ class Agent:
                 start_new_session=True,
             )
         self._procs.setdefault(job_id, []).append(proc)
+        # start_new_session=True → the child's pgid is its pid.
+        self._record_pgid(proc.pid)
         return await proc.wait()
 
     async def _run_job(self, job: Dict[str, Any]) -> None:

@@ -1,0 +1,64 @@
+"""Build-on-first-use for the native runtime components (native/*.cc).
+
+TPU hosts get the framework via pip/rsync, not a container image with
+prebuilt binaries, so natives compile lazily with the host toolchain
+(g++ is universally present on TPU VM images) and cache under
+``$SKY_TPU_HOME/bin``. A missing toolchain degrades gracefully: callers
+treat ``None`` as "native unavailable" and fall back to pure-Python
+behavior.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+from skypilot_tpu.utils import common
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), 'native')
+
+
+def _bin_dir() -> str:
+    d = os.path.join(common.base_dir(), 'bin')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def ensure_binary(name: str) -> Optional[str]:
+    """Path to the compiled native binary, building if needed.
+
+    Cache key includes the source hash so edited sources rebuild.
+    Returns None when the source or a C++ toolchain is unavailable.
+    """
+    src = os.path.join(_NATIVE_DIR, f'{name}.cc')
+    if not os.path.exists(src):
+        return None
+    with open(src, 'rb') as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    out = os.path.join(_bin_dir(), f'{name}-{digest}')
+    if os.path.exists(out):
+        return out
+    cxx = shutil.which('g++') or shutil.which('c++')
+    if cxx is None:
+        logger.warning('no C++ toolchain; native %s unavailable', name)
+        return None
+    tmp = out + '.tmp'
+    proc = subprocess.run(
+        [cxx, '-O2', '-std=c++17', '-o', tmp, src],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        logger.warning('building native %s failed:\n%s', name,
+                       proc.stderr)
+        return None
+    os.replace(tmp, out)   # atomic: concurrent builders race safely
+    return out
+
+
+def ensure_reaper() -> Optional[str]:
+    return ensure_binary('reaper')

@@ -27,6 +27,7 @@ import logging
 import os
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict
@@ -36,6 +37,7 @@ from aiohttp import web
 from skypilot_tpu import core
 from skypilot_tpu import exceptions
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.server import metrics as metrics_lib
 from skypilot_tpu.server.requests_store import RequestStatus, RequestStore
 from skypilot_tpu.utils import common
 
@@ -99,6 +101,9 @@ class Server:
         req = self.store.get(request_id)
         log_path = req['log_path']
         self.store.set_status(request_id, RequestStatus.RUNNING)
+        metrics_lib.inflight(+1)
+        t0 = time.monotonic()
+        status = 'succeeded'
         try:
             with open(log_path, 'a', encoding='utf-8') as logf:
                 self._stdout_router.register(logf)
@@ -111,11 +116,16 @@ class Server:
             self.store.set_status(request_id, RequestStatus.SUCCEEDED,
                                   result=result)
         except Exception as e:  # noqa: BLE001 — errors go to the client
+            status = 'failed'
             with open(log_path, 'a', encoding='utf-8') as logf:
                 traceback.print_exc(file=logf)
             self.store.set_status(
                 request_id, RequestStatus.FAILED,
                 error=f'{type(e).__name__}: {e}')
+        finally:
+            metrics_lib.inflight(-1)
+            metrics_lib.observe_request(req['name'], status,
+                                        time.monotonic() - t0)
 
     def submit(self, name: str, payload: Dict[str, Any],
                fn: Callable[[], Any]) -> str:
@@ -349,9 +359,16 @@ class Server:
     async def h_requests(self, _req: web.Request) -> web.Response:
         return web.json_response({'requests': self.store.list_requests()})
 
+    async def h_metrics(self, _req: web.Request) -> web.Response:
+        """Prometheus exposition (reference /metrics, server/metrics.py
+        :189)."""
+        return web.Response(text=metrics_lib.render(),
+                            content_type='text/plain')
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get('/api/health', self.h_health)
+        app.router.add_get('/metrics', self.h_metrics)
         app.router.add_get('/api/requests', self.h_requests)
         app.router.add_get('/api/get/{request_id}', self.h_get)
         app.router.add_get('/api/stream/{request_id}', self.h_stream)

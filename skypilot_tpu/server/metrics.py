@@ -1,0 +1,138 @@
+"""Prometheus metrics for the API server — no client library needed.
+
+Counterpart of the reference's ``sky/server/metrics.py``
+(PrometheusMiddleware :358, /metrics endpoint :189). The exposition
+format is a stable text protocol, so a ~100-line registry beats a
+dependency: counters + histograms keyed by label tuples, rendered on
+scrape. Tracked, mirroring the reference:
+
+- ``sky_tpu_requests_total{op,status}`` — every executed API request.
+- ``sky_tpu_request_duration_seconds{op}`` — histogram.
+- ``sky_tpu_requests_in_flight`` — gauge.
+- ``sky_tpu_process_*`` — RSS / cpu seconds / uptime.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Tuple
+
+_BUCKETS = (0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 300.0, float('inf'))
+_started_at = time.time()
+
+
+class _Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple], float] = {}
+        self._hist: Dict[Tuple[str, Tuple], List[float]] = {}
+        self._hist_sum: Dict[Tuple[str, Tuple], float] = {}
+        self._gauges: Dict[Tuple[str, Tuple], float] = {}
+
+    def inc(self, name: str, labels: Tuple = (), by: float = 1.0) -> None:
+        with self._lock:
+            key = (name, labels)
+            self._counters[key] = self._counters.get(key, 0.0) + by
+
+    def gauge_add(self, name: str, by: float,
+                  labels: Tuple = ()) -> None:
+        with self._lock:
+            key = (name, labels)
+            self._gauges[key] = self._gauges.get(key, 0.0) + by
+
+    def gauge_set(self, name: str, value: float,
+                  labels: Tuple = ()) -> None:
+        with self._lock:
+            self._gauges[(name, labels)] = value
+
+    def observe(self, name: str, value: float,
+                labels: Tuple = ()) -> None:
+        with self._lock:
+            key = (name, labels)
+            if key not in self._hist:
+                self._hist[key] = [0.0] * len(_BUCKETS)
+                self._hist_sum[key] = 0.0
+            for i, b in enumerate(_BUCKETS):
+                if value <= b:
+                    self._hist[key][i] += 1
+            self._hist_sum[key] += value
+
+    # ---- exposition ------------------------------------------------------
+    @staticmethod
+    def _fmt_labels(label_names: Tuple, labels: Tuple) -> str:
+        if not labels:
+            return ''
+        pairs = ','.join(f'{n}="{v}"'
+                         for n, v in zip(label_names, labels))
+        return '{' + pairs + '}'
+
+    def render(self) -> str:
+        self._collect_process()
+        out: List[str] = []
+        with self._lock:
+            for (name, labels), val in sorted(self._counters.items()):
+                names = _LABEL_NAMES.get(name, ())
+                out.append(f'{name}{self._fmt_labels(names, labels)} '
+                           f'{val}')
+            for (name, labels), val in sorted(self._gauges.items()):
+                names = _LABEL_NAMES.get(name, ())
+                out.append(f'{name}{self._fmt_labels(names, labels)} '
+                           f'{val}')
+            for (name, labels), counts in sorted(self._hist.items()):
+                names = _LABEL_NAMES.get(name, ())
+                cum = 0.0
+                for b, c in zip(_BUCKETS, counts):
+                    cum = c  # counts already cumulative per bucket
+                    le = '+Inf' if b == float('inf') else repr(b)
+                    lbl = self._fmt_labels(names + ('le',),
+                                           labels + (le,))
+                    out.append(f'{name}_bucket{lbl} {c}')
+                out.append(
+                    f'{name}_sum'
+                    f'{self._fmt_labels(names, labels)} '
+                    f'{self._hist_sum[(name, labels)]}')
+                out.append(
+                    f'{name}_count'
+                    f'{self._fmt_labels(names, labels)} {cum}')
+        return '\n'.join(out) + '\n'
+
+    def _collect_process(self) -> None:
+        self.gauge_set('sky_tpu_process_uptime_seconds',
+                       time.time() - _started_at)
+        try:
+            with open(f'/proc/{os.getpid()}/statm',
+                      encoding='utf-8') as f:
+                rss_pages = int(f.read().split()[1])
+            self.gauge_set('sky_tpu_process_resident_memory_bytes',
+                           rss_pages * os.sysconf('SC_PAGE_SIZE'))
+        except (OSError, ValueError, IndexError):
+            pass
+        try:
+            cpu = os.times()
+            self.gauge_set('sky_tpu_process_cpu_seconds_total',
+                           cpu.user + cpu.system)
+        except OSError:
+            pass
+
+
+_LABEL_NAMES = {
+    'sky_tpu_requests_total': ('op', 'status'),
+    'sky_tpu_request_duration_seconds': ('op',),
+}
+
+registry = _Registry()
+
+
+def observe_request(op: str, status: str, duration_s: float) -> None:
+    registry.inc('sky_tpu_requests_total', (op, status))
+    registry.observe('sky_tpu_request_duration_seconds', duration_s,
+                     (op,))
+
+
+def inflight(delta: int) -> None:
+    registry.gauge_add('sky_tpu_requests_in_flight', delta)
+
+
+def render() -> str:
+    return registry.render()

@@ -1,0 +1,90 @@
+"""Usage telemetry: local, append-only, opt-out.
+
+Counterpart of the reference's ``sky/usage/usage_lib.py`` (messages +
+heartbeats shipped to a hosted Loki, ``_send_to_loki`` :427, the
+``@usage_lib.entrypoint`` decorator :615). This environment has zero
+egress, so the same record stream lands in
+``~/.sky_tpu/usage/usage.jsonl`` — one JSON line per entrypoint call with
+op name, duration, outcome, and framework version. A deployment that
+wants central collection points ``SKY_TPU_USAGE_SINK`` at a different
+writable path (or a future HTTP sink). ``SKY_TPU_DISABLE_USAGE=1`` turns
+it off entirely.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu.utils import common
+
+DISABLE_ENV = 'SKY_TPU_DISABLE_USAGE'
+SINK_ENV = 'SKY_TPU_USAGE_SINK'
+
+_run_id = uuid.uuid4().hex[:12]
+
+
+def disabled() -> bool:
+    return os.environ.get(DISABLE_ENV, '').lower() in ('1', 'true')
+
+
+def _sink_path() -> str:
+    custom = os.environ.get(SINK_ENV)
+    if custom:
+        return custom
+    d = os.path.join(common.base_dir(), 'usage')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'usage.jsonl')
+
+
+def record(op: str, duration_s: float, outcome: str,
+           extra: Optional[Dict[str, Any]] = None) -> None:
+    if disabled():
+        return
+    import skypilot_tpu
+    line = {
+        'ts': time.time(),
+        'run_id': _run_id,
+        'op': op,
+        'duration_s': round(duration_s, 4),
+        'outcome': outcome,
+        'version': skypilot_tpu.__version__,
+    }
+    if extra:
+        line.update(extra)
+    try:
+        with open(_sink_path(), 'a', encoding='utf-8') as f:
+            f.write(json.dumps(line) + '\n')
+    except OSError:
+        pass   # telemetry must never break the product
+
+
+def entrypoint(fn: Callable = None, *,
+               name: Optional[str] = None) -> Callable:
+    """Decorator recording each call (reference @usage_lib.entrypoint)."""
+    def wrap(f: Callable) -> Callable:
+        op = name or f.__qualname__
+
+        @functools.wraps(f)
+        def inner(*a, **kw):
+            t0 = time.time()
+            try:
+                result = f(*a, **kw)
+            except BaseException as e:
+                record(op, time.time() - t0,
+                       f'error:{type(e).__name__}')
+                raise
+            record(op, time.time() - t0, 'ok')
+            return result
+        return inner
+
+    return wrap(fn) if fn is not None else wrap
+
+
+def heartbeat() -> None:
+    """Periodic liveness record (reference UsageHeartbeatReportEvent,
+    sky/skylet/events.py:153); called by server daemons."""
+    record('heartbeat', 0.0, 'ok')

@@ -1,0 +1,102 @@
+"""Chrome trace-event recorder (opt-in profiling of the control plane).
+
+Counterpart of the reference's ``sky/utils/timeline.py`` (enabled via
+SKYPILOT_TIMELINE_FILE_PATH, :19-21; ``@timeline.event`` decorating
+entrypoints like sky/execution.py:597). Same contract here:
+
+    SKY_TPU_TIMELINE_FILE=/tmp/trace.json sky-tpu launch ...
+
+then load the file in chrome://tracing or Perfetto. Events are complete
+("X") trace events with thread/process ids, flushed on process exit.
+Zero overhead when the env var is unset (decorator returns fn unchanged
+at decoration time).
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+ENV_VAR = 'SKY_TPU_TIMELINE_FILE'
+
+_events: List[Dict[str, Any]] = []
+_lock = threading.Lock()
+_registered = False
+
+
+def enabled() -> bool:
+    return bool(os.environ.get(ENV_VAR))
+
+
+def _ensure_flush_registered() -> None:
+    global _registered
+    if not _registered:
+        atexit.register(save)
+        _registered = True
+
+
+def record(name: str, start_us: float, dur_us: float,
+           args: Optional[Dict[str, Any]] = None) -> None:
+    if not enabled():
+        return
+    _ensure_flush_registered()
+    ev = {
+        'name': name, 'ph': 'X', 'ts': start_us, 'dur': dur_us,
+        'pid': os.getpid(), 'tid': threading.get_ident(),
+    }
+    if args:
+        ev['args'] = args
+    with _lock:
+        _events.append(ev)
+
+
+class Event:
+    """Context manager form: ``with timeline.Event('provision'): ...``"""
+
+    def __init__(self, name: str, **args: Any) -> None:
+        self.name = name
+        self.args = args or None
+        self._t0 = 0.0
+
+    def __enter__(self) -> 'Event':
+        self._t0 = time.perf_counter_ns() / 1e3
+        return self
+
+    def __exit__(self, *exc) -> None:
+        record(self.name, self._t0,
+               time.perf_counter_ns() / 1e3 - self._t0, self.args)
+
+
+def event(fn: Callable = None, *, name: Optional[str] = None) -> Callable:
+    """Decorator: trace every call of fn. No-op unless enabled at
+    decoration time (matching the reference's zero-cost default)."""
+    def wrap(f: Callable) -> Callable:
+        if not enabled():
+            return f
+        label = name or f'{f.__module__}.{f.__qualname__}'
+
+        @functools.wraps(f)
+        def inner(*a, **kw):
+            with Event(label):
+                return f(*a, **kw)
+        return inner
+
+    return wrap(fn) if fn is not None else wrap
+
+
+def save(path: Optional[str] = None) -> Optional[str]:
+    """Write accumulated events as a Chrome trace JSON; returns path."""
+    path = path or os.environ.get(ENV_VAR)
+    if not path:
+        return None
+    with _lock:
+        events = list(_events)
+    if not events:
+        return None
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+    return path

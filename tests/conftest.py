@@ -40,3 +40,37 @@ def sky_tpu_home(tmp_path, monkeypatch):
     if clusters.is_dir():
         for agent_json in clusters.glob('*/agent.json'):
             local_instance._kill_agent(str(agent_json.parent), timeout=1.0)
+
+
+@pytest.fixture
+def api_server(sky_tpu_home, monkeypatch):
+    """A real API server subprocess on an isolated SKY_TPU_HOME."""
+    import subprocess
+    import sys
+    import time
+
+    import requests
+
+    from skypilot_tpu.utils import common as common_lib
+    port = common_lib.free_port()
+    url = f'http://127.0.0.1:{port}'
+    with open(os.path.join(sky_tpu_home, 'api_server.log'), 'ab') as log:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.server.app',
+             '--host', '127.0.0.1', '--port', str(port)],
+            stdout=log, stderr=subprocess.STDOUT,
+            env={**os.environ, 'SKY_TPU_HOME': sky_tpu_home})
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            if requests.get(f'{url}/api/health', timeout=1).ok:
+                break
+        except requests.RequestException:
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        raise RuntimeError('API server did not start')
+    monkeypatch.setenv('SKY_TPU_API_SERVER', url)
+    yield url
+    proc.terminate()
+    proc.wait(timeout=10)

@@ -15,32 +15,6 @@ import requests
 from skypilot_tpu.utils import common
 
 
-@pytest.fixture
-def api_server(sky_tpu_home, monkeypatch):
-    port = 46591
-    url = f'http://127.0.0.1:{port}'
-    log = open(os.path.join(sky_tpu_home, 'api_server.log'), 'ab')
-    proc = subprocess.Popen(
-        [sys.executable, '-m', 'skypilot_tpu.server.app',
-         '--host', '127.0.0.1', '--port', str(port)],
-        stdout=log, stderr=subprocess.STDOUT,
-        env={**os.environ, 'SKY_TPU_HOME': sky_tpu_home})
-    deadline = time.time() + 20
-    while time.time() < deadline:
-        try:
-            if requests.get(f'{url}/api/health', timeout=1).ok:
-                break
-        except requests.RequestException:
-            time.sleep(0.2)
-    else:
-        proc.kill()
-        raise RuntimeError('API server did not start')
-    monkeypatch.setenv('SKY_TPU_API_SERVER', url)
-    yield url
-    proc.terminate()
-    proc.wait(timeout=10)
-
-
 def test_health_and_launch_roundtrip(api_server):
     from skypilot_tpu import Resources, Task
     from skypilot_tpu.client import sdk

@@ -1,0 +1,100 @@
+"""Native reaper: orphaned job process groups die with the agent.
+
+Drives the real compiled binary (native/reaper.cc): a fake "agent"
+process spawns a long-running job in its own process group, records the
+pgid, and is then SIGKILLed — the reaper must tear the job down.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu.runtime import native_build
+from skypilot_tpu.utils import common
+
+
+def _alive(pid):
+    return common.pid_alive(pid)
+
+
+@pytest.fixture
+def reaper_bin():
+    path = native_build.ensure_binary('reaper')
+    if path is None:
+        pytest.skip('no C++ toolchain available')
+    return path
+
+
+def test_build_is_cached(reaper_bin):
+    # Second call within the same home hits the hash-keyed cache.
+    again = native_build.ensure_binary('reaper')
+    assert again == reaper_bin
+    assert os.access(reaper_bin, os.X_OK)
+
+
+def test_reaper_kills_orphans_on_parent_death(reaper_bin, tmp_path):
+    pgid_file = tmp_path / 'pgids'
+    pgid_file.write_text('')
+
+    # Fake agent: stays alive until killed.
+    agent = subprocess.Popen([sys.executable, '-c',
+                              'import time; time.sleep(600)'])
+    # Job process in its own group (as the real agent spawns ranks).
+    job = subprocess.Popen([sys.executable, '-c',
+                            'import time; time.sleep(600)'],
+                           start_new_session=True)
+    pgid_file.write_text(f'{job.pid}\n')
+
+    reaper = subprocess.Popen(
+        [reaper_bin, '--parent-pid', str(agent.pid),
+         '--pgid-file', str(pgid_file), '--poll-ms', '100'])
+    try:
+        time.sleep(0.5)
+        assert _alive(job.pid)          # nothing reaped while agent lives
+
+        agent.kill()                    # SIGKILL: no cleanup handlers run
+        agent.wait()
+        deadline = time.time() + 10
+        # poll(), not kill(pid, 0): the dead job is a zombie until this
+        # test (its parent) reaps it, and zombies still answer signal 0.
+        while time.time() < deadline and job.poll() is None:
+            time.sleep(0.2)
+        assert job.poll() is not None, 'orphan survived the reaper'
+        assert job.returncode == -signal.SIGTERM
+        assert reaper.wait(timeout=10) == 0
+    finally:
+        for p in (job, reaper):
+            if p.poll() is None:
+                p.kill()
+        if job.poll() is None:
+            job.wait()
+
+
+def test_reaper_exits_clean_with_no_jobs(reaper_bin, tmp_path):
+    pgid_file = tmp_path / 'pgids'
+    pgid_file.write_text('')
+    agent = subprocess.Popen([sys.executable, '-c', 'pass'])
+    agent.wait()
+    reaper = subprocess.Popen(
+        [reaper_bin, '--parent-pid', str(agent.pid),
+         '--pgid-file', str(pgid_file), '--poll-ms', '50'])
+    assert reaper.wait(timeout=10) == 0
+
+
+def test_agent_records_pgids_and_reaper_spawns(sky_tpu_home):
+    """The real agent starts a reaper and records rank pgids."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import core
+
+    task = sky.Task('reap', run='sleep 0.1',
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4'))
+    _, info = core.launch(task, cluster_name='reap-c', quiet=True)
+    core.wait_job('reap-c', 1, timeout=60)
+    cdir = os.path.join(sky_tpu_home, 'clusters', 'reap-c')
+    pgids = open(os.path.join(cdir, 'job_pgids')).read().split()
+    assert len(pgids) >= 1          # one rank recorded
+    core.down('reap-c')

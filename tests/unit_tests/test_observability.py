@@ -1,0 +1,89 @@
+"""Timeline tracing, Prometheus metrics, usage telemetry."""
+import json
+import os
+import urllib.request
+
+from skypilot_tpu import usage
+from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.utils import timeline
+
+
+def test_timeline_records_and_saves(tmp_path, monkeypatch):
+    trace = tmp_path / 'trace.json'
+    monkeypatch.setenv(timeline.ENV_VAR, str(trace))
+    with timeline.Event('phase-one', detail='x'):
+        pass
+
+    @timeline.event(name='decorated')
+    def work():
+        return 42
+
+    assert work() == 42
+    assert timeline.save() == str(trace)
+    data = json.loads(trace.read_text())
+    names = [e['name'] for e in data['traceEvents']]
+    assert 'phase-one' in names and 'decorated' in names
+    ev = data['traceEvents'][0]
+    assert ev['ph'] == 'X' and ev['dur'] >= 0
+
+
+def test_timeline_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv(timeline.ENV_VAR, raising=False)
+    before = len(timeline._events)  # noqa: SLF001
+    with timeline.Event('ignored'):
+        pass
+    assert len(timeline._events) == before  # noqa: SLF001
+    assert timeline.save() is None
+
+
+def test_metrics_render_counters_and_histogram():
+    metrics_lib.observe_request('launch', 'succeeded', 0.8)
+    metrics_lib.observe_request('launch', 'failed', 12.0)
+    metrics_lib.inflight(+1)
+    text = metrics_lib.render()
+    assert ('sky_tpu_requests_total{op="launch",status="succeeded"}'
+            in text)
+    assert 'sky_tpu_request_duration_seconds_bucket' in text
+    assert 'le="+Inf"' in text
+    assert 'sky_tpu_process_uptime_seconds' in text
+    metrics_lib.inflight(-1)
+    # Histogram invariant: +Inf bucket == count.
+    lines = dict(
+        l.rsplit(' ', 1) for l in text.splitlines() if ' ' in l)
+    inf = lines['sky_tpu_request_duration_seconds_bucket'
+                '{op="launch",le="+Inf"}']
+    cnt = lines['sky_tpu_request_duration_seconds_count{op="launch"}']
+    assert inf == cnt
+
+
+def test_metrics_endpoint_on_server(api_server):
+    with urllib.request.urlopen(f'{api_server}/metrics',
+                                timeout=10) as resp:
+        body = resp.read().decode()
+    assert 'sky_tpu_process_uptime_seconds' in body
+
+
+def test_usage_records_and_opt_out(sky_tpu_home, monkeypatch):
+    monkeypatch.delenv(usage.DISABLE_ENV, raising=False)
+
+    @usage.entrypoint(name='op-under-test')
+    def op(fail=False):
+        if fail:
+            raise RuntimeError('boom')
+        return 1
+
+    op()
+    try:
+        op(fail=True)
+    except RuntimeError:
+        pass
+    path = os.path.join(sky_tpu_home, 'usage', 'usage.jsonl')
+    lines = [json.loads(l) for l in open(path)]
+    ops = [(l['op'], l['outcome']) for l in lines]
+    assert ('op-under-test', 'ok') in ops
+    assert ('op-under-test', 'error:RuntimeError') in ops
+
+    monkeypatch.setenv(usage.DISABLE_ENV, '1')
+    n = len(lines)
+    op()
+    assert len(open(path).readlines()) == n
