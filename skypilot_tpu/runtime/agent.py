@@ -109,6 +109,16 @@ class Agent:
             # under this dir (a real host would use / directly).
             env['SKY_TPU_HOST_ROOT'] = os.path.join(self.cluster_dir,
                                                     f'host{rank}')
+            # Rank cwd is the host workdir, so first-party modules (e.g.
+            # `python -m skypilot_tpu.infer.server` replicas) are only
+            # importable if the framework root rides PYTHONPATH — the
+            # local analog of the wheel a real host has installed.
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            prior_pp = env.get('PYTHONPATH', '')
+            if pkg_root not in prior_pp.split(os.pathsep):
+                env['PYTHONPATH'] = (f'{pkg_root}{os.pathsep}{prior_pp}'
+                                     if prior_pp else pkg_root)
             # Fake slices must not grab a real TPU. Overridden (not
             # setdefault): the inherited environment may pin a TPU platform,
             # and both selection variables must agree for every jax version.
