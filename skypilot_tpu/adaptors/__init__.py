@@ -1,0 +1,29 @@
+"""Lazy adaptors for optional/heavy third-party SDKs.
+
+Counterpart of the reference's ``sky/adaptors/`` (LazyImport at
+``sky/adaptors/common.py:10-24``): cloud SDKs are imported on first
+attribute access so the framework imports fast and works where a given
+SDK is absent — callers get a clear, actionable ImportError only when
+they actually touch the missing SDK.
+"""
+from skypilot_tpu.adaptors.common import LazyImport
+
+# The TPU cloud's storage SDK (present in the standard image).
+gcs_storage = LazyImport(
+    'google.cloud.storage',
+    install_hint='google-cloud-storage is required for GCS bucket '
+    'operations (pip install google-cloud-storage)')
+
+# Optional elsewhere.
+boto3 = LazyImport(
+    'boto3',
+    install_hint='boto3 is required for S3/R2 bucket SDK operations '
+    '(pip install boto3); the `aws` CLI is used as a fallback when '
+    'available')
+azure_blob = LazyImport(
+    'azure.storage.blob',
+    install_hint='azure-storage-blob is required for Azure Blob '
+    'operations (pip install azure-storage-blob)')
+gcsfs = LazyImport('gcsfs',
+                   install_hint='gcsfs is required for fsspec-style GCS '
+                   'access (pip install gcsfs)')

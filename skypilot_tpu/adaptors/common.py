@@ -1,0 +1,47 @@
+"""LazyImport: defer SDK imports until first use.
+
+Same role as the reference's ``sky/adaptors/common.py:10`` LazyImport;
+re-designed minimally — a module proxy that imports on first attribute
+access and raises a hint-carrying ImportError if the SDK is missing.
+"""
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Any, Optional
+
+
+class LazyImport:
+    """Proxy for a module imported on first attribute access."""
+
+    def __init__(self, module_name: str,
+                 install_hint: Optional[str] = None) -> None:
+        self._module_name = module_name
+        self._install_hint = install_hint
+        self._module: Any = None
+        self._lock = threading.Lock()
+
+    def _load(self) -> Any:
+        if self._module is None:
+            with self._lock:
+                if self._module is None:
+                    try:
+                        self._module = importlib.import_module(
+                            self._module_name)
+                    except ImportError as e:
+                        hint = self._install_hint or str(e)
+                        raise ImportError(
+                            f'Failed to import {self._module_name!r}: '
+                            f'{hint}') from e
+        return self._module
+
+    def available(self) -> bool:
+        """True if the underlying module can be imported."""
+        try:
+            self._load()
+            return True
+        except ImportError:
+            return False
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._load(), name)

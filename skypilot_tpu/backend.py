@@ -122,11 +122,11 @@ class TpuVmBackend(Backend):
 
     def sync_file_mounts(self, info: ClusterInfo,
                          file_mounts: Dict[str, str]) -> None:
+        from skypilot_tpu.data import storage as storage_lib
         for dst, src in file_mounts.items():
-            if src.startswith(('gs://', 's3://')):
-                # Storage mounts are handled by data/storage.py via the
-                # agent (gcsfuse/copy on host).
-                from skypilot_tpu.data import storage as storage_lib
+            if storage_lib.is_bucket_url(src):
+                # Bucket-backed sources (gs/s3/r2/azure/file) are mounted
+                # by data/storage.py via the agent on every host.
                 storage_lib.mount_on_cluster(info, dst, src)
                 continue
             for runner in self._runners(info):

@@ -1,0 +1,69 @@
+"""Cross-store bucket transfers (reference ``sky/data/data_transfer.py``).
+
+The reference shells out to cloud transfer services; here every pairwise
+transfer routes through one of two mechanisms:
+
+- same-API pairs (gcs→gcs, s3→s3/r2) use the store's native sync CLI;
+- cross-cloud pairs stream through a local staging directory, which is
+  correct everywhere and fast enough for the code/checkpoint-sized
+  payloads the control plane moves (bulk datasets should be mounted, not
+  copied — see storage.StorageMode.MOUNT).
+"""
+from __future__ import annotations
+
+import subprocess
+import tempfile
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import storage as storage_lib
+
+
+def _sync_cli(src_url: str, dst_url: str) -> list:
+    if src_url.startswith('gs://') and dst_url.startswith('gs://'):
+        return ['gsutil', '-m', 'rsync', '-r', src_url, dst_url]
+    if src_url.startswith('s3://') and dst_url.startswith('s3://'):
+        return ['aws', 's3', 'sync', src_url, dst_url]
+    # gsutil speaks s3:// too when boto credentials exist.
+    if {src_url.split('://')[0], dst_url.split('://')[0]} <= {'gs', 's3'}:
+        return ['gsutil', '-m', 'rsync', '-r', src_url, dst_url]
+    return []
+
+
+def transfer(src_url: str, dst_url: str) -> None:
+    """Copy all objects under src_url into dst_url."""
+    cmd = _sync_cli(src_url, dst_url)
+    if cmd:
+        rc = subprocess.run(cmd, capture_output=True, text=True)
+        if rc.returncode == 0:
+            return
+        # fall through to staging on CLI failure
+    src = storage_lib.store_from_url(src_url)
+    dst = storage_lib.store_from_url(dst_url)
+    with tempfile.TemporaryDirectory(prefix='sky_tpu_xfer_') as stage:
+        _download_to(src, stage)
+        dst.create()
+        dst.upload(stage)
+
+
+def _download_to(store: storage_lib.AbstractStore, local_dir: str) -> None:
+    if isinstance(store, storage_lib.LocalStore):
+        rc = subprocess.run(['cp', '-a', store.path + '/.', local_dir],
+                            capture_output=True, text=True)
+    elif store.store_type == storage_lib.StoreType.GCS:
+        rc = subprocess.run(
+            ['gsutil', '-m', 'rsync', '-r', store.url, local_dir],
+            capture_output=True, text=True)
+    elif store.store_type in (storage_lib.StoreType.S3,
+                              storage_lib.StoreType.R2):
+        cmd = ['aws', 's3', 'sync',
+               's3://' + store.url.split('://', 1)[1], local_dir]
+        endpoint = getattr(store, '_endpoint_url', None)
+        if endpoint:
+            cmd += ['--endpoint-url', endpoint]
+        rc = subprocess.run(cmd, capture_output=True, text=True)
+    else:
+        raise exceptions.StorageError(
+            f'No download path for store {store.store_type}')
+    if rc.returncode != 0:
+        raise exceptions.StorageError(
+            f'Download from {store.url} failed: {rc.stderr}')

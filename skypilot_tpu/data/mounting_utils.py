@@ -1,0 +1,115 @@
+"""FUSE mount / copy command builders for every store type.
+
+Counterpart of the reference's ``sky/data/mounting_utils.py`` (command
+builders consumed by its SSH runner). Here the commands run through the
+on-host agent on every host of a TPU slice; all builders return plain
+POSIX shell so they work on TPU-VM images and on local fake-slice hosts.
+
+Each builder is idempotent (``mountpoint -q || mount``) because managed
+jobs re-run setup after recovery.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Optional
+
+_FUSE_CACHE_MB = 10240
+
+
+def gcsfuse_install_command() -> str:
+    """Install gcsfuse on a Debian-family TPU VM (no-op if present).
+
+    Chained into every gcs mount command — TPU-VM images usually ship
+    gcsfuse, so the common case is the cheap `command -v` check.
+    """
+    return (
+        'command -v gcsfuse >/dev/null 2>&1 || ('
+        'export GCSFUSE_REPO=gcsfuse-`lsb_release -c -s` && '
+        'echo "deb https://packages.cloud.google.com/apt $GCSFUSE_REPO '
+        'main" | sudo tee /etc/apt/sources.list.d/gcsfuse.list && '
+        'curl -fsSL https://packages.cloud.google.com/apt/doc/apt-key.gpg '
+        '| sudo apt-key add - && '
+        'sudo apt-get update -qq && sudo apt-get install -y gcsfuse)')
+
+
+def rclone_install_command() -> str:
+    return ('command -v rclone >/dev/null 2>&1 || '
+            'curl -fsSL https://rclone.org/install.sh | sudo bash')
+
+
+def _mkdir_and_guard(dst: str) -> str:
+    return f'mkdir -p {shlex.quote(dst)} && (mountpoint -q {shlex.quote(dst)} || '
+
+
+def gcs_mount_command(bucket: str, dst: str, *,
+                      only_dir: str = '',
+                      cached: bool = False) -> str:
+    """gcsfuse mount (reference mounting_utils gcs path)."""
+    only = f'--only-dir {shlex.quote(only_dir)} ' if only_dir else ''
+    cache = (f'--file-cache-max-size-mb {_FUSE_CACHE_MB} '
+             '--cache-dir /tmp/gcsfuse-cache ' if cached else '')
+    return (gcsfuse_install_command() + ' && ' + _mkdir_and_guard(dst) +
+            f'gcsfuse {only}{cache}--implicit-dirs '
+            f'{shlex.quote(bucket)} {shlex.quote(dst)})')
+
+
+def s3_mount_command(bucket: str, dst: str, *,
+                     endpoint_url: Optional[str] = None,
+                     profile: Optional[str] = None) -> str:
+    """rclone-based S3/R2 mount (goofys is unmaintained; rclone ships
+    static binaries that run on TPU VMs)."""
+    remote = f':s3,provider=AWS,env_auth=true'
+    if endpoint_url:
+        remote = f':s3,provider=Cloudflare,env_auth=true,endpoint={endpoint_url}'
+    if profile:
+        remote += f',profile={profile}'
+    return (rclone_install_command() + ' && ' + _mkdir_and_guard(dst) +
+            f'rclone mount {shlex.quote(remote + ":" + bucket)} '
+            f'{shlex.quote(dst)} --daemon --vfs-cache-mode writes)')
+
+
+def azure_mount_command(container: str, dst: str, *,
+                        account_name: str) -> str:
+    """blobfuse2 mount."""
+    return (_mkdir_and_guard(dst) +
+            f'AZURE_STORAGE_ACCOUNT={shlex.quote(account_name)} '
+            f'blobfuse2 mount {shlex.quote(dst)} '
+            f'--container-name {shlex.quote(container)} '
+            '--use-adls=false --tmp-path /tmp/blobfuse2-cache)')
+
+
+def local_link_command(src_path: str, dst: str) -> str:
+    """Fake-slice hosts: a symlink stands in for a FUSE mount."""
+    return (f'mkdir -p "$(dirname {shlex.quote(dst)})" && '
+            f'rm -rf {shlex.quote(dst)} && '
+            f'ln -s {shlex.quote(src_path)} {shlex.quote(dst)}')
+
+
+def copy_command(url: str, dst: str, *,
+                 endpoint_url: Optional[str] = None) -> str:
+    """One-time COPY-mode sync onto host disk.
+
+    ``endpoint_url`` targets S3-compatible stores (R2) at their own
+    endpoint instead of AWS.
+    """
+    q_dst = shlex.quote(dst)
+    if url.startswith('gs://'):
+        return (f'mkdir -p {q_dst} && '
+                f'(command -v gcloud >/dev/null 2>&1 && '
+                f'gcloud storage rsync -r {shlex.quote(url)} {q_dst} || '
+                f'gsutil -m rsync -r {shlex.quote(url)} {q_dst})')
+    if url.startswith(('s3://', 'r2://')):
+        s3url = 's3://' + url.split('://', 1)[1]
+        ep = (f' --endpoint-url {shlex.quote(endpoint_url)}'
+              if endpoint_url else '')
+        return (f'mkdir -p {q_dst} && '
+                f'aws s3 sync {shlex.quote(s3url)} {q_dst}{ep}')
+    if url.startswith('https://') and '.blob.core.windows.net' in url:
+        return (f'mkdir -p {q_dst} && '
+                f'azcopy sync {shlex.quote(url)} {q_dst} --recursive')
+    raise ValueError(f'No copy command for {url!r}')
+
+
+def unmount_command(dst: str) -> str:
+    return (f'(mountpoint -q {shlex.quote(dst)} && '
+            f'fusermount -u {shlex.quote(dst)}) || true')

@@ -1,0 +1,141 @@
+"""Storage subsystem: store parsing, mount commands, local E2E, transfer."""
+import os
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import data_transfer
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data import storage as storage_lib
+
+S = storage_lib.StoreType
+M = storage_lib.StorageMode
+
+
+def test_store_type_from_url():
+    assert S.from_url('gs://b/p') == S.GCS
+    assert S.from_url('s3://b') == S.S3
+    assert S.from_url('r2://b') == S.R2
+    assert S.from_url('https://acct.blob.core.windows.net/c') == S.AZURE
+    assert S.from_url('file:///tmp/x') == S.LOCAL
+    assert S.from_url('/tmp/x') == S.LOCAL
+    with pytest.raises(exceptions.StorageError):
+        S.from_url('ftp://nope')
+
+
+def test_store_from_url_parses_bucket_and_subpath():
+    st = storage_lib.store_from_url('gs://bkt/sub/dir')
+    assert isinstance(st, storage_lib.GcsStore)
+    assert st.name == 'bkt' and st.sub_path == 'sub/dir'
+    az = storage_lib.store_from_url(
+        'https://myacct.blob.core.windows.net/cont/sub')
+    assert isinstance(az, storage_lib.AzureBlobStore)
+    assert az.name == 'cont' and az.account_name == 'myacct'
+    assert az.sub_path == 'sub'
+
+
+def test_mount_commands_by_store():
+    cmd = storage_lib.mount_command('/data', 'gs://bkt')
+    assert 'gcsfuse' in cmd and 'bkt' in cmd and 'mountpoint -q' in cmd
+    cmd = storage_lib.mount_command('/data', 'gs://bkt/sub')
+    assert '--only-dir sub' in cmd
+    cmd = storage_lib.mount_command('/data', 'gs://bkt', M.MOUNT_CACHED)
+    assert '--file-cache-max-size-mb' in cmd
+    cmd = storage_lib.mount_command('/data', 'gs://bkt', M.COPY)
+    assert 'rsync' in cmd and 'gcsfuse' not in cmd
+    cmd = storage_lib.mount_command('/data', 's3://bkt')
+    assert 'rclone mount' in cmd
+    cmd = storage_lib.mount_command(
+        '/data', 'https://a.blob.core.windows.net/c')
+    assert 'blobfuse2' in cmd
+    local = storage_lib.mount_command('/data', 'file:///tmp/src')
+    assert 'ln -s' in local
+
+
+def test_mount_command_quotes_paths():
+    cmd = storage_lib.mount_command('/da ta', 'gs://bkt')
+    assert "'/da ta'" in cmd
+
+
+def test_local_store_lifecycle(tmp_path):
+    src = tmp_path / 'src'
+    src.mkdir()
+    (src / 'a.txt').write_text('hello')
+    store_dir = tmp_path / 'bucket'
+    st = storage_lib.LocalStore(str(store_dir))
+    st.create()
+    assert st.exists()
+    st.upload(str(src))
+    assert (store_dir / 'a.txt').read_text() == 'hello'
+    st.delete()
+    assert not st.exists()
+
+
+def test_storage_object_multi_store(tmp_path):
+    s = storage_lib.Storage(str(tmp_path / 'b'), store=S.LOCAL)
+    assert s.store == S.LOCAL
+    s.create()
+    assert s.url.startswith('file://')
+    d = storage_lib.to_dict(s)
+    assert d['store'] == 'local' and d['mode'] == 'MOUNT'
+
+
+def test_data_transfer_local_to_local(tmp_path):
+    src = tmp_path / 'src'
+    src.mkdir()
+    (src / 'f.bin').write_bytes(b'\x00' * 64)
+    dst = tmp_path / 'dst'
+    data_transfer.transfer(f'file://{src}', f'file://{dst}')
+    assert (dst / 'f.bin').read_bytes() == b'\x00' * 64
+
+
+def test_s3_store_without_cli_raises():
+    st = storage_lib.S3Store('bkt')
+    if os.path.exists('/usr/bin/aws') or os.path.exists('/usr/local/bin/aws'):
+        pytest.skip('aws CLI present')
+    with pytest.raises(exceptions.StorageError, match='CLI not found'):
+        st.exists()
+
+
+def test_copy_command_unknown_scheme():
+    with pytest.raises(ValueError):
+        mounting_utils.copy_command('ftp://x', '/data')
+
+
+def test_r2_requires_account_id(monkeypatch):
+    monkeypatch.delenv('R2_ACCOUNT_ID', raising=False)
+    with pytest.raises(exceptions.StorageError, match='R2_ACCOUNT_ID'):
+        storage_lib.R2Store('bkt')
+
+
+def test_r2_copy_and_mount_use_endpoint(monkeypatch):
+    monkeypatch.setenv('R2_ACCOUNT_ID', 'acct1')
+    st = storage_lib.store_from_url('r2://bkt')
+    copy = st.mount_command('/data', M.COPY)
+    assert '--endpoint-url https://acct1.r2.cloudflarestorage.com' in copy
+    mount = st.mount_command('/data', M.MOUNT)
+    assert 'endpoint=https://acct1.r2.cloudflarestorage.com' in mount
+    assert 'provider=Cloudflare' in mount
+
+
+def test_azure_url_without_container_raises():
+    with pytest.raises(exceptions.StorageError, match='no container'):
+        storage_lib.store_from_url('https://acct.blob.core.windows.net')
+
+
+def test_is_bucket_url():
+    assert storage_lib.is_bucket_url('gs://b')
+    assert storage_lib.is_bucket_url('file:///tmp/x')
+    assert not storage_lib.is_bucket_url('/tmp/x')          # rsync path
+    assert not storage_lib.is_bucket_url('~/local/dir')
+    assert not storage_lib.is_bucket_url('ftp://weird')
+
+
+def test_gcs_mount_chains_install():
+    cmd = storage_lib.mount_command('/data', 'gs://bkt')
+    assert 'command -v gcsfuse' in cmd  # installs when missing
+
+
+def test_unmount_idempotent():
+    cmd = mounting_utils.unmount_command('/data')
+    assert 'fusermount -u' in cmd and '|| true' in cmd
