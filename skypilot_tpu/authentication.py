@@ -1,0 +1,111 @@
+"""SSH keypair management for cluster access.
+
+Counterpart of the reference's ``sky/authentication.py`` (per-cloud key
+setup; its GCP path pushes the public key into instance/project
+metadata). TPU-first differences: the primary control channel is the
+on-host gRPC agent, so SSH is a bootstrap/debug channel only — one
+framework keypair is generated lazily and injected into TPU-VM metadata
+at provision time.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import subprocess
+from typing import Dict, Tuple
+
+from skypilot_tpu import exceptions
+
+KEY_DIR = '~/.sky_tpu/keys'
+PRIVATE_KEY_PATH = f'{KEY_DIR}/sky-key'
+PUBLIC_KEY_PATH = f'{KEY_DIR}/sky-key.pub'
+DEFAULT_SSH_USER = 'sky'
+
+
+@functools.lru_cache(maxsize=1)
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Return (private_key_path, public_key_path), generating once.
+
+    ed25519 (small, fast, universally supported by TPU-VM images).
+    Generated in-process via `cryptography` — no ssh-keygen dependency —
+    with a CLI fallback for exotic environments.
+    """
+    priv = os.path.expanduser(PRIVATE_KEY_PATH)
+    pub = os.path.expanduser(PUBLIC_KEY_PATH)
+    if os.path.exists(priv) and os.path.exists(pub):
+        return priv, pub
+    if os.path.exists(priv):
+        # .pub lost but the private key is live on clusters — re-derive
+        # the public half instead of regenerating (which would orphan
+        # running clusters' metadata-authorized key).
+        _derive_public_key(priv, pub)
+        return priv, pub
+    os.makedirs(os.path.dirname(priv), mode=0o700, exist_ok=True)
+    try:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import ed25519
+        key = ed25519.Ed25519PrivateKey.generate()
+        priv_bytes = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.OpenSSH,
+            serialization.NoEncryption())
+        pub_bytes = key.public_key().public_bytes(
+            serialization.Encoding.OpenSSH,
+            serialization.PublicFormat.OpenSSH)
+        with open(priv, 'wb') as f:
+            f.write(priv_bytes)
+        with open(pub, 'wb') as f:
+            f.write(pub_bytes + b' skypilot-tpu\n')
+    except ImportError:
+        rc = subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', priv,
+             '-C', 'skypilot-tpu'],
+            capture_output=True, text=True)
+        if rc.returncode != 0:
+            raise exceptions.AuthenticationError(
+                f'ssh-keygen failed: {rc.stderr}')
+    os.chmod(priv, 0o600)
+    return priv, pub
+
+
+def _derive_public_key(priv: str, pub: str) -> None:
+    try:
+        from cryptography.hazmat.primitives import serialization
+        with open(priv, 'rb') as f:
+            key = serialization.load_ssh_private_key(f.read(), None)
+        pub_bytes = key.public_key().public_bytes(
+            serialization.Encoding.OpenSSH,
+            serialization.PublicFormat.OpenSSH)
+        with open(pub, 'wb') as f:
+            f.write(pub_bytes + b' skypilot-tpu\n')
+    except ImportError:
+        rc = subprocess.run(['ssh-keygen', '-y', '-f', priv],
+                            capture_output=True, text=True)
+        if rc.returncode != 0:
+            raise exceptions.AuthenticationError(
+                f'Could not derive public key from {priv}: {rc.stderr}')
+        with open(pub, 'w', encoding='utf-8') as f:
+            f.write(rc.stdout)
+
+
+def public_key() -> str:
+    _, pub = get_or_generate_keys()
+    with open(pub, 'r', encoding='utf-8') as f:
+        return f.read().strip()
+
+
+def setup_gcp_authentication(provider_config: Dict) -> Dict:
+    """Fill ssh_user/ssh_key and the metadata entry that authorizes the
+    framework key on every host of a TPU slice (reference
+    authentication.py GCP path writes the same ``ssh-keys`` metadata).
+
+    Returns the updated provider_config; the GCP provisioner attaches
+    ``metadata['ssh-keys']`` to the TPU VM create request.
+    """
+    provider_config = dict(provider_config)
+    user = provider_config.setdefault('ssh_user', DEFAULT_SSH_USER)
+    provider_config.setdefault('ssh_key', PRIVATE_KEY_PATH)
+    metadata = dict(provider_config.get('metadata', {}))
+    metadata['ssh-keys'] = f'{user}:{public_key()}'
+    provider_config['metadata'] = metadata
+    return provider_config

@@ -205,10 +205,30 @@ def autostop(cluster: str, idle_minutes: int, down_: bool) -> None:
 
 @cli.command()
 def check() -> None:
-    """Probe cloud credentials."""
-    results = _engine().check()
-    for cloud, ok in results.items():
-        click.echo(f'  {cloud}: {"enabled" if ok else "disabled"}')
+    """Probe cloud credentials and capabilities."""
+    engine = _engine()
+    if not hasattr(engine, 'check_detailed'):
+        # Remote SDK path: the API server probes ITS credentials and
+        # records enabled clouds in its own state DB.
+        for cloud, ok in engine.check().items():
+            click.echo(f'  {"✓" if ok else "✗"} {cloud}: '
+                       f'{"enabled" if ok else "disabled"}')
+        return
+    results = engine.check_detailed()
+    for r in results:
+        mark = '✓' if r.ok else '✗'
+        line = f'  {mark} {r.cloud}: {"enabled" if r.ok else "disabled"}'
+        if r.ok and r.storage_ok:
+            line += ' [compute, storage]'
+        elif r.ok:
+            line += ' [compute]'
+        click.echo(line)
+        if r.reason:
+            click.echo(f'      {r.reason}')
+        for k, v in r.details.items():
+            click.echo(f'      {k}: {v}')
+    enabled = [r.cloud for r in results if r.ok]
+    click.echo(f'\nEnabled clouds: {", ".join(enabled) or "none"}')
 
 
 @cli.command('show-accelerators')

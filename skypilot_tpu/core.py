@@ -168,21 +168,13 @@ def cost_report() -> List[Dict[str, Any]]:
 
 def check(clouds: Optional[List[str]] = None) -> Dict[str, bool]:
     """Probe cloud credentials and record enabled clouds (reference
-    sky/check.py: `sky check`)."""
-    results: Dict[str, bool] = {}
-    for cloud in clouds or ['local', 'gcp']:
-        if cloud == 'local':
-            results[cloud] = True
-            continue
-        if cloud == 'gcp':
-            try:
-                import google.auth
-                google.auth.default(scopes=[
-                    'https://www.googleapis.com/auth/cloud-platform'])
-                results[cloud] = True
-            except Exception:  # noqa: BLE001
-                results[cloud] = False
-            continue
-        results[cloud] = False
-    state.set_enabled_clouds([c for c, ok in results.items() if ok])
-    return results
+    sky/check.py: `sky check`). Thin wrapper over check.check() keeping
+    the historical {cloud: bool} shape for the SDK/API."""
+    from skypilot_tpu import check as check_lib
+    return {r.cloud: r.ok for r in check_lib.check(clouds)}
+
+
+def check_detailed(clouds: Optional[List[str]] = None):
+    """Structured per-cloud capability results."""
+    from skypilot_tpu import check as check_lib
+    return check_lib.check(clouds)

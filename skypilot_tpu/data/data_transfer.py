@@ -14,7 +14,6 @@ from __future__ import annotations
 import subprocess
 import tempfile
 
-from skypilot_tpu import exceptions
 from skypilot_tpu.data import storage as storage_lib
 
 
@@ -40,30 +39,6 @@ def transfer(src_url: str, dst_url: str) -> None:
     src = storage_lib.store_from_url(src_url)
     dst = storage_lib.store_from_url(dst_url)
     with tempfile.TemporaryDirectory(prefix='sky_tpu_xfer_') as stage:
-        _download_to(src, stage)
+        src.download(stage)
         dst.create()
         dst.upload(stage)
-
-
-def _download_to(store: storage_lib.AbstractStore, local_dir: str) -> None:
-    if isinstance(store, storage_lib.LocalStore):
-        rc = subprocess.run(['cp', '-a', store.path + '/.', local_dir],
-                            capture_output=True, text=True)
-    elif store.store_type == storage_lib.StoreType.GCS:
-        rc = subprocess.run(
-            ['gsutil', '-m', 'rsync', '-r', store.url, local_dir],
-            capture_output=True, text=True)
-    elif store.store_type in (storage_lib.StoreType.S3,
-                              storage_lib.StoreType.R2):
-        cmd = ['aws', 's3', 'sync',
-               's3://' + store.url.split('://', 1)[1], local_dir]
-        endpoint = getattr(store, '_endpoint_url', None)
-        if endpoint:
-            cmd += ['--endpoint-url', endpoint]
-        rc = subprocess.run(cmd, capture_output=True, text=True)
-    else:
-        raise exceptions.StorageError(
-            f'No download path for store {store.store_type}')
-    if rc.returncode != 0:
-        raise exceptions.StorageError(
-            f'Download from {store.url} failed: {rc.stderr}')

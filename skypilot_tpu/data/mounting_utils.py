@@ -54,27 +54,39 @@ def gcs_mount_command(bucket: str, dst: str, *,
 
 
 def s3_mount_command(bucket: str, dst: str, *,
+                     sub_path: str = '',
                      endpoint_url: Optional[str] = None,
                      profile: Optional[str] = None) -> str:
     """rclone-based S3/R2 mount (goofys is unmaintained; rclone ships
     static binaries that run on TPU VMs)."""
     remote = f':s3,provider=AWS,env_auth=true'
     if endpoint_url:
-        remote = f':s3,provider=Cloudflare,env_auth=true,endpoint={endpoint_url}'
+        # rclone connection strings require values containing ':'/','
+        # to be double-quoted.
+        remote = (f':s3,provider=Cloudflare,env_auth=true,'
+                  f'endpoint="{endpoint_url}"')
     if profile:
         remote += f',profile={profile}'
+    path = f'{bucket}/{sub_path}' if sub_path else bucket
     return (rclone_install_command() + ' && ' + _mkdir_and_guard(dst) +
-            f'rclone mount {shlex.quote(remote + ":" + bucket)} '
+            f'rclone mount {shlex.quote(remote + ":" + path)} '
             f'{shlex.quote(dst)} --daemon --vfs-cache-mode writes)')
 
 
 def azure_mount_command(container: str, dst: str, *,
-                        account_name: str) -> str:
-    """blobfuse2 mount."""
-    return (_mkdir_and_guard(dst) +
+                        account_name: str,
+                        sub_path: str = '') -> str:
+    """blobfuse2 mount. No self-install (blobfuse2 needs a Microsoft apt
+    repo) — fail early with an actionable message instead."""
+    sub = (f'--subdirectory={shlex.quote(sub_path)} ' if sub_path else '')
+    return ('command -v blobfuse2 >/dev/null 2>&1 || '
+            '{ echo "blobfuse2 not installed on host — see '
+            'https://learn.microsoft.com/azure/storage/blobs/'
+            'blobfuse2-how-to-deploy" >&2; exit 1; }; ' +
+            _mkdir_and_guard(dst) +
             f'AZURE_STORAGE_ACCOUNT={shlex.quote(account_name)} '
             f'blobfuse2 mount {shlex.quote(dst)} '
-            f'--container-name {shlex.quote(container)} '
+            f'--container-name {shlex.quote(container)} {sub}'
             '--use-adls=false --tmp-path /tmp/blobfuse2-cache)')
 
 

@@ -104,6 +104,10 @@ class AbstractStore:
     def upload(self, local_path: str, sub_path: str = '') -> None:
         raise NotImplementedError
 
+    def download(self, local_dir: str) -> None:
+        """Sync the bucket (under sub_path) into local_dir."""
+        raise NotImplementedError
+
     # -- host-side commands ----------------------------------------------
     def mount_command(self, dst: str, mode: StorageMode) -> str:
         raise NotImplementedError
@@ -164,6 +168,12 @@ class GcsStore(AbstractStore):
             raise exceptions.StorageError(
                 f'Upload to {target} failed: {rc.stderr}')
 
+    def download(self, local_dir: str) -> None:
+        rc = _run(['gsutil', '-m', 'rsync', '-r', self.url, local_dir])
+        if rc.returncode != 0:
+            raise exceptions.StorageError(
+                f'Download from {self.url} failed: {rc.stderr}')
+
     def mount_command(self, dst: str, mode: StorageMode) -> str:
         if mode == StorageMode.COPY:
             return mounting_utils.copy_command(self.url, dst)
@@ -212,12 +222,20 @@ class S3Store(AbstractStore):
             raise exceptions.StorageError(
                 f'Upload to {target} failed: {rc.stderr}')
 
+    def download(self, local_dir: str) -> None:
+        rc = self._aws('s3', 'sync',
+                       's3://' + self.url.split('://', 1)[1], local_dir)
+        if rc.returncode != 0:
+            raise exceptions.StorageError(
+                f'Download from {self.url} failed: {rc.stderr}')
+
     def mount_command(self, dst: str, mode: StorageMode) -> str:
         if mode == StorageMode.COPY:
             return mounting_utils.copy_command(
                 self.url, dst, endpoint_url=self._endpoint_url)
         return mounting_utils.s3_mount_command(
-            self.name, dst, endpoint_url=self._endpoint_url)
+            self.name, dst, sub_path=self.sub_path,
+            endpoint_url=self._endpoint_url)
 
 
 class R2Store(S3Store):
@@ -254,6 +272,11 @@ class AzureBlobStore(AbstractStore):
         super().__init__(name, sub_path)
         self.account_name = (account_name or
                              os.environ.get('AZURE_STORAGE_ACCOUNT', ''))
+        if not self.account_name:
+            raise exceptions.StorageError(
+                'Azure Blob storage needs an account name — pass it in '
+                'the URL (https://<account>.blob.core.windows.net/...) '
+                'or set AZURE_STORAGE_ACCOUNT')
 
     @property
     def url(self) -> str:
@@ -287,11 +310,18 @@ class AzureBlobStore(AbstractStore):
             raise exceptions.StorageError(
                 f'Upload to {target} failed: {rc.stderr}')
 
+    def download(self, local_dir: str) -> None:
+        rc = _run(['azcopy', 'copy', self.url, local_dir, '--recursive'])
+        if rc.returncode != 0:
+            raise exceptions.StorageError(
+                f'Download from {self.url} failed: {rc.stderr}')
+
     def mount_command(self, dst: str, mode: StorageMode) -> str:
         if mode == StorageMode.COPY:
             return mounting_utils.copy_command(self.url, dst)
         return mounting_utils.azure_mount_command(
-            self.name, dst, account_name=self.account_name)
+            self.name, dst, account_name=self.account_name,
+            sub_path=self.sub_path)
 
 
 class LocalStore(AbstractStore):
@@ -324,6 +354,9 @@ class LocalStore(AbstractStore):
             shutil.copytree(local_path, dst, dirs_exist_ok=True)
         else:
             shutil.copy2(local_path, dst)
+
+    def download(self, local_dir: str) -> None:
+        shutil.copytree(self.path, local_dir, dirs_exist_ok=True)
 
     def mount_command(self, dst: str, mode: StorageMode) -> str:
         return mounting_utils.local_link_command(self.path, dst)

@@ -138,3 +138,7 @@ class CheckpointError(SkyTpuError):
 
 class NoCloudAccessError(SkyTpuError):
     """No cloud credentials are available for the requested operation."""
+
+
+class AuthenticationError(SkyTpuError):
+    """SSH key generation / credential setup failure."""

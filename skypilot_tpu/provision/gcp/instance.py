@@ -63,6 +63,10 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
     assert config.tpu_slice is not None, (
         'GCP provider currently supports TPU slices (CPU/GPU VMs via the '
         'compute provider are a future drop-in)')
+    # Authorize the framework SSH key on every host of the slice.
+    from skypilot_tpu import authentication
+    config.provider_config.update(
+        authentication.setup_gcp_authentication(config.provider_config))
     s = topology.parse_tpu(config.tpu_slice)
     runtime_version = (config.runtime_version or
                        DEFAULT_RUNTIME_VERSIONS[s.generation])
@@ -72,7 +76,8 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         runtime_version=runtime_version,
         spot=config.use_spot,
         labels={**config.labels, 'sky-tpu-cluster': config.cluster_name},
-        startup_script=_STARTUP_SCRIPT)
+        startup_script=_STARTUP_SCRIPT,
+        metadata=config.provider_config.get('metadata'))
     info = get_cluster_info(config.cluster_name, {
         **config.provider_config, 'zone': config.zone})
     if info is None:

@@ -93,7 +93,9 @@ class TpuApiClient:
                     spot: bool = False,
                     labels: Optional[Dict[str, str]] = None,
                     startup_script: Optional[str] = None,
-                    network: Optional[str] = None) -> Dict[str, Any]:
+                    network: Optional[str] = None,
+                    metadata: Optional[Dict[str, str]] = None
+                    ) -> Dict[str, Any]:
         body: Dict[str, Any] = {
             'acceleratorType': accelerator_type,
             'runtimeVersion': runtime_version,
@@ -104,8 +106,11 @@ class TpuApiClient:
             body['networkConfig']['network'] = network
         if spot:
             body['schedulingConfig'] = {'spot': True}
+        if metadata:
+            body['metadata'] = dict(metadata)
         if startup_script:
-            body['metadata'] = {'startup-script': startup_script}
+            body.setdefault('metadata', {})['startup-script'] = (
+                startup_script)
         url = (f'{TPU_API}/projects/{self.project}/locations/{zone}'
                f'/nodes?nodeId={node_id}')
         op = self._request('POST', url, body)

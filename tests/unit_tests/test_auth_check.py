@@ -1,0 +1,80 @@
+"""Authentication (SSH keys) + credential check subsystem."""
+import os
+
+import pytest
+
+from skypilot_tpu import authentication
+from skypilot_tpu import check as check_lib
+from skypilot_tpu import core
+
+
+@pytest.fixture()
+def key_home(tmp_path, monkeypatch):
+    monkeypatch.setattr(authentication, 'KEY_DIR', str(tmp_path / 'keys'))
+    monkeypatch.setattr(authentication, 'PRIVATE_KEY_PATH',
+                        str(tmp_path / 'keys' / 'sky-key'))
+    monkeypatch.setattr(authentication, 'PUBLIC_KEY_PATH',
+                        str(tmp_path / 'keys' / 'sky-key.pub'))
+    authentication.get_or_generate_keys.cache_clear()
+    yield tmp_path
+    authentication.get_or_generate_keys.cache_clear()
+
+
+def test_keygen_creates_ed25519_pair(key_home):
+    priv, pub = authentication.get_or_generate_keys()
+    assert os.path.exists(priv) and os.path.exists(pub)
+    assert oct(os.stat(priv).st_mode & 0o777) == '0o600'
+    assert authentication.public_key().startswith('ssh-ed25519 ')
+    # Second call reuses, does not regenerate.
+    assert authentication.get_or_generate_keys() == (priv, pub)
+
+
+def test_pub_key_rederived_from_private(key_home):
+    priv, pub = authentication.get_or_generate_keys()
+    original_pub = authentication.public_key()
+    os.remove(pub)
+    authentication.get_or_generate_keys.cache_clear()
+    priv2, pub2 = authentication.get_or_generate_keys()
+    assert priv2 == priv
+    # Private key untouched; public half re-derived to the same key.
+    assert authentication.public_key().split()[1] == (
+        original_pub.split()[1])
+
+
+def test_setup_gcp_authentication_injects_metadata(key_home):
+    cfg = authentication.setup_gcp_authentication({'project': 'p'})
+    assert cfg['ssh_user'] == 'sky'
+    assert cfg['metadata']['ssh-keys'].startswith('sky:ssh-ed25519 ')
+    # Existing user respected, original dict not mutated.
+    original = {'ssh_user': 'me'}
+    cfg2 = authentication.setup_gcp_authentication(original)
+    assert cfg2['metadata']['ssh-keys'].startswith('me:')
+    assert 'metadata' not in original
+
+
+def test_check_local_always_enabled(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path))
+    results = check_lib.check(['local'])
+    assert len(results) == 1 and results[0].ok and results[0].storage_ok
+    assert check_lib.enabled_clouds() == ['local']
+
+
+def test_check_unknown_cloud(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path))
+    (r,) = check_lib.check(['nope'])
+    assert not r.ok and 'Unknown cloud' in r.reason
+
+
+def test_check_gcp_without_creds_has_hint(monkeypatch, tmp_path):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path))
+    monkeypatch.setenv('GOOGLE_APPLICATION_CREDENTIALS',
+                       str(tmp_path / 'nonexistent.json'))
+    (r,) = check_lib.check(['gcp'])
+    assert not r.ok
+    assert 'gcloud auth' in r.reason or 'credentials' in r.reason.lower()
+
+
+def test_core_check_bool_shape(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path))
+    result = core.check(['local'])
+    assert result == {'local': True}

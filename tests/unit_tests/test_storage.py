@@ -90,8 +90,9 @@ def test_data_transfer_local_to_local(tmp_path):
 
 
 def test_s3_store_without_cli_raises():
+    import shutil as _shutil
     st = storage_lib.S3Store('bkt')
-    if os.path.exists('/usr/bin/aws') or os.path.exists('/usr/local/bin/aws'):
+    if _shutil.which('aws'):
         pytest.skip('aws CLI present')
     with pytest.raises(exceptions.StorageError, match='CLI not found'):
         st.exists()
@@ -114,7 +115,7 @@ def test_r2_copy_and_mount_use_endpoint(monkeypatch):
     copy = st.mount_command('/data', M.COPY)
     assert '--endpoint-url https://acct1.r2.cloudflarestorage.com' in copy
     mount = st.mount_command('/data', M.MOUNT)
-    assert 'endpoint=https://acct1.r2.cloudflarestorage.com' in mount
+    assert 'endpoint="https://acct1.r2.cloudflarestorage.com"' in mount
     assert 'provider=Cloudflare' in mount
 
 
@@ -134,6 +135,31 @@ def test_is_bucket_url():
 def test_gcs_mount_chains_install():
     cmd = storage_lib.mount_command('/data', 'gs://bkt')
     assert 'command -v gcsfuse' in cmd  # installs when missing
+
+
+def test_s3_mount_includes_subpath():
+    cmd = storage_lib.mount_command('/data', 's3://bkt/sub/dir')
+    assert 'bkt/sub/dir' in cmd
+
+
+def test_r2_mount_endpoint_quoted_for_rclone(monkeypatch):
+    monkeypatch.setenv('R2_ACCOUNT_ID', 'acct1')
+    cmd = storage_lib.store_from_url('r2://bkt').mount_command(
+        '/data', M.MOUNT)
+    assert 'endpoint="https://acct1.r2.cloudflarestorage.com"' in cmd
+
+
+def test_azure_without_account_raises(monkeypatch):
+    monkeypatch.delenv('AZURE_STORAGE_ACCOUNT', raising=False)
+    with pytest.raises(exceptions.StorageError, match='account name'):
+        storage_lib.AzureBlobStore('cont')
+
+
+def test_azure_mount_guards_blobfuse2():
+    cmd = storage_lib.mount_command(
+        '/data', 'https://a.blob.core.windows.net/c/sub')
+    assert 'command -v blobfuse2' in cmd
+    assert '--subdirectory=sub' in cmd
 
 
 def test_unmount_idempotent():
