@@ -58,7 +58,39 @@ def _load_task(yaml_path: str, env: tuple) -> 'sky.Task':
               help='Autodown the cluster when the job finishes.')
 def launch(task_yaml: str, cluster: Optional[str], cloud: Optional[str],
            env: tuple, detach_run: bool, yes: bool, autodown: bool) -> None:
-    """Launch a task from a YAML spec (provision + run)."""
+    """Launch a task from a YAML spec (provision + run).
+
+    Multi-document YAMLs describe a pipeline (serial chain) or a job
+    group (``execution: parallel``) and run through the DAG path.
+    """
+    import yaml as yaml_lib
+    with open(os.path.expanduser(task_yaml), encoding='utf-8') as f:
+        docs = [d for d in yaml_lib.safe_load_all(f) if d is not None]
+    if len(docs) > 1:
+        from skypilot_tpu import execution
+        from skypilot_tpu.utils import dag_utils
+        overrides = dict(e.partition('=')[::2] for e in env)
+        dag = dag_utils.load_dag_from_yaml(task_yaml,
+                                           overrides or None)
+        if cloud:
+            for t in dag.tasks:
+                t.set_resources(t.resources.copy(cloud=cloud))
+        if cluster:
+            click.echo('Warning: --cluster is ignored for multi-task '
+                       'YAMLs (each task gets its own cluster).')
+        if detach_run and not dag.is_job_group():
+            click.echo('Warning: --detach-run is ignored for serial '
+                       'pipelines (stages must run in order).')
+        if not yes:
+            mode = 'job group' if dag.is_job_group() else 'pipeline'
+            click.confirm(
+                f'Launching {mode} {dag.name or task_yaml} '
+                f'({len(dag)} tasks). Proceed?', abort=True)
+        results = execution.launch_dag(dag, quiet=False, down=autodown,
+                                       detach_run=detach_run)
+        for name, job_id, _ in results:
+            click.echo(f'Cluster: {name}  job: {job_id}')
+        return
     task = _load_task(task_yaml, env)
     if cloud:
         task.set_resources(task.resources.copy(cloud=cloud))

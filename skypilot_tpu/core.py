@@ -14,6 +14,7 @@ from skypilot_tpu import provision
 from skypilot_tpu import state
 from skypilot_tpu.execution import exec as exec_  # noqa: F401 (re-export)
 from skypilot_tpu.execution import launch  # noqa: F401 (re-export)
+from skypilot_tpu.execution import launch_dag  # noqa: F401 (re-export)
 
 exec = exec_  # noqa: A001 — public API name matches the reference's sky.exec
 from skypilot_tpu.optimizer import optimize  # noqa: F401 (re-export)

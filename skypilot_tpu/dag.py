@@ -8,10 +8,23 @@ here so the general case is solved exactly for small DAGs — see
 """
 from __future__ import annotations
 
+import enum
 import threading
 from typing import Dict, List, Optional, Set
 
 from skypilot_tpu import task as task_lib
+
+
+class DagExecution(enum.Enum):
+    """How a multi-task DAG executes (reference sky/dag.py:12).
+
+    SERIAL: tasks run one after another, in topological order.
+    PARALLEL: a *job group* — tasks run simultaneously and must be
+    gang-placed on the same infra (cloud + region); on TPU this means
+    slices carved out of the same region so DCN between them is local.
+    """
+    SERIAL = 'serial'
+    PARALLEL = 'parallel'
 
 
 class Dag:
@@ -21,6 +34,9 @@ class Dag:
         self.name = name
         self.tasks: List[task_lib.Task] = []
         self._edges: Dict[int, Set[int]] = {}  # task index -> child indices
+        # None means DEFAULT (serial); set_execution(PARALLEL) marks a
+        # job group (reference sky/dag.py:91 is_job_group).
+        self.execution: Optional[DagExecution] = None
 
     # ---- construction ----------------------------------------------------
     def add(self, t: task_lib.Task) -> 'Dag':
@@ -64,6 +80,14 @@ class Dag:
     def parents(self, t: task_lib.Task) -> List[task_lib.Task]:
         idx = self.tasks.index(t)
         return [self.tasks[p] for p, cs in self._edges.items() if idx in cs]
+
+    def set_execution(self, execution: DagExecution) -> None:
+        self.execution = execution
+
+    def is_job_group(self) -> bool:
+        """True when tasks run in parallel as one gang (reference
+        sky/dag.py:91): they must be co-placed on common infra."""
+        return self.execution is DagExecution.PARALLEL
 
     def is_chain(self) -> bool:
         """True for a *connected* linear chain: every degree <= 1, exactly

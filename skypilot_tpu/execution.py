@@ -141,7 +141,15 @@ def launch(
 def _failover_candidates(
         task: task_lib.Task,
         target: optimizer_lib.OptimizeTarget) -> List[catalog.Candidate]:
-    """Best-first candidate list for the failover loop."""
+    """Best-first candidate list for the failover loop.
+
+    When the optimizer already placed the task (``best_resources``), its
+    placement leads the list so the chosen cloud/region is honored —
+    critical for job-group gang placement, where every member's
+    best_resources share one region. Remaining candidates stay as
+    failover fallbacks (availability still wins over preference,
+    mirroring the reference's optimizer-seeds-failover design).
+    """
     plans = optimizer_lib._fill_candidates(task, target)  # noqa: SLF001
     seen = set()
     out = []
@@ -152,7 +160,85 @@ def _failover_candidates(
             continue
         seen.add(key)
         out.append(p.candidate)
+    br = task.best_resources
+    if br is not None:
+        def _preferred(c: catalog.Candidate) -> int:
+            return 0 if (c.cloud == br.cloud and
+                         (br.region is None or c.region == br.region) and
+                         (br.zone is None or c.zone == br.zone)) else 1
+        out.sort(key=_preferred)   # stable: best-first within groups
     return out
+
+
+@usage.entrypoint(name='launch_dag')
+@timeline.event(name='execution.launch_dag')
+def launch_dag(
+    dag,
+    *,
+    backend: Optional[backend_lib.Backend] = None,
+    optimize_target: optimizer_lib.OptimizeTarget =
+        optimizer_lib.OptimizeTarget.COST,
+    detach_run: bool = True,
+    quiet: bool = True,
+    down: bool = False,
+) -> List[Tuple[str, int, ClusterInfo]]:
+    """Execute a multi-task Dag (reference ``_execute_dag``,
+    sky/execution.py:293).
+
+    Chains run serially in topological order, each task on its own
+    cluster (optionally downed after, like the reference's pipeline
+    semantics); ``detach_run`` is ignored for chains since stage N+1
+    must wait on stage N anyway. Job groups (``execution: parallel``)
+    are optimized with the same-infra gang constraint and launched
+    concurrently; with ``down=True`` each member autodowns (autostop
+    idle=0, down) once its job queue drains, so the call can still
+    return without blocking on job completion.
+
+    Returns a list of (cluster_name, job_id, info) per task, in
+    execution order.
+    """
+    from skypilot_tpu import dag as dag_lib  # local: avoid import cycle
+
+    assert isinstance(dag, dag_lib.Dag), dag
+    backend = backend or backend_lib.TpuVmBackend()
+    results: List[Tuple[str, int, ClusterInfo]] = []
+    if dag.is_job_group():
+        optimizer_lib.Optimizer.optimize_job_group(dag, optimize_target,
+                                                   quiet=quiet)
+        import concurrent.futures as cf
+        with cf.ThreadPoolExecutor(max_workers=len(dag.tasks)) as pool:
+            futs = [
+                pool.submit(launch, t, None, backend=backend,
+                            # placement fixed by the gang optimizer above
+                            stages=[Stage.PROVISION, Stage.SYNC_WORKDIR,
+                                    Stage.SYNC_FILE_MOUNTS, Stage.SETUP,
+                                    Stage.EXEC],
+                            detach_run=detach_run, quiet=quiet)
+                for t in dag.tasks
+            ]
+            for t, f in zip(dag.tasks, futs):
+                job_id, info = f.result()
+                results.append((info.cluster_name, job_id, info))
+        if down:
+            for _, _, info in results:
+                backend.set_autostop(info, 0, True)
+        return results
+    # Serial chain: run to completion before the next stage starts.
+    for t in dag.topological_order():
+        job_id, info = launch(t, None, backend=backend,
+                              optimize_target=optimize_target,
+                              detach_run=False, quiet=quiet)
+        results.append((info.cluster_name, job_id, info))
+        if job_id >= 0:
+            status = backend.wait_job(info, job_id)
+            if status != common.JobStatus.SUCCEEDED:
+                raise exceptions.CommandError(
+                    1, f'dag stage {t.name or "<task>"}',
+                    f'stage failed with status {status.value}; aborting '
+                    f'downstream tasks.')
+        if down:
+            backend.teardown(info, terminate=True)
+    return results
 
 
 @usage.entrypoint(name='exec')

@@ -204,14 +204,88 @@ def _optimize_general(dag: dag_lib.Dag,
     return chosen
 
 
+def _set_best_resources(p: TaskPlan) -> None:
+    """Write the chosen placement back onto the task."""
+    c = p.candidate
+    base = p.req if p.req is not None else p.task.resources
+    override = {
+        'cloud': c.cloud,
+        'region': c.region,
+        'zone': c.zone,
+        'use_spot': c.use_spot,
+        'any_of': None,
+    }
+    if c.tpu is not None:
+        override['accelerators'] = c.tpu.name
+    elif c.accelerator_name:
+        override['accelerators'] = (
+            f'{c.accelerator_name}:{c.accelerator_count}')
+    else:
+        override['instance_type'] = c.instance_type
+    p.task.best_resources = base.copy(**override)
+
+
 class Optimizer:
     """Reference sky/optimizer.py:109 ``Optimizer.optimize``."""
+
+    @staticmethod
+    def optimize_job_group(dag: dag_lib.Dag,
+                           target: OptimizeTarget = OptimizeTarget.COST,
+                           blocked: Optional[List[catalog.Candidate]] = None,
+                           quiet: bool = False) -> Plan:
+        """Gang-place a PARALLEL job group on common infra (reference
+        ``Optimizer.optimize_job_group`` + ``_optimize_same_infra``,
+        sky/optimizer.py:1037). All tasks must land in one (cloud, region)
+        so inter-job traffic stays on local DCN, not cross-region WAN.
+        """
+        if not dag.is_job_group():
+            return Optimizer.optimize(dag, target, blocked, quiet)
+        order = dag.tasks
+        cands = {i: _fill_candidates(t, target, blocked)
+                 for i, t in enumerate(order)}
+        # Group each task's candidates by (cloud, region); a region is
+        # feasible only if EVERY task has a candidate there.
+        by_region: Dict[Tuple[str, str], List[Optional[TaskPlan]]] = {}
+        for i in range(len(order)):
+            for p in cands[i]:
+                key = (p.candidate.cloud, p.candidate.region)
+                slot = by_region.setdefault(key, [None] * len(order))
+                if slot[i] is None:   # cands are sorted best-first
+                    slot[i] = p
+
+        def obj(p: TaskPlan) -> float:
+            return p.total_cost if target is OptimizeTarget.COST \
+                else p.run_hours
+
+        best_key, best_sel, best_score = None, None, float('inf')
+        for key, sel in by_region.items():
+            if any(s is None for s in sel):
+                continue
+            score = sum(obj(s) for s in sel)
+            if score < best_score:
+                best_key, best_sel, best_score = key, sel, score
+        if best_sel is None:
+            raise exceptions.ResourcesUnavailableError(
+                f'No common (cloud, region) can satisfy all '
+                f'{len(order)} jobs of job group '
+                f'{dag.name or "<unnamed>"}.')
+        for p in best_sel:
+            _set_best_resources(p)
+        # Gang: wall-clock is the slowest member, all run simultaneously.
+        plan = Plan(per_task=list(best_sel),
+                    critical_path_hours=max(p.run_hours for p in best_sel))
+        if not quiet:
+            print(f'Job group placed in {best_key[0]}/{best_key[1]}')
+            print(format_plan(plan))
+        return plan
 
     @staticmethod
     def optimize(dag: dag_lib.Dag,
                  target: OptimizeTarget = OptimizeTarget.COST,
                  blocked: Optional[List[catalog.Candidate]] = None,
                  quiet: bool = False) -> Plan:
+        if dag.is_job_group():
+            return Optimizer.optimize_job_group(dag, target, blocked, quiet)
         order = dag.topological_order()
         cands = {i: _fill_candidates(t, target, blocked)
                  for i, t in enumerate(order)}
@@ -220,23 +294,7 @@ class Optimizer:
         else:
             chosen = _optimize_general(dag, order, cands, target)
         for p in chosen:
-            c = p.candidate
-            base = p.req if p.req is not None else p.task.resources
-            override = {
-                'cloud': c.cloud,
-                'region': c.region,
-                'zone': c.zone,
-                'use_spot': c.use_spot,
-                'any_of': None,
-            }
-            if c.tpu is not None:
-                override['accelerators'] = c.tpu.name
-            elif c.accelerator_name:
-                override['accelerators'] = (
-                    f'{c.accelerator_name}:{c.accelerator_count}')
-            else:
-                override['instance_type'] = c.instance_type
-            p.task.best_resources = base.copy(**override)
+            _set_best_resources(p)
         # Critical path over the DAG (longest run_hours chain).
         hours_of = {id(p.task): p.run_hours for p in chosen}
         finish: Dict[int, float] = {}

@@ -48,7 +48,9 @@ def ensure_binary(name: str) -> Optional[str]:
     if cxx is None:
         logger.warning('no C++ toolchain; native %s unavailable', name)
         return None
-    tmp = out + '.tmp'
+    # Per-process tmp name: concurrent builders (e.g. two agents starting
+    # at once) must not share one tmp or the loser's replace() fails.
+    tmp = f'{out}.{os.getpid()}.tmp'
     proc = subprocess.run(
         [cxx, '-O2', '-std=c++17', '-o', tmp, src],
         capture_output=True, text=True)
@@ -56,7 +58,7 @@ def ensure_binary(name: str) -> Optional[str]:
         logger.warning('building native %s failed:\n%s', name,
                        proc.stderr)
         return None
-    os.replace(tmp, out)   # atomic: concurrent builders race safely
+    os.replace(tmp, out)   # atomic rename; last writer wins
     return out
 
 

@@ -1,0 +1,86 @@
+"""Multi-document YAML → Dag loaders.
+
+Counterpart of the reference's ``sky/utils/dag_utils.py``
+(``load_chain_dag_from_yaml`` at :139, ``load_job_group_from_yaml`` at
+:420). Format: an optional header document carrying only ``name`` (and
+optionally ``execution: serial|parallel``), followed by one document per
+task. ``execution: parallel`` marks a *job group*: tasks are gang-placed
+on common infra by ``Optimizer.optimize_job_group``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+
+_HEADER_FIELDS = {'name', 'execution'}
+
+
+def _is_header(doc: Dict[str, Any]) -> bool:
+    return bool(doc) and set(doc).issubset(_HEADER_FIELDS)
+
+
+def load_dag_from_yaml_str(
+        yaml_str: str,
+        env_overrides: Optional[Dict[str, str]] = None) -> dag_lib.Dag:
+    """Parse a (possibly multi-document) task YAML into a Dag.
+
+    Single-document YAML gives a one-task Dag. Multi-document YAML gives a
+    chain (``execution: serial`` / default) or a job group
+    (``execution: parallel``).
+    """
+    docs = [d for d in yaml.safe_load_all(yaml_str) if d is not None]
+    if not docs:
+        docs = [{}]
+    for d in docs:
+        if not isinstance(d, dict):
+            raise exceptions.InvalidTaskError(
+                'Each YAML document must be a mapping, got '
+                f'{type(d).__name__}')
+    dag = dag_lib.Dag()
+    execution = dag_lib.DagExecution.SERIAL
+    if len(docs) > 1 and _is_header(docs[0]):
+        header = docs.pop(0)
+        dag.name = header.get('name')
+        exec_str = header.get('execution', 'serial')
+        try:
+            execution = dag_lib.DagExecution(exec_str)
+        except ValueError:
+            raise exceptions.InvalidTaskError(
+                f'Invalid execution mode {exec_str!r}; expected one of '
+                f'{[e.value for e in dag_lib.DagExecution]}') from None
+    prev: Optional[task_lib.Task] = None
+    for doc in docs:
+        t = task_lib.Task.from_yaml_config(doc, env_overrides)
+        dag.add(t)
+        if dag.name is None and len(docs) == 1:
+            dag.name = t.name
+        if prev is not None and execution is dag_lib.DagExecution.SERIAL:
+            dag.add_edge(prev, t)
+        prev = t
+    dag.set_execution(execution)
+    return dag
+
+
+def load_dag_from_yaml(
+        path: str,
+        env_overrides: Optional[Dict[str, str]] = None) -> dag_lib.Dag:
+    with open(os.path.expanduser(path), 'r', encoding='utf-8') as f:
+        return load_dag_from_yaml_str(f.read(), env_overrides)
+
+
+def dump_dag_to_yaml_str(dag: dag_lib.Dag) -> str:
+    """Round-trip: serialize a chain/job-group Dag back to multi-doc YAML
+    (reference dump_chain_dag_to_yaml_str)."""
+    header: Dict[str, Any] = {'name': dag.name}
+    if dag.execution is not None:
+        header['execution'] = dag.execution.value
+    configs: List[Dict[str, Any]] = [header]
+    for t in dag.tasks:
+        configs.append(t.to_yaml_config())
+    return yaml.safe_dump_all(configs, sort_keys=False)

@@ -187,3 +187,92 @@ def test_workdir_and_file_mounts(tmp_path):
     log = b''.join(core.tail_logs('wd-c', job_id, follow=False)).decode()
     assert 'TRAINED' in log and 'DATA123' in log
     core.down('wd-c')
+
+
+def test_launch_dag_chain(tmp_path):
+    """Serial pipeline: stage2 starts only after stage1 succeeds."""
+    from skypilot_tpu import execution
+    from skypilot_tpu.utils import dag_utils
+    marker = tmp_path / 'stage1_done'
+    yaml_str = f"""\
+name: pipe
+---
+name: stage1
+resources:
+  cloud: local
+  accelerators: v5e-4
+run: date +%s%N > {marker}
+---
+name: stage2
+resources:
+  cloud: local
+  accelerators: v5e-4
+run: test -f {marker}
+"""
+    dag = dag_utils.load_dag_from_yaml_str(yaml_str)
+    results = execution.launch_dag(dag, quiet=True, down=True)
+    assert len(results) == 2
+    assert all(job_id >= 1 for _, job_id, _ in results)
+    # down=True terminated the stage clusters.
+    for name, _, _ in results:
+        assert state.get_cluster(name) is None
+
+
+def test_launch_dag_chain_aborts_on_failure():
+    from skypilot_tpu import exceptions
+    from skypilot_tpu import execution
+    from skypilot_tpu.utils import dag_utils
+    yaml_str = """\
+name: pipe
+---
+name: bad
+resources:
+  cloud: local
+  accelerators: v5e-4
+run: exit 3
+---
+name: never
+resources:
+  cloud: local
+  accelerators: v5e-4
+run: echo unreachable
+"""
+    dag = dag_utils.load_dag_from_yaml_str(yaml_str)
+    with pytest.raises(exceptions.CommandError):
+        execution.launch_dag(dag, quiet=True, down=True)
+
+
+def test_launch_dag_job_group_parallel():
+    """PARALLEL group: both tasks run concurrently on separate clusters."""
+    from skypilot_tpu import execution
+    from skypilot_tpu.utils import dag_utils
+    yaml_str = """\
+name: grp
+execution: parallel
+---
+name: j1
+resources:
+  cloud: local
+  accelerators: v5e-4
+run: echo one
+---
+name: j2
+resources:
+  cloud: local
+  accelerators: v5e-4
+run: echo two
+"""
+    dag = dag_utils.load_dag_from_yaml_str(yaml_str)
+    results = execution.launch_dag(dag, quiet=True)
+    assert len(results) == 2
+    names = [n for n, _, _ in results]
+    assert len(set(names)) == 2
+    try:
+        for (name, job_id, _), expect in zip(results, (b'one', b'two')):
+            st = core.wait_job(name, job_id, timeout=60)
+            assert st == common.JobStatus.SUCCEEDED
+            log = b''.join(core.tail_logs(name, job_id, follow=False))
+            assert expect in log
+    finally:
+        for name in names:
+            core.down(name)

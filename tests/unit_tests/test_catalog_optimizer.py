@@ -186,3 +186,116 @@ def test_exact_cpus_no_match():
     plan = optimize(t2, quiet=True)
     assert plan.per_task[0].candidate.instance_type in (
         'n2-standard-16', 'n2-standard-32')
+
+
+def test_job_group_same_infra():
+    # PARALLEL job group: trainer pinned to europe-west4, helper free —
+    # gang placement must drag the helper into the same (cloud, region).
+    trainer = Task('trainer', run='t', resources=Resources(
+        cloud='gcp', accelerators='v5p-8', region='europe-west4'))
+    helper = Task('helper', run='h', resources=Resources(
+        cloud='gcp', accelerators='v5e-8'))
+    helper.estimated_runtime_hours = 2.0
+    trainer.estimated_runtime_hours = 1.0
+    from skypilot_tpu.dag import DagExecution
+    dag = Dag('grp')
+    dag.add(trainer)
+    dag.add(helper)
+    dag.set_execution(DagExecution.PARALLEL)
+    assert dag.is_job_group()
+    plan = Optimizer.optimize(dag, quiet=True)
+    regions = {p.candidate.region for p in plan.per_task}
+    assert regions == {'europe-west4'}
+    # Gang wall-clock = slowest member, not the sum.
+    assert plan.total_hours == pytest.approx(2.0)
+    for p in plan.per_task:
+        assert p.task.best_resources.region == 'europe-west4'
+
+
+def test_job_group_infeasible():
+    from skypilot_tpu.dag import DagExecution
+    a = Task('a', run='x', resources=Resources(
+        cloud='gcp', accelerators='v5p-8', region='europe-west4'))
+    b = Task('b', run='y', resources=Resources(
+        cloud='gcp', accelerators='v5e-8', region='us-central1'))
+    dag = Dag('bad')
+    dag.add(a)
+    dag.add(b)
+    dag.set_execution(DagExecution.PARALLEL)
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        Optimizer.optimize(dag, quiet=True)
+
+
+def test_load_job_group_yaml():
+    from skypilot_tpu.utils import dag_utils
+    yaml_str = """\
+name: my-group
+execution: parallel
+---
+name: trainer
+resources:
+  cloud: gcp
+  accelerators: v5e-8
+run: python train.py
+---
+name: proc
+resources:
+  cloud: gcp
+  accelerators: v5e-4
+run: python proc.py
+"""
+    dag = dag_utils.load_dag_from_yaml_str(yaml_str)
+    assert dag.name == 'my-group'
+    assert dag.is_job_group()
+    assert len(dag) == 2
+    assert dag.parents(dag.tasks[1]) == []   # parallel: no chain edges
+    # Round trip preserves execution mode.
+    s = dag_utils.dump_dag_to_yaml_str(dag)
+    dag2 = dag_utils.load_dag_from_yaml_str(s)
+    assert dag2.is_job_group() and len(dag2) == 2
+
+
+def test_load_chain_dag_yaml():
+    from skypilot_tpu.utils import dag_utils
+    yaml_str = """\
+name: pipe
+---
+name: stage1
+resources:
+  cloud: gcp
+  accelerators: v5e-4
+run: python a.py
+---
+name: stage2
+resources:
+  cloud: gcp
+  accelerators: v5e-4
+run: python b.py
+"""
+    dag = dag_utils.load_dag_from_yaml_str(yaml_str)
+    assert dag.is_chain()
+    assert not dag.is_job_group()
+    assert dag.parents(dag.tasks[1])[0].name == 'stage1'
+    # Single-doc YAML → one-task dag.
+    one = dag_utils.load_dag_from_yaml_str('run: echo hi\n')
+    assert len(one) == 1
+
+
+def test_gang_placement_seeds_failover_candidates():
+    # After optimize_job_group, each member's failover candidate list must
+    # lead with the gang's common region so provisioning honors the gang.
+    from skypilot_tpu import execution
+    from skypilot_tpu.dag import DagExecution
+    trainer = Task('trainer', run='t', resources=Resources(
+        cloud='gcp', accelerators='v5p-8', region='europe-west4'))
+    helper = Task('helper', run='h', resources=Resources(
+        cloud='gcp', accelerators='v5e-8'))
+    dag = Dag('grp')
+    dag.add(trainer)
+    dag.add(helper)
+    dag.set_execution(DagExecution.PARALLEL)
+    Optimizer.optimize(dag, quiet=True)
+    cands = execution._failover_candidates(helper, OptimizeTarget.COST)
+    assert cands[0].region == 'europe-west4'
+    # Other regions remain as availability fallbacks.
+    assert any(c.region != 'europe-west4' for c in cands)
