@@ -94,16 +94,24 @@ ALL_CLOUDS = list(_PROBES)
 
 
 def check(clouds: Optional[List[str]] = None) -> List[CheckResult]:
-    """Probe the given clouds (default: all) and persist enabled set."""
+    """Probe the given clouds (default: all) and persist enabled set.
+
+    A subset probe only updates the probed clouds' enablement — clouds
+    not probed keep their previous state (reference `sky check aws`
+    does not disable gcp).
+    """
+    probed = clouds or ALL_CLOUDS
     results = []
-    for cloud in clouds or ALL_CLOUDS:
+    for cloud in probed:
         probe = _PROBES.get(cloud)
         if probe is None:
             results.append(CheckResult(cloud, ok=False,
                                        reason=f'Unknown cloud {cloud!r}.'))
             continue
         results.append(probe())
-    state.set_enabled_clouds([r.cloud for r in results if r.ok])
+    enabled = set(state.get_enabled_clouds()) - set(probed)
+    enabled |= {r.cloud for r in results if r.ok}
+    state.set_enabled_clouds(sorted(enabled))
     return results
 
 

@@ -311,7 +311,11 @@ class AzureBlobStore(AbstractStore):
                 f'Upload to {target} failed: {rc.stderr}')
 
     def download(self, local_dir: str) -> None:
-        rc = _run(['azcopy', 'copy', self.url, local_dir, '--recursive'])
+        # `/*` syncs the container's *contents* into local_dir; without it
+        # azcopy nests the last source path element as a subdirectory,
+        # unlike every other store's download.
+        rc = _run(['azcopy', 'copy', f'{self.url}/*', local_dir,
+                   '--recursive'])
         if rc.returncode != 0:
             raise exceptions.StorageError(
                 f'Download from {self.url} failed: {rc.stderr}')

@@ -64,9 +64,11 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         'GCP provider currently supports TPU slices (CPU/GPU VMs via the '
         'compute provider are a future drop-in)')
     # Authorize the framework SSH key on every host of the slice.
+    # setup_gcp_authentication is copy-on-write; rebind rather than
+    # mutating the caller's dict in place.
     from skypilot_tpu import authentication
-    config.provider_config.update(
-        authentication.setup_gcp_authentication(config.provider_config))
+    config.provider_config = authentication.setup_gcp_authentication(
+        config.provider_config)
     s = topology.parse_tpu(config.tpu_slice)
     runtime_version = (config.runtime_version or
                        DEFAULT_RUNTIME_VERSIONS[s.generation])

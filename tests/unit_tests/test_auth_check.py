@@ -78,3 +78,17 @@ def test_core_check_bool_shape(tmp_path, monkeypatch):
     monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path))
     result = core.check(['local'])
     assert result == {'local': True}
+
+
+def test_subset_check_preserves_other_clouds(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path))
+    from skypilot_tpu import state
+    state.set_enabled_clouds(['gcp', 'local'])
+    # Probing only `local` must not disable gcp.
+    check_lib.check(['local'])
+    assert set(check_lib.enabled_clouds()) == {'gcp', 'local'}
+    # A failing subset probe disables only that cloud.
+    monkeypatch.setenv('GOOGLE_APPLICATION_CREDENTIALS', '/nonexistent')
+    check_lib.check(['gcp'])
+    enabled = set(check_lib.enabled_clouds())
+    assert 'local' in enabled
