@@ -138,9 +138,12 @@ def exec_cmd(cluster: str, task_yaml: str, env: tuple,
 
 @cli.command()
 @click.option('--refresh', '-r', is_flag=True, default=False)
-def status(refresh: bool) -> None:
-    """Show clusters."""
-    records = _engine().status(refresh=refresh)
+@click.option('--all-workspaces', '-u', is_flag=True, default=False,
+              help='Include clusters from every workspace.')
+def status(refresh: bool, all_workspaces: bool) -> None:
+    """Show clusters (scoped to the active workspace by default)."""
+    records = _engine().status(refresh=refresh,
+                               all_workspaces=all_workspaces)
     if not records:
         click.echo('No clusters.')
         return
@@ -543,6 +546,153 @@ def api_status() -> None:
     health = sdk.api_health()
     click.echo(f'{sdk.server_url()}: {health["status"]} '
                f'(v{health["version"]}, api {health["api_version"]})')
+
+
+def _remote() -> bool:
+    """True when ops should go through the API server (its RBAC applies;
+    acting on the local DB would mint tokens the server rejects)."""
+    return bool(os.environ.get('SKY_TPU_API_SERVER'))
+
+
+@cli.group()
+def users() -> None:
+    """User management + service-account tokens (RBAC)."""
+
+
+@users.command('ls')
+def users_ls() -> None:
+    if _remote():
+        from skypilot_tpu.client import sdk
+        rows = sdk.call('users.list')
+    else:
+        from skypilot_tpu import users as users_lib
+        users_lib.core.ensure_user()
+        rows = users_lib.list_users()
+    fmt = '{:<10} {:<16} {:<8}'
+    click.echo(fmt.format('ID', 'NAME', 'ROLE'))
+    for u in rows:
+        click.echo(fmt.format(u['id'], u['name'], u['role']))
+
+
+@users.command('role')
+@click.argument('user_id')
+@click.argument('role')
+def users_role(user_id: str, role: str) -> None:
+    if _remote():
+        from skypilot_tpu.client import sdk
+        sdk.call('users.role', {'user_id': user_id, 'role': role})
+    else:
+        from skypilot_tpu import users as users_lib
+        users_lib.update_role(user_id, role)
+    click.echo(f'{user_id}: role={role}')
+
+
+@users.command('token-create')
+@click.argument('name')
+@click.option('--expires-days', type=float, default=None)
+def users_token_create(name: str, expires_days: Optional[float]) -> None:
+    """Mint a service-account token (shown once; store it safely)."""
+    expires = expires_days * 86400 if expires_days else None
+    if _remote():
+        from skypilot_tpu.client import sdk
+        token = sdk.call('users.token_create',
+                         {'name': name, 'expires_in_s': expires})
+    else:
+        from skypilot_tpu import users as users_lib
+        token = users_lib.create_token(name, expires_in_s=expires)
+    click.echo(token)
+
+
+@users.command('tokens')
+def users_tokens() -> None:
+    if _remote():
+        from skypilot_tpu.client import sdk
+        rows = sdk.call('users.token_list')
+    else:
+        from skypilot_tpu import users as users_lib
+        rows = users_lib.list_tokens()
+    fmt = '{:<18} {:<14} {:<10} {:<8}'
+    click.echo(fmt.format('TOKEN_ID', 'NAME', 'USER', 'REVOKED'))
+    for t in rows:
+        click.echo(fmt.format(t['token_id'], t['name'], t['user_id'],
+                              'yes' if t['revoked'] else 'no'))
+
+
+@users.command('token-revoke')
+@click.argument('token_id')
+def users_token_revoke(token_id: str) -> None:
+    if _remote():
+        from skypilot_tpu.client import sdk
+        sdk.call('users.token_revoke', {'token_id': token_id})
+    else:
+        from skypilot_tpu import users as users_lib
+        users_lib.revoke_token(token_id)
+    click.echo(f'{token_id}: revoked')
+
+
+@cli.group()
+def workspaces() -> None:
+    """Workspaces: scoped cluster/config namespaces."""
+
+
+@workspaces.command('ls')
+def workspaces_ls() -> None:
+    from skypilot_tpu import workspaces as ws_lib
+    if _remote():
+        from skypilot_tpu.client import sdk
+        all_ws = sdk.call('workspaces.list')
+    else:
+        all_ws = ws_lib.get_workspaces()
+    active = ws_lib.active_workspace()
+    for name, cfg in all_ws.items():
+        mark = '*' if name == active else ' '
+        priv = ' (private)' if (cfg or {}).get('private') else ''
+        click.echo(f'{mark} {name}{priv}')
+
+
+@workspaces.command('create')
+@click.argument('name')
+@click.option('--private', is_flag=True, default=False)
+@click.option('--allowed-user', 'allowed_users', multiple=True)
+def workspaces_create(name: str, private: bool,
+                      allowed_users: tuple) -> None:
+    cfg = {}
+    if private:
+        cfg['private'] = True
+        cfg['allowed_users'] = list(allowed_users)
+    if _remote():
+        from skypilot_tpu.client import sdk
+        sdk.call('workspaces.create', {'name': name, 'config': cfg})
+    else:
+        from skypilot_tpu import workspaces as ws_lib
+        ws_lib.create_workspace(name, cfg)
+    click.echo(f'Workspace {name} created.')
+
+
+@workspaces.command('delete')
+@click.argument('name')
+def workspaces_delete(name: str) -> None:
+    if _remote():
+        from skypilot_tpu.client import sdk
+        sdk.call('workspaces.delete', {'name': name})
+    else:
+        from skypilot_tpu import workspaces as ws_lib
+        ws_lib.delete_workspace(name)
+    click.echo(f'Workspace {name} deleted.')
+
+
+@workspaces.command('switch')
+@click.argument('name')
+def workspaces_switch(name: str) -> None:
+    """Set the active workspace in the global config."""
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu import users as users_lib
+    from skypilot_tpu import workspaces as ws_lib
+    # Raises for unknown workspaces and for private ones that exclude
+    # the local identity.
+    ws_lib.check_workspace_permission(users_lib.core.ensure_user(), name)
+    config_lib.update_global({'active_workspace': name})
+    click.echo(f'Active workspace: {name}')
 
 
 def main() -> None:

@@ -33,16 +33,37 @@ def server_url() -> str:
             f'http://127.0.0.1:{common.DEFAULT_API_PORT}').rstrip('/')
 
 
-def _post(op: str, payload: Dict[str, Any]) -> str:
+def _auth_headers() -> Dict[str, str]:
+    """Bearer token from env/config (reference service-account auth)."""
+    token = (os.environ.get('SKY_TPU_API_TOKEN') or
+             config_lib.get_nested(('api_server', 'token')))
+    return {'Authorization': f'Bearer {token}'} if token else {}
+
+
+def _post_raw(op: str, payload: Dict[str, Any]) -> Dict[str, Any]:
     url = server_url()
     try:
-        r = requests_lib.post(f'{url}/{op}', json=payload, timeout=30)
+        r = requests_lib.post(f'{url}/{op}', json=payload, timeout=30,
+                              headers=_auth_headers())
     except requests_lib.RequestException as e:
         raise exceptions.ApiServerConnectionError(url) from e
-    if r.status_code == 400:
+    if r.status_code in (400, 401, 403):
         raise exceptions.SkyTpuError(r.json().get('error', r.text))
     r.raise_for_status()
-    return r.json()['request_id']
+    return r.json()
+
+
+def _post(op: str, payload: Dict[str, Any]) -> str:
+    return _post_raw(op, payload)['request_id']
+
+
+def call(op: str, payload: Optional[Dict[str, Any]] = None) -> Any:
+    """POST an op and block for its result (async ops poll /api/get;
+    sync ops like users.token_create answer inline)."""
+    resp = _post_raw(op, payload or {})
+    if 'result' in resp:
+        return resp['result']
+    return get(resp['request_id'])
 
 
 def _http_get(path: str, *, timeout=30, stream: bool = False):
@@ -52,7 +73,7 @@ def _http_get(path: str, *, timeout=30, stream: bool = False):
     url = server_url()
     try:
         r = requests_lib.get(f'{url}{path}', timeout=timeout,
-                             stream=stream)
+                             stream=stream, headers=_auth_headers())
         r.raise_for_status()
         return r
     except requests_lib.HTTPError as e:
@@ -125,9 +146,11 @@ def exec(task: task_lib.Task, cluster_name: str,  # noqa: A001
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> List[Dict[str, Any]]:
+           refresh: bool = False,
+           all_workspaces: bool = False) -> List[Dict[str, Any]]:
     rid = _post('status', {'cluster_names': cluster_names,
-                           'refresh': refresh})
+                           'refresh': refresh,
+                           'all_workspaces': all_workspaces})
     records = get(rid)
     for r in records:
         r['status'] = common.ClusterStatus(r['status'])

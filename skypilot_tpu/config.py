@@ -20,7 +20,12 @@ from typing import Any, Dict, Iterator, Optional, Tuple
 import yaml
 
 CONFIG_ENV_VAR = 'SKY_TPU_CONFIG'
-DEFAULT_CONFIG_PATH = '~/.sky_tpu/config.yaml'
+
+
+def _default_config_path() -> str:
+    """Under base_dir so SKY_TPU_HOME isolation covers the config too."""
+    from skypilot_tpu.utils import common
+    return os.path.join(common.base_dir(), 'config.yaml')
 
 _lock = threading.Lock()
 _global_config: Optional[Dict[str, Any]] = None
@@ -32,7 +37,7 @@ def _load_global() -> Dict[str, Any]:
     with _lock:
         if _global_config is None:
             path = os.path.expanduser(
-                os.environ.get(CONFIG_ENV_VAR, DEFAULT_CONFIG_PATH))
+                os.environ.get(CONFIG_ENV_VAR) or _default_config_path())
             if os.path.exists(path):
                 with open(path, 'r', encoding='utf-8') as f:
                     _global_config = yaml.safe_load(f) or {}
@@ -108,3 +113,38 @@ def override(config: Dict[str, Any]) -> Iterator[None]:
         yield
     finally:
         _local.overrides.pop()
+
+
+def update_global(patch: Dict[str, Any],
+                  replace_keys: Tuple[str, ...] = ()) -> Dict[str, Any]:
+    """Merge `patch` into the global config YAML on disk and reload.
+
+    Top-level keys listed in ``replace_keys`` are overwritten wholesale
+    instead of deep-merged (deletions inside them must stick).
+
+    The one sanctioned write path (reference workspaces/core.py
+    _update_workspaces_config rewrites ~/.sky/config.yaml the same way);
+    everything else treats config as immutable.
+    """
+    from skypilot_tpu.utils import locks
+    path = os.path.expanduser(
+        os.environ.get(CONFIG_ENV_VAR) or _default_config_path())
+    # Cross-process lock: concurrent workspace ops are read-modify-write
+    # on this file; unlocked, the last writer silently drops the other's
+    # update.
+    with locks.named_lock('global_config'):
+        current: Dict[str, Any] = {}
+        if os.path.exists(path):
+            with open(path, 'r', encoding='utf-8') as f:
+                current = yaml.safe_load(f) or {}
+        merged = _merge(current, patch)
+        for k in replace_keys:
+            if k in patch:
+                merged[k] = copy.deepcopy(patch[k])
+        os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+        tmp = f'{path}.{os.getpid()}.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            yaml.safe_dump(merged, f, sort_keys=False)
+        os.replace(tmp, path)
+    reload()
+    return merged

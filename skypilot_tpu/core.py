@@ -60,9 +60,15 @@ def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> List[Dict[str, Any]]:
-    """Reference sky/core.py:112."""
+           refresh: bool = False,
+           all_workspaces: bool = False) -> List[Dict[str, Any]]:
+    """Reference sky/core.py:112. Scoped to the active workspace unless
+    ``all_workspaces`` (reference `sky status` workspace scoping)."""
     records = state.get_clusters()
+    if not all_workspaces:
+        from skypilot_tpu import workspaces
+        ws = workspaces.active_workspace()
+        records = [r for r in records if r.get('workspace', 'default') == ws]
     if cluster_names:
         records = [r for r in records if r['name'] in cluster_names]
     if refresh:

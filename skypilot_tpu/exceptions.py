@@ -142,3 +142,15 @@ class NoCloudAccessError(SkyTpuError):
 
 class AuthenticationError(SkyTpuError):
     """SSH key generation / credential setup failure."""
+
+
+class UserNotFoundError(SkyTpuError):
+    """Unknown user or token id (reference users/server.py 404s)."""
+
+
+class PermissionDeniedError(SkyTpuError):
+    """RBAC blocked the request (reference permission.py enforcement)."""
+
+
+class WorkspaceError(SkyTpuError):
+    """Workspace validation/permission failure (reference workspaces/core)."""

@@ -89,6 +89,14 @@ def launch(
     Returns (job_id, cluster_info); job_id is -1 for run-less tasks.
     """
     task = admin_policy_lib.apply(task)
+    # Private-workspace gate (reference workspaces/core.py:659
+    # reject_request_for_unauthorized_workspace): the active workspace
+    # must admit the launching identity. Server-side, the HTTP layer has
+    # already authenticated the caller; here the local identity applies.
+    from skypilot_tpu import users as users_lib
+    from skypilot_tpu import workspaces as workspaces_lib
+    workspaces_lib.check_workspace_permission(
+        users_lib.core.ensure_user(), workspaces_lib.active_workspace())
     cluster_name = cluster_name or _generate_cluster_name()
     backend = backend or backend_lib.TpuVmBackend()
     run_stages = stages or [

@@ -48,6 +48,10 @@ logger = logging.getLogger(__name__)
 
 LONG_OPS = {'launch', 'exec', 'down', 'stop', 'start', 'jobs.launch',
             'serve.up', 'serve.down', 'serve.update'}
+# Ops answered inline, never persisted to the requests store — their
+# results are secrets (a cleartext token in the store would be readable
+# via /api/get by anyone, defeating the store-only-hashes design).
+SYNC_OPS = {'users.token_create'}
 
 
 class _ThreadRoutedWriter(io.TextIOBase):
@@ -162,7 +166,9 @@ class Server:
             def fn():
                 out = []
                 for r in core.status(payload.get('cluster_names'),
-                                     refresh=payload.get('refresh', False)):
+                                     refresh=payload.get('refresh', False),
+                                     all_workspaces=payload.get(
+                                         'all_workspaces', False)):
                     r = dict(r)
                     r['status'] = r['status'].value
                     out.append(r)
@@ -187,6 +193,10 @@ class Server:
             return functools.partial(core.check, payload.get('clouds'))
         if name == 'cost_report':
             return core.cost_report
+        if name.startswith('users.'):
+            return self._dispatch_users(name, payload)
+        if name.startswith('workspaces.'):
+            return self._dispatch_workspaces(name, payload)
         if name.startswith('jobs.') or name.startswith('serve.'):
             try:
                 if name.startswith('jobs.'):
@@ -197,6 +207,46 @@ class Server:
             except (ImportError, AttributeError) as e:
                 raise web.HTTPNotImplemented(
                     text=f'op {name} not available: {e}') from e
+        raise web.HTTPNotFound(text=f'unknown op {name}')
+
+    def _dispatch_users(self, name, payload):
+        from skypilot_tpu import users as users_lib
+        if name == 'users.list':
+            return users_lib.list_users
+        if name == 'users.role':
+            return functools.partial(users_lib.update_role,
+                                     payload['user_id'], payload['role'])
+        if name == 'users.delete':
+            return functools.partial(users_lib.delete_user,
+                                     payload['user_id'])
+        if name == 'users.token_create':
+            return functools.partial(
+                users_lib.create_token, payload['name'],
+                payload.get('user_id'), payload.get('expires_in_s'),
+                caller=payload.get('_caller'))
+        if name == 'users.token_list':
+            return functools.partial(users_lib.list_tokens,
+                                     payload.get('user_id'))
+        if name == 'users.token_revoke':
+            return functools.partial(users_lib.revoke_token,
+                                     payload['token_id'])
+        raise web.HTTPNotFound(text=f'unknown op {name}')
+
+    def _dispatch_workspaces(self, name, payload):
+        from skypilot_tpu import workspaces as ws_lib
+        if name == 'workspaces.list':
+            return ws_lib.get_workspaces
+        if name == 'workspaces.create':
+            return functools.partial(ws_lib.create_workspace,
+                                     payload['name'],
+                                     payload.get('config'))
+        if name == 'workspaces.update':
+            return functools.partial(ws_lib.update_workspace,
+                                     payload['name'],
+                                     payload.get('config') or {})
+        if name == 'workspaces.delete':
+            return functools.partial(ws_lib.delete_workspace,
+                                     payload['name'])
         raise web.HTTPNotFound(text=f'unknown op {name}')
 
     def _dispatch_jobs(self, name, payload, jobs_lib):
@@ -235,6 +285,12 @@ class Server:
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
             return web.json_response(
                 {'error': f'malformed JSON body: {e}'}, status=400)
+        if name in SYNC_OPS:
+            # The caller's resolved identity gates self-service ops; an
+            # anonymous loopback caller acts as the default role.
+            from skypilot_tpu.users import rbac
+            payload['_caller'] = req.get('user') or {
+                'id': None, 'role': rbac.get_default_role()}
         try:
             fn = self._dispatch(name, payload)
         except web.HTTPException:
@@ -242,6 +298,14 @@ class Server:
         except KeyError as e:
             return web.json_response(
                 {'error': f'missing field {e}'}, status=400)
+        if name in SYNC_OPS:
+            loop = asyncio.get_event_loop()
+            try:
+                result = await loop.run_in_executor(self.short_pool, fn)
+            except exceptions.SkyTpuError as e:
+                return web.json_response(
+                    {'error': f'{type(e).__name__}: {e}'}, status=403)
+            return web.json_response({'result': result})
         request_id = self.submit(name, payload, fn)
         return web.json_response({'request_id': request_id})
 
@@ -365,8 +429,52 @@ class Server:
         return web.Response(text=metrics_lib.render(),
                             content_type='text/plain')
 
+    # ---- auth / RBAC middleware -----------------------------------------
+    @staticmethod
+    @web.middleware
+    async def auth_middleware(req: web.Request, handler):
+        """Bearer-token auth + RBAC (reference server.py bearer-token
+        middleware :363 and RBAC middleware :167).
+
+        Modes: with an ``Authorization: Bearer sky_...`` header the token
+        must verify and the resolved role is enforced against the RBAC
+        blocklist. Without one, the request is allowed only when
+        ``api_server.require_auth`` is unset (single-user/loopback mode,
+        reference loopback auth) and runs as the default role.
+        """
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu import users as users_lib
+        from skypilot_tpu.users import rbac
+        if req.path in ('/api/health', '/metrics'):
+            return await handler(req)
+        authz = req.headers.get('Authorization', '')
+        server: 'Server' = req.app['server']
+        loop = asyncio.get_event_loop()
+        user = None
+        if authz.startswith('Bearer '):
+            # Token resolution hits sqlite (verify + touch_token commit):
+            # off the event loop, like every other blocking call here.
+            user = await loop.run_in_executor(
+                server.short_pool, users_lib.core.authenticate,
+                authz[len('Bearer '):])
+            if user is None:
+                return web.json_response(
+                    {'error': 'invalid or revoked token'}, status=401)
+        elif config_lib.get_nested(('api_server', 'require_auth'), False):
+            return web.json_response(
+                {'error': 'authentication required '
+                          '(Authorization: Bearer <token>)'}, status=401)
+        role = (user or {}).get('role') or rbac.get_default_role()
+        if not rbac.check_permission(role, req.path, req.method):
+            return web.json_response(
+                {'error': f'role {role!r} may not {req.method} '
+                          f'{req.path}'}, status=403)
+        req['user'] = user
+        return await handler(req)
+
     def make_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(middlewares=[self.auth_middleware])
+        app['server'] = self
         app.router.add_get('/api/health', self.h_health)
         app.router.add_get('/metrics', self.h_metrics)
         app.router.add_get('/api/requests', self.h_requests)

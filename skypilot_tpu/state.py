@@ -63,6 +63,26 @@ CREATE TABLE IF NOT EXISTS enabled_clouds (
     cloud TEXT PRIMARY KEY,
     enabled_at REAL
 );
+CREATE TABLE IF NOT EXISTS users (
+    id TEXT PRIMARY KEY,
+    name TEXT,
+    role TEXT,
+    created_at REAL
+);
+CREATE TABLE IF NOT EXISTS service_account_tokens (
+    token_id TEXT PRIMARY KEY,
+    name TEXT,
+    user_id TEXT,
+    token_hash TEXT,
+    created_at REAL,
+    last_used_at REAL,
+    expires_at REAL,
+    revoked INTEGER DEFAULT 0
+);
+CREATE TABLE IF NOT EXISTS kv_secrets (
+    key TEXT PRIMARY KEY,
+    value TEXT
+);
 """
 
 
@@ -78,8 +98,13 @@ def add_or_update_cluster(name: str,
                           resources_config: Optional[Dict[str, Any]] = None,
                           cluster_info: Optional[Dict[str, Any]] = None,
                           task_yaml: Optional[str] = None,
-                          user: Optional[str] = None) -> None:
+                          user: Optional[str] = None,
+                          workspace: Optional[str] = None) -> None:
     """Reference sky/global_user_state.py:611."""
+    if workspace is None:
+        # Lazy import: workspaces imports state at module load.
+        from skypilot_tpu import workspaces
+        workspace = workspaces.active_workspace()
     conn = _db().conn
     now = time.time()
     # Atomic upsert: concurrent callers for the same name must not race a
@@ -87,8 +112,8 @@ def add_or_update_cluster(name: str,
     # values mean "keep the existing column on update".
     conn.execute(
         'INSERT INTO clusters (name, launched_at, last_use, status, '
-        'resources_json, cluster_info_json, task_yaml, user, '
-        'status_updated_at) VALUES (?,?,?,?,?,?,?,?,?) '
+        'resources_json, cluster_info_json, task_yaml, user, workspace, '
+        'status_updated_at) VALUES (?,?,?,?,?,?,?,?,?,?) '
         'ON CONFLICT(name) DO UPDATE SET '
         'status=excluded.status, '
         'status_updated_at=excluded.status_updated_at, '
@@ -102,7 +127,7 @@ def add_or_update_cluster(name: str,
          else None,
          json.dumps(cluster_info) if cluster_info is not None else None,
          task_yaml,
-         user or os.environ.get('USER', 'unknown'), now))
+         user or os.environ.get('USER', 'unknown'), workspace, now))
     conn.commit()
 
 
@@ -210,3 +235,94 @@ def set_enabled_clouds(clouds: List[str]) -> None:
 def get_enabled_clouds() -> List[str]:
     rows = _db().conn.execute('SELECT cloud FROM enabled_clouds').fetchall()
     return [r['cloud'] for r in rows]
+
+
+# ---- users / RBAC (reference sky/global_user_state.py:361,520) -----------
+def add_or_update_user(user_id: str, name: str,
+                       role: Optional[str] = None) -> None:
+    conn = _db().conn
+    conn.execute(
+        'INSERT INTO users (id, name, role, created_at) VALUES (?,?,?,?) '
+        'ON CONFLICT(id) DO UPDATE SET name=excluded.name, '
+        'role=COALESCE(excluded.role, users.role)',
+        (user_id, name, role, time.time()))
+    conn.commit()
+
+
+def get_user(user_id: str) -> Optional[Dict[str, Any]]:
+    row = _db().conn.execute('SELECT * FROM users WHERE id=?',
+                             (user_id,)).fetchone()
+    return dict(row) if row else None
+
+
+def get_users() -> List[Dict[str, Any]]:
+    rows = _db().conn.execute('SELECT * FROM users ORDER BY id').fetchall()
+    return [dict(r) for r in rows]
+
+
+def set_user_role(user_id: str, role: str) -> None:
+    conn = _db().conn
+    conn.execute('UPDATE users SET role=? WHERE id=?', (role, user_id))
+    conn.commit()
+
+
+def delete_user(user_id: str) -> None:
+    conn = _db().conn
+    conn.execute('DELETE FROM users WHERE id=?', (user_id,))
+    conn.execute('DELETE FROM service_account_tokens WHERE user_id=?',
+                 (user_id,))
+    conn.commit()
+
+
+# ---- service account tokens (reference sky/users/token_service.py) -------
+def add_token(token_id: str, name: str, user_id: str, token_hash: str,
+              expires_at: Optional[float]) -> None:
+    conn = _db().conn
+    conn.execute(
+        'INSERT INTO service_account_tokens (token_id, name, user_id, '
+        'token_hash, created_at, expires_at) VALUES (?,?,?,?,?,?)',
+        (token_id, name, user_id, token_hash, time.time(), expires_at))
+    conn.commit()
+
+
+def get_token(token_id: str) -> Optional[Dict[str, Any]]:
+    row = _db().conn.execute(
+        'SELECT * FROM service_account_tokens WHERE token_id=?',
+        (token_id,)).fetchone()
+    return dict(row) if row else None
+
+
+def get_tokens(user_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    q = 'SELECT * FROM service_account_tokens'
+    args: tuple = ()
+    if user_id is not None:
+        q += ' WHERE user_id=?'
+        args = (user_id,)
+    rows = _db().conn.execute(q + ' ORDER BY created_at', args).fetchall()
+    return [dict(r) for r in rows]
+
+
+def revoke_token(token_id: str) -> None:
+    conn = _db().conn
+    conn.execute('UPDATE service_account_tokens SET revoked=1 '
+                 'WHERE token_id=?', (token_id,))
+    conn.commit()
+
+
+def touch_token(token_id: str) -> None:
+    conn = _db().conn
+    conn.execute('UPDATE service_account_tokens SET last_used_at=? '
+                 'WHERE token_id=?', (time.time(), token_id))
+    conn.commit()
+
+
+# ---- kv secrets (server-side signing secret) -----------------------------
+def get_or_create_secret(key: str, generate) -> str:
+    """Atomic get-or-create: concurrent servers must agree on one value."""
+    conn = _db().conn
+    conn.execute('INSERT OR IGNORE INTO kv_secrets (key, value) '
+                 'VALUES (?,?)', (key, generate()))
+    conn.commit()
+    row = conn.execute('SELECT value FROM kv_secrets WHERE key=?',
+                       (key,)).fetchone()
+    return row['value']

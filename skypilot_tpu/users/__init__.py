@@ -1,0 +1,12 @@
+"""User management + RBAC (reference ``sky/users/``: rbac.py roles and
+blocklists, permission.py enforcement, token_service.py service-account
+tokens)."""
+from skypilot_tpu.users.core import (create_token, delete_user, get_user,
+                                     list_tokens, list_users, revoke_token,
+                                     update_role)
+from skypilot_tpu.users.rbac import RoleName, check_permission
+
+__all__ = [
+    'RoleName', 'check_permission', 'create_token', 'delete_user',
+    'get_user', 'list_tokens', 'list_users', 'revoke_token', 'update_role',
+]

@@ -1,0 +1,112 @@
+"""User-management server ops (reference ``sky/users/server.py`` endpoints
+backed by global_user_state user rows)."""
+from __future__ import annotations
+
+import getpass
+import hashlib
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+from skypilot_tpu.users import rbac
+from skypilot_tpu.users import token_service
+
+
+def current_user_id() -> str:
+    """Stable id for the local OS user (reference hashes the username the
+    same way for its default identity)."""
+    name = getpass.getuser()
+    return hashlib.md5(name.encode()).hexdigest()[:8]
+
+
+def ensure_user(user_id: Optional[str] = None,
+                name: Optional[str] = None) -> Dict[str, Any]:
+    """Get-or-create, assigning the default role on first sight."""
+    user_id = user_id or current_user_id()
+    user = state.get_user(user_id)
+    if user is None:
+        state.add_or_update_user(user_id, name or getpass.getuser(),
+                                 rbac.get_default_role())
+        user = state.get_user(user_id)
+    return user
+
+
+def get_user(user_id: str) -> Optional[Dict[str, Any]]:
+    return state.get_user(user_id)
+
+
+def list_users() -> List[Dict[str, Any]]:
+    return state.get_users()
+
+
+def update_role(user_id: str, role: str) -> None:
+    if role not in rbac.get_supported_roles():
+        raise exceptions.InvalidTaskError(
+            f'Unknown role {role!r}; supported: '
+            f'{rbac.get_supported_roles()}')
+    if state.get_user(user_id) is None:
+        raise exceptions.UserNotFoundError(f'No such user: {user_id}')
+    state.set_user_role(user_id, role)
+
+
+def delete_user(user_id: str) -> None:
+    if state.get_user(user_id) is None:
+        raise exceptions.UserNotFoundError(f'No such user: {user_id}')
+    state.delete_user(user_id)
+
+
+def create_token(name: str, user_id: Optional[str] = None,
+                 expires_in_s: Optional[float] = None,
+                 caller: Optional[Dict[str, Any]] = None) -> str:
+    """Mint a token.
+
+    ``user_id=None`` means "for the calling identity" (auto-created on
+    first sight). An explicit user_id must already exist — auto-creating
+    it would hand out default-role (often admin) credentials — and a
+    non-admin ``caller`` may only mint tokens for itself (privilege
+    escalation otherwise: a user-role caller minting an admin's token).
+    """
+    if user_id is None:
+        # Self-service: the authenticated caller's identity, else the
+        # local OS user (direct/loopback mode).
+        if caller is not None and caller.get('id'):
+            user = state.get_user(caller['id'])
+            if user is None:
+                raise exceptions.UserNotFoundError(
+                    f'Caller {caller["id"]!r} has no user record.')
+        else:
+            user = ensure_user()
+    else:
+        user = state.get_user(user_id)
+        if user is None:
+            raise exceptions.UserNotFoundError(
+                f'No such user: {user_id} (tokens are only minted for '
+                f'existing users)')
+        if (caller is not None and
+                caller.get('role') != rbac.RoleName.ADMIN.value and
+                caller.get('id') != user['id']):
+            raise exceptions.PermissionDeniedError(
+                f'Role {caller.get("role")!r} may only mint tokens for '
+                f'itself, not for user {user["id"]!r}.')
+    return token_service.create_token(name, user['id'], expires_in_s)
+
+
+def list_tokens(user_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    rows = state.get_tokens(user_id)
+    for r in rows:
+        r.pop('token_hash', None)   # never expose even hashes
+    return rows
+
+
+def revoke_token(token_id: str) -> None:
+    if state.get_token(token_id) is None:
+        raise exceptions.UserNotFoundError(f'No such token: {token_id}')
+    state.revoke_token(token_id)
+
+
+def authenticate(token: str) -> Optional[Dict[str, Any]]:
+    """Resolve a bearer token to its user record (with role)."""
+    payload = token_service.verify_token(token)
+    if payload is None:
+        return None
+    return state.get_user(payload['uid'])

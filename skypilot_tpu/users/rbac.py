@@ -1,0 +1,71 @@
+"""Role-based access control (reference ``sky/users/rbac.py``: RoleName
+at :49, config-driven role permissions at :63, default-user blocklist).
+
+Enforcement model matches the reference: roles carry a *blocklist* of
+(path, method) rules; everything not blocked is allowed. Admins have an
+empty blocklist. The server's auth middleware calls
+``check_permission(role, path, method)`` per request.
+"""
+from __future__ import annotations
+
+import enum
+import fnmatch
+from typing import Dict, List
+
+from skypilot_tpu import config
+
+
+class RoleName(str, enum.Enum):
+    ADMIN = 'admin'
+    USER = 'user'
+
+
+# Mutating control-plane surfaces a plain user cannot touch (reference
+# _DEFAULT_USER_BLOCKLIST: workspace config updates, user role changes).
+_DEFAULT_USER_BLOCKLIST: List[Dict[str, str]] = [
+    {'path': '/users.role', 'method': 'POST'},
+    {'path': '/users.delete', 'method': 'POST'},
+    {'path': '/users.token_revoke', 'method': 'POST'},
+    {'path': '/workspaces.create', 'method': 'POST'},
+    {'path': '/workspaces.update', 'method': 'POST'},
+    {'path': '/workspaces.delete', 'method': 'POST'},
+]
+
+
+def get_supported_roles() -> List[str]:
+    return [r.value for r in RoleName]
+
+
+def get_default_role() -> str:
+    """Role assigned to users on first sight (reference rbac.py:58;
+    default admin keeps single-user deployments frictionless)."""
+    return config.get_nested(('rbac', 'default_role'),
+                             RoleName.ADMIN.value)
+
+
+def get_role_permissions() -> Dict[str, Dict[str, List[Dict[str, str]]]]:
+    """Blocklist per role, overridable from config ``rbac.roles``."""
+    roles: Dict[str, Dict[str, List[Dict[str, str]]]] = {
+        RoleName.ADMIN.value: {'blocklist': []},
+        RoleName.USER.value: {'blocklist': list(_DEFAULT_USER_BLOCKLIST)},
+    }
+    for role, spec in (config.get_nested(('rbac', 'roles'), {}) or {}).items():
+        role = role.lower()
+        if role not in roles:
+            continue
+        blocklist = (spec or {}).get('permissions', {}).get('blocklist')
+        if blocklist is not None:
+            roles[role] = {'blocklist': list(blocklist)}
+    return roles
+
+
+def check_permission(role: str, path: str, method: str) -> bool:
+    """True when `role` may call `method path`. Unknown roles get the
+    most-restricted (user) blocklist."""
+    perms = get_role_permissions()
+    spec = perms.get(role, perms[RoleName.USER.value])
+    for rule in spec['blocklist']:
+        if (fnmatch.fnmatch(path, rule['path']) and
+                method.upper() == rule.get('method', 'POST').upper()):
+            return False
+    return True

@@ -1,0 +1,78 @@
+"""Service-account bearer tokens (reference ``sky/users/token_service.py``:
+JWT-style tokens signed with a DB-persisted secret, token records with
+revocation + last-used tracking).
+
+PyJWT is not a baked-in dependency, so tokens are stdlib HMAC-SHA256:
+``sky_<token_id>_<base64url(payload)>_<hex sig>``. The payload carries
+(token_id, user_id, exp); the DB row carries a *hash* of the full token
+so a leaked DB does not leak usable credentials (same property the
+reference gets from storing only token hashes).
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import secrets as pysecrets
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import state
+
+_SECRET_KEY = 'token_signing_secret'
+TOKEN_PREFIX = 'sky'
+
+
+def _secret() -> bytes:
+    return state.get_or_create_secret(
+        _SECRET_KEY, lambda: pysecrets.token_hex(32)).encode()
+
+
+def _sign(msg: bytes) -> str:
+    return hmac.new(_secret(), msg, hashlib.sha256).hexdigest()
+
+
+def token_hash(token: str) -> str:
+    return hashlib.sha256(token.encode()).hexdigest()
+
+
+def create_token(name: str, user_id: str,
+                 expires_in_s: Optional[float] = None) -> str:
+    """Mint a token. The cleartext is returned exactly once."""
+    token_id = pysecrets.token_hex(8)
+    expires_at = time.time() + expires_in_s if expires_in_s else None
+    payload = {'tid': token_id, 'uid': user_id, 'exp': expires_at}
+    body = base64.urlsafe_b64encode(
+        json.dumps(payload, separators=(',', ':')).encode()).decode()
+    sig = _sign(body.encode())
+    token = f'{TOKEN_PREFIX}_{token_id}_{body}_{sig}'
+    state.add_token(token_id, name, user_id, token_hash(token), expires_at)
+    return token
+
+
+def verify_token(token: str) -> Optional[Dict[str, Any]]:
+    """Payload dict if the token is valid, unrevoked and unexpired."""
+    # base64url bodies may themselves contain '_': split off the hex sig
+    # from the right, then prefix/tid (both '_'-free) from the left.
+    head, _, sig = token.rpartition('_')
+    parts = head.split('_', 2)
+    if not sig or len(parts) != 3 or parts[0] != TOKEN_PREFIX:
+        return None
+    _, token_id, body = parts
+    if not hmac.compare_digest(sig, _sign(body.encode())):
+        return None
+    try:
+        payload = json.loads(base64.urlsafe_b64decode(body))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    record = state.get_token(token_id)
+    if record is None or record['revoked']:
+        return None
+    if not hmac.compare_digest(record['token_hash'], token_hash(token)):
+        return None
+    exp = payload.get('exp')
+    if exp is not None and time.time() > exp:
+        return None
+    state.touch_token(token_id)
+    return payload

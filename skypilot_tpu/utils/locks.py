@@ -28,3 +28,24 @@ def cluster_lock(cluster_name: str,
                              timeout=timeout)
     with lock:
         yield
+
+
+# One FileLock instance per path: distinct instances on the same path
+# conflict even within a process (flock is per-open-file), so nested
+# named_lock() calls (workspace CRUD -> config.update_global) would
+# deadlock. A shared instance is reentrant and still serializes threads.
+_named_locks: dict = {}
+_named_locks_guard = __import__('threading').Lock()
+
+
+@contextlib.contextmanager
+def named_lock(name: str, timeout: float = 60.0) -> Iterator[None]:
+    """General-purpose cross-process lock (config writes, etc.)."""
+    path = _lock_path(name)
+    with _named_locks_guard:
+        lock = _named_locks.get(path)
+        if lock is None:
+            lock = filelock.FileLock(path, timeout=timeout)
+            _named_locks[path] = lock
+    with lock:
+        yield

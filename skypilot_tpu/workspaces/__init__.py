@@ -1,0 +1,13 @@
+"""Multi-workspace config scoping (reference ``sky/workspaces/``)."""
+from skypilot_tpu.workspaces.core import (accessible_workspaces,
+                                          active_workspace,
+                                          check_workspace_permission,
+                                          create_workspace,
+                                          delete_workspace, get_workspaces,
+                                          update_workspace)
+
+__all__ = [
+    'accessible_workspaces', 'active_workspace',
+    'check_workspace_permission', 'create_workspace', 'delete_workspace',
+    'get_workspaces', 'update_workspace',
+]

@@ -1,0 +1,152 @@
+"""Workspace CRUD + access control (reference ``sky/workspaces/core.py``:
+get_workspaces :67, create :416, update :358, delete :465,
+check_workspace_permission :641).
+
+A workspace is a named section of the global config that scopes clusters
+and can pin per-cloud settings (e.g. a GCP project per team). Clusters are
+tagged with the active workspace at launch; `status` filters by it. A
+workspace with ``private: true`` is visible only to ``allowed_users``
+(and admins).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import config
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+from skypilot_tpu.users import rbac
+from skypilot_tpu.utils import locks
+
+DEFAULT_WORKSPACE = 'default'
+ACTIVE_ENV_VAR = 'SKY_TPU_WORKSPACE'
+
+
+def get_workspaces() -> Dict[str, Any]:
+    """All configured workspaces; `default` always exists."""
+    ws = config.get_nested(('workspaces',), {}) or {}
+    if DEFAULT_WORKSPACE not in ws:
+        ws = {DEFAULT_WORKSPACE: {}, **ws}
+    return ws
+
+
+def active_workspace() -> str:
+    """Env override > config ``active_workspace`` > default."""
+    import os
+    env = os.environ.get(ACTIVE_ENV_VAR)
+    if env:
+        return env
+    return config.get_nested(('active_workspace',), DEFAULT_WORKSPACE)
+
+
+def _validate_name(name: str) -> None:
+    if not name or not name.replace('-', '').replace('_', '').isalnum():
+        raise exceptions.WorkspaceError(
+            f'Invalid workspace name {name!r}: alphanumeric, - and _ only.')
+
+
+def _validate_config(name: str, ws_config: Dict[str, Any]) -> None:
+    if not isinstance(ws_config, dict):
+        raise exceptions.WorkspaceError(
+            f'Workspace {name!r} config must be a mapping.')
+    allowed = {'private', 'allowed_users', 'gcp', 'clouds', 'description'}
+    unknown = set(ws_config) - allowed
+    if unknown:
+        raise exceptions.WorkspaceError(
+            f'Unknown workspace fields {sorted(unknown)}; '
+            f'allowed: {sorted(allowed)}')
+
+
+def create_workspace(name: str,
+                     ws_config: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    _validate_name(name)
+    ws_config = ws_config or {}
+    _validate_config(name, ws_config)
+    # Lock spans the read-modify-write: a concurrent create must not be
+    # dropped by this one's wholesale rewrite (POSIX locks are
+    # per-process, so update_global's nested acquire is safe).
+    with locks.named_lock('global_config'):
+        config.reload()
+        workspaces = get_workspaces()
+        if name in workspaces and name != DEFAULT_WORKSPACE:
+            raise exceptions.WorkspaceError(
+                f'Workspace {name!r} already exists.')
+        workspaces[name] = ws_config
+        config.update_global({'workspaces': workspaces},
+                             replace_keys=('workspaces',))
+    return workspaces
+
+
+def update_workspace(name: str,
+                     ws_config: Dict[str, Any]) -> Dict[str, Any]:
+    _validate_config(name, ws_config)
+    with locks.named_lock('global_config'):
+        config.reload()
+        workspaces = get_workspaces()
+        if name not in workspaces:
+            raise exceptions.WorkspaceError(f'No such workspace: {name!r}')
+        workspaces[name] = ws_config
+        config.update_global({'workspaces': workspaces},
+                             replace_keys=('workspaces',))
+    return workspaces
+
+
+def delete_workspace(name: str) -> Dict[str, Any]:
+    if name == DEFAULT_WORKSPACE:
+        raise exceptions.WorkspaceError(
+            'The default workspace cannot be deleted.')
+    with locks.named_lock('global_config'):
+        config.reload()
+        workspaces = get_workspaces()
+        if name not in workspaces:
+            raise exceptions.WorkspaceError(f'No such workspace: {name!r}')
+        # Active clusters pin their workspace (reference delete_workspace
+        # refuses while clusters reference it).
+        in_use = [c['name'] for c in state.get_clusters()
+                  if c.get('workspace') == name]
+        if in_use:
+            raise exceptions.WorkspaceError(
+                f'Workspace {name!r} still has clusters: {in_use}. '
+                f'Down them first.')
+        del workspaces[name]
+        config.update_global({'workspaces': workspaces},
+                             replace_keys=('workspaces',))
+    return workspaces
+
+
+def is_workspace_private(ws_config: Dict[str, Any]) -> bool:
+    return bool((ws_config or {}).get('private', False))
+
+
+def check_workspace_permission(user: Optional[Dict[str, Any]],
+                               workspace: str) -> None:
+    """Raise unless `user` may use `workspace` (reference :641)."""
+    ws_config = get_workspaces().get(workspace)
+    if ws_config is None:
+        raise exceptions.WorkspaceError(f'No such workspace: {workspace!r}')
+    if not is_workspace_private(ws_config):
+        return
+    if user is None:
+        raise exceptions.PermissionDeniedError(
+            f'Workspace {workspace!r} is private; authentication required.')
+    if user.get('role') == rbac.RoleName.ADMIN.value:
+        return
+    allowed = ws_config.get('allowed_users', []) or []
+    if user.get('id') in allowed or user.get('name') in allowed:
+        return
+    raise exceptions.PermissionDeniedError(
+        f'User {user.get("name")!r} is not in workspace '
+        f'{workspace!r} allowed_users.')
+
+
+def accessible_workspaces(user: Optional[Dict[str, Any]]
+                          ) -> List[str]:
+    out = []
+    for name in get_workspaces():
+        try:
+            check_workspace_permission(user, name)
+            out.append(name)
+        except (exceptions.PermissionDeniedError, exceptions.WorkspaceError):
+            continue
+    return out

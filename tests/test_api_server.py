@@ -104,3 +104,52 @@ def test_serve_roundtrip_via_server(api_server):
 
     sdk.serve_down('svc-api')
     assert sdk.serve_status() == []
+
+
+def test_auth_rbac_flow(api_server, sky_tpu_home):
+    """Bearer-token auth + RBAC blocklist (reference server.py:167,363)."""
+    # Anonymous loopback mode: allowed, default role admin.
+    r = requests.post(f'{api_server}/users.list', json={}, timeout=5)
+    assert r.status_code == 200
+
+    # Mint a token for a 'user'-role account directly in the state DB the
+    # server shares (same SKY_TPU_HOME).
+    from skypilot_tpu import users as users_lib
+    users_lib.core.ensure_user('limited', 'lim')
+    users_lib.update_role('limited', 'user')
+    token = users_lib.create_token('ci', user_id='limited')
+
+    hdr = {'Authorization': f'Bearer {token}'}
+    # Allowed op for user role.
+    r = requests.post(f'{api_server}/users.token_list',
+                      json={'user_id': 'limited'}, headers=hdr, timeout=5)
+    assert r.status_code == 200
+    # Blocked op for user role -> 403.
+    r = requests.post(f'{api_server}/users.role',
+                      json={'user_id': 'limited', 'role': 'admin'},
+                      headers=hdr, timeout=5)
+    assert r.status_code == 403
+    # Invalid token -> 401.
+    r = requests.post(f'{api_server}/users.list', json={},
+                      headers={'Authorization': 'Bearer sky_bogus'},
+                      timeout=5)
+    assert r.status_code == 401
+    # Health stays public.
+    assert requests.get(f'{api_server}/api/health', timeout=5).ok
+
+
+def test_workspaces_ops_via_server(api_server):
+    from skypilot_tpu.client import sdk
+    rid = requests.post(f'{api_server}/workspaces.create',
+                        json={'name': 'api-ws'},
+                        timeout=5).json()['request_id']
+    res = sdk.get(rid)
+    assert 'api-ws' in res
+    rid = requests.post(f'{api_server}/workspaces.list', json={},
+                        timeout=5).json()['request_id']
+    assert 'api-ws' in sdk.get(rid)
+    rid = requests.post(f'{api_server}/workspaces.delete',
+                        json={'name': 'api-ws'},
+                        timeout=5).json()['request_id']
+    res = sdk.get(rid)
+    assert 'api-ws' not in res

@@ -1,0 +1,262 @@
+"""Users/RBAC, service-account tokens, workspaces.
+
+Reference coverage model: sky/users (rbac roles + blocklist,
+token_service signed tokens) and sky/workspaces (CRUD + private
+workspace permissions), tested offline against sqlite state.
+"""
+import os
+
+import pytest
+
+from skypilot_tpu import config
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+from skypilot_tpu import users
+from skypilot_tpu import workspaces
+from skypilot_tpu.users import rbac
+from skypilot_tpu.users import token_service
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path))
+    monkeypatch.setenv('SKY_TPU_CONFIG', str(tmp_path / 'config.yaml'))
+    monkeypatch.delenv('SKY_TPU_WORKSPACE', raising=False)
+    config.reload()
+    yield
+    config.reload()
+
+
+# ---- users / roles -------------------------------------------------------
+def test_ensure_user_default_role():
+    u = users.core.ensure_user()
+    assert u['role'] == 'admin'   # default_role default
+    assert users.get_user(u['id']) == u
+
+
+def test_update_role_and_validation():
+    u = users.core.ensure_user('u1', 'alice')
+    users.update_role('u1', 'user')
+    assert users.get_user('u1')['role'] == 'user'
+    with pytest.raises(exceptions.InvalidTaskError):
+        users.update_role('u1', 'superadmin')
+    with pytest.raises(exceptions.UserNotFoundError):
+        users.update_role('ghost', 'user')
+    del u
+
+
+def test_delete_user_removes_tokens():
+    users.core.ensure_user('u2', 'bob')
+    users.create_token('t', user_id='u2')
+    assert users.list_tokens('u2')
+    users.delete_user('u2')
+    assert users.get_user('u2') is None
+    assert not users.list_tokens('u2')
+
+
+# ---- tokens --------------------------------------------------------------
+def test_token_roundtrip():
+    users.core.ensure_user('u3', 'carol')
+    token = users.create_token('ci', user_id='u3')
+    assert token.startswith('sky_')
+    user = users.core.authenticate(token)
+    assert user['id'] == 'u3'
+    # last_used is tracked
+    (rec,) = users.list_tokens('u3')
+    assert rec['last_used_at'] is not None
+    assert 'token_hash' not in rec
+
+
+def test_token_revocation_and_tamper():
+    users.core.ensure_user('u4', 'dan')
+    token = users.create_token('x', user_id='u4')
+    (rec,) = users.list_tokens('u4')
+    users.revoke_token(rec['token_id'])
+    assert users.core.authenticate(token) is None
+    # Tampered signature fails.
+    t2 = users.create_token('y', user_id='u4')
+    head, _, _sig = t2.rpartition('_')
+    assert users.core.authenticate(head + '_' + 'f' * 64) is None
+    # Garbage fails without raising.
+    assert users.core.authenticate('sky_nope') is None
+
+
+def test_token_expiry():
+    users.core.ensure_user('u5', 'eve')
+    token = users.create_token('short', user_id='u5', expires_in_s=-1)
+    assert users.core.authenticate(token) is None
+
+
+def test_secret_stable_across_calls():
+    s1 = token_service._secret()
+    s2 = token_service._secret()
+    assert s1 == s2
+
+
+# ---- rbac ----------------------------------------------------------------
+def test_rbac_blocklist():
+    assert rbac.check_permission('admin', '/users.role', 'POST')
+    assert not rbac.check_permission('user', '/users.role', 'POST')
+    assert not rbac.check_permission('user', '/workspaces.delete', 'POST')
+    assert rbac.check_permission('user', '/launch', 'POST')
+    # Unknown role gets user restrictions.
+    assert not rbac.check_permission('mystery', '/users.role', 'POST')
+
+
+def test_rbac_config_override():
+    override_cfg = {
+        'rbac': {
+            'roles': {
+                'user': {
+                    'permissions': {
+                        'blocklist': [
+                            {'path': '/launch', 'method': 'POST'},
+                        ],
+                    },
+                },
+            },
+        },
+    }
+    with config.override(override_cfg):
+        assert not rbac.check_permission('user', '/launch', 'POST')
+        assert rbac.check_permission('user', '/users.role', 'POST')
+
+
+def test_rbac_default_role_from_config():
+    with config.override({'rbac': {'default_role': 'user'}}):
+        assert rbac.get_default_role() == 'user'
+
+
+# ---- workspaces ----------------------------------------------------------
+def test_workspace_crud_and_validation():
+    workspaces.create_workspace('team-a')
+    assert 'team-a' in workspaces.get_workspaces()
+    with pytest.raises(exceptions.WorkspaceError):
+        workspaces.create_workspace('team-a')
+    with pytest.raises(exceptions.WorkspaceError):
+        workspaces.create_workspace('bad name!')
+    with pytest.raises(exceptions.WorkspaceError):
+        workspaces.create_workspace('x', {'nope': 1})
+    workspaces.delete_workspace('team-a')
+    assert 'team-a' not in workspaces.get_workspaces()
+    with pytest.raises(exceptions.WorkspaceError):
+        workspaces.delete_workspace('default')
+
+
+def test_workspace_delete_blocked_by_clusters():
+    from skypilot_tpu.utils import common
+    workspaces.create_workspace('busy')
+    state.add_or_update_cluster('c1', common.ClusterStatus.UP,
+                                workspace='busy')
+    with pytest.raises(exceptions.WorkspaceError, match='still has'):
+        workspaces.delete_workspace('busy')
+    state.remove_cluster('c1')
+    workspaces.delete_workspace('busy')
+
+
+def test_private_workspace_permissions():
+    workspaces.create_workspace(
+        'sec', {'private': True, 'allowed_users': ['alice']})
+    alice = {'id': 'a1', 'name': 'alice', 'role': 'user'}
+    bob = {'id': 'b1', 'name': 'bob', 'role': 'user'}
+    admin = {'id': 'r1', 'name': 'root', 'role': 'admin'}
+    workspaces.check_workspace_permission(alice, 'sec')
+    workspaces.check_workspace_permission(admin, 'sec')
+    with pytest.raises(exceptions.PermissionDeniedError):
+        workspaces.check_workspace_permission(bob, 'sec')
+    with pytest.raises(exceptions.PermissionDeniedError):
+        workspaces.check_workspace_permission(None, 'sec')
+    assert 'sec' in workspaces.accessible_workspaces(alice)
+    assert 'sec' not in workspaces.accessible_workspaces(bob)
+
+
+def test_active_workspace_env_and_cluster_tagging(monkeypatch):
+    from skypilot_tpu import core
+    from skypilot_tpu.utils import common
+    workspaces.create_workspace('team-b')
+    assert workspaces.active_workspace() == 'default'
+    monkeypatch.setenv('SKY_TPU_WORKSPACE', 'team-b')
+    assert workspaces.active_workspace() == 'team-b'
+    state.add_or_update_cluster('wb', common.ClusterStatus.UP)
+    assert state.get_cluster('wb')['workspace'] == 'team-b'
+    # status is scoped to the active workspace.
+    assert [r['name'] for r in core.status()] == ['wb']
+    monkeypatch.delenv('SKY_TPU_WORKSPACE')
+    assert core.status() == []
+    assert [r['name'] for r in core.status(all_workspaces=True)] == ['wb']
+    state.remove_cluster('wb')
+
+
+def test_workspace_switch_via_config():
+    workspaces.create_workspace('team-c')
+    config.update_global({'active_workspace': 'team-c'})
+    assert workspaces.active_workspace() == 'team-c'
+    # Survives a reload (written to disk).
+    config.reload()
+    assert workspaces.active_workspace() == 'team-c'
+    assert os.path.exists(os.environ['SKY_TPU_CONFIG'])
+
+
+# ---- review regressions --------------------------------------------------
+def test_token_create_requires_existing_user():
+    with pytest.raises(exceptions.UserNotFoundError):
+        users.create_token('x', user_id='never-seen')
+
+
+def test_user_role_cannot_mint_for_others():
+    users.core.ensure_user('victim', 'admin-user')
+    users.core.ensure_user('attacker', 'mallory')
+    caller = {'id': 'attacker', 'role': 'user'}
+    with pytest.raises(exceptions.PermissionDeniedError):
+        users.core.create_token('steal', user_id='victim', caller=caller)
+    # Self-minting stays allowed.
+    token = users.core.create_token('mine', user_id='attacker',
+                                    caller=caller)
+    assert users.core.authenticate(token)['id'] == 'attacker'
+    # user_id=None resolves to the caller's identity, not the OS user.
+    t2 = users.core.create_token('mine2', caller=caller)
+    assert users.core.authenticate(t2)['id'] == 'attacker'
+
+
+def test_token_with_underscore_in_body_verifies():
+    # base64url bodies can contain '_'; parsing must survive it.
+    users.core.ensure_user('u?x\x7f', 'odd')
+    token = users.core.create_token('odd', user_id='u?x\x7f')
+    assert users.core.authenticate(token) is not None
+
+
+def test_launch_blocked_in_private_workspace(monkeypatch):
+    from skypilot_tpu import execution
+    import skypilot_tpu as sky
+    me = users.core.ensure_user()
+    users.update_role(me['id'], 'user')
+    workspaces.create_workspace(
+        'vault', {'private': True, 'allowed_users': ['someone-else']})
+    monkeypatch.setenv('SKY_TPU_WORKSPACE', 'vault')
+    task = sky.Task('t', run='echo hi',
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4'))
+    with pytest.raises(exceptions.PermissionDeniedError):
+        execution.launch(task, quiet=True)
+
+
+def test_concurrent_workspace_creates_both_survive():
+    import threading
+    errs = []
+
+    def mk(n):
+        try:
+            workspaces.create_workspace(n)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=mk, args=(f'ws-{i}',))
+          for i in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    config.reload()
+    got = set(workspaces.get_workspaces())
+    assert {f'ws-{i}' for i in range(6)} <= got
