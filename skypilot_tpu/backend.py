@@ -64,6 +64,27 @@ class TpuVmBackend(Backend):
     # ---- provision ------------------------------------------------------
     def provision(self, task: task_lib.Task, cluster_name: str,
                   candidates: List[catalog.Candidate]) -> ClusterInfo:
+        # gcp-pd volumes are zonal and only attach at TPU-node create
+        # (dataDisks): pin placement to the disks' zone and pass them in.
+        data_disks: List[str] = []
+        pd_zones = set()
+        for vol_name in task.volumes.values():
+            rec = state.get_volume(vol_name)
+            if rec is not None and rec['type'] == 'gcp-pd':
+                data_disks.append(rec['name'])
+                pd_zones.add(rec['zone'])
+        if data_disks:
+            if len(pd_zones) > 1:
+                raise exceptions.InvalidTaskError(
+                    f'gcp-pd volumes of one task must share a zone; '
+                    f'got {sorted(pd_zones)}')
+            (pd_zone,) = pd_zones
+            candidates = [c for c in candidates
+                          if c.cloud != 'gcp' or c.zone == pd_zone]
+            if not candidates:
+                raise exceptions.ResourcesUnavailableError(
+                    f'No placement in zone {pd_zone} (required by '
+                    f'gcp-pd volumes {data_disks}).')
         state.add_or_update_cluster(
             cluster_name, common.ClusterStatus.INIT,
             resources_config=task.resources.to_yaml_config(),
@@ -72,7 +93,8 @@ class TpuVmBackend(Backend):
                                 f'trying {len(candidates)} placements')
         try:
             info, cand = provisioner.provision_with_retries(
-                cluster_name, task.resources, candidates)
+                cluster_name, task.resources, candidates,
+                data_disks=data_disks)
         except exceptions.ResourcesUnavailableError as e:
             state.add_cluster_event(cluster_name, 'PROVISION_FAILED', str(e))
             state.remove_cluster(cluster_name)
@@ -88,7 +110,9 @@ class TpuVmBackend(Backend):
     # ---- file sync ------------------------------------------------------
     def _runners(self, info: ClusterInfo
                  ) -> List[command_runner.CommandRunner]:
-        if info.cloud == 'local':
+        # Process-simulated hosts (local cloud, process-mode ssh pools)
+        # carry a cluster_dir; real hosts are reached over SSH.
+        if 'cluster_dir' in info.provider_config:
             cdir = info.provider_config['cluster_dir']
             return [command_runner.LocalProcessCommandRunner(
                 os.path.join(cdir, f'host{i}'))
@@ -106,7 +130,7 @@ class TpuVmBackend(Backend):
         Local fake slices: relative to each host sandbox. Real hosts: the
         agent's cluster dir (gcp instance.py AGENT_CLUSTER_DIR).
         """
-        if info.cloud == 'local':
+        if 'cluster_dir' in info.provider_config:
             return 'workdir/'
         return '/opt/sky_tpu/cluster/workdir/'
 
@@ -131,6 +155,27 @@ class TpuVmBackend(Backend):
                 continue
             for runner in self._runners(info):
                 runner.rsync(os.path.expanduser(src), dst)
+
+    def mount_volumes(self, info: ClusterInfo,
+                      task: task_lib.Task) -> None:
+        """Attach + mount each task volume on every host (reference
+        volumes are mounted during file-mount sync)."""
+        if not task.volumes:
+            return
+        from skypilot_tpu.volumes import core as volumes_core
+        client = self._client(info)
+        for mount_path, vol_name in task.volumes.items():
+            rec = volumes_core.attach(vol_name, info.cluster_name)
+            vol = volumes_core.to_volume(rec)
+            result = client.exec_sync(vol.mount_command(mount_path))
+            rcs = result['returncodes']
+            if any(rc != 0 for rc in rcs):
+                raise exceptions.CommandError(
+                    max(rcs), f'mount volume {vol_name}',
+                    str(result['tails']))
+            state.add_cluster_event(
+                info.cluster_name, 'VOLUME_MOUNTED',
+                f'{vol_name} at {mount_path}')
 
     # ---- setup / execute -------------------------------------------------
     def _client(self, info: ClusterInfo) -> agent_client.AgentClient:
@@ -180,6 +225,11 @@ class TpuVmBackend(Backend):
 
     # ---- teardown -------------------------------------------------------
     def teardown(self, info: ClusterInfo, terminate: bool) -> None:
+        if terminate:
+            # Stop keeps volumes attached (the stopped cluster still owns
+            # its disks/data); only terminate releases them.
+            from skypilot_tpu.volumes import core as volumes_core
+            volumes_core.detach_all(info.cluster_name)
         if terminate:
             provision.terminate_instances(info.cloud, info.cluster_name,
                                           info.provider_config)

@@ -167,6 +167,9 @@ def get_candidates(resources: 'Resources') -> List[Candidate]:  # noqa: F821
         if cloud == 'local':
             out.append(_local_candidate(resources))
             continue
+        if cloud == 'ssh':
+            out.extend(_ssh_pool_candidates(resources))
+            continue
         for e in _load(cloud):
             if resources.region and e.region != resources.region:
                 continue
@@ -241,6 +244,40 @@ def _local_candidate(resources: 'Resources') -> Candidate:  # noqa: F821
         cost_per_hour=0.0,
         num_hosts=tpu.num_hosts if tpu else 1,
         tpu=tpu)
+
+
+def _ssh_pool_candidates(resources: 'Resources') -> List[Candidate]:  # noqa: F821,E501
+    """Bare-metal pools as placements: `cloud: ssh` with instance_type
+    naming the pool (all pools when unpinned). Pools are sunk cost —
+    $0/hr — and gang-shaped by their host list; a pool declaring
+    ``accelerator: v4-16`` carries TPU topology."""
+    from skypilot_tpu import topology as topology_lib
+    from skypilot_tpu.ssh_node_pools import SSHNodePoolManager
+    pools = SSHNodePoolManager().get_all_pools()
+    if resources.instance_type:
+        pools = {k: v for k, v in pools.items()
+                 if k == resources.instance_type}
+    out: List[Candidate] = []
+    for name, cfg in pools.items():
+        tpu = None
+        acc = cfg.get('accelerator')
+        if acc:
+            try:
+                tpu = topology_lib.parse_tpu(acc)
+            except Exception:  # noqa: BLE001 — non-TPU accelerator pools
+                tpu = None
+        if resources.tpu is not None and (
+                tpu is None or tpu.name != resources.tpu.name):
+            continue
+        out.append(Candidate(
+            cloud='ssh', region=cfg.get('region', 'pool'),
+            zone=name, instance_type=name,
+            accelerator_name=(resources.accelerator_name
+                              if tpu is None else tpu.name),
+            accelerator_count=resources.accelerator_count,
+            use_spot=False, cost_per_hour=0.0,
+            num_hosts=len(cfg['hosts']), tpu=tpu))
+    return out
 
 
 def egress_cost_per_gib(src: Candidate, dst: Candidate) -> float:

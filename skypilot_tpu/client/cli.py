@@ -695,6 +695,116 @@ def workspaces_switch(name: str) -> None:
     click.echo(f'Active workspace: {name}')
 
 
+@cli.group()
+def pools() -> None:
+    """Bare-metal SSH node pools (reference `sky ssh`)."""
+
+
+@pools.command('ls')
+def pools_ls() -> None:
+    if _remote():
+        from skypilot_tpu.client import sdk
+        all_pools = sdk.call('pools.list')
+    else:
+        from skypilot_tpu.ssh_node_pools import SSHNodePoolManager
+        all_pools = SSHNodePoolManager().get_all_pools()
+    fmt = '{:<16} {:<7} {:<14} {:<6} {}'
+    click.echo(fmt.format('POOL', 'HOSTS', 'ACCELERATOR', 'MODE',
+                          'FIRST_HOST'))
+    for name, cfg in all_pools.items():
+        click.echo(fmt.format(name, len(cfg['hosts']),
+                              cfg.get('accelerator', '-'),
+                              cfg.get('mode', 'ssh'), cfg['hosts'][0]))
+
+
+@pools.command('apply')
+@click.argument('spec_yaml')
+def pools_apply(spec_yaml: str) -> None:
+    """Add/update pools from a YAML mapping of pool-name -> config.
+
+    Pools live on the API server when one is configured — launches
+    resolve pools server-side.
+    """
+    import yaml as yaml_lib
+    with open(os.path.expanduser(spec_yaml), encoding='utf-8') as f:
+        cfg = yaml_lib.safe_load(f) or {}
+    if _remote():
+        from skypilot_tpu.client import sdk
+        sdk.call('pools.apply', {'pools': cfg})
+    else:
+        from skypilot_tpu.ssh_node_pools import SSHNodePoolManager
+        SSHNodePoolManager().update_pools(cfg)
+    click.echo(f'Pools updated: {", ".join(cfg)}')
+
+
+@pools.command('delete')
+@click.argument('name')
+def pools_delete(name: str) -> None:
+    if _remote():
+        from skypilot_tpu.client import sdk
+        ok = sdk.call('pools.delete', {'name': name})
+    else:
+        from skypilot_tpu.ssh_node_pools import SSHNodePoolManager
+        ok = SSHNodePoolManager().delete_pool(name)
+    if ok:
+        click.echo(f'Pool {name} deleted.')
+    else:
+        raise click.ClickException(f'No such pool: {name}')
+
+
+@cli.group()
+def volumes() -> None:
+    """Persistent volumes (gcp-pd, gcsfuse, hostpath)."""
+
+
+@volumes.command('apply')
+@click.argument('spec_yaml')
+def volumes_apply(spec_yaml: str) -> None:
+    """Create/register a volume from a YAML spec."""
+    import yaml as yaml_lib
+    with open(os.path.expanduser(spec_yaml), encoding='utf-8') as f:
+        cfg = yaml_lib.safe_load(f)
+    if _remote():
+        from skypilot_tpu.client import sdk
+        rec = sdk.call('volumes.apply', {'spec': cfg})
+    else:
+        from skypilot_tpu import volumes as volumes_lib
+        rec = volumes_lib.volume_apply(cfg)
+    click.echo(f'Volume {rec["name"]} ({rec["type"]}): {rec["status"]}')
+
+
+@volumes.command('ls')
+def volumes_ls() -> None:
+    if _remote():
+        from skypilot_tpu.client import sdk
+        rows = sdk.call('volumes.list')
+    else:
+        from skypilot_tpu import volumes as volumes_lib
+        rows = volumes_lib.volume_list()
+    fmt = '{:<16} {:<10} {:<8} {:<14} {:>8} {:<10} {:<16}'
+    click.echo(fmt.format('NAME', 'TYPE', 'CLOUD', 'ZONE', 'SIZE_GB',
+                          'STATUS', 'ATTACHED_TO'))
+    for v in rows:
+        click.echo(fmt.format(v['name'], v['type'], v['cloud'],
+                              v['zone'] or '-', v['size_gb'] or '-',
+                              v['status'], v['attached_to'] or '-'))
+
+
+@volumes.command('delete')
+@click.argument('names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def volumes_delete(names: tuple, yes: bool) -> None:
+    if not yes:
+        click.confirm(f'Delete volume(s) {", ".join(names)}?', abort=True)
+    if _remote():
+        from skypilot_tpu.client import sdk
+        sdk.call('volumes.delete', {'names': list(names)})
+    else:
+        from skypilot_tpu import volumes as volumes_lib
+        volumes_lib.volume_delete(list(names))
+    click.echo('Deleted.')
+
+
 def main() -> None:
     try:
         cli(standalone_mode=False)

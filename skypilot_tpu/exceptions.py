@@ -154,3 +154,11 @@ class PermissionDeniedError(SkyTpuError):
 
 class WorkspaceError(SkyTpuError):
     """Workspace validation/permission failure (reference workspaces/core)."""
+
+
+class VolumeError(SkyTpuError):
+    """Volume lifecycle failure (reference volumes/server/core.py)."""
+
+
+class VolumeNotFoundError(VolumeError):
+    """Unknown volume name."""

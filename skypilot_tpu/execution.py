@@ -133,6 +133,8 @@ def launch(
             for mp, spec in task.storage_mounts.items():
                 mounts[mp] = spec['source']
             backend.sync_file_mounts(info, mounts)
+        if Stage.SYNC_FILE_MOUNTS in run_stages and task.volumes:
+            backend.mount_volumes(info, task)
         if Stage.SETUP in run_stages:
             backend.setup(info, task)
         job_id = -1

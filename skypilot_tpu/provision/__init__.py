@@ -24,6 +24,7 @@ from skypilot_tpu.provision.common import ClusterInfo, ProvisionConfig
 _PROVIDERS = {
     'local': 'skypilot_tpu.provision.local.instance',
     'gcp': 'skypilot_tpu.provision.gcp.instance',
+    'ssh': 'skypilot_tpu.provision.ssh.instance',
 }
 
 

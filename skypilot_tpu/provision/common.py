@@ -80,4 +80,7 @@ class ProvisionConfig:
     runtime_version: Optional[str] = None  # TPU software version
     ports: List[int] = dataclasses.field(default_factory=list)
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Pre-created zonal disk names to attach at node create (gcp-pd
+    # volumes; the TPU API only attaches data disks at creation).
+    data_disks: List[str] = dataclasses.field(default_factory=list)
     provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)

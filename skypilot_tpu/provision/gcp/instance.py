@@ -79,7 +79,8 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         spot=config.use_spot,
         labels={**config.labels, 'sky-tpu-cluster': config.cluster_name},
         startup_script=_STARTUP_SCRIPT,
-        metadata=config.provider_config.get('metadata'))
+        metadata=config.provider_config.get('metadata'),
+        data_disks=config.data_disks)
     info = get_cluster_info(config.cluster_name, {
         **config.provider_config, 'zone': config.zone})
     if info is None:

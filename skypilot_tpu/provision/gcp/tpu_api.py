@@ -94,7 +94,8 @@ class TpuApiClient:
                     labels: Optional[Dict[str, str]] = None,
                     startup_script: Optional[str] = None,
                     network: Optional[str] = None,
-                    metadata: Optional[Dict[str, str]] = None
+                    metadata: Optional[Dict[str, str]] = None,
+                    data_disks: Optional[List[str]] = None
                     ) -> Dict[str, Any]:
         body: Dict[str, Any] = {
             'acceleratorType': accelerator_type,
@@ -102,6 +103,12 @@ class TpuApiClient:
             'networkConfig': {'enableExternalIps': True},
             'labels': labels or {},
         }
+        if data_disks:
+            # gcp-pd volumes: the TPU API only attaches disks at create.
+            body['dataDisks'] = [
+                {'sourceDisk': d if '/' in d else
+                 f'projects/{self.project}/zones/{zone}/disks/{d}',
+                 'mode': 'READ_WRITE'} for d in data_disks]
         if network:
             body['networkConfig']['network'] = network
         if spot:
@@ -164,3 +171,95 @@ class TpuApiClient:
             time.sleep(OPERATION_POLL_INTERVAL)
         raise exceptions.ProvisionTimeoutError(
             f'TPU operation {name} timed out after {timeout}s')
+
+
+def default_project() -> str:
+    """Project from env/ADC (mirrors gcp/instance.py _project)."""
+    import os
+    proj = (os.environ.get('GOOGLE_CLOUD_PROJECT') or
+            os.environ.get('GCP_PROJECT'))
+    if proj:
+        return proj
+    try:
+        import google.auth
+        _, proj = google.auth.default()
+    except Exception as e:  # noqa: BLE001
+        raise exceptions.NoCloudAccessError(
+            f'Cannot determine GCP project: {e}') from e
+    if not proj:
+        raise exceptions.NoCloudAccessError(
+            'No GCP project configured (set GOOGLE_CLOUD_PROJECT).')
+    return proj
+
+
+COMPUTE_API = 'https://compute.googleapis.com/compute/v1'
+
+
+class GceDiskClient(TpuApiClient):
+    """Persistent-disk ops for gcp-pd volumes (compute API; reuses the
+    TPU client's auth/error mapping — reference provisions PDs through
+    the same google-api plumbing)."""
+
+    def _disk_url(self, zone: str, name: str = '') -> str:
+        base = (f'{COMPUTE_API}/projects/{self.project}/zones/{zone}'
+                f'/disks')
+        return f'{base}/{name}' if name else base
+
+    def _wait_zone_op(self, zone: str, op: Dict[str, Any],
+                      timeout: float = 300.0) -> None:
+        """Compute zone operations poll at a different URL than TPU ops
+        (the inherited wait_operation cannot be reused)."""
+        name = op.get('name')
+        if name is None or op.get('status') == 'DONE':
+            self._check_compute_op_error(op)
+            return
+        url = (f'{COMPUTE_API}/projects/{self.project}/zones/{zone}'
+               f'/operations/{name}')
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            cur = self._request('GET', url)
+            if cur.get('status') == 'DONE':
+                self._check_compute_op_error(cur)
+                return
+            time.sleep(2.0)
+        raise exceptions.ProvisionTimeoutError(
+            f'Compute operation {name} timed out after {timeout}s')
+
+    @staticmethod
+    def _check_compute_op_error(op: Dict[str, Any]) -> None:
+        errors = (op.get('error') or {}).get('errors') or []
+        if errors:
+            msg = '; '.join(e.get('message', str(e)) for e in errors)
+            if any('quota' in str(e).lower() for e in errors):
+                raise exceptions.QuotaExceededError(msg)
+            raise exceptions.ProvisionError(msg)
+
+    def create_disk(self, zone: str, name: str, size_gb: int, *,
+                    disk_type: str = 'pd-balanced') -> Dict[str, Any]:
+        body = {
+            'name': name,
+            'sizeGb': str(size_gb),
+            'type': (f'projects/{self.project}/zones/{zone}/diskTypes/'
+                     f'{disk_type}'),
+            'labels': {'sky-tpu-volume': name},
+        }
+        try:
+            op = self._request('POST', self._disk_url(zone), body)
+        except exceptions.ProvisionError as e:
+            if 'already exists' in str(e).lower():
+                return self.get_disk(zone, name)
+            raise
+        # disks.insert is async; READY must mean the disk exists (an
+        # async quota failure would otherwise surface at mount time).
+        self._wait_zone_op(zone, op)
+        return self.get_disk(zone, name)
+
+    def get_disk(self, zone: str, name: str) -> Dict[str, Any]:
+        return self._request('GET', self._disk_url(zone, name))
+
+    def delete_disk(self, zone: str, name: str) -> None:
+        try:
+            op = self._request('DELETE', self._disk_url(zone, name))
+            self._wait_zone_op(zone, op)
+        except exceptions.ClusterDoesNotExist:
+            pass   # already gone

@@ -30,7 +30,8 @@ logger = logging.getLogger(__name__)
 
 def _make_config(candidate: catalog.Candidate,
                  cluster_name: str,
-                 res: resources_lib.Resources) -> ProvisionConfig:
+                 res: resources_lib.Resources,
+                 data_disks: 'List[str]' = ()) -> ProvisionConfig:
     from skypilot_tpu import config as config_lib
     provider_config = dict(
         config_lib.get_nested((candidate.cloud,), {}) or {})
@@ -48,6 +49,7 @@ def _make_config(candidate: catalog.Candidate,
         runtime_version=res.runtime_version,
         ports=res.ports,
         labels=res.labels,
+        data_disks=list(data_disks),
         provider_config=provider_config,
     )
 
@@ -56,11 +58,12 @@ def bulk_provision(candidate: catalog.Candidate,
                    cluster_name: str,
                    res: resources_lib.Resources,
                    *,
-                   wait_agent: bool = True) -> ClusterInfo:
+                   wait_agent: bool = True,
+                   data_disks: 'List[str]' = ()) -> ClusterInfo:
     """One atomic provisioning attempt: create slice, wait for hosts, wait
     for the head agent (reference provisioner.py:122 + wait_for_ssh :389 —
     the agent replaces SSH-wait as the readiness signal)."""
-    config = _make_config(candidate, cluster_name, res)
+    config = _make_config(candidate, cluster_name, res, data_disks)
     info = provision.run_instances(candidate.cloud, config)
     provision.wait_instances(candidate.cloud, cluster_name,
                              info.provider_config)
@@ -77,6 +80,7 @@ def provision_with_retries(
     cluster_name: str,
     res: resources_lib.Resources,
     candidates: List[catalog.Candidate],
+    data_disks: 'List[str]' = (),
 ) -> Tuple[ClusterInfo, catalog.Candidate]:
     """Walk candidates cheapest-first with zone/region blocklisting.
 
@@ -95,7 +99,8 @@ def provision_with_retries(
             continue
         try:
             logger.info('Provisioning %s as %s', cand, cluster_name)
-            info = bulk_provision(cand, cluster_name, res)
+            info = bulk_provision(cand, cluster_name, res,
+                                  data_disks=data_disks)
             return info, cand
         except exceptions.QuotaExceededError as e:
             # Quota is regional: block the whole region.

@@ -1,0 +1,271 @@
+"""SSH node-pool provisioner: bare-metal hosts as gang-ready slices.
+
+Counterpart of the reference's ``sky/provision/ssh`` + ``sky ssh up``
+(sky/ssh_node_pools/core.py:144): "provisioning" a pool is health-checking
+every host and bootstrapping the on-host agent — the hosts already exist.
+Terminate releases the pool (stops the agent) but never destroys hosts.
+
+Two modes per pool (``mode:`` in the pool config):
+- ``ssh`` (default): reach hosts over SSH, rsync the framework, start the
+  agent on host 0 (reference instance_setup start_skylet analog).
+- ``process``: hosts are simulated by local processes exactly like the
+  ``local`` cloud — the offline test path for pool logic.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
+                                           ProvisionConfig)
+from skypilot_tpu.provision.local import instance as local_instance
+from skypilot_tpu.ssh_node_pools import SSHNodePoolManager
+from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import common
+
+AGENT_PORT = 46590   # same convention as the GCP provider
+AGENT_DIR = '/opt/sky_tpu/cluster'
+
+
+def _cluster_dir(cluster_name: str) -> str:
+    return os.path.join(common.clusters_dir(), cluster_name)
+
+
+def _pool_of(config_or_provider) -> Dict[str, Any]:
+    if isinstance(config_or_provider, ProvisionConfig):
+        pool_name = (config_or_provider.instance_type or
+                     config_or_provider.provider_config.get('pool'))
+    else:
+        pool_name = config_or_provider.get('pool')
+    if not pool_name:
+        raise exceptions.ProvisionError(
+            '[ssh] no pool named (set resources.instance_type to the '
+            'pool name)', retryable=False)
+    return {'name': pool_name, **SSHNodePoolManager().get_pool(pool_name)}
+
+
+def _runner_for(host: str, pool: Dict[str, Any]
+                ) -> command_runner.CommandRunner:
+    return command_runner.SSHCommandRunner(
+        host, user=pool['user'],
+        key_path=pool.get('identity_file'),
+        password=pool.get('password'))
+
+
+def _health_check(pool: Dict[str, Any]) -> List[str]:
+    """Every host must answer; a gang with a dead member is no gang."""
+    dead = []
+    for host in pool['hosts']:
+        rc, _, _ = _runner_for(host, pool).run(
+            'true', timeout=15, check=False)
+        if rc != 0:
+            dead.append(host)
+    return dead
+
+
+def run_instances(config: ProvisionConfig) -> ClusterInfo:
+    pool = _pool_of(config)
+    cdir = _cluster_dir(config.cluster_name)
+    os.makedirs(cdir, exist_ok=True)
+    mode = pool.get('mode', 'ssh')
+    if mode == 'process':
+        # Delegate host simulation to the local provider, then overlay
+        # pool identity on the result.
+        num_hosts = len(pool['hosts'])
+        meta = {
+            'cluster_name': config.cluster_name,
+            'region': pool.get('region', 'pool'),
+            'zone': pool['name'],
+            'instance_type': pool['name'],
+            'tpu_slice': pool.get('accelerator'),
+            'num_hosts': num_hosts,
+            'use_spot': False,
+            'created_at': time.time(),
+            'pool': pool['name'],
+            'mode': 'process',
+        }
+        for r in range(num_hosts):
+            hd = os.path.join(cdir, f'host{r}')
+            os.makedirs(os.path.join(hd, 'workdir'), exist_ok=True)
+            with open(os.path.join(hd, 'state'), 'w',
+                      encoding='utf-8') as f:
+                f.write('RUNNING')
+        with open(os.path.join(cdir, 'meta.json'), 'w',
+                  encoding='utf-8') as f:
+            json.dump(meta, f)
+        local_instance._start_agent(config.cluster_name)  # noqa: SLF001
+        return get_cluster_info(config.cluster_name,
+                                {'pool': pool['name']})
+    dead = _health_check(pool)
+    if dead:
+        raise exceptions.ProvisionError(
+            f'[ssh] pool {pool["name"]!r} hosts unreachable: {dead}',
+            retryable=True)
+    _bootstrap_agent(config.cluster_name, pool)
+    meta = {
+        'cluster_name': config.cluster_name,
+        'region': pool.get('region', 'pool'),
+        'zone': pool['name'],
+        'instance_type': pool['name'],
+        'tpu_slice': pool.get('accelerator'),
+        'num_hosts': len(pool['hosts']),
+        'use_spot': False,
+        'created_at': time.time(),
+        'pool': pool['name'],
+        'mode': 'ssh',
+    }
+    with open(os.path.join(cdir, 'meta.json'), 'w', encoding='utf-8') as f:
+        json.dump(meta, f)
+    return get_cluster_info(config.cluster_name, {'pool': pool['name']})
+
+
+def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any]) -> None:
+    """Push the framework + start an agent on EVERY host (mirrors the GCP
+    provider's _install_agents: head's agent fans job ranks out to peers'
+    /run_rank, so each host needs a listening agent)."""
+    import skypilot_tpu
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(skypilot_tpu.__file__)))
+    hosts = list(pool['hosts'])
+    for rank, host in enumerate(hosts):
+        runner = _runner_for(host, pool)
+        runner.run(f'sudo mkdir -p {AGENT_DIR} && sudo chown -R '
+                   f'$(whoami) /opt/sky_tpu', timeout=30, check=True)
+        runner.rsync(f'{pkg_root}/skypilot_tpu/',
+                     f'{AGENT_DIR}/skypilot_tpu/')
+        agent_config = {
+            'cluster_name': cluster_name,
+            'mode': 'host',
+            'host_rank': rank,
+            'host_ips': hosts,
+            'num_hosts': len(hosts),
+            'tpu_slice': pool.get('accelerator'),
+            'peer_agent_urls': [
+                f'http://{h}:{AGENT_PORT}'
+                for i, h in enumerate(hosts) if i != rank
+            ] if rank == 0 else [],
+            'provider_config': {'pool': pool['name'],
+                                'ssh_user': pool['user'],
+                                'ssh_key': pool.get('identity_file')},
+        }
+        cfg_json = json.dumps(agent_config).replace("'", "'\\''")
+        runner.run(
+            f"echo '{cfg_json}' > {AGENT_DIR}/agent_config.json && "
+            f"pgrep -f 'skypilot_tpu.runtime.agent' >/dev/null || "
+            f'PYTHONPATH={AGENT_DIR} nohup python3 -m '
+            f'skypilot_tpu.runtime.agent --cluster-dir {AGENT_DIR} '
+            f'--host 0.0.0.0 --port {AGENT_PORT} '
+            f'> {AGENT_DIR}/agent.log 2>&1 &', timeout=60, check=True)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    cdir = _cluster_dir(cluster_name)
+    meta = _meta(cdir)
+    if meta and meta.get('mode') == 'process':
+        local_instance.stop_instances(cluster_name, provider_config)
+        return
+    # Bare metal "stop" = stop the agents; hosts stay up.
+    pool = _pool_of({'pool': (meta or {}).get('pool') or
+                     provider_config.get('pool')})
+    for host in pool['hosts']:
+        _runner_for(host, pool).run(
+            'pkill -f skypilot_tpu.runtime.agent || true', timeout=30,
+            check=False)
+
+
+def start_instances(cluster_name: str,
+                    provider_config: Dict[str, Any]) -> ClusterInfo:
+    cdir = _cluster_dir(cluster_name)
+    meta = _meta(cdir)
+    if meta is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    if meta.get('mode') == 'process':
+        local_instance.start_instances(cluster_name, provider_config)
+        return get_cluster_info(cluster_name, provider_config)
+    pool = _pool_of({'pool': meta['pool']})
+    _bootstrap_agent(cluster_name, pool)
+    return get_cluster_info(cluster_name, provider_config)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    cdir = _cluster_dir(cluster_name)
+    meta = _meta(cdir)
+    if meta and meta.get('mode') == 'process':
+        local_instance.terminate_instances(cluster_name, provider_config)
+        return
+    if meta:
+        try:
+            pool = _pool_of({'pool': meta['pool']})
+            for host in pool['hosts']:
+                _runner_for(host, pool).run(
+                    f'pkill -f skypilot_tpu.runtime.agent || true; '
+                    f'rm -rf {AGENT_DIR}', timeout=30, check=False)
+        except exceptions.SkyTpuError:
+            pass   # pool config gone; release bookkeeping anyway
+    shutil.rmtree(cdir, ignore_errors=True)
+
+
+def wait_instances(cluster_name: str, provider_config: Dict[str, Any],
+                   state: str = 'RUNNING') -> None:
+    info = get_cluster_info(cluster_name, provider_config)
+    if info is None:
+        raise exceptions.ProvisionError(
+            f'[ssh] cluster {cluster_name} does not exist')
+    bad = [h for h in info.hosts if h.state != state]
+    if bad:
+        raise exceptions.ProvisionError(
+            f'[ssh] hosts not {state}: {[h.host_id for h in bad]}')
+
+
+def _meta(cdir: str) -> Optional[Dict[str, Any]]:
+    p = os.path.join(cdir, 'meta.json')
+    if not os.path.exists(p):
+        return None
+    with open(p, encoding='utf-8') as f:
+        return json.load(f)
+
+
+def get_cluster_info(cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> Optional[ClusterInfo]:
+    cdir = _cluster_dir(cluster_name)
+    meta = _meta(cdir)
+    if meta is None:
+        return None
+    if meta.get('mode') == 'process':
+        info = local_instance.get_cluster_info(cluster_name,
+                                               provider_config)
+        if info is None:
+            return None
+        # Pool identity overlays the local simulation.
+        info.cloud = 'ssh'
+        info.instance_type = meta['instance_type']
+        info.tpu_slice = meta.get('tpu_slice')
+        return info
+    pool = _pool_of({'pool': meta['pool']})
+    agent_url = f'http://{pool["hosts"][0]}:{AGENT_PORT}'
+    hosts = [HostInfo(host_id=f'{cluster_name}-host{i}',
+                      internal_ip=h, external_ip=h, state='RUNNING',
+                      agent_url=agent_url)
+             for i, h in enumerate(pool['hosts'])]
+    return ClusterInfo(
+        cluster_name=cluster_name, cloud='ssh',
+        region=meta['region'], zone=meta['zone'], hosts=hosts,
+        tpu_slice=meta.get('tpu_slice'),
+        instance_type=meta['instance_type'], use_spot=False,
+        cost_per_hour=0.0,
+        provider_config={'pool': meta['pool'],
+                         'ssh_user': pool.get('user'),
+                         'ssh_key': pool.get('identity_file')})
+
+
+def open_ports(cluster_name: str, ports,
+               provider_config: Dict[str, Any]) -> None:
+    del cluster_name, ports, provider_config   # firewalling is the
+    # pool operator's concern on bare metal

@@ -193,6 +193,10 @@ class Server:
             return functools.partial(core.check, payload.get('clouds'))
         if name == 'cost_report':
             return core.cost_report
+        if name.startswith('volumes.'):
+            return self._dispatch_volumes(name, payload)
+        if name.startswith('pools.'):
+            return self._dispatch_pools(name, payload)
         if name.startswith('users.'):
             return self._dispatch_users(name, payload)
         if name.startswith('workspaces.'):
@@ -207,6 +211,31 @@ class Server:
             except (ImportError, AttributeError) as e:
                 raise web.HTTPNotImplemented(
                     text=f'op {name} not available: {e}') from e
+        raise web.HTTPNotFound(text=f'unknown op {name}')
+
+    def _dispatch_pools(self, name, payload):
+        from skypilot_tpu.ssh_node_pools import SSHNodePoolManager
+        mgr = SSHNodePoolManager()
+        if name == 'pools.list':
+            return mgr.get_all_pools
+        if name == 'pools.apply':
+            return functools.partial(mgr.update_pools, payload['pools'])
+        if name == 'pools.delete':
+            return functools.partial(mgr.delete_pool, payload['name'])
+        raise web.HTTPNotFound(text=f'unknown op {name}')
+
+    def _dispatch_volumes(self, name, payload):
+        from skypilot_tpu import volumes as volumes_lib
+        if name == 'volumes.apply':
+            return functools.partial(volumes_lib.volume_apply,
+                                     payload['spec'])
+        if name == 'volumes.list':
+            return volumes_lib.volume_list
+        if name == 'volumes.delete':
+            return functools.partial(volumes_lib.volume_delete,
+                                     payload['names'])
+        if name == 'volumes.refresh':
+            return volumes_lib.volume_refresh
         raise web.HTTPNotFound(text=f'unknown op {name}')
 
     def _dispatch_users(self, name, payload):

@@ -1,0 +1,4 @@
+"""Bare-metal SSH node pools (reference ``sky/ssh_node_pools/``)."""
+from skypilot_tpu.ssh_node_pools.core import SSHNodePoolManager
+
+__all__ = ['SSHNodePoolManager']

@@ -1,0 +1,114 @@
+"""SSH node pool registry (reference ``sky/ssh_node_pools/core.py``:
+``SSHNodePoolManager`` :16 — pools YAML + uploaded keys).
+
+A pool names a fixed set of reachable hosts (e.g. on-prem TPU v4 hosts
+or reserved TPU VMs managed outside this framework) with shared SSH
+credentials. A pool is usable as a provisioning target via the ``ssh``
+cloud: ``resources: {cloud: ssh, instance_type: <pool-name>}`` — the
+"slice" is the pool itself, gang-ready, and the provisioner health-checks
+every host before declaring it UP (the reference's `sky ssh up`).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common
+from skypilot_tpu.utils import locks
+
+POOLS_FILE = 'ssh_node_pools.yaml'
+
+
+class SSHNodePoolManager:
+    """CRUD over the pools YAML + key files (reference core.py:16)."""
+
+    def __init__(self) -> None:
+        self.config_path = os.path.join(common.base_dir(), POOLS_FILE)
+        self.keys_dir = os.path.join(common.base_dir(), 'pool_keys')
+        os.makedirs(self.keys_dir, exist_ok=True)
+
+    def get_all_pools(self) -> Dict[str, Any]:
+        if not os.path.exists(self.config_path):
+            return {}
+        with open(self.config_path, encoding='utf-8') as f:
+            return yaml.safe_load(f) or {}
+
+    def _save(self, pools: Dict[str, Any]) -> None:
+        tmp = f'{self.config_path}.{os.getpid()}.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            yaml.safe_dump(pools, f, sort_keys=False)
+        os.replace(tmp, self.config_path)
+
+    def add_or_update_pool(self, name: str,
+                           pool_config: Dict[str, Any]) -> None:
+        self._validate(pool_config)
+        with locks.named_lock('ssh_node_pools'):
+            pools = self.get_all_pools()
+            pools[name] = pool_config
+            self._save(pools)
+
+    def update_pools(self, pools_config: Dict[str, Any]) -> None:
+        for cfg in pools_config.values():
+            self._validate(cfg)
+        with locks.named_lock('ssh_node_pools'):
+            pools = self.get_all_pools()
+            pools.update(pools_config)
+            self._save(pools)
+
+    def delete_pool(self, name: str) -> bool:
+        with locks.named_lock('ssh_node_pools'):
+            pools = self.get_all_pools()
+            if name not in pools:
+                return False
+            del pools[name]
+            self._save(pools)
+            return True
+
+    def get_pool(self, name: str) -> Dict[str, Any]:
+        pool = self.get_all_pools().get(name)
+        if pool is None:
+            raise exceptions.ProvisionError(
+                f'No such SSH node pool: {name!r} '
+                f'(configured: {sorted(self.get_all_pools())})',
+                retryable=False)
+        return pool
+
+    # ---- keys ----------------------------------------------------------
+    def save_ssh_key(self, key_name: str, key_content: str) -> str:
+        if (not key_name or '/' in key_name or '\\' in key_name or
+                key_name.startswith('.')):
+            raise exceptions.InvalidTaskError(
+                f'Invalid key name {key_name!r}')
+        path = os.path.join(self.keys_dir, key_name)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            f.write(key_content)
+        return path
+
+    def list_ssh_keys(self) -> List[str]:
+        if not os.path.isdir(self.keys_dir):
+            return []
+        return sorted(f for f in os.listdir(self.keys_dir)
+                      if os.path.isfile(os.path.join(self.keys_dir, f)))
+
+    # ---- validation ----------------------------------------------------
+    @staticmethod
+    def _validate(config: Dict[str, Any]) -> None:
+        if not isinstance(config.get('hosts'), list) or not config['hosts']:
+            raise exceptions.InvalidTaskError(
+                'Pool configuration needs a non-empty `hosts` list.')
+        mode = config.get('mode', 'ssh')
+        if mode not in ('ssh', 'process'):
+            raise exceptions.InvalidTaskError(
+                f'Pool mode must be ssh|process, got {mode!r}')
+        if mode == 'ssh':
+            if not str(config.get('user', '')).strip():
+                raise exceptions.InvalidTaskError(
+                    'Pool configuration needs `user` (ssh login).')
+            if not (config.get('identity_file') or config.get('password')):
+                raise exceptions.InvalidTaskError(
+                    'Pool configuration needs `identity_file` or '
+                    '`password`.')

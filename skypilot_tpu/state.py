@@ -83,6 +83,19 @@ CREATE TABLE IF NOT EXISTS kv_secrets (
     key TEXT PRIMARY KEY,
     value TEXT
 );
+CREATE TABLE IF NOT EXISTS volumes (
+    name TEXT PRIMARY KEY,
+    type TEXT,
+    cloud TEXT,
+    region TEXT,
+    zone TEXT,
+    size_gb INTEGER,
+    config_json TEXT,
+    status TEXT,
+    created_at REAL,
+    last_attached_at REAL,
+    attached_to TEXT
+);
 """
 
 
@@ -326,3 +339,61 @@ def get_or_create_secret(key: str, generate) -> str:
     row = conn.execute('SELECT value FROM kv_secrets WHERE key=?',
                        (key,)).fetchone()
     return row['value']
+
+
+# ---- volumes (reference sky/global_user_state volume table) --------------
+def add_or_update_volume(name: str, *, vol_type: str, cloud: str,
+                         region: Optional[str], zone: Optional[str],
+                         size_gb: Optional[int],
+                         config: Optional[Dict[str, Any]] = None,
+                         status: str = 'READY') -> None:
+    conn = _db().conn
+    conn.execute(
+        'INSERT INTO volumes (name, type, cloud, region, zone, size_gb, '
+        'config_json, status, created_at) VALUES (?,?,?,?,?,?,?,?,?) '
+        'ON CONFLICT(name) DO UPDATE SET status=excluded.status, '
+        'config_json=excluded.config_json',
+        (name, vol_type, cloud, region, zone, size_gb,
+         json.dumps(config or {}), status, time.time()))
+    conn.commit()
+
+
+def get_volume(name: str) -> Optional[Dict[str, Any]]:
+    row = _db().conn.execute('SELECT * FROM volumes WHERE name=?',
+                             (name,)).fetchone()
+    if row is None:
+        return None
+    d = dict(row)
+    d['config'] = json.loads(d.pop('config_json') or '{}')
+    return d
+
+
+def get_volumes() -> List[Dict[str, Any]]:
+    rows = _db().conn.execute(
+        'SELECT * FROM volumes ORDER BY created_at').fetchall()
+    out = []
+    for r in rows:
+        d = dict(r)
+        d['config'] = json.loads(d.pop('config_json') or '{}')
+        out.append(d)
+    return out
+
+
+def set_volume_status(name: str, status: str,
+                      attached_to: Optional[str] = None) -> None:
+    conn = _db().conn
+    if attached_to is not None:
+        conn.execute(
+            'UPDATE volumes SET status=?, attached_to=?, '
+            'last_attached_at=? WHERE name=?',
+            (status, attached_to, time.time(), name))
+    else:
+        conn.execute('UPDATE volumes SET status=?, attached_to=NULL '
+                     'WHERE name=?', (status, name))
+    conn.commit()
+
+
+def remove_volume(name: str) -> None:
+    conn = _db().conn
+    conn.execute('DELETE FROM volumes WHERE name=?', (name,))
+    conn.commit()

@@ -26,6 +26,7 @@ _VALID_NAME_RE = re.compile(r'^[a-zA-Z0-9]([a-zA-Z0-9._-]*[a-zA-Z0-9])?$')
 _TASK_FIELDS = {
     'name', 'workdir', 'setup', 'run', 'num_nodes', 'envs', 'secrets',
     'resources', 'file_mounts', 'storage_mounts', 'service', 'config',
+    'volumes',
 }
 
 
@@ -56,6 +57,7 @@ class Task:
         storage_mounts: Optional[Dict[str, Dict[str, Any]]] = None,
         service: Optional[Dict[str, Any]] = None,
         config_overrides: Optional[Dict[str, Any]] = None,
+        volumes: Optional[Dict[str, str]] = None,
     ):
         if name is not None and not _VALID_NAME_RE.match(name):
             raise exceptions.InvalidTaskError(
@@ -80,6 +82,8 @@ class Task:
             k: dict(v) for k, v in (storage_mounts or {}).items()}
         self.service = dict(service) if service else None
         self.config_overrides = dict(config_overrides or {})
+        # mount point -> registered volume name (reference task volumes)
+        self.volumes: Dict[str, str] = dict(volumes or {})
         # Filled by the optimizer (reference: best_resources on Task).
         self.best_resources: Optional[resources_lib.Resources] = None
         # Optional optimizer hints (reference Task.set_time_estimator /
@@ -107,6 +111,11 @@ class Task:
             if not isinstance(dst, str) or not isinstance(src, str):
                 raise exceptions.InvalidTaskError(
                     f'file_mounts entries must be str->str: {dst!r}: {src!r}')
+        for mp, vol in self.volumes.items():
+            if not isinstance(mp, str) or not isinstance(vol, str):
+                raise exceptions.InvalidTaskError(
+                    f'volumes entries must be mount_path->name strings: '
+                    f'{mp!r}: {vol!r}')
         for mp, spec in self.storage_mounts.items():
             if 'source' not in spec:
                 raise exceptions.InvalidTaskError(
@@ -178,6 +187,7 @@ class Task:
             storage_mounts=config.get('storage_mounts'),
             service=config.get('service'),
             config_overrides=config.get('config'),
+            volumes=config.get('volumes'),
         )
 
     @classmethod
@@ -220,6 +230,8 @@ class Task:
             cfg['service'] = dict(self.service)
         if self.config_overrides:
             cfg['config'] = dict(self.config_overrides)
+        if self.volumes:
+            cfg['volumes'] = dict(self.volumes)
         return cfg
 
     def to_yaml(self) -> str:

@@ -80,18 +80,31 @@ class SSHCommandRunner(CommandRunner):
     agent path is unavailable and for file sync."""
 
     def __init__(self, ip: str, user: str = 'root',
-                 key_path: Optional[str] = None, port: int = 22):
+                 key_path: Optional[str] = None, port: int = 22,
+                 password: Optional[str] = None):
         self.ip = ip
         self.user = user
         self.key_path = key_path
         self.port = port
+        self.password = password
+        if password and shutil.which('sshpass') is None:
+            raise exceptions.CommandError(
+                1, 'sshpass', 'password auth requires sshpass on PATH; '
+                'install it or use identity_file instead')
         os.makedirs(os.path.expanduser('~/.sky_tpu/ssh_control'),
                     exist_ok=True)
 
+    def _auth_prefix(self) -> List[str]:
+        return (['sshpass', '-p', self.password] if self.password else [])
+
     def _ssh_base(self) -> List[str]:
-        cmd = ['ssh', *_SSH_OPTS, '-p', str(self.port)]
+        cmd = self._auth_prefix() + ['ssh', *_SSH_OPTS, '-p',
+                                     str(self.port)]
         if self.key_path:
             cmd += ['-i', os.path.expanduser(self.key_path)]
+        if not self.password:
+            # Fail fast instead of prompting when key auth is rejected.
+            cmd += ['-o', 'BatchMode=yes']
         cmd.append(f'{self.user}@{self.ip}')
         return cmd
 
@@ -109,6 +122,7 @@ class SSHCommandRunner(CommandRunner):
         remote = f'{self.user}@{self.ip}:{dst}'
         pair = [src, remote] if up else [remote, src]
         proc = subprocess.run(
+            self._auth_prefix() +
             ['rsync', '-az', '--delete', '-e', ssh_cmd, *pair],
             capture_output=True, text=True)
         if proc.returncode != 0:

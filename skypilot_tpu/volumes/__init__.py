@@ -1,0 +1,8 @@
+"""Volumes: persistent storage attachable to clusters (reference
+``sky/volumes/``: Volume model volume.py:25, server ops server/core.py)."""
+from skypilot_tpu.volumes.core import (volume_apply, volume_delete,
+                                       volume_list, volume_refresh)
+from skypilot_tpu.volumes.volume import Volume, VolumeType
+
+__all__ = ['Volume', 'VolumeType', 'volume_apply', 'volume_delete',
+           'volume_list', 'volume_refresh']

@@ -1,0 +1,114 @@
+"""Volume server ops (reference ``sky/volumes/server/core.py``:
+volume_apply :303, volume_list :169, volume_delete :247,
+volume_refresh :28, per-volume lock :428)."""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+from skypilot_tpu.utils import locks
+from skypilot_tpu.volumes.volume import Volume, VolumeType
+
+logger = logging.getLogger(__name__)
+
+
+def _create_backend_resource(vol: Volume) -> None:
+    """Create the backing resource for non-existing volumes."""
+    if vol.type == VolumeType.GCP_PD and not vol.use_existing:
+        from skypilot_tpu.provision.gcp import tpu_api
+        client = tpu_api.GceDiskClient(
+            vol.config.get('project') or tpu_api.default_project())
+        client.create_disk(vol.zone, vol.name, vol.size_gb,
+                           disk_type=vol.config.get('disk_type',
+                                                    'pd-balanced'))
+    # gcsfuse/hostpath: backing store is created lazily at mount time
+    # (bucket must already exist or be creatable by the storage layer).
+
+
+def volume_apply(cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """Create/register a volume (idempotent). Reference :303."""
+    vol = Volume.from_yaml_config(cfg)
+    with locks.named_lock(f'volume_{vol.name}'):
+        existing = state.get_volume(vol.name)
+        if existing is not None:
+            if existing['type'] != vol.type.value:
+                raise exceptions.InvalidTaskError(
+                    f'Volume {vol.name!r} already exists with type '
+                    f'{existing["type"]} != {vol.type.value}.')
+            return existing
+        _create_backend_resource(vol)
+        state.add_or_update_volume(
+            vol.name, vol_type=vol.type.value, cloud=vol.cloud,
+            region=vol.region, zone=vol.zone, size_gb=vol.size_gb,
+            config=vol.config, status='READY')
+    return state.get_volume(vol.name)
+
+
+def volume_list() -> List[Dict[str, Any]]:
+    return state.get_volumes()
+
+
+def volume_delete(names: List[str]) -> None:
+    """Reference :247 — refuses while a cluster uses the volume."""
+    for name in names:
+        with locks.named_lock(f'volume_{name}'):
+            rec = state.get_volume(name)
+            if rec is None:
+                raise exceptions.VolumeNotFoundError(
+                    f'No such volume: {name}')
+            if rec['status'] == 'IN_USE':
+                raise exceptions.VolumeError(
+                    f'Volume {name!r} is attached to '
+                    f'{rec["attached_to"]!r}; detach (down the cluster) '
+                    f'first.')
+            if (rec['type'] == VolumeType.GCP_PD.value and
+                    not rec['config'].get('use_existing')):
+                from skypilot_tpu.provision.gcp import tpu_api
+                client = tpu_api.GceDiskClient(
+                    rec['config'].get('project') or
+                    tpu_api.default_project())
+                client.delete_disk(rec['zone'], name)
+            state.remove_volume(name)
+
+
+def volume_refresh() -> None:
+    """Reconcile IN_USE volumes whose cluster is gone (reference :28)."""
+    for rec in state.get_volumes():
+        if rec['status'] != 'IN_USE':
+            continue
+        cluster = rec.get('attached_to')
+        if cluster and state.get_cluster(cluster) is None:
+            logger.info('volume %s: cluster %s gone; marking READY',
+                        rec['name'], cluster)
+            state.set_volume_status(rec['name'], 'READY')
+
+
+def attach(name: str, cluster_name: str) -> Dict[str, Any]:
+    """Mark attached + return the record (used by the backend at mount
+    time)."""
+    with locks.named_lock(f'volume_{name}'):
+        rec = state.get_volume(name)
+        if rec is None:
+            raise exceptions.VolumeNotFoundError(f'No such volume: {name}')
+        if rec['status'] == 'IN_USE' and rec['attached_to'] != cluster_name:
+            raise exceptions.VolumeError(
+                f'Volume {name!r} is already attached to '
+                f'{rec["attached_to"]!r}.')
+        state.set_volume_status(name, 'IN_USE', attached_to=cluster_name)
+        return state.get_volume(name)
+
+
+def detach_all(cluster_name: str) -> None:
+    """Release every volume held by `cluster_name` (teardown path)."""
+    for rec in state.get_volumes():
+        if rec.get('attached_to') == cluster_name:
+            state.set_volume_status(rec['name'], 'READY')
+
+
+def to_volume(rec: Dict[str, Any]) -> Volume:
+    return Volume(name=rec['name'], type=VolumeType(rec['type']),
+                  cloud=rec['cloud'], region=rec['region'],
+                  zone=rec['zone'], size_gb=rec['size_gb'],
+                  use_existing=True, config=rec['config'])

@@ -1,0 +1,272 @@
+"""Volumes + SSH node pools.
+
+Reference coverage model: sky/volumes (apply/list/delete, attach
+refcounting) and sky/ssh_node_pools (pool CRUD, key handling), plus the
+ssh provisioner's process mode driving a real launch offline.
+"""
+import os
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+from skypilot_tpu import volumes
+from skypilot_tpu.ssh_node_pools import SSHNodePoolManager
+from skypilot_tpu.volumes.volume import Volume, VolumeType, parse_size_gb
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path))
+    monkeypatch.setenv('SKY_TPU_CONFIG', str(tmp_path / 'config.yaml'))
+    from skypilot_tpu import config
+    config.reload()
+    yield
+    config.reload()
+
+
+# ---- volume model --------------------------------------------------------
+def test_parse_size():
+    assert parse_size_gb('100Gi') == 100
+    assert parse_size_gb('100') == 100
+    assert parse_size_gb('2Ti') == 2048
+    assert parse_size_gb(None) is None
+    with pytest.raises(exceptions.InvalidTaskError):
+        parse_size_gb('ten')
+
+
+def test_volume_validation():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Volume(name='d', type=VolumeType.GCP_PD)   # needs size+zone
+    with pytest.raises(exceptions.InvalidTaskError):
+        Volume(name='h', type=VolumeType.HOSTPATH)  # needs path
+    v = Volume.from_yaml_config({
+        'name': 'ckpt', 'type': 'gcsfuse',
+        'config': {'bucket': 'my-bkt'}})
+    assert v.config['bucket'] == 'my-bkt'
+    assert 'gcsfuse' in v.mount_command('/ckpt') or \
+        'my-bkt' in v.mount_command('/ckpt')
+    with pytest.raises(exceptions.InvalidTaskError):
+        Volume.from_yaml_config({'name': 'x', 'type': 'ebs'})
+
+
+def test_volume_apply_list_delete():
+    rec = volumes.volume_apply({'name': 'scratch', 'type': 'hostpath',
+                                'config': {'path': '/tmp/scratch'}})
+    assert rec['status'] == 'READY'
+    # Idempotent re-apply.
+    again = volumes.volume_apply({'name': 'scratch', 'type': 'hostpath',
+                                  'config': {'path': '/tmp/scratch'}})
+    assert again['name'] == 'scratch'
+    # Type conflict rejected.
+    with pytest.raises(exceptions.InvalidTaskError):
+        volumes.volume_apply({'name': 'scratch', 'type': 'gcsfuse'})
+    assert [v['name'] for v in volumes.volume_list()] == ['scratch']
+    volumes.volume_delete(['scratch'])
+    assert volumes.volume_list() == []
+    with pytest.raises(exceptions.VolumeNotFoundError):
+        volumes.volume_delete(['scratch'])
+
+
+def test_volume_attach_refcount():
+    from skypilot_tpu.volumes import core as vcore
+    volumes.volume_apply({'name': 'v1', 'type': 'hostpath',
+                          'config': {'path': '/tmp/v1'}})
+    vcore.attach('v1', 'cluster-a')
+    assert state.get_volume('v1')['status'] == 'IN_USE'
+    # Second cluster cannot steal it.
+    with pytest.raises(exceptions.VolumeError):
+        vcore.attach('v1', 'cluster-b')
+    # Same cluster re-attach is fine (idempotent mounts).
+    vcore.attach('v1', 'cluster-a')
+    # Deleting while attached is refused.
+    with pytest.raises(exceptions.VolumeError):
+        volumes.volume_delete(['v1'])
+    vcore.detach_all('cluster-a')
+    assert state.get_volume('v1')['status'] == 'READY'
+    volumes.volume_delete(['v1'])
+
+
+def test_volume_refresh_reconciles_dead_cluster():
+    from skypilot_tpu.volumes import core as vcore
+    volumes.volume_apply({'name': 'v2', 'type': 'hostpath',
+                          'config': {'path': '/tmp/v2'}})
+    vcore.attach('v2', 'ghost-cluster')
+    volumes.volume_refresh()   # ghost-cluster is not in the state DB
+    assert state.get_volume('v2')['status'] == 'READY'
+
+
+def test_volume_mounted_on_launch(tmp_path):
+    """E2E on the local fake slice: a hostpath volume lands in the task's
+    filesystem and detaches on down."""
+    from skypilot_tpu import core
+    host_store = tmp_path / 'host_store'
+    volumes.volume_apply({'name': 'data', 'type': 'hostpath',
+                          'config': {'path': str(host_store)}})
+    task = sky.Task(
+        'vol-task', run='echo hello > /tmp/skyvol/out.txt',
+        resources=sky.Resources(cloud='local', accelerators='v5e-4'),
+        volumes={'/tmp/skyvol': 'data'})
+    job_id, info = core.launch(task, cluster_name='vol-c', quiet=True)
+    try:
+        assert core.wait_job('vol-c', job_id, timeout=60).value == \
+            'SUCCEEDED'
+        assert state.get_volume('data')['attached_to'] == 'vol-c'
+        assert (host_store / 'out.txt').read_text().strip() == 'hello'
+    finally:
+        core.down('vol-c')
+    assert state.get_volume('data')['status'] == 'READY'
+    volumes.volume_delete(['data'])
+
+
+# ---- ssh node pools ------------------------------------------------------
+def test_pool_crud_and_validation():
+    mgr = SSHNodePoolManager()
+    with pytest.raises(exceptions.InvalidTaskError):
+        mgr.add_or_update_pool('bad', {'hosts': []})
+    with pytest.raises(exceptions.InvalidTaskError):
+        mgr.add_or_update_pool('bad', {'hosts': ['h1']})   # no user/key
+    mgr.add_or_update_pool('rack1', {
+        'hosts': ['10.0.0.1', '10.0.0.2'], 'user': 'ops',
+        'identity_file': '~/.ssh/id', 'accelerator': 'v4-16'})
+    assert 'rack1' in mgr.get_all_pools()
+    mgr.update_pools({'rack2': {'hosts': ['10.0.1.1'], 'user': 'ops',
+                                'password': 'x'}})
+    assert set(mgr.get_all_pools()) == {'rack1', 'rack2'}
+    assert mgr.delete_pool('rack2')
+    assert not mgr.delete_pool('rack2')
+
+
+def test_pool_keys():
+    mgr = SSHNodePoolManager()
+    path = mgr.save_ssh_key('deploy', 'FAKE KEY MATERIAL')
+    assert oct(os.stat(path).st_mode & 0o777) == '0o600'
+    assert mgr.list_ssh_keys() == ['deploy']
+    with pytest.raises(exceptions.InvalidTaskError):
+        mgr.save_ssh_key('../evil', 'x')
+
+
+def test_pool_catalog_candidates():
+    from skypilot_tpu import catalog
+    mgr = SSHNodePoolManager()
+    mgr.add_or_update_pool('tpurack', {
+        'hosts': ['h0', 'h1', 'h2', 'h3'], 'user': 'ops',
+        'identity_file': '~/.ssh/id', 'accelerator': 'v4-32'})
+    cands = catalog.get_candidates(
+        sky.Resources(cloud='ssh', instance_type='tpurack'))
+    assert len(cands) == 1
+    assert cands[0].num_hosts == 4
+    assert cands[0].cost_per_hour == 0.0
+    assert cands[0].tpu.name == 'v4-32'
+    # TPU-shaped request matches only pools with that accelerator.
+    cands2 = catalog.get_candidates(
+        sky.Resources(cloud='ssh', accelerators='v4-32'))
+    assert [c.instance_type for c in cands2] == ['tpurack']
+    assert catalog.get_candidates(
+        sky.Resources(cloud='ssh', accelerators='v5e-8')) == []
+
+
+def test_pool_process_mode_launch():
+    """Full launch onto a process-mode pool: the pool is the slice."""
+    from skypilot_tpu import core
+    mgr = SSHNodePoolManager()
+    mgr.add_or_update_pool('simrack', {
+        'hosts': ['127.0.0.1', '127.0.0.1'], 'mode': 'process'})
+    task = sky.Task(
+        'pool-task', run='echo POOLRANK=$SKY_TPU_NODE_RANK',
+        resources=sky.Resources(cloud='ssh', instance_type='simrack'))
+    job_id, info = core.launch(task, cluster_name='pool-c', quiet=True)
+    try:
+        assert info.cloud == 'ssh'
+        assert info.num_hosts == 2
+        assert core.wait_job('pool-c', job_id, timeout=60).value == \
+            'SUCCEEDED'
+        log = b''.join(core.tail_logs('pool-c', job_id, follow=False,
+                                      rank=1)).decode()
+        assert 'POOLRANK=1' in log
+    finally:
+        core.down('pool-c')
+
+
+# ---- review regressions --------------------------------------------------
+def test_mount_command_quotes_hostile_paths():
+    v = Volume(name='h', type=VolumeType.HOSTPATH,
+               config={'path': '/tmp/x; touch /tmp/pwned'})
+    cmd = v.mount_command('/data dir')
+    import shlex
+    assert shlex.quote('/data dir') in cmd
+    assert shlex.quote('/tmp/x; touch /tmp/pwned') in cmd
+    assert '; touch /tmp/pwned ' not in cmd
+
+
+def test_stop_keeps_volumes_attached():
+    """Stopping a cluster must not release its volumes to other
+    clusters; only terminate does."""
+    from skypilot_tpu import core
+    from skypilot_tpu.volumes import core as vcore
+    volumes.volume_apply({'name': 'pv', 'type': 'hostpath',
+                          'config': {'path': '/tmp/pv'}})
+    task = sky.Task('t', run='echo hi',
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4'),
+                    volumes={'/tmp/pvmnt': 'pv'})
+    job_id, _ = core.launch(task, cluster_name='stopc', quiet=True)
+    core.wait_job('stopc', job_id, timeout=60)
+    assert state.get_volume('pv')['status'] == 'IN_USE'
+    core.stop('stopc')
+    assert state.get_volume('pv')['status'] == 'IN_USE'
+    with pytest.raises(exceptions.VolumeError):
+        vcore.attach('pv', 'other-cluster')
+    core.down('stopc')
+    assert state.get_volume('pv')['status'] == 'READY'
+    volumes.volume_delete(['pv'])
+
+
+def test_password_pool_requires_sshpass(monkeypatch):
+    from skypilot_tpu.utils import command_runner
+    monkeypatch.setattr('shutil.which', lambda _: None)
+    with pytest.raises(exceptions.CommandError, match='sshpass'):
+        command_runner.SSHCommandRunner('10.0.0.1', user='u',
+                                        password='secret')
+
+
+def test_create_node_data_disks_shape(monkeypatch):
+    from skypilot_tpu.provision.gcp import tpu_api
+    captured = {}
+
+    client = tpu_api.TpuApiClient('proj-x')
+
+    def fake_request(method, url, json_body=None):
+        captured['body'] = json_body
+        return {'done': True}
+
+    monkeypatch.setattr(client, '_request', fake_request)
+    client.create_node('us-central2-b', 'n1', accelerator_type='v4-16',
+                       runtime_version='tpu-ubuntu2204-base',
+                       data_disks=['ckpt-disk'])
+    dd = captured['body']['dataDisks']
+    assert dd == [{'sourceDisk':
+                   'projects/proj-x/zones/us-central2-b/disks/ckpt-disk',
+                   'mode': 'READ_WRITE'}]
+
+
+def test_pd_volume_pins_provision_zone():
+    """Candidates outside the gcp-pd volume's zone are filtered out."""
+    from skypilot_tpu import backend as backend_lib
+    state.add_or_update_volume('zonal', vol_type='gcp-pd', cloud='gcp',
+                               region='us-central1', zone='us-central1-a',
+                               size_gb=100, status='READY')
+    task = sky.Task('t', run='x',
+                    resources=sky.Resources(cloud='gcp',
+                                            accelerators='v5e-8'),
+                    volumes={'/ckpt': 'zonal'})
+    from skypilot_tpu import catalog
+    cands = catalog.get_candidates(task.resources)
+    wrong_zone = [c for c in cands if c.zone != 'us-central1-a']
+    assert wrong_zone, 'test needs candidates outside the pinned zone'
+    # Provision with ONLY wrong-zone candidates must fail fast.
+    be = backend_lib.TpuVmBackend()
+    with pytest.raises(exceptions.ResourcesUnavailableError,
+                       match='us-central1-a'):
+        be.provision(task, 'pinned-c', wrong_zone)
