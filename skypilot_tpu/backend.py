@@ -70,7 +70,16 @@ class TpuVmBackend(Backend):
         pd_zones = set()
         for vol_name in task.volumes.values():
             rec = state.get_volume(vol_name)
-            if rec is not None and rec['type'] == 'gcp-pd':
+            if rec is None:
+                continue   # mount_volumes reports unknown names
+            # Fail BEFORE provisioning a slice the volumes can't join.
+            if (rec['status'] == 'IN_USE' and
+                    rec['attached_to'] != cluster_name):
+                raise exceptions.VolumeError(
+                    f'Volume {vol_name!r} is attached to '
+                    f'{rec["attached_to"]!r}; detach it before '
+                    f'launching.')
+            if rec['type'] == 'gcp-pd':
                 data_disks.append(rec['name'])
                 pd_zones.add(rec['zone'])
         if data_disks:
@@ -226,13 +235,14 @@ class TpuVmBackend(Backend):
     # ---- teardown -------------------------------------------------------
     def teardown(self, info: ClusterInfo, terminate: bool) -> None:
         if terminate:
-            # Stop keeps volumes attached (the stopped cluster still owns
-            # its disks/data); only terminate releases them.
-            from skypilot_tpu.volumes import core as volumes_core
-            volumes_core.detach_all(info.cluster_name)
-        if terminate:
             provision.terminate_instances(info.cloud, info.cluster_name,
                                           info.provider_config)
+            # Volumes release only AFTER a successful terminate — a
+            # failed delete must not let another cluster claim a disk
+            # that is still attached. Stop keeps them attached (the
+            # stopped cluster still owns its disks/data).
+            from skypilot_tpu.volumes import core as volumes_core
+            volumes_core.detach_all(info.cluster_name)
             state.remove_cluster(info.cluster_name)
             state.add_cluster_event(info.cluster_name, 'TERMINATED', 'down')
         else:

@@ -763,7 +763,7 @@ def volumes_apply(spec_yaml: str) -> None:
     """Create/register a volume from a YAML spec."""
     import yaml as yaml_lib
     with open(os.path.expanduser(spec_yaml), encoding='utf-8') as f:
-        cfg = yaml_lib.safe_load(f)
+        cfg = yaml_lib.safe_load(f) or {}
     if _remote():
         from skypilot_tpu.client import sdk
         rec = sdk.call('volumes.apply', {'spec': cfg})

@@ -1,0 +1,12 @@
+"""Web dashboard (reference ``sky/dashboard/``: a Next.js app, 109 source
+files). Here: a dependency-free single-page app served by the API server
+at ``/dashboard`` — clusters, jobs, services, requests, infra — consuming
+the same REST ops as the SDK."""
+import os
+
+STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          'static')
+
+
+def index_path() -> str:
+    return os.path.join(STATIC_DIR, 'index.html')

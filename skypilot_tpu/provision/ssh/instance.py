@@ -150,7 +150,8 @@ def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any]) -> None:
             ] if rank == 0 else [],
             'provider_config': {'pool': pool['name'],
                                 'ssh_user': pool['user'],
-                                'ssh_key': pool.get('identity_file')},
+                                'ssh_key': pool.get('identity_file'),
+                                'ssh_password': pool.get('password')},
         }
         cfg_json = json.dumps(agent_config).replace("'", "'\\''")
         runner.run(
@@ -169,9 +170,14 @@ def stop_instances(cluster_name: str,
     if meta and meta.get('mode') == 'process':
         local_instance.stop_instances(cluster_name, provider_config)
         return
-    # Bare metal "stop" = stop the agents; hosts stay up.
-    pool = _pool_of({'pool': (meta or {}).get('pool') or
-                     provider_config.get('pool')})
+    # Bare metal "stop" = stop the agents; hosts stay up. A deleted
+    # pool config must not wedge the cluster in a half-stopped state
+    # (terminate has the same guard).
+    try:
+        pool = _pool_of({'pool': (meta or {}).get('pool') or
+                         provider_config.get('pool')})
+    except exceptions.SkyTpuError:
+        return
     for host in pool['hosts']:
         _runner_for(host, pool).run(
             'pkill -f skypilot_tpu.runtime.agent || true', timeout=30,
@@ -262,7 +268,8 @@ def get_cluster_info(cluster_name: str,
         cost_per_hour=0.0,
         provider_config={'pool': meta['pool'],
                          'ssh_user': pool.get('user'),
-                         'ssh_key': pool.get('identity_file')})
+                         'ssh_key': pool.get('identity_file'),
+                         'ssh_password': pool.get('password')})
 
 
 def open_ports(cluster_name: str, ports,

@@ -193,6 +193,10 @@ class Server:
             return functools.partial(core.check, payload.get('clouds'))
         if name == 'cost_report':
             return core.cost_report
+        if name == 'accelerators':
+            from skypilot_tpu import catalog
+            return functools.partial(catalog.list_accelerators,
+                                     name_filter=payload.get('filter'))
         if name.startswith('volumes.'):
             return self._dispatch_volumes(name, payload)
         if name.startswith('pools.'):
@@ -442,6 +446,17 @@ class Server:
         await resp.write_eof()
         return resp
 
+    async def h_dashboard(self, _req: web.Request) -> web.Response:
+        """Serve the single-page dashboard (reference sky/dashboard)."""
+        from skypilot_tpu import dashboard
+        try:
+            with open(dashboard.index_path(), encoding='utf-8') as f:
+                html = f.read()
+        except FileNotFoundError:
+            return web.Response(text='dashboard assets missing',
+                                status=404)
+        return web.Response(text=html, content_type='text/html')
+
     async def h_health(self, _req: web.Request) -> web.Response:
         return web.json_response({
             'status': 'healthy',
@@ -474,7 +489,10 @@ class Server:
         from skypilot_tpu import config as config_lib
         from skypilot_tpu import users as users_lib
         from skypilot_tpu.users import rbac
-        if req.path in ('/api/health', '/metrics'):
+        if req.path in ('/api/health', '/metrics', '/', '/dashboard'):
+            # The dashboard page itself must load without a bearer header
+            # (browsers can't attach one to the initial GET); every API
+            # call it makes is still individually authenticated.
             return await handler(req)
         authz = req.headers.get('Authorization', '')
         server: 'Server' = req.app['server']
@@ -505,6 +523,8 @@ class Server:
         app = web.Application(middlewares=[self.auth_middleware])
         app['server'] = self
         app.router.add_get('/api/health', self.h_health)
+        app.router.add_get('/dashboard', self.h_dashboard)
+        app.router.add_get('/', self.h_dashboard)
         app.router.add_get('/metrics', self.h_metrics)
         app.router.add_get('/api/requests', self.h_requests)
         app.router.add_get('/api/get/{request_id}', self.h_get)

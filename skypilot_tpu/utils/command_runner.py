@@ -111,8 +111,16 @@ class SSHCommandRunner(CommandRunner):
     def run(self, cmd: str, *, timeout: Optional[float] = None,
             check: bool = False) -> Tuple[int, str, str]:
         full = self._ssh_base() + [f'bash -lc {shlex.quote(cmd)}']
-        proc = subprocess.run(full, capture_output=True, text=True,
-                              timeout=timeout)
+        try:
+            proc = subprocess.run(full, capture_output=True, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired:
+            # A hung handshake must look like a failed command (rc 124,
+            # GNU timeout convention), not a raw TimeoutExpired that
+            # escapes the provisioner's failover error handling.
+            rc, err = 124, f'ssh to {self.ip} timed out after {timeout}s'
+            self._check(rc, cmd, err, check)
+            return rc, '', err
         self._check(proc.returncode, cmd, proc.stderr, check)
         return proc.returncode, proc.stdout, proc.stderr
 

@@ -153,3 +153,19 @@ def test_workspaces_ops_via_server(api_server):
                         timeout=5).json()['request_id']
     res = sdk.get(rid)
     assert 'api-ws' not in res
+
+
+def test_dashboard_served(api_server):
+    """GET / and /dashboard return the single-page app; the ops it
+    drives (accelerators, status all_workspaces) answer."""
+    for path in ('/', '/dashboard'):
+        r = requests.get(f'{api_server}{path}', timeout=5)
+        assert r.status_code == 200
+        assert 'text/html' in r.headers['Content-Type']
+        assert 'sky-tpu dashboard' in r.text
+    rid = requests.post(f'{api_server}/accelerators',
+                        json={'filter': 'v5p'},
+                        timeout=5).json()['request_id']
+    from skypilot_tpu.client import sdk
+    accs = sdk.get(rid)
+    assert any(k.startswith('v5p') for k in accs)

@@ -270,3 +270,35 @@ def test_pd_volume_pins_provision_zone():
     with pytest.raises(exceptions.ResourcesUnavailableError,
                        match='us-central1-a'):
         be.provision(task, 'pinned-c', wrong_zone)
+
+
+def test_launch_fails_fast_on_attached_volume():
+    """A volume IN_USE by another cluster aborts BEFORE provisioning."""
+    from skypilot_tpu import core
+    from skypilot_tpu.volumes import core as vcore
+    volumes.volume_apply({'name': 'busyvol', 'type': 'hostpath',
+                          'config': {'path': '/tmp/busyvol'}})
+    vcore.attach('busyvol', 'other-c')
+    task = sky.Task('t', run='echo hi',
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4'),
+                    volumes={'/mnt': 'busyvol'})
+    with pytest.raises(exceptions.VolumeError, match='other-c'):
+        core.launch(task, cluster_name='conflict-c', quiet=True)
+    # Nothing was provisioned.
+    assert state.get_cluster('conflict-c') is None
+
+
+def test_ssh_run_timeout_returns_rc_124(monkeypatch):
+    import subprocess as sp
+    from skypilot_tpu.utils import command_runner
+
+    def fake_run(*a, **kw):
+        raise sp.TimeoutExpired(cmd='ssh', timeout=kw.get('timeout'))
+
+    monkeypatch.setattr(sp, 'run', fake_run)
+    r = command_runner.SSHCommandRunner('10.9.9.9', user='u')
+    rc, _, err = r.run('true', timeout=1, check=False)
+    assert rc == 124 and 'timed out' in err
+    with pytest.raises(exceptions.CommandError):
+        r.run('true', timeout=1, check=True)
