@@ -127,10 +127,13 @@ class TpuVmBackend(Backend):
                 os.path.join(cdir, f'host{i}'))
                 for i in range(info.num_hosts)]
         ssh_user = info.provider_config.get('ssh_user', 'sky')
-        key = info.provider_config.get('ssh_key',
-                                       '~/.sky_tpu/keys/sky-key')
+        password = info.provider_config.get('ssh_password')
+        key = info.provider_config.get('ssh_key')
+        if key is None and not password:
+            key = '~/.sky_tpu/keys/sky-key'
         return [command_runner.SSHCommandRunner(
-            h.external_ip or h.internal_ip, user=ssh_user, key_path=key)
+            h.external_ip or h.internal_ip, user=ssh_user, key_path=key,
+            password=password)
             for h in info.hosts]
 
     def _remote_workdir(self, info: ClusterInfo) -> str:

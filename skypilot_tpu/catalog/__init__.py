@@ -155,12 +155,13 @@ def get_candidates(resources: 'Resources') -> List[Candidate]:  # noqa: F821
     if resources.cloud:
         clouds = [resources.cloud]
     else:
-        # Unpinned requests consider enabled *real* clouds only. The free
-        # in-process 'local' fake is never auto-selected — its $0.00/hr
-        # would win every cost ranking — it must be pinned explicitly with
-        # `cloud: local`.
+        # Unpinned requests consider enabled *priced* clouds only. The
+        # $0.00/hr clouds (local fake, sunk-cost ssh pools, in-cluster
+        # kubernetes) would win every cost ranking — they must be pinned
+        # explicitly with `cloud: ...`.
         from skypilot_tpu import state
-        enabled = [c for c in state.get_enabled_clouds() if c != 'local']
+        enabled = [c for c in state.get_enabled_clouds()
+                   if c not in ('local', 'ssh', 'kubernetes')]
         clouds = enabled or ['gcp']
 
     for cloud in clouds:
@@ -169,6 +170,11 @@ def get_candidates(resources: 'Resources') -> List[Candidate]:  # noqa: F821
             continue
         if cloud == 'ssh':
             out.extend(_ssh_pool_candidates(resources))
+            continue
+        if cloud == 'kubernetes':
+            cand = _k8s_candidate(resources)
+            if cand is not None:
+                out.append(cand)
             continue
         for e in _load(cloud):
             if resources.region and e.region != resources.region:
@@ -238,6 +244,26 @@ def _local_candidate(resources: 'Resources') -> Candidate:  # noqa: F821
         cloud='local', region='local', zone='local',
         instance_type=(f'tpu-{tpu.name}' if tpu else
                        resources.instance_type or 'local-vm'),
+        accelerator_name=resources.accelerator_name,
+        accelerator_count=resources.accelerator_count,
+        use_spot=resources.use_spot,
+        cost_per_hour=0.0,
+        num_hosts=tpu.num_hosts if tpu else 1,
+        tpu=tpu)
+
+
+def _k8s_candidate(resources: 'Resources') -> Optional[Candidate]:  # noqa: F821,E501
+    """In-cluster placement: the GKE cluster is sunk cost ($0/hr); slice
+    shape still gangs via the TPU topology (provision/k8s renders the
+    StatefulSet from it)."""
+    from skypilot_tpu import config as config_lib
+    tpu = resources.tpu
+    ctx = config_lib.get_nested(('kubernetes', 'context'), 'in-cluster')
+    ns = config_lib.get_nested(('kubernetes', 'namespace'), 'default')
+    return Candidate(
+        cloud='kubernetes', region=ctx, zone=ns,
+        instance_type=(f'tpu-{tpu.name}' if tpu else
+                       resources.instance_type or 'pod'),
         accelerator_name=resources.accelerator_name,
         accelerator_count=resources.accelerator_count,
         use_spot=resources.use_spot,

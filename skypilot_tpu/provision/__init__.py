@@ -25,6 +25,7 @@ _PROVIDERS = {
     'local': 'skypilot_tpu.provision.local.instance',
     'gcp': 'skypilot_tpu.provision.gcp.instance',
     'ssh': 'skypilot_tpu.provision.ssh.instance',
+    'kubernetes': 'skypilot_tpu.provision.k8s.instance',
 }
 
 

@@ -1,0 +1,111 @@
+"""Kubernetes manifest rendering for TPU slices on GKE.
+
+The reference's largest provisioner is kubernetes
+(sky/provision/kubernetes/, pod-based with jinja templates). The
+TPU-native shape is different and simpler: a multi-host TPU slice on GKE
+is a *StatefulSet with one pod per TPU-VM host* plus a headless Service
+— GKE's TPU webhook injects TPU_WORKER_ID/TPU_WORKER_HOSTNAMES from the
+pod ordinal when the pods carry the TPU nodeSelectors, which is exactly
+the gang identity the agent needs.
+
+GKE nodeSelector mapping (public GKE docs' accelerator names):
+    v4  -> tpu-v4-podslice        v5e -> tpu-v5-lite-podslice
+    v5p -> tpu-v5p-slice          v6e -> tpu-v6e-slice
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import topology
+
+GKE_TPU_ACCELERATOR = {
+    'v4': 'tpu-v4-podslice',
+    'v5e': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
+    'v6e': 'tpu-v6e-slice',
+}
+
+LABEL_CLUSTER = 'sky-tpu-cluster'
+AGENT_PORT = 46590
+DEFAULT_IMAGE = 'python:3.11-slim'
+
+
+def render_slice(cluster_name: str,
+                 tpu: Optional[topology.TpuSlice],
+                 *,
+                 namespace: str = 'default',
+                 image: str = DEFAULT_IMAGE,
+                 cpu: str = '4',
+                 memory: str = '16Gi',
+                 labels: Optional[Dict[str, str]] = None
+                 ) -> Dict[str, Any]:
+    """Headless Service + StatefulSet for one slice (or one CPU pod when
+    tpu is None). Returned as a kubectl-applyable List manifest."""
+    num_hosts = tpu.num_hosts if tpu else 1
+    # The gang size survives scale-to-zero stops via this label (start
+    # reads it back to restore the full slice).
+    meta_labels = {LABEL_CLUSTER: cluster_name,
+                   'sky-tpu-num-hosts': str(num_hosts),
+                   **(labels or {})}
+    container: Dict[str, Any] = {
+        'name': 'sky-host',
+        'image': image,
+        'command': ['/bin/bash', '-c'],
+        # The agent is installed+started by the provisioner's bootstrap
+        # exec (mirrors the TPU-VM path); the pod just stays alive.
+        'args': ['sleep infinity'],
+        'ports': [{'containerPort': AGENT_PORT, 'name': 'sky-agent'}],
+        'resources': {'requests': {'cpu': cpu, 'memory': memory},
+                      'limits': {}},
+        'env': [
+            {'name': 'SKY_TPU_CLUSTER', 'value': cluster_name},
+        ],
+    }
+    pod_spec: Dict[str, Any] = {
+        'containers': [container],
+        # Gang semantics: a slice pod that dies must come back on the
+        # same slice; Never lets the controller recreate it instead of
+        # restarting in place with stale TPU state.
+        'restartPolicy': 'Always',
+        'subdomain': cluster_name,
+    }
+    if tpu is not None:
+        chips = tpu.chips_per_host
+        container['resources']['requests']['google.com/tpu'] = str(chips)
+        container['resources']['limits']['google.com/tpu'] = str(chips)
+        pod_spec['nodeSelector'] = {
+            'cloud.google.com/gke-tpu-accelerator':
+                GKE_TPU_ACCELERATOR[tpu.generation],
+            'cloud.google.com/gke-tpu-topology': tpu.topology_str,
+        }
+    service = {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {'name': cluster_name, 'namespace': namespace,
+                     'labels': meta_labels},
+        'spec': {
+            'clusterIP': 'None',       # headless: stable per-pod DNS
+            'selector': {LABEL_CLUSTER: cluster_name},
+            'ports': [{'port': AGENT_PORT, 'name': 'sky-agent'}],
+        },
+    }
+    statefulset = {
+        'apiVersion': 'apps/v1',
+        'kind': 'StatefulSet',
+        'metadata': {'name': cluster_name, 'namespace': namespace,
+                     'labels': meta_labels},
+        'spec': {
+            'serviceName': cluster_name,
+            'replicas': num_hosts,
+            # All-or-nothing gang: pods start in parallel, not ordinal
+            # order — host 7 must not wait for host 0's readiness.
+            'podManagementPolicy': 'Parallel',
+            'selector': {'matchLabels': {LABEL_CLUSTER: cluster_name}},
+            'template': {
+                'metadata': {'labels': meta_labels},
+                'spec': pod_spec,
+            },
+        },
+    }
+    return {'apiVersion': 'v1', 'kind': 'List',
+            'items': [service, statefulset]}

@@ -148,10 +148,11 @@ def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any]) -> None:
                 f'http://{h}:{AGENT_PORT}'
                 for i, h in enumerate(hosts) if i != rank
             ] if rank == 0 else [],
+            # NOTE: no password here — agent_config.json lands on every
+            # host and the agent never sshes outward.
             'provider_config': {'pool': pool['name'],
                                 'ssh_user': pool['user'],
-                                'ssh_key': pool.get('identity_file'),
-                                'ssh_password': pool.get('password')},
+                                'ssh_key': pool.get('identity_file')},
         }
         cfg_json = json.dumps(agent_config).replace("'", "'\\''")
         runner.run(

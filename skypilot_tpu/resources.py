@@ -21,7 +21,7 @@ _ACC_RE = re.compile(r'^([A-Za-z0-9\-]+?)(?::(\d+))?$')
 # Clouds known to the framework. 'local' is the in-process fake used by tests
 # and the minimum-E2E path (reference analog: the mock_aws_backend fixture,
 # reference tests/conftest.py:33).
-KNOWN_CLOUDS = ('gcp', 'local', 'ssh')
+KNOWN_CLOUDS = ('gcp', 'local', 'ssh', 'kubernetes')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -168,11 +168,6 @@ class Resources:
                 f'Invalid {what} spec: {value!r}') from None
 
     def _validate(self) -> None:
-        if self._tpu is not None and self._cloud not in (None, 'gcp',
-                                                         'local', 'ssh'):
-            raise exceptions.InvalidResourcesError(
-                f'TPU {self._accelerator_name} requires cloud gcp (or local '
-                f'for tests); got {self._cloud!r}')
         if self._use_spot and self._autostop and self._autostop.enabled:
             # Allowed in the reference too; just a sanity check placeholder.
             pass

@@ -1,0 +1,194 @@
+"""Kubernetes (GKE TPU) provisioner, tested against a fake kubectl.
+
+Reference analog: the k8s provisioner's unit tests run against fake
+cluster APIs; here a stub kubectl on PATH records invocations and
+serves canned pod JSON, so manifest rendering, gang wait, bootstrap,
+and the stop/start/terminate lifecycle are all exercised offline.
+"""
+import json
+import os
+import stat
+import textwrap
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology
+from skypilot_tpu.provision.common import ProvisionConfig
+from skypilot_tpu.provision.k8s import instance as k8s
+from skypilot_tpu.provision.k8s import manifests
+
+
+# ---- manifest rendering --------------------------------------------------
+def test_render_multihost_slice():
+    tpu = topology.parse_tpu('v5e-16')   # 4 hosts x 4 chips
+    m = manifests.render_slice('trainer', tpu, namespace='ml')
+    svc, sts = m['items']
+    assert svc['kind'] == 'Service'
+    assert svc['spec']['clusterIP'] == 'None'
+    assert sts['spec']['replicas'] == 4
+    assert sts['spec']['podManagementPolicy'] == 'Parallel'
+    assert sts['metadata']['labels']['sky-tpu-num-hosts'] == '4'
+    pod = sts['spec']['template']['spec']
+    sel = pod['nodeSelector']
+    assert sel['cloud.google.com/gke-tpu-accelerator'] == \
+        'tpu-v5-lite-podslice'
+    assert sel['cloud.google.com/gke-tpu-topology'] == '4x4'
+    res = pod['containers'][0]['resources']
+    assert res['requests']['google.com/tpu'] == '4'
+    assert res['limits']['google.com/tpu'] == '4'
+
+
+def test_render_v5p_and_cpu():
+    tpu = topology.parse_tpu('v5p-16')
+    m = manifests.render_slice('big', tpu)
+    sts = m['items'][1]
+    sel = sts['spec']['template']['spec']['nodeSelector']
+    assert sel['cloud.google.com/gke-tpu-accelerator'] == 'tpu-v5p-slice'
+    # CPU pod: no TPU selector, 1 replica.
+    m2 = manifests.render_slice('cpu-only', None)
+    sts2 = m2['items'][1]
+    assert sts2['spec']['replicas'] == 1
+    assert 'nodeSelector' not in sts2['spec']['template']['spec']
+
+
+def test_gke_slice_name_roundtrip():
+    assert k8s._slice_name_from_gke('tpu-v5-lite-podslice', '4x4') == \
+        'v5e-16'
+    assert k8s._slice_name_from_gke('tpu-v5p-slice', '2x2x2') == 'v5p-16'
+    assert k8s._slice_name_from_gke('tpu-v4-podslice', '2x2x1') == 'v4-8'
+    assert k8s._slice_name_from_gke(None, None) is None
+
+
+# ---- fake kubectl harness ------------------------------------------------
+@pytest.fixture
+def fake_kubectl(tmp_path, monkeypatch):
+    """A kubectl stub: logs argv+stdin to calls.jsonl, replies from
+    canned files keyed by subcommand."""
+    bindir = tmp_path / 'bin'
+    bindir.mkdir()
+    calls = tmp_path / 'calls.jsonl'
+    replies = tmp_path / 'replies'
+    replies.mkdir()
+    script = bindir / 'kubectl'
+    script.write_text(textwrap.dedent(f"""\
+        #!/usr/bin/env python3
+        import json, os, sys
+        argv = sys.argv[1:]
+        stdin = sys.stdin.read() if not sys.stdin.isatty() else ''
+        with open({str(calls)!r}, 'a') as f:
+            f.write(json.dumps({{'argv': argv, 'stdin': stdin}}) + '\\n')
+        for word in ('get', 'apply', 'scale', 'delete', 'exec'):
+            if word in argv:
+                sub = word
+                break
+        else:
+            sub = 'other'
+        if sub == 'get':
+            kind = argv[argv.index('get') + 1]
+            path = os.path.join({str(replies)!r}, f'get_{{kind}}.json')
+            if os.path.exists(path):
+                print(open(path).read())
+            else:
+                sys.stderr.write(f'Error: {{kind}} not found')
+                sys.exit(1)
+        sys.exit(0)
+    """))
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('PATH',
+                       f'{bindir}:{os.environ["PATH"]}')
+
+    class H:
+        def set_pods(self, pods):
+            (replies / 'get_pods.json').write_text(
+                json.dumps({'items': pods}))
+
+        def set_sts(self, sts):
+            (replies / 'get_statefulset.json').write_text(
+                json.dumps(sts))
+
+        def calls(self):
+            if not calls.exists():
+                return []
+            return [json.loads(line)
+                    for line in calls.read_text().splitlines()]
+    return H()
+
+
+def _pod(name, phase='Running', ip='10.8.0.5', selector=True):
+    spec = {}
+    if selector:
+        spec['nodeSelector'] = {
+            'cloud.google.com/gke-tpu-accelerator': 'tpu-v5-lite-podslice',
+            'cloud.google.com/gke-tpu-topology': '4x4',
+        }
+    return {'metadata': {'name': name},
+            'status': {'phase': phase, 'podIP': ip},
+            'spec': spec}
+
+
+def test_run_instances_applies_and_bootstraps(fake_kubectl):
+    # v5e-16 = 4 hosts x 4 chips in this framework's topology.
+    fake_kubectl.set_pods([
+        _pod(f'sliceA-{i}', ip=f'10.8.0.{5 + i}') for i in range(4)])
+    cfg = ProvisionConfig(
+        cluster_name='sliceA', region='ctx', zone='default',
+        instance_type='tpu-v5e-16', num_hosts=4, tpu_slice='v5e-16',
+        provider_config={'namespace': 'default'})
+    info = k8s.run_instances(cfg)
+    assert info.cloud == 'kubernetes'
+    assert info.num_hosts == 4
+    assert info.head.agent_url == 'http://10.8.0.5:46590'
+    calls = fake_kubectl.calls()
+    # apply with the manifest on stdin
+    apply_calls = [c for c in calls if 'apply' in c['argv']]
+    assert apply_calls
+    manifest = json.loads(apply_calls[0]['stdin'])
+    assert manifest['items'][1]['spec']['replicas'] == 4
+    # one bootstrap exec per pod, rank 0 carrying peer urls
+    execs = [c for c in calls if 'exec' in c['argv']]
+    assert len(execs) == 4
+    assert 'sliceA-0' in execs[0]['argv']
+    assert '10.8.0.8:46590' in ' '.join(execs[0]['argv'])
+
+
+def test_unschedulable_is_capacity_error(fake_kubectl):
+    pod = _pod('sliceB-0', phase='Pending')
+    pod['status']['conditions'] = [{
+        'type': 'PodScheduled', 'status': 'False',
+        'reason': 'Unschedulable',
+        'message': '0/3 nodes available: no tpu topology 2x4'}]
+    fake_kubectl.set_pods([pod])
+    cfg = ProvisionConfig(
+        cluster_name='sliceB', region='ctx', zone='default',
+        instance_type='tpu-v5e-16', num_hosts=4, tpu_slice='v5e-16',
+        provider_config={})
+    with pytest.raises(exceptions.CapacityError, match='Unschedulable|no tpu'):
+        k8s.run_instances(cfg)
+
+
+def test_lifecycle_stop_start_terminate(fake_kubectl):
+    fake_kubectl.set_pods([_pod('c-0'), _pod('c-1')])
+    fake_kubectl.set_sts({
+        'metadata': {'labels': {'sky-tpu-num-hosts': '2'}},
+        'spec': {'replicas': 0}})
+    k8s.stop_instances('c', {})
+    info = k8s.start_instances('c', {})
+    assert info.num_hosts == 2
+    k8s.terminate_instances('c', {})
+    argvs = [' '.join(c['argv']) for c in fake_kubectl.calls()]
+    assert any('scale statefulset c --replicas 0' in a for a in argvs)
+    assert any('scale statefulset c --replicas 2' in a for a in argvs)
+    assert any('delete statefulset c' in a for a in argvs)
+    assert any('delete service c' in a for a in argvs)
+
+
+def test_get_cluster_info_missing(fake_kubectl):
+    # No canned replies -> pods lookup errors -> None (terminated).
+    assert k8s.get_cluster_info('ghost', {}) is None
+
+
+def test_kubectl_missing_binary(monkeypatch, tmp_path):
+    monkeypatch.setenv('PATH', str(tmp_path))   # no kubectl anywhere
+    with pytest.raises(exceptions.NoCloudAccessError):
+        k8s._kubectl({}, ['get', 'pods'])
