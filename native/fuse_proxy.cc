@@ -1,0 +1,297 @@
+// Rootless-FUSE proxy: C++ equivalent of the reference's only native
+// component, the Go fuse-proxy (reference addons/fuse-proxy: a
+// fusermount-shim client masking `fusermount` in unprivileged
+// containers + a privileged DaemonSet server, talking over a shared
+// unix domain socket — README.md:1-13).
+//
+// One binary, two personalities (busybox-style, by argv[0] or first arg):
+//
+//   fuse_proxy server --socket <path> [--fusermount <real-binary>]
+//       Privileged side. Accepts connections; each request carries the
+//       fusermount argv and, when libfuse is completing a mount, the
+//       _FUSE_COMMFD socket fd forwarded via SCM_RIGHTS. The server
+//       re-execs the REAL fusermount with that env/fd, so the device fd
+//       that fusermount sends back travels over the forwarded socket
+//       directly to the unprivileged caller — the proxy never touches
+//       the /dev/fuse fd itself (same design as the Go server).
+//
+//   fuse_proxy shim [fusermount args...]
+//       Unprivileged side, installed AS `fusermount` on PATH inside the
+//       container. Forwards argv + the _FUSE_COMMFD fd to the server,
+//       then mirrors the real fusermount's exit code.
+//
+// Wire protocol (SOCK_STREAM, host byte order — same host by
+// definition):
+//   request:  u32 argc, argc x { u32 len, bytes }, u8 has_fd
+//             (the fd rides as SCM_RIGHTS ancillary data on the has_fd
+//             byte when set)
+//   response: u32 exit_code
+//
+// Build: `make -C native` or lazily via runtime/native_build.py.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr const char kDefaultSocket[] = "/var/run/fusermount/proxy.sock";
+constexpr const char kSocketEnv[] = "SKY_TPU_FUSE_PROXY_SOCK";
+constexpr const char kCommFdEnv[] = "_FUSE_COMMFD";
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = write(fd, p, n);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// Send one byte carrying `fd` as SCM_RIGHTS (fd < 0: plain byte 0).
+bool SendByteMaybeFd(int sock, int fd) {
+  uint8_t flag = fd >= 0 ? 1 : 0;
+  struct iovec iov = {&flag, 1};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  if (fd >= 0) {
+    msg.msg_control = cbuf;
+    msg.msg_controllen = sizeof(cbuf);
+    struct cmsghdr* cm = CMSG_FIRSTHDR(&msg);
+    cm->cmsg_level = SOL_SOCKET;
+    cm->cmsg_type = SCM_RIGHTS;
+    cm->cmsg_len = CMSG_LEN(sizeof(int));
+    memcpy(CMSG_DATA(cm), &fd, sizeof(int));
+  }
+  while (true) {
+    if (sendmsg(sock, &msg, 0) >= 0) return true;
+    if (errno != EINTR) return false;
+  }
+}
+
+// Receive the flag byte; *out_fd = received fd or -1.
+bool RecvByteMaybeFd(int sock, int* out_fd) {
+  *out_fd = -1;
+  uint8_t flag = 0;
+  struct iovec iov = {&flag, 1};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  ssize_t r;
+  do {
+    r = recvmsg(sock, &msg, 0);
+  } while (r < 0 && errno == EINTR);
+  if (r <= 0) return false;
+  for (struct cmsghdr* cm = CMSG_FIRSTHDR(&msg); cm != nullptr;
+       cm = CMSG_NXTHDR(&msg, cm)) {
+    if (cm->cmsg_level == SOL_SOCKET && cm->cmsg_type == SCM_RIGHTS) {
+      memcpy(out_fd, CMSG_DATA(cm), sizeof(int));
+    }
+  }
+  if (flag && *out_fd < 0) return false;  // promised an fd, none came
+  return true;
+}
+
+int ConnectUnix(const std::string& path) {
+  int s = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (s < 0) return -1;
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", path.c_str());
+  if (connect(s, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    close(s);
+    return -1;
+  }
+  return s;
+}
+
+std::string SocketPath() {
+  const char* env = getenv(kSocketEnv);
+  return env && *env ? env : kDefaultSocket;
+}
+
+// ---------------- shim (unprivileged client) ----------------------------
+
+int RunShim(int argc, char** argv) {
+  int sock = ConnectUnix(SocketPath());
+  if (sock < 0) {
+    fprintf(stderr, "fusermount-shim: cannot reach proxy at %s: %s\n",
+            SocketPath().c_str(), strerror(errno));
+    return 1;
+  }
+  uint32_t n = static_cast<uint32_t>(argc);
+  if (!WriteFull(sock, &n, sizeof(n))) return 1;
+  for (int i = 0; i < argc; i++) {
+    uint32_t len = static_cast<uint32_t>(strlen(argv[i]));
+    if (!WriteFull(sock, &len, sizeof(len)) ||
+        !WriteFull(sock, argv[i], len))
+      return 1;
+  }
+  // libfuse passes the mount-completion socket via _FUSE_COMMFD; forward
+  // the actual fd so the real fusermount talks straight to our caller.
+  int commfd = -1;
+  const char* commfd_env = getenv(kCommFdEnv);
+  if (commfd_env && *commfd_env) commfd = atoi(commfd_env);
+  if (!SendByteMaybeFd(sock, commfd)) {
+    fprintf(stderr, "fusermount-shim: fd forward failed: %s\n",
+            strerror(errno));
+    return 1;
+  }
+  uint32_t code = 1;
+  if (!ReadFull(sock, &code, sizeof(code))) {
+    fprintf(stderr, "fusermount-shim: proxy hung up\n");
+    return 1;
+  }
+  return static_cast<int>(code);
+}
+
+// ---------------- server (privileged side) ------------------------------
+
+struct ServerOpts {
+  std::string socket_path;
+  std::string fusermount = "fusermount3";
+};
+
+void HandleConn(int conn, const ServerOpts& opts) {
+  uint32_t argc = 0;
+  if (!ReadFull(conn, &argc, sizeof(argc)) || argc > 256) return;
+  std::vector<std::string> args;
+  for (uint32_t i = 0; i < argc; i++) {
+    uint32_t len = 0;
+    if (!ReadFull(conn, &len, sizeof(len)) || len > 65536) return;
+    std::string a(len, '\0');
+    if (len > 0 && !ReadFull(conn, a.data(), len)) return;
+    args.push_back(std::move(a));
+  }
+  int commfd = -1;
+  if (!RecvByteMaybeFd(conn, &commfd)) return;
+
+  pid_t pid = fork();
+  if (pid == 0) {
+    // Child: exec the REAL fusermount with the forwarded commfd.
+    std::vector<char*> cargv;
+    cargv.push_back(const_cast<char*>(opts.fusermount.c_str()));
+    for (size_t i = 1; i < args.size(); i++)  // argv[0] replaced
+      cargv.push_back(const_cast<char*>(args[i].c_str()));
+    cargv.push_back(nullptr);
+    if (commfd >= 0) {
+      char buf[16];
+      snprintf(buf, sizeof(buf), "%d", commfd);
+      setenv(kCommFdEnv, buf, 1);
+    } else {
+      unsetenv(kCommFdEnv);
+    }
+    execvp(opts.fusermount.c_str(), cargv.data());
+    fprintf(stderr, "fuse_proxy: exec %s: %s\n",
+            opts.fusermount.c_str(), strerror(errno));
+    _exit(127);
+  }
+  if (commfd >= 0) close(commfd);
+  uint32_t code = 1;
+  if (pid > 0) {
+    int status = 0;
+    while (waitpid(pid, &status, 0) < 0 && errno == EINTR) {}
+    code = WIFEXITED(status) ? static_cast<uint32_t>(WEXITSTATUS(status))
+                             : 128u + WTERMSIG(status);
+  }
+  WriteFull(conn, &code, sizeof(code));
+}
+
+int RunServer(const ServerOpts& opts) {
+  signal(SIGPIPE, SIG_IGN);
+  int s = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (s < 0) {
+    perror("socket");
+    return 1;
+  }
+  unlink(opts.socket_path.c_str());
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  snprintf(addr.sun_path, sizeof(addr.sun_path), "%s",
+           opts.socket_path.c_str());
+  if (bind(s, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  chmod(opts.socket_path.c_str(), 0666);  // unprivileged pods connect
+  if (listen(s, 64) != 0) {
+    perror("listen");
+    return 1;
+  }
+  fprintf(stderr, "fuse_proxy server on %s (real fusermount: %s)\n",
+          opts.socket_path.c_str(), opts.fusermount.c_str());
+  while (true) {
+    int conn = accept(s, nullptr, nullptr);
+    if (conn < 0) {
+      if (errno == EINTR) continue;
+      perror("accept");
+      return 1;
+    }
+    // One forked handler per connection: a slow mount must not block
+    // other pods' fusermount calls.
+    pid_t pid = fork();
+    if (pid == 0) {
+      close(s);
+      HandleConn(conn, opts);
+      _exit(0);
+    }
+    close(conn);
+    // Reap without blocking.
+    while (waitpid(-1, nullptr, WNOHANG) > 0) {}
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  // Personality: `fuse_proxy server ...` | invoked as fusermount (shim).
+  if (argc > 1 && strcmp(argv[1], "server") == 0) {
+    ServerOpts opts;
+    opts.socket_path = SocketPath();
+    for (int i = 2; i < argc - 1; i++) {
+      if (strcmp(argv[i], "--socket") == 0)
+        opts.socket_path = argv[++i];
+      else if (strcmp(argv[i], "--fusermount") == 0)
+        opts.fusermount = argv[++i];
+    }
+    return RunServer(opts);
+  }
+  if (argc > 1 && strcmp(argv[1], "shim") == 0) {
+    return RunShim(argc - 2 + 1, argv + 1);  // keep argv[0]-like slot
+  }
+  return RunShim(argc, argv);
+}

@@ -120,12 +120,20 @@ class TpuVmBackend(Backend):
     def _runners(self, info: ClusterInfo
                  ) -> List[command_runner.CommandRunner]:
         # Process-simulated hosts (local cloud, process-mode ssh pools)
-        # carry a cluster_dir; real hosts are reached over SSH.
+        # carry a cluster_dir; pods go through kubectl; real hosts are
+        # reached over SSH.
         if 'cluster_dir' in info.provider_config:
             cdir = info.provider_config['cluster_dir']
             return [command_runner.LocalProcessCommandRunner(
                 os.path.join(cdir, f'host{i}'))
                 for i in range(info.num_hosts)]
+        if info.cloud == 'kubernetes':
+            return [command_runner.KubectlCommandRunner(
+                h.host_id,
+                namespace=info.provider_config.get('namespace',
+                                                   'default'),
+                context=info.provider_config.get('context'))
+                for h in info.hosts]
         ssh_user = info.provider_config.get('ssh_user', 'sky')
         password = info.provider_config.get('ssh_password')
         key = info.provider_config.get('ssh_key')

@@ -258,8 +258,12 @@ def _k8s_candidate(resources: 'Resources') -> Optional[Candidate]:  # noqa: F821
     StatefulSet from it)."""
     from skypilot_tpu import config as config_lib
     tpu = resources.tpu
-    ctx = config_lib.get_nested(('kubernetes', 'context'), 'in-cluster')
-    ns = config_lib.get_nested(('kubernetes', 'namespace'), 'default')
+    # region pins the kubeconfig context, zone the namespace (the k8s
+    # analog of placement); config supplies defaults.
+    ctx = resources.region or config_lib.get_nested(
+        ('kubernetes', 'context'), 'in-cluster')
+    ns = resources.zone or config_lib.get_nested(
+        ('kubernetes', 'namespace'), 'default')
     return Candidate(
         cloud='kubernetes', region=ctx, zone=ns,
         instance_type=(f'tpu-{tpu.name}' if tpu else

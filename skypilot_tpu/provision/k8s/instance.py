@@ -14,6 +14,7 @@ provisioner (ignored here — placement is the cluster's business).
 from __future__ import annotations
 
 import json
+import os
 import shlex
 import subprocess
 import time
@@ -59,8 +60,8 @@ def _kubectl(provider_config: Dict[str, Any], args: List[str],
         # NotFound only means "cluster gone" for reads/deletes of our
         # own objects; an apply failing with a missing namespace must
         # surface as a provisioning error, not ClusterDoesNotExist.
-        if args and args[0] in ('get', 'delete') and (
-                'notfound' in low.replace(' ', '') or 'not found' in low):
+        if args and args[0] in ('get', 'delete') and \
+                'notfound' in low.replace(' ', ''):
             raise exceptions.ClusterDoesNotExist(err)
         raise exceptions.ProvisionError(f'[k8s] kubectl failed: {err}')
     return proc.stdout
@@ -101,13 +102,27 @@ def _wait_pods_running(cluster_name: str,
                                           for ph in phases):
             return
         for p in pods:
+            name = p['metadata']['name']
+            if p['status'].get('phase') in ('Failed', 'Succeeded'):
+                raise exceptions.ProvisionError(
+                    f'[k8s] pod {name} terminal phase '
+                    f'{p["status"]["phase"]} during provisioning')
             for cond in p['status'].get('conditions', []) or []:
                 if (cond.get('type') == 'PodScheduled' and
                         cond.get('status') == 'False' and
                         cond.get('reason') == 'Unschedulable'):
                     raise exceptions.CapacityError(
-                        f'[k8s] {p["metadata"]["name"]} unschedulable: '
+                        f'[k8s] {name} unschedulable: '
                         f'{cond.get("message", "")}')
+            for cs in p['status'].get('containerStatuses', []) or []:
+                waiting = (cs.get('state') or {}).get('waiting') or {}
+                if waiting.get('reason') in (
+                        'ErrImagePull', 'ImagePullBackOff',
+                        'CreateContainerConfigError',
+                        'CreateContainerError', 'CrashLoopBackOff'):
+                    raise exceptions.ProvisionError(
+                        f'[k8s] pod {name}: {waiting["reason"]}: '
+                        f'{waiting.get("message", "")}')
         time.sleep(_POLL)
     raise exceptions.ProvisionTimeoutError(
         f'[k8s] slice {cluster_name}: pods not Running within '
@@ -143,20 +158,31 @@ def _bootstrap_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
                 k: v for k, v in config.provider_config.items()
                 if k in ('context', 'namespace')},
         }
+        # Ship the LOCAL framework tree into the pod (kubectl cp; the ssh
+        # provider rsyncs the same way) — pip would install a different
+        # or missing package and its failure would be invisible behind
+        # the backgrounded agent.
+        import skypilot_tpu
+        from skypilot_tpu.utils import command_runner
+        runner = command_runner.KubectlCommandRunner(
+            pod,
+            namespace=config.provider_config.get('namespace', 'default'),
+            context=config.provider_config.get('context'))
+        pkg_root = os.path.dirname(os.path.abspath(
+            skypilot_tpu.__file__))
+        runner.rsync(pkg_root, '/opt/sky_tpu/cluster/skypilot_tpu')
         script = (
-            'mkdir -p /opt/sky_tpu/cluster && '
             f"printf %s {shlex.quote(json.dumps(agent_config))} "
             '> /opt/sky_tpu/cluster/agent_config.json && '
-            '(python3 -m pip show skypilot-tpu >/dev/null 2>&1 || '
-            'python3 -m pip install -q skypilot-tpu aiohttp || true) && '
+            '(python3 -c "import aiohttp" 2>/dev/null || '
+            'python3 -m pip install -q aiohttp) && '
             "pgrep -f 'skypilot_tpu.runtime.agent' >/dev/null || "
+            'PYTHONPATH=/opt/sky_tpu/cluster '
             'nohup python3 -m skypilot_tpu.runtime.agent '
             '--cluster-dir /opt/sky_tpu/cluster --host 0.0.0.0 '
             f'--port {manifests.AGENT_PORT} '
             '>/opt/sky_tpu/agent.log 2>&1 &')
-        _kubectl(config.provider_config,
-                 ['exec', pod, '--', '/bin/bash', '-c', script],
-                 timeout=300.0)
+        runner.run(script, check=True, timeout=300.0)
 
 
 def stop_instances(cluster_name: str,

@@ -14,6 +14,7 @@ GKE nodeSelector mapping (public GKE docs' accelerator names):
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import topology
@@ -109,3 +110,80 @@ def render_slice(cluster_name: str,
     }
     return {'apiVersion': 'v1', 'kind': 'List',
             'items': [service, statefulset]}
+
+
+def _fuse_proxy_source() -> str:
+    """The native fuse_proxy.cc source, shipped to the DaemonSet via a
+    ConfigMap so the manifest is self-contained (the default image has
+    no framework files)."""
+    import skypilot_tpu
+    candidates = [
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
+            skypilot_tpu.__file__))), 'native', 'fuse_proxy.cc'),
+    ]
+    for path in candidates:
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                return f.read()
+    raise FileNotFoundError(
+        'native/fuse_proxy.cc not found next to the package')
+
+
+def render_fuse_proxy_daemonset(namespace: str = 'kube-system',
+                                image: str = DEFAULT_IMAGE
+                                ) -> Dict[str, Any]:
+    """Privileged fusermount-server DaemonSet + source ConfigMap
+    (reference addons/fuse-proxy's example manifest): shares
+    /var/run/fusermount with workload pods; pods' containers mask
+    `fusermount` with the shim personality of the same native binary."""
+    shared = {'name': 'fusermount-shared',
+              'hostPath': {'path': '/var/run/fusermount',
+                           'type': 'DirectoryOrCreate'}}
+    src_volume = {'name': 'fuse-proxy-src',
+                  'configMap': {'name': 'sky-tpu-fuse-proxy-src'}}
+    configmap = {
+        'apiVersion': 'v1',
+        'kind': 'ConfigMap',
+        'metadata': {'name': 'sky-tpu-fuse-proxy-src',
+                     'namespace': namespace},
+        'data': {'fuse_proxy.cc': _fuse_proxy_source()},
+    }
+    daemonset = {
+        'apiVersion': 'apps/v1',
+        'kind': 'DaemonSet',
+        'metadata': {'name': 'sky-tpu-fusermount-server',
+                     'namespace': namespace,
+                     'labels': {'app': 'sky-tpu-fusermount-server'}},
+        'spec': {
+            'selector': {'matchLabels':
+                         {'app': 'sky-tpu-fusermount-server'}},
+            'template': {
+                'metadata': {'labels':
+                             {'app': 'sky-tpu-fusermount-server'}},
+                'spec': {
+                    'hostPID': True,
+                    'containers': [{
+                        'name': 'server',
+                        'image': image,
+                        'securityContext': {'privileged': True},
+                        'command': ['/bin/bash', '-c'],
+                        'args': [
+                            'apt-get update -qq && '
+                            'apt-get install -y -qq fuse3 g++ && '
+                            'g++ -O2 -std=c++17 -o /usr/local/bin/'
+                            'fuse_proxy /opt/native/fuse_proxy.cc && '
+                            '/usr/local/bin/fuse_proxy server '
+                            '--socket /var/run/fusermount/proxy.sock'],
+                        'volumeMounts': [
+                            {'name': 'fusermount-shared',
+                             'mountPath': '/var/run/fusermount'},
+                            {'name': 'fuse-proxy-src',
+                             'mountPath': '/opt/native'}],
+                    }],
+                    'volumes': [shared, src_volume],
+                },
+            },
+        },
+    }
+    return {'apiVersion': 'v1', 'kind': 'List',
+            'items': [configmap, daemonset]}

@@ -136,3 +136,65 @@ class SSHCommandRunner(CommandRunner):
         if proc.returncode != 0:
             raise exceptions.CommandError(proc.returncode,
                                           f'rsync {src} {dst}', proc.stderr)
+
+
+class KubectlCommandRunner(CommandRunner):
+    """kubectl exec/cp to a pod (reference KubernetesCommandRunner,
+    command_runner.py:1410). Pods have no sshd; the k8s transport is the
+    API server."""
+
+    def __init__(self, pod: str, *, namespace: str = 'default',
+                 context: Optional[str] = None,
+                 container: Optional[str] = None):
+        self.pod = pod
+        self.namespace = namespace
+        self.context = context
+        self.container = container
+
+    def _base(self) -> List[str]:
+        cmd = ['kubectl']
+        if self.context:
+            cmd += ['--context', self.context]
+        cmd += ['-n', self.namespace]
+        return cmd
+
+    def run(self, cmd: str, *, timeout: Optional[float] = None,
+            check: bool = False) -> Tuple[int, str, str]:
+        full = self._base() + ['exec', self.pod]
+        if self.container:
+            full += ['-c', self.container]
+        full += ['--', '/bin/bash', '-c', cmd]
+        try:
+            proc = subprocess.run(full, capture_output=True, text=True,
+                                  timeout=timeout, input='')
+        except FileNotFoundError:
+            self._check(127, cmd, 'kubectl not found on PATH', check)
+            return 127, '', 'kubectl not found on PATH'
+        except subprocess.TimeoutExpired:
+            err = f'kubectl exec to {self.pod} timed out after {timeout}s'
+            self._check(124, cmd, err, check)
+            return 124, '', err
+        self._check(proc.returncode, cmd, proc.stderr, check)
+        return proc.returncode, proc.stdout, proc.stderr
+
+    def rsync(self, src: str, dst: str, *, up: bool = True) -> None:
+        """kubectl cp (no rsync delta, but the same contract)."""
+        if up:
+            # Parent must exist, but NOT dst itself: kubectl cp nests
+            # the source under an existing destination directory.
+            parent = os.path.dirname(dst.rstrip('/')) or '/'
+            self.run(f'mkdir -p {shlex.quote(parent)} && '
+                     f'rm -rf {shlex.quote(dst.rstrip("/"))}',
+                     check=True, timeout=60)
+            pair = [src.rstrip('/'),
+                    f'{self.namespace}/{self.pod}:{dst.rstrip("/")}']
+        else:
+            pair = [f'{self.namespace}/{self.pod}:{src}', dst]
+        full = self._base() + ['cp', *pair]
+        if self.container:
+            full += ['-c', self.container]
+        proc = subprocess.run(full, capture_output=True, text=True,
+                              input='')
+        if proc.returncode != 0:
+            raise exceptions.CommandError(
+                proc.returncode, f'kubectl cp {src} {dst}', proc.stderr)

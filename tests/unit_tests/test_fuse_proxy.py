@@ -1,0 +1,101 @@
+"""Native fuse-proxy: shim <-> server over a unix socket.
+
+Reference analog: addons/fuse-proxy (Go) — fusermount-shim masks
+`fusermount` in unprivileged containers and forwards calls (including
+the libfuse _FUSE_COMMFD mount-completion fd, via SCM_RIGHTS) to a
+privileged server. Tested rootless with a fake "real" fusermount that
+records argv and writes through the forwarded fd.
+"""
+import os
+import socket
+import stat
+import subprocess
+import time
+
+import pytest
+
+from skypilot_tpu.runtime import native_build
+
+
+@pytest.fixture(scope='module')
+def fuse_proxy_bin():
+    path = native_build.ensure_binary('fuse_proxy')
+    if path is None:
+        pytest.skip('no C++ toolchain')
+    return path
+
+
+@pytest.fixture
+def proxy(tmp_path, fuse_proxy_bin):
+    """A running server wired to a fake fusermount."""
+    sock = tmp_path / 'proxy.sock'
+    argv_log = tmp_path / 'argv.log'
+    fake = tmp_path / 'fake_fusermount'
+    fake.write_text(f"""#!/usr/bin/env python3
+import os, socket, sys
+with open({str(argv_log)!r}, 'a') as f:
+    f.write(' '.join(sys.argv[1:]) + '\\n')
+commfd = os.environ.get('_FUSE_COMMFD')
+if commfd:
+    s = socket.socket(fileno=int(commfd))
+    s.sendall(b'FD_OK')
+    s.close()
+if '--fail' in sys.argv:
+    sys.exit(7)
+""")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    proc = subprocess.Popen(
+        [fuse_proxy_bin, 'server', '--socket', str(sock),
+         '--fusermount', str(fake)],
+        stderr=subprocess.PIPE)
+    deadline = time.time() + 10
+    while time.time() < deadline and not sock.exists():
+        time.sleep(0.05)
+    assert sock.exists(), 'server did not bind'
+    yield {'sock': str(sock), 'argv_log': argv_log,
+           'bin': fuse_proxy_bin}
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def test_shim_forwards_args_and_exit_code(proxy):
+    env = {**os.environ, 'SKY_TPU_FUSE_PROXY_SOCK': proxy['sock']}
+    env.pop('_FUSE_COMMFD', None)
+    r = subprocess.run(
+        [proxy['bin'], 'shim', '-u', '/mnt/bucket'],
+        env=env, capture_output=True, timeout=15)
+    assert r.returncode == 0, r.stderr
+    assert '-u /mnt/bucket' in proxy['argv_log'].read_text()
+    # Exit code mirrors the real fusermount's.
+    r2 = subprocess.run(
+        [proxy['bin'], 'shim', '--fail'],
+        env=env, capture_output=True, timeout=15)
+    assert r2.returncode == 7
+
+
+def test_commfd_travels_via_scm_rights(proxy):
+    """The libfuse mount-completion fd must reach the real fusermount:
+    whatever it writes arrives on OUR socketpair end."""
+    ours, theirs = socket.socketpair()
+    env = {**os.environ,
+           'SKY_TPU_FUSE_PROXY_SOCK': proxy['sock'],
+           '_FUSE_COMMFD': str(theirs.fileno())}
+    r = subprocess.run(
+        [proxy['bin'], 'shim', '/mnt/x'],
+        env=env, capture_output=True, timeout=15,
+        pass_fds=(theirs.fileno(),))
+    theirs.close()
+    assert r.returncode == 0, r.stderr
+    ours.settimeout(5)
+    assert ours.recv(16) == b'FD_OK'
+    ours.close()
+
+
+def test_shim_without_server_fails_cleanly(fuse_proxy_bin, tmp_path):
+    env = {**os.environ,
+           'SKY_TPU_FUSE_PROXY_SOCK': str(tmp_path / 'nope.sock')}
+    env.pop('_FUSE_COMMFD', None)
+    r = subprocess.run([fuse_proxy_bin, 'shim', '-u', '/x'],
+                       env=env, capture_output=True, timeout=15)
+    assert r.returncode == 1
+    assert b'cannot reach proxy' in r.stderr

@@ -145,11 +145,30 @@ def test_run_instances_applies_and_bootstraps(fake_kubectl):
     assert apply_calls
     manifest = json.loads(apply_calls[0]['stdin'])
     assert manifest['items'][1]['spec']['replicas'] == 4
-    # one bootstrap exec per pod, rank 0 carrying peer urls
-    execs = [c for c in calls if 'exec' in c['argv']]
-    assert len(execs) == 4
-    assert 'sliceA-0' in execs[0]['argv']
-    assert '10.8.0.8:46590' in ' '.join(execs[0]['argv'])
+    # framework shipped into each pod (kubectl cp), then the agent
+    # started via exec; rank 0 carries peer urls.
+    cps = [c for c in calls if 'cp' in c['argv']]
+    assert len(cps) == 4
+    agent_execs = [c for c in calls if 'exec' in c['argv'] and
+                   'agent_config.json' in ' '.join(c['argv'])]
+    assert len(agent_execs) == 4
+    assert 'sliceA-0' in agent_execs[0]['argv']
+    assert '10.8.0.8:46590' in ' '.join(agent_execs[0]['argv'])
+
+
+def test_image_pull_failure_fails_fast(fake_kubectl):
+    pod = _pod('sliceC-0', phase='Pending')
+    pod['status']['containerStatuses'] = [{
+        'state': {'waiting': {'reason': 'ImagePullBackOff',
+                              'message': 'no such image'}}}]
+    fake_kubectl.set_pods([pod])
+    cfg = ProvisionConfig(
+        cluster_name='sliceC', region='ctx', zone='default',
+        instance_type='tpu-v5e-16', num_hosts=4, tpu_slice='v5e-16',
+        provider_config={})
+    with pytest.raises(exceptions.ProvisionError,
+                       match='ImagePullBackOff'):
+        k8s.run_instances(cfg)
 
 
 def test_unschedulable_is_capacity_error(fake_kubectl):
