@@ -186,6 +186,9 @@ struct ServerOpts {
 };
 
 void HandleConn(int conn, const ServerOpts& opts) {
+  // Undo the server's SIG_IGN: this handler child needs waitpid to
+  // return the real fusermount's exit code.
+  signal(SIGCHLD, SIG_DFL);
   uint32_t argc = 0;
   if (!ReadFull(conn, &argc, sizeof(argc)) || argc > 256) return;
   std::vector<std::string> args;
@@ -232,6 +235,10 @@ void HandleConn(int conn, const ServerOpts& opts) {
 
 int RunServer(const ServerOpts& opts) {
   signal(SIGPIPE, SIG_IGN);
+  // Auto-reap idle-period handler children (no zombies in the host PID
+  // namespace); handlers restore default disposition before forking the
+  // real fusermount so their waitpid still sees its exit status.
+  signal(SIGCHLD, SIG_IGN);
   int s = socket(AF_UNIX, SOCK_STREAM, 0);
   if (s < 0) {
     perror("socket");
@@ -270,8 +277,6 @@ int RunServer(const ServerOpts& opts) {
       _exit(0);
     }
     close(conn);
-    // Reap without blocking.
-    while (waitpid(-1, nullptr, WNOHANG) > 0) {}
   }
 }
 

@@ -114,7 +114,42 @@ class TpuVmBackend(Backend):
         state.add_cluster_event(
             cluster_name, 'PROVISIONED',
             f'{cand} ({info.num_hosts} hosts)')
+        self._setup_logging_agent(info)
         return info
+
+    def _setup_logging_agent(self, info: ClusterInfo) -> None:
+        """Install the configured log-shipping agent on every host
+        (reference wires sky/logs agents into cluster setup). Non-fatal:
+        a logging outage must not fail a launch."""
+        from skypilot_tpu import logs as logs_lib
+        try:
+            agent = logs_lib.get_logging_agent()
+        except exceptions.SkyTpuError as e:
+            logger.warning('logging agent config invalid: %s', e)
+            return
+        if agent is None or 'cluster_dir' in info.provider_config:
+            return   # not configured / local fake slice has no sudo env
+        try:
+            for dst, src in agent.get_credential_file_mounts().items():
+                for runner in self._runners(info):
+                    runner.rsync(os.path.expanduser(src), dst)
+            client = self._client(info)
+            result = client.exec_sync(
+                agent.get_setup_command(info.cluster_name))
+            if any(rc != 0 for rc in result['returncodes']):
+                raise exceptions.CommandError(
+                    max(result['returncodes']), 'logging agent setup',
+                    str(result['tails']))
+            state.add_cluster_event(info.cluster_name,
+                                    'LOGGING_AGENT_SETUP',
+                                    type(agent).__name__)
+        except Exception as e:  # noqa: BLE001 — non-fatal by contract:
+            # agent HTTP errors (requests.*) included, a log-shipping
+            # outage must not fail the launch.
+            logger.warning('logging agent setup failed on %s: %s',
+                           info.cluster_name, e)
+            state.add_cluster_event(info.cluster_name,
+                                    'LOGGING_AGENT_FAILED', str(e))
 
     # ---- file sync ------------------------------------------------------
     def _runners(self, info: ClusterInfo

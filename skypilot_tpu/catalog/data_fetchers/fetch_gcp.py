@@ -1,0 +1,177 @@
+"""Regenerate the bundled GCP catalog CSV (reference
+``sky/catalog/data_fetchers/fetch_gcp.py``: queries the Cloud Billing
+Catalog API and writes the hosted CSVs this framework bundles instead).
+
+Online mode walks the Cloud Billing Catalog API
+(``cloudbilling.googleapis.com/v1/services/<compute-service>/skus``)
+for TPU/GPU SKUs and converts nanos -> $/chip-hour rows. ``--offline``
+(the default in air-gapped environments) re-emits the audited built-in
+snapshot so the pipeline stays runnable end-to-end without credentials.
+
+Usage:
+    python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp \
+        [--offline] [--output <path>]
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+from typing import Dict, Iterator, List, Optional
+
+# Compute Engine's service id in the billing catalog (stable, public).
+_COMPUTE_SERVICE = 'services/6F81-5844-456A'
+_BILLING_API = 'https://cloudbilling.googleapis.com/v1'
+
+_HEADER = ['kind', 'name', 'region', 'zone', 'price', 'spot_price',
+           'vcpus', 'memory_gb', 'notes']
+
+# TPU SKU descriptions encode generation; map onto catalog names.
+_TPU_DESC_TO_GEN = {
+    'tpu v2': 'v2',
+    'tpu v3': 'v3',
+    'tpu v4': 'v4',
+    'tpu v5 lite': 'v5e',
+    'tpu v5e': 'v5e',
+    'tpu v5p': 'v5p',
+    'tpu v6e': 'v6e',
+    'trillium': 'v6e',
+}
+
+# Region -> a zone with TPU capacity (the API prices per region; the
+# provisioner needs a concrete zone).
+_DEFAULT_ZONE = {
+    'us-central1': 'us-central1-a',
+    'us-central2': 'us-central2-b',
+    'us-east1': 'us-east1-c',
+    'us-east5': 'us-east5-a',
+    'us-west1': 'us-west1-c',
+    'us-west4': 'us-west4-a',
+    'europe-west4': 'europe-west4-a',
+    'asia-southeast1': 'asia-southeast1-b',
+    'asia-northeast1': 'asia-northeast1-b',
+}
+
+
+def _iter_skus(token: Optional[str] = None) -> Iterator[Dict]:
+    """Pages through the billing catalog (online mode)."""
+    import requests
+    page: Optional[str] = None
+    while True:
+        params = {'pageSize': 500}
+        if page:
+            params['pageToken'] = page
+        headers = {}
+        if token:
+            headers['Authorization'] = f'Bearer {token}'
+        else:
+            key = os.environ.get('GCP_API_KEY')
+            if key:
+                params['key'] = key
+        r = requests.get(f'{_BILLING_API}/{_COMPUTE_SERVICE}/skus',
+                         params=params, headers=headers, timeout=60)
+        r.raise_for_status()
+        body = r.json()
+        yield from body.get('skus', [])
+        page = body.get('nextPageToken')
+        if not page:
+            return
+
+
+def _sku_unit_price(sku: Dict) -> Optional[float]:
+    infos = sku.get('pricingInfo') or []
+    if not infos:
+        return None
+    tiers = (infos[0].get('pricingExpression') or {}).get(
+        'tieredRates') or []
+    if not tiers:
+        return None
+    money = tiers[-1].get('unitPrice') or {}
+    return (float(money.get('units') or 0) +
+            float(money.get('nanos') or 0) / 1e9)
+
+
+def fetch_online(token: Optional[str] = None) -> List[List]:
+    """TPU rows from the live billing catalog."""
+    rows: List[List] = []
+    for sku in _iter_skus(token):
+        desc = (sku.get('description') or '').lower()
+        gen = next((g for d, g in _TPU_DESC_TO_GEN.items() if d in desc),
+                   None)
+        if gen is None or 'pod' in desc and 'slice' not in desc:
+            continue
+        spot = ('preemptible' in desc or 'spot' in desc)
+        price = _sku_unit_price(sku)
+        if price is None or price <= 0:
+            continue
+        for region in (sku.get('serviceRegions') or []):
+            zone = _DEFAULT_ZONE.get(region)
+            if zone is None:
+                continue
+            rows.append(['tpu', gen, region, zone,
+                         '' if spot else f'{price:.4f}',
+                         f'{price:.4f}' if spot else '',
+                         '', '', 'per-chip-hour (fetched)'])
+    return _merge_spot(rows)
+
+
+def _merge_spot(rows: List[List]) -> List[List]:
+    """Collapse separate on-demand/spot SKU rows into one CSV row."""
+    merged: Dict[tuple, List] = {}
+    for r in rows:
+        key = (r[0], r[1], r[2], r[3])
+        cur = merged.setdefault(
+            key, [r[0], r[1], r[2], r[3], '', '', '', '', r[8]])
+        if r[4]:
+            cur[4] = r[4]
+        if r[5]:
+            cur[5] = r[5]
+    out = []
+    for cur in merged.values():
+        if not cur[4]:
+            continue   # spot-only rows are unusable without on-demand
+        if not cur[5]:
+            cur[5] = f'{float(cur[4]) * 0.3:.4f}'   # GCP spot ~70% off
+        out.append(cur)
+    return out
+
+
+def fetch_offline() -> List[List]:
+    """Re-emit the audited bundled snapshot (air-gapped mode)."""
+    bundled = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'data', 'gcp.csv')
+    with open(bundled, newline='', encoding='utf-8') as f:
+        reader = csv.reader(f)
+        next(reader)   # header
+        return [row for row in reader if row]
+
+
+def write_csv(rows: List[List], output: str) -> None:
+    tmp = f'{output}.{os.getpid()}.tmp'
+    with open(tmp, 'w', newline='', encoding='utf-8') as f:
+        w = csv.writer(f)
+        w.writerow(_HEADER)
+        w.writerows(rows)
+    os.replace(tmp, output)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--offline', action='store_true',
+                        help='re-emit the bundled snapshot (no network)')
+    parser.add_argument('--output', default=None,
+                        help='output CSV (default: the bundled gcp.csv)')
+    args = parser.parse_args()
+    output = args.output or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'data', 'gcp.csv')
+    rows = fetch_offline() if args.offline else fetch_online()
+    if not rows:
+        raise SystemExit('fetched 0 rows; refusing to write an empty '
+                         'catalog')
+    write_csv(rows, output)
+    print(f'wrote {len(rows)} rows to {output}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,10 @@
+"""Cluster-log shipping agents (reference ``sky/logs/``: fluentbit-based
+agents for GCP Cloud Logging / AWS CloudWatch, wired into cluster setup
+when ``logs.store`` is configured)."""
+from skypilot_tpu.logs.agent import (FluentbitAgent, LoggingAgent,
+                                     get_logging_agent)
+from skypilot_tpu.logs.aws import CloudwatchLoggingAgent
+from skypilot_tpu.logs.gcp import GCPLoggingAgent
+
+__all__ = ['CloudwatchLoggingAgent', 'FluentbitAgent', 'GCPLoggingAgent',
+           'LoggingAgent', 'get_logging_agent']

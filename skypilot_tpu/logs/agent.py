@@ -1,0 +1,94 @@
+"""Logging agent base classes (reference ``sky/logs/agent.py``:
+``LoggingAgent`` with get_setup_command/get_credential_file_mounts,
+``FluentbitAgent`` rendering a fluent-bit config that tails the per-job
+log files).
+
+TPU-native wiring: when the global config carries ``logs.store``, the
+backend appends the agent's setup command to cluster setup, so every
+host of a slice ships its job logs (all ranks — per-rank log files are
+first-class here, unlike the GPU reference's single driver log).
+"""
+from __future__ import annotations
+
+import abc
+import shlex
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+
+LOGGING_CONFIG_DIR = '/opt/sky_tpu/logging'
+# Agent job logs: <cluster_dir>/jobs/<job_id>/rank<i>.log on real hosts.
+JOB_LOG_GLOB = '/opt/sky_tpu/cluster/jobs/*/*.log'
+
+
+class LoggingAgent(abc.ABC):
+    """Reference sky/logs/agent.py:12."""
+
+    @abc.abstractmethod
+    def get_setup_command(self, cluster_name: str) -> str:
+        ...
+
+    @abc.abstractmethod
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        ...
+
+
+class FluentbitAgent(LoggingAgent):
+    """Fluent-bit install + config scaffolding (reference :31)."""
+
+    def get_setup_command(self, cluster_name: str) -> str:
+        install = (
+            'if ! command -v fluent-bit >/dev/null 2>&1 && '
+            '[ ! -f /opt/fluent-bit/bin/fluent-bit ]; then '
+            'curl -fsSL '
+            'https://raw.githubusercontent.com/fluent/fluent-bit/master/'
+            'install.sh | sh; fi')
+        cfg = self.fluentbit_config(cluster_name)
+        cfg_path = f'{LOGGING_CONFIG_DIR}/fluentbit.yaml'
+        configure = (
+            f'sudo mkdir -p {LOGGING_CONFIG_DIR} && '
+            f'sudo chmod a+rwx {LOGGING_CONFIG_DIR} && '
+            f'echo {shlex.quote(cfg)} > {cfg_path}')
+        start = (
+            'nohup $(command -v fluent-bit || '
+            'echo /opt/fluent-bit/bin/fluent-bit) '
+            f'-c {cfg_path} > {LOGGING_CONFIG_DIR}/agent.log 2>&1 &')
+        return f'({install}) && {configure} && ({start})'
+
+    def fluentbit_config(self, cluster_name: str) -> str:
+        import yaml
+        cfg = {
+            'pipeline': {
+                'inputs': [{
+                    'name': 'tail',
+                    'path': JOB_LOG_GLOB,
+                    'path_key': 'log_path',
+                    'refresh_interval': 5,
+                }],
+                'outputs': [self.fluentbit_output_config(cluster_name)],
+            },
+        }
+        return yaml.safe_dump(cfg, sort_keys=False)
+
+    @abc.abstractmethod
+    def fluentbit_output_config(self,
+                                cluster_name: str) -> Dict[str, Any]:
+        ...
+
+
+def get_logging_agent() -> Optional[LoggingAgent]:
+    """The configured agent, or None (reference resolves logs.store the
+    same way)."""
+    store = config_lib.get_nested(('logs', 'store'))
+    if store is None:
+        return None
+    store_cfg = config_lib.get_nested(('logs', store), {}) or {}
+    if store == 'gcp':
+        from skypilot_tpu.logs.gcp import GCPLoggingAgent
+        return GCPLoggingAgent(store_cfg)
+    if store == 'aws':
+        from skypilot_tpu.logs.aws import CloudwatchLoggingAgent
+        return CloudwatchLoggingAgent(store_cfg)
+    raise exceptions.InvalidTaskError(
+        f'Unknown logs.store {store!r}; supported: gcp, aws')

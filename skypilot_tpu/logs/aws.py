@@ -1,0 +1,30 @@
+"""AWS CloudWatch agent (reference ``sky/logs/aws.py``) — relevant when
+jobs ship logs cross-cloud (e.g. a team standardized on CloudWatch)."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from skypilot_tpu.logs.agent import FluentbitAgent
+
+
+class CloudwatchLoggingAgent(FluentbitAgent):
+    def __init__(self, config: Dict[str, Any]):
+        self.region = config.get('region', 'us-east-1')
+        self.log_group = config.get('log_group_name', 'sky-tpu-logs')
+        self.credentials_file = config.get('credentials_file')
+
+    def fluentbit_output_config(self,
+                                cluster_name: str) -> Dict[str, Any]:
+        return {
+            'name': 'cloudwatch_logs',
+            'match': '*',
+            'region': self.region,
+            'log_group_name': self.log_group,
+            'log_stream_prefix': f'{cluster_name}-',
+            'auto_create_group': 'true',
+        }
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        if not self.credentials_file:
+            return {}
+        return {'~/.aws/credentials': self.credentials_file}

@@ -1,0 +1,43 @@
+"""GCP Cloud Logging agent (reference ``sky/logs/gcp.py``:
+``GCPLoggingAgent`` at :38, stackdriver fluent-bit output at :19)."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from skypilot_tpu.logs.agent import FluentbitAgent
+
+
+class GCPLoggingAgent(FluentbitAgent):
+    """Ships job logs to Cloud Logging via fluent-bit's stackdriver
+    output. On TPU VMs the metadata-server credentials just work; off
+    GCP, ``credentials_file`` points at a service-account key."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.project_id = config.get('project_id')
+        self.credentials_file = config.get('credentials_file')
+        self.additional_labels = dict(config.get('labels') or {})
+
+    def fluentbit_output_config(self,
+                                cluster_name: str) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            'name': 'stackdriver',
+            'match': '*',
+            'resource': 'global',
+            'labels': ','.join(
+                f'{k}={v}' for k, v in {
+                    'sky_tpu_cluster': cluster_name,
+                    **self.additional_labels,
+                }.items()),
+        }
+        if self.project_id:
+            out['export_to_project_id'] = self.project_id
+        if self.credentials_file:
+            out['google_service_credentials'] = (
+                '/opt/sky_tpu/logging/gcp-credentials.json')
+        return out
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        if not self.credentials_file:
+            return {}   # TPU VM metadata credentials
+        return {'/opt/sky_tpu/logging/gcp-credentials.json':
+                self.credentials_file}

@@ -60,7 +60,14 @@ def render_slice(cluster_name: str,
                       'limits': {}},
         'env': [
             {'name': 'SKY_TPU_CLUSTER', 'value': cluster_name},
+            # Rootless FUSE: the shim (fuse_proxy) reads this to reach
+            # the privileged fusermount-server DaemonSet's socket on the
+            # shared hostPath (render_fuse_proxy_daemonset).
+            {'name': 'SKY_TPU_FUSE_PROXY_SOCK',
+             'value': '/var/run/fusermount/proxy.sock'},
         ],
+        'volumeMounts': [{'name': 'fusermount-shared',
+                          'mountPath': '/var/run/fusermount'}],
     }
     pod_spec: Dict[str, Any] = {
         'containers': [container],
@@ -69,6 +76,9 @@ def render_slice(cluster_name: str,
         # restarting in place with stale TPU state.
         'restartPolicy': 'Always',
         'subdomain': cluster_name,
+        'volumes': [{'name': 'fusermount-shared',
+                     'hostPath': {'path': '/var/run/fusermount',
+                                  'type': 'DirectoryOrCreate'}}],
     }
     if tpu is not None:
         chips = tpu.chips_per_host
@@ -162,6 +172,13 @@ def render_fuse_proxy_daemonset(namespace: str = 'kube-system',
                              {'app': 'sky-tpu-fusermount-server'}},
                 'spec': {
                     'hostPID': True,
+                    # GKE taints TPU nodes (google.com/tpu:NoSchedule);
+                    # workload pods tolerate it implicitly via their TPU
+                    # resource request, the DaemonSet must do so
+                    # explicitly or it never lands where mounts happen.
+                    'tolerations': [
+                        {'key': 'google.com/tpu', 'operator': 'Exists',
+                         'effect': 'NoSchedule'}],
                     'containers': [{
                         'name': 'server',
                         'image': image,

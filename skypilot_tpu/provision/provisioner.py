@@ -36,6 +36,12 @@ def _make_config(candidate: catalog.Candidate,
     provider_config = dict(
         config_lib.get_nested((candidate.cloud,), {}) or {})
     provider_config['zone'] = candidate.zone
+    if candidate.cloud == 'kubernetes':
+        # k8s candidates encode context as region, namespace as zone
+        # (catalog._k8s_candidate); the provider reads these keys.
+        if candidate.region != 'in-cluster':
+            provider_config['context'] = candidate.region
+        provider_config['namespace'] = candidate.zone
     return ProvisionConfig(
         cluster_name=cluster_name,
         region=candidate.region,

@@ -177,8 +177,22 @@ class KubectlCommandRunner(CommandRunner):
         self._check(proc.returncode, cmd, proc.stderr, check)
         return proc.returncode, proc.stdout, proc.stderr
 
+    def _expand_home(self, path: str) -> str:
+        """kubectl cp / quoted mkdir never expand ~ (unlike ssh)."""
+        if not path.startswith('~'):
+            return path
+        if not hasattr(self, '_home'):
+            _, out, _ = self.run('echo $HOME', check=True, timeout=30)
+            self._home = out.strip() or '/root'
+        return self._home + path[1:].lstrip('/')  \
+            if path == '~' else path.replace('~', self._home, 1)
+
     def rsync(self, src: str, dst: str, *, up: bool = True) -> None:
         """kubectl cp (no rsync delta, but the same contract)."""
+        if up:
+            dst = self._expand_home(dst)
+        else:
+            src = self._expand_home(src)
         if up:
             # Parent must exist, but NOT dst itself: kubectl cp nests
             # the source under an existing destination directory.
