@@ -130,8 +130,17 @@ class TpuVmBackend(Backend):
         if agent is None or 'cluster_dir' in info.provider_config:
             return   # not configured / local fake slice has no sudo env
         try:
+            runners = self._runners(info)
             for dst, src in agent.get_credential_file_mounts().items():
-                for runner in self._runners(info):
+                for runner in runners:
+                    # Parent dirs like /opt/sky_tpu/logging are created
+                    # by the setup command, which runs AFTER this rsync
+                    # — create them (writably) first.
+                    parent = os.path.dirname(dst) or '/'
+                    runner.run(f'sudo mkdir -p {parent} && '
+                               f'sudo chmod a+rwx {parent} || '
+                               f'mkdir -p {parent}', check=True,
+                               timeout=60)
                     runner.rsync(os.path.expanduser(src), dst)
             client = self._client(info)
             result = client.exec_sync(

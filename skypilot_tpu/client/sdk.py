@@ -66,27 +66,37 @@ def call(op: str, payload: Optional[Dict[str, Any]] = None) -> Any:
     return get(resp['request_id'])
 
 
-def _http_get(path: str, *, timeout=30, stream: bool = False):
+def _http_get(path: str, *, timeout=30, stream: bool = False,
+              retries: int = 3):
     """GET with the same error contract as _post: connection trouble and
     HTTP errors surface as SkyTpuError subclasses, never raw requests
-    exceptions (clients catch SkyTpuError only)."""
+    exceptions (clients catch SkyTpuError only).
+
+    GETs are idempotent — transient connection failures (server restart,
+    flaky proxy; the chaos suite injects exactly this) retry with
+    backoff before surfacing.
+    """
     url = server_url()
-    try:
-        r = requests_lib.get(f'{url}{path}', timeout=timeout,
-                             stream=stream, headers=_auth_headers())
-        r.raise_for_status()
-        return r
-    except requests_lib.HTTPError as e:
-        detail = ''
+    for attempt in range(retries + 1):
         try:
-            detail = e.response.json().get('error', '')
-        except Exception:  # noqa: BLE001 — non-JSON error body
-            pass
-        raise exceptions.SkyTpuError(
-            f'API server error for GET {path}: '
-            f'{detail or e}') from e
-    except requests_lib.RequestException as e:
-        raise exceptions.ApiServerConnectionError(url) from e
+            r = requests_lib.get(f'{url}{path}', timeout=timeout,
+                                 stream=stream, headers=_auth_headers())
+            r.raise_for_status()
+            return r
+        except requests_lib.HTTPError as e:
+            detail = ''
+            try:
+                detail = e.response.json().get('error', '')
+            except Exception:  # noqa: BLE001 — non-JSON error body
+                pass
+            raise exceptions.SkyTpuError(
+                f'API server error for GET {path}: '
+                f'{detail or e}') from e
+        except requests_lib.RequestException as e:
+            if attempt < retries:
+                time.sleep(0.4 * (2 ** attempt))
+                continue
+            raise exceptions.ApiServerConnectionError(url) from e
 
 
 def get(request_id: str) -> Any:
@@ -103,14 +113,22 @@ def get(request_id: str) -> Any:
 
 
 def stream_and_get(request_id: str, *, quiet: bool = False) -> Any:
-    """Stream the request's server-side log, then return its result."""
-    with _http_get(f'/api/stream/{request_id}', stream=True,
-                   timeout=None) as r:
-        for chunk in r.iter_content(chunk_size=None):
-            if not quiet and chunk:
-                import sys
-                sys.stdout.buffer.write(chunk)
-                sys.stdout.buffer.flush()
+    """Stream the request's server-side log, then return its result.
+
+    A dropped stream is non-fatal: the request keeps running server-side
+    (async-request design), so fall back to polling for the result.
+    """
+    try:
+        with _http_get(f'/api/stream/{request_id}', stream=True,
+                       timeout=None) as r:
+            for chunk in r.iter_content(chunk_size=None):
+                if not quiet and chunk:
+                    import sys
+                    sys.stdout.buffer.write(chunk)
+                    sys.stdout.buffer.flush()
+    except (exceptions.ApiServerConnectionError,
+            requests_lib.RequestException):
+        pass   # reconnect via the poll below
     return get(request_id)
 
 

@@ -18,8 +18,9 @@ from skypilot_tpu import config as config_lib
 from skypilot_tpu import exceptions
 
 LOGGING_CONFIG_DIR = '/opt/sky_tpu/logging'
-# Agent job logs: <cluster_dir>/jobs/<job_id>/rank<i>.log on real hosts.
-JOB_LOG_GLOB = '/opt/sky_tpu/cluster/jobs/*/*.log'
+# Agent job logs: <cluster_dir>/job_logs/<job_id>/rank<i>_<phase>.log
+# on real hosts (runtime/agent.py h_submit log_dir layout).
+JOB_LOG_GLOB = '/opt/sky_tpu/cluster/job_logs/*/*.log'
 
 
 class LoggingAgent(abc.ABC):

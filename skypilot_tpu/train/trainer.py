@@ -32,12 +32,18 @@ def make_optimizer(learning_rate: float = 3e-4,
                    weight_decay: float = 0.1,
                    warmup_steps: int = 100,
                    total_steps: int = 10_000,
-                   grad_clip: float = 1.0) -> optax.GradientTransformation:
+                   grad_clip: float = 1.0,
+                   mu_dtype: Optional[str] = None
+                   ) -> optax.GradientTransformation:
+    """AdamW + cosine schedule. ``mu_dtype='bfloat16'`` halves the
+    first-moment memory — the difference between fitting a ~1B model on
+    one v5e chip and OOMing (nu stays fp32 for numerics)."""
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay,
+                    mu_dtype=mu_dtype),
     )
 
 

@@ -1,0 +1,149 @@
+"""TCP chaos proxy (reference ``tests/chaos/chaos_proxy.py``): forwards
+client<->server traffic and violently kills every live connection on an
+interval, so client resilience (retry, stream-reconnect) is tested
+against real connection resets rather than mocks.
+
+Usage (library):
+    proxy = ChaosProxy(target_port=46580, kill_every_s=1.0)
+    proxy.start()          # proxy.port is the listen port
+    ...
+    proxy.stop()
+
+Or standalone:
+    python tests/chaos/chaos_proxy.py --target-port 46580 \
+        --kill-every 5
+"""
+from __future__ import annotations
+
+import argparse
+import socket
+import threading
+import time
+from typing import List, Optional
+
+
+class ChaosProxy:
+    def __init__(self, target_port: int, *, target_host: str = '127.0.0.1',
+                 listen_port: int = 0, kill_every_s: float = 2.0):
+        self.target = (target_host, target_port)
+        self.kill_every_s = kill_every_s
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR,
+                                  1)
+        self._listener.bind(('127.0.0.1', listen_port))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._conns: List[socket.socket] = []
+        self._conns_lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self.kills = 0
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self) -> 'ChaosProxy':
+        for fn in (self._accept_loop, self._chaos_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._kill_all()
+
+    # ---- internals -----------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(self.target,
+                                                    timeout=10)
+            except OSError:
+                client.close()
+                continue
+            with self._conns_lock:
+                self._conns += [client, upstream]
+            for a, b in ((client, upstream), (upstream, client)):
+                t = threading.Thread(target=self._pipe, args=(a, b),
+                                     daemon=True)
+                t.start()
+
+    def _pipe(self, src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def _kill_all(self) -> None:
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            # shutdown() FIRST: close() alone never reaches the wire
+            # while a pipe thread is blocked in recv on the same socket
+            # (the in-flight syscall pins the open file description, so
+            # no FIN/RST is ever sent and the peer blocks forever).
+            # shutdown wakes the readers; the linger-RST close then
+            # resets the peer mid-stream.
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             b'\x01\x00\x00\x00\x00\x00\x00\x00')
+            except OSError:
+                pass
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        if conns:
+            self.kills += 1
+
+    def _chaos_loop(self) -> None:
+        while not self._stop.wait(self.kill_every_s):
+            self._kill_all()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--target-port', type=int, required=True)
+    parser.add_argument('--target-host', default='127.0.0.1')
+    parser.add_argument('--listen-port', type=int, default=0)
+    parser.add_argument('--kill-every', type=float, default=5.0)
+    args = parser.parse_args(argv)
+    proxy = ChaosProxy(args.target_port, target_host=args.target_host,
+                       listen_port=args.listen_port,
+                       kill_every_s=args.kill_every).start()
+    print(f'chaos proxy :{proxy.port} -> {args.target_host}:'
+          f'{args.target_port}, killing every {args.kill_every}s')
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        proxy.stop()
+
+
+if __name__ == '__main__':
+    main()

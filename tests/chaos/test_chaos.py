@@ -1,0 +1,59 @@
+"""Client/server resilience under connection chaos (reference
+tests/chaos: a killer TCP proxy between client and API server)."""
+import time
+
+import pytest
+
+from tests.chaos.chaos_proxy import ChaosProxy
+
+
+@pytest.fixture
+def chaotic_server(api_server, monkeypatch):
+    """The api_server fixture's endpoint, fronted by a killer proxy."""
+    port = int(api_server.rsplit(':', 1)[1])
+    proxy = ChaosProxy(target_port=port, kill_every_s=0.8).start()
+    monkeypatch.setenv('SKY_TPU_API_SERVER',
+                       f'http://127.0.0.1:{proxy.port}')
+    yield proxy
+    proxy.stop()
+
+
+def test_status_survives_connection_kills(chaotic_server):
+    """Polling GETs retry through resets; ops complete end-to-end."""
+    from skypilot_tpu.client import sdk
+    ok = 0
+    for _ in range(8):
+        try:
+            sdk.status()
+            ok += 1
+        except Exception:  # noqa: BLE001 — a POST may land mid-kill
+            pass
+        time.sleep(0.25)
+    # With 0.8s kill cadence and ~2s of traffic, unretried clients lose
+    # most calls; the retrying SDK must land a clear majority.
+    assert ok >= 6, f'only {ok}/8 status calls survived chaos'
+    assert chaotic_server.kills >= 1, 'proxy never killed anything'
+
+
+def test_launch_through_chaos(chaotic_server):
+    """A full launch (POST + stream + poll) completes despite resets:
+    the stream falls back to polling and polls retry."""
+    import skypilot_tpu as sky
+    from skypilot_tpu.client import sdk
+    task = sky.Task('chaos-t', run='echo CHAOS_OK',
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4'))
+    job_id = None
+    for attempt in range(4):   # the initial POST itself may be killed
+        try:
+            job_id, info = sdk.launch(task, cluster_name='chaos-c',
+                                      quiet=True)
+            break
+        except Exception:  # noqa: BLE001
+            time.sleep(0.5)
+    assert job_id is not None, 'launch never survived the chaos proxy'
+    st = sdk.wait_job('chaos-c', job_id, timeout=120)
+    assert st.value == 'SUCCEEDED'
+    log = b''.join(sdk.tail_logs('chaos-c', job_id, follow=False))
+    assert b'CHAOS_OK' in log
+    sdk.down('chaos-c')

@@ -1,0 +1,86 @@
+"""API-server load test (reference ``tests/load_tests/
+test_load_on_server.py``: N concurrent users against one server; its
+README records 50-user CPU/RAM numbers as the published baseline).
+
+Kept small enough for CI (20 clients x 5 ops) while still exercising
+the short/long queue separation: a slow LONG op (launch) must not
+starve concurrent SHORT status calls.
+"""
+import statistics
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import requests
+
+
+N_CLIENTS = 20
+OPS_PER_CLIENT = 5
+
+
+def _status_once(api_server: str) -> float:
+    t0 = time.monotonic()
+    r = requests.post(f'{api_server}/status', json={}, timeout=30)
+    r.raise_for_status()
+    rid = r.json()['request_id']
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        g = requests.get(f'{api_server}/api/get/{rid}', timeout=30)
+        g.raise_for_status()
+        if g.json()['status'] in ('SUCCEEDED', 'FAILED'):
+            assert g.json()['status'] == 'SUCCEEDED'
+            return time.monotonic() - t0
+        time.sleep(0.05)
+    raise TimeoutError('status op never finished')
+
+
+def test_concurrent_status_under_long_op(api_server):
+    """SHORT ops stay fast while a LONG op occupies the long pool."""
+    # Occupy the long lane with a real (slow-ish) launch.
+    task = {'name': 'load-bg', 'run': 'sleep 5',
+            'resources': {'cloud': 'local', 'accelerators': 'v5e-4'}}
+    launch_rid = requests.post(
+        f'{api_server}/launch',
+        json={'task': task, 'cluster_name': 'load-c'},
+        timeout=30).json()['request_id']
+
+    latencies = []
+    failures = []
+
+    def client(_i):
+        for _ in range(OPS_PER_CLIENT):
+            try:
+                latencies.append(_status_once(api_server))
+            except Exception as e:  # noqa: BLE001
+                failures.append(e)
+
+    with ThreadPoolExecutor(max_workers=N_CLIENTS) as pool:
+        list(pool.map(client, range(N_CLIENTS)))
+
+    assert not failures, f'{len(failures)} failed: {failures[:3]}'
+    assert len(latencies) == N_CLIENTS * OPS_PER_CLIENT
+    p50 = statistics.median(latencies)
+    p95 = sorted(latencies)[int(len(latencies) * 0.95) - 1]
+    print(f'\nstatus under load: p50={p50 * 1000:.0f}ms '
+          f'p95={p95 * 1000:.0f}ms n={len(latencies)}')
+    # Generous ceiling: the point is "not starved by the long op", not
+    # absolute speed on a 1-core CI box.
+    assert p95 < 10.0, f'p95 {p95:.1f}s — short queue starved'
+
+    # Drain the background launch and clean up.
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        g = requests.get(f'{api_server}/api/get/{launch_rid}',
+                         timeout=30).json()
+        if g['status'] in ('SUCCEEDED', 'FAILED'):
+            break
+        time.sleep(0.5)
+    assert g['status'] == 'SUCCEEDED', g
+    rid = requests.post(f'{api_server}/down',
+                        json={'cluster_name': 'load-c'},
+                        timeout=30).json()['request_id']
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if requests.get(f'{api_server}/api/get/{rid}',
+                        timeout=30).json()['status'] == 'SUCCEEDED':
+            break
+        time.sleep(0.3)

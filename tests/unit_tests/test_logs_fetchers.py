@@ -37,7 +37,7 @@ def test_gcp_agent_config():
     cfg = yaml.safe_load(agent.fluentbit_config('my-cluster'))
     (inp,) = cfg['pipeline']['inputs']
     assert inp['name'] == 'tail'
-    assert 'jobs/*' in inp['path']
+    assert 'job_logs/' in inp['path']
     (out,) = cfg['pipeline']['outputs']
     assert out['name'] == 'stackdriver'
     assert out['export_to_project_id'] == 'proj-x'
@@ -90,9 +90,9 @@ def test_offline_fetch_roundtrip(tmp_path):
     # The regenerated CSV loads through the real catalog parser.
     from skypilot_tpu import catalog
     orig = catalog._DATA_DIR
-    catalog._DATA_DIR = str(tmp_path)
-    catalog.refresh()
     try:
+        catalog._DATA_DIR = str(tmp_path)
+        catalog.refresh()
         entries = catalog._load('gcp')
         assert entries and any(e.kind == 'tpu' for e in entries)
     finally:
