@@ -33,11 +33,18 @@ def server_url() -> str:
             f'http://127.0.0.1:{common.DEFAULT_API_PORT}').rstrip('/')
 
 
+CLIENT_API_VERSION = 1
+
+
 def _auth_headers() -> Dict[str, str]:
-    """Bearer token from env/config (reference service-account auth)."""
+    """Bearer token from env/config (reference service-account auth) +
+    the client's API version for the server's compatibility gate."""
+    headers = {'X-Sky-Tpu-Api-Version': str(CLIENT_API_VERSION)}
     token = (os.environ.get('SKY_TPU_API_TOKEN') or
              config_lib.get_nested(('api_server', 'token')))
-    return {'Authorization': f'Bearer {token}'} if token else {}
+    if token:
+        headers['Authorization'] = f'Bearer {token}'
+    return headers
 
 
 def _post_raw(op: str, payload: Dict[str, Any]) -> Dict[str, Any]:
@@ -47,7 +54,7 @@ def _post_raw(op: str, payload: Dict[str, Any]) -> Dict[str, Any]:
                               headers=_auth_headers())
     except requests_lib.RequestException as e:
         raise exceptions.ApiServerConnectionError(url) from e
-    if r.status_code in (400, 401, 403):
+    if r.status_code in (400, 401, 403, 426):
         raise exceptions.SkyTpuError(r.json().get('error', r.text))
     r.raise_for_status()
     return r.json()
@@ -140,6 +147,19 @@ def api_health() -> Dict[str, Any]:
         return r.json()
     except requests_lib.RequestException as e:
         raise exceptions.ApiServerConnectionError(url) from e
+
+
+def check_server_compatibility() -> None:
+    """New-client/old-server direction of the version gate: the server
+    only rejects clients NEWER than itself via the request header; a
+    newer client must itself refuse servers older than it understands
+    (reference backward-compat middleware covers both directions)."""
+    server_v = api_health().get('api_version', 0)
+    if server_v < CLIENT_API_VERSION:
+        raise exceptions.SkyTpuError(
+            f'API server at {server_url()} speaks api v{server_v} but '
+            f'this client requires >= v{CLIENT_API_VERSION}; upgrade '
+            f'the server or downgrade the client.')
 
 
 def api_requests() -> List[Dict[str, Any]]:

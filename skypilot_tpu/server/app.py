@@ -43,6 +43,11 @@ from skypilot_tpu.utils import common
 
 DEFAULT_PORT = common.DEFAULT_API_PORT
 API_VERSION = 1
+# Oldest client API version this server still answers (reference
+# API-version middleware, sky/server/server.py:852: old client vs new
+# server and vice versa must fail loud, not corrupt).
+MIN_CLIENT_API_VERSION = 1
+API_VERSION_HEADER = 'X-Sky-Tpu-Api-Version'
 
 logger = logging.getLogger(__name__)
 
@@ -494,6 +499,24 @@ class Server:
             # (browsers can't attach one to the initial GET); every API
             # call it makes is still individually authenticated.
             return await handler(req)
+        # API-version gate: a client that declares an incompatible
+        # version gets a clear 426 instead of silent wire mismatches
+        # (clients that send no header — curl, dashboards — pass).
+        declared = req.headers.get(API_VERSION_HEADER)
+        if declared is not None:
+            try:
+                v = int(declared)
+            except ValueError:
+                return web.json_response(
+                    {'error': f'invalid {API_VERSION_HEADER}: '
+                              f'{declared!r}'}, status=400)
+            if v < MIN_CLIENT_API_VERSION or v > API_VERSION:
+                return web.json_response(
+                    {'error': f'client api version {v} unsupported '
+                              f'(server supports '
+                              f'{MIN_CLIENT_API_VERSION}..{API_VERSION});'
+                              f' upgrade the client or server'},
+                    status=426)
         authz = req.headers.get('Authorization', '')
         server: 'Server' = req.app['server']
         loop = asyncio.get_event_loop()

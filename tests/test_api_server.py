@@ -169,3 +169,35 @@ def test_dashboard_served(api_server):
     from skypilot_tpu.client import sdk
     accs = sdk.get(rid)
     assert any(k.startswith('v5p') for k in accs)
+
+
+def test_api_version_gate(api_server):
+    """Backward-compat guard (reference server.py:852): incompatible
+    declared versions are refused loudly; no header passes."""
+    hdr = {'X-Sky-Tpu-Api-Version': '99'}
+    r = requests.post(f'{api_server}/status', json={}, headers=hdr,
+                      timeout=5)
+    assert r.status_code == 426
+    assert 'upgrade' in r.json()['error']
+    r = requests.post(f'{api_server}/status', json={},
+                      headers={'X-Sky-Tpu-Api-Version': 'abc'}, timeout=5)
+    assert r.status_code == 400
+    # Current SDK version and headerless clients pass.
+    from skypilot_tpu.client import sdk
+    assert isinstance(sdk.status(), list)
+    r = requests.post(f'{api_server}/status', json={}, timeout=5)
+    assert r.status_code == 200
+
+
+def test_client_side_version_check(api_server, monkeypatch):
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu import exceptions as exc
+    sdk.check_server_compatibility()   # matching versions pass
+    monkeypatch.setattr(sdk, 'CLIENT_API_VERSION', 99)
+    with pytest.raises(exc.SkyTpuError, match='upgrade the server'):
+        sdk.check_server_compatibility()
+    # The 426 path surfaces the server's message as SkyTpuError.
+    monkeypatch.setattr(sdk, '_auth_headers',
+                        lambda: {'X-Sky-Tpu-Api-Version': '99'})
+    with pytest.raises(exc.SkyTpuError, match='upgrade the client'):
+        sdk.status()
