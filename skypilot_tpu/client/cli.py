@@ -24,11 +24,12 @@ def _engine():
     if os.environ.get('SKY_TPU_API_SERVER'):
         try:
             from skypilot_tpu.client import sdk
-            return sdk
         except ImportError as e:
             raise click.ClickException(
                 f'SKY_TPU_API_SERVER is set but the SDK is unavailable: '
                 f'{e}') from e
+        sdk.ensure_server_compatibility()
+        return sdk
     from skypilot_tpu import core
     return core
 
@@ -552,6 +553,26 @@ def _remote() -> bool:
     """True when ops should go through the API server (its RBAC applies;
     acting on the local DB would mint tokens the server rejects)."""
     return bool(os.environ.get('SKY_TPU_API_SERVER'))
+
+
+@cli.command('dump')
+@click.option('--output', '-o', default=None)
+@click.option('--no-logs', is_flag=True, default=False)
+def dump(output, no_logs) -> None:
+    """Bundle state + logs into a diagnostics tarball (server-side
+    state when an API server is configured, then downloaded)."""
+    if _remote():
+        from skypilot_tpu.client import sdk
+        remote_path = sdk.call('debug_dump',
+                               {'include_logs': not no_logs})
+        filename = os.path.basename(remote_path)
+        local = output or filename
+        sdk.download_dump(filename, local)
+        click.echo(local)
+        return
+    from skypilot_tpu import core as core_lib
+    path = core_lib.debug_dump(output, include_logs=not no_logs)
+    click.echo(path)
 
 
 @cli.group()

@@ -149,6 +149,30 @@ def api_health() -> Dict[str, Any]:
         raise exceptions.ApiServerConnectionError(url) from e
 
 
+_compat_checked_url: Optional[str] = None
+
+
+def ensure_server_compatibility() -> None:
+    """check_server_compatibility, once per server URL per process —
+    every CLI invocation in server mode goes through this."""
+    global _compat_checked_url
+    url = server_url()
+    if _compat_checked_url == url:
+        return
+    check_server_compatibility()
+    _compat_checked_url = url
+
+
+def download_dump(filename: str, local_path: str) -> str:
+    """Fetch a server-side debug dump (reference /debug/dump_download)."""
+    r = _http_get(f'/api/dump_download/{filename}', stream=True,
+                  timeout=120)
+    with open(local_path, 'wb') as f:
+        for chunk in r.iter_content(chunk_size=1 << 16):
+            f.write(chunk)
+    return local_path
+
+
 def check_server_compatibility() -> None:
     """New-client/old-server direction of the version gate: the server
     only rejects clients NEWER than itself via the request header; a

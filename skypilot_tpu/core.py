@@ -185,3 +185,99 @@ def check_detailed(clouds: Optional[List[str]] = None):
     """Structured per-cloud capability results."""
     from skypilot_tpu import check as check_lib
     return check_lib.check(clouds)
+
+
+def debug_dump(output: Optional[str] = None,
+               include_logs: bool = True) -> str:
+    """Bundle diagnostics into a tarball (reference sky/core.py:1762
+    debug dumps): cluster records + events, API request history (when a
+    server store exists locally), enabled clouds, volumes, config with
+    secrets redacted, version info, and recent server/agent logs.
+    Returns the archive path.
+    """
+    import io
+    import json as json_lib
+    import os
+    import tarfile
+    import time as time_lib
+
+    import skypilot_tpu
+    from skypilot_tpu import config as config_lib
+
+    output = output or os.path.join(
+        common.base_dir(),
+        f'debug-dump-{time_lib.strftime("%Y%m%d-%H%M%S")}.tar.gz')
+
+    def redact(obj):
+        if isinstance(obj, dict):
+            return {k: ('<redacted>' if any(
+                s in str(k).lower()
+                for s in ('secret', 'token', 'password', 'credential',
+                          'key'))
+                else redact(v)) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [redact(v) for v in obj]
+        return obj
+
+    def _jsonable(obj):
+        if isinstance(obj, dict):
+            return {k: _jsonable(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [_jsonable(v) for v in obj]
+        if hasattr(obj, 'value'):
+            return obj.value
+        return obj
+
+    clusters = [_jsonable(dict(r)) for r in state.get_clusters()]
+    # API request history, when this host runs (or ran) the server.
+    request_rows: List[Dict[str, Any]] = []
+    try:
+        from skypilot_tpu.server.requests_store import RequestStore
+        request_rows = RequestStore().list_requests()
+    except Exception:  # noqa: BLE001 — no server store here
+        pass
+    sections: Dict[str, Any] = {
+        'version': skypilot_tpu.__version__,
+        'generated_at': time_lib.time(),
+        'clusters': clusters,
+        'cluster_events': {
+            c['name']: state.get_cluster_events(c['name'])
+            for c in clusters},
+        'cluster_history': state.get_cluster_history(),
+        'enabled_clouds': state.get_enabled_clouds(),
+        'volumes': state.get_volumes(),
+        'requests': _jsonable(request_rows),
+        'config': redact(config_lib.to_dict()),
+    }
+    with tarfile.open(output, 'w:gz') as tar:
+        data = json_lib.dumps(sections, indent=1, default=str).encode()
+        info = tarfile.TarInfo('dump.json')
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+        if include_logs:
+            for rel in ('api_server.log',):
+                p = os.path.join(common.base_dir(), rel)
+                if os.path.exists(p):
+                    tar.add(p, arcname=rel)
+            cdir = common.clusters_dir()
+            if os.path.isdir(cdir):
+                # Known clusters first, then newest-first leftovers; cap
+                # at 20 and SAY SO rather than silently truncating.
+                known = [c['name'] for c in clusters]
+                rest = sorted(
+                    (n for n in os.listdir(cdir) if n not in known),
+                    key=lambda n: os.path.getmtime(
+                        os.path.join(cdir, n)),
+                    reverse=True)
+                ordered = known + rest
+                for name in ordered[:20]:
+                    agent_log = os.path.join(cdir, name, 'agent.log')
+                    if os.path.exists(agent_log):
+                        tar.add(agent_log,
+                                arcname=f'clusters/{name}/agent.log')
+                if len(ordered) > 20:
+                    logger.warning(
+                        'debug dump: %d cluster dirs truncated to 20',
+                        len(ordered))
+    logger.info('debug dump written to %s', output)
+    return output

@@ -202,6 +202,11 @@ class Server:
             from skypilot_tpu import catalog
             return functools.partial(catalog.list_accelerators,
                                      name_filter=payload.get('filter'))
+        if name == 'debug_dump':
+            # Reference /debug/dump_create: bundle server-side state;
+            # the client fetches it via /api/dump_download/<name>.
+            return functools.partial(core.debug_dump, None,
+                                     payload.get('include_logs', True))
         if name.startswith('volumes.'):
             return self._dispatch_volumes(name, payload)
         if name.startswith('pools.'):
@@ -462,6 +467,20 @@ class Server:
                                 status=404)
         return web.Response(text=html, content_type='text/html')
 
+    async def h_dump_download(self, req: web.Request) -> web.Response:
+        """Reference /debug/dump_download/:filename — only dump files
+        from the base dir are served (no traversal)."""
+        filename = req.match_info['filename']
+        if ('/' in filename or '\\' in filename or
+                not filename.startswith('debug-dump-')):
+            return web.json_response({'error': 'invalid dump name'},
+                                     status=400)
+        path = os.path.join(common.base_dir(), filename)
+        if not os.path.exists(path):
+            return web.json_response({'error': 'no such dump'},
+                                     status=404)
+        return web.FileResponse(path)
+
     async def h_health(self, _req: web.Request) -> web.Response:
         return web.json_response({
             'status': 'healthy',
@@ -554,6 +573,8 @@ class Server:
         app.router.add_get('/api/stream/{request_id}', self.h_stream)
         app.router.add_get(r'/logs/{cluster}/{job_id:\d+}',
                            self.h_job_logs)
+        app.router.add_get('/api/dump_download/{filename}',
+                           self.h_dump_download)
         app.router.add_post('/{op:[a-z_.]+}', self.h_op)
         return app
 

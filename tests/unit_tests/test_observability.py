@@ -87,3 +87,30 @@ def test_usage_records_and_opt_out(sky_tpu_home, monkeypatch):
     n = len(lines)
     op()
     assert len(open(path).readlines()) == n
+
+
+def test_debug_dump_bundle(tmp_path, monkeypatch):
+    """Reference sky/core.py:1762 debug dumps: state + redacted config."""
+    import json
+    import tarfile
+
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path))
+    monkeypatch.setenv('SKY_TPU_CONFIG', str(tmp_path / 'config.yaml'))
+    from skypilot_tpu import config as config_lib
+    (tmp_path / 'config.yaml').write_text(
+        'api_server:\n  token: hunter2\nlogs:\n  store: gcp\n')
+    config_lib.reload()
+    from skypilot_tpu import core, state
+    from skypilot_tpu.utils import common as common_lib
+    state.add_or_update_cluster('dumped', common_lib.ClusterStatus.UP)
+    try:
+        out = core.debug_dump(str(tmp_path / 'd.tar.gz'))
+        with tarfile.open(out) as tar:
+            d = json.load(tar.extractfile('dump.json'))
+        assert d['config']['api_server']['token'] == '<redacted>'
+        assert d['config']['logs']['store'] == 'gcp'   # non-secret kept
+        assert [c['name'] for c in d['clusters']] == ['dumped']
+        assert 'dumped' in d['cluster_events']
+    finally:
+        state.remove_cluster('dumped')
+        config_lib.reload()
