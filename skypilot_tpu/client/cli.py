@@ -563,6 +563,7 @@ def dump(output, no_logs) -> None:
     state when an API server is configured, then downloaded)."""
     if _remote():
         from skypilot_tpu.client import sdk
+        sdk.ensure_server_compatibility()
         remote_path = sdk.call('debug_dump',
                                {'include_logs': not no_logs})
         filename = os.path.basename(remote_path)

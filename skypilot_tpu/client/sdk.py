@@ -164,12 +164,24 @@ def ensure_server_compatibility() -> None:
 
 
 def download_dump(filename: str, local_path: str) -> str:
-    """Fetch a server-side debug dump (reference /debug/dump_download)."""
-    r = _http_get(f'/api/dump_download/{filename}', stream=True,
-                  timeout=120)
-    with open(local_path, 'wb') as f:
-        for chunk in r.iter_content(chunk_size=1 << 16):
-            f.write(chunk)
+    """Fetch a server-side debug dump (reference /debug/dump_download).
+
+    A dropped connection mid-body surfaces as SkyTpuError (module
+    contract) and removes the truncated local file rather than leaving
+    it around looking like a valid dump."""
+    try:
+        with _http_get(f'/api/dump_download/{filename}', stream=True,
+                       timeout=120) as r:
+            with open(local_path, 'wb') as f:
+                for chunk in r.iter_content(chunk_size=1 << 16):
+                    f.write(chunk)
+    except requests_lib.RequestException as e:
+        try:
+            os.unlink(local_path)
+        except OSError:
+            pass
+        raise exceptions.SkyTpuError(
+            f'dump download interrupted: {e}') from e
     return local_path
 
 

@@ -32,8 +32,32 @@ def _info_of(record: Dict[str, Any]) -> ClusterInfo:
 
 def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
     """Reconcile DB status with the provider's truth (reference
-    backend_utils status refresh; autostop self-teardown shows up here)."""
+    backend_utils status refresh; autostop self-teardown shows up here).
+
+    Runs under the cluster lock: the background refresh daemon must not
+    clobber a concurrent start/stop/down's freshly written state with a
+    stale provider read. A busy lock skips the refresh (the mutating op
+    will write the truth anyway)."""
     name = record['name']
+    if not record['cluster_info']:
+        return record
+    try:
+        with locks.cluster_lock(name, timeout=1.0):
+            return _refresh_one_locked(record)
+    except Exception as e:  # noqa: BLE001 — filelock.Timeout and kin
+        logger.debug('skip refresh of %s (busy): %s', name, e)
+        return record
+
+
+def _refresh_one_locked(record: Dict[str, Any]) -> Dict[str, Any]:
+    name = record['name']
+    # Re-read: the op we waited on may have changed or removed it.
+    current = state.get_cluster(name)
+    if current is None:
+        record = dict(record)
+        record['status'] = None
+        return record
+    record = current
     if not record['cluster_info']:
         return record
     info = _info_of(record)
@@ -249,35 +273,44 @@ def debug_dump(output: Optional[str] = None,
         'requests': _jsonable(request_rows),
         'config': redact(config_lib.to_dict()),
     }
+    # Decide which agent logs go in BEFORE writing dump.json so the
+    # truncation is recorded in the artifact itself (a server-side log
+    # line is invisible to the user who downloads the dump).
+    log_files: List[tuple] = []
+    if include_logs:
+        for rel in ('api_server.log',):
+            p = os.path.join(common.base_dir(), rel)
+            if os.path.exists(p):
+                log_files.append((p, rel))
+        cdir = common.clusters_dir()
+        if os.path.isdir(cdir):
+            known = [c['name'] for c in clusters]
+
+            def _mtime(n: str) -> float:
+                try:   # a concurrent `down` may delete the dir
+                    return os.path.getmtime(os.path.join(cdir, n))
+                except OSError:
+                    return 0.0
+            rest = sorted(
+                (n for n in os.listdir(cdir) if n not in known),
+                key=_mtime, reverse=True)
+            ordered = known + rest
+            for name in ordered[:20]:
+                agent_log = os.path.join(cdir, name, 'agent.log')
+                if os.path.exists(agent_log):
+                    log_files.append(
+                        (agent_log, f'clusters/{name}/agent.log'))
+            sections['agent_logs_truncated'] = max(
+                0, len(ordered) - 20)
     with tarfile.open(output, 'w:gz') as tar:
         data = json_lib.dumps(sections, indent=1, default=str).encode()
         info = tarfile.TarInfo('dump.json')
         info.size = len(data)
         tar.addfile(info, io.BytesIO(data))
-        if include_logs:
-            for rel in ('api_server.log',):
-                p = os.path.join(common.base_dir(), rel)
-                if os.path.exists(p):
-                    tar.add(p, arcname=rel)
-            cdir = common.clusters_dir()
-            if os.path.isdir(cdir):
-                # Known clusters first, then newest-first leftovers; cap
-                # at 20 and SAY SO rather than silently truncating.
-                known = [c['name'] for c in clusters]
-                rest = sorted(
-                    (n for n in os.listdir(cdir) if n not in known),
-                    key=lambda n: os.path.getmtime(
-                        os.path.join(cdir, n)),
-                    reverse=True)
-                ordered = known + rest
-                for name in ordered[:20]:
-                    agent_log = os.path.join(cdir, name, 'agent.log')
-                    if os.path.exists(agent_log):
-                        tar.add(agent_log,
-                                arcname=f'clusters/{name}/agent.log')
-                if len(ordered) > 20:
-                    logger.warning(
-                        'debug dump: %d cluster dirs truncated to 20',
-                        len(ordered))
+        for path, arcname in log_files:
+            try:
+                tar.add(path, arcname=arcname)
+            except OSError:
+                pass   # churn between listing and archiving
     logger.info('debug dump written to %s', output)
     return output

@@ -590,7 +590,12 @@ async def _serve(host: str, port: int) -> None:
     with open(os.path.join(common.base_dir(), 'api_server.json'), 'w',
               encoding='utf-8') as f:
         json.dump({'url': f'http://{host}:{port}', 'pid': os.getpid()}, f)
-    logger.info('API server on %s:%s', host, port)
+    from skypilot_tpu.server import daemons as daemons_lib
+    # Keep strong refs: asyncio only weakly references tasks, and a
+    # GC'd daemon task dies silently.
+    daemon_tasks = daemons_lib.start_all(server.short_pool)
+    logger.info('API server on %s:%s (%d daemons)', host, port,
+                len(daemon_tasks))
     while True:
         await asyncio.sleep(3600)
 

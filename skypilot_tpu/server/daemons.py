@@ -1,0 +1,99 @@
+"""In-server periodic daemons (reference ``sky/server/daemons.py``:
+``InternalRequestDaemon`` :75 running cluster-status refresh :151,
+managed-job refresh :199, serve status :288, heartbeat :312).
+
+Each daemon is an asyncio task that runs a blocking refresh on the
+server's short pool at its own cadence; failures are logged and the
+loop continues (a flaky cloud API must not kill the daemon).
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, List
+
+logger = logging.getLogger(__name__)
+
+# Intervals (reference uses minutes-scale cadences; env-tunable for
+# tests via config `api_server.daemon_interval_s`).
+CLUSTER_REFRESH_INTERVAL_S = 300.0
+VOLUME_REFRESH_INTERVAL_S = 300.0
+USAGE_HEARTBEAT_INTERVAL_S = 600.0
+
+
+@dataclasses.dataclass
+class Daemon:
+    name: str
+    interval_s: float
+    fn: Callable[[], Any]
+    last_run_at: float = 0.0
+    last_error: str = ''
+    runs: int = 0
+
+
+def _refresh_clusters() -> None:
+    from skypilot_tpu import core
+    core.status(refresh=True, all_workspaces=True)
+
+
+def _refresh_volumes() -> None:
+    from skypilot_tpu import volumes
+    volumes.volume_refresh()
+
+
+def _heartbeat() -> None:
+    from skypilot_tpu import usage
+    usage.heartbeat()
+
+
+def default_daemons() -> List[Daemon]:
+    from skypilot_tpu import config as config_lib
+    override = config_lib.get_nested(
+        ('api_server', 'daemon_interval_s'))
+    def iv(default: float) -> float:
+        return float(override) if override is not None else default
+    return [
+        Daemon('cluster-status-refresh',
+               iv(CLUSTER_REFRESH_INTERVAL_S), _refresh_clusters),
+        Daemon('volume-refresh', iv(VOLUME_REFRESH_INTERVAL_S),
+               _refresh_volumes),
+        Daemon('usage-heartbeat', iv(USAGE_HEARTBEAT_INTERVAL_S),
+               _heartbeat),
+    ]
+
+
+async def run_daemon(daemon: Daemon, pool,
+                     initial_delay_s: float = 5.0) -> None:
+    """One daemon's forever-loop; blocking work runs on `pool`."""
+    loop = asyncio.get_event_loop()
+    await asyncio.sleep(min(daemon.interval_s, initial_delay_s))
+    while True:
+        t0 = time.monotonic()
+        try:
+            await loop.run_in_executor(pool, daemon.fn)
+            daemon.last_error = ''
+        except Exception as e:  # noqa: BLE001 — daemons must survive
+            daemon.last_error = f'{type(e).__name__}: {e}'
+            logger.warning('daemon %s failed: %s', daemon.name,
+                           daemon.last_error)
+        daemon.runs += 1
+        daemon.last_run_at = time.time()
+        elapsed = time.monotonic() - t0
+        await asyncio.sleep(max(1.0, daemon.interval_s - elapsed))
+
+
+def start_all(pool) -> List[asyncio.Task]:
+    """Returns the tasks — the CALLER must keep this list alive:
+    asyncio holds only weak refs to tasks, and a GC'd daemon dies
+    silently mid-flight."""
+    tasks = []
+    for i, d in enumerate(default_daemons()):
+        # Index-based stagger: three daemons sharing one pool must not
+        # stampede the boot window together.
+        tasks.append(asyncio.get_event_loop().create_task(
+            run_daemon(d, pool, initial_delay_s=5.0 + 7.0 * i),
+            name=f'daemon-{d.name}'))
+    logger.info('started %d background daemons', len(tasks))
+    return tasks

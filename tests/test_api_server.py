@@ -201,3 +201,43 @@ def test_client_side_version_check(api_server, monkeypatch):
                         lambda: {'X-Sky-Tpu-Api-Version': '99'})
     with pytest.raises(exc.SkyTpuError, match='upgrade the client'):
         sdk.status()
+
+
+def test_background_daemons_run(sky_tpu_home, monkeypatch):
+    """Reference server daemons (daemons.py:151): periodic refresh loops
+    fire on their cadence and survive failures."""
+    import asyncio
+    from concurrent.futures import ThreadPoolExecutor
+
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu.server import daemons as daemons_lib
+
+    calls = {'n': 0}
+
+    def flaky():
+        calls['n'] += 1
+        if calls['n'] == 1:
+            raise RuntimeError('transient cloud error')
+
+    d = daemons_lib.Daemon('test', 0.1, flaky)
+
+    async def drive():
+        pool = ThreadPoolExecutor(max_workers=1)
+        task = asyncio.get_event_loop().create_task(
+            daemons_lib.run_daemon(d, pool))
+        for _ in range(100):
+            if d.runs >= 2:
+                break
+            await asyncio.sleep(0.1)
+        task.cancel()
+        pool.shutdown(wait=False)
+
+    asyncio.run(drive())
+    assert d.runs >= 2            # survived the first-run failure
+    assert calls['n'] >= 2
+    assert d.last_error == ''     # cleared after a success
+
+    # Config override applies to every default daemon's interval.
+    with config_lib.override({'api_server': {'daemon_interval_s': 7}}):
+        assert all(x.interval_s == 7.0
+                   for x in daemons_lib.default_daemons())
