@@ -203,9 +203,54 @@ def api_requests() -> List[Dict[str, Any]]:
 
 
 # ---- core-mirroring surface ---------------------------------------------
+def _upload_workdir(workdir: str) -> str:
+    """Zip + POST the local workdir; returns the server-side path
+    (reference client-side workdir upload feeding server.py:1463)."""
+    import tempfile
+    import zipfile
+    root = os.path.expanduser(workdir)
+    # Spool to disk and stream the POST: a large workdir must not be
+    # held in client RAM (twice) as a BytesIO.
+    spool = tempfile.NamedTemporaryFile(suffix='.zip', delete=False)
+    try:
+        with zipfile.ZipFile(spool, 'w', zipfile.ZIP_DEFLATED) as zf:
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ('.git', '__pycache__')]
+                for fn in filenames:
+                    full = os.path.join(dirpath, fn)
+                    zf.write(full, os.path.relpath(full, root))
+        spool.close()
+        url = server_url()
+        try:
+            with open(spool.name, 'rb') as f:
+                r = requests_lib.post(f'{url}/api/upload', data=f,
+                                      timeout=300,
+                                      headers=_auth_headers())
+        except requests_lib.RequestException as e:
+            raise exceptions.ApiServerConnectionError(url) from e
+    finally:
+        try:
+            os.unlink(spool.name)
+        except OSError:
+            pass
+    if r.status_code != 200:
+        try:
+            detail = r.json().get('error', r.text)
+        except ValueError:
+            detail = r.text
+        raise exceptions.SkyTpuError(f'workdir upload failed: {detail}')
+    return r.json()['workdir']
+
+
 def launch(task: task_lib.Task, cluster_name: Optional[str] = None,
            *, quiet: bool = True, **_kw) -> Tuple[int, ClusterInfo]:
-    rid = _post('launch', {'task': task.to_yaml_config(),
+    task_cfg = task.to_yaml_config()
+    if task.workdir:
+        # The server launches from ITS filesystem: ship the client's
+        # workdir up first and point the task at the server-side copy.
+        task_cfg['workdir'] = _upload_workdir(task.workdir)
+    rid = _post('launch', {'task': task_cfg,
                            'cluster_name': cluster_name})
     result = stream_and_get(rid, quiet=quiet)
     return result['job_id'], ClusterInfo.from_dict(result['cluster_info'])

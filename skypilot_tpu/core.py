@@ -41,12 +41,22 @@ def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
     name = record['name']
     if not record['cluster_info']:
         return record
+    import filelock
     try:
-        with locks.cluster_lock(name, timeout=1.0):
-            return _refresh_one_locked(record)
-    except Exception as e:  # noqa: BLE001 — filelock.Timeout and kin
-        logger.debug('skip refresh of %s (busy): %s', name, e)
+        lock_ctx = locks.cluster_lock(name, timeout=1.0)
+        lock_ctx.__enter__()
+    except filelock.Timeout:
+        logger.debug('skip refresh of %s (lock busy)', name)
         return record
+    try:
+        return _refresh_one_locked(record)
+    except Exception as e:  # noqa: BLE001 — provider flake: keep the
+        # stale record but SAY so (a silent swallow here hides real
+        # auth/API failures from `status --refresh` and the daemon).
+        logger.warning('refresh of %s failed: %s', name, e)
+        return record
+    finally:
+        lock_ctx.__exit__(None, None, None)
 
 
 def _refresh_one_locked(record: Dict[str, Any]) -> Dict[str, Any]:

@@ -467,6 +467,71 @@ class Server:
                                 status=404)
         return web.Response(text=html, content_type='text/html')
 
+    async def h_upload(self, req: web.Request) -> web.Response:
+        """Client workdir upload (reference file upload/chunk assembly,
+        server.py:1463): a zip body is extracted under the server's
+        uploads dir, keyed by content hash — the client rewrites
+        task.workdir to the returned path so the server-side launch
+        syncs the CLIENT's files, not the server's filesystem."""
+        import hashlib
+        import tempfile
+        import zipfile
+        uploads_dir = os.path.join(common.base_dir(), 'uploads')
+        os.makedirs(uploads_dir, exist_ok=True)
+        # Spool the body to disk (not RAM): archives run to hundreds of
+        # MB and the zip needs random access anyway.
+        digest = hashlib.sha256()
+        with tempfile.NamedTemporaryFile(dir=uploads_dir,
+                                         delete=False) as spool:
+            async for chunk in req.content.iter_chunked(1 << 20):
+                digest.update(chunk)
+                spool.write(chunk)
+            zip_path = spool.name
+        dest = os.path.join(uploads_dir, digest.hexdigest()[:16])
+        loop = asyncio.get_event_loop()
+
+        def extract():
+            try:
+                if os.path.isdir(dest):   # content-addressed: reuse
+                    return
+                # Private tmp per request: two concurrent identical
+                # uploads must not share an extraction dir.
+                tmp = tempfile.mkdtemp(dir=uploads_dir)
+                real_tmp = os.path.realpath(tmp)
+                with zipfile.ZipFile(zip_path) as zf:
+                    for zinfo in zf.infolist():
+                        # Zip-slip guard (trailing sep: a sibling dir
+                        # sharing the prefix must not pass).
+                        target = os.path.realpath(
+                            os.path.join(tmp, zinfo.filename))
+                        if not (target == real_tmp or
+                                target.startswith(real_tmp + os.sep)):
+                            raise ValueError(
+                                f'unsafe path in upload: '
+                                f'{zinfo.filename}')
+                    zf.extractall(tmp)
+                try:
+                    os.replace(tmp, dest)
+                except OSError:
+                    # Lost the race to an identical upload: dest exists
+                    # with the same content — that IS success.
+                    if not os.path.isdir(dest):
+                        raise
+                    import shutil
+                    shutil.rmtree(tmp, ignore_errors=True)
+            finally:
+                try:
+                    os.unlink(zip_path)
+                except OSError:
+                    pass
+
+        try:
+            await loop.run_in_executor(self.short_pool, extract)
+        except (zipfile.BadZipFile, ValueError) as e:
+            return web.json_response({'error': f'bad upload: {e}'},
+                                     status=400)
+        return web.json_response({'workdir': dest})
+
     async def h_dump_download(self, req: web.Request) -> web.Response:
         """Reference /debug/dump_download/:filename — only dump files
         from the base dir are served (no traversal)."""
@@ -562,7 +627,10 @@ class Server:
         return await handler(req)
 
     def make_app(self) -> web.Application:
-        app = web.Application(middlewares=[self.auth_middleware])
+        # client_max_size: aiohttp's 1 MiB default would reject any real
+        # workdir upload before h_upload even runs.
+        app = web.Application(middlewares=[self.auth_middleware],
+                              client_max_size=512 * 1024 * 1024)
         app['server'] = self
         app.router.add_get('/api/health', self.h_health)
         app.router.add_get('/dashboard', self.h_dashboard)
@@ -575,6 +643,7 @@ class Server:
                            self.h_job_logs)
         app.router.add_get('/api/dump_download/{filename}',
                            self.h_dump_download)
+        app.router.add_post('/api/upload', self.h_upload)
         app.router.add_post('/{op:[a-z_.]+}', self.h_op)
         return app
 
@@ -592,8 +661,12 @@ async def _serve(host: str, port: int) -> None:
         json.dump({'url': f'http://{host}:{port}', 'pid': os.getpid()}, f)
     from skypilot_tpu.server import daemons as daemons_lib
     # Keep strong refs: asyncio only weakly references tasks, and a
-    # GC'd daemon task dies silently.
-    daemon_tasks = daemons_lib.start_all(server.short_pool)
+    # GC'd daemon task dies silently. Daemons get their own tiny pool so
+    # a hung provider refresh never occupies interactive short-op
+    # workers (reference daemons are similarly isolated).
+    daemon_pool = ThreadPoolExecutor(max_workers=2,
+                                     thread_name_prefix='daemon')
+    daemon_tasks = daemons_lib.start_all(daemon_pool)
     logger.info('API server on %s:%s (%d daemons)', host, port,
                 len(daemon_tasks))
     while True:

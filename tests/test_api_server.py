@@ -241,3 +241,37 @@ def test_background_daemons_run(sky_tpu_home, monkeypatch):
     with config_lib.override({'api_server': {'daemon_interval_s': 7}}):
         assert all(x.interval_s == 7.0
                    for x in daemons_lib.default_daemons())
+
+
+def test_workdir_upload_roundtrip(api_server, tmp_path):
+    """Client workdir reaches the job via the server (reference file
+    upload, server.py:1463) — the server must not read its own fs."""
+    from skypilot_tpu import Resources, Task
+    from skypilot_tpu.client import sdk
+    wd = tmp_path / 'proj'
+    (wd / 'sub').mkdir(parents=True)
+    (wd / 'main.txt').write_text('CLIENT_PAYLOAD')
+    (wd / 'sub' / 'n.txt').write_text('NESTED')
+    task = Task('up-t', run='cat main.txt sub/n.txt', workdir=str(wd),
+                resources=Resources(cloud='local', accelerators='v5e-4'))
+    job_id, info = sdk.launch(task, cluster_name='up-c', quiet=True)
+    try:
+        assert sdk.wait_job('up-c', job_id, timeout=60).value == \
+            'SUCCEEDED'
+        log = b''.join(sdk.tail_logs('up-c', job_id, follow=False))
+        assert b'CLIENT_PAYLOAD' in log and b'NESTED' in log
+    finally:
+        sdk.down('up-c')
+
+
+def test_upload_rejects_zip_slip(api_server):
+    import io
+    import zipfile
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, 'w') as zf:
+        zf.writestr('../../evil.txt', 'pwn')
+    r = requests.post(f'{api_server}/api/upload', data=buf.getvalue(),
+                      timeout=10)
+    assert r.status_code == 400
+    assert 'unsafe' in r.json()['error'] or 'bad upload' in \
+        r.json()['error']
