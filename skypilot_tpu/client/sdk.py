@@ -209,6 +209,10 @@ def _upload_workdir(workdir: str) -> str:
     import tempfile
     import zipfile
     root = os.path.expanduser(workdir)
+    if not os.path.isdir(root):
+        raise exceptions.SkyTpuError(
+            f'workdir {workdir!r} does not exist (an empty upload '
+            f'would launch a job with no files)')
     # Spool to disk and stream the POST: a large workdir must not be
     # held in client RAM (twice) as a BytesIO.
     spool = tempfile.NamedTemporaryFile(suffix='.zip', delete=False)
@@ -219,6 +223,10 @@ def _upload_workdir(workdir: str) -> str:
                                if d not in ('.git', '__pycache__')]
                 for fn in filenames:
                     full = os.path.join(dirpath, fn)
+                    # Dangling symlinks / files deleted mid-walk must
+                    # not crash the launch.
+                    if not os.path.isfile(full):
+                        continue
                     zf.write(full, os.path.relpath(full, root))
         spool.close()
         url = server_url()

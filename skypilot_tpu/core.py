@@ -43,20 +43,22 @@ def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
         return record
     import filelock
     try:
-        lock_ctx = locks.cluster_lock(name, timeout=1.0)
-        lock_ctx.__enter__()
+        with locks.cluster_lock(name, timeout=1.0):
+            try:
+                return _refresh_one_locked(record)
+            except Exception as e:  # noqa: BLE001 — provider flake:
+                # keep the stale record but SAY so (silence here hides
+                # real auth/API failures from `status --refresh`).
+                logger.warning('refresh of %s failed: %s', name, e)
+                return record
     except filelock.Timeout:
         logger.debug('skip refresh of %s (lock busy)', name)
         return record
-    try:
-        return _refresh_one_locked(record)
-    except Exception as e:  # noqa: BLE001 — provider flake: keep the
-        # stale record but SAY so (a silent swallow here hides real
-        # auth/API failures from `status --refresh` and the daemon).
-        logger.warning('refresh of %s failed: %s', name, e)
+    except OSError as e:
+        # Lock-file trouble (read-only/full disk) degrades this one
+        # cluster, not the whole sweep.
+        logger.warning('refresh of %s skipped (lock error): %s', name, e)
         return record
-    finally:
-        lock_ctx.__exit__(None, None, None)
 
 
 def _refresh_one_locked(record: Dict[str, Any]) -> Dict[str, Any]:

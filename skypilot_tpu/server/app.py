@@ -478,19 +478,43 @@ class Server:
         import zipfile
         uploads_dir = os.path.join(common.base_dir(), 'uploads')
         os.makedirs(uploads_dir, exist_ok=True)
+        max_bytes = 512 * 1024 * 1024
         # Spool the body to disk (not RAM): archives run to hundreds of
-        # MB and the zip needs random access anyway.
+        # MB and the zip needs random access anyway. Failure paths must
+        # unlink the spool — aborted uploads would otherwise fill disk.
         digest = hashlib.sha256()
-        with tempfile.NamedTemporaryFile(dir=uploads_dir,
-                                         delete=False) as spool:
+        total = 0
+        spool = tempfile.NamedTemporaryFile(dir=uploads_dir,
+                                            delete=False)
+        zip_path = spool.name
+        too_large = False
+        try:
             async for chunk in req.content.iter_chunked(1 << 20):
+                total += len(chunk)
+                if total > max_bytes:
+                    too_large = True
+                    break
                 digest.update(chunk)
                 spool.write(chunk)
-            zip_path = spool.name
+        except BaseException:
+            # Client disconnected mid-stream (or loop teardown): the
+            # partial spool must not pile up in uploads_dir.
+            spool.close()
+            with contextlib.suppress(OSError):
+                os.unlink(zip_path)
+            raise
+        spool.close()
+        if too_large:
+            with contextlib.suppress(OSError):
+                os.unlink(zip_path)
+            return web.json_response(
+                {'error': 'upload too large (512MB cap)'}, status=413)
         dest = os.path.join(uploads_dir, digest.hexdigest()[:16])
         loop = asyncio.get_event_loop()
 
         def extract():
+            import shutil
+            tmp = None
             try:
                 if os.path.isdir(dest):   # content-addressed: reuse
                     return
@@ -512,18 +536,17 @@ class Server:
                     zf.extractall(tmp)
                 try:
                     os.replace(tmp, dest)
+                    tmp = None
                 except OSError:
                     # Lost the race to an identical upload: dest exists
                     # with the same content — that IS success.
                     if not os.path.isdir(dest):
                         raise
-                    import shutil
-                    shutil.rmtree(tmp, ignore_errors=True)
             finally:
-                try:
+                if tmp is not None:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                with contextlib.suppress(OSError):
                     os.unlink(zip_path)
-                except OSError:
-                    pass
 
         try:
             await loop.run_in_executor(self.short_pool, extract)
@@ -627,10 +650,10 @@ class Server:
         return await handler(req)
 
     def make_app(self) -> web.Application:
-        # client_max_size: aiohttp's 1 MiB default would reject any real
-        # workdir upload before h_upload even runs.
-        app = web.Application(middlewares=[self.auth_middleware],
-                              client_max_size=512 * 1024 * 1024)
+        # Keep aiohttp's 1 MiB default body cap for the JSON op routes;
+        # /api/upload streams req.content directly, which that cap does
+        # not govern — h_upload enforces its own byte limit in-loop.
+        app = web.Application(middlewares=[self.auth_middleware])
         app['server'] = self
         app.router.add_get('/api/health', self.h_health)
         app.router.add_get('/dashboard', self.h_dashboard)

@@ -1,0 +1,33 @@
+"""Smoke tests against REAL clouds (reference tests/smoke_tests/,
+parameterized by --cloud and skipped without credentials).
+
+Run:  pytest tests/smoke_tests --cloud gcp            # real TPU quota!
+      pytest tests/smoke_tests --cloud kubernetes     # live GKE context
+Default (no --cloud): every smoke test is skipped, so the offline suite
+stays green.
+"""
+import pytest
+
+
+def pytest_addoption(parser):
+    parser.addoption('--cloud', action='store', default=None,
+                     help='real cloud to smoke-test against')
+    parser.addoption('--accelerator', action='store', default='v5e-1',
+                     help='TPU slice for smoke tests')
+
+
+@pytest.fixture(scope='session')
+def smoke_cloud(request):
+    cloud = request.config.getoption('--cloud')
+    if cloud is None:
+        pytest.skip('smoke tests need --cloud (real credentials/quota)')
+    from skypilot_tpu import check as check_lib
+    (result,) = check_lib.check([cloud])
+    if not result.ok:
+        pytest.skip(f'{cloud} credentials unavailable: {result.reason}')
+    return cloud
+
+
+@pytest.fixture(scope='session')
+def smoke_accelerator(request):
+    return request.config.getoption('--accelerator')

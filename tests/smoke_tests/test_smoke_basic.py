@@ -1,0 +1,62 @@
+"""Basic real-cloud lifecycle (reference tests/smoke_tests/test_basic.py
+shape): launch -> logs -> exec -> autostop -> down on a real slice."""
+import uuid
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu.utils import common
+
+
+@pytest.fixture
+def cluster_name():
+    name = f'smoke-{uuid.uuid4().hex[:6]}'
+    yield name
+    # Always clean up real resources, pass or fail.
+    try:
+        core.down(name)
+    except Exception:  # noqa: BLE001 — may never have provisioned
+        pass
+
+
+def test_launch_exec_down(smoke_cloud, smoke_accelerator, cluster_name):
+    task = sky.Task(
+        'smoke', run='echo SMOKE_RANK=$SKY_TPU_NODE_RANK && python3 -c '
+        '"import os; print(os.environ.get(\'TPU_WORKER_ID\'))"',
+        resources=sky.Resources(cloud=smoke_cloud,
+                                accelerators=smoke_accelerator))
+    job_id, info = core.launch(task, cluster_name=cluster_name,
+                               quiet=True)
+    assert core.wait_job(cluster_name, job_id, timeout=900) == \
+        common.JobStatus.SUCCEEDED
+    log = b''.join(core.tail_logs(cluster_name, job_id,
+                                  follow=False)).decode()
+    assert 'SMOKE_RANK=0' in log
+
+    # exec reuses the warm cluster.
+    task2 = sky.Task('smoke2', run='hostname',
+                     resources=task.resources)
+    job2, _ = core.exec(task2, cluster_name)
+    assert core.wait_job(cluster_name, job2, timeout=300) == \
+        common.JobStatus.SUCCEEDED
+
+    core.autostop(cluster_name, idle_minutes=30)
+    records = core.status([cluster_name])
+    assert records[0]['autostop_minutes'] == 30
+
+
+def test_jax_sees_tpu(smoke_cloud, smoke_accelerator, cluster_name):
+    """The provisioned slice must expose real TPU devices to jax."""
+    task = sky.Task(
+        'smoke-jax',
+        run='python3 -c "import jax; ds = jax.devices(); '
+            'print(\'DEVICES\', len(ds), ds[0].platform)"',
+        resources=sky.Resources(cloud=smoke_cloud,
+                                accelerators=smoke_accelerator))
+    job_id, _ = core.launch(task, cluster_name=cluster_name, quiet=True)
+    assert core.wait_job(cluster_name, job_id, timeout=900) == \
+        common.JobStatus.SUCCEEDED
+    log = b''.join(core.tail_logs(cluster_name, job_id,
+                                  follow=False)).decode()
+    assert 'DEVICES' in log and 'tpu' in log
