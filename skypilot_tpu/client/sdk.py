@@ -217,6 +217,7 @@ def _upload_workdir(workdir: str) -> str:
     # held in client RAM (twice) as a BytesIO.
     spool = tempfile.NamedTemporaryFile(suffix='.zip', delete=False)
     try:
+        n_files = 0
         with zipfile.ZipFile(spool, 'w', zipfile.ZIP_DEFLATED) as zf:
             for dirpath, dirnames, filenames in os.walk(root):
                 dirnames[:] = [d for d in dirnames
@@ -228,6 +229,11 @@ def _upload_workdir(workdir: str) -> str:
                     if not os.path.isfile(full):
                         continue
                     zf.write(full, os.path.relpath(full, root))
+                    n_files += 1
+        if n_files == 0:
+            raise exceptions.SkyTpuError(
+                f'workdir {workdir!r} contains no files — refusing to '
+                f'launch a job with an empty workdir')
         spool.close()
         url = server_url()
         try:

@@ -11,9 +11,11 @@ as the autoscaler's QPS signal.
 from __future__ import annotations
 
 import asyncio
+import collections
+import contextlib
 import logging
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 import aiohttp
 from aiohttp import web
@@ -39,6 +41,13 @@ class LoadBalancer:
         self._session: Optional[aiohttp.ClientSession] = None
         self._pending_requests = 0
         self._running = True
+        # TTFT per proxied request: arrival -> first response byte from
+        # the replica (the BASELINE.md north-star serving metric; for a
+        # streaming LLM endpoint this is time-to-first-token as the
+        # client experiences it through the LB).
+        self._ttfts: collections.deque = collections.deque(maxlen=4096)
+        self._requests_total = 0
+        self._requests_failed = 0
 
     # -- background sync ---------------------------------------------------
     async def _sync_loop(self) -> None:
@@ -64,19 +73,47 @@ class LoadBalancer:
                     logger.warning('stats flush failed', exc_info=True)
 
     # -- request path ------------------------------------------------------
+    # NOTE: JSON (not the API server's Prometheus registry) is
+    # deliberate — the LB runs as its own process on the serve
+    # controller and this shape feeds `serve status` + the TTFT bench
+    # directly; a Prometheus exposition can wrap lb_metrics() later.
+    def lb_metrics(self) -> Dict[str, object]:
+        ttfts = sorted(self._ttfts)
+
+        def pct(p: float):
+            if not ttfts:
+                return None
+            return ttfts[min(len(ttfts) - 1, int(len(ttfts) * p))]
+        return {
+            'requests_total': self._requests_total,
+            'requests_failed': self._requests_failed,
+            'ttft_p50_s': pct(0.50),
+            'ttft_p90_s': pct(0.90),
+            'ttft_p99_s': pct(0.99),
+            'ttft_samples': len(ttfts),
+            'ready_replicas': len(self.policy.ready_urls),
+        }
+
     async def handle(self, request: web.Request) -> web.StreamResponse:
         if request.path == '/-/urls':   # introspection endpoint
             return web.json_response(
                 {'ready_replica_urls': list(self.policy.ready_urls)})
+        if request.path == '/-/metrics':
+            return web.json_response(self.lb_metrics())
         url = self.policy.select_replica()
         if url is None:
+            self._requests_total += 1
+            self._requests_failed += 1
             return web.Response(
                 status=503,
                 text=f'No ready replicas for service '
                      f'{self.service_name!r}. Use `sky-tpu serve status` '
                      f'to check replica health.\n')
         self._pending_requests += 1
+        self._requests_total += 1
+        t_arrival = time.monotonic()
         self.policy.pre_execute(url)
+        resp: Optional[web.StreamResponse] = None
         try:
             target = url.rstrip('/') + request.path_qs
             headers = {k: v for k, v in request.headers.items()
@@ -87,16 +124,38 @@ class LoadBalancer:
                     request.method, target, headers=headers,
                     data=body or None,
                     allow_redirects=False) as upstream:
+                # Replica-level errors are failures for the metrics even
+                # though we faithfully proxy them — and their (instant)
+                # latency must not pollute the TTFT distribution.
+                upstream_ok = upstream.status < 500
+                if not upstream_ok:
+                    self._requests_failed += 1
                 resp = web.StreamResponse(
                     status=upstream.status,
                     headers={k: v for k, v in upstream.headers.items()
                              if k.lower() not in _HOP_HEADERS})
                 await resp.prepare(request)
+                first = True
                 async for chunk in upstream.content.iter_chunked(64 * 1024):
+                    if first and upstream_ok:
+                        self._ttfts.append(time.monotonic() - t_arrival)
+                    first = False
                     await resp.write(chunk)
+                if first and upstream_ok:  # empty body: headers counted
+                    self._ttfts.append(time.monotonic() - t_arrival)
                 await resp.write_eof()
                 return resp
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            self._requests_failed += 1
+            if resp is not None and resp.prepared:
+                # Headers (and possibly body) already went out: a 502
+                # now would corrupt the stream with a second status
+                # line. Terminate the response; the truncation IS the
+                # client's error signal.
+                logger.warning('replica %s died mid-stream: %s', url, e)
+                with contextlib.suppress(Exception):
+                    await resp.write_eof()
+                return resp
             return web.Response(
                 status=502,
                 text=f'Replica {url} failed: {type(e).__name__}: {e}\n')

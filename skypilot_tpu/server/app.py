@@ -650,10 +650,12 @@ class Server:
         return await handler(req)
 
     def make_app(self) -> web.Application:
-        # Keep aiohttp's 1 MiB default body cap for the JSON op routes;
-        # /api/upload streams req.content directly, which that cap does
+        # 64 MiB cap for the JSON op routes (task configs embed whole
+        # setup/run scripts; aiohttp's 1 MiB default is too tight).
+        # /api/upload streams req.content directly, which this cap does
         # not govern — h_upload enforces its own byte limit in-loop.
-        app = web.Application(middlewares=[self.auth_middleware])
+        app = web.Application(middlewares=[self.auth_middleware],
+                              client_max_size=64 * 1024 * 1024)
         app['server'] = self
         app.router.add_get('/api/health', self.h_health)
         app.router.add_get('/dashboard', self.h_dashboard)

@@ -276,3 +276,75 @@ def test_llm_inference_replica_e2e():
         m = json.loads(resp.read())
     assert m['decode_tokens'] > 0
     serve.down('llm-svc')
+
+
+def test_lb_ttft_metrics(sky_tpu_home):
+    """North-star serving metric: the LB tracks per-request TTFT and
+    exposes p50/p90/p99 at /-/metrics (BASELINE.md metric #2)."""
+    import asyncio
+    import threading
+    import time
+
+    import requests as req_lib
+
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve import state as serve_state
+    from skypilot_tpu.utils import common as common_lib
+
+    # A slow-first-byte backend: 120ms before the first body chunk.
+    from aiohttp import web as aioweb
+
+    async def backend(request):
+        resp = aioweb.StreamResponse()
+        await resp.prepare(request)
+        await asyncio.sleep(0.12)
+        await resp.write(b'TOKEN1 ')
+        await resp.write(b'TOKEN2')
+        await resp.write_eof()
+        return resp
+
+    backend_port = common_lib.free_port()
+    lb_port = common_lib.free_port()
+    loop = asyncio.new_event_loop()
+
+    def run_all():
+        asyncio.set_event_loop(loop)
+        app = aioweb.Application()
+        app.router.add_route('*', '/{tail:.*}', backend)
+        runner = aioweb.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = aioweb.TCPSite(runner, '127.0.0.1', backend_port)
+        loop.run_until_complete(site.start())
+        lb = lb_lib.LoadBalancer('svc-ttft', 'round_robin')
+        lb.policy.set_ready_replicas(
+            [f'http://127.0.0.1:{backend_port}'])
+        loop.create_task(lb.run('127.0.0.1', lb_port))
+        loop.run_forever()
+
+    serve_state.add_service('svc-ttft', spec_json='{}',
+                            task_yaml='', lb_port=0,
+                            lb_policy='round_robin')
+    rid = serve_state.add_replica('svc-ttft', 'ttft-replica', version=1)
+    serve_state.set_replica_url(rid, f'http://127.0.0.1:{backend_port}')
+    serve_state.set_replica_status(rid, serve_state.ReplicaStatus.READY)
+    t = threading.Thread(target=run_all, daemon=True)
+    t.start()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            if req_lib.get(f'http://127.0.0.1:{lb_port}/-/urls',
+                           timeout=1).ok:
+                break
+        except req_lib.RequestException:
+            time.sleep(0.2)
+    for _ in range(5):
+        r = req_lib.get(f'http://127.0.0.1:{lb_port}/gen', timeout=10)
+        assert r.text == 'TOKEN1 TOKEN2'
+    m = req_lib.get(f'http://127.0.0.1:{lb_port}/-/metrics',
+                    timeout=5).json()
+    assert m['requests_total'] >= 5
+    assert m['ttft_samples'] >= 5
+    # TTFT reflects the backend's 120ms first-byte delay, not the
+    # 200ms+ full-response time.
+    assert 0.08 <= m['ttft_p50_s'] <= 0.5, m
+    loop.call_soon_threadsafe(loop.stop)
