@@ -68,3 +68,33 @@ def mesh_from_slice(s: topology.TpuSlice, *,
         raise ValueError(f'dp={dp} * tp={tp} must divide {total} chips')
     return make_mesh(dp=dp, fsdp=total // (tp * dp), tp=tp,
                      devices=devices)
+
+
+def make_multislice_mesh(num_slices: int, *,
+                         fsdp: Optional[int] = None, tp: int = 1,
+                         devices: Optional[Sequence[jax.Device]] = None
+                         ) -> Mesh:
+    """Mesh for a DCN-connected multislice job (MEGASCALE wiring).
+
+    Logical layout follows the standard multislice recipe: the ``dp`` axis
+    spans slices (gradient all-reduce rides DCN, the only traffic that
+    crosses slice boundaries), while ``fsdp``/``tp`` stay within each
+    slice's ICI. Devices must be ordered slice-major — jax returns exactly
+    that order under MEGASCALE (process ids are slice-major, see
+    runtime/distributed_env.make_env), and the CPU dryrun emulates it by
+    construction.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) % num_slices != 0:
+        raise ValueError(
+            f'{len(devices)} devices do not split into {num_slices} slices')
+    per_slice = len(devices) // num_slices
+    if fsdp is None:
+        if per_slice % tp != 0:
+            raise ValueError(f'tp={tp} must divide {per_slice} '
+                             f'devices/slice')
+        fsdp = per_slice // tp
+    if fsdp * tp != per_slice:
+        raise ValueError(
+            f'fsdp={fsdp} * tp={tp} != {per_slice} devices per slice')
+    return make_mesh(dp=num_slices, fsdp=fsdp, tp=tp, devices=devices)

@@ -40,6 +40,9 @@ class ClusterInfo:
     hosts: List[HostInfo]
     # TPU metadata (None for CPU/GPU clusters).
     tpu_slice: Optional[str] = None        # canonical slice name, 'v5e-16'
+    # Multislice: hosts covers ALL slices (slice j owns hosts
+    # [j*per_slice, (j+1)*per_slice)); DCN wiring via MEGASCALE env.
+    num_slices: int = 1
     instance_type: Optional[str] = None
     use_spot: bool = False
     cost_per_hour: float = 0.0
@@ -72,8 +75,9 @@ class ProvisionConfig:
     region: str
     zone: str
     instance_type: str
-    num_hosts: int
+    num_hosts: int                         # hosts per slice
     tpu_slice: Optional[str] = None        # canonical slice name
+    num_slices: int = 1                    # multislice: N slices, one gang
     use_spot: bool = False
     disk_size_gb: int = 256
     image_id: Optional[str] = None

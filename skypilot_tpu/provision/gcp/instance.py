@@ -11,6 +11,7 @@ is the head (its agent fans out to peers over the slice's internal IPs).
 """
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Dict, List, Optional
 
@@ -19,6 +20,8 @@ from skypilot_tpu import topology
 from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
                                            ProvisionConfig)
 from skypilot_tpu.provision.gcp import tpu_api
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_RUNTIME_VERSIONS = {
     'v2': 'tpu-ubuntu2204-base',
@@ -58,6 +61,14 @@ def _client(provider_config: Dict[str, Any]) -> tpu_api.TpuApiClient:
     return tpu_api.TpuApiClient(_project(provider_config))
 
 
+def _node_names(cluster_name: str, num_slices: int) -> List[str]:
+    """TPU node name per slice. Single slice keeps the bare cluster name
+    (back-compat); multislice nodes are `<cluster>-s<j>`."""
+    if num_slices <= 1:
+        return [cluster_name]
+    return [f'{cluster_name}-s{j}' for j in range(num_slices)]
+
+
 def run_instances(config: ProvisionConfig) -> ClusterInfo:
     client = _client(config.provider_config)
     assert config.tpu_slice is not None, (
@@ -72,17 +83,52 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
     s = topology.parse_tpu(config.tpu_slice)
     runtime_version = (config.runtime_version or
                        DEFAULT_RUNTIME_VERSIONS[s.generation])
-    client.create_node(
-        config.zone, config.cluster_name,
-        accelerator_type=s.accelerator_type,
-        runtime_version=runtime_version,
-        spot=config.use_spot,
-        labels={**config.labels, 'sky-tpu-cluster': config.cluster_name},
-        startup_script=_STARTUP_SCRIPT,
-        metadata=config.provider_config.get('metadata'),
-        data_disks=config.data_disks)
+    # Multislice: one TPU node per slice, created in order; a failed
+    # create tears down the already-created slices so the gang stays
+    # atomic (partial multislice is useless to the job).
+    names = _node_names(config.cluster_name, config.num_slices)
+    created: List[str] = []
+    try:
+        for name in names:
+            # Rollback must cover the IN-FLIGHT create too: a timeout
+            # during create_node's operation-wait can leave the node
+            # existing (billing, blocking the name) even though the call
+            # raised — delete_node tolerates not-found.
+            created.append(name)
+            client.create_node(
+                config.zone, name,
+                accelerator_type=s.accelerator_type,
+                runtime_version=runtime_version,
+                spot=config.use_spot,
+                labels={**config.labels,
+                        'sky-tpu-cluster': config.cluster_name},
+                startup_script=_STARTUP_SCRIPT,
+                metadata=config.provider_config.get('metadata'),
+                data_disks=config.data_disks)
+    except Exception:
+        import time as time_lib
+        for name in created:
+            # The in-flight node may still be CREATING — GCP answers 409
+            # to a delete racing its create op. Retry briefly; a node
+            # that still survives is logged loud (it bills until removed)
+            # rather than silently leaked.
+            for attempt in range(4):
+                try:
+                    client.delete_node(config.zone, name)
+                    break
+                except Exception as de:  # noqa: BLE001 — rollback path
+                    if attempt == 3:
+                        logger.error(
+                            'multislice rollback could not delete TPU '
+                            'node %s/%s: %s — delete it manually or '
+                            'relaunch will fail with already-exists',
+                            config.zone, name, de)
+                    else:
+                        time_lib.sleep(10 * (attempt + 1))
+        raise
     info = get_cluster_info(config.cluster_name, {
-        **config.provider_config, 'zone': config.zone})
+        **config.provider_config, 'zone': config.zone,
+        'num_slices': config.num_slices})
     if info is None:
         raise exceptions.ProvisionError(
             f'TPU node {config.cluster_name} vanished after create')
@@ -102,13 +148,18 @@ def _install_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
     ssh_user = config.provider_config.get('ssh_user', 'sky')
     key = config.provider_config.get('ssh_key', '~/.sky_tpu/keys/sky-key')
     internal_ips = [h.internal_ip for h in info.hosts]
+    hosts_per_slice = len(info.hosts) // max(info.num_slices, 1)
     for rank, host in enumerate(info.hosts):
         agent_config = {
             'cluster_name': info.cluster_name,
             'mode': 'host',
+            # Global host index; the agent derives (slice_id, in-slice
+            # rank) from it and num_hosts.
             'host_rank': rank,
             'host_ips': internal_ips,
-            'num_hosts': len(info.hosts),
+            'num_hosts': hosts_per_slice,
+            'num_slices': info.num_slices,
+            'slice_id': rank // hosts_per_slice,
             'tpu_slice': info.tpu_slice,
             'peer_agent_urls': [
                 f'http://{ip}:{AGENT_PORT}'
@@ -138,25 +189,29 @@ def get_cluster_info(cluster_name: str,
                      ) -> Optional[ClusterInfo]:
     client = _client(provider_config)
     zone = provider_config['zone']
-    try:
-        node = client.get_node(zone, cluster_name)
-    except exceptions.ClusterDoesNotExist:
-        return None
+    num_slices = int(provider_config.get('num_slices', 1))
     hosts: List[HostInfo] = []
-    state = node.get('state', 'UNKNOWN')
-    host_state = {'READY': 'RUNNING', 'STOPPED': 'STOPPED'}.get(
-        state, state)
-    for i, ep in enumerate(node.get('networkEndpoints', [])):
-        external = (ep.get('accessConfig') or {}).get('externalIp')
-        hosts.append(HostInfo(
-            host_id=f'{cluster_name}-host{i}',
-            internal_ip=ep.get('ipAddress', ''),
-            external_ip=external,
-            state=host_state,
-            agent_url=(f'http://{external or ep.get("ipAddress", "")}:'
-                       f'{AGENT_PORT}')))
+    state = 'UNKNOWN'
+    node = None
+    for name in _node_names(cluster_name, num_slices):
+        try:
+            node = client.get_node(zone, name)
+        except exceptions.ClusterDoesNotExist:
+            return None
+        state = node.get('state', 'UNKNOWN')
+        host_state = {'READY': 'RUNNING', 'STOPPED': 'STOPPED'}.get(
+            state, state)
+        for i, ep in enumerate(node.get('networkEndpoints', [])):
+            external = (ep.get('accessConfig') or {}).get('externalIp')
+            hosts.append(HostInfo(
+                host_id=f'{name}-host{i}',
+                internal_ip=ep.get('ipAddress', ''),
+                external_ip=external,
+                state=host_state,
+                agent_url=(f'http://{external or ep.get("ipAddress", "")}:'
+                           f'{AGENT_PORT}')))
     slice_name = None
-    acc_type = node.get('acceleratorType')
+    acc_type = node.get('acceleratorType') if node else None
     if acc_type:
         parsed = topology.parse_tpu(acc_type)
         slice_name = parsed.name if parsed else None
@@ -167,21 +222,31 @@ def get_cluster_info(cluster_name: str,
         zone=zone,
         hosts=hosts,
         tpu_slice=slice_name,
+        num_slices=num_slices,
         instance_type=acc_type,
-        use_spot=bool((node.get('schedulingConfig') or {}).get('spot')),
+        use_spot=bool(((node or {}).get('schedulingConfig') or
+                       {}).get('spot')),
         provider_config={'project': client.project, 'zone': zone,
-                         'node_state': state})
+                         'node_state': state, 'num_slices': num_slices})
+
+
+def _slices(provider_config: Dict[str, Any], cluster_name: str) -> List[str]:
+    return _node_names(cluster_name,
+                       int(provider_config.get('num_slices', 1)))
 
 
 def stop_instances(cluster_name: str,
                    provider_config: Dict[str, Any]) -> None:
-    _client(provider_config).stop_node(provider_config['zone'], cluster_name)
+    client = _client(provider_config)
+    for name in _slices(provider_config, cluster_name):
+        client.stop_node(provider_config['zone'], name)
 
 
 def start_instances(cluster_name: str,
                     provider_config: Dict[str, Any]) -> ClusterInfo:
-    _client(provider_config).start_node(provider_config['zone'],
-                                        cluster_name)
+    client = _client(provider_config)
+    for name in _slices(provider_config, cluster_name):
+        client.start_node(provider_config['zone'], name)
     info = get_cluster_info(cluster_name, provider_config)
     assert info is not None
     return info
@@ -189,8 +254,9 @@ def start_instances(cluster_name: str,
 
 def terminate_instances(cluster_name: str,
                         provider_config: Dict[str, Any]) -> None:
-    _client(provider_config).delete_node(provider_config['zone'],
-                                         cluster_name)
+    client = _client(provider_config)
+    for name in _slices(provider_config, cluster_name):
+        client.delete_node(provider_config['zone'], name)
 
 
 def wait_instances(cluster_name: str, provider_config: Dict[str, Any],
@@ -199,16 +265,22 @@ def wait_instances(cluster_name: str, provider_config: Dict[str, Any],
     want = {'RUNNING': 'READY', 'STOPPED': 'STOPPED'}.get(state, state)
     client = _client(provider_config)
     deadline = time.time() + 600
+    pending = list(_slices(provider_config, cluster_name))
     while time.time() < deadline:
-        node = client.get_node(provider_config['zone'], cluster_name)
-        if node.get('state') == want:
+        still = []
+        for name in pending:
+            node = client.get_node(provider_config['zone'], name)
+            if node.get('state') in ('PREEMPTED', 'TERMINATED'):
+                raise exceptions.ProvisionError(
+                    f'TPU node {name} entered {node.get("state")}')
+            if node.get('state') != want:
+                still.append(name)
+        pending = still
+        if not pending:
             return
-        if node.get('state') in ('PREEMPTED', 'TERMINATED'):
-            raise exceptions.ProvisionError(
-                f'TPU node entered {node.get("state")}')
         time.sleep(10)
     raise exceptions.ProvisionTimeoutError(
-        f'TPU node {cluster_name} not {want} within 600s')
+        f'TPU nodes {pending} not {want} within 600s')
 
 
 def open_ports(cluster_name: str, ports,

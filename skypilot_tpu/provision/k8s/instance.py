@@ -68,6 +68,11 @@ def _kubectl(provider_config: Dict[str, Any], args: List[str],
 
 
 def run_instances(config: ProvisionConfig) -> ClusterInfo:
+    if config.num_slices > 1:
+        raise exceptions.ProvisionError(
+            'multislice (num_slices > 1) is supported on the gcp and '
+            'local providers only; GKE multislice needs a JobSet path',
+            retryable=False)
     tpu = topology.parse_tpu(config.tpu_slice) if config.tpu_slice \
         else None
     manifest = manifests.render_slice(

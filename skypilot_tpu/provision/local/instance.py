@@ -50,8 +50,9 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
     _check_injected_failure(config)
     cdir = _cluster_dir(config.cluster_name)
     os.makedirs(cdir, exist_ok=True)
-    num_hosts = config.num_hosts
-    for r in range(num_hosts):
+    num_hosts = config.num_hosts          # per slice
+    total_hosts = num_hosts * config.num_slices
+    for r in range(total_hosts):
         hd = os.path.join(cdir, f'host{r}')
         os.makedirs(os.path.join(hd, 'workdir'), exist_ok=True)
         with open(os.path.join(hd, 'state'), 'w', encoding='utf-8') as f:
@@ -63,6 +64,7 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         'instance_type': config.instance_type,
         'tpu_slice': config.tpu_slice,
         'num_hosts': num_hosts,
+        'num_slices': config.num_slices,
         'use_spot': config.use_spot,
         'created_at': time.time(),
     }
@@ -80,12 +82,14 @@ def _start_agent(cluster_name: str) -> None:
         return
     with open(os.path.join(cdir, 'meta.json'), encoding='utf-8') as f:
         meta = json.load(f)
+    num_slices = int(meta.get('num_slices', 1))
     agent_config = {
         'cluster_name': cluster_name,
         'mode': 'local-slice',
         'host_rank': 0,
-        'host_ips': ['127.0.0.1'] * meta['num_hosts'],
+        'host_ips': ['127.0.0.1'] * (meta['num_hosts'] * num_slices),
         'num_hosts': meta['num_hosts'],
+        'num_slices': num_slices,
         'tpu_slice': meta.get('tpu_slice'),
     }
     with open(os.path.join(cdir, 'agent_config.json'), 'w',
@@ -224,7 +228,8 @@ def get_cluster_info(cluster_name: str,
     agent = _agent_info(cdir)
     agent_url = agent['url'] if agent else None
     hosts: List[HostInfo] = []
-    for r in range(meta['num_hosts']):
+    total_hosts = meta['num_hosts'] * int(meta.get('num_slices', 1))
+    for r in range(total_hosts):
         state_p = os.path.join(cdir, f'host{r}', 'state')
         st = 'TERMINATED'
         if os.path.exists(state_p):
@@ -243,6 +248,7 @@ def get_cluster_info(cluster_name: str,
         zone=meta['zone'],
         hosts=hosts,
         tpu_slice=meta.get('tpu_slice'),
+        num_slices=int(meta.get('num_slices', 1)),
         instance_type=meta['instance_type'],
         use_spot=meta.get('use_spot', False),
         cost_per_hour=0.0,

@@ -49,6 +49,7 @@ def _make_config(candidate: catalog.Candidate,
         instance_type=candidate.instance_type,
         num_hosts=candidate.num_hosts,
         tpu_slice=candidate.tpu.name if candidate.tpu else None,
+        num_slices=res.num_slices,
         use_spot=candidate.use_spot,
         disk_size_gb=res.disk_size_gb,
         image_id=res.image_id,
@@ -73,7 +74,7 @@ def bulk_provision(candidate: catalog.Candidate,
     info = provision.run_instances(candidate.cloud, config)
     provision.wait_instances(candidate.cloud, cluster_name,
                              info.provider_config)
-    info.cost_per_hour = candidate.cost_per_hour
+    info.cost_per_hour = candidate.cost_per_hour * res.num_slices
     if wait_agent and info.head.agent_url:
         agent_client.AgentClient(info.head.agent_url).wait_healthy()
     if res.ports:

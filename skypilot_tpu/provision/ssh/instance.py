@@ -68,6 +68,10 @@ def _health_check(pool: Dict[str, Any]) -> List[str]:
 
 
 def run_instances(config: ProvisionConfig) -> ClusterInfo:
+    if config.num_slices > 1:
+        raise exceptions.ProvisionError(
+            'multislice (num_slices > 1) is supported on the gcp and '
+            'local providers only', retryable=False)
     pool = _pool_of(config)
     cdir = _cluster_dir(config.cluster_name)
     os.makedirs(cdir, exist_ok=True)

@@ -112,6 +112,7 @@ class Resources:
         network_tier: Optional[str] = None,
         job_recovery: Optional[Union[str, Dict[str, Any]]] = None,
         any_of: Optional[List[Dict[str, Any]]] = None,
+        num_slices: int = 1,
     ):
         if cloud is not None and cloud not in KNOWN_CLOUDS:
             raise exceptions.InvalidResourcesError(
@@ -146,6 +147,16 @@ class Resources:
         # `any_of`: list of alternative resource dicts (reference supports
         # this for multi-resource failover).
         self._any_of = [dict(a) for a in any_of] if any_of else None
+        # Multislice: N identical TPU slices gang-allocated as ONE cluster,
+        # connected over DCN (MEGASCALE_* wiring in runtime/distributed_env).
+        self._num_slices = int(num_slices)
+        if self._num_slices < 1:
+            raise exceptions.InvalidResourcesError(
+                f'num_slices must be >= 1, got {num_slices}')
+        if self._num_slices > 1 and self._tpu is None:
+            raise exceptions.InvalidResourcesError(
+                'num_slices > 1 requires a TPU slice accelerator '
+                '(multislice is DCN-connected TPU slices).')
         self._validate()
 
     # ---- parsing helpers -------------------------------------------------
@@ -209,8 +220,13 @@ class Resources:
 
     @property
     def num_hosts(self) -> int:
-        """Host VMs implied by this request (1 for non-TPU)."""
-        return self._tpu.num_hosts if self._tpu else 1
+        """Host VMs implied by this request (1 for non-TPU), all slices."""
+        per_slice = self._tpu.num_hosts if self._tpu else 1
+        return per_slice * self._num_slices
+
+    @property
+    def num_slices(self) -> int:
+        return self._num_slices
 
     @property
     def cpus(self) -> Optional[Tuple[float, bool]]:
@@ -314,6 +330,7 @@ class Resources:
             'instance_type', 'use_spot', 'spot_recovery', 'disk_size_gb',
             'disk_size', 'image_id', 'ports', 'autostop', 'labels',
             'runtime_version', 'network_tier', 'job_recovery', 'any_of',
+            'num_slices',
         }
         unknown = set(config) - known
         if unknown:
@@ -367,6 +384,8 @@ class Resources:
             cfg['job_recovery'] = self._job_recovery
         if self._any_of:
             cfg['any_of'] = [dict(a) for a in self._any_of]
+        if self._num_slices != 1:
+            cfg['num_slices'] = self._num_slices
         return cfg
 
     def __eq__(self, other: object) -> bool:

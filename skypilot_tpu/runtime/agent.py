@@ -61,6 +61,11 @@ class Agent:
             topology.parse_tpu(slice_name) if slice_name else None)
         self.num_hosts: int = int(self.config.get(
             'num_hosts', self.tpu_slice.num_hosts if self.tpu_slice else 1))
+        # Multislice (DCN): num_hosts is per slice; this host's slice is
+        # config['slice_id'] (host mode); local-slice mode simulates all
+        # num_slices * num_hosts ranks in one process tree.
+        self.num_slices: int = int(self.config.get('num_slices', 1))
+        self.slice_id: int = int(self.config.get('slice_id', 0))
         self.jobs = job_lib.JobTable(
             os.path.join(self.cluster_dir, 'jobs.db'))
         self.started_at = time.time()
@@ -99,9 +104,22 @@ class Agent:
     # ---------------- job execution --------------------------------------
     def _rank_env(self, rank: int, job_envs: Dict[str, str],
                   job_id: int) -> Dict[str, str]:
+        """Env for global host index `rank` (slice-aware).
+
+        `rank` spans all slices; slice j owns ranks
+        [j*num_hosts, (j+1)*num_hosts). make_env gets the slice-local view
+        (libtpu TPU_WORKER_* is per slice) plus the global coordinator.
+        """
         env = dict(os.environ)
-        env.update(distributed_env.make_env(self.host_ips, rank,
-                                            self.tpu_slice))
+        sid, in_rank = divmod(rank, self.num_hosts)
+        slice_ips = self.host_ips[sid * self.num_hosts:
+                                  (sid + 1) * self.num_hosts]
+        env.update(distributed_env.make_env(
+            slice_ips, in_rank, self.tpu_slice,
+            num_slices=self.num_slices, slice_id=sid,
+            megascale_coordinator=(self.host_ips[0]
+                                   if self.num_slices > 1 else None),
+            coordinator_ip=self.host_ips[0]))
         env.update(job_envs)
         env['SKY_TPU_JOB_ID'] = str(job_id)
         if self.mode == 'local-slice':
@@ -194,7 +212,7 @@ class Agent:
             tasks = [
                 self._run_rank(job_id, r, cmd, envs,
                                os.path.join(log_dir, f'rank{r}_{phase}.log'))
-                for r in range(self.num_hosts)
+                for r in range(self.num_hosts * self.num_slices)
             ]
             return list(await asyncio.gather(*tasks))
         # host mode: this agent runs its own rank; peers run theirs.
@@ -284,7 +302,7 @@ class Agent:
         else:
             # Local fake slice: mark hosts stopped; the engine's status
             # refresh reconciles.
-            for r in range(self.num_hosts):
+            for r in range(self.num_hosts * self.num_slices):
                 hd = os.path.join(self.cluster_dir, f'host{r}')
                 if os.path.isdir(hd):
                     with open(os.path.join(hd, 'state'), 'w',
@@ -299,6 +317,7 @@ class Agent:
             'idle': self.jobs.is_idle(),
             'mode': self.mode,
             'num_hosts': self.num_hosts,
+            'num_slices': self.num_slices,
         })
 
     async def h_submit(self, req: web.Request) -> web.Response:
@@ -309,7 +328,7 @@ class Agent:
             run_cmd=body['run'],
             setup_cmd=body.get('setup'),
             envs=body.get('envs', {}),
-            num_hosts=self.num_hosts,
+            num_hosts=self.num_hosts * self.num_slices,
             log_dir='')
         log_dir = os.path.join(log_dir, str(job_id))
         self.jobs._conn.execute(  # set final log dir now that id is known

@@ -41,15 +41,18 @@ def make_env(host_ips: List[str],
              *,
              num_slices: int = 1,
              slice_id: int = 0,
-             megascale_coordinator: Optional[str] = None) -> Dict[str, str]:
+             megascale_coordinator: Optional[str] = None,
+             coordinator_ip: Optional[str] = None) -> Dict[str, str]:
     """Env vars for the process running on host `rank` of a slice.
 
-    For multislice jobs (num_slices > 1), `rank` is the host index within
-    its slice and `slice_id` identifies the slice; MEGASCALE vars carry the
-    DCN-level wiring while JAX vars cover the global process group.
+    `host_ips` is THIS slice's host list and `rank` the host index within
+    it (libtpu's TPU_WORKER_* wiring is per-slice). For multislice jobs
+    (num_slices > 1) `slice_id` identifies the slice, `coordinator_ip`
+    must be host 0 of slice 0 (the ONE jax.distributed coordinator for the
+    global process group), and MEGASCALE vars carry the DCN-level wiring.
     """
     num_hosts = len(host_ips)
-    coordinator = f'{host_ips[0]}:{COORDINATOR_PORT}'
+    coordinator = f'{coordinator_ip or host_ips[0]}:{COORDINATOR_PORT}'
     env = {
         NODE_RANK_ENV: str(rank),
         NODE_IPS_ENV: '\n'.join(host_ips),

@@ -1,0 +1,108 @@
+"""Multislice (DCN-connected slices, MEGASCALE wiring) — env contract,
+resources model, multislice mesh, and the local-provider gang.
+
+Reference scope note: the reference has NO multislice equivalent (its gang
+is one Ray placement group per cluster, sky/backends/task_codegen.py:439);
+this is the TPU-native extension SURVEY.md §2.8 calls for ("collectives
+ride ICI within a slice and DCN across slices").
+"""
+import numpy as np
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology
+from skypilot_tpu.runtime import distributed_env
+
+
+def test_make_env_multislice_contract():
+    """Slice 1 host 0 of a 2x(2-host) job: global jax process group,
+    per-slice libtpu wiring, MEGASCALE DCN vars."""
+    s = topology.parse_tpu('v5e-16')            # 4 hosts -> use 2-host ips
+    slice_ips = ['10.0.1.0', '10.0.1.1']
+    env = distributed_env.make_env(
+        slice_ips, 0, s, num_slices=2, slice_id=1,
+        megascale_coordinator='10.0.0.0', coordinator_ip='10.0.0.0')
+    # jax.distributed: ONE global coordinator (slice 0 host 0), global ids.
+    assert env['JAX_COORDINATOR_ADDRESS'] == (
+        f'10.0.0.0:{distributed_env.COORDINATOR_PORT}')
+    assert env['JAX_NUM_PROCESSES'] == '4'      # 2 slices x 2 hosts
+    assert env['JAX_PROCESS_ID'] == '2'         # slice 1, host 0
+    # libtpu: per-slice worker wiring.
+    assert env['TPU_WORKER_ID'] == '0'
+    assert env['TPU_WORKER_HOSTNAMES'] == '10.0.1.0,10.0.1.1'
+    # DCN: MEGASCALE coordinator is slice 0's host 0.
+    assert env['MEGASCALE_NUM_SLICES'] == '2'
+    assert env['MEGASCALE_SLICE_ID'] == '1'
+    assert env['MEGASCALE_COORDINATOR_ADDRESS'] == (
+        f'10.0.0.0:{distributed_env.MEGASCALE_PORT}')
+
+
+def test_make_env_single_slice_has_no_megascale():
+    env = distributed_env.make_env(['127.0.0.1'], 0,
+                                   topology.parse_tpu('v5e-4'))
+    assert 'MEGASCALE_NUM_SLICES' not in env
+    assert env['JAX_NUM_PROCESSES'] == '1'
+
+
+def test_resources_num_slices_roundtrip_and_validation():
+    r = sky.Resources(cloud='gcp', accelerators='v5p-64', num_slices=4)
+    assert r.num_slices == 4
+    assert r.num_hosts == 8 * 4                 # v5p-64 = 8 hosts/slice
+    cfg = r.to_yaml_config()
+    assert cfg['num_slices'] == 4
+    assert sky.Resources.from_yaml_config(cfg) == r
+    # Default is 1 and is omitted from YAML.
+    assert 'num_slices' not in sky.Resources(
+        accelerators='v5p-64').to_yaml_config()
+    with pytest.raises(exceptions.InvalidResourcesError):
+        sky.Resources(accelerators='v5e-8', num_slices=0)
+    with pytest.raises(exceptions.InvalidResourcesError):
+        sky.Resources(accelerators='H100:8', num_slices=2)  # GPU: no DCN
+
+
+def test_make_multislice_mesh_axes():
+    import jax
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    devices = jax.devices()[:8]
+    mesh = mesh_lib.make_multislice_mesh(2, devices=devices)
+    assert mesh.shape == {'dp': 2, 'fsdp': 4, 'tp': 1}
+    # Slice-major: row j of the dp axis is slice j's devices, in order.
+    arr = np.asarray(mesh.devices).reshape(2, 4)
+    assert [d.id for d in arr[0]] == [d.id for d in devices[:4]]
+    assert [d.id for d in arr[1]] == [d.id for d in devices[4:]]
+    with pytest.raises(ValueError):
+        mesh_lib.make_multislice_mesh(3, devices=devices)
+
+
+def test_local_multislice_launch_env():
+    """2 slices x 1 host (v5e-4): both ranks run, each sees its slice id,
+    the global process group, and the shared MEGASCALE coordinator."""
+    from skypilot_tpu import core
+    from skypilot_tpu import state
+    from skypilot_tpu.utils import common
+    task = sky.Task(
+        'ms', run='echo SID=$MEGASCALE_SLICE_ID NS=$MEGASCALE_NUM_SLICES '
+                  'PID=$JAX_PROCESS_ID NP=$JAX_NUM_PROCESSES '
+                  'TPUW=$TPU_WORKER_ID MC=$MEGASCALE_COORDINATOR_ADDRESS',
+        resources=sky.Resources(cloud='local', accelerators='v5e-4',
+                                num_slices=2))
+    job_id, info = core.launch(task, cluster_name='ms-c', quiet=True)
+    try:
+        assert info.num_slices == 2
+        assert info.num_hosts == 2              # 1 host/slice x 2 slices
+        st = core.wait_job('ms-c', job_id, timeout=60)
+        assert st == common.JobStatus.SUCCEEDED
+        for rank in range(2):
+            log = b''.join(core.tail_logs('ms-c', job_id, follow=False,
+                                          rank=rank)).decode()
+            assert f'SID={rank}' in log, log    # 1 host/slice: sid == rank
+            assert 'NS=2' in log
+            assert f'PID={rank}' in log
+            assert 'NP=2' in log
+            assert 'TPUW=0' in log              # in-slice worker id
+            assert f'MC=127.0.0.1:{distributed_env.MEGASCALE_PORT}' in log
+        rec = state.get_cluster('ms-c')
+        assert rec['status'] == common.ClusterStatus.UP
+    finally:
+        core.down('ms-c')
