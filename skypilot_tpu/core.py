@@ -283,8 +283,14 @@ def debug_dump(output: Optional[str] = None,
         'enabled_clouds': state.get_enabled_clouds(),
         'volumes': state.get_volumes(),
         'requests': _jsonable(request_rows),
-        'config': redact(config_lib.to_dict()),
+        'config': config_lib.to_dict(),
     }
+    # Redact EVERY section, not just config: cluster records embed
+    # provider_config verbatim, which for ssh-pool clusters carries the
+    # pool's cleartext ssh_password (provision/ssh/instance.py), and
+    # request payloads may carry task env secrets. Dumps are designed to
+    # be downloaded and shared.
+    sections = redact(sections)
     # Decide which agent logs go in BEFORE writing dump.json so the
     # truncation is recorded in the artifact itself (a server-side log
     # line is invisible to the user who downloads the dump).

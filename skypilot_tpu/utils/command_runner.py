@@ -95,7 +95,14 @@ class SSHCommandRunner(CommandRunner):
                     exist_ok=True)
 
     def _auth_prefix(self) -> List[str]:
-        return (['sshpass', '-p', self.password] if self.password else [])
+        # -e reads the password from $SSHPASS (see _env): a -p argument
+        # would expose it to every local user via /proc/*/cmdline.
+        return ['sshpass', '-e'] if self.password else []
+
+    def _env(self) -> Optional[dict]:
+        if not self.password:
+            return None
+        return {**os.environ, 'SSHPASS': self.password}
 
     def _ssh_base(self) -> List[str]:
         cmd = self._auth_prefix() + ['ssh', *_SSH_OPTS, '-p',
@@ -113,7 +120,7 @@ class SSHCommandRunner(CommandRunner):
         full = self._ssh_base() + [f'bash -lc {shlex.quote(cmd)}']
         try:
             proc = subprocess.run(full, capture_output=True, text=True,
-                                  timeout=timeout)
+                                  timeout=timeout, env=self._env())
         except subprocess.TimeoutExpired:
             # A hung handshake must look like a failed command (rc 124,
             # GNU timeout convention), not a raw TimeoutExpired that
@@ -132,7 +139,7 @@ class SSHCommandRunner(CommandRunner):
         proc = subprocess.run(
             self._auth_prefix() +
             ['rsync', '-az', '--delete', '-e', ssh_cmd, *pair],
-            capture_output=True, text=True)
+            capture_output=True, text=True, env=self._env())
         if proc.returncode != 0:
             raise exceptions.CommandError(proc.returncode,
                                           f'rsync {src} {dst}', proc.stderr)

@@ -21,8 +21,26 @@ from skypilot_tpu import task as task_lib
 _HEADER_FIELDS = {'name', 'execution'}
 
 
-def _is_header(doc: Dict[str, Any]) -> bool:
-    return bool(doc) and set(doc).issubset(_HEADER_FIELDS)
+def _is_header(doc: Dict[str, Any], rest: List[Dict[str, Any]]) -> bool:
+    """Is doc[0] the pipeline header (``name:`` / ``execution:`` only)?
+
+    A first document whose keys are a subset of the header fields is the
+    header — the reference's pipeline format (``name: my-pipeline`` as
+    doc 0). That reading is only safe when the remaining documents are
+    recognizably tasks; if EVERY document looks like a header, treating
+    doc 0 as one would silently swallow a task, so the caller raises.
+    """
+    if not doc or not set(doc).issubset(_HEADER_FIELDS):
+        return False
+    if 'execution' in doc:  # not a task field — unambiguously a header
+        return True
+    if all(set(d).issubset(_HEADER_FIELDS) for d in rest):
+        raise exceptions.InvalidTaskError(
+            'Ambiguous multi-document YAML: every document has only '
+            f'header fields ({sorted(_HEADER_FIELDS)}). Add a task field '
+            "(e.g. 'run:') to task documents, or an 'execution:' field "
+            'to the header.')
+    return True
 
 
 def load_dag_from_yaml_str(
@@ -44,7 +62,7 @@ def load_dag_from_yaml_str(
                 f'{type(d).__name__}')
     dag = dag_lib.Dag()
     execution = dag_lib.DagExecution.SERIAL
-    if len(docs) > 1 and _is_header(docs[0]):
+    if len(docs) > 1 and _is_header(docs[0], docs[1:]):
         header = docs.pop(0)
         dag.name = header.get('name')
         exec_str = header.get('execution', 'serial')

@@ -114,3 +114,33 @@ def test_debug_dump_bundle(tmp_path, monkeypatch):
     finally:
         state.remove_cluster('dumped')
         config_lib.reload()
+
+
+def test_debug_dump_redacts_cluster_provider_secrets(tmp_path, monkeypatch):
+    """provider_config in cluster records carries ssh-pool passwords
+    (provision/ssh/instance.py); the dump walker must redact every
+    section, not just config."""
+    import json
+    import tarfile
+
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path))
+    from skypilot_tpu import core, state
+    from skypilot_tpu.utils import common as common_lib
+    state.add_or_update_cluster(
+        'poolc', common_lib.ClusterStatus.UP,
+        cluster_info={'cluster_name': 'poolc', 'provider': 'ssh',
+                      'provider_config': {'pool': 'p1',
+                                          'ssh_password': 'hunter2-live',
+                                          'ssh_user': 'ops'}})
+    try:
+        out = core.debug_dump(str(tmp_path / 'd.tar.gz'))
+        with tarfile.open(out) as tar:
+            raw = tar.extractfile('dump.json').read().decode()
+        assert 'hunter2-live' not in raw
+        d = json.loads(raw)
+        rec = [c for c in d['clusters'] if c['name'] == 'poolc'][0]
+        pc = rec['cluster_info']['provider_config']
+        assert pc['ssh_password'] == '<redacted>'
+        assert pc['ssh_user'] == 'ops'   # non-secret fields survive
+    finally:
+        state.remove_cluster('poolc')

@@ -91,3 +91,23 @@ def test_dag_context():
     with Dag('ctx') as dag:
         assert get_current_dag() is dag
     assert get_current_dag() is None
+
+
+def test_multidoc_all_header_like_docs_raise():
+    """A file where every document could be the header must raise instead
+    of silently swallowing the first 'task' (dag_utils._is_header)."""
+    import pytest
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.utils import dag_utils
+    with pytest.raises(exceptions.InvalidTaskError, match='Ambiguous'):
+        dag_utils.load_dag_from_yaml_str('name: a\n---\nname: b\n')
+
+
+def test_multidoc_name_only_header_with_real_tasks():
+    """The reference pipeline format: doc 0 carries only `name`, later
+    docs are recognizable tasks -> doc 0 is the header."""
+    from skypilot_tpu.utils import dag_utils
+    dag = dag_utils.load_dag_from_yaml_str(
+        'name: pipe\n---\nname: s1\nrun: echo 1\n---\nname: s2\nrun: echo 2\n')
+    assert dag.name == 'pipe'
+    assert [t.name for t in dag.tasks] == ['s1', 's2']
