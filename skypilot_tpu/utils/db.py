@@ -1,43 +1,246 @@
-"""Shared sqlite access: thread-local connections, WAL, schema bootstrap.
+"""Shared state-store access: sqlite by default, postgres by DSN.
 
 One copy of the pattern every state store uses (control-plane clusters DB,
 managed-jobs DB, serve DB, API request store — reference keeps these
 separate too: global_user_state / jobs/state / serve_state / requests).
-Connections are per-(path, thread); WAL gives multi-process safety with
-the per-cluster file locks providing read-modify-write discipline.
+
+Engine selection (reference global_user_state runs on SQLAlchemy with
+sqlite or postgres; here the same choice is made without the ORM):
+
+- default: per-store sqlite file. Connections are per-(path, thread);
+  WAL gives multi-process safety with the per-cluster file locks
+  providing read-modify-write discipline.
+- ``SKY_TPU_DB_URL=postgresql://user:pw@host/db`` (or config ``db.url``):
+  every store lands in that one database, each in its own pg *schema*
+  named after the store file (``state``, ``server_requests``, ...), so a
+  multi-user API server deployment gets transactional shared state.
+
+Store code is written once against the sqlite dialect; the postgres
+connection adapter translates statements (placeholders, AUTOINCREMENT,
+PRAGMA, INSERT OR REPLACE) at execute time. The translation layer is unit
+tested against a fake DBAPI driver — a real postgres needs psycopg2 or
+pg8000 on the server's PATH (not bundled).
 """
 from __future__ import annotations
 
 import os
+import re
 import sqlite3
 import threading
-from typing import Dict, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 _local = threading.local()
 _GLOBAL_LOCK = threading.Lock()
 
 
+_cfg_url_cache: List[Optional[str]] = []   # [] = not yet resolved
+
+
+def db_url() -> Optional[str]:
+    """The configured shared-database DSN, if any.
+
+    Called on every `.conn` access, so: env lookup (cheap, and lets tests
+    flip engines per-test) first; the config fallback is resolved once
+    per process.
+    """
+    url = os.environ.get('SKY_TPU_DB_URL')
+    if url:
+        return url
+    if not _cfg_url_cache:
+        try:
+            from skypilot_tpu import config as config_lib
+            _cfg_url_cache.append(config_lib.get_nested(('db', 'url')))
+        except Exception:  # noqa: BLE001 — config not importable yet:
+            return None    # retry next call rather than caching None
+    return _cfg_url_cache[0]
+
+
+def _is_postgres(url: Optional[str]) -> bool:
+    return bool(url) and url.split('://', 1)[0] in ('postgres',
+                                                    'postgresql')
+
+
+# --------------------------------------------------------------------------
+# sqlite-dialect → postgres translation
+# --------------------------------------------------------------------------
+def translate_schema(schema: str) -> List[str]:
+    """Translate a sqlite CREATE script into postgres statements."""
+    out = []
+    for stmt in schema.split(';'):
+        stmt = stmt.strip()
+        if not stmt or stmt.upper().startswith('PRAGMA'):
+            continue
+        stmt = re.sub(r'INTEGER\s+PRIMARY\s+KEY\s+AUTOINCREMENT',
+                      'BIGSERIAL PRIMARY KEY', stmt, flags=re.I)
+        stmt = re.sub(r'\bREAL\b', 'DOUBLE PRECISION', stmt, flags=re.I)
+        stmt = re.sub(r'\bBLOB\b', 'BYTEA', stmt, flags=re.I)
+        out.append(stmt)
+    return out
+
+
+def translate_sql(sql: str) -> str:
+    """Translate one sqlite-dialect statement for postgres."""
+    if re.search(r'INSERT\s+OR\s+REPLACE', sql, flags=re.I):
+        # No generic pg equivalent (needs a conflict target); store code
+        # must use explicit ON CONFLICT ... DO UPDATE, which both engines
+        # accept. Failing loud beats silently dropping replace semantics.
+        raise ValueError(
+            f'INSERT OR REPLACE is not portable to postgres; use '
+            f'ON CONFLICT DO UPDATE: {sql!r}')
+    if re.search(r'INSERT\s+OR\s+IGNORE\s+INTO', sql, flags=re.I):
+        # Atomic get-or-create relies on conflicts being swallowed
+        # (state.get_or_create_secret) — map to pg's equivalent.
+        sql = re.sub(r'INSERT\s+OR\s+IGNORE\s+INTO', 'INSERT INTO', sql,
+                     flags=re.I)
+        sql = sql.rstrip().rstrip(';') + ' ON CONFLICT DO NOTHING'
+    # `?` placeholders → `%s` (outside string literals; store SQL never
+    # embeds literal question marks in strings).
+    sql = sql.replace('?', '%s')
+    return sql
+
+
+class _DictRow(dict):
+    """Row usable as both mapping and by dict(row) (sqlite3.Row parity)."""
+
+    def keys(self):  # noqa: D102 — dict.keys already documented
+        return super().keys()
+
+
+class PostgresConnection:
+    """sqlite3.Connection-shaped adapter over a DBAPI pg connection."""
+
+    def __init__(self, raw, schema_name: str):
+        self._raw = raw
+        self.schema_name = schema_name
+
+    def execute(self, sql: str, params: Tuple = ()):
+        cur = self._raw.cursor()
+        cur.execute(translate_sql(sql), tuple(params))
+        return _PgCursor(cur)
+
+    def executemany(self, sql: str, seq_of_params):
+        cur = self._raw.cursor()
+        cur.executemany(translate_sql(sql),
+                        [tuple(p) for p in seq_of_params])
+        return _PgCursor(cur)
+
+    def executescript(self, script: str) -> None:
+        cur = self._raw.cursor()
+        for stmt in translate_schema(script):
+            cur.execute(stmt)
+        self._raw.commit()
+
+    def commit(self) -> None:
+        self._raw.commit()
+
+    def close(self) -> None:
+        self._raw.close()
+
+
+class _PgCursor:
+    def __init__(self, cur):
+        self._cur = cur
+
+    def _cols(self) -> List[str]:
+        return [d[0] for d in self._cur.description or []]
+
+    def fetchone(self) -> Optional[_DictRow]:
+        row = self._cur.fetchone()
+        if row is None:
+            return None
+        return _DictRow(zip(self._cols(), row))
+
+    def fetchall(self) -> List[_DictRow]:
+        cols = None
+        out = []
+        for row in self._cur.fetchall():
+            if cols is None:
+                cols = self._cols()
+            out.append(_DictRow(zip(cols, row)))
+        return out
+
+    @property
+    def lastrowid(self):
+        return getattr(self._cur, 'lastrowid', None)
+
+    @property
+    def rowcount(self):
+        return self._cur.rowcount
+
+
+def _connect_postgres(url: str):
+    """Import a driver and connect. Overridable in tests (fake driver)."""
+    try:
+        import psycopg2  # type: ignore
+        return psycopg2.connect(url)
+    except ImportError:
+        pass
+    try:
+        import pg8000.dbapi  # type: ignore
+    except ImportError as e:
+        raise RuntimeError(
+            f'SKY_TPU_DB_URL={url!r} needs a postgres driver; install '
+            f'psycopg2 or pg8000 on the API server host') from e
+    import urllib.parse as up
+    p = up.urlparse(url)
+    return pg8000.dbapi.connect(
+        user=p.username or 'postgres', password=p.password,
+        host=p.hostname or 'localhost', port=p.port or 5432,
+        database=(p.path or '/postgres').lstrip('/'))
+
+
+def _schema_name_for(path: str) -> str:
+    base = os.path.splitext(os.path.basename(path))[0]
+    return re.sub(r'[^a-z0-9_]', '_', base.lower()) or 'state'
+
+
 class Db:
-    """Thread-local sqlite connections to one database file."""
+    """Thread-local connections to one logical store.
+
+    `path` names the store: a sqlite file by default, or a pg schema
+    within the shared database when a postgres DSN is configured.
+    """
 
     def __init__(self, path: str, schema: str):
         self.path = path
         self.schema = schema
 
     @property
-    def conn(self) -> sqlite3.Connection:
-        cache: Dict[str, sqlite3.Connection] = getattr(
-            _local, 'conns', None) or {}
+    def conn(self):
+        # NOTE: `getattr(...) or {}` would drop the cache whenever the
+        # dict is empty (every call would open a new connection, and an
+        # INSERT's commit could land on a different connection).
         if not hasattr(_local, 'conns'):
-            _local.conns = cache
-        conn = cache.get(self.path)
+            _local.conns = {}
+        cache: Dict[str, Any] = _local.conns
+        url = db_url()
+        key = f'{url or "sqlite"}::{self.path}'
+        conn = cache.get(key)
         if conn is None:
-            os.makedirs(os.path.dirname(self.path), exist_ok=True)
-            conn = sqlite3.connect(self.path, timeout=30.0)
-            conn.execute('PRAGMA journal_mode=WAL')
-            conn.executescript(self.schema)
-            conn.row_factory = sqlite3.Row
-            cache[self.path] = conn
+            if _is_postgres(url):
+                conn = self._connect_pg(url)
+            else:
+                conn = self._connect_sqlite()
+            cache[key] = conn
+        return conn
+
+    def _connect_sqlite(self) -> sqlite3.Connection:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        conn = sqlite3.connect(self.path, timeout=30.0)
+        conn.execute('PRAGMA journal_mode=WAL')
+        conn.executescript(self.schema)
+        conn.row_factory = sqlite3.Row
+        return conn
+
+    def _connect_pg(self, url: str) -> PostgresConnection:
+        raw = _connect_postgres(url)
+        name = _schema_name_for(self.path)
+        conn = PostgresConnection(raw, name)
+        cur = raw.cursor()
+        cur.execute(f'CREATE SCHEMA IF NOT EXISTS {name}')
+        cur.execute(f'SET search_path TO {name}')
+        raw.commit()
+        conn.executescript(self.schema)
         return conn
 
 
