@@ -549,6 +549,58 @@ def api_status() -> None:
                f'(v{health["version"]}, api {health["api_version"]})')
 
 
+@api.command('login')
+@click.option('--timeout', type=float, default=300.0,
+              help='Seconds to wait for the browser authorization.')
+def api_login(timeout: float) -> None:
+    """Log in to a remote API server (PKCE browser flow).
+
+    Opens the server's /auth/authorize page; once the (SSO-
+    authenticated) browser confirms, the CLI receives a bearer token
+    and persists it for subsequent commands.
+    """
+    import secrets as pysecrets
+    import time as time_lib
+    import webbrowser
+
+    import requests as requests_lib
+
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.server.auth import sessions
+    url = sdk.server_url()
+    verifier = pysecrets.token_urlsafe(32)
+    challenge = sessions.compute_code_challenge(verifier)
+    authorize = f'{url}/auth/authorize?code_challenge={challenge}'
+    click.echo(f'Authorize this CLI in your browser:\n  {authorize}')
+    try:
+        webbrowser.open(authorize)
+    except Exception:  # noqa: BLE001 — headless host; URL printed above
+        pass
+    deadline = time_lib.time() + timeout
+    while time_lib.time() < deadline:
+        try:
+            r = requests_lib.post(f'{url}/auth/token',
+                                  json={'code_verifier': verifier},
+                                  timeout=10)
+        except requests_lib.RequestException as e:
+            raise click.ClickException(f'API server unreachable: {e}')
+        if r.status_code == 200:
+            token = r.json()['token']
+            token_path = os.path.join(
+                os.path.expanduser('~/.sky_tpu'), 'token')
+            os.makedirs(os.path.dirname(token_path), exist_ok=True)
+            fd = os.open(token_path, os.O_WRONLY | os.O_CREAT |
+                         os.O_TRUNC, 0o600)
+            with os.fdopen(fd, 'w') as f:
+                f.write(token)
+            click.echo(f'Logged in. Token saved to {token_path}; '
+                       f'export SKY_TPU_API_TOKEN=$(cat {token_path})')
+            return
+        time_lib.sleep(2.0)
+    raise click.ClickException('Login timed out (browser authorization '
+                               'never arrived).')
+
+
 def _remote() -> bool:
     """True when ops should go through the API server (its RBAC applies;
     acting on the local DB would mint tokens the server rejects)."""

@@ -1,0 +1,3 @@
+"""Server-side auth subsystem: oauth2-proxy delegation, loopback
+detection, and the PKCE session store backing the CLI login flow
+(counterpart of reference ``sky/server/auth/``)."""

@@ -1,0 +1,33 @@
+"""Loopback-request detection (reference sky/server/auth/loopback.py).
+
+A request from 127.0.0.1 with no proxy-forwarding headers is the local
+operator (single-user mode) and may act unauthenticated; anything that
+came through a proxy must authenticate even if the proxy itself dials
+from localhost.
+"""
+from __future__ import annotations
+
+import ipaddress
+
+from aiohttp import web
+
+COMMON_PROXY_HEADERS = (
+    'X-Forwarded-For', 'Forwarded', 'X-Real-IP', 'X-Client-IP',
+    'X-Forwarded-Host', 'X-Forwarded-Proto',
+)
+
+
+def _is_loopback_ip(ip_str: str) -> bool:
+    try:
+        return ipaddress.ip_address(ip_str).is_loopback
+    except ValueError:
+        return False
+
+
+def is_loopback_request(req: web.Request) -> bool:
+    host = req.remote
+    if host is None:
+        return False
+    if host == 'localhost' or _is_loopback_ip(host):
+        return not any(req.headers.get(h) for h in COMMON_PROXY_HEADERS)
+    return False

@@ -1,0 +1,123 @@
+"""oauth2-proxy delegation (reference sky/server/auth/oauth2_proxy.py).
+
+When ``api_server.oauth2_proxy.base_url`` (or env
+``SKY_TPU_OAUTH2_PROXY_BASE_URL``) is configured, browser requests are
+authenticated by an external oauth2-proxy deployment:
+
+- ``/oauth2/*`` paths are forwarded verbatim to the proxy (its
+  start/callback/sign-in endpoints).
+- Every other request is checked against the proxy's ``/oauth2/auth``
+  endpoint with the request's cookies; 202 means authenticated and the
+  user identity rides the ``X-Auth-Request-Email`` header.
+- Unauthenticated browser requests are redirected to
+  ``/oauth2/start?rd=<original-path>``; API clients get 401.
+
+The IdP side is fully external, so tests run a fake oauth2-proxy (a tiny
+aiohttp app speaking the same three endpoints) — the login flow is
+testable offline.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import urllib.parse
+from typing import Any, Dict, Optional
+
+import aiohttp
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+EMAIL_HEADER = 'X-Auth-Request-Email'
+BASE_URL_ENV = 'SKY_TPU_OAUTH2_PROXY_BASE_URL'
+# Paths that must answer without auth: health checks and the CLI login
+# poll (the CLI polls BEFORE it has a token, by construction).
+_EXEMPT_PATHS = ('/api/health', '/auth/token')
+
+
+def proxy_base_url() -> Optional[str]:
+    url = os.environ.get(BASE_URL_ENV)
+    if not url:
+        from skypilot_tpu import config as config_lib
+        url = config_lib.get_nested(('api_server', 'oauth2_proxy',
+                                     'base_url'))
+    return url.rstrip('/') if url else None
+
+
+def user_from_email(email: str) -> Dict[str, Any]:
+    """Stable user record for an SSO identity (same hash rule as the
+    local-user identity in users/core.py)."""
+    return {'id': hashlib.md5(email.encode()).hexdigest()[:8],
+            'name': email}
+
+
+class OAuth2ProxyAuthenticator:
+    """aiohttp-middleware half of the oauth2-proxy contract."""
+
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip('/')
+
+    async def forward(self, req: web.Request) -> web.Response:
+        """Proxy /oauth2/* through to oauth2-proxy (start/callback/...)."""
+        target = f'{self.base_url}{req.path}'
+        body = await req.read()
+        try:
+            async with aiohttp.ClientSession(cookies=req.cookies) as sess:
+                async with sess.request(
+                        req.method, target, headers=dict(req.headers),
+                        params=dict(req.query), data=body,
+                        allow_redirects=False,
+                        timeout=aiohttp.ClientTimeout(total=15)) as r:
+                    resp = web.Response(body=await r.read(),
+                                        status=r.status)
+                    for k, v in r.headers.items():
+                        if k.lower() in ('set-cookie', 'location',
+                                         'content-type'):
+                            resp.headers.add(k, v)
+                    return resp
+        except aiohttp.ClientError as e:
+            logger.error('oauth2-proxy unreachable: %s', e)
+            return web.json_response(
+                {'error': 'oauth2-proxy service unavailable'}, status=502)
+
+    async def authenticate(self, req: web.Request
+                           ) -> Optional[Dict[str, Any]]:
+        """Resolve the request's SSO identity, or raise an HTTP response.
+
+        Returns the user dict on success; None when the path is exempt.
+        Raises web.HTTPException (redirect or 401/502) otherwise.
+        """
+        if any(req.path.startswith(p) for p in _EXEMPT_PATHS):
+            return None
+        try:
+            async with aiohttp.ClientSession(cookies=req.cookies) as sess:
+                async with sess.get(
+                        f'{self.base_url}/oauth2/auth',
+                        headers={'X-Forwarded-Uri': str(req.url)},
+                        allow_redirects=False,
+                        timeout=aiohttp.ClientTimeout(total=10)) as r:
+                    if r.status == 202:
+                        email = r.headers.get(EMAIL_HEADER)
+                        if not email:
+                            raise web.HTTPInternalServerError(
+                                text='oauth2-proxy returned no user '
+                                     'identity; check the proxy setup')
+                        return user_from_email(email)
+                    if r.status == 401:
+                        accept = req.headers.get('Accept', '')
+                        if 'text/html' in accept:
+                            rd = urllib.parse.quote(
+                                req.path_qs or req.path)
+                            raise web.HTTPFound(
+                                f'/oauth2/start?rd={rd}')
+                        raise web.HTTPUnauthorized(
+                            text='{"error": "authentication required '
+                                 '(oauth2)"}',
+                            content_type='application/json')
+                    raise web.HTTPBadGateway(
+                        text=f'oauth2-proxy returned {r.status}')
+        except aiohttp.ClientError as e:
+            logger.error('oauth2-proxy unreachable: %s', e)
+            raise web.HTTPBadGateway(
+                text='oauth2-proxy service unavailable') from e

@@ -1,0 +1,82 @@
+"""PKCE session store for the CLI login flow (reference
+sky/server/auth/sessions.py).
+
+Flow: ``sky-tpu api login`` generates a random code_verifier, opens the
+browser at ``/auth/authorize?code_challenge=sha256(verifier)`` and polls
+``/auth/token`` with the verifier. The browser request is authenticated
+(oauth2-proxy/SSO); the server mints a bearer token for that user and
+parks it under the code_challenge. The poll computes the challenge from
+the verifier and atomically consumes the session — so the token transits
+only over the two TLS legs, never through the browser URL.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu.utils import common
+from skypilot_tpu.utils import db as db_util
+
+SESSION_TIMEOUT_S = 600.0
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS auth_sessions (
+    code_challenge TEXT PRIMARY KEY,
+    token TEXT NOT NULL,
+    created_at REAL NOT NULL
+);
+"""
+
+
+def compute_code_challenge(code_verifier: str) -> str:
+    digest = hashlib.sha256(code_verifier.encode()).digest()
+    return base64.urlsafe_b64encode(digest).decode().rstrip('=')
+
+
+class AuthSessionStore:
+    def __init__(self, db_path: Optional[str] = None):
+        self.db_path = db_path or os.path.join(common.base_dir(),
+                                               'auth_sessions.db')
+
+    @property
+    def _conn(self):
+        return db_util.get_db(self.db_path, _SCHEMA).conn
+
+    def _cleanup_expired(self) -> None:
+        self._conn.execute(
+            'DELETE FROM auth_sessions WHERE created_at < ?',
+            (time.time() - SESSION_TIMEOUT_S,))
+
+    def create_session(self, code_challenge: str, token: str) -> None:
+        """Park `token` under the challenge (idempotent re-authorize)."""
+        self._cleanup_expired()
+        self._conn.execute(
+            'INSERT INTO auth_sessions (code_challenge, token, created_at) '
+            'VALUES (?,?,?) ON CONFLICT(code_challenge) DO UPDATE SET '
+            'token=excluded.token, created_at=excluded.created_at',
+            (code_challenge, token, time.time()))
+        self._conn.commit()
+
+    def poll_session(self, code_verifier: str) -> Optional[str]:
+        """Atomically consume the session matching the verifier.
+
+        SELECT-then-DELETE with a rowcount check instead of
+        DELETE..RETURNING: older system sqlite (< 3.35, e.g. Ubuntu
+        20.04) lacks RETURNING, and the rowcount makes concurrent polls
+        single-winner anyway.
+        """
+        challenge = compute_code_challenge(code_verifier)
+        fresh = time.time() - SESSION_TIMEOUT_S
+        row = self._conn.execute(
+            'SELECT token FROM auth_sessions WHERE code_challenge=? AND '
+            'created_at > ?', (challenge, fresh)).fetchone()
+        if row is None:
+            return None
+        cur = self._conn.execute(
+            'DELETE FROM auth_sessions WHERE code_challenge=? AND '
+            'created_at > ?', (challenge, fresh))
+        self._conn.commit()
+        return row['token'] if cur.rowcount == 1 else None
