@@ -42,6 +42,12 @@ def _auth_headers() -> Dict[str, str]:
     headers = {'X-Sky-Tpu-Api-Version': str(CLIENT_API_VERSION)}
     token = (os.environ.get('SKY_TPU_API_TOKEN') or
              config_lib.get_nested(('api_server', 'token')))
+    if not token:
+        # `sky-tpu api login` persists its PKCE-minted token here.
+        token_path = os.path.expanduser('~/.sky_tpu/token')
+        if os.path.exists(token_path):
+            with open(token_path, encoding='utf-8') as f:
+                token = f.read().strip()
     if token:
         headers['Authorization'] = f'Bearer {token}'
     return headers
@@ -137,6 +143,23 @@ def stream_and_get(request_id: str, *, quiet: bool = False) -> Any:
             requests_lib.RequestException):
         pass   # reconnect via the poll below
     return get(request_id)
+
+
+def api_cancel(request_id: str) -> str:
+    """Cancel a queued/running API request; returns the final status.
+
+    Running requests execute in isolated worker processes server-side, so
+    cancellation kills the worker's whole process group."""
+    url = server_url()
+    try:
+        r = requests_lib.post(f'{url}/api/cancel/{request_id}',
+                              timeout=30, headers=_auth_headers())
+    except requests_lib.RequestException as e:
+        raise exceptions.ApiServerConnectionError(url) from e
+    if r.status_code == 404:
+        raise exceptions.SkyTpuError(f'unknown request {request_id}')
+    r.raise_for_status()
+    return r.json()['status']
 
 
 def api_health() -> Dict[str, Any]:
@@ -272,7 +295,13 @@ def launch(task: task_lib.Task, cluster_name: Optional[str] = None,
 
 def exec(task: task_lib.Task, cluster_name: str,  # noqa: A001
          **_kw) -> Tuple[int, ClusterInfo]:
-    rid = _post('exec', {'task': task.to_yaml_config(),
+    task_cfg = task.to_yaml_config()
+    if task.workdir:
+        # Same as launch(): the server syncs from ITS filesystem, so the
+        # client's workdir must be shipped up first — otherwise exec would
+        # silently rsync whatever happens to live at that path server-side.
+        task_cfg['workdir'] = _upload_workdir(task.workdir)
+    rid = _post('exec', {'task': task_cfg,
                          'cluster_name': cluster_name})
     result = get(rid)
     return result['job_id'], ClusterInfo.from_dict(result['cluster_info'])

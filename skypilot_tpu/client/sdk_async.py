@@ -1,0 +1,295 @@
+"""Async Python SDK over the REST API server (aiohttp).
+
+Counterpart of the reference's ``sky/client/sdk_async.py``: the same
+surface as the sync SDK (``client/sdk.py``) with every call awaitable and
+log tails exposed as async iterators — for agents, notebooks and servers
+that multiplex many control-plane calls on one event loop.
+
+Implementation notes: the wire protocol is identical to the sync SDK
+(POST op → request_id → poll ``/api/get``); URL/auth/compat logic is
+imported from the sync module so the two cannot drift. CPU-bound work
+(zipping a workdir for upload) runs in a thread via asyncio.to_thread.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+import aiohttp
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.client import sdk as _sync
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.utils import common
+
+server_url = _sync.server_url
+
+_POLL_S = 0.3
+
+
+def _headers() -> Dict[str, str]:
+    return _sync._auth_headers()  # noqa: SLF001 — shared by design
+
+
+async def _post_raw(op: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+    url = server_url()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(f'{url}/{op}', json=payload,
+                                 headers=_headers(),
+                                 timeout=aiohttp.ClientTimeout(
+                                     total=30)) as r:
+                if r.status in (400, 401, 403, 404, 426, 501):
+                    try:
+                        body = await r.json()
+                        detail = body.get('error', '')
+                    except (aiohttp.ContentTypeError,
+                            json.JSONDecodeError):
+                        detail = await r.text()
+                    raise exceptions.SkyTpuError(detail)
+                r.raise_for_status()
+                return await r.json()
+    except aiohttp.ClientError as e:
+        raise exceptions.ApiServerConnectionError(url) from e
+
+
+async def _post(op: str, payload: Dict[str, Any]) -> str:
+    return (await _post_raw(op, payload))['request_id']
+
+
+async def call(op: str, payload: Optional[Dict[str, Any]] = None) -> Any:
+    """POST an op and await its result (sync ops answer inline)."""
+    resp = await _post_raw(op, payload or {})
+    if 'result' in resp:
+        return resp['result']
+    return await get(resp['request_id'])
+
+
+async def get(request_id: str) -> Any:
+    """Await a request's result (server-side async request pattern)."""
+    url = server_url()
+    while True:
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(f'{url}/api/get/{request_id}',
+                                    headers=_headers(),
+                                    timeout=aiohttp.ClientTimeout(
+                                        total=30)) as r:
+                    r.raise_for_status()
+                    body = await r.json()
+        except aiohttp.ClientError as e:
+            raise exceptions.ApiServerConnectionError(url) from e
+        status = body['status']
+        if status == 'SUCCEEDED':
+            return body['result']
+        if status in ('FAILED', 'CANCELLED'):
+            raise exceptions.SkyTpuError(
+                body.get('error') or f'request {request_id} {status}')
+        await asyncio.sleep(_POLL_S)
+
+
+async def stream_and_get(request_id: str, *, quiet: bool = True) -> Any:
+    """Stream the request's server log, then return its result. A dropped
+    stream is non-fatal (the request keeps running server-side)."""
+    url = server_url()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(f'{url}/api/stream/{request_id}',
+                                headers=_headers(),
+                                timeout=aiohttp.ClientTimeout(
+                                    total=None)) as r:
+                async for chunk in r.content.iter_any():
+                    if not quiet and chunk:
+                        import sys
+                        sys.stdout.buffer.write(chunk)
+                        sys.stdout.buffer.flush()
+    except aiohttp.ClientError:
+        pass   # fall back to polling
+    return await get(request_id)
+
+
+async def api_health() -> Dict[str, Any]:
+    url = server_url()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(f'{url}/api/health',
+                                timeout=aiohttp.ClientTimeout(
+                                    total=5)) as r:
+                r.raise_for_status()
+                return await r.json()
+    except aiohttp.ClientError as e:
+        raise exceptions.ApiServerConnectionError(url) from e
+
+
+async def api_cancel(request_id: str) -> str:
+    url = server_url()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(f'{url}/api/cancel/{request_id}',
+                                 headers=_headers(),
+                                 timeout=aiohttp.ClientTimeout(
+                                     total=30)) as r:
+                if r.status == 404:
+                    raise exceptions.SkyTpuError(
+                        f'unknown request {request_id}')
+                r.raise_for_status()
+                return (await r.json())['status']
+    except aiohttp.ClientError as e:
+        raise exceptions.ApiServerConnectionError(url) from e
+
+
+# ---- cluster ops ---------------------------------------------------------
+async def launch(task: task_lib.Task,
+                 cluster_name: Optional[str] = None,
+                 *, quiet: bool = True,
+                 **_kw) -> Tuple[int, ClusterInfo]:
+    task_cfg = task.to_yaml_config()
+    if task.workdir:
+        # Zip+upload is blocking (file IO + requests); keep the loop free.
+        task_cfg['workdir'] = await asyncio.to_thread(
+            _sync._upload_workdir, task.workdir)  # noqa: SLF001
+    rid = await _post('launch', {'task': task_cfg,
+                                 'cluster_name': cluster_name})
+    result = await stream_and_get(rid, quiet=quiet)
+    return result['job_id'], ClusterInfo.from_dict(result['cluster_info'])
+
+
+async def exec(task: task_lib.Task, cluster_name: str,  # noqa: A001
+               **_kw) -> Tuple[int, ClusterInfo]:
+    task_cfg = task.to_yaml_config()
+    if task.workdir:
+        task_cfg['workdir'] = await asyncio.to_thread(
+            _sync._upload_workdir, task.workdir)  # noqa: SLF001
+    rid = await _post('exec', {'task': task_cfg,
+                               'cluster_name': cluster_name})
+    result = await get(rid)
+    return result['job_id'], ClusterInfo.from_dict(result['cluster_info'])
+
+
+async def status(cluster_names: Optional[List[str]] = None,
+                 refresh: bool = False,
+                 all_workspaces: bool = False) -> List[Dict[str, Any]]:
+    rid = await _post('status', {'cluster_names': cluster_names,
+                                 'refresh': refresh,
+                                 'all_workspaces': all_workspaces})
+    records = await get(rid)
+    for r in records:
+        r['status'] = common.ClusterStatus(r['status'])
+    return records
+
+
+async def down(cluster_name: str) -> None:
+    await get(await _post('down', {'cluster_name': cluster_name}))
+
+
+async def stop(cluster_name: str) -> None:
+    await get(await _post('stop', {'cluster_name': cluster_name}))
+
+
+async def start(cluster_name: str) -> None:
+    await get(await _post('start', {'cluster_name': cluster_name}))
+
+
+async def autostop(cluster_name: str, idle_minutes: int,
+                   down_: bool = False) -> None:
+    await get(await _post('autostop', {'cluster_name': cluster_name,
+                                       'idle_minutes': idle_minutes,
+                                       'down': down_}))
+
+
+async def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    return await get(await _post('queue', {'cluster_name': cluster_name}))
+
+
+async def cancel(cluster_name: str, job_id: int) -> None:
+    await get(await _post('cancel', {'cluster_name': cluster_name,
+                                     'job_id': job_id}))
+
+
+async def job_status(cluster_name: str, job_id: int) -> common.JobStatus:
+    return common.JobStatus(await get(await _post('job_status', {
+        'cluster_name': cluster_name, 'job_id': job_id})))
+
+
+async def wait_job(cluster_name: str, job_id: int,
+                   timeout: float = 3600.0) -> common.JobStatus:
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        st = await job_status(cluster_name, job_id)
+        if st.is_terminal():
+            return st
+        await asyncio.sleep(0.5)
+    raise TimeoutError(f'job {job_id} still running after {timeout}s')
+
+
+async def tail_logs(cluster_name: str, job_id: int, *,
+                    follow: bool = True,
+                    rank: int = 0) -> AsyncIterator[bytes]:
+    url = server_url()
+    follow_q = '1' if follow else '0'
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(
+                    f'{url}/logs/{cluster_name}/{job_id}'
+                    f'?follow={follow_q}&rank={rank}',
+                    headers=_headers(),
+                    timeout=aiohttp.ClientTimeout(total=None)) as r:
+                if r.status != 200:
+                    detail = (await r.json()).get('error', '')
+                    raise exceptions.SkyTpuError(
+                        f'log tail failed: {detail}')
+                async for chunk in r.content.iter_any():
+                    yield chunk
+    except aiohttp.ClientError as e:
+        raise exceptions.ApiServerConnectionError(url) from e
+
+
+async def check(clouds: Optional[List[str]] = None) -> Dict[str, bool]:
+    return await get(await _post('check', {'clouds': clouds}))
+
+
+async def cost_report() -> List[Dict[str, Any]]:
+    return await get(await _post('cost_report', {}))
+
+
+# ---- managed jobs --------------------------------------------------------
+async def jobs_launch(task: task_lib.Task,
+                      name: Optional[str] = None) -> int:
+    return await get(await _post('jobs.launch',
+                                 {'task': task.to_yaml_config(),
+                                  'name': name}))
+
+
+async def jobs_queue() -> List[Dict[str, Any]]:
+    return await get(await _post('jobs.queue', {}))
+
+
+async def jobs_cancel(job_id: int) -> bool:
+    return await get(await _post('jobs.cancel', {'job_id': job_id}))
+
+
+# ---- serve ---------------------------------------------------------------
+async def serve_up(task: task_lib.Task,
+                   service_name: Optional[str] = None) -> Dict[str, Any]:
+    return await get(await _post('serve.up',
+                                 {'task': task.to_yaml_config(),
+                                  'service_name': service_name}))
+
+
+async def serve_update(task: task_lib.Task, service_name: str) -> int:
+    return await get(await _post('serve.update',
+                                 {'task': task.to_yaml_config(),
+                                  'service_name': service_name}))
+
+
+async def serve_down(service_name: str) -> None:
+    await get(await _post('serve.down', {'service_name': service_name}))
+
+
+async def serve_status(service_name: Optional[str] = None
+                       ) -> List[Dict[str, Any]]:
+    return await get(await _post('serve.status',
+                                 {'service_name': service_name}))

@@ -162,3 +162,11 @@ class VolumeError(SkyTpuError):
 
 class VolumeNotFoundError(VolumeError):
     """Unknown volume name."""
+
+
+class UnknownOpError(SkyTpuError):
+    """API request named an op that does not exist (HTTP 404)."""
+
+
+class OpUnavailableError(SkyTpuError):
+    """API op exists but its subsystem is not importable (HTTP 501)."""

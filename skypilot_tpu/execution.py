@@ -17,7 +17,7 @@ from __future__ import annotations
 import enum
 import logging
 import uuid
-from typing import List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import admin_policy as admin_policy_lib
 from skypilot_tpu import backend as backend_lib
@@ -83,6 +83,7 @@ def launch(
     stages: Optional[List[Stage]] = None,
     quiet: bool = True,
     blocked_placements: Optional[List[Tuple[str, str]]] = None,
+    caller: Optional[Dict[str, Any]] = None,
 ) -> Tuple[int, ClusterInfo]:
     """Provision (or reuse) a cluster and run the task on it.
 
@@ -91,12 +92,16 @@ def launch(
     task = admin_policy_lib.apply(task)
     # Private-workspace gate (reference workspaces/core.py:659
     # reject_request_for_unauthorized_workspace): the active workspace
-    # must admit the launching identity. Server-side, the HTTP layer has
-    # already authenticated the caller; here the local identity applies.
+    # must admit the launching identity. In API-server mode the worker
+    # runs as the server's OS user, so the HTTP layer passes the
+    # authenticated caller through `caller`; the local OS identity
+    # applies only for direct/library use (caller=None).
     from skypilot_tpu import users as users_lib
     from skypilot_tpu import workspaces as workspaces_lib
+    identity = (caller if caller is not None
+                else users_lib.core.ensure_user())
     workspaces_lib.check_workspace_permission(
-        users_lib.core.ensure_user(), workspaces_lib.active_workspace())
+        identity, workspaces_lib.active_workspace())
     cluster_name = cluster_name or _generate_cluster_name()
     backend = backend or backend_lib.TpuVmBackend()
     run_stages = stages or [
@@ -259,9 +264,20 @@ def exec(  # noqa: A001 — mirrors the reference's public name
     *,
     backend: Optional[backend_lib.Backend] = None,
     detach_run: bool = True,
+    caller: Optional[Dict[str, Any]] = None,
 ) -> Tuple[int, ClusterInfo]:
     """Run a task on an existing cluster, skipping provision/setup
     (reference sky/execution.py:825)."""
+    # Private-workspace gate: running commands on a cluster is entering
+    # the workspace the cluster was LAUNCHED in (its record carries it) —
+    # not whatever workspace happens to be active in this process.
+    from skypilot_tpu import users as users_lib
+    from skypilot_tpu import workspaces as workspaces_lib
+    record_ws = state.get_cluster(cluster_name)
+    workspaces_lib.check_workspace_permission(
+        caller if caller is not None else users_lib.core.ensure_user(),
+        (record_ws.get('workspace') if record_ws else None) or
+        workspaces_lib.active_workspace())
     backend = backend or backend_lib.TpuVmBackend()
     with locks.cluster_lock(cluster_name):
         record = state.get_cluster(cluster_name)

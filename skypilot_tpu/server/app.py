@@ -9,9 +9,12 @@ is a private detail behind ``client/sdk.py``.
 
 Two executor lanes (reference's long/short queues,
 sky/server/requests/executor.py:1-20): LONG ops (launch/down/start/stop)
-and SHORT ops (status/queue/...) run on separate thread pools so a slow
-provision never starves a status call. Ops are IO-bound (cloud APIs, agent
-HTTP), so threads — not processes — are the right worker model here.
+each run in an ISOLATED WORKER SUBPROCESS (server/worker.py — reference
+RequestWorker, executor.py:169), so a crashing/OOMing launch cannot take
+the control plane down and can be cancelled by killing its process group.
+SHORT ops (status/queue/...) are quick IO-bound reads and run on an
+in-process thread pool — a slow provision never starves a status call
+because the lanes never share a worker.
 
 Run: ``sky-tpu api start`` (spawns ``python -m skypilot_tpu.server.app``).
 """
@@ -30,14 +33,14 @@ import threading
 import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 from aiohttp import web
 
 from skypilot_tpu import core
 from skypilot_tpu import exceptions
-from skypilot_tpu import task as task_lib
 from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.server import ops as ops_lib
 from skypilot_tpu.server.requests_store import RequestStatus, RequestStore
 from skypilot_tpu.utils import common
 
@@ -51,12 +54,11 @@ API_VERSION_HEADER = 'X-Sky-Tpu-Api-Version'
 
 logger = logging.getLogger(__name__)
 
-LONG_OPS = {'launch', 'exec', 'down', 'stop', 'start', 'jobs.launch',
-            'serve.up', 'serve.down', 'serve.update'}
-# Ops answered inline, never persisted to the requests store — their
-# results are secrets (a cleartext token in the store would be readable
-# via /api/get by anyone, defeating the store-only-hashes design).
-SYNC_OPS = {'users.token_create'}
+LONG_OPS = ops_lib.LONG_OPS
+SYNC_OPS = ops_lib.SYNC_OPS
+# Concurrent long-request worker subprocesses (reference's long-queue
+# parallelism); excess requests stay PENDING until a slot frees.
+MAX_LONG_WORKERS = 4
 
 
 class _ThreadRoutedWriter(io.TextIOBase):
@@ -92,8 +94,6 @@ class Server:
     def __init__(self) -> None:
         self.store = RequestStore()
         self.store.interrupted_to_failed()
-        self.long_pool = ThreadPoolExecutor(max_workers=4,
-                                            thread_name_prefix='long')
         self.short_pool = ThreadPoolExecutor(max_workers=8,
                                              thread_name_prefix='short')
         # Log tails can pin a worker for a job's entire runtime — they get
@@ -104,12 +104,22 @@ class Server:
         self._stderr_router = _ThreadRoutedWriter(sys.stderr)
         sys.stdout = self._stdout_router
         sys.stderr = self._stderr_router
+        # Long-request worker subprocesses: request_id -> Process. The
+        # semaphore is created lazily (needs the running event loop).
+        self._workers: Dict[str, asyncio.subprocess.Process] = {}
+        self._long_sem: Optional[asyncio.Semaphore] = None
+        # SSO: oauth2-proxy delegation when configured (server/auth).
+        from skypilot_tpu.server.auth import oauth2_proxy as o2_lib
+        base = o2_lib.proxy_base_url()
+        self.oauth2 = (o2_lib.OAuth2ProxyAuthenticator(base)
+                       if base else None)
 
     # ---- request execution ---------------------------------------------
     def _run_request(self, request_id: str, fn: Callable[[], Any]) -> None:
         req = self.store.get(request_id)
         log_path = req['log_path']
-        self.store.set_status(request_id, RequestStatus.RUNNING)
+        if not self.store.try_start(request_id):
+            return   # cancelled before a thread picked it up
         metrics_lib.inflight(+1)
         t0 = time.monotonic()
         status = 'succeeded'
@@ -122,13 +132,13 @@ class Server:
                 finally:
                     self._stdout_router.unregister()
                     self._stderr_router.unregister()
-            self.store.set_status(request_id, RequestStatus.SUCCEEDED,
-                                  result=result)
+            self.store.finish(request_id, RequestStatus.SUCCEEDED,
+                              result=result)
         except Exception as e:  # noqa: BLE001 — errors go to the client
             status = 'failed'
             with open(log_path, 'a', encoding='utf-8') as logf:
                 traceback.print_exc(file=logf)
-            self.store.set_status(
+            self.store.finish(
                 request_id, RequestStatus.FAILED,
                 error=f'{type(e).__name__}: {e}')
         finally:
@@ -136,189 +146,51 @@ class Server:
             metrics_lib.observe_request(req['name'], status,
                                         time.monotonic() - t0)
 
-    def submit(self, name: str, payload: Dict[str, Any],
-               fn: Callable[[], Any]) -> str:
-        request_id = self.store.create(name, payload)
-        pool = self.long_pool if name in LONG_OPS else self.short_pool
-        pool.submit(self._run_request, request_id, fn)
-        return request_id
-
-    # ---- op payload -> engine call --------------------------------------
-    @staticmethod
-    def _task_from_payload(payload: Dict[str, Any]) -> task_lib.Task:
-        return task_lib.Task.from_yaml_config(payload['task'])
-
-    def _dispatch(self, name: str, payload: Dict[str, Any]
-                  ) -> Callable[[], Any]:
-        if name in ('launch', 'exec') and 'task' not in payload:
-            raise KeyError("'task'")
-        if name == 'launch':
-            def fn():
-                job_id, info = core.launch(
-                    self._task_from_payload(payload),
-                    cluster_name=payload.get('cluster_name'),
-                    quiet=False)
-                return {'job_id': job_id, 'cluster_info': info.to_dict()}
-            return fn
-        if name == 'exec':
-            def fn():
-                job_id, info = core.exec(
-                    self._task_from_payload(payload),
-                    payload['cluster_name'])
-                return {'job_id': job_id, 'cluster_info': info.to_dict()}
-            return fn
-        if name == 'status':
-            def fn():
-                out = []
-                for r in core.status(payload.get('cluster_names'),
-                                     refresh=payload.get('refresh', False),
-                                     all_workspaces=payload.get(
-                                         'all_workspaces', False)):
-                    r = dict(r)
-                    r['status'] = r['status'].value
-                    out.append(r)
-                return out
-            return fn
-        if name in ('down', 'stop', 'start'):
-            return functools.partial(getattr(core, name),
-                                     payload['cluster_name'])
-        if name == 'autostop':
-            return functools.partial(core.autostop, payload['cluster_name'],
-                                     payload['idle_minutes'],
-                                     payload.get('down', False))
-        if name == 'queue':
-            return functools.partial(core.queue, payload['cluster_name'])
-        if name == 'cancel':
-            return functools.partial(core.cancel, payload['cluster_name'],
-                                     payload['job_id'])
-        if name == 'job_status':
-            return lambda: core.job_status(payload['cluster_name'],
-                                           payload['job_id']).value
-        if name == 'check':
-            return functools.partial(core.check, payload.get('clouds'))
-        if name == 'cost_report':
-            return core.cost_report
-        if name == 'accelerators':
-            from skypilot_tpu import catalog
-            return functools.partial(catalog.list_accelerators,
-                                     name_filter=payload.get('filter'))
-        if name == 'debug_dump':
-            # Reference /debug/dump_create: bundle server-side state;
-            # the client fetches it via /api/dump_download/<name>.
-            return functools.partial(core.debug_dump, None,
-                                     payload.get('include_logs', True))
-        if name.startswith('volumes.'):
-            return self._dispatch_volumes(name, payload)
-        if name.startswith('pools.'):
-            return self._dispatch_pools(name, payload)
-        if name.startswith('users.'):
-            return self._dispatch_users(name, payload)
-        if name.startswith('workspaces.'):
-            return self._dispatch_workspaces(name, payload)
-        if name.startswith('jobs.') or name.startswith('serve.'):
+    async def _run_long_request(self, request_id: str) -> None:
+        """Supervise one worker subprocess (reference RequestWorker,
+        executor.py:169): spawn, await exit, fail the row if the worker
+        died without writing a terminal status (segfault / kill -9)."""
+        if self._long_sem is None:
+            self._long_sem = asyncio.Semaphore(MAX_LONG_WORKERS)
+        async with self._long_sem:
+            req = self.store.get(request_id)
+            if req is None or req['status'] != RequestStatus.PENDING:
+                return   # cancelled while queued
+            metrics_lib.inflight(+1)
+            t0 = time.monotonic()
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, '-m', 'skypilot_tpu.server.worker',
+                request_id,
+                stdout=asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.DEVNULL,
+                start_new_session=True,
+            )
+            self._workers[request_id] = proc
             try:
-                if name.startswith('jobs.'):
-                    from skypilot_tpu import jobs as jobs_lib
-                    return self._dispatch_jobs(name, payload, jobs_lib)
-                from skypilot_tpu import serve as serve_lib
-                return self._dispatch_serve(name, payload, serve_lib)
-            except (ImportError, AttributeError) as e:
-                raise web.HTTPNotImplemented(
-                    text=f'op {name} not available: {e}') from e
-        raise web.HTTPNotFound(text=f'unknown op {name}')
+                rc = await proc.wait()
+            finally:
+                self._workers.pop(request_id, None)
+                metrics_lib.inflight(-1)
+            status = 'succeeded' if rc == 0 else 'failed'
+            # Worker died before writing a result (crash, OOM-kill)?
+            # Atomic: a concurrent CANCELLED/SUCCEEDED write wins.
+            if self.store.fail_if_not_terminal(
+                    request_id,
+                    f'worker process died (rc={rc}) before completing '
+                    f'the request'):
+                status = 'failed'
+            metrics_lib.observe_request(req['name'], status,
+                                        time.monotonic() - t0)
 
-    def _dispatch_pools(self, name, payload):
-        from skypilot_tpu.ssh_node_pools import SSHNodePoolManager
-        mgr = SSHNodePoolManager()
-        if name == 'pools.list':
-            return mgr.get_all_pools
-        if name == 'pools.apply':
-            return functools.partial(mgr.update_pools, payload['pools'])
-        if name == 'pools.delete':
-            return functools.partial(mgr.delete_pool, payload['name'])
-        raise web.HTTPNotFound(text=f'unknown op {name}')
-
-    def _dispatch_volumes(self, name, payload):
-        from skypilot_tpu import volumes as volumes_lib
-        if name == 'volumes.apply':
-            return functools.partial(volumes_lib.volume_apply,
-                                     payload['spec'])
-        if name == 'volumes.list':
-            return volumes_lib.volume_list
-        if name == 'volumes.delete':
-            return functools.partial(volumes_lib.volume_delete,
-                                     payload['names'])
-        if name == 'volumes.refresh':
-            return volumes_lib.volume_refresh
-        raise web.HTTPNotFound(text=f'unknown op {name}')
-
-    def _dispatch_users(self, name, payload):
-        from skypilot_tpu import users as users_lib
-        if name == 'users.list':
-            return users_lib.list_users
-        if name == 'users.role':
-            return functools.partial(users_lib.update_role,
-                                     payload['user_id'], payload['role'])
-        if name == 'users.delete':
-            return functools.partial(users_lib.delete_user,
-                                     payload['user_id'])
-        if name == 'users.token_create':
-            return functools.partial(
-                users_lib.create_token, payload['name'],
-                payload.get('user_id'), payload.get('expires_in_s'),
-                caller=payload.get('_caller'))
-        if name == 'users.token_list':
-            return functools.partial(users_lib.list_tokens,
-                                     payload.get('user_id'))
-        if name == 'users.token_revoke':
-            return functools.partial(users_lib.revoke_token,
-                                     payload['token_id'])
-        raise web.HTTPNotFound(text=f'unknown op {name}')
-
-    def _dispatch_workspaces(self, name, payload):
-        from skypilot_tpu import workspaces as ws_lib
-        if name == 'workspaces.list':
-            return ws_lib.get_workspaces
-        if name == 'workspaces.create':
-            return functools.partial(ws_lib.create_workspace,
-                                     payload['name'],
-                                     payload.get('config'))
-        if name == 'workspaces.update':
-            return functools.partial(ws_lib.update_workspace,
-                                     payload['name'],
-                                     payload.get('config') or {})
-        if name == 'workspaces.delete':
-            return functools.partial(ws_lib.delete_workspace,
-                                     payload['name'])
-        raise web.HTTPNotFound(text=f'unknown op {name}')
-
-    def _dispatch_jobs(self, name, payload, jobs_lib):
-        if name == 'jobs.launch':
-            return functools.partial(
-                jobs_lib.launch, self._task_from_payload(payload),
-                name=payload.get('name'))
-        if name == 'jobs.queue':
-            return jobs_lib.queue
-        if name == 'jobs.cancel':
-            return functools.partial(jobs_lib.cancel, payload['job_id'])
-        raise web.HTTPNotFound(text=f'unknown op {name}')
-
-    def _dispatch_serve(self, name, payload, serve_lib):
-        if name == 'serve.up':
-            return functools.partial(
-                serve_lib.up, self._task_from_payload(payload),
-                service_name=payload.get('service_name'))
-        if name == 'serve.down':
-            return functools.partial(serve_lib.down,
-                                     payload['service_name'])
-        if name == 'serve.status':
-            return functools.partial(serve_lib.status,
-                                     payload.get('service_name'))
-        if name == 'serve.update':
-            return functools.partial(
-                serve_lib.update, self._task_from_payload(payload),
-                payload['service_name'])
-        raise web.HTTPNotFound(text=f'unknown op {name}')
+    def submit(self, name: str, payload: Dict[str, Any],
+               fn: Optional[Callable[[], Any]]) -> str:
+        request_id = self.store.create(name, payload)
+        if name in LONG_OPS:
+            asyncio.get_event_loop().create_task(
+                self._run_long_request(request_id))
+        else:
+            self.short_pool.submit(self._run_request, request_id, fn)
+        return request_id
 
     # ---- HTTP handlers ---------------------------------------------------
     async def h_op(self, req: web.Request) -> web.Response:
@@ -328,16 +200,26 @@ class Server:
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
             return web.json_response(
                 {'error': f'malformed JSON body: {e}'}, status=400)
-        if name in SYNC_OPS:
-            # The caller's resolved identity gates self-service ops; an
-            # anonymous loopback caller acts as the default role.
-            from skypilot_tpu.users import rbac
-            payload['_caller'] = req.get('user') or {
-                'id': None, 'role': rbac.get_default_role()}
+        # The caller's resolved identity gates self-service ops AND the
+        # private-workspace check in execution.launch: launch workers run
+        # as the server's OS user, so without this every remote caller
+        # would inherit the server's (usually admin) identity. An
+        # anonymous loopback caller acts as the default role.
+        from skypilot_tpu.users import rbac
+        payload['_caller'] = req.get('user') or {
+            'id': None, 'role': rbac.get_default_role()}
         try:
-            fn = self._dispatch(name, payload)
-        except web.HTTPException:
-            raise
+            # LONG ops re-dispatch inside their worker subprocess; this
+            # call validates the op/payload up front so a bad request
+            # fails at submit time, not minutes later in a worker.
+            fn = ops_lib.dispatch(name, payload)
+        except exceptions.UnknownOpError as e:
+            return web.json_response({'error': str(e)}, status=404)
+        except exceptions.OpUnavailableError as e:
+            return web.json_response({'error': str(e)}, status=501)
+        except exceptions.PermissionDeniedError as e:
+            return web.json_response(
+                {'error': f'PermissionDeniedError: {e}'}, status=403)
         except KeyError as e:
             return web.json_response(
                 {'error': f'missing field {e}'}, status=400)
@@ -364,6 +246,43 @@ class Server:
             'result': r['result'],
             'error': r['error'],
         })
+
+    async def h_cancel_request(self, req: web.Request) -> web.Response:
+        """Cancel a queued/running request (reference request
+        cancellation: the worker process is killed as a group so the
+        in-flight engine call and its subprocesses die with it)."""
+        import signal
+        request_id = req.match_info['request_id']
+        r = self.store.get(request_id)
+        if r is None:
+            return web.json_response({'error': 'unknown request'},
+                                     status=404)
+        if r['status'].is_terminal():
+            return web.json_response({'request_id': request_id,
+                                      'status': r['status'].value})
+        if r['name'] not in LONG_OPS:
+            # Short ops run on in-process threads with no interruption
+            # path; claiming CANCELLED while the op executes anyway would
+            # make /api/cancel and /api/get disagree.
+            return web.json_response(
+                {'error': f'op {r["name"]!r} is not cancellable '
+                          f'(short ops run to completion)'}, status=409)
+        # Atomic mark-then-kill: a request that finished in the meantime
+        # keeps its terminal state; a PENDING one flips before its worker
+        # spawns (both worker and supervisor CAS on PENDING).
+        if not self.store.cancel_if_not_terminal(request_id):
+            r = self.store.get(request_id)
+            return web.json_response({'request_id': request_id,
+                                      'status': r['status'].value})
+        proc = self._workers.get(request_id)
+        pid = proc.pid if proc is not None else r.get('pid')
+        if pid:
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+        return web.json_response({'request_id': request_id,
+                                  'status': RequestStatus.CANCELLED.value})
 
     async def h_stream(self, req: web.Request) -> web.StreamResponse:
         """Tail a request's log until it finishes (reference
@@ -407,6 +326,17 @@ class Server:
         """Proxy a cluster job's logs through the server."""
         cluster = req.match_info['cluster']
         job_id = int(req.match_info['job_id'])  # route-constrained \\d+
+        # Logs expose job output: same cluster-workspace gate as exec.
+        from skypilot_tpu.users import rbac
+        caller = req.get('user') or {'id': None,
+                                     'role': rbac.get_default_role()}
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                self.short_pool, ops_lib.check_cluster_access, caller,
+                cluster)
+        except exceptions.PermissionDeniedError as e:
+            return web.json_response(
+                {'error': f'PermissionDeniedError: {e}'}, status=403)
         follow = req.query.get('follow', '1') == '1'
         try:
             rank = int(req.query.get('rank', 0))
@@ -601,10 +531,14 @@ class Server:
         from skypilot_tpu import config as config_lib
         from skypilot_tpu import users as users_lib
         from skypilot_tpu.users import rbac
-        if req.path in ('/api/health', '/metrics', '/', '/dashboard'):
+        if (req.path in ('/api/health', '/metrics', '/', '/dashboard',
+                         '/auth/token') or
+                req.path.startswith('/oauth2/')):
             # The dashboard page itself must load without a bearer header
             # (browsers can't attach one to the initial GET); every API
             # call it makes is still individually authenticated.
+            # /auth/token is the CLI login poll (no token yet, by
+            # construction) and /oauth2/* IS the login flow.
             return await handler(req)
         # API-version gate: a client that declares an incompatible
         # version gets a clear 426 instead of silent wire mismatches
@@ -637,6 +571,22 @@ class Server:
             if user is None:
                 return web.json_response(
                     {'error': 'invalid or revoked token'}, status=401)
+        elif server.oauth2 is not None:
+            # SSO via oauth2-proxy (reference oauth2_proxy middleware):
+            # the external proxy authenticates browser cookies; loopback
+            # requests (the local operator) bypass.
+            from skypilot_tpu.server.auth import loopback as loopback_lib
+            from skypilot_tpu.server.auth import oauth2_proxy as o2_lib
+            if not loopback_lib.is_loopback_request(req):
+                try:
+                    sso = await server.oauth2.authenticate(req)
+                except web.HTTPException as resp:
+                    return resp
+                if sso is not None:
+                    user = await loop.run_in_executor(
+                        server.short_pool,
+                        functools.partial(users_lib.core.ensure_user,
+                                          sso['id'], sso['name']))
         elif config_lib.get_nested(('api_server', 'require_auth'), False):
             return web.json_response(
                 {'error': 'authentication required '
@@ -648,6 +598,69 @@ class Server:
                           f'{req.path}'}, status=403)
         req['user'] = user
         return await handler(req)
+
+    # ---- CLI login (PKCE session flow, reference auth/sessions.py) ------
+    async def h_oauth2_forward(self, req: web.Request) -> web.Response:
+        if self.oauth2 is None:
+            return web.json_response({'error': 'oauth2 not configured'},
+                                     status=404)
+        return await self.oauth2.forward(req)
+
+    async def h_auth_authorize(self, req: web.Request) -> web.Response:
+        """Browser half of `sky-tpu api login`: the (authenticated)
+        browser request mints a bearer token for the user and parks it
+        under the code_challenge for the CLI to collect."""
+        challenge = req.query.get('code_challenge')
+        if not challenge:
+            return web.json_response({'error': 'missing code_challenge'},
+                                     status=400)
+        user = req.get('user')
+        if user is None:
+            from skypilot_tpu.server.auth import loopback as loopback_lib
+            if not loopback_lib.is_loopback_request(req):
+                return web.json_response(
+                    {'error': 'authenticate first (SSO or bearer token) '
+                              'to authorize a CLI login'}, status=401)
+            from skypilot_tpu import users as users_lib
+            user = await asyncio.get_event_loop().run_in_executor(
+                self.short_pool, users_lib.core.ensure_user)
+
+        def mint_and_park():
+            from skypilot_tpu import users as users_lib
+            from skypilot_tpu.server.auth import sessions
+            token = users_lib.core.create_token(
+                'cli-login', user_id=user['id'],
+                expires_in_s=30 * 24 * 3600.0)
+            sessions.AuthSessionStore().create_session(challenge, token)
+
+        await asyncio.get_event_loop().run_in_executor(
+            self.short_pool, mint_and_park)
+        return web.Response(
+            text='<html><body><h2>Login complete.</h2>'
+                 '<p>Return to your terminal — the CLI picks the token '
+                 'up automatically.</p></body></html>',
+            content_type='text/html')
+
+    async def h_auth_token(self, req: web.Request) -> web.Response:
+        """CLI half: poll with the code_verifier until the browser
+        authorizes. Unauthenticated by design (the CLI has no token yet);
+        possession of the verifier IS the proof."""
+        try:
+            body = await req.json()
+        except json.JSONDecodeError:
+            return web.json_response({'error': 'malformed body'},
+                                     status=400)
+        verifier = body.get('code_verifier', '')
+        if not verifier:
+            return web.json_response({'error': 'missing code_verifier'},
+                                     status=400)
+        from skypilot_tpu.server.auth import sessions
+        token = await asyncio.get_event_loop().run_in_executor(
+            self.short_pool,
+            sessions.AuthSessionStore().poll_session, verifier)
+        if token is None:
+            return web.json_response({'status': 'pending'}, status=202)
+        return web.json_response({'status': 'ok', 'token': token})
 
     def make_app(self) -> web.Application:
         # 64 MiB cap for the JSON op routes (task configs embed whole
@@ -663,12 +676,18 @@ class Server:
         app.router.add_get('/metrics', self.h_metrics)
         app.router.add_get('/api/requests', self.h_requests)
         app.router.add_get('/api/get/{request_id}', self.h_get)
+        app.router.add_post('/api/cancel/{request_id}',
+                            self.h_cancel_request)
         app.router.add_get('/api/stream/{request_id}', self.h_stream)
         app.router.add_get(r'/logs/{cluster}/{job_id:\d+}',
                            self.h_job_logs)
         app.router.add_get('/api/dump_download/{filename}',
                            self.h_dump_download)
         app.router.add_post('/api/upload', self.h_upload)
+        app.router.add_route('*', '/oauth2/{tail:.*}',
+                             self.h_oauth2_forward)
+        app.router.add_get('/auth/authorize', self.h_auth_authorize)
+        app.router.add_post('/auth/token', self.h_auth_token)
         app.router.add_post('/{op:[a-z_.]+}', self.h_op)
         return app
 

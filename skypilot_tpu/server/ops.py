@@ -1,0 +1,262 @@
+"""Op-name → engine-call dispatch, shared by the API server and its
+worker subprocesses.
+
+Counterpart of the reference's request registry
+(sky/server/requests/payloads.py + executor.py): every API op is a pure
+function of its JSON payload, so a worker process can re-create the exact
+call from the persisted request row — the property that makes
+process-isolated execution (and crash recovery) possible.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+
+# Ops that run in an isolated worker subprocess (reference's long-request
+# queue, executor.py:1-20): they provision/mutate clusters and can run for
+# minutes — or crash — without taking the control plane down.
+LONG_OPS = {'launch', 'exec', 'down', 'stop', 'start', 'jobs.launch',
+            'serve.up', 'serve.down', 'serve.update'}
+# Ops answered inline, never persisted to the requests store — their
+# results are secrets (a cleartext token in the store would be readable
+# via /api/get by anyone, defeating the store-only-hashes design).
+SYNC_OPS = {'users.token_create'}
+# Ops that CREATE resources in the active workspace: the authenticated
+# caller (not the server's OS user, which the workers run as) must pass
+# the private-workspace gate (reference workspaces/core.py:659).
+WORKSPACE_GATED = {'launch', 'jobs.launch', 'serve.up', 'serve.update'}
+# Ops that act on an EXISTING cluster: the gate must judge the caller
+# against the workspace the cluster was LAUNCHED in (clusters carry a
+# workspace column) — the server's active workspace says nothing about
+# the target's privacy.
+CLUSTER_GATED = {'exec', 'down', 'stop', 'start', 'autostop', 'cancel',
+                 'queue', 'job_status'}
+
+
+def _check_workspace_access(payload: Dict[str, Any]) -> None:
+    caller = payload.get('_caller')
+    if caller is None:
+        # Direct/library use: the engine-level gates judge the local OS
+        # identity instead.
+        return
+    from skypilot_tpu import workspaces
+    workspaces.check_workspace_permission(
+        caller, workspaces.active_workspace())
+
+
+def check_cluster_access(caller: Optional[Dict[str, Any]],
+                         cluster_name: Optional[str]) -> None:
+    """Gate an op on an existing cluster by ITS workspace (not the
+    server's active one). Unknown clusters pass — the engine raises
+    ClusterDoesNotExist with identical observable behavior either way."""
+    if caller is None or not cluster_name:
+        return
+    from skypilot_tpu import state
+    from skypilot_tpu import workspaces
+    rec = state.get_cluster(cluster_name)
+    if rec is None:
+        return
+    workspaces.check_workspace_permission(
+        caller, rec.get('workspace') or 'default')
+
+
+def _task_from_payload(payload: Dict[str, Any]) -> task_lib.Task:
+    return task_lib.Task.from_yaml_config(payload['task'])
+
+
+def dispatch(name: str, payload: Dict[str, Any]) -> Callable[[], Any]:
+    """Build the zero-arg engine call for op `name`.
+
+    Raises UnknownOpError for unroutable names, OpUnavailableError when a
+    subsystem is missing, KeyError for missing payload fields.
+    """
+    if name in ('launch', 'exec') and 'task' not in payload:
+        raise KeyError("'task'")
+    if name in WORKSPACE_GATED:
+        # Raises PermissionDeniedError BEFORE a request row / worker is
+        # created — launch carries the caller through to the engine gate
+        # too, but jobs/serve must not bypass the check just because
+        # their engine paths run as the server's (admin) OS user.
+        _check_workspace_access(payload)
+    if name in CLUSTER_GATED:
+        check_cluster_access(payload.get('_caller'),
+                             payload.get('cluster_name'))
+    if name == 'launch':
+        def fn():
+            job_id, info = core.launch(
+                _task_from_payload(payload),
+                cluster_name=payload.get('cluster_name'),
+                quiet=False,
+                caller=payload.get('_caller'))
+            return {'job_id': job_id, 'cluster_info': info.to_dict()}
+        return fn
+    if name == 'exec':
+        def fn():
+            job_id, info = core.exec(
+                _task_from_payload(payload),
+                payload['cluster_name'],
+                caller=payload.get('_caller'))
+            return {'job_id': job_id, 'cluster_info': info.to_dict()}
+        return fn
+    if name == 'status':
+        def fn():
+            out = []
+            for r in core.status(payload.get('cluster_names'),
+                                 refresh=payload.get('refresh', False),
+                                 all_workspaces=payload.get(
+                                     'all_workspaces', False)):
+                r = dict(r)
+                r['status'] = r['status'].value
+                out.append(r)
+            return out
+        return fn
+    if name in ('down', 'stop', 'start'):
+        return functools.partial(getattr(core, name),
+                                 payload['cluster_name'])
+    if name == 'autostop':
+        return functools.partial(core.autostop, payload['cluster_name'],
+                                 payload['idle_minutes'],
+                                 payload.get('down', False))
+    if name == 'queue':
+        return functools.partial(core.queue, payload['cluster_name'])
+    if name == 'cancel':
+        return functools.partial(core.cancel, payload['cluster_name'],
+                                 payload['job_id'])
+    if name == 'job_status':
+        return lambda: core.job_status(payload['cluster_name'],
+                                       payload['job_id']).value
+    if name == 'check':
+        return functools.partial(core.check, payload.get('clouds'))
+    if name == 'cost_report':
+        return core.cost_report
+    if name == 'accelerators':
+        from skypilot_tpu import catalog
+        return functools.partial(catalog.list_accelerators,
+                                 name_filter=payload.get('filter'))
+    if name == 'debug_dump':
+        # Reference /debug/dump_create: bundle server-side state;
+        # the client fetches it via /api/dump_download/<name>.
+        return functools.partial(core.debug_dump, None,
+                                 payload.get('include_logs', True))
+    if name.startswith('volumes.'):
+        return _dispatch_volumes(name, payload)
+    if name.startswith('pools.'):
+        return _dispatch_pools(name, payload)
+    if name.startswith('users.'):
+        return _dispatch_users(name, payload)
+    if name.startswith('workspaces.'):
+        return _dispatch_workspaces(name, payload)
+    if name.startswith('jobs.') or name.startswith('serve.'):
+        try:
+            if name.startswith('jobs.'):
+                from skypilot_tpu import jobs as jobs_lib
+                return _dispatch_jobs(name, payload, jobs_lib)
+            from skypilot_tpu import serve as serve_lib
+            return _dispatch_serve(name, payload, serve_lib)
+        except (ImportError, AttributeError) as e:
+            raise exceptions.OpUnavailableError(
+                f'op {name} not available: {e}') from e
+    raise exceptions.UnknownOpError(f'unknown op {name}')
+
+
+def _dispatch_volumes(name, payload):
+    from skypilot_tpu import volumes as volumes_lib
+    if name == 'volumes.apply':
+        return functools.partial(volumes_lib.volume_apply,
+                                 payload['spec'])
+    if name == 'volumes.list':
+        return volumes_lib.volume_list
+    if name == 'volumes.delete':
+        return functools.partial(volumes_lib.volume_delete,
+                                 payload['names'])
+    if name == 'volumes.refresh':
+        return volumes_lib.volume_refresh
+    raise exceptions.UnknownOpError(f'unknown op {name}')
+
+
+def _dispatch_pools(name, payload):
+    from skypilot_tpu.ssh_node_pools import SSHNodePoolManager
+    mgr = SSHNodePoolManager()
+    if name == 'pools.list':
+        return mgr.get_all_pools
+    if name == 'pools.apply':
+        return functools.partial(mgr.update_pools, payload['pools'])
+    if name == 'pools.delete':
+        return functools.partial(mgr.delete_pool, payload['name'])
+    raise exceptions.UnknownOpError(f'unknown op {name}')
+
+
+def _dispatch_users(name, payload):
+    from skypilot_tpu import users as users_lib
+    if name == 'users.list':
+        return users_lib.list_users
+    if name == 'users.role':
+        return functools.partial(users_lib.update_role,
+                                 payload['user_id'], payload['role'])
+    if name == 'users.delete':
+        return functools.partial(users_lib.delete_user,
+                                 payload['user_id'])
+    if name == 'users.token_create':
+        return functools.partial(
+            users_lib.create_token, payload['name'],
+            payload.get('user_id'), payload.get('expires_in_s'),
+            caller=payload.get('_caller'))
+    if name == 'users.token_list':
+        return functools.partial(users_lib.list_tokens,
+                                 payload.get('user_id'))
+    if name == 'users.token_revoke':
+        return functools.partial(users_lib.revoke_token,
+                                 payload['token_id'])
+    raise exceptions.UnknownOpError(f'unknown op {name}')
+
+
+def _dispatch_workspaces(name, payload):
+    from skypilot_tpu import workspaces as ws_lib
+    if name == 'workspaces.list':
+        return ws_lib.get_workspaces
+    if name == 'workspaces.create':
+        return functools.partial(ws_lib.create_workspace,
+                                 payload['name'],
+                                 payload.get('config'))
+    if name == 'workspaces.update':
+        return functools.partial(ws_lib.update_workspace,
+                                 payload['name'],
+                                 payload.get('config') or {})
+    if name == 'workspaces.delete':
+        return functools.partial(ws_lib.delete_workspace,
+                                 payload['name'])
+    raise exceptions.UnknownOpError(f'unknown op {name}')
+
+
+def _dispatch_jobs(name, payload, jobs_lib):
+    if name == 'jobs.launch':
+        return functools.partial(
+            jobs_lib.launch, _task_from_payload(payload),
+            name=payload.get('name'))
+    if name == 'jobs.queue':
+        return jobs_lib.queue
+    if name == 'jobs.cancel':
+        return functools.partial(jobs_lib.cancel, payload['job_id'])
+    raise exceptions.UnknownOpError(f'unknown op {name}')
+
+
+def _dispatch_serve(name, payload, serve_lib):
+    if name == 'serve.up':
+        return functools.partial(
+            serve_lib.up, _task_from_payload(payload),
+            service_name=payload.get('service_name'))
+    if name == 'serve.down':
+        return functools.partial(serve_lib.down,
+                                 payload['service_name'])
+    if name == 'serve.status':
+        return functools.partial(serve_lib.status,
+                                 payload.get('service_name'))
+    if name == 'serve.update':
+        return functools.partial(
+            serve_lib.update, _task_from_payload(payload),
+            payload['service_name'])
+    raise exceptions.UnknownOpError(f'unknown op {name}')

@@ -103,9 +103,101 @@ class RequestStore:
             (limit,)).fetchall()
         return [dict(r) for r in rows]
 
+    def try_start(self, request_id: str) -> bool:
+        """PENDING → RUNNING compare-and-swap.
+
+        A cancel can land between a worker's read and its RUNNING write;
+        the CAS makes the loser visible: returns False when the row is no
+        longer PENDING (cancelled/raced) and the caller must not run it.
+        """
+        cur = self._conn.execute(
+            'UPDATE requests SET status=? WHERE request_id=? AND status=?',
+            (RequestStatus.RUNNING.value, request_id,
+             RequestStatus.PENDING.value))
+        self._conn.commit()
+        return cur.rowcount == 1
+
+    def finish(self, request_id: str, status: RequestStatus,
+               *, result: Any = None, error: Optional[str] = None) -> bool:
+        """RUNNING → terminal transition; refuses to overwrite a terminal
+        row (a cancel that already marked CANCELLED must stick even if
+        the worker finishes before the kill signal lands)."""
+        assert status.is_terminal(), status
+        cols: Dict[str, Any] = {'status': status.value,
+                                'finished_at': time.time()}
+        if result is not None:
+            cols['result_json'] = json.dumps(result)
+        if error is not None:
+            cols['error'] = error
+        sets = ', '.join(f'{k}=?' for k in cols)
+        cur = self._conn.execute(
+            f'UPDATE requests SET {sets} WHERE request_id=? AND status=?',
+            (*cols.values(), request_id, RequestStatus.RUNNING.value))
+        self._conn.commit()
+        return cur.rowcount == 1
+
+    def cancel_if_not_terminal(self, request_id: str) -> bool:
+        """Atomically cancel a PENDING/RUNNING row; False if the request
+        already reached a terminal state (that state wins)."""
+        cur = self._conn.execute(
+            'UPDATE requests SET status=?, error=?, finished_at=? '
+            'WHERE request_id=? AND status IN (?,?)',
+            (RequestStatus.CANCELLED.value, 'cancelled by user',
+             time.time(), request_id,
+             RequestStatus.PENDING.value, RequestStatus.RUNNING.value))
+        self._conn.commit()
+        return cur.rowcount == 1
+
+    def fail_if_not_terminal(self, request_id: str, error: str) -> bool:
+        """Atomically fail a PENDING/RUNNING row (supervisor reconciling a
+        dead worker); a concurrent CANCELLED/SUCCEEDED write wins."""
+        cur = self._conn.execute(
+            'UPDATE requests SET status=?, error=?, finished_at=? '
+            'WHERE request_id=? AND status IN (?,?)',
+            (RequestStatus.FAILED.value, error, time.time(), request_id,
+             RequestStatus.PENDING.value, RequestStatus.RUNNING.value))
+        self._conn.commit()
+        return cur.rowcount == 1
+
+    def set_pid(self, request_id: str, pid: Optional[int]) -> None:
+        self._conn.execute(
+            'UPDATE requests SET pid=? WHERE request_id=?',
+            (pid, request_id))
+        self._conn.commit()
+
     def interrupted_to_failed(self) -> None:
-        """On server restart: RUNNING requests from a dead server are
-        failed (their worker thread is gone)."""
+        """On server restart: reconcile non-terminal rows.
+
+        Short/in-process requests died with the server. Long requests ran
+        in worker subprocesses that may have outlived it — those orphans
+        are killed (their client lost the request id's context anyway and
+        a half-supervised launch must not mutate clusters unobserved),
+        then every non-terminal row is failed (reference executor
+        reconciliation on restart).
+        """
+        import signal
+        rows = self._conn.execute(
+            'SELECT request_id, pid FROM requests WHERE status IN (?,?)',
+            (RequestStatus.RUNNING.value,
+             RequestStatus.PENDING.value)).fetchall()
+        for row in rows:
+            pid = row['pid']
+            if not pid or pid <= 0:
+                continue
+            # Persisted pids can be recycled by unrelated processes
+            # (server down for days / host reboot): only kill a pid that
+            # is verifiably still OUR worker.
+            try:
+                with open(f'/proc/{pid}/cmdline', 'rb') as f:
+                    cmdline = f.read()
+            except OSError:
+                continue
+            if b'skypilot_tpu.server.worker' not in cmdline:
+                continue
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
         self._conn.execute(
             'UPDATE requests SET status=?, error=? WHERE status IN (?,?)',
             (RequestStatus.FAILED.value, 'server restarted mid-request',

@@ -1,0 +1,73 @@
+"""Request worker: one isolated subprocess per long-running API request.
+
+Counterpart of the reference's per-request worker processes
+(sky/server/requests/executor.py:113 RequestQueue, :169 RequestWorker).
+The server spawns ``python -m skypilot_tpu.server.worker <request_id>``
+for every LONG op; the worker re-creates the engine call from the
+persisted request row (server/ops.dispatch), so a segfault, OOM-kill or
+``kill -9`` of one launch cannot take the control plane down — the server
+merely observes the exit and fails the row.
+
+stdout/stderr go straight to the request's log file (the same file
+``/api/stream`` tails), so client-visible progress is identical to the
+old in-process path.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+
+def run_request(request_id: str) -> int:
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.server import ops
+    from skypilot_tpu.server.requests_store import (RequestStatus,
+                                                    RequestStore)
+    store = RequestStore()
+    req = store.get(request_id)
+    if req is None:
+        print(f'worker: unknown request {request_id}', file=sys.stderr)
+        return 2
+    # PENDING -> RUNNING is a CAS: a cancel landing between a plain read
+    # and write would be silently overwritten and the request would run
+    # to completion despite the client being told CANCELLED.
+    if not store.try_start(request_id):
+        return 0
+    log_path = req['log_path']
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    logf = open(log_path, 'a', buffering=1, encoding='utf-8')
+    # Redirect at the fd level so subprocesses (provisioners, agents)
+    # inherit the request log too.
+    os.dup2(logf.fileno(), sys.stdout.fileno())
+    os.dup2(logf.fileno(), sys.stderr.fileno())
+    store.set_pid(request_id, os.getpid())
+    try:
+        fn = ops.dispatch(req['name'], req['payload'])
+        result = fn()
+        json.dumps(result)   # fail HERE if unserializable, not in the row
+        store.finish(request_id, RequestStatus.SUCCEEDED, result=result)
+        return 0
+    except exceptions.SkyTpuError as e:
+        traceback.print_exc()
+        store.finish(request_id, RequestStatus.FAILED,
+                     error=f'{type(e).__name__}: {e}')
+        return 1
+    except BaseException as e:  # noqa: BLE001 — row must not stay RUNNING
+        traceback.print_exc()
+        store.finish(request_id, RequestStatus.FAILED,
+                     error=f'{type(e).__name__}: {e}')
+        return 1
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('request_id')
+    args = parser.parse_args()
+    sys.exit(run_request(args.request_id))
+
+
+if __name__ == '__main__':
+    main()

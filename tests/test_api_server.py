@@ -275,3 +275,33 @@ def test_upload_rejects_zip_slip(api_server):
     assert r.status_code == 400
     assert 'unsafe' in r.json()['error'] or 'bad upload' in \
         r.json()['error']
+
+
+def test_exec_uploads_client_workdir(api_server, tmp_path):
+    """exec() must ship the client workdir like launch() does — otherwise
+    the server rsyncs ITS filesystem at the client's local path (wrong
+    files, or failure)."""
+    from skypilot_tpu import Resources, Task
+    from skypilot_tpu.client import sdk
+    wd1 = tmp_path / 'v1'
+    wd1.mkdir()
+    (wd1 / 'data.txt').write_text('VERSION_ONE')
+    task = Task('x-t', run='cat data.txt', workdir=str(wd1),
+                resources=Resources(cloud='local', accelerators='v5e-4'))
+    job_id, _ = sdk.launch(task, cluster_name='x-c', quiet=True)
+    try:
+        assert sdk.wait_job('x-c', job_id, timeout=60).value == 'SUCCEEDED'
+        # Second run via exec with an UPDATED client workdir; the job must
+        # see the new content, proving the client copy was shipped.
+        wd2 = tmp_path / 'v2'
+        wd2.mkdir()
+        (wd2 / 'data.txt').write_text('VERSION_TWO')
+        task2 = Task('x-t2', run='cat data.txt', workdir=str(wd2),
+                     resources=Resources(cloud='local',
+                                         accelerators='v5e-4'))
+        job2, _ = sdk.exec(task2, 'x-c')
+        assert sdk.wait_job('x-c', job2, timeout=60).value == 'SUCCEEDED'
+        log = b''.join(sdk.tail_logs('x-c', job2, follow=False))
+        assert b'VERSION_TWO' in log
+    finally:
+        sdk.down('x-c')

@@ -1,0 +1,76 @@
+"""Async SDK e2e: launch -> logs -> queue -> exec -> down, fully async
+(reference sky/client/sdk_async.py surface)."""
+import asyncio
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu.utils import common
+
+
+def test_async_sdk_full_lifecycle(api_server):
+    from skypilot_tpu.client import sdk_async
+
+    async def flow():
+        health = await sdk_async.api_health()
+        assert health['status'] == 'healthy'
+
+        task = sky.Task('a-e2e',
+                        run='echo ASYNC rank=$SKY_TPU_NODE_RANK',
+                        resources=sky.Resources(cloud='local',
+                                                accelerators='v5e-4'))
+        job_id, info = await sdk_async.launch(task, cluster_name='a-c')
+        assert job_id == 1 and info.cluster_name == 'a-c'
+        st = await sdk_async.wait_job('a-c', job_id, timeout=60)
+        assert st == common.JobStatus.SUCCEEDED
+
+        chunks = []
+        async for chunk in sdk_async.tail_logs('a-c', job_id,
+                                               follow=False):
+            chunks.append(chunk)
+        assert b'ASYNC' in b''.join(chunks)
+
+        records = await sdk_async.status()
+        assert records[0]['name'] == 'a-c'
+        assert records[0]['status'] == common.ClusterStatus.UP
+        q = await sdk_async.queue('a-c')
+        assert len(q) == 1
+
+        job2, _ = await sdk_async.exec(
+            sky.Task('a2', run='echo SECOND'), 'a-c')
+        assert await sdk_async.wait_job('a-c', job2, timeout=60) == \
+            common.JobStatus.SUCCEEDED
+
+        await sdk_async.down('a-c')
+        assert await sdk_async.status() == []
+
+    asyncio.run(flow())
+
+
+def test_async_sdk_concurrent_short_ops(api_server):
+    """The point of async: N control-plane calls multiplexed on one loop."""
+    from skypilot_tpu.client import sdk_async
+
+    async def flow():
+        results = await asyncio.gather(
+            sdk_async.status(), sdk_async.cost_report(),
+            sdk_async.check(None), sdk_async.api_health())
+        assert results[0] == []
+        assert isinstance(results[1], list)
+        assert results[3]['status'] == 'healthy'
+
+    asyncio.run(flow())
+
+
+def test_async_sdk_error_propagation(api_server):
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.client import sdk_async
+
+    async def flow():
+        with pytest.raises(exceptions.SkyTpuError) as ei:
+            await sdk_async.down('no-such-cluster')
+        assert 'does not exist' in str(ei.value)
+        with pytest.raises(exceptions.SkyTpuError):
+            await sdk_async.call('definitely_not_an_op')
+
+    asyncio.run(flow())

@@ -1,0 +1,165 @@
+"""OAuth2/session auth, offline: PKCE session store semantics and the
+oauth2-proxy middleware driven against a FAKE oauth2-proxy (reference
+sky/server/auth/{oauth2_proxy,sessions,loopback}.py)."""
+import asyncio
+import secrets
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from skypilot_tpu.server.auth import loopback
+from skypilot_tpu.server.auth import oauth2_proxy as o2
+from skypilot_tpu.server.auth import sessions
+
+
+def test_session_store_pkce_roundtrip(tmp_path):
+    store = sessions.AuthSessionStore(str(tmp_path / 's.db'))
+    verifier = secrets.token_urlsafe(32)
+    challenge = sessions.compute_code_challenge(verifier)
+    store.create_session(challenge, 'sky_tok_abc')
+    # Wrong verifier consumes nothing.
+    assert store.poll_session('wrong-verifier') is None
+    # Right verifier gets the token exactly once (atomic consume).
+    assert store.poll_session(verifier) == 'sky_tok_abc'
+    assert store.poll_session(verifier) is None
+
+
+def test_session_store_expiry(tmp_path, monkeypatch):
+    store = sessions.AuthSessionStore(str(tmp_path / 's.db'))
+    verifier = secrets.token_urlsafe(32)
+    store.create_session(sessions.compute_code_challenge(verifier), 't')
+    monkeypatch.setattr(sessions, 'SESSION_TIMEOUT_S', -1.0)
+    assert store.poll_session(verifier) is None
+
+
+def test_loopback_detection():
+    class FakeReq:
+        def __init__(self, remote, headers=None):
+            self.remote = remote
+            self.headers = headers or {}
+    assert loopback.is_loopback_request(FakeReq('127.0.0.1'))
+    assert loopback.is_loopback_request(FakeReq('::1'))
+    assert not loopback.is_loopback_request(FakeReq('10.0.0.5'))
+    # Proxied traffic from localhost is NOT loopback.
+    assert not loopback.is_loopback_request(
+        FakeReq('127.0.0.1', {'X-Forwarded-For': '8.8.8.8'}))
+
+
+@pytest.fixture
+def fake_idp_app():
+    """A fake oauth2-proxy: /oauth2/auth answers 202 for the magic
+    cookie, 401 otherwise; /oauth2/start sets the cookie and redirects."""
+
+    async def auth(req):
+        if req.cookies.get('_oauth2_proxy') == 'good':
+            return web.Response(
+                status=202, headers={o2.EMAIL_HEADER: 'alice@example.com'})
+        return web.Response(status=401)
+
+    async def start(req):
+        rd = req.query.get('rd', '/')
+        resp = web.Response(status=302, headers={'Location': rd})
+        resp.set_cookie('_oauth2_proxy', 'good')
+        return resp
+
+    app = web.Application()
+    app.router.add_get('/oauth2/auth', auth)
+    app.router.add_get('/oauth2/start', start)
+    return app
+
+
+def test_oauth2_authenticate_against_fake_idp(fake_idp_app):
+    async def flow():
+        server = TestServer(fake_idp_app)
+        await server.start_server()
+        base = f'http://{server.host}:{server.port}'
+        auth = o2.OAuth2ProxyAuthenticator(base)
+
+        class FakeReq:
+            path = '/status'
+            path_qs = '/status'
+            url = 'http://sky/status'
+            headers = {'Accept': 'application/json'}
+
+            def __init__(self, cookies):
+                self.cookies = cookies
+
+        # Authenticated cookie -> SSO identity resolved from the header.
+        user = await auth.authenticate(FakeReq({'_oauth2_proxy': 'good'}))
+        assert user['name'] == 'alice@example.com'
+        assert user['id'] == o2.user_from_email('alice@example.com')['id']
+
+        # No cookie + API client -> 401 (no redirect).
+        with pytest.raises(web.HTTPUnauthorized):
+            await auth.authenticate(FakeReq({}))
+
+        # No cookie + browser -> redirect into the proxy's start flow.
+        class BrowserReq(FakeReq):
+            headers = {'Accept': 'text/html,application/xhtml+xml'}
+        with pytest.raises(web.HTTPFound) as ei:
+            await auth.authenticate(BrowserReq({}))
+        assert '/oauth2/start?rd=' in str(ei.value.location)
+
+        # Exempt paths bypass (health checks, CLI token poll).
+        class HealthReq(FakeReq):
+            path = '/api/health'
+        assert await auth.authenticate(HealthReq({})) is None
+
+        await server.close()
+
+    asyncio.run(flow())
+
+
+def test_oauth2_proxy_down_is_502(fake_idp_app):
+    async def flow():
+        auth = o2.OAuth2ProxyAuthenticator('http://127.0.0.1:1')
+
+        class FakeReq:
+            path = '/status'
+            path_qs = '/status'
+            url = 'http://sky/status'
+            headers = {'Accept': 'application/json'}
+            cookies = {}
+
+        with pytest.raises(web.HTTPBadGateway):
+            await auth.authenticate(FakeReq())
+
+    asyncio.run(flow())
+
+
+def test_login_flow_against_live_server(api_server, tmp_path):
+    """Full PKCE login against a real server process: authorize (as the
+    loopback operator) -> poll -> use the minted token."""
+    import requests
+    import secrets as pysecrets
+
+    verifier = pysecrets.token_urlsafe(32)
+    challenge = sessions.compute_code_challenge(verifier)
+    # Poll before authorize: pending.
+    r = requests.post(f'{api_server}/auth/token',
+                      json={'code_verifier': verifier}, timeout=10)
+    assert r.status_code == 202
+    # Browser authorize (loopback operator → allowed without SSO).
+    r = requests.get(f'{api_server}/auth/authorize'
+                     f'?code_challenge={challenge}', timeout=10)
+    assert r.status_code == 200 and 'Login complete' in r.text
+    # Poll now yields a working bearer token, exactly once.
+    r = requests.post(f'{api_server}/auth/token',
+                      json={'code_verifier': verifier}, timeout=10)
+    assert r.status_code == 200
+    token = r.json()['token']
+    assert token.startswith('sky_')
+    r2 = requests.post(f'{api_server}/auth/token',
+                       json={'code_verifier': verifier}, timeout=10)
+    assert r2.status_code == 202            # consumed
+    # The token authenticates API calls.
+    r = requests.post(f'{api_server}/status', json={},
+                      headers={'Authorization': f'Bearer {token}'},
+                      timeout=10)
+    assert r.status_code == 200
+    # A garbage token is rejected.
+    r = requests.post(f'{api_server}/status', json={},
+                      headers={'Authorization': 'Bearer sky_bad_x_y'},
+                      timeout=10)
+    assert r.status_code == 401

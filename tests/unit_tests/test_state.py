@@ -59,3 +59,29 @@ def test_config_layering(monkeypatch, tmp_path):
             assert config.get_nested(('jobs', 'extra')) == 1
     assert config.get_nested(('jobs', 'max_retries')) == 3
     config.reload()
+
+
+def test_request_store_cas_transitions(tmp_path, monkeypatch):
+    """PENDING->RUNNING and RUNNING->terminal are CAS: a cancel can never
+    be overwritten by a racing worker (code-review regression)."""
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path))
+    from skypilot_tpu.server.requests_store import (RequestStatus,
+                                                    RequestStore)
+    store = RequestStore()
+    rid = store.create('launch', {})
+    # Cancel between the worker's read and its RUNNING write:
+    assert store.cancel_if_not_terminal(rid)
+    assert not store.try_start(rid)          # worker loses the CAS
+    assert store.get(rid)['status'] == RequestStatus.CANCELLED
+    # Worker finishing after a cancel must not flip CANCELLED->SUCCEEDED.
+    rid2 = store.create('launch', {})
+    assert store.try_start(rid2)
+    assert store.cancel_if_not_terminal(rid2)
+    assert not store.finish(rid2, RequestStatus.SUCCEEDED, result={})
+    assert store.get(rid2)['status'] == RequestStatus.CANCELLED
+    # Supervisor reconcile respects terminal rows.
+    assert not store.fail_if_not_terminal(rid2, 'worker died')
+    rid3 = store.create('launch', {})
+    assert store.try_start(rid3)
+    assert store.fail_if_not_terminal(rid3, 'worker died')
+    assert store.get(rid3)['status'] == RequestStatus.FAILED

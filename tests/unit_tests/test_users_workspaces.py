@@ -260,3 +260,100 @@ def test_concurrent_workspace_creates_both_survive():
     config.reload()
     got = set(workspaces.get_workspaces())
     assert {f'ws-{i}' for i in range(6)} <= got
+
+
+def test_launch_enforces_remote_caller_identity(monkeypatch):
+    """API-server mode: launch workers run as the server's OS user, so the
+    private-workspace gate must judge the authenticated HTTP caller passed
+    via `caller=`, not the process identity (which is typically admin)."""
+    from skypilot_tpu import execution
+    import skypilot_tpu as sky
+    me = users.core.ensure_user()
+    users.update_role(me['id'], 'admin')   # server process identity: admin
+    workspaces.create_workspace(
+        'vault2', {'private': True, 'allowed_users': ['someone-else']})
+    monkeypatch.setenv('SKY_TPU_WORKSPACE', 'vault2')
+    task = sky.Task('t', run='echo hi',
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4'))
+    remote_caller = {'id': 'remote-bob', 'name': 'bob', 'role': 'user'}
+    with pytest.raises(exceptions.PermissionDeniedError):
+        execution.launch(task, quiet=True, caller=remote_caller)
+
+
+def test_server_ops_gate_exec_and_serve_by_caller(monkeypatch):
+    """ops.dispatch applies the private-workspace gate to exec/jobs/serve
+    using the authenticated caller — not just launch (code-review
+    regression: exec used to bypass it entirely)."""
+    from skypilot_tpu.server import ops as ops_lib
+    me = users.core.ensure_user()
+    users.update_role(me['id'], 'admin')
+    workspaces.create_workspace(
+        'vault3', {'private': True, 'allowed_users': ['only-alice']})
+    monkeypatch.setenv('SKY_TPU_WORKSPACE', 'vault3')
+    bob = {'id': 'bob', 'name': 'bob', 'role': 'user'}
+    task_cfg = {'name': 't', 'run': 'echo hi',
+                'resources': {'cloud': 'local', 'accelerators': 'v5e-4'}}
+    # Resource-creating ops are gated on the ACTIVE workspace (exec and
+    # other existing-cluster ops are gated on the cluster's own
+    # workspace — see test_cluster_ops_gated_by_cluster_workspace).
+    for name, payload in [
+        ('launch', {'task': task_cfg, '_caller': bob}),
+        ('jobs.launch', {'task': task_cfg, '_caller': bob}),
+        ('serve.up', {'task': task_cfg, '_caller': bob}),
+        ('serve.update', {'task': task_cfg, 'service_name': 's',
+                          '_caller': bob}),
+    ]:
+        with pytest.raises(exceptions.PermissionDeniedError):
+            ops_lib.dispatch(name, payload)
+    # The admin caller passes the gate (dispatch returns a callable).
+    admin = {'id': me['id'], 'name': 'me', 'role': 'admin'}
+    assert callable(ops_lib.dispatch(
+        'launch', {'task': task_cfg, '_caller': admin}))
+
+
+def test_engine_exec_gated_like_launch(monkeypatch):
+    from skypilot_tpu import execution
+    import skypilot_tpu as sky
+    me = users.core.ensure_user()
+    users.update_role(me['id'], 'admin')
+    workspaces.create_workspace(
+        'vault4', {'private': True, 'allowed_users': ['nobody']})
+    monkeypatch.setenv('SKY_TPU_WORKSPACE', 'vault4')
+    task = sky.Task('t', run='echo hi')
+    with pytest.raises(exceptions.PermissionDeniedError):
+        execution.exec(task, 'some-cluster',
+                       caller={'id': 'x', 'role': 'user'})
+
+
+def test_cluster_ops_gated_by_cluster_workspace(monkeypatch):
+    """Ops on an existing cluster are judged against the workspace the
+    cluster was LAUNCHED in, regardless of the server's active workspace
+    (code-review regression: down/exec on a private-workspace cluster
+    from the default workspace used to pass)."""
+    from skypilot_tpu import state
+    from skypilot_tpu.server import ops as ops_lib
+    from skypilot_tpu.utils import common as common_lib
+    workspaces.create_workspace(
+        'sec-ws', {'private': True, 'allowed_users': ['alice-id']})
+    state.add_or_update_cluster('sec-c', common_lib.ClusterStatus.UP,
+                                workspace='sec-ws')
+    try:
+        bob = {'id': 'bob', 'name': 'bob', 'role': 'user'}
+        alice = {'id': 'alice-id', 'name': 'alice', 'role': 'user'}
+        # Active workspace is 'default' (public) — must not matter.
+        for op in ('exec', 'down', 'stop', 'queue', 'cancel',
+                   'autostop', 'job_status'):
+            with pytest.raises(exceptions.PermissionDeniedError):
+                ops_lib.dispatch(op, {
+                    'task': {'name': 't', 'run': 'x'},
+                    'cluster_name': 'sec-c', 'job_id': 1,
+                    'idle_minutes': 1, '_caller': bob})
+        # Allowed user and admin pass the same gate.
+        ops_lib.check_cluster_access(alice, 'sec-c')
+        ops_lib.check_cluster_access({'id': 'r', 'role': 'admin'},
+                                     'sec-c')
+        # Unknown cluster: gate defers to the engine's not-found error.
+        ops_lib.check_cluster_access(bob, 'no-such-cluster')
+    finally:
+        state.remove_cluster('sec-c')
