@@ -169,6 +169,17 @@ def test_dashboard_served(api_server):
     from skypilot_tpu.client import sdk
     accs = sdk.get(rid)
     assert any(k.startswith('v5p') for k in accs)
+    # v2 page inventory (reference dashboard pages): all tabs present
+    # and their backing ops answer.
+    page = requests.get(f'{api_server}/dashboard', timeout=5).text
+    for tab in ('clusters', 'jobs', 'serve', 'requests', 'infra',
+                'volumes', 'users', 'workspaces'):
+        assert f'data-tab="{tab}"' in page, tab
+    assert 'streamLogs' in page and 'doAction' in page  # live logs+actions
+    for op in ('users.list', 'workspaces.list', 'volumes.list'):
+        rid = requests.post(f'{api_server}/{op}', json={},
+                            timeout=5).json()['request_id']
+        sdk.get(rid)   # raises on FAILED
 
 
 def test_api_version_gate(api_server):
