@@ -28,6 +28,39 @@ from skypilot_tpu import topology
 
 _DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
 
+
+@functools.lru_cache(maxsize=None)
+def _az_mappings(cloud: str) -> Dict[tuple, List[str]]:
+    """(region, generation) → zones with that TPU generation, from the
+    bundled <cloud>_az_mappings.csv (reference ships az-mapping CSVs per
+    cloud; the failover loop walks them zone by zone)."""
+    path = os.path.join(_DATA_DIR, f'{cloud}_az_mappings.csv')
+    out: Dict[tuple, List[str]] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, newline='', encoding='utf-8') as f:
+        for row in csv.DictReader(f):
+            for gen in (row.get('tpu_generations') or '').split(';'):
+                gen = gen.strip()
+                if gen:
+                    out.setdefault((row['region'], gen),
+                                   []).append(row['zone'])
+    return out
+
+
+def zones_for(cloud: str, region: str, generation: str,
+              default_zone: str) -> List[str]:
+    """All zones of `region` offering `generation`.
+
+    The az-mapping is authoritative when it has an entry — the catalog
+    row's representative zone may not actually carry this generation
+    (e.g. v6e sits in us-east5-b while the price row's zone is -a), and
+    a candidate in a zone without the TPU guarantees a provision
+    failure. The row's zone is only the fallback for unmapped regions.
+    """
+    zones = _az_mappings(cloud).get((region, generation))
+    return list(zones) if zones else [default_zone]
+
 # Egress $/GiB (reference models this in sky/optimizer.py's egress cost).
 SAME_REGION_EGRESS = 0.0
 CROSS_REGION_EGRESS = 0.01
@@ -98,6 +131,7 @@ def _load(cloud: str) -> List[CatalogEntry]:
 def refresh() -> None:
     """Drop cached catalog data (hook for a future hosted-catalog fetcher)."""
     _load.cache_clear()
+    _az_mappings.cache_clear()
 
 
 def list_accelerators(name_filter: Optional[str] = None,
@@ -179,21 +213,29 @@ def get_candidates(resources: 'Resources') -> List[Candidate]:  # noqa: F821
         for e in _load(cloud):
             if resources.region and e.region != resources.region:
                 continue
-            if resources.zone and e.zone != resources.zone:
-                continue
             price = e.spot_price if resources.use_spot else e.price
             if resources.is_tpu:
                 s = resources.tpu
                 if e.kind != 'tpu' or e.name != s.generation:
                     continue
-                out.append(Candidate(
-                    cloud=cloud, region=e.region, zone=e.zone,
-                    instance_type=f'tpu-{s.name}',
-                    accelerator_name=s.name, accelerator_count=1,
-                    use_spot=resources.use_spot,
-                    cost_per_hour=price * s.num_chips,
-                    num_hosts=s.num_hosts, tpu=s))
-            elif resources.accelerator_name is not None:
+                # az-mappings widen the failover surface: the catalog
+                # prices per region with one representative zone, but a
+                # region usually has several zones with that generation
+                # (reference az-mapping CSVs, gcp_catalog.py:486-566).
+                for zone in zones_for(cloud, e.region, e.name, e.zone):
+                    if resources.zone and zone != resources.zone:
+                        continue
+                    out.append(Candidate(
+                        cloud=cloud, region=e.region, zone=zone,
+                        instance_type=f'tpu-{s.name}',
+                        accelerator_name=s.name, accelerator_count=1,
+                        use_spot=resources.use_spot,
+                        cost_per_hour=price * s.num_chips,
+                        num_hosts=s.num_hosts, tpu=s))
+                continue
+            if resources.zone and e.zone != resources.zone:
+                continue
+            if resources.accelerator_name is not None:
                 if (e.kind != 'gpu' or
                         e.name.lower() !=
                         resources.accelerator_name.lower()):

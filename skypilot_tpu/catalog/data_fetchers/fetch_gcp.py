@@ -91,10 +91,12 @@ def _sku_unit_price(sku: Dict) -> Optional[float]:
             float(money.get('nanos') or 0) / 1e9)
 
 
-def fetch_online(token: Optional[str] = None) -> List[List]:
-    """TPU rows from the live billing catalog."""
+def _convert_skus(skus) -> List[List]:
+    """Billing-catalog SKU objects → catalog TPU rows (shared by the
+    online API walk and the canned-fixture path, so the fixture test
+    exercises the REAL conversion)."""
     rows: List[List] = []
-    for sku in _iter_skus(token):
+    for sku in skus:
         desc = (sku.get('description') or '').lower()
         gen = next((g for d, g in _TPU_DESC_TO_GEN.items() if d in desc),
                    None)
@@ -111,8 +113,35 @@ def fetch_online(token: Optional[str] = None) -> List[List]:
             rows.append(['tpu', gen, region, zone,
                          '' if spot else f'{price:.4f}',
                          f'{price:.4f}' if spot else '',
-                         '', '', 'per-chip-hour (fetched)'])
+                         '', '', 'per-chip-hour'])
     return _merge_spot(rows)
+
+
+def fetch_online(token: Optional[str] = None) -> List[List]:
+    """TPU rows from the live billing catalog + maintained comparators."""
+    return _convert_skus(_iter_skus(token)) + comparator_rows()
+
+
+def fetch_from_fixture(path: Optional[str] = None) -> List[List]:
+    """TPU rows from a canned billing-API response (offline CI), through
+    the same conversion as the live walk, + maintained comparators."""
+    import json
+    path = path or os.path.join(os.path.dirname(os.path.abspath(
+        __file__)), 'fixtures', 'gcp_billing_skus.json')
+    with open(path, encoding='utf-8') as f:
+        return _convert_skus(json.load(f)['skus']) + comparator_rows()
+
+
+def comparator_rows() -> List[List]:
+    """GPU/CPU comparator rows (maintained here, not fetched: the GPU
+    market prices move slowly and the optimizer only needs them for
+    TPU-vs-GPU cost ranking)."""
+    bundled = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           'fixtures', 'gcp_comparators.csv')
+    with open(bundled, newline='', encoding='utf-8') as f:
+        reader = csv.reader(f)
+        next(reader)   # header
+        return [row for row in reader if row]
 
 
 def _merge_spot(rows: List[List]) -> List[List]:
@@ -159,13 +188,18 @@ def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--offline', action='store_true',
                         help='re-emit the bundled snapshot (no network)')
+    parser.add_argument('--fixture', action='store_true',
+                        help='generate from the canned billing-API '
+                             'fixture (what the shipped CSV is built '
+                             'from; no network)')
     parser.add_argument('--output', default=None,
                         help='output CSV (default: the bundled gcp.csv)')
     args = parser.parse_args()
     output = args.output or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         'data', 'gcp.csv')
-    rows = fetch_offline() if args.offline else fetch_online()
+    rows = (fetch_from_fixture() if args.fixture else
+            fetch_offline() if args.offline else fetch_online())
     if not rows:
         raise SystemExit('fetched 0 rows; refusing to write an empty '
                          'catalog')

@@ -121,8 +121,11 @@ def test_online_sku_parsing(monkeypatch):
     monkeypatch.setattr(fetch_gcp, '_iter_skus',
                         lambda token=None: iter(skus))
     rows = fetch_gcp.fetch_online()
-    assert len(rows) == 1   # v5e merged; unknown region dropped
-    kind, gen, region, zone, price, spot, *_ = rows[0]
+    tpu_rows = [r for r in rows if r[0] == 'tpu']
+    assert len(tpu_rows) == 1   # v5e merged; unknown region dropped
+    kind, gen, region, zone, price, spot, *_ = tpu_rows[0]
     assert (kind, gen, region) == ('tpu', 'v5e', 'us-central1')
     assert float(price) == pytest.approx(1.2)
     assert float(spot) == pytest.approx(0.48)
+    # Maintained GPU/CPU comparator rows ride along with every fetch.
+    assert any(r[0] == 'gpu' and r[1] == 'H100' for r in rows)
