@@ -1,0 +1,165 @@
+"""Serve-path TTFT benchmark on the local chip (north-star #2).
+
+Measures time-to-first-token as a client experiences it THROUGH the
+serve stack: a real inference server (continuous-batching engine,
+infer/engine.py) on the local accelerator, registered as a ready
+replica in the serve state DB, fronted by the real serve load balancer
+(serve/load_balancer.py) whose per-request arrival→first-byte clock is
+the metric (BASELINE.md: "sky serve p50 TTFT").
+
+Short prompts keep the engine to two compiled programs (one prefill
+bucket + fused decode/sample), per the compile-latency constraints of
+single-chip benching. Prints ONE JSON line and writes TTFT_r<N>.json
+when --output is given.
+
+Usage:  python bench_ttft.py [--requests 48] [--output TTFT_r02.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import sys
+import time
+import urllib.request
+
+
+def _post(url: str, payload: dict, timeout: float = 120.0) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        body = r.read()
+    try:
+        out = json.loads(body)
+    except json.JSONDecodeError:
+        # Streaming responses are JSON lines; the last line is terminal.
+        out = json.loads(body.splitlines()[-1])
+    if isinstance(out, dict) and out.get('error'):
+        raise RuntimeError(f'request failed: {out["error"]}')
+    return out
+
+
+def _get(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_http(url: str, deadline_s: float) -> None:
+    deadline = time.time() + deadline_s
+    last = None
+    while time.time() < deadline:
+        try:
+            _get(url, timeout=2.0)
+            return
+        except Exception as e:  # noqa: BLE001 — booting
+            last = e
+            time.sleep(0.5)
+    raise RuntimeError(f'{url} never became healthy: {last}')
+
+
+def _run_lb(service: str, port: int) -> None:
+    from skypilot_tpu.serve import load_balancer
+    load_balancer.run_load_balancer(service, 'least_load', '127.0.0.1',
+                                    port)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--requests', type=int, default=48)
+    parser.add_argument('--model', default='tiny',
+                        help="infer/server.py model ('tiny' keeps warmup "
+                             'to seconds; TTFT measures the serving '
+                             'path, not model quality)')
+    parser.add_argument('--max-seq-len', type=int, default=128)
+    parser.add_argument('--output', default=None)
+    args = parser.parse_args()
+
+    from skypilot_tpu.utils import common
+    # Unique per run: a stale READY replica from a previous run (dead
+    # port) would absorb half the traffic and corrupt the percentiles.
+    service = f'ttft-bench-{os.getpid()}'
+    infer_port = common.free_port()
+    lb_port = common.free_port()
+
+    # 1. Real inference server on the local accelerator (random weights:
+    #    TTFT is a latency property of the serving path, not the values).
+    import subprocess
+    infer_proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.infer.server',
+         '--port', str(infer_port), '--model', args.model,
+         '--slots', '8', '--max-seq-len', str(args.max_seq_len)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        _wait_http(f'http://127.0.0.1:{infer_port}/health', 300)
+
+        # 2. Register it as a ready replica; start the REAL serve LB.
+        from skypilot_tpu.serve import state as serve_state
+        from skypilot_tpu.serve.state import ReplicaStatus
+        serve_state.add_service(service, spec_json='{}', task_yaml='',
+                                lb_port=lb_port, lb_policy='least_load')
+        rid = serve_state.add_replica(service, 'ttft-local', 1)
+        serve_state.set_replica_url(rid, f'http://127.0.0.1:{infer_port}')
+        serve_state.set_replica_status(rid, ReplicaStatus.READY)
+        lb_proc = multiprocessing.Process(target=_run_lb,
+                                          args=(service, lb_port))
+        lb_proc.start()
+        try:
+            _wait_http(f'http://127.0.0.1:{lb_port}/-/metrics', 60)
+            # LB syncs the ready set every second; wait until it has one.
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                m = _get(f'http://127.0.0.1:{lb_port}/-/metrics')
+                if m.get('ready_replicas'):
+                    break
+                time.sleep(0.5)
+
+            # 3. Warm the two compiled programs (prefill bucket + decode)
+            #    off the clock, then measure through the LB.
+            gen_url = f'http://127.0.0.1:{lb_port}/generate'
+            _post(gen_url, {'prompt': 'warmup', 'max_new_tokens': 8},
+                  timeout=600)
+            # stream=true: the replica flushes the first token as it is
+            # produced, so the LB's arrival→first-byte clock measures
+            # true time-to-first-token (not time-to-full-completion).
+            t0 = time.time()
+            for i in range(args.requests):
+                _post(gen_url, {'prompt': f'request {i} hello',
+                                'max_new_tokens': 8, 'stream': True})
+            wall = time.time() - t0
+
+            metrics = _get(f'http://127.0.0.1:{lb_port}/-/metrics')
+        finally:
+            lb_proc.terminate()
+            lb_proc.join(timeout=10)
+            try:
+                serve_state.remove_replica(rid)
+                serve_state.remove_service(service)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+    finally:
+        infer_proc.terminate()
+        infer_proc.wait(timeout=10)
+
+    import jax
+    result = {
+        'metric': 'serve_ttft_p50_s',
+        'value': metrics['ttft_p50_s'],
+        'unit': 'seconds',
+        'ttft_p90_s': metrics['ttft_p90_s'],
+        'ttft_p99_s': metrics['ttft_p99_s'],
+        'samples': metrics['ttft_samples'],
+        'requests_per_sec': round(args.requests / wall, 2),
+        'model': args.model,
+        'device': jax.devices()[0].device_kind,
+        'path': 'client -> serve LB -> continuous-batching engine',
+    }
+    print(json.dumps(result))
+    if args.output:
+        with open(args.output, 'w', encoding='utf-8') as f:
+            json.dump(result, f, indent=1)
+
+
+if __name__ == '__main__':
+    main()

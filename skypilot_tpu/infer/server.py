@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import logging
 import threading
 import time
@@ -120,6 +121,44 @@ class InferenceServer:
         except ValueError as e:
             return web.json_response({'error': str(e)}, status=400)
         self._woken.set()
+        if body.get('stream'):
+            # Token streaming (what a production LLM endpoint serves):
+            # one JSON line per token batch, flushed as the engine emits
+            # them — the first byte leaves at the FIRST token, so
+            # LB-measured TTFT is true time-to-first-token, not
+            # time-to-full-completion.
+            if self.dead:
+                # Before prepare(): once 200 headers are out, a dead
+                # engine would masquerade as a valid TTFT sample to the
+                # LB (which excludes 5xx from the distribution).
+                return web.json_response(
+                    {'error': f'engine died: {self.dead}'}, status=500)
+            resp = web.StreamResponse()
+            resp.content_type = 'application/jsonlines'
+            await resp.prepare(request)
+            sent = 0
+            while True:
+                if self.dead:
+                    await resp.write(json.dumps(
+                        {'error': f'engine died: {self.dead}'}).encode()
+                        + b'\n')
+                    break
+                n = len(req.output_tokens)
+                if n > sent:
+                    chunk = req.output_tokens[sent:n]
+                    await resp.write(json.dumps(
+                        {'tokens': chunk,
+                         'text': _decode_bytes(chunk)}).encode() + b'\n')
+                    sent = n
+                if req.done and sent == len(req.output_tokens):
+                    await resp.write(json.dumps(
+                        {'done': True, 'request_id': req.request_id,
+                         'finish_reason': req.finish_reason,
+                         'ttft_s': req.ttft}).encode() + b'\n')
+                    break
+                await asyncio.sleep(0.002)
+            await resp.write_eof()
+            return resp
         while not req.done:
             if self.dead:
                 return web.json_response(

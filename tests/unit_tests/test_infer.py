@@ -110,3 +110,47 @@ def test_metrics_shape(params):
     assert m['decode_tokens'] > 0
     assert m['decode_tokens_per_sec'] > 0
     assert m['ttft_p50_s'] is not None
+
+
+def test_streaming_generate_first_token_early(params):
+    """stream=true flushes tokens as the engine emits them: the client
+    sees the first chunk before the request finishes, and the
+    concatenated stream equals the non-streaming result."""
+    import asyncio
+    import json as json_lib
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from skypilot_tpu.infer import server as server_lib
+
+    async def flow():
+        eng = InferenceEngine(CFG, params,
+                              EngineConfig(n_slots=2, max_seq_len=128))
+        srv = server_lib.InferenceServer(eng)
+        srv._thread.start()
+        client = TestClient(TestServer(srv.make_app()))
+        await client.start_server()
+        try:
+            # Non-streaming oracle.
+            r = await client.post('/generate',
+                                  json={'tokens': [1, 2, 3],
+                                        'max_new_tokens': 6})
+            full = await r.json()
+            # Streaming: collect JSON lines as they arrive.
+            r = await client.post('/generate',
+                                  json={'tokens': [1, 2, 3],
+                                        'max_new_tokens': 6,
+                                        'stream': True})
+            lines = []
+            async for chunk in r.content:
+                if chunk.strip():
+                    lines.append(json_lib.loads(chunk))
+            assert lines[-1]['done'] is True
+            assert lines[-1]['finish_reason'] == 'max_tokens'
+            streamed = [t for ln in lines[:-1] for t in ln['tokens']]
+            assert streamed == full['tokens']
+        finally:
+            await client.close()
+            srv._stop.set()
+
+    asyncio.run(flow())
