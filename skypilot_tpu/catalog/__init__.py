@@ -177,16 +177,25 @@ def list_accelerators(name_filter: Optional[str] = None,
     return result
 
 
-def get_candidates(resources: 'Resources') -> List[Candidate]:  # noqa: F821
+def get_candidates(resources: 'Resources',  # noqa: F821
+                   required=None) -> List[Candidate]:
     """All feasible (cloud, region, zone, instance) placements for a request.
 
     The optimizer's feasibility+pricing source (reference
     sky/optimizer.py:1664 ``_fill_in_launchable_resources``).
+
+    `required` (a frozenset of cloud_capabilities.Feature) filters clouds
+    declaratively: a pinned cloud missing a feature raises with the
+    feature names; unpinned requests silently skip infeasible clouds
+    (reference CloudImplementationFeatures gating).
     """
     from skypilot_tpu import resources as resources_lib
     assert isinstance(resources, resources_lib.Resources)
     out: List[Candidate] = []
     if resources.cloud:
+        if required:
+            from skypilot_tpu import cloud_capabilities as caps
+            caps.check_features(resources.cloud, required)
         clouds = [resources.cloud]
     else:
         # Unpinned requests consider enabled *priced* clouds only. The
@@ -197,6 +206,10 @@ def get_candidates(resources: 'Resources') -> List[Candidate]:  # noqa: F821
         enabled = [c for c in state.get_enabled_clouds()
                    if c not in ('local', 'ssh', 'kubernetes')]
         clouds = enabled or ['gcp']
+        if required:
+            from skypilot_tpu import cloud_capabilities as caps
+            clouds = [c for c in clouds
+                      if not caps.unsupported(c, required)]
 
     for cloud in clouds:
         if cloud == 'local':

@@ -1,0 +1,117 @@
+"""Declarative per-cloud capability flags.
+
+Counterpart of the reference's ``CloudImplementationFeatures`` + ``Cloud``
+base (reference sky/clouds/cloud.py:40-105,158): the optimizer rejects
+infeasible (cloud, feature) combinations declaratively instead of
+scattering per-call checks. A task's required features are derived from
+its spec (spot, multislice, ports, mounts, ...); a cloud is a launch
+candidate only when it supports all of them, and the mismatch message
+names exactly which feature ruled each cloud out.
+
+The flag tables live here (4 clouds today) so adding a cloud is one dict
+entry plus a provisioner package — no optimizer edits.
+"""
+from __future__ import annotations
+
+import enum
+from typing import TYPE_CHECKING, Dict, FrozenSet, List
+
+from skypilot_tpu import exceptions
+
+if TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+
+class Feature(str, enum.Enum):
+    """Things a task/operation may require of a cloud."""
+    STOP = 'stop'                       # cluster can stop + restart
+    AUTOSTOP = 'autostop'               # cluster can stop ITSELF when idle
+    SPOT = 'spot'                       # preemptible capacity exists
+    MULTISLICE = 'multislice'           # num_slices > 1 (DCN gangs)
+    STORAGE_MOUNTING = 'storage_mounting'   # bucket FUSE mounts
+    OPEN_PORTS = 'open_ports'           # expose ports to the network
+    VOLUMES = 'volumes'                 # attachable block volumes
+    HOST_CONTROLLERS = 'host_controllers'   # can run jobs/serve controllers
+
+
+# What each provider actually implements (kept in lockstep with
+# provision/<cloud>/instance.py — the unit tests assert the load-bearing
+# entries against provider behavior).
+CLOUD_FEATURES: Dict[str, FrozenSet[Feature]] = {
+    'gcp': frozenset({
+        Feature.STOP, Feature.AUTOSTOP, Feature.SPOT, Feature.MULTISLICE,
+        Feature.STORAGE_MOUNTING, Feature.VOLUMES,
+        Feature.HOST_CONTROLLERS,
+        # OPEN_PORTS: intra-VPC reachability (what serve's LB→replica
+        # path needs) works without firewall rules; provision/gcp's
+        # open_ports no-op only limits EXTERNAL exposure.
+        Feature.OPEN_PORTS,
+    }),
+    'local': frozenset({
+        Feature.STOP, Feature.AUTOSTOP, Feature.SPOT, Feature.MULTISLICE,
+        Feature.STORAGE_MOUNTING, Feature.OPEN_PORTS, Feature.VOLUMES,
+        Feature.HOST_CONTROLLERS,
+    }),
+    'kubernetes': frozenset({
+        # stop = scale-to-zero (provision/k8s/instance.py:193).
+        Feature.STOP, Feature.STORAGE_MOUNTING,
+        Feature.HOST_CONTROLLERS,
+        # NOT AUTOSTOP: the in-pod agent cannot scale its own
+        # StatefulSet without RBAC the manifests do not grant.
+        # NOT SPOT / MULTISLICE / OPEN_PORTS / VOLUMES.
+    }),
+    'ssh': frozenset({
+        # Bare metal: hosts are sunk cost; stop = stop the agents.
+        Feature.STOP, Feature.AUTOSTOP, Feature.STORAGE_MOUNTING,
+        Feature.HOST_CONTROLLERS,
+    }),
+}
+
+
+def features_of(cloud: str) -> FrozenSet[Feature]:
+    return CLOUD_FEATURES.get(cloud, frozenset())
+
+
+def required_features(task: 'task_lib.Task',
+                      resources=None) -> FrozenSet[Feature]:
+    """Features this task's spec demands of whatever cloud runs it.
+
+    `resources` overrides the task's base resources — any_of failover
+    alternatives may flip spot/ports/num_slices, so the caller must gate
+    each alternative against ITS OWN feature set, not the base one.
+    """
+    needed = set()
+    res = resources if resources is not None else task.resources
+    if res.use_spot:
+        needed.add(Feature.SPOT)
+    if res.num_slices > 1:
+        needed.add(Feature.MULTISLICE)
+    if res.ports:
+        needed.add(Feature.OPEN_PORTS)
+    if res.autostop is not None and res.autostop.enabled:
+        needed.add(Feature.AUTOSTOP)
+    if task.volumes:
+        needed.add(Feature.VOLUMES)
+    if task.storage_mounts or any(
+            _is_bucket(src) for src in (task.file_mounts or {}).values()):
+        needed.add(Feature.STORAGE_MOUNTING)
+    return frozenset(needed)
+
+
+def _is_bucket(src: str) -> bool:
+    from skypilot_tpu.data import storage as storage_lib
+    return storage_lib.is_bucket_url(src)
+
+
+def unsupported(cloud: str, needed: FrozenSet[Feature]) -> List[Feature]:
+    return sorted(needed - features_of(cloud), key=lambda f: f.value)
+
+
+def check_features(cloud: str, needed: FrozenSet[Feature]) -> None:
+    """Raise with the exact blocking features (reference
+    check_features_are_supported)."""
+    missing = unsupported(cloud, needed)
+    if missing:
+        raise exceptions.ResourcesMismatchError(
+            f'cloud {cloud!r} does not support: '
+            f'{[f.value for f in missing]}')

@@ -98,10 +98,24 @@ def _fill_candidates(t: task_lib.Task,
     blocked_keys = {(b.cloud, b.region, b.zone, b.instance_type)
                     for b in (blocked or [])}
     alternatives = _candidate_resources(t)
+    # Declarative (cloud, feature) gating (reference
+    # CloudImplementationFeatures): a task needing spot/multislice/
+    # ports/... only considers clouds implementing them. Derived PER
+    # alternative — any_of entries may flip spot/ports/num_slices.
+    from skypilot_tpu import cloud_capabilities as caps
     # Runtime estimates are anchored to the first alternative's slice.
     ref_tpu = next((r.tpu for r in alternatives if r.tpu is not None), None)
+    feature_notes: List[str] = []
     for req in alternatives:
-        for cand in catalog.get_candidates(req):
+        required = caps.required_features(t, req)
+        try:
+            cands = catalog.get_candidates(req, required=required)
+        except exceptions.ResourcesMismatchError as e:
+            # A pinned-cloud alternative lacking a feature is skipped,
+            # not fatal — other any_of alternatives may be feasible.
+            feature_notes.append(str(e))
+            continue
+        for cand in cands:
             if (cand.cloud, cand.region, cand.zone,
                     cand.instance_type) in blocked_keys:
                 continue
@@ -110,9 +124,24 @@ def _fill_candidates(t: task_lib.Task,
                                   run_cost=hours * cand.cost_per_hour,
                                   req=req))
     if not plans:
+        # Name the blocking features (the cloud_capabilities contract):
+        # pinned mismatches were collected above; for unpinned requests
+        # explain which enabled clouds lost on which feature.
+        if not feature_notes:
+            from skypilot_tpu import state
+            for cloud in state.get_enabled_clouds() or ['gcp']:
+                for req in alternatives:
+                    missing = caps.unsupported(
+                        cloud, caps.required_features(t, req))
+                    if missing:
+                        feature_notes.append(
+                            f'cloud {cloud!r} lacks '
+                            f'{[f.value for f in missing]}')
+        hint = ('; '.join(sorted(set(feature_notes)))
+                if feature_notes else 'Check the catalog/regions.')
         raise exceptions.ResourcesUnavailableError(
             f'No feasible placement for task {t.name or "<unnamed>"} '
-            f'with resources {t.resources!r}. Check the catalog/regions.')
+            f'with resources {t.resources!r}. {hint}')
     key = ((lambda p: (p.run_cost, p.run_hours))
            if target is OptimizeTarget.COST
            else (lambda p: (p.run_hours, p.run_cost)))

@@ -1,0 +1,107 @@
+"""Declarative cloud capability flags (reference
+CloudImplementationFeatures, sky/clouds/cloud.py:40-105): tasks demand
+features, clouds declare them, the optimizer filters declaratively."""
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import cloud_capabilities as caps
+from skypilot_tpu import exceptions
+
+
+def _task(**res_kw):
+    return sky.Task('t', run='echo hi',
+                    resources=sky.Resources(**res_kw))
+
+
+def test_required_features_derivation():
+    F = caps.Feature
+    assert caps.required_features(_task()) == frozenset()
+    assert F.SPOT in caps.required_features(
+        _task(accelerators='v5e-8', use_spot=True))
+    assert F.MULTISLICE in caps.required_features(
+        _task(accelerators='v5p-64', num_slices=2))
+    assert F.OPEN_PORTS in caps.required_features(_task(ports=[8080]))
+    assert F.AUTOSTOP in caps.required_features(_task(autostop=5))
+    t = sky.Task('t', run='x', volumes={'/data': 'vol1'})
+    assert F.VOLUMES in caps.required_features(t)
+    t2 = sky.Task('t', run='x',
+                  file_mounts={'/m': 'gs://bucket/path'})
+    assert F.STORAGE_MOUNTING in caps.required_features(t2)
+    # Plain local file mounts need nothing special.
+    t3 = sky.Task('t', run='x', file_mounts={'/m': '/tmp/x'})
+    assert F.STORAGE_MOUNTING not in caps.required_features(t3)
+
+
+def test_flags_match_provider_behavior():
+    F = caps.Feature
+    # Multislice is implemented by gcp+local only; k8s/ssh run_instances
+    # reject num_slices > 1 (provision/{k8s,ssh}/instance.py).
+    for cloud in ('gcp', 'local'):
+        assert F.MULTISLICE in caps.features_of(cloud)
+    for cloud in ('kubernetes', 'ssh'):
+        assert F.MULTISLICE not in caps.features_of(cloud)
+    # gcp ports = intra-VPC reachability (serve LB→replica path).
+    assert F.OPEN_PORTS in caps.features_of('gcp')
+    # Bare-metal ssh pools have no spot market.
+    assert F.SPOT not in caps.features_of('ssh')
+    # Every provider implements stop.
+    for cloud in ('gcp', 'local', 'kubernetes', 'ssh'):
+        assert F.STOP in caps.features_of(cloud)
+
+
+def test_check_features_raises_with_names():
+    with pytest.raises(exceptions.ResourcesMismatchError,
+                       match='multislice'):
+        caps.check_features('kubernetes',
+                            frozenset({caps.Feature.MULTISLICE}))
+    caps.check_features('gcp', frozenset({caps.Feature.SPOT}))  # ok
+
+
+def test_candidates_filtered_by_features():
+    """Pinned clouds missing a required feature raise with the feature
+    name; unpinned requests only offer clouds that implement it."""
+    from skypilot_tpu import catalog
+    t = _task(cloud='kubernetes', accelerators='v5e-8', use_spot=True)
+    with pytest.raises(exceptions.ResourcesMismatchError,
+                       match='spot'):
+        catalog.get_candidates(t.resources,
+                               required=caps.required_features(t))
+    t2 = _task(cloud='ssh', accelerators='v5e-8',
+               num_slices=2)
+    with pytest.raises(exceptions.ResourcesMismatchError,
+                       match='multislice'):
+        catalog.get_candidates(t2.resources,
+                               required=caps.required_features(t2))
+    # Unpinned spot request: gcp supports SPOT, so it stays the
+    # (default-enabled) candidate pool.
+    t3 = _task(accelerators='v5e-8', use_spot=True)
+    cands = catalog.get_candidates(t3.resources,
+                                   required=caps.required_features(t3))
+    assert cands and all(c.cloud == 'gcp' for c in cands)
+
+
+def test_any_of_alternatives_gated_individually():
+    """any_of alternatives carry their own feature needs: a spot base
+    with an on-demand ssh alternative must keep the ssh alternative
+    viable (code-review regression: base features were applied to every
+    alternative)."""
+    from skypilot_tpu import optimizer as optimizer_lib
+    t = _task(accelerators='v5e-4', cloud='local', use_spot=True)
+    t.resources = sky.Resources(
+        accelerators='v5e-4', cloud='local', use_spot=True,
+        any_of=[{'cloud': 'ssh', 'use_spot': False},
+                {'cloud': 'local'}])
+    plans = optimizer_lib._fill_candidates(  # noqa: SLF001
+        t, optimizer_lib.OptimizeTarget.COST)
+    # The local (spot-capable) alternative survives; no crash from the
+    # ssh+no-spot alternative even though the BASE is spot.
+    assert any(p.candidate.cloud == 'local' for p in plans)
+
+
+def test_no_feasible_cloud_error_names_features():
+    from skypilot_tpu import optimizer as optimizer_lib
+    t = _task(cloud='ssh', accelerators='v5e-8', use_spot=True)
+    with pytest.raises(exceptions.ResourcesUnavailableError,
+                       match='spot'):
+        optimizer_lib._fill_candidates(  # noqa: SLF001
+            t, optimizer_lib.OptimizeTarget.COST)

@@ -60,3 +60,22 @@ def test_baseline_example_yamls_parse():
             assert task.resources.use_spot
         if name == 'serve_llm.yaml':
             assert task.is_service
+
+
+def test_remat_policies_numerically_identical():
+    """Remat must never change values — only the recompute schedule."""
+    import jax
+    import numpy as np
+    from skypilot_tpu.models import llama
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 100)
+    tgts = jax.numpy.roll(toks, -1, axis=1)
+    grads = {}
+    for pol in ('full', 'save_attn', 'dots'):
+        cfg = llama.LlamaConfig.tiny(remat_policy=pol)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        g = jax.grad(lambda p: llama.loss_fn(cfg, p, toks, tgts))(params)
+        grads[pol] = np.asarray(g['embed'])
+    np.testing.assert_allclose(grads['full'], grads['save_attn'],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(grads['full'], grads['dots'],
+                               rtol=1e-5, atol=1e-6)
