@@ -204,7 +204,7 @@ def get_candidates(resources: 'Resources',  # noqa: F821
         # explicitly with `cloud: ...`.
         from skypilot_tpu import state
         enabled = [c for c in state.get_enabled_clouds()
-                   if c not in ('local', 'ssh', 'kubernetes')]
+                   if c not in ('local', 'ssh', 'kubernetes', 'slurm')]
         clouds = enabled or ['gcp']
         if required:
             from skypilot_tpu import cloud_capabilities as caps
@@ -222,6 +222,9 @@ def get_candidates(resources: 'Resources',  # noqa: F821
             cand = _k8s_candidate(resources)
             if cand is not None:
                 out.append(cand)
+            continue
+        if cloud == 'slurm':
+            out.append(_slurm_candidate(resources))
             continue
         for e in _load(cloud):
             if resources.region and e.region != resources.region:
@@ -323,6 +326,26 @@ def _k8s_candidate(resources: 'Resources') -> Optional[Candidate]:  # noqa: F821
         cloud='kubernetes', region=ctx, zone=ns,
         instance_type=(f'tpu-{tpu.name}' if tpu else
                        resources.instance_type or 'pod'),
+        accelerator_name=resources.accelerator_name,
+        accelerator_count=resources.accelerator_count,
+        use_spot=resources.use_spot,
+        cost_per_hour=0.0,
+        num_hosts=tpu.num_hosts if tpu else 1,
+        tpu=tpu)
+
+
+def _slurm_candidate(resources: 'Resources') -> Candidate:  # noqa: F821
+    """Slurm allocation as a placement: on-prem sunk cost ($0/hr), gang
+    size from the TPU slice (or num_nodes); region carries the
+    partition (config default otherwise)."""
+    from skypilot_tpu import config as config_lib
+    tpu = resources.tpu
+    partition = resources.region or config_lib.get_nested(
+        ('slurm', 'partition'), 'default')
+    return Candidate(
+        cloud='slurm', region=partition, zone='slurm',
+        instance_type=(f'tpu-{tpu.name}' if tpu else
+                       resources.instance_type or 'slurm-node'),
         accelerator_name=resources.accelerator_name,
         accelerator_count=resources.accelerator_count,
         use_spot=resources.use_spot,

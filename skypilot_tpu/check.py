@@ -84,10 +84,33 @@ def _check_kubernetes() -> CheckResult:
                        details={'context': ctx})
 
 
+def _check_slurm() -> CheckResult:
+    for tool in ('sbatch', 'sinfo'):
+        if shutil.which(tool) is None:
+            return CheckResult(
+                'slurm', ok=False,
+                reason=f'{tool} not found on PATH (run where Slurm '
+                       f'client tools are installed).')
+    try:
+        rc = subprocess.run(['sinfo', '-h', '-o', '%P'],
+                            capture_output=True, text=True, timeout=15)
+    except subprocess.TimeoutExpired:
+        return CheckResult('slurm', ok=False,
+                           reason='sinfo timed out (slurmctld down?)')
+    if rc.returncode != 0:
+        return CheckResult(
+            'slurm', ok=False,
+            reason=f'sinfo failed: {rc.stderr.strip() or "no cluster?"}')
+    partitions = [p.strip('*') for p in rc.stdout.split()]
+    return CheckResult('slurm', ok=True,
+                       details={'partitions': partitions})
+
+
 _PROBES: Dict[str, Callable[[], CheckResult]] = {
     'local': _check_local,
     'gcp': _check_gcp,
     'kubernetes': _check_kubernetes,
+    'slurm': _check_slurm,
 }
 
 ALL_CLOUDS = list(_PROBES)

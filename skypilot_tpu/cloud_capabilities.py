@@ -65,6 +65,12 @@ CLOUD_FEATURES: Dict[str, FrozenSet[Feature]] = {
         Feature.STOP, Feature.AUTOSTOP, Feature.STORAGE_MOUNTING,
         Feature.HOST_CONTROLLERS,
     }),
+    'slurm': frozenset({
+        # stop = scancel the allocation, start = resubmit
+        # (provision/slurm/instance.py); intra-cluster network is open.
+        Feature.STOP, Feature.STORAGE_MOUNTING, Feature.OPEN_PORTS,
+        Feature.HOST_CONTROLLERS,
+    }),
 }
 
 

@@ -26,6 +26,7 @@ _PROVIDERS = {
     'gcp': 'skypilot_tpu.provision.gcp.instance',
     'ssh': 'skypilot_tpu.provision.ssh.instance',
     'kubernetes': 'skypilot_tpu.provision.k8s.instance',
+    'slurm': 'skypilot_tpu.provision.slurm.instance',
 }
 
 

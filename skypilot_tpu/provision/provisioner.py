@@ -42,6 +42,11 @@ def _make_config(candidate: catalog.Candidate,
         if candidate.region != 'in-cluster':
             provider_config['context'] = candidate.region
         provider_config['namespace'] = candidate.zone
+    if candidate.cloud == 'slurm' and candidate.region != 'default':
+        # slurm candidates encode the partition as region
+        # (catalog._slurm_candidate); a user-pinned partition must reach
+        # the sbatch script.
+        provider_config['partition'] = candidate.region
     return ProvisionConfig(
         cluster_name=cluster_name,
         region=candidate.region,

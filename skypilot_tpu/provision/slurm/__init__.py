@@ -1,0 +1,2 @@
+"""Slurm provider package (reference sky/clouds/slurm.py +
+sky/skylet/executor/slurm.py, redesigned agent-first)."""

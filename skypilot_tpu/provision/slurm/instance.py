@@ -1,0 +1,318 @@
+"""Slurm provider: a cluster is one sbatch allocation running agents.
+
+Counterpart of the reference's Slurm support (reference
+sky/clouds/slurm.py as a cloud + sky/skylet/executor/slurm.py as an
+alternative on-cluster executor). The TPU-native redesign keeps ONE
+runtime everywhere instead of a second executor: ``run_instances``
+submits an sbatch job whose only payload is `srun` starting the standard
+on-host agent on every allocated node (host mode, head = node 0), so
+jobs/logs/autostop/serve all work unchanged on Slurm — the allocation is
+just another way to obtain a gang of hosts.
+
+Assumptions: this process runs where Slurm's client tools work (a login
+node — the usual deployment for an on-prem API server), and
+``$SKY_TPU_HOME`` lives on a filesystem shared with the compute nodes
+(standard on-prem setup) — agents read their config from it and the
+backend syncs workdirs through it. Config:
+
+    slurm:
+      partition: tpu        # optional
+      account: myacct       # optional
+      time_limit: 7-00:00:00  # optional, sbatch -t
+
+Lifecycle mapping: stop = scancel (release the allocation, keep
+metadata), start = resubmit, terminate = scancel + forget. Offline tests
+drive the full provider against stub sbatch/squeue/scontrol binaries
+(tests/unit_tests/test_slurm_provisioner.py), mirroring the fake-cloud
+test strategy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
+                                           ProvisionConfig)
+from skypilot_tpu.utils import common
+
+AGENT_PORT = 46590
+SUBMIT_TIMEOUT_S = 30.0
+
+
+def _cluster_dir(cluster_name: str) -> str:
+    return os.path.join(common.clusters_dir(), cluster_name)
+
+
+def _run(cmd: List[str], timeout: float = SUBMIT_TIMEOUT_S) -> str:
+    if shutil.which(cmd[0]) is None:
+        raise exceptions.NoCloudAccessError(
+            f'{cmd[0]!r} not found on PATH — the Slurm provider must run '
+            f'where Slurm client tools are installed (a login node).')
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        # Must be a SkyTpuError: a hung slurmctld has to ride the
+        # failover/error paths, not escape as a raw traceback.
+        raise exceptions.ProvisionError(
+            f'[slurm] {cmd[0]} timed out after {timeout}s '
+            f'(slurmctld unresponsive?)', retryable=True) from e
+    if proc.returncode != 0:
+        raise exceptions.ProvisionError(
+            f'[slurm] {" ".join(cmd[:2])} failed: '
+            f'{proc.stderr.strip() or proc.stdout.strip()}',
+            retryable=False)
+    return proc.stdout
+
+
+def _meta(cdir: str) -> Optional[Dict[str, Any]]:
+    p = os.path.join(cdir, 'meta.json')
+    if not os.path.exists(p):
+        return None
+    with open(p, encoding='utf-8') as f:
+        return json.load(f)
+
+
+def _write_meta(cdir: str, meta: Dict[str, Any]) -> None:
+    tmp = os.path.join(cdir, 'meta.json.tmp')
+    with open(tmp, 'w', encoding='utf-8') as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(cdir, 'meta.json'))
+
+
+def _node_script(cdir: str, cluster_name: str,
+                 tpu_slice: Optional[str]) -> str:
+    """The per-node srun payload: derive rank/hosts from the Slurm env,
+    write the agent config, run the agent in the foreground (the srun
+    task's lifetime IS the allocation's)."""
+    return f"""#!/bin/bash
+set -e
+RANK=${{SLURM_NODEID:?}}
+NODE_DIR={cdir}/host$RANK
+mkdir -p "$NODE_DIR"
+HOSTS=$(scontrol show hostnames "$SLURM_JOB_NODELIST")
+python3 - "$RANK" "$NODE_DIR" <<'PYEOF'
+import json, os, sys
+rank, node_dir = int(sys.argv[1]), sys.argv[2]
+hosts = os.environ['SKY_TPU_SLURM_HOSTS'].split()
+cfg = {{
+    'cluster_name': {cluster_name!r},
+    'mode': 'host',
+    'host_rank': rank,
+    'host_ips': hosts,
+    'num_hosts': len(hosts),
+    'tpu_slice': {tpu_slice!r},
+    'peer_agent_urls': [f'http://{{h}}:{AGENT_PORT}'
+                        for i, h in enumerate(hosts) if i != rank]
+                       if rank == 0 else [],
+}}
+with open(os.path.join(node_dir, 'agent_config.json'), 'w') as f:
+    json.dump(cfg, f)
+PYEOF
+exec env SKY_TPU_SLURM_HOSTS="$HOSTS" python3 -m \\
+    skypilot_tpu.runtime.agent --cluster-dir "$NODE_DIR" \\
+    --host 0.0.0.0 --port {AGENT_PORT}
+"""
+
+
+def _sbatch_script(config: ProvisionConfig, cdir: str) -> str:
+    pc = config.provider_config
+    lines = ['#!/bin/bash',
+             f'#SBATCH --job-name=sky-tpu-{config.cluster_name}',
+             f'#SBATCH --nodes={config.num_hosts}',
+             '#SBATCH --ntasks-per-node=1',
+             f'#SBATCH --output={cdir}/slurm.log']
+    if pc.get('partition'):
+        lines.append(f'#SBATCH --partition={pc["partition"]}')
+    if pc.get('account'):
+        lines.append(f'#SBATCH --account={pc["account"]}')
+    if pc.get('time_limit'):
+        lines.append(f'#SBATCH --time={pc["time_limit"]}')
+    lines += [
+        'export SKY_TPU_SLURM_HOSTS="$(scontrol show hostnames '
+        '"$SLURM_JOB_NODELIST")"',
+        f'srun --ntasks-per-node=1 bash {cdir}/node_start.sh',
+    ]
+    return '\n'.join(lines) + '\n'
+
+
+def _submit(config: ProvisionConfig, cdir: str) -> str:
+    with open(os.path.join(cdir, 'node_start.sh'), 'w',
+              encoding='utf-8') as f:
+        f.write(_node_script(cdir, config.cluster_name, config.tpu_slice))
+    sbatch_path = os.path.join(cdir, 'job.sbatch')
+    with open(sbatch_path, 'w', encoding='utf-8') as f:
+        f.write(_sbatch_script(config, cdir))
+    out = _run(['sbatch', '--parsable', sbatch_path])
+    # --parsable: "<jobid>" or "<jobid>;<cluster>".
+    return out.strip().split(';')[0]
+
+
+def run_instances(config: ProvisionConfig) -> ClusterInfo:
+    if config.num_slices > 1:
+        raise exceptions.ProvisionError(
+            'multislice (num_slices > 1) is supported on the gcp and '
+            'local providers only', retryable=False)
+    cdir = _cluster_dir(config.cluster_name)
+    os.makedirs(cdir, exist_ok=True)
+    job_id = _submit(config, cdir)
+    _write_meta(cdir, {
+        'cluster_name': config.cluster_name,
+        'job_id': job_id,
+        'num_hosts': config.num_hosts,
+        'tpu_slice': config.tpu_slice,
+        'instance_type': config.instance_type,
+        'provider_config': {k: v for k, v in
+                            config.provider_config.items()
+                            if isinstance(v, (str, int, float, bool))},
+        'created_at': time.time(),
+    })
+    info = get_cluster_info(config.cluster_name, config.provider_config)
+    assert info is not None
+    return info
+
+
+def _job_status(job_id: str) -> tuple:
+    """(state code, node hostnames) in ONE squeue round trip.
+
+    A finished job ages out of squeue after MinJobAge; real squeue then
+    prints 'Invalid job id' and exits NONZERO — that is the normal
+    'GONE' case, not an error.
+    """
+    try:
+        out = _run(['squeue', '-h', '-j', job_id, '-o', '%t %N'])
+    except exceptions.ProvisionError:
+        return 'GONE', []
+    line = out.strip().splitlines()
+    if not line:
+        return 'GONE', []
+    parts = line[0].split(None, 1)
+    state = parts[0].strip()
+    nodelist = parts[1].strip() if len(parts) > 1 else ''
+    nodes: List[str] = []
+    if state == 'R' and nodelist:
+        nodes = _run(['scontrol', 'show', 'hostnames',
+                      nodelist]).split()
+    return state, nodes
+
+
+def get_cluster_info(cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> Optional[ClusterInfo]:
+    cdir = _cluster_dir(cluster_name)
+    meta = _meta(cdir)
+    if meta is None:
+        return None
+    job_id = meta.get('job_id')
+    state, nodes = _job_status(job_id) if job_id else ('GONE', [])
+    host_state = {'R': 'RUNNING', 'PD': 'PROVISIONING',
+                  'CG': 'STOPPED'}.get(state, 'STOPPED')
+    if not nodes:
+        # Not (or no longer) allocated: synthesize placeholders so the
+        # host count survives for status displays.
+        nodes = [f'<pending-{i}>' for i in range(meta['num_hosts'])]
+    hosts = [HostInfo(
+        host_id=f'{cluster_name}-node{i}',
+        internal_ip=n,
+        external_ip=n if not n.startswith('<') else None,
+        state=host_state,
+        agent_url=(f'http://{n}:{AGENT_PORT}'
+                   if host_state == 'RUNNING' else None))
+        for i, n in enumerate(nodes)]
+    return ClusterInfo(
+        cluster_name=cluster_name,
+        cloud='slurm',
+        region=meta.get('provider_config', {}).get('partition',
+                                                   'default'),
+        zone='slurm',
+        hosts=hosts,
+        tpu_slice=meta.get('tpu_slice'),
+        instance_type=meta.get('instance_type'),
+        cost_per_hour=0.0,     # on-prem allocation: sunk cost
+        # cluster_dir routes the backend's file sync through the SHARED
+        # FILESYSTEM (login node and compute nodes see the same
+        # $SKY_TPU_HOME — the standard Slurm deployment): workdir sync
+        # is a local copy into host<i>/workdir, exactly where each
+        # node's agent runs jobs.
+        provider_config={**meta.get('provider_config', {}),
+                         'job_id': job_id, 'cluster_dir': cdir})
+
+
+def wait_instances(cluster_name: str, provider_config: Dict[str, Any],
+                   state: str = 'RUNNING') -> None:
+    meta = _meta(_cluster_dir(cluster_name))
+    if meta is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    want = {'RUNNING': 'R'}.get(state, state)
+    deadline = time.time() + float(
+        provider_config.get('provision_timeout_s', 600))
+    while time.time() < deadline:
+        st, _ = _job_status(meta['job_id'])
+        if st == want:
+            return
+        if st in ('F', 'CA', 'TO', 'NF', 'GONE'):
+            raise exceptions.CapacityError(
+                f'[slurm] job {meta["job_id"]} entered {st} '
+                f'(queue rejected / failed)')
+        if st in ('CD', 'BF', 'OOM', 'DL', 'PR'):
+            # Allocated, ran, and already exited: the node payload
+            # crashed (e.g. python missing on compute nodes) — fail fast
+            # with the real cause, not a 10-minute "still queued?".
+            raise exceptions.ProvisionError(
+                f'[slurm] job {meta["job_id"]} exited immediately '
+                f'({st}); check slurm.log in the cluster dir — the '
+                f'agent payload likely failed on the compute nodes',
+                retryable=False)
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'[slurm] job {meta["job_id"]} not {want} in time '
+        f'(still queued? check the partition)')
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    """Release the allocation; metadata survives for a later start."""
+    meta = _meta(_cluster_dir(cluster_name))
+    if meta and meta.get('job_id'):
+        _run(['scancel', meta['job_id']])
+
+
+def start_instances(cluster_name: str,
+                    provider_config: Dict[str, Any]) -> ClusterInfo:
+    cdir = _cluster_dir(cluster_name)
+    meta = _meta(cdir)
+    if meta is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    cfg = ProvisionConfig(
+        cluster_name=cluster_name, region='slurm', zone='slurm',
+        instance_type=meta.get('instance_type') or 'slurm-node',
+        num_hosts=meta['num_hosts'], tpu_slice=meta.get('tpu_slice'),
+        provider_config={**meta.get('provider_config', {}),
+                         **provider_config})
+    meta['job_id'] = _submit(cfg, cdir)
+    _write_meta(cdir, meta)
+    info = get_cluster_info(cluster_name, provider_config)
+    assert info is not None
+    return info
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    cdir = _cluster_dir(cluster_name)
+    meta = _meta(cdir)
+    if meta and meta.get('job_id'):
+        try:
+            _run(['scancel', meta['job_id']])
+        except exceptions.SkyTpuError:
+            pass   # already gone
+    shutil.rmtree(cdir, ignore_errors=True)
+
+
+def open_ports(cluster_name: str, ports,
+               provider_config: Dict[str, Any]) -> None:
+    del cluster_name, ports, provider_config   # intra-cluster network

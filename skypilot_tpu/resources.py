@@ -21,7 +21,7 @@ _ACC_RE = re.compile(r'^([A-Za-z0-9\-]+?)(?::(\d+))?$')
 # Clouds known to the framework. 'local' is the in-process fake used by tests
 # and the minimum-E2E path (reference analog: the mock_aws_backend fixture,
 # reference tests/conftest.py:33).
-KNOWN_CLOUDS = ('gcp', 'local', 'ssh', 'kubernetes')
+KNOWN_CLOUDS = ('gcp', 'local', 'ssh', 'kubernetes', 'slurm')
 
 
 @dataclasses.dataclass(frozen=True)

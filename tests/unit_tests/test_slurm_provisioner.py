@@ -1,0 +1,158 @@
+"""Slurm provider against stub sbatch/squeue/scontrol/scancel binaries
+(the fake-cloud strategy applied to Slurm: reference treats slurm as a
+cloud, sky/clouds/slurm.py; here the whole provider contract runs with
+zero real Slurm)."""
+import json
+import os
+import stat
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import ProvisionConfig
+from skypilot_tpu.provision.slurm import instance as slurm_instance
+
+
+@pytest.fixture
+def slurm_stubs(tmp_path, monkeypatch):
+    """Stub Slurm CLI: sbatch prints a job id and records the script;
+    squeue reports state from a control file; scontrol expands the
+    nodelist; scancel flips the state file to gone."""
+    bindir = tmp_path / 'bin'
+    bindir.mkdir()
+    state_file = tmp_path / 'job_state'
+    state_file.write_text('R')
+
+    def stub(name: str, body: str) -> None:
+        p = bindir / name
+        p.write_text('#!/bin/bash\n' + body)
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+
+    stub('sbatch', f'cp "${{@: -1}}" {tmp_path}/submitted.sbatch\n'
+                   'echo 4242\n')
+    # Real squeue exits NONZERO for an expired job id — model that.
+    stub('squeue', f'[ "$(cat {state_file})" = GONE ] && '
+                   'echo "slurm_load_jobs error: Invalid job id" >&2 && '
+                   'exit 1\n'
+                   f'echo "$(cat {state_file}) node[01-02]"\n')
+    stub('scontrol', 'echo node01; echo node02\n')
+    stub('scancel', f'echo GONE > {state_file}\n')
+    monkeypatch.setenv('PATH', f'{bindir}:{os.environ["PATH"]}')
+    return {'state_file': state_file, 'tmp': tmp_path}
+
+
+def _config(name='sl-c'):
+    return ProvisionConfig(
+        cluster_name=name, region='tpu-part', zone='slurm',
+        instance_type='tpu-v4-16', num_hosts=2, tpu_slice='v4-16',
+        provider_config={'partition': 'tpu-part', 'account': 'acct'})
+
+
+def test_provision_roundtrip(slurm_stubs, tmp_path, monkeypatch):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path / 'home'))
+    info = slurm_instance.run_instances(_config())
+    assert info.cloud == 'slurm'
+    assert info.num_hosts == 2
+    assert [h.internal_ip for h in info.hosts] == ['node01', 'node02']
+    assert info.head.agent_url == 'http://node01:46590'
+    assert info.cost_per_hour == 0.0
+    assert info.provider_config['job_id'] == '4242'
+    # The submitted batch script carries the gang + partition + agent.
+    script = (slurm_stubs['tmp'] / 'submitted.sbatch').read_text()
+    assert '--nodes=2' in script
+    assert '--partition=tpu-part' in script
+    assert '--account=acct' in script
+    assert 'srun --ntasks-per-node=1' in script
+    # The node payload starts the standard agent in host mode, rooted at
+    # host<rank>/ on the shared filesystem (the backend's file-sync
+    # convention).
+    cdir = slurm_instance._cluster_dir('sl-c')
+    node = open(os.path.join(cdir, 'node_start.sh')).read()
+    assert 'skypilot_tpu.runtime.agent' in node
+    assert "'mode': 'host'" in node
+    assert 'host$RANK' in node
+    assert info.provider_config['cluster_dir'] == cdir
+    slurm_instance.wait_instances('sl-c', {})     # already R
+    # stop = scancel; info degrades to STOPPED placeholders.
+    slurm_instance.stop_instances('sl-c', {})
+    info2 = slurm_instance.get_cluster_info('sl-c', {})
+    assert all(h.state == 'STOPPED' for h in info2.hosts)
+    assert info2.num_hosts == 2                   # metadata survives
+    # start resubmits (stub state file back to R).
+    slurm_stubs['state_file'].write_text('R')
+    info3 = slurm_instance.start_instances('sl-c', {})
+    assert info3.head.agent_url == 'http://node01:46590'
+    slurm_instance.terminate_instances('sl-c', {})
+    assert slurm_instance.get_cluster_info('sl-c', {}) is None
+
+
+def test_queue_rejection_is_capacity_error(slurm_stubs, tmp_path,
+                                           monkeypatch):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path / 'home'))
+    slurm_stubs['state_file'].write_text('PD')
+    slurm_instance.run_instances(_config('sl-pd'))
+    slurm_stubs['state_file'].write_text('F')
+    with pytest.raises(exceptions.CapacityError):
+        slurm_instance.wait_instances('sl-pd', {})
+
+
+def test_multislice_rejected(slurm_stubs, tmp_path, monkeypatch):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path / 'home'))
+    cfg = _config('sl-ms')
+    cfg.num_slices = 2
+    with pytest.raises(exceptions.ProvisionError, match='multislice'):
+        slurm_instance.run_instances(cfg)
+
+
+def test_no_slurm_tools_is_no_access(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path / 'home'))
+    monkeypatch.setenv('PATH', str(tmp_path))     # empty PATH
+    with pytest.raises(exceptions.NoCloudAccessError):
+        slurm_instance.run_instances(_config('sl-x'))
+
+
+def test_slurm_candidate_and_capability(tmp_path, monkeypatch):
+    import skypilot_tpu as sky
+    from skypilot_tpu import catalog
+    from skypilot_tpu import cloud_capabilities as caps
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path / 'home'))
+    res = sky.Resources(cloud='slurm', accelerators='v4-16')
+    cands = catalog.get_candidates(res)
+    assert len(cands) == 1
+    c = cands[0]
+    assert (c.cloud, c.num_hosts, c.cost_per_hour) == ('slurm', 2, 0.0)
+    # No spot market on-prem: pinned slurm + spot raises with the name.
+    with pytest.raises(exceptions.ResourcesMismatchError, match='spot'):
+        catalog.get_candidates(
+            sky.Resources(cloud='slurm', accelerators='v4-16',
+                          use_spot=True),
+            required=frozenset({caps.Feature.SPOT}))
+
+
+def test_pinned_partition_reaches_sbatch(slurm_stubs, tmp_path,
+                                         monkeypatch):
+    """Resources(region=...) names the partition; it must survive into
+    the sbatch script even with no slurm: config section (code-review
+    regression)."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import catalog
+    from skypilot_tpu.provision import provisioner
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path / 'home'))
+    res = sky.Resources(cloud='slurm', accelerators='v4-16',
+                        region='a100-queue')
+    (cand,) = catalog.get_candidates(res)
+    cfg = provisioner._make_config(cand, 'sl-part', res)  # noqa: SLF001
+    assert cfg.provider_config['partition'] == 'a100-queue'
+    slurm_instance.run_instances(cfg)
+    script = (slurm_stubs['tmp'] / 'submitted.sbatch').read_text()
+    assert '--partition=a100-queue' in script
+
+
+def test_immediate_exit_fails_fast(slurm_stubs, tmp_path, monkeypatch):
+    monkeypatch.setenv('SKY_TPU_HOME', str(tmp_path / 'home'))
+    slurm_stubs['state_file'].write_text('PD')
+    slurm_instance.run_instances(_config('sl-cd'))
+    slurm_stubs['state_file'].write_text('CD')
+    with pytest.raises(exceptions.ProvisionError,
+                       match='exited immediately'):
+        slurm_instance.wait_instances('sl-cd', {})
