@@ -43,6 +43,13 @@ class StoreType(enum.Enum):
     R2 = 'r2'
     AZURE = 'azure'
     LOCAL = 'local'              # file:// — used by tests and fake slices
+    # S3-compatible providers (reference ships one SDK-backed class each,
+    # storage.py:3020-4386; here: one endpoint-configured S3 code path).
+    NEBIUS = 'nebius'
+    COREWEAVE = 'cw'
+    VAST = 'vast'
+    IBM_COS = 'cos'
+    OCI = 'oci'
 
     @classmethod
     def from_url(cls, url: str) -> 'StoreType':
@@ -57,8 +64,13 @@ class StoreType(enum.Enum):
             return cls.AZURE
         if url.startswith('file://') or url.startswith('/'):
             return cls.LOCAL
+        for st in (cls.NEBIUS, cls.COREWEAVE, cls.VAST, cls.IBM_COS,
+                   cls.OCI):
+            if url.startswith(f'{st.value}://'):
+                return st
         raise exceptions.StorageError(
             f'Unsupported storage source {url!r} (want gs:// s3:// r2:// '
+            'nebius:// cw:// vast:// cos:// oci:// '
             'https://<acct>.blob.core.windows.net/... or file://)')
 
 
@@ -261,6 +273,75 @@ class R2Store(S3Store):
         return f'r2://{self.name}{tail}'
 
 
+class _EndpointS3Store(S3Store):
+    """Base for S3-compatible providers: same aws-CLI code path as S3,
+    pointed at the provider's endpoint from an env var. The endpoint is
+    REQUIRED — without it every call would silently target AWS."""
+
+    # Subclasses set these.
+    endpoint_env: str = ''
+    provider_label: str = ''
+
+    def __init__(self, name: str, sub_path: str = '') -> None:
+        super().__init__(name, sub_path)
+        endpoint = os.environ.get(self.endpoint_env, '')
+        if not endpoint:
+            raise exceptions.StorageError(
+                f'{self.store_type.value}:// storage needs '
+                f'{self.endpoint_env} set to your {self.provider_label} '
+                f'S3-compatible endpoint URL')
+        self._endpoint_url = endpoint
+
+    @property
+    def url(self) -> str:
+        tail = f'/{self.sub_path}' if self.sub_path else ''
+        return f'{self.store_type.value}://{self.name}{tail}'
+
+    def mount_command(self, dst: str, mode: StorageMode) -> str:
+        # Command builders speak s3:// + endpoint; the provider scheme
+        # is a client-side spelling only.
+        if mode == StorageMode.COPY:
+            tail = f'/{self.sub_path}' if self.sub_path else ''
+            return mounting_utils.copy_command(
+                f's3://{self.name}{tail}', dst,
+                endpoint_url=self._endpoint_url)
+        return mounting_utils.s3_mount_command(
+            self.name, dst, sub_path=self.sub_path,
+            endpoint_url=self._endpoint_url)
+
+
+class NebiusStore(_EndpointS3Store):
+    store_type = StoreType.NEBIUS
+    endpoint_env = 'NEBIUS_S3_ENDPOINT'
+    provider_label = 'Nebius Object Storage'
+
+
+class CoreWeaveStore(_EndpointS3Store):
+    store_type = StoreType.COREWEAVE
+    endpoint_env = 'COREWEAVE_S3_ENDPOINT'
+    provider_label = 'CoreWeave Object Storage'
+
+
+class VastStore(_EndpointS3Store):
+    store_type = StoreType.VAST
+    endpoint_env = 'VAST_S3_ENDPOINT'
+    provider_label = 'VAST Data'
+
+
+class IbmCosStore(_EndpointS3Store):
+    store_type = StoreType.IBM_COS
+    endpoint_env = 'IBM_COS_ENDPOINT'
+    provider_label = 'IBM Cloud Object Storage'
+
+
+class OciStore(_EndpointS3Store):
+    store_type = StoreType.OCI
+    endpoint_env = 'OCI_S3_ENDPOINT'
+    provider_label = ('OCI Object Storage (the '
+                      '<namespace>.compat.objectstorage.<region> '
+                      'S3-compatibility endpoint)')
+
+
 class AzureBlobStore(AbstractStore):
     """Azure Blob container via az CLI / azcopy (reference
     AzureBlobStore :2484)."""
@@ -372,6 +453,11 @@ _STORE_CLASSES: Dict[StoreType, Type[AbstractStore]] = {
     StoreType.R2: R2Store,
     StoreType.AZURE: AzureBlobStore,
     StoreType.LOCAL: LocalStore,
+    StoreType.NEBIUS: NebiusStore,
+    StoreType.COREWEAVE: CoreWeaveStore,
+    StoreType.VAST: VastStore,
+    StoreType.IBM_COS: IbmCosStore,
+    StoreType.OCI: OciStore,
 }
 
 

@@ -260,6 +260,23 @@ class Agent:
                 return json.load(f)
         return {'idle_minutes': -1, 'down': False}
 
+    async def heartbeat_loop(self) -> None:
+        """Reference UsageHeartbeatReportEvent (sky/skylet/events.py:153):
+        the on-cluster runtime reports liveness into the usage stream."""
+        from skypilot_tpu import usage
+        while True:
+            try:
+                usage.record('agent-heartbeat', 0.0, 'ok', {
+                    'cluster': self.config.get('cluster_name', '?'),
+                    'mode': self.mode,
+                    'num_hosts': self.num_hosts,
+                    'num_slices': self.num_slices,
+                    'idle': self.jobs.is_idle(),
+                })
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                pass
+            await asyncio.sleep(600.0)
+
     async def autostop_loop(self) -> None:
         """Reference AutostopEvent (sky/skylet/events.py:161): the cluster
         tears *itself* down after idling."""
@@ -481,6 +498,7 @@ async def _main(cluster_dir: str, host: str, port: int) -> None:
     loop = asyncio.get_event_loop()
     loop.create_task(agent.scheduler_loop())
     loop.create_task(agent.autostop_loop())
+    loop.create_task(agent.heartbeat_loop())
     while True:
         await asyncio.sleep(3600)
 
