@@ -572,6 +572,9 @@ def api_login(timeout: float) -> None:
     challenge = sessions.compute_code_challenge(verifier)
     authorize = f'{url}/auth/authorize?code_challenge={challenge}'
     click.echo(f'Authorize this CLI in your browser:\n  {authorize}')
+    click.echo(f'Verification code: {sessions.user_code(challenge)} '
+               '— the browser page must show the SAME code before you '
+               'click Authorize.')
     try:
         webbrowser.open(authorize)
     except Exception:  # noqa: BLE001 — headless host; URL printed above

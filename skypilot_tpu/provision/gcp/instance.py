@@ -106,34 +106,44 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
                 metadata=config.provider_config.get('metadata'),
                 data_disks=config.data_disks)
     except Exception:
-        import time as time_lib
-        for name in created:
-            # The in-flight node may still be CREATING — GCP answers 409
-            # to a delete racing its create op. Retry briefly; a node
-            # that still survives is logged loud (it bills until removed)
-            # rather than silently leaked.
-            for attempt in range(4):
-                try:
-                    client.delete_node(config.zone, name)
-                    break
-                except Exception as de:  # noqa: BLE001 — rollback path
-                    if attempt == 3:
-                        logger.error(
-                            'multislice rollback could not delete TPU '
-                            'node %s/%s: %s — delete it manually or '
-                            'relaunch will fail with already-exists',
-                            config.zone, name, de)
-                    else:
-                        time_lib.sleep(10 * (attempt + 1))
+        _rollback_created(client, config.zone, created)
         raise
     info = get_cluster_info(config.cluster_name, {
         **config.provider_config, 'zone': config.zone,
         'num_slices': config.num_slices})
     if info is None:
+        # All creates returned but a node is gone on re-read. Same gang
+        # atomicity rule as a failed create: tear down the survivors
+        # before raising, or they bill until someone notices.
+        _rollback_created(client, config.zone, created)
         raise exceptions.ProvisionError(
             f'TPU node {config.cluster_name} vanished after create')
     _install_agents(info, config)
     return info
+
+
+def _rollback_created(client: 'tpu_api.TpuApiClient', zone: str,
+                      created: List[str]) -> None:
+    """Best-effort delete of a partially-created multislice gang."""
+    import time as time_lib
+    for name in created:
+        # The in-flight node may still be CREATING — GCP answers 409
+        # to a delete racing its create op. Retry briefly; a node
+        # that still survives is logged loud (it bills until removed)
+        # rather than silently leaked.
+        for attempt in range(4):
+            try:
+                client.delete_node(zone, name)
+                break
+            except Exception as de:  # noqa: BLE001 — rollback path
+                if attempt == 3:
+                    logger.error(
+                        'multislice rollback could not delete TPU '
+                        'node %s/%s: %s — delete it manually or '
+                        'relaunch will fail with already-exists',
+                        zone, name, de)
+                else:
+                    time_lib.sleep(10 * (attempt + 1))
 
 
 def _install_agents(info: ClusterInfo, config: ProvisionConfig) -> None:

@@ -186,7 +186,13 @@ def _job_status(job_id: str) -> tuple:
     """
     try:
         out = _run(['squeue', '-h', '-j', job_id, '-o', '%t %N'])
-    except exceptions.ProvisionError:
+    except exceptions.ProvisionError as e:
+        # Only the job-aged-out case is 'GONE'. A hung/timed-out
+        # slurmctld is a transient control-plane outage — re-raise so
+        # the caller retries instead of misreading it as a capacity
+        # rejection and blocklisting the placement.
+        if getattr(e, 'retryable', False):
+            raise
         return 'GONE', []
     line = out.strip().splitlines()
     if not line:
@@ -252,7 +258,15 @@ def wait_instances(cluster_name: str, provider_config: Dict[str, Any],
     deadline = time.time() + float(
         provider_config.get('provision_timeout_s', 600))
     while time.time() < deadline:
-        st, _ = _job_status(meta['job_id'])
+        try:
+            st, _ = _job_status(meta['job_id'])
+        except exceptions.ProvisionError as e:
+            # Transient slurmctld outage (squeue timeout): keep polling
+            # until the deadline rather than aborting the attempt.
+            if getattr(e, 'retryable', False):
+                time.sleep(5)
+                continue
+            raise
         if st == want:
             return
         if st in ('F', 'CA', 'TO', 'NF', 'GONE'):

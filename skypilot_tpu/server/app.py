@@ -24,6 +24,7 @@ import argparse
 import asyncio
 import contextlib
 import functools
+import html as html_lib
 import io
 import json
 import logging
@@ -606,36 +607,86 @@ class Server:
                                      status=404)
         return await self.oauth2.forward(req)
 
+    async def _auth_request_user(self, req: web.Request):
+        """The authenticated user for an /auth/authorize request, or
+        None (→ caller answers 401). Loopback operator counts."""
+        user = req.get('user')
+        if user is not None:
+            return user
+        from skypilot_tpu.server.auth import loopback as loopback_lib
+        if not loopback_lib.is_loopback_request(req):
+            return None
+        from skypilot_tpu import users as users_lib
+        return await asyncio.get_event_loop().run_in_executor(
+            self.short_pool, users_lib.core.ensure_user)
+
     async def h_auth_authorize(self, req: web.Request) -> web.Response:
-        """Browser half of `sky-tpu api login`: the (authenticated)
-        browser request mints a bearer token for the user and parks it
-        under the code_challenge for the CLI to collect."""
+        """Browser half of `sky-tpu api login`, step 1: serve a
+        confirmation page. Nothing is minted or parked on GET — a bare
+        link click must not authorize anything (login-CSRF); the page
+        shows a verification code the user compares with their terminal
+        and a CSRF-protected Authorize button that POSTs step 2."""
         challenge = req.query.get('code_challenge')
         if not challenge:
             return web.json_response({'error': 'missing code_challenge'},
                                      status=400)
-        user = req.get('user')
+        user = await self._auth_request_user(req)
         if user is None:
-            from skypilot_tpu.server.auth import loopback as loopback_lib
-            if not loopback_lib.is_loopback_request(req):
-                return web.json_response(
-                    {'error': 'authenticate first (SSO or bearer token) '
-                              'to authorize a CLI login'}, status=401)
-            from skypilot_tpu import users as users_lib
-            user = await asyncio.get_event_loop().run_in_executor(
-                self.short_pool, users_lib.core.ensure_user)
-
-        def mint_and_park():
-            from skypilot_tpu import users as users_lib
-            from skypilot_tpu.server.auth import sessions
-            token = users_lib.core.create_token(
-                'cli-login', user_id=user['id'],
-                expires_in_s=30 * 24 * 3600.0)
-            sessions.AuthSessionStore().create_session(challenge, token)
-
-        await asyncio.get_event_loop().run_in_executor(
-            self.short_pool, mint_and_park)
+            return web.json_response(
+                {'error': 'authenticate first (SSO or bearer token) '
+                          'to authorize a CLI login'}, status=401)
+        from skypilot_tpu.server.auth import sessions
+        csrf = sessions.make_csrf_token(challenge, user['id'])
+        code = sessions.user_code(challenge)
         return web.Response(
+            # Frame-busting: an iframed authorize page would let a decoy
+            # overlay defeat the verification-code check (clickjacking).
+            headers={'X-Frame-Options': 'DENY',
+                     'Content-Security-Policy': "frame-ancestors 'none'"},
+            text=f'''<html><body>
+<h2>Authorize CLI login?</h2>
+<p>A command-line client is asking to act as
+<b>{html_lib.escape(user.get("name") or user["id"])}</b>.</p>
+<p>Verification code: <b id="user-code">{code}</b><br>
+Confirm it matches the code shown in your terminal. If you did not just
+run <code>sky-tpu api login</code>, close this page.</p>
+<form method="post" action="/auth/authorize">
+  <input type="hidden" name="code_challenge"
+         value="{html_lib.escape(challenge)}">
+  <input type="hidden" name="csrf" value="{csrf}">
+  <button type="submit">Authorize</button>
+</form>
+</body></html>''',
+            content_type='text/html')
+
+    async def h_auth_authorize_post(self, req: web.Request
+                                    ) -> web.Response:
+        """Browser half, step 2: the user clicked Authorize. Verify the
+        CSRF token against *this* request's user, then park the user id
+        (not a token — minting happens at poll time)."""
+        form = await req.post()
+        challenge = str(form.get('code_challenge', ''))
+        csrf = str(form.get('csrf', ''))
+        if not challenge:
+            return web.json_response({'error': 'missing code_challenge'},
+                                     status=400)
+        user = await self._auth_request_user(req)
+        if user is None:
+            return web.json_response(
+                {'error': 'authenticate first (SSO or bearer token) '
+                          'to authorize a CLI login'}, status=401)
+        from skypilot_tpu.server.auth import sessions
+        if not sessions.check_csrf_token(csrf, challenge, user['id']):
+            return web.json_response(
+                {'error': 'invalid or expired csrf token — reload the '
+                          'authorize page'}, status=403)
+        await asyncio.get_event_loop().run_in_executor(
+            self.short_pool,
+            sessions.AuthSessionStore().create_session, challenge,
+            user['id'])
+        return web.Response(
+            headers={'X-Frame-Options': 'DENY',
+                     'Content-Security-Policy': "frame-ancestors 'none'"},
             text='<html><body><h2>Login complete.</h2>'
                  '<p>Return to your terminal — the CLI picks the token '
                  'up automatically.</p></body></html>',
@@ -644,7 +695,9 @@ class Server:
     async def h_auth_token(self, req: web.Request) -> web.Response:
         """CLI half: poll with the code_verifier until the browser
         authorizes. Unauthenticated by design (the CLI has no token yet);
-        possession of the verifier IS the proof."""
+        possession of the verifier IS the proof. The bearer token is
+        minted HERE — at claim time, for the parked user — so an
+        unclaimed session never holds a live credential."""
         try:
             body = await req.json()
         except json.JSONDecodeError:
@@ -654,10 +707,18 @@ class Server:
         if not verifier:
             return web.json_response({'error': 'missing code_verifier'},
                                      status=400)
-        from skypilot_tpu.server.auth import sessions
+
+        def claim():
+            from skypilot_tpu import users as users_lib
+            from skypilot_tpu.server.auth import sessions
+            uid = sessions.AuthSessionStore().poll_session(verifier)
+            if uid is None:
+                return None
+            return users_lib.core.create_token(
+                'cli-login', user_id=uid, expires_in_s=30 * 24 * 3600.0)
+
         token = await asyncio.get_event_loop().run_in_executor(
-            self.short_pool,
-            sessions.AuthSessionStore().poll_session, verifier)
+            self.short_pool, claim)
         if token is None:
             return web.json_response({'status': 'pending'}, status=202)
         return web.json_response({'status': 'ok', 'token': token})
@@ -687,6 +748,8 @@ class Server:
         app.router.add_route('*', '/oauth2/{tail:.*}',
                              self.h_oauth2_forward)
         app.router.add_get('/auth/authorize', self.h_auth_authorize)
+        app.router.add_post('/auth/authorize',
+                            self.h_auth_authorize_post)
         app.router.add_post('/auth/token', self.h_auth_token)
         app.router.add_post('/{op:[a-z_.]+}', self.h_op)
         return app

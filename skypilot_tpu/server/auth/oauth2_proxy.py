@@ -62,10 +62,19 @@ class OAuth2ProxyAuthenticator:
         """Proxy /oauth2/* through to oauth2-proxy (start/callback/...)."""
         target = f'{self.base_url}{req.path}'
         body = await req.read()
+        # Strip Host (aiohttp sets the target's), Cookie (supplied once
+        # via the session — copying the header too emits duplicates),
+        # and hop-by-hop headers, which must not be forwarded.
+        hop_by_hop = {'host', 'cookie', 'connection', 'keep-alive',
+                      'proxy-authenticate', 'proxy-authorization', 'te',
+                      'trailers', 'transfer-encoding', 'upgrade',
+                      'content-length'}
+        fwd_headers = {k: v for k, v in req.headers.items()
+                       if k.lower() not in hop_by_hop}
         try:
             async with aiohttp.ClientSession(cookies=req.cookies) as sess:
                 async with sess.request(
-                        req.method, target, headers=dict(req.headers),
+                        req.method, target, headers=fwd_headers,
                         params=dict(req.query), data=body,
                         allow_redirects=False,
                         timeout=aiohttp.ClientTimeout(total=15)) as r:

@@ -3,17 +3,22 @@ sky/server/auth/sessions.py).
 
 Flow: ``sky-tpu api login`` generates a random code_verifier, opens the
 browser at ``/auth/authorize?code_challenge=sha256(verifier)`` and polls
-``/auth/token`` with the verifier. The browser request is authenticated
-(oauth2-proxy/SSO); the server mints a bearer token for that user and
-parks it under the code_challenge. The poll computes the challenge from
-the verifier and atomically consumes the session — so the token transits
-only over the two TLS legs, never through the browser URL.
+``/auth/token`` with the verifier. The browser GET serves a confirmation
+page showing a short verification code (also printed by the CLI); the
+user compares the codes and clicks Authorize, which POSTs back with a
+CSRF token. Only then is the session parked — and what is parked is the
+authenticated **user id**, not a token: the bearer token is minted at
+poll time, when the CLI proves possession of the verifier. So no live
+token ever sits at rest in the session DB, and an unclaimed session
+expires without leaving a valid credential behind.
 """
 from __future__ import annotations
 
 import base64
 import hashlib
+import hmac
 import os
+import secrets
 import time
 from typing import Optional
 
@@ -21,11 +26,12 @@ from skypilot_tpu.utils import common
 from skypilot_tpu.utils import db as db_util
 
 SESSION_TIMEOUT_S = 600.0
+CSRF_TIMEOUT_S = 600.0
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS auth_sessions (
     code_challenge TEXT PRIMARY KEY,
-    token TEXT NOT NULL,
+    user_id TEXT NOT NULL,
     created_at REAL NOT NULL
 );
 """
@@ -36,6 +42,72 @@ def compute_code_challenge(code_verifier: str) -> str:
     return base64.urlsafe_b64encode(digest).decode().rstrip('=')
 
 
+def user_code(code_challenge: str) -> str:
+    """Short human-comparable verification code, derived from the
+    challenge so the CLI and the authorize page compute it
+    independently (phishing-resistance: a victim lured to an attacker's
+    authorize link sees a code that does not match their terminal)."""
+    digest = hashlib.sha256(('user-code:' + code_challenge).encode())
+    code = base64.b32encode(digest.digest()[:5]).decode()[:8]
+    return f'{code[:4]}-{code[4:]}'
+
+
+# ---- CSRF tokens for the authorize confirmation form -----------------
+# Synchronizer-token scheme: the GET page embeds an HMAC bound to
+# (challenge, authenticated user, timestamp); the POST must echo it and
+# is verified against the *posting* request's user. A cross-site
+# attacker can neither read the victim's page (same-origin policy) nor
+# substitute a token minted for their own account (user id mismatch).
+
+_SECRET_FILE = 'login_csrf.key'
+
+
+def _csrf_secret() -> bytes:
+    """Read-or-generate, atomically: generate into a temp file and
+    rename-over, then re-read. Two racing first users both rename a
+    full 32-byte key, so a reader never observes a partial write and
+    the loser's re-read picks up whichever key won."""
+    path = os.path.join(common.base_dir(), _SECRET_FILE)
+    for _ in range(2):
+        try:
+            with open(path, 'rb') as f:
+                key = f.read()
+            if len(key) >= 32:
+                return key
+        except OSError:
+            pass
+        tmp = f'{path}.{os.getpid()}.tmp'
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, 'wb') as f:
+            f.write(secrets.token_bytes(32))
+        os.replace(tmp, path)
+    with open(path, 'rb') as f:
+        return f.read()
+
+
+def _csrf_mac(challenge: str, uid: str, ts: str) -> str:
+    msg = f'{challenge}|{uid}|{ts}'.encode()
+    return hmac.new(_csrf_secret(), msg, hashlib.sha256).hexdigest()
+
+
+def make_csrf_token(code_challenge: str, uid: str) -> str:
+    ts = str(int(time.time()))
+    return f'{ts}.{_csrf_mac(code_challenge, uid, ts)}'
+
+
+def check_csrf_token(token: str, code_challenge: str, uid: str) -> bool:
+    try:
+        ts, mac = token.split('.', 1)
+        if time.time() - float(ts) > CSRF_TIMEOUT_S:
+            return False
+    except ValueError:
+        return False
+    return hmac.compare_digest(mac, _csrf_mac(code_challenge, uid, ts))
+
+
+_migrated_paths = set()
+
+
 class AuthSessionStore:
     def __init__(self, db_path: Optional[str] = None):
         self.db_path = db_path or os.path.join(common.base_dir(),
@@ -43,25 +115,41 @@ class AuthSessionStore:
 
     @property
     def _conn(self):
-        return db_util.get_db(self.db_path, _SCHEMA).conn
+        conn = db_util.get_db(self.db_path, _SCHEMA).conn
+        if self.db_path not in _migrated_paths:
+            # Pre-round-3 stores parked the minted token itself (column
+            # `token`). Those rows are stale short-lived sessions; drop
+            # the old-shape table rather than carry a migration. Checked
+            # once per path per process.
+            try:
+                conn.execute('SELECT user_id FROM auth_sessions LIMIT 1')
+            except Exception:  # noqa: BLE001 — old schema
+                conn.execute('DROP TABLE auth_sessions')
+                conn.execute(_SCHEMA)
+                conn.commit()
+            _migrated_paths.add(self.db_path)
+        return conn
 
     def _cleanup_expired(self) -> None:
         self._conn.execute(
             'DELETE FROM auth_sessions WHERE created_at < ?',
             (time.time() - SESSION_TIMEOUT_S,))
 
-    def create_session(self, code_challenge: str, token: str) -> None:
-        """Park `token` under the challenge (idempotent re-authorize)."""
+    def create_session(self, code_challenge: str, user_id: str) -> None:
+        """Park the authorizing user under the challenge (idempotent
+        re-authorize)."""
         self._cleanup_expired()
         self._conn.execute(
-            'INSERT INTO auth_sessions (code_challenge, token, created_at) '
-            'VALUES (?,?,?) ON CONFLICT(code_challenge) DO UPDATE SET '
-            'token=excluded.token, created_at=excluded.created_at',
-            (code_challenge, token, time.time()))
+            'INSERT INTO auth_sessions (code_challenge, user_id, '
+            'created_at) VALUES (?,?,?) ON CONFLICT(code_challenge) DO '
+            'UPDATE SET user_id=excluded.user_id, '
+            'created_at=excluded.created_at',
+            (code_challenge, user_id, time.time()))
         self._conn.commit()
 
     def poll_session(self, code_verifier: str) -> Optional[str]:
-        """Atomically consume the session matching the verifier.
+        """Atomically consume the session matching the verifier;
+        returns the parked user_id.
 
         SELECT-then-DELETE with a rowcount check instead of
         DELETE..RETURNING: older system sqlite (< 3.35, e.g. Ubuntu
@@ -71,12 +159,12 @@ class AuthSessionStore:
         challenge = compute_code_challenge(code_verifier)
         fresh = time.time() - SESSION_TIMEOUT_S
         row = self._conn.execute(
-            'SELECT token FROM auth_sessions WHERE code_challenge=? AND '
-            'created_at > ?', (challenge, fresh)).fetchone()
+            'SELECT user_id FROM auth_sessions WHERE code_challenge=? '
+            'AND created_at > ?', (challenge, fresh)).fetchone()
         if row is None:
             return None
         cur = self._conn.execute(
             'DELETE FROM auth_sessions WHERE code_challenge=? AND '
             'created_at > ?', (challenge, fresh))
         self._conn.commit()
-        return row['token'] if cur.rowcount == 1 else None
+        return row['user_id'] if cur.rowcount == 1 else None

@@ -17,12 +17,35 @@ def test_session_store_pkce_roundtrip(tmp_path):
     store = sessions.AuthSessionStore(str(tmp_path / 's.db'))
     verifier = secrets.token_urlsafe(32)
     challenge = sessions.compute_code_challenge(verifier)
-    store.create_session(challenge, 'sky_tok_abc')
+    store.create_session(challenge, 'user-1')
     # Wrong verifier consumes nothing.
     assert store.poll_session('wrong-verifier') is None
-    # Right verifier gets the token exactly once (atomic consume).
-    assert store.poll_session(verifier) == 'sky_tok_abc'
+    # Right verifier gets the parked user exactly once (atomic consume).
+    assert store.poll_session(verifier) == 'user-1'
     assert store.poll_session(verifier) is None
+
+
+def test_csrf_token_binding(tmp_path, monkeypatch):
+    monkeypatch.setattr('skypilot_tpu.utils.common.base_dir',
+                        lambda: str(tmp_path))
+    tok = sessions.make_csrf_token('chal-A', 'user-1')
+    assert sessions.check_csrf_token(tok, 'chal-A', 'user-1')
+    # Bound to the challenge AND the user: an attacker's own token
+    # (minted for their account) must not validate for the victim.
+    assert not sessions.check_csrf_token(tok, 'chal-B', 'user-1')
+    assert not sessions.check_csrf_token(tok, 'chal-A', 'user-2')
+    assert not sessions.check_csrf_token('garbage', 'chal-A', 'user-1')
+    # Expiry.
+    monkeypatch.setattr(sessions, 'CSRF_TIMEOUT_S', -1.0)
+    assert not sessions.check_csrf_token(tok, 'chal-A', 'user-1')
+
+
+def test_user_code_stable_and_short():
+    c = sessions.compute_code_challenge('some-verifier')
+    code = sessions.user_code(c)
+    assert code == sessions.user_code(c)        # deterministic
+    assert len(code) == 9 and code[4] == '-'
+    assert code != sessions.user_code(c + 'x')  # challenge-bound
 
 
 def test_session_store_expiry(tmp_path, monkeypatch):
@@ -130,7 +153,10 @@ def test_oauth2_proxy_down_is_502(fake_idp_app):
 
 def test_login_flow_against_live_server(api_server, tmp_path):
     """Full PKCE login against a real server process: authorize (as the
-    loopback operator) -> poll -> use the minted token."""
+    loopback operator) -> confirm (CSRF POST) -> poll -> use the minted
+    token."""
+    import re
+
     import requests
     import secrets as pysecrets
 
@@ -140,9 +166,25 @@ def test_login_flow_against_live_server(api_server, tmp_path):
     r = requests.post(f'{api_server}/auth/token',
                       json={'code_verifier': verifier}, timeout=10)
     assert r.status_code == 202
-    # Browser authorize (loopback operator → allowed without SSO).
+    # Browser GET: a confirmation page — shows the verification code,
+    # parks NOTHING (a bare link click must not authorize: login-CSRF).
     r = requests.get(f'{api_server}/auth/authorize'
                      f'?code_challenge={challenge}', timeout=10)
+    assert r.status_code == 200
+    assert sessions.user_code(challenge) in r.text
+    csrf = re.search(r'name="csrf" value="([^"]+)"', r.text).group(1)
+    r = requests.post(f'{api_server}/auth/token',
+                      json={'code_verifier': verifier}, timeout=10)
+    assert r.status_code == 202             # GET did not authorize
+    # Forged confirm without a valid CSRF token is rejected.
+    r = requests.post(f'{api_server}/auth/authorize',
+                      data={'code_challenge': challenge,
+                            'csrf': 'forged'}, timeout=10)
+    assert r.status_code == 403
+    # Real confirm: the form POST with the embedded CSRF token.
+    r = requests.post(f'{api_server}/auth/authorize',
+                      data={'code_challenge': challenge, 'csrf': csrf},
+                      timeout=10)
     assert r.status_code == 200 and 'Login complete' in r.text
     # Poll now yields a working bearer token, exactly once.
     r = requests.post(f'{api_server}/auth/token',
