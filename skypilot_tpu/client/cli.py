@@ -40,12 +40,16 @@ def cli() -> None:
     """sky-tpu: TPU-native workload orchestrator."""
 
 
-def _load_task(yaml_path: str, env: tuple) -> 'sky.Task':
+def _env_overrides(env: tuple) -> Optional[dict]:
     overrides = {}
     for e in env:
         k, _, v = e.partition('=')
         overrides[k] = v
-    return sky.Task.from_yaml(yaml_path, env_overrides=overrides or None)
+    return overrides or None
+
+
+def _load_task(yaml_path: str, env: tuple) -> 'sky.Task':
+    return sky.Task.from_yaml(yaml_path, env_overrides=_env_overrides(env))
 
 
 @cli.command()
@@ -322,13 +326,30 @@ def _jobs_engine():
 @click.option('--yes', '-y', is_flag=True, default=False)
 def jobs_launch(task_yaml: str, name: Optional[str], env: tuple,
                 yes: bool) -> None:
-    """Submit a managed job (auto-recovers on preemption)."""
-    task = _load_task(task_yaml, env)
-    if not yes:
-        click.confirm(
-            f'Submitting managed job {name or task.name or task_yaml} '
-            f'({task.resources!r}). Proceed?', abort=True)
-    job_id = _jobs_engine().launch(task, name=name)
+    """Submit a managed job (auto-recovers on preemption).
+
+    A multi-document YAML submits a managed PIPELINE: stages run
+    sequentially, each with its own cluster and per-stage recovery.
+    """
+    from skypilot_tpu.utils import dag_utils
+    dag = dag_utils.load_dag_from_yaml(task_yaml,
+                                       env_overrides=_env_overrides(env))
+    if len(dag) > 1:
+        stages = ', '.join(t.name or f'stage-{i}'
+                           for i, t in enumerate(dag.tasks))
+        if not yes:
+            click.confirm(
+                f'Submitting managed pipeline '
+                f'{name or dag.name or task_yaml} '
+                f'({len(dag)} stages: {stages}). Proceed?', abort=True)
+        job_id = _jobs_engine().launch(dag, name=name)
+    else:
+        task = dag.tasks[0]
+        if not yes:
+            click.confirm(
+                f'Submitting managed job {name or task.name or task_yaml} '
+                f'({task.resources!r}). Proceed?', abort=True)
+        job_id = _jobs_engine().launch(task, name=name)
     click.echo(f'Managed job: {job_id}')
     click.echo(f'Watch: sky-tpu jobs queue   '
                f'logs: sky-tpu jobs logs {job_id}')
@@ -344,6 +365,11 @@ def jobs_queue() -> None:
         click.echo(fmt.format(j['job_id'], (j['name'] or '')[:18],
                               j['status'], j['recovery_count'],
                               j['cluster_name'] or '-'))
+        for t in j.get('tasks') or []:
+            click.echo(fmt.format(
+                f' ↳{t["task_id"]}', (t['name'] or '')[:18],
+                t['status'], t['recovery_count'],
+                t['cluster_name'] or '-'))
 
 
 @jobs.command('cancel')

@@ -379,7 +379,14 @@ def cost_report() -> List[Dict[str, Any]]:
 
 
 # ---- managed jobs (reference sky/jobs/client/sdk.py) ---------------------
-def jobs_launch(task: task_lib.Task, name: Optional[str] = None) -> int:
+def jobs_launch(task, name: Optional[str] = None) -> int:
+    """Submit a managed job (Task) or pipeline (Dag)."""
+    from skypilot_tpu import dag as dag_lib
+    if isinstance(task, dag_lib.Dag):
+        from skypilot_tpu.utils import dag_utils
+        return get(_post('jobs.launch', {
+            'dag_yaml': dag_utils.dump_dag_to_yaml_str(task),
+            'name': name}))
     return get(_post('jobs.launch', {'task': task.to_yaml_config(),
                                      'name': name}))
 

@@ -14,8 +14,9 @@ controller-cluster cold start. The jobs themselves still run on real
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Union
 
+from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.jobs import scheduler
@@ -23,12 +24,33 @@ from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.jobs.state import ManagedJobStatus  # noqa: F401 (public)
 
 
-def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
+def launch(task: Union[task_lib.Task, dag_lib.Dag],
+           name: Optional[str] = None) -> int:
     """Submit a managed job; returns its job id immediately.
 
-    Reference sky/jobs/server/core.py:500 (minus the controller-cluster
-    provisioning, see module doc).
+    A ``Dag`` submits a managed **pipeline**: the controller runs its
+    tasks as sequential stages, each with its own cluster and its own
+    preemption recovery — a preempted stage resumes without re-running
+    finished ones (reference sky/jobs/server/core.py:500 +
+    sky/jobs/controller.py:215 iterating ``dag.tasks``).
     """
+    if isinstance(task, dag_lib.Dag):
+        dag = task
+        if len(dag) == 0:
+            raise exceptions.InvalidTaskError('empty pipeline')
+        if not dag.is_chain():
+            raise exceptions.InvalidTaskError(
+                'managed pipelines must be chains (sequential stages); '
+                'use execution: serial')
+        job_name = name or dag.name or 'pipeline'
+        from skypilot_tpu.utils import dag_utils
+        stages = [{'name': t.name or f'{job_name}-{i}',
+                   'task_yaml': t.to_yaml()}
+                  for i, t in enumerate(dag.tasks)]
+        return scheduler.submit_job(
+            job_name, dag_utils.dump_dag_to_yaml_str(dag),
+            resources_str=repr(dag.tasks[0].resources),
+            tasks=stages)
     job_name = name or task.name or 'managed-job'
     task.name = job_name
     return scheduler.submit_job(job_name, task.to_yaml(),

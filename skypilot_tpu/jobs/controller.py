@@ -47,7 +47,9 @@ _AGENT_MISS_LIMIT = int(os.environ.get('SKY_TPU_JOBS_AGENT_MISS_LIMIT',
 
 
 class JobController:
-    """Drives one managed job to a terminal state."""
+    """Drives one managed job — a single task or a pipeline of stages —
+    to a terminal state (reference sky/jobs/controller.py:215 iterates
+    ``dag.tasks``; :344 ``_run_one_task``)."""
 
     def __init__(self, job_id: int):
         self.job_id = job_id
@@ -55,19 +57,54 @@ class JobController:
         if record is None:
             raise exceptions.JobNotFoundError(f'managed job {job_id}')
         self.record = record
-        self.task = task_lib.Task.from_yaml_config(
-            yaml.safe_load(record['task_yaml']))
-        self.cluster_name = (record['cluster_name'] or
-                             f'{self.task.name or "job"}-mj-{job_id}')
-        self.strategy = recovery_strategy.StrategyExecutor.make(
-            job_id, self.task, self.cluster_name)
+        self.task_rows = jobs_state.get_tasks(job_id)
+        if not self.task_rows:
+            # Pre-pipeline DB row: synthesize the single stage.
+            self.task_rows = [{
+                'task_id': 0, 'name': record['name'],
+                'task_yaml': record['task_yaml'],
+                'status': jobs_state.ManagedJobStatus.PENDING,
+                'recovery_count': 0,
+            }]
+        # Deterministic from (name, job_id) — NOT read back from the job
+        # row's cluster_name, which _launch overwrites with the current
+        # stage's suffixed name; re-deriving keeps a restarted
+        # controller's stage clusters at the same names, so the resume
+        # relaunch reuses the pre-crash cluster instead of orphaning it.
+        base = record['name'] or 'job'
+        self.base_cluster_name = f'{base}-mj-{job_id}'
+        # Per-stage context, bound by _prepare_stage().
+        self.task_id = 0
+        self.task: Optional[task_lib.Task] = None
+        self.cluster_name = self.base_cluster_name
+        self.strategy: Optional[
+            recovery_strategy.StrategyExecutor] = None
         self.cluster_job_id = -1
         self.last_placement: Optional[Tuple[str, str]] = None
+
+    def _prepare_stage(self, row: dict) -> None:
+        """Bind the controller to pipeline stage ``row``. Each stage gets
+        its own cluster (name suffixed for pipelines, bare for plain jobs
+        — back-compat) and its own strategy executor."""
+        self.task_id = row['task_id']
+        self.task = task_lib.Task.from_yaml_config(
+            yaml.safe_load(row['task_yaml']))
+        self.cluster_name = (self.base_cluster_name
+                             if len(self.task_rows) == 1 else
+                             f'{self.base_cluster_name}-t{self.task_id}')
+        self.strategy = recovery_strategy.StrategyExecutor.make(
+            self.job_id, self.task, self.cluster_name)
+        self.cluster_job_id = -1
+        self.last_placement = None
 
     # -- helpers -----------------------------------------------------------
     def _set_status(self, status: ManagedJobStatus,
                     reason: Optional[str] = None) -> None:
+        """Job-level status; mirrored onto the current stage row so the
+        queue shows which pipeline stage is doing what."""
         jobs_state.set_status(self.job_id, status, failure_reason=reason)
+        jobs_state.set_task_status(self.job_id, self.task_id, status,
+                                   failure_reason=reason)
 
     def _cluster_info(self) -> Optional[ClusterInfo]:
         record = global_state.get_cluster(self.cluster_name)
@@ -106,14 +143,20 @@ class JobController:
             final = self._cancel()
         except exceptions.ManagedJobReachedMaxRetriesError as e:
             logger.error('job %s: %s', self.job_id, e)
-            self.strategy.terminate_cluster()
+            if self.strategy is not None:
+                self.strategy.terminate_cluster()
             self._set_status(ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
+            jobs_state.cancel_remaining_tasks(
+                self.job_id, self.task_id + 1, 'earlier stage failed')
             final = ManagedJobStatus.FAILED_NO_RESOURCE
         except Exception as e:  # noqa: BLE001 — controller crash is a state
             logger.exception('job %s: controller error', self.job_id)
-            self.strategy.terminate_cluster()
+            if self.strategy is not None:
+                self.strategy.terminate_cluster()
             self._set_status(ManagedJobStatus.FAILED_CONTROLLER,
                              f'{type(e).__name__}: {e}')
+            jobs_state.cancel_remaining_tasks(
+                self.job_id, self.task_id + 1, 'earlier stage failed')
             final = ManagedJobStatus.FAILED_CONTROLLER
         finally:
             jobs_state.set_schedule_state(self.job_id, ScheduleState.DONE)
@@ -131,6 +174,8 @@ class JobController:
         self.cluster_job_id = job_id
         self.last_placement = (info.region, info.zone)
         jobs_state.set_cluster(self.job_id, self.cluster_name, job_id)
+        jobs_state.set_task_cluster(self.job_id, self.task_id,
+                                    self.cluster_name, job_id)
         jobs_state.set_schedule_state(self.job_id, ScheduleState.ALIVE)
         self._set_status(ManagedJobStatus.RUNNING)
 
@@ -143,11 +188,39 @@ class JobController:
                     self.cluster_job_id)
             except Exception:  # noqa: BLE001 — cluster may be gone
                 pass
-        self.strategy.terminate_cluster()
+        if self.strategy is not None:
+            self.strategy.terminate_cluster()
         self._set_status(ManagedJobStatus.CANCELLED)
+        jobs_state.cancel_remaining_tasks(
+            self.job_id, self.task_id, 'pipeline cancelled')
         return ManagedJobStatus.CANCELLED
 
     def _run(self) -> ManagedJobStatus:
+        """Run every pipeline stage in order (a plain job is a 1-stage
+        pipeline). A controller restart resumes at the first stage that
+        is not already SUCCEEDED — finished stages never re-run."""
+        for row in self.task_rows:
+            if row['status'] == ManagedJobStatus.SUCCEEDED:
+                continue
+            self._prepare_stage(row)
+            logger.info('job %s: stage %d/%d (%s)', self.job_id,
+                        self.task_id + 1, len(self.task_rows),
+                        row['name'])
+            final = self._run_one_task()
+            if final != ManagedJobStatus.SUCCEEDED:
+                if final != ManagedJobStatus.CANCELLED:
+                    # _cancel marks trailing stages itself. 1-based
+                    # numbering to match the progress log above.
+                    jobs_state.cancel_remaining_tasks(
+                        self.job_id, self.task_id + 1,
+                        f'stage {self.task_id + 1}/{len(self.task_rows)}'
+                        f' ({row["name"]}) ended {final.value}')
+                return final
+        return ManagedJobStatus.SUCCEEDED
+
+    def _run_one_task(self) -> ManagedJobStatus:
+        """Launch → monitor → recover one stage to a terminal state
+        (reference _run_one_task, sky/jobs/controller.py:344)."""
         if jobs_state.cancel_requested(self.job_id):
             # Cancelled while WAITING: never launch at all.
             return self._cancel()
@@ -181,7 +254,15 @@ class JobController:
             if status is not None and status.is_terminal():
                 if status == common.JobStatus.SUCCEEDED:
                     self.strategy.terminate_cluster()
-                    self._set_status(ManagedJobStatus.SUCCEEDED)
+                    jobs_state.set_task_status(
+                        self.job_id, self.task_id,
+                        ManagedJobStatus.SUCCEEDED)
+                    if self.task_id == len(self.task_rows) - 1:
+                        # Job-level SUCCEEDED only when the LAST stage
+                        # finishes; intermediate stages leave the job
+                        # RUNNING for the next stage's launch.
+                        jobs_state.set_status(self.job_id,
+                                              ManagedJobStatus.SUCCEEDED)
                     return ManagedJobStatus.SUCCEEDED
                 if status == common.JobStatus.CANCELLED:
                     return self._cancel()
@@ -215,8 +296,13 @@ class JobController:
 
     def _recover(self) -> None:
         self._set_status(ManagedJobStatus.RECOVERING)
-        count = jobs_state.bump_recovery(self.job_id)
-        logger.info('job %s: recovering (attempt %d)', self.job_id, count)
+        job_count = jobs_state.bump_recovery(self.job_id)
+        count = jobs_state.bump_task_recovery(
+            self.job_id, self.task_id)
+        if count is None:   # pre-pipeline DB row
+            count = job_count
+        logger.info('job %s: recovering stage %d (attempt %d)',
+                    self.job_id, self.task_id, count)
         self._launch(recovery_count=count, recovering=True)
 
 

@@ -32,9 +32,12 @@ def _scheduler_lock():
     return locks.cluster_lock('__managed_jobs_scheduler__')
 
 
-def submit_job(name: str, task_yaml: str, resources_str: str = '') -> int:
-    """Record the job and start its controller if a slot is free."""
-    job_id = jobs_state.submit_job(name, task_yaml, resources_str)
+def submit_job(name: str, task_yaml: str, resources_str: str = '',
+               tasks=None) -> int:
+    """Record the job (and its pipeline stages, if any) and start its
+    controller if a slot is free."""
+    job_id = jobs_state.submit_job(name, task_yaml, resources_str,
+                                   tasks=tasks)
     maybe_schedule_next()
     return job_id
 
@@ -118,6 +121,19 @@ def reconcile() -> Optional[int]:
                     failure_reason='controller process died')
                 jobs_state.set_schedule_state(job['job_id'],
                                               ScheduleState.DONE)
+                # Mirror onto the stage rows, as the controller's own
+                # error paths do — otherwise the queue shows a stage
+                # RUNNING forever under a FAILED_CONTROLLER job.
+                for t in jobs_state.get_tasks(job['job_id']):
+                    if not t['status'].is_terminal():
+                        jobs_state.set_task_status(
+                            job['job_id'], t['task_id'],
+                            jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                            failure_reason='controller process died')
+                        jobs_state.cancel_remaining_tasks(
+                            job['job_id'], t['task_id'] + 1,
+                            'controller process died')
+                        break
                 repaired += 1
     if repaired:
         maybe_schedule_next()

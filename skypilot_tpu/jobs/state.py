@@ -82,6 +82,20 @@ CREATE TABLE IF NOT EXISTS jobs (
     cluster_job_id INTEGER DEFAULT -1,
     resources_str TEXT
 );
+CREATE TABLE IF NOT EXISTS job_tasks (
+    job_id INTEGER,
+    task_id INTEGER,
+    name TEXT,
+    task_yaml TEXT,
+    status TEXT,
+    cluster_name TEXT,
+    cluster_job_id INTEGER DEFAULT -1,
+    started_at REAL,
+    ended_at REAL,
+    recovery_count INTEGER DEFAULT 0,
+    failure_reason TEXT,
+    PRIMARY KEY (job_id, task_id)
+);
 """
 
 
@@ -101,15 +115,30 @@ def controller_log_path(job_id: int) -> str:
 
 
 # ---- submission ----------------------------------------------------------
-def submit_job(name: str, task_yaml: str, resources_str: str = '') -> int:
+def submit_job(name: str, task_yaml: str, resources_str: str = '',
+               tasks: Optional[List[Dict[str, str]]] = None) -> int:
+    """Record a managed job. ``tasks`` is the per-stage list
+    ``[{'name':..., 'task_yaml':...}, ...]`` — one entry for a plain job,
+    several for a pipeline (reference sky/jobs/state.py keeps one `spot`
+    row per (job_id, task_id) the same way). ``task_yaml`` on the job row
+    is the original (possibly multi-document) submission."""
     conn = _db().conn
     cur = conn.execute(
         'INSERT INTO jobs (name, task_yaml, status, schedule_state, '
         'submitted_at, resources_str) VALUES (?,?,?,?,?,?)',
         (name, task_yaml, ManagedJobStatus.PENDING.value,
          ScheduleState.WAITING.value, time.time(), resources_str))
+    job_id = int(cur.lastrowid)
+    if tasks is None:
+        tasks = [{'name': name, 'task_yaml': task_yaml}]
+    for i, t in enumerate(tasks):
+        conn.execute(
+            'INSERT INTO job_tasks (job_id, task_id, name, task_yaml, '
+            'status) VALUES (?,?,?,?,?)',
+            (job_id, i, t.get('name') or f'{name}-{i}', t['task_yaml'],
+             ManagedJobStatus.PENDING.value))
     conn.commit()
-    return int(cur.lastrowid)
+    return job_id
 
 
 # ---- transitions ---------------------------------------------------------
@@ -185,6 +214,78 @@ def cancel_requested(job_id: int) -> bool:
     return bool(row and row['cancel_requested'])
 
 
+# ---- per-task (pipeline stage) transitions -------------------------------
+def get_tasks(job_id: int) -> List[Dict[str, Any]]:
+    """Stage rows in pipeline order (empty only for pre-pipeline DBs)."""
+    rows = _db().conn.execute(
+        'SELECT * FROM job_tasks WHERE job_id=? ORDER BY task_id',
+        (job_id,)).fetchall()
+    out = []
+    for r in rows:
+        d = dict(r)
+        d['status'] = ManagedJobStatus(d['status'])
+        out.append(d)
+    return out
+
+
+def set_task_status(job_id: int, task_id: int, status: ManagedJobStatus,
+                    failure_reason: Optional[str] = None) -> None:
+    conn = _db().conn
+    sets = ['status=?']
+    args: List[Any] = [status.value]
+    if status == ManagedJobStatus.RUNNING:
+        sets.append('started_at=COALESCE(started_at, ?)')
+        args.append(time.time())
+    if status.is_terminal():
+        sets.append('ended_at=?')
+        args.append(time.time())
+    if failure_reason is not None:
+        sets.append('failure_reason=?')
+        args.append(failure_reason)
+    args += [job_id, task_id]
+    conn.execute(f'UPDATE job_tasks SET {", ".join(sets)} '
+                 'WHERE job_id=? AND task_id=?', args)
+    conn.commit()
+
+
+def set_task_cluster(job_id: int, task_id: int,
+                     cluster_name: Optional[str],
+                     cluster_job_id: int = -1) -> None:
+    conn = _db().conn
+    conn.execute(
+        'UPDATE job_tasks SET cluster_name=?, cluster_job_id=? '
+        'WHERE job_id=? AND task_id=?',
+        (cluster_name, cluster_job_id, job_id, task_id))
+    conn.commit()
+
+
+def bump_task_recovery(job_id: int, task_id: int) -> Optional[int]:
+    """Returns the stage's new recovery count, or None for a
+    pre-pipeline job row with no job_tasks entry."""
+    conn = _db().conn
+    conn.execute(
+        'UPDATE job_tasks SET recovery_count=recovery_count+1 '
+        'WHERE job_id=? AND task_id=?', (job_id, task_id))
+    conn.commit()
+    row = conn.execute(
+        'SELECT recovery_count FROM job_tasks WHERE job_id=? AND '
+        'task_id=?', (job_id, task_id)).fetchone()
+    return int(row['recovery_count']) if row else None
+
+
+def cancel_remaining_tasks(job_id: int, from_task_id: int,
+                           reason: str) -> None:
+    """Stages after a failed/cancelled one never run — mark them so the
+    queue shows why (reference marks trailing pipeline rows CANCELLED)."""
+    conn = _db().conn
+    conn.execute(
+        'UPDATE job_tasks SET status=?, ended_at=?, failure_reason=? '
+        'WHERE job_id=? AND task_id>=? AND status NOT IN (?,?,?,?,?,?)',
+        (ManagedJobStatus.CANCELLED.value, time.time(), reason, job_id,
+         from_task_id, *[s.value for s in _TERMINAL]))
+    conn.commit()
+
+
 # ---- queries -------------------------------------------------------------
 def get_job(job_id: int) -> Optional[Dict[str, Any]]:
     row = _db().conn.execute('SELECT * FROM jobs WHERE job_id=?',
@@ -227,9 +328,22 @@ def _row_to_dict(row: sqlite3.Row) -> Dict[str, Any]:
 
 
 def to_json(job: Dict[str, Any]) -> Dict[str, Any]:
-    """JSON-safe view for the API server / CLI."""
+    """JSON-safe view for the API server / CLI. Pipelines (≥2 stage
+    rows) carry their per-stage breakdown."""
     d = dict(job)
     d['status'] = d['status'].value
     d['schedule_state'] = d['schedule_state'].value
     d.pop('task_yaml', None)
+    tasks = get_tasks(job['job_id'])
+    if len(tasks) > 1:
+        d['tasks'] = [{
+            'task_id': t['task_id'],
+            'name': t['name'],
+            'status': t['status'].value,
+            'cluster_name': t['cluster_name'],
+            'recovery_count': t['recovery_count'],
+            'started_at': t['started_at'],
+            'ended_at': t['ended_at'],
+            'failure_reason': t['failure_reason'],
+        } for t in tasks]
     return json.loads(json.dumps(d))

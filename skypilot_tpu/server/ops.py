@@ -234,6 +234,12 @@ def _dispatch_workspaces(name, payload):
 
 def _dispatch_jobs(name, payload, jobs_lib):
     if name == 'jobs.launch':
+        if payload.get('dag_yaml'):
+            # Managed pipeline: the client ships the multi-doc YAML.
+            from skypilot_tpu.utils import dag_utils
+            dag = dag_utils.load_dag_from_yaml_str(payload['dag_yaml'])
+            return functools.partial(jobs_lib.launch, dag,
+                                     name=payload.get('name'))
         return functools.partial(
             jobs_lib.launch, _task_from_payload(payload),
             name=payload.get('name'))

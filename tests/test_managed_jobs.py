@@ -197,3 +197,206 @@ def test_reconcile_dead_controller(monkeypatch):
     assert repaired == 1
     record = jobs_state.get_job(job_id)
     assert record['status'] == ManagedJobStatus.FAILED_CONTROLLER
+
+
+# ---- pipelines (reference sky/jobs/controller.py:215 iterates dag.tasks) --
+
+def _pipeline_dag(stage_runs, name='pipe', **res_kw):
+    """Build a chain Dag from a list of run commands."""
+    from skypilot_tpu import dag as dag_lib
+    dag = dag_lib.Dag(name=name)
+    prev = None
+    for i, run in enumerate(stage_runs):
+        t = _task(run, name=f'{name}-s{i}', **res_kw)
+        dag.add(t)
+        if prev is not None:
+            dag.add_edge(prev, t)
+        prev = t
+    dag.set_execution(dag_lib.DagExecution.SERIAL)
+    return dag
+
+
+def _submit_dag_without_spawn(dag, monkeypatch):
+    monkeypatch.setattr(scheduler, '_spawn_controller', lambda job_id: None)
+    return jobs.launch(dag)
+
+
+def test_pipeline_success_runs_stages_in_order(monkeypatch, sky_tpu_home):
+    log = os.path.join(sky_tpu_home, 'order')
+    dag = _pipeline_dag([f'echo s{i} >> {log}' for i in range(3)])
+    job_id = _submit_dag_without_spawn(dag, monkeypatch)
+    # Per-stage rows exist from submission.
+    rows = jobs_state.get_tasks(job_id)
+    assert [r['task_id'] for r in rows] == [0, 1, 2]
+    assert all(r['status'] == ManagedJobStatus.PENDING for r in rows)
+    final = _run_controller_inproc(job_id)
+    assert final == ManagedJobStatus.SUCCEEDED
+    with open(log) as f:
+        assert f.read().split() == ['s0', 's1', 's2']
+    rows = jobs_state.get_tasks(job_id)
+    assert all(r['status'] == ManagedJobStatus.SUCCEEDED for r in rows)
+    # Each stage got its own cluster; all torn down.
+    names = {r['cluster_name'] for r in rows}
+    assert len(names) == 3
+    for n in names:
+        assert global_state.get_cluster(n) is None
+    # queue() surfaces the per-stage breakdown.
+    q = jobs.queue(refresh=False)
+    job_json = next(j for j in q if j['job_id'] == job_id)
+    assert [t['status'] for t in job_json['tasks']] == ['SUCCEEDED'] * 3
+
+
+def test_pipeline_stage2_preemption_resumes_at_stage2(monkeypatch,
+                                                      sky_tpu_home):
+    """BASELINE config-5 shape: a staged run on spot survives a stage-2
+    preemption — stage 2 recovers, stage 1 does NOT re-run."""
+    s1 = os.path.join(sky_tpu_home, 's1_runs')
+    s2 = os.path.join(sky_tpu_home, 's2_runs')
+    # Stage 2 succeeds only on its second attempt (post-recovery).
+    stage2 = (f'echo x >> {s2}; '
+              f'if [ $(wc -l < {s2}) -ge 2 ]; then exit 0; fi; sleep 60')
+    dag = _pipeline_dag([f'echo x >> {s1}', stage2, 'echo done'],
+                        use_spot=True, job_recovery='EAGER_FAILOVER')
+    job_id = _submit_dag_without_spawn(dag, monkeypatch)
+
+    result = {}
+    t = threading.Thread(
+        target=lambda: result.update(final=_run_controller_inproc(job_id)))
+    t.start()
+    # Wait until stage 2 (task_id=1) is RUNNING with a live cluster.
+    deadline = time.time() + 60
+    cluster_name = None
+    while time.time() < deadline:
+        rows = jobs_state.get_tasks(job_id)
+        r1 = rows[1]
+        if (r1['status'] == ManagedJobStatus.RUNNING and
+                r1['cluster_name'] and os.path.exists(s2)):
+            cluster_name = r1['cluster_name']
+            break
+        time.sleep(0.05)
+    assert cluster_name, 'stage 2 never reached RUNNING'
+
+    # Preempt stage 2's slice.
+    cdir = os.path.join(sky_tpu_home, 'clusters', cluster_name)
+    from skypilot_tpu.provision.local import instance as local_instance
+    local_instance._kill_agent(cdir)
+    for entry in os.listdir(cdir):
+        if entry.startswith('host'):
+            with open(os.path.join(cdir, entry, 'state'), 'w') as f:
+                f.write('PREEMPTED')
+
+    t.join(timeout=120)
+    assert not t.is_alive(), 'controller wedged after stage-2 preemption'
+    assert result['final'] == ManagedJobStatus.SUCCEEDED
+    rows = jobs_state.get_tasks(job_id)
+    assert [r['status'] for r in rows] == [ManagedJobStatus.SUCCEEDED] * 3
+    assert rows[1]['recovery_count'] >= 1
+    assert rows[0]['recovery_count'] == 0
+    with open(s1) as f:
+        assert len(f.readlines()) == 1, 'stage 1 must not re-run'
+    with open(s2) as f:
+        assert len(f.readlines()) >= 2
+
+
+def test_pipeline_stage_failure_cancels_trailing_stages(monkeypatch,
+                                                        sky_tpu_home):
+    ran3 = os.path.join(sky_tpu_home, 's3_ran')
+    dag = _pipeline_dag(['echo ok', 'exit 9', f'touch {ran3}'])
+    job_id = _submit_dag_without_spawn(dag, monkeypatch)
+    final = _run_controller_inproc(job_id)
+    assert final == ManagedJobStatus.FAILED
+    rows = jobs_state.get_tasks(job_id)
+    assert rows[0]['status'] == ManagedJobStatus.SUCCEEDED
+    assert rows[1]['status'] == ManagedJobStatus.FAILED
+    assert rows[2]['status'] == ManagedJobStatus.CANCELLED
+    assert 'stage 2/3' in (rows[2]['failure_reason'] or '')
+    assert not os.path.exists(ran3)
+    record = jobs_state.get_job(job_id)
+    assert record['status'] == ManagedJobStatus.FAILED
+
+
+def test_pipeline_cancel_marks_remaining(monkeypatch, sky_tpu_home):
+    dag = _pipeline_dag(['sleep 120', 'echo never'])
+    job_id = _submit_dag_without_spawn(dag, monkeypatch)
+    result = {}
+    t = threading.Thread(
+        target=lambda: result.update(final=_run_controller_inproc(job_id)))
+    t.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if jobs_state.get_job(job_id)['status'] == ManagedJobStatus.RUNNING:
+            break
+        time.sleep(0.05)
+    assert jobs.cancel(job_id)
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert result['final'] == ManagedJobStatus.CANCELLED
+    rows = jobs_state.get_tasks(job_id)
+    assert rows[0]['status'] == ManagedJobStatus.CANCELLED
+    assert rows[1]['status'] == ManagedJobStatus.CANCELLED
+
+
+def test_pipeline_controller_restart_skips_finished_stages(monkeypatch,
+                                                           sky_tpu_home):
+    """A restarted controller resumes at the first unfinished stage."""
+    s1 = os.path.join(sky_tpu_home, 'restart_s1')
+    dag = _pipeline_dag([f'touch {s1}', 'echo two'])
+    job_id = _submit_dag_without_spawn(dag, monkeypatch)
+    # Simulate a previous controller run that finished stage 1.
+    jobs_state.set_task_status(job_id, 0, ManagedJobStatus.SUCCEEDED)
+    final = _run_controller_inproc(job_id)
+    assert final == ManagedJobStatus.SUCCEEDED
+    assert not os.path.exists(s1), 'finished stage must not re-run'
+
+
+def test_pipeline_yaml_roundtrip_submission(monkeypatch, sky_tpu_home):
+    """Multi-doc YAML → Dag → submit (the CLI path)."""
+    from skypilot_tpu.utils import dag_utils
+    yaml_str = '\n---\n'.join([
+        'name: ypipe',
+        ('name: prep\nrun: echo prep\n'
+         'resources: {cloud: local, accelerators: v5e-4}'),
+        ('name: train\nrun: echo train\n'
+         'resources: {cloud: local, accelerators: v5e-4}'),
+    ])
+    dag = dag_utils.load_dag_from_yaml_str(yaml_str)
+    job_id = _submit_dag_without_spawn(dag, monkeypatch)
+    rows = jobs_state.get_tasks(job_id)
+    assert [r['name'] for r in rows] == ['prep', 'train']
+    final = _run_controller_inproc(job_id)
+    assert final == ManagedJobStatus.SUCCEEDED
+
+
+def test_reconcile_dead_pipeline_mirrors_stage_rows(monkeypatch):
+    dag = _pipeline_dag(['echo a', 'sleep 60', 'echo c'],
+                        name='recpipe')
+    job_id = _submit_dag_without_spawn(dag, monkeypatch)
+    # Simulate: stage 0 done, stage 1 running when the controller died.
+    jobs_state.set_task_status(job_id, 0, ManagedJobStatus.SUCCEEDED)
+    jobs_state.set_task_status(job_id, 1, ManagedJobStatus.RUNNING)
+    jobs_state.set_schedule_state(job_id, ScheduleState.ALIVE)
+    jobs_state.set_status(job_id, ManagedJobStatus.RUNNING)
+    jobs_state.set_controller_pid(job_id, 2 ** 30)  # dead
+    assert scheduler.reconcile() == 1
+    rows = jobs_state.get_tasks(job_id)
+    assert rows[0]['status'] == ManagedJobStatus.SUCCEEDED
+    assert rows[1]['status'] == ManagedJobStatus.FAILED_CONTROLLER
+    assert rows[2]['status'] == ManagedJobStatus.CANCELLED
+
+
+def test_pipeline_restart_reuses_stage_cluster_names(monkeypatch,
+                                                     sky_tpu_home):
+    """After a stage has run (jobs.cluster_name holds a SUFFIXED name),
+    a restarted controller must derive the same stage cluster names —
+    not suffix the suffix (which would orphan the old cluster)."""
+    dag = _pipeline_dag(['echo a', 'echo b'], name='rse')
+    job_id = _submit_dag_without_spawn(dag, monkeypatch)
+    final = _run_controller_inproc(job_id)
+    assert final == ManagedJobStatus.SUCCEEDED
+    # The job row now carries the LAST stage's suffixed cluster name.
+    record = jobs_state.get_job(job_id)
+    assert record['cluster_name'] == f'rse-mj-{job_id}-t1'
+    # A fresh controller derives identical stage names from scratch.
+    ctl = controller_lib.JobController(job_id)
+    ctl._prepare_stage(ctl.task_rows[1])
+    assert ctl.cluster_name == f'rse-mj-{job_id}-t1'
