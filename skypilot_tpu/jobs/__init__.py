@@ -61,7 +61,11 @@ def queue(refresh: bool = True) -> List[Dict[str, Any]]:
     """All managed jobs, newest first (reference jobs queue)."""
     if refresh:
         scheduler.reconcile()
-    return [jobs_state.to_json(j) for j in jobs_state.get_jobs()]
+    records = jobs_state.get_jobs()
+    stage_map = jobs_state.get_tasks_for_jobs(
+        [j['job_id'] for j in records])
+    return [jobs_state.to_json(j, tasks=stage_map.get(j['job_id'], []))
+            for j in records]
 
 
 def get(job_id: int) -> Dict[str, Any]:

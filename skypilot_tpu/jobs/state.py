@@ -327,14 +327,36 @@ def _row_to_dict(row: sqlite3.Row) -> Dict[str, Any]:
     return d
 
 
-def to_json(job: Dict[str, Any]) -> Dict[str, Any]:
+def get_tasks_for_jobs(job_ids: List[int]) -> Dict[int, List[Dict[str,
+                                                                  Any]]]:
+    """Stage rows for many jobs in ONE query (queue rendering)."""
+    if not job_ids:
+        return {}
+    rows = _db().conn.execute(
+        'SELECT * FROM job_tasks WHERE job_id IN ('
+        + ','.join('?' * len(job_ids)) + ') ORDER BY job_id, task_id',
+        list(job_ids)).fetchall()
+    out: Dict[int, List[Dict[str, Any]]] = {}
+    for r in rows:
+        d = dict(r)
+        d['status'] = ManagedJobStatus(d['status'])
+        out.setdefault(d['job_id'], []).append(d)
+    return out
+
+
+def to_json(job: Dict[str, Any],
+            tasks: Optional[List[Dict[str, Any]]] = None
+            ) -> Dict[str, Any]:
     """JSON-safe view for the API server / CLI. Pipelines (≥2 stage
-    rows) carry their per-stage breakdown."""
+    rows) carry their per-stage breakdown. Pass ``tasks`` (from
+    ``get_tasks_for_jobs``) when rendering many jobs to avoid an N+1
+    query."""
     d = dict(job)
     d['status'] = d['status'].value
     d['schedule_state'] = d['schedule_state'].value
     d.pop('task_yaml', None)
-    tasks = get_tasks(job['job_id'])
+    if tasks is None:
+        tasks = get_tasks(job['job_id'])
     if len(tasks) > 1:
         d['tasks'] = [{
             'task_id': t['task_id'],

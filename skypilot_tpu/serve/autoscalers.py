@@ -1,11 +1,18 @@
 """Autoscalers: decide the target replica count each controller tick.
 
 Counterpart of the reference's ``sky/serve/autoscalers.py`` (``Autoscaler``
-:117, ``RequestRateAutoscaler`` :458) — QPS-based scaling with hysteresis:
-an upscale fires only after the overloaded condition persists for
-``upscale_delay_seconds``, a downscale after ``downscale_delay_seconds``.
-Decisions are pure (state in the object, inputs passed per tick) so tests
-drive them with a fake clock.
+:117, ``RequestRateAutoscaler`` :458, ``InstanceAwareRequestRateAutoscaler``
+:584, ``FallbackRequestRateAutoscaler`` :912, ``QueueLengthAutoscaler``
+:1073) — scaling with hysteresis: an upscale fires only after the
+overloaded condition persists for ``upscale_delay_seconds``, a downscale
+after ``downscale_delay_seconds``. Decisions are pure (state in the
+object, inputs passed per tick) so tests drive them with a fake clock.
+
+TPU-native notes: the queue-length signal comes from the LB's in-flight
+gauge (``serve_state.get_inflight``) — for continuous-batching inference
+a deep queue, not QPS, is what saturation looks like. The fallback
+autoscaler emits separate spot/on-demand targets and the controller
+reconciles each kind, launching replicas with a ``use_spot`` override.
 """
 from __future__ import annotations
 
@@ -13,7 +20,7 @@ import dataclasses
 import logging
 import math
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from skypilot_tpu.serve import spec as spec_lib
 from skypilot_tpu.serve import state as serve_state
@@ -28,6 +35,11 @@ QPS_WINDOW_S = 60.0
 class AutoscalerDecision:
     target_num_replicas: int
     reason: str = ''
+    # Per-kind targets for mixed spot/on-demand fleets (reference
+    # Fallback autoscaler). None → homogeneous: the controller launches
+    # whatever the task's resources say.
+    target_spot: Optional[int] = None
+    target_ondemand: Optional[int] = None
 
 
 class Autoscaler:
@@ -43,15 +55,19 @@ class Autoscaler:
         self.policy = policy
 
     def evaluate(self, num_ready: int,
-                 now: Optional[float] = None) -> AutoscalerDecision:
-        del num_ready, now
+                 now: Optional[float] = None,
+                 replicas: Optional[List[dict]] = None
+                 ) -> AutoscalerDecision:
+        del num_ready, now, replicas
         return AutoscalerDecision(
             self.policy.min_replicas + self.policy.num_overprovision,
             reason='fixed')
 
 
-class RequestRateAutoscaler(Autoscaler):
-    """Scale on measured QPS vs target_qps_per_replica (reference :458)."""
+class _HysteresisAutoscaler(Autoscaler):
+    """Shared hysteresis machinery (reference _AutoscalerWithHysteresis):
+    subclasses supply ``_desired(...)``; a change of target only lands
+    after persisting for the configured delay."""
 
     def __init__(self, service_name: str,
                  policy: spec_lib.ReplicaPolicy) -> None:
@@ -59,23 +75,31 @@ class RequestRateAutoscaler(Autoscaler):
         self._overload_since: Optional[float] = None
         self._underload_since: Optional[float] = None
 
-    def _measure_qps(self, now: float) -> float:
-        n = serve_state.request_count_since(self.service_name,
-                                            now - QPS_WINDOW_S)
-        return n / QPS_WINDOW_S
+    def _desired(self, now: float, num_ready: int,
+                 replicas: Optional[List[dict]]) -> tuple:
+        """→ (desired_count_before_overprovision, reason string)."""
+        raise NotImplementedError
+
+    def _clip(self, n: int) -> int:
+        lo = self.policy.min_replicas
+        hi = (self.policy.max_replicas
+              if self.policy.max_replicas is not None else n)
+        return max(lo, min(hi, n))
 
     def evaluate(self, num_ready: int,
-                 now: Optional[float] = None) -> AutoscalerDecision:
+                 now: Optional[float] = None,
+                 replicas: Optional[List[dict]] = None
+                 ) -> AutoscalerDecision:
+        # ``target_num_replicas`` is kept overprovision-FREE: relative
+        # scalers (queue-length ±1) step from the demand-driven base;
+        # overprovision is added once, on the emitted decision.
         now = time.time() if now is None else now
         pol = self.policy
-        if not pol.autoscaling or pol.target_qps_per_replica is None:
-            return AutoscalerDecision(
-                pol.min_replicas + pol.num_overprovision, reason='fixed')
-        qps = self._measure_qps(now)
-        demand = math.ceil(qps / pol.target_qps_per_replica)
-        lo = pol.min_replicas
-        hi = pol.max_replicas if pol.max_replicas is not None else demand
-        desired = max(lo, min(hi, demand)) + pol.num_overprovision
+        if not pol.autoscaling:
+            return self._finalize(
+                pol.min_replicas + pol.num_overprovision, 'fixed')
+        demand, why = self._desired(now, num_ready, replicas)
+        desired = self._clip(demand)
         current = self.target_num_replicas
 
         if desired > current:
@@ -85,9 +109,8 @@ class RequestRateAutoscaler(Autoscaler):
             if now - self._overload_since >= pol.upscale_delay_seconds:
                 self._overload_since = None
                 self.target_num_replicas = desired
-                return AutoscalerDecision(
-                    desired, reason=f'upscale: qps={qps:.2f} '
-                    f'demand={demand}')
+                return self._finalize(desired + pol.num_overprovision,
+                                      f'upscale: {why}')
         elif desired < current:
             self._overload_since = None
             if self._underload_since is None:
@@ -95,17 +118,164 @@ class RequestRateAutoscaler(Autoscaler):
             if now - self._underload_since >= pol.downscale_delay_seconds:
                 self._underload_since = None
                 self.target_num_replicas = desired
-                return AutoscalerDecision(
-                    desired, reason=f'downscale: qps={qps:.2f} '
-                    f'demand={demand}')
+                return self._finalize(desired + pol.num_overprovision,
+                                      f'downscale: {why}')
         else:
             self._overload_since = None
             self._underload_since = None
-        return AutoscalerDecision(current, reason='steady')
+        return self._finalize(current + pol.num_overprovision, 'steady')
+
+    def _finalize(self, target: int, reason: str) -> AutoscalerDecision:
+        """Hook for subclasses to split the target by kind."""
+        return AutoscalerDecision(target, reason=reason)
+
+
+class RequestRateAutoscaler(_HysteresisAutoscaler):
+    """Scale on measured QPS vs target_qps_per_replica (reference :458)."""
+
+    def _measure_qps(self, now: float) -> float:
+        n = serve_state.request_count_since(self.service_name,
+                                            now - QPS_WINDOW_S)
+        return n / QPS_WINDOW_S
+
+    def _target_qps(self) -> float:
+        tq = self.policy.target_qps_per_replica
+        assert not isinstance(tq, dict)
+        return float(tq)
+
+    def _desired(self, now: float, num_ready: int,
+                 replicas: Optional[List[dict]]) -> tuple:
+        qps = self._measure_qps(now)
+        demand = math.ceil(qps / self._target_qps())
+        return demand, f'qps={qps:.2f} demand={demand}'
+
+
+class InstanceAwareRequestRateAutoscaler(RequestRateAutoscaler):
+    """Per-accelerator QPS targets (reference :584).
+
+    ``target_qps_per_replica`` is a dict ``{accelerator: qps}``. When
+    scaling up, capacity is estimated optimistically with the LARGEST
+    per-replica target (new replicas may land on the fastest type —
+    reference ``_set_target_num_replicas_with_instance_aware_logic``
+    uses max for upscale); when scaling down, the READY replicas' actual
+    accelerator capacities (sorted descending) decide how few suffice.
+    """
+
+    def _qps_map(self) -> Dict[str, float]:
+        tq = self.policy.target_qps_per_replica
+        assert isinstance(tq, dict)
+        return tq
+
+    def _capacity_of(self, replica: dict) -> float:
+        qps_map = self._qps_map()
+        acc = replica.get('accelerator')
+        if acc in qps_map:
+            return qps_map[acc]
+        return max(qps_map.values())
+
+    def _desired(self, now: float, num_ready: int,
+                 replicas: Optional[List[dict]]) -> tuple:
+        qps = self._measure_qps(now)
+        qps_map = self._qps_map()
+        ready = [r for r in (replicas or [])
+                 if r['status'] == serve_state.ReplicaStatus.READY]
+        ready_capacity = sum(self._capacity_of(r) for r in ready)
+        if not ready or qps >= ready_capacity:
+            # Upscale estimate: assume the best type for new replicas.
+            max_qps = max(qps_map.values())
+            extra = math.ceil(max(0.0, qps - ready_capacity) / max_qps)
+            demand = len(ready) + extra
+        else:
+            # Downscale: keep the largest replicas until demand is met.
+            caps = sorted((self._capacity_of(r) for r in ready),
+                          reverse=True)
+            acc, demand = 0.0, 0
+            for c in caps:
+                if acc >= qps:
+                    break
+                acc += c
+                demand += 1
+            demand = max(demand, 1 if qps > 0 else 0)
+        return demand, (f'qps={qps:.2f} ready_capacity='
+                        f'{ready_capacity:.2f} demand={demand}')
+
+
+class QueueLengthAutoscaler(_HysteresisAutoscaler):
+    """Scale on the LB's queue depth (reference :1073).
+
+    Steps ±1 replica per decision (rate-limited by the hysteresis
+    delays); a queue of zero scales to min_replicas; a non-empty queue
+    never scales to zero.
+    """
+
+    def _desired(self, now: float, num_ready: int,
+                 replicas: Optional[List[dict]]) -> tuple:
+        threshold = self.policy.queue_length_threshold
+        assert threshold is not None
+        qlen = serve_state.get_inflight(self.service_name)
+        current = self.target_num_replicas
+        if qlen == 0:
+            desired = self.policy.min_replicas
+        elif qlen > threshold:
+            desired = current + 1
+        elif qlen < threshold:
+            desired = current - 1
+        else:
+            desired = current
+        if desired == 0 and qlen > 0:
+            desired = 1
+        return desired, f'queue={qlen} threshold={threshold:g}'
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Spot fleet with an on-demand safety net (reference :912).
+
+    The total target follows the request rate; of it,
+    ``base_ondemand_fallback_replicas`` are always on-demand, and with
+    ``dynamic_ondemand_fallback`` every spot replica that is not READY
+    gets an on-demand stand-in until the spot capacity comes back.
+    """
+
+    def __init__(self, service_name: str,
+                 policy: spec_lib.ReplicaPolicy) -> None:
+        super().__init__(service_name, policy)
+        self._last_replicas: List[dict] = []
+
+    def evaluate(self, num_ready: int,
+                 now: Optional[float] = None,
+                 replicas: Optional[List[dict]] = None
+                 ) -> AutoscalerDecision:
+        self._last_replicas = replicas or []
+        return super().evaluate(num_ready, now=now, replicas=replicas)
+
+    def _finalize(self, target: int, reason: str) -> AutoscalerDecision:
+        pol = self.policy
+        base_od = min(pol.base_ondemand_fallback_replicas, target)
+        target_spot = target - base_od
+        target_od = base_od
+        if pol.dynamic_ondemand_fallback:
+            ready_spot = sum(
+                1 for r in self._last_replicas
+                if r.get('is_spot')
+                and r['status'] == serve_state.ReplicaStatus.READY)
+            # Reference: fill the gap between the spot target and READY
+            # spot with on-demand (provisioning spot may never arrive).
+            target_od += max(0, target_spot - ready_spot)
+            target_od = min(target_od, target)
+        return AutoscalerDecision(
+            target, reason=f'{reason} (spot={target_spot} '
+            f'ondemand={target_od})',
+            target_spot=target_spot, target_ondemand=target_od)
 
 
 def make(service_name: str,
          policy: spec_lib.ReplicaPolicy) -> Autoscaler:
+    if policy.queue_length_threshold is not None:
+        return QueueLengthAutoscaler(service_name, policy)
+    if policy.use_ondemand_fallback:
+        return FallbackRequestRateAutoscaler(service_name, policy)
+    if policy.instance_aware:
+        return InstanceAwareRequestRateAutoscaler(service_name, policy)
     if policy.autoscaling:
         return RequestRateAutoscaler(service_name, policy)
     return Autoscaler(service_name, policy)

@@ -53,7 +53,35 @@ class ServeController:
             self.version = record['version']
             self.spec = spec_lib.ServiceSpec.from_config(record['spec'])
             self.rm.update_version(self.spec, record['task_yaml'])
-            self.autoscaler.update_policy(self.spec.replica_policy)
+            # Rebuild via make(): the new policy may select a DIFFERENT
+            # autoscaler class (qps → queue-length, fallback on/off) —
+            # hot-swapping the policy into the old class would evaluate
+            # a signal the policy no longer carries. Carry the current
+            # target over so the fleet doesn't jump on the rollover.
+            old_target = self.autoscaler.target_num_replicas
+            self.autoscaler = autoscalers_lib.make(
+                self.service_name, self.spec.replica_policy)
+            self.autoscaler.target_num_replicas = max(
+                self.spec.replica_policy.min_replicas, old_target)
+
+    def _reconcile_kind(self, group: list, target: int, use_spot: bool,
+                        reason: str) -> None:
+        """Bring one kind (spot / on-demand) of the current-version fleet
+        to its target count."""
+        kind = 'spot' if use_spot else 'on-demand'
+        delta = target - len(group)
+        for _ in range(max(0, delta)):
+            rid = self.rm.launch_replica(self.version, use_spot=use_spot)
+            logger.info('service %s: launching %s replica %d (v%d) [%s]',
+                        self.service_name, kind, rid, self.version,
+                        reason)
+        if delta < 0:
+            victims = autoscalers_lib.select_replicas_to_scale_down(
+                group, -delta)
+            for rid in victims:
+                logger.info('service %s: scaling down %s replica %d [%s]',
+                            self.service_name, kind, rid, reason)
+                self.rm.terminate_replica(rid, reason)
 
     # -- one tick ----------------------------------------------------------
     def tick(self, now: Optional[float] = None) -> None:
@@ -63,7 +91,8 @@ class ServeController:
         live = self.rm.live_replicas()
         num_ready = sum(1 for r in live
                         if r['status'] == ReplicaStatus.READY)
-        decision = self.autoscaler.evaluate(num_ready, now=now)
+        decision = self.autoscaler.evaluate(num_ready, now=now,
+                                            replicas=live)
         target = decision.target_num_replicas
 
         current = [r for r in live if r['version'] == self.version]
@@ -73,13 +102,25 @@ class ServeController:
         ready_current = sum(1 for r in current
                             if r['status'] == ReplicaStatus.READY)
 
-        # Launch up to target on the current version.
-        to_launch = target - len(current)
-        for _ in range(max(0, to_launch)):
-            rid = self.rm.launch_replica(self.version)
-            logger.info('service %s: launching replica %d (v%d) [%s]',
-                        self.service_name, rid, self.version,
-                        decision.reason)
+        if decision.target_spot is not None:
+            # Mixed fleet (fallback autoscaler): reconcile spot and
+            # on-demand groups independently, launching each kind with a
+            # use_spot override.
+            self._reconcile_kind(
+                [r for r in current if r['is_spot']],
+                decision.target_spot, True, decision.reason)
+            self._reconcile_kind(
+                [r for r in current if not r['is_spot']],
+                decision.target_ondemand or 0, False, decision.reason)
+            to_launch = 0   # handled per-kind
+        else:
+            # Launch up to target on the current version.
+            to_launch = target - len(current)
+            for _ in range(max(0, to_launch)):
+                rid = self.rm.launch_replica(self.version)
+                logger.info('service %s: launching replica %d (v%d) [%s]',
+                            self.service_name, rid, self.version,
+                            decision.reason)
         # Rolling update: drain stale replicas only once the current
         # version can carry the FULL load (or there is nothing stale/ready
         # worth preserving) — never collapse capacity mid-roll.

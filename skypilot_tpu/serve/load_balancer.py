@@ -40,6 +40,7 @@ class LoadBalancer:
         self.policy = lbp.make(policy_name)
         self._session: Optional[aiohttp.ClientSession] = None
         self._pending_requests = 0
+        self._inflight = 0
         self._running = True
         # TTFT per proxied request: arrival -> first response byte from
         # the replica (the BASELINE.md north-star serving metric; for a
@@ -53,9 +54,20 @@ class LoadBalancer:
     async def _sync_loop(self) -> None:
         while self._running:
             try:
-                urls = await asyncio.to_thread(
-                    serve_state.ready_replica_urls, self.service_name)
-                self.policy.set_ready_replicas(urls)
+                info = await asyncio.to_thread(
+                    serve_state.ready_replica_info, self.service_name)
+                self.policy.set_replica_info(info)
+                self.policy.set_ready_replicas(list(info))
+                if hasattr(self.policy, 'set_target_qps_per_accelerator'):
+                    # Instance-aware policy: refresh the per-accelerator
+                    # QPS map from the (possibly updated) service spec.
+                    record = await asyncio.to_thread(
+                        serve_state.get_service, self.service_name)
+                    if record is not None:
+                        tq = ((record['spec'].get('replica_policy') or {})
+                              .get('target_qps_per_replica'))
+                        if isinstance(tq, dict):
+                            self.policy.set_target_qps_per_accelerator(tq)
             except Exception:  # noqa: BLE001 — keep serving on DB hiccup
                 logger.warning('replica sync failed', exc_info=True)
             await asyncio.sleep(SYNC_INTERVAL_S)
@@ -64,13 +76,19 @@ class LoadBalancer:
         while self._running:
             await asyncio.sleep(STATS_FLUSH_S)
             n, self._pending_requests = self._pending_requests, 0
-            if n:
-                try:
+            try:
+                if n:
                     await asyncio.to_thread(
                         serve_state.record_requests, self.service_name, n,
                         time.time())
-                except Exception:  # noqa: BLE001
-                    logger.warning('stats flush failed', exc_info=True)
+                # In-flight gauge: the queue-depth signal for
+                # QueueLengthAutoscaler (requests accepted but not yet
+                # finished across all replicas).
+                await asyncio.to_thread(
+                    serve_state.set_inflight, self.service_name,
+                    self._inflight)
+            except Exception:  # noqa: BLE001
+                logger.warning('stats flush failed', exc_info=True)
 
     # -- request path ------------------------------------------------------
     # NOTE: JSON (not the API server's Prometheus registry) is
@@ -111,6 +129,7 @@ class LoadBalancer:
                      f'to check replica health.\n')
         self._pending_requests += 1
         self._requests_total += 1
+        self._inflight += 1
         t_arrival = time.monotonic()
         self.policy.pre_execute(url)
         resp: Optional[web.StreamResponse] = None
@@ -160,6 +179,7 @@ class LoadBalancer:
                 status=502,
                 text=f'Replica {url} failed: {type(e).__name__}: {e}\n')
         finally:
+            self._inflight -= 1
             self.policy.post_execute(url)
 
     # -- lifecycle ---------------------------------------------------------

@@ -1,14 +1,15 @@
 """Load-balancing policies: pick a ready replica per request.
 
 Counterpart of the reference's ``sky/serve/load_balancing_policies.py``
-(RoundRobinPolicy :85, LeastLoadPolicy :111 — the default). Policies are
-synchronous and in-memory; the LB serializes calls through the asyncio
-event loop so no locking is needed.
+(RoundRobinPolicy :85, LeastLoadPolicy :111 — the default,
+InstanceAwareLeastLoadPolicy :151). Policies are synchronous and
+in-memory; the LB serializes calls through the asyncio event loop so no
+locking is needed.
 """
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 
 class LoadBalancingPolicy:
@@ -23,6 +24,10 @@ class LoadBalancingPolicy:
             if set(urls) != set(self.ready_urls):
                 self._on_replica_change(urls)
             self.ready_urls = list(urls)
+
+    def set_replica_info(self, info: Dict[str, Dict[str, Any]]) -> None:
+        """url → replica metadata (accelerator, ...); only the
+        instance-aware policy uses it."""
 
     def _on_replica_change(self, new_urls: List[str]) -> None:
         pass
@@ -79,9 +84,45 @@ class LeastLoadPolicy(LoadBalancingPolicy):
             self._inflight[url] = max(0, self._inflight.get(url, 0) - 1)
 
 
+class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
+    """Least *normalized* load: in-flight divided by the replica's
+    per-accelerator QPS target (reference :151) — a v5p-8 replica with 4
+    in-flight requests may be less loaded than a v5e-4 with 2."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._replica_info: Dict[str, Dict[str, Any]] = {}
+        self._target_qps: Dict[str, float] = {}
+
+    def set_replica_info(self, info: Dict[str, Dict[str, Any]]) -> None:
+        with self._lock:
+            self._replica_info = dict(info)
+
+    def set_target_qps_per_accelerator(
+            self, target_qps: Dict[str, float]) -> None:
+        with self._lock:
+            self._target_qps = {str(k): float(v)
+                                for k, v in target_qps.items()}
+
+    def _normalized_load(self, url: str) -> float:
+        load = self._inflight.get(url, 0)
+        acc = (self._replica_info.get(url) or {}).get('accelerator')
+        qps = self._target_qps.get(acc or '', 0.0)
+        if qps <= 0:
+            qps = max(self._target_qps.values(), default=1.0) or 1.0
+        return load / qps
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_urls:
+                return None
+            return min(self.ready_urls, key=self._normalized_load)
+
+
 POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_load': LeastLoadPolicy,
+    'instance_aware_least_load': InstanceAwareLeastLoadPolicy,
 }
 
 

@@ -70,9 +70,16 @@ class ReplicaManager:
         self.task_yaml = task_yaml
 
     # -- scale up ----------------------------------------------------------
-    def launch_replica(self, version: int) -> int:
+    def launch_replica(self, version: int,
+                       use_spot: Optional[bool] = None) -> int:
+        """``use_spot`` overrides the task's resources — the fallback
+        autoscaler launches on-demand stand-ins into a spot fleet
+        (reference FallbackRequestRateAutoscaler SPOT/ONDEMAND_OVERRIDE).
+        """
         task = task_lib.Task.from_yaml_config(
             yaml.safe_load(self.task_yaml))
+        if use_spot is not None and use_spot != task.resources.use_spot:
+            task.set_resources(task.resources.copy(use_spot=use_spot))
         if task.resources.cloud == 'local':
             # Replicas share the host's network namespace locally — each
             # needs its own port.
@@ -106,6 +113,10 @@ class ReplicaManager:
                                    blocked_placements=blocked)
         ip = info.head.external_ip or info.head.internal_ip or '127.0.0.1'
         serve_state.set_replica_url(replica_id, f'http://{ip}:{port}')
+        acc = info.tpu_slice
+        if not acc and task.resources.accelerators:
+            acc = next(iter(task.resources.accelerators))
+        serve_state.set_replica_accelerator(replica_id, acc)
         conn = serve_state._db().conn  # noqa: SLF001
         # starting_at anchors the readiness grace period: provisioning can
         # take arbitrarily long and must not eat initial_delay_seconds.

@@ -65,12 +65,28 @@ class ReadinessProbe:
 class ReplicaPolicy:
     min_replicas: int = 1
     max_replicas: Optional[int] = None   # None → fixed at min_replicas
-    target_qps_per_replica: Optional[float] = None
+    # float: one QPS target for every replica. dict: accelerator type →
+    # QPS target ('v5e-4': 8, 'v5p-8': 20, ...) — selects the
+    # instance-aware autoscaler/LB (reference
+    # InstanceAwareRequestRateAutoscaler, sky/serve/autoscalers.py:584).
+    target_qps_per_replica: Optional[Any] = None
+    # Scale on LB queue depth instead of QPS (reference
+    # QueueLengthAutoscaler, sky/serve/autoscalers.py:1073) — the right
+    # signal for continuous-batching TPU inference, where a deep queue,
+    # not request rate, means the batch is saturated.
+    queue_length_threshold: Optional[float] = None
     upscale_delay_seconds: float = 300.0
     downscale_delay_seconds: float = 1200.0
     # Extra replicas beyond demand, absorbing preemption churn when the
     # replicas are spot (reference: spot "base on-demand fallback").
     num_overprovision: int = 0
+    # Spot fleet with on-demand safety net (reference
+    # FallbackRequestRateAutoscaler, sky/serve/autoscalers.py:912):
+    # always keep this many on-demand replicas...
+    base_ondemand_fallback_replicas: int = 0
+    # ...and/or launch an on-demand stand-in for every spot replica that
+    # is not (yet) ready.
+    dynamic_ondemand_fallback: bool = False
 
     @classmethod
     def from_config(cls, config: Any) -> 'ReplicaPolicy':
@@ -78,20 +94,31 @@ class ReplicaPolicy:
             return cls()
         if isinstance(config, int):
             return cls(min_replicas=config)
+        tqps = config.get('target_qps_per_replica')
+        if tqps is not None:
+            if isinstance(tqps, dict):
+                tqps = {str(k): float(v) for k, v in tqps.items()}
+            else:
+                tqps = float(tqps)
         pol = cls(
             min_replicas=int(config.get('min_replicas', 1)),
             max_replicas=(int(config['max_replicas'])
                           if config.get('max_replicas') is not None
                           else None),
-            target_qps_per_replica=(
-                float(config['target_qps_per_replica'])
-                if config.get('target_qps_per_replica') is not None
+            target_qps_per_replica=tqps,
+            queue_length_threshold=(
+                float(config['queue_length_threshold'])
+                if config.get('queue_length_threshold') is not None
                 else None),
             upscale_delay_seconds=float(
                 config.get('upscale_delay_seconds', 300.0)),
             downscale_delay_seconds=float(
                 config.get('downscale_delay_seconds', 1200.0)),
             num_overprovision=int(config.get('num_overprovision', 0)),
+            base_ondemand_fallback_replicas=int(
+                config.get('base_ondemand_fallback_replicas', 0)),
+            dynamic_ondemand_fallback=bool(
+                config.get('dynamic_ondemand_fallback', False)),
         )
         if pol.min_replicas < 0:
             raise exceptions.InvalidTaskError('min_replicas must be >= 0')
@@ -101,10 +128,26 @@ class ReplicaPolicy:
                 'max_replicas must be >= min_replicas')
         if (pol.max_replicas is not None
                 and pol.max_replicas > pol.min_replicas
-                and pol.target_qps_per_replica is None):
+                and pol.target_qps_per_replica is None
+                and pol.queue_length_threshold is None):
             raise exceptions.InvalidTaskError(
                 'autoscaling (max_replicas > min_replicas) requires '
-                'target_qps_per_replica')
+                'target_qps_per_replica or queue_length_threshold')
+        if (pol.target_qps_per_replica is not None
+                and pol.queue_length_threshold is not None):
+            raise exceptions.InvalidTaskError(
+                'target_qps_per_replica and queue_length_threshold are '
+                'mutually exclusive scaling signals')
+        if pol.use_ondemand_fallback:
+            if pol.queue_length_threshold is not None:
+                raise exceptions.InvalidTaskError(
+                    'on-demand fallback requires the request-rate signal '
+                    '(target_qps_per_replica); it does not combine with '
+                    'queue_length_threshold')
+            if isinstance(pol.target_qps_per_replica, dict):
+                raise exceptions.InvalidTaskError(
+                    'on-demand fallback does not combine with per-'
+                    'accelerator target_qps_per_replica (pick one)')
         return pol
 
     def to_config(self) -> Dict[str, Any]:
@@ -114,6 +157,15 @@ class ReplicaPolicy:
     def autoscaling(self) -> bool:
         return (self.max_replicas is not None
                 and self.max_replicas > self.min_replicas)
+
+    @property
+    def use_ondemand_fallback(self) -> bool:
+        return (self.base_ondemand_fallback_replicas > 0
+                or self.dynamic_ondemand_fallback)
+
+    @property
+    def instance_aware(self) -> bool:
+        return isinstance(self.target_qps_per_replica, dict)
 
 
 @dataclasses.dataclass

@@ -74,6 +74,7 @@ CREATE TABLE IF NOT EXISTS replicas (
     version INTEGER,
     url TEXT,
     is_spot INTEGER DEFAULT 0,
+    accelerator TEXT,
     zone TEXT,
     launched_at REAL,
     starting_at REAL,
@@ -87,6 +88,11 @@ CREATE TABLE IF NOT EXISTS lb_stats (
     window_start REAL,
     num_requests INTEGER
 );
+CREATE TABLE IF NOT EXISTS lb_gauges (
+    service_name TEXT PRIMARY KEY,
+    updated_at REAL,
+    inflight INTEGER DEFAULT 0
+);
 CREATE INDEX IF NOT EXISTS idx_replicas_service
     ON replicas (service_name);
 CREATE INDEX IF NOT EXISTS idx_lb_stats_service
@@ -94,9 +100,33 @@ CREATE INDEX IF NOT EXISTS idx_lb_stats_service
 """
 
 
+_migrated = set()
+
+
 def _db() -> db_util.Db:
-    return db_util.get_db(os.path.join(common.base_dir(), 'serve.db'),
-                          _SCHEMA)
+    db = db_util.get_db(os.path.join(common.base_dir(), 'serve.db'),
+                        _SCHEMA)
+    if db.path not in _migrated:
+        # Round-3 column on pre-existing DBs (CREATE IF NOT EXISTS does
+        # not evolve live tables). Checked once per path per process.
+        try:
+            db.conn.execute('SELECT accelerator FROM replicas LIMIT 1')
+        except Exception:  # noqa: BLE001 — old schema
+            try:
+                db.conn.rollback()
+            except Exception:  # noqa: BLE001 — sqlite: nothing open
+                pass
+            try:
+                db.conn.execute(
+                    'ALTER TABLE replicas ADD COLUMN accelerator TEXT')
+                db.conn.commit()
+            except Exception:  # noqa: BLE001 — concurrent migrator won
+                try:
+                    db.conn.rollback()
+                except Exception:  # noqa: BLE001
+                    pass
+        _migrated.add(db.path)
+    return db
 
 
 def service_dir(name: str) -> str:
@@ -292,6 +322,24 @@ def ready_replica_urls(service_name: str) -> List[str]:
     return [r['url'] for r in rows if r['url']]
 
 
+def ready_replica_info(service_name: str) -> Dict[str, Dict[str, Any]]:
+    """url → {accelerator, is_spot, replica_id} for ready replicas (the
+    instance-aware LB's view)."""
+    rows = get_replicas(service_name, [ReplicaStatus.READY])
+    return {r['url']: {'accelerator': r.get('accelerator'),
+                       'is_spot': r['is_spot'],
+                       'replica_id': r['replica_id']}
+            for r in rows if r['url']}
+
+
+def set_replica_accelerator(replica_id: int,
+                            accelerator: Optional[str]) -> None:
+    conn = _db().conn
+    conn.execute('UPDATE replicas SET accelerator = ? WHERE replica_id = ?',
+                 (accelerator, replica_id))
+    conn.commit()
+
+
 def _replica_row(row: sqlite3.Row) -> Dict[str, Any]:
     d = dict(row)
     d['status'] = ReplicaStatus(d['status'])
@@ -316,6 +364,29 @@ def request_count_since(service_name: str, since: float) -> int:
         'WHERE service_name = ? AND window_start >= ?',
         (service_name, since)).fetchone()
     return int(row['n'])
+
+
+def set_inflight(service_name: str, inflight: int) -> None:
+    """LB's current in-flight request gauge — the queue-depth signal for
+    QueueLengthAutoscaler."""
+    conn = _db().conn
+    conn.execute(
+        'INSERT INTO lb_gauges (service_name, updated_at, inflight) '
+        'VALUES (?,?,?) ON CONFLICT(service_name) DO UPDATE SET '
+        'updated_at=excluded.updated_at, inflight=excluded.inflight',
+        (service_name, time.time(), inflight))
+    conn.commit()
+
+
+def get_inflight(service_name: str,
+                 max_age_s: float = 30.0) -> int:
+    """Latest LB in-flight gauge; 0 when stale (LB down = no queue)."""
+    row = _db().conn.execute(
+        'SELECT inflight, updated_at FROM lb_gauges WHERE '
+        'service_name = ?', (service_name,)).fetchone()
+    if row is None or time.time() - row['updated_at'] > max_age_s:
+        return 0
+    return int(row['inflight'])
 
 
 def prune_stats(service_name: str, older_than: float) -> None:

@@ -348,3 +348,293 @@ def test_lb_ttft_metrics(sky_tpu_home):
     # 200ms+ full-response time.
     assert 0.08 <= m['ttft_p50_s'] <= 0.5, m
     loop.call_soon_threadsafe(loop.stop)
+
+
+# ---------- round-3 autoscalers (queue / fallback / instance-aware) -------
+def test_queue_length_autoscaler_ticks():
+    name = 'q-svc'
+    pol = spec_lib.ReplicaPolicy(
+        min_replicas=1, max_replicas=4, queue_length_threshold=3.0,
+        upscale_delay_seconds=10.0, downscale_delay_seconds=20.0)
+    scaler = autoscalers.QueueLengthAutoscaler(name, pol)
+    t0 = time.time()
+    # Deep queue: overload starts, but within the upscale delay → hold.
+    serve_state.set_inflight(name, 8)
+    assert scaler.evaluate(1, now=t0).target_num_replicas == 1
+    # Past the delay → step up by ONE (not to max).
+    d = scaler.evaluate(1, now=t0 + 11)
+    assert d.target_num_replicas == 2
+    assert 'queue=8' in d.reason
+    # Queue still deep → another step after another delay.
+    assert scaler.evaluate(2, now=t0 + 12).target_num_replicas == 2
+    assert scaler.evaluate(2, now=t0 + 23).target_num_replicas == 3
+    # Queue drains to zero → back to min after the downscale delay.
+    serve_state.set_inflight(name, 0)
+    t1 = t0 + 100
+    assert scaler.evaluate(3, now=t1).target_num_replicas == 3
+    assert scaler.evaluate(3, now=t1 + 21).target_num_replicas == 1
+
+
+def test_queue_length_autoscaler_never_zero_with_queue():
+    name = 'q0-svc'
+    pol = spec_lib.ReplicaPolicy(
+        min_replicas=0, max_replicas=2, queue_length_threshold=5.0,
+        upscale_delay_seconds=1.0, downscale_delay_seconds=1.0)
+    scaler = autoscalers.QueueLengthAutoscaler(name, pol)
+    scaler.target_num_replicas = 1
+    t0 = time.time()
+    # Below threshold but non-empty: would step to 0 — must hold at 1.
+    serve_state.set_inflight(name, 2)
+    scaler.evaluate(1, now=t0)
+    assert scaler.evaluate(1, now=t0 + 2).target_num_replicas == 1
+    # Empty queue: 0 is allowed (min_replicas=0 pools scale to zero).
+    serve_state.set_inflight(name, 0)
+    scaler.evaluate(1, now=t0 + 3)
+    assert scaler.evaluate(1, now=t0 + 10).target_num_replicas == 0
+
+
+def test_fallback_autoscaler_base_and_dynamic():
+    name = 'fb-svc'
+    pol = spec_lib.ReplicaPolicy(
+        min_replicas=3, max_replicas=6, target_qps_per_replica=1.0,
+        base_ondemand_fallback_replicas=1,
+        dynamic_ondemand_fallback=True,
+        upscale_delay_seconds=10.0, downscale_delay_seconds=10.0)
+    scaler = autoscalers.FallbackRequestRateAutoscaler(name, pol)
+    t0 = time.time()
+
+    def rep(rid, spot, status):
+        return {'replica_id': rid, 'is_spot': spot, 'status': status,
+                'version': 1, 'launched_at': t0}
+
+    # Steady at 3: 1 base on-demand + 2 spot. No spot READY yet →
+    # dynamic fallback covers BOTH missing spot with on-demand.
+    d = scaler.evaluate(0, now=t0, replicas=[])
+    assert d.target_num_replicas == 3
+    assert d.target_spot == 2
+    assert d.target_ondemand == 3   # 1 base + 2 dynamic, capped at total
+    # Both spot READY → dynamic stand-ins no longer needed.
+    replicas = [rep(1, True, ReplicaStatus.READY),
+                rep(2, True, ReplicaStatus.READY),
+                rep(3, False, ReplicaStatus.READY)]
+    d = scaler.evaluate(3, now=t0 + 1, replicas=replicas)
+    assert d.target_spot == 2 and d.target_ondemand == 1
+    # One spot preempted (gone from the list) → one dynamic on-demand.
+    replicas = [rep(1, True, ReplicaStatus.READY),
+                rep(3, False, ReplicaStatus.READY)]
+    d = scaler.evaluate(2, now=t0 + 2, replicas=replicas)
+    assert d.target_spot == 2 and d.target_ondemand == 2
+
+
+def test_fallback_controller_reconciles_mixed_fleet(monkeypatch):
+    """The controller launches per-kind: spot replicas with use_spot=True,
+    fallback on-demand with use_spot=False."""
+    task = _service_task(
+        name='svc-fb',
+        policy={'min_replicas': 2, 'max_replicas': 4,
+                'target_qps_per_replica': 10,
+                'base_ondemand_fallback_replicas': 1,
+                'upscale_delay_seconds': 1,
+                'downscale_delay_seconds': 1},
+        use_spot=True)
+    serve.up(task, _spawn=False)
+    ctl = controller_lib.ServeController('svc-fb')
+    launches = []
+    monkeypatch.setattr(
+        ctl.rm, 'launch_replica',
+        lambda version, use_spot=None: launches.append(use_spot) or
+        len(launches))
+    ctl.tick()
+    assert sorted(launches, key=str) == [False, True]
+    serve.down('svc-fb')
+
+
+def test_instance_aware_autoscaler_capacity_fit():
+    name = 'ia-svc'
+    pol = spec_lib.ReplicaPolicy(
+        min_replicas=1, max_replicas=6,
+        target_qps_per_replica={'v5e-4': 2.0, 'v5p-8': 6.0},
+        upscale_delay_seconds=10.0, downscale_delay_seconds=10.0)
+    scaler = autoscalers.InstanceAwareRequestRateAutoscaler(name, pol)
+    t0 = time.time()
+
+    def rep(rid, acc):
+        return {'replica_id': rid, 'accelerator': acc,
+                'status': ReplicaStatus.READY, 'version': 1,
+                'launched_at': t0, 'is_spot': False}
+
+    # 10 qps over ready capacity 8 (2 + 6) → 1 more replica assuming the
+    # fastest type (ceil(2/6)=1): demand 3.
+    serve_state.record_requests(name, int(10 * autoscalers.QPS_WINDOW_S),
+                                window_start=t0 - 1)
+    replicas = [rep(1, 'v5e-4'), rep(2, 'v5p-8')]
+    scaler.evaluate(2, now=t0, replicas=replicas)
+    d = scaler.evaluate(2, now=t0 + 11, replicas=replicas)
+    assert d.target_num_replicas == 3
+    # Downscale fit: 5 qps with [v5p-8 (6), v5e-4 (2)] ready → the v5p
+    # alone suffices → demand 1 (fresh scaler to skip hysteresis state).
+    name2 = 'ia-svc2'
+    scaler2 = autoscalers.InstanceAwareRequestRateAutoscaler(name2, pol)
+    scaler2.target_num_replicas = 2
+    serve_state.record_requests(name2, int(5 * autoscalers.QPS_WINDOW_S),
+                                window_start=t0 - 1)
+    scaler2.evaluate(2, now=t0, replicas=replicas)
+    d = scaler2.evaluate(2, now=t0 + 11, replicas=replicas)
+    assert d.target_num_replicas == 1
+
+
+def test_instance_aware_least_load_policy():
+    pol = lbp.InstanceAwareLeastLoadPolicy()
+    pol.set_target_qps_per_accelerator({'v5e-4': 2.0, 'v5p-8': 8.0})
+    pol.set_replica_info({
+        'http://a': {'accelerator': 'v5e-4'},
+        'http://b': {'accelerator': 'v5p-8'},
+    })
+    pol.set_ready_replicas(['http://a', 'http://b'])
+    # a: 1 in-flight / 2 qps = 0.5; b: 3 in-flight / 8 qps = 0.375 → b.
+    pol.pre_execute('http://a')
+    for _ in range(3):
+        pol.pre_execute('http://b')
+    assert pol.select_replica() == 'http://b'
+    # b gains a 4th request: 4/8 = 0.5 == a's 0.5; one more → b over.
+    pol.pre_execute('http://b')
+    pol.pre_execute('http://b')   # 5/8 = 0.625 > 0.5
+    assert pol.select_replica() == 'http://a'
+
+
+def test_queue_pressure_scales_replicas_e2e(sky_tpu_home, tmp_path):
+    """End-to-end: slow replicas + concurrent requests through the LB →
+    in-flight gauge rises → QueueLengthAutoscaler adds a replica."""
+    script = tmp_path / 'slow_server.py'
+    script.write_text(
+        'import http.server, os, time\n'
+        'class H(http.server.BaseHTTPRequestHandler):\n'
+        '    def do_GET(self):\n'
+        '        if self.path != "/healthz":\n'
+        '            time.sleep(1.0)\n'
+        '        self.send_response(200)\n'
+        '        self.end_headers()\n'
+        '        self.wfile.write(b"ok")\n'
+        '    def log_message(self, *a):\n'
+        '        pass\n'
+        'http.server.ThreadingHTTPServer(\n'
+        '    ("", int(os.environ["SKYPILOT_SERVE_PORT"])), H\n'
+        ').serve_forever()\n')
+    task = _service_task(
+        run=f'exec python3 {script}',
+        name='svc-qp',
+        policy={'min_replicas': 1, 'max_replicas': 2,
+                'queue_length_threshold': 2,
+                'upscale_delay_seconds': 0.5,
+                'downscale_delay_seconds': 1000})
+    # Fast readiness: probe the instant /healthz path.
+    task.service['readiness_probe'] = {
+        'path': '/healthz', 'initial_delay_seconds': 30,
+        'timeout_seconds': 2}
+    serve.up(task, _spawn=False)
+    ctl = controller_lib.ServeController('svc-qp')
+    _tick_until(ctl, lambda: _num_ready('svc-qp') >= 1)
+
+    record = serve_state.get_service('svc-qp')
+    lb = lb_lib.LoadBalancer('svc-qp', record['lb_policy'])
+    lb_thread = threading.Thread(
+        target=lambda: asyncio.run(lb.run('127.0.0.1',
+                                          record['lb_port'])),
+        daemon=True)
+    lb_thread.start()
+    lb_url = f'http://127.0.0.1:{record["lb_port"]}'
+    # Wait until the LB proxies.
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(f'{lb_url}/healthz', timeout=5):
+                break
+        except Exception:
+            time.sleep(0.3)
+
+    # Sustained pressure: 6 loops of slow requests keep ≥4 in flight.
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(lb_url, timeout=10):
+                    pass
+            except Exception:
+                time.sleep(0.1)
+
+    hammers = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(6)]
+    for h in hammers:
+        h.start()
+    try:
+        _tick_until(
+            ctl,
+            lambda: len(serve_state.get_replicas('svc-qp')) >= 2,
+            timeout=90)
+    finally:
+        stop.set()
+        lb._running = False  # noqa: SLF001
+    # The scale-up decision came from queue pressure.
+    assert serve_state.get_inflight('svc-qp') >= 1
+    serve.down('svc-qp')
+
+
+def test_policy_rejects_conflicting_scaling_signals():
+    with pytest.raises(exceptions.InvalidTaskError):
+        spec_lib.ReplicaPolicy.from_config(
+            {'min_replicas': 1, 'max_replicas': 2,
+             'target_qps_per_replica': 5, 'queue_length_threshold': 3})
+    with pytest.raises(exceptions.InvalidTaskError):
+        spec_lib.ReplicaPolicy.from_config(
+            {'min_replicas': 1, 'max_replicas': 2,
+             'queue_length_threshold': 3,
+             'dynamic_ondemand_fallback': True})
+    with pytest.raises(exceptions.InvalidTaskError):
+        spec_lib.ReplicaPolicy.from_config(
+            {'min_replicas': 1, 'max_replicas': 2,
+             'target_qps_per_replica': {'v5e-4': 2.0},
+             'base_ondemand_fallback_replicas': 1})
+
+
+def test_update_switches_autoscaler_class():
+    """serve update that changes the scaling signal must swap the
+    autoscaler implementation, not hot-swap the policy into the old
+    class (which would evaluate a missing signal)."""
+    task = _service_task(
+        name='svc-sw',
+        policy={'min_replicas': 1, 'max_replicas': 3,
+                'target_qps_per_replica': 5})
+    serve.up(task, _spawn=False)
+    ctl = controller_lib.ServeController('svc-sw')
+    assert isinstance(ctl.autoscaler, autoscalers.RequestRateAutoscaler)
+    task2 = _service_task(
+        name='svc-sw',
+        policy={'min_replicas': 1, 'max_replicas': 3,
+                'queue_length_threshold': 4})
+    serve.update(task2, service_name='svc-sw')
+    ctl.tick()   # must not crash; must swap the scaler
+    assert isinstance(ctl.autoscaler, autoscalers.QueueLengthAutoscaler)
+    serve.down('svc-sw')
+
+
+def test_overprovision_with_queue_scaler_steps_correctly():
+    name = 'op-svc'
+    pol = spec_lib.ReplicaPolicy(
+        min_replicas=1, max_replicas=4, queue_length_threshold=3.0,
+        num_overprovision=1,
+        upscale_delay_seconds=1.0, downscale_delay_seconds=1.0)
+    scaler = autoscalers.QueueLengthAutoscaler(name, pol)
+    t0 = time.time()
+    # Queue below threshold (but non-empty): with overprovision the
+    # fleet must still be able to step DOWN toward min.
+    scaler.target_num_replicas = 3
+    serve_state.set_inflight(name, 1)
+    scaler.evaluate(3, now=t0)
+    d = scaler.evaluate(3, now=t0 + 2)
+    assert d.target_num_replicas == 3  # base 2 + overprovision 1
+    # Queue exactly at threshold: steady, no ratchet.
+    serve_state.set_inflight(name, 3)
+    d1 = scaler.evaluate(3, now=t0 + 4)
+    d2 = scaler.evaluate(3, now=t0 + 8)
+    assert d1.target_num_replicas == d2.target_num_replicas == 3
