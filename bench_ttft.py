@@ -2,43 +2,36 @@
 
 Measures time-to-first-token as a client experiences it THROUGH the
 serve stack: a real inference server (continuous-batching engine,
-infer/engine.py) on the local accelerator, registered as a ready
-replica in the serve state DB, fronted by the real serve load balancer
-(serve/load_balancer.py) whose per-request arrival→first-byte clock is
-the metric (BASELINE.md: "sky serve p50 TTFT").
+infer/engine.py, optionally tensor-parallel) on the local accelerator,
+registered as a ready replica in the serve state DB, fronted by the real
+serve load balancer (serve/load_balancer.py). TTFT is clocked
+client-side per request: send → first streamed byte back through the LB
+(BASELINE.md: "sky serve p50 TTFT").
 
-Short prompts keep the engine to two compiled programs (one prefill
-bucket + fused decode/sample), per the compile-latency constraints of
-single-chip benching. Prints ONE JSON line and writes TTFT_r<N>.json
-when --output is given.
+Protocol: one cold request (captures the compile tail separately), a
+warmup pass, then a CONCURRENCY SWEEP — the same request mix at 1, 4,
+and 16 concurrent in-flight requests — reporting warm p50/p90/p99 and
+achieved throughput per level (the throughput-vs-TTFT curve of a
+continuous-batching engine). Cold compile never pollutes the warm
+percentiles.
 
-Usage:  python bench_ttft.py [--requests 48] [--output TTFT_r02.json]
+Usage:
+  python bench_ttft.py [--model 1b] [--requests-per-level 80]
+                       [--concurrency 1 4 16] [--tp 1]
+                       [--output TTFT_r03.json]
 """
 from __future__ import annotations
 
 import argparse
+import concurrent.futures
 import json
 import multiprocessing
 import os
+import statistics
+import subprocess
 import sys
 import time
 import urllib.request
-
-
-def _post(url: str, payload: dict, timeout: float = 120.0) -> dict:
-    req = urllib.request.Request(
-        url, data=json.dumps(payload).encode(),
-        headers={'Content-Type': 'application/json'})
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        body = r.read()
-    try:
-        out = json.loads(body)
-    except json.JSONDecodeError:
-        # Streaming responses are JSON lines; the last line is terminal.
-        out = json.loads(body.splitlines()[-1])
-    if isinstance(out, dict) and out.get('error'):
-        raise RuntimeError(f'request failed: {out["error"]}')
-    return out
 
 
 def _get(url: str, timeout: float = 10.0) -> dict:
@@ -65,14 +58,67 @@ def _run_lb(service: str, port: int) -> None:
                                     port)
 
 
+def _streamed_ttft(url: str, prompt: str, max_new_tokens: int = 8,
+                   timeout: float = 300.0) -> float:
+    """One streamed /generate through the LB; returns send→first-byte
+    seconds (true client-observed TTFT)."""
+    req = urllib.request.Request(
+        url, data=json.dumps({'prompt': prompt,
+                              'max_new_tokens': max_new_tokens,
+                              'stream': True}).encode(),
+        headers={'Content-Type': 'application/json'})
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        first = r.read(1)          # first streamed byte = first token
+        ttft = time.perf_counter() - t0
+        if not first:
+            raise RuntimeError('empty stream')
+        r.read()                   # drain
+    return ttft
+
+
+def _pct(sorted_vals, p: float):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(len(sorted_vals) * p))
+    return round(sorted_vals[i], 5)
+
+
+def _sweep_level(gen_url: str, concurrency: int, n_requests: int) -> dict:
+    ttfts = []
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
+        futs = [pool.submit(_streamed_ttft, gen_url,
+                            f'request {i} hello world')
+                for i in range(n_requests)]
+        for f in concurrent.futures.as_completed(futs):
+            ttfts.append(f.result())
+    wall = time.perf_counter() - t0
+    ttfts.sort()
+    return {
+        'concurrency': concurrency,
+        'samples': len(ttfts),
+        'ttft_p50_s': _pct(ttfts, 0.50),
+        'ttft_p90_s': _pct(ttfts, 0.90),
+        'ttft_p99_s': _pct(ttfts, 0.99),
+        'ttft_mean_s': round(statistics.fmean(ttfts), 5),
+        'throughput_rps': round(n_requests / wall, 2),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument('--requests', type=int, default=48)
-    parser.add_argument('--model', default='tiny',
-                        help="infer/server.py model ('tiny' keeps warmup "
-                             'to seconds; TTFT measures the serving '
-                             'path, not model quality)')
+    parser.add_argument('--requests-per-level', type=int, default=80)
+    parser.add_argument('--concurrency', type=int, nargs='+',
+                        default=[1, 4, 16])
+    parser.add_argument('--model', default='1b',
+                        help="infer/server.py model (default '1b': a "
+                             'real ~1B-param LLaMA on the chip; random '
+                             'weights — TTFT is a latency property of '
+                             'the serving path, not the values)')
     parser.add_argument('--max-seq-len', type=int, default=128)
+    parser.add_argument('--slots', type=int, default=16)
+    parser.add_argument('--tp', type=int, default=1)
     parser.add_argument('--output', default=None)
     args = parser.parse_args()
 
@@ -83,16 +129,17 @@ def main() -> None:
     infer_port = common.free_port()
     lb_port = common.free_port()
 
-    # 1. Real inference server on the local accelerator (random weights:
-    #    TTFT is a latency property of the serving path, not the values).
-    import subprocess
+    # 1. Real inference server on the local accelerator.
     infer_proc = subprocess.Popen(
         [sys.executable, '-m', 'skypilot_tpu.infer.server',
          '--port', str(infer_port), '--model', args.model,
-         '--slots', '8', '--max-seq-len', str(args.max_seq_len)],
+         '--slots', str(args.slots),
+         '--max-seq-len', str(args.max_seq_len), '--tp', str(args.tp)],
         stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    sweep = []
+    cold_s = None
     try:
-        _wait_http(f'http://127.0.0.1:{infer_port}/health', 300)
+        _wait_http(f'http://127.0.0.1:{infer_port}/health', 600)
 
         # 2. Register it as a ready replica; start the REAL serve LB.
         from skypilot_tpu.serve import state as serve_state
@@ -107,7 +154,6 @@ def main() -> None:
         lb_proc.start()
         try:
             _wait_http(f'http://127.0.0.1:{lb_port}/-/metrics', 60)
-            # LB syncs the ready set every second; wait until it has one.
             deadline = time.time() + 30
             while time.time() < deadline:
                 m = _get(f'http://127.0.0.1:{lb_port}/-/metrics')
@@ -115,21 +161,17 @@ def main() -> None:
                     break
                 time.sleep(0.5)
 
-            # 3. Warm the two compiled programs (prefill bucket + decode)
-            #    off the clock, then measure through the LB.
             gen_url = f'http://127.0.0.1:{lb_port}/generate'
-            _post(gen_url, {'prompt': 'warmup', 'max_new_tokens': 8},
-                  timeout=600)
-            # stream=true: the replica flushes the first token as it is
-            # produced, so the LB's arrival→first-byte clock measures
-            # true time-to-first-token (not time-to-full-completion).
-            t0 = time.time()
-            for i in range(args.requests):
-                _post(gen_url, {'prompt': f'request {i} hello',
-                                'max_new_tokens': 8, 'stream': True})
-            wall = time.time() - t0
-
-            metrics = _get(f'http://127.0.0.1:{lb_port}/-/metrics')
+            # 3. COLD: the first request eats any residual compile —
+            #    reported separately, never mixed into warm percentiles.
+            cold_s = round(_streamed_ttft(gen_url, 'cold request',
+                                          timeout=600), 4)
+            # Warm every concurrency level's batch shapes off the clock.
+            _sweep_level(gen_url, max(args.concurrency), 2 * args.slots)
+            # 4. The sweep.
+            for conc in args.concurrency:
+                sweep.append(_sweep_level(gen_url, conc,
+                                          args.requests_per_level))
         finally:
             lb_proc.terminate()
             lb_proc.join(timeout=10)
@@ -143,17 +185,21 @@ def main() -> None:
         infer_proc.wait(timeout=10)
 
     import jax
+    base = sweep[0] if sweep else {}
     result = {
-        'metric': 'serve_ttft_p50_s',
-        'value': metrics['ttft_p50_s'],
+        'metric': 'serve_ttft_warm_p50_s',
+        'value': base.get('ttft_p50_s'),
         'unit': 'seconds',
-        'ttft_p90_s': metrics['ttft_p90_s'],
-        'ttft_p99_s': metrics['ttft_p99_s'],
-        'samples': metrics['ttft_samples'],
-        'requests_per_sec': round(args.requests / wall, 2),
+        'ttft_warm_p99_s': base.get('ttft_p99_s'),
+        'cold_first_request_s': cold_s,
+        'sweep': sweep,
+        'total_samples': sum(lv['samples'] for lv in sweep),
         'model': args.model,
+        'tp': args.tp,
+        'slots': args.slots,
         'device': jax.devices()[0].device_kind,
-        'path': 'client -> serve LB -> continuous-batching engine',
+        'path': ('client -> serve LB -> continuous-batching engine '
+                 '(streamed; client-side send->first-byte clock)'),
     }
     print(json.dumps(result))
     if args.output:

@@ -68,6 +68,7 @@ class TpuVmBackend(Backend):
         # (dataDisks): pin placement to the disks' zone and pass them in.
         data_disks: List[str] = []
         pd_zones = set()
+        has_pvc = False
         for vol_name in task.volumes.values():
             rec = state.get_volume(vol_name)
             if rec is None:
@@ -82,17 +83,37 @@ class TpuVmBackend(Backend):
             if rec['type'] == 'gcp-pd':
                 data_disks.append(rec['name'])
                 pd_zones.add(rec['zone'])
-        if data_disks:
+            elif rec['type'] == 'k8s-pvc':
+                # PVCs bind inside one cluster: ride the data_disks
+                # channel into render_slice's persistentVolumeClaim
+                # mounts.
+                data_disks.append(rec['name'])
+                has_pvc = True
+                candidates = [c for c in candidates
+                              if c.cloud == 'kubernetes']
+                if not candidates:
+                    raise exceptions.ResourcesUnavailableError(
+                        f'k8s-pvc volume {rec["name"]!r} requires a '
+                        f'kubernetes placement.')
+        if pd_zones:
+            # data_disks semantics are PROVIDER-SPECIFIC (PD names on
+            # gcp, PVC claim names on k8s) — a pd-carrying task must
+            # never reach another provisioner, or the names would be
+            # misinterpreted (e.g. rendered as nonexistent PVCs).
+            if has_pvc:
+                raise exceptions.InvalidTaskError(
+                    'gcp-pd and k8s-pvc volumes cannot be mixed in one '
+                    'task (they pin to different clouds)')
             if len(pd_zones) > 1:
                 raise exceptions.InvalidTaskError(
                     f'gcp-pd volumes of one task must share a zone; '
                     f'got {sorted(pd_zones)}')
             (pd_zone,) = pd_zones
             candidates = [c for c in candidates
-                          if c.cloud != 'gcp' or c.zone == pd_zone]
+                          if c.cloud == 'gcp' and c.zone == pd_zone]
             if not candidates:
                 raise exceptions.ResourcesUnavailableError(
-                    f'No placement in zone {pd_zone} (required by '
+                    f'No gcp placement in zone {pd_zone} (required by '
                     f'gcp-pd volumes {data_disks}).')
         state.add_or_update_cluster(
             cluster_name, common.ClusterStatus.INIT,

@@ -42,9 +42,9 @@ CLOUD_FEATURES: Dict[str, FrozenSet[Feature]] = {
         Feature.STOP, Feature.AUTOSTOP, Feature.SPOT, Feature.MULTISLICE,
         Feature.STORAGE_MOUNTING, Feature.VOLUMES,
         Feature.HOST_CONTROLLERS,
-        # OPEN_PORTS: intra-VPC reachability (what serve's LB→replica
-        # path needs) works without firewall rules; provision/gcp's
-        # open_ports no-op only limits EXTERNAL exposure.
+        # OPEN_PORTS: real VPC firewall rules targeted at the slice's
+        # network tag (provision/gcp/instance.py open_ports) — external
+        # exposure, not just intra-VPC reachability.
         Feature.OPEN_PORTS,
     }),
     'local': frozenset({
@@ -56,9 +56,13 @@ CLOUD_FEATURES: Dict[str, FrozenSet[Feature]] = {
         # stop = scale-to-zero (provision/k8s/instance.py:193).
         Feature.STOP, Feature.STORAGE_MOUNTING,
         Feature.HOST_CONTROLLERS,
+        # SPOT: GKE spot node pools (render_slice use_spot toleration +
+        # nodeSelector); OPEN_PORTS: Service exposure (open_ports);
+        # VOLUMES: k8s-pvc PersistentVolumeClaims.
+        Feature.SPOT, Feature.OPEN_PORTS, Feature.VOLUMES,
         # NOT AUTOSTOP: the in-pod agent cannot scale its own
         # StatefulSet without RBAC the manifests do not grant.
-        # NOT SPOT / MULTISLICE / OPEN_PORTS / VOLUMES.
+        # NOT MULTISLICE (needs a JobSet path).
     }),
     'ssh': frozenset({
         # Bare metal: hosts are sunk cost; stop = stop the agents.

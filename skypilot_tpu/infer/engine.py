@@ -47,6 +47,12 @@ class EngineConfig:
     max_new_tokens: int = 256
     top_k: int = 0
     cache_dtype: str = 'bfloat16'
+    # Tensor-parallel degree: shard params (Megatron-style, the
+    # column/row rules in parallel/sharding.py) and the KV cache (over
+    # KV heads) across the first `tp` local devices. An 8B model in bf16
+    # does not fit one v5e chip; tp=4/8 over ICI makes it servable —
+    # GSPMD inserts the all-reduces, the engine code is unchanged.
+    tp: int = 1
 
 
 @dataclasses.dataclass
@@ -72,6 +78,28 @@ class Request:
         return self.first_token_at - self.submitted_at
 
 
+def tp_mesh(tp: int) -> 'jax.sharding.Mesh':
+    """The engine's tensor-parallel mesh over the first `tp` local
+    devices ((tp, fsdp=1) so the training param rules apply directly)."""
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if len(devs) < tp:
+        raise ValueError(f'tp={tp} but only {len(devs)} devices')
+    return Mesh(np.array(devs[:tp]).reshape(tp, 1), ('tp', 'fsdp'))
+
+
+def init_params_sharded(config: llama.LlamaConfig, tp: int,
+                        seed: int = 0) -> llama.Params:
+    """Initialize params DIRECTLY onto the tp mesh — an 8B model cannot
+    first materialize on one chip (jit with out_shardings makes XLA
+    produce each shard on its own device)."""
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    mesh = tp_mesh(tp)
+    init = lambda: llama.init_params(config, jax.random.PRNGKey(seed))  # noqa: E731
+    shardings = sharding_lib.param_shardings(mesh, jax.eval_shape(init))
+    return jax.jit(init, out_shardings=shardings)()
+
+
 class InferenceEngine:
     """Slot-based continuous batching over one model replica."""
 
@@ -95,6 +123,9 @@ class InferenceEngine:
             config.n_layers, self.ecfg.n_slots, self.ecfg.max_seq_len,
             config.n_kv_heads, config.head_dim,
             dtype=jnp.dtype(self.ecfg.cache_dtype))
+        self.mesh = None
+        if self.ecfg.tp > 1:
+            self._shard_tp()
         self._key = jax.random.PRNGKey(seed)
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
@@ -112,10 +143,14 @@ class InferenceEngine:
         self._ttfts: collections.deque = collections.deque(maxlen=1024)
 
         # ---- compiled programs ------------------------------------------
+        # Params are ARGUMENTS, never closure-captured: captured arrays
+        # are baked into the lowered program as constants — for a 1B+
+        # model that is gigabytes of constants, a pathological compile,
+        # and a second copy of the weights in the executable.
         @functools.partial(jax.jit, static_argnums=(0,))
-        def _prefill(bucket_is_static, tokens, true_len):
+        def _prefill(bucket_is_static, params, tokens, true_len):
             del bucket_is_static
-            return model_lib.prefill(config, self.params, tokens, true_len)
+            return model_lib.prefill(config, params, tokens, true_len)
         self._prefill = _prefill
 
         @functools.partial(jax.jit, donate_argnums=(0,))
@@ -125,9 +160,9 @@ class InferenceEngine:
         self._insert = _insert
 
         @functools.partial(jax.jit, donate_argnums=(0,))
-        def _decode(kv_cache, tokens, key, temps):
+        def _decode(kv_cache, params, tokens, key, temps):
             logits, new_cache = model_lib.decode_step(
-                config, self.params, kv_cache, tokens)
+                config, params, kv_cache, tokens)
             toks = sampling_lib.sample(logits, key, temps,
                                        top_k=self.ecfg.top_k)
             return toks, new_cache
@@ -143,6 +178,39 @@ class InferenceEngine:
             return sampling_lib.sample(logits[None], key, temp[None],
                                        top_k=self.ecfg.top_k)[0]
         self._sample_first = _sample_first
+
+    def _shard_tp(self) -> None:
+        """Distribute params + KV cache over a `tp` mesh axis.
+
+        Reuses the training sharding rules (parallel/sharding.py:
+        attention/MLP column+row parallel, vocab-parallel embed/lm_head)
+        on a (tp, fsdp=1) mesh; the KV cache shards over KV heads. The
+        compiled prefill/decode programs are untouched — GSPMD partitions
+        them from the input shardings and inserts the collectives.
+        """
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        tp = self.ecfg.tp
+        cfg = self.config
+        for dim_name, dim in (('n_heads', cfg.n_heads),
+                              ('n_kv_heads', cfg.n_kv_heads),
+                              ('ffn_dim', cfg.ffn_dim),
+                              ('vocab_size', cfg.vocab_size)):
+            if dim % tp:
+                raise ValueError(
+                    f'tp={tp} must divide {dim_name}={dim}')
+        mesh = tp_mesh(tp)
+        self.mesh = mesh
+        self.params = sharding_lib.shard_pytree(
+            self.params, sharding_lib.param_shardings(mesh, self.params))
+        kv_spec = NamedSharding(mesh, P(None, None, None, 'tp', None))
+        self.cache = cache_lib.KVCache(
+            k=jax.device_put(self.cache.k, kv_spec),
+            v=jax.device_put(self.cache.v, kv_spec),
+            lengths=jax.device_put(self.cache.lengths,
+                                   NamedSharding(mesh, P())))
 
     # ---- submission ------------------------------------------------------
     def submit(self, prompt_tokens: Sequence[int],
@@ -185,8 +253,8 @@ class InferenceEngine:
         bucket = self._bucket(n)
         padded = np.zeros((bucket,), np.int32)
         padded[:n] = req.prompt_tokens
-        ks, vs, logits = self._prefill(bucket, jnp.asarray(padded),
-                                       jnp.int32(n))
+        ks, vs, logits = self._prefill(bucket, self.params,
+                                       jnp.asarray(padded), jnp.int32(n))
         self.cache = self._insert(self.cache, jnp.int32(slot), ks, vs,
                                   jnp.int32(n))
         first = int(self._sample_first(
@@ -240,7 +308,7 @@ class InferenceEngine:
             return 0
         t0 = time.perf_counter()
         toks, self.cache = self._decode(
-            self.cache, jnp.asarray(self._last_token),
+            self.cache, self.params, jnp.asarray(self._last_token),
             self._next_key(), jnp.asarray(self._temps))
         toks_host = np.asarray(toks)
         self._decode_time += time.perf_counter() - t0

@@ -40,6 +40,7 @@ logger = logging.getLogger(__name__)
 MODELS = {
     'tiny': llama.LlamaConfig.tiny,
     '350m': llama.LlamaConfig.bench_350m,
+    '1b': llama.LlamaConfig.bench_1b,
     '8b': llama.LlamaConfig.llama3_8b,
 }
 
@@ -194,16 +195,42 @@ def main() -> None:
                         help='Orbax checkpoint dir (train/checkpoint.py)')
     parser.add_argument('--slots', type=int, default=8)
     parser.add_argument('--max-seq-len', type=int, default=1024)
+    parser.add_argument('--tp', type=int, default=1,
+                        help='Tensor-parallel degree over local devices '
+                             '(8B-class models need tp>=4 on v5e)')
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     config = MODELS[args.model]()
     if args.checkpoint:
         from skypilot_tpu.train import checkpoint as ckpt_lib
-        restored = ckpt_lib.CheckpointManager(args.checkpoint).restore()
+        mgr = ckpt_lib.CheckpointManager(args.checkpoint)
+        if args.tp > 1:
+            # Restore DIRECTLY sharded: an 8B-class model cannot first
+            # materialize on one chip (engine.init_params_sharded has
+            # the same rule for random weights). The target carries
+            # per-leaf NamedShardings; orbax places each shard on its
+            # device.
+            from skypilot_tpu.parallel import sharding as sharding_lib
+            mesh = engine_lib.tp_mesh(args.tp)
+            abstract = jax.eval_shape(
+                lambda: llama.init_params(config, jax.random.PRNGKey(0)))
+            shardings = sharding_lib.param_shardings(mesh, abstract)
+            target = jax.tree_util.tree_map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                  sharding=s),
+                abstract, shardings)
+            restored = mgr.restore(target=target)
+        else:
+            restored = mgr.restore()
         # Accept either a bare params pytree or a full train state.
         params = restored.get('params', restored) if isinstance(
             restored, dict) else restored.params
+    elif args.tp > 1:
+        logger.warning('no --checkpoint: serving random weights (%s), '
+                       'initialized sharded over tp=%d', args.model,
+                       args.tp)
+        params = engine_lib.init_params_sharded(config, args.tp)
     else:
         logger.warning('no --checkpoint: serving random weights (%s)',
                        args.model)
@@ -212,7 +239,8 @@ def main() -> None:
         config, params,
         engine_lib.EngineConfig(
             n_slots=args.slots,
-            max_seq_len=min(args.max_seq_len, config.max_seq_len)))
+            max_seq_len=min(args.max_seq_len, config.max_seq_len),
+            tp=args.tp))
     InferenceServer(engine).run(args.host, args.port)
 
 

@@ -104,7 +104,8 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
                         'sky-tpu-cluster': config.cluster_name},
                 startup_script=_STARTUP_SCRIPT,
                 metadata=config.provider_config.get('metadata'),
-                data_disks=config.data_disks)
+                data_disks=config.data_disks,
+                tags=[_net_tag(config.cluster_name)])
     except Exception:
         _rollback_created(client, config.zone, created)
         raise
@@ -267,6 +268,12 @@ def terminate_instances(cluster_name: str,
     client = _client(provider_config)
     for name in _slices(provider_config, cluster_name):
         client.delete_node(provider_config['zone'], name)
+    try:
+        cleanup_ports(cluster_name, provider_config)
+    except Exception:  # noqa: BLE001 — an orphan allow-rule targets a
+        # tag with no remaining VMs; never fail teardown over it.
+        logger.warning('firewall rule cleanup failed for %s',
+                       cluster_name, exc_info=True)
 
 
 def wait_instances(cluster_name: str, provider_config: Dict[str, Any],
@@ -293,9 +300,33 @@ def wait_instances(cluster_name: str, provider_config: Dict[str, Any],
         f'TPU nodes {pending} not {want} within 600s')
 
 
+def _net_tag(cluster_name: str) -> str:
+    import re
+    # Network-tag charset: lowercase letters, digits, dash; ≤63 chars.
+    tag = 'sky-tpu-' + re.sub(r'[^a-z0-9-]', '-', cluster_name.lower())
+    return tag[:63].rstrip('-')
+
+
+def _fw_rule_name(cluster_name: str) -> str:
+    return (_net_tag(cluster_name) + '-ports')[:63].rstrip('-')
+
+
 def open_ports(cluster_name: str, ports,
                provider_config: Dict[str, Any]) -> None:
-    """Firewall rules via the compute API — deferred; TPU VMs within a VPC
-    reach each other already, and the API server path documents the
-    limitation."""
-    del cluster_name, ports, provider_config
+    """Create/refresh the VPC firewall rule exposing ``ports`` on this
+    cluster's VMs (targeted by the network tag set at create; reference
+    sky/provision/gcp/config.py:424 firewall-rule shape). Without it a
+    served endpoint is reachable only inside the VPC."""
+    client = tpu_api.GceFirewallClient(_project(provider_config))
+    client.ensure_rule(
+        _fw_rule_name(cluster_name),
+        network=provider_config.get('network', 'default'),
+        ports=[str(p) for p in ports],
+        target_tag=_net_tag(cluster_name))
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    """Delete the cluster's firewall rule (no-op if none was created)."""
+    client = tpu_api.GceFirewallClient(_project(provider_config))
+    client.delete_rule(_fw_rule_name(cluster_name))

@@ -95,7 +95,8 @@ class TpuApiClient:
                     startup_script: Optional[str] = None,
                     network: Optional[str] = None,
                     metadata: Optional[Dict[str, str]] = None,
-                    data_disks: Optional[List[str]] = None
+                    data_disks: Optional[List[str]] = None,
+                    tags: Optional[List[str]] = None
                     ) -> Dict[str, Any]:
         body: Dict[str, Any] = {
             'acceleratorType': accelerator_type,
@@ -103,6 +104,10 @@ class TpuApiClient:
             'networkConfig': {'enableExternalIps': True},
             'labels': labels or {},
         }
+        if tags:
+            # Network tags: firewall rules target the slice's VMs by tag
+            # (open_ports) instead of blanketing the whole VPC.
+            body['tags'] = list(tags)
         if data_disks:
             # gcp-pd volumes: the TPU API only attaches disks at create.
             body['dataDisks'] = [
@@ -195,7 +200,38 @@ def default_project() -> str:
 COMPUTE_API = 'https://compute.googleapis.com/compute/v1'
 
 
-class GceDiskClient(TpuApiClient):
+class _GceComputeClient(TpuApiClient):
+    """Shared compute-API operation handling (TPU ops poll a different
+    URL/shape than compute ops, so the inherited wait_operation cannot
+    be reused). Subclasses pass the scope-specific operations URL."""
+
+    @staticmethod
+    def _check_compute_op_error(op: Dict[str, Any]) -> None:
+        errors = (op.get('error') or {}).get('errors') or []
+        if errors:
+            msg = '; '.join(e.get('message', str(e)) for e in errors)
+            if any('quota' in str(e).lower() for e in errors):
+                raise exceptions.QuotaExceededError(msg)
+            raise exceptions.ProvisionError(msg)
+
+    def _wait_compute_op(self, op: Dict[str, Any], op_url_base: str,
+                         timeout: float = 300.0) -> None:
+        name = op.get('name')
+        if name is None or op.get('status') == 'DONE':
+            self._check_compute_op_error(op)
+            return
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            cur = self._request('GET', f'{op_url_base}/{name}')
+            if cur.get('status') == 'DONE':
+                self._check_compute_op_error(cur)
+                return
+            time.sleep(2.0)
+        raise exceptions.ProvisionTimeoutError(
+            f'Compute operation {name} timed out after {timeout}s')
+
+
+class GceDiskClient(_GceComputeClient):
     """Persistent-disk ops for gcp-pd volumes (compute API; reuses the
     TPU client's auth/error mapping — reference provisions PDs through
     the same google-api plumbing)."""
@@ -207,32 +243,9 @@ class GceDiskClient(TpuApiClient):
 
     def _wait_zone_op(self, zone: str, op: Dict[str, Any],
                       timeout: float = 300.0) -> None:
-        """Compute zone operations poll at a different URL than TPU ops
-        (the inherited wait_operation cannot be reused)."""
-        name = op.get('name')
-        if name is None or op.get('status') == 'DONE':
-            self._check_compute_op_error(op)
-            return
-        url = (f'{COMPUTE_API}/projects/{self.project}/zones/{zone}'
-               f'/operations/{name}')
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            cur = self._request('GET', url)
-            if cur.get('status') == 'DONE':
-                self._check_compute_op_error(cur)
-                return
-            time.sleep(2.0)
-        raise exceptions.ProvisionTimeoutError(
-            f'Compute operation {name} timed out after {timeout}s')
-
-    @staticmethod
-    def _check_compute_op_error(op: Dict[str, Any]) -> None:
-        errors = (op.get('error') or {}).get('errors') or []
-        if errors:
-            msg = '; '.join(e.get('message', str(e)) for e in errors)
-            if any('quota' in str(e).lower() for e in errors):
-                raise exceptions.QuotaExceededError(msg)
-            raise exceptions.ProvisionError(msg)
+        self._wait_compute_op(
+            op, f'{COMPUTE_API}/projects/{self.project}/zones/{zone}'
+            f'/operations', timeout)
 
     def create_disk(self, zone: str, name: str, size_gb: int, *,
                     disk_type: str = 'pd-balanced') -> Dict[str, Any]:
@@ -261,5 +274,61 @@ class GceDiskClient(TpuApiClient):
         try:
             op = self._request('DELETE', self._disk_url(zone, name))
             self._wait_zone_op(zone, op)
+        except exceptions.ClusterDoesNotExist:
+            pass   # already gone
+
+
+class GceFirewallClient(_GceComputeClient):
+    """VPC firewall-rule ops backing ``open_ports`` (compute API;
+    reference sky/provision/gcp/config.py:424 _check_firewall_rules and
+    the rule-create path around it — same rule shape: allow tcp:<ports>
+    from 0.0.0.0/0 to the cluster's network tag)."""
+
+    def _fw_url(self, name: str = '') -> str:
+        base = f'{COMPUTE_API}/projects/{self.project}/global/firewalls'
+        return f'{base}/{name}' if name else base
+
+    def _wait_global_op(self, op: Dict[str, Any],
+                        timeout: float = 300.0) -> None:
+        self._wait_compute_op(
+            op, f'{COMPUTE_API}/projects/{self.project}/global'
+            f'/operations', timeout)
+
+    def ensure_rule(self, name: str, *, network: str,
+                    ports: List[str], target_tag: str,
+                    source_ranges: Optional[List[str]] = None
+                    ) -> Dict[str, Any]:
+        """Create (or update, if the port set changed) an allow rule."""
+        body = {
+            'name': name,
+            'network': (network if '/' in network else
+                        f'projects/{self.project}/global/networks/'
+                        f'{network}'),
+            'direction': 'INGRESS',
+            'allowed': [{'IPProtocol': 'tcp',
+                         'ports': [str(p) for p in ports]}],
+            'sourceRanges': source_ranges or ['0.0.0.0/0'],
+            'targetTags': [target_tag],
+        }
+        try:
+            existing = self._request('GET', self._fw_url(name))
+        except exceptions.ClusterDoesNotExist:
+            existing = None
+        if existing is None:
+            op = self._request('POST', self._fw_url(), body)
+        else:
+            have = set()
+            for a in existing.get('allowed', []):
+                have.update(str(p) for p in a.get('ports', []))
+            if have == set(body['allowed'][0]['ports']):
+                return existing
+            op = self._request('PATCH', self._fw_url(name), body)
+        self._wait_global_op(op)
+        return body
+
+    def delete_rule(self, name: str) -> None:
+        try:
+            op = self._request('DELETE', self._fw_url(name))
+            self._wait_global_op(op)
         except exceptions.ClusterDoesNotExist:
             pass   # already gone

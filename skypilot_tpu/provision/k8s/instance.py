@@ -80,7 +80,9 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         namespace=config.provider_config.get('namespace', 'default'),
         image=config.provider_config.get(
             'image', manifests.DEFAULT_IMAGE),
-        labels=config.labels)
+        labels=config.labels,
+        use_spot=config.use_spot,
+        pvc_volumes=config.data_disks)
     _kubectl(config.provider_config, ['apply', '-f', '-'],
              stdin=json.dumps(manifest))
     _wait_pods_running(config.cluster_name, config.provider_config,
@@ -223,6 +225,9 @@ def terminate_instances(cluster_name: str,
                                    '--ignore-not-found'])
         _kubectl(provider_config, ['delete', 'service', cluster_name,
                                    '--ignore-not-found'])
+        _kubectl(provider_config, ['delete', 'service',
+                                   f'{cluster_name}-ports',
+                                   '--ignore-not-found'])
     except exceptions.ClusterDoesNotExist:
         pass
 
@@ -248,6 +253,28 @@ _PHASE_TO_STATE = {
 }
 
 
+def _expected_hosts(cluster_name: str,
+                    provider_config: Dict[str, Any]) -> Optional[int]:
+    """The gang's CURRENT intended host count from the StatefulSet.
+
+    spec.replicas first (0 after a scale-to-zero stop — which must not
+    read as a dead gang), the sky-tpu-num-hosts label as fallback.
+    None = the StatefulSet itself is gone (terminated cluster)."""
+    try:
+        out = _kubectl(provider_config, ['get', 'statefulset',
+                                         cluster_name, '-o', 'json'])
+        sts = json.loads(out)
+    except (exceptions.ClusterDoesNotExist, exceptions.ProvisionError,
+            json.JSONDecodeError):
+        return None
+    replicas = sts.get('spec', {}).get('replicas')
+    if replicas is not None:
+        return int(replicas)
+    label = (sts.get('metadata', {}).get('labels', {})
+             .get('sky-tpu-num-hosts'))
+    return int(label) if label and str(label).isdigit() else None
+
+
 def get_cluster_info(cluster_name: str,
                      provider_config: Dict[str, Any]
                      ) -> Optional[ClusterInfo]:
@@ -256,13 +283,21 @@ def get_cluster_info(cluster_name: str,
     except exceptions.ClusterDoesNotExist:
         return None
     if not pods:
-        # Distinguish scaled-to-zero (sts exists) from terminated.
-        try:
-            _kubectl(provider_config, ['get', 'statefulset',
-                                       cluster_name, '-o', 'name'])
-        except (exceptions.ClusterDoesNotExist, exceptions.ProvisionError):
+        # Distinguish scaled-to-zero (sts exists, replicas 0) from a
+        # fully reclaimed gang (replicas > 0 but every pod deleted at
+        # once — e.g. an N-host spot slice losing all N): the latter
+        # must read as TERMINATED hosts or the managed-jobs
+        # provider-plane watch (all-RUNNING check over an EMPTY list)
+        # would call a dead slice healthy.
+        expected = _expected_hosts(cluster_name, provider_config)
+        if expected is None:
             return None
-        hosts: List[HostInfo] = []
+        hosts: List[HostInfo] = [
+            HostInfo(host_id=f'{cluster_name}-{i}', internal_ip='',
+                     external_ip=None, state='TERMINATED',
+                     agent_url=None)
+            for i in range(expected)
+        ]
         tpu_slice = None
     else:
         # Numeric ordinal sort: lexicographic puts '-10' before '-2'
@@ -283,6 +318,25 @@ def get_cluster_info(cluster_name: str,
                     p['status'].get('phase', 'Unknown'), 'UNKNOWN'),
                 agent_url=(f'http://{ip}:{manifests.AGENT_PORT}'
                            if ip else None)))
+        # A reclaimed spot pod is DELETED, not Failed — with only live
+        # pods listed, a 3/4 gang would read as all-RUNNING and the
+        # managed-jobs provider-plane watch would never fire. Compare
+        # against the gang size (the sky-tpu-num-hosts label rides on
+        # every pod — no extra kubectl round trip) and surface missing
+        # ordinals as TERMINATED hosts.
+        label = (pods[0].get('metadata', {}).get('labels', {})
+                 .get('sky-tpu-num-hosts'))
+        expected = (int(label) if label and str(label).isdigit()
+                    else _expected_hosts(cluster_name, provider_config))
+        if expected is not None and len(hosts) < expected:
+            present = {h.host_id for h in hosts}
+            for i in range(expected):
+                pod_name = f'{cluster_name}-{i}'
+                if pod_name not in present:
+                    hosts.append(HostInfo(
+                        host_id=pod_name, internal_ip='',
+                        external_ip=None, state='TERMINATED',
+                        agent_url=None))
         sel = (pods[0]['spec'].get('nodeSelector') or {})
         gke_acc = sel.get('cloud.google.com/gke-tpu-accelerator')
         topo = sel.get('cloud.google.com/gke-tpu-topology')
@@ -321,5 +375,30 @@ def _slice_name_from_gke(gke_acc: Optional[str],
 
 def open_ports(cluster_name: str, ports,
                provider_config: Dict[str, Any]) -> None:
-    del cluster_name, ports, provider_config   # Service exposure is a
-    # follow-up (LoadBalancer/Ingress rendering)
+    """Expose ``ports`` via a Service over the slice's pods (reference's
+    k8s provisioner uses Services the same way). Type LoadBalancer by
+    default; ``ports_service_type: NodePort`` for clusters without an LB
+    controller."""
+    manifest = manifests.render_ports_service(
+        cluster_name, [str(p) for p in ports],
+        namespace=provider_config.get('namespace', 'default'),
+        service_type=provider_config.get('ports_service_type',
+                                         'LoadBalancer'))
+    _kubectl(provider_config, ['apply', '-f', '-'],
+             stdin=json.dumps(manifest))
+
+
+def create_pvc(name: str, size_gb: int,
+               provider_config: Dict[str, Any]) -> None:
+    """Create the PVC backing a ``k8s-pvc`` volume (idempotent apply)."""
+    manifest = manifests.render_pvc(
+        name, size_gb,
+        namespace=provider_config.get('namespace', 'default'),
+        storage_class=provider_config.get('storage_class'))
+    _kubectl(provider_config, ['apply', '-f', '-'],
+             stdin=json.dumps(manifest))
+
+
+def delete_pvc(name: str, provider_config: Dict[str, Any]) -> None:
+    _kubectl(provider_config, ['delete', 'pvc', name,
+                               '--ignore-not-found'])

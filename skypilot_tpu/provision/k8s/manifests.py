@@ -15,7 +15,7 @@ GKE nodeSelector mapping (public GKE docs' accelerator names):
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import topology
 
@@ -38,7 +38,9 @@ def render_slice(cluster_name: str,
                  image: str = DEFAULT_IMAGE,
                  cpu: str = '4',
                  memory: str = '16Gi',
-                 labels: Optional[Dict[str, str]] = None
+                 labels: Optional[Dict[str, str]] = None,
+                 use_spot: bool = False,
+                 pvc_volumes: Optional[List[str]] = None
                  ) -> Dict[str, Any]:
     """Headless Service + StatefulSet for one slice (or one CPU pod when
     tpu is None). Returned as a kubectl-applyable List manifest."""
@@ -89,6 +91,27 @@ def render_slice(cluster_name: str,
                 GKE_TPU_ACCELERATOR[tpu.generation],
             'cloud.google.com/gke-tpu-topology': tpu.topology_str,
         }
+    if use_spot:
+        # GKE spot node pools: schedule onto spot nodes and tolerate
+        # their taint (the slice then rides spot pricing; preemption
+        # surfaces as pod deletion, which the managed-jobs dual-plane
+        # watch already treats as a dead gang).
+        pod_spec.setdefault('nodeSelector', {})[
+            'cloud.google.com/gke-spot'] = 'true'
+        pod_spec.setdefault('tolerations', []).append({
+            'key': 'cloud.google.com/gke-spot',
+            'operator': 'Equal',
+            'value': 'true',
+            'effect': 'NoSchedule',
+        })
+    for vol_name in pvc_volumes or []:
+        # PVC-backed volumes mount at a fixed in-pod path; the volume
+        # mount step symlinks the task's requested path onto it.
+        container['volumeMounts'].append(
+            {'name': f'vol-{vol_name}', 'mountPath': f'/mnt/{vol_name}'})
+        pod_spec['volumes'].append(
+            {'name': f'vol-{vol_name}',
+             'persistentVolumeClaim': {'claimName': vol_name}})
     service = {
         'apiVersion': 'v1',
         'kind': 'Service',
@@ -137,6 +160,50 @@ def _fuse_proxy_source() -> str:
                 return f.read()
     raise FileNotFoundError(
         'native/fuse_proxy.cc not found next to the package')
+
+
+def render_ports_service(cluster_name: str, ports: List[str], *,
+                         namespace: str = 'default',
+                         service_type: str = 'LoadBalancer'
+                         ) -> Dict[str, Any]:
+    """Service exposing ``ports`` on the slice's pods (open_ports;
+    reference's k8s provisioner exposes ports via Services). Default
+    LoadBalancer for an external IP; set
+    ``provider_config.ports_service_type: NodePort`` on clusters whose
+    LB controller is absent."""
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {'name': f'{cluster_name}-ports',
+                     'namespace': namespace,
+                     'labels': {LABEL_CLUSTER: cluster_name}},
+        'spec': {
+            'type': service_type,
+            'selector': {LABEL_CLUSTER: cluster_name},
+            'ports': [{'port': int(p), 'targetPort': int(p),
+                       'name': f'port-{p}'} for p in ports],
+        },
+    }
+
+
+def render_pvc(name: str, size_gb: int, *,
+               namespace: str = 'default',
+               storage_class: Optional[str] = None,
+               access_mode: str = 'ReadWriteOnce') -> Dict[str, Any]:
+    """PersistentVolumeClaim backing a ``k8s-pvc`` volume."""
+    spec: Dict[str, Any] = {
+        'accessModes': [access_mode],
+        'resources': {'requests': {'storage': f'{size_gb}Gi'}},
+    }
+    if storage_class is not None:
+        spec['storageClassName'] = storage_class
+    return {
+        'apiVersion': 'v1',
+        'kind': 'PersistentVolumeClaim',
+        'metadata': {'name': name, 'namespace': namespace,
+                     'labels': {'sky-tpu-volume': name}},
+        'spec': spec,
+    }
 
 
 def render_fuse_proxy_daemonset(namespace: str = 'kube-system',

@@ -258,3 +258,8 @@ def get_cluster_info(cluster_name: str,
 def open_ports(cluster_name: str, ports,
                provider_config: Dict[str, Any]) -> None:
     del cluster_name, ports, provider_config  # no-op locally
+
+
+# Loopback networking: every port is already reachable. The capability
+# honesty test accepts a no-op only with this marker.
+open_ports.trivially_open = True

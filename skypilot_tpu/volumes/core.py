@@ -23,6 +23,9 @@ def _create_backend_resource(vol: Volume) -> None:
         client.create_disk(vol.zone, vol.name, vol.size_gb,
                            disk_type=vol.config.get('disk_type',
                                                     'pd-balanced'))
+    elif vol.type == VolumeType.K8S_PVC and not vol.use_existing:
+        from skypilot_tpu.provision.k8s import instance as k8s_instance
+        k8s_instance.create_pvc(vol.name, vol.size_gb, vol.config)
     # gcsfuse/hostpath: backing store is created lazily at mount time
     # (bucket must already exist or be creatable by the storage layer).
 
@@ -42,7 +45,11 @@ def volume_apply(cfg: Dict[str, Any]) -> Dict[str, Any]:
         state.add_or_update_volume(
             vol.name, vol_type=vol.type.value, cloud=vol.cloud,
             region=vol.region, zone=vol.zone, size_gb=vol.size_gb,
-            config=vol.config, status='READY')
+            # use_existing must survive into the record: delete consults
+            # it to decide whether the backing resource is OURS to
+            # destroy (deleting a user-owned PVC/PD would eat data).
+            config={**vol.config, 'use_existing': vol.use_existing},
+            status='READY')
     return state.get_volume(vol.name)
 
 
@@ -70,6 +77,11 @@ def volume_delete(names: List[str]) -> None:
                     rec['config'].get('project') or
                     tpu_api.default_project())
                 client.delete_disk(rec['zone'], name)
+            elif (rec['type'] == VolumeType.K8S_PVC.value and
+                    not rec['config'].get('use_existing')):
+                from skypilot_tpu.provision.k8s import (
+                    instance as k8s_instance)
+                k8s_instance.delete_pvc(name, rec['config'])
             state.remove_volume(name)
 
 

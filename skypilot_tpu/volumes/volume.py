@@ -25,6 +25,7 @@ class VolumeType(str, enum.Enum):
     GCP_PD = 'gcp-pd'
     GCSFUSE = 'gcsfuse'
     HOSTPATH = 'hostpath'
+    K8S_PVC = 'k8s-pvc'
 
 
 _SIZE_RE = re.compile(r'^(\d+)\s*(Gi|G|Ti|T)?$', re.IGNORECASE)
@@ -74,6 +75,11 @@ class Volume:
                 'path'):
             raise exceptions.InvalidTaskError(
                 f'hostpath volume {self.name!r} needs config.path.')
+        if self.type == VolumeType.K8S_PVC:
+            self.cloud = 'kubernetes'
+            if self.size_gb is None and not self.use_existing:
+                raise exceptions.InvalidTaskError(
+                    f'k8s-pvc volume {self.name!r} needs a size.')
 
     @classmethod
     def from_yaml_config(cls, cfg: Dict[str, Any]) -> 'Volume':
@@ -112,6 +118,15 @@ class Volume:
                     f'"$(readlink -f {q_dst})" ] '
                     f'|| (mkdir -p {q_src} && rm -rf {q_dst} && '
                     f'ln -sfn {q_src} {q_dst})')
+        if self.type == VolumeType.K8S_PVC:
+            # The PVC is already mounted into the pod by the StatefulSet
+            # spec (render_slice pvc_volumes) at /mnt/<name>; link the
+            # task's requested path onto it.
+            q_src = shlex.quote(f'/mnt/{self.name}')
+            return (f'mkdir -p "$(dirname {q_dst})" && '
+                    f'[ "$(readlink -f {q_src})" = '
+                    f'"$(readlink -f {q_dst})" ] '
+                    f'|| (rm -rf {q_dst} && ln -sfn {q_src} {q_dst})')
         if self.type == VolumeType.GCP_PD:
             dev = shlex.quote(f'/dev/disk/by-id/google-{self.name}')
             return (f'sudo mkdir -p {q_dst} && '

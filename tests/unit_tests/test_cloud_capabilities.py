@@ -61,9 +61,10 @@ def test_candidates_filtered_by_features():
     """Pinned clouds missing a required feature raise with the feature
     name; unpinned requests only offer clouds that implement it."""
     from skypilot_tpu import catalog
-    t = _task(cloud='kubernetes', accelerators='v5e-8', use_spot=True)
+    # (k8s gained SPOT in round 3 — multislice is still unsupported.)
+    t = _task(cloud='kubernetes', accelerators='v5e-8', num_slices=2)
     with pytest.raises(exceptions.ResourcesMismatchError,
-                       match='spot'):
+                       match='multislice'):
         catalog.get_candidates(t.resources,
                                required=caps.required_features(t))
     t2 = _task(cloud='ssh', accelerators='v5e-8',
@@ -105,3 +106,45 @@ def test_no_feasible_cloud_error_names_features():
                        match='spot'):
         optimizer_lib._fill_candidates(  # noqa: SLF001
             t, optimizer_lib.OptimizeTarget.COST)
+
+
+def test_open_ports_flag_backed_by_real_implementation():
+    """Every cloud claiming OPEN_PORTS must either implement open_ports
+    for real or mark it `trivially_open` (network already open on that
+    provider). A bare `del args` stub behind the flag means the
+    optimizer will happily place `ports:` tasks the provider cannot
+    expose (round-2 GCP bug)."""
+    import inspect
+
+    from skypilot_tpu import provision
+    from skypilot_tpu.cloud_capabilities import CLOUD_FEATURES, Feature
+    for cloud, feats in CLOUD_FEATURES.items():
+        if Feature.OPEN_PORTS not in feats:
+            continue
+        impl = provision._impl(cloud)  # noqa: SLF001 — introspection
+        fn = getattr(impl, 'open_ports', None)
+        assert fn is not None, f'{cloud} claims OPEN_PORTS, no function'
+        if getattr(fn, 'trivially_open', False):
+            continue   # documented: every port already reachable
+        body = [
+            ln.strip() for ln in inspect.getsource(fn).splitlines()[1:]
+            if ln.strip() and not ln.strip().startswith(('#', '"', "'"))
+        ]
+        # Strip the def continuation lines and docstring remnants.
+        real = [ln for ln in body
+                if not ln.startswith(('provider_config', 'del ', 'pass'))
+                and ') -> None:' not in ln]
+        assert real, (
+            f'{cloud} claims OPEN_PORTS but open_ports is a stub; '
+            f'implement it or mark it trivially_open with a reason')
+
+
+def test_volumes_flag_backed_by_volume_type():
+    """Clouds claiming VOLUMES must have a VolumeType that targets them."""
+    from skypilot_tpu.cloud_capabilities import CLOUD_FEATURES, Feature
+    backed = {'gcp', 'kubernetes', 'local'}   # gcp-pd / k8s-pvc /
+    # hostpath+gcsfuse respectively
+    for cloud, feats in CLOUD_FEATURES.items():
+        if Feature.VOLUMES in feats:
+            assert cloud in backed, (
+                f'{cloud} claims VOLUMES with no volume type backing it')

@@ -1,0 +1,101 @@
+"""GCP open_ports: real VPC firewall rules against a mocked compute API
+(reference sky/provision/gcp/config.py:424 rule shape)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from skypilot_tpu.provision.gcp import tpu_api
+
+
+class _FakeApi:
+    """Record requests; script responses per (method, url-suffix)."""
+
+    def __init__(self):
+        self.calls = []
+        self.existing_rule = None
+
+    def __call__(self, method, url, json_body=None):
+        self.calls.append((method, url, json_body))
+        if method == 'GET' and '/global/firewalls/' in url:
+            if self.existing_rule is None:
+                raise exceptions.ClusterDoesNotExist('no rule')
+            return self.existing_rule
+        return {'status': 'DONE'}
+
+
+@pytest.fixture
+def fw(monkeypatch):
+    client = tpu_api.GceFirewallClient('proj-x')
+    fake = _FakeApi()
+    monkeypatch.setattr(client, '_request', fake)
+    monkeypatch.setattr(tpu_api, 'GceFirewallClient',
+                        lambda project: client)
+    return client, fake
+
+
+def test_open_ports_creates_rule(fw, monkeypatch):
+    client, fake = fw
+    gcp_instance.open_ports('my-cluster', [8080, 9000],
+                            {'project': 'proj-x'})
+    posts = [c for c in fake.calls if c[0] == 'POST']
+    assert len(posts) == 1
+    body = posts[0][2]
+    assert body['name'] == 'sky-tpu-my-cluster-ports'
+    assert body['allowed'] == [{'IPProtocol': 'tcp',
+                                'ports': ['8080', '9000']}]
+    assert body['targetTags'] == ['sky-tpu-my-cluster']
+    assert body['sourceRanges'] == ['0.0.0.0/0']
+    assert body['direction'] == 'INGRESS'
+    assert body['network'].endswith('/global/networks/default')
+
+
+def test_open_ports_idempotent_and_patches(fw):
+    client, fake = fw
+    fake.existing_rule = {
+        'name': 'sky-tpu-c2-ports',
+        'allowed': [{'IPProtocol': 'tcp', 'ports': ['8080']}],
+    }
+    # Same port set: no write.
+    gcp_instance.open_ports('c2', [8080], {'project': 'proj-x'})
+    assert not [c for c in fake.calls if c[0] in ('POST', 'PATCH')]
+    # Changed port set: PATCH, not duplicate POST.
+    gcp_instance.open_ports('c2', [8080, 9090], {'project': 'proj-x'})
+    patches = [c for c in fake.calls if c[0] == 'PATCH']
+    assert len(patches) == 1
+    assert patches[0][2]['allowed'][0]['ports'] == ['8080', '9090']
+
+
+def test_cleanup_ports_deletes_rule(fw):
+    client, fake = fw
+    gcp_instance.cleanup_ports('my-cluster', {'project': 'proj-x'})
+    deletes = [c for c in fake.calls if c[0] == 'DELETE']
+    assert len(deletes) == 1
+    assert deletes[0][1].endswith('/firewalls/sky-tpu-my-cluster-ports')
+    # Deleting a missing rule is a no-op, not an error.
+    fake.calls.clear()
+
+    def raise_404(method, url, json_body=None):
+        fake.calls.append((method, url, json_body))
+        raise exceptions.ClusterDoesNotExist('gone')
+    client._request = raise_404
+    gcp_instance.cleanup_ports('my-cluster', {'project': 'proj-x'})
+
+
+def test_net_tag_sanitization():
+    assert gcp_instance._net_tag('My_Big.Cluster') == 'sky-tpu-my-big-cluster'
+    long = gcp_instance._net_tag('x' * 100)
+    assert len(long) <= 63 and not long.endswith('-')
+
+
+def test_create_node_carries_net_tag(monkeypatch):
+    captured = {}
+    client = tpu_api.TpuApiClient('proj-x')
+
+    def fake_request(method, url, json_body=None):
+        captured['body'] = json_body
+        return {'done': True}
+    monkeypatch.setattr(client, '_request', fake_request)
+    client.create_node('us-central2-b', 'n1', accelerator_type='v4-16',
+                       runtime_version='tpu-ubuntu2204-base',
+                       tags=['sky-tpu-n1'])
+    assert captured['body']['tags'] == ['sky-tpu-n1']

@@ -211,3 +211,108 @@ def test_kubectl_missing_binary(monkeypatch, tmp_path):
     monkeypatch.setenv('PATH', str(tmp_path))   # no kubectl anywhere
     with pytest.raises(exceptions.NoCloudAccessError):
         k8s._kubectl({}, ['get', 'pods'])
+
+
+# ---- round 3: spot, ports Services, PVC volumes --------------------------
+def test_render_spot_tolerations_and_selector():
+    from skypilot_tpu import topology
+    m = manifests.render_slice('sp', topology.parse_tpu('v5e-16'),
+                               use_spot=True)
+    pod = m['items'][1]['spec']['template']['spec']
+    assert pod['nodeSelector']['cloud.google.com/gke-spot'] == 'true'
+    [tol] = [t for t in pod['tolerations']
+             if t['key'] == 'cloud.google.com/gke-spot']
+    assert tol['effect'] == 'NoSchedule' and tol['value'] == 'true'
+    # Non-spot renders no spot constraint.
+    m2 = manifests.render_slice('od', topology.parse_tpu('v5e-16'))
+    pod2 = m2['items'][1]['spec']['template']['spec']
+    assert 'cloud.google.com/gke-spot' not in pod2.get('nodeSelector', {})
+
+
+def test_render_pvc_volumes_mounted():
+    m = manifests.render_slice('pv', None, pvc_volumes=['ckpts'])
+    pod = m['items'][1]['spec']['template']['spec']
+    [vol] = [v for v in pod['volumes'] if v['name'] == 'vol-ckpts']
+    assert vol['persistentVolumeClaim']['claimName'] == 'ckpts'
+    mounts = pod['containers'][0]['volumeMounts']
+    [mnt] = [v for v in mounts if v['name'] == 'vol-ckpts']
+    assert mnt['mountPath'] == '/mnt/ckpts'
+
+
+def test_open_ports_applies_service(fake_kubectl):
+    k8s.open_ports('sliceA', [8080, 9000], {'namespace': 'ns1'})
+    apply_calls = [c for c in fake_kubectl.calls()
+                   if 'apply' in c['argv']]
+    assert apply_calls
+    svc = json.loads(apply_calls[-1]['stdin'])
+    assert svc['kind'] == 'Service'
+    assert svc['metadata']['name'] == 'sliceA-ports'
+    assert svc['metadata']['namespace'] == 'ns1'
+    assert svc['spec']['type'] == 'LoadBalancer'
+    assert [p['port'] for p in svc['spec']['ports']] == [8080, 9000]
+    assert svc['spec']['selector'] == {manifests.LABEL_CLUSTER: 'sliceA'}
+
+
+def test_open_ports_service_type_override(fake_kubectl):
+    k8s.open_ports('s2', [80], {'ports_service_type': 'NodePort'})
+    svc = json.loads([c for c in fake_kubectl.calls()
+                      if 'apply' in c['argv']][-1]['stdin'])
+    assert svc['spec']['type'] == 'NodePort'
+
+
+def test_terminate_deletes_ports_service(fake_kubectl):
+    k8s.terminate_instances('sliceA', {})
+    deletes = [c['argv'] for c in fake_kubectl.calls()
+               if 'delete' in c['argv']]
+    assert any('sliceA-ports' in a for a in deletes)
+
+
+def test_pvc_create_delete(fake_kubectl):
+    k8s.create_pvc('ckpts', 100, {'storage_class': 'premium-rwo'})
+    pvc = json.loads([c for c in fake_kubectl.calls()
+                      if 'apply' in c['argv']][-1]['stdin'])
+    assert pvc['kind'] == 'PersistentVolumeClaim'
+    assert pvc['spec']['resources']['requests']['storage'] == '100Gi'
+    assert pvc['spec']['storageClassName'] == 'premium-rwo'
+    k8s.delete_pvc('ckpts', {})
+    deletes = [c['argv'] for c in fake_kubectl.calls()
+               if 'delete' in c['argv']]
+    assert any('pvc' in a and 'ckpts' in a for a in deletes)
+
+
+def test_spot_preemption_visible_to_provider_plane(fake_kubectl):
+    """A reclaimed spot pod (gone from the list) must surface as a
+    non-RUNNING gang so the managed-jobs controller recovers (its
+    _provider_alive requires all hosts RUNNING)."""
+    fake_kubectl.set_sts({'metadata': {'name': 'sp',
+                                       'labels': {'sky-tpu-num-hosts':
+                                                  '4'}},
+                          'spec': {'replicas': 4}})
+    fake_kubectl.set_pods([
+        _pod(f'sp-{i}', ip=f'10.8.0.{5 + i}') for i in range(3)])
+    info = k8s.get_cluster_info('sp', {})
+    assert info is not None
+    states = [h.state for h in info.hosts]
+    assert not all(s == 'RUNNING' for s in states)
+
+
+def test_fully_reclaimed_gang_reads_terminated(fake_kubectl):
+    """All N pods deleted at once (or the common 1-host slice losing
+    its only pod): must NOT read as provider-alive via an empty host
+    list — and a scale-to-zero stop (replicas=0) must NOT read as dead."""
+    fake_kubectl.set_sts({'metadata': {'name': 'gone',
+                                       'labels': {'sky-tpu-num-hosts':
+                                                  '2'}},
+                          'spec': {'replicas': 2}})
+    fake_kubectl.set_pods([])
+    info = k8s.get_cluster_info('gone', {})
+    assert info is not None
+    assert len(info.hosts) == 2
+    assert all(h.state == 'TERMINATED' for h in info.hosts)
+    # Cleanly stopped: replicas 0, empty host list (STOPPED, not dead).
+    fake_kubectl.set_sts({'metadata': {'name': 'gone',
+                                       'labels': {'sky-tpu-num-hosts':
+                                                  '2'}},
+                          'spec': {'replicas': 0}})
+    info = k8s.get_cluster_info('gone', {})
+    assert info is not None and info.hosts == []

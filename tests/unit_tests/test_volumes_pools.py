@@ -302,3 +302,24 @@ def test_ssh_run_timeout_returns_rc_124(monkeypatch):
     assert rc == 124 and 'timed out' in err
     with pytest.raises(exceptions.CommandError):
         r.run('true', timeout=1, check=True)
+
+
+def test_use_existing_volume_survives_delete(monkeypatch):
+    """Deleting a registered use_existing volume must NOT destroy the
+    user-owned backing resource (k8s-pvc here; the record must persist
+    use_existing, not just the Volume object)."""
+    deleted = []
+    from skypilot_tpu.provision.k8s import instance as k8s_instance
+    monkeypatch.setattr(k8s_instance, 'create_pvc',
+                        lambda *a, **k: None)
+    monkeypatch.setattr(k8s_instance, 'delete_pvc',
+                        lambda name, cfg: deleted.append(name))
+    volumes.volume_apply({'name': 'theirs', 'type': 'k8s-pvc',
+                          'use_existing': True})
+    volumes.volume_delete(['theirs'])
+    assert deleted == [], 'user-owned PVC must not be deleted'
+    # Ours IS deleted.
+    volumes.volume_apply({'name': 'ours', 'type': 'k8s-pvc',
+                          'size': '10Gi'})
+    volumes.volume_delete(['ours'])
+    assert deleted == ['ours']
