@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from skypilot_tpu.models import llama
 from skypilot_tpu.train import trainer
 
+import argparse
+
 BATCH = 4
 SEQ = 2048
 WARMUP = 2
@@ -48,25 +50,34 @@ def _peak_tflops(device) -> float:
 
 
 def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--seq', type=int, default=SEQ,
+                        help='sequence length (8192 proves the flash '
+                             "backward's O(s) memory: batch auto-drops "
+                             'to 1)')
+    parser.add_argument('--batch', type=int, default=None)
+    args = parser.parse_args()
+    seq = args.seq
+    batch = args.batch or (BATCH if seq <= 2048 else 1)
     dev = jax.devices()[0]
     on_tpu = jax.default_backend() == 'tpu'
     steps = STEPS if on_tpu else 1
     config = llama.LlamaConfig.bench_1b(
-        max_seq_len=SEQ, attention_impl='auto')
+        max_seq_len=seq, attention_impl='auto')
     print(f'[bench] device={dev.device_kind} params={config.num_params/1e6:.0f}M '
-          f'batch={BATCH} seq={SEQ} backend={jax.default_backend()}',
+          f'batch={batch} seq={seq} backend={jax.default_backend()}',
           file=sys.stderr)
 
     opt = trainer.make_optimizer(total_steps=1000,
                                  mu_dtype='bfloat16')
     state = trainer.init_train_state(config, jax.random.PRNGKey(0), opt)
     step = trainer.make_train_step(config, opt)
-    batch = trainer.synthetic_batch(config, BATCH, SEQ,
-                                    jax.random.PRNGKey(1))
+    batch_data = trainer.synthetic_batch(config, batch, seq,
+                                         jax.random.PRNGKey(1))
 
     t_compile = time.perf_counter()
     for _ in range(WARMUP):
-        state, metrics = step(state, batch)
+        state, metrics = step(state, batch_data)
     # float() forces a device->host transfer — a hard sync even on backends
     # where block_until_ready returns early (e.g. tunneled devices).
     float(metrics['loss'])
@@ -75,11 +86,11 @@ def main() -> None:
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, metrics = step(state, batch)
+        state, metrics = step(state, batch_data)
     final_loss = float(metrics['loss'])
     dt = time.perf_counter() - t0
 
-    tokens = BATCH * SEQ * steps
+    tokens = batch * seq * steps
     tok_per_sec = tokens / dt
     flops_per_tok = llama.flops_per_token(config)
     mfu = tok_per_sec * flops_per_tok / (_peak_tflops(dev) * 1e12)
@@ -94,7 +105,7 @@ def main() -> None:
         'vs_baseline': round(mfu / REFERENCE_MFU, 3),
         'mfu': round(mfu, 4),
         'model_params_m': round(config.num_params / 1e6),
-        'batch': BATCH, 'seq': SEQ,
+        'batch': batch, 'seq': seq,
         'device': dev.device_kind,
     }))
 

@@ -38,8 +38,9 @@ _TPU_DESC_TO_GEN = {
     'trillium': 'v6e',
 }
 
-# Region -> a zone with TPU capacity (the API prices per region; the
-# provisioner needs a concrete zone).
+# Region -> a representative zone with TPU capacity (the API prices per
+# region; the provisioner needs a concrete zone — the az-mappings CSV
+# then widens each row to every zone carrying that generation).
 _DEFAULT_ZONE = {
     'us-central1': 'us-central1-a',
     'us-central2': 'us-central2-b',
@@ -47,9 +48,14 @@ _DEFAULT_ZONE = {
     'us-east5': 'us-east5-a',
     'us-west1': 'us-west1-c',
     'us-west4': 'us-west4-a',
+    'us-south1': 'us-south1-a',
+    'europe-west1': 'europe-west1-c',
     'europe-west4': 'europe-west4-a',
+    'asia-east1': 'asia-east1-c',
     'asia-southeast1': 'asia-southeast1-b',
     'asia-northeast1': 'asia-northeast1-b',
+    'asia-south1': 'asia-south1-a',
+    'southamerica-west1': 'southamerica-west1-a',
 }
 
 

@@ -220,7 +220,12 @@ def main() -> None:
                 lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
                                                   sharding=s),
                 abstract, shardings)
-            restored = mgr.restore(target=target)
+            try:
+                restored = mgr.restore(target=target)
+            except Exception:  # noqa: BLE001 — tree-structure mismatch
+                # Full-train-state checkpoint (params nested under
+                # 'params'): retry with that shape before giving up.
+                restored = mgr.restore(target={'params': target})
         else:
             restored = mgr.restore()
         # Accept either a bare params pytree or a full train state.

@@ -363,19 +363,37 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                             interpret)
 
 
+def _fit_block(want: int, seq_len: int) -> int:
+    """Largest tile <= `want` that DIVIDES seq_len (the kernels require
+    it), preferring lane-aligned multiples of 128. seq 768 with a 512
+    request fits 384; non-multiple-of-128 seqs fall back to the gcd."""
+    import math
+    b = min(want, seq_len)
+    while b > 128 and seq_len % b:
+        b -= 128
+    if seq_len % b:
+        b = math.gcd(b, seq_len)
+    return max(b, 1)
+
+
 def attention(q, k, v, *, causal: bool = True,
               sm_scale: Optional[float] = None,
-              impl: str = 'auto') -> jnp.ndarray:
+              impl: str = 'auto',
+              block_q: Optional[int] = None,
+              block_k: Optional[int] = None) -> jnp.ndarray:
     """Dispatch: 'dense', 'flash', or 'auto' (flash on TPU when shapes
-    allow, else dense)."""
+    allow, else dense). block_q/block_k override the flash tile sizes
+    (clamped to seq; None → defaults)."""
     if impl == 'dense':
         return dense_attention(q, k, v, causal=causal, sm_scale=sm_scale)
-    if impl == 'flash':
-        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     s = q.shape[2]
+    bq = _fit_block(block_q or DEFAULT_BLOCK_Q, s)
+    bk = _fit_block(block_k or DEFAULT_BLOCK_K, s)
+    if impl == 'flash':
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               block_q=bq, block_k=bk)
     on_tpu = jax.default_backend() == 'tpu'
     if on_tpu and s % 128 == 0 and s >= 256:
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                               block_q=min(DEFAULT_BLOCK_Q, s),
-                               block_k=min(DEFAULT_BLOCK_K, s))
+                               block_q=bq, block_k=bk)
     return dense_attention(q, k, v, causal=causal, sm_scale=sm_scale)

@@ -301,14 +301,22 @@ def wait_instances(cluster_name: str, provider_config: Dict[str, Any],
 
 
 def _net_tag(cluster_name: str) -> str:
+    import hashlib
     import re
-    # Network-tag charset: lowercase letters, digits, dash; ≤63 chars.
+    # Network-tag charset: lowercase letters, digits, dash. Capped at 57
+    # so the '-ports' firewall-rule suffix still fits GCP's 63-char
+    # limit. Truncated names get a hash suffix of the FULL name —
+    # otherwise two long names sharing a prefix would collide and one
+    # cluster's teardown would delete the other's firewall rule.
     tag = 'sky-tpu-' + re.sub(r'[^a-z0-9-]', '-', cluster_name.lower())
-    return tag[:63].rstrip('-')
+    if len(tag) <= 57:
+        return tag.rstrip('-')
+    h = hashlib.sha1(cluster_name.encode()).hexdigest()[:6]
+    return f'{tag[:50].rstrip("-")}-{h}'
 
 
 def _fw_rule_name(cluster_name: str) -> str:
-    return (_net_tag(cluster_name) + '-ports')[:63].rstrip('-')
+    return _net_tag(cluster_name) + '-ports'
 
 
 def open_ports(cluster_name: str, ports,

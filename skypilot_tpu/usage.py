@@ -1,14 +1,16 @@
-"""Usage telemetry: local, append-only, opt-out.
+"""Usage telemetry: local by default, HTTP sink optional, opt-out.
 
 Counterpart of the reference's ``sky/usage/usage_lib.py`` (messages +
-heartbeats shipped to a hosted Loki, ``_send_to_loki`` :427, the
-``@usage_lib.entrypoint`` decorator :615). This environment has zero
-egress, so the same record stream lands in
-``~/.sky_tpu/usage/usage.jsonl`` — one JSON line per entrypoint call with
-op name, duration, outcome, and framework version. A deployment that
-wants central collection points ``SKY_TPU_USAGE_SINK`` at a different
-writable path (or a future HTTP sink). ``SKY_TPU_DISABLE_USAGE=1`` turns
-it off entirely.
+heartbeats shipped to a hosted Loki, ``_send_to_loki`` :427, heartbeat
+:554, the ``@usage_lib.entrypoint`` decorator :615). The record stream
+lands in ``~/.sky_tpu/usage/usage.jsonl`` — one JSON line per entrypoint
+call with op name, duration, outcome, and framework version; the
+periodic heartbeat (server daemon) adds control-plane gauges (cluster/
+job/service counts). ``SKY_TPU_USAGE_SINK`` redirects: a filesystem path
+appends there instead, an ``http(s)://`` URL POSTs each record as JSON
+(Loki-push-compatible shape: ``{"streams":[{"stream":{...},"values":
+[[ts_ns, line]]}]}``) — best-effort, never blocking the product.
+``SKY_TPU_DISABLE_USAGE=1`` turns it off entirely.
 """
 from __future__ import annotations
 
@@ -40,6 +42,20 @@ def _sink_path() -> str:
     return os.path.join(d, 'usage.jsonl')
 
 
+def _post_http(url: str, line: Dict[str, Any]) -> None:
+    """Loki-push-shaped POST (reference _send_to_loki,
+    sky/usage/usage_lib.py:427). 2s budget; failures are dropped."""
+    import urllib.request
+    payload = json.dumps({'streams': [{
+        'stream': {'source': 'skypilot-tpu', 'op': line['op']},
+        'values': [[str(int(line['ts'] * 1e9)), json.dumps(line)]],
+    }]}).encode()
+    req = urllib.request.Request(
+        url, data=payload, headers={'Content-Type': 'application/json'})
+    with urllib.request.urlopen(req, timeout=2.0):
+        pass
+
+
 def record(op: str, duration_s: float, outcome: str,
            extra: Optional[Dict[str, Any]] = None) -> None:
     if disabled():
@@ -55,11 +71,15 @@ def record(op: str, duration_s: float, outcome: str,
     }
     if extra:
         line.update(extra)
+    sink = os.environ.get(SINK_ENV, '')
     try:
-        with open(_sink_path(), 'a', encoding='utf-8') as f:
-            f.write(json.dumps(line) + '\n')
-    except OSError:
-        pass   # telemetry must never break the product
+        if sink.startswith(('http://', 'https://')):
+            _post_http(sink, line)
+        else:
+            with open(_sink_path(), 'a', encoding='utf-8') as f:
+                f.write(json.dumps(line) + '\n')
+    except Exception:  # noqa: BLE001 — telemetry must never break
+        pass           # the product
 
 
 def entrypoint(fn: Callable = None, *,
@@ -85,6 +105,26 @@ def entrypoint(fn: Callable = None, *,
 
 
 def heartbeat() -> None:
-    """Periodic liveness record (reference UsageHeartbeatReportEvent,
-    sky/skylet/events.py:153); called by server daemons."""
-    record('heartbeat', 0.0, 'ok')
+    """Periodic liveness record with control-plane gauges (reference
+    UsageHeartbeatReportEvent, sky/skylet/events.py:153 +
+    usage_lib.py:554); called by server daemons."""
+    gauges: Dict[str, Any] = {}
+    try:
+        from skypilot_tpu import state
+        gauges['clusters'] = len(state.get_clusters())
+    except Exception:  # noqa: BLE001 — gauge collection is best-effort
+        pass
+    try:
+        from skypilot_tpu.jobs import state as jobs_state
+        jobs = jobs_state.get_jobs()
+        gauges['managed_jobs'] = len(jobs)
+        gauges['managed_jobs_active'] = sum(
+            1 for j in jobs if not j['status'].is_terminal())
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from skypilot_tpu.serve import state as serve_state
+        gauges['services'] = len(serve_state.get_services())
+    except Exception:  # noqa: BLE001
+        pass
+    record('heartbeat', 0.0, 'ok', extra=gauges)

@@ -48,9 +48,10 @@ def test_cpu_feasibility():
     cands = catalog.get_candidates(Resources(cloud='gcp', cpus='16+'))
     assert cands
     assert all((c.accelerator_name is None) for c in cands)
-    # All must have >= 16 vcpus: n2-standard-16/32 only.
-    assert {c.instance_type for c in cands} == {'n2-standard-16',
-                                                'n2-standard-32'}
+    # Every offered shape has >= 16 vcpus; smaller shapes are gone.
+    names = {c.instance_type for c in cands}
+    assert {'n2-standard-16', 'n2-standard-32'} <= names
+    assert not any(n.endswith(('-4', '-8')) for n in names)
 
 
 def test_local_cloud_free():
@@ -188,8 +189,8 @@ def test_exact_cpus_no_match():
     # minimum form matches larger instances
     t2 = Task('t2', run='x', resources=Resources(cloud='gcp', cpus='12+'))
     plan = optimize(t2, quiet=True)
-    assert plan.per_task[0].candidate.instance_type in (
-        'n2-standard-16', 'n2-standard-32')
+    chosen = plan.per_task[0].candidate
+    assert not chosen.instance_type.endswith(('-4', '-8'))
 
 
 def test_job_group_same_infra():
@@ -352,3 +353,35 @@ def test_az_mappings_expand_failover_zones():
     res_v6 = resources_lib.Resources(cloud='gcp', accelerators='v6e-8',
                                      zone='us-east5-c')   # v5e-only zone
     assert catalog.get_candidates(res_v6) == []
+
+
+def test_catalog_breadth_and_multi_region_v6e():
+    """Round-3 breadth: >=140 catalog rows, v6e in >=5 regions, and the
+    optimizer failing over v6e across regions by price."""
+    entries = catalog._load('gcp')
+    assert len(entries) >= 140, len(entries)
+    v6e_regions = {e.region for e in entries
+                   if e.kind == 'tpu' and e.name == 'v6e'}
+    assert len(v6e_regions) >= 5, v6e_regions
+    # Unpinned v6e request: candidates span regions, cheapest first
+    # after the optimizer ranks them.
+    cands = catalog.get_candidates(Resources(cloud='gcp',
+                                             accelerators='v6e-8'))
+    regions = {c.region for c in cands}
+    assert len(regions) >= 5
+    t = Task('t', run='x', resources=Resources(cloud='gcp',
+                                               accelerators='v6e-8'))
+    plan = optimize(t, quiet=True)
+    chosen = plan.per_task[0].candidate
+    assert chosen.cost_per_hour == min(c.cost_per_hour for c in cands)
+    # US list price beats the uplifted europe/asia rows.
+    assert chosen.region.startswith('us-')
+
+
+def test_az_mappings_expand_v5e_zones():
+    """One v5e price row per region widens to every mapped zone."""
+    cands = catalog.get_candidates(Resources(cloud='gcp',
+                                             accelerators='v5e-8',
+                                             region='us-central1'))
+    zones = {c.zone for c in cands}
+    assert zones == {'us-central1-a', 'us-central1-b', 'us-central1-c'}
