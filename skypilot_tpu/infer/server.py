@@ -222,10 +222,18 @@ def main() -> None:
                 abstract, shardings)
             try:
                 restored = mgr.restore(target=target)
-            except Exception:  # noqa: BLE001 — tree-structure mismatch
-                # Full-train-state checkpoint (params nested under
-                # 'params'): retry with that shape before giving up.
-                restored = mgr.restore(target={'params': target})
+            except Exception as first_err:  # noqa: BLE001 — may be a
+                # tree-structure mismatch: full-train-state checkpoints
+                # nest params under 'params'. Retry with that shape;
+                # chain the ORIGINAL error so a missing/corrupt
+                # checkpoint isn't masked by the retry's mismatch.
+                logger.warning('sharded params-shaped restore failed '
+                               '(%s); retrying with train-state shape',
+                               first_err)
+                try:
+                    restored = mgr.restore(target={'params': target})
+                except Exception as second_err:
+                    raise second_err from first_err
         else:
             restored = mgr.restore()
         # Accept either a bare params pytree or a full train state.

@@ -390,6 +390,14 @@ def attention(q, k, v, *, causal: bool = True,
     bq = _fit_block(block_q or DEFAULT_BLOCK_Q, s)
     bk = _fit_block(block_k or DEFAULT_BLOCK_K, s)
     if impl == 'flash':
+        if min(bq, bk) < 128 and s >= 128:
+            # The gcd fallback would hand the kernel sub-lane tiles (a
+            # pathological grid); explicit flash on such a seq is a
+            # user error, not something to quietly degrade.
+            raise ValueError(
+                f'flash attention needs seq_len divisible by a >=128 '
+                f'tile; got seq_len={s} (fitted tiles {bq}x{bk}). Pad '
+                f'the sequence or use impl="dense"/"auto".')
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                block_q=bq, block_k=bk)
     on_tpu = jax.default_backend() == 'tpu'

@@ -81,7 +81,13 @@ def bulk_provision(candidate: catalog.Candidate,
                              info.provider_config)
     info.cost_per_hour = candidate.cost_per_hour * res.num_slices
     if wait_agent and info.head.agent_url:
-        agent_client.AgentClient(info.head.agent_url).wait_healthy()
+        # EVERY host's agent, not just the head: the head fans job ranks
+        # out to peers' /run_rank the moment a job is submitted — a peer
+        # still booting turns the first job into a spurious rank failure
+        # (caught by the fake-ssh multihost e2e).
+        for host in info.hosts:
+            if host.agent_url:
+                agent_client.AgentClient(host.agent_url).wait_healthy()
     if res.ports:
         provision.open_ports(candidate.cloud, cluster_name, res.ports,
                              info.provider_config)

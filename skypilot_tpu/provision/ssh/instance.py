@@ -159,13 +159,25 @@ def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any]) -> None:
                                 'ssh_key': pool.get('identity_file')},
         }
         cfg_json = json.dumps(agent_config).replace("'", "'\\''")
+        # Idempotence probe via pidfile, NOT pgrep: the remote shell's
+        # own cmdline contains the agent start text, so any
+        # `pgrep -f <agent pattern> || start` one-liner SELF-MATCHES and
+        # the agent never starts on a fresh host (found by the fake-ssh
+        # multihost e2e). The /proc cmdline check guards against PID
+        # reuse after a reboot (stale pidfile pointing at an unrelated
+        # process would otherwise suppress the restart forever).
         runner.run(
             f"echo '{cfg_json}' > {AGENT_DIR}/agent_config.json && "
-            f"pgrep -f 'skypilot_tpu.runtime.agent' >/dev/null || "
+            f'AP="$(cat {AGENT_DIR}/agent.pid 2>/dev/null)"; '
+            f'if ! {{ kill -0 "$AP" 2>/dev/null && '
+            f'grep -q runtime.agent "/proc/$AP/cmdline" 2>/dev/null; }}; '
+            f'then '
             f'PYTHONPATH={AGENT_DIR} nohup python3 -m '
             f'skypilot_tpu.runtime.agent --cluster-dir {AGENT_DIR} '
             f'--host 0.0.0.0 --port {AGENT_PORT} '
-            f'> {AGENT_DIR}/agent.log 2>&1 &', timeout=60, check=True)
+            f'> {AGENT_DIR}/agent.log 2>&1 & '
+            f'echo $! > {AGENT_DIR}/agent.pid; fi',
+            timeout=60, check=True)
 
 
 def stop_instances(cluster_name: str,
@@ -260,10 +272,11 @@ def get_cluster_info(cluster_name: str,
         info.tpu_slice = meta.get('tpu_slice')
         return info
     pool = _pool_of({'pool': meta['pool']})
-    agent_url = f'http://{pool["hosts"][0]}:{AGENT_PORT}'
+    # Per-HOST agent URLs: each host runs its own agent (the head fans
+    # ranks out to them); provisioning waits on every one of them.
     hosts = [HostInfo(host_id=f'{cluster_name}-host{i}',
                       internal_ip=h, external_ip=h, state='RUNNING',
-                      agent_url=agent_url)
+                      agent_url=f'http://{h}:{AGENT_PORT}')
              for i, h in enumerate(pool['hosts'])]
     return ClusterInfo(
         cluster_name=cluster_name, cloud='ssh',

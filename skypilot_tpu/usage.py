@@ -42,18 +42,63 @@ def _sink_path() -> str:
     return os.path.join(d, 'usage.jsonl')
 
 
+_http_queue = None
+_http_thread = None
+
+
+def _http_worker() -> None:
+    import urllib.request
+    while True:
+        url, line = _http_queue.get()
+        try:
+            payload = json.dumps({'streams': [{
+                'stream': {'source': 'skypilot-tpu', 'op': line['op']},
+                'values': [[str(int(line['ts'] * 1e9)),
+                            json.dumps(line)]],
+            }]}).encode()
+            req = urllib.request.Request(
+                url, data=payload,
+                headers={'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req, timeout=2.0):
+                pass
+        except Exception:  # noqa: BLE001 — drop; telemetry never breaks
+            pass
+        finally:
+            _http_queue.task_done()
+
+
 def _post_http(url: str, line: Dict[str, Any]) -> None:
     """Loki-push-shaped POST (reference _send_to_loki,
-    sky/usage/usage_lib.py:427). 2s budget; failures are dropped."""
-    import urllib.request
-    payload = json.dumps({'streams': [{
-        'stream': {'source': 'skypilot-tpu', 'op': line['op']},
-        'values': [[str(int(line['ts'] * 1e9)), json.dumps(line)]],
-    }]}).encode()
-    req = urllib.request.Request(
-        url, data=payload, headers={'Content-Type': 'application/json'})
-    with urllib.request.urlopen(req, timeout=2.0):
+    sky/usage/usage_lib.py:427), shipped from a background thread so a
+    slow/blackholed sink never stalls the calling entrypoint. Bounded
+    queue: overflow drops records rather than blocking."""
+    global _http_queue, _http_thread
+    import queue
+    import threading
+    if _http_thread is None or not _http_thread.is_alive():
+        _http_queue = queue.Queue(maxsize=1024)
+        _http_thread = threading.Thread(target=_http_worker,
+                                        daemon=True,
+                                        name='usage-http-sink')
+        _http_thread.start()
+    try:
+        _http_queue.put_nowait((url, line))
+    except queue.Full:
         pass
+
+
+def flush_http_sink(timeout: float = 5.0) -> None:
+    """Drain pending HTTP records (tests / graceful shutdown)."""
+    if _http_queue is None:
+        return
+    deadline = time.time() + timeout
+    while not _http_queue.empty() and time.time() < deadline:
+        time.sleep(0.02)
+    # One in-flight record may remain; give the join a moment.
+    t0 = time.time()
+    while (_http_queue.unfinished_tasks and
+           time.time() - t0 < max(0.0, deadline - time.time()) + 0.5):
+        time.sleep(0.02)
 
 
 def record(op: str, duration_s: float, outcome: str,

@@ -210,6 +210,7 @@ def test_usage_http_sink_posts_loki_shape(monkeypatch):
                            f'http://127.0.0.1:{srv.server_port}/loki')
         monkeypatch.delenv('SKY_TPU_DISABLE_USAGE', raising=False)
         usage.record('launch', 1.25, 'ok', extra={'cloud': 'gcp'})
+        usage.flush_http_sink()   # async shipper: drain before assert
         assert len(got) == 1
         stream = got[0]['streams'][0]
         assert stream['stream']['op'] == 'launch'
@@ -218,6 +219,7 @@ def test_usage_http_sink_posts_loki_shape(monkeypatch):
         # Dead sink: silently dropped.
         monkeypatch.setenv('SKY_TPU_USAGE_SINK', 'http://127.0.0.1:9/x')
         usage.record('launch', 0.1, 'ok')
+        usage.flush_http_sink()
     finally:
         srv.shutdown()
 
