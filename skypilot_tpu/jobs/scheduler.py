@@ -25,7 +25,40 @@ from skypilot_tpu.utils import locks
 logger = logging.getLogger(__name__)
 
 _MAX_LAUNCHING = int(os.environ.get('SKY_TPU_JOBS_MAX_LAUNCHING', '8'))
-_MAX_ALIVE = int(os.environ.get('SKY_TPU_JOBS_MAX_ALIVE', '16'))
+# Per-controller-process memory budget for admission (reference sizes
+# limits from the controller VM's cpu/mem; here controllers share the
+# API-server host, so ALIVE is capped by what the host can actually
+# carry rather than a blind constant).
+_CONTROLLER_MEM_MB = int(os.environ.get(
+    'SKY_TPU_JOBS_CONTROLLER_MEM_MB', '256'))
+
+
+# Memory kept free for the control plane itself.
+_MEM_RESERVE_MB = int(os.environ.get('SKY_TPU_JOBS_MEM_RESERVE_MB',
+                                     '1024'))
+
+
+def _mem_headroom_admits() -> bool:
+    """Can the host's CURRENT free memory carry one more controller?
+
+    Headroom-based (not a total-count cap compared against shrinking
+    MemAvailable, which double-counts running controllers and converges
+    to ~half utilization): admit while starting one more process still
+    leaves the reserve free.
+    """
+    try:
+        with open('/proc/meminfo', encoding='ascii') as f:
+            for line in f:
+                if line.startswith('MemAvailable:'):
+                    avail_mb = int(line.split()[1]) // 1024
+                    return avail_mb >= (_CONTROLLER_MEM_MB +
+                                        _MEM_RESERVE_MB)
+    except (OSError, ValueError, IndexError):
+        pass
+    return True   # unknown platform: fall back to the count caps only
+
+
+_MAX_ALIVE = int(os.environ.get('SKY_TPU_JOBS_MAX_ALIVE', '0')) or None
 
 
 def _scheduler_lock():
@@ -51,7 +84,12 @@ def maybe_schedule_next() -> None:
                 [ScheduleState.LAUNCHING])
             active = jobs_state.count_schedule_state(
                 [ScheduleState.LAUNCHING, ScheduleState.ALIVE])
-            if launching >= _MAX_LAUNCHING or active >= _MAX_ALIVE:
+            if launching >= _MAX_LAUNCHING:
+                return
+            if _MAX_ALIVE is not None:
+                if active >= _MAX_ALIVE:
+                    return
+            elif not _mem_headroom_admits():
                 return
             waiting = jobs_state.waiting_jobs()
             if not waiting:

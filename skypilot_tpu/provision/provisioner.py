@@ -84,10 +84,17 @@ def bulk_provision(candidate: catalog.Candidate,
         # EVERY host's agent, not just the head: the head fans job ranks
         # out to peers' /run_rank the moment a job is submitted — a peer
         # still booting turns the first job into a spurious rank failure
-        # (caught by the fake-ssh multihost e2e).
+        # (caught by the fake-ssh multihost e2e). One SHARED deadline:
+        # hosts boot concurrently, so a dead host must fail the attempt
+        # after ~one budget, not num_hosts budgets in sequence.
+        import os as os_lib
+        import time as time_lib
+        budget = float(os_lib.environ.get('SKY_TPU_AGENT_WAIT_S', '60'))
+        deadline = time_lib.time() + budget
         for host in info.hosts:
             if host.agent_url:
-                agent_client.AgentClient(host.agent_url).wait_healthy()
+                agent_client.AgentClient(host.agent_url).wait_healthy(
+                    timeout=max(5.0, deadline - time_lib.time()))
     if res.ports:
         provision.open_ports(candidate.cloud, cluster_name, res.ports,
                              info.provider_config)

@@ -7,6 +7,7 @@ reachable over the VPC (or an SSH tunnel, handled by the backend).
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -21,7 +22,13 @@ class AgentClient:
         self.url = url.rstrip('/')
         self.timeout = timeout
 
-    def wait_healthy(self, timeout: float = 60.0) -> Dict[str, Any]:
+    def wait_healthy(self, timeout: Optional[float] = None
+                     ) -> Dict[str, Any]:
+        if timeout is None:
+            # Env-tunable: CI boxes under heavy contention (xdist on few
+            # cores) need longer than production's 60s to fork+import an
+            # agent process.
+            timeout = float(os.environ.get('SKY_TPU_AGENT_WAIT_S', '60'))
         deadline = time.time() + timeout
         last_err: Optional[Exception] = None
         while time.time() < deadline:

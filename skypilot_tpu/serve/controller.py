@@ -213,6 +213,10 @@ def service_snapshot(name: str) -> Optional[dict]:
                     if record['lb_port'] else None,
         'policy': record['lb_policy'],
         'failure_reason': record['failure_reason'],
+        'ready_replicas': sum(
+            1 for r in replicas
+            if r['status'] == ReplicaStatus.READY),
+        'total_replicas': len(replicas),
         'replicas': [{
             'replica_id': r['replica_id'],
             'cluster_name': r['cluster_name'],
@@ -220,8 +224,11 @@ def service_snapshot(name: str) -> Optional[dict]:
             'version': r['version'],
             'url': r['url'],
             'is_spot': r['is_spot'],
+            'accelerator': r.get('accelerator'),
             'zone': r['zone'],
             'launched_at': r['launched_at'],
+            'ready_at': r['ready_at'],
+            'failure_reason': r['failure_reason'],
         } for r in replicas],
     }
 

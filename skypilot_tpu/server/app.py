@@ -507,6 +507,27 @@ class Server:
             'version': __import__('skypilot_tpu').__version__,
         })
 
+    async def h_whoami(self, req: web.Request) -> web.Response:
+        """The authenticated identity of THIS request (dashboard session
+        chip; reference dashboard's login-aware header)."""
+        from skypilot_tpu.users import rbac
+        user = req.get('user')
+        if user is None:
+            from skypilot_tpu.server.auth import loopback as loopback_lib
+            if loopback_lib.is_loopback_request(req):
+                return web.json_response(
+                    {'auth': 'loopback', 'user': None,
+                     'role': rbac.get_default_role()})
+            return web.json_response(
+                {'auth': 'anonymous', 'user': None,
+                 'role': rbac.get_default_role()})
+        return web.json_response({
+            'auth': 'token' if req.headers.get(
+                'Authorization', '').startswith('Bearer ') else 'sso',
+            'user': {'id': user['id'], 'name': user.get('name')},
+            'role': user.get('role') or rbac.get_default_role(),
+        })
+
     async def h_requests(self, _req: web.Request) -> web.Response:
         return web.json_response({'requests': self.store.list_requests()})
 
@@ -732,6 +753,7 @@ run <code>sky-tpu api login</code>, close this page.</p>
                               client_max_size=64 * 1024 * 1024)
         app['server'] = self
         app.router.add_get('/api/health', self.h_health)
+        app.router.add_get('/api/whoami', self.h_whoami)
         app.router.add_get('/dashboard', self.h_dashboard)
         app.router.add_get('/', self.h_dashboard)
         app.router.add_get('/metrics', self.h_metrics)

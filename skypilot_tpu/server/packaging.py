@@ -37,12 +37,9 @@ COPY skypilot_tpu ./skypilot_tpu
 # native/ sources ride along: the k8s fuse-proxy DaemonSet renderer
 # reads fuse_proxy.cc from next to the package at provision time.
 COPY native ./native
-# Control-plane runtime deps (pyproject declares none — the dev image
-# bakes them; this image must install them itself). jax/orbax are NOT
-# needed: the API server provisions TPU slices, it does not compute.
-RUN pip install --no-cache-dir \\
-        aiohttp pyyaml requests click filelock numpy && \\
-    pip install --no-cache-dir .
+# pyproject declares the control-plane deps; jax/orbax are NOT needed
+# here: the API server provisions TPU slices, it does not compute.
+RUN pip install --no-cache-dir .
 
 # State lives under SKY_TPU_HOME: mount a volume (or point db.url at
 # postgres and treat the volume as cache/logs only).

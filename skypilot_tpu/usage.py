@@ -73,6 +73,7 @@ def _post_http(url: str, line: Dict[str, Any]) -> None:
     slow/blackholed sink never stalls the calling entrypoint. Bounded
     queue: overflow drops records rather than blocking."""
     global _http_queue, _http_thread
+    import atexit
     import queue
     import threading
     if _http_thread is None or not _http_thread.is_alive():
@@ -81,6 +82,10 @@ def _post_http(url: str, line: Dict[str, Any]) -> None:
                                         daemon=True,
                                         name='usage-http-sink')
         _http_thread.start()
+        # Short-lived CLI processes would otherwise exit before the
+        # daemon thread ships anything; a bounded flush at exit keeps
+        # the common telemetry source (one-shot CLI ops) reporting.
+        atexit.register(flush_http_sink, 2.0)
     try:
         _http_queue.put_nowait((url, line))
     except queue.Full:

@@ -316,3 +316,13 @@ def test_exec_uploads_client_workdir(api_server, tmp_path):
         assert b'VERSION_TWO' in log
     finally:
         sdk.down('x-c')
+
+
+def test_whoami_endpoint(api_server):
+    """Login-aware session surface for the dashboard chip."""
+    import requests
+    r = requests.get(f'{api_server}/api/whoami', timeout=10)
+    assert r.status_code == 200
+    body = r.json()
+    assert body['auth'] in ('loopback', 'anonymous', 'token', 'sso')
+    assert 'role' in body

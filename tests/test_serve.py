@@ -578,6 +578,10 @@ def test_queue_pressure_scales_replicas_e2e(sky_tpu_home, tmp_path):
     # The scale-up decision came from queue pressure.
     assert serve_state.get_inflight('svc-qp') >= 1
     serve.down('svc-qp')
+    # The replicas' slow-server processes must not outlive the test (a
+    # leaked one keeps absorbing CPU for the rest of the CI run).
+    import subprocess
+    subprocess.run(['pkill', '-f', str(script)], check=False)
 
 
 def test_policy_rejects_conflicting_scaling_signals():

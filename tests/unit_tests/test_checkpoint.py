@@ -1,4 +1,8 @@
 """Orbax checkpoint save/restore round-trip + resume convention."""
+import pytest
+
+pytestmark = pytest.mark.jax
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -4,6 +4,10 @@ The oracle: greedy decoding via the cache-free ``llama.forward`` (re-run
 the whole sequence every token). Continuous batching, slot reuse, and
 mixed-length batches must reproduce it exactly (fp32, CPU).
 """
+import pytest
+
+pytestmark = pytest.mark.jax
+
 import jax
 import jax.numpy as jnp
 import numpy as np

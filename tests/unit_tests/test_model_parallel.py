@@ -1,4 +1,8 @@
 """Llama forward/grad on CPU; sharded train step on the 8-device CPU mesh."""
+import pytest
+
+pytestmark = pytest.mark.jax
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -6,6 +6,10 @@ is one Ray placement group per cluster, sky/backends/task_codegen.py:439);
 this is the TPU-native extension SURVEY.md §2.8 calls for ("collectives
 ride ICI within a slice and DCN across slices").
 """
+import pytest
+
+pytestmark = pytest.mark.jax
+
 import numpy as np
 import pytest
 

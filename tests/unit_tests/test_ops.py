@@ -1,4 +1,8 @@
 """Numerics for ops: rms_norm, rope, dense vs flash attention."""
+import pytest
+
+pytestmark = pytest.mark.jax
+
 import jax
 import jax.numpy as jnp
 import numpy as np

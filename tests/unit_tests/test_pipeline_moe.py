@@ -4,6 +4,10 @@ Oracles: the pipelined loss/grad must equal the plain single-program
 loss/grad (same params, fp32, CPU mesh); the ep/tp/fsdp-sharded MoE loss
 must equal its unsharded value (sharding is semantics-preserving).
 """
+import pytest
+
+pytestmark = pytest.mark.jax
+
 import jax
 import jax.numpy as jnp
 import numpy as np

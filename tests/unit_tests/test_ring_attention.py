@@ -1,4 +1,8 @@
 """Ring attention vs dense over an 8-device sequence-parallel mesh."""
+import pytest
+
+pytestmark = pytest.mark.jax
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,5 +1,9 @@
 """Training entrypoints + ResNet: the runnables behind the baseline
 configs, smoke-run at tiny scale on the CPU mesh."""
+import pytest
+
+pytestmark = pytest.mark.jax
+
 import glob
 import os
 
