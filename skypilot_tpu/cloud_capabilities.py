@@ -58,11 +58,13 @@ CLOUD_FEATURES: Dict[str, FrozenSet[Feature]] = {
         Feature.HOST_CONTROLLERS,
         # SPOT: GKE spot node pools (render_slice use_spot toleration +
         # nodeSelector); OPEN_PORTS: Service exposure (open_ports);
-        # VOLUMES: k8s-pvc PersistentVolumeClaims.
+        # VOLUMES: k8s-pvc PersistentVolumeClaims; MULTISLICE: one
+        # StatefulSet per slice with per-slice selectors and slice-aware
+        # agent configs (run_instances/_bootstrap_agents).
         Feature.SPOT, Feature.OPEN_PORTS, Feature.VOLUMES,
+        Feature.MULTISLICE,
         # NOT AUTOSTOP: the in-pod agent cannot scale its own
         # StatefulSet without RBAC the manifests do not grant.
-        # NOT MULTISLICE (needs a JobSet path).
     }),
     'ssh': frozenset({
         # Bare metal: hosts are sunk cost; stop = stop the agents.

@@ -67,26 +67,35 @@ def _kubectl(provider_config: Dict[str, Any], args: List[str],
     return proc.stdout
 
 
+def _slice_obj_names(cluster_name: str, num_slices: int) -> List[str]:
+    """StatefulSet/Service name per slice (bare name for one slice —
+    back-compat; suffixed for multislice, same rule as the gcp
+    provider's node names)."""
+    if num_slices <= 1:
+        return [cluster_name]
+    return [f'{cluster_name}-s{j}' for j in range(num_slices)]
+
+
 def run_instances(config: ProvisionConfig) -> ClusterInfo:
-    if config.num_slices > 1:
-        raise exceptions.ProvisionError(
-            'multislice (num_slices > 1) is supported on the gcp and '
-            'local providers only; GKE multislice needs a JobSet path',
-            retryable=False)
     tpu = topology.parse_tpu(config.tpu_slice) if config.tpu_slice \
         else None
-    manifest = manifests.render_slice(
-        config.cluster_name, tpu,
-        namespace=config.provider_config.get('namespace', 'default'),
-        image=config.provider_config.get(
-            'image', manifests.DEFAULT_IMAGE),
-        labels=config.labels,
-        use_spot=config.use_spot,
-        pvc_volumes=config.data_disks)
-    _kubectl(config.provider_config, ['apply', '-f', '-'],
-             stdin=json.dumps(manifest))
+    names = _slice_obj_names(config.cluster_name, config.num_slices)
+    for j, obj_name in enumerate(names):
+        manifest = manifests.render_slice(
+            config.cluster_name, tpu,
+            namespace=config.provider_config.get('namespace', 'default'),
+            image=config.provider_config.get(
+                'image', manifests.DEFAULT_IMAGE),
+            labels=config.labels,
+            use_spot=config.use_spot,
+            pvc_volumes=config.data_disks,
+            obj_name=obj_name, slice_id=j,
+            num_slices=config.num_slices)
+        _kubectl(config.provider_config, ['apply', '-f', '-'],
+                 stdin=json.dumps(manifest))
+    per_slice = tpu.num_hosts if tpu else 1
     _wait_pods_running(config.cluster_name, config.provider_config,
-                       tpu.num_hosts if tpu else 1)
+                       per_slice * max(config.num_slices, 1))
     info = get_cluster_info(config.cluster_name, config.provider_config)
     if info is None:
         raise exceptions.ProvisionError(
@@ -146,16 +155,23 @@ def _get_pods(cluster_name: str,
 
 def _bootstrap_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
     """Install + start the agent in every pod via kubectl exec (mirrors
-    the TPU-VM path's per-host agent install)."""
+    the TPU-VM path's per-host agent install). Slice-aware: each agent
+    learns its (slice_id, global rank) so the distributed env wires
+    MEGASCALE coordinates for multislice gangs (same contract as the
+    gcp provider's _install_agents)."""
     host_ips = [h.internal_ip for h in info.hosts]
+    num_slices = max(config.num_slices, 1)
+    hosts_per_slice = len(info.hosts) // num_slices
     for rank, host in enumerate(info.hosts):
-        pod = f'{info.cluster_name}-{rank}'
+        pod = host.host_id
         agent_config = {
             'cluster_name': info.cluster_name,
             'mode': 'host',
             'host_rank': rank,
             'host_ips': host_ips,
-            'num_hosts': len(info.hosts),
+            'num_hosts': hosts_per_slice,
+            'num_slices': num_slices,
+            'slice_id': rank // hosts_per_slice,
             'tpu_slice': info.tpu_slice,
             'peer_agent_urls': [
                 f'http://{ip}:{manifests.AGENT_PORT}'
@@ -178,41 +194,77 @@ def _bootstrap_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
         pkg_root = os.path.dirname(os.path.abspath(
             skypilot_tpu.__file__))
         runner.rsync(pkg_root, '/opt/sky_tpu/cluster/skypilot_tpu')
+        # Pidfile probe, NOT pgrep: the exec'd shell's own cmdline
+        # contains the agent start text, so `pgrep -f <pattern> ||
+        # start` SELF-MATCHES and the agent never starts (same bug the
+        # fake-ssh multihost e2e caught in the ssh provider).
         script = (
             f"printf %s {shlex.quote(json.dumps(agent_config))} "
             '> /opt/sky_tpu/cluster/agent_config.json && '
             '(python3 -c "import aiohttp" 2>/dev/null || '
             'python3 -m pip install -q aiohttp) && '
-            "pgrep -f 'skypilot_tpu.runtime.agent' >/dev/null || "
-            'PYTHONPATH=/opt/sky_tpu/cluster '
+            'AP="$(cat /opt/sky_tpu/agent.pid 2>/dev/null)"; '
+            'if ! { kill -0 "$AP" 2>/dev/null && '
+            'grep -q runtime.agent "/proc/$AP/cmdline" 2>/dev/null; }; '
+            'then PYTHONPATH=/opt/sky_tpu/cluster '
             'nohup python3 -m skypilot_tpu.runtime.agent '
             '--cluster-dir /opt/sky_tpu/cluster --host 0.0.0.0 '
             f'--port {manifests.AGENT_PORT} '
-            '>/opt/sky_tpu/agent.log 2>&1 &')
+            '>/opt/sky_tpu/agent.log 2>&1 & '
+            'echo $! > /opt/sky_tpu/agent.pid; fi')
         runner.run(script, check=True, timeout=300.0)
+
+
+def _cluster_sts(cluster_name: str,
+                 provider_config: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Every StatefulSet of this cluster (one per slice), by label.
+    Accepts both list and single-object kubectl responses."""
+    try:
+        out = _kubectl(provider_config, [
+            'get', 'statefulset', '-l',
+            f'{manifests.LABEL_CLUSTER}={cluster_name}', '-o', 'json'])
+        body = json.loads(out)
+    except (exceptions.ClusterDoesNotExist, exceptions.ProvisionError,
+            json.JSONDecodeError):
+        return []
+    items = body.get('items') if isinstance(body, dict) else None
+    if items is None:
+        items = [body] if body.get('metadata') else []
+    for s in items:
+        # Single-object responses (and older harnesses) may omit the
+        # name; the bare cluster name is the pre-multislice convention.
+        s.setdefault('metadata', {}).setdefault('name', cluster_name)
+    return sorted(items, key=lambda s: s['metadata']['name'])
 
 
 def stop_instances(cluster_name: str,
                    provider_config: Dict[str, Any]) -> None:
     # Pods hold TPU chips; "stop" scales the gang to zero, releasing the
-    # slice but keeping the StatefulSet/Service for a fast start.
-    _kubectl(provider_config, ['scale', 'statefulset', cluster_name,
-                               '--replicas', '0'])
+    # slice(s) but keeping the StatefulSets/Services for a fast start.
+    names = ([s['metadata']['name']
+              for s in _cluster_sts(cluster_name, provider_config)]
+             or [cluster_name])
+    for name in names:
+        _kubectl(provider_config, ['scale', 'statefulset', name,
+                                   '--replicas', '0'])
 
 
 def start_instances(cluster_name: str,
                     provider_config: Dict[str, Any]) -> ClusterInfo:
-    out = _kubectl(provider_config, ['get', 'statefulset', cluster_name,
-                                     '-o', 'json'])
-    sts = json.loads(out)
-    # Original gang size survives in the selector-matched spec we wrote.
-    num = sts['metadata']['labels'].get('sky-tpu-num-hosts')
-    if num is None:
-        # Pre-label manifests: best effort from current replicas.
-        num = sts['spec'].get('replicas') or 1
-    _kubectl(provider_config, ['scale', 'statefulset', cluster_name,
-                               '--replicas', str(num)])
-    _wait_pods_running(cluster_name, provider_config, int(num))
+    stss = _cluster_sts(cluster_name, provider_config)
+    if not stss:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    total = 0
+    for sts in stss:
+        # Original gang size survives in the label we wrote.
+        num = sts['metadata'].get('labels', {}).get('sky-tpu-num-hosts')
+        if num is None:
+            num = sts['spec'].get('replicas') or 1
+        total += int(num)
+        _kubectl(provider_config, ['scale', 'statefulset',
+                                   sts['metadata']['name'],
+                                   '--replicas', str(num)])
+    _wait_pods_running(cluster_name, provider_config, total)
     info = get_cluster_info(cluster_name, provider_config)
     assert info is not None
     return info
@@ -220,11 +272,15 @@ def start_instances(cluster_name: str,
 
 def terminate_instances(cluster_name: str,
                         provider_config: Dict[str, Any]) -> None:
+    names = ([s['metadata']['name']
+              for s in _cluster_sts(cluster_name, provider_config)]
+             or [cluster_name])
     try:
-        _kubectl(provider_config, ['delete', 'statefulset', cluster_name,
-                                   '--ignore-not-found'])
-        _kubectl(provider_config, ['delete', 'service', cluster_name,
-                                   '--ignore-not-found'])
+        for name in names:
+            _kubectl(provider_config, ['delete', 'statefulset', name,
+                                       '--ignore-not-found'])
+            _kubectl(provider_config, ['delete', 'service', name,
+                                       '--ignore-not-found'])
         _kubectl(provider_config, ['delete', 'service',
                                    f'{cluster_name}-ports',
                                    '--ignore-not-found'])
@@ -255,24 +311,36 @@ _PHASE_TO_STATE = {
 
 def _expected_hosts(cluster_name: str,
                     provider_config: Dict[str, Any]) -> Optional[int]:
-    """The gang's CURRENT intended host count from the StatefulSet.
+    """The gang's CURRENT intended host count, summed over every slice
+    StatefulSet.
 
     spec.replicas first (0 after a scale-to-zero stop — which must not
     read as a dead gang), the sky-tpu-num-hosts label as fallback.
-    None = the StatefulSet itself is gone (terminated cluster)."""
-    try:
-        out = _kubectl(provider_config, ['get', 'statefulset',
-                                         cluster_name, '-o', 'json'])
-        sts = json.loads(out)
-    except (exceptions.ClusterDoesNotExist, exceptions.ProvisionError,
-            json.JSONDecodeError):
-        return None
-    replicas = sts.get('spec', {}).get('replicas')
-    if replicas is not None:
-        return int(replicas)
-    label = (sts.get('metadata', {}).get('labels', {})
-             .get('sky-tpu-num-hosts'))
-    return int(label) if label and str(label).isdigit() else None
+    None = the StatefulSet(s) are gone (terminated cluster)."""
+    stss = _cluster_sts(cluster_name, provider_config)
+    if not stss:
+        # Selector queries may be unsupported by a minimal harness; fall
+        # back to the bare-name read.
+        try:
+            out = _kubectl(provider_config, ['get', 'statefulset',
+                                             cluster_name, '-o', 'json'])
+            stss = [json.loads(out)]
+        except (exceptions.ClusterDoesNotExist,
+                exceptions.ProvisionError, json.JSONDecodeError):
+            return None
+    total = 0
+    for sts in stss:
+        replicas = sts.get('spec', {}).get('replicas')
+        if replicas is not None:
+            total += int(replicas)
+            continue
+        label = (sts.get('metadata', {}).get('labels', {})
+                 .get('sky-tpu-num-hosts'))
+        if label and str(label).isdigit():
+            total += int(label)
+        else:
+            return None
+    return total
 
 
 def get_cluster_info(cluster_name: str,
@@ -300,12 +368,17 @@ def get_cluster_info(cluster_name: str,
         ]
         tpu_slice = None
     else:
-        # Numeric ordinal sort: lexicographic puts '-10' before '-2'
-        # and scrambles host ranks on 10+-host slices.
+        # (slice, ordinal) sort: lexicographic puts '-10' before '-2'
+        # and scrambles host ranks on 10+-host slices; multislice pods
+        # ('<cluster>-s<j>-<i>') must group by slice first so global
+        # host_rank // hosts_per_slice recovers the slice id.
         def _ordinal(p):
             name = p['metadata']['name']
+            labels = p.get('metadata', {}).get('labels', {})
+            s = labels.get('sky-tpu-slice', '0')
             tail = name.rsplit('-', 1)[-1]
-            return int(tail) if tail.isdigit() else 0
+            return (int(s) if str(s).isdigit() else 0,
+                    int(tail) if tail.isdigit() else 0)
         pods.sort(key=_ordinal)
         hosts = []
         for i, p in enumerate(pods):
@@ -341,6 +414,12 @@ def get_cluster_info(cluster_name: str,
         gke_acc = sel.get('cloud.google.com/gke-tpu-accelerator')
         topo = sel.get('cloud.google.com/gke-tpu-topology')
         tpu_slice = _slice_name_from_gke(gke_acc, topo)
+    num_slices = 1
+    if pods:
+        ns_label = (pods[0].get('metadata', {}).get('labels', {})
+                    .get('sky-tpu-num-slices'))
+        if ns_label and str(ns_label).isdigit():
+            num_slices = int(ns_label)
     return ClusterInfo(
         cluster_name=cluster_name,
         cloud='kubernetes',
@@ -348,6 +427,7 @@ def get_cluster_info(cluster_name: str,
         zone=provider_config.get('namespace', 'default'),
         hosts=hosts,
         tpu_slice=tpu_slice,
+        num_slices=num_slices,
         instance_type=tpu_slice or 'pod',
         use_spot=False,
         cost_per_hour=0.0,

@@ -40,15 +40,26 @@ def render_slice(cluster_name: str,
                  memory: str = '16Gi',
                  labels: Optional[Dict[str, str]] = None,
                  use_spot: bool = False,
-                 pvc_volumes: Optional[List[str]] = None
+                 pvc_volumes: Optional[List[str]] = None,
+                 obj_name: Optional[str] = None,
+                 slice_id: int = 0,
+                 num_slices: int = 1
                  ) -> Dict[str, Any]:
     """Headless Service + StatefulSet for one slice (or one CPU pod when
-    tpu is None). Returned as a kubectl-applyable List manifest."""
+    tpu is None). Returned as a kubectl-applyable List manifest.
+
+    Multislice (GKE): one render per slice with ``obj_name``
+    '<cluster>-s<j>'; every object still carries the CLUSTER label so
+    list/terminate selectors cover the whole gang, plus slice labels the
+    agents use for MEGASCALE wiring."""
+    obj_name = obj_name or cluster_name
     num_hosts = tpu.num_hosts if tpu else 1
     # The gang size survives scale-to-zero stops via this label (start
     # reads it back to restore the full slice).
     meta_labels = {LABEL_CLUSTER: cluster_name,
                    'sky-tpu-num-hosts': str(num_hosts),
+                   'sky-tpu-slice': str(slice_id),
+                   'sky-tpu-num-slices': str(num_slices),
                    **(labels or {})}
     container: Dict[str, Any] = {
         'name': 'sky-host',
@@ -77,7 +88,7 @@ def render_slice(cluster_name: str,
         # same slice; Never lets the controller recreate it instead of
         # restarting in place with stale TPU state.
         'restartPolicy': 'Always',
-        'subdomain': cluster_name,
+        'subdomain': obj_name,
         'volumes': [{'name': 'fusermount-shared',
                      'hostPath': {'path': '/var/run/fusermount',
                                   'type': 'DirectoryOrCreate'}}],
@@ -112,29 +123,33 @@ def render_slice(cluster_name: str,
         pod_spec['volumes'].append(
             {'name': f'vol-{vol_name}',
              'persistentVolumeClaim': {'claimName': vol_name}})
+    # Per-slice pod identity: the Service/StatefulSet selectors include
+    # the slice label, so multislice gangs don't cross-adopt pods.
+    slice_selector = {LABEL_CLUSTER: cluster_name,
+                      'sky-tpu-slice': str(slice_id)}
     service = {
         'apiVersion': 'v1',
         'kind': 'Service',
-        'metadata': {'name': cluster_name, 'namespace': namespace,
+        'metadata': {'name': obj_name, 'namespace': namespace,
                      'labels': meta_labels},
         'spec': {
             'clusterIP': 'None',       # headless: stable per-pod DNS
-            'selector': {LABEL_CLUSTER: cluster_name},
+            'selector': slice_selector,
             'ports': [{'port': AGENT_PORT, 'name': 'sky-agent'}],
         },
     }
     statefulset = {
         'apiVersion': 'apps/v1',
         'kind': 'StatefulSet',
-        'metadata': {'name': cluster_name, 'namespace': namespace,
+        'metadata': {'name': obj_name, 'namespace': namespace,
                      'labels': meta_labels},
         'spec': {
-            'serviceName': cluster_name,
+            'serviceName': obj_name,
             'replicas': num_hosts,
             # All-or-nothing gang: pods start in parallel, not ordinal
             # order — host 7 must not wait for host 0's readiness.
             'podManagementPolicy': 'Parallel',
-            'selector': {'matchLabels': {LABEL_CLUSTER: cluster_name}},
+            'selector': {'matchLabels': slice_selector},
             'template': {
                 'metadata': {'labels': meta_labels},
                 'spec': pod_spec,

@@ -316,3 +316,86 @@ def test_fully_reclaimed_gang_reads_terminated(fake_kubectl):
                           'spec': {'replicas': 0}})
     info = k8s.get_cluster_info('gone', {})
     assert info is not None and info.hosts == []
+
+
+# ---- round 3: multislice (one StatefulSet per slice) ---------------------
+def _ms_pod(name, slice_id, ip):
+    p = _pod(name, ip=ip)
+    p['metadata']['labels'] = {
+        'sky-tpu-cluster': name.rsplit('-s', 1)[0].rsplit('-', 1)[0]
+        if '-s' in name else name,
+        'sky-tpu-slice': str(slice_id),
+        'sky-tpu-num-slices': '2',
+        'sky-tpu-num-hosts': '2',
+    }
+    return p
+
+
+def test_render_multislice_objects():
+    from skypilot_tpu import topology
+    m = manifests.render_slice('ms', topology.parse_tpu('v5e-8'),
+                               obj_name='ms-s1', slice_id=1,
+                               num_slices=2)
+    svc, sts = m['items']
+    assert svc['metadata']['name'] == 'ms-s1'
+    assert sts['metadata']['name'] == 'ms-s1'
+    assert sts['spec']['serviceName'] == 'ms-s1'
+    # Selectors pin the SLICE, not just the cluster — two slices must
+    # not adopt each other's pods.
+    sel = sts['spec']['selector']['matchLabels']
+    assert sel['sky-tpu-slice'] == '1'
+    assert sel[manifests.LABEL_CLUSTER] == 'ms'
+    labels = sts['metadata']['labels']
+    assert labels['sky-tpu-num-slices'] == '2'
+
+
+def test_run_instances_multislice(fake_kubectl):
+    pods = [
+        _ms_pod('msA-s0-0', 0, '10.8.1.1'),
+        _ms_pod('msA-s0-1', 0, '10.8.1.2'),
+        _ms_pod('msA-s1-0', 1, '10.8.1.3'),
+        _ms_pod('msA-s1-1', 1, '10.8.1.4'),
+    ]
+    fake_kubectl.set_pods(pods)
+    cfg = ProvisionConfig(
+        cluster_name='msA', region='ctx', zone='default',
+        instance_type='tpu-v5e-8', num_hosts=2, tpu_slice='v5e-8',
+        num_slices=2, provider_config={'namespace': 'default'})
+    info = k8s.run_instances(cfg)
+    assert info.num_slices == 2
+    assert info.num_hosts == 4
+    # Hosts ordered slice-major (global rank // 2 = slice id).
+    assert [h.internal_ip for h in info.hosts] == [
+        '10.8.1.1', '10.8.1.2', '10.8.1.3', '10.8.1.4']
+    calls = fake_kubectl.calls()
+    applies = [json.loads(c['stdin']) for c in calls
+               if 'apply' in c['argv'] and c['stdin']]
+    sts_names = [m['items'][1]['metadata']['name'] for m in applies
+                 if m.get('items') and len(m['items']) > 1 and
+                 m['items'][1].get('kind') == 'StatefulSet']
+    assert sts_names == ['msA-s0', 'msA-s1']
+    # Agent configs carry slice coordinates for MEGASCALE wiring.
+    execs = [' '.join(c['argv']) for c in calls if 'exec' in c['argv']
+             and 'agent_config.json' in ' '.join(c['argv'])]
+    assert len(execs) == 4
+    assert any('"slice_id": 1' in e and '"host_rank": 2' in e
+               for e in execs)
+    assert all('"num_slices": 2' in e for e in execs)
+    assert all('"num_hosts": 2' in e for e in execs)
+
+
+def test_multislice_terminate_deletes_all_slices(fake_kubectl):
+    fake_kubectl.set_sts({'items': [
+        {'metadata': {'name': 'msA-s0',
+                      'labels': {'sky-tpu-num-hosts': '2'}},
+         'spec': {'replicas': 2}},
+        {'metadata': {'name': 'msA-s1',
+                      'labels': {'sky-tpu-num-hosts': '2'}},
+         'spec': {'replicas': 2}},
+    ]})
+    k8s.terminate_instances('msA', {})
+    deletes = [c['argv'] for c in fake_kubectl.calls()
+               if 'delete' in c['argv']]
+    flat = [' '.join(a) for a in deletes]
+    assert any('statefulset msA-s0' in f for f in flat)
+    assert any('statefulset msA-s1' in f for f in flat)
