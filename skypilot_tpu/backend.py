@@ -268,7 +268,7 @@ class TpuVmBackend(Backend):
         if not url:
             raise exceptions.ClusterNotUpError(
                 f'{info.cluster_name}: no agent URL (cluster stopped?)')
-        return agent_client.AgentClient(url)
+        return agent_client.AgentClient.for_info(info)
 
     def setup(self, info: ClusterInfo, task: task_lib.Task) -> None:
         if not task.setup:

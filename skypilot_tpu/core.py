@@ -165,7 +165,7 @@ def _client_for(cluster_name: str) -> agent_client.AgentClient:
     if not info.head.agent_url:
         raise exceptions.ClusterNotUpError(
             f'{cluster_name} has no live agent')
-    return agent_client.AgentClient(info.head.agent_url)
+    return agent_client.AgentClient.for_info(info)
 
 
 def queue(cluster_name: str) -> List[Dict[str, Any]]:

@@ -506,7 +506,7 @@ def mount_command(dst: str, source: str,
 def mount_on_cluster(info: ClusterInfo, dst: str, source: str,
                      mode: StorageMode = StorageMode.MOUNT) -> None:
     """Run the mount command on every host of the slice via the agent."""
-    client = agent_client.AgentClient(info.head.agent_url)
+    client = agent_client.AgentClient.for_info(info)
     cmd = mount_command(dst, source, mode)
     result = client.exec_sync(cmd)
     if any(rc != 0 for rc in result['returncodes']):

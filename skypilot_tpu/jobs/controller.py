@@ -130,8 +130,8 @@ class JobController:
         if not url:
             return None
         try:
-            return agent_client.AgentClient(url, timeout=10.0).job_status(
-                self.cluster_job_id)
+            return agent_client.AgentClient.for_info(
+                info, timeout=10.0).job_status(self.cluster_job_id)
         except Exception:  # noqa: BLE001 — dead agent == dead slice
             return None
 
@@ -184,7 +184,7 @@ class JobController:
         info = self._cluster_info()
         if info is not None and info.head.agent_url:
             try:
-                agent_client.AgentClient(info.head.agent_url).cancel(
+                agent_client.AgentClient.for_info(info).cancel(
                     self.cluster_job_id)
             except Exception:  # noqa: BLE001 — cluster may be gone
                 pass

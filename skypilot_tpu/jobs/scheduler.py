@@ -38,19 +38,25 @@ _MEM_RESERVE_MB = int(os.environ.get('SKY_TPU_JOBS_MEM_RESERVE_MB',
                                      '1024'))
 
 
-def _mem_headroom_admits() -> bool:
+def _mem_headroom_admits(launching: int = 0) -> bool:
     """Can the host's CURRENT free memory carry one more controller?
 
     Headroom-based (not a total-count cap compared against shrinking
     MemAvailable, which double-counts running controllers and converges
     to ~half utilization): admit while starting one more process still
-    leaves the reserve free.
+    leaves the reserve free. ``launching`` debits controllers in
+    LAUNCHING state — spawned (by this drain or any concurrent submit
+    process) but not yet memory-resident, so MemAvailable alone would
+    admit a whole burst against the same headroom (advisor finding,
+    round 3). The DB state covers the one-submit-per-process burst
+    path a loop-local counter would miss.
     """
     try:
         with open('/proc/meminfo', encoding='ascii') as f:
             for line in f:
                 if line.startswith('MemAvailable:'):
                     avail_mb = int(line.split()[1]) // 1024
+                    avail_mb -= launching * _CONTROLLER_MEM_MB
                     return avail_mb >= (_CONTROLLER_MEM_MB +
                                         _MEM_RESERVE_MB)
     except (OSError, ValueError, IndexError):
@@ -89,7 +95,7 @@ def maybe_schedule_next() -> None:
             if _MAX_ALIVE is not None:
                 if active >= _MAX_ALIVE:
                     return
-            elif not _mem_headroom_admits():
+            elif not _mem_headroom_admits(launching):
                 return
             waiting = jobs_state.waiting_jobs()
             if not waiting:

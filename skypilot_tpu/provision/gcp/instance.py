@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import os
+import secrets
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
@@ -80,6 +81,11 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
     from skypilot_tpu import authentication
     config.provider_config = authentication.setup_gcp_authentication(
         config.provider_config)
+    # Per-cluster agent secret: every agent endpoint but /health
+    # requires it (the agent port is VPC-reachable once open_ports
+    # runs). Rides provider_config so status refreshes preserve it.
+    config.provider_config.setdefault('agent_token',
+                                      secrets.token_hex(16))
     s = topology.parse_tpu(config.tpu_slice)
     runtime_version = (config.runtime_version or
                        DEFAULT_RUNTIME_VERSIONS[s.generation])
@@ -164,6 +170,7 @@ def _install_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
         agent_config = {
             'cluster_name': info.cluster_name,
             'mode': 'host',
+            'auth_token': config.provider_config.get('agent_token'),
             # Global host index; the agent derives (slice_id, in-slice
             # rank) from it and num_hosts.
             'host_rank': rank,
@@ -182,16 +189,25 @@ def _install_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
             host.external_ip or host.internal_ip, user=ssh_user,
             key_path=key)
         cfg_json = json.dumps(agent_config).replace("'", "'\\''")
+        # Idempotence probe via pidfile + /proc cmdline, NOT pgrep: the
+        # remote shell's own cmdline contains the agent start text, so
+        # `pgrep -f <pattern> || start` SELF-MATCHES and the agent never
+        # starts on a fresh VM (same bug the fake-ssh multihost e2e
+        # caught in the ssh provider; this was the last copy of it).
         runner.run(
             f"sudo mkdir -p {AGENT_CLUSTER_DIR} && "
             f"sudo chown -R $(whoami) /opt/sky_tpu && "
             f"echo '{cfg_json}' > {AGENT_CLUSTER_DIR}/agent_config.json && "
             f"(python3 -m pip show skypilot-tpu >/dev/null 2>&1 || "
             f"python3 -m pip install -q skypilot-tpu || true) && "
-            f"pgrep -f 'skypilot_tpu.runtime.agent' >/dev/null || "
+            f'AP="$(cat /opt/sky_tpu/agent.pid 2>/dev/null)"; '
+            f'if ! {{ kill -0 "$AP" 2>/dev/null && '
+            f'grep -q runtime.agent "/proc/$AP/cmdline" 2>/dev/null; }}; '
+            f'then '
             f"nohup python3 -m skypilot_tpu.runtime.agent "
             f"--cluster-dir {AGENT_CLUSTER_DIR} --host 0.0.0.0 "
-            f"--port {AGENT_PORT} >/opt/sky_tpu/agent.log 2>&1 &",
+            f"--port {AGENT_PORT} >/opt/sky_tpu/agent.log 2>&1 & "
+            f"echo $! > /opt/sky_tpu/agent.pid; fi",
             check=True, timeout=120)
 
 
@@ -238,7 +254,9 @@ def get_cluster_info(cluster_name: str,
         use_spot=bool(((node or {}).get('schedulingConfig') or
                        {}).get('spot')),
         provider_config={'project': client.project, 'zone': zone,
-                         'node_state': state, 'num_slices': num_slices})
+                         'node_state': state, 'num_slices': num_slices,
+                         'agent_token':
+                             provider_config.get('agent_token')})
 
 
 def _slices(provider_config: Dict[str, Any], cluster_name: str) -> List[str]:

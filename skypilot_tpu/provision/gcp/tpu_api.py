@@ -317,11 +317,30 @@ class GceFirewallClient(_GceComputeClient):
         if existing is None:
             op = self._request('POST', self._fw_url(), body)
         else:
+            # Only the rule's TCP entries count toward "already open"
+            # (a udp:53 entry does not open tcp:53); non-tcp entries
+            # ride along unchanged in the PATCH body.
             have = set()
+            others = []
             for a in existing.get('allowed', []):
-                have.update(str(p) for p in a.get('ports', []))
-            if have == set(body['allowed'][0]['ports']):
+                if str(a.get('IPProtocol', '')).lower() == 'tcp':
+                    if 'ports' not in a:
+                        # GCP semantics: a tcp entry with no ports list
+                        # allows ALL tcp ports — nothing to add, and a
+                        # PATCH would narrow it.
+                        return existing
+                    have.update(str(p) for p in a.get('ports', []))
+                else:
+                    others.append(a)
+            want = set(body['allowed'][0]['ports'])
+            if want <= have:
                 return existing
+            # UNION with the live rule: a second open_ports call with a
+            # different port list must not silently close earlier ports
+            # (advisor finding, round 3).
+            body['allowed'][0]['ports'] = sorted(
+                have | want, key=lambda p: (len(p), p))
+            body['allowed'].extend(others)
             op = self._request('PATCH', self._fw_url(name), body)
         self._wait_global_op(op)
         return body

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import secrets
 import shlex
 import subprocess
 import time
@@ -28,6 +29,18 @@ from skypilot_tpu.provision.k8s import manifests
 
 POD_WAIT_TIMEOUT = 600.0
 _POLL = 2.0
+
+
+def _pod_wait_timeout() -> float:
+    """Resolved at call time so tests/operators can shorten the gang
+    wait (a bound default argument froze the old module constant)."""
+    env = os.environ.get('SKY_TPU_K8S_POD_WAIT_TIMEOUT')
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    return POD_WAIT_TIMEOUT
 
 
 def _kubectl(provider_config: Dict[str, Any], args: List[str],
@@ -77,6 +90,9 @@ def _slice_obj_names(cluster_name: str, num_slices: int) -> List[str]:
 
 
 def run_instances(config: ProvisionConfig) -> ClusterInfo:
+    # Per-cluster agent secret (see runtime/agent.py auth middleware).
+    config.provider_config.setdefault('agent_token',
+                                      secrets.token_hex(16))
     tpu = topology.parse_tpu(config.tpu_slice) if config.tpu_slice \
         else None
     names = _slice_obj_names(config.cluster_name, config.num_slices)
@@ -107,16 +123,31 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
 def _wait_pods_running(cluster_name: str,
                        provider_config: Dict[str, Any],
                        num_hosts: int,
-                       timeout: float = POD_WAIT_TIMEOUT) -> None:
+                       timeout: Optional[float] = None) -> None:
     """Gang wait: ALL pods of the slice must reach Running. Unschedulable
     TPU pods (no node pool with that topology) fail fast as capacity."""
+    if timeout is None:
+        timeout = _pod_wait_timeout()
     deadline = time.time() + timeout
     while time.time() < deadline:
-        pods = _get_pods(cluster_name, provider_config)
+        # Terminating pods (deletionTimestamp set, phase still Running)
+        # from a just-deleted previous incarnation self-heal within the
+        # grace period — they must neither satisfy the gang nor trip
+        # the over-count fail-fast.
+        pods = [p for p in _get_pods(cluster_name, provider_config)
+                if not p.get('metadata', {}).get('deletionTimestamp')]
         phases = [p['status'].get('phase') for p in pods]
         if len(pods) == num_hosts and all(ph == 'Running'
                                           for ph in phases):
             return
+        if len(pods) > num_hosts:
+            # Over-count never self-heals within this wait (stale pods
+            # from a previous size, a half-deleted StatefulSet, or a
+            # mis-sized gang) — spinning the full timeout just hides it.
+            raise exceptions.ProvisionError(
+                f'[k8s] slice {cluster_name}: {len(pods)} pods found '
+                f'but the gang expects {num_hosts}; stale pods from a '
+                f'previous size or a conflicting StatefulSet?')
         for p in pods:
             name = p['metadata']['name']
             if p['status'].get('phase') in ('Failed', 'Succeeded'):
@@ -167,6 +198,7 @@ def _bootstrap_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
         agent_config = {
             'cluster_name': info.cluster_name,
             'mode': 'host',
+            'auth_token': config.provider_config.get('agent_token'),
             'host_rank': rank,
             'host_ips': host_ips,
             'num_hosts': hosts_per_slice,
@@ -310,14 +342,19 @@ _PHASE_TO_STATE = {
 
 
 def _expected_hosts(cluster_name: str,
-                    provider_config: Dict[str, Any]) -> Optional[int]:
+                    provider_config: Dict[str, Any],
+                    stss: Optional[List[Dict[str, Any]]] = None
+                    ) -> Optional[int]:
     """The gang's CURRENT intended host count, summed over every slice
     StatefulSet.
 
     spec.replicas first (0 after a scale-to-zero stop — which must not
     read as a dead gang), the sky-tpu-num-hosts label as fallback.
-    None = the StatefulSet(s) are gone (terminated cluster)."""
-    stss = _cluster_sts(cluster_name, provider_config)
+    None = the StatefulSet(s) are gone (terminated cluster).
+    ``stss``: pass a pre-fetched _cluster_sts result to skip the kubectl
+    round trip (status-poll hot path)."""
+    if stss is None:
+        stss = _cluster_sts(cluster_name, provider_config)
     if not stss:
         # Selector queries may be unsupported by a minimal harness; fall
         # back to the bare-name read.
@@ -357,14 +394,26 @@ def get_cluster_info(cluster_name: str,
         # must read as TERMINATED hosts or the managed-jobs
         # provider-plane watch (all-RUNNING check over an EMPTY list)
         # would call a dead slice healthy.
-        expected = _expected_hosts(cluster_name, provider_config)
+        stss = _cluster_sts(cluster_name, provider_config)
+        expected = _expected_hosts(cluster_name, provider_config,
+                                   stss=stss)
         if expected is None:
             return None
+        # Slice-aware synthesis: a fully reclaimed S>=2 gang must keep
+        # its real shape (per-slice pod names, num_slices) — consumers
+        # correlate host_ids to pods and read the gang topology here.
+        sts_slices = max(len(stss), 1)
+        if sts_slices <= 1:
+            names = [f'{cluster_name}-{i}' for i in range(expected)]
+        else:
+            per = expected // sts_slices
+            names = [f'{s["metadata"]["name"]}-{i}'
+                     for s in stss for i in range(per)]
         hosts: List[HostInfo] = [
-            HostInfo(host_id=f'{cluster_name}-{i}', internal_ip='',
+            HostInfo(host_id=n, internal_ip='',
                      external_ip=None, state='TERMINATED',
                      agent_url=None)
-            for i in range(expected)
+            for n in names
         ]
         tpu_slice = None
     else:
@@ -397,14 +446,26 @@ def get_cluster_info(cluster_name: str,
         # against the gang size (the sky-tpu-num-hosts label rides on
         # every pod — no extra kubectl round trip) and surface missing
         # ordinals as TERMINATED hosts.
-        label = (pods[0].get('metadata', {}).get('labels', {})
-                 .get('sky-tpu-num-hosts'))
-        expected = (int(label) if label and str(label).isdigit()
+        labels0 = pods[0].get('metadata', {}).get('labels', {})
+        per_slice = labels0.get('sky-tpu-num-hosts')
+        n_slices_label = labels0.get('sky-tpu-num-slices')
+        n_slices = (int(n_slices_label)
+                    if n_slices_label and str(n_slices_label).isdigit()
+                    else 1)
+        # The num-hosts label is PER SLICE: a whole reclaimed slice in
+        # an S>=2 gang would go unnoticed if compared against the
+        # all-slice pod count (advisor finding, round 3).
+        expected = (int(per_slice) * n_slices
+                    if per_slice and str(per_slice).isdigit()
                     else _expected_hosts(cluster_name, provider_config))
         if expected is not None and len(hosts) < expected:
             present = {h.host_id for h in hosts}
-            for i in range(expected):
-                pod_name = f'{cluster_name}-{i}'
+            names = ([f'{cluster_name}-{i}' for i in range(expected)]
+                     if n_slices <= 1 else
+                     [f'{cluster_name}-s{j}-{i}'
+                      for j in range(n_slices)
+                      for i in range(expected // n_slices)])
+            for pod_name in names:
                 if pod_name not in present:
                     hosts.append(HostInfo(
                         host_id=pod_name, internal_ip='',
@@ -414,12 +475,14 @@ def get_cluster_info(cluster_name: str,
         gke_acc = sel.get('cloud.google.com/gke-tpu-accelerator')
         topo = sel.get('cloud.google.com/gke-tpu-topology')
         tpu_slice = _slice_name_from_gke(gke_acc, topo)
-    num_slices = 1
     if pods:
+        num_slices = 1
         ns_label = (pods[0].get('metadata', {}).get('labels', {})
                     .get('sky-tpu-num-slices'))
         if ns_label and str(ns_label).isdigit():
             num_slices = int(ns_label)
+    else:
+        num_slices = sts_slices
     return ClusterInfo(
         cluster_name=cluster_name,
         cloud='kubernetes',
@@ -432,7 +495,8 @@ def get_cluster_info(cluster_name: str,
         use_spot=False,
         cost_per_hour=0.0,
         provider_config={k: v for k, v in provider_config.items()
-                         if k in ('context', 'namespace', 'image')})
+                         if k in ('context', 'namespace', 'image',
+                                  'agent_token')})
 
 
 def _slice_name_from_gke(gke_acc: Optional[str],

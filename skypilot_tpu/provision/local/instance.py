@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import secrets
 import shutil
 import signal
 import subprocess
@@ -31,6 +32,17 @@ from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
 from skypilot_tpu.utils import common
 
 AGENT_START_TIMEOUT = 30.0
+
+
+def _meta_of(cdir: str):
+    p = os.path.join(cdir, 'meta.json')
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p, encoding='utf-8') as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None
 
 
 def _cluster_dir(cluster_name: str) -> str:
@@ -57,6 +69,13 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         os.makedirs(os.path.join(hd, 'workdir'), exist_ok=True)
         with open(os.path.join(hd, 'state'), 'w', encoding='utf-8') as f:
             f.write('RUNNING')
+    # Per-cluster agent secret: reuse the existing one on idempotent
+    # re-provision (a live agent keeps serving under it), generate on
+    # first create. Callers that pass one (provisioner) win.
+    token = config.provider_config.get('agent_token')
+    if not token:
+        prev = _meta_of(cdir)
+        token = (prev or {}).get('agent_token') or secrets.token_hex(16)
     meta = {
         'cluster_name': config.cluster_name,
         'region': config.region,
@@ -67,6 +86,7 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         'num_slices': config.num_slices,
         'use_spot': config.use_spot,
         'created_at': time.time(),
+        'agent_token': token,
     }
     with open(os.path.join(cdir, 'meta.json'), 'w', encoding='utf-8') as f:
         json.dump(meta, f)
@@ -91,6 +111,7 @@ def _start_agent(cluster_name: str) -> None:
         'num_hosts': meta['num_hosts'],
         'num_slices': num_slices,
         'tpu_slice': meta.get('tpu_slice'),
+        'auth_token': meta.get('agent_token'),
     }
     with open(os.path.join(cdir, 'agent_config.json'), 'w',
               encoding='utf-8') as f:
@@ -252,7 +273,8 @@ def get_cluster_info(cluster_name: str,
         instance_type=meta['instance_type'],
         use_spot=meta.get('use_spot', False),
         cost_per_hour=0.0,
-        provider_config={'cluster_dir': cdir})
+        provider_config={'cluster_dir': cdir,
+                         'agent_token': meta.get('agent_token')})
 
 
 def open_ports(cluster_name: str, ports,

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import os
+import secrets
 import shutil
 import subprocess
 import time
@@ -86,7 +87,7 @@ def _write_meta(cdir: str, meta: Dict[str, Any]) -> None:
 
 
 def _node_script(cdir: str, cluster_name: str,
-                 tpu_slice: Optional[str]) -> str:
+                 tpu_slice: Optional[str], token: str) -> str:
     """The per-node srun payload: derive rank/hosts from the Slurm env,
     write the agent config, run the agent in the foreground (the srun
     task's lifetime IS the allocation's)."""
@@ -107,6 +108,7 @@ cfg = {{
     'host_ips': hosts,
     'num_hosts': len(hosts),
     'tpu_slice': {tpu_slice!r},
+    'auth_token': {token!r},
     'peer_agent_urls': [f'http://{{h}}:{AGENT_PORT}'
                         for i, h in enumerate(hosts) if i != rank]
                        if rank == 0 else [],
@@ -142,9 +144,15 @@ def _sbatch_script(config: ProvisionConfig, cdir: str) -> str:
 
 
 def _submit(config: ProvisionConfig, cdir: str) -> str:
+    # Per-cluster agent secret (see runtime/agent.py auth middleware);
+    # rides meta['provider_config'] so get_cluster_info preserves it.
+    config.provider_config.setdefault('agent_token',
+                                      secrets.token_hex(16))
     with open(os.path.join(cdir, 'node_start.sh'), 'w',
               encoding='utf-8') as f:
-        f.write(_node_script(cdir, config.cluster_name, config.tpu_slice))
+        f.write(_node_script(cdir, config.cluster_name, config.tpu_slice,
+                             config.provider_config['agent_token']))
+    os.chmod(os.path.join(cdir, 'node_start.sh'), 0o700)
     sbatch_path = os.path.join(cdir, 'job.sbatch')
     with open(sbatch_path, 'w', encoding='utf-8') as f:
         f.write(_sbatch_script(config, cdir))

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import secrets
 import shutil
 import time
 from typing import Any, Dict, List, Optional
@@ -75,6 +76,11 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
     pool = _pool_of(config)
     cdir = _cluster_dir(config.cluster_name)
     os.makedirs(cdir, exist_ok=True)
+    # Per-cluster agent secret (reused on idempotent re-provision so a
+    # live agent keeps serving; see runtime/agent.py auth middleware).
+    token = (config.provider_config.get('agent_token') or
+             (_meta(cdir) or {}).get('agent_token') or
+             secrets.token_hex(16))
     mode = pool.get('mode', 'ssh')
     if mode == 'process':
         # Delegate host simulation to the local provider, then overlay
@@ -91,6 +97,7 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
             'created_at': time.time(),
             'pool': pool['name'],
             'mode': 'process',
+            'agent_token': token,
         }
         for r in range(num_hosts):
             hd = os.path.join(cdir, f'host{r}')
@@ -109,7 +116,7 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         raise exceptions.ProvisionError(
             f'[ssh] pool {pool["name"]!r} hosts unreachable: {dead}',
             retryable=True)
-    _bootstrap_agent(config.cluster_name, pool)
+    _bootstrap_agent(config.cluster_name, pool, token)
     meta = {
         'cluster_name': config.cluster_name,
         'region': pool.get('region', 'pool'),
@@ -121,13 +128,15 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         'created_at': time.time(),
         'pool': pool['name'],
         'mode': 'ssh',
+        'agent_token': token,
     }
     with open(os.path.join(cdir, 'meta.json'), 'w', encoding='utf-8') as f:
         json.dump(meta, f)
     return get_cluster_info(config.cluster_name, {'pool': pool['name']})
 
 
-def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any]) -> None:
+def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any],
+                     token: str) -> None:
     """Push the framework + start an agent on EVERY host (mirrors the GCP
     provider's _install_agents: head's agent fans job ranks out to peers'
     /run_rank, so each host needs a listening agent)."""
@@ -144,6 +153,7 @@ def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any]) -> None:
         agent_config = {
             'cluster_name': cluster_name,
             'mode': 'host',
+            'auth_token': token,
             'host_rank': rank,
             'host_ips': hosts,
             'num_hosts': len(hosts),
@@ -287,7 +297,8 @@ def get_cluster_info(cluster_name: str,
         provider_config={'pool': meta['pool'],
                          'ssh_user': pool.get('user'),
                          'ssh_key': pool.get('identity_file'),
-                         'ssh_password': pool.get('password')})
+                         'ssh_password': pool.get('password'),
+                         'agent_token': meta.get('agent_token')})
 
 
 def open_ports(cluster_name: str, ports,

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import hmac
 import json
 import os
 import signal
@@ -69,6 +70,13 @@ class Agent:
         self.jobs = job_lib.JobTable(
             os.path.join(self.cluster_dir, 'jobs.db'))
         self.started_at = time.time()
+        # Per-cluster shared secret, provision-time generated. The agent
+        # binds a routable interface on real clouds, so every endpoint
+        # except /health requires it (the reference never exposes skylet
+        # at all — gRPC rides an SSH tunnel,
+        # cloud_vm_ray_backend.py:2288-2320; a bearer token over the VPC
+        # is this framework's equivalent trust boundary).
+        self._token_cache = (-1.0, self.config.get('auth_token'))
         # autostop state (reference sky/skylet/autostop_lib.py)
         self._autostop_file = os.path.join(self.cluster_dir, 'autostop.json')
         # job_id -> list of subprocess handles (local-slice mode)
@@ -81,6 +89,28 @@ class Agent:
         self._pgid_file = os.path.join(self.cluster_dir, 'job_pgids')
         open(self._pgid_file, 'w', encoding='utf-8').close()
         self._start_reaper()
+
+    def _auth_token(self) -> Optional[str]:
+        """Live cluster token: re-read agent_config.json when it changes
+        so a re-provision can rotate the secret without an agent
+        restart (providers rewrite the config on every run_instances)."""
+        path = os.path.join(self.cluster_dir, 'agent_config.json')
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            return self._token_cache[1]
+        if mtime != self._token_cache[0]:
+            try:
+                with open(path, encoding='utf-8') as f:
+                    tok = json.load(f).get('auth_token')
+                self._token_cache = (mtime, tok)
+            except (OSError, json.JSONDecodeError):
+                pass   # mid-rewrite read; keep the cached token
+        return self._token_cache[1]
+
+    def _auth_headers(self) -> Dict[str, str]:
+        tok = self._auth_token()
+        return {'Authorization': f'Bearer {tok}'} if tok else {}
 
     def _start_reaper(self) -> None:
         import subprocess as sp
@@ -222,11 +252,13 @@ class Agent:
                                          f'rank{self.host_rank}_{phase}.log'))
 
         async def call_peer(sess: 'aiohttp.ClientSession', url: str) -> int:
-            # Response body must be read while the session is open.
+            # Response body must be read while the session is open. The
+            # cluster token rides the fan-out too — peers enforce it.
             async with sess.post(f'{url}/run_rank', json={
                     'job_id': job_id, 'cmd': cmd, 'envs': envs,
                     'phase': phase,
-            }, timeout=aiohttp.ClientTimeout(total=None)) as res:
+            }, headers=self._auth_headers(),
+                    timeout=aiohttp.ClientTimeout(total=None)) as res:
                 body = await res.json()
                 return int(body.get('returncode', 255))
 
@@ -466,7 +498,27 @@ class Agent:
         return web.json_response(self._autostop_config())
 
     def make_app(self) -> web.Application:
-        app = web.Application()
+        @web.middleware
+        async def _auth(request: web.Request, handler):
+            if request.path == '/health':
+                return await handler(request)
+            token = self._auth_token()
+            if not token:
+                # Secure by default: an agent provisioned without a
+                # token serves liveness only. Every provider generates
+                # one; hitting this means a hand-rolled config.
+                return web.json_response(
+                    {'error': 'agent has no auth token configured; '
+                              'only /health is served'}, status=403)
+            hdr = request.headers.get('Authorization', '')
+            presented = hdr[len('Bearer '):] if \
+                hdr.startswith('Bearer ') else ''
+            if not hmac.compare_digest(presented, token):
+                return web.json_response({'error': 'forbidden'},
+                                         status=403)
+            return await handler(request)
+
+        app = web.Application(middlewares=[_auth])
         app.router.add_get('/health', self.h_health)
         app.router.add_post('/submit', self.h_submit)
         app.router.add_get('/jobs', self.h_jobs)

@@ -18,9 +18,27 @@ from skypilot_tpu.utils import common
 
 
 class AgentClient:
-    def __init__(self, url: str, timeout: float = 30.0):
+    def __init__(self, url: str, timeout: float = 30.0,
+                 token: Optional[str] = None):
         self.url = url.rstrip('/')
         self.timeout = timeout
+        # Per-cluster shared secret (provision-time generated, rides
+        # ClusterInfo.provider_config['agent_token']); the agent 403s
+        # every endpoint but /health without it.
+        self.token = token
+
+    @classmethod
+    def for_info(cls, info, timeout: float = 30.0,
+                 host: Optional[int] = None) -> 'AgentClient':
+        """Client for a cluster's head agent (or host index ``host``),
+        with the cluster token wired through."""
+        h = info.hosts[host] if host is not None else info.head
+        return cls(h.agent_url, timeout=timeout,
+                   token=info.provider_config.get('agent_token'))
+
+    def _headers(self) -> dict:
+        return ({'Authorization': f'Bearer {self.token}'}
+                if self.token else {})
 
     def wait_healthy(self, timeout: Optional[float] = None
                      ) -> Dict[str, Any]:
@@ -51,25 +69,27 @@ class AgentClient:
                envs: Optional[Dict[str, str]] = None) -> int:
         r = requests.post(f'{self.url}/submit', json={
             'name': name, 'run': run, 'setup': setup, 'envs': envs or {},
-        }, timeout=self.timeout)
+        }, headers=self._headers(), timeout=self.timeout)
         r.raise_for_status()
         return int(r.json()['job_id'])
 
     def job_status(self, job_id: int) -> common.JobStatus:
-        r = requests.get(f'{self.url}/jobs/{job_id}', timeout=self.timeout)
+        r = requests.get(f'{self.url}/jobs/{job_id}',
+                         headers=self._headers(), timeout=self.timeout)
         if r.status_code == 404:
             raise exceptions.JobNotFoundError(f'job {job_id}')
         r.raise_for_status()
         return common.JobStatus(r.json()['status'])
 
     def jobs(self) -> List[Dict[str, Any]]:
-        r = requests.get(f'{self.url}/jobs', timeout=self.timeout)
+        r = requests.get(f'{self.url}/jobs', headers=self._headers(),
+                         timeout=self.timeout)
         r.raise_for_status()
         return r.json()['jobs']
 
     def cancel(self, job_id: int) -> None:
         r = requests.post(f'{self.url}/cancel/{job_id}',
-                          timeout=self.timeout)
+                          headers=self._headers(), timeout=self.timeout)
         if r.status_code == 404:
             raise exceptions.JobNotFoundError(f'job {job_id}')
         r.raise_for_status()
@@ -79,7 +99,7 @@ class AgentClient:
                   timeout: float = 600.0) -> Dict[str, Any]:
         r = requests.post(f'{self.url}/exec',
                           json={'cmd': cmd, 'envs': envs or {}},
-                          timeout=timeout)
+                          headers=self._headers(), timeout=timeout)
         r.raise_for_status()
         return r.json()
 
@@ -88,7 +108,7 @@ class AgentClient:
         with requests.get(
                 f'{self.url}/logs/{job_id}',
                 params={'follow': '1' if follow else '0', 'rank': rank},
-                stream=True, timeout=None) as r:
+                headers=self._headers(), stream=True, timeout=None) as r:
             if r.status_code == 404:
                 raise exceptions.JobNotFoundError(f'job {job_id}')
             r.raise_for_status()
@@ -107,5 +127,5 @@ class AgentClient:
     def set_autostop(self, idle_minutes: int, down: bool = False) -> None:
         r = requests.post(f'{self.url}/autostop', json={
             'idle_minutes': idle_minutes, 'down': down,
-        }, timeout=self.timeout)
+        }, headers=self._headers(), timeout=self.timeout)
         r.raise_for_status()

@@ -19,6 +19,7 @@ import hashlib
 import hmac
 import os
 import secrets
+import sqlite3
 import time
 from typing import Optional
 
@@ -123,7 +124,12 @@ class AuthSessionStore:
             # once per path per process.
             try:
                 conn.execute('SELECT user_id FROM auth_sessions LIMIT 1')
-            except Exception:  # noqa: BLE001 — old schema
+            except sqlite3.OperationalError as e:
+                # Only the old-schema signature drops the table; a
+                # transient error ('database is locked') must NOT
+                # destroy live in-flight login sessions.
+                if 'no such column' not in str(e).lower():
+                    raise
                 conn.execute('DROP TABLE auth_sessions')
                 conn.execute(_SCHEMA)
                 conn.commit()

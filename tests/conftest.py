@@ -17,6 +17,14 @@ if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
 
+# Every python process this suite spawns (agents, controllers, CLI
+# subprocesses, fake kubectl stubs) inherits the environment, and the
+# machine's sitecustomize runs a ~2.5s TPU PJRT register at interpreter
+# start whenever PALLAS_AXON_POOL_IPS is set. Tests run on the CPU mesh
+# only — dropping the trigger removes multi-second startup from every
+# subprocess (previously roughly half the suite's wall clock).
+os.environ.pop('PALLAS_AXON_POOL_IPS', None)
+
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')

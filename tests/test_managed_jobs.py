@@ -413,17 +413,19 @@ def test_memory_based_admission(monkeypatch):
     monkeypatch.setattr(scheduler, '_MAX_LAUNCHING', 10)
     # No headroom → nothing admitted.
     monkeypatch.setattr(scheduler, '_MAX_ALIVE', None)
-    monkeypatch.setattr(scheduler, '_mem_headroom_admits', lambda: False)
+    monkeypatch.setattr(scheduler, '_mem_headroom_admits',
+                        lambda *a: False)
     jobs.launch(_task('sleep 1', name='adm-no'))
     assert spawned == []
     # Headroom back → waiting job admitted.
-    monkeypatch.setattr(scheduler, '_mem_headroom_admits', lambda: True)
+    monkeypatch.setattr(scheduler, '_mem_headroom_admits',
+                        lambda *a: True)
     scheduler.maybe_schedule_next()
     assert len(spawned) == 1
     # Explicit count cap overrides the memory signal.
     monkeypatch.setattr(scheduler, '_MAX_ALIVE', 2)
     monkeypatch.setattr(scheduler, '_mem_headroom_admits',
-                        lambda: (_ for _ in ()).throw(AssertionError))
+                        lambda *a: (_ for _ in ()).throw(AssertionError))
     for i in range(4):
         jobs.launch(_task('sleep 1', name=f'adm{i}'))
     assert len(spawned) == 2  # 1 earlier + 1 more up to the cap

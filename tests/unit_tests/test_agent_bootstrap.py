@@ -1,0 +1,93 @@
+"""Agent bootstrap idempotence across providers.
+
+Round-3 landmine: `pgrep -f '<agent pattern>' || start` inside an SSH /
+kubectl-exec one-liner SELF-MATCHES (the probing shell's own cmdline
+contains the pattern) so the agent never starts on a fresh host. Fixed
+three times (ssh, k8s, then gcp); these tests make a fourth copy
+impossible.
+"""
+import ast
+import pathlib
+
+import pytest
+
+from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
+                                           ProvisionConfig)
+
+_PROVISION_DIR = pathlib.Path(__file__).resolve().parents[2] / \
+    'skypilot_tpu' / 'provision'
+
+
+def _string_constants(source: str):
+    """Every string literal in the module (f-string pieces included)."""
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value
+
+
+@pytest.mark.parametrize('provider', ['gcp', 'k8s', 'ssh', 'slurm',
+                                      'local'])
+def test_no_pgrep_self_match_start_gate(provider):
+    path = _PROVISION_DIR / provider / 'instance.py'
+    if not path.exists():
+        pytest.skip(f'no instance.py for {provider}')
+    gated = [s for s in _string_constants(path.read_text())
+             if 'pgrep' in s and 'runtime.agent' in s]
+    assert not gated, (
+        f'{provider}/instance.py gates agent start on a pgrep that '
+        f'self-matches the probing shell: {gated}')
+
+
+@pytest.mark.parametrize('provider', ['gcp', 'k8s', 'ssh'])
+def test_agent_start_uses_pidfile_probe(provider):
+    """Any shell snippet that starts the agent must carry the pidfile +
+    /proc cmdline probe (PID-reuse-safe idempotence)."""
+    path = _PROVISION_DIR / provider / 'instance.py'
+    starters = [s for s in _string_constants(path.read_text())
+                if 'runtime.agent' in s and 'nohup' in s]
+    assert starters, f'{provider}: no agent start snippet found'
+    joined = ' '.join(_string_constants(path.read_text()))
+    assert 'agent.pid' in joined and '/proc/' in joined, (
+        f'{provider}: agent start lacks the pidfile + /proc probe')
+
+
+def test_gcp_generated_bootstrap_command(monkeypatch):
+    """Behavioral check on the ACTUAL generated remote command: capture
+    what _install_agents would run over SSH on a fresh TPU VM."""
+    from skypilot_tpu.provision.gcp import instance as gcp
+    from skypilot_tpu.utils import command_runner
+
+    captured = []
+
+    class FakeRunner:
+        def __init__(self, *a, **kw):
+            pass
+
+        def run(self, cmd, **kw):
+            captured.append(cmd)
+            return 0, '', ''
+
+        def rsync(self, *a, **kw):
+            pass
+
+    monkeypatch.setattr(command_runner, 'SSHCommandRunner', FakeRunner)
+    info = ClusterInfo(
+        cluster_name='c1', cloud='gcp', region='us-central2',
+        zone='us-central2-b',
+        hosts=[HostInfo(host_id=f'c1-host{i}',
+                        internal_ip=f'10.0.0.{i + 1}',
+                        external_ip=f'34.0.0.{i + 1}')
+               for i in range(2)],
+        tpu_slice='v5p-16')
+    cfg = ProvisionConfig(
+        cluster_name='c1', region='us-central2', zone='us-central2-b',
+        instance_type='tpu-v5p-16', num_hosts=2, tpu_slice='v5p-16',
+        provider_config={'project': 'p', 'zone': 'us-central2-b'})
+    gcp._install_agents(info, cfg)
+    assert len(captured) == 2
+    for cmd in captured:
+        assert 'pgrep' not in cmd
+        assert 'agent.pid' in cmd and '/proc/$AP/cmdline' in cmd
+        assert 'nohup python3 -m skypilot_tpu.runtime.agent' in cmd
+        assert 'agent_config.json' in cmd

@@ -34,12 +34,11 @@ def test_required_features_derivation():
 
 def test_flags_match_provider_behavior():
     F = caps.Feature
-    # Multislice is implemented by gcp+local only; k8s/ssh run_instances
-    # reject num_slices > 1 (provision/{k8s,ssh}/instance.py).
-    for cloud in ('gcp', 'local'):
+    # Multislice: gcp/local/k8s implement it (k8s: one StatefulSet per
+    # slice, provision/k8s/instance.py); ssh pools have no slice API.
+    for cloud in ('gcp', 'local', 'kubernetes'):
         assert F.MULTISLICE in caps.features_of(cloud)
-    for cloud in ('kubernetes', 'ssh'):
-        assert F.MULTISLICE not in caps.features_of(cloud)
+    assert F.MULTISLICE not in caps.features_of('ssh')
     # gcp ports = intra-VPC reachability (serve LB→replica path).
     assert F.OPEN_PORTS in caps.features_of('gcp')
     # Bare-metal ssh pools have no spot market.
@@ -52,7 +51,7 @@ def test_flags_match_provider_behavior():
 def test_check_features_raises_with_names():
     with pytest.raises(exceptions.ResourcesMismatchError,
                        match='multislice'):
-        caps.check_features('kubernetes',
+        caps.check_features('ssh',
                             frozenset({caps.Feature.MULTISLICE}))
     caps.check_features('gcp', frozenset({caps.Feature.SPOT}))  # ok
 
@@ -61,12 +60,7 @@ def test_candidates_filtered_by_features():
     """Pinned clouds missing a required feature raise with the feature
     name; unpinned requests only offer clouds that implement it."""
     from skypilot_tpu import catalog
-    # (k8s gained SPOT in round 3 — multislice is still unsupported.)
-    t = _task(cloud='kubernetes', accelerators='v5e-8', num_slices=2)
-    with pytest.raises(exceptions.ResourcesMismatchError,
-                       match='multislice'):
-        catalog.get_candidates(t.resources,
-                               required=caps.required_features(t))
+    # ssh pools can never gang DCN slices; k8s can (round-3 multislice).
     t2 = _task(cloud='ssh', accelerators='v5e-8',
                num_slices=2)
     with pytest.raises(exceptions.ResourcesMismatchError,

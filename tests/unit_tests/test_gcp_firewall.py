@@ -65,6 +65,38 @@ def test_open_ports_idempotent_and_patches(fw):
     assert patches[0][2]['allowed'][0]['ports'] == ['8080', '9090']
 
 
+def test_open_ports_unions_with_existing(fw):
+    """A second open_ports call with a DIFFERENT port list must not
+    close earlier ports: PATCH carries the union (advisor finding,
+    round 3)."""
+    client, fake = fw
+    fake.existing_rule = {
+        'name': 'sky-tpu-c3-ports',
+        'allowed': [{'IPProtocol': 'tcp', 'ports': ['8080', '9000']}],
+    }
+    gcp_instance.open_ports('c3', [22], {'project': 'proj-x'})
+    patches = [c for c in fake.calls if c[0] == 'PATCH']
+    assert len(patches) == 1
+    assert sorted(patches[0][2]['allowed'][0]['ports']) == \
+        ['22', '8080', '9000']
+    # A subset of the live rule: no write at all.
+    fake.calls.clear()
+    gcp_instance.open_ports('c3', [8080], {'project': 'proj-x'})
+    assert not [c for c in fake.calls if c[0] in ('POST', 'PATCH')]
+
+
+def test_open_ports_all_tcp_rule_untouched(fw):
+    """A tcp entry with NO ports list allows ALL tcp ports (GCP
+    semantics) — open_ports must not PATCH it down to a narrow list."""
+    client, fake = fw
+    fake.existing_rule = {
+        'name': 'sky-tpu-c4-ports',
+        'allowed': [{'IPProtocol': 'tcp'}],
+    }
+    gcp_instance.open_ports('c4', [8080], {'project': 'proj-x'})
+    assert not [c for c in fake.calls if c[0] in ('POST', 'PATCH')]
+
+
 def test_cleanup_ports_deletes_rule(fw):
     client, fake = fw
     gcp_instance.cleanup_ports('my-cluster', {'project': 'proj-x'})

@@ -350,6 +350,9 @@ def test_render_multislice_objects():
 
 
 def test_run_instances_multislice(fake_kubectl):
+    # v5p-16 = 2 hosts per slice (v5e-8 is single-host — the round-3
+    # version of this test fabricated 2 hosts/slice for it and hung the
+    # gang wait for the full timeout).
     pods = [
         _ms_pod('msA-s0-0', 0, '10.8.1.1'),
         _ms_pod('msA-s0-1', 0, '10.8.1.2'),
@@ -359,7 +362,7 @@ def test_run_instances_multislice(fake_kubectl):
     fake_kubectl.set_pods(pods)
     cfg = ProvisionConfig(
         cluster_name='msA', region='ctx', zone='default',
-        instance_type='tpu-v5e-8', num_hosts=2, tpu_slice='v5e-8',
+        instance_type='tpu-v5p-16', num_hosts=2, tpu_slice='v5p-16',
         num_slices=2, provider_config={'namespace': 'default'})
     info = k8s.run_instances(cfg)
     assert info.num_slices == 2
@@ -382,6 +385,71 @@ def test_run_instances_multislice(fake_kubectl):
                for e in execs)
     assert all('"num_slices": 2' in e for e in execs)
     assert all('"num_hosts": 2' in e for e in execs)
+
+
+def test_wait_pods_fails_fast_on_overcount(fake_kubectl):
+    """More pods than the gang expects (stale pods from a previous
+    size, a half-deleted StatefulSet) never self-heals — must raise
+    immediately instead of spinning the full timeout."""
+    fake_kubectl.set_pods([_pod(f'oc-{i}') for i in range(3)])
+    with pytest.raises(exceptions.ProvisionError, match='3 pods'):
+        k8s._wait_pods_running('oc', {}, num_hosts=2)
+
+
+def test_pod_wait_timeout_env_tunable(fake_kubectl, monkeypatch):
+    monkeypatch.setenv('SKY_TPU_K8S_POD_WAIT_TIMEOUT', '0.2')
+    fake_kubectl.set_pods([_pod('t-0', phase='Pending')])
+    import time as _time
+    start = _time.time()
+    with pytest.raises(exceptions.ProvisionTimeoutError):
+        k8s._wait_pods_running('t', {}, num_hosts=1)
+    assert _time.time() - start < 30
+
+
+def test_multislice_partial_slice_loss_detected(fake_kubectl):
+    """A WHOLE reclaimed slice in an S=2 gang: per-pod num-hosts label
+    (2) must be multiplied by num-slices (2) so the 2 surviving pods
+    read as a broken gang, with missing hosts named per-slice
+    (advisor finding, round 3)."""
+    fake_kubectl.set_pods([
+        _ms_pod('msA-s0-0', 0, '10.8.1.1'),
+        _ms_pod('msA-s0-1', 0, '10.8.1.2'),
+    ])
+    info = k8s.get_cluster_info('msA', {})
+    assert info is not None
+    assert len(info.hosts) == 4
+    dead = sorted(h.host_id for h in info.hosts
+                  if h.state == 'TERMINATED')
+    assert dead == ['msA-s1-0', 'msA-s1-1']
+
+
+def test_multislice_fully_reclaimed_keeps_shape(fake_kubectl):
+    """All pods of an S=2 gang gone at once: synthesized hosts must use
+    the real per-slice pod names and num_slices must stay 2."""
+    fake_kubectl.set_sts({'items': [
+        {'metadata': {'name': 'msA-s0',
+                      'labels': {'sky-tpu-num-hosts': '2'}},
+         'spec': {'replicas': 2}},
+        {'metadata': {'name': 'msA-s1',
+                      'labels': {'sky-tpu-num-hosts': '2'}},
+         'spec': {'replicas': 2}},
+    ]})
+    fake_kubectl.set_pods([])
+    info = k8s.get_cluster_info('msA', {})
+    assert info is not None
+    assert info.num_slices == 2
+    assert sorted(h.host_id for h in info.hosts) == [
+        'msA-s0-0', 'msA-s0-1', 'msA-s1-0', 'msA-s1-1']
+    assert all(h.state == 'TERMINATED' for h in info.hosts)
+
+
+def test_wait_pods_ignores_terminating(fake_kubectl):
+    """Pods with deletionTimestamp (previous incarnation draining) must
+    not trip the over-count fail-fast nor satisfy the gang."""
+    dying = _pod('tg-9')
+    dying['metadata']['deletionTimestamp'] = '2026-01-01T00:00:00Z'
+    fake_kubectl.set_pods([_pod('tg-0'), dying])
+    k8s._wait_pods_running('tg', {}, num_hosts=1)   # no raise
 
 
 def test_multislice_terminate_deletes_all_slices(fake_kubectl):
