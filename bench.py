@@ -7,13 +7,16 @@ Prints ONE JSON line:
 Methodology (documented because the reference publishes no model-level
 numbers — BASELINE.md): a ~1B-param Llama (bf16, full per-layer remat,
 bf16 Adam moments, flash attention) trains on one chip; value =
-tokens/sec/chip. ``vs_baseline`` is model FLOPs utilization (MFU)
-divided by 0.40 — the tokens/sec/$-parity proxy from BASELINE.json:
-reference-class GPU frameworks sustain ~40% MFU on this workload, so
-vs_baseline > 1.0 means this framework extracts more of its hardware
-than the reference stack does of its H100s. (The earlier 350M bench
-config peaked at ~0.28 MFU — dim 1024 matmuls underfill the v5e MXU;
-dim 1536 x 24 layers reaches ~0.44 while still fitting HBM.)
+tokens/sec/chip. The headline quality number is the RAW ``mfu`` field.
+``vs_baseline`` compares it against an EXTERNAL published figure: the
+Llama-3 training report ("The Llama 3 Herd of Models", Meta 2024,
+sec. 3.3.2) reports 38-43% MFU for H100 BF16 pretraining across its
+configurations; vs_baseline = mfu / 0.43 uses the report's UPPER bound
+(conservative against this framework). It is a hardware-utilization
+comparison — tokens/sec/$ parity (BASELINE.json) additionally depends
+on instance pricing, which the optimizer's catalog covers. (The
+earlier 350M bench config peaked at ~0.28 MFU — dim 1024 matmuls
+underfill the v5e MXU; dim 1536 x 24 layers fills it.)
 """
 from __future__ import annotations
 
@@ -33,7 +36,9 @@ BATCH = 4
 SEQ = 2048
 WARMUP = 2
 STEPS = 5
-REFERENCE_MFU = 0.40
+# Llama-3 report (Meta 2024, sec 3.3.2): 38-43% MFU, H100 BF16
+# pretraining. Upper bound used: conservative vs this framework.
+EXTERNAL_BASELINE_MFU = 0.43
 
 PEAK_BF16_TFLOPS = {
     'v5 lite': 197.0, 'v5litepod': 197.0, 'v5e': 197.0,
@@ -102,7 +107,8 @@ def main() -> None:
         'metric': 'train_tokens_per_sec_per_chip',
         'value': round(tok_per_sec, 1),
         'unit': 'tokens/s/chip',
-        'vs_baseline': round(mfu / REFERENCE_MFU, 3),
+        'vs_baseline': round(mfu / EXTERNAL_BASELINE_MFU, 3),
+        'baseline_source': 'Llama-3 report 2024 sec3.3.2: 43% MFU H100 BF16',
         'mfu': round(mfu, 4),
         'model_params_m': round(config.num_params / 1e6),
         'batch': batch, 'seq': seq,

@@ -116,9 +116,15 @@ def main() -> None:
                              'real ~1B-param LLaMA on the chip; random '
                              'weights — TTFT is a latency property of '
                              'the serving path, not the values)')
-    parser.add_argument('--max-seq-len', type=int, default=128)
+    parser.add_argument('--max-seq-len', type=int, default=256)
     parser.add_argument('--slots', type=int, default=16)
     parser.add_argument('--tp', type=int, default=1)
+    parser.add_argument('--quantize', action='store_true',
+                        help='int8 weight-only (8B on one v5e chip)')
+    parser.add_argument('--tokenizer', default=None,
+                        help='tokenizer.json for the text path '
+                             '(default: examples/tokenizer_8k.json '
+                             'if present)')
     parser.add_argument('--output', default=None)
     args = parser.parse_args()
 
@@ -130,12 +136,27 @@ def main() -> None:
     lb_port = common.free_port()
 
     # 1. Real inference server on the local accelerator.
+    tokenizer = args.tokenizer
+    if tokenizer is None:
+        from skypilot_tpu.infer import server as server_lib
+        default_tok = os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), 'examples', 'tokenizer_8k.json')
+        # Only auto-attach when the model vocab can hold the
+        # tokenizer's ids — `--model tiny` (vocab 256) must keep its
+        # byte fallback instead of dying in the server's vocab check.
+        if (os.path.exists(default_tok) and
+                server_lib.MODELS[args.model]().vocab_size >= 8192):
+            tokenizer = default_tok
+    cmd = [sys.executable, '-m', 'skypilot_tpu.infer.server',
+           '--port', str(infer_port), '--model', args.model,
+           '--slots', str(args.slots),
+           '--max-seq-len', str(args.max_seq_len), '--tp', str(args.tp)]
+    if args.quantize:
+        cmd.append('--quantize')
+    if tokenizer:
+        cmd += ['--tokenizer', tokenizer]
     infer_proc = subprocess.Popen(
-        [sys.executable, '-m', 'skypilot_tpu.infer.server',
-         '--port', str(infer_port), '--model', args.model,
-         '--slots', str(args.slots),
-         '--max-seq-len', str(args.max_seq_len), '--tp', str(args.tp)],
-        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        cmd, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
     sweep = []
     cold_s = None
     try:
@@ -197,6 +218,8 @@ def main() -> None:
         'model': args.model,
         'tp': args.tp,
         'slots': args.slots,
+        'quantize': args.quantize,
+        'tokenizer': ('bpe-8k' if tokenizer else 'bytes'),
         'device': jax.devices()[0].device_kind,
         'path': ('client -> serve LB -> continuous-batching engine '
                  '(streamed; client-side send->first-byte clock)'),

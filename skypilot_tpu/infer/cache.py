@@ -47,22 +47,6 @@ def init_cache(n_layers: int, n_slots: int, max_seq_len: int,
         lengths=jnp.zeros((n_slots,), jnp.int32))
 
 
-def insert_prefill(cache: KVCache, slot: jnp.ndarray, k_new: jnp.ndarray,
-                   v_new: jnp.ndarray, true_len: jnp.ndarray) -> KVCache:
-    """Write a prefilled prompt's K/V into ``slot``.
-
-    k_new/v_new: [L, P, kv_heads, head_dim] (P = padded prompt bucket;
-    only the first ``true_len`` positions are meaningful — the garbage
-    tail is never attended to because lengths[slot] = true_len).
-    """
-    k = jax.lax.dynamic_update_slice(
-        cache.k, k_new[:, None].astype(cache.k.dtype), (0, slot, 0, 0, 0))
-    v = jax.lax.dynamic_update_slice(
-        cache.v, v_new[:, None].astype(cache.v.dtype), (0, slot, 0, 0, 0))
-    lengths = cache.lengths.at[slot].set(true_len.astype(jnp.int32))
-    return KVCache(k=k, v=v, lengths=lengths)
-
-
 def append_token(cache_k_layer: jnp.ndarray, cache_v_layer: jnp.ndarray,
                  k_new: jnp.ndarray, v_new: jnp.ndarray,
                  positions: jnp.ndarray

@@ -42,7 +42,7 @@ from skypilot_tpu.models import llama
 class EngineConfig:
     n_slots: int = 8
     max_seq_len: int = 2048
-    prefill_buckets: Sequence[int] = (16, 64, 256, 1024, 2048)
+    prefill_buckets: Sequence[int] = (16, 64, 256)
     eos_id: Optional[int] = None
     max_new_tokens: int = 256
     top_k: int = 0
@@ -53,6 +53,16 @@ class EngineConfig:
     # does not fit one v5e chip; tp=4/8 over ICI makes it servable —
     # GSPMD inserts the all-reduces, the engine code is unchanged.
     tp: int = 1
+    # Chunked prefill (the round-3 TTFT-under-concurrency fix): prompts
+    # are processed in <=prefill_chunk-token chunks interleaved with
+    # decode steps, so a long prompt never head-of-line blocks every
+    # active slot's next token. chunks_per_step bounds prefill work per
+    # engine step.
+    prefill_chunk: int = 256
+    prefill_chunks_per_step: int = 1
+    # int8 weight-only quantization (ops/quant.py): halves weight HBM
+    # bytes (8B fits one v5e chip) and speeds the bandwidth-bound decode.
+    quantize: bool = False
 
 
 @dataclasses.dataclass
@@ -112,12 +122,28 @@ class InferenceEngine:
             raise ValueError(
                 f'cache max_seq_len {self.ecfg.max_seq_len} exceeds model '
                 f'max_seq_len {config.max_seq_len}')
-        # Buckets clamp to the cache length and always include it, so any
-        # prompt submit() accepts has a bucket that fits the cache.
+        # Chunk buckets: prefill_buckets clamped to the chunk cap (and
+        # the cache length). Non-final chunks always use the cap, so
+        # write offsets stay multiples of it; requiring cap | max_seq_len
+        # keeps every padded chunk write inside the cache
+        # (dynamic_update_slice clamps out-of-range starts, which would
+        # silently corrupt earlier positions).
+        cap = min(self.ecfg.prefill_chunk, self.ecfg.max_seq_len)
         self._buckets = sorted(
-            {min(b, self.ecfg.max_seq_len)
-             for b in self.ecfg.prefill_buckets}
-            | {self.ecfg.max_seq_len})
+            {min(b, cap) for b in self.ecfg.prefill_buckets} | {cap})
+        self._chunk_cap = self._buckets[-1]
+        if self.ecfg.max_seq_len % self._chunk_cap:
+            raise ValueError(
+                f'max_seq_len {self.ecfg.max_seq_len} must be a '
+                f'multiple of the chunk size {self._chunk_cap}')
+        if self.ecfg.quantize:
+            if self.ecfg.tp > 1:
+                # param_shardings has no rules for QuantArray leaves
+                # yet; 8B int8 fits ONE chip, which is the point.
+                raise ValueError('quantize=True requires tp=1')
+            from skypilot_tpu.ops import quant as quant_lib
+            if not quant_lib.is_quantized(params):
+                params = quant_lib.quantize_params(params)
         self.params = params
         self.cache = cache_lib.init_cache(
             config.n_layers, self.ecfg.n_slots, self.ecfg.max_seq_len,
@@ -131,6 +157,10 @@ class InferenceEngine:
         self._lock = threading.Lock()
         self._waiting: List[Request] = []
         self._slots: List[Optional[Request]] = [None] * self.ecfg.n_slots
+        # slot -> prompt tokens already prefilled (chunked prefill in
+        # flight); a slot decodes only once its prompt is fully cached.
+        self._prefilling: Dict[int, int] = {}
+        self._rr = 0   # round-robin cursor over prefilling slots
         # Host mirrors of device state (avoid device reads on the hot path)
         self._last_token = np.zeros((self.ecfg.n_slots,), np.int32)
         self._slot_len = np.zeros((self.ecfg.n_slots,), np.int64)
@@ -147,22 +177,19 @@ class InferenceEngine:
         # are baked into the lowered program as constants — for a 1B+
         # model that is gigabytes of constants, a pathological compile,
         # and a second copy of the weights in the executable.
-        @functools.partial(jax.jit, static_argnums=(0,))
-        def _prefill(bucket_is_static, params, tokens, true_len):
-            del bucket_is_static
-            return model_lib.prefill(config, params, tokens, true_len)
-        self._prefill = _prefill
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _prefill_chunk(kv_cache, params, slot, tokens, offset,
+                           true_len):
+            # One compiled program per chunk bucket (tokens shape).
+            return model_lib.prefill_chunk(config, params, kv_cache,
+                                           slot, tokens, offset,
+                                           true_len)
+        self._prefill_chunk = _prefill_chunk
 
         @functools.partial(jax.jit, donate_argnums=(0,))
-        def _insert(kv_cache, slot, ks, vs, true_len):
-            return cache_lib.insert_prefill(kv_cache, slot, ks, vs,
-                                            true_len)
-        self._insert = _insert
-
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def _decode(kv_cache, params, tokens, key, temps):
+        def _decode(kv_cache, params, tokens, key, temps, active):
             logits, new_cache = model_lib.decode_step(
-                config, params, kv_cache, tokens)
+                config, params, kv_cache, tokens, active)
             toks = sampling_lib.sample(logits, key, temps,
                                        top_k=self.ecfg.top_k)
             return toks, new_cache
@@ -248,15 +275,25 @@ class InferenceEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _do_prefill(self, req: Request, slot: int) -> None:
+    def _do_chunk(self, slot: int) -> None:
+        """Advance one prefilling slot by ONE chunk; on the final chunk
+        sample the first token and hand the slot to the decode phase."""
+        req = self._slots[slot]
+        off = self._prefilling[slot]
         n = len(req.prompt_tokens)
-        bucket = self._bucket(n)
+        remaining = n - off
+        bucket = self._bucket(min(remaining, self._chunk_cap))
+        tl = min(remaining, bucket)
         padded = np.zeros((bucket,), np.int32)
-        padded[:n] = req.prompt_tokens
-        ks, vs, logits = self._prefill(bucket, self.params,
-                                       jnp.asarray(padded), jnp.int32(n))
-        self.cache = self._insert(self.cache, jnp.int32(slot), ks, vs,
-                                  jnp.int32(n))
+        padded[:tl] = req.prompt_tokens[off:off + tl]
+        self.cache, logits = self._prefill_chunk(
+            self.cache, self.params, jnp.int32(slot),
+            jnp.asarray(padded), jnp.int32(off), jnp.int32(tl))
+        off += tl
+        if off < n:
+            self._prefilling[slot] = off
+            return
+        del self._prefilling[slot]
         first = int(self._sample_first(
             logits, self._next_key(), jnp.float32(req.temperature)))
         req.first_token_at = time.time()
@@ -288,33 +325,44 @@ class InferenceEngine:
 
     # ---- the step --------------------------------------------------------
     def step(self) -> int:
-        """Refill free slots, then decode one token for all active slots.
-        Returns the number of active slots stepped.
+        """Refill free slots, advance at most ``prefill_chunks_per_step``
+        prefill chunks (round-robin across prefilling slots), then decode
+        one token for every fully-prefilled slot. Returns the number of
+        slots worked on.
 
         The lock guards only the waiting queue — prefill compiles/executes
         on-device and must not block submit() (which HTTP handlers call
         from the event loop)."""
-        refill: List[tuple] = []
         with self._lock:
             for slot in range(self.ecfg.n_slots):
                 if self._slots[slot] is None and self._waiting:
                     req = self._waiting.pop(0)
                     self._slots[slot] = req   # reserve before releasing
-                    refill.append((req, slot))
-        for req, slot in refill:
-            self._do_prefill(req, slot)
-        active = [s for s, r in enumerate(self._slots) if r is not None]
-        if not active:
-            return 0
+                    self._prefilling[slot] = 0
+        # Chunk phase: bounded prefill work per step so decode latency
+        # of active slots stays flat under prompt bursts.
+        for _ in range(self.ecfg.prefill_chunks_per_step):
+            if not self._prefilling:
+                break
+            slots = sorted(self._prefilling)
+            self._rr = (self._rr + 1) % len(slots)
+            self._do_chunk(slots[self._rr])
+        decoding = [s for s, r in enumerate(self._slots)
+                    if r is not None and s not in self._prefilling]
+        if not decoding:
+            return len(self._prefilling)
+        active_mask = np.zeros((self.ecfg.n_slots,), np.bool_)
+        active_mask[decoding] = True
         t0 = time.perf_counter()
         toks, self.cache = self._decode(
             self.cache, self.params, jnp.asarray(self._last_token),
-            self._next_key(), jnp.asarray(self._temps))
+            self._next_key(), jnp.asarray(self._temps),
+            jnp.asarray(active_mask))
         toks_host = np.asarray(toks)
         self._decode_time += time.perf_counter() - t0
         self._decode_steps += 1
-        self._decode_tokens += len(active)
-        for slot in active:
+        self._decode_tokens += len(decoding)
+        for slot in decoding:
             req = self._slots[slot]
             token = int(toks_host[slot])
             req.output_tokens.append(token)
@@ -322,7 +370,7 @@ class InferenceEngine:
             self._slot_len[slot] += 1
             if self._finished(req, slot, token):
                 self._finish(slot, req)
-        return len(active)
+        return len(decoding) + len(self._prefilling)
 
     def idle(self) -> bool:
         with self._lock:

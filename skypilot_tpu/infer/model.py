@@ -2,9 +2,9 @@
 
 Same weights, two execution shapes:
 
-- **prefill**: the full prompt in one pass (MXU-bound, flash attention),
-  emitting every position's K/V for cache insertion plus the last
-  position's logits.
+- **prefill_chunk**: the prompt in bounded chunks with cache context
+  (MXU-bound; interleaves with decode so long prompts never
+  head-of-line block active slots).
 - **decode**: ONE token for every slot in one fused step
   (HBM-bandwidth-bound: the work is streaming the KV cache through the
   chip once). Attention is computed dense over the static cache with a
@@ -16,7 +16,7 @@ cache (XLA updates it in place).
 """
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,57 +24,117 @@ import jax.numpy as jnp
 from skypilot_tpu.infer import cache as cache_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.ops import norms
+from skypilot_tpu.ops import quant as quant_lib
 from skypilot_tpu.ops import rope as rope_lib
 
 
-def prefill(config: llama.LlamaConfig, params: llama.Params,
-            tokens: jnp.ndarray, true_len: jnp.ndarray
-            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Run the prompt; return (k [L,P,kv,hd], v [L,P,kv,hd],
-    last_logits [vocab]).
+def prefill_chunk(config: llama.LlamaConfig, params: llama.Params,
+                  kv: cache_lib.KVCache, slot: jnp.ndarray,
+                  tokens: jnp.ndarray, offset: jnp.ndarray,
+                  true_len: jnp.ndarray
+                  ) -> Tuple[cache_lib.KVCache, jnp.ndarray]:
+    """Process ONE chunk of a prompt with cache context (chunked /
+    incremental prefill — the fix for prefill head-of-line blocking:
+    long prompts no longer monopolize the device between decode steps).
 
-    tokens: [P] int32, padded to a bucket size; true_len: scalar int32.
-    The pad tail's K/V are garbage but unreachable (cache lengths stop at
-    true_len); last_logits reads position true_len-1.
+    tokens: [C] int32, a chunk padded to the chunk bucket; offset =
+    tokens of this slot already in the cache; true_len = valid tokens in
+    this chunk. K/V of the chunk are written into ``slot`` at
+    [offset, offset+C) (write-then-attend, like decode), the chunk's
+    queries attend to the slot's cached prefix plus the chunk itself
+    (causal), and lengths[slot] advances to offset+true_len. Returns
+    (cache', last_logits [vocab]) — logits at local position
+    true_len-1, meaningful on the final chunk.
+
+    The pad tail writes garbage at [offset+true_len, offset+C), beyond
+    the slot's frontier: unreadable (every mask stops at the frontier)
+    and overwritten by the next chunk/decode write before the frontier
+    reaches it.
     """
-    x = params['embed'][tokens][None]          # [1, P, d]
+    C = tokens.shape[0]
+    x = quant_lib.qembed(params['embed'], tokens)[None]   # [1, C, d]
     cos, sin = rope_lib.rope_frequencies(config.head_dim,
                                          config.max_seq_len,
                                          config.rope_theta)
+    positions = offset + jnp.arange(C, dtype=jnp.int32)   # [C]
+    S = kv.max_seq_len
+    # [C, S]: causal over cache prefix + chunk (key_pos <= query_pos).
+    mask = jnp.arange(S)[None, :] <= positions[:, None]
 
-    def body(carry, layer):
-        h, kv = _prefill_layer(config, carry, layer, cos, sin)
-        return h, kv
+    def body(carry, xs):
+        layer, k_layer, v_layer = xs
+        h, k_new, v_new = _chunk_layer(config, carry, layer, cos, sin,
+                                       k_layer, v_layer, slot,
+                                       positions, mask)
+        return h, (k_new, v_new)
 
-    x, (ks, vs) = jax.lax.scan(body, x, params['layers'])
+    x, (k_upd, v_upd) = jax.lax.scan(
+        body, x, (params['layers'], kv.k, kv.v))
     x = norms.rms_norm(x, params['final_norm'], config.norm_eps)
     last = jax.lax.dynamic_index_in_dim(x[0], true_len - 1, axis=0,
                                         keepdims=False)
-    logits = (last @ params['lm_head']).astype(jnp.float32)
-    return ks, vs, logits
+    logits = quant_lib.qdot(last,
+                            params['lm_head']).astype(jnp.float32)
+    lengths = kv.lengths.at[slot].set(
+        (offset + true_len).astype(jnp.int32))
+    return cache_lib.KVCache(k=k_upd, v=v_upd, lengths=lengths), logits
 
 
-def _prefill_layer(config, x, layer, cos, sin):
-    x, k, v = llama.attention_block(config, x, layer, cos, sin, None)
-    h = norms.rms_norm(x, layer['mlp_norm'], config.norm_eps)
-    gate = jax.nn.silu(h @ layer['w_gate'])
-    x = x + (gate * (h @ layer['w_up'])) @ layer['w_down']
-    # [s, kv, hd] for the cache (batch=1 squeezed).
-    return x, (k[0], v[0])
+def _chunk_layer(config, x, layer, cos, sin, k_cache, v_cache, slot,
+                 positions, mask):
+    """One layer of chunked prefill. k_cache/v_cache: [slots, S, kv, hd]
+    (this layer); x: [1, C, d]."""
+    _, C, d = x.shape
+    hq, hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    group = hq // hkv
+
+    h = norms.rms_norm(x, layer['attn_norm'], config.norm_eps)
+    q = quant_lib.qdot(h, layer['wq']).reshape(1, C, hq, hd)
+    k = quant_lib.qdot(h, layer['wk']).reshape(1, C, hkv, hd)
+    v = quant_lib.qdot(h, layer['wv']).reshape(1, C, hkv, hd)
+    q = rope_lib.apply_rope(q, cos, sin, positions[None])
+    k = rope_lib.apply_rope(k, cos, sin, positions[None])
+
+    # Write the chunk's K/V into the slot FIRST, then attend over the
+    # cache — the chunk sees itself through the causal mask.
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (slot, positions[0], 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (slot, positions[0], 0, 0))
+
+    kc = jax.lax.dynamic_index_in_dim(k_cache, slot, axis=0,
+                                      keepdims=False)  # [S, kv, hd]
+    vc = jax.lax.dynamic_index_in_dim(v_cache, slot, axis=0,
+                                      keepdims=False)
+    qg = q[0].reshape(C, hkv, group, hd).astype(jnp.float32)
+    scores = jnp.einsum('ckgd,skd->ckgs', qg,
+                        kc.astype(jnp.float32)) * (hd ** -0.5)
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    att = jnp.einsum('ckgs,skd->ckgd', probs, vc.astype(jnp.float32))
+    att = att.reshape(1, C, hq * hd).astype(x.dtype)
+    x = x + quant_lib.qdot(att, layer['wo'])
+    x = llama.mlp_block(config, x, layer)
+    return x, k_cache, v_cache
 
 
 def decode_step(config: llama.LlamaConfig, params: llama.Params,
-                kv: cache_lib.KVCache, tokens: jnp.ndarray
+                kv: cache_lib.KVCache, tokens: jnp.ndarray,
+                active: Optional[jnp.ndarray] = None
                 ) -> Tuple[jnp.ndarray, cache_lib.KVCache]:
     """One decode token for every slot.
 
     tokens: [slots] int32 (last sampled token per slot). Returns
-    (logits [slots, vocab] fp32, cache with K/V appended and lengths+1).
-    Inactive slots (length 0) compute garbage that the engine ignores —
-    uniform work keeps the step a single static program.
+    (logits [slots, vocab] fp32, cache with K/V appended and lengths
+    advanced). Inactive slots (``active`` False — free, or mid-way
+    through a chunked prefill) compute garbage that the engine ignores
+    and their lengths DON'T advance; their garbage K/V write lands at
+    the slot frontier, which the next real write covers. Uniform work
+    keeps the step a single static program.
     """
     positions = kv.lengths                       # write offset = length
-    x = params['embed'][tokens][:, None]         # [slots, 1, d]
+    x = quant_lib.qembed(params['embed'],
+                         tokens)[:, None]        # [slots, 1, d]
     cos, sin = rope_lib.rope_frequencies(config.head_dim,
                                          config.max_seq_len,
                                          config.rope_theta)
@@ -92,9 +152,12 @@ def decode_step(config: llama.LlamaConfig, params: llama.Params,
     x, (k_upd, v_upd) = jax.lax.scan(
         body, x, (params['layers'], kv.k, kv.v))
     x = norms.rms_norm(x, params['final_norm'], config.norm_eps)
-    logits = (x[:, 0] @ params['lm_head']).astype(jnp.float32)
+    logits = quant_lib.qdot(x[:, 0],
+                            params['lm_head']).astype(jnp.float32)
+    bump = (jnp.ones_like(kv.lengths) if active is None
+            else active.astype(kv.lengths.dtype))
     new_cache = cache_lib.KVCache(k=k_upd, v=v_upd,
-                                  lengths=kv.lengths + 1)
+                                  lengths=kv.lengths + bump)
     return logits, new_cache
 
 
@@ -105,9 +168,9 @@ def _decode_layer(config, x, layer, cos, sin, k_cache, v_cache,
     group = hq // hkv
 
     h = norms.rms_norm(x, layer['attn_norm'], config.norm_eps)
-    q = (h @ layer['wq']).reshape(slots, 1, hq, hd)
-    k = (h @ layer['wk']).reshape(slots, 1, hkv, hd)
-    v = (h @ layer['wv']).reshape(slots, 1, hkv, hd)
+    q = quant_lib.qdot(h, layer['wq']).reshape(slots, 1, hq, hd)
+    k = quant_lib.qdot(h, layer['wk']).reshape(slots, 1, hkv, hd)
+    v = quant_lib.qdot(h, layer['wv']).reshape(slots, 1, hkv, hd)
     q = rope_lib.apply_rope(q, cos, sin, positions[:, None])
     k = rope_lib.apply_rope(k, cos, sin, positions[:, None])
 
@@ -124,9 +187,7 @@ def _decode_layer(config, x, layer, cos, sin, k_cache, v_cache,
     probs = jax.nn.softmax(scores, axis=-1)
     att = jnp.einsum('bkgs,bskd->bkgd', probs, vc)
     att = att.reshape(slots, 1, hq * hd).astype(x.dtype)
-    x = x + att @ layer['wo']
+    x = x + quant_lib.qdot(att, layer['wo'])
 
-    h = norms.rms_norm(x, layer['mlp_norm'], config.norm_eps)
-    gate = jax.nn.silu(h @ layer['w_gate'])
-    x = x + (gate * (h @ layer['w_up'])) @ layer['w_down']
+    x = llama.mlp_block(config, x, layer)
     return x, k_cache, v_cache

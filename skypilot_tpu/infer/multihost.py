@@ -1,0 +1,152 @@
+"""Multi-host tensor-parallel serving driver (JetStream-style lockstep).
+
+The reference reaches multi-GPU/多-node serving by delegating to
+vLLM/TGI (reference llm/vllm example YAMLs). TPU-native equivalent: a
+serve replica that IS a multi-host slice. The agent gang-fans the same
+``infer.server`` command to every host with the ``jax.distributed`` env
+injected (runtime/distributed_env.py); host 0 serves HTTP, and every
+host runs an IDENTICAL engine in lockstep:
+
+- Request submissions are broadcast host0 → all as two fixed-shape
+  collectives (length, then padded payload bytes) via
+  ``jax.experimental.multihost_utils``.
+- Every host then performs the same ``engine.step()``. All host-side
+  decisions (slot assignment, chunk scheduling, sampling keys) are
+  deterministic functions of the submission order, and the device work
+  is one SPMD program over the global ``tp`` mesh — the hosts cannot
+  diverge.
+
+Shutdown: a ``stop`` flag rides the same broadcast, so followers exit
+cleanly when host 0 does.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _broadcast_bytes(data: Optional[bytes]) -> bytes:
+    """host0 → all. ``data`` is ignored on followers (pass None)."""
+    import jax
+    from jax.experimental import multihost_utils
+    del jax
+    n_local = len(data) if data else 0
+    n = int(multihost_utils.broadcast_one_to_all(
+        np.array([n_local], np.int32))[0])
+    if n == 0:
+        return b''
+    buf = np.zeros((n,), np.uint8)
+    if data:
+        buf[:] = np.frombuffer(data, np.uint8)
+    return bytes(np.asarray(multihost_utils.broadcast_one_to_all(buf)))
+
+
+class MultihostEngineDriver:
+    """Lockstep wrapper around an ``InferenceEngine`` replicated on
+    every host of the slice."""
+
+    def __init__(self, engine) -> None:
+        import jax
+        self.engine = engine
+        self.rank = jax.process_index()
+        self.world = jax.process_count()
+        self._pending: List[Dict[str, Any]] = []   # rank0 only
+        self._lock = threading.Lock()
+        self._stop = False
+
+    # ---- rank-0 API (called from HTTP handler threads) ------------------
+    def submit(self, prompt_tokens, max_new_tokens=None,
+               temperature: float = 0.0):
+        """Queue a submission for the next tick; block until every host
+        has admitted it, then return this host's Request object."""
+        assert self.rank == 0, 'only host 0 accepts requests'
+        entry = {
+            'spec': {'prompt_tokens': list(map(int, prompt_tokens)),
+                     'max_new_tokens': max_new_tokens,
+                     'temperature': float(temperature)},
+            'event': threading.Event(),
+            'request': None,
+            'error': None,
+        }
+        with self._lock:
+            self._pending.append(entry)
+        entry['event'].wait()
+        if entry['error'] is not None:
+            raise entry['error']
+        return entry['request']
+
+    def stop(self) -> None:
+        self._stop = True
+
+    # ---- the lockstep loop (every host) ---------------------------------
+    def tick(self) -> bool:
+        """One broadcast + one engine step on every host. Returns False
+        when the replica is shutting down."""
+        batch: List[Dict[str, Any]] = []
+        payload = None
+        if self.rank == 0:
+            with self._lock:
+                batch, self._pending = self._pending, []
+            payload = json.dumps({
+                'reqs': [e['spec'] for e in batch],
+                'stop': self._stop,
+            }).encode()
+        data = _broadcast_bytes(payload)
+        msg = json.loads(data) if data else {'reqs': [], 'stop': False}
+        for i, spec in enumerate(msg['reqs']):
+            try:
+                req = self.engine.submit(
+                    spec['prompt_tokens'],
+                    max_new_tokens=spec['max_new_tokens'],
+                    temperature=spec['temperature'])
+            except ValueError as e:
+                # Every host rejects identically (same validation on the
+                # same spec) — lockstep is preserved.
+                req, err = None, e
+            else:
+                err = None
+            if self.rank == 0:
+                batch[i]['request'] = req
+                batch[i]['error'] = err
+                batch[i]['event'].set()
+        if msg.get('stop'):
+            return False
+        self.engine.step()
+        return True
+
+    def run(self, idle_sleep: float = 0.002) -> None:
+        """Follower loop (and usable as rank-0's loop body driver): tick
+        until stopped; nap only when the engine is idle AND nothing is
+        queued (followers block inside the broadcast instead)."""
+        while self.tick():
+            if self.rank == 0 and self.engine.idle():
+                with self._lock:
+                    quiet = not self._pending
+                if quiet and not self._stop:
+                    time.sleep(idle_sleep)
+
+
+def maybe_initialize_distributed() -> int:
+    """``jax.distributed.initialize`` from the env the provisioner
+    injected (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID, runtime/distributed_env.py). Args are passed
+    explicitly — argless initialize() only works with jax's cluster
+    auto-detectors (TPU pod metadata, SLURM), not plain env vars.
+    Returns the process count (1 = single-host: nothing initialized)."""
+    import os
+
+    import jax
+    if int(os.environ.get('JAX_NUM_PROCESSES', '1')) <= 1:
+        return 1
+    jax.distributed.initialize(
+        coordinator_address=os.environ['JAX_COORDINATOR_ADDRESS'],
+        num_processes=int(os.environ['JAX_NUM_PROCESSES']),
+        process_id=int(os.environ['JAX_PROCESS_ID']))
+    return jax.process_count()

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu.ops import attention as attention_lib
 from skypilot_tpu.ops import norms
+from skypilot_tpu.ops import quant as quant_lib
 from skypilot_tpu.ops import rope as rope_lib
 
 Params = Dict[str, Any]
@@ -177,9 +178,11 @@ def attention_block(config: LlamaConfig, x: jnp.ndarray, layer: Params,
     hq, hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
 
     h = norms.rms_norm(x, layer['attn_norm'], config.norm_eps)
-    q = (h @ layer['wq']).reshape(b, s, hq, hd)
-    k = (h @ layer['wk']).reshape(b, s, hkv, hd)
-    v = (h @ layer['wv']).reshape(b, s, hkv, hd)
+    # qdot: plain `@` for training params, dequantizing matmul for the
+    # int8 serving path (ops/quant.py) — one attention implementation.
+    q = quant_lib.qdot(h, layer['wq']).reshape(b, s, hq, hd)
+    k = quant_lib.qdot(h, layer['wk']).reshape(b, s, hkv, hd)
+    v = quant_lib.qdot(h, layer['wv']).reshape(b, s, hkv, hd)
     q = rope_lib.apply_rope(q, cos, sin, positions)
     k = rope_lib.apply_rope(k, cos, sin, positions)
     # [b, s, h, hd] -> [b, h, s, hd] for the attention kernels.
@@ -193,17 +196,24 @@ def attention_block(config: LlamaConfig, x: jnp.ndarray, layer: Params,
     # it) lets the backward skip re-running attention entirely.
     att = jax.ad_checkpoint.checkpoint_name(att, _ATTN_OUT_NAME)
     att = att.transpose(0, 2, 1, 3).reshape(b, s, hq * hd)
-    return x + att @ layer['wo'], k, v
+    return x + quant_lib.qdot(att, layer['wo']), k, v
+
+
+def mlp_block(config: LlamaConfig, x: jnp.ndarray,
+              layer: Params) -> jnp.ndarray:
+    """norm -> SwiGLU -> residual; shared with the inference paths so
+    the MLP math (and its quantized form) exists exactly once."""
+    h = norms.rms_norm(x, layer['mlp_norm'], config.norm_eps)
+    gate = jax.nn.silu(quant_lib.qdot(h, layer['w_gate']))
+    return x + quant_lib.qdot(gate * quant_lib.qdot(h, layer['w_up']),
+                              layer['w_down'])
 
 
 def _layer(config: LlamaConfig, x: jnp.ndarray, layer: Params,
            cos: jnp.ndarray, sin: jnp.ndarray,
            positions: Optional[jnp.ndarray]) -> jnp.ndarray:
     x, _, _ = attention_block(config, x, layer, cos, sin, positions)
-    h = norms.rms_norm(x, layer['mlp_norm'], config.norm_eps)
-    gate = jax.nn.silu(h @ layer['w_gate'])
-    x = x + (gate * (h @ layer['w_up'])) @ layer['w_down']
-    return x
+    return mlp_block(config, x, layer)
 
 
 def forward(config: LlamaConfig, params: Params, tokens: jnp.ndarray,

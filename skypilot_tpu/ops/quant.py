@@ -1,0 +1,171 @@
+"""Int8 weight-only quantization for inference.
+
+The reference serves 8B-70B models by delegating to vLLM/TGI, which
+ship weight-only int8/int4 paths (reference llm/vllm example YAMLs).
+TPU-native equivalent: per-output-channel symmetric int8 weights with
+bf16 scales, consumed by the same prefill/decode programs.
+
+Why per-OUTPUT-channel: scales then commute with the matmul —
+``x @ (q * s_col) == (x @ q) * s_col`` — so the contraction runs on the
+int8->bf16 converted weight (XLA keeps the bytes int8 in HBM and fuses
+the convert into the dot's operand read) and one cheap [out]-vector
+multiply finishes the job. Decode is HBM-bandwidth-bound on weight
+streaming, so halving weight bytes is a throughput win, not just a
+memory one; an 8B model drops from ~16 GB to ~8.5 GB and fits a single
+v5e chip.
+
+``qdot``/``qembed`` are transparent: plain jnp arrays pass through, so
+the shared model code serves both full-precision and quantized params.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QuantArray:
+    """int8 weight + per-output-channel scale.
+
+    Matmul weights [..., in, out]: scale [..., out] (reduce over in).
+    Embedding tables [vocab, d]: scale [vocab] (per row — rows are
+    gathered individually, per-row dynamic range is what matters).
+    """
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.scale.dtype
+
+
+def quantize_weight(w: jnp.ndarray,
+                    scale_dtype=jnp.bfloat16) -> QuantArray:
+    """Symmetric per-output-channel int8 over the contraction axis
+    (axis -2 of [..., in, out])."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+    scale = jnp.maximum(absmax / 127.0, 1e-8)
+    q = jnp.round(w.astype(jnp.float32) / scale[..., None, :])
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return QuantArray(q=q, scale=scale.astype(scale_dtype))
+
+
+def quantize_embed(w: jnp.ndarray,
+                   scale_dtype=jnp.bfloat16) -> QuantArray:
+    """Per-row int8 for embedding tables [vocab, d]: scale [vocab]."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax / 127.0, 1e-8)
+    q = jnp.round(w.astype(jnp.float32) / scale[..., None])
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return QuantArray(q=q, scale=scale.astype(scale_dtype))
+
+
+def qdot(x: jnp.ndarray, w) -> jnp.ndarray:
+    """``x @ w`` for plain arrays; dequantizing matmul for QuantArray.
+
+    The int8->x.dtype convert happens inside the dot's operand read
+    (XLA fusion); scales apply to the [..., out] result."""
+    if isinstance(w, QuantArray):
+        y = x @ w.q.astype(x.dtype)
+        return y * w.scale.astype(x.dtype)
+    return x @ w
+
+
+def qembed(embed, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Row gather for plain or quantized embedding tables."""
+    if isinstance(embed, QuantArray):
+        rows = embed.q[tokens]
+        scale = embed.scale[tokens]
+        return rows.astype(scale.dtype) * scale[..., None]
+    return embed[tokens]
+
+
+_MATMUL_KEYS = frozenset({'wq', 'wk', 'wv', 'wo',
+                          'w_gate', 'w_up', 'w_down', 'lm_head'})
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize a Llama param tree: matmul weights per-output-channel,
+    the embedding per-row; norms stay in their compute dtype (tiny).
+
+    Leaf-by-leaf with buffer donation: the bf16 source of each weight is
+    freed as its int8 replacement materializes, so peak HBM is
+    params + one leaf — not params + quantized params (which would OOM
+    an 8B model on the 16 GB chip it is being quantized to fit)."""
+    qw = jax.jit(quantize_weight, donate_argnums=0)
+    qe = jax.jit(quantize_embed, donate_argnums=0)
+    layers = dict(params['layers'])
+    for key in list(layers):
+        if key in _MATMUL_KEYS:
+            layers[key] = qw(layers[key])
+    return {
+        'embed': qe(params['embed']),
+        'layers': layers,
+        'final_norm': params['final_norm'],
+        'lm_head': qw(params['lm_head']),
+    }
+
+
+def init_params_quantized(config, key: jax.Array) -> Params:
+    """Random-init DIRECTLY into int8: each weight is generated in the
+    compute dtype, quantized, and freed before the next — an 8B model
+    (16 GB bf16) never exists whole on the chip, only its ~8.5 GB int8
+    form plus one transient leaf. Mirrors llama.init_params's tree
+    shape and scaling exactly (structure asserted by
+    test_infer.test_quantized_init_matches_structure)."""
+    dtype = jnp.dtype(config.dtype)
+    d, hd = config.dim, config.head_dim
+    L = config.n_layers
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def qnormal(k, shape, scale, quant_fn=quantize_weight):
+        w = (jax.random.normal(k, shape, dtype) *
+             jnp.asarray(scale, dtype))
+        return jax.jit(quant_fn, donate_argnums=0)(w)
+
+    ks = jax.random.split(k_layers, 7)
+    scale = d ** -0.5
+    out_scale = scale / (2 * L) ** 0.5
+    layers = {
+        'attn_norm': jnp.ones((L, d), dtype),
+        'wq': qnormal(ks[0], (L, d, config.n_heads * hd), scale),
+        'wk': qnormal(ks[1], (L, d, config.n_kv_heads * hd), scale),
+        'wv': qnormal(ks[2], (L, d, config.n_kv_heads * hd), scale),
+        'wo': qnormal(ks[3], (L, config.n_heads * hd, d), out_scale),
+        'mlp_norm': jnp.ones((L, d), dtype),
+        'w_gate': qnormal(ks[4], (L, d, config.ffn_dim), scale),
+        'w_up': qnormal(ks[5], (L, d, config.ffn_dim), scale),
+        'w_down': qnormal(ks[6], (L, config.ffn_dim, d), out_scale),
+    }
+    return {
+        'embed': qnormal(k_embed, (config.vocab_size, d), 1.0,
+                         quantize_embed),
+        'layers': layers,
+        'final_norm': jnp.ones((d,), dtype),
+        'lm_head': qnormal(k_head, (d, config.vocab_size), scale),
+    }
+
+
+def is_quantized(params: Params) -> bool:
+    return any(isinstance(leaf, QuantArray)
+               for leaf in jax.tree_util.tree_leaves(
+                   params,
+                   is_leaf=lambda x: isinstance(x, QuantArray)))
+
+
+def param_bytes(params: Params) -> int:
+    """HBM footprint of a (possibly quantized) param tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total

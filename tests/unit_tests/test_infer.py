@@ -188,3 +188,122 @@ def test_tensor_parallel_validates_divisibility(params):
         InferenceEngine(CFG, params,
                         EngineConfig(n_slots=2, max_seq_len=64,
                                      prefill_buckets=(8,), tp=3))
+
+
+# ---- round 4: chunked prefill, int8 quantization, tokenizer --------------
+def test_chunked_long_prompt_matches_oracle(params):
+    """A prompt spanning several chunks (chunk cap 8 here) must decode
+    identically to the cache-free oracle — the chunk attention mask and
+    K/V writes are position-exact."""
+    eng = InferenceEngine(CFG, params,
+                          EngineConfig(n_slots=2, max_seq_len=64,
+                                       prefill_buckets=(8,),
+                                       prefill_chunk=8))
+    prompt = [(i * 7 + 3) % 250 for i in range(21)]   # 3 chunks
+    [req] = eng.generate([prompt], max_new_tokens=6)
+    assert req.output_tokens == _oracle_greedy(params, prompt, 6)
+
+
+def test_chunked_prefill_interleaves_decode(params):
+    """While a long prompt prefills chunk-by-chunk, already-active slots
+    must keep emitting tokens every step (no head-of-line blocking)."""
+    eng = InferenceEngine(CFG, params,
+                          EngineConfig(n_slots=2, max_seq_len=64,
+                                       prefill_buckets=(8,),
+                                       prefill_chunk=8))
+    short = eng.submit([5, 4], max_new_tokens=40)
+    # Prefill the short prompt, get it decoding.
+    while short.first_token_at is None:
+        eng.step()
+    produced_before = len(short.output_tokens)
+    long_req = eng.submit([(i * 3 + 1) % 250 for i in range(40)],
+                          max_new_tokens=2)
+    # The 40-token prompt needs 5 chunks; each step advances ONE chunk
+    # and still decodes the short request.
+    for _ in range(5):
+        eng.step()
+        if short.done:
+            break
+    assert len(short.output_tokens) >= produced_before + 4, (
+        'short request starved during the long prefill')
+    eng.run_until_idle()
+    assert long_req.output_tokens == _oracle_greedy(
+        params, long_req.prompt_tokens, 2)
+    assert short.output_tokens == _oracle_greedy(params, [5, 4], 40)
+
+
+def test_quantized_engine_generates(params):
+    """int8 weight-only engine: outputs stay high-fidelity (the tiny
+    fp32 model is quantization-sensitive, so only the first tokens are
+    compared) and memory halves."""
+    from skypilot_tpu.ops import quant
+    eng = InferenceEngine(CFG, params,
+                          EngineConfig(n_slots=2, max_seq_len=64,
+                                       prefill_buckets=(8,),
+                                       quantize=True))
+    assert quant.param_bytes(eng.params) < \
+        quant.param_bytes(params) / 2
+    prompt = [5, 17, 101, 7]
+    [req] = eng.generate([prompt], max_new_tokens=4)
+    oracle = _oracle_greedy(params, prompt, 4)
+    assert req.output_tokens[0] == oracle[0], (
+        'first int8 token diverged from fp32 oracle')
+    assert all(0 <= t < CFG.vocab_size for t in req.output_tokens)
+
+
+def test_quantize_with_tp_rejected(params):
+    with pytest.raises(ValueError, match='quantize'):
+        InferenceEngine(CFG, params,
+                        EngineConfig(quantize=True, tp=2,
+                                     max_seq_len=64,
+                                     prefill_buckets=(8,)))
+
+
+def test_max_seq_len_must_align_to_chunk(params):
+    with pytest.raises(ValueError, match='multiple'):
+        InferenceEngine(CFG, params,
+                        EngineConfig(max_seq_len=60,
+                                     prefill_buckets=(8,),
+                                     prefill_chunk=8))
+
+
+def test_tokenizer_roundtrip_real_file():
+    """The shipped tokenizer.json round-trips text (round-3 verdict:
+    /generate must not gibberish-decode bytes)."""
+    import os
+    from skypilot_tpu.infer import server as server_lib
+    path = os.path.join(os.path.dirname(__file__), '..', '..',
+                        'examples', 'tokenizer_8k.json')
+    tok = server_lib.Tokenizer(os.path.abspath(path), vocab_limit=32768)
+    text = 'Launch a v5p-64 slice and gang-schedule the job.'
+    ids = tok.encode(text)
+    assert ids and all(isinstance(i, int) for i in ids)
+    assert len(ids) < len(text) // 2   # real subwords, not bytes
+    assert tok.decode(ids) == text
+
+
+def test_tokenizer_vocab_limit_enforced():
+    import os
+    from skypilot_tpu.infer import server as server_lib
+    path = os.path.abspath(os.path.join(
+        os.path.dirname(__file__), '..', '..', 'examples',
+        'tokenizer_8k.json'))
+    with pytest.raises(SystemExit, match='vocab'):
+        server_lib.Tokenizer(path, vocab_limit=256)
+
+
+def test_quantized_init_matches_structure(params):
+    """init_params_quantized must mirror quantize_params(init_params)
+    exactly in tree structure (drift here would break checkpoints and
+    sharding rules silently)."""
+    from skypilot_tpu.ops import quant
+    direct = quant.init_params_quantized(CFG, jax.random.PRNGKey(1))
+    via = quant.quantize_params(
+        llama.init_params(CFG, jax.random.PRNGKey(1)))
+    assert (jax.tree_util.tree_structure(direct) ==
+            jax.tree_util.tree_structure(via))
+    for a, b in zip(jax.tree_util.tree_leaves(direct),
+                    jax.tree_util.tree_leaves(via)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+    assert quant.is_quantized(direct)
+    assert not quant.is_quantized(params)
