@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import functools
 import itertools
 import threading
 import time
@@ -89,13 +88,39 @@ class Request:
 
 
 def tp_mesh(tp: int) -> 'jax.sharding.Mesh':
-    """The engine's tensor-parallel mesh over the first `tp` local
-    devices ((tp, fsdp=1) so the training param rules apply directly)."""
+    """The engine's tensor-parallel mesh ((tp, fsdp=1) so the training
+    param rules apply directly).
+
+    Single-process: the first `tp` local devices. Multi-process
+    (multi-host replica): `tp` devices striped EVENLY across processes —
+    every process must own part of the mesh, or the non-participating
+    hosts execute programs whose outputs they cannot address (and the
+    participating host does all the work)."""
     from jax.sharding import Mesh
     devs = jax.devices()
     if len(devs) < tp:
         raise ValueError(f'tp={tp} but only {len(devs)} devices')
-    return Mesh(np.array(devs[:tp]).reshape(tp, 1), ('tp', 'fsdp'))
+    nproc = jax.process_count()
+    if nproc > 1:
+        if tp % nproc:
+            raise ValueError(
+                f'multi-host replica: tp={tp} must be a multiple of '
+                f'the process count ({nproc}) so every host owns an '
+                f'equal part of the mesh')
+        per = tp // nproc
+        by_proc: dict = {}
+        for d in devs:
+            by_proc.setdefault(d.process_index, []).append(d)
+        short = [p for p, ds in by_proc.items() if len(ds) < per]
+        if short:
+            raise ValueError(
+                f'tp={tp} needs {per} devices per process; processes '
+                f'{short} have fewer')
+        chosen = [d for p in sorted(by_proc)
+                  for d in by_proc[p][:per]]
+    else:
+        chosen = devs[:tp]
+    return Mesh(np.array(chosen).reshape(tp, 1), ('tp', 'fsdp'))
 
 
 def init_params_sharded(config: llama.LlamaConfig, tp: int,
@@ -150,6 +175,8 @@ class InferenceEngine:
             config.n_kv_heads, config.head_dim,
             dtype=jnp.dtype(self.ecfg.cache_dtype))
         self.mesh = None
+        self._rep_sharding = None
+        self._cache_sharding = None
         if self.ecfg.tp > 1:
             self._shard_tp()
         self._key = jax.random.PRNGKey(seed)
@@ -177,34 +204,44 @@ class InferenceEngine:
         # are baked into the lowered program as constants — for a 1B+
         # model that is gigabytes of constants, a pathological compile,
         # and a second copy of the weights in the executable.
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _jit(fn, *, donate=(), out=None):
+            kw = {}
+            if donate:
+                kw['donate_argnums'] = donate
+            if out is not None and self.mesh is not None:
+                kw['out_shardings'] = out
+            return jax.jit(fn, **kw)
+
         def _prefill_chunk(kv_cache, params, slot, tokens, offset,
                            true_len):
             # One compiled program per chunk bucket (tokens shape).
             return model_lib.prefill_chunk(config, params, kv_cache,
                                            slot, tokens, offset,
                                            true_len)
-        self._prefill_chunk = _prefill_chunk
+        self._prefill_chunk = _jit(
+            _prefill_chunk, donate=(0,),
+            out=(self._cache_sharding, self._rep_sharding))
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
         def _decode(kv_cache, params, tokens, key, temps, active):
             logits, new_cache = model_lib.decode_step(
                 config, params, kv_cache, tokens, active)
             toks = sampling_lib.sample(logits, key, temps,
                                        top_k=self.ecfg.top_k)
             return toks, new_cache
-        self._decode = _decode
+        self._decode = _jit(
+            _decode, donate=(0,),
+            out=(self._rep_sharding, self._cache_sharding))
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
         def _free(kv_cache, slot):
             return cache_lib.free_slot(kv_cache, slot)
-        self._free = _free
+        self._free = _jit(_free, donate=(0,),
+                          out=self._cache_sharding)
 
-        @jax.jit
         def _sample_first(logits, key, temp):
             return sampling_lib.sample(logits[None], key, temp[None],
                                        top_k=self.ecfg.top_k)[0]
-        self._sample_first = _sample_first
+        self._sample_first = _jit(_sample_first,
+                                  out=self._rep_sharding)
 
     def _shard_tp(self) -> None:
         """Distribute params + KV cache over a `tp` mesh axis.
@@ -233,11 +270,18 @@ class InferenceEngine:
         self.params = sharding_lib.shard_pytree(
             self.params, sharding_lib.param_shardings(mesh, self.params))
         kv_spec = NamedSharding(mesh, P(None, None, None, 'tp', None))
+        rep = NamedSharding(mesh, P())
         self.cache = cache_lib.KVCache(
             k=jax.device_put(self.cache.k, kv_spec),
             v=jax.device_put(self.cache.v, kv_spec),
-            lengths=jax.device_put(self.cache.lengths,
-                                   NamedSharding(mesh, P())))
+            lengths=jax.device_put(self.cache.lengths, rep))
+        # Host-consumed outputs (sampled tokens, logits) must be FULLY
+        # REPLICATED: when the tp axis spans processes (multi-host
+        # replica), np.asarray on a non-replicated global array raises
+        # 'spans non-addressable devices'. The cache keeps its sharding.
+        self._rep_sharding = rep
+        self._cache_sharding = cache_lib.KVCache(k=kv_spec, v=kv_spec,
+                                                 lengths=rep)
 
     # ---- submission ------------------------------------------------------
     def submit(self, prompt_tokens: Sequence[int],

@@ -642,3 +642,39 @@ def test_overprovision_with_queue_scaler_steps_correctly():
     d1 = scaler.evaluate(3, now=t0 + 4)
     d2 = scaler.evaluate(3, now=t0 + 8)
     assert d1.target_num_replicas == d2.target_num_replicas == 3
+
+
+def test_llm_multihost_replica_e2e():
+    """Round-4: a serve replica that IS a multi-host slice. The local
+    fake v5p-16 gang fans the server command to BOTH hosts with the
+    jax.distributed env injected; they form a real 2-process CPU group
+    (infer/multihost.py lockstep driver), host 0 binds
+    $SKYPILOT_SERVE_PORT, and the replica serves through it."""
+    import json
+    import urllib.request as ur
+    task = sky.Task(
+        'llm-mh',
+        # tp=2 spans the two hosts' process group (tiny model:
+        # n_kv_heads=2 bounds tp).
+        run=('exec python3 -m skypilot_tpu.infer.server '
+             '--port $SKYPILOT_SERVE_PORT --model tiny --slots 2 '
+             '--max-seq-len 64 --tp 2'),
+        resources=sky.Resources(cloud='local', accelerators='v5p-16'),
+        service={'readiness_probe': {'path': '/health',
+                                     'initial_delay_seconds': 180},
+                 'replicas': 1})
+    serve.up(task, _spawn=False)
+    ctl = controller_lib.ServeController('llm-mh')
+    try:
+        _tick_until(ctl, lambda: _num_ready('llm-mh') >= 1,
+                    timeout=420)
+        [url] = serve_state.ready_replica_urls('llm-mh')
+        body = json.dumps({'tokens': [5, 17, 101, 7],
+                           'max_new_tokens': 4}).encode()
+        req = ur.Request(url + '/generate', data=body,
+                         headers={'Content-Type': 'application/json'})
+        with ur.urlopen(req, timeout=60) as resp:
+            out = json.loads(resp.read())
+        assert len(out['tokens']) == 4
+    finally:
+        serve.down('llm-mh')
