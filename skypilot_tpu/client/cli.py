@@ -440,6 +440,7 @@ def _serve_engine():
             update = staticmethod(sdk.serve_update)
             down = staticmethod(lambda name: sdk.serve_down(name))
             status = staticmethod(sdk.serve_status)
+            restart_replica = staticmethod(sdk.serve_restart_replica)
         return _SdkServe
     from skypilot_tpu import serve as serve_lib
     return serve_lib
@@ -483,6 +484,21 @@ def serve_down(service_name: str, yes: bool) -> None:
         click.confirm(f'Tear down service {service_name}?', abort=True)
     _serve_engine().down(service_name)
     click.echo(f'Service {service_name} torn down.')
+
+
+@serve.command('restart-replica')
+@click.argument('service_name')
+@click.argument('replica_id', type=int)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_restart_replica(service_name: str, replica_id: int,
+                          yes: bool) -> None:
+    """Replace one replica: terminate it; the autoscaler launches a
+    substitute to hold the target count."""
+    if not yes:
+        click.confirm(f'Restart replica {replica_id} of '
+                      f'{service_name}?', abort=True)
+    _serve_engine().restart_replica(service_name, replica_id)
+    click.echo(f'Replica {replica_id} flagged for replacement.')
 
 
 @serve.command('status')
@@ -906,6 +922,102 @@ def volumes_delete(names: tuple, yes: bool) -> None:
         from skypilot_tpu import volumes as volumes_lib
         volumes_lib.volume_delete(list(names))
     click.echo('Deleted.')
+
+
+@cli.group()
+def recipe() -> None:
+    """Recipe hub: shareable, validated task templates
+    (reference sky/recipes)."""
+
+
+@recipe.command('add')
+@click.argument('name')
+@click.argument('task_yaml')
+@click.option('--description', '-d', default='')
+def recipe_add(name: str, task_yaml: str, description: str) -> None:
+    """Validate + store TASK_YAML as recipe NAME."""
+    with open(task_yaml, encoding='utf-8') as f:
+        yaml_str = f.read()
+    if _remote():
+        from skypilot_tpu.client import sdk
+        sdk.call('recipes.add', {'name': name, 'yaml': yaml_str,
+                                 'description': description})
+    else:
+        from skypilot_tpu import recipes as recipes_lib
+        recipes_lib.add(name, yaml_str, description=description)
+    click.echo(f'Recipe {name!r} saved.')
+
+
+@recipe.command('ls')
+def recipe_ls() -> None:
+    if _remote():
+        from skypilot_tpu.client import sdk
+        rows = sdk.call('recipes.list')
+    else:
+        from skypilot_tpu import recipes as recipes_lib
+        rows = recipes_lib.list_recipes()
+    fmt = '{:<24} {:<4} {:<16} {}'
+    click.echo(fmt.format('NAME', 'VER', 'BY', 'DESCRIPTION'))
+    for r in rows:
+        click.echo(fmt.format(r['name'], 'v' + str(r['version']),
+                              (r.get('created_by') or '-')[:15],
+                              r.get('description') or '-'))
+
+
+@recipe.command('show')
+@click.argument('name')
+def recipe_show(name: str) -> None:
+    """Print a recipe's YAML."""
+    if _remote():
+        from skypilot_tpu.client import sdk
+        rec = sdk.call('recipes.get', {'name': name})
+    else:
+        from skypilot_tpu import recipes as recipes_lib
+        rec = recipes_lib.get(name)
+    click.echo(rec['yaml'])
+
+
+@recipe.command('rm')
+@click.argument('name')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def recipe_rm(name: str, yes: bool) -> None:
+    if not yes:
+        click.confirm(f'Delete recipe {name}?', abort=True)
+    if _remote():
+        from skypilot_tpu.client import sdk
+        sdk.call('recipes.delete', {'name': name})
+    else:
+        from skypilot_tpu import recipes as recipes_lib
+        recipes_lib.delete(name)
+    click.echo(f'Recipe {name!r} deleted.')
+
+
+@recipe.command('launch')
+@click.argument('name')
+@click.option('--cluster', '-c', default=None)
+@click.option('--env', multiple=True, help='KEY=VALUE env override.')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def recipe_launch(name: str, cluster: Optional[str], env: tuple,
+                  yes: bool) -> None:
+    """Launch a stored recipe (single-task recipes)."""
+    bad = [e for e in env if '=' not in e]
+    if bad:
+        raise click.UsageError(
+            f'--env must be KEY=VALUE, got {bad[0]!r}')
+    envs = dict(e.split('=', 1) for e in env)
+    if not yes:
+        click.confirm(f'Launch recipe {name}?', abort=True)
+    if _remote():
+        from skypilot_tpu.client import sdk
+        out = sdk.call('recipes.launch', {'name': name,
+                                          'cluster_name': cluster,
+                                          'env_overrides': envs})
+        click.echo(f'Launched: {out}')
+    else:
+        from skypilot_tpu import recipes as recipes_lib
+        job_id, info = recipes_lib.launch(name, cluster,
+                                          env_overrides=envs)
+        click.echo(f'Cluster: {info.cluster_name}  job: {job_id}')
 
 
 def main() -> None:

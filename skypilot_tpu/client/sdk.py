@@ -418,3 +418,9 @@ def serve_down(service_name: str) -> None:
 def serve_status(service_name: Optional[str] = None
                  ) -> List[Dict[str, Any]]:
     return get(_post('serve.status', {'service_name': service_name}))
+
+
+def serve_restart_replica(service_name: str, replica_id: int) -> None:
+    get(_post('serve.restart_replica',
+              {'service_name': service_name,
+               'replica_id': replica_id}))

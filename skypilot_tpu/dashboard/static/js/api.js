@@ -1,0 +1,58 @@
+// Shared fetch/session layer: bearer-token auth, the SDK's
+// RequestId-poll protocol, and health/identity probes.
+'use strict';
+
+export function authHeaders() {
+  const t = localStorage.getItem('sky_tpu_token');
+  return t ? {'Authorization': 'Bearer ' + t} : {};
+}
+
+export async function afetch(url, opts) {
+  opts = opts || {};
+  opts.headers = Object.assign({}, opts.headers, authHeaders());
+  const r = await fetch(url, opts);
+  if (r.status === 401)
+    throw new Error('401 unauthorized — paste an API token (top right)');
+  if (r.status === 403) {
+    let detail = 'permission denied';
+    try { detail = (await r.json()).error || detail; } catch (e) {}
+    throw new Error('403 forbidden: ' + detail);
+  }
+  return r;
+}
+
+// The SDK protocol: POST an op, poll /api/get/<rid> until terminal.
+export async function callOp(op, payload) {
+  const r = await afetch('/' + op, {
+    method: 'POST',
+    headers: {'Content-Type': 'application/json'},
+    body: JSON.stringify(payload || {}),
+  });
+  if (!r.ok) {
+    let detail = '';
+    try { detail = (await r.json()).error || ''; } catch (e) {}
+    throw new Error(op + ': ' + (detail || 'HTTP ' + r.status));
+  }
+  const body = await r.json();
+  if ('result' in body) return body.result;
+  const rid = body.request_id;
+  for (let i = 0; i < 300; i++) {
+    const g = await (await afetch('/api/get/' + rid)).json();
+    if (g.status === 'SUCCEEDED') return g.result;
+    if (g.status === 'FAILED' || g.status === 'CANCELLED')
+      throw new Error(op + ': ' + (g.error || g.status));
+    await new Promise(res => setTimeout(res, 400));
+  }
+  throw new Error(op + ': timed out');
+}
+
+export async function fetchHealth() {
+  const h = await (await fetch('/api/health')).json();
+  return 'v' + h.version + ' · api v' + h.api_version + ' · ' + h.status;
+}
+
+export async function fetchWhoami() {
+  const w = await (await afetch('/api/whoami')).json();
+  const who = w.user ? (w.user.name || w.user.id) : ('(' + w.auth + ')');
+  return '· ' + who + ' [' + w.role + ']';
+}

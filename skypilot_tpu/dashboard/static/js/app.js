@@ -1,0 +1,128 @@
+// Router + refresh loop. Each tab renders through its view module;
+// inline onclick handlers resolve through the window.* globals
+// registered here (the markup is server-rendered strings, not JSX).
+'use strict';
+import {callOp, fetchHealth, fetchWhoami} from './api.js';
+import {stopLogStream} from './logs.js';
+import {navigate, onRender, S} from './state.js';
+import * as clusters from './views/clusters.js';
+import * as jobs from './views/jobs.js';
+import * as misc from './views/misc.js';
+import * as serve from './views/serve.js';
+
+const REFRESH_S = 10;
+let countdown = REFRESH_S;
+
+const views = {
+  clusters: clusters.render,
+  jobs: jobs.render,
+  serve: serve.render,
+  requests: misc.requests,
+  infra: misc.infra,
+  volumes: misc.volumes,
+  users: misc.users,
+  workspaces: misc.workspaces,
+};
+
+async function refresh() {
+  const content = document.getElementById('content');
+  const errBox = document.getElementById('error');
+  const epoch = S.epoch;
+  try {
+    const html = await views[S.activeTab]();
+    if (epoch !== S.epoch) return;   // user navigated away meanwhile
+    errBox.style.display = 'none';
+    content.innerHTML = html;
+  } catch (e) {
+    if (epoch !== S.epoch) return;
+    errBox.textContent = String(e);
+    errBox.style.display = 'block';
+  }
+}
+onRender(refresh);
+
+async function health() {
+  try {
+    document.getElementById('server-info').textContent =
+      await fetchHealth();
+  } catch (e) {
+    document.getElementById('server-info').textContent = 'unreachable';
+  }
+  try {
+    document.getElementById('whoami').textContent = await fetchWhoami();
+  } catch (e) {
+    document.getElementById('whoami').textContent = '';
+  }
+}
+
+// Mutating actions: confirm, run, surface errors, refresh.
+async function doAction(label, op, payload) {
+  if (!confirm(label + ' — are you sure?')) return;
+  const errBox = document.getElementById('error');
+  try {
+    await callOp(op, payload);
+    errBox.style.display = 'none';
+  } catch (e) {
+    errBox.textContent = String(e);
+    errBox.style.display = 'block';
+  }
+  refresh();
+}
+
+function accFilter(q) {
+  // Client-side catalog filter: hide rows not matching the query.
+  q = q.toLowerCase();
+  document.querySelectorAll('#accrows tbody tr').forEach(tr => {
+    tr.style.display =
+      tr.textContent.toLowerCase().includes(q) ? '' : 'none';
+  });
+}
+
+// Globals referenced by server-rendered onclick attributes.
+window.doAction = doAction;
+window.accFilter = accFilter;
+window.stopLogStream = stopLogStream;
+window.openCluster = name => navigate({cluster: name});
+window.openService = name => navigate({kind: 'service', name: name});
+window.openLogs = (cluster, job, rank) =>
+  navigate({cluster: cluster, job: job, rank: rank || 0});
+window.closeDetail = () => { stopLogStream(); navigate(null); };
+
+const tokenInput = document.getElementById('token');
+tokenInput.value = localStorage.getItem('sky_tpu_token') || '';
+tokenInput.addEventListener('change', () => {
+  if (tokenInput.value) {
+    localStorage.setItem('sky_tpu_token', tokenInput.value);
+  } else {
+    localStorage.removeItem('sky_tpu_token');
+  }
+  refresh(); health();
+});
+
+document.getElementById('tabs').addEventListener('click', e => {
+  const b = e.target.closest('button');
+  if (!b) return;
+  document.querySelectorAll('nav button').forEach(
+    x => x.classList.toggle('active', x === b));
+  S.activeTab = b.dataset.tab;
+  stopLogStream();
+  countdown = REFRESH_S;
+  document.getElementById('content').innerHTML =
+    '<div class="empty">Loading…</div>';
+  navigate(null);
+});
+
+setInterval(() => {
+  countdown -= 1;
+  if (countdown <= 0) {
+    countdown = REFRESH_S;
+    // A live log stream IS the refresh; re-rendering would sever it.
+    if (!(S.detail && S.detail.job !== undefined)) {
+      refresh(); health();
+    }
+  }
+  document.getElementById('tick').textContent = countdown;
+}, 1000);
+
+health();
+refresh();

@@ -1,0 +1,37 @@
+// Live log streaming: read /logs/<cluster>/<job>?follow=1 chunk by
+// chunk into the <pre>, auto-scrolling while the user stays at bottom.
+'use strict';
+import {afetch} from './api.js';
+
+let logAbort = null;   // AbortController of the active log stream
+
+export function stopLogStream() {
+  if (logAbort) { logAbort.abort(); logAbort = null; }
+}
+
+export async function streamLogs(cluster, job, rank) {
+  stopLogStream();
+  const ctl = new AbortController();
+  logAbort = ctl;
+  const pre = document.getElementById('logbox');
+  if (!pre) return;
+  try {
+    const r = await afetch('/logs/' + encodeURIComponent(cluster) + '/' +
+                           job + '?follow=1&rank=' + rank,
+                           {signal: ctl.signal});
+    const reader = r.body.getReader();
+    const dec = new TextDecoder();
+    while (true) {
+      const {done, value} = await reader.read();
+      if (done) break;
+      const atBottom =
+        pre.scrollTop + pre.clientHeight >= pre.scrollHeight - 8;
+      pre.textContent += dec.decode(value, {stream: true});
+      if (atBottom) pre.scrollTop = pre.scrollHeight;
+    }
+    pre.textContent += '\n── end of stream (job finished) ──';
+  } catch (e) {
+    if (!ctl.signal.aborted)
+      pre.textContent += '\n── stream error: ' + e + ' ──';
+  }
+}

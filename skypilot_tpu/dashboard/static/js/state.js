@@ -1,0 +1,24 @@
+// Navigation state shared by the router and every view. Views read S
+// and call navigate()/closeDetail(); app.js registers the render
+// callback (avoids a circular import of the router from the views).
+'use strict';
+
+export const S = {
+  activeTab: 'clusters',
+  // Drill-down state: {cluster} shows one cluster's queue;
+  // {cluster, job, rank} streams that job's logs;
+  // {kind: 'service', name} shows one service's replicas.
+  detail: null,
+  // Bumped on every navigation; an in-flight refresh whose epoch is
+  // stale must NOT write its result over a newer view.
+  epoch: 0,
+};
+
+let renderCb = () => {};
+export function onRender(fn) { renderCb = fn; }
+
+export function navigate(detail) {
+  S.detail = detail;
+  S.epoch += 1;
+  renderCb();
+}

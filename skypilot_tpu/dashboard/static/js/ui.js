@@ -1,0 +1,53 @@
+// Rendering primitives shared by every view: escaping, status badges,
+// tables, stat tiles, age formatting.
+'use strict';
+
+export const esc = s => String(s ?? '').replace(/[&<>"']/g,
+  c => ({'&': '&amp;', '<': '&lt;', '>': '&gt;', '"': '&quot;',
+         "'": '&#39;'}[c]));
+
+// For use inside single-quoted JS strings in onclick attributes.
+export const jsq = s => String(s ?? '').replace(/[\\']/g, c => '\\' + c)
+  .replace(/[&<>"]/g, c => ({'&': '&amp;', '<': '&lt;', '>': '&gt;',
+                             '"': '&quot;'}[c]));
+
+// Status → {class, label}; label always shown (never color alone).
+export function badge(status) {
+  const s = String(status || '').toUpperCase();
+  const cls =
+    ['UP', 'SUCCEEDED', 'RUNNING', 'READY', 'ACTIVE', 'IN_USE'].includes(s)
+      ? 'b-good' :
+    ['INIT', 'PENDING', 'STARTING', 'RECOVERING', 'PROVISIONING',
+     'SUBMITTED', 'CANCELLED', 'STOPPED', 'SHUTTING_DOWN', 'NO_REPLICAS',
+     'SETTING_UP', 'AVAILABLE', 'PRIVATE', 'SHARED'].includes(s)
+      ? 'b-warn' :
+    ['FAILED', 'FAILED_SETUP', 'FAILED_PRECHECKS', 'FAILED_NO_RESOURCE',
+     'FAILED_CONTROLLER', 'NOT_READY', 'UNHEALTHY'].includes(s)
+      ? 'b-serious' : 'b-neutral';
+  return '<span class="badge ' + cls + '">' + esc(s || '?') + '</span>';
+}
+
+export function table(headers, rows) {
+  if (!rows.length) return '<div class="empty">Nothing here yet.</div>';
+  return '<table><thead><tr>' +
+    headers.map(h => '<th>' + esc(h) + '</th>').join('') +
+    '</tr></thead><tbody>' +
+    rows.map(r => '<tr>' + r.map(c => '<td>' + c + '</td>').join('') +
+             '</tr>').join('') +
+    '</tbody></table>';
+}
+
+export function tiles(items) {
+  document.getElementById('tiles').innerHTML = items.map(
+    ([n, l]) => '<div class="tile"><div class="n">' + esc(n) +
+                '</div><div class="l">' + esc(l) + '</div></div>'
+  ).join('');
+}
+
+export const fmtAge = ts => {
+  if (!ts) return '-';
+  const s = Math.max(0, Date.now() / 1000 - ts);
+  if (s < 3600) return Math.floor(s / 60) + 'm';
+  if (s < 86400) return Math.floor(s / 3600) + 'h';
+  return Math.floor(s / 86400) + 'd';
+};

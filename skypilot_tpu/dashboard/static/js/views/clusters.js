@@ -1,0 +1,77 @@
+// Clusters tab: list → per-cluster job queue → live job logs.
+'use strict';
+import {callOp} from '../api.js';
+import {streamLogs} from '../logs.js';
+import {S} from '../state.js';
+import {badge, esc, fmtAge, jsq, table, tiles} from '../ui.js';
+
+export async function render() {
+  if (S.detail && S.detail.job !== undefined) return renderLogs();
+  if (S.detail) return renderCluster();
+  const recs = await callOp('status', {all_workspaces: true});
+  tiles([[recs.filter(r => r.status === 'UP').length, 'clusters up'],
+         [recs.length, 'total clusters']]);
+  return table(
+    ['NAME', 'STATUS', 'RESOURCES', 'HOSTS', 'WORKSPACE', 'USER',
+     'AGE', 'AUTOSTOP', 'ACTIONS'],
+    recs.map(r => {
+      const res = r.resources || {};
+      const acc = res.accelerators || res.instance_type || '-';
+      const slices = res.num_slices > 1 ? ' ×' + res.num_slices : '';
+      const hosts = ((r.cluster_info || {}).hosts || []).length || 1;
+      const astop = r.autostop_minutes >= 0
+        ? r.autostop_minutes + 'm' + (r.autostop_down ? ' ↓' : '') : '-';
+      const q = jsq(r.name);
+      return ['<a class="rowlink" onclick="openCluster(\'' + q +
+                '\')">' + esc(r.name) + '</a>', badge(r.status),
+              '<span class="mono">' + esc((res.cloud || '?') + ':' + acc)
+                + slices + '</span>',
+              hosts, esc(r.workspace || 'default'), esc(r.user || '-'),
+              fmtAge(r.launched_at), esc(astop),
+              '<button class="act" onclick="doAction(\'Stop ' + q +
+                '\', \'stop\', {cluster_name: \'' + q + '\'})">stop' +
+                '</button>' +
+              '<button class="act danger" onclick="doAction(\'Down ' +
+                q + '\', \'down\', {cluster_name: \'' + q +
+                '\'})">down</button>'];
+    }));
+}
+
+async function renderCluster() {
+  const name = S.detail.cluster;
+  const q = jsq(name);
+  let jobs = [];
+  try { jobs = await callOp('queue', {cluster_name: name}); }
+  catch (e) {
+    // Auth problems must reach the error banner — an empty job list
+    // would read as "cluster idle". Other errors (stopped/gone
+    // cluster) legitimately render empty.
+    if (/401|403/.test(String(e))) throw e;
+  }
+  tiles([[jobs.filter(j => j.status === 'RUNNING').length, 'running'],
+         [jobs.length, 'jobs on ' + name]]);
+  return '<p class="crumb"><a class="rowlink" ' +
+    'onclick="closeDetail()">← clusters</a> / ' + esc(name) + '</p>' +
+    table(['JOB', 'NAME', 'STATUS', 'SUBMITTED', 'ACTIONS'],
+      jobs.map(j => [j.job_id, esc(j.name || '-'), badge(j.status),
+        fmtAge(j.submitted_at),
+        '<button class="act" onclick="openLogs(\'' + q + '\', ' +
+          j.job_id + ')">logs</button>' +
+        '<button class="act danger" onclick="doAction(' +
+          '\'Cancel job ' + j.job_id + '\', \'cancel\', ' +
+          '{cluster_name: \'' + q + '\', job_id: ' + j.job_id +
+          '})">cancel</button>']));
+}
+
+async function renderLogs() {
+  // Render the shell; the stream fills it after insertion.
+  setTimeout(() => streamLogs(S.detail.cluster, S.detail.job,
+                              S.detail.rank), 0);
+  const q = jsq(S.detail.cluster);
+  return '<p class="crumb"><a class="rowlink" ' +
+    'onclick="closeDetail()">← clusters</a> / <a class="rowlink" ' +
+    'onclick="stopLogStream(); openCluster(\'' + q + '\')">' +
+    esc(S.detail.cluster) + '</a> / job ' + S.detail.job +
+    ' <span class="muted">(rank ' + S.detail.rank + ', live)</span></p>' +
+    '<pre class="logs" id="logbox"></pre>';
+}

@@ -1,0 +1,33 @@
+// Managed jobs tab, with per-stage pipeline sub-rows.
+'use strict';
+import {callOp} from '../api.js';
+import {badge, esc, fmtAge, table, tiles} from '../ui.js';
+
+export async function render() {
+  let rows = [];
+  try { rows = await callOp('jobs.queue'); }
+  catch (e) { /* jobs controller not running yet */ }
+  tiles([[rows.filter(j => j.status === 'RUNNING').length, 'running'],
+         [rows.length, 'total managed jobs']]);
+  return table(
+    ['ID', 'NAME', 'STATUS', 'CLUSTER', 'RECOVERIES', 'AGE',
+     'ACTIONS'],
+    rows.flatMap(j => {
+      const main = [j.job_id, esc(j.name || '-'), badge(j.status),
+                    esc(j.cluster_name || '-'), j.recovery_count ?? 0,
+                    fmtAge(j.submitted_at),
+                    '<button class="act danger" onclick="doAction(' +
+                    '\'Cancel managed job ' + j.job_id + '\', ' +
+                    '\'jobs.cancel\', {job_id: ' + j.job_id +
+                    '})">cancel</button>'];
+      // Pipeline stage breakdown (one sub-row per stage).
+      const stages = (j.tasks || []).map(t => [
+        '<span class="muted">&nbsp;&nbsp;&#8627; ' + t.task_id +
+        '</span>',
+        '<span class="muted">' + esc(t.name || '-') + '</span>',
+        badge(t.status), esc(t.cluster_name || '-'),
+        t.recovery_count ?? 0,
+        t.started_at ? fmtAge(t.started_at) : '-', '']);
+      return [main].concat(stages);
+    }));
+}

@@ -1,0 +1,108 @@
+// Smaller tabs: requests, infra (clouds + catalog), volumes, users,
+// workspaces. One module — each is a single table over one op.
+'use strict';
+import {afetch, callOp} from '../api.js';
+import {badge, esc, fmtAge, jsq, table, tiles} from '../ui.js';
+
+export async function requests() {
+  const reqs = (await (await afetch('/api/requests')).json()).requests;
+  tiles([[reqs.filter(r => r.status === 'RUNNING').length, 'running'],
+         [reqs.length, 'recent requests']]);
+  return table(
+    ['ID', 'OP', 'STATUS', 'AGE', 'ERROR'],
+    reqs.slice(0, 100).map(
+      r => ['<span class="mono">' + esc(r.request_id.slice(0, 8)) +
+            '</span>', esc(r.name), badge(r.status),
+            fmtAge(r.created_at),
+            '<span class="muted">' + esc((r.error || '').slice(0, 80))
+            + '</span>']));
+}
+
+export async function infra() {
+  const checks = await callOp('check', {});
+  const clouds = Object.entries(checks);
+  tiles([[clouds.filter(([, ok]) => ok).length, 'clouds enabled']]);
+  let html = '<h2>Clouds</h2>' + table(
+    ['CLOUD', 'STATUS'],
+    clouds.map(([c, ok]) => [esc(c),
+                             badge(ok ? 'READY' : 'NOT_READY')]));
+  try {
+    const accs = await callOp('accelerators', {});
+    html += '<h2>Accelerators</h2>' +
+      '<input id="accfilter" placeholder="filter (e.g. v5e, ' +
+      'us-central1)" style="background:var(--bg);border:1px solid ' +
+      'var(--border);color:var(--ink);border-radius:6px;' +
+      'padding:3px 8px;font-size:12px;margin-bottom:6px" ' +
+      'oninput="accFilter(this.value)">' +
+      '<div id="accrows">' + table(
+      ['ACCELERATOR', 'CLOUD', 'REGION', 'HOSTS', 'CHIPS', '$/HR',
+       'SPOT $/HR'],
+      Object.entries(accs).flatMap(([name, offers]) =>
+        offers.map(o => [esc(name), esc(o.cloud),
+                         esc(o.region || '-'),
+                         o.num_hosts ?? 1, o.chips ?? '-',
+                         (o.price ?? 0).toFixed(2),
+                         (o.spot_price ?? 0).toFixed(2)]))) +
+      '</div>';
+  } catch (e) { /* accelerators op unavailable */ }
+  return html;
+}
+
+export async function volumes() {
+  let vols = [];
+  try { vols = await callOp('volumes.list'); }
+  catch (e) { /* volumes op unavailable */ }
+  tiles([[vols.length, 'volumes'],
+         [vols.filter(v => v.status === 'IN_USE').length, 'in use']]);
+  return table(
+    ['NAME', 'TYPE', 'CLOUD', 'ZONE', 'SIZE', 'STATUS',
+     'ATTACHED TO'],
+    vols.map(v => [esc(v.name), esc(v.type || '-'),
+                   esc(v.cloud || '-'), esc(v.zone || '-'),
+                   (v.size_gb ? v.size_gb + ' GB' : '-'),
+                   badge(v.status), esc(v.attached_to || '-')]));
+}
+
+export async function users() {
+  const rows = await callOp('users.list');
+  tiles([[rows.length, 'users'],
+         [rows.filter(u => u.role === 'admin').length, 'admins']]);
+  const roles = ['admin', 'user'];   // rbac.get_supported_roles()
+  return table(
+    ['ID', 'NAME', 'ROLE', 'SET ROLE'],
+    rows.map(u => ['<span class="mono">' + esc(u.id) + '</span>',
+                   esc(u.name), badge(u.role),
+                   '<select class="role" onchange="if (this.value) ' +
+                   'doAction(' +
+                   '\'Change ' + jsq(u.name) + ' to \' + this.value, ' +
+                   '\'users.role\', {user_id: \'' + jsq(u.id) +
+                   '\', role: this.value})">' +
+                   '<option value="">change…</option>' +
+                   roles.map(r => '<option value="' + r + '">' + r +
+                             '</option>').join('') + '</select>']));
+}
+
+export async function workspaces() {
+  const [ws, recs] = await Promise.all([
+    callOp('workspaces.list'),
+    callOp('status', {all_workspaces: true}).catch(() => []),
+  ]);
+  const counts = {};
+  recs.forEach(r => {
+    const w = r.workspace || 'default';
+    counts[w] = (counts[w] || 0) + 1;
+  });
+  const entries = Object.entries(ws);
+  tiles([[entries.length, 'workspaces'],
+         [entries.filter(([, c]) => (c || {}).private).length,
+          'private']]);
+  return table(
+    ['NAME', 'VISIBILITY', 'ALLOWED USERS', 'CLUSTERS'],
+    entries.map(([name, cfg]) => {
+      cfg = cfg || {};
+      return [esc(name),
+              badge(cfg.private ? 'PRIVATE' : 'SHARED'),
+              esc((cfg.allowed_users || []).join(', ') || '-'),
+              counts[name] || 0];
+    }));
+}

@@ -1,0 +1,63 @@
+// Services tab: list → per-service replica drill-down with actions.
+'use strict';
+import {callOp} from '../api.js';
+import {S} from '../state.js';
+import {badge, esc, fmtAge, jsq, table, tiles} from '../ui.js';
+
+export async function render() {
+  let svcs = [];
+  try { svcs = await callOp('serve.status', {}); }
+  catch (e) { /* serve not running */ }
+  if (S.detail && S.detail.kind === 'service') {
+    return renderService(svcs);
+  }
+  tiles([[svcs.filter(s => (s.status || '') === 'READY').length,
+          'services ready'], [svcs.length, 'total services']]);
+  return table(
+    ['SERVICE', 'STATUS', 'REPLICAS', 'ENDPOINT', 'ACTIONS'],
+    svcs.map(s => ['<a href="#" onclick="openService(\'' +
+                   jsq(s.name) + '\');return false">' + esc(s.name) +
+                   '</a>', badge(s.status),
+                   (s.ready_replicas ?? '?') + '/' +
+                   (s.total_replicas ?? '?'),
+                   '<span class="mono">' + esc(s.endpoint || '-') +
+                   '</span>',
+                   '<button class="act danger" onclick="doAction(' +
+                   '\'Tear down service ' + jsq(s.name) + '\', ' +
+                   '\'serve.down\', {service_name: \'' + jsq(s.name) +
+                   '\'})">down</button>']));
+}
+
+function renderService(svcs) {
+  const s = svcs.find(x => x.name === S.detail.name);
+  if (!s) {
+    window.closeDetail();
+    return '<div class="empty">gone</div>';
+  }
+  const qn = jsq(s.name);
+  tiles([[s.ready_replicas ?? 0, 'ready'],
+         [(s.replicas || []).length, 'replicas'],
+         ['v' + s.version, 'version']]);
+  return '<p><a href="#" onclick="closeDetail();return false">' +
+    '&larr; services</a> / <b>' + esc(s.name) + '</b> ' +
+    badge(s.status) + ' <span class="mono">' +
+    esc(s.endpoint || '') + '</span>' +
+    (s.failure_reason ? '<p class="err">' + esc(s.failure_reason) +
+     '</p>' : '') + '</p>' +
+    table(['ID', 'STATUS', 'VER', 'CLUSTER', 'ACCEL', 'SPOT',
+           'ZONE', 'URL', 'AGE', 'FAILURE', 'ACTIONS'],
+      (s.replicas || []).map(r => [r.replica_id, badge(r.status),
+        'v' + r.version, esc(r.cluster_name || '-'),
+        esc(r.accelerator || '-'), r.is_spot ? 'spot' : 'od',
+        esc(r.zone || '-'),
+        '<span class="mono">' + esc(r.url || '-') + '</span>',
+        fmtAge(r.launched_at),
+        '<span class="muted">' +
+        esc((r.failure_reason || '').slice(0, 60)) + '</span>',
+        // Per-replica action: flag for replacement; the controller
+        // terminates it and the autoscaler launches a substitute.
+        '<button class="act danger" onclick="doAction(' +
+        '\'Restart replica ' + r.replica_id + ' of ' + qn + '\', ' +
+        '\'serve.restart_replica\', {service_name: \'' + qn +
+        '\', replica_id: ' + r.replica_id + '})">restart</button>']));
+}

@@ -58,7 +58,7 @@ class EngineConfig:
     # active slot's next token. chunks_per_step bounds prefill work per
     # engine step.
     prefill_chunk: int = 256
-    prefill_chunks_per_step: int = 1
+    prefill_chunks_per_step: int = 4
     # int8 weight-only quantization (ops/quant.py): halves weight HBM
     # bytes (8B fits one v5e chip) and speeds the bandwidth-bound decode.
     quantize: bool = False
@@ -188,8 +188,13 @@ class InferenceEngine:
         # flight); a slot decodes only once its prompt is fully cached.
         self._prefilling: Dict[int, int] = {}
         self._rr = 0   # round-robin cursor over prefilling slots
-        # Host mirrors of device state (avoid device reads on the hot path)
-        self._last_token = np.zeros((self.ecfg.n_slots,), np.int32)
+        # Last sampled token per slot lives ON DEVICE: reading it back
+        # per step would add a host sync (decode consumes it directly;
+        # the host sees tokens through the decode output pair).
+        self._last_dev = jnp.zeros((self.ecfg.n_slots,), jnp.int32)
+        if self._rep_sharding is not None:
+            self._last_dev = jax.device_put(self._last_dev,
+                                            self._rep_sharding)
         self._slot_len = np.zeros((self.ecfg.n_slots,), np.int64)
         self._temps = np.zeros((self.ecfg.n_slots,), np.float32)
         self._decode_steps = 0
@@ -213,21 +218,34 @@ class InferenceEngine:
             return jax.jit(fn, **kw)
 
         def _prefill_chunk(kv_cache, params, slot, tokens, offset,
-                           true_len):
+                           true_len, key, temp, last):
             # One compiled program per chunk bucket (tokens shape).
-            return model_lib.prefill_chunk(config, params, kv_cache,
-                                           slot, tokens, offset,
-                                           true_len)
+            # First-token sampling AND the last-token vector update are
+            # FUSED: separate programs would cost extra dispatches (and
+            # a sample sync) per prompt, and on a tunneled device the
+            # round trip (~100ms) dwarfs the compute. The sampled token
+            # is only meaningful on the final chunk; earlier chunks'
+            # updates are overwritten before the slot ever decodes.
+            new_cache, logits = model_lib.prefill_chunk(
+                config, params, kv_cache, slot, tokens, offset,
+                true_len)
+            tok = sampling_lib.sample(logits[None], key, temp[None],
+                                      top_k=self.ecfg.top_k)[0]
+            return new_cache, last.at[slot].set(tok.astype(last.dtype))
         self._prefill_chunk = _jit(
-            _prefill_chunk, donate=(0,),
+            _prefill_chunk, donate=(0, 8),
             out=(self._cache_sharding, self._rep_sharding))
 
         def _decode(kv_cache, params, tokens, key, temps, active):
             logits, new_cache = model_lib.decode_step(
                 config, params, kv_cache, tokens, active)
-            toks = sampling_lib.sample(logits, key, temps,
-                                       top_k=self.ecfg.top_k)
-            return toks, new_cache
+            sampled = sampling_lib.sample(logits, key, temps,
+                                          top_k=self.ecfg.top_k)
+            toks_out = jnp.where(active, sampled, tokens)
+            # [2, slots]: row 0 echoes the inputs (= the first sampled
+            # token of any slot that finished prefill this step), row 1
+            # the new tokens — ONE host read serves both.
+            return jnp.stack([tokens, toks_out]), new_cache
         self._decode = _jit(
             _decode, donate=(0,),
             out=(self._rep_sharding, self._cache_sharding))
@@ -236,12 +254,6 @@ class InferenceEngine:
             return cache_lib.free_slot(kv_cache, slot)
         self._free = _jit(_free, donate=(0,),
                           out=self._cache_sharding)
-
-        def _sample_first(logits, key, temp):
-            return sampling_lib.sample(logits[None], key, temp[None],
-                                       top_k=self.ecfg.top_k)[0]
-        self._sample_first = _jit(_sample_first,
-                                  out=self._rep_sharding)
 
     def _shard_tp(self) -> None:
         """Distribute params + KV cache over a `tp` mesh axis.
@@ -319,9 +331,11 @@ class InferenceEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _do_chunk(self, slot: int) -> None:
-        """Advance one prefilling slot by ONE chunk; on the final chunk
-        sample the first token and hand the slot to the decode phase."""
+    def _do_chunk(self, slot: int) -> bool:
+        """Advance one prefilling slot by ONE chunk — NO host sync
+        (the sampled first token stays on device; the step's single
+        decode read surfaces it). Returns True when the prompt is fully
+        cached (slot joins this step's decode)."""
         req = self._slots[slot]
         off = self._prefilling[slot]
         n = len(req.prompt_tokens)
@@ -330,24 +344,19 @@ class InferenceEngine:
         tl = min(remaining, bucket)
         padded = np.zeros((bucket,), np.int32)
         padded[:tl] = req.prompt_tokens[off:off + tl]
-        self.cache, logits = self._prefill_chunk(
+        self.cache, self._last_dev = self._prefill_chunk(
             self.cache, self.params, jnp.int32(slot),
-            jnp.asarray(padded), jnp.int32(off), jnp.int32(tl))
+            jnp.asarray(padded), jnp.int32(off), jnp.int32(tl),
+            self._next_key(), jnp.float32(req.temperature),
+            self._last_dev)
         off += tl
         if off < n:
             self._prefilling[slot] = off
-            return
+            return False
         del self._prefilling[slot]
-        first = int(self._sample_first(
-            logits, self._next_key(), jnp.float32(req.temperature)))
-        req.first_token_at = time.time()
-        req.output_tokens.append(first)
-        self._ttfts.append(req.first_token_at - req.submitted_at)
-        self._last_token[slot] = first
         self._slot_len[slot] = n
         self._temps[slot] = req.temperature
-        if self._finished(req, slot, first):
-            self._finish(slot, req)
+        return True
 
     def _finished(self, req: Request, slot: int, token: int) -> bool:
         if self.ecfg.eos_id is not None and token == self.ecfg.eos_id:
@@ -384,13 +393,23 @@ class InferenceEngine:
                     self._slots[slot] = req   # reserve before releasing
                     self._prefilling[slot] = 0
         # Chunk phase: bounded prefill work per step so decode latency
-        # of active slots stays flat under prompt bursts.
+        # of active slots stays flat under prompt bursts. Chunks are
+        # async dispatches (no sync), so several per step cost latency
+        # only in device compute.
+        just_prefilled: List[int] = []
         for _ in range(self.ecfg.prefill_chunks_per_step):
             if not self._prefilling:
                 break
             slots = sorted(self._prefilling)
             self._rr = (self._rr + 1) % len(slots)
-            self._do_chunk(slots[self._rr])
+            slot = slots[self._rr]
+            if self._do_chunk(slot):
+                just_prefilled.append(slot)
+        # Decode phase: every fully-prefilled slot — including the ones
+        # that JUST finished (their first token is in _last_dev; they
+        # decode their second token in this same step). The step's ONE
+        # host sync reads the [2, slots] pair: row 0 carries their
+        # first tokens, row 1 everyone's new token.
         decoding = [s for s, r in enumerate(self._slots)
                     if r is not None and s not in self._prefilling]
         if not decoding:
@@ -398,19 +417,32 @@ class InferenceEngine:
         active_mask = np.zeros((self.ecfg.n_slots,), np.bool_)
         active_mask[decoding] = True
         t0 = time.perf_counter()
-        toks, self.cache = self._decode(
-            self.cache, self.params, jnp.asarray(self._last_token),
+        pair, self.cache = self._decode(
+            self.cache, self.params, self._last_dev,
             self._next_key(), jnp.asarray(self._temps),
             jnp.asarray(active_mask))
-        toks_host = np.asarray(toks)
+        self._last_dev = pair[1]
+        pair_host = np.asarray(pair)          # the step's single sync
         self._decode_time += time.perf_counter() - t0
         self._decode_steps += 1
         self._decode_tokens += len(decoding)
+        now = time.time()
+        for slot in just_prefilled:
+            req = self._slots[slot]
+            first = int(pair_host[0, slot])
+            req.first_token_at = now
+            req.output_tokens.append(first)
+            self._ttfts.append(now - req.submitted_at)
+            if self._finished(req, slot, first):
+                # First token already ends the request; the second
+                # token decoded this step is discarded with the slot.
+                self._finish(slot, req)
         for slot in decoding:
             req = self._slots[slot]
-            token = int(toks_host[slot])
+            if req is None or req.done:
+                continue   # freed above (first token was terminal)
+            token = int(pair_host[1, slot])
             req.output_tokens.append(token)
-            self._last_token[slot] = token
             self._slot_len[slot] += 1
             if self._finished(req, slot, token):
                 self._finish(slot, req)

@@ -1,0 +1,14 @@
+"""Recipe hub: shareable, validated task templates.
+
+Counterpart of the reference's recipes subsystem (reference
+sky/recipes/core.py:1 — named task templates with CRUD + deploy),
+redesigned on this framework's primitives: recipes live in the state DB,
+are validated at save time (YAML parses into a Task AND contains no
+local-only paths, so a recipe launched by another user on another
+machine cannot silently depend on files that aren't there), and launch
+through the normal execution path.
+"""
+from skypilot_tpu.recipes.core import (add, delete, get, launch,
+                                       list_recipes, update)
+
+__all__ = ['add', 'delete', 'get', 'launch', 'list_recipes', 'update']

@@ -1,0 +1,157 @@
+"""Recipe CRUD + launch (reference sky/recipes/core.py behavior).
+
+A recipe is a named, versioned task YAML stored in the state DB. The
+save-time contract (mirrors the reference's `_validate_no_local_paths`,
+reference sky/recipes/core.py:23):
+
+- the YAML must parse into a valid Task (or multi-doc pipeline);
+- no local workdir (shareable templates cannot reference a directory on
+  the author's machine);
+- file_mounts sources must be cloud URLs (gs://, s3://, ...), not local
+  paths.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common
+from skypilot_tpu.utils import db as db_util
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS recipes (
+    name TEXT PRIMARY KEY,
+    yaml TEXT NOT NULL,
+    description TEXT,
+    created_by TEXT,
+    created_at REAL,
+    updated_at REAL,
+    version INTEGER DEFAULT 1
+);
+"""
+
+_CLOUD_PREFIXES = ('gs://', 's3://', 'r2://', 'cos://', 'oci://',
+                   'azblob://', 'https://', 'http://', 'volume://')
+
+
+def _db() -> db_util.Db:
+    return db_util.get_db(os.path.join(common.base_dir(), 'recipes.db'),
+                          _SCHEMA)
+
+
+def _validate(yaml_str: str) -> List[str]:
+    """Parse + shareability validation; returns the task names."""
+    from skypilot_tpu.utils import dag_utils
+    docs = [d for d in yaml.safe_load_all(yaml_str) if d]
+    if not docs:
+        raise exceptions.InvalidTaskError('recipe YAML is empty')
+    for doc in docs:
+        if not isinstance(doc, dict):
+            raise exceptions.InvalidTaskError(
+                f'recipe documents must be mappings, got {type(doc)}')
+        workdir = doc.get('workdir')
+        if isinstance(workdir, str):
+            raise exceptions.InvalidTaskError(
+                'recipes are shareable templates: a local workdir '
+                f'path ({workdir!r}) would not exist on other '
+                'machines. Ship code via cloud file_mounts or a '
+                'setup that clones it.')
+        for dst, src in (doc.get('file_mounts') or {}).items():
+            if isinstance(src, str) and not src.startswith(
+                    _CLOUD_PREFIXES):
+                raise exceptions.InvalidTaskError(
+                    f'recipe file_mounts[{dst!r}] = {src!r} is a local '
+                    f'path; recipes may only mount cloud storage '
+                    f'({", ".join(_CLOUD_PREFIXES[:4])}, ...)')
+    # Full Task validation (resources parse, service spec, ...).
+    dag = dag_utils.load_dag_from_yaml_str(yaml_str)
+    return [t.name or '<unnamed>' for t in dag.tasks]
+
+
+def add(name: str, yaml_str: str, *,
+        description: str = '', created_by: Optional[str] = None
+        ) -> Dict[str, Any]:
+    """Validate + store a new recipe. Name must be unused."""
+    if not name or '/' in name:
+        raise exceptions.InvalidTaskError(
+            f'invalid recipe name {name!r}')
+    _validate(yaml_str)
+    from skypilot_tpu.users import core as users_core
+    conn = _db().conn
+    now = time.time()
+    try:
+        conn.execute(
+            'INSERT INTO recipes (name, yaml, description, created_by, '
+            'created_at, updated_at, version) VALUES (?,?,?,?,?,?,1)',
+            (name, yaml_str, description,
+             created_by or users_core.current_user_id(), now, now))
+        conn.commit()
+    except db_util.sqlite3.IntegrityError:
+        raise exceptions.InvalidTaskError(
+            f'recipe {name!r} already exists (use update)') from None
+    return get(name)
+
+
+def update(name: str, yaml_str: str, *,
+           description: Optional[str] = None) -> Dict[str, Any]:
+    """Replace a recipe's YAML (version bumps)."""
+    _validate(yaml_str)
+    conn = _db().conn
+    cur = conn.execute(
+        'UPDATE recipes SET yaml = ?, updated_at = ?, '
+        'version = version + 1, '
+        'description = COALESCE(?, description) WHERE name = ?',
+        (yaml_str, time.time(), description, name))
+    conn.commit()
+    if cur.rowcount == 0:
+        raise exceptions.JobNotFoundError(f'recipe {name!r}')
+    return get(name)
+
+
+def get(name: str) -> Dict[str, Any]:
+    row = _db().conn.execute(
+        'SELECT * FROM recipes WHERE name = ?', (name,)).fetchone()
+    if row is None:
+        raise exceptions.JobNotFoundError(f'recipe {name!r}')
+    return dict(row)
+
+
+def list_recipes() -> List[Dict[str, Any]]:
+    rows = _db().conn.execute(
+        'SELECT name, description, created_by, created_at, updated_at, '
+        'version FROM recipes ORDER BY name').fetchall()
+    return [dict(r) for r in rows]
+
+
+def delete(name: str) -> None:
+    conn = _db().conn
+    cur = conn.execute('DELETE FROM recipes WHERE name = ?', (name,))
+    conn.commit()
+    if cur.rowcount == 0:
+        raise exceptions.JobNotFoundError(f'recipe {name!r}')
+
+
+def launch(name: str, cluster_name: Optional[str] = None,
+           env_overrides: Optional[Dict[str, str]] = None,
+           caller: Optional[Dict[str, Any]] = None
+           ) -> Tuple[int, Any]:
+    """Launch a recipe through the normal execution path (single-task
+    recipes; pipelines go through `sky-tpu jobs launch` on the stored
+    YAML). ``caller`` carries the authenticated API identity so the
+    private-workspace gate judges the real user, not the server's OS
+    account."""
+    from skypilot_tpu import execution
+    from skypilot_tpu.utils import dag_utils
+    rec = get(name)
+    dag = dag_utils.load_dag_from_yaml_str(
+        rec['yaml'], env_overrides=env_overrides)
+    if len(dag.tasks) != 1:
+        raise exceptions.InvalidTaskError(
+            f'recipe {name!r} is a {len(dag.tasks)}-stage pipeline; '
+            f'launch it as a managed job: sky-tpu jobs launch '
+            f'--recipe {name}')
+    return execution.launch(dag.tasks[0], cluster_name, caller=caller)

@@ -108,6 +108,23 @@ def down(service_name: str, *, purge: bool = False,
         f'retry with purge=True to force')
 
 
+def restart_replica(service_name: str, replica_id: int) -> None:
+    """Flag a replica for replacement: the controller terminates it on
+    its next sync and the autoscaler launches a substitute (dashboard /
+    CLI action; reference has no per-replica op — this is the TPU-native
+    equivalent of killing a bad vLLM replica pod)."""
+    if serve_state.get_service(service_name) is None:
+        raise exceptions.JobNotFoundError(f'service {service_name!r}')
+    if not serve_state.request_replica_restart(service_name, replica_id):
+        rec = serve_state.get_replica(replica_id)
+        if rec is not None and rec['service_name'] == service_name:
+            raise exceptions.InvalidTaskError(
+                f'replica {replica_id} is {rec["status"].value}; only '
+                f'live replicas can be restarted')
+        raise exceptions.JobNotFoundError(
+            f'replica {replica_id} of {service_name!r}')
+
+
 def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
     """Snapshot of one or all services (reference serve status)."""
     if service_name is not None:

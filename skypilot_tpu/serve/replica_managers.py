@@ -241,6 +241,14 @@ class ReplicaManager:
                           ReplicaStatus.FAILED,
                           ReplicaStatus.PREEMPTED):
                 continue
+            if r.get('restart_requested'):
+                # Operator-initiated replacement (dashboard/CLI): tear
+                # the replica down; the autoscaler's next tick launches
+                # a substitute to hold the target count.
+                serve_state.consume_restart_request(rid)
+                logger.info('replica %d: restart requested', rid)
+                self.terminate_replica(rid, 'restart requested')
+                continue
             # STARTING / READY / NOT_READY: check provider plane first.
             alive = self._provider_alive(r['cluster_name'])
             if alive is False or alive is None:

@@ -81,7 +81,8 @@ CREATE TABLE IF NOT EXISTS replicas (
     ready_at REAL,
     terminated_at REAL,
     consecutive_failures INTEGER DEFAULT 0,
-    failure_reason TEXT
+    failure_reason TEXT,
+    restart_requested INTEGER DEFAULT 0
 );
 CREATE TABLE IF NOT EXISTS lb_stats (
     service_name TEXT,
@@ -109,16 +110,24 @@ def _db() -> db_util.Db:
     if db.path not in _migrated:
         # Round-3 column on pre-existing DBs (CREATE IF NOT EXISTS does
         # not evolve live tables). Checked once per path per process.
-        try:
-            db.conn.execute('SELECT accelerator FROM replicas LIMIT 1')
-        except Exception:  # noqa: BLE001 — old schema
+        for col, ddl in (('accelerator',
+                          'ALTER TABLE replicas ADD COLUMN '
+                          'accelerator TEXT'),
+                         ('restart_requested',
+                          'ALTER TABLE replicas ADD COLUMN '
+                          'restart_requested INTEGER DEFAULT 0')):
+            try:
+                db.conn.execute(
+                    f'SELECT {col} FROM replicas LIMIT 1')
+                continue
+            except Exception:  # noqa: BLE001 — old schema
+                pass
             try:
                 db.conn.rollback()
             except Exception:  # noqa: BLE001 — sqlite: nothing open
                 pass
             try:
-                db.conn.execute(
-                    'ALTER TABLE replicas ADD COLUMN accelerator TEXT')
+                db.conn.execute(ddl)
                 db.conn.commit()
             except Exception:  # noqa: BLE001 — concurrent migrator won
                 try:
@@ -261,6 +270,31 @@ def set_replica_status(replica_id: int, status: ReplicaStatus,
         f'UPDATE replicas SET status = ?, failure_reason = '
         f'COALESCE(?, failure_reason){extra} WHERE replica_id = ?',
         (status.value, failure_reason, replica_id))
+    conn.commit()
+
+
+def request_replica_restart(service_name: str,
+                            replica_id: int) -> bool:
+    """Dashboard/CLI-initiated replica replacement: flag the replica;
+    the controller's manager terminates it on its next sync and the
+    autoscaler launches a substitute to hold the target count. Returns
+    False if the replica doesn't belong to the service."""
+    conn = _db().conn
+    # Terminal replicas are skipped by the controller's sync loop, so
+    # flagging one would report success for a permanent no-op.
+    cur = conn.execute(
+        'UPDATE replicas SET restart_requested = 1 '
+        'WHERE replica_id = ? AND service_name = ? '
+        "AND status NOT IN ('FAILED','PREEMPTED','SHUTTING_DOWN')",
+        (replica_id, service_name))
+    conn.commit()
+    return cur.rowcount > 0
+
+
+def consume_restart_request(replica_id: int) -> None:
+    conn = _db().conn
+    conn.execute('UPDATE replicas SET restart_requested = 0 '
+                 'WHERE replica_id = ?', (replica_id,))
     conn.commit()
 
 

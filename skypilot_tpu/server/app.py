@@ -387,6 +387,24 @@ class Server:
         await resp.write_eof()
         return resp
 
+    async def h_static(self, req: web.Request) -> web.Response:
+        """Dashboard assets (ES modules under dashboard/static/)."""
+        import mimetypes
+
+        from skypilot_tpu import dashboard
+        rel = req.match_info['path']
+        root = os.path.abspath(dashboard.STATIC_DIR)
+        full = os.path.abspath(os.path.join(root, rel))
+        # Path-traversal guard: the resolved file must stay inside the
+        # static root.
+        if not full.startswith(root + os.sep) or not os.path.isfile(full):
+            return web.Response(text='not found', status=404)
+        ctype = mimetypes.guess_type(full)[0] or 'application/octet-stream'
+        loop = asyncio.get_event_loop()
+        body = await loop.run_in_executor(
+            self.short_pool, lambda: open(full, 'rb').read())
+        return web.Response(body=body, content_type=ctype)
+
     async def h_dashboard(self, _req: web.Request) -> web.Response:
         """Serve the single-page dashboard (reference sky/dashboard)."""
         from skypilot_tpu import dashboard
@@ -555,7 +573,10 @@ class Server:
         from skypilot_tpu.users import rbac
         if (req.path in ('/api/health', '/metrics', '/', '/dashboard',
                          '/auth/token') or
-                req.path.startswith('/oauth2/')):
+                req.path.startswith(('/oauth2/', '/static/'))):
+            # /static/: the dashboard's ES modules — the browser cannot
+            # attach a bearer header to <script type=module> fetches,
+            # and the assets are public code, not data.
             # The dashboard page itself must load without a bearer header
             # (browsers can't attach one to the initial GET); every API
             # call it makes is still individually authenticated.
@@ -756,6 +777,7 @@ run <code>sky-tpu api login</code>, close this page.</p>
         app.router.add_get('/api/whoami', self.h_whoami)
         app.router.add_get('/dashboard', self.h_dashboard)
         app.router.add_get('/', self.h_dashboard)
+        app.router.add_get('/static/{path:.+}', self.h_static)
         app.router.add_get('/metrics', self.h_metrics)
         app.router.add_get('/api/requests', self.h_requests)
         app.router.add_get('/api/get/{request_id}', self.h_get)

@@ -20,7 +20,7 @@ from skypilot_tpu import task as task_lib
 # queue, executor.py:1-20): they provision/mutate clusters and can run for
 # minutes — or crash — without taking the control plane down.
 LONG_OPS = {'launch', 'exec', 'down', 'stop', 'start', 'jobs.launch',
-            'serve.up', 'serve.down', 'serve.update'}
+            'serve.up', 'serve.down', 'serve.update', 'recipes.launch'}
 # Ops answered inline, never persisted to the requests store — their
 # results are secrets (a cleartext token in the store would be readable
 # via /api/get by anyone, defeating the store-only-hashes design).
@@ -28,7 +28,8 @@ SYNC_OPS = {'users.token_create'}
 # Ops that CREATE resources in the active workspace: the authenticated
 # caller (not the server's OS user, which the workers run as) must pass
 # the private-workspace gate (reference workspaces/core.py:659).
-WORKSPACE_GATED = {'launch', 'jobs.launch', 'serve.up', 'serve.update'}
+WORKSPACE_GATED = {'launch', 'jobs.launch', 'serve.up', 'serve.update',
+                   'recipes.launch'}
 # Ops that act on an EXISTING cluster: the gate must judge the caller
 # against the workspace the cluster was LAUNCHED in (clusters carry a
 # workspace column) — the server's active workspace says nothing about
@@ -150,6 +151,8 @@ def dispatch(name: str, payload: Dict[str, Any]) -> Callable[[], Any]:
         return _dispatch_users(name, payload)
     if name.startswith('workspaces.'):
         return _dispatch_workspaces(name, payload)
+    if name.startswith('recipes.'):
+        return _dispatch_recipes(name, payload)
     if name.startswith('jobs.') or name.startswith('serve.'):
         try:
             if name.startswith('jobs.'):
@@ -160,6 +163,36 @@ def dispatch(name: str, payload: Dict[str, Any]) -> Callable[[], Any]:
         except (ImportError, AttributeError) as e:
             raise exceptions.OpUnavailableError(
                 f'op {name} not available: {e}') from e
+    raise exceptions.UnknownOpError(f'unknown op {name}')
+
+
+def _dispatch_recipes(name, payload):
+    from skypilot_tpu import recipes as recipes_lib
+    if name == 'recipes.add':
+        caller = payload.get('_caller') or {}
+        return functools.partial(
+            recipes_lib.add, payload['name'], payload['yaml'],
+            description=payload.get('description', ''),
+            created_by=caller.get('name') or caller.get('id'))
+    if name == 'recipes.update':
+        return functools.partial(
+            recipes_lib.update, payload['name'], payload['yaml'],
+            description=payload.get('description'))
+    if name == 'recipes.list':
+        return recipes_lib.list_recipes
+    if name == 'recipes.get':
+        return functools.partial(recipes_lib.get, payload['name'])
+    if name == 'recipes.delete':
+        return functools.partial(recipes_lib.delete, payload['name'])
+    if name == 'recipes.launch':
+        def _launch():
+            job_id, info = recipes_lib.launch(
+                payload['name'], payload.get('cluster_name'),
+                env_overrides=payload.get('env_overrides'),
+                caller=payload.get('_caller'))
+            return {'job_id': job_id,
+                    'cluster_name': info.cluster_name}
+        return _launch
     raise exceptions.UnknownOpError(f'unknown op {name}')
 
 
@@ -265,4 +298,8 @@ def _dispatch_serve(name, payload, serve_lib):
         return functools.partial(
             serve_lib.update, _task_from_payload(payload),
             payload['service_name'])
+    if name == 'serve.restart_replica':
+        return functools.partial(serve_lib.restart_replica,
+                                 payload['service_name'],
+                                 int(payload['replica_id']))
     raise exceptions.UnknownOpError(f'unknown op {name}')

@@ -105,6 +105,17 @@ def render_deployment(namespace: str = 'sky-tpu', *,
             'template': {
                 'metadata': {'labels': _labels()},
                 'spec': {
+                    # With a postgres db-url, prove the dialect
+                    # translation against the REAL server before the API
+                    # server takes writes (utils/db_selftest.py; no-op
+                    # when the secret is absent -> sqlite).
+                    'initContainers': [{
+                        'name': 'db-selftest',
+                        'image': image,
+                        'command': ['python', '-m',
+                                    'skypilot_tpu.utils.db_selftest'],
+                        'env': env,
+                    }],
                     'containers': [{
                         'name': 'api',
                         'image': image,

@@ -678,3 +678,34 @@ def test_llm_multihost_replica_e2e():
         assert len(out['tokens']) == 4
     finally:
         serve.down('llm-mh')
+
+
+def test_restart_replica_action():
+    """Dashboard/CLI per-replica action: serve.restart_replica flags the
+    replica; the controller terminates it and the autoscaler launches a
+    substitute (round-4 serve-replica action)."""
+    task = _service_task(name='svc-restart')
+    serve.up(task, _spawn=False)
+    ctl = controller_lib.ServeController('svc-restart')
+    _tick_until(ctl, lambda: _num_ready('svc-restart') >= 1)
+    [old] = serve_state.get_replicas('svc-restart',
+                                     [ReplicaStatus.READY])
+
+    serve.restart_replica('svc-restart', old['replica_id'])
+    _tick_until(ctl, lambda: any(
+        r['replica_id'] != old['replica_id']
+        and r['status'] == ReplicaStatus.READY
+        for r in serve_state.get_replicas('svc-restart')))
+    # The flagged replica was really torn down, not left running.
+    gone = serve_state.get_replica(old['replica_id'])
+    assert gone is None or gone['status'] in (
+        ReplicaStatus.SHUTTING_DOWN, ReplicaStatus.FAILED,
+        ReplicaStatus.PREEMPTED)
+    serve.down('svc-restart')
+
+    # Unknown replica/service raise.
+    import pytest as _pytest
+
+    from skypilot_tpu import exceptions as exc
+    with _pytest.raises(exc.JobNotFoundError):
+        serve.restart_replica('nope', 1)

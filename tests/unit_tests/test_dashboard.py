@@ -1,0 +1,127 @@
+"""Dashboard: DOM structure, module wiring, and asset serving.
+
+No JS runtime exists in this image (no node), so "DOM-level" here means:
+parse the served page into a DOM tree (html.parser), assert the
+structure the modules mutate actually exists, and contract-check the
+ES-module graph — every import resolves to a shipped file, every
+window.* global referenced by server-rendered onclick strings is
+registered by app.js, and every tab button has a view. These are the
+integration seams a refactor breaks silently.
+"""
+import html.parser
+import os
+import re
+
+import pytest
+import requests
+
+from skypilot_tpu import dashboard
+
+JS_DIR = os.path.join(dashboard.STATIC_DIR, 'js')
+
+
+class _Dom(html.parser.HTMLParser):
+    def __init__(self):
+        super().__init__()
+        self.ids = set()
+        self.tabs = []
+        self.scripts = []
+
+    def handle_starttag(self, tag, attrs):
+        d = dict(attrs)
+        if 'id' in d:
+            self.ids.add(d['id'])
+        if tag == 'button' and 'data-tab' in d:
+            self.tabs.append(d['data-tab'])
+        if tag == 'script':
+            self.scripts.append(d)
+
+
+def _parse_index() -> _Dom:
+    with open(dashboard.index_path(), encoding='utf-8') as f:
+        dom = _Dom()
+        dom.feed(f.read())
+    return dom
+
+
+def _js_files():
+    out = {}
+    for root, _, files in os.walk(JS_DIR):
+        for f in files:
+            if f.endswith('.js'):
+                full = os.path.join(root, f)
+                rel = os.path.relpath(full, JS_DIR)
+                with open(full, encoding='utf-8') as fh:
+                    out[rel.replace(os.sep, '/')] = fh.read()
+    return out
+
+
+def test_dom_has_every_node_the_modules_touch():
+    dom = _parse_index()
+    # Every getElementById target in the JS must exist in the page.
+    needed = set()
+    for src in _js_files().values():
+        needed.update(re.findall(r"getElementById\('([\w-]+)'\)", src))
+    needed -= {'logbox', 'accrows', 'accfilter'}   # rendered dynamically
+    missing = needed - dom.ids
+    assert not missing, f'modules touch absent DOM ids: {missing}'
+    # The page boots through the module entry, not inline script.
+    [entry] = [s for s in dom.scripts if s.get('src')]
+    assert entry['src'] == '/static/js/app.js'
+    assert entry.get('type') == 'module'
+
+
+def test_every_tab_has_a_view():
+    dom = _parse_index()
+    app = _js_files()['app.js']
+    views_block = app[app.index('const views = {'):]
+    views_block = views_block[:views_block.index('};')]
+    for tab in dom.tabs:
+        assert re.search(rf'\b{tab}:', views_block), (
+            f'tab {tab!r} has no entry in app.js views')
+
+
+def test_module_imports_resolve():
+    files = _js_files()
+    for rel, src in files.items():
+        base = os.path.dirname(rel)
+        for m in re.finditer(r"from '(\.[./\w]+\.js)'", src):
+            target = os.path.normpath(
+                os.path.join(base, m.group(1))).replace(os.sep, '/')
+            assert target in files, (
+                f'{rel} imports {m.group(1)} -> {target}: not shipped')
+
+
+def test_onclick_globals_are_registered():
+    files = _js_files()
+    app = files['app.js']
+    registered = set(re.findall(r'window\.(\w+)\s*=', app))
+    for rel, src in files.items():
+        for g in re.findall(r'onclick=\\?"(\w+)\(', src):
+            assert g in registered, (
+                f'{rel} renders onclick global {g!r} that app.js '
+                f'never registers')
+        for g in re.findall(r"onclick=\"(\w+)\(", src):
+            assert g in registered, (
+                f'{rel}: unregistered onclick global {g!r}')
+
+
+def test_assets_served_with_traversal_guard(api_server):
+    base = api_server
+    r = requests.get(f'{base}/static/js/app.js', timeout=10)
+    assert r.status_code == 200
+    assert 'javascript' in r.headers['Content-Type']
+    assert 'const views' in r.text
+    r = requests.get(f'{base}/static/js/views/serve.js', timeout=10)
+    assert r.status_code == 200
+    assert 'serve.restart_replica' in r.text
+    # Index references the module entry and parses.
+    r = requests.get(f'{base}/dashboard', timeout=10)
+    assert r.status_code == 200
+    assert '/static/js/app.js' in r.text
+    # Path traversal is rejected.
+    r = requests.get(f'{base}/static/../../../etc/passwd', timeout=10)
+    assert r.status_code in (403, 404)
+    r = requests.get(f'{base}/static/js/%2e%2e/%2e%2e/config.py',
+                     timeout=10)
+    assert r.status_code in (403, 404)

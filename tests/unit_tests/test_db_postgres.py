@@ -191,3 +191,12 @@ def test_real_postgres_roundtrip(monkeypatch):
     conn.commit()
     row = conn.execute('SELECT v FROM t WHERE k=?', ('a',)).fetchone()
     assert row['v'] == 2
+
+
+def test_db_selftest_sql_is_valid_postgres(fake_pg):
+    """The packaged image's initContainer self-test
+    (utils/db_selftest.py) must itself emit valid postgres dialect —
+    otherwise the deploy gate would crash for the wrong reason."""
+    from skypilot_tpu.utils import db_selftest
+    db_selftest.run('postgresql://fake/skytpu')
+    assert len(fake_pg) >= 1
