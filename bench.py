@@ -17,6 +17,19 @@ comparison — tokens/sec/$ parity (BASELINE.json) additionally depends
 on instance pricing, which the optimizer's catalog covers. (The
 earlier 350M bench config peaked at ~0.28 MFU — dim 1024 matmuls
 underfill the v5e MXU; dim 1536 x 24 layers fills it.)
+
+Round-4 profile (why the seq-2048 ceiling sits at ~0.585, measured on
+the chip): forward alone runs at 0.66 utilization; the full-remat step
+executes 8/6 of nominal FLOPs (backward recomputes the forward), so
+0.585 nominal MFU is ~0.78 actual hardware utilization. The non-MXU
+floor is: cross-entropy over the fp32 [b*s, 32k] logits (~25 ms of the
+forward; a vocab-chunked custom-VJP CE was built and measured SLOWER at
+32k vocab — kept config-gated for 128k-vocab models where the dense
+form cannot even materialize), memory-bound RMSNorm/RoPE passes, and
+the flash kernel's VPU-bound softmax at short sequence. Swept: flash
+tiles (512x512 best of 8 configs), remat policies (full > save_attn >
+dots at 2048), batch (6 > 4 > 8). Sequence scaling amortizes the floor:
+seq 4096 -> 0.603, seq 8192 -> 0.618 MFU (run `--seq 8192`).
 """
 from __future__ import annotations
 
@@ -32,7 +45,7 @@ from skypilot_tpu.train import trainer
 
 import argparse
 
-BATCH = 4
+BATCH = 6   # b6 measured best on v5e (0.585 vs 0.578 at b4)
 SEQ = 2048
 WARMUP = 2
 STEPS = 5
@@ -61,14 +74,29 @@ def main() -> None:
                              "backward's O(s) memory: batch auto-drops "
                              'to 1)')
     parser.add_argument('--batch', type=int, default=None)
+    parser.add_argument('--remat-policy', default=None,
+                        choices=['full', 'dots', 'save_attn'])
+    parser.add_argument('--attn', default=None,
+                        choices=['flash', 'dense'])
+    parser.add_argument('--block-q', type=int, default=None)
+    parser.add_argument('--block-k', type=int, default=None)
     args = parser.parse_args()
     seq = args.seq
     batch = args.batch or (BATCH if seq <= 2048 else 1)
     dev = jax.devices()[0]
     on_tpu = jax.default_backend() == 'tpu'
     steps = STEPS if on_tpu else 1
+    kw = {}
+    if args.remat_policy:
+        kw['remat_policy'] = args.remat_policy
+    if args.attn:
+        kw['attention_impl'] = args.attn
+    if args.block_q:
+        kw['attn_block_q'] = args.block_q
+    if args.block_k:
+        kw['attn_block_k'] = args.block_k
     config = llama.LlamaConfig.bench_1b(
-        max_seq_len=seq, attention_impl='auto')
+        max_seq_len=seq, attention_impl='auto', **kw)
     print(f'[bench] device={dev.device_kind} params={config.num_params/1e6:.0f}M '
           f'batch={batch} seq={seq} backend={jax.default_backend()}',
           file=sys.stderr)

@@ -66,6 +66,11 @@ class LlamaConfig:
     # while its output is a tiny [b, s, d]; +1.5% tok/s at seq 8192,
     # noise-level at 2048.
     remat_policy: str = 'full'      # 'full' | 'dots' | 'save_attn'
+    # Vocab-chunked cross-entropy (ops/cross_entropy.py). None = dense
+    # (XLA's fused log-softmax wins at 32k vocab — measured on v5e);
+    # set for 100k+ vocabs where fp32 [b*s, V] logits (4.3 GB for
+    # Llama-3's 128256 at b4 s2048) must never materialize.
+    loss_vocab_chunks: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -84,10 +89,12 @@ class LlamaConfig:
     # ---- presets --------------------------------------------------------
     @staticmethod
     def llama3_8b(**kw) -> 'LlamaConfig':
+        kw.setdefault('loss_vocab_chunks', 16)   # 128k vocab
         return LlamaConfig(**kw)
 
     @staticmethod
     def llama3_70b(**kw) -> 'LlamaConfig':
+        kw.setdefault('loss_vocab_chunks', 16)   # 128k vocab
         return LlamaConfig(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
                            ffn_dim=28_672, **kw)
 
@@ -216,9 +223,9 @@ def _layer(config: LlamaConfig, x: jnp.ndarray, layer: Params,
     return mlp_block(config, x, layer)
 
 
-def forward(config: LlamaConfig, params: Params, tokens: jnp.ndarray,
-            positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """tokens [b, s] int32 -> logits [b, s, vocab] (fp32)."""
+def backbone(config: LlamaConfig, params: Params, tokens: jnp.ndarray,
+             positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """tokens [b, s] int32 -> final-norm hidden states [b, s, d]."""
     x = params['embed'][tokens]
     cos, sin = rope_lib.rope_frequencies(config.head_dim,
                                          config.max_seq_len,
@@ -249,17 +256,39 @@ def forward(config: LlamaConfig, params: Params, tokens: jnp.ndarray,
         return fn(config, carry, layer, cos, sin, positions), None
 
     x, _ = jax.lax.scan(body, x, params['layers'])
-    x = norms.rms_norm(x, params['final_norm'], config.norm_eps)
-    return (x @ params['lm_head']).astype(jnp.float32)
+    return norms.rms_norm(x, params['final_norm'], config.norm_eps)
+
+
+def forward(config: LlamaConfig, params: Params, tokens: jnp.ndarray,
+            positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """tokens [b, s] int32 -> logits [b, s, vocab] (fp32)."""
+    x = backbone(config, params, tokens, positions)
+    return quant_lib.qdot(x, params['lm_head']).astype(jnp.float32)
 
 
 def loss_fn(config: LlamaConfig, params: Params, tokens: jnp.ndarray,
             targets: jnp.ndarray,
             mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Causal LM cross-entropy (fp32 logits)."""
-    logits = forward(config, params, tokens)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    """Causal LM cross-entropy.
+
+    Dense fp32 log-softmax by default (XLA fuses it well at 32k vocab);
+    ``config.loss_vocab_chunks`` switches to the vocab-chunked
+    custom-VJP path (ops/cross_entropy.py) that never materializes the
+    fp32 [b*s, vocab] logits — required headroom at 100k+ vocabs.
+    """
+    if config.loss_vocab_chunks:
+        from skypilot_tpu.ops import cross_entropy as ce
+        b, s = tokens.shape
+        x = backbone(config, params, tokens)
+        nll = ce.chunked_cross_entropy(
+            x.reshape(b * s, config.dim), params['lm_head'],
+            targets.reshape(b * s).astype(jnp.int32),
+            config.loss_vocab_chunks).reshape(b, s)
+    else:
+        logits = forward(config, params, tokens)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1)[..., 0]
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
     return jnp.mean(nll)

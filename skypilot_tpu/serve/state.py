@@ -280,12 +280,16 @@ def request_replica_restart(service_name: str,
     autoscaler launches a substitute to hold the target count. Returns
     False if the replica doesn't belong to the service."""
     conn = _db().conn
-    # Terminal replicas are skipped by the controller's sync loop, so
-    # flagging one would report success for a permanent no-op.
+    # Only replicas the controller's sync loop actually visits can be
+    # restarted: terminal ones are a permanent no-op, and
+    # PENDING/PROVISIONING ones would be killed the instant they come
+    # up (the flag fires after the status skip clears) — paying the
+    # provisioning cost twice for nothing.
     cur = conn.execute(
         'UPDATE replicas SET restart_requested = 1 '
         'WHERE replica_id = ? AND service_name = ? '
-        "AND status NOT IN ('FAILED','PREEMPTED','SHUTTING_DOWN')",
+        "AND status NOT IN ('FAILED','PREEMPTED','SHUTTING_DOWN',"
+        "'PENDING','PROVISIONING')",
         (replica_id, service_name))
     conn.commit()
     return cur.rowcount > 0

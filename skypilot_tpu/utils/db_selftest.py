@@ -27,32 +27,43 @@ def run(dsn: str) -> None:
               'name TEXT PRIMARY KEY, value TEXT, n INTEGER DEFAULT 0)')
     db = db_util.get_db(f'{probe}.db', schema)
     conn = db.conn
-    conn.execute(f'INSERT INTO {probe} (name, value, n) VALUES (?,?,?)',
-                 ('a', 'x', 1))
-    # Upsert path (sqlite ON CONFLICT syntax must translate).
-    conn.execute(
-        f'INSERT INTO {probe} (name, value, n) VALUES (?,?,?) '
-        f'ON CONFLICT(name) DO UPDATE SET value=excluded.value, '
-        f'n=excluded.n',
-        ('a', 'y', 2))
-    conn.commit()
-    row = conn.execute(f'SELECT value, n FROM {probe} WHERE name = ?',
-                       ('a',)).fetchone()
-    assert row is not None and row['value'] == 'y' and row['n'] == 2, row
-    cur = conn.execute(f'UPDATE {probe} SET n = n + 1 WHERE name = ?',
-                       ('a',))
-    assert cur.rowcount == 1
-    conn.execute(f'DROP TABLE {probe}')
-    conn.commit()
+    try:
+        conn.execute(
+            f'INSERT INTO {probe} (name, value, n) VALUES (?,?,?)',
+            ('a', 'x', 1))
+        # Upsert path (sqlite ON CONFLICT syntax must translate).
+        conn.execute(
+            f'INSERT INTO {probe} (name, value, n) VALUES (?,?,?) '
+            f'ON CONFLICT(name) DO UPDATE SET value=excluded.value, '
+            f'n=excluded.n',
+            ('a', 'y', 2))
+        conn.commit()
+        row = conn.execute(
+            f'SELECT value, n FROM {probe} WHERE name = ?',
+            ('a',)).fetchone()
+        assert row is not None and row['value'] == 'y' and \
+            row['n'] == 2, row
+        cur = conn.execute(
+            f'UPDATE {probe} SET n = n + 1 WHERE name = ?', ('a',))
+        assert cur.rowcount == 1
+    finally:
+        # This runs against the SHARED production DB: never leak the
+        # probe table, even when an assertion above fails.
+        conn.execute(f'DROP TABLE IF EXISTS {probe}')
+        conn.commit()
 
     # The real state store against the same server.
     from skypilot_tpu import state
     from skypilot_tpu.utils import common
     name = f'selftest-cluster-{int(time.time())}'
     state.add_or_update_cluster(name, common.ClusterStatus.INIT)
-    rec = state.get_cluster(name)
-    assert rec is not None and rec['name'] == name
-    state.remove_cluster(name)
+    try:
+        rec = state.get_cluster(name)
+        assert rec is not None and rec['name'] == name
+    finally:
+        # A phantom INIT cluster in the shared table would show in
+        # every user's status view.
+        state.remove_cluster(name)
     assert state.get_cluster(name) is None
     print(f'db selftest OK against {dsn.split("@")[-1]}')
 
