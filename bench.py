@@ -86,17 +86,14 @@ def main() -> None:
     dev = jax.devices()[0]
     on_tpu = jax.default_backend() == 'tpu'
     steps = STEPS if on_tpu else 1
-    kw = {}
+    kw = {'attention_impl': args.attn or 'auto'}
     if args.remat_policy:
         kw['remat_policy'] = args.remat_policy
-    if args.attn:
-        kw['attention_impl'] = args.attn
     if args.block_q:
         kw['attn_block_q'] = args.block_q
     if args.block_k:
         kw['attn_block_k'] = args.block_k
-    config = llama.LlamaConfig.bench_1b(
-        max_seq_len=seq, attention_impl='auto', **kw)
+    config = llama.LlamaConfig.bench_1b(max_seq_len=seq, **kw)
     print(f'[bench] device={dev.device_kind} params={config.num_params/1e6:.0f}M '
           f'batch={batch} seq={seq} backend={jax.default_backend()}',
           file=sys.stderr)

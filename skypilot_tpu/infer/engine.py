@@ -162,10 +162,6 @@ class InferenceEngine:
                 f'max_seq_len {self.ecfg.max_seq_len} must be a '
                 f'multiple of the chunk size {self._chunk_cap}')
         if self.ecfg.quantize:
-            if self.ecfg.tp > 1:
-                # param_shardings has no rules for QuantArray leaves
-                # yet; 8B int8 fits ONE chip, which is the point.
-                raise ValueError('quantize=True requires tp=1')
             from skypilot_tpu.ops import quant as quant_lib
             if not quant_lib.is_quantized(params):
                 params = quant_lib.quantize_params(params)

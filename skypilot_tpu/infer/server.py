@@ -345,20 +345,20 @@ def main() -> None:
         # Accept either a bare params pytree or a full train state.
         params = restored.get('params', restored) if isinstance(
             restored, dict) else restored.params
+    elif args.quantize:
+        # Direct int8 init, sharded when tp>1: neither a model's bf16
+        # form nor (for 70B-class) a single int8 leaf may materialize
+        # whole on one chip (ops/quant.py init_params_quantized).
+        from skypilot_tpu.ops import quant as quant_lib
+        logger.warning('no --checkpoint: serving random int8 weights '
+                       '(%s, tp=%d)', args.model, args.tp)
+        params = quant_lib.init_params_quantized(
+            config, jax.random.PRNGKey(0), tp=args.tp)
     elif args.tp > 1:
         logger.warning('no --checkpoint: serving random weights (%s), '
                        'initialized sharded over tp=%d', args.model,
                        args.tp)
         params = engine_lib.init_params_sharded(config, args.tp)
-    elif args.quantize:
-        # Direct int8 init: an 8B model's bf16 form (16 GB) must never
-        # materialize whole on the 16 GB chip it is being quantized
-        # to fit (ops/quant.py init_params_quantized).
-        from skypilot_tpu.ops import quant as quant_lib
-        logger.warning('no --checkpoint: serving random int8 weights '
-                       '(%s)', args.model)
-        params = quant_lib.init_params_quantized(config,
-                                                 jax.random.PRNGKey(0))
     else:
         logger.warning('no --checkpoint: serving random weights (%s)',
                        args.model)

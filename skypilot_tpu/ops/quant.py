@@ -116,43 +116,77 @@ def quantize_params(params: Params) -> Params:
     }
 
 
-def init_params_quantized(config, key: jax.Array) -> Params:
+def init_params_quantized(config, key: jax.Array,
+                          tp: int = 1) -> Params:
     """Random-init DIRECTLY into int8: each weight is generated in the
     compute dtype, quantized, and freed before the next — an 8B model
     (16 GB bf16) never exists whole on the chip, only its ~8.5 GB int8
     form plus one transient leaf. Mirrors llama.init_params's tree
     shape and scaling exactly (structure asserted by
-    test_infer.test_quantized_init_matches_structure)."""
+    test_infer.test_quantized_init_matches_structure).
+
+    ``tp > 1``: each leaf is produced ALREADY SHARDED over the tp mesh
+    (jit with quant-aware out_shardings, parallel/sharding.py) — a 70B
+    int8 leaf never materializes on one chip either. Partitionable
+    threefry keeps the values identical to the unsharded init."""
     dtype = jnp.dtype(config.dtype)
     d, hd = config.dim, config.head_dim
     L = config.n_layers
     k_embed, k_layers, k_head = jax.random.split(key, 3)
 
-    def qnormal(k, shape, scale, quant_fn=quantize_weight):
-        w = (jax.random.normal(k, shape, dtype) *
-             jnp.asarray(scale, dtype))
-        return jax.jit(quant_fn, donate_argnums=0)(w)
+    leaf_shardings = {}
+    if tp > 1:
+        from skypilot_tpu.infer.engine import tp_mesh
+        from skypilot_tpu.models import llama as llama_lib
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        mesh = tp_mesh(tp)
+        abstract = jax.eval_shape(lambda: quantize_params(
+            llama_lib.init_params(config, jax.random.PRNGKey(0))))
+        shard_tree = sharding_lib.param_shardings(mesh, abstract)
+        leaf_shardings = {
+            'embed': shard_tree['embed'],
+            'lm_head': shard_tree['lm_head'],
+            **{k: v for k, v in shard_tree['layers'].items()
+               if k in _MATMUL_KEYS},
+        }
+
+    def qnormal(k, shape, scale, quant_fn=quantize_weight, name=None):
+        def build():
+            w = (jax.random.normal(k, shape, dtype) *
+                 jnp.asarray(scale, dtype))
+            return quant_fn(w)
+        sh = leaf_shardings.get(name)
+        kw = {'out_shardings': sh} if sh is not None else {}
+        return jax.jit(build, **kw)()
 
     ks = jax.random.split(k_layers, 7)
     scale = d ** -0.5
     out_scale = scale / (2 * L) ** 0.5
     layers = {
         'attn_norm': jnp.ones((L, d), dtype),
-        'wq': qnormal(ks[0], (L, d, config.n_heads * hd), scale),
-        'wk': qnormal(ks[1], (L, d, config.n_kv_heads * hd), scale),
-        'wv': qnormal(ks[2], (L, d, config.n_kv_heads * hd), scale),
-        'wo': qnormal(ks[3], (L, config.n_heads * hd, d), out_scale),
+        'wq': qnormal(ks[0], (L, d, config.n_heads * hd), scale,
+                      name='wq'),
+        'wk': qnormal(ks[1], (L, d, config.n_kv_heads * hd), scale,
+                      name='wk'),
+        'wv': qnormal(ks[2], (L, d, config.n_kv_heads * hd), scale,
+                      name='wv'),
+        'wo': qnormal(ks[3], (L, config.n_heads * hd, d), out_scale,
+                      name='wo'),
         'mlp_norm': jnp.ones((L, d), dtype),
-        'w_gate': qnormal(ks[4], (L, d, config.ffn_dim), scale),
-        'w_up': qnormal(ks[5], (L, d, config.ffn_dim), scale),
-        'w_down': qnormal(ks[6], (L, config.ffn_dim, d), out_scale),
+        'w_gate': qnormal(ks[4], (L, d, config.ffn_dim), scale,
+                          name='w_gate'),
+        'w_up': qnormal(ks[5], (L, d, config.ffn_dim), scale,
+                        name='w_up'),
+        'w_down': qnormal(ks[6], (L, config.ffn_dim, d), out_scale,
+                          name='w_down'),
     }
     return {
         'embed': qnormal(k_embed, (config.vocab_size, d), 1.0,
-                         quantize_embed),
+                         quantize_embed, name='embed'),
         'layers': layers,
         'final_norm': jnp.ones((d,), dtype),
-        'lm_head': qnormal(k_head, (d, config.vocab_size), scale),
+        'lm_head': qnormal(k_head, (d, config.vocab_size), scale,
+                           name='lm_head'),
     }
 
 

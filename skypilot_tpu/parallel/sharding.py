@@ -42,15 +42,39 @@ BATCH_SPEC = P(('dp', 'fsdp'), None)           # [batch, seq]
 
 def param_shardings(mesh: Mesh, params: Any) -> Any:
     """NamedShardings matching the params pytree (LLAMA_PARAM_SPECS
-    broadcast over identical tree structure)."""
+    broadcast over identical tree structure).
+
+    Int8-quantized trees (ops/quant.py QuantArray) are handled too:
+    the ``q`` field shards like the original weight; ``scale`` drops
+    the contraction axis it was reduced over (-2 for matmul weights,
+    -1 for the per-row embedding table) from the weight's spec — this
+    is what lets an int8 70B shard over a tp mesh."""
     specs = LLAMA_PARAM_SPECS
 
     def to_sharding(path, leaf):
         node = specs
-        for p in path:
-            key = p.key if hasattr(p, 'key') else p.idx
-            node = node[key]
-        return NamedSharding(mesh, node)
+        keys = [p.key if hasattr(p, 'key') else
+                getattr(p, 'name', None) or p.idx for p in path]
+        consumed = 0
+        for key in keys:
+            if isinstance(node, dict):
+                node = node[key]
+                consumed += 1
+            else:
+                break
+        rest = keys[consumed:]
+        if not rest:
+            return NamedSharding(mesh, node)
+        [field] = rest                      # QuantArray member
+        if field == 'q':
+            return NamedSharding(mesh, node)
+        assert field == 'scale', field
+        parts = list(node) + [None] * (len(leaf.shape) + 1 - len(node))
+        if keys[0] == 'embed':
+            spec = P(*parts[:1])            # per-row: [vocab]
+        else:
+            spec = P(*(parts[:-2] + parts[-1:]))   # drop the in axis
+        return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(to_sharding, params)
 

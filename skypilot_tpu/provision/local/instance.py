@@ -159,7 +159,48 @@ def _pid_alive(pid: int) -> bool:
         return False
 
 
+def _kill_job_pgids(cdir: str) -> None:
+    """Tear down the rank process groups the agent recorded.
+
+    Rank processes run in their OWN sessions (start_new_session=True),
+    so killing the agent's group does not reach them; the native reaper
+    covers agent crashes, but teardown must not race it against the
+    rmtree that deletes the pgid file (that race leaked long-lived
+    serve replicas burning the CI box's only core)."""
+    path = os.path.join(cdir, 'job_pgids')
+    try:
+        with open(path, encoding='utf-8') as f:
+            pgids = [int(x) for x in f.read().split() if x.strip()]
+    except (OSError, ValueError):
+        return
+
+    def _ours(pg: int) -> bool:
+        # Pid-reuse guard: the file only ever grows while the agent
+        # lives, so a finished job's pgid may now belong to an
+        # unrelated process. Every rank we spawn carries
+        # SKY_TPU_JOB_ID in its environment — only kill those.
+        try:
+            with open(f'/proc/{pg}/environ', 'rb') as f:
+                return b'SKY_TPU_JOB_ID=' in f.read()
+        except OSError:
+            return False
+
+    pgids = [pg for pg in pgids if _ours(pg)]
+    for pg in pgids:
+        try:
+            os.killpg(pg, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    time.sleep(0.2)
+    for pg in pgids:
+        try:
+            os.killpg(pg, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
 def _kill_agent(cdir: str, timeout: float = 5.0) -> None:
+    _kill_job_pgids(cdir)
     info = _agent_info(cdir)
     if not info:
         return

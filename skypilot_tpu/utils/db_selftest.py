@@ -48,7 +48,14 @@ def run(dsn: str) -> None:
         assert cur.rowcount == 1
     finally:
         # This runs against the SHARED production DB: never leak the
-        # probe table, even when an assertion above fails.
+        # probe table, even when an assertion above fails. On postgres
+        # a failed statement aborts the transaction — roll back first
+        # or the DROP itself raises and masks the real dialect error.
+        for meth in ('rollback',):
+            try:
+                getattr(conn, meth)()
+            except Exception:  # noqa: BLE001 — sqlite: nothing open
+                pass
         conn.execute(f'DROP TABLE IF EXISTS {probe}')
         conn.commit()
 

@@ -175,7 +175,9 @@ def test_dashboard_served(api_server):
     for tab in ('clusters', 'jobs', 'serve', 'requests', 'infra',
                 'volumes', 'users', 'workspaces'):
         assert f'data-tab="{tab}"' in page, tab
-    assert 'streamLogs' in page and 'doAction' in page  # live logs+actions
+    # Round-4: the app is ES modules; the page carries the module
+    # entry, and the modules themselves serve from /static.
+    assert '/static/js/app.js' in page
     for op in ('users.list', 'workspaces.list', 'volumes.list'):
         rid = requests.post(f'{api_server}/{op}', json={},
                             timeout=5).json()['request_id']

@@ -251,14 +251,6 @@ def test_quantized_engine_generates(params):
     assert all(0 <= t < CFG.vocab_size for t in req.output_tokens)
 
 
-def test_quantize_with_tp_rejected(params):
-    with pytest.raises(ValueError, match='quantize'):
-        InferenceEngine(CFG, params,
-                        EngineConfig(quantize=True, tp=2,
-                                     max_seq_len=64,
-                                     prefill_buckets=(8,)))
-
-
 def test_max_seq_len_must_align_to_chunk(params):
     with pytest.raises(ValueError, match='multiple'):
         InferenceEngine(CFG, params,
@@ -307,3 +299,29 @@ def test_quantized_init_matches_structure(params):
         assert a.shape == b.shape and a.dtype == b.dtype
     assert quant.is_quantized(direct)
     assert not quant.is_quantized(params)
+
+
+def test_quantized_tp_engine_matches_single_device(params):
+    """int8 + tensor parallelism (the 70B-class path): sharded
+    quantized init produces the same values as unsharded (partitionable
+    threefry), and greedy decode over the tp mesh matches tp=1."""
+    from skypilot_tpu.ops import quant
+    ref = InferenceEngine(CFG, params,
+                          EngineConfig(n_slots=2, max_seq_len=64,
+                                       prefill_buckets=(8,),
+                                       quantize=True))
+    tp = InferenceEngine(CFG, params,
+                         EngineConfig(n_slots=2, max_seq_len=64,
+                                      prefill_buckets=(8,),
+                                      quantize=True, tp=2))
+    prompt = [5, 17, 101, 7]
+    [r1] = ref.generate([prompt], max_new_tokens=5)
+    [r2] = tp.generate([prompt], max_new_tokens=5)
+    assert r1.output_tokens == r2.output_tokens
+
+    # Direct sharded int8 init: same values as unsharded.
+    a = quant.init_params_quantized(CFG, jax.random.PRNGKey(3))
+    b = quant.init_params_quantized(CFG, jax.random.PRNGKey(3), tp=2)
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        assert jnp.array_equal(la, jnp.asarray(lb)), 'sharded init drifted'
