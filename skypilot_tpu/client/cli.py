@@ -320,20 +320,39 @@ def _jobs_engine():
 
 
 @jobs.command('launch')
-@click.argument('task_yaml')
+@click.argument('task_yaml', required=False)
+@click.option('--recipe', default=None,
+              help='Launch a stored recipe instead of a YAML file '
+                   '(pipelines supported).')
 @click.option('--name', '-n', default=None, help='Job name.')
 @click.option('--env', multiple=True, help='KEY=VALUE env override.')
 @click.option('--yes', '-y', is_flag=True, default=False)
-def jobs_launch(task_yaml: str, name: Optional[str], env: tuple,
+def jobs_launch(task_yaml: Optional[str], recipe: Optional[str],
+                name: Optional[str], env: tuple,
                 yes: bool) -> None:
     """Submit a managed job (auto-recovers on preemption).
 
     A multi-document YAML submits a managed PIPELINE: stages run
     sequentially, each with its own cluster and per-stage recovery.
+    --recipe NAME launches a stored template (sky-tpu recipe ls).
     """
     from skypilot_tpu.utils import dag_utils
-    dag = dag_utils.load_dag_from_yaml(task_yaml,
-                                       env_overrides=_env_overrides(env))
+    if (task_yaml is None) == (recipe is None):
+        raise click.UsageError('pass exactly one of TASK_YAML or '
+                               '--recipe NAME')
+    if recipe:
+        if _remote():
+            from skypilot_tpu.client import sdk
+            rec = sdk.call('recipes.get', {'name': recipe})
+        else:
+            from skypilot_tpu import recipes as recipes_lib
+            rec = recipes_lib.get(recipe)
+        dag = dag_utils.load_dag_from_yaml_str(
+            rec['yaml'], env_overrides=_env_overrides(env))
+        name = name or recipe
+    else:
+        dag = dag_utils.load_dag_from_yaml(
+            task_yaml, env_overrides=_env_overrides(env))
     if len(dag) > 1:
         stages = ', '.join(t.name or f'stage-{i}'
                            for i, t in enumerate(dag.tasks))

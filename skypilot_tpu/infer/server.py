@@ -311,7 +311,22 @@ def main() -> None:
     if args.checkpoint:
         from skypilot_tpu.train import checkpoint as ckpt_lib
         mgr = ckpt_lib.CheckpointManager(args.checkpoint)
-        if args.tp > 1:
+        if args.quantize and args.tp == 1:
+            # bf16-whole-on-device would OOM the very chip the int8
+            # form is meant to fit: restore into host RAM; the shared
+            # extraction + quantize below move it to the device
+            # leaf-by-leaf.
+            abstract = jax.eval_shape(
+                lambda: llama.init_params(config, jax.random.PRNGKey(0)))
+            try:
+                restored = mgr.restore_to_host(abstract)
+            except Exception as first_err:  # noqa: BLE001 — train-state
+                # checkpoints nest params under 'params'.
+                try:
+                    restored = mgr.restore_to_host({'params': abstract})
+                except Exception as second_err:
+                    raise second_err from first_err
+        elif args.tp > 1:
             # Restore DIRECTLY sharded: an 8B-class model cannot first
             # materialize on one chip (engine.init_params_sharded has
             # the same rule for random weights). The target carries
@@ -345,6 +360,9 @@ def main() -> None:
         # Accept either a bare params pytree or a full train state.
         params = restored.get('params', restored) if isinstance(
             restored, dict) else restored.params
+        if args.quantize and args.tp == 1:
+            from skypilot_tpu.ops import quant as quant_lib
+            params = quant_lib.quantize_params_transfer(params)
     elif args.quantize:
         # Direct int8 init, sharded when tp>1: neither a model's bf16
         # form nor (for 70B-class) a single int8 leaf may materialize

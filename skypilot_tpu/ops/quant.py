@@ -190,6 +190,39 @@ def init_params_quantized(config, key: jax.Array,
     }
 
 
+def quantize_params_transfer(params: Params) -> Params:
+    """quantize_params for HOST-resident trees (checkpoint restored to
+    RAM via CheckpointManager.restore_to_host): each leaf transfers to
+    the default device, quantizes, and frees its bf16 form before the
+    next — peak device memory is the int8 tree plus one bf16 leaf."""
+    # EXPLICIT target device: device_put(x) with no device is the
+    # identity for already-committed arrays, and restore_to_host
+    # commits leaves to the cpu backend — without the target the whole
+    # "quantized" tree would silently stay in host RAM.
+    target = jax.local_devices()[0]
+
+    def q(fn):
+        def run(leaf):
+            dev = jax.device_put(jnp.asarray(leaf), target)
+            return jax.jit(fn, donate_argnums=0)(dev)
+        return run
+    qw, qe = q(quantize_weight), q(quantize_embed)
+    layers = dict(params['layers'])
+    for key in list(layers):
+        if key in _MATMUL_KEYS:
+            layers[key] = qw(layers[key])
+        else:
+            layers[key] = jax.device_put(jnp.asarray(layers[key]),
+                                         target)
+    return {
+        'embed': qe(params['embed']),
+        'layers': layers,
+        'final_norm': jax.device_put(jnp.asarray(params['final_norm']),
+                                     target),
+        'lm_head': qw(params['lm_head']),
+    }
+
+
 def is_quantized(params: Params) -> bool:
     return any(isinstance(leaf, QuantArray)
                for leaf in jax.tree_util.tree_leaves(

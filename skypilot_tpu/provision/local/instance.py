@@ -200,9 +200,11 @@ def _kill_job_pgids(cdir: str) -> None:
 
 
 def _kill_agent(cdir: str, timeout: float = 5.0) -> None:
-    _kill_job_pgids(cdir)
     info = _agent_info(cdir)
     if not info:
+        # No agent (already dead): still reap any rank processes it
+        # left behind.
+        _kill_job_pgids(cdir)
         return
     pid = info.get('pid', -1)
     if _pid_alive(pid):
@@ -223,6 +225,11 @@ def _kill_agent(cdir: str, timeout: float = 5.0) -> None:
                 os.killpg(os.getpgid(pid), signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+    # Rank process groups die AFTER the agent: if the agent saw its
+    # ranks exit first it would record the job FAILED on the way down,
+    # and the managed-jobs controller would read a preemption as a
+    # user failure and refuse to recover.
+    _kill_job_pgids(cdir)
     # Stale agent.json must not be mistaken for a live agent later.
     try:
         os.unlink(os.path.join(cdir, 'agent.json'))

@@ -50,6 +50,29 @@ class CheckpointManager:
                 step, args=ocp.args.StandardRestore(target_struct))
         return self._mgr.restore(step)
 
+    def restore_to_host(self, target: Any,
+                        step: Optional[int] = None) -> Any:
+        """Restore onto the HOST (cpu backend), not the accelerator.
+
+        The int8 serving path needs this: an 8B bf16 checkpoint (16 GB)
+        cannot first land on the 16 GB chip it is being quantized to
+        fit — it restores into host RAM and quantizes leaf-by-leaf onto
+        the device (ops/quant.py quantize_params_transfer). ``target``
+        is a concrete or abstract pytree giving shapes/dtypes."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f'No checkpoint under {self.directory}')
+        cpu = jax.local_devices(backend='cpu')[0]
+        sharding = jax.sharding.SingleDeviceSharding(cpu)
+        target_struct = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                           sharding=sharding),
+            jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                   target))
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(target_struct))
+
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 

@@ -56,3 +56,31 @@ def test_restore_or_init(tmp_path):
     state2, restored2 = checkpoint.restore_or_init(ckpt_dir, init)
     assert restored2
     assert int(state2.step) == 5
+
+
+def test_restore_to_host_and_transfer_quantize(tmp_path):
+    """The --quantize --checkpoint serving path: restore into host RAM
+    (cpu backend), quantize leaf-by-leaf to the default device —
+    bit-identical to quantizing the directly-restored tree (an 8B bf16
+    checkpoint must never land whole on the chip it's quantized for)."""
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.ops import quant
+    from skypilot_tpu.train import checkpoint as ckpt
+    cfg = llama.LlamaConfig.tiny()
+    p = llama.init_params(cfg, jax.random.PRNGKey(0))
+    mgr = ckpt.CheckpointManager(str(tmp_path / 'ck'))
+    mgr.save(0, {'params': p})
+    mgr.wait()
+    abstract = jax.eval_shape(
+        lambda: llama.init_params(cfg, jax.random.PRNGKey(0)))
+    host = mgr.restore_to_host({'params': abstract})['params']
+    leaf = jax.tree_util.tree_leaves(host)[0]
+    assert list(leaf.devices())[0].platform == 'cpu'
+    qp = quant.quantize_params_transfer(host)
+    ref = quant.quantize_params(p)
+    for a, b in zip(jax.tree_util.tree_leaves(qp),
+                    jax.tree_util.tree_leaves(ref)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

@@ -74,3 +74,26 @@ def test_pipeline_recipe_refuses_plain_launch(sky_tpu_home):
     recipes.add('pipe', multi)
     with pytest.raises(exceptions.InvalidTaskError, match='pipeline'):
         recipes.launch('pipe')
+
+
+def test_jobs_launch_recipe_cli(sky_tpu_home):
+    """`sky-tpu jobs launch --recipe NAME` (the path the pipeline error
+    message points at) resolves the stored YAML."""
+    from click.testing import CliRunner
+
+    from skypilot_tpu.client import cli as cli_mod
+    recipes.add('cli-pipe', GOOD_YAML + '---\n' +
+                GOOD_YAML.replace('train-tiny', 's2'))
+    runner = CliRunner()
+    # Mutually-exclusive args enforced.
+    r = runner.invoke(cli_mod.cli, ['jobs', 'launch'])
+    assert r.exit_code != 0 and 'exactly one' in r.output
+    r = runner.invoke(cli_mod.cli,
+                      ['jobs', 'launch', 'x.yaml', '--recipe', 'p'])
+    assert r.exit_code != 0 and 'exactly one' in r.output
+    # Recipe resolution happens before the confirm prompt (abort at
+    # the prompt -> the recipe was found and parsed into 2 stages).
+    r = runner.invoke(cli_mod.cli,
+                      ['jobs', 'launch', '--recipe', 'cli-pipe'],
+                      input='n\n')
+    assert '2 stages' in r.output, r.output
