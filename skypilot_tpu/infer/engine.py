@@ -478,3 +478,79 @@ class InferenceEngine:
             'num_waiting': len(self._waiting),
             'num_active': sum(1 for r in self._slots if r is not None),
         }
+
+
+class EnginePool:
+    """Length-routed pool of engines — two-tier KV for long context.
+
+    The dense per-slot cache prices EVERY slot at the pool's longest
+    sequence; serving 16 slots at 16k would cost 16x16k of KV HBM even
+    though most requests are short. A pool routes each request to the
+    smallest engine whose cache fits its prompt, so HBM is
+    sum(slots_i * seq_i) — e.g. 16x2048 + 2x16384 — instead of
+    (16+2)x16384. (A fully paged KV cache is the next refinement; the
+    routing layer is where its block allocator would slot in.)
+
+    Exposes the same surface the server and the multihost lockstep
+    driver use (submit/step/idle/metrics), and the routing is a pure
+    function of the submission order — multi-host lockstep safe.
+    """
+
+    def __init__(self, engines: 'List[InferenceEngine]') -> None:
+        if not engines:
+            raise ValueError('empty engine pool')
+        self.engines = sorted(engines,
+                              key=lambda e: e.ecfg.max_seq_len)
+
+    def submit(self, prompt_tokens: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0) -> Request:
+        n = len(prompt_tokens)
+        for eng in self.engines:
+            if n <= eng.ecfg.max_seq_len - 1:
+                return eng.submit(prompt_tokens, max_new_tokens,
+                                  temperature)
+        raise ValueError(
+            f'prompt ({n} tokens) exceeds every pool tier '
+            f'(largest: {self.engines[-1].ecfg.max_seq_len - 1})')
+
+    def step(self) -> int:
+        return sum(e.step() for e in self.engines)
+
+    def idle(self) -> bool:
+        return all(e.idle() for e in self.engines)
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        for _ in range(max_steps):
+            if self.idle():
+                return
+            self.step()
+
+    def generate(self, prompts, max_new_tokens=None,
+                 temperature: float = 0.0) -> 'List[Request]':
+        reqs = [self.submit(p, max_new_tokens, temperature)
+                for p in prompts]
+        self.run_until_idle()
+        return reqs
+
+    def metrics(self) -> Dict[str, Any]:
+        tiers = [e.metrics() for e in self.engines]
+        # Tiers interleave on the same chip: the honest combined rate
+        # is total tokens over total decode time, NOT the sum of
+        # per-tier rates (which double-counts wall clock); the pool
+        # p50 merges every tier's TTFT window.
+        total_time = sum(e._decode_time for e in self.engines)
+        total_tokens = sum(t['decode_tokens'] for t in tiers)
+        ttfts = sorted(x for e in self.engines for x in e._ttfts)
+        return {
+            'decode_steps': sum(t['decode_steps'] for t in tiers),
+            'decode_tokens': total_tokens,
+            'decode_tokens_per_sec': (total_tokens / total_time
+                                      if total_time else 0.0),
+            'ttft_p50_s': (ttfts[len(ttfts) // 2] if ttfts else None),
+            'num_waiting': sum(t['num_waiting'] for t in tiers),
+            'num_active': sum(t['num_active'] for t in tiers),
+            'tiers': [{'max_seq_len': e.ecfg.max_seq_len,
+                       'n_slots': e.ecfg.n_slots, **t}
+                      for e, t in zip(self.engines, tiers)],
+        }

@@ -135,8 +135,14 @@ class InferenceServer:
                 # warm request is submitted from a side thread because
                 # driver.submit blocks until a tick admits it.
                 def _warm():
-                    req = self.driver.submit([1], max_new_tokens=2)
-                    while not req.done:
+                    reqs = [self.driver.submit([1], max_new_tokens=2)]
+                    if hasattr(self.engine, 'engines'):
+                        tiers = self.engine.engines
+                        for prev in tiers[:-1]:
+                            reqs.append(self.driver.submit(
+                                [1] * prev.ecfg.max_seq_len,
+                                max_new_tokens=2))
+                    while not all(r.done for r in reqs):
                         time.sleep(0.01)
                     logger.info('engine warm in %.1fs',
                                 time.time() - t0)
@@ -144,8 +150,19 @@ class InferenceServer:
                 threading.Thread(target=_warm, daemon=True).start()
                 self.driver.run()
                 return
-            warm = self.engine.submit([1], max_new_tokens=2)
-            while not warm.done:
+            warm_reqs = [self.engine.submit([1], max_new_tokens=2)]
+            if hasattr(self.engine, 'engines'):
+                # Pool: compile every tier before declaring ready (a
+                # long prompt must not eat a multi-second first-compile
+                # mid-traffic).
+                tiers = self.engine.engines
+                for prev, eng in zip(tiers, tiers[1:]):
+                    # A prompt just past the previous tier's cap is
+                    # guaranteed to route to THIS tier.
+                    n = prev.ecfg.max_seq_len
+                    warm_reqs.append(self.engine.submit(
+                        [1] * n, max_new_tokens=2))
+            while not all(w.done for w in warm_reqs):
                 self.engine.step()
             logger.info('engine warm in %.1fs', time.time() - t0)
             self.ready = True
@@ -281,6 +298,14 @@ def main() -> None:
                         help='Orbax checkpoint dir (train/checkpoint.py)')
     parser.add_argument('--slots', type=int, default=8)
     parser.add_argument('--max-seq-len', type=int, default=1024)
+    parser.add_argument('--long-slots', type=int, default=0,
+                        help='Add a second engine pool with this many '
+                             'slots at --long-seq-len: long prompts '
+                             'route there, so HBM is '
+                             'slots*max_seq + long_slots*long_seq '
+                             'instead of every slot paying the '
+                             'longest length (two-tier KV).')
+    parser.add_argument('--long-seq-len', type=int, default=8192)
     parser.add_argument('--tp', type=int, default=1,
                         help='Tensor-parallel degree over local devices '
                              '(8B-class models need tp>=4 on v5e in '
@@ -387,6 +412,25 @@ def main() -> None:
             n_slots=args.slots,
             max_seq_len=min(args.max_seq_len, config.max_seq_len),
             tp=args.tp, quantize=args.quantize))
+    if args.long_slots > 0:
+        short_cap = min(args.max_seq_len, config.max_seq_len)
+        long_cap = min(args.long_seq_len, config.max_seq_len)
+        if long_cap <= short_cap:
+            raise SystemExit(
+                f'--long-seq-len ({args.long_seq_len}, clamped to '
+                f'{long_cap} by the model) must exceed --max-seq-len '
+                f'({short_cap}); equal or inverted tiers would break '
+                f'routing')
+        # Two-tier KV (EnginePool): same params object — the weights
+        # are shared; only the KV caches differ.
+        long_engine = engine_lib.InferenceEngine(
+            config, engine.params,
+            engine_lib.EngineConfig(
+                n_slots=args.long_slots,
+                max_seq_len=long_cap,
+                tp=args.tp, quantize=False),   # params already int8
+            seed=1)
+        engine = engine_lib.EnginePool([engine, long_engine])
     driver = None
     if world > 1:
         driver = multihost.MultihostEngineDriver(engine)

@@ -325,3 +325,29 @@ def test_quantized_tp_engine_matches_single_device(params):
     for la, lb in zip(jax.tree_util.tree_leaves(a),
                       jax.tree_util.tree_leaves(b)):
         assert jnp.array_equal(la, jnp.asarray(lb)), 'sharded init drifted'
+
+
+def test_engine_pool_two_tier_routing(params):
+    """EnginePool: requests route to the smallest tier whose cache fits
+    the prompt; outputs equal single-engine greedy (two-tier KV for
+    long-context serving)."""
+    from skypilot_tpu.infer.engine import EnginePool
+    short = InferenceEngine(CFG, params,
+                            EngineConfig(n_slots=2, max_seq_len=32,
+                                         prefill_buckets=(8,)))
+    long = InferenceEngine(CFG, params,
+                           EngineConfig(n_slots=1, max_seq_len=64,
+                                        prefill_buckets=(8,)), seed=1)
+    pool = EnginePool([long, short])   # ctor sorts by seq len
+    assert [e.ecfg.max_seq_len for e in pool.engines] == [32, 64]
+    p_short = [5, 17, 101, 7]
+    p_long = [(i * 7 + 3) % 250 for i in range(40)]   # > 31 -> long tier
+    reqs = pool.generate([p_short, p_long], max_new_tokens=5)
+    assert reqs[0].output_tokens == _oracle_greedy(params, p_short, 5)
+    assert reqs[1].output_tokens == _oracle_greedy(params, p_long, 5)
+    # Routing proof: the long request occupied the long engine.
+    assert pool.engines[1].metrics()['decode_tokens'] > 0
+    m = pool.metrics()
+    assert len(m['tiers']) == 2 and m['num_active'] == 0
+    with pytest.raises(ValueError, match='every pool tier'):
+        pool.submit(list(range(70)))
