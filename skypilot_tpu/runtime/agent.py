@@ -81,6 +81,10 @@ class Agent:
         self._autostop_file = os.path.join(self.cluster_dir, 'autostop.json')
         # job_id -> list of subprocess handles (local-slice mode)
         self._procs: Dict[int, List[asyncio.subprocess.Process]] = {}
+        # /exec invocations get unique negative ids so their proc/pgid
+        # bookkeeping is cleaned per call (a shared -1 key would
+        # accumulate handles forever on exec-heavy clusters).
+        self._exec_counter = 0
         self._cancelled: set = set()
         # Native orphan reaper (native/reaper.cc): if this agent is
         # SIGKILLed mid-job, the rank process groups recorded in the
@@ -128,6 +132,34 @@ class Agent:
         try:
             with open(self._pgid_file, 'a', encoding='utf-8') as f:
                 f.write(f'{pid}\n')
+        except OSError:
+            pass
+
+    def _prune_pgids(self, pids) -> None:
+        """Drop finished ranks' pgids from the reaper file — but ONLY
+        groups that are really gone: a rank leader can exit while a
+        backgrounded child keeps the group alive, and that survivor
+        must stay covered by the reaper/teardown (it could be holding
+        libtpu). Entries only ever accumulated before, which was the
+        opposite hazard: teardown acting on pids the OS had recycled."""
+        gone = set()
+        for p in pids:
+            try:
+                os.killpg(int(p), 0)
+            except ProcessLookupError:
+                gone.add(str(p))
+            except PermissionError:
+                pass   # group alive (not ours to probe): keep covered
+        if not gone:
+            return
+        try:
+            with open(self._pgid_file, encoding='utf-8') as f:
+                live = [ln for ln in f.read().split()
+                        if ln and ln not in gone]
+            tmp = self._pgid_file + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                f.write(''.join(f'{ln}\n' for ln in live))
+            os.replace(tmp, self._pgid_file)
         except OSError:
             pass
 
@@ -233,7 +265,8 @@ class Agent:
                 f.write(f'{e!r}\n')
             self.jobs.set_status(job_id, job_lib.JobStatus.FAILED)
         finally:
-            self._procs.pop(job_id, None)
+            procs = self._procs.pop(job_id, None) or []
+            self._prune_pgids(p.pid for p in procs)
 
     async def _fan_out(self, job_id: int, cmd: str, envs: Dict[str, str],
                        log_dir: str, phase: str) -> List[int]:
@@ -464,10 +497,17 @@ class Agent:
     async def h_exec(self, req: web.Request) -> web.Response:
         """Synchronous command on all hosts (setup / pre-exec stages)."""
         body = await req.json()
+        self._exec_counter += 1
+        exec_id = -self._exec_counter
         log_dir = os.path.join(self.cluster_dir, 'exec_logs',
                                str(int(time.time() * 1000)))
-        rcs = await self._fan_out(-1, body['cmd'], body.get('envs', {}),
-                                  log_dir, 'exec')
+        try:
+            rcs = await self._fan_out(exec_id, body['cmd'],
+                                      body.get('envs', {}),
+                                      log_dir, 'exec')
+        finally:
+            procs = self._procs.pop(exec_id, None) or []
+            self._prune_pgids(p.pid for p in procs)
         tails = {}
         for r in range(len(rcs)):
             p = os.path.join(log_dir, f'rank{r}_exec.log')
@@ -481,11 +521,21 @@ class Agent:
         body = await req.json()
         log_dir = os.path.join(self.cluster_dir, 'job_logs',
                                str(body['job_id']))
+        job_id = int(body['job_id'])
         rc = await self._run_rank(
-            int(body['job_id']), self.host_rank, body['cmd'],
+            job_id, self.host_rank, body['cmd'],
             body.get('envs', {}),
             os.path.join(log_dir,
                          f'rank{self.host_rank}_{body["phase"]}.log'))
+        # Peers have no _run_job finally: clean this call's handle and
+        # reaper entry here or they accumulate for the agent's lifetime.
+        procs = self._procs.get(job_id, [])
+        done = [p for p in procs if p.returncode is not None]
+        for p in done:
+            procs.remove(p)
+        if not procs:
+            self._procs.pop(job_id, None)
+        self._prune_pgids(p.pid for p in done)
         return web.json_response({'returncode': rc})
 
     async def h_autostop(self, req: web.Request) -> web.Response:

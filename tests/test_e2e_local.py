@@ -276,3 +276,28 @@ run: echo two
     finally:
         for name in names:
             core.down(name)
+
+
+def test_finished_job_pgids_pruned(sky_tpu_home):
+    """The agent removes a finished job's process groups from the
+    reaper file — stale entries could SIGKILL recycled pids at
+    teardown (round-4 hygiene)."""
+    from skypilot_tpu import execution
+    task = sky.Task('pg', run='true',
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-1'))
+    job_id, info = execution.launch(task, 'pgc')
+    try:
+        client = core._client_for('pgc')  # noqa: SLF001
+        assert client.wait_job(job_id, timeout=120).value == 'SUCCEEDED'
+        pgid_file = os.path.join(sky_tpu_home, 'clusters', 'pgc',
+                                 'job_pgids')
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            content = open(pgid_file, encoding='utf-8').read().split()
+            if not content:
+                break
+            time.sleep(0.2)
+        assert content == [], f'stale pgids remain: {content}'
+    finally:
+        core.down('pgc')
