@@ -85,16 +85,39 @@ def test_reaper_exits_clean_with_no_jobs(reaper_bin, tmp_path):
 
 
 def test_agent_records_pgids_and_reaper_spawns(sky_tpu_home):
-    """The real agent starts a reaper and records rank pgids."""
+    """The agent records rank pgids WHILE a job runs, and prunes the
+    dead groups once it finishes (round-4: entries no longer
+    accumulate — stale pids would be a pid-reuse kill hazard)."""
+    import time
+
     import skypilot_tpu as sky
     from skypilot_tpu import core
 
-    task = sky.Task('reap', run='sleep 0.1',
+    task = sky.Task('reap', run='sleep 5',
                     resources=sky.Resources(cloud='local',
                                             accelerators='v5e-4'))
     _, info = core.launch(task, cluster_name='reap-c', quiet=True)
-    core.wait_job('reap-c', 1, timeout=60)
-    cdir = os.path.join(sky_tpu_home, 'clusters', 'reap-c')
-    pgids = open(os.path.join(cdir, 'job_pgids')).read().split()
-    assert len(pgids) >= 1          # one rank recorded
-    core.down('reap-c')
+    try:
+        cdir = os.path.join(sky_tpu_home, 'clusters', 'reap-c')
+        pgid_file = os.path.join(cdir, 'job_pgids')
+        deadline = time.time() + 30
+        recorded = []
+        while time.time() < deadline:
+            try:
+                recorded = open(pgid_file).read().split()
+            except FileNotFoundError:
+                recorded = []
+            if recorded:
+                break
+            time.sleep(0.1)
+        assert recorded, 'no rank pgid recorded while the job ran'
+        core.wait_job('reap-c', 1, timeout=60)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            left = open(pgid_file).read().split()
+            if not left:
+                break
+            time.sleep(0.2)
+        assert left == [], f'dead pgids not pruned: {left}'
+    finally:
+        core.down('reap-c')
