@@ -311,9 +311,13 @@ def _jobs_engine():
 
         class _SdkJobs:
             launch = staticmethod(
-                lambda task, name=None: sdk.jobs_launch(task, name))
+                lambda task, name=None, pool=None:
+                sdk.jobs_launch(task, name, pool=pool))
             queue = staticmethod(sdk.jobs_queue)
             cancel = staticmethod(sdk.jobs_cancel)
+            pool_apply = staticmethod(sdk.jobs_pool_apply)
+            pool_status = staticmethod(sdk.jobs_pool_status)
+            pool_down = staticmethod(sdk.jobs_pool_down)
         return _SdkJobs
     from skypilot_tpu import jobs as jobs_lib
     return jobs_lib
@@ -325,16 +329,21 @@ def _jobs_engine():
               help='Launch a stored recipe instead of a YAML file '
                    '(pipelines supported).')
 @click.option('--name', '-n', default=None, help='Job name.')
+@click.option('--pool', '-p', default=None,
+              help='Run on a claimed worker from this pre-provisioned '
+                   'pool instead of provisioning a cluster '
+                   '(sky-tpu jobs pool apply).')
 @click.option('--env', multiple=True, help='KEY=VALUE env override.')
 @click.option('--yes', '-y', is_flag=True, default=False)
 def jobs_launch(task_yaml: Optional[str], recipe: Optional[str],
-                name: Optional[str], env: tuple,
+                name: Optional[str], pool: Optional[str], env: tuple,
                 yes: bool) -> None:
     """Submit a managed job (auto-recovers on preemption).
 
     A multi-document YAML submits a managed PIPELINE: stages run
     sequentially, each with its own cluster and per-stage recovery.
     --recipe NAME launches a stored template (sky-tpu recipe ls).
+    --pool NAME runs on an idle worker of a pre-provisioned pool.
     """
     from skypilot_tpu.utils import dag_utils
     if (task_yaml is None) == (recipe is None):
@@ -361,17 +370,97 @@ def jobs_launch(task_yaml: Optional[str], recipe: Optional[str],
                 f'Submitting managed pipeline '
                 f'{name or dag.name or task_yaml} '
                 f'({len(dag)} stages: {stages}). Proceed?', abort=True)
-        job_id = _jobs_engine().launch(dag, name=name)
+        job_id = _jobs_engine().launch(dag, name=name, pool=pool)
     else:
         task = dag.tasks[0]
         if not yes:
+            where = (f'pool {pool}' if pool
+                     else repr(task.resources))
             click.confirm(
                 f'Submitting managed job {name or task.name or task_yaml} '
-                f'({task.resources!r}). Proceed?', abort=True)
-        job_id = _jobs_engine().launch(task, name=name)
+                f'({where}). Proceed?', abort=True)
+        job_id = _jobs_engine().launch(task, name=name, pool=pool)
     click.echo(f'Managed job: {job_id}')
     click.echo(f'Watch: sky-tpu jobs queue   '
                f'logs: sky-tpu jobs logs {job_id}')
+
+
+@jobs.group('pool')
+def jobs_pool() -> None:
+    """Worker pools: pre-provisioned clusters that managed jobs reuse."""
+
+
+@jobs_pool.command('apply')
+@click.argument('pool_yaml', required=False)
+@click.option('--pool', '-p', 'pool_name', default=None,
+              help='Pool name (defaults to the task name).')
+@click.option('--workers', type=int, default=None,
+              help='Override (or, without YAML, resize to) this many '
+                   'workers.')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_pool_apply_cmd(pool_yaml: Optional[str],
+                        pool_name: Optional[str],
+                        workers: Optional[int], yes: bool) -> None:
+    """Create/update a worker pool from YAML, or resize with --workers.
+
+    The YAML needs a `pool:` section (pool: {workers: N}) instead of
+    `service:`; `setup:` pre-bakes each worker once, and jobs launched
+    with `--pool NAME` bring their own `run` command.
+    """
+    task = None
+    if pool_yaml is not None:
+        from skypilot_tpu import task as task_lib
+        task = task_lib.Task.from_yaml(pool_yaml)
+    elif workers is None or pool_name is None:
+        raise click.UsageError('pass POOL_YAML, or both --pool NAME and '
+                               '--workers N to resize')
+    if not yes:
+        what = (f'apply {pool_yaml}' if task is not None
+                else f'resize to {workers} workers')
+        click.confirm(f'Pool {pool_name or (task and task.name)}: '
+                      f'{what}. Proceed?', abort=True)
+    out = _jobs_engine().pool_apply(task, pool_name=pool_name,
+                                    workers=workers)
+    click.echo(f'Pool {out["name"]}: {out["workers"]} workers '
+               f'(version {out["version"]})')
+    click.echo(f'Watch: sky-tpu jobs pool status {out["name"]}   '
+               f'launch onto it: sky-tpu jobs launch --pool '
+               f'{out["name"]} task.yaml')
+
+
+@jobs_pool.command('status')
+@click.argument('pool_names', nargs=-1)
+def jobs_pool_status_cmd(pool_names: tuple) -> None:
+    """Show pool(s) and their workers' job assignments."""
+    snaps = _jobs_engine().pool_status(list(pool_names) or None)
+    if not snaps:
+        click.echo('No pools.')
+        return
+    for s in snaps:
+        click.echo(f'{s["name"]}: {s["status"]}  '
+                   f'ready {s["ready_replicas"]}/{s["target_workers"]}  '
+                   f'idle {s["idle_workers"]}')
+        fmt = '  {:<4} {:<24} {:<14} {:<10}'
+        click.echo(fmt.format('ID', 'CLUSTER', 'STATUS', 'JOB'))
+        for r in s['replicas']:
+            click.echo(fmt.format(
+                r['replica_id'], (r['cluster_name'] or '')[:24],
+                r['status'],
+                r['assigned_job'] if r['assigned_job'] else 'idle'))
+
+
+@jobs_pool.command('down')
+@click.argument('pool_name')
+@click.option('--purge', is_flag=True, default=False,
+              help='Force-clean a pool whose controller died.')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_pool_down_cmd(pool_name: str, purge: bool, yes: bool) -> None:
+    """Tear down a pool and all its workers."""
+    if not yes:
+        click.confirm(f'Tear down pool {pool_name} and all its workers?',
+                      abort=True)
+    _jobs_engine().pool_down(pool_name, purge=purge)
+    click.echo(f'Pool {pool_name}: down.')
 
 
 @jobs.command('queue')

@@ -379,16 +379,17 @@ def cost_report() -> List[Dict[str, Any]]:
 
 
 # ---- managed jobs (reference sky/jobs/client/sdk.py) ---------------------
-def jobs_launch(task, name: Optional[str] = None) -> int:
+def jobs_launch(task, name: Optional[str] = None,
+                pool: Optional[str] = None) -> int:
     """Submit a managed job (Task) or pipeline (Dag)."""
     from skypilot_tpu import dag as dag_lib
     if isinstance(task, dag_lib.Dag):
         from skypilot_tpu.utils import dag_utils
         return get(_post('jobs.launch', {
             'dag_yaml': dag_utils.dump_dag_to_yaml_str(task),
-            'name': name}))
+            'name': name, 'pool': pool}))
     return get(_post('jobs.launch', {'task': task.to_yaml_config(),
-                                     'name': name}))
+                                     'name': name, 'pool': pool}))
 
 
 def jobs_queue() -> List[Dict[str, Any]]:
@@ -397,6 +398,25 @@ def jobs_queue() -> List[Dict[str, Any]]:
 
 def jobs_cancel(job_id: int) -> bool:
     return get(_post('jobs.cancel', {'job_id': job_id}))
+
+
+# ---- jobs worker pools (reference `sky jobs pool ...`) -------------------
+def jobs_pool_apply(task=None, pool_name: Optional[str] = None,
+                    workers: Optional[int] = None) -> Dict[str, Any]:
+    payload: Dict[str, Any] = {'pool_name': pool_name, 'workers': workers}
+    if task is not None:
+        payload['task'] = task.to_yaml_config()
+    return get(_post('jobs.pool_apply', payload))
+
+
+def jobs_pool_status(pool_names: Optional[List[str]] = None
+                     ) -> List[Dict[str, Any]]:
+    return get(_post('jobs.pool_status', {'pool_names': pool_names}))
+
+
+def jobs_pool_down(pool_name: str, purge: bool = False) -> None:
+    return get(_post('jobs.pool_down', {'pool_name': pool_name,
+                                        'purge': purge}))
 
 
 # ---- serve (reference sky/serve/client/sdk.py) ---------------------------

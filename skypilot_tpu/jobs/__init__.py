@@ -22,10 +22,15 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.jobs.state import ManagedJobStatus  # noqa: F401 (public)
+# Worker pools (reference `sky jobs pool ...`).
+from skypilot_tpu.jobs.pool import apply as pool_apply  # noqa: F401
+from skypilot_tpu.jobs.pool import down as pool_down  # noqa: F401
+from skypilot_tpu.jobs.pool import status as pool_status  # noqa: F401
 
 
 def launch(task: Union[task_lib.Task, dag_lib.Dag],
-           name: Optional[str] = None) -> int:
+           name: Optional[str] = None,
+           pool: Optional[str] = None) -> int:
     """Submit a managed job; returns its job id immediately.
 
     A ``Dag`` submits a managed **pipeline**: the controller runs its
@@ -33,7 +38,16 @@ def launch(task: Union[task_lib.Task, dag_lib.Dag],
     preemption recovery — a preempted stage resumes without re-running
     finished ones (reference sky/jobs/server/core.py:500 +
     sky/jobs/controller.py:215 iterating ``dag.tasks``).
+
+    ``pool`` runs the job on a claimed worker from a pre-provisioned
+    worker pool instead of provisioning a cluster (reference
+    `sky jobs launch --pool`, sky/jobs/server/core.py:279-281).
     """
+    if pool is not None:
+        from skypilot_tpu.serve import state as serve_state
+        record = serve_state.get_service(pool)
+        if record is None or not record.get('pool'):
+            raise exceptions.JobNotFoundError(f'pool {pool!r}')
     if isinstance(task, dag_lib.Dag):
         dag = task
         if len(dag) == 0:
@@ -50,11 +64,12 @@ def launch(task: Union[task_lib.Task, dag_lib.Dag],
         return scheduler.submit_job(
             job_name, dag_utils.dump_dag_to_yaml_str(dag),
             resources_str=repr(dag.tasks[0].resources),
-            tasks=stages)
+            tasks=stages, pool=pool)
     job_name = name or task.name or 'managed-job'
     task.name = job_name
     return scheduler.submit_job(job_name, task.to_yaml(),
-                                resources_str=repr(task.resources))
+                                resources_str=repr(task.resources),
+                                pool=pool)
 
 
 def queue(refresh: bool = True) -> List[Dict[str, Any]]:

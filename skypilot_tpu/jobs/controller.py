@@ -73,6 +73,9 @@ class JobController:
         # relaunch reuses the pre-crash cluster instead of orphaning it.
         base = record['name'] or 'job'
         self.base_cluster_name = f'{base}-mj-{job_id}'
+        # Worker pool the job runs on (reference sky/jobs/state.py:141):
+        # set ⇒ stages exec onto claimed pool workers, no provisioning.
+        self.pool: Optional[str] = record.get('pool')
         # Per-stage context, bound by _prepare_stage().
         self.task_id = 0
         self.task: Optional[task_lib.Task] = None
@@ -89,11 +92,22 @@ class JobController:
         self.task_id = row['task_id']
         self.task = task_lib.Task.from_yaml_config(
             yaml.safe_load(row['task_yaml']))
-        self.cluster_name = (self.base_cluster_name
-                             if len(self.task_rows) == 1 else
-                             f'{self.base_cluster_name}-t{self.task_id}')
-        self.strategy = recovery_strategy.StrategyExecutor.make(
-            self.job_id, self.task, self.cluster_name)
+        if self.pool:
+            # Cluster name is whatever worker gets claimed at launch.
+            self.cluster_name = ''
+            spec = self.task.resources.job_recovery
+            max_restarts = (int(spec.get('max_restarts_on_errors', 0))
+                            if isinstance(spec, dict) else 0)
+            self.strategy = recovery_strategy.PoolStrategyExecutor(
+                self.job_id, self.task, self.pool,
+                max_restarts_on_errors=max_restarts)
+        else:
+            self.cluster_name = (
+                self.base_cluster_name
+                if len(self.task_rows) == 1 else
+                f'{self.base_cluster_name}-t{self.task_id}')
+            self.strategy = recovery_strategy.StrategyExecutor.make(
+                self.job_id, self.task, self.cluster_name)
         self.cluster_job_id = -1
         self.last_placement = None
 
@@ -114,14 +128,7 @@ class JobController:
 
     def _provider_alive(self, info: ClusterInfo) -> bool:
         """Provider-plane health: all slice hosts RUNNING."""
-        try:
-            live = provision.get_cluster_info(info.cloud, info.cluster_name,
-                                              info.provider_config)
-        except Exception:  # noqa: BLE001 — treat probe errors as unknown
-            return True  # don't recover on a flaky control-plane probe
-        if live is None:
-            return False
-        return all(h.state == 'RUNNING' for h in live.hosts)
+        return provision.probe_cluster_running(info)
 
     def _job_status(self, info: ClusterInfo
                     ) -> Optional[common.JobStatus]:
@@ -173,6 +180,9 @@ class JobController:
             job_id, info = self.strategy.launch()
         self.cluster_job_id = job_id
         self.last_placement = (info.region, info.zone)
+        # Pool jobs: the strategy binds the claimed worker's cluster name
+        # at launch/recover time.
+        self.cluster_name = self.strategy.cluster_name
         jobs_state.set_cluster(self.job_id, self.cluster_name, job_id)
         jobs_state.set_task_cluster(self.job_id, self.task_id,
                                     self.cluster_name, job_id)

@@ -164,6 +164,134 @@ class StrategyExecutor:
         raise NotImplementedError
 
 
+# exec-on-worker failures that mean "this worker is gone", not "this
+# task can never run": retried on another worker. Everything else (e.g.
+# ResourcesMismatchError) is deterministic and fails the job.
+def _transient_exec_errors():
+    import requests
+    return (exceptions.ClusterDoesNotExist, exceptions.ClusterNotUpError,
+            exceptions.CommandError, requests.RequestException,
+            ConnectionError, TimeoutError, OSError)
+
+
+_TRANSIENT_EXEC_ERRORS = _transient_exec_errors()
+
+
+class PoolStrategyExecutor(StrategyExecutor):
+    """Run the job on a pre-provisioned worker from a named pool instead
+    of launching a cluster (reference: `sky jobs launch --pool`,
+    scheduling at sky/jobs/server/core.py:279-281).
+
+    launch = claim an idle READY worker + ``execution.exec`` the task on
+    it (no provisioning); terminate = release the worker back to the
+    pool (workers outlive jobs — that is the point); recover = release
+    the dead/failed worker and claim another, while the pool's own
+    controller replaces the dead slice in the background.
+    """
+
+    NAME = 'POOL'
+
+    def __init__(self, job_id: int, task: task_lib.Task, pool: str,
+                 max_restarts_on_errors: int = 0):
+        super().__init__(job_id, task, cluster_name='',
+                         max_restarts_on_errors=max_restarts_on_errors)
+        self.pool = pool
+        self.replica_id: Optional[int] = None
+        # The worker a recovery just walked away from: skipped on the
+        # next acquire until the pool controller reaps it.
+        self._avoid_replica: Optional[int] = None
+
+    def launch(self, recovery_count: int = 0,
+               blocked: Optional[List[Tuple[str, str]]] = None
+               ) -> Tuple[int, ClusterInfo]:
+        del blocked  # placement is the pool's concern, not the job's
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.serve import state as serve_state
+        self._inject_job_envs(recovery_count)
+        poll_s = float(os.environ.get('SKY_TPU_POOL_ACQUIRE_POLL_S', '2'))
+        rounds = 0
+        while True:
+            if jobs_state.cancel_requested(self.job_id):
+                raise exceptions.RequestCancelled(
+                    f'managed job {self.job_id} cancelled while waiting '
+                    f'for a pool worker')
+            if serve_state.get_service(self.pool) is None:
+                raise exceptions.ManagedJobReachedMaxRetriesError(
+                    f'job {self.job_id}: pool {self.pool!r} no longer '
+                    f'exists')
+            worker = serve_state.acquire_pool_worker(
+                self.pool, self.job_id,
+                exclude_replica=self._avoid_replica)
+            if worker is None:
+                rounds += 1
+                if rounds % 30 == 1:
+                    logger.info('job %s: waiting for an idle worker in '
+                                'pool %s', self.job_id, self.pool)
+                time.sleep(poll_s)
+                continue
+            self.replica_id = worker['replica_id']
+            self.cluster_name = worker['cluster_name']
+            try:
+                return execution.exec(self.task, self.cluster_name,
+                                      backend=self.backend,
+                                      detach_run=True)
+            except _TRANSIENT_EXEC_ERRORS as e:
+                # Worker died between READY and exec (cluster record
+                # gone, agent unreachable): release, shun it until the
+                # pool controller reaps it, try another.
+                logger.warning(
+                    'job %s: exec on pool worker %s failed (%s); '
+                    'releasing and retrying', self.job_id,
+                    self.cluster_name, e)
+                serve_state.release_pool_worker(self.replica_id)
+                self._avoid_replica = self.replica_id
+                self.replica_id = None
+                time.sleep(poll_s)
+            except exceptions.ResourcesMismatchError as e:
+                # Deterministic: the task demands more than the pool's
+                # workers have — identical on every worker, so fail the
+                # job as no-resource rather than spin forever.
+                serve_state.release_pool_worker(self.replica_id)
+                self.replica_id = None
+                raise exceptions.ManagedJobReachedMaxRetriesError(
+                    f'job {self.job_id}: pool {self.pool!r} cannot '
+                    f'satisfy the task resources: {e}') from e
+            except Exception:
+                # Unknown failure: also deterministic until proven
+                # otherwise — release and surface it.
+                serve_state.release_pool_worker(self.replica_id)
+                self.replica_id = None
+                raise
+
+    def terminate_cluster(self) -> None:
+        """Release the worker — never tear down pool infrastructure."""
+        from skypilot_tpu.serve import state as serve_state
+        if self.replica_id is None:
+            return
+        serve_state.release_pool_worker(self.replica_id)
+        self.replica_id = None
+
+    def _worker_alive(self) -> bool:
+        from skypilot_tpu import provision
+        record = global_state.get_cluster(self.cluster_name)
+        if record is None or not record.get('cluster_info'):
+            return False
+        return provision.probe_cluster_running(
+            ClusterInfo.from_dict(record['cluster_info']))
+
+    def recover(self, recovery_count: int,
+                last_placement: Optional[Tuple[str, str]]
+                ) -> Tuple[int, ClusterInfo]:
+        del last_placement
+        # Only shun the worker if its slice is actually dead — a user-code
+        # retry on a healthy worker may (and with a 1-worker pool, must)
+        # reuse the same worker.
+        self._avoid_replica = (None if self._worker_alive()
+                               else self.replica_id)
+        self.terminate_cluster()
+        return self.launch(recovery_count=recovery_count)
+
+
 @_register('FAILOVER')
 class FailoverStrategyExecutor(StrategyExecutor):
     """Retry the same placement first, then fail over elsewhere

@@ -72,11 +72,11 @@ def _scheduler_lock():
 
 
 def submit_job(name: str, task_yaml: str, resources_str: str = '',
-               tasks=None) -> int:
+               tasks=None, pool=None) -> int:
     """Record the job (and its pipeline stages, if any) and start its
     controller if a slot is free."""
     job_id = jobs_state.submit_job(name, task_yaml, resources_str,
-                                   tasks=tasks)
+                                   tasks=tasks, pool=pool)
     maybe_schedule_next()
     return job_id
 
@@ -163,6 +163,11 @@ def reconcile() -> Optional[int]:
                     job['job_id'],
                     jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
                     failure_reason='controller process died')
+                if job.get('pool'):
+                    # Free the worker the dead controller was holding.
+                    from skypilot_tpu.serve import state as serve_state
+                    serve_state.release_pool_workers_for_job(
+                        job['job_id'])
                 jobs_state.set_schedule_state(job['job_id'],
                                               ScheduleState.DONE)
                 # Mirror onto the stage rows, as the controller's own

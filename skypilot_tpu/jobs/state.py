@@ -80,7 +80,8 @@ CREATE TABLE IF NOT EXISTS jobs (
     cancel_requested INTEGER DEFAULT 0,
     controller_pid INTEGER,
     cluster_job_id INTEGER DEFAULT -1,
-    resources_str TEXT
+    resources_str TEXT,
+    pool TEXT
 );
 CREATE TABLE IF NOT EXISTS job_tasks (
     job_id INTEGER,
@@ -99,9 +100,35 @@ CREATE TABLE IF NOT EXISTS job_tasks (
 """
 
 
+_migrated = set()
+
+
 def _db() -> db_util.Db:
-    return db_util.get_db(os.path.join(common.base_dir(),
-                                       'managed_jobs.db'), _SCHEMA)
+    db = db_util.get_db(os.path.join(common.base_dir(),
+                                     'managed_jobs.db'), _SCHEMA)
+    if db.path not in _migrated:
+        # Round-5 `pool` column on pre-existing DBs (reference keeps
+        # `pool`/`job_id_on_pool_cluster` on the job row the same way,
+        # sky/jobs/state.py:141-148; cluster_job_id doubles as
+        # job_id_on_pool_cluster here — for a pool job the "cluster" IS
+        # the pool worker).
+        try:
+            db.conn.execute('SELECT pool FROM jobs LIMIT 1')
+        except Exception:  # noqa: BLE001 — old schema
+            try:
+                db.conn.rollback()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                db.conn.execute('ALTER TABLE jobs ADD COLUMN pool TEXT')
+                db.conn.commit()
+            except Exception:  # noqa: BLE001 — concurrent migrator won
+                try:
+                    db.conn.rollback()
+                except Exception:  # noqa: BLE001
+                    pass
+        _migrated.add(db.path)
+    return db
 
 
 def jobs_dir(job_id: int) -> str:
@@ -116,18 +143,21 @@ def controller_log_path(job_id: int) -> str:
 
 # ---- submission ----------------------------------------------------------
 def submit_job(name: str, task_yaml: str, resources_str: str = '',
-               tasks: Optional[List[Dict[str, str]]] = None) -> int:
+               tasks: Optional[List[Dict[str, str]]] = None,
+               pool: Optional[str] = None) -> int:
     """Record a managed job. ``tasks`` is the per-stage list
     ``[{'name':..., 'task_yaml':...}, ...]`` — one entry for a plain job,
     several for a pipeline (reference sky/jobs/state.py keeps one `spot`
     row per (job_id, task_id) the same way). ``task_yaml`` on the job row
-    is the original (possibly multi-document) submission."""
+    is the original (possibly multi-document) submission. ``pool`` names
+    a worker pool the job runs on instead of provisioning its own
+    cluster (reference sky/jobs/state.py:141)."""
     conn = _db().conn
     cur = conn.execute(
         'INSERT INTO jobs (name, task_yaml, status, schedule_state, '
-        'submitted_at, resources_str) VALUES (?,?,?,?,?,?)',
+        'submitted_at, resources_str, pool) VALUES (?,?,?,?,?,?,?)',
         (name, task_yaml, ManagedJobStatus.PENDING.value,
-         ScheduleState.WAITING.value, time.time(), resources_str))
+         ScheduleState.WAITING.value, time.time(), resources_str, pool))
     job_id = int(cur.lastrowid)
     if tasks is None:
         tasks = [{'name': name, 'task_yaml': task_yaml}]

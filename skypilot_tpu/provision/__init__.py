@@ -63,6 +63,25 @@ def get_cluster_info(cloud: str, cluster_name: str,
     return _impl(cloud).get_cluster_info(cluster_name, provider_config)
 
 
+def probe_cluster_running(info: ClusterInfo) -> bool:
+    """Provider-plane liveness: every slice host RUNNING.
+
+    The one preemption-detection predicate (SURVEY.md "hard parts":
+    no NCCL-timeout signal on TPU — the provider's view of the slice is
+    authoritative). A probe *error* is treated as alive: a flaky
+    control-plane call must not trigger recovery. Shared by the managed-
+    jobs controller, the serve replica manager, and the pool strategy.
+    """
+    try:
+        live = get_cluster_info(info.cloud, info.cluster_name,
+                                info.provider_config)
+    except Exception:  # noqa: BLE001 — flaky probe ≠ dead slice
+        return True
+    if live is None:
+        return False
+    return all(h.state == 'RUNNING' for h in live.hosts)
+
+
 def open_ports(cloud: str, cluster_name: str, ports,
                provider_config: Dict[str, Any]) -> None:
     return _impl(cloud).open_ports(cluster_name, ports, provider_config)

@@ -36,7 +36,24 @@ def _validate(task: task_lib.Task) -> spec_lib.ServiceSpec:
         raise exceptions.InvalidTaskError(
             'a service task needs a `run` command that starts the '
             'workload server')
-    return spec_lib.ServiceSpec.from_config(task.service)
+    spec = spec_lib.ServiceSpec.from_config(task.service)
+    if spec.pool:
+        # `pool` in ServiceSpec exists only to round-trip the stored
+        # spec_json of worker pools; user YAML creates pools via the
+        # `pool:` section + `jobs pool apply`, never through serve.
+        raise exceptions.InvalidTaskError(
+            'service: may not set pool; use a top-level `pool:` section '
+            'with `jobs pool apply` to create a worker pool')
+    return spec
+
+
+def _require_service(service_name: str) -> Dict[str, Any]:
+    record = serve_state.get_service(service_name)
+    if record is None or record.get('pool'):
+        # Pools share the state tables but not the serve surface —
+        # `jobs pool status/down` is their control path.
+        raise exceptions.JobNotFoundError(f'service {service_name!r}')
+    return record
 
 
 def up(task: task_lib.Task, service_name: Optional[str] = None,
@@ -65,9 +82,7 @@ def update(task: task_lib.Task, service_name: str) -> int:
     """Roll the service to a new task/spec version (reference
     sky/serve/server/core.py:49). Returns the new version."""
     spec = _validate(task)
-    record = serve_state.get_service(service_name)
-    if record is None:
-        raise exceptions.JobNotFoundError(f'service {service_name!r}')
+    _require_service(service_name)
     version = serve_state.update_service_spec(
         service_name, json.dumps(spec.to_config()), task.to_yaml())
     return version
@@ -76,9 +91,7 @@ def update(task: task_lib.Task, service_name: str) -> int:
 def down(service_name: str, *, purge: bool = False,
          timeout: float = 120.0) -> None:
     """Tear a service down: replicas, then the service row itself."""
-    record = serve_state.get_service(service_name)
-    if record is None:
-        raise exceptions.JobNotFoundError(f'service {service_name!r}')
+    record = _require_service(service_name)
     serve_state.request_shutdown(service_name)
     pid = record.get('controller_pid')
     alive = common.pid_alive(pid)
@@ -128,12 +141,13 @@ def restart_replica(service_name: str, replica_id: int) -> None:
 def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
     """Snapshot of one or all services (reference serve status)."""
     if service_name is not None:
+        _require_service(service_name)
         snap = controller_lib.service_snapshot(service_name)
         if snap is None:
             raise exceptions.JobNotFoundError(f'service {service_name!r}')
         return [snap]
     snaps = (controller_lib.service_snapshot(s['name'])
-             for s in serve_state.get_services())
+             for s in serve_state.get_services(pool=False))
     # A service removed between the listing and the snapshot read (e.g. a
     # controller finishing `down`) yields None — drop it.
     return [s for s in snaps if s is not None]

@@ -64,6 +64,15 @@ class ServeController:
             self.autoscaler.target_num_replicas = max(
                 self.spec.replica_policy.min_replicas, old_target)
 
+    def _scale_down_victims(self, group: list, n: int) -> list:
+        """Scale-down victims. For pools, a worker with a job assigned is
+        never a victim — the target shrinks as workers go idle on later
+        ticks (reference pools drain idle workers first)."""
+        if self.spec.pool:
+            group = [r for r in group if not r.get('assigned_job')]
+            n = min(n, len(group))
+        return autoscalers_lib.select_replicas_to_scale_down(group, n)
+
     def _reconcile_kind(self, group: list, target: int, use_spot: bool,
                         reason: str) -> None:
         """Bring one kind (spot / on-demand) of the current-version fleet
@@ -76,8 +85,7 @@ class ServeController:
                         self.service_name, kind, rid, self.version,
                         reason)
         if delta < 0:
-            victims = autoscalers_lib.select_replicas_to_scale_down(
-                group, -delta)
+            victims = self._scale_down_victims(group, -delta)
             for rid in victims:
                 logger.info('service %s: scaling down %s replica %d [%s]',
                             self.service_name, kind, rid, reason)
@@ -126,12 +134,13 @@ class ServeController:
         # worth preserving) — never collapse capacity mid-roll.
         if stale and (ready_current >= target or not stale_ready):
             for r in stale:
+                if self.spec.pool and r.get('assigned_job'):
+                    continue   # drain pool workers only when idle
                 self.rm.terminate_replica(r['replica_id'],
                                           'superseded version')
         # Scale down excess current-version replicas.
         if to_launch < 0:
-            victims = autoscalers_lib.select_replicas_to_scale_down(
-                current, -to_launch)
+            victims = self._scale_down_victims(current, -to_launch)
             for rid in victims:
                 logger.info('service %s: scaling down replica %d [%s]',
                             self.service_name, rid, decision.reason)
@@ -212,6 +221,7 @@ def service_snapshot(name: str) -> Optional[dict]:
         'endpoint': f'http://127.0.0.1:{record["lb_port"]}'
                     if record['lb_port'] else None,
         'policy': record['lb_policy'],
+        'pool': bool(record.get('pool')),
         'failure_reason': record['failure_reason'],
         'ready_replicas': sum(
             1 for r in replicas
@@ -228,6 +238,7 @@ def service_snapshot(name: str) -> Optional[dict]:
             'zone': r['zone'],
             'launched_at': r['launched_at'],
             'ready_at': r['ready_at'],
+            'assigned_job': r.get('assigned_job'),
             'failure_reason': r['failure_reason'],
         } for r in replicas],
     }

@@ -80,7 +80,10 @@ class ReplicaManager:
             yaml.safe_load(self.task_yaml))
         if use_spot is not None and use_spot != task.resources.use_spot:
             task.set_resources(task.resources.copy(use_spot=use_spot))
-        if task.resources.cloud == 'local':
+        if self.spec.pool:
+            # Pool workers are idle clusters; there is no workload port.
+            port = 0
+        elif task.resources.cloud == 'local':
             # Replicas share the host's network namespace locally — each
             # needs its own port.
             port = _free_port()
@@ -98,7 +101,8 @@ class ReplicaManager:
         conn.commit()
         serve_state.set_replica_status(replica_id,
                                        ReplicaStatus.PROVISIONING)
-        task.envs['SKYPILOT_SERVE_PORT'] = str(port)
+        if not self.spec.pool:
+            task.envs['SKYPILOT_SERVE_PORT'] = str(port)
         task.envs['SKYPILOT_SERVE_REPLICA_ID'] = str(replica_id)
         fut = self._pool.submit(self._do_launch, replica_id, cluster_name,
                                 task, port)
@@ -111,8 +115,15 @@ class ReplicaManager:
                    if task.resources.use_spot else None)
         _, info = execution.launch(task, cluster_name,
                                    blocked_placements=blocked)
-        ip = info.head.external_ip or info.head.internal_ip or '127.0.0.1'
-        serve_state.set_replica_url(replica_id, f'http://{ip}:{port}')
+        if self.spec.pool:
+            # Readiness for a worker is its agent plane, not a workload
+            # port — record the head agent URL for observability.
+            serve_state.set_replica_url(replica_id,
+                                        info.head.agent_url or '')
+        else:
+            ip = (info.head.external_ip or info.head.internal_ip
+                  or '127.0.0.1')
+            serve_state.set_replica_url(replica_id, f'http://{ip}:{port}')
         acc = info.tpu_slice
         if not acc and task.resources.accelerators:
             acc = next(iter(task.resources.accelerators))
@@ -198,20 +209,35 @@ class ReplicaManager:
         except (urllib.error.URLError, OSError, ValueError):
             return False
 
+    def _probe_pool_worker(self, cluster_name: str) -> bool:
+        """Pool readiness: every host agent of the worker slice answers
+        /health (a gang worker with one dead host can't run a job)."""
+        from skypilot_tpu.runtime import agent_client
+        record = global_state.get_cluster(cluster_name)
+        if record is None or not record.get('cluster_info'):
+            return False
+        info = ClusterInfo.from_dict(record['cluster_info'])
+        timeout = self.spec.readiness_probe.timeout_seconds
+        try:
+            for i in range(len(info.hosts)):
+                agent_client.AgentClient.for_info(
+                    info, timeout=timeout, host=i).health()
+            return True
+        except Exception:  # noqa: BLE001 — any failure = not ready
+            return False
+
+    def _probe(self, replica: dict) -> bool:
+        if self.spec.pool:
+            return self._probe_pool_worker(replica['cluster_name'])
+        return self._probe_url(replica['url'])
+
     def _provider_alive(self, cluster_name: str) -> Optional[bool]:
         """True/False = provider verdict; None = no cluster record."""
         record = global_state.get_cluster(cluster_name)
         if record is None or not record.get('cluster_info'):
             return None
-        info = ClusterInfo.from_dict(record['cluster_info'])
-        try:
-            live = provision.get_cluster_info(info.cloud, cluster_name,
-                                              info.provider_config)
-        except Exception:  # noqa: BLE001 — flaky probe ≠ dead slice
-            return True
-        if live is None:
-            return False
-        return all(h.state == 'RUNNING' for h in live.hosts)
+        return provision.probe_cluster_running(
+            ClusterInfo.from_dict(record['cluster_info']))
 
     # -- the tick ----------------------------------------------------------
     def sync(self) -> None:
@@ -262,9 +288,9 @@ class ReplicaManager:
                 self._pool.submit(self._cleanup_carcass,
                                   r['cluster_name'])
                 continue
-            if not r['url']:
+            if not r['url'] and not self.spec.pool:
                 continue
-            probe_ok = self._probe_url(r['url'])
+            probe_ok = self._probe(r)
             if status == ReplicaStatus.STARTING:
                 anchor = r.get('starting_at') or r['launched_at'] or now
                 in_grace = (now - anchor <
@@ -302,6 +328,12 @@ class ReplicaManager:
                             rid, ReplicaStatus.NOT_READY,
                             'readiness probes failing')
                     elif fails >= threshold * NOT_READY_TERMINATE_FACTOR:
+                        if self.spec.pool and r.get('assigned_job'):
+                            # Never tear a worker out from under its
+                            # job: the job controller owns recovery (its
+                            # agent-miss limit releases the worker), and
+                            # only then may the pool replace it.
+                            continue
                         # Persistently unhealthy on a healthy slice: give
                         # up and replace, or a single wedged server pins
                         # the service at NO_REPLICA forever.

@@ -176,6 +176,11 @@ class ServiceSpec:
     # Port the replica's workload listens on. The replica manager injects
     # it as $SKYPILOT_SERVE_PORT (locally each replica gets a unique one).
     replica_port: Optional[int] = None
+    # Jobs worker pool (reference threads pool=True through the serve
+    # machinery, sky/serve/server/core.py:45-90): replicas are idle
+    # worker clusters — readiness is the on-cluster agent's health, no
+    # HTTP workload, no load balancer.
+    pool: bool = False
 
     @classmethod
     def from_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -183,7 +188,7 @@ class ServiceSpec:
             raise exceptions.InvalidTaskError(
                 f'service must be a mapping, got {type(config).__name__}')
         known = {'readiness_probe', 'replica_policy', 'replicas',
-                 'load_balancing_policy', 'replica_port'}
+                 'load_balancing_policy', 'replica_port', 'pool'}
         unknown = set(config) - known
         if unknown:
             raise exceptions.InvalidTaskError(
@@ -205,6 +210,7 @@ class ServiceSpec:
             replica_port=(int(config['replica_port'])
                           if config.get('replica_port') is not None
                           else None),
+            pool=bool(config.get('pool', False)),
         )
 
     def to_config(self) -> Dict[str, Any]:
@@ -213,4 +219,36 @@ class ServiceSpec:
             'replica_policy': self.replica_policy.to_config(),
             'load_balancing_policy': self.load_balancing_policy,
             'replica_port': self.replica_port,
+            'pool': self.pool,
         }
+
+
+def pool_spec_from_config(config: Dict[str, Any]) -> ServiceSpec:
+    """Build a pool ServiceSpec from a task's ``pool:`` section.
+
+    Shape (reference `sky jobs pool apply` YAML):
+
+        pool:
+          workers: 2
+
+    Workers are plain idle clusters; readiness = agent health, so the
+    probe block is fixed (path unused in pool mode) with a generous
+    initial delay for slice spin-up.
+    """
+    if not isinstance(config, dict):
+        raise exceptions.InvalidTaskError(
+            f'pool must be a mapping, got {type(config).__name__}')
+    known = {'workers'}
+    unknown = set(config) - known
+    if unknown:
+        raise exceptions.InvalidTaskError(
+            f'unknown pool fields: {sorted(unknown)}; valid: workers')
+    workers = int(config.get('workers', 1))
+    if workers < 1:
+        raise exceptions.InvalidTaskError('pool workers must be >= 1')
+    return ServiceSpec(
+        readiness_probe=ReadinessProbe(initial_delay_seconds=300.0,
+                                       timeout_seconds=5.0),
+        replica_policy=ReplicaPolicy(min_replicas=workers),
+        pool=True,
+    )

@@ -64,7 +64,8 @@ CREATE TABLE IF NOT EXISTS services (
     controller_pid INTEGER,
     requested_at REAL,
     shutdown_requested INTEGER DEFAULT 0,
-    failure_reason TEXT
+    failure_reason TEXT,
+    pool INTEGER DEFAULT 0
 );
 CREATE TABLE IF NOT EXISTS replicas (
     replica_id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -82,7 +83,8 @@ CREATE TABLE IF NOT EXISTS replicas (
     terminated_at REAL,
     consecutive_failures INTEGER DEFAULT 0,
     failure_reason TEXT,
-    restart_requested INTEGER DEFAULT 0
+    restart_requested INTEGER DEFAULT 0,
+    assigned_job INTEGER
 );
 CREATE TABLE IF NOT EXISTS lb_stats (
     service_name TEXT,
@@ -110,15 +112,20 @@ def _db() -> db_util.Db:
     if db.path not in _migrated:
         # Round-3 column on pre-existing DBs (CREATE IF NOT EXISTS does
         # not evolve live tables). Checked once per path per process.
-        for col, ddl in (('accelerator',
-                          'ALTER TABLE replicas ADD COLUMN '
-                          'accelerator TEXT'),
-                         ('restart_requested',
-                          'ALTER TABLE replicas ADD COLUMN '
-                          'restart_requested INTEGER DEFAULT 0')):
+        for table, col, ddl in (
+                ('replicas', 'accelerator',
+                 'ALTER TABLE replicas ADD COLUMN accelerator TEXT'),
+                ('replicas', 'restart_requested',
+                 'ALTER TABLE replicas ADD COLUMN '
+                 'restart_requested INTEGER DEFAULT 0'),
+                ('replicas', 'assigned_job',
+                 'ALTER TABLE replicas ADD COLUMN assigned_job INTEGER'),
+                ('services', 'pool',
+                 'ALTER TABLE services ADD COLUMN pool INTEGER '
+                 'DEFAULT 0')):
             try:
                 db.conn.execute(
-                    f'SELECT {col} FROM replicas LIMIT 1')
+                    f'SELECT {col} FROM {table} LIMIT 1')
                 continue
             except Exception:  # noqa: BLE001 — old schema
                 pass
@@ -150,36 +157,49 @@ def controller_log_path(name: str) -> str:
 
 # ---- services ------------------------------------------------------------
 def add_service(name: str, spec_json: str, task_yaml: str, lb_port: int,
-                lb_policy: str) -> bool:
-    """Insert a new service row; False if the name is taken."""
+                lb_policy: str, pool: bool = False) -> bool:
+    """Insert a new service row; False if the name is taken. ``pool``
+    marks a jobs worker pool (reference threads pool=True through
+    sky/serve/server/core.py:45-90 the same way)."""
     conn = _db().conn
     try:
         conn.execute(
             'INSERT INTO services (name, status, spec_json, task_yaml, '
-            'version, lb_port, lb_policy, requested_at) '
-            'VALUES (?,?,?,?,1,?,?,?)',
+            'version, lb_port, lb_policy, requested_at, pool) '
+            'VALUES (?,?,?,?,1,?,?,?,?)',
             (name, ServiceStatus.CONTROLLER_INIT.value, spec_json,
-             task_yaml, lb_port, lb_policy, time.time()))
+             task_yaml, lb_port, lb_policy, time.time(), int(pool)))
         conn.commit()
         return True
     except sqlite3.IntegrityError:
         return False
 
 
-def update_service_spec(name: str, spec_json: str,
-                        task_yaml: str) -> int:
-    """Record a new target version (rolling update); returns it."""
+def update_service_spec(name: str, spec_json: str, task_yaml: str,
+                        adopt_replicas: bool = False) -> int:
+    """Record a new target version (rolling update); returns it.
+
+    ``adopt_replicas`` moves existing replicas to the new version IN THE
+    SAME TRANSACTION — used when only the spec changed (pool resize), so
+    a controller tick between bump and adoption can't see the fleet as
+    stale and launch spurious replacements."""
     conn = _db().conn
     cur = conn.execute(
         'UPDATE services SET spec_json = ?, task_yaml = ?, '
         'version = version + 1 WHERE name = ?',
         (spec_json, task_yaml, name))
-    conn.commit()
     if cur.rowcount == 0:
+        conn.commit()
         return -1
     row = conn.execute('SELECT version FROM services WHERE name = ?',
                        (name,)).fetchone()
-    return int(row['version'])
+    version = int(row['version'])
+    if adopt_replicas:
+        conn.execute(
+            'UPDATE replicas SET version = ? WHERE service_name = ?',
+            (version, name))
+    conn.commit()
+    return version
 
 
 def set_service_status(name: str, status: ServiceStatus,
@@ -221,9 +241,16 @@ def get_service(name: str) -> Optional[Dict[str, Any]]:
     return _service_row(row) if row else None
 
 
-def get_services() -> List[Dict[str, Any]]:
-    rows = _db().conn.execute(
-        'SELECT * FROM services ORDER BY requested_at').fetchall()
+def get_services(pool: Optional[bool] = None) -> List[Dict[str, Any]]:
+    """All services; ``pool=True`` → only worker pools, ``pool=False`` →
+    only real services, None → both."""
+    q = 'SELECT * FROM services'
+    args: List[Any] = []
+    if pool is not None:
+        q += ' WHERE pool = ?'
+        args = [int(pool)]
+    rows = _db().conn.execute(q + ' ORDER BY requested_at',
+                              args).fetchall()
     return [_service_row(r) for r in rows]
 
 
@@ -239,6 +266,7 @@ def _service_row(row: sqlite3.Row) -> Dict[str, Any]:
     d = dict(row)
     d['status'] = ServiceStatus(d['status'])
     d['spec'] = json.loads(d.pop('spec_json'))
+    d['pool'] = bool(d.get('pool'))
     return d
 
 
@@ -383,6 +411,62 @@ def _replica_row(row: sqlite3.Row) -> Dict[str, Any]:
     d['status'] = ReplicaStatus(d['status'])
     d['is_spot'] = bool(d['is_spot'])
     return d
+
+
+# ---- worker-pool assignment (jobs worker pools) --------------------------
+def acquire_pool_worker(service_name: str, job_id: int,
+                        exclude_replica: Optional[int] = None
+                        ) -> Optional[Dict[str, Any]]:
+    """Atomically claim a READY, unassigned worker for managed job
+    ``job_id``; returns its replica row, or None when every worker is
+    busy/unready. Idempotent: a worker already assigned to this job is
+    returned as-is (controller-restart resume). Reference analog:
+    sky/jobs/scheduling the job onto a pool cluster without launching
+    (sky/jobs/server/core.py:279-281)."""
+    conn = _db().conn
+    row = conn.execute(
+        'SELECT * FROM replicas WHERE service_name = ? AND '
+        'assigned_job = ?', (service_name, job_id)).fetchone()
+    if row is not None:
+        return _replica_row(row)
+    # Single-statement claim: the subquery + UPDATE are atomic under
+    # sqlite's writer lock, so two concurrent job controllers can never
+    # claim the same worker.
+    # ``exclude_replica`` skips a worker the caller just declared dead
+    # (recovery) so a not-yet-reaped READY row isn't instantly re-claimed.
+    cur = conn.execute(
+        'UPDATE replicas SET assigned_job = ? WHERE replica_id = ('
+        '  SELECT replica_id FROM replicas WHERE service_name = ? '
+        '  AND status = ? AND assigned_job IS NULL '
+        '  AND replica_id != ? '
+        '  ORDER BY replica_id LIMIT 1)',
+        (job_id, service_name, ReplicaStatus.READY.value,
+         -1 if exclude_replica is None else exclude_replica))
+    conn.commit()
+    if cur.rowcount == 0:
+        return None
+    row = conn.execute(
+        'SELECT * FROM replicas WHERE service_name = ? AND '
+        'assigned_job = ?', (service_name, job_id)).fetchone()
+    return _replica_row(row) if row else None
+
+
+def release_pool_worker(replica_id: int) -> None:
+    conn = _db().conn
+    conn.execute(
+        'UPDATE replicas SET assigned_job = NULL WHERE replica_id = ?',
+        (replica_id,))
+    conn.commit()
+
+
+def release_pool_workers_for_job(job_id: int) -> None:
+    """Safety net for a crashed job controller: free any worker still
+    assigned to the job."""
+    conn = _db().conn
+    conn.execute(
+        'UPDATE replicas SET assigned_job = NULL WHERE assigned_job = ?',
+        (job_id,))
+    conn.commit()
 
 
 # ---- LB request stats (autoscaler input) ---------------------------------

@@ -20,7 +20,8 @@ from skypilot_tpu import task as task_lib
 # queue, executor.py:1-20): they provision/mutate clusters and can run for
 # minutes — or crash — without taking the control plane down.
 LONG_OPS = {'launch', 'exec', 'down', 'stop', 'start', 'jobs.launch',
-            'serve.up', 'serve.down', 'serve.update', 'recipes.launch'}
+            'serve.up', 'serve.down', 'serve.update', 'recipes.launch',
+            'jobs.pool_apply', 'jobs.pool_down'}
 # Ops answered inline, never persisted to the requests store — their
 # results are secrets (a cleartext token in the store would be readable
 # via /api/get by anyone, defeating the store-only-hashes design).
@@ -29,7 +30,7 @@ SYNC_OPS = {'users.token_create'}
 # caller (not the server's OS user, which the workers run as) must pass
 # the private-workspace gate (reference workspaces/core.py:659).
 WORKSPACE_GATED = {'launch', 'jobs.launch', 'serve.up', 'serve.update',
-                   'recipes.launch'}
+                   'recipes.launch', 'jobs.pool_apply'}
 # Ops that act on an EXISTING cluster: the gate must judge the caller
 # against the workspace the cluster was LAUNCHED in (clusters carry a
 # workspace column) — the server's active workspace says nothing about
@@ -272,14 +273,29 @@ def _dispatch_jobs(name, payload, jobs_lib):
             from skypilot_tpu.utils import dag_utils
             dag = dag_utils.load_dag_from_yaml_str(payload['dag_yaml'])
             return functools.partial(jobs_lib.launch, dag,
-                                     name=payload.get('name'))
+                                     name=payload.get('name'),
+                                     pool=payload.get('pool'))
         return functools.partial(
             jobs_lib.launch, _task_from_payload(payload),
-            name=payload.get('name'))
+            name=payload.get('name'), pool=payload.get('pool'))
     if name == 'jobs.queue':
         return jobs_lib.queue
     if name == 'jobs.cancel':
         return functools.partial(jobs_lib.cancel, payload['job_id'])
+    if name == 'jobs.pool_apply':
+        task = (_task_from_payload(payload)
+                if payload.get('task') is not None else None)
+        return functools.partial(
+            jobs_lib.pool_apply, task,
+            pool_name=payload.get('pool_name'),
+            workers=payload.get('workers'))
+    if name == 'jobs.pool_status':
+        return functools.partial(jobs_lib.pool_status,
+                                 payload.get('pool_names'))
+    if name == 'jobs.pool_down':
+        return functools.partial(jobs_lib.pool_down,
+                                 payload['pool_name'],
+                                 purge=payload.get('purge', False))
     raise exceptions.UnknownOpError(f'unknown op {name}')
 
 

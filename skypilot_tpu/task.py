@@ -26,7 +26,7 @@ _VALID_NAME_RE = re.compile(r'^[a-zA-Z0-9]([a-zA-Z0-9._-]*[a-zA-Z0-9])?$')
 _TASK_FIELDS = {
     'name', 'workdir', 'setup', 'run', 'num_nodes', 'envs', 'secrets',
     'resources', 'file_mounts', 'storage_mounts', 'service', 'config',
-    'volumes',
+    'volumes', 'pool',
 }
 
 
@@ -56,6 +56,7 @@ class Task:
         file_mounts: Optional[Dict[str, str]] = None,
         storage_mounts: Optional[Dict[str, Dict[str, Any]]] = None,
         service: Optional[Dict[str, Any]] = None,
+        pool: Optional[Dict[str, Any]] = None,
         config_overrides: Optional[Dict[str, Any]] = None,
         volumes: Optional[Dict[str, str]] = None,
     ):
@@ -81,6 +82,10 @@ class Task:
         self.storage_mounts: Dict[str, Dict[str, Any]] = {
             k: dict(v) for k, v in (storage_mounts or {}).items()}
         self.service = dict(service) if service else None
+        # `pool:` section — a worker-pool spec for managed jobs (reference
+        # sky/client/cli/command.py:6031 `sky jobs pool apply` requires a
+        # `pool` section in the YAML; pools reuse the serve machinery).
+        self.pool = dict(pool) if pool else None
         self.config_overrides = dict(config_overrides or {})
         # mount point -> registered volume name (reference task volumes)
         self.volumes: Dict[str, str] = dict(volumes or {})
@@ -151,6 +156,10 @@ class Task:
     def is_service(self) -> bool:
         return self.service is not None
 
+    @property
+    def is_pool(self) -> bool:
+        return self.pool is not None
+
     # ---- YAML ---------------------------------------------------------
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any],
@@ -186,6 +195,7 @@ class Task:
             file_mounts=config.get('file_mounts'),
             storage_mounts=config.get('storage_mounts'),
             service=config.get('service'),
+            pool=config.get('pool'),
             config_overrides=config.get('config'),
             volumes=config.get('volumes'),
         )
@@ -228,6 +238,8 @@ class Task:
             cfg['run'] = self.run
         if self.service:
             cfg['service'] = dict(self.service)
+        if self.pool:
+            cfg['pool'] = dict(self.pool)
         if self.config_overrides:
             cfg['config'] = dict(self.config_overrides)
         if self.volumes:

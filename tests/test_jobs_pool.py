@@ -1,0 +1,333 @@
+"""Jobs worker pools: apply, readiness, worker reuse, recovery, down.
+
+Reference analog: `sky jobs pool apply/status/down` + `sky jobs launch
+--pool` (sky/client/cli/command.py:6031-6230), pool replicas managed by
+the serve machinery (sky/serve/server/core.py:45-90). Run against the
+local fake-slice cloud: pool workers are real (local) slices running real
+agents, and worker death is injected by preempting the slice underneath.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu import jobs
+from skypilot_tpu import serve
+from skypilot_tpu import state as global_state
+from skypilot_tpu.jobs import controller as jobs_controller_lib
+from skypilot_tpu.jobs import pool as pool_lib
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.serve import controller as serve_controller_lib
+from skypilot_tpu.serve import spec as spec_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.serve.state import ReplicaStatus
+
+
+@pytest.fixture(autouse=True)
+def fast_timers(monkeypatch):
+    monkeypatch.setattr(jobs_controller_lib, '_POLL_S', 0.1)
+    monkeypatch.setattr(recovery_strategy, '_RETRY_GAP_S', 0.1)
+    monkeypatch.setenv('SKY_TPU_POOL_ACQUIRE_POLL_S', '0.1')
+    yield
+
+
+def _pool_task(name='wpool', workers=2):
+    return sky.Task(name,
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4'),
+                    pool={'workers': workers})
+
+
+def _job_task(run, name='pj'):
+    return sky.Task(name, run=run,
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4'))
+
+
+def _tick_until(ctl, predicate, timeout=120.0, tick_s=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        ctl.tick()
+        if predicate():
+            return
+        time.sleep(tick_s)
+    raise TimeoutError('condition not reached; replicas: '
+                       f'{serve_state.get_replicas(ctl.service_name)}')
+
+
+def _ready_workers(name):
+    return serve_state.get_replicas(name, [ReplicaStatus.READY])
+
+
+def _submit_pool_job(task, pool, monkeypatch):
+    monkeypatch.setattr(scheduler, '_spawn_controller',
+                        lambda job_id: None)
+    return jobs.launch(task, pool=pool)
+
+
+def _run_job_inproc(job_id):
+    return jobs_controller_lib.JobController(job_id).run()
+
+
+class _PoolTicker:
+    """Background serve-controller ticking while job controllers run."""
+
+    def __init__(self, name):
+        self.ctl = serve_controller_lib.ServeController(name)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.ctl.tick()
+            except Exception:  # noqa: BLE001 — surface via test asserts
+                pass
+            time.sleep(0.2)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+# ---------- spec / apply validation ---------------------------------------
+def test_pool_spec_parsing_and_validation():
+    spec = spec_lib.pool_spec_from_config({'workers': 3})
+    assert spec.pool and spec.replica_policy.min_replicas == 3
+    # Round-trips through the services table json.
+    again = spec_lib.ServiceSpec.from_config(spec.to_config())
+    assert again.pool and again.replica_policy.min_replicas == 3
+    with pytest.raises(exceptions.InvalidTaskError):
+        spec_lib.pool_spec_from_config({'workers': 0})
+    with pytest.raises(exceptions.InvalidTaskError):
+        spec_lib.pool_spec_from_config({'bogus': 1})
+
+    # Task round-trip keeps the pool section.
+    t = _pool_task()
+    t2 = sky.Task.from_yaml_config(t.to_yaml_config())
+    assert t2.is_pool and t2.pool == {'workers': 2}
+
+    # A pool task must not carry a run command (jobs bring it).
+    bad = sky.Task('p', run='echo x',
+                   resources=sky.Resources(cloud='local',
+                                           accelerators='v5e-4'),
+                   pool={'workers': 1})
+    with pytest.raises(exceptions.InvalidTaskError):
+        pool_lib.apply(bad, _spawn=False)
+    # A task without a pool section is rejected too.
+    with pytest.raises(exceptions.InvalidTaskError):
+        pool_lib.apply(_job_task('echo x'), _spawn=False)
+
+
+# ---------- e2e: apply → ready → jobs reuse workers -----------------------
+def test_pool_jobs_reuse_workers_without_provisioning(monkeypatch):
+    out = pool_lib.apply(_pool_task('wpool', workers=2), _spawn=False)
+    assert out == {'name': 'wpool', 'workers': 2, 'version': 1}
+    ctl = serve_controller_lib.ServeController('wpool')
+    _tick_until(ctl, lambda: len(_ready_workers('wpool')) >= 2)
+    worker_clusters = {r['cluster_name']
+                      for r in _ready_workers('wpool')}
+    assert len(worker_clusters) == 2
+    clusters_before = {c['name'] for c in global_state.get_clusters()}
+
+    # Three jobs through a 2-worker pool: all reuse pool workers; no
+    # job provisions anything.
+    job_ids = [_submit_pool_job(_job_task(f'echo job-{i}', name=f'pj{i}'),
+                                'wpool', monkeypatch)
+               for i in range(3)]
+    threads = [threading.Thread(
+        target=_run_job_inproc, args=(jid,)) for jid in job_ids]
+    with _PoolTicker('wpool'):
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), 'job wedged'
+
+    for jid in job_ids:
+        record = jobs_state.get_job(jid)
+        assert record['status'] == ManagedJobStatus.SUCCEEDED, record
+        # Ran on a pool worker, with the agent job id recorded.
+        assert record['cluster_name'] in worker_clusters
+        assert record['cluster_job_id'] >= 0
+        assert record['pool'] == 'wpool'
+    # No cluster beyond the pool's two workers was ever created.
+    clusters_after = {c['name'] for c in global_state.get_clusters()}
+    assert clusters_after == clusters_before
+    # All workers released back to idle.
+    assert all(r['assigned_job'] is None
+               for r in serve_state.get_replicas('wpool'))
+
+    # status() surfaces idle counts; serve.status() hides pools.
+    snap = jobs.pool_status(['wpool'])[0]
+    assert snap['pool'] and snap['idle_workers'] == 2
+    assert snap['target_workers'] == 2
+    assert all(s['name'] != 'wpool' for s in serve.status())
+
+    pool_lib.down('wpool')
+    assert serve_state.get_service('wpool') is None
+    assert all(not c['name'].startswith('wpool-r')
+               for c in global_state.get_clusters())
+
+
+def test_two_jobs_never_share_a_worker(monkeypatch):
+    pool_lib.apply(_pool_task('xpool', workers=1), _spawn=False)
+    ctl = serve_controller_lib.ServeController('xpool')
+    _tick_until(ctl, lambda: len(_ready_workers('xpool')) >= 1)
+
+    # Job A holds the only worker; job B must wait for release.
+    a = _submit_pool_job(_job_task('sleep 2', name='hold'), 'xpool',
+                         monkeypatch)
+    b = _submit_pool_job(_job_task('echo quick', name='wait'), 'xpool',
+                         monkeypatch)
+    ta = threading.Thread(target=_run_job_inproc, args=(a,))
+    tb = threading.Thread(target=_run_job_inproc, args=(b,))
+    with _PoolTicker('xpool'):
+        ta.start()
+        # Let A claim the worker first.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            reps = serve_state.get_replicas('xpool')
+            if reps and reps[0]['assigned_job'] == a:
+                break
+            time.sleep(0.05)
+        tb.start()
+        ta.join(timeout=60)
+        tb.join(timeout=60)
+    assert jobs_state.get_job(a)['status'] == ManagedJobStatus.SUCCEEDED
+    assert jobs_state.get_job(b)['status'] == ManagedJobStatus.SUCCEEDED
+    pool_lib.down('xpool')
+
+
+# ---------- e2e: worker death → pool replaces, job recovers ---------------
+def test_worker_death_job_recovers_pool_replaces(monkeypatch,
+                                                 sky_tpu_home):
+    pool_lib.apply(_pool_task('rpool', workers=2), _spawn=False)
+    ctl = serve_controller_lib.ServeController('rpool')
+    _tick_until(ctl, lambda: len(_ready_workers('rpool')) >= 2)
+
+    # Job succeeds only on its second attempt (marker survives the
+    # worker's death — it lives outside the cluster dirs).
+    marker = os.path.join(sky_tpu_home, 'attempts')
+    run = (f'echo x >> {marker}; '
+           f'if [ $(wc -l < {marker}) -ge 2 ]; then exit 0; fi; '
+           'sleep 60')
+    jid = _submit_pool_job(_job_task(run, name='recov'), 'rpool',
+                           monkeypatch)
+    t = threading.Thread(target=_run_job_inproc, args=(jid,))
+    with _PoolTicker('rpool'):
+        t.start()
+        # Wait until the job is RUNNING on a claimed worker.
+        deadline = time.time() + 60
+        victim = None
+        while time.time() < deadline:
+            record = jobs_state.get_job(jid)
+            if (record['status'] == ManagedJobStatus.RUNNING
+                    and record['cluster_name']
+                    and os.path.exists(marker)):
+                victim = record['cluster_name']
+                break
+            time.sleep(0.05)
+        assert victim, 'job never reached RUNNING on a worker'
+
+        # Kill the worker slice underneath the job (spot reclaim shape:
+        # provider says PREEMPTED, agent dies).
+        cdir = os.path.join(sky_tpu_home, 'clusters', victim)
+        from skypilot_tpu.provision.local import instance as local_inst
+        local_inst._kill_agent(cdir)
+        for entry in os.listdir(cdir):
+            if entry.startswith('host'):
+                with open(os.path.join(cdir, entry, 'state'), 'w') as f:
+                    f.write('PREEMPTED')
+
+        t.join(timeout=180)
+        assert not t.is_alive(), 'job controller wedged after death'
+        record = jobs_state.get_job(jid)
+        assert record['status'] == ManagedJobStatus.SUCCEEDED
+        assert record['recovery_count'] >= 1
+        # Recovered onto a DIFFERENT worker.
+        assert record['cluster_name'] != victim
+
+        # The pool heals back to 2 READY workers (dead one replaced).
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            ready = _ready_workers('rpool')
+            if (len(ready) >= 2
+                    and all(r['cluster_name'] != victim for r in ready)):
+                break
+            time.sleep(0.3)
+        else:
+            raise TimeoutError(
+                f'pool never healed: {serve_state.get_replicas("rpool")}')
+    pool_lib.down('rpool')
+
+
+# ---------- resize / misc -------------------------------------------------
+def test_pool_resize_and_guards(monkeypatch):
+    pool_lib.apply(_pool_task('zpool', workers=1), _spawn=False)
+    ctl = serve_controller_lib.ServeController('zpool')
+    _tick_until(ctl, lambda: len(_ready_workers('zpool')) >= 1)
+    [keeper] = _ready_workers('zpool')
+
+    out = pool_lib.apply(pool_name='zpool', workers=3)
+    assert out['workers'] == 3 and out['version'] == 2
+    # The controller picks the new target up on its next tick — and a
+    # resize must NOT roll the existing (identical) worker.
+    ctl.tick()
+    assert ctl.spec.replica_policy.min_replicas == 3
+    kept = serve_state.get_replica(keeper['replica_id'])
+    assert kept is not None and kept['version'] == 2
+    assert kept['status'] == ReplicaStatus.READY
+
+    # Launch --pool onto a nonexistent pool fails fast at submit.
+    with pytest.raises(exceptions.JobNotFoundError):
+        jobs.launch(_job_task('echo x'), pool='nope')
+    # Resize of a nonexistent pool too.
+    with pytest.raises(exceptions.JobNotFoundError):
+        pool_lib.apply(pool_name='nope', workers=2)
+    # down() of a service through the pool path is rejected.
+    with pytest.raises(exceptions.JobNotFoundError):
+        pool_lib.down('nope')
+
+    # Pools are invisible to the serve surface: serve.down/status on a
+    # pool row is a JobNotFoundError, and user YAML can't smuggle
+    # pool=true through a `service:` section.
+    with pytest.raises(exceptions.JobNotFoundError):
+        serve.down('zpool')
+    with pytest.raises(exceptions.JobNotFoundError):
+        serve.status('zpool')
+    svc = sky.Task('sneaky', run='echo hi',
+                   resources=sky.Resources(cloud='local',
+                                           accelerators='v5e-4'),
+                   service={'replicas': 1, 'pool': True})
+    with pytest.raises(exceptions.InvalidTaskError):
+        serve.up(svc, _spawn=False)
+    pool_lib.down('zpool', purge=True)
+
+
+def test_pool_job_resource_mismatch_fails_fast(monkeypatch):
+    """A job whose resources exceed every pool worker must fail as
+    NO_RESOURCE, not spin claiming/releasing workers forever."""
+    pool_lib.apply(_pool_task('mpool', workers=1), _spawn=False)
+    ctl = serve_controller_lib.ServeController('mpool')
+    _tick_until(ctl, lambda: len(_ready_workers('mpool')) >= 1)
+    big = sky.Task('big', run='echo x',
+                   resources=sky.Resources(cloud='local',
+                                           accelerators='v5p-16'))
+    jid = _submit_pool_job(big, 'mpool', monkeypatch)
+    final = _run_job_inproc(jid)
+    assert final == ManagedJobStatus.FAILED_NO_RESOURCE
+    # Worker released.
+    assert all(r['assigned_job'] is None
+               for r in serve_state.get_replicas('mpool'))
+    pool_lib.down('mpool')
