@@ -51,7 +51,8 @@ class Backend:
         raise NotImplementedError
 
     def execute(self, info: ClusterInfo, task: task_lib.Task,
-                detach: bool = True) -> int:
+                detach: bool = True, *,
+                include_setup: bool = False) -> int:
         raise NotImplementedError
 
     def teardown(self, info: ClusterInfo, terminate: bool) -> None:
@@ -285,9 +286,16 @@ class TpuVmBackend(Backend):
                 f'{[i for i, rc in enumerate(rcs) if rc]}:\n{tails}')
 
     def execute(self, info: ClusterInfo, task: task_lib.Task,
-                detach: bool = True) -> int:
+                detach: bool = True, *,
+                include_setup: bool = False) -> int:
         """Submit the run command as a job; the agent gangs it across all
-        hosts of the slice."""
+        hosts of the slice.
+
+        include_setup submits task.setup as the job's setup phase too —
+        the pool-job path uses it (workers are provisioned once, so the
+        launch-time SETUP stage never saw this task); the normal launch
+        flow leaves it False because Stage.SETUP already ran it.
+        """
         if not task.run:
             logger.info('Task has no run command; nothing to execute.')
             return -1
@@ -295,6 +303,7 @@ class TpuVmBackend(Backend):
         job_id = client.submit(
             name=task.name or 'job',
             run=task.run,
+            setup=(task.setup if include_setup else None),
             envs={**task.envs, **task.secrets})
         state.update_last_use(info.cluster_name, f'exec job {job_id}')
         return job_id

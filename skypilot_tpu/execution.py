@@ -265,9 +265,12 @@ def exec(  # noqa: A001 — mirrors the reference's public name
     backend: Optional[backend_lib.Backend] = None,
     detach_run: bool = True,
     caller: Optional[Dict[str, Any]] = None,
+    include_setup: bool = False,
 ) -> Tuple[int, ClusterInfo]:
     """Run a task on an existing cluster, skipping provision/setup
-    (reference sky/execution.py:825)."""
+    (reference sky/execution.py:825). ``include_setup`` opts the task's
+    setup back in as the job's setup phase — pool jobs need it, since
+    their worker never ran this task's SETUP stage."""
     # Private-workspace gate: running commands on a cluster is entering
     # the workspace the cluster was LAUNCHED in (its record carries it) —
     # not whatever workspace happens to be active in this process.
@@ -287,5 +290,6 @@ def exec(  # noqa: A001 — mirrors the reference's public name
         assert info is not None
         if task.workdir:
             backend.sync_workdir(info, task.workdir)
-        job_id = backend.execute(info, task, detach=detach_run)
+        job_id = backend.execute(info, task, detach=detach_run,
+                                 include_setup=include_setup)
     return job_id, info

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import json
 import os
-import signal
 import subprocess
 import sys
 import time
@@ -37,7 +36,6 @@ from skypilot_tpu.serve import controller as serve_controller
 from skypilot_tpu.serve import spec as spec_lib
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.serve.state import ServiceStatus
-from skypilot_tpu.utils import common
 
 
 def _require_pool(name: str) -> Dict[str, Any]:
@@ -155,33 +153,10 @@ def down(pool_name: str, *, purge: bool = False,
     """Tear a pool down. Jobs still running on its workers lose them
     (they fail over per their recovery strategy — same as the reference
     tearing a pool out from under queued jobs)."""
+    from skypilot_tpu import serve as serve_lib
     record = _require_pool(pool_name)
-    serve_state.request_shutdown(pool_name)
-    pid = record.get('controller_pid')
-    alive = common.pid_alive(pid)
-    if not alive or purge:
-        from skypilot_tpu.serve import replica_managers
-        rm = replica_managers.ReplicaManager(
-            pool_name,
-            spec_lib.ServiceSpec.from_config(record['spec']),
-            record['task_yaml'])
-        rm.terminate_all()
-        rm.shutdown()
-        if alive and purge:
-            try:
-                os.kill(pid, signal.SIGTERM)
-            except (ProcessLookupError, PermissionError):
-                pass
-        serve_state.remove_service(pool_name)
-        return
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if serve_state.get_service(pool_name) is None:
-            return
-        time.sleep(0.2)
-    raise TimeoutError(
-        f'pool {pool_name!r} still shutting down after {timeout}s; '
-        f'retry with purge=True to force')
+    serve_lib.down_record(record, purge=purge, timeout=timeout,
+                          kind='pool')
 
 
 def wait_ready(pool_name: str, min_workers: int = 1,
@@ -196,7 +171,13 @@ def wait_ready(pool_name: str, min_workers: int = 1,
         if record['status'] == ServiceStatus.FAILED:
             raise exceptions.SkyTpuError(
                 f'pool {pool_name!r} FAILED: {record["failure_reason"]}')
-        snap = status([pool_name])[0]
+        snaps = status([pool_name])
+        if not snaps:
+            # Row vanished between the record check and the snapshot
+            # (pool torn down underneath us): report it as gone, not as
+            # an IndexError.
+            raise exceptions.JobNotFoundError(f'pool {pool_name!r}')
+        snap = snaps[0]
         if snap['ready_replicas'] >= min_workers:
             return snap
         time.sleep(poll_s)

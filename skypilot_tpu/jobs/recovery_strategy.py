@@ -232,9 +232,14 @@ class PoolStrategyExecutor(StrategyExecutor):
             self.replica_id = worker['replica_id']
             self.cluster_name = worker['cluster_name']
             try:
+                # include_setup: the worker was provisioned for the POOL,
+                # not this task — the job's setup must run per claim or
+                # it is silently dropped (non-pool launches run it in
+                # Stage.SETUP).
                 return execution.exec(self.task, self.cluster_name,
                                       backend=self.backend,
-                                      detach_run=True)
+                                      detach_run=True,
+                                      include_setup=True)
             except _TRANSIENT_EXEC_ERRORS as e:
                 # Worker died between READY and exec (cluster record
                 # gone, agent unreachable): release, shun it until the

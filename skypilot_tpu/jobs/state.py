@@ -112,21 +112,9 @@ def _db() -> db_util.Db:
         # sky/jobs/state.py:141-148; cluster_job_id doubles as
         # job_id_on_pool_cluster here — for a pool job the "cluster" IS
         # the pool worker).
-        try:
-            db.conn.execute('SELECT pool FROM jobs LIMIT 1')
-        except Exception:  # noqa: BLE001 — old schema
-            try:
-                db.conn.rollback()
-            except Exception:  # noqa: BLE001
-                pass
-            try:
-                db.conn.execute('ALTER TABLE jobs ADD COLUMN pool TEXT')
-                db.conn.commit()
-            except Exception:  # noqa: BLE001 — concurrent migrator won
-                try:
-                    db.conn.rollback()
-                except Exception:  # noqa: BLE001
-                    pass
+        db_util.ensure_columns(db.conn, [
+            ('jobs', 'pool', 'ALTER TABLE jobs ADD COLUMN pool TEXT'),
+        ])
         _migrated.add(db.path)
     return db
 

@@ -21,6 +21,7 @@ from skypilot_tpu import topology
 from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
                                            ProvisionConfig)
 from skypilot_tpu.provision.gcp import tpu_api
+from skypilot_tpu.utils import tls
 
 logger = logging.getLogger(__name__)
 
@@ -86,6 +87,12 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
     # runs). Rides provider_config so status refreshes preserve it.
     config.provider_config.setdefault('agent_token',
                                       secrets.token_hex(16))
+    # Cluster TLS pair (utils/tls.py): the agent serves HTTPS and
+    # clients pin the cert fingerprint, so the bearer token never rides
+    # the VPC in clear. Lives in provider_config like the token so
+    # status refreshes preserve it.
+    tls.ensure_cluster_cert(config.provider_config,
+                            config.cluster_name)
     s = topology.parse_tpu(config.tpu_slice)
     runtime_version = (config.runtime_version or
                        DEFAULT_RUNTIME_VERSIONS[s.generation])
@@ -171,6 +178,8 @@ def _install_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
             'cluster_name': info.cluster_name,
             'mode': 'host',
             'auth_token': config.provider_config.get('agent_token'),
+            'tls_cert_pem': config.provider_config.get('agent_tls_cert'),
+            'tls_key_pem': config.provider_config.get('agent_tls_key'),
             # Global host index; the agent derives (slice_id, in-slice
             # rank) from it and num_hosts.
             'host_rank': rank,
@@ -180,7 +189,8 @@ def _install_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
             'slice_id': rank // hosts_per_slice,
             'tpu_slice': info.tpu_slice,
             'peer_agent_urls': [
-                f'http://{ip}:{AGENT_PORT}'
+                f'{"https" if config.provider_config.get("agent_tls_cert") else "http"}'
+                f'://{ip}:{AGENT_PORT}'
                 for i, ip in enumerate(internal_ips) if i != rank
             ] if rank == 0 else [],
             'provider_config': dict(config.provider_config),
@@ -228,6 +238,8 @@ def get_cluster_info(cluster_name: str,
         state = node.get('state', 'UNKNOWN')
         host_state = {'READY': 'RUNNING', 'STOPPED': 'STOPPED'}.get(
             state, state)
+        scheme = ('https' if provider_config.get('agent_tls_cert')
+                  else 'http')
         for i, ep in enumerate(node.get('networkEndpoints', [])):
             external = (ep.get('accessConfig') or {}).get('externalIp')
             hosts.append(HostInfo(
@@ -235,7 +247,8 @@ def get_cluster_info(cluster_name: str,
                 internal_ip=ep.get('ipAddress', ''),
                 external_ip=external,
                 state=host_state,
-                agent_url=(f'http://{external or ep.get("ipAddress", "")}:'
+                agent_url=(f'{scheme}://'
+                           f'{external or ep.get("ipAddress", "")}:'
                            f'{AGENT_PORT}')))
     slice_name = None
     acc_type = node.get('acceleratorType') if node else None
@@ -256,7 +269,13 @@ def get_cluster_info(cluster_name: str,
         provider_config={'project': client.project, 'zone': zone,
                          'node_state': state, 'num_slices': num_slices,
                          'agent_token':
-                             provider_config.get('agent_token')})
+                             provider_config.get('agent_token'),
+                         'agent_tls_cert':
+                             provider_config.get('agent_tls_cert'),
+                         'agent_tls_key':
+                             provider_config.get('agent_tls_key'),
+                         'agent_cert_fingerprint': tls.fingerprint_of_pem(
+                             provider_config.get('agent_tls_cert'))})
 
 
 def _slices(provider_config: Dict[str, Any], cluster_name: str) -> List[str]:

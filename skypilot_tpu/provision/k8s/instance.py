@@ -26,6 +26,7 @@ from skypilot_tpu import topology
 from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
                                            ProvisionConfig)
 from skypilot_tpu.provision.k8s import manifests
+from skypilot_tpu.utils import tls
 
 POD_WAIT_TIMEOUT = 600.0
 _POLL = 2.0
@@ -93,6 +94,10 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
     # Per-cluster agent secret (see runtime/agent.py auth middleware).
     config.provider_config.setdefault('agent_token',
                                       secrets.token_hex(16))
+    # Cluster TLS pair: agents serve HTTPS inside the pod network,
+    # clients pin the fingerprint (utils/tls.py).
+    tls.ensure_cluster_cert(config.provider_config,
+                            config.cluster_name)
     tpu = topology.parse_tpu(config.tpu_slice) if config.tpu_slice \
         else None
     names = _slice_obj_names(config.cluster_name, config.num_slices)
@@ -199,6 +204,8 @@ def _bootstrap_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
             'cluster_name': info.cluster_name,
             'mode': 'host',
             'auth_token': config.provider_config.get('agent_token'),
+            'tls_cert_pem': config.provider_config.get('agent_tls_cert'),
+            'tls_key_pem': config.provider_config.get('agent_tls_key'),
             'host_rank': rank,
             'host_ips': host_ips,
             'num_hosts': hosts_per_slice,
@@ -206,7 +213,8 @@ def _bootstrap_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
             'slice_id': rank // hosts_per_slice,
             'tpu_slice': info.tpu_slice,
             'peer_agent_urls': [
-                f'http://{ip}:{manifests.AGENT_PORT}'
+                f'{"https" if config.provider_config.get("agent_tls_cert") else "http"}'
+                f'://{ip}:{manifests.AGENT_PORT}'
                 for i, ip in enumerate(host_ips) if i != rank
             ] if rank == 0 else [],
             'provider_config': {
@@ -430,6 +438,8 @@ def get_cluster_info(cluster_name: str,
                     int(tail) if tail.isdigit() else 0)
         pods.sort(key=_ordinal)
         hosts = []
+        scheme = ('https' if provider_config.get('agent_tls_cert')
+                  else 'http')
         for i, p in enumerate(pods):
             ip = p['status'].get('podIP', '')
             hosts.append(HostInfo(
@@ -438,7 +448,7 @@ def get_cluster_info(cluster_name: str,
                 external_ip=None,
                 state=_PHASE_TO_STATE.get(
                     p['status'].get('phase', 'Unknown'), 'UNKNOWN'),
-                agent_url=(f'http://{ip}:{manifests.AGENT_PORT}'
+                agent_url=(f'{scheme}://{ip}:{manifests.AGENT_PORT}'
                            if ip else None)))
         # A reclaimed spot pod is DELETED, not Failed — with only live
         # pods listed, a 3/4 gang would read as all-RUNNING and the
@@ -494,9 +504,12 @@ def get_cluster_info(cluster_name: str,
         instance_type=tpu_slice or 'pod',
         use_spot=False,
         cost_per_hour=0.0,
-        provider_config={k: v for k, v in provider_config.items()
-                         if k in ('context', 'namespace', 'image',
-                                  'agent_token')})
+        provider_config={
+            **{k: v for k, v in provider_config.items()
+               if k in ('context', 'namespace', 'image', 'agent_token',
+                        'agent_tls_cert', 'agent_tls_key')},
+            'agent_cert_fingerprint': tls.fingerprint_of_pem(
+                provider_config.get('agent_tls_cert'))})
 
 
 def _slice_name_from_gke(gke_acc: Optional[str],

@@ -30,6 +30,7 @@ from skypilot_tpu import topology
 from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
                                            ProvisionConfig)
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import tls
 
 AGENT_START_TIMEOUT = 30.0
 
@@ -73,9 +74,14 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
     # re-provision (a live agent keeps serving under it), generate on
     # first create. Callers that pass one (provisioner) win.
     token = config.provider_config.get('agent_token')
+    prev = _meta_of(cdir)
     if not token:
-        prev = _meta_of(cdir)
         token = (prev or {}).get('agent_token') or secrets.token_hex(16)
+    # Cluster TLS pair: generated once, reused across idempotent
+    # re-provisions (a rotation would invalidate the live agent's pin
+    # mid-flight); rides meta.json → agent_config.json like the token.
+    cert_pem, key_pem = tls.ensure_cluster_cert(
+        prev or {}, config.cluster_name, 'tls_cert_pem', 'tls_key_pem')
     meta = {
         'cluster_name': config.cluster_name,
         'region': config.region,
@@ -87,6 +93,8 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         'use_spot': config.use_spot,
         'created_at': time.time(),
         'agent_token': token,
+        'tls_cert_pem': cert_pem,
+        'tls_key_pem': key_pem,
     }
     with open(os.path.join(cdir, 'meta.json'), 'w', encoding='utf-8') as f:
         json.dump(meta, f)
@@ -112,6 +120,8 @@ def _start_agent(cluster_name: str) -> None:
         'num_slices': num_slices,
         'tpu_slice': meta.get('tpu_slice'),
         'auth_token': meta.get('agent_token'),
+        'tls_cert_pem': meta.get('tls_cert_pem'),
+        'tls_key_pem': meta.get('tls_key_pem'),
     }
     with open(os.path.join(cdir, 'agent_config.json'), 'w',
               encoding='utf-8') as f:
@@ -154,9 +164,19 @@ def _pid_alive(pid: int) -> bool:
         return False
     try:
         os.kill(pid, 0)
-        return True
     except (ProcessLookupError, PermissionError):
         return False
+    # A zombie answers kill(0) but is already dead — the agent's Popen
+    # handle is never wait()ed (it outlives the provision call), so
+    # every killed agent lingers as a zombie and a liveness wait that
+    # counts zombies as alive burns its whole timeout on a corpse.
+    try:
+        with open(f'/proc/{pid}/stat', encoding='utf-8') as f:
+            # Field 3 (after the parenthesized comm, which may itself
+            # contain spaces): process state.
+            return f.read().rpartition(')')[2].split()[0] != 'Z'
+    except (OSError, IndexError):
+        return True
 
 
 def _kill_job_pgids(cdir: str) -> None:
@@ -322,7 +342,10 @@ def get_cluster_info(cluster_name: str,
         use_spot=meta.get('use_spot', False),
         cost_per_hour=0.0,
         provider_config={'cluster_dir': cdir,
-                         'agent_token': meta.get('agent_token')})
+                         'agent_token': meta.get('agent_token'),
+                         'agent_cert_fingerprint': (
+                             tls.fingerprint_of_pem(
+                                 meta.get('tls_cert_pem')))})
 
 
 def open_ports(cluster_name: str, ports,

@@ -91,10 +91,12 @@ def bulk_provision(candidate: catalog.Candidate,
         import time as time_lib
         budget = float(os_lib.environ.get('SKY_TPU_AGENT_WAIT_S', '60'))
         deadline = time_lib.time() + budget
+        fp = info.provider_config.get('agent_cert_fingerprint')
         for host in info.hosts:
             if host.agent_url:
-                agent_client.AgentClient(host.agent_url).wait_healthy(
-                    timeout=max(5.0, deadline - time_lib.time()))
+                agent_client.AgentClient(
+                    host.agent_url, cert_fingerprint=fp).wait_healthy(
+                        timeout=max(5.0, deadline - time_lib.time()))
     if res.ports:
         provision.open_ports(candidate.cloud, cluster_name, res.ports,
                              info.provider_config)

@@ -40,6 +40,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
                                            ProvisionConfig)
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import tls
 
 AGENT_PORT = 46590
 SUBMIT_TIMEOUT_S = 30.0
@@ -87,10 +88,13 @@ def _write_meta(cdir: str, meta: Dict[str, Any]) -> None:
 
 
 def _node_script(cdir: str, cluster_name: str,
-                 tpu_slice: Optional[str], token: str) -> str:
+                 tpu_slice: Optional[str], token: str,
+                 cert_pem: Optional[str] = None,
+                 key_pem: Optional[str] = None) -> str:
     """The per-node srun payload: derive rank/hosts from the Slurm env,
     write the agent config, run the agent in the foreground (the srun
     task's lifetime IS the allocation's)."""
+    scheme = 'https' if cert_pem else 'http'
     return f"""#!/bin/bash
 set -e
 RANK=${{SLURM_NODEID:?}}
@@ -109,7 +113,9 @@ cfg = {{
     'num_hosts': len(hosts),
     'tpu_slice': {tpu_slice!r},
     'auth_token': {token!r},
-    'peer_agent_urls': [f'http://{{h}}:{AGENT_PORT}'
+    'tls_cert_pem': {cert_pem!r},
+    'tls_key_pem': {key_pem!r},
+    'peer_agent_urls': [f'{scheme}://{{h}}:{AGENT_PORT}'
                         for i, h in enumerate(hosts) if i != rank]
                        if rank == 0 else [],
 }}
@@ -148,10 +154,16 @@ def _submit(config: ProvisionConfig, cdir: str) -> str:
     # rides meta['provider_config'] so get_cluster_info preserves it.
     config.provider_config.setdefault('agent_token',
                                       secrets.token_hex(16))
+    # Cluster TLS pair (utils/tls.py) — generated with the token,
+    # delivered via the node-start script on the shared filesystem.
+    tls.ensure_cluster_cert(config.provider_config,
+                            config.cluster_name)
     with open(os.path.join(cdir, 'node_start.sh'), 'w',
               encoding='utf-8') as f:
         f.write(_node_script(cdir, config.cluster_name, config.tpu_slice,
-                             config.provider_config['agent_token']))
+                             config.provider_config['agent_token'],
+                             config.provider_config['agent_tls_cert'],
+                             config.provider_config['agent_tls_key']))
     os.chmod(os.path.join(cdir, 'node_start.sh'), 0o700)
     sbatch_path = os.path.join(cdir, 'job.sbatch')
     with open(sbatch_path, 'w', encoding='utf-8') as f:
@@ -230,12 +242,15 @@ def get_cluster_info(cluster_name: str,
         # Not (or no longer) allocated: synthesize placeholders so the
         # host count survives for status displays.
         nodes = [f'<pending-{i}>' for i in range(meta['num_hosts'])]
+    scheme = ('https'
+              if meta.get('provider_config', {}).get('agent_tls_cert')
+              else 'http')
     hosts = [HostInfo(
         host_id=f'{cluster_name}-node{i}',
         internal_ip=n,
         external_ip=n if not n.startswith('<') else None,
         state=host_state,
-        agent_url=(f'http://{n}:{AGENT_PORT}'
+        agent_url=(f'{scheme}://{n}:{AGENT_PORT}'
                    if host_state == 'RUNNING' else None))
         for i, n in enumerate(nodes)]
     return ClusterInfo(
@@ -254,7 +269,10 @@ def get_cluster_info(cluster_name: str,
         # is a local copy into host<i>/workdir, exactly where each
         # node's agent runs jobs.
         provider_config={**meta.get('provider_config', {}),
-                         'job_id': job_id, 'cluster_dir': cdir})
+                         'job_id': job_id, 'cluster_dir': cdir,
+                         'agent_cert_fingerprint': tls.fingerprint_of_pem(
+                             meta.get('provider_config', {})
+                             .get('agent_tls_cert'))})
 
 
 def wait_instances(cluster_name: str, provider_config: Dict[str, Any],

@@ -27,6 +27,7 @@ from skypilot_tpu.provision.local import instance as local_instance
 from skypilot_tpu.ssh_node_pools import SSHNodePoolManager
 from skypilot_tpu.utils import command_runner
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import tls
 
 AGENT_PORT = 46590   # same convention as the GCP provider
 AGENT_DIR = '/opt/sky_tpu/cluster'
@@ -78,9 +79,14 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
     os.makedirs(cdir, exist_ok=True)
     # Per-cluster agent secret (reused on idempotent re-provision so a
     # live agent keeps serving; see runtime/agent.py auth middleware).
+    prev_meta = _meta(cdir) or {}
     token = (config.provider_config.get('agent_token') or
-             (_meta(cdir) or {}).get('agent_token') or
+             prev_meta.get('agent_token') or
              secrets.token_hex(16))
+    # Cluster TLS pair (utils/tls.py): generated once per cluster,
+    # reused on idempotent re-provision so live agents keep their pin.
+    cert_pem, key_pem = tls.ensure_cluster_cert(
+        prev_meta, config.cluster_name, 'tls_cert_pem', 'tls_key_pem')
     mode = pool.get('mode', 'ssh')
     if mode == 'process':
         # Delegate host simulation to the local provider, then overlay
@@ -98,6 +104,8 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
             'pool': pool['name'],
             'mode': 'process',
             'agent_token': token,
+            'tls_cert_pem': cert_pem,
+            'tls_key_pem': key_pem,
         }
         for r in range(num_hosts):
             hd = os.path.join(cdir, f'host{r}')
@@ -116,7 +124,7 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         raise exceptions.ProvisionError(
             f'[ssh] pool {pool["name"]!r} hosts unreachable: {dead}',
             retryable=True)
-    _bootstrap_agent(config.cluster_name, pool, token)
+    _bootstrap_agent(config.cluster_name, pool, token, cert_pem, key_pem)
     meta = {
         'cluster_name': config.cluster_name,
         'region': pool.get('region', 'pool'),
@@ -129,6 +137,8 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         'pool': pool['name'],
         'mode': 'ssh',
         'agent_token': token,
+        'tls_cert_pem': cert_pem,
+        'tls_key_pem': key_pem,
     }
     with open(os.path.join(cdir, 'meta.json'), 'w', encoding='utf-8') as f:
         json.dump(meta, f)
@@ -136,7 +146,8 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
 
 
 def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any],
-                     token: str) -> None:
+                     token: str, cert_pem: Optional[str] = None,
+                     key_pem: Optional[str] = None) -> None:
     """Push the framework + start an agent on EVERY host (mirrors the GCP
     provider's _install_agents: head's agent fans job ranks out to peers'
     /run_rank, so each host needs a listening agent)."""
@@ -154,12 +165,14 @@ def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any],
             'cluster_name': cluster_name,
             'mode': 'host',
             'auth_token': token,
+            'tls_cert_pem': cert_pem,
+            'tls_key_pem': key_pem,
             'host_rank': rank,
             'host_ips': hosts,
             'num_hosts': len(hosts),
             'tpu_slice': pool.get('accelerator'),
             'peer_agent_urls': [
-                f'http://{h}:{AGENT_PORT}'
+                f'{"https" if cert_pem else "http"}://{h}:{AGENT_PORT}'
                 for i, h in enumerate(hosts) if i != rank
             ] if rank == 0 else [],
             # NOTE: no password here — agent_config.json lands on every
@@ -221,7 +234,8 @@ def start_instances(cluster_name: str,
         local_instance.start_instances(cluster_name, provider_config)
         return get_cluster_info(cluster_name, provider_config)
     pool = _pool_of({'pool': meta['pool']})
-    _bootstrap_agent(cluster_name, pool)
+    _bootstrap_agent(cluster_name, pool, meta['agent_token'],
+                     meta.get('tls_cert_pem'), meta.get('tls_key_pem'))
     return get_cluster_info(cluster_name, provider_config)
 
 
@@ -284,9 +298,10 @@ def get_cluster_info(cluster_name: str,
     pool = _pool_of({'pool': meta['pool']})
     # Per-HOST agent URLs: each host runs its own agent (the head fans
     # ranks out to them); provisioning waits on every one of them.
+    scheme = 'https' if meta.get('tls_cert_pem') else 'http'
     hosts = [HostInfo(host_id=f'{cluster_name}-host{i}',
                       internal_ip=h, external_ip=h, state='RUNNING',
-                      agent_url=f'http://{h}:{AGENT_PORT}')
+                      agent_url=f'{scheme}://{h}:{AGENT_PORT}')
              for i, h in enumerate(pool['hosts'])]
     return ClusterInfo(
         cluster_name=cluster_name, cloud='ssh',
@@ -298,7 +313,9 @@ def get_cluster_info(cluster_name: str,
                          'ssh_user': pool.get('user'),
                          'ssh_key': pool.get('identity_file'),
                          'ssh_password': pool.get('password'),
-                         'agent_token': meta.get('agent_token')})
+                         'agent_token': meta.get('agent_token'),
+                         'agent_cert_fingerprint': tls.fingerprint_of_pem(
+                             meta.get('tls_cert_pem'))})
 
 
 def open_ports(cluster_name: str, ports,

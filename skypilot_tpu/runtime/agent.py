@@ -77,6 +77,16 @@ class Agent:
         # cloud_vm_ray_backend.py:2288-2320; a bearer token over the VPC
         # is this framework's equivalent trust boundary).
         self._token_cache = (-1.0, self.config.get('auth_token'))
+        # Cluster TLS (utils/tls.py): cert+key PEMs ride agent_config
+        # next to the bearer token; all agents of a cluster share one
+        # cert, so peer fan-out pins the same fingerprint it serves.
+        self.tls_cert_pem: Optional[str] = self.config.get('tls_cert_pem')
+        self.tls_key_pem: Optional[str] = self.config.get('tls_key_pem')
+        self.cert_fingerprint: Optional[str] = None
+        if self.tls_cert_pem:
+            from skypilot_tpu.utils import tls
+            self.cert_fingerprint = tls.fingerprint_of_pem(
+                self.tls_cert_pem)
         # autostop state (reference sky/skylet/autostop_lib.py)
         self._autostop_file = os.path.join(self.cluster_dir, 'autostop.json')
         # job_id -> list of subprocess handles (local-slice mode)
@@ -284,13 +294,16 @@ class Agent:
                             os.path.join(log_dir,
                                          f'rank{self.host_rank}_{phase}.log'))
 
+        from skypilot_tpu.utils import tls
+        peer_ssl = tls.aiohttp_ssl(self.cert_fingerprint)
+
         async def call_peer(sess: 'aiohttp.ClientSession', url: str) -> int:
             # Response body must be read while the session is open. The
             # cluster token rides the fan-out too — peers enforce it.
             async with sess.post(f'{url}/run_rank', json={
                     'job_id': job_id, 'cmd': cmd, 'envs': envs,
                     'phase': phase,
-            }, headers=self._auth_headers(),
+            }, headers=self._auth_headers(), ssl=peer_ssl,
                     timeout=aiohttp.ClientTimeout(total=None)) as res:
                 body = await res.json()
                 return int(body.get('returncode', 255))
@@ -586,16 +599,24 @@ async def _main(cluster_dir: str, host: str, port: int) -> None:
     app = agent.make_app()
     runner = web.AppRunner(app)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    ssl_ctx = None
+    if agent.tls_cert_pem and agent.tls_key_pem:
+        from skypilot_tpu.utils import tls
+        ssl_ctx = tls.server_context(agent.tls_cert_pem,
+                                     agent.tls_key_pem,
+                                     workdir=agent.cluster_dir)
+    site = web.TCPSite(runner, host, port, ssl_context=ssl_ctx)
     await site.start()
     actual_port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+    scheme = 'https' if ssl_ctx is not None else 'http'
     # Atomic publish: provisioners poll for this file and JSON-parse it the
     # moment it appears, so a plain open/write races with the reader.
     agent_json = os.path.join(cluster_dir, 'agent.json')
     tmp = agent_json + '.tmp'
     with open(tmp, 'w', encoding='utf-8') as f:
-        json.dump({'url': f'http://{host}:{actual_port}',
-                   'pid': os.getpid()}, f)
+        json.dump({'url': f'{scheme}://{host}:{actual_port}',
+                   'pid': os.getpid(),
+                   'cert_fingerprint': agent.cert_fingerprint}, f)
     os.replace(tmp, agent_json)
     loop = asyncio.get_event_loop()
     loop.create_task(agent.scheduler_loop())

@@ -15,26 +15,34 @@ import requests
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import tls
 
 
 class AgentClient:
     def __init__(self, url: str, timeout: float = 30.0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 cert_fingerprint: Optional[str] = None):
         self.url = url.rstrip('/')
         self.timeout = timeout
         # Per-cluster shared secret (provision-time generated, rides
         # ClusterInfo.provider_config['agent_token']); the agent 403s
         # every endpoint but /health without it.
         self.token = token
+        # Cluster cert pin (provider_config['agent_cert_fingerprint']).
+        # https agent URLs are only spoken to through the pinned
+        # session — an unpinned https URL fails closed (utils/tls.py).
+        self._session = tls.pinned_session(cert_fingerprint)
 
     @classmethod
     def for_info(cls, info, timeout: float = 30.0,
                  host: Optional[int] = None) -> 'AgentClient':
         """Client for a cluster's head agent (or host index ``host``),
-        with the cluster token wired through."""
+        with the cluster token + cert pin wired through."""
         h = info.hosts[host] if host is not None else info.head
         return cls(h.agent_url, timeout=timeout,
-                   token=info.provider_config.get('agent_token'))
+                   token=info.provider_config.get('agent_token'),
+                   cert_fingerprint=info.provider_config.get(
+                       'agent_cert_fingerprint'))
 
     def _headers(self) -> dict:
         return ({'Authorization': f'Bearer {self.token}'}
@@ -51,7 +59,7 @@ class AgentClient:
         last_err: Optional[Exception] = None
         while time.time() < deadline:
             try:
-                r = requests.get(f'{self.url}/health', timeout=5)
+                r = self._session.get(f'{self.url}/health', timeout=5)
                 if r.ok:
                     return r.json()
             except requests.RequestException as e:
@@ -61,20 +69,20 @@ class AgentClient:
             f'Agent at {self.url} not healthy after {timeout}s: {last_err}')
 
     def health(self) -> Dict[str, Any]:
-        r = requests.get(f'{self.url}/health', timeout=self.timeout)
+        r = self._session.get(f'{self.url}/health', timeout=self.timeout)
         r.raise_for_status()
         return r.json()
 
     def submit(self, name: str, run: str, setup: Optional[str] = None,
                envs: Optional[Dict[str, str]] = None) -> int:
-        r = requests.post(f'{self.url}/submit', json={
+        r = self._session.post(f'{self.url}/submit', json={
             'name': name, 'run': run, 'setup': setup, 'envs': envs or {},
         }, headers=self._headers(), timeout=self.timeout)
         r.raise_for_status()
         return int(r.json()['job_id'])
 
     def job_status(self, job_id: int) -> common.JobStatus:
-        r = requests.get(f'{self.url}/jobs/{job_id}',
+        r = self._session.get(f'{self.url}/jobs/{job_id}',
                          headers=self._headers(), timeout=self.timeout)
         if r.status_code == 404:
             raise exceptions.JobNotFoundError(f'job {job_id}')
@@ -82,13 +90,13 @@ class AgentClient:
         return common.JobStatus(r.json()['status'])
 
     def jobs(self) -> List[Dict[str, Any]]:
-        r = requests.get(f'{self.url}/jobs', headers=self._headers(),
+        r = self._session.get(f'{self.url}/jobs', headers=self._headers(),
                          timeout=self.timeout)
         r.raise_for_status()
         return r.json()['jobs']
 
     def cancel(self, job_id: int) -> None:
-        r = requests.post(f'{self.url}/cancel/{job_id}',
+        r = self._session.post(f'{self.url}/cancel/{job_id}',
                           headers=self._headers(), timeout=self.timeout)
         if r.status_code == 404:
             raise exceptions.JobNotFoundError(f'job {job_id}')
@@ -97,7 +105,7 @@ class AgentClient:
     def exec_sync(self, cmd: str,
                   envs: Optional[Dict[str, str]] = None,
                   timeout: float = 600.0) -> Dict[str, Any]:
-        r = requests.post(f'{self.url}/exec',
+        r = self._session.post(f'{self.url}/exec',
                           json={'cmd': cmd, 'envs': envs or {}},
                           headers=self._headers(), timeout=timeout)
         r.raise_for_status()
@@ -105,7 +113,7 @@ class AgentClient:
 
     def tail_logs(self, job_id: int, *, follow: bool = True,
                   rank: int = 0) -> Iterator[bytes]:
-        with requests.get(
+        with self._session.get(
                 f'{self.url}/logs/{job_id}',
                 params={'follow': '1' if follow else '0', 'rank': rank},
                 headers=self._headers(), stream=True, timeout=None) as r:
@@ -125,7 +133,7 @@ class AgentClient:
         raise TimeoutError(f'job {job_id} still running after {timeout}s')
 
     def set_autostop(self, idle_minutes: int, down: bool = False) -> None:
-        r = requests.post(f'{self.url}/autostop', json={
+        r = self._session.post(f'{self.url}/autostop', json={
             'idle_minutes': idle_minutes, 'down': down,
         }, headers=self._headers(), timeout=self.timeout)
         r.raise_for_status()

@@ -37,6 +37,14 @@ def _validate(task: task_lib.Task) -> spec_lib.ServiceSpec:
             'a service task needs a `run` command that starts the '
             'workload server')
     spec = spec_lib.ServiceSpec.from_config(task.service)
+    if spec.tls is not None:
+        # Fail at `serve up`, not in the detached service process: the
+        # cert/key files live on this (server) host, where the LB runs.
+        for what, path in (('certfile', spec.tls.certfile),
+                           ('keyfile', spec.tls.keyfile)):
+            if not os.path.isfile(os.path.expanduser(path)):
+                raise exceptions.InvalidTaskError(
+                    f'service tls {what} not found: {path}')
     if spec.pool:
         # `pool` in ServiceSpec exists only to round-trip the stored
         # spec_json of worker pools; user YAML creates pools via the
@@ -75,7 +83,8 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
             f'roll it, or pick another name')
     if _spawn:
         service_lib.spawn_detached(name)
-    return {'name': name, 'endpoint': f'http://127.0.0.1:{lb_port}'}
+    scheme = 'https' if spec.tls else 'http'
+    return {'name': name, 'endpoint': f'{scheme}://127.0.0.1:{lb_port}'}
 
 
 def update(task: task_lib.Task, service_name: str) -> int:
@@ -88,18 +97,21 @@ def update(task: task_lib.Task, service_name: str) -> int:
     return version
 
 
-def down(service_name: str, *, purge: bool = False,
-         timeout: float = 120.0) -> None:
-    """Tear a service down: replicas, then the service row itself."""
-    record = _require_service(service_name)
-    serve_state.request_shutdown(service_name)
+def down_record(record: Dict[str, Any], *, purge: bool = False,
+                timeout: float = 120.0, kind: str = 'service') -> None:
+    """Shared teardown body for services AND worker pools (pools ride
+    the same state tables; only the caller's record predicate differs):
+    request shutdown, let a live controller drain, else (or on purge)
+    terminate replicas and delete the row in-process."""
+    name = record['name']
+    serve_state.request_shutdown(name)
     pid = record.get('controller_pid')
     alive = common.pid_alive(pid)
     if not alive or purge:
         # No controller to do it — clean up here.
         from skypilot_tpu.serve import replica_managers
         rm = replica_managers.ReplicaManager(
-            service_name,
+            name,
             spec_lib.ServiceSpec.from_config(record['spec']),
             record['task_yaml'])
         rm.terminate_all()
@@ -109,16 +121,23 @@ def down(service_name: str, *, purge: bool = False,
                 os.kill(pid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
-        serve_state.remove_service(service_name)
+        serve_state.remove_service(name)
         return
     deadline = time.time() + timeout
     while time.time() < deadline:
-        if serve_state.get_service(service_name) is None:
+        if serve_state.get_service(name) is None:
             return
         time.sleep(0.2)
     raise TimeoutError(
-        f'service {service_name!r} still shutting down after {timeout}s; '
+        f'{kind} {name!r} still shutting down after {timeout}s; '
         f'retry with purge=True to force')
+
+
+def down(service_name: str, *, purge: bool = False,
+         timeout: float = 120.0) -> None:
+    """Tear a service down: replicas, then the service row itself."""
+    record = _require_service(service_name)
+    down_record(record, purge=purge, timeout=timeout, kind='service')
 
 
 def restart_replica(service_name: str, replica_id: int) -> None:

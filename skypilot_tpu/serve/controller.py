@@ -218,8 +218,10 @@ def service_snapshot(name: str) -> Optional[dict]:
         'name': record['name'],
         'status': record['status'].value,
         'version': record['version'],
-        'endpoint': f'http://127.0.0.1:{record["lb_port"]}'
-                    if record['lb_port'] else None,
+        'endpoint': (
+            f'{"https" if (record.get("spec") or {}).get("tls") else "http"}'
+            f'://127.0.0.1:{record["lb_port"]}'
+            if record['lb_port'] else None),
         'policy': record['lb_policy'],
         'pool': bool(record.get('pool')),
         'failure_reason': record['failure_reason'],

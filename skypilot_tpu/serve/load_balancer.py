@@ -188,15 +188,17 @@ class LoadBalancer:
         app.router.add_route('*', '/{tail:.*}', self.handle)
         return app
 
-    async def run(self, host: str, port: int) -> None:
+    async def run(self, host: str, port: int,
+                  ssl_context=None) -> None:
         self._session = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=600))
         runner = web.AppRunner(self.make_app())
         await runner.setup()
-        site = web.TCPSite(runner, host, port)
+        site = web.TCPSite(runner, host, port, ssl_context=ssl_context)
         await site.start()
-        logger.info('service %s: load balancer on %s:%d',
-                    self.service_name, host, port)
+        logger.info('service %s: load balancer on %s://%s:%d',
+                    self.service_name,
+                    'https' if ssl_context else 'http', host, port)
         tasks = [asyncio.create_task(self._sync_loop()),
                  asyncio.create_task(self._stats_loop())]
         try:
@@ -210,7 +212,7 @@ class LoadBalancer:
 
 
 def run_load_balancer(service_name: str, policy_name: str, host: str,
-                      port: int) -> None:
+                      port: int, ssl_context=None) -> None:
     """Blocking entry (reference run_load_balancer :289)."""
     lb = LoadBalancer(service_name, policy_name)
-    asyncio.run(lb.run(host, port))
+    asyncio.run(lb.run(host, port, ssl_context=ssl_context))

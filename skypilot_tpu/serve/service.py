@@ -28,6 +28,22 @@ def run_service(service_name: str) -> None:
         raise ValueError(f'service {service_name!r} not found')
     ctl = controller_lib.ServeController(service_name)
     lb = lb_lib.LoadBalancer(service_name, record['lb_policy'])
+    # TLS termination (reference sky/serve/load_balancer.py:274-286):
+    # the tls: block in the service spec names operator cert/key files.
+    # A bad path must surface as a FAILED service, not a silent
+    # CONTROLLER_INIT hang.
+    ssl_ctx = None
+    tls_cfg = (record.get('spec') or {}).get('tls')
+    if tls_cfg:
+        from skypilot_tpu.utils import tls as tls_lib
+        try:
+            ssl_ctx = tls_lib.file_server_context(tls_cfg['certfile'],
+                                                  tls_cfg['keyfile'])
+        except (OSError, ValueError) as e:
+            serve_state.set_service_status(
+                service_name, serve_state.ServiceStatus.FAILED,
+                f'tls credential unusable: {type(e).__name__}: {e}')
+            raise
 
     def controller_thread() -> None:
         try:
@@ -41,7 +57,8 @@ def run_service(service_name: str) -> None:
     t.start()
     import asyncio
     try:
-        asyncio.run(lb.run('127.0.0.1', record['lb_port']))
+        asyncio.run(lb.run('127.0.0.1', record['lb_port'],
+                           ssl_context=ssl_ctx))
     except Exception as e:  # noqa: BLE001 — e.g. LB port stolen pre-bind
         logger.exception('service %s: load balancer died', service_name)
         serve_state.set_service_status(

@@ -169,10 +169,40 @@ class ReplicaPolicy:
 
 
 @dataclasses.dataclass
+class TlsCredential:
+    """LB HTTPS termination (reference sky/serve/load_balancer.py:274-286
+    TLSCredential): operator-supplied cert/key served by the load
+    balancer; user traffic to the service endpoint rides TLS."""
+    certfile: str
+    keyfile: str
+
+    @classmethod
+    def from_config(cls, config: Any) -> 'TlsCredential':
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'service tls must be a mapping with certfile/keyfile, '
+                f'got {type(config).__name__}')
+        unknown = set(config) - {'certfile', 'keyfile'}
+        if unknown:
+            raise exceptions.InvalidTaskError(
+                f'unknown tls fields: {sorted(unknown)}')
+        if not config.get('certfile') or not config.get('keyfile'):
+            raise exceptions.InvalidTaskError(
+                'service tls requires both certfile and keyfile')
+        return cls(certfile=str(config['certfile']),
+                   keyfile=str(config['keyfile']))
+
+    def to_config(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
 class ServiceSpec:
     readiness_probe: ReadinessProbe
     replica_policy: ReplicaPolicy
     load_balancing_policy: str = 'least_load'
+    # HTTPS termination at the LB (None → plaintext endpoint).
+    tls: Optional[TlsCredential] = None
     # Port the replica's workload listens on. The replica manager injects
     # it as $SKYPILOT_SERVE_PORT (locally each replica gets a unique one).
     replica_port: Optional[int] = None
@@ -188,7 +218,7 @@ class ServiceSpec:
             raise exceptions.InvalidTaskError(
                 f'service must be a mapping, got {type(config).__name__}')
         known = {'readiness_probe', 'replica_policy', 'replicas',
-                 'load_balancing_policy', 'replica_port', 'pool'}
+                 'load_balancing_policy', 'replica_port', 'pool', 'tls'}
         unknown = set(config) - known
         if unknown:
             raise exceptions.InvalidTaskError(
@@ -211,6 +241,8 @@ class ServiceSpec:
                           if config.get('replica_port') is not None
                           else None),
             pool=bool(config.get('pool', False)),
+            tls=(TlsCredential.from_config(config['tls'])
+                 if config.get('tls') is not None else None),
         )
 
     def to_config(self) -> Dict[str, Any]:
@@ -220,6 +252,7 @@ class ServiceSpec:
             'load_balancing_policy': self.load_balancing_policy,
             'replica_port': self.replica_port,
             'pool': self.pool,
+            'tls': self.tls.to_config() if self.tls else None,
         }
 
 

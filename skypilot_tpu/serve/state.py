@@ -110,37 +110,19 @@ def _db() -> db_util.Db:
     db = db_util.get_db(os.path.join(common.base_dir(), 'serve.db'),
                         _SCHEMA)
     if db.path not in _migrated:
-        # Round-3 column on pre-existing DBs (CREATE IF NOT EXISTS does
-        # not evolve live tables). Checked once per path per process.
-        for table, col, ddl in (
-                ('replicas', 'accelerator',
-                 'ALTER TABLE replicas ADD COLUMN accelerator TEXT'),
-                ('replicas', 'restart_requested',
-                 'ALTER TABLE replicas ADD COLUMN '
-                 'restart_requested INTEGER DEFAULT 0'),
-                ('replicas', 'assigned_job',
-                 'ALTER TABLE replicas ADD COLUMN assigned_job INTEGER'),
-                ('services', 'pool',
-                 'ALTER TABLE services ADD COLUMN pool INTEGER '
-                 'DEFAULT 0')):
-            try:
-                db.conn.execute(
-                    f'SELECT {col} FROM {table} LIMIT 1')
-                continue
-            except Exception:  # noqa: BLE001 — old schema
-                pass
-            try:
-                db.conn.rollback()
-            except Exception:  # noqa: BLE001 — sqlite: nothing open
-                pass
-            try:
-                db.conn.execute(ddl)
-                db.conn.commit()
-            except Exception:  # noqa: BLE001 — concurrent migrator won
-                try:
-                    db.conn.rollback()
-                except Exception:  # noqa: BLE001
-                    pass
+        # Add-column migrations on pre-existing DBs (CREATE IF NOT
+        # EXISTS does not evolve live tables). Once per path per process.
+        db_util.ensure_columns(db.conn, [
+            ('replicas', 'accelerator',
+             'ALTER TABLE replicas ADD COLUMN accelerator TEXT'),
+            ('replicas', 'restart_requested',
+             'ALTER TABLE replicas ADD COLUMN '
+             'restart_requested INTEGER DEFAULT 0'),
+            ('replicas', 'assigned_job',
+             'ALTER TABLE replicas ADD COLUMN assigned_job INTEGER'),
+            ('services', 'pool',
+             'ALTER TABLE services ADD COLUMN pool INTEGER DEFAULT 0'),
+        ])
         _migrated.add(db.path)
     return db
 

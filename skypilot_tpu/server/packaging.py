@@ -105,6 +105,7 @@ def render_deployment(namespace: str = 'sky-tpu', *,
             'template': {
                 'metadata': {'labels': _labels()},
                 'spec': {
+                    'serviceAccountName': 'sky-tpu-api',
                     # With a postgres db-url, prove the dialect
                     # translation against the REAL server before the API
                     # server takes writes (utils/db_selftest.py; no-op
@@ -141,12 +142,20 @@ def render_deployment(namespace: str = 'sky-tpu', *,
                         'volumeMounts': [{
                             'name': 'state',
                             'mountPath': '/var/lib/sky-tpu',
+                        }, {
+                            'name': 'server-config',
+                            'mountPath': '/var/lib/sky-tpu/config.yaml',
+                            'subPath': 'config.yaml',
                         }],
                     }],
                     'volumes': [{
                         'name': 'state',
                         'persistentVolumeClaim':
                             {'claimName': 'sky-tpu-state'},
+                    }, {
+                        'name': 'server-config',
+                        'configMap':
+                            {'name': 'sky-tpu-server-config'},
                     }],
                 },
             },
@@ -212,7 +221,12 @@ def render_oauth2_proxy(namespace: str = 'sky-tpu') -> List[Dict[str, Any]]:
                     'args': ['--http-address=0.0.0.0:4180',
                              '--reverse-proxy=true',
                              '--set-xauthrequest=true',
-                             '--email-domain=*'],
+                             '--email-domain=*',
+                             # Redis session store (oauth2-proxy-redis):
+                             # large OIDC tokens overflow cookie limits.
+                             '--session-store-type=redis',
+                             '--redis-connection-url='
+                             'redis://sky-tpu-oauth2-redis:6379'],
                     'envFrom': [{'secretRef':
                                  {'name': 'sky-tpu-oauth2'}}],
                     'ports': [{'containerPort': 4180}],
@@ -231,6 +245,221 @@ def render_oauth2_proxy(namespace: str = 'sky-tpu') -> List[Dict[str, Any]]:
     return [dep, svc]
 
 
+def render_oauth2_redis(namespace: str = 'sky-tpu') -> List[Dict[str, Any]]:
+    """Session store for oauth2-proxy (reference
+    templates/oauth2-proxy-redis.yaml): cookie sessions overflow header
+    limits with large OIDC tokens, so sessions live in redis and the
+    cookie carries only a ticket."""
+    labels = {'app': 'sky-tpu-oauth2-redis'}
+    dep = {
+        'apiVersion': 'apps/v1',
+        'kind': 'Deployment',
+        'metadata': {'name': 'sky-tpu-oauth2-redis',
+                     'namespace': namespace, 'labels': labels},
+        'spec': {
+            'replicas': 1,
+            'selector': {'matchLabels': labels},
+            'template': {
+                'metadata': {'labels': labels},
+                'spec': {'containers': [{
+                    'name': 'redis',
+                    'image': 'redis:7-alpine',
+                    'args': ['--save', '', '--appendonly', 'no'],
+                    'ports': [{'containerPort': 6379}],
+                    'resources': {'requests': {'cpu': '50m',
+                                               'memory': '64Mi'}},
+                }]},
+            },
+        },
+    }
+    svc = {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {'name': 'sky-tpu-oauth2-redis',
+                     'namespace': namespace, 'labels': labels},
+        'spec': {'selector': labels,
+                 'ports': [{'port': 6379, 'targetPort': 6379}]},
+    }
+    return [dep, svc]
+
+
+def render_rbac(namespace: str = 'sky-tpu') -> List[Dict[str, Any]]:
+    """ServiceAccount + Role for the API server (reference
+    templates/rbac.yaml): lets an in-cluster control plane provision
+    TPU workload pods through the kubernetes provider without cluster-
+    admin credentials mounted by hand."""
+    sa = {
+        'apiVersion': 'v1',
+        'kind': 'ServiceAccount',
+        'metadata': {'name': 'sky-tpu-api', 'namespace': namespace},
+    }
+    role = {
+        'apiVersion': 'rbac.authorization.k8s.io/v1',
+        'kind': 'Role',
+        'metadata': {'name': 'sky-tpu-api', 'namespace': namespace},
+        'rules': [
+            # The k8s provider's object set (provision/k8s/manifests.py):
+            # workload pods/STSs, their services, PVC volumes, and exec
+            # into pods for agent bootstrap + log pull.
+            {'apiGroups': [''],
+             'resources': ['pods', 'pods/exec', 'pods/log', 'services',
+                           'persistentvolumeclaims', 'configmaps',
+                           'secrets', 'events'],
+             'verbs': ['get', 'list', 'watch', 'create', 'update',
+                       'patch', 'delete']},
+            {'apiGroups': ['apps'],
+             'resources': ['statefulsets', 'deployments'],
+             'verbs': ['get', 'list', 'watch', 'create', 'update',
+                       'patch', 'delete']},
+            {'apiGroups': ['networking.k8s.io'],
+             'resources': ['networkpolicies'],
+             'verbs': ['get', 'list', 'create', 'delete']},
+        ],
+    }
+    binding = {
+        'apiVersion': 'rbac.authorization.k8s.io/v1',
+        'kind': 'RoleBinding',
+        'metadata': {'name': 'sky-tpu-api', 'namespace': namespace},
+        'subjects': [{'kind': 'ServiceAccount', 'name': 'sky-tpu-api',
+                      'namespace': namespace}],
+        'roleRef': {'apiGroup': 'rbac.authorization.k8s.io',
+                    'kind': 'Role', 'name': 'sky-tpu-api'},
+    }
+    return [sa, role, binding]
+
+
+def render_ingress(namespace: str = 'sky-tpu', *,
+                   host: str = 'sky-tpu.example.com',
+                   tls_secret: str = 'sky-tpu-ingress-tls',
+                   oauth2: bool = True) -> Dict[str, Any]:
+    """HTTPS ingress in front of the API server (reference
+    templates/ingress.yaml + oauth2-proxy-ingress.yaml): TLS terminates
+    here; when oauth2 is on, nginx auth_request routes through the
+    oauth2-proxy sidecar before any request reaches the API."""
+    annotations: Dict[str, str] = {
+        'nginx.ingress.kubernetes.io/proxy-body-size': '1g',
+        # SSE log streams: no buffering, long read timeout.
+        'nginx.ingress.kubernetes.io/proxy-buffering': 'off',
+        'nginx.ingress.kubernetes.io/proxy-read-timeout': '3600',
+    }
+    if oauth2:
+        annotations.update({
+            'nginx.ingress.kubernetes.io/auth-url':
+                (f'http://sky-tpu-oauth2-proxy.{namespace}.svc:4180/'
+                 'oauth2/auth'),
+            'nginx.ingress.kubernetes.io/auth-signin':
+                f'https://{host}/oauth2/start?rd=$escaped_request_uri',
+            'nginx.ingress.kubernetes.io/auth-response-headers':
+                'X-Auth-Request-User, X-Auth-Request-Email',
+        })
+    return {
+        'apiVersion': 'networking.k8s.io/v1',
+        'kind': 'Ingress',
+        'metadata': {'name': 'sky-tpu-api', 'namespace': namespace,
+                     'annotations': annotations},
+        'spec': {
+            'ingressClassName': 'nginx',
+            'tls': [{'hosts': [host], 'secretName': tls_secret}],
+            'rules': [{
+                'host': host,
+                'http': {'paths': [{
+                    'path': '/',
+                    'pathType': 'Prefix',
+                    'backend': {'service': {
+                        'name': 'sky-tpu-api',
+                        'port': {'number': 80}}},
+                }]},
+            }],
+        },
+    }
+
+
+def render_server_config(namespace: str = 'sky-tpu') -> Dict[str, Any]:
+    """Server-side config.yaml ConfigMap (reference
+    templates/server-config.yaml + api-configmap.yaml): mounts at
+    SKY_TPU_HOME/config.yaml as the server-level layer of the config
+    system (skypilot_tpu/config.py)."""
+    return {
+        'apiVersion': 'v1',
+        'kind': 'ConfigMap',
+        'metadata': {'name': 'sky-tpu-server-config',
+                     'namespace': namespace},
+        'data': {
+            'config.yaml': ('# Server-side overrides (layered under '
+                            'workspace/task config).\n'
+                            '# e.g.\n'
+                            '# gcp:\n'
+                            '#   project: my-project\n'
+                            '{}\n'),
+        },
+    }
+
+
+def render_initial_auth(namespace: str = 'sky-tpu') -> Dict[str, Any]:
+    """Bootstrap admin token secret (reference
+    templates/initial-auth.yaml): the server mints the first admin
+    API token from this secret at startup; rotate via `sky-tpu user`
+    afterwards. Placeholder value — overwrite at deploy time."""
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Secret',
+        'metadata': {'name': 'sky-tpu-initial-auth',
+                     'namespace': namespace},
+        'type': 'Opaque',
+        'stringData': {'admin-token': ''},
+    }
+
+
+def render_metrics_service(namespace: str = 'sky-tpu') -> Dict[str, Any]:
+    """Prometheus scrape target (reference
+    dcgm-prometheus-scrape-service.yaml shape, pointed at the server's
+    own /metrics instead of DCGM): annotation-based discovery, no
+    ServiceMonitor CRD dependency."""
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {
+            'name': 'sky-tpu-api-metrics',
+            'namespace': namespace,
+            'labels': _labels(),
+            'annotations': {
+                'prometheus.io/scrape': 'true',
+                'prometheus.io/port': str(API_PORT),
+                'prometheus.io/path': '/metrics',
+            },
+        },
+        'spec': {'selector': _labels(),
+                 'ports': [{'port': API_PORT,
+                            'targetPort': API_PORT,
+                            'name': 'metrics'}]},
+    }
+
+
+def render_grafana_datasource(namespace: str = 'sky-tpu'
+                              ) -> Dict[str, Any]:
+    """Grafana provisioning ConfigMap (reference
+    templates/datasource.yaml + api-dashboard-grafana-configmap.yaml
+    scope, minus the vendored dashboard JSON): points a cluster
+    Grafana at the prometheus that scrapes sky-tpu-api-metrics."""
+    return {
+        'apiVersion': 'v1',
+        'kind': 'ConfigMap',
+        'metadata': {'name': 'sky-tpu-grafana-datasource',
+                     'namespace': namespace,
+                     'labels': {'grafana_datasource': '1'}},
+        'data': {
+            'sky-tpu.yaml': (
+                'apiVersion: 1\n'
+                'datasources:\n'
+                '- name: sky-tpu-prometheus\n'
+                '  type: prometheus\n'
+                '  access: proxy\n'
+                '  url: http://prometheus-server.monitoring.svc\n'
+                '  isDefault: false\n'),
+        },
+    }
+
+
 def render_all(namespace: str = 'sky-tpu') -> Dict[str, Any]:
     """Everything, as one kubectl-applyable List."""
     return {
@@ -239,13 +468,20 @@ def render_all(namespace: str = 'sky-tpu') -> Dict[str, Any]:
         'items': [
             render_namespace(namespace),
             render_secret(namespace),
+            render_initial_auth(namespace),
+            render_server_config(namespace),
             render_state_pvc(namespace),
+            *render_rbac(namespace),
             render_deployment(
                 namespace,
                 oauth2_proxy_url=('http://sky-tpu-oauth2-proxy.'
                                   f'{namespace}.svc:4180')),
             render_service(namespace),
+            render_metrics_service(namespace),
+            render_ingress(namespace),
             *render_oauth2_proxy(namespace),
+            *render_oauth2_redis(namespace),
+            render_grafana_datasource(namespace),
         ],
     }
 

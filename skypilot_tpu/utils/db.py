@@ -254,3 +254,30 @@ def get_db(path: str, schema: str) -> Db:
         if key not in _instances:
             _instances[key] = Db(path, schema)
         return _instances[key]
+
+
+def ensure_columns(conn, migrations) -> None:
+    """Apply add-column migrations to a live DB (CREATE IF NOT EXISTS
+    does not evolve existing tables). `migrations` is a sequence of
+    (table, column, ddl); each column is probed and, when missing, its
+    DDL applied — losing the race to a concurrent migrator is fine
+    (the other side created the identical column).
+    """
+    for table, col, ddl in migrations:
+        try:
+            conn.execute(f'SELECT {col} FROM {table} LIMIT 1')
+            continue
+        except Exception:  # noqa: BLE001 — old schema
+            pass
+        try:
+            conn.rollback()
+        except Exception:  # noqa: BLE001 — nothing open
+            pass
+        try:
+            conn.execute(ddl)
+            conn.commit()
+        except Exception:  # noqa: BLE001 — concurrent migrator won
+            try:
+                conn.rollback()
+            except Exception:  # noqa: BLE001
+                pass

@@ -1,0 +1,210 @@
+"""Cluster TLS: self-signed certs with fingerprint pinning.
+
+The reference encrypts its control channel by riding gRPC over an SSH
+tunnel (reference sky/backends/cloud_vm_ray_backend.py:2288-2320). This
+framework's agent plane is HTTP on the VPC, so the equivalent hardening
+is TLS at the agent socket: each cluster gets one self-signed cert,
+generated at provision time next to the bearer token, delivered to every
+host inside agent_config.json (the same secret-bearing channel the token
+already rides), and **pinned by SHA-256 fingerprint** on the client side
+— no CA, no hostname checks, no trust store to manage. A MITM on the VPC
+can no longer read the bearer token off the wire, and cannot present its
+own cert without breaking the pin.
+
+The serve load balancer reuses the server half for user-plane HTTPS
+(reference sky/serve/load_balancer.py:274-286 TLSCredential), there with
+operator-supplied cert/key files instead of a generated pair.
+"""
+from __future__ import annotations
+
+import datetime
+import functools
+import hashlib
+import os
+import ssl
+import tempfile
+from typing import Optional, Tuple
+
+import requests
+import requests.adapters
+
+CERT_FILE = 'agent_cert.pem'
+KEY_FILE = 'agent_key.pem'
+
+
+def generate_cluster_cert(common_name: str,
+                          valid_days: int = 3650
+                          ) -> Tuple[str, str, str]:
+    """One self-signed cert per cluster.
+
+    Returns (cert_pem, key_pem, sha256_fingerprint_hex). ECDSA P-256:
+    small keys (the PEM travels inline in agent_config.json to every
+    host) and fast handshakes on the agent's tiny HTTP exchanges.
+    """
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name)
+            .issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=valid_days))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName(common_name)]), critical=False)
+            .sign(key, hashes.SHA256()))
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM).decode()
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()).decode()
+    return cert_pem, key_pem, fingerprint_of_pem(cert_pem)
+
+
+def fingerprint_of_pem(cert_pem: Optional[str]) -> Optional[str]:
+    """SHA-256 over the DER encoding, lowercase hex (no colons).
+    None-tolerant: providers pass whatever their metadata holds, and a
+    cluster provisioned before TLS simply has no pin."""
+    if not cert_pem:
+        return None
+    der = ssl.PEM_cert_to_DER_cert(cert_pem)
+    return hashlib.sha256(der).hexdigest()
+
+
+def server_context(cert_pem: str, key_pem: str,
+                   workdir: Optional[str] = None) -> ssl.SSLContext:
+    """Server-side context from inline PEMs.
+
+    load_cert_chain only takes paths, so the PEMs are materialized under
+    `workdir` (0600) — on an agent host that is the cluster dir, which
+    already holds the bearer token in agent_config.json.
+    """
+    if workdir is None:
+        workdir = tempfile.mkdtemp(prefix='sky-tpu-tls-')
+    cert_path = os.path.join(workdir, CERT_FILE)
+    key_path = os.path.join(workdir, KEY_FILE)
+    for path, pem in ((cert_path, cert_pem), (key_path, key_pem)):
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            f.write(pem)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx
+
+
+def file_server_context(certfile: str, keyfile: str) -> ssl.SSLContext:
+    """Server context from operator-supplied files (serve LB tls: block)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(os.path.expanduser(certfile),
+                        os.path.expanduser(keyfile))
+    return ctx
+
+
+class _FingerprintAdapter(requests.adapters.HTTPAdapter):
+    """requests transport that accepts exactly one pinned server cert.
+
+    urllib3's assert_fingerprint replaces CA verification: the TLS
+    handshake completes, then the peer cert's SHA-256 is compared to the
+    pin and the connection is torn down on mismatch.
+    """
+
+    def __init__(self, fingerprint: str, **kwargs):
+        self._fingerprint = fingerprint
+        super().__init__(**kwargs)
+
+    def init_poolmanager(self, *args, **kwargs):
+        kwargs['assert_fingerprint'] = self._fingerprint
+        kwargs['cert_reqs'] = 'CERT_NONE'
+        return super().init_poolmanager(*args, **kwargs)
+
+    def proxy_manager_for(self, proxy, **kwargs):
+        # Proxied connections must carry the pin too, or an HTTPS_PROXY
+        # env var silently downgrades the channel to unverified TLS —
+        # the exact MITM this adapter exists to stop.
+        kwargs['assert_fingerprint'] = self._fingerprint
+        kwargs['cert_reqs'] = 'CERT_NONE'
+        return super().proxy_manager_for(proxy, **kwargs)
+
+    def send(self, request, *args, **kwargs):
+        # requests re-applies its per-request `verify` onto the pool,
+        # which would restore CA verification and reject the
+        # self-signed cert before the fingerprint check ever ran. The
+        # pin IS the verification; CA checks are forced off.
+        kwargs['verify'] = False
+        import urllib3.exceptions
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter(
+                'ignore', urllib3.exceptions.InsecureRequestWarning)
+            return super().send(request, *args, **kwargs)
+
+
+@functools.lru_cache(maxsize=256)
+def pinned_session(fingerprint: Optional[str]) -> requests.Session:
+    """A requests.Session whose https:// transport is fingerprint-pinned.
+
+    With no fingerprint the session still works for http:// URLs and
+    refuses https (no pin → no basis for trust: failing closed here is
+    what makes the sniff-test meaningful).
+
+    Cached per fingerprint: monitor loops build a fresh AgentClient
+    every probe tick, and a new Session per client would leak its
+    urllib3 pool and re-handshake TLS each time — the cache gives every
+    client of a cluster one shared keep-alive pool. (urllib3 pools are
+    thread-safe; callers only issue requests.)
+    """
+    sess = requests.Session()
+    # Agents live on the VPC/loopback: a corp HTTPS_PROXY from the
+    # environment must never be interposed on the pinned channel.
+    sess.trust_env = False
+    if fingerprint:
+        sess.mount('https://', _FingerprintAdapter(fingerprint))
+    else:
+        class _Refuse(requests.adapters.BaseAdapter):
+            def send(self, request, **kwargs):  # noqa: D102
+                raise requests.exceptions.SSLError(
+                    f'no pinned fingerprint for {request.url}; refusing '
+                    'unverified TLS to an agent')
+
+            def close(self) -> None:
+                pass
+        sess.mount('https://', _Refuse())
+    return sess
+
+
+def ensure_cluster_cert(store: dict, cluster_name: str,
+                        cert_key: str = 'agent_tls_cert',
+                        key_key: str = 'agent_tls_key'
+                        ) -> Tuple[str, str]:
+    """Get-or-mint the cluster TLS pair in `store` (a provider's
+    provider_config or metadata dict). Reused across idempotent
+    re-provisions — a rotation would invalidate the live agents' pin
+    mid-flight. One home for the logic all five providers share."""
+    cert, key = store.get(cert_key), store.get(key_key)
+    if not cert or not key:
+        cert, key, _ = generate_cluster_cert(cluster_name)
+        store[cert_key] = cert
+        store[key_key] = key
+    return cert, key
+
+
+def aiohttp_ssl(fingerprint: Optional[str]):
+    """ssl= argument for aiohttp requests to a pinned agent.
+
+    aiohttp.Fingerprint disables cert verification and instead matches
+    the peer cert digest — the async twin of _FingerprintAdapter.
+    Returns None (library default: full verification) when no pin is
+    given, for plain-http or public endpoints.
+    """
+    if not fingerprint:
+        return None
+    import aiohttp
+    return aiohttp.Fingerprint(bytes.fromhex(fingerprint))

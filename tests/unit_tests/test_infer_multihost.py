@@ -69,6 +69,15 @@ def test_two_process_tp_matches_single_process(tmp_path):
             'JAX_PROCESS_ID': str(rank),
         })
         env.pop('PALLAS_AXON_POOL_IPS', None)
+        # The rank script runs from tmp_path: the framework must ride
+        # PYTHONPATH explicitly (an editable install is not guaranteed).
+        import skypilot_tpu
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(skypilot_tpu.__file__)))
+        prior = env.get('PYTHONPATH', '')
+        if pkg_root not in prior.split(os.pathsep):
+            env['PYTHONPATH'] = (f'{pkg_root}{os.pathsep}{prior}'
+                                 if prior else pkg_root)
         procs.append(subprocess.Popen(
             [sys.executable, str(script)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,

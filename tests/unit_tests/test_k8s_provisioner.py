@@ -138,7 +138,8 @@ def test_run_instances_applies_and_bootstraps(fake_kubectl):
     info = k8s.run_instances(cfg)
     assert info.cloud == 'kubernetes'
     assert info.num_hosts == 4
-    assert info.head.agent_url == 'http://10.8.0.5:46590'
+    assert info.head.agent_url == 'https://10.8.0.5:46590'
+    assert info.provider_config['agent_cert_fingerprint']
     calls = fake_kubectl.calls()
     # apply with the manifest on stdin
     apply_calls = [c for c in calls if 'apply' in c['argv']]

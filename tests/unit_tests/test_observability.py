@@ -157,9 +157,26 @@ def test_deploy_manifests_render_and_match_shipped():
     from skypilot_tpu.server import packaging
     manifest = packaging.render_all()
     kinds = [i['kind'] for i in manifest['items']]
-    assert kinds.count('Deployment') == 2      # api + oauth2-proxy
+    # api + oauth2-proxy + oauth2-redis
+    assert kinds.count('Deployment') == 3
     assert 'Namespace' in kinds and 'Service' in kinds
     assert 'Secret' in kinds and 'PersistentVolumeClaim' in kinds
+    # Production bundle (reference charts/skypilot/templates scope):
+    # ingress TLS, RBAC for in-cluster provisioning, config map,
+    # prometheus scrape service.
+    assert 'Ingress' in kinds
+    assert {'ServiceAccount', 'Role', 'RoleBinding'} <= set(kinds)
+    assert 'ConfigMap' in kinds
+    ing = next(i for i in manifest['items'] if i['kind'] == 'Ingress')
+    assert ing['spec']['tls'], 'ingress must terminate TLS'
+    assert ('auth-url' in str(ing['metadata']['annotations'])), (
+        'ingress must gate through oauth2-proxy')
+    metrics_svc = next(
+        i for i in manifest['items'] if i['kind'] == 'Service'
+        and i['metadata']['name'] == 'sky-tpu-api-metrics')
+    ann = metrics_svc['metadata']['annotations']
+    assert ann['prometheus.io/scrape'] == 'true'
+    assert ann['prometheus.io/path'] == '/metrics'
     dep = next(i for i in manifest['items']
                if i['kind'] == 'Deployment' and
                i['metadata']['name'] == 'sky-tpu-api')

@@ -54,7 +54,8 @@ def test_provision_roundtrip(slurm_stubs, tmp_path, monkeypatch):
     assert info.cloud == 'slurm'
     assert info.num_hosts == 2
     assert [h.internal_ip for h in info.hosts] == ['node01', 'node02']
-    assert info.head.agent_url == 'http://node01:46590'
+    assert info.head.agent_url == 'https://node01:46590'
+    assert info.provider_config['agent_cert_fingerprint']
     assert info.cost_per_hour == 0.0
     assert info.provider_config['job_id'] == '4242'
     # The submitted batch script carries the gang + partition + agent.
@@ -81,7 +82,7 @@ def test_provision_roundtrip(slurm_stubs, tmp_path, monkeypatch):
     # start resubmits (stub state file back to R).
     slurm_stubs['state_file'].write_text('R')
     info3 = slurm_instance.start_instances('sl-c', {})
-    assert info3.head.agent_url == 'http://node01:46590'
+    assert info3.head.agent_url == 'https://node01:46590'
     slurm_instance.terminate_instances('sl-c', {})
     assert slurm_instance.get_cluster_info('sl-c', {}) is None
 
