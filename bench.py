@@ -30,6 +30,21 @@ the flash kernel's VPU-bound softmax at short sequence. Swept: flash
 tiles (512x512 best of 8 configs), remat policies (full > save_attn >
 dots at 2048), batch (6 > 4 > 8). Sequence scaling amortizes the floor:
 seq 4096 -> 0.603, seq 8192 -> 0.618 MFU (run `--seq 8192`).
+
+Round-5 attack on that floor (all measured on the chip, same-day dense
+control 0.5787): a fused Pallas CE forward (logits tiles consumed in
+VMEM, ops/cross_entropy.py fused_cross_entropy) with a fully-Pallas
+backward hit 0.5721; with a single-recompute XLA backward 0.5724 —
+BOTH below dense, because at 32k vocab and d=1536 the CE cost is the
+matmul itself and XLA's one big fused matmul+log-softmax beats any
+tiled reformulation (the extra recompute matmul costs ~2x what the
+saved HBM passes are worth; the flops/byte ratio keeps that true at
+every vocab). CONCLUSION: 0.58 at b6/s2048/32k-vocab is the measured
+ceiling with kernels in place; the levers that DO move it are sequence
+length (0.618 at 8k) and vocab: at Llama-3's 128,256 vocab
+(`--vocab 128256 --ce chunked`) the gated chunked CE delivers 0.639
+MFU where the dense path OOMs outright — the gate's reason to exist,
+now proven on chip.
 """
 from __future__ import annotations
 
@@ -80,6 +95,18 @@ def main() -> None:
                         choices=['flash', 'dense'])
     parser.add_argument('--block-q', type=int, default=None)
     parser.add_argument('--block-k', type=int, default=None)
+    parser.add_argument('--fused-ce', action='store_true',
+                        help='fused Pallas cross-entropy (logits tiles '
+                             'never leave VMEM; ops/cross_entropy.py '
+                             'fused_cross_entropy)')
+    parser.add_argument('--vocab', type=int, default=None,
+                        help='override vocab size (e.g. 128256 = '
+                             'Llama-3) — the 128k-vocab CE validation')
+    parser.add_argument('--ce', default=None,
+                        choices=['dense', 'chunked', 'fused'],
+                        help='CE path: dense fp32 log-softmax, vocab-'
+                             'chunked custom VJP, or the fused Pallas '
+                             'forward (equivalent to --fused-ce)')
     args = parser.parse_args()
     seq = args.seq
     batch = args.batch or (BATCH if seq <= 2048 else 1)
@@ -93,6 +120,14 @@ def main() -> None:
         kw['attn_block_q'] = args.block_q
     if args.block_k:
         kw['attn_block_k'] = args.block_k
+    if args.fused_ce or args.ce == 'fused':
+        kw['fused_loss'] = True
+    elif args.ce == 'chunked':
+        kw['loss_vocab_chunks'] = 16
+    elif args.ce == 'dense':
+        kw['loss_vocab_chunks'] = None
+    if args.vocab:
+        kw['vocab_size'] = args.vocab
     config = llama.LlamaConfig.bench_1b(max_seq_len=seq, **kw)
     print(f'[bench] device={dev.device_kind} params={config.num_params/1e6:.0f}M '
           f'batch={batch} seq={seq} backend={jax.default_backend()}',

@@ -84,18 +84,31 @@ def _pct(sorted_vals, p: float):
     return round(sorted_vals[i], 5)
 
 
-def _sweep_level(gen_url: str, concurrency: int, n_requests: int) -> dict:
-    ttfts = []
+def _sweep_level(gen_url: str, concurrency: int, n_requests: int,
+                 long_prompt_tokens: int = 0) -> dict:
+    """One concurrency level. With long_prompt_tokens, every 8th
+    request carries a long prompt (the mixed-length workload a paged
+    cache exists for); long/short TTFTs are reported separately so the
+    long lane cannot hide in the p50."""
+    def prompt_for(i: int) -> str:
+        if long_prompt_tokens and i % 8 == 7:
+            filler = f'ctx{i} ' * (long_prompt_tokens // 5)
+            return filler + ' summarize.'
+        return f'request {i} hello world'
+
+    results = []   # (is_long, ttft)
     t0 = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
-        futs = [pool.submit(_streamed_ttft, gen_url,
-                            f'request {i} hello world')
-                for i in range(n_requests)]
+        futs = {pool.submit(_streamed_ttft, gen_url, prompt_for(i),
+                            timeout=900): i
+                for i in range(n_requests)}
         for f in concurrent.futures.as_completed(futs):
-            ttfts.append(f.result())
+            i = futs[f]
+            results.append((bool(long_prompt_tokens and i % 8 == 7),
+                            f.result()))
     wall = time.perf_counter() - t0
-    ttfts.sort()
-    return {
+    ttfts = sorted(t for _, t in results)
+    out = {
         'concurrency': concurrency,
         'samples': len(ttfts),
         'ttft_p50_s': _pct(ttfts, 0.50),
@@ -104,6 +117,13 @@ def _sweep_level(gen_url: str, concurrency: int, n_requests: int) -> dict:
         'ttft_mean_s': round(statistics.fmean(ttfts), 5),
         'throughput_rps': round(n_requests / wall, 2),
     }
+    longs = sorted(t for is_long, t in results if is_long)
+    if longs:
+        shorts = sorted(t for is_long, t in results if not is_long)
+        out['short_ttft_p50_s'] = _pct(shorts, 0.50)
+        out['long_ttft_p50_s'] = _pct(longs, 0.50)
+        out['long_samples'] = len(longs)
+    return out
 
 
 def main() -> None:
@@ -121,6 +141,15 @@ def main() -> None:
     parser.add_argument('--tp', type=int, default=1)
     parser.add_argument('--quantize', action='store_true',
                         help='int8 weight-only (8B on one v5e chip)')
+    parser.add_argument('--paged', action='store_true',
+                        help='paged KV engine (block-table pool)')
+    parser.add_argument('--page-size', type=int, default=64)
+    parser.add_argument('--n-pages', type=int, default=None)
+    parser.add_argument('--long-prompt-tokens', type=int, default=0,
+                        help='adds a long-context lane to the sweep: '
+                             'this many prompt chars per long request, '
+                             'mixed 1-in-8 with short ones (exercises '
+                             'chunked prefill + paged KV at depth)')
     parser.add_argument('--tokenizer', default=None,
                         help='tokenizer.json for the text path '
                              '(default: examples/tokenizer_8k.json '
@@ -153,6 +182,10 @@ def main() -> None:
            '--max-seq-len', str(args.max_seq_len), '--tp', str(args.tp)]
     if args.quantize:
         cmd.append('--quantize')
+    if args.paged:
+        cmd += ['--paged', '--page-size', str(args.page_size)]
+        if args.n_pages:
+            cmd += ['--n-pages', str(args.n_pages)]
     if tokenizer:
         cmd += ['--tokenizer', tokenizer]
     infer_proc = subprocess.Popen(
@@ -188,11 +221,13 @@ def main() -> None:
             cold_s = round(_streamed_ttft(gen_url, 'cold request',
                                           timeout=600), 4)
             # Warm every concurrency level's batch shapes off the clock.
-            _sweep_level(gen_url, max(args.concurrency), 2 * args.slots)
+            _sweep_level(gen_url, max(args.concurrency), 2 * args.slots,
+                         args.long_prompt_tokens)
             # 4. The sweep.
             for conc in args.concurrency:
                 sweep.append(_sweep_level(gen_url, conc,
-                                          args.requests_per_level))
+                                          args.requests_per_level,
+                                          args.long_prompt_tokens))
         finally:
             lb_proc.terminate()
             lb_proc.join(timeout=10)
@@ -219,6 +254,10 @@ def main() -> None:
         'tp': args.tp,
         'slots': args.slots,
         'quantize': args.quantize,
+        'paged': args.paged,
+        **({'page_size': args.page_size,
+            'long_prompt_tokens': args.long_prompt_tokens}
+           if args.paged or args.long_prompt_tokens else {}),
         'tokenizer': ('bpe-8k' if tokenizer else 'bytes'),
         'device': jax.devices()[0].device_kind,
         'path': ('client -> serve LB -> continuous-batching engine '

@@ -221,16 +221,37 @@ def launch_dag(
         optimizer_lib.Optimizer.optimize_job_group(dag, optimize_target,
                                                    quiet=quiet)
         import concurrent.futures as cf
+
+        from skypilot_tpu.jobs import job_group_networking as jg_net
+        group_name = dag.name or 'jobgroup'
+        # Two-phase launch: every member's slice must EXIST before any
+        # member runs, or the peers' addresses can't be known. Phase 1
+        # provisions the whole gang concurrently; phase 2 injects the
+        # peer map (env + best-effort hosts file) and runs
+        # setup/exec with it.
         with cf.ThreadPoolExecutor(max_workers=len(dag.tasks)) as pool:
             futs = [
                 pool.submit(launch, t, None, backend=backend,
                             # placement fixed by the gang optimizer above
-                            stages=[Stage.PROVISION, Stage.SYNC_WORKDIR,
-                                    Stage.SYNC_FILE_MOUNTS, Stage.SETUP,
-                                    Stage.EXEC],
+                            stages=[Stage.PROVISION],
                             detach_run=detach_run, quiet=quiet)
                 for t in dag.tasks
             ]
+            infos = [f.result()[1] for f in futs]
+        infos_by_task = {
+            (t.name or f'task{i}'): info
+            for i, (t, info) in enumerate(zip(dag.tasks, infos))}
+        genv = jg_net.group_env(group_name, infos_by_task)
+        jg_net.inject_hosts(backend, group_name, infos_by_task)
+        with cf.ThreadPoolExecutor(max_workers=len(dag.tasks)) as pool:
+            futs = []
+            for i, t in enumerate(dag.tasks):
+                t.envs.update(genv)
+                futs.append(pool.submit(
+                    launch, t, infos[i].cluster_name, backend=backend,
+                    stages=[Stage.SYNC_WORKDIR, Stage.SYNC_FILE_MOUNTS,
+                            Stage.SETUP, Stage.EXEC],
+                    detach_run=detach_run, quiet=quiet))
             for t, f in zip(dag.tasks, futs):
                 job_id, info = f.result()
                 results.append((info.cluster_name, job_id, info))

@@ -33,6 +33,7 @@ import numpy as np
 
 from skypilot_tpu.infer import cache as cache_lib
 from skypilot_tpu.infer import model as model_lib
+from skypilot_tpu.infer import paged_cache as paged_cache_lib
 from skypilot_tpu.infer import sampling as sampling_lib
 from skypilot_tpu.models import llama
 
@@ -62,6 +63,18 @@ class EngineConfig:
     # int8 weight-only quantization (ops/quant.py): halves weight HBM
     # bytes (8B fits one v5e chip) and speeds the bandwidth-bound decode.
     quantize: bool = False
+    # Paged KV cache (infer/paged_cache.py + ops/paged_attention.py):
+    # slots share a pool of fixed-size pages, HBM ∝ tokens-in-flight
+    # instead of slots x max_seq_len, and one engine serves mixed
+    # 2k/16k prompts (subsumes the round-4 two-tier EnginePool). When
+    # the pool runs dry mid-decode, the youngest other slot is
+    # preempted and resumed later by re-prefilling prompt+output.
+    paged: bool = False
+    page_size: int = 64
+    # Total pool pages (page 0 is a reserved garbage sink). None →
+    # dense-equivalent capacity (n_slots * max_seq_len / page_size + 1);
+    # set lower to cap KV HBM at the expected tokens-in-flight.
+    n_pages: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -166,10 +179,51 @@ class InferenceEngine:
             if not quant_lib.is_quantized(params):
                 params = quant_lib.quantize_params(params)
         self.params = params
-        self.cache = cache_lib.init_cache(
-            config.n_layers, self.ecfg.n_slots, self.ecfg.max_seq_len,
-            config.n_kv_heads, config.head_dim,
-            dtype=jnp.dtype(self.ecfg.cache_dtype))
+        self.allocator: Optional[paged_cache_lib.PageAllocator] = None
+        if self.ecfg.paged:
+            if self.ecfg.tp > 1:
+                raise ValueError(
+                    'paged KV is single-device for now (the Pallas '
+                    'kernels are not yet shard_map-wrapped); use the '
+                    'dense cache for tp > 1')
+            page = self.ecfg.page_size
+            if self._chunk_cap % page:
+                raise ValueError(
+                    f'prefill chunk {self._chunk_cap} must be a '
+                    f'multiple of page_size {page}')
+            # Buckets must cover whole pages (chunk writes are
+            # whole-page dynamic_update_slices), and the ladder must be
+            # page-granular enough that a short tail never allocates a
+            # cap-sized pad (power-of-two multiples of the page bound
+            # the overshoot at 2x while keeping compile count small).
+            ladder = set()
+            b = page
+            while b < self._chunk_cap:
+                ladder.add(b)
+                b *= 2
+            self._buckets = sorted(
+                {b for b in self._buckets if b % page == 0}
+                | ladder | {self._chunk_cap})
+            max_pages_per_slot = self.ecfg.max_seq_len // page
+            n_pages = self.ecfg.n_pages
+            if n_pages is None:
+                n_pages = self.ecfg.n_slots * max_pages_per_slot + 1
+            min_pages = self._chunk_cap // page + 1
+            if n_pages < min_pages:
+                raise ValueError(
+                    f'n_pages={n_pages} cannot hold one prefill chunk '
+                    f'(needs >= {min_pages} incl. the sink page)')
+            self.allocator = paged_cache_lib.PageAllocator(
+                n_pages, page, self.ecfg.n_slots, max_pages_per_slot)
+            self.cache = paged_cache_lib.init_paged_cache(
+                config.n_layers, self.ecfg.n_slots, n_pages, page,
+                config.n_kv_heads, config.head_dim,
+                dtype=jnp.dtype(self.ecfg.cache_dtype))
+        else:
+            self.cache = cache_lib.init_cache(
+                config.n_layers, self.ecfg.n_slots,
+                self.ecfg.max_seq_len, config.n_kv_heads,
+                config.head_dim, dtype=jnp.dtype(self.ecfg.cache_dtype))
         self.mesh = None
         self._rep_sharding = None
         self._cache_sharding = None
@@ -196,6 +250,7 @@ class InferenceEngine:
         self._decode_steps = 0
         self._decode_tokens = 0
         self._decode_time = 0.0
+        self._preemptions = 0
         # Recent-window TTFTs: bounded so a long-lived replica's /metrics
         # stays O(1) in memory and p50 reflects current behavior.
         self._ttfts: collections.deque = collections.deque(maxlen=1024)
@@ -213,43 +268,74 @@ class InferenceEngine:
                 kw['out_shardings'] = out
             return jax.jit(fn, **kw)
 
-        def _prefill_chunk(kv_cache, params, slot, tokens, offset,
-                           true_len, key, temp, last):
-            # One compiled program per chunk bucket (tokens shape).
-            # First-token sampling AND the last-token vector update are
-            # FUSED: separate programs would cost extra dispatches (and
-            # a sample sync) per prompt, and on a tunneled device the
-            # round trip (~100ms) dwarfs the compute. The sampled token
-            # is only meaningful on the final chunk; earlier chunks'
-            # updates are overwritten before the slot ever decodes.
-            new_cache, logits = model_lib.prefill_chunk(
-                config, params, kv_cache, slot, tokens, offset,
-                true_len)
-            tok = sampling_lib.sample(logits[None], key, temp[None],
-                                      top_k=self.ecfg.top_k)[0]
-            return new_cache, last.at[slot].set(tok.astype(last.dtype))
-        self._prefill_chunk = _jit(
-            _prefill_chunk, donate=(0, 8),
-            out=(self._cache_sharding, self._rep_sharding))
+        if self.ecfg.paged:
+            def _prefill_chunk_paged(kv_cache, params, slot, table_row,
+                                     tokens, offset, true_len, key,
+                                     temp, last):
+                new_cache, logits = model_lib.paged_prefill_chunk(
+                    config, params, kv_cache, slot, table_row, tokens,
+                    offset, true_len)
+                tok = sampling_lib.sample(logits[None], key, temp[None],
+                                          top_k=self.ecfg.top_k)[0]
+                return new_cache, last.at[slot].set(
+                    tok.astype(last.dtype))
+            self._prefill_chunk = _jit(_prefill_chunk_paged,
+                                       donate=(0, 9))
 
-        def _decode(kv_cache, params, tokens, key, temps, active):
-            logits, new_cache = model_lib.decode_step(
-                config, params, kv_cache, tokens, active)
-            sampled = sampling_lib.sample(logits, key, temps,
-                                          top_k=self.ecfg.top_k)
-            toks_out = jnp.where(active, sampled, tokens)
-            # [2, slots]: row 0 echoes the inputs (= the first sampled
-            # token of any slot that finished prefill this step), row 1
-            # the new tokens — ONE host read serves both.
-            return jnp.stack([tokens, toks_out]), new_cache
-        self._decode = _jit(
-            _decode, donate=(0,),
-            out=(self._rep_sharding, self._cache_sharding))
+            def _decode_paged(kv_cache, params, tables, tokens, key,
+                              temps, active):
+                logits, new_cache = model_lib.paged_decode_step(
+                    config, params, kv_cache, tables, tokens, active)
+                sampled = sampling_lib.sample(logits, key, temps,
+                                              top_k=self.ecfg.top_k)
+                toks_out = jnp.where(active, sampled, tokens)
+                return jnp.stack([tokens, toks_out]), new_cache
+            self._decode = _jit(_decode_paged, donate=(0,))
 
-        def _free(kv_cache, slot):
-            return cache_lib.free_slot(kv_cache, slot)
-        self._free = _jit(_free, donate=(0,),
-                          out=self._cache_sharding)
+            def _free_paged(kv_cache, slot):
+                return paged_cache_lib.free_slot(kv_cache, slot)
+            self._free = _jit(_free_paged, donate=(0,))
+        else:
+            def _prefill_chunk(kv_cache, params, slot, tokens, offset,
+                               true_len, key, temp, last):
+                # One compiled program per chunk bucket (tokens shape).
+                # First-token sampling AND the last-token vector update
+                # are FUSED: separate programs would cost extra
+                # dispatches (and a sample sync) per prompt, and on a
+                # tunneled device the round trip (~100ms) dwarfs the
+                # compute. The sampled token is only meaningful on the
+                # final chunk; earlier chunks' updates are overwritten
+                # before the slot ever decodes.
+                new_cache, logits = model_lib.prefill_chunk(
+                    config, params, kv_cache, slot, tokens, offset,
+                    true_len)
+                tok = sampling_lib.sample(logits[None], key, temp[None],
+                                          top_k=self.ecfg.top_k)[0]
+                return new_cache, last.at[slot].set(
+                    tok.astype(last.dtype))
+            self._prefill_chunk = _jit(
+                _prefill_chunk, donate=(0, 8),
+                out=(self._cache_sharding, self._rep_sharding))
+
+            def _decode(kv_cache, params, tokens, key, temps, active):
+                logits, new_cache = model_lib.decode_step(
+                    config, params, kv_cache, tokens, active)
+                sampled = sampling_lib.sample(logits, key, temps,
+                                              top_k=self.ecfg.top_k)
+                toks_out = jnp.where(active, sampled, tokens)
+                # [2, slots]: row 0 echoes the inputs (= the first
+                # sampled token of any slot that finished prefill this
+                # step), row 1 the new tokens — ONE host read serves
+                # both.
+                return jnp.stack([tokens, toks_out]), new_cache
+            self._decode = _jit(
+                _decode, donate=(0,),
+                out=(self._rep_sharding, self._cache_sharding))
+
+            def _free(kv_cache, slot):
+                return cache_lib.free_slot(kv_cache, slot)
+            self._free = _jit(_free, donate=(0,),
+                              out=self._cache_sharding)
 
     def _shard_tp(self) -> None:
         """Distribute params + KV cache over a `tp` mesh axis.
@@ -301,6 +387,22 @@ class InferenceEngine:
             raise ValueError(
                 f'prompt ({len(prompt_tokens)} tokens) exceeds cache '
                 f'capacity ({self.ecfg.max_seq_len - 1})')
+        if self.allocator is not None:
+            # Peak prefill allocation is BUCKET-padded (the final chunk
+            # writes its whole padded bucket), plus one decode page —
+            # admitting on the raw token count would accept requests
+            # that can never finish prefill (starvation, not an error).
+            n = len(prompt_tokens)
+            off = (n // self._chunk_cap) * self._chunk_cap
+            rem = n - off
+            peak = self.allocator.pages_needed(
+                off + (self._bucket(rem) if rem else 0)) + 1
+            if peak > self.allocator.n_pages - 1:
+                raise ValueError(
+                    f'prompt ({n} tokens; {peak} pages incl. padding + '
+                    f'first decode page) exceeds the page pool '
+                    f'({self.allocator.n_pages - 1} usable pages x '
+                    f'{self.allocator.page_size})')
         if max_new_tokens is None:
             max_new_tokens = self.ecfg.max_new_tokens
         if max_new_tokens < 1:
@@ -327,24 +429,47 @@ class InferenceEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _do_chunk(self, slot: int) -> bool:
+    @staticmethod
+    def _source_tokens(req: Request) -> List[int]:
+        """What prefill must cache for `req`: the prompt, plus — after a
+        preemption — everything already generated (resume-by-recompute:
+        the sampled token of the final resume chunk is then simply the
+        NEXT new token, so the normal first-token plumbing continues
+        the stream)."""
+        return req.prompt_tokens + req.output_tokens
+
+    def _do_chunk(self, slot: int) -> Optional[bool]:
         """Advance one prefilling slot by ONE chunk — NO host sync
         (the sampled first token stays on device; the step's single
         decode read surfaces it). Returns True when the prompt is fully
-        cached (slot joins this step's decode)."""
+        cached (slot joins this step's decode), False on progress, None
+        when the page pool cannot cover the chunk right now (deferred;
+        decode continues and finishing slots free pages)."""
         req = self._slots[slot]
         off = self._prefilling[slot]
-        n = len(req.prompt_tokens)
+        source = self._source_tokens(req)
+        n = len(source)
         remaining = n - off
         bucket = self._bucket(min(remaining, self._chunk_cap))
         tl = min(remaining, bucket)
+        if self.allocator is not None:
+            if not self.allocator.extend(slot, off + bucket):
+                return None
+            table_row = jnp.asarray(self.allocator.table()[slot])
         padded = np.zeros((bucket,), np.int32)
-        padded[:tl] = req.prompt_tokens[off:off + tl]
-        self.cache, self._last_dev = self._prefill_chunk(
-            self.cache, self.params, jnp.int32(slot),
-            jnp.asarray(padded), jnp.int32(off), jnp.int32(tl),
-            self._next_key(), jnp.float32(req.temperature),
-            self._last_dev)
+        padded[:tl] = source[off:off + tl]
+        if self.allocator is not None:
+            self.cache, self._last_dev = self._prefill_chunk(
+                self.cache, self.params, jnp.int32(slot), table_row,
+                jnp.asarray(padded), jnp.int32(off), jnp.int32(tl),
+                self._next_key(), jnp.float32(req.temperature),
+                self._last_dev)
+        else:
+            self.cache, self._last_dev = self._prefill_chunk(
+                self.cache, self.params, jnp.int32(slot),
+                jnp.asarray(padded), jnp.int32(off), jnp.int32(tl),
+                self._next_key(), jnp.float32(req.temperature),
+                self._last_dev)
         off += tl
         if off < n:
             self._prefilling[slot] = off
@@ -370,7 +495,63 @@ class InferenceEngine:
         req.finished_at = time.time()
         self._slots[slot] = None
         self._slot_len[slot] = 0
+        if self.allocator is not None:
+            self.allocator.free(slot)
         self.cache = self._free(self.cache, jnp.int32(slot))
+
+    def _preempt(self, slot: int) -> None:
+        """Evict `slot` to reclaim its pages: the request goes back to
+        the FRONT of the queue and resumes by recomputing
+        prompt+generated (vLLM-style recompute preemption). Output
+        already streamed is kept; TTFT is not re-recorded."""
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._slot_len[slot] = 0
+        self._prefilling.pop(slot, None)
+        self.allocator.free(slot)
+        self.cache = self._free(self.cache, jnp.int32(slot))
+        with self._lock:
+            self._waiting.insert(0, req)
+        self._preemptions += 1
+
+    def _ensure_decode_pages(self, decoding: List[int]) -> List[int]:
+        """Guarantee every decoding slot owns the page its next token
+        writes into, preempting the youngest other slot when the pool
+        is dry. Returns the (possibly shrunk) decoding list."""
+        decoding = list(decoding)
+        for slot in list(decoding):
+            if slot not in decoding:
+                continue   # preempted as an earlier slot's victim
+            if self._slots[slot] is None:
+                decoding.remove(slot)
+                continue
+            while not self.allocator.extend(
+                    slot, int(self._slot_len[slot]) + 1):
+                # Per-slot ceiling: no amount of preemption helps.
+                if (self.allocator.pages_needed(
+                        int(self._slot_len[slot]) + 1)
+                        > self.allocator.max_pages_per_slot):
+                    req = self._slots[slot]
+                    req.finish_reason = 'cache_full'
+                    self._finish(slot, req)
+                    decoding.remove(slot)
+                    break
+                victims = [s for s, r in enumerate(self._slots)
+                           if r is not None and s != slot]
+                if not victims:
+                    # Alone and out of pages: the pool itself is the
+                    # ceiling for this request.
+                    req = self._slots[slot]
+                    req.finish_reason = 'cache_full'
+                    self._finish(slot, req)
+                    decoding.remove(slot)
+                    break
+                victim = max(victims,
+                             key=lambda s: self._slots[s].submitted_at)
+                self._preempt(victim)
+                if victim in decoding:
+                    decoding.remove(victim)
+        return decoding
 
     # ---- the step --------------------------------------------------------
     def step(self) -> int:
@@ -393,14 +574,42 @@ class InferenceEngine:
         # async dispatches (no sync), so several per step cost latency
         # only in device compute.
         just_prefilled: List[int] = []
+        deferred: set = set()
         for _ in range(self.ecfg.prefill_chunks_per_step):
-            if not self._prefilling:
+            candidates = sorted(s for s in self._prefilling
+                                if s not in deferred)
+            if not candidates:
                 break
-            slots = sorted(self._prefilling)
-            self._rr = (self._rr + 1) % len(slots)
-            slot = slots[self._rr]
-            if self._do_chunk(slot):
+            self._rr = (self._rr + 1) % len(candidates)
+            slot = candidates[self._rr]
+            result = self._do_chunk(slot)
+            if result is None:
+                # Page pool dry: stop burning chunk budget on this slot
+                # until decode frees pages.
+                deferred.add(slot)
+            elif result:
                 just_prefilled.append(slot)
+        if (deferred and self.allocator is not None
+                and not any(r is not None and s not in self._prefilling
+                            for s, r in enumerate(self._slots))):
+            # Nothing is decoding, so nothing will ever free pages on
+            # its own: deferral would livelock. Preempt the youngest
+            # OTHER page-holding slot in favor of the oldest deferred
+            # one; a deferred request alone in the engine that still
+            # can't extend has outgrown the pool itself.
+            keep = min(deferred,
+                       key=lambda s: self._slots[s].submitted_at)
+            victims = [s for s, r in enumerate(self._slots)
+                       if r is not None and s != keep
+                       and self.allocator.pages_of(s) > 0]
+            if victims:
+                self._preempt(max(
+                    victims, key=lambda s: self._slots[s].submitted_at))
+            else:
+                req = self._slots[keep]
+                req.finish_reason = 'cache_full'
+                self._prefilling.pop(keep, None)
+                self._finish(keep, req)
         # Decode phase: every fully-prefilled slot — including the ones
         # that JUST finished (their first token is in _last_dev; they
         # decode their second token in this same step). The step's ONE
@@ -408,15 +617,24 @@ class InferenceEngine:
         # first tokens, row 1 everyone's new token.
         decoding = [s for s, r in enumerate(self._slots)
                     if r is not None and s not in self._prefilling]
+        if self.allocator is not None and decoding:
+            decoding = self._ensure_decode_pages(decoding)
         if not decoding:
             return len(self._prefilling)
         active_mask = np.zeros((self.ecfg.n_slots,), np.bool_)
         active_mask[decoding] = True
         t0 = time.perf_counter()
-        pair, self.cache = self._decode(
-            self.cache, self.params, self._last_dev,
-            self._next_key(), jnp.asarray(self._temps),
-            jnp.asarray(active_mask))
+        if self.allocator is not None:
+            pair, self.cache = self._decode(
+                self.cache, self.params,
+                jnp.asarray(self.allocator.table()), self._last_dev,
+                self._next_key(), jnp.asarray(self._temps),
+                jnp.asarray(active_mask))
+        else:
+            pair, self.cache = self._decode(
+                self.cache, self.params, self._last_dev,
+                self._next_key(), jnp.asarray(self._temps),
+                jnp.asarray(active_mask))
         self._last_dev = pair[1]
         pair_host = np.asarray(pair)          # the step's single sync
         self._decode_time += time.perf_counter() - t0
@@ -425,10 +643,13 @@ class InferenceEngine:
         now = time.time()
         for slot in just_prefilled:
             req = self._slots[slot]
+            if req is None or req.done:
+                continue   # preempted/finished by the page-pool pass
             first = int(pair_host[0, slot])
-            req.first_token_at = now
+            if req.first_token_at is None:
+                req.first_token_at = now
+                self._ttfts.append(now - req.submitted_at)
             req.output_tokens.append(first)
-            self._ttfts.append(now - req.submitted_at)
             if self._finished(req, slot, first):
                 # First token already ends the request; the second
                 # token decoded this step is discarded with the slot.
@@ -477,6 +698,12 @@ class InferenceEngine:
             'ttft_p50_s': p50,
             'num_waiting': len(self._waiting),
             'num_active': sum(1 for r in self._slots if r is not None),
+            **({'paged': True,
+                'page_size': self.allocator.page_size,
+                'pages_total': self.allocator.n_pages,
+                'pages_free': self.allocator.free_pages,
+                'preemptions': self._preemptions}
+               if self.allocator is not None else {}),
         }
 
 

@@ -1,0 +1,131 @@
+"""Paged KV cache: block tables over a shared page pool.
+
+The round-5 refinement named in engine.py's round-4 docstring: the dense
+slot cache prices every slot at max_seq_len, so 16 slots at 16k cost
+16x16k of KV HBM even when most requests are 2k. Here the cache is a
+pool of fixed-size pages shared by all slots; a slot owns
+ceil(len/page) pages, HBM scales with tokens-in-flight, and one engine
+serves mixed 2k/16k prompts (subsuming the round-4 two-tier EnginePool).
+
+Device state (static shapes, XLA-friendly):
+
+    k_pages, v_pages: [n_layers, n_kv_heads, n_pages, page, head_dim]
+    lengths:          [n_slots] int32
+
+Host state: the **allocator** (free-page stack + per-slot block table).
+Page assignment is control flow, not compute — it changes a few ints
+per step — so it lives on the host and the current block table rides
+into each compiled step as a tiny [slots, max_pages] int32 argument
+(the kernels read it via scalar prefetch; see ops/paged_attention.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    k_pages: jnp.ndarray   # [L, hkv, P, page, hd]
+    v_pages: jnp.ndarray   # [L, hkv, P, page, hd]
+    lengths: jnp.ndarray   # [slots] int32
+
+    @property
+    def n_pages(self) -> int:
+        return self.k_pages.shape[2]
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[3]
+
+
+def init_paged_cache(n_layers: int, n_slots: int, n_pages: int,
+                     page_size: int, n_kv_heads: int, head_dim: int,
+                     dtype=jnp.bfloat16) -> PagedKVCache:
+    shape = (n_layers, n_kv_heads, n_pages, page_size, head_dim)
+    return PagedKVCache(
+        k_pages=jnp.zeros(shape, dtype),
+        v_pages=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((n_slots,), jnp.int32))
+
+
+class PageAllocator:
+    """Host-side free-page stack + per-slot block tables.
+
+    Never touches the device: ``table()`` snapshots the current
+    [slots, max_pages] int32 block table for the next compiled call.
+    Freed pages go back on the stack; their bytes stay in HBM untouched
+    (a slot's length makes stale pages unreachable, same zero-memset
+    rule as the dense cache's free_slot).
+    """
+
+    def __init__(self, n_pages: int, page_size: int, n_slots: int,
+                 max_pages_per_slot: int) -> None:
+        self.page_size = page_size
+        self.n_pages = n_pages
+        self.max_pages_per_slot = max_pages_per_slot
+        # Page 0 is the GARBAGE SINK, never allocated: the decode step
+        # is one static program over every slot, so inactive slots
+        # still scatter a garbage K/V row at table[slot,0] — with the
+        # table zeroed that is page 0, which must therefore belong to
+        # nobody (in the dense cache the garbage landed in the inactive
+        # slot's own region; pages share, so the sink makes it safe).
+        self._free: List[int] = list(range(n_pages - 1, 0, -1))
+        self._owned: List[List[int]] = [[] for _ in range(n_slots)]
+        self._table = np.zeros((n_slots, max_pages_per_slot), np.int32)
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_of(self, slot: int) -> int:
+        return len(self._owned[slot])
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def table(self) -> np.ndarray:
+        """Current block table (copy — compiled calls must not see later
+        mutations through a shared buffer)."""
+        return self._table.copy()
+
+    # -- allocation --------------------------------------------------------
+    def extend(self, slot: int, upto_tokens: int) -> bool:
+        """Grow `slot` to cover `upto_tokens` positions. All-or-nothing:
+        returns False (allocating nothing) when the pool can't cover it
+        — the engine then defers the chunk or preempts."""
+        need = self.pages_needed(upto_tokens) - len(self._owned[slot])
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        if self.pages_needed(upto_tokens) > self.max_pages_per_slot:
+            return False
+        for _ in range(need):
+            pid = self._free.pop()
+            self._table[slot, len(self._owned[slot])] = pid
+            self._owned[slot].append(pid)
+        return True
+
+    def free(self, slot: int) -> None:
+        """Return all of `slot`'s pages to the pool."""
+        self._free.extend(reversed(self._owned[slot]))
+        self._owned[slot] = []
+        self._table[slot, :] = 0
+
+    def used_tokens_capacity(self) -> int:
+        """Tokens coverable by currently-owned pages (observability)."""
+        return sum(len(o) for o in self._owned) * self.page_size
+
+
+def free_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
+    """Device half of freeing: zero the slot's length (the allocator's
+    ``free`` is the host half)."""
+    return PagedKVCache(k_pages=cache.k_pages, v_pages=cache.v_pages,
+                        lengths=cache.lengths.at[slot].set(0))

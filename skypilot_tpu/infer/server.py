@@ -306,6 +306,17 @@ def main() -> None:
                              'instead of every slot paying the '
                              'longest length (two-tier KV).')
     parser.add_argument('--long-seq-len', type=int, default=8192)
+    parser.add_argument('--paged', action='store_true',
+                        help='Paged KV cache (block tables over a '
+                             'shared page pool): HBM ∝ tokens-in-'
+                             'flight, one engine serves mixed 2k/16k '
+                             'prompts — supersedes --long-slots '
+                             '(infer/paged_cache.py).')
+    parser.add_argument('--page-size', type=int, default=64)
+    parser.add_argument('--n-pages', type=int, default=None,
+                        help='Page-pool size (default: dense-equivalent '
+                             'slots*max_seq/page; lower it to cap KV '
+                             'HBM at expected tokens-in-flight)')
     parser.add_argument('--tp', type=int, default=1,
                         help='Tensor-parallel degree over local devices '
                              '(8B-class models need tp>=4 on v5e in '
@@ -318,6 +329,11 @@ def main() -> None:
                              'sentencepiece .model for /generate text')
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    if args.paged and args.long_slots > 0:
+        # Usage error: fail in milliseconds, not after minutes of
+        # checkpoint loading and KV allocation.
+        raise SystemExit('--paged already serves mixed lengths from '
+                         'one pool; drop --long-slots')
 
     # Multi-host replica: the agent runs this same command on EVERY host
     # of the slice with the jax.distributed env injected
@@ -411,7 +427,9 @@ def main() -> None:
         engine_lib.EngineConfig(
             n_slots=args.slots,
             max_seq_len=min(args.max_seq_len, config.max_seq_len),
-            tp=args.tp, quantize=args.quantize))
+            tp=args.tp, quantize=args.quantize,
+            paged=args.paged, page_size=args.page_size,
+            n_pages=args.n_pages))
     if args.long_slots > 0:
         short_cap = min(args.max_seq_len, config.max_seq_len)
         long_cap = min(args.long_seq_len, config.max_seq_len)

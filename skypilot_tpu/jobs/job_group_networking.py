@@ -1,0 +1,110 @@
+"""Job-group cross-task networking: peer discovery for gang-placed tasks.
+
+Counterpart of the reference's sky/jobs/job_group_networking.py (three
+layers: env-var interface, address resolver, /etc/hosts-or-DNS
+configurator). A job group (``execution: parallel``) gang-places its
+tasks on shared infra (optimizer.optimize_job_group) precisely so they
+can talk — trainer + parameter server, RLHF actor/learner,
+prefill/decode disaggregation. This module gives co-scheduled tasks the
+addresses to do it:
+
+- **Layer 1 (env)**: every task's process sees
+  ``SKY_TPU_JOBGROUP_NAME``, ``SKY_TPU_JOBGROUP_TASKS`` and, per peer
+  task T, ``SKY_TPU_JOBGROUP_TASK_<T>_IPS`` (comma-joined, host order)
+  plus ``SKY_TPU_JOBGROUP_TASK_<T>_HOST0`` (the head host — where a
+  task's server conventionally listens). Env alone is sufficient for
+  programs that take addresses as config — the common case.
+- **Layer 2 (hostnames)**: the stable name ``{task}-{i}.{group}`` for
+  host i of task `task`, listed in ``..._HOSTNAMES``.
+- **Layer 3 (hosts file)**: best-effort ``/etc/hosts`` injection on
+  every member cluster so the Layer-2 names resolve for programs that
+  want DNS-ish names (the reference injects /etc/hosts on SSH clouds
+  and relies on native DNS on k8s; here the injection is attempted
+  everywhere and skipped silently where the host file is not writable
+  — the env interface never depends on it).
+
+The launch two-phase comes from execution.launch_dag: provision every
+member first, then compute this map, then run setup/exec with it.
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Dict, List
+
+from skypilot_tpu.provision.common import ClusterInfo
+
+logger = logging.getLogger(__name__)
+
+ENV_GROUP_NAME = 'SKY_TPU_JOBGROUP_NAME'
+ENV_GROUP_TASKS = 'SKY_TPU_JOBGROUP_TASKS'
+_HOSTS_MARKER = '# sky-tpu-jobgroup'
+
+
+def _env_key(task_name: str) -> str:
+    return re.sub(r'[^A-Z0-9]', '_', task_name.upper())
+
+
+def hostname(task_name: str, node_idx: int, group_name: str) -> str:
+    """Stable per-host name (reference _get_job_address:
+    ``{job}-{idx}.{group}``)."""
+    return f'{task_name}-{node_idx}.{group_name}'
+
+
+def group_env(group_name: str,
+              infos_by_task: Dict[str, ClusterInfo]) -> Dict[str, str]:
+    """The Layer-1 env map every member task's processes receive."""
+    env = {
+        ENV_GROUP_NAME: group_name,
+        ENV_GROUP_TASKS: ','.join(sorted(infos_by_task)),
+    }
+    for tname, info in infos_by_task.items():
+        key = _env_key(tname)
+        ips = [h.internal_ip for h in info.hosts]
+        env[f'SKY_TPU_JOBGROUP_TASK_{key}_IPS'] = ','.join(ips)
+        env[f'SKY_TPU_JOBGROUP_TASK_{key}_HOST0'] = (
+            ips[0] if ips else '')
+        env[f'SKY_TPU_JOBGROUP_TASK_{key}_HOSTNAMES'] = ','.join(
+            hostname(tname, i, group_name) for i in range(len(ips)))
+    return env
+
+
+def hosts_file_lines(group_name: str,
+                     infos_by_task: Dict[str, ClusterInfo]
+                     ) -> List[str]:
+    """`ip name` lines mapping every member host's Layer-2 name."""
+    lines = []
+    for tname, info in sorted(infos_by_task.items()):
+        for i, h in enumerate(info.hosts):
+            if h.internal_ip:
+                lines.append(
+                    f'{h.internal_ip} {hostname(tname, i, group_name)} '
+                    f'{_HOSTS_MARKER} {group_name}')
+    return lines
+
+
+def inject_hosts(backend, group_name: str,
+                 infos_by_task: Dict[str, ClusterInfo]) -> None:
+    """Layer 3: append the group's name map to /etc/hosts on every
+    member cluster (idempotent via the group marker). Best-effort by
+    design: k8s pods and local fake slices either have native DNS or
+    no writable hosts file — the env interface carries them."""
+    lines = hosts_file_lines(group_name, infos_by_task)
+    if not lines:
+        return
+    block = '\\n'.join(lines)
+    marker = f'{_HOSTS_MARKER} {group_name}'
+    cmd = (f"grep -qF '{marker}' /etc/hosts 2>/dev/null || "
+           f"{{ printf '{block}\\n' | "
+           f'{{ sudo tee -a /etc/hosts >/dev/null 2>&1 || '
+           f'tee -a /etc/hosts >/dev/null 2>&1; }}; }} || true')
+    from skypilot_tpu.runtime import agent_client
+    for tname, info in infos_by_task.items():
+        if not info.head.agent_url:
+            continue
+        try:
+            agent_client.AgentClient.for_info(info, timeout=30).exec_sync(
+                cmd, timeout=60)
+        except Exception as e:  # noqa: BLE001 — Layer 3 is best-effort
+            logger.debug('jobgroup %s: hosts injection on %s skipped: %s',
+                         group_name, tname, e)

@@ -71,6 +71,11 @@ class LlamaConfig:
     # set for 100k+ vocabs where fp32 [b*s, V] logits (4.3 GB for
     # Llama-3's 128256 at b4 s2048) must never materialize.
     loss_vocab_chunks: Optional[int] = None
+    # Fused Pallas cross-entropy (ops/cross_entropy.py
+    # fused_cross_entropy): logits tiles live and die in VMEM — HBM
+    # traffic drops to the matmul operands. Requires b*s and vocab
+    # divisible by 512. Overrides loss_vocab_chunks when set.
+    fused_loss: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -276,7 +281,14 @@ def loss_fn(config: LlamaConfig, params: Params, tokens: jnp.ndarray,
     custom-VJP path (ops/cross_entropy.py) that never materializes the
     fp32 [b*s, vocab] logits — required headroom at 100k+ vocabs.
     """
-    if config.loss_vocab_chunks:
+    if config.fused_loss:
+        from skypilot_tpu.ops import cross_entropy as ce
+        b, s = tokens.shape
+        x = backbone(config, params, tokens)
+        nll = ce.fused_cross_entropy(
+            x.reshape(b * s, config.dim), params['lm_head'],
+            targets.reshape(b * s).astype(jnp.int32)).reshape(b, s)
+    elif config.loss_vocab_chunks:
         from skypilot_tpu.ops import cross_entropy as ce
         b, s = tokens.shape
         x = backbone(config, params, tokens)

@@ -189,7 +189,7 @@ def _install_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
             'slice_id': rank // hosts_per_slice,
             'tpu_slice': info.tpu_slice,
             'peer_agent_urls': [
-                f'{"https" if config.provider_config.get("agent_tls_cert") else "http"}'
+                f'{tls.scheme_for(config.provider_config.get("agent_tls_cert"))}'
                 f'://{ip}:{AGENT_PORT}'
                 for i, ip in enumerate(internal_ips) if i != rank
             ] if rank == 0 else [],
@@ -238,8 +238,7 @@ def get_cluster_info(cluster_name: str,
         state = node.get('state', 'UNKNOWN')
         host_state = {'READY': 'RUNNING', 'STOPPED': 'STOPPED'}.get(
             state, state)
-        scheme = ('https' if provider_config.get('agent_tls_cert')
-                  else 'http')
+        scheme = tls.scheme_for(provider_config.get('agent_tls_cert'))
         for i, ep in enumerate(node.get('networkEndpoints', [])):
             external = (ep.get('accessConfig') or {}).get('externalIp')
             hosts.append(HostInfo(

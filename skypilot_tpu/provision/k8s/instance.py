@@ -213,7 +213,7 @@ def _bootstrap_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
             'slice_id': rank // hosts_per_slice,
             'tpu_slice': info.tpu_slice,
             'peer_agent_urls': [
-                f'{"https" if config.provider_config.get("agent_tls_cert") else "http"}'
+                f'{tls.scheme_for(config.provider_config.get("agent_tls_cert"))}'
                 f'://{ip}:{manifests.AGENT_PORT}'
                 for i, ip in enumerate(host_ips) if i != rank
             ] if rank == 0 else [],
@@ -438,8 +438,7 @@ def get_cluster_info(cluster_name: str,
                     int(tail) if tail.isdigit() else 0)
         pods.sort(key=_ordinal)
         hosts = []
-        scheme = ('https' if provider_config.get('agent_tls_cert')
-                  else 'http')
+        scheme = tls.scheme_for(provider_config.get('agent_tls_cert'))
         for i, p in enumerate(pods):
             ip = p['status'].get('podIP', '')
             hosts.append(HostInfo(

@@ -94,7 +94,8 @@ def _node_script(cdir: str, cluster_name: str,
     """The per-node srun payload: derive rank/hosts from the Slurm env,
     write the agent config, run the agent in the foreground (the srun
     task's lifetime IS the allocation's)."""
-    scheme = 'https' if cert_pem else 'http'
+    from skypilot_tpu.utils import tls as tls_lib
+    scheme = tls_lib.scheme_for(cert_pem)
     return f"""#!/bin/bash
 set -e
 RANK=${{SLURM_NODEID:?}}
@@ -242,9 +243,8 @@ def get_cluster_info(cluster_name: str,
         # Not (or no longer) allocated: synthesize placeholders so the
         # host count survives for status displays.
         nodes = [f'<pending-{i}>' for i in range(meta['num_hosts'])]
-    scheme = ('https'
-              if meta.get('provider_config', {}).get('agent_tls_cert')
-              else 'http')
+    scheme = tls.scheme_for(
+        meta.get('provider_config', {}).get('agent_tls_cert'))
     hosts = [HostInfo(
         host_id=f'{cluster_name}-node{i}',
         internal_ip=n,

@@ -172,7 +172,7 @@ def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any],
             'num_hosts': len(hosts),
             'tpu_slice': pool.get('accelerator'),
             'peer_agent_urls': [
-                f'{"https" if cert_pem else "http"}://{h}:{AGENT_PORT}'
+                f'{tls.scheme_for(cert_pem)}://{h}:{AGENT_PORT}'
                 for i, h in enumerate(hosts) if i != rank
             ] if rank == 0 else [],
             # NOTE: no password here — agent_config.json lands on every
@@ -298,7 +298,7 @@ def get_cluster_info(cluster_name: str,
     pool = _pool_of({'pool': meta['pool']})
     # Per-HOST agent URLs: each host runs its own agent (the head fans
     # ranks out to them); provisioning waits on every one of them.
-    scheme = 'https' if meta.get('tls_cert_pem') else 'http'
+    scheme = tls.scheme_for(meta.get('tls_cert_pem'))
     hosts = [HostInfo(host_id=f'{cluster_name}-host{i}',
                       internal_ip=h, external_ip=h, state='RUNNING',
                       agent_url=f'{scheme}://{h}:{AGENT_PORT}')

@@ -355,6 +355,79 @@ class Agent:
                 pass
             await asyncio.sleep(600.0)
 
+    # ---------------- log GC ----------------------------------------------
+    def _gc_logs(self, now: Optional[float] = None) -> None:
+        """Prune finished jobs' logs by age AND total size (reference
+        sky/jobs/log_gc.py: 7-day retention, hourly loop; the size
+        budget is the TPU-host twist — a long-lived slice writes
+        per-rank logs forever and eventually fills the host disk).
+
+        Never touches a non-terminal job's logs; exec logs (setup /
+        pre-exec stages) age out the same way. Tunables ride
+        agent_config.json: log_retention_hours (negative disables),
+        log_budget_mb (total across finished-job + exec logs).
+        """
+        import shutil
+        now = now if now is not None else time.time()
+        retention_h = float(self.config.get('log_retention_hours', 168))
+        budget_bytes = float(self.config.get('log_budget_mb',
+                                             1024)) * 1e6
+        if retention_h < 0:
+            return
+        job_root = os.path.join(self.cluster_dir, 'job_logs')
+        exec_root = os.path.join(self.cluster_dir, 'exec_logs')
+        # Candidate dirs: terminal jobs' log dirs + all exec log dirs.
+        candidates = []   # (mtime, size, path)
+        terminal_ids = {
+            str(j['job_id']) for j in self.jobs.list_jobs()
+            if j['status'].is_terminal()}
+        known_ids = {str(j['job_id']) for j in self.jobs.list_jobs()}
+        if os.path.isdir(job_root):
+            for name in os.listdir(job_root):
+                # Unknown dirs (job row gone) are prunable; live jobs
+                # are not.
+                if name in known_ids and name not in terminal_ids:
+                    continue
+                candidates.append(os.path.join(job_root, name))
+        if os.path.isdir(exec_root):
+            candidates.extend(os.path.join(exec_root, name)
+                              for name in os.listdir(exec_root))
+        entries = []
+        for path in candidates:
+            try:
+                mtime = os.path.getmtime(path)
+                size = sum(
+                    os.path.getsize(os.path.join(r, f))
+                    for r, _, fs in os.walk(path) for f in fs)
+            except OSError:
+                continue
+            entries.append((mtime, size, path))
+        # Age pass.
+        kept = []
+        for mtime, size, path in sorted(entries):
+            if now - mtime > retention_h * 3600:
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                kept.append((mtime, size, path))
+        # Size pass: oldest finished logs go first until under budget.
+        total = sum(size for _, size, _ in kept)
+        for mtime, size, path in kept:
+            if total <= budget_bytes:
+                break
+            shutil.rmtree(path, ignore_errors=True)
+            total -= size
+
+    async def log_gc_loop(self) -> None:
+        """Hourly (clamped like the reference's _next_gc_interval)."""
+        retention_h = float(self.config.get('log_retention_hours', 168))
+        interval = max(min(retention_h * 3600, 3600.0), 30.0)
+        while True:
+            try:
+                self._gc_logs()
+            except Exception:  # noqa: BLE001 — GC must not kill agent
+                pass
+            await asyncio.sleep(interval)
+
     async def autostop_loop(self) -> None:
         """Reference AutostopEvent (sky/skylet/events.py:161): the cluster
         tears *itself* down after idling."""
@@ -622,6 +695,7 @@ async def _main(cluster_dir: str, host: str, port: int) -> None:
     loop.create_task(agent.scheduler_loop())
     loop.create_task(agent.autostop_loop())
     loop.create_task(agent.heartbeat_loop())
+    loop.create_task(agent.log_gc_loop())
     while True:
         await asyncio.sleep(3600)
 

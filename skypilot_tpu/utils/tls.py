@@ -180,6 +180,12 @@ def pinned_session(fingerprint: Optional[str]) -> requests.Session:
     return sess
 
 
+def scheme_for(cert_pem: Optional[str]) -> str:
+    """URL scheme for an agent endpoint given its cluster cert (one
+    home for the https-iff-cert rule every provider applies)."""
+    return 'https' if cert_pem else 'http'
+
+
 def ensure_cluster_cert(store: dict, cluster_name: str,
                         cert_key: str = 'agent_tls_cert',
                         key_key: str = 'agent_tls_key'

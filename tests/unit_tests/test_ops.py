@@ -153,3 +153,91 @@ def test_chunked_cross_entropy_matches_dense():
         argnums=(0, 1))(x, w)
     for a, b in zip(gd, gc):
         assert jnp.max(jnp.abs(a - b)) < 1e-5
+
+
+def test_fused_cross_entropy_matches_dense():
+    """ops/cross_entropy.py fused_cross_entropy (Pallas): value AND
+    both gradients match the dense fp32 log-softmax oracle. Interpret
+    mode here; the bench runs the same kernels compiled on chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.ops import cross_entropy as ce
+    key = jax.random.PRNGKey(0)
+    T, d, V, bt, bv = 64, 128, 256, 32, 128
+    x = jax.random.normal(key, (T, d), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (d, V),
+                          jnp.float32) * 0.05
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (T,), 0, V)
+
+    def dense(x, w):
+        logp = jax.nn.log_softmax((x @ w).astype(jnp.float32), -1)
+        return -jnp.take_along_axis(logp, tgt[:, None], 1)[:, 0]
+
+    nll_d = dense(x, w)
+    nll_f = ce.fused_cross_entropy(x, w, tgt, bt, bv)
+    assert jnp.max(jnp.abs(nll_d - nll_f)) < 1e-4
+
+    gd = jax.grad(lambda x, w: jnp.mean(dense(x, w)),
+                  argnums=(0, 1))(x, w)
+    gf = jax.grad(
+        lambda x, w: jnp.mean(ce.fused_cross_entropy(x, w, tgt, bt, bv)),
+        argnums=(0, 1))(x, w)
+    for name, a, b in zip(('dx', 'dw'), gd, gf):
+        assert jnp.max(jnp.abs(a - b)) < 1e-4, name
+
+
+def test_fused_cross_entropy_loss_fn_wiring():
+    """config.fused_loss routes llama.loss_fn through the fused kernel
+    and the loss (with mask) matches the dense path."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import llama
+    # tiny() has vocab 256 / dim 64; block sizes must divide b*s and V.
+    cfg_d = llama.LlamaConfig.tiny()
+    cfg_f = llama.LlamaConfig.tiny(fused_loss=True)
+    params = llama.init_params(cfg_d, jax.random.PRNGKey(0))
+    b, s = 2, 16   # b*s = 32 tokens
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, 256)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (b, s), 0, 256)
+    mask = jnp.ones((b, s))
+
+    # Patch the default blocks to divide the tiny shapes.
+    from skypilot_tpu.ops import cross_entropy as ce
+    orig = ce.fused_cross_entropy
+    loss_d = llama.loss_fn(cfg_d, params, tokens, targets, mask)
+    loss_f = llama.loss_fn.__wrapped__(
+        cfg_f, params, tokens, targets, mask) if hasattr(
+            llama.loss_fn, '__wrapped__') else None
+    # Call through the public path with compatible blocks via partial.
+    import functools as ft
+    ce.fused_cross_entropy = ft.partial(orig, block_t=32, block_v=128)
+    try:
+        loss_f = llama.loss_fn(cfg_f, params, tokens, targets, mask)
+    finally:
+        ce.fused_cross_entropy = orig
+    assert jnp.abs(loss_d - loss_f) < 1e-4
+
+
+def test_fused_cross_entropy_chunked_backward_branch(monkeypatch):
+    """The large-vocab backward branch (chunked scan instead of the
+    one-shot fp32 recompute) produces the same gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.ops import cross_entropy as ce
+    T, d, V = 32, 64, 256
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, d), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (d, V),
+                          jnp.float32) * 0.05
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (T,), 0, V)
+
+    def loss(x, w):
+        return jnp.mean(ce.fused_cross_entropy(x, w, tgt, 32, 128))
+
+    g_one = jax.grad(loss, argnums=(0, 1))(x, w)
+    monkeypatch.setattr(ce, 'ONE_SHOT_BWD_MAX_VOCAB', 0)
+    g_chunk = jax.grad(loss, argnums=(0, 1))(x, w)
+    for name, a, b in zip(('dx', 'dw'), g_one, g_chunk):
+        assert jnp.max(jnp.abs(a - b)) < 1e-5, name
