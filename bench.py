@@ -108,6 +108,12 @@ def main() -> None:
                              'chunked custom VJP, or the fused Pallas '
                              'forward (equivalent to --fused-ce)')
     args = parser.parse_args()
+    # Bench-owns-the-chip: block until the test suite (or another
+    # bench) releases the accelerator — a perf artifact produced while
+    # tests burn the box measures contention, not the kernel (VERDICT
+    # r5 weak #2).
+    from skypilot_tpu.utils import locks
+    locks.acquire_chip_lock('bench')
     seq = args.seq
     batch = args.batch or (BATCH if seq <= 2048 else 1)
     dev = jax.devices()[0]

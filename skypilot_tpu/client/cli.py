@@ -271,6 +271,86 @@ def check() -> None:
     click.echo(f'\nEnabled clouds: {", ".join(enabled) or "none"}')
 
 
+@cli.command('trace')
+@click.argument('request_id', required=False)
+@click.option('--perfetto', 'perfetto_path', default=None,
+              help='Also write Perfetto/Chrome-trace JSON here '
+                   '(open in ui.perfetto.dev or chrome://tracing).')
+def trace_cmd(request_id: Optional[str],
+              perfetto_path: Optional[str]) -> None:
+    """Render the distributed trace of one API request.
+
+    REQUEST_ID is the id `sky-tpu` ops return (also accepts a raw
+    trace id). With no argument, lists recent traces. Requires the
+    request to have run with SKY_TPU_TRACE=1 on the client and server
+    (see docs/observability.md).
+    """
+    import json as json_lib
+
+    from skypilot_tpu.observability import render as render_lib
+    from skypilot_tpu.observability import store as store_lib
+    from skypilot_tpu.observability import trace as trace_mod
+
+    def _local_store():
+        return store_lib.SpanStore()
+
+    # Query wherever spans actually shipped: the same resolution chain
+    # the shipper uses (env → config endpoint → local api_server.json),
+    # falling back to the client-local store. The resolved URL is
+    # pinned into the env so the SDK talks to the SAME server (a local
+    # server found via api_server.json may sit on a non-default port).
+    server = trace_mod._resolve_collector()  # noqa: SLF001
+    use_server = server is not None
+    if use_server:
+        os.environ['SKY_TPU_API_SERVER'] = server
+    if request_id is None:
+        traces = None
+        if use_server:
+            from skypilot_tpu import exceptions as exc
+            from skypilot_tpu.client import sdk
+            try:
+                traces = sdk.api_traces()
+            except exc.SkyTpuError:
+                traces = None   # stale/dead server: fall back to local
+        if traces is None:
+            traces = _local_store().list_traces()
+        if not traces:
+            click.echo('No traces recorded. Run with SKY_TPU_TRACE=1.')
+            return
+        fmt = '{:34} {:>8} {:24} {}'
+        click.echo(fmt.format('TRACE', 'SPANS', 'ROOT', 'REQUEST'))
+        for t in traces:
+            click.echo(fmt.format(t['trace_id'], t['n_spans'],
+                                  t.get('root') or '-',
+                                  t.get('request_id') or '-'))
+        return
+    spans = []
+    if use_server:
+        from skypilot_tpu import exceptions as exc
+        from skypilot_tpu.client import sdk
+        try:
+            spans = sdk.api_trace(request_id)
+        except exc.SkyTpuError:
+            spans = []
+    if not spans:
+        # Engine mode / server unreachable: the local span store holds
+        # whatever this host's processes shipped.
+        store = _local_store()
+        spans = store.trace_for_request(request_id)
+        if not spans:
+            spans = store.get_trace(request_id)
+    if not spans:
+        raise click.ClickException(
+            f'no trace recorded for {request_id!r} — run the request '
+            f'with SKY_TPU_TRACE=1 (client and server), or check '
+            f'`sky-tpu trace` for the trace list.')
+    click.echo(render_lib.render_tree(spans))
+    if perfetto_path:
+        with open(perfetto_path, 'w', encoding='utf-8') as f:
+            json_lib.dump(render_lib.to_perfetto(spans), f)
+        click.echo(f'wrote {perfetto_path}')
+
+
 @cli.command('show-accelerators')
 @click.option('--filter', 'name_filter', default=None)
 def show_accelerators(name_filter: Optional[str]) -> None:

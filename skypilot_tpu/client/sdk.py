@@ -21,6 +21,7 @@ import requests as requests_lib
 from skypilot_tpu import config as config_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.provision.common import ClusterInfo
 from skypilot_tpu.utils import common
 
@@ -55,15 +56,27 @@ def _auth_headers() -> Dict[str, str]:
 
 def _post_raw(op: str, payload: Dict[str, Any]) -> Dict[str, Any]:
     url = server_url()
-    try:
-        r = requests_lib.post(f'{url}/{op}', json=payload, timeout=30,
-                              headers=_auth_headers())
-    except requests_lib.RequestException as e:
-        raise exceptions.ApiServerConnectionError(url) from e
-    if r.status_code in (400, 401, 403, 426):
-        raise exceptions.SkyTpuError(r.json().get('error', r.text))
-    r.raise_for_status()
-    return r.json()
+    # Root span of the distributed trace: the op submission as the
+    # client observed it. The traceparent header carries the context to
+    # the server; the span ships immediately after (ops are rare and
+    # opt-in traced, so the extra POST is fine) so `sky-tpu trace`
+    # shows the client hop without waiting for process exit.
+    with trace_lib.span(f'sdk.{op}') as tspan:
+        try:
+            r = requests_lib.post(
+                f'{url}/{op}', json=payload, timeout=30,
+                headers=trace_lib.inject_headers(_auth_headers()))
+        except requests_lib.RequestException as e:
+            raise exceptions.ApiServerConnectionError(url) from e
+        if r.status_code in (400, 401, 403, 426):
+            raise exceptions.SkyTpuError(r.json().get('error', r.text))
+        r.raise_for_status()
+        body = r.json()
+        if tspan is not None and 'request_id' in body:
+            tspan.set_attr('request_id', body['request_id'])
+    if trace_lib.enabled():
+        trace_lib.flush()
+    return body
 
 
 def _post(op: str, payload: Dict[str, Any]) -> str:
@@ -223,6 +236,22 @@ def check_server_compatibility() -> None:
 
 def api_requests() -> List[Dict[str, Any]]:
     return _http_get('/api/requests').json()['requests']
+
+
+def api_trace(key: str) -> List[Dict[str, Any]]:
+    """Spans of one trace, by request id or raw trace id. Empty list
+    when nothing was recorded (tracing off, or spans GC'd)."""
+    try:
+        return _http_get(f'/api/traces/{key}').json()['spans']
+    except exceptions.SkyTpuError as e:
+        if 'no trace recorded' in str(e):
+            return []
+        raise
+
+
+def api_traces() -> List[Dict[str, Any]]:
+    """Recent trace summaries from the server's span store."""
+    return _http_get('/api/traces').json()['traces']
 
 
 # ---- core-mirroring surface ---------------------------------------------

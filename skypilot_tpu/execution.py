@@ -27,6 +27,7 @@ from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import state
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.observability import trace
 from skypilot_tpu.provision.common import ClusterInfo
 from skypilot_tpu import usage
 from skypilot_tpu.utils import common
@@ -72,6 +73,7 @@ def _existing_cluster_info(
 
 @usage.entrypoint(name='launch')
 @timeline.event(name='execution.launch')
+@trace.traced(name='execution.launch')
 def launch(
     task: task_lib.Task,
     cluster_name: Optional[str] = None,
@@ -128,23 +130,29 @@ def launch(
                 # An all-blocked list means capacity moved on — fall back
                 # to the full list rather than failing the launch.
                 candidates = keep or candidates
-            info = backend.provision(task, cluster_name, candidates)
+            with trace.span('launch.provision', cluster=cluster_name):
+                info = backend.provision(task, cluster_name, candidates)
 
         if Stage.SYNC_WORKDIR in run_stages and task.workdir:
-            backend.sync_workdir(info, task.workdir)
+            with trace.span('launch.sync_workdir'):
+                backend.sync_workdir(info, task.workdir)
         if Stage.SYNC_FILE_MOUNTS in run_stages and (task.file_mounts or
                                                      task.storage_mounts):
             mounts = dict(task.file_mounts)
             for mp, spec in task.storage_mounts.items():
                 mounts[mp] = spec['source']
-            backend.sync_file_mounts(info, mounts)
+            with trace.span('launch.sync_file_mounts'):
+                backend.sync_file_mounts(info, mounts)
         if Stage.SYNC_FILE_MOUNTS in run_stages and task.volumes:
-            backend.mount_volumes(info, task)
+            with trace.span('launch.mount_volumes'):
+                backend.mount_volumes(info, task)
         if Stage.SETUP in run_stages:
-            backend.setup(info, task)
+            with trace.span('launch.setup'):
+                backend.setup(info, task)
         job_id = -1
         if Stage.EXEC in run_stages and task.run:
-            job_id = backend.execute(info, task, detach=detach_run)
+            with trace.span('launch.exec', cluster=cluster_name):
+                job_id = backend.execute(info, task, detach=detach_run)
         # Apply requested autostop.
         auto = task.resources.autostop
         if auto is not None and auto.enabled and hasattr(backend,
@@ -187,6 +195,7 @@ def _failover_candidates(
 
 @usage.entrypoint(name='launch_dag')
 @timeline.event(name='execution.launch_dag')
+@trace.traced(name='execution.launch_dag')
 def launch_dag(
     dag,
     *,
@@ -279,6 +288,7 @@ def launch_dag(
 
 @usage.entrypoint(name='exec')
 @timeline.event(name='execution.exec')
+@trace.traced(name='execution.exec')
 def exec(  # noqa: A001 — mirrors the reference's public name
     task: task_lib.Task,
     cluster_name: str,

@@ -36,6 +36,7 @@ from skypilot_tpu.infer import model as model_lib
 from skypilot_tpu.infer import paged_cache as paged_cache_lib
 from skypilot_tpu.infer import sampling as sampling_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.observability import trace
 
 
 @dataclasses.dataclass(frozen=True)
@@ -554,6 +555,12 @@ class InferenceEngine:
         return decoding
 
     # ---- the step --------------------------------------------------------
+    # Traced only when SKY_TPU_TRACE is set at process start (the
+    # decorator returns `step` unchanged otherwise — this loop runs per
+    # token and must stay wrapper-free by default). min_dur_s filters
+    # steady-state decode ticks: only outliers (prefill-bucket compiles,
+    # long chunk batches) are worth a span.
+    @trace.traced(name='engine.step', hop='infer', min_dur_s=0.05)
     def step(self) -> int:
         """Refill free slots, advance at most ``prefill_chunks_per_step``
         prefill chunks (round-robin across prefilling slots), then decode

@@ -32,6 +32,7 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.jobs.state import ManagedJobStatus, ScheduleState
+from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.provision.common import ClusterInfo
 from skypilot_tpu.runtime import agent_client
 from skypilot_tpu.utils import common
@@ -167,17 +168,29 @@ class JobController:
             final = ManagedJobStatus.FAILED_CONTROLLER
         finally:
             jobs_state.set_schedule_state(self.job_id, ScheduleState.DONE)
+            trace_lib.flush()   # recovery spans: ship before teardown
         return final
 
     def _launch(self, recovery_count: int = 0,
                 recovering: bool = False) -> None:
         jobs_state.set_schedule_state(self.job_id, ScheduleState.LAUNCHING)
         if recovering:
-            job_id, info = self.strategy.recover(recovery_count,
-                                                 self.last_placement)
+            # The recovery trace (preempt → reprovision → resume): one
+            # span per attempt; the strategy's relaunch nests
+            # launch.provision / launch.exec under it, so recovery
+            # latency decomposes by hop.
+            with trace_lib.span('managed_job.recover',
+                                hop='jobs-controller',
+                                job_id=self.job_id,
+                                attempt=recovery_count):
+                job_id, info = self.strategy.recover(recovery_count,
+                                                     self.last_placement)
         else:
             self._set_status(ManagedJobStatus.STARTING)
-            job_id, info = self.strategy.launch()
+            with trace_lib.span('managed_job.launch',
+                                hop='jobs-controller',
+                                job_id=self.job_id):
+                job_id, info = self.strategy.launch()
         self.cluster_job_id = job_id
         self.last_placement = (info.region, info.zone)
         # Pool jobs: the strategy binds the claimed worker's cluster name

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import logging
 import re
+import shlex
 from typing import Dict, List
 
 from skypilot_tpu.provision.common import ClusterInfo
@@ -92,10 +93,14 @@ def inject_hosts(backend, group_name: str,
     lines = hosts_file_lines(group_name, infos_by_task)
     if not lines:
         return
-    block = '\\n'.join(lines)
     marker = f'{_HOSTS_MARKER} {group_name}'
-    cmd = (f"grep -qF '{marker}' /etc/hosts 2>/dev/null || "
-           f"{{ printf '{block}\\n' | "
+    # The hosts block is DATA, never format string or syntax: each line
+    # rides as a shlex-quoted printf '%s\n' argument (quotes and % in
+    # task/group names cannot break out or be format-interpreted), and
+    # the grep marker is quoted + `--`-guarded the same way.
+    quoted_lines = ' '.join(shlex.quote(line) for line in lines)
+    cmd = (f'grep -qF -- {shlex.quote(marker)} /etc/hosts 2>/dev/null || '
+           f"{{ printf '%s\\n' {quoted_lines} | "
            f'{{ sudo tee -a /etc/hosts >/dev/null 2>&1 || '
            f'tee -a /etc/hosts >/dev/null 2>&1; }}; }} || true')
     from skypilot_tpu.runtime import agent_client

@@ -1,0 +1,25 @@
+"""End-to-end distributed tracing for the control plane.
+
+A Dapper-style propagated-trace subsystem for the multi-hop
+orchestrator (CLI/SDK → API server → request worker → on-cluster agent
+→ job runtime, plus the jobs controller and the serve LB → replica
+path). ``utils/timeline.py`` records Chrome-trace events *per process*;
+this package adds the piece timeline cannot provide — a trace context
+that crosses the wire, so a TTFT or recovery regression is attributable
+to a hop instead of "the box was noisy".
+
+Layout:
+
+- ``trace``  — trace context (W3C-traceparent-style), span recording,
+  cross-process propagation (HTTP header / env var / request payload),
+  and best-effort span shipping. Zero overhead when ``SKY_TPU_TRACE``
+  is unset; every ship path is fail-open.
+- ``store``  — sqlite-backed span store (``utils/db.py`` pattern, like
+  ``server/requests_store.py``) with size-capped GC, plus ``ingest()``,
+  the single write path that also feeds the Prometheus
+  ``sky_tpu_span_duration_seconds{op,hop}`` series.
+- ``render`` — span-tree text rendering for ``sky-tpu trace`` and
+  Perfetto/Chrome-trace JSON export (same event shape as
+  ``utils/timeline.py``, so local intra-process events merge in).
+"""
+from skypilot_tpu.observability import trace  # noqa: F401

@@ -1,0 +1,117 @@
+"""Trace surfacing: span-tree text rendering + Perfetto export.
+
+``render_tree`` drives ``sky-tpu trace <request_id>`` — an indented
+tree with per-hop latency so a slow launch reads as "provision took
+41s of the 44s total, and 39s of that was wait_healthy on the agent".
+
+``to_perfetto`` emits Chrome-trace JSON in the SAME event shape as
+``utils/timeline.py`` ('X' complete events, microsecond timestamps),
+so a process's local timeline events (intra-process profiling) merge
+into one file with the propagated spans and nest visually under them
+in Perfetto / chrome://tracing.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def build_tree(spans: List[Dict[str, Any]]
+               ) -> List[Dict[str, Any]]:
+    """Parent-link the flat span list into a forest (roots returned,
+    children attached as ``span['children']``, sorted by start time).
+    Spans whose parent never arrived (a hop's ship was dropped —
+    fail-open tracing guarantees only best effort) become roots rather
+    than vanishing."""
+    by_id = {s['span_id']: dict(s, children=[]) for s in spans}
+    roots: List[Dict[str, Any]] = []
+    for s in by_id.values():
+        parent = by_id.get(s.get('parent_id') or '')
+        if parent is not None and parent is not s:
+            parent['children'].append(s)
+        else:
+            roots.append(s)
+    def _sort(nodes):
+        nodes.sort(key=lambda n: n.get('start') or 0.0)
+        for n in nodes:
+            _sort(n['children'])
+    _sort(roots)
+    return roots
+
+
+def _fmt_dur(dur_s: float) -> str:
+    if dur_s >= 1.0:
+        return f'{dur_s:.2f}s'
+    return f'{dur_s * 1000:.1f}ms'
+
+
+def render_tree(spans: List[Dict[str, Any]]) -> str:
+    """ASCII span tree with per-hop latency and status."""
+    if not spans:
+        return '(no spans)'
+    roots = build_tree(spans)
+    trace_id = spans[0].get('trace_id', '?')
+    lines = [f'trace {trace_id} — {len(spans)} spans']
+
+    def walk(node: Dict[str, Any], prefix: str, last: bool) -> None:
+        branch = '└─ ' if last else '├─ '
+        status = node.get('status') or 'ok'
+        flag = '' if status == 'ok' else f'  [{status}]'
+        attrs = node.get('attrs') or {}
+        extra = ''
+        if attrs:
+            short = {k: v for k, v in sorted(attrs.items())
+                     if k != 'request_id'}
+            if short:
+                kv = ', '.join(f'{k}={v}' for k, v in short.items())
+                extra = f'  ({kv})'
+        lines.append(
+            f'{prefix}{branch}{node.get("name", "?")} '
+            f'[{node.get("hop", "?")}] '
+            f'{_fmt_dur(float(node.get("dur_s") or 0.0))}{flag}{extra}')
+        children = node['children']
+        child_prefix = prefix + ('   ' if last else '│  ')
+        for i, c in enumerate(children):
+            walk(c, child_prefix, i == len(children) - 1)
+
+    for i, r in enumerate(roots):
+        walk(r, '', i == len(roots) - 1)
+    return '\n'.join(lines)
+
+
+def to_perfetto(spans: List[Dict[str, Any]],
+                extra_events: Optional[List[Dict[str, Any]]] = None
+                ) -> Dict[str, Any]:
+    """Chrome trace JSON. Each hop becomes a pid row (named via
+    process_name metadata); spans become 'X' events whose ts/dur are in
+    microseconds of wall time, so cross-hop spans line up on one clock.
+    ``extra_events`` takes raw ``utils/timeline.py`` events (already in
+    this format) and merges them verbatim."""
+    hops = []
+    events: List[Dict[str, Any]] = []
+    for s in spans:
+        hop = s.get('hop') or '?'
+        if hop not in hops:
+            hops.append(hop)
+        ev = {
+            'name': s.get('name', '?'),
+            'ph': 'X',
+            'ts': float(s.get('start') or 0.0) * 1e6,
+            'dur': float(s.get('dur_s') or 0.0) * 1e6,
+            'pid': hops.index(hop) + 1,
+            'tid': 1,
+            'args': {
+                'trace_id': s.get('trace_id'),
+                'span_id': s.get('span_id'),
+                'parent_id': s.get('parent_id'),
+                'status': s.get('status'),
+                **(s.get('attrs') or {}),
+            },
+        }
+        events.append(ev)
+    meta = [
+        {'name': 'process_name', 'ph': 'M', 'pid': i + 1, 'tid': 1,
+         'args': {'name': hop}} for i, hop in enumerate(hops)
+    ]
+    if extra_events:
+        events.extend(extra_events)
+    return {'traceEvents': meta + events, 'displayTimeUnit': 'ms'}

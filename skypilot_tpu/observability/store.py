@@ -1,0 +1,203 @@
+"""Span store: sqlite-backed persistence for shipped spans.
+
+Same shape as ``server/requests_store.py`` over ``utils/db.py``: one
+logical store (``traces.db`` under the state dir, or a pg schema when
+``SKY_TPU_DB_URL`` is set), plain accessors, no ORM. ``ingest()`` is
+the single write path — every shipped batch lands here, feeds the
+``sky_tpu_span_duration_seconds{op,hop}`` Prometheus series, and
+triggers the size-capped GC so a busy control plane cannot grow the
+trace DB without bound.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common
+from skypilot_tpu.utils import db as db_util
+
+# Whole-trace GC cap (rows). Oldest traces are dropped first; a trace is
+# never half-deleted (a broken parent chain renders as orphans).
+MAX_SPANS_ENV = 'SKY_TPU_TRACE_MAX_SPANS'
+DEFAULT_MAX_SPANS = 100_000
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS spans (
+    trace_id TEXT,
+    span_id TEXT,
+    parent_id TEXT,
+    name TEXT,
+    hop TEXT,
+    start_ts REAL,
+    dur_s REAL,
+    status TEXT,
+    attrs_json TEXT,
+    request_id TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_spans_trace ON spans (trace_id);
+CREATE INDEX IF NOT EXISTS idx_spans_request ON spans (request_id);
+CREATE INDEX IF NOT EXISTS idx_spans_start ON spans (start_ts);
+"""
+
+
+class SpanStore:
+    def __init__(self, db_path: Optional[str] = None):
+        self.db_path = db_path or os.path.join(common.base_dir(),
+                                               'traces.db')
+
+    @property
+    def _conn(self):
+        return db_util.get_db(self.db_path, _SCHEMA).conn
+
+    def add_spans(self, spans: List[Dict[str, Any]]) -> int:
+        rows = []
+        for s in spans:
+            attrs = s.get('attrs') or {}
+            if not isinstance(attrs, dict):
+                attrs = {}
+            attrs_json = json.dumps(attrs, default=str)
+            # Attr payloads are caller-controlled (and the collector
+            # endpoint is unauthenticated): bound bytes per span so the
+            # store's GC row cap is also, in effect, a byte cap.
+            if len(attrs_json) > 8192:
+                attrs_json = json.dumps(
+                    {'_truncated': True,
+                     'request_id': attrs.get('request_id')})
+            rows.append((
+                str(s['trace_id'])[:64], str(s['span_id'])[:64],
+                (str(s['parent_id'])[:64]
+                 if s.get('parent_id') is not None else None),
+                str(s.get('name', ''))[:256], str(s.get('hop', ''))[:64],
+                float(s.get('start', 0.0)), float(s.get('dur_s', 0.0)),
+                str(s.get('status', 'ok'))[:128], attrs_json,
+                (str(attrs['request_id'])[:64]
+                 if attrs.get('request_id') is not None else None),
+            ))
+        if not rows:
+            return 0
+        self._conn.executemany(
+            'INSERT INTO spans (trace_id, span_id, parent_id, name, hop,'
+            ' start_ts, dur_s, status, attrs_json, request_id) '
+            'VALUES (?,?,?,?,?,?,?,?,?,?)', rows)
+        self._conn.commit()
+        return len(rows)
+
+    @staticmethod
+    def _row_to_span(row) -> Dict[str, Any]:
+        d = dict(row)
+        d['attrs'] = json.loads(d.pop('attrs_json') or '{}')
+        d['start'] = d.pop('start_ts')
+        return d
+
+    def get_trace(self, trace_id: str) -> List[Dict[str, Any]]:
+        rows = self._conn.execute(
+            'SELECT * FROM spans WHERE trace_id=? ORDER BY start_ts',
+            (trace_id,)).fetchall()
+        return [self._row_to_span(r) for r in rows]
+
+    def trace_id_for_request(self, request_id: str) -> Optional[str]:
+        row = self._conn.execute(
+            'SELECT trace_id FROM spans WHERE request_id=? '
+            'ORDER BY start_ts LIMIT 1', (request_id,)).fetchone()
+        return row['trace_id'] if row else None
+
+    def trace_for_request(self, request_id: str) -> List[Dict[str, Any]]:
+        trace_id = self.trace_id_for_request(request_id)
+        if trace_id is None:
+            return []
+        return self.get_trace(trace_id)
+
+    def list_traces(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Most-recent-first trace summaries (for `sky-tpu trace` with
+        no argument / the API listing)."""
+        rows = self._conn.execute(
+            'SELECT trace_id, MIN(start_ts) AS start_ts,'
+            ' COUNT(*) AS n_spans, MAX(request_id) AS request_id '
+            'FROM spans GROUP BY trace_id '
+            'ORDER BY start_ts DESC LIMIT ?', (limit,)).fetchall()
+        out = []
+        for r in rows:
+            d = dict(r)
+            root = self._conn.execute(
+                'SELECT name FROM spans WHERE trace_id=? AND '
+                'parent_id IS NULL ORDER BY start_ts LIMIT 1',
+                (d['trace_id'],)).fetchone()
+            d['root'] = root['name'] if root else None
+            out.append(d)
+        return out
+
+    def count(self) -> int:
+        return self._conn.execute(
+            'SELECT COUNT(*) AS n FROM spans').fetchone()['n']
+
+    def gc(self, max_spans: Optional[int] = None) -> int:
+        """Drop oldest whole traces until the row count fits the cap.
+        Returns rows deleted.
+
+        Set-based: one aggregate scan picks the oldest traces whose
+        removal brings the store under cap, one DELETE drops them — a
+        per-trace loop would re-COUNT the full table thousands of
+        times when small SDK traces pushed it over cap."""
+        if max_spans is None:
+            max_spans = int(os.environ.get(MAX_SPANS_ENV,
+                                           DEFAULT_MAX_SPANS))
+        excess = self.count() - max_spans
+        if excess <= 0:
+            return 0
+        rows = self._conn.execute(
+            'SELECT trace_id, COUNT(*) AS n FROM spans '
+            'GROUP BY trace_id ORDER BY MIN(start_ts)').fetchall()
+        victims = []
+        for r in rows:
+            if excess <= 0:
+                break
+            victims.append(r['trace_id'])
+            excess -= r['n']
+        if not victims:
+            return 0
+        marks = ','.join('?' for _ in victims)
+        cur = self._conn.execute(
+            f'DELETE FROM spans WHERE trace_id IN ({marks})',
+            tuple(victims))
+        self._conn.commit()
+        return cur.rowcount
+
+
+_ingest_count = 0
+
+# Spans can arrive over the auth-exempt collector endpoint: label
+# values fed to Prometheus must not be able to corrupt the exposition
+# format (quotes/newlines) or carry unbounded payloads.
+_LABEL_RE = re.compile(r'[^A-Za-z0-9_.:/\-]')
+
+
+def _label(value: Any) -> str:
+    return _LABEL_RE.sub('_', str(value))[:64]
+
+
+def ingest(spans: List[Dict[str, Any]],
+           store: Optional[SpanStore] = None) -> int:
+    """The one write path for shipped spans: persist, feed the metrics
+    registry, and GC occasionally. Used directly by the API server's
+    sink and by its POST /api/traces handler."""
+    global _ingest_count
+    if not spans:
+        return 0
+    store = store or SpanStore()
+    n = store.add_spans(spans)
+    from skypilot_tpu.server import metrics as metrics_lib
+    for s in spans:
+        try:
+            metrics_lib.observe_span(_label(s.get('name', '')),
+                                     _label(s.get('hop', '')),
+                                     float(s.get('dur_s', 0.0)))
+        except Exception:  # noqa: BLE001 — telemetry must not throw
+            pass
+    _ingest_count += 1
+    # Amortized GC: the cap check is a COUNT(*) — cheap, but not free on
+    # every batch.
+    if _ingest_count % 20 == 0:
+        store.gc()
+    return n

@@ -88,3 +88,21 @@ class ProvisionConfig:
     # volumes; the TPU API only attaches data disks at creation).
     data_disks: List[str] = dataclasses.field(default_factory=list)
     provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def agent_stop_snippet(pidfile: str) -> str:
+    """Shell fragment that stops a running agent recorded in `pidfile`
+    (and clears the pidfile), for bootstrap commands that must force an
+    agent restart — e.g. the TLS upgrade path, where a freshly minted
+    cluster cert is useless while a pre-TLS agent keeps serving plain
+    HTTP behind the idempotence guard. /proc cmdline-checked so a
+    recycled pid belonging to an unrelated process is never signalled.
+    """
+    return (
+        f'AP="$(cat {pidfile} 2>/dev/null)"; '
+        f'if grep -q runtime.agent "/proc/$AP/cmdline" 2>/dev/null; '
+        f'then kill "$AP" 2>/dev/null; '
+        f'for i in 1 2 3 4 5 6 7 8 9 10; do '
+        f'kill -0 "$AP" 2>/dev/null || break; sleep 0.2; done; '
+        f'kill -9 "$AP" 2>/dev/null; fi; '
+        f'rm -f {pidfile}; ')

@@ -90,9 +90,15 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
     # Cluster TLS pair (utils/tls.py): the agent serves HTTPS and
     # clients pin the cert fingerprint, so the bearer token never rides
     # the VPC in clear. Lives in provider_config like the token so
-    # status refreshes preserve it.
+    # status refreshes preserve it. A pair minted HERE (fresh cluster
+    # or pre-TLS re-provision) only takes effect when the agents
+    # (re)start with it — _install_agents must not let the pidfile
+    # guard keep a plain-HTTP agent alive behind an https:// URL.
+    had_cert = bool(config.provider_config.get('agent_tls_cert'))
     tls.ensure_cluster_cert(config.provider_config,
                             config.cluster_name)
+    cert_minted = (not had_cert and
+                   bool(config.provider_config.get('agent_tls_cert')))
     s = topology.parse_tpu(config.tpu_slice)
     runtime_version = (config.runtime_version or
                        DEFAULT_RUNTIME_VERSIONS[s.generation])
@@ -132,7 +138,7 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         _rollback_created(client, config.zone, created)
         raise exceptions.ProvisionError(
             f'TPU node {config.cluster_name} vanished after create')
-    _install_agents(info, config)
+    _install_agents(info, config, force_restart=cert_minted)
     return info
 
 
@@ -160,15 +166,20 @@ def _rollback_created(client: 'tpu_api.TpuApiClient', zone: str,
                     time_lib.sleep(10 * (attempt + 1))
 
 
-def _install_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
+def _install_agents(info: ClusterInfo, config: ProvisionConfig,
+                    force_restart: bool = False) -> None:
     """Push per-host agent config + the framework itself, start agents.
 
     Host 0 is head; its agent fans out to the peers' /run_rank. Runs over
-    SSH (the TPU VM's metadata-managed keys).
+    SSH (the TPU VM's metadata-managed keys). ``force_restart`` stops a
+    running agent first (TLS upgrade: the new cert needs a restart).
     """
     import json
 
+    from skypilot_tpu.provision import common as provision_common
     from skypilot_tpu.utils import command_runner
+    stop_snippet = (provision_common.agent_stop_snippet(
+        '/opt/sky_tpu/agent.pid') if force_restart else '')
     ssh_user = config.provider_config.get('ssh_user', 'sky')
     key = config.provider_config.get('ssh_key', '~/.sky_tpu/keys/sky-key')
     internal_ips = [h.internal_ip for h in info.hosts]
@@ -195,6 +206,10 @@ def _install_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
             ] if rank == 0 else [],
             'provider_config': dict(config.provider_config),
         }
+        # Distributed tracing reaches remote agents through their
+        # config, not the provisioner's environment.
+        from skypilot_tpu.observability import trace as trace_lib
+        agent_config.update(trace_lib.agent_trace_config())
         runner = command_runner.SSHCommandRunner(
             host.external_ip or host.internal_ip, user=ssh_user,
             key_path=key)
@@ -210,6 +225,7 @@ def _install_agents(info: ClusterInfo, config: ProvisionConfig) -> None:
             f"echo '{cfg_json}' > {AGENT_CLUSTER_DIR}/agent_config.json && "
             f"(python3 -m pip show skypilot-tpu >/dev/null 2>&1 || "
             f"python3 -m pip install -q skypilot-tpu || true) && "
+            f'{stop_snippet}'
             f'AP="$(cat /opt/sky_tpu/agent.pid 2>/dev/null)"; '
             f'if ! {{ kill -0 "$AP" 2>/dev/null && '
             f'grep -q runtime.agent "/proc/$AP/cmdline" 2>/dev/null; }}; '

@@ -80,8 +80,15 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
     # Cluster TLS pair: generated once, reused across idempotent
     # re-provisions (a rotation would invalidate the live agent's pin
     # mid-flight); rides meta.json → agent_config.json like the token.
+    # A pair minted HERE over a pre-TLS cluster must restart the live
+    # plain-HTTP agent (same TLS upgrade path as the ssh/gcp
+    # providers), or the reported https URL would point at it.
+    had_cert = bool((prev or {}).get('tls_cert_pem') and
+                    (prev or {}).get('tls_key_pem'))
     cert_pem, key_pem = tls.ensure_cluster_cert(
         prev or {}, config.cluster_name, 'tls_cert_pem', 'tls_key_pem')
+    if prev is not None and bool(cert_pem) and not had_cert:
+        _kill_agent(cdir)
     meta = {
         'cluster_name': config.cluster_name,
         'region': config.region,

@@ -163,8 +163,8 @@ def _submit(config: ProvisionConfig, cdir: str) -> str:
               encoding='utf-8') as f:
         f.write(_node_script(cdir, config.cluster_name, config.tpu_slice,
                              config.provider_config['agent_token'],
-                             config.provider_config['agent_tls_cert'],
-                             config.provider_config['agent_tls_key']))
+                             config.provider_config.get('agent_tls_cert'),
+                             config.provider_config.get('agent_tls_key')))
     os.chmod(os.path.join(cdir, 'node_start.sh'), 0o700)
     sbatch_path = os.path.join(cdir, 'job.sbatch')
     with open(sbatch_path, 'w', encoding='utf-8') as f:

@@ -85,8 +85,15 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
              secrets.token_hex(16))
     # Cluster TLS pair (utils/tls.py): generated once per cluster,
     # reused on idempotent re-provision so live agents keep their pin.
+    # When the pair is MINTED here (fresh cluster, or a pre-TLS cluster
+    # being re-provisioned), any already-running agent is still serving
+    # plain HTTP — the bootstrap must restart it, or the https:// URLs
+    # this provision reports would point at live plain-HTTP agents.
+    had_cert = bool(prev_meta.get('tls_cert_pem') and
+                    prev_meta.get('tls_key_pem'))
     cert_pem, key_pem = tls.ensure_cluster_cert(
         prev_meta, config.cluster_name, 'tls_cert_pem', 'tls_key_pem')
+    cert_minted = bool(cert_pem) and not had_cert
     mode = pool.get('mode', 'ssh')
     if mode == 'process':
         # Delegate host simulation to the local provider, then overlay
@@ -124,7 +131,8 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
         raise exceptions.ProvisionError(
             f'[ssh] pool {pool["name"]!r} hosts unreachable: {dead}',
             retryable=True)
-    _bootstrap_agent(config.cluster_name, pool, token, cert_pem, key_pem)
+    _bootstrap_agent(config.cluster_name, pool, token, cert_pem, key_pem,
+                     force_restart=cert_minted)
     meta = {
         'cluster_name': config.cluster_name,
         'region': pool.get('region', 'pool'),
@@ -147,14 +155,22 @@ def run_instances(config: ProvisionConfig) -> ClusterInfo:
 
 def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any],
                      token: str, cert_pem: Optional[str] = None,
-                     key_pem: Optional[str] = None) -> None:
+                     key_pem: Optional[str] = None,
+                     force_restart: bool = False) -> None:
     """Push the framework + start an agent on EVERY host (mirrors the GCP
     provider's _install_agents: head's agent fans job ranks out to peers'
-    /run_rank, so each host needs a listening agent)."""
+    /run_rank, so each host needs a listening agent).
+
+    ``force_restart`` kills a running agent before the idempotence
+    guard: used when the serving scheme changes under it (TLS upgrade —
+    a freshly minted cert only takes effect on restart)."""
     import skypilot_tpu
+    from skypilot_tpu.provision import common as provision_common
     pkg_root = os.path.dirname(os.path.dirname(
         os.path.abspath(skypilot_tpu.__file__)))
     hosts = list(pool['hosts'])
+    stop_snippet = (provision_common.agent_stop_snippet(
+        f'{AGENT_DIR}/agent.pid') if force_restart else '')
     for rank, host in enumerate(hosts):
         runner = _runner_for(host, pool)
         runner.run(f'sudo mkdir -p {AGENT_DIR} && sudo chown -R '
@@ -181,6 +197,10 @@ def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any],
                                 'ssh_user': pool['user'],
                                 'ssh_key': pool.get('identity_file')},
         }
+        # Distributed tracing reaches remote agents through their
+        # config, not the provisioner's environment.
+        from skypilot_tpu.observability import trace as trace_lib
+        agent_config.update(trace_lib.agent_trace_config())
         cfg_json = json.dumps(agent_config).replace("'", "'\\''")
         # Idempotence probe via pidfile, NOT pgrep: the remote shell's
         # own cmdline contains the agent start text, so any
@@ -191,6 +211,7 @@ def _bootstrap_agent(cluster_name: str, pool: Dict[str, Any],
         # process would otherwise suppress the restart forever).
         runner.run(
             f"echo '{cfg_json}' > {AGENT_DIR}/agent_config.json && "
+            f'{stop_snippet}'
             f'AP="$(cat {AGENT_DIR}/agent.pid 2>/dev/null)"; '
             f'if ! {{ kill -0 "$AP" 2>/dev/null && '
             f'grep -q runtime.agent "/proc/$AP/cmdline" 2>/dev/null; }}; '

@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional
 from aiohttp import web
 
 from skypilot_tpu import topology
+from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.runtime import distributed_env
 from skypilot_tpu.runtime import job_lib
 from skypilot_tpu.utils import common
@@ -48,10 +49,28 @@ AUTOSTOP_CHECK_INTERVAL = 5.0
 
 class Agent:
     def __init__(self, cluster_dir: str):
+        # A provision-time trace context inherited from the spawning
+        # provisioner must not become the parent of every span this
+        # long-lived daemon ever records — context arrives per request
+        # (traceparent header) or per job (SKY_TPU_TRACEPARENT in the
+        # job's envs), never from the daemon's own environment.
+        os.environ.pop(trace_lib.CTX_ENV_VAR, None)
+        trace_lib.set_hop('agent')
         self.cluster_dir = os.path.abspath(cluster_dir)
         with open(os.path.join(self.cluster_dir, 'agent_config.json'),
                   encoding='utf-8') as f:
             self.config: Dict[str, Any] = json.load(f)
+        # Tracing config rides agent_config.json for real (remote)
+        # hosts, where the provisioner's environment does not reach:
+        # `trace_enabled` turns span recording on, `trace_collector`
+        # names the URL spans ship to (the API server as seen FROM the
+        # cluster). On the local fake slice the inherited env already
+        # carries both.
+        if self.config.get('trace_enabled'):
+            os.environ.setdefault(trace_lib.ENV_VAR, '1')
+        if self.config.get('trace_collector'):
+            os.environ.setdefault(trace_lib.COLLECTOR_ENV_VAR,
+                                  str(self.config['trace_collector']))
         self.mode: str = self.config.get('mode', 'local-slice')
         self.host_rank: int = int(self.config.get('host_rank', 0))
         self.host_ips: List[str] = self.config.get('host_ips', ['127.0.0.1'])
@@ -251,18 +270,32 @@ class Agent:
         job_id = job['job_id']
         log_dir = job['log_dir']
         os.makedirs(log_dir, exist_ok=True)
+        # Re-adopt the submitting request's trace context (persisted in
+        # the job envs by h_submit) — the job-runtime hop of the trace.
+        trace_ctx = trace_lib.context_from(
+            (job['envs'] or {}).get(trace_lib.CTX_ENV_VAR))
         try:
-            if job['setup_cmd']:
-                self.jobs.set_status(job_id, job_lib.JobStatus.SETTING_UP)
-                rcs = await self._fan_out(job_id, job['setup_cmd'],
-                                          job['envs'], log_dir, 'setup')
-                if any(rc != 0 for rc in rcs):
+            with trace_ctx:
+                if job['setup_cmd']:
                     self.jobs.set_status(job_id,
-                                         job_lib.JobStatus.FAILED_SETUP)
-                    return
-            self.jobs.set_status(job_id, job_lib.JobStatus.RUNNING)
-            rcs = await self._fan_out(job_id, job['run_cmd'], job['envs'],
-                                      log_dir, 'run')
+                                         job_lib.JobStatus.SETTING_UP)
+                    with trace_lib.span('job.setup', job_id=job_id):
+                        rcs = await self._fan_out(job_id,
+                                                  job['setup_cmd'],
+                                                  job['envs'], log_dir,
+                                                  'setup')
+                    if any(rc != 0 for rc in rcs):
+                        self.jobs.set_status(
+                            job_id, job_lib.JobStatus.FAILED_SETUP)
+                        return
+                self.jobs.set_status(job_id, job_lib.JobStatus.RUNNING)
+                with trace_lib.span('job.run', job_id=job_id,
+                                    hosts=self.num_hosts *
+                                    self.num_slices) as jspan:
+                    rcs = await self._fan_out(job_id, job['run_cmd'],
+                                              job['envs'], log_dir, 'run')
+                    if jspan is not None:
+                        jspan.set_attr('returncodes', rcs)
             if job_id in self._cancelled:
                 self.jobs.set_status(job_id, job_lib.JobStatus.CANCELLED)
             elif all(rc == 0 for rc in rcs):
@@ -277,6 +310,9 @@ class Agent:
         finally:
             procs = self._procs.pop(job_id, None) or []
             self._prune_pgids(p.pid for p in procs)
+            if trace_lib.enabled():
+                await asyncio.get_event_loop().run_in_executor(
+                    None, trace_lib.flush)
 
     async def _fan_out(self, job_id: int, cmd: str, envs: Dict[str, str],
                        log_dir: str, phase: str) -> List[int]:
@@ -491,11 +527,17 @@ class Agent:
     async def h_submit(self, req: web.Request) -> web.Response:
         body = await req.json()
         log_dir = os.path.join(self.cluster_dir, 'job_logs')
+        envs = dict(body.get('envs', {}))
+        # Job execution is async (the scheduler loop picks it up later):
+        # persist the submit's trace context in the job's envs so the
+        # runtime spans (job.setup/job.run) — and the rank processes,
+        # which inherit the env — parent to this submission.
+        trace_lib.child_env(envs)
         job_id = self.jobs.add_job(
             name=body.get('name', 'job'),
             run_cmd=body['run'],
             setup_cmd=body.get('setup'),
-            envs=body.get('envs', {}),
+            envs=envs,
             num_hosts=self.num_hosts * self.num_slices,
             log_dir='')
         log_dir = os.path.join(log_dir, str(job_id))
@@ -635,6 +677,30 @@ class Agent:
 
     def make_app(self) -> web.Application:
         @web.middleware
+        async def _trace(request: web.Request, handler):
+            # Mutating endpoints get an agent-hop span parented to the
+            # caller's traceparent header. GET/stream endpoints (log
+            # tails can live for a job's whole runtime) stay untraced.
+            if not trace_lib.enabled() or request.method != 'POST':
+                return await handler(request)
+            # Span names use the ROUTE TEMPLATE ('/cancel/{job_id}'),
+            # not the raw path — per-id names would mint a metric label
+            # per job and exhaust the server's label-cardinality cap.
+            try:
+                name = request.match_info.route.resource.canonical
+            except AttributeError:
+                name = request.path
+            with trace_lib.context_from(
+                    request.headers.get(trace_lib.HEADER)), \
+                    trace_lib.span(f'agent.{name}'):
+                resp = await handler(request)
+            # Ship promptly (local store or the API server's collector);
+            # off-loop: flush may do file/HTTP IO.
+            await asyncio.get_event_loop().run_in_executor(
+                None, trace_lib.flush)
+            return resp
+
+        @web.middleware
         async def _auth(request: web.Request, handler):
             if request.path == '/health':
                 return await handler(request)
@@ -654,7 +720,7 @@ class Agent:
                                          status=403)
             return await handler(request)
 
-        app = web.Application(middlewares=[_auth])
+        app = web.Application(middlewares=[_auth, _trace])
         app.router.add_get('/health', self.h_health)
         app.router.add_post('/submit', self.h_submit)
         app.router.add_get('/jobs', self.h_jobs)

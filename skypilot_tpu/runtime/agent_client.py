@@ -14,6 +14,7 @@ from typing import Any, Dict, Iterator, List, Optional
 import requests
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.utils import common
 from skypilot_tpu.utils import tls
 
@@ -45,8 +46,12 @@ class AgentClient:
                        'agent_cert_fingerprint'))
 
     def _headers(self) -> dict:
-        return ({'Authorization': f'Bearer {self.token}'}
-                if self.token else {})
+        headers = ({'Authorization': f'Bearer {self.token}'}
+                   if self.token else {})
+        # Trace context crosses the pinned agent channel as the same
+        # traceparent header the API server consumed (no-op when
+        # tracing is off).
+        return trace_lib.inject_headers(headers)
 
     def wait_healthy(self, timeout: Optional[float] = None
                      ) -> Dict[str, Any]:
@@ -57,14 +62,15 @@ class AgentClient:
             timeout = float(os.environ.get('SKY_TPU_AGENT_WAIT_S', '60'))
         deadline = time.time() + timeout
         last_err: Optional[Exception] = None
-        while time.time() < deadline:
-            try:
-                r = self._session.get(f'{self.url}/health', timeout=5)
-                if r.ok:
-                    return r.json()
-            except requests.RequestException as e:
-                last_err = e
-            time.sleep(0.5)
+        with trace_lib.span('agent_client.wait_healthy', url=self.url):
+            while time.time() < deadline:
+                try:
+                    r = self._session.get(f'{self.url}/health', timeout=5)
+                    if r.ok:
+                        return r.json()
+                except requests.RequestException as e:
+                    last_err = e
+                time.sleep(0.5)
         raise exceptions.ClusterNotUpError(
             f'Agent at {self.url} not healthy after {timeout}s: {last_err}')
 
@@ -75,11 +81,13 @@ class AgentClient:
 
     def submit(self, name: str, run: str, setup: Optional[str] = None,
                envs: Optional[Dict[str, str]] = None) -> int:
-        r = self._session.post(f'{self.url}/submit', json={
-            'name': name, 'run': run, 'setup': setup, 'envs': envs or {},
-        }, headers=self._headers(), timeout=self.timeout)
-        r.raise_for_status()
-        return int(r.json()['job_id'])
+        with trace_lib.span('agent_client.submit', job=name):
+            r = self._session.post(f'{self.url}/submit', json={
+                'name': name, 'run': run, 'setup': setup,
+                'envs': envs or {},
+            }, headers=self._headers(), timeout=self.timeout)
+            r.raise_for_status()
+            return int(r.json()['job_id'])
 
     def job_status(self, job_id: int) -> common.JobStatus:
         r = self._session.get(f'{self.url}/jobs/{job_id}',
@@ -105,11 +113,12 @@ class AgentClient:
     def exec_sync(self, cmd: str,
                   envs: Optional[Dict[str, str]] = None,
                   timeout: float = 600.0) -> Dict[str, Any]:
-        r = self._session.post(f'{self.url}/exec',
-                          json={'cmd': cmd, 'envs': envs or {}},
-                          headers=self._headers(), timeout=timeout)
-        r.raise_for_status()
-        return r.json()
+        with trace_lib.span('agent_client.exec'):
+            r = self._session.post(f'{self.url}/exec',
+                              json={'cmd': cmd, 'envs': envs or {}},
+                              headers=self._headers(), timeout=timeout)
+            r.raise_for_status()
+            return r.json()
 
     def tail_logs(self, job_id: int, *, follow: bool = True,
                   rank: int = 0) -> Iterator[bytes]:

@@ -20,6 +20,7 @@ from typing import Dict, Optional
 import aiohttp
 from aiohttp import web
 
+from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.serve import load_balancing_policies as lbp
 from skypilot_tpu.serve import state as serve_state
 
@@ -133,10 +134,24 @@ class LoadBalancer:
         t_arrival = time.monotonic()
         self.policy.pre_execute(url)
         resp: Optional[web.StreamResponse] = None
+        # LB → replica is a traced hop: adopt the caller's context (if
+        # any) and pass ours downstream, so serve-path TTFT decomposes
+        # into LB time vs replica time. Span recording closes with the
+        # proxied response (stack.close() in the finally); the proxy
+        # loop stays allocation-free when tracing is off.
+        stack = contextlib.ExitStack()
         try:
             target = url.rstrip('/') + request.path_qs
             headers = {k: v for k, v in request.headers.items()
                        if k.lower() not in _HOP_HEADERS}
+            if trace_lib.enabled():
+                with contextlib.suppress(Exception):
+                    stack.enter_context(trace_lib.context_from(
+                        request.headers.get(trace_lib.HEADER)))
+                    stack.enter_context(trace_lib.span(
+                        'lb.proxy', hop='serve-lb', replica=url,
+                        path=request.path))
+                    trace_lib.inject_headers(headers)
             body = await request.read()
             assert self._session is not None
             async with self._session.request(
@@ -179,6 +194,8 @@ class LoadBalancer:
                 status=502,
                 text=f'Replica {url} failed: {type(e).__name__}: {e}\n')
         finally:
+            with contextlib.suppress(Exception):
+                stack.close()
             self._inflight -= 1
             self.policy.post_execute(url)
 

@@ -40,6 +40,7 @@ from aiohttp import web
 
 from skypilot_tpu import core
 from skypilot_tpu import exceptions
+from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.server import metrics as metrics_lib
 from skypilot_tpu.server import ops as ops_lib
 from skypilot_tpu.server.requests_store import RequestStatus, RequestStore
@@ -114,6 +115,14 @@ class Server:
         base = o2_lib.proxy_base_url()
         self.oauth2 = (o2_lib.OAuth2ProxyAuthenticator(base)
                        if base else None)
+        # Distributed tracing (observability/): the server is the span
+        # collector, so its own spans sink straight into the store
+        # (never HTTP-to-self). Shipped spans from other hops land via
+        # POST /api/traces on the same ingest path.
+        if trace_lib.enabled():
+            from skypilot_tpu.observability import store as span_store
+            trace_lib.set_hop('server')
+            trace_lib.set_sink(span_store.ingest)
 
     # ---- request execution ---------------------------------------------
     def _run_request(self, request_id: str, fn: Callable[[], Any]) -> None:
@@ -129,7 +138,14 @@ class Server:
                 self._stdout_router.register(logf)
                 self._stderr_router.register(logf)
                 try:
-                    result = fn()
+                    # Short-lane execution span, parented to the submit
+                    # span via the payload handoff (executor threads do
+                    # not inherit the handler's contextvars).
+                    with trace_lib.context_from(
+                            req['payload'].get(trace_lib.PAYLOAD_KEY)), \
+                            trace_lib.span(f'request.{req["name"]}',
+                                           request_id=request_id):
+                        result = fn()
                 finally:
                     self._stdout_router.unregister()
                     self._stderr_router.unregister()
@@ -201,6 +217,19 @@ class Server:
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
             return web.json_response(
                 {'error': f'malformed JSON body: {e}'}, status=400)
+        # Trace context: adopt the caller's traceparent header (SDK/CLI
+        # root span) and record the admission as the server hop's span.
+        # The context is stamped into the payload so the executing side
+        # (short-lane thread or detached worker subprocess, which
+        # re-reads the persisted row) parents correctly.
+        with trace_lib.context_from(req.headers.get(trace_lib.HEADER)), \
+                trace_lib.span(f'server.{name}') as tspan:
+            trace_lib.inject_payload(payload)
+            return await self._h_op_inner(name, req, payload, tspan)
+
+    async def _h_op_inner(self, name: str, req: web.Request,
+                          payload: Dict[str, Any],
+                          tspan) -> web.Response:
         # The caller's resolved identity gates self-service ops AND the
         # private-workspace check in execution.launch: launch workers run
         # as the server's OS user, so without this every remote caller
@@ -227,12 +256,16 @@ class Server:
         if name in SYNC_OPS:
             loop = asyncio.get_event_loop()
             try:
-                result = await loop.run_in_executor(self.short_pool, fn)
+                # bind: executor threads do not inherit contextvars.
+                result = await loop.run_in_executor(self.short_pool,
+                                                    trace_lib.bind(fn))
             except exceptions.SkyTpuError as e:
                 return web.json_response(
                     {'error': f'{type(e).__name__}: {e}'}, status=403)
             return web.json_response({'result': result})
         request_id = self.submit(name, payload, fn)
+        if tspan is not None:
+            tspan.set_attr('request_id', request_id)
         return web.json_response({'request_id': request_id})
 
     async def h_get(self, req: web.Request) -> web.Response:
@@ -555,6 +588,95 @@ class Server:
         return web.Response(text=metrics_lib.render(),
                             content_type='text/plain')
 
+    # ---- distributed tracing (observability/) ---------------------------
+    async def h_traces_ingest(self, req: web.Request) -> web.Response:
+        """Span collector: remote hops (SDK, workers, agents, the serve
+        LB) ship finished spans here. Telemetry-write-only and
+        fail-open by contract — shippers drop on any error, so this
+        endpoint is auth-exempt like /metrics (agents hold cluster
+        tokens, not API bearer tokens)."""
+        # Byte cap FIRST: this endpoint is unauthenticated, so the
+        # app-wide 64MB body limit (sized for task-config ops) must not
+        # apply — one oversized attrs blob per request would grow
+        # traces.db without bound (row-count GC does not cap bytes).
+        # A declared length is REQUIRED: chunked bodies would bypass
+        # the cap (content_length None), and every real shipper
+        # (requests json=) sends Content-Length.
+        if req.content_length is None:
+            return web.json_response(
+                {'error': 'span batch requires Content-Length'},
+                status=411)
+        if req.content_length > 4 * 1024 * 1024:
+            return web.json_response({'error': 'span batch too large'},
+                                     status=413)
+        try:
+            body = await req.json()
+        except Exception:  # noqa: BLE001 — malformed telemetry: reject
+            body = None
+        spans = body.get('spans') if isinstance(body, dict) else None
+        if not isinstance(spans, list):
+            return web.json_response({'error': 'malformed span batch'},
+                                     status=400)
+
+        def well_formed(s) -> bool:
+            # Ids are bounded too — the store's per-field caps do not
+            # cover them, and an unauthenticated multi-MB "id" is just
+            # a disk-filler.
+            return (isinstance(s, dict) and
+                    isinstance(s.get('trace_id'), str) and
+                    0 < len(s['trace_id']) <= 64 and
+                    isinstance(s.get('span_id'), str) and
+                    0 < len(s['span_id']) <= 64 and
+                    (s.get('parent_id') is None or
+                     (isinstance(s['parent_id'], str) and
+                      len(s['parent_id']) <= 64)) and
+                    isinstance(s.get('start', 0.0), (int, float)) and
+                    isinstance(s.get('dur_s', 0.0), (int, float)) and
+                    isinstance(s.get('attrs', {}), dict))
+
+        # Batch cap: one runaway shipper must not stall the event loop
+        # or blow the store; the GC bounds total size regardless. Only
+        # well-formed span dicts survive (a junk element is dropped
+        # here, not 500'd inside the store taking the batch with it).
+        spans = [s for s in spans[:5000] if well_formed(s)]
+
+        def ingest():
+            from skypilot_tpu.observability import store as span_store
+            return span_store.ingest(spans)
+
+        n = await asyncio.get_event_loop().run_in_executor(
+            self.short_pool, ingest)
+        return web.json_response({'ingested': n})
+
+    async def h_trace_get(self, req: web.Request) -> web.Response:
+        """Span tree for one request id (or raw trace id)."""
+        key = req.match_info['key']
+
+        def read():
+            from skypilot_tpu.observability import store as span_store
+            st = span_store.SpanStore()
+            spans = st.trace_for_request(key)
+            if not spans:
+                spans = st.get_trace(key)
+            return spans
+
+        spans = await asyncio.get_event_loop().run_in_executor(
+            self.short_pool, read)
+        if not spans:
+            return web.json_response(
+                {'error': f'no trace recorded for {key!r}'}, status=404)
+        return web.json_response({'trace_id': spans[0]['trace_id'],
+                                  'spans': spans})
+
+    async def h_traces_list(self, _req: web.Request) -> web.Response:
+        def read():
+            from skypilot_tpu.observability import store as span_store
+            return span_store.SpanStore().list_traces()
+
+        traces = await asyncio.get_event_loop().run_in_executor(
+            self.short_pool, read)
+        return web.json_response({'traces': traces})
+
     # ---- auth / RBAC middleware -----------------------------------------
     @staticmethod
     @web.middleware
@@ -573,7 +695,20 @@ class Server:
         from skypilot_tpu.users import rbac
         if (req.path in ('/api/health', '/metrics', '/', '/dashboard',
                          '/auth/token') or
+                (req.path == '/api/traces' and req.method == 'POST' and
+                 not config_lib.get_nested(
+                     ('api_server', 'require_auth'), False)) or
                 req.path.startswith(('/oauth2/', '/static/'))):
+            # POST /api/traces is the span collector — telemetry from
+            # agents/workers that hold cluster tokens, not API bearer
+            # tokens. Write-only, size-capped and GC-bounded; open only
+            # in single-user/loopback mode: under require_auth it needs
+            # a bearer token like any other write (a network peer must
+            # not be able to GC-evict real traces or pollute span
+            # metrics on a locked-down server). Shippers are fail-open
+            # — workers on the server host fall back to writing the
+            # store directly; remote agents drop unless the operator
+            # provisions a collector credential path.
             # /static/: the dashboard's ES modules — the browser cannot
             # attach a bearer header to <script type=module> fetches,
             # and the assets are public code, not data.
@@ -779,6 +914,9 @@ run <code>sky-tpu api login</code>, close this page.</p>
         app.router.add_get('/', self.h_dashboard)
         app.router.add_get('/static/{path:.+}', self.h_static)
         app.router.add_get('/metrics', self.h_metrics)
+        app.router.add_post('/api/traces', self.h_traces_ingest)
+        app.router.add_get('/api/traces', self.h_traces_list)
+        app.router.add_get('/api/traces/{key}', self.h_trace_get)
         app.router.add_get('/api/requests', self.h_requests)
         app.router.add_get('/api/get/{request_id}', self.h_get)
         app.router.add_post('/api/cancel/{request_id}',

@@ -10,6 +10,8 @@ scrape. Tracked, mirroring the reference:
 - ``sky_tpu_request_duration_seconds{op}`` — histogram.
 - ``sky_tpu_requests_in_flight`` — gauge.
 - ``sky_tpu_process_*`` — RSS / cpu seconds / uptime.
+- ``sky_tpu_span_duration_seconds{op,hop}`` — per-hop latency derived
+  from ingested trace spans (observability/).
 """
 from __future__ import annotations
 
@@ -119,6 +121,7 @@ class _Registry:
 _LABEL_NAMES = {
     'sky_tpu_requests_total': ('op', 'status'),
     'sky_tpu_request_duration_seconds': ('op',),
+    'sky_tpu_span_duration_seconds': ('op', 'hop'),
 }
 
 registry = _Registry()
@@ -132,6 +135,26 @@ def observe_request(op: str, status: str, duration_s: float) -> None:
 
 def inflight(delta: int) -> None:
     registry.gauge_add('sky_tpu_requests_in_flight', delta)
+
+
+# Spans arrive over an auth-exempt collector endpoint, so label values
+# are attacker-influencable: cap the live (op,hop) label set, bucketing
+# the overflow — unbounded label cardinality is a classic Prometheus
+# memory leak.
+_MAX_SPAN_LABEL_SETS = 256
+_span_label_sets: set = set()
+
+
+def observe_span(op: str, hop: str, duration_s: float) -> None:
+    """Per-hop span latency, derived from every span the trace
+    subsystem ingests on this server (observability/store.ingest) —
+    the Prometheus view of the same data `sky-tpu trace` renders."""
+    key = (op, hop)
+    if key not in _span_label_sets:
+        if len(_span_label_sets) >= _MAX_SPAN_LABEL_SETS:
+            key = ('_other', '_other')
+        _span_label_sets.add(key)
+    registry.observe('sky_tpu_span_duration_seconds', duration_s, key)
 
 
 def render() -> str:

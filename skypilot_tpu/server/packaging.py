@@ -460,6 +460,76 @@ def render_grafana_datasource(namespace: str = 'sky-tpu'
     }
 
 
+def _grafana_panel(panel_id: int, title: str, expr: str,
+                   legend: str, y: int, x: int = 0,
+                   unit: str = 'short') -> Dict[str, Any]:
+    return {
+        'id': panel_id,
+        'title': title,
+        'type': 'timeseries',
+        'datasource': 'sky-tpu-prometheus',
+        'gridPos': {'h': 8, 'w': 12, 'x': x, 'y': y},
+        'fieldConfig': {'defaults': {'unit': unit}},
+        'targets': [{'expr': expr, 'legendFormat': legend,
+                     'refId': 'A'}],
+    }
+
+
+def render_grafana_dashboard(namespace: str = 'sky-tpu'
+                             ) -> Dict[str, Any]:
+    """Grafana dashboard ConfigMap (reference
+    api-dashboard-grafana-configmap.yaml): picked up by a Grafana
+    sidecar watching the ``grafana_dashboard`` label, it charts the
+    API server's /metrics — request rates/latency plus the per-hop
+    span series the tracing subsystem derives (observability/), so
+    "launch p95 regressed" points at a hop without leaving Grafana."""
+    import json
+    dashboard = {
+        'uid': 'sky-tpu-api',
+        'title': 'sky-tpu API server',
+        'schemaVersion': 39,
+        'refresh': '30s',
+        'time': {'from': 'now-6h', 'to': 'now'},
+        'panels': [
+            _grafana_panel(
+                1, 'Request rate by op',
+                'sum by (op, status) '
+                '(rate(sky_tpu_requests_total[5m]))',
+                '{{op}} {{status}}', y=0, x=0, unit='reqps'),
+            _grafana_panel(
+                2, 'Request duration p95 by op',
+                'histogram_quantile(0.95, sum by (le, op) '
+                '(rate(sky_tpu_request_duration_seconds_bucket[5m])))',
+                '{{op}}', y=0, x=12, unit='s'),
+            _grafana_panel(
+                3, 'Requests in flight',
+                'sky_tpu_requests_in_flight', 'in flight', y=8, x=0),
+            _grafana_panel(
+                4, 'Span duration p95 by hop (tracing)',
+                'histogram_quantile(0.95, sum by (le, hop) '
+                '(rate(sky_tpu_span_duration_seconds_bucket[5m])))',
+                '{{hop}}', y=8, x=12, unit='s'),
+            _grafana_panel(
+                5, 'Span rate by op/hop (tracing)',
+                'sum by (op, hop) '
+                '(rate(sky_tpu_span_duration_seconds_count[5m]))',
+                '{{hop}}: {{op}}', y=16, x=0, unit='ops'),
+            _grafana_panel(
+                6, 'API server RSS',
+                'sky_tpu_process_resident_memory_bytes', 'rss',
+                y=16, x=12, unit='bytes'),
+        ],
+    }
+    return {
+        'apiVersion': 'v1',
+        'kind': 'ConfigMap',
+        'metadata': {'name': 'sky-tpu-grafana-dashboard',
+                     'namespace': namespace,
+                     'labels': {'grafana_dashboard': '1'}},
+        'data': {'sky-tpu-api.json': json.dumps(dashboard, indent=1)},
+    }
+
+
 def render_all(namespace: str = 'sky-tpu') -> Dict[str, Any]:
     """Everything, as one kubectl-applyable List."""
     return {
@@ -482,6 +552,7 @@ def render_all(namespace: str = 'sky-tpu') -> Dict[str, Any]:
             *render_oauth2_proxy(namespace),
             *render_oauth2_redis(namespace),
             render_grafana_datasource(namespace),
+            render_grafana_dashboard(namespace),
         ],
     }
 

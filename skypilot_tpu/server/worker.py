@@ -23,6 +23,7 @@ import traceback
 
 def run_request(request_id: str) -> int:
     from skypilot_tpu import exceptions
+    from skypilot_tpu.observability import trace as trace_lib
     from skypilot_tpu.server import ops
     from skypilot_tpu.server.requests_store import (RequestStatus,
                                                     RequestStore)
@@ -44,9 +45,18 @@ def run_request(request_id: str) -> int:
     os.dup2(logf.fileno(), sys.stdout.fileno())
     os.dup2(logf.fileno(), sys.stderr.fileno())
     store.set_pid(request_id, os.getpid())
+    # Trace context rides the persisted request row (payload
+    # `_traceparent`, stamped by the server at admission) — the worker's
+    # execution span parents to the server's submit span, and engine
+    # spans (execution.launch phases) nest under it.
+    trace_lib.set_hop('worker')
     try:
-        fn = ops.dispatch(req['name'], req['payload'])
-        result = fn()
+        with trace_lib.context_from(
+                req['payload'].get(trace_lib.PAYLOAD_KEY)), \
+                trace_lib.span(f'worker.{req["name"]}',
+                               request_id=request_id):
+            fn = ops.dispatch(req['name'], req['payload'])
+            result = fn()
         json.dumps(result)   # fail HERE if unserializable, not in the row
         store.finish(request_id, RequestStatus.SUCCEEDED, result=result)
         return 0
@@ -60,6 +70,8 @@ def run_request(request_id: str) -> int:
         store.finish(request_id, RequestStatus.FAILED,
                      error=f'{type(e).__name__}: {e}')
         return 1
+    finally:
+        trace_lib.flush()   # ship before the process exits
 
 
 def main() -> None:

@@ -225,9 +225,23 @@ class Db:
         return conn
 
     def _connect_sqlite(self) -> sqlite3.Connection:
+        import time
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         conn = sqlite3.connect(self.path, timeout=30.0)
-        conn.execute('PRAGMA journal_mode=WAL')
+        # Switching journal mode needs a moment of exclusive access; on
+        # a FRESH store two threads connecting simultaneously (e.g. a
+        # job group's parallel launches both doing first-touch) can race
+        # it to an immediate 'database is locked' that the busy timeout
+        # does not cover. Retry briefly; if the other side won, the file
+        # is already in WAL (mode is persistent) and proceeding is fine.
+        for attempt in range(20):
+            try:
+                conn.execute('PRAGMA journal_mode=WAL')
+                break
+            except sqlite3.OperationalError:
+                if attempt == 19:
+                    break   # connection still works under the winner's mode
+                time.sleep(0.05 * (attempt + 1))
         conn.executescript(self.schema)
         conn.row_factory = sqlite3.Row
         return conn

@@ -147,7 +147,33 @@ class _FingerprintAdapter(requests.adapters.HTTPAdapter):
             return super().send(request, *args, **kwargs)
 
 
+class _RefuseAdapter(requests.adapters.BaseAdapter):
+    """https transport for unpinned clients: always fails closed."""
+
+    def send(self, request, **kwargs):  # noqa: D102
+        raise requests.exceptions.SSLError(
+            f'no pinned fingerprint for {request.url}; refusing '
+            'unverified TLS to an agent')
+
+    def close(self) -> None:
+        pass
+
+
+_REFUSE_ADAPTER = _RefuseAdapter()
+
+
 @functools.lru_cache(maxsize=256)
+def _pinned_adapter(fingerprint: str) -> _FingerprintAdapter:
+    """One adapter (= one urllib3 keep-alive pool) per fingerprint.
+
+    The adapter is the expensive, shareable part: urllib3 pools are
+    thread-safe and survive an HTTPAdapter.close() (pools re-create on
+    demand), so every client of a cluster shares one TLS-session pool
+    without re-handshaking each probe tick.
+    """
+    return _FingerprintAdapter(fingerprint)
+
+
 def pinned_session(fingerprint: Optional[str]) -> requests.Session:
     """A requests.Session whose https:// transport is fingerprint-pinned.
 
@@ -155,28 +181,21 @@ def pinned_session(fingerprint: Optional[str]) -> requests.Session:
     refuses https (no pin → no basis for trust: failing closed here is
     what makes the sniff-test meaningful).
 
-    Cached per fingerprint: monitor loops build a fresh AgentClient
-    every probe tick, and a new Session per client would leak its
-    urllib3 pool and re-handshake TLS each time — the cache gives every
-    client of a cluster one shared keep-alive pool. (urllib3 pools are
-    thread-safe; callers only issue requests.)
+    Returns a NEW lightweight Session per call, mounting the cached
+    per-fingerprint adapter. Sessions are NOT thread-safe (cookie jar,
+    per-request state) — the old one-cached-Session-per-fingerprint
+    design handed the same Session to every AgentClient in the process,
+    so concurrent monitor loops and request workers raced on it. The
+    connection pool (the part worth sharing) lives in the adapter.
     """
     sess = requests.Session()
     # Agents live on the VPC/loopback: a corp HTTPS_PROXY from the
     # environment must never be interposed on the pinned channel.
     sess.trust_env = False
     if fingerprint:
-        sess.mount('https://', _FingerprintAdapter(fingerprint))
+        sess.mount('https://', _pinned_adapter(fingerprint))
     else:
-        class _Refuse(requests.adapters.BaseAdapter):
-            def send(self, request, **kwargs):  # noqa: D102
-                raise requests.exceptions.SSLError(
-                    f'no pinned fingerprint for {request.url}; refusing '
-                    'unverified TLS to an agent')
-
-            def close(self) -> None:
-                pass
-        sess.mount('https://', _Refuse())
+        sess.mount('https://', _REFUSE_ADAPTER)
     return sess
 
 
@@ -186,17 +205,40 @@ def scheme_for(cert_pem: Optional[str]) -> str:
     return 'https' if cert_pem else 'http'
 
 
+_warned_no_cryptography = False
+
+
 def ensure_cluster_cert(store: dict, cluster_name: str,
                         cert_key: str = 'agent_tls_cert',
                         key_key: str = 'agent_tls_key'
-                        ) -> Tuple[str, str]:
+                        ) -> Tuple[Optional[str], Optional[str]]:
     """Get-or-mint the cluster TLS pair in `store` (a provider's
     provider_config or metadata dict). Reused across idempotent
     re-provisions — a rotation would invalidate the live agents' pin
-    mid-flight. One home for the logic all five providers share."""
+    mid-flight. One home for the logic all five providers share.
+
+    Gated on the optional ``cryptography`` dependency: without it the
+    cluster provisions in pre-TLS mode (plain-HTTP agents + bearer
+    token, the pervasive None-cert path) instead of failing the launch
+    — logged loudly once, since it is a downgrade an operator should
+    notice. A later re-provision with cryptography installed mints the
+    pair and force-restarts the agents (the TLS upgrade path).
+    """
     cert, key = store.get(cert_key), store.get(key_key)
     if not cert or not key:
-        cert, key, _ = generate_cluster_cert(cluster_name)
+        try:
+            cert, key, _ = generate_cluster_cert(cluster_name)
+        except ImportError:
+            global _warned_no_cryptography
+            if not _warned_no_cryptography:
+                _warned_no_cryptography = True
+                import logging
+                logging.getLogger(__name__).warning(
+                    "the 'cryptography' package is unavailable — "
+                    'provisioning %s WITHOUT agent TLS (bearer-token '
+                    'auth over plain HTTP). Install cryptography and '
+                    're-provision to upgrade.', cluster_name)
+            return None, None
         store[cert_key] = cert
         store[key_key] = key
     return cert, key

@@ -719,6 +719,9 @@ def test_lb_tls_termination_e2e(sky_tpu_home, tmp_path):
     import socket
     import ssl as ssl_lib
 
+    # Cert generation needs the optional cryptography dependency.
+    pytest.importorskip('cryptography')
+
     from skypilot_tpu.utils import tls as tls_lib
 
     cert_pem, key_pem, fp = tls_lib.generate_cluster_cert('svc-tls-lb')

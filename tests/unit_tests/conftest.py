@@ -1,0 +1,28 @@
+"""Shared fixtures for provider unit tests."""
+import pytest
+
+FAKE_CERT_PEM = ('-----BEGIN CERTIFICATE-----\nAAECAwQFBgcICQ==\n'
+                 '-----END CERTIFICATE-----\n')
+
+
+@pytest.fixture
+def fake_certs_without_cryptography(monkeypatch):
+    """Provider tests assert the https-iff-cert contract against STUB
+    transports (fake kubectl / stub sbatch — no agent ever starts, so
+    the PEM is never loaded into an SSL context). When the optional
+    cryptography package is absent, substitute a framing-valid fake
+    cert so the contract stays testable instead of degrading to the
+    pre-TLS http path. Opt-in per module via an autouse alias — it must
+    NOT apply to e2e tests whose agents would try to serve the fake
+    cert."""
+    try:
+        import cryptography  # noqa: F401
+        return
+    except ImportError:
+        pass
+    from skypilot_tpu.utils import tls
+    monkeypatch.setattr(
+        tls, 'generate_cluster_cert',
+        lambda name, valid_days=3650: (
+            FAKE_CERT_PEM, 'FAKE-KEY',
+            tls.fingerprint_of_pem(FAKE_CERT_PEM)))

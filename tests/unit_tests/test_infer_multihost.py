@@ -194,3 +194,102 @@ def test_watchdog_detects_dead_follower(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+class _FakeEngine:
+    """Engine stub for watchdog-semantics tests (no device work)."""
+
+    def __init__(self, step_s=0.0):
+        self.step_s = step_s
+        self.steps = 0
+
+    def submit(self, *a, **kw):
+        return None
+
+    def step(self):
+        self.steps += 1
+        if self.step_s:
+            time.sleep(self.step_s)
+
+    def idle(self):
+        return True
+
+
+def _driver(monkeypatch, engine, deadline_s):
+    from skypilot_tpu.infer import multihost
+    drv = multihost.MultihostEngineDriver(engine)
+    drv._tick_deadline = deadline_s  # noqa: SLF001
+    died = []
+    monkeypatch.setattr(drv, '_die',
+                        lambda stalled, **kw: died.append(stalled))
+    return drv, died
+
+
+def test_watchdog_ignores_slow_step(monkeypatch):
+    """Peer-slow: a legitimately slow engine.step (compile) far beyond
+    the tick deadline must NOT kill the host — the watchdog heartbeat
+    is independent of step, monitoring only time-in-collective."""
+    from skypilot_tpu.infer import multihost
+    # Loopback broadcast: rank 0 gets its own payload back instantly.
+    monkeypatch.setattr(multihost, '_broadcast_bytes', lambda data: data)
+    drv, died = _driver(monkeypatch, _FakeEngine(step_s=0.4),
+                        deadline_s=0.1)
+    drv._start_watchdog()  # noqa: SLF001
+    for _ in range(3):     # 3 steps x 0.4s, deadline 0.1s
+        assert drv.tick()
+    assert drv.engine.steps == 3
+    assert died == [], 'watchdog killed a healthy host mid-compile'
+    drv.stop()
+
+
+def test_watchdog_fires_when_collective_hangs(monkeypatch):
+    """Peer-dead: a broadcast that never completes (dead peer) trips
+    the watchdog within the deadline."""
+    import threading
+
+    from skypilot_tpu.infer import multihost
+
+    hang = threading.Event()
+    monkeypatch.setattr(multihost, '_broadcast_bytes',
+                        lambda data: (hang.wait(30), b'')[1])
+    drv, died = _driver(monkeypatch, _FakeEngine(), deadline_s=0.2)
+    drv._start_watchdog()  # noqa: SLF001
+    t = threading.Thread(target=drv.tick, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while not died and time.time() < deadline:
+        time.sleep(0.05)
+    assert died, 'watchdog never fired on a hung collective'
+    assert died[0] > 0.2
+    drv.stop()
+    hang.set()      # release the stuck tick thread
+    t.join(timeout=5)
+
+
+def test_watchdog_hard_backstop_covers_wedged_step(monkeypatch):
+    """A peer death inside engine.step's device collectives never
+    touches the broadcast deadline — the whole-tick HARD backstop
+    (sized far above any compile) must still fire."""
+    import threading
+
+    from skypilot_tpu.infer import multihost
+
+    monkeypatch.setattr(multihost, '_broadcast_bytes', lambda data: data)
+    wedged = threading.Event()
+
+    class WedgedEngine(_FakeEngine):
+        def step(self):
+            wedged.wait(30)   # peer died mid-device-collective
+
+    drv, died = _driver(monkeypatch, WedgedEngine(), deadline_s=60.0)
+    drv._hard_deadline = 0.2  # noqa: SLF001
+    drv._start_watchdog()  # noqa: SLF001
+    t = threading.Thread(target=drv.tick, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while not died and time.time() < deadline:
+        time.sleep(0.05)
+    assert died, 'hard backstop never fired on a wedged step'
+    drv.stop()
+    wedged.set()
+    t.join(timeout=5)

@@ -19,6 +19,12 @@ from skypilot_tpu.provision.k8s import instance as k8s
 from skypilot_tpu.provision.k8s import manifests
 
 
+@pytest.fixture(autouse=True)
+def _fake_certs(fake_certs_without_cryptography):
+    """These tests assert the https-iff-cert provider contract against
+    a FAKE kubectl — see the shared fixture in conftest.py."""
+
+
 # ---- manifest rendering --------------------------------------------------
 def test_render_multihost_slice():
     tpu = topology.parse_tpu('v5e-16')   # 4 hosts x 4 chips

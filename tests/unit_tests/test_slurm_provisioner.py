@@ -13,6 +13,12 @@ from skypilot_tpu.provision.common import ProvisionConfig
 from skypilot_tpu.provision.slurm import instance as slurm_instance
 
 
+@pytest.fixture(autouse=True)
+def _fake_certs(fake_certs_without_cryptography):
+    """These tests assert the https-iff-cert provider contract against
+    STUB Slurm binaries — see the shared fixture in conftest.py."""
+
+
 @pytest.fixture
 def slurm_stubs(tmp_path, monkeypatch):
     """Stub Slurm CLI: sbatch prints a job id and records the script;

@@ -1,0 +1,397 @@
+"""Distributed tracing (observability/): context propagation, span
+store + GC, rendering, the /api/traces endpoints, Grafana packaging,
+and the end-to-end SDK → API server → agent → job-runtime trace."""
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.observability import render as render_lib
+from skypilot_tpu.observability import store as store_lib
+from skypilot_tpu.observability import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_state():
+    trace._reset_for_tests()  # noqa: SLF001
+    yield
+    trace._reset_for_tests()  # noqa: SLF001
+
+
+def test_traceparent_roundtrip():
+    ctx = trace.SpanContext('ab' * 16, 'cd' * 8)
+    parsed = trace.parse_traceparent(ctx.traceparent())
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.span_id == ctx.span_id
+    # Malformed input never raises (fail-open header parsing).
+    assert trace.parse_traceparent(None) is None
+    assert trace.parse_traceparent('') is None
+    assert trace.parse_traceparent('garbage') is None
+    assert trace.parse_traceparent('00-xyz-abc-01') is None
+
+
+def test_disabled_is_zero_overhead(monkeypatch):
+    """Acceptance: env unset → decorators return the original fn,
+    header/payload injection is skipped, span() records nothing."""
+    monkeypatch.delenv(trace.ENV_VAR, raising=False)
+
+    def f():
+        return 1
+
+    assert trace.traced(f) is f
+    assert trace.traced(name='x')(f) is f
+    headers = {'Authorization': 'Bearer t'}
+    assert trace.inject_headers(headers) == {'Authorization': 'Bearer t'}
+    payload = {}
+    trace.inject_payload(payload)
+    assert payload == {}
+    env = {}
+    trace.child_env(env)
+    assert env == {}
+    with trace.span('nope') as h:
+        assert h is None
+    assert trace.buffered() == (0, 0)
+    assert trace.flush() == 0
+    # The agent channel carries no traceparent header when disabled.
+    from skypilot_tpu.runtime import agent_client
+    c = agent_client.AgentClient('http://127.0.0.1:1', token='t')
+    assert 'traceparent' not in c._headers()  # noqa: SLF001
+
+
+def test_span_nesting_parent_links(monkeypatch):
+    monkeypatch.setenv(trace.ENV_VAR, '1')
+    shipped = []
+    trace.set_sink(lambda spans: shipped.extend(spans))
+    with trace.span('root', hop='client') as h:
+        h.set_attr('request_id', 'req-1')
+        with trace.span('child'):
+            pass
+        with trace.span('boomer'):
+            with pytest.raises(RuntimeError):
+                with trace.span('failing'):
+                    raise RuntimeError('boom')
+    trace.flush()
+    by_name = {s['name']: s for s in shipped}
+    assert set(by_name) == {'root', 'child', 'boomer', 'failing'}
+    root = by_name['root']
+    assert root['parent_id'] is None
+    assert root['attrs']['request_id'] == 'req-1'
+    assert by_name['child']['parent_id'] == root['span_id']
+    assert by_name['boomer']['parent_id'] == root['span_id']
+    assert by_name['failing']['parent_id'] == by_name['boomer']['span_id']
+    assert len({s['trace_id'] for s in shipped}) == 1
+    assert by_name['failing']['status'] == 'error:RuntimeError'
+    assert by_name['child']['status'] == 'ok'
+
+
+def test_cross_process_handoff_channels(monkeypatch):
+    monkeypatch.setenv(trace.ENV_VAR, '1')
+    trace.set_sink(lambda spans: None)
+    with trace.span('outer'):
+        tp = trace.current_traceparent()
+        headers, payload, env = {}, {}, {}
+        trace.inject_headers(headers)
+        trace.inject_payload(payload)
+        trace.child_env(env)
+    assert headers[trace.HEADER] == tp
+    assert payload[trace.PAYLOAD_KEY] == tp
+    assert env[trace.CTX_ENV_VAR] == tp
+    # Re-adoption on the far side of any channel.
+    with trace.context_from(tp):
+        cur = trace.current()
+        assert cur.traceparent() == tp
+    # Env-var channel (agent → job rank processes).
+    monkeypatch.setenv(trace.CTX_ENV_VAR, tp)
+    assert trace.current().traceparent() == tp
+
+
+def test_bind_carries_context_across_threads(monkeypatch):
+    import concurrent.futures
+    monkeypatch.setenv(trace.ENV_VAR, '1')
+    trace.set_sink(lambda spans: None)
+    with trace.span('outer'):
+        expected = trace.current().trace_id
+        fn = trace.bind(lambda: trace.current().trace_id)
+    with concurrent.futures.ThreadPoolExecutor(1) as pool:
+        assert pool.submit(fn).result() == expected
+        # Without bind, the executor thread has no context.
+        assert pool.submit(trace.current).result() is None
+
+
+def _mk_span(trace_id, span_id, parent_id=None, name='op', hop='client',
+             start=0.0, dur=0.1, request_id=None, status='ok'):
+    attrs = {'request_id': request_id} if request_id else {}
+    return {'trace_id': trace_id, 'span_id': span_id,
+            'parent_id': parent_id, 'name': name, 'hop': hop,
+            'start': start, 'dur_s': dur, 'status': status,
+            'attrs': attrs}
+
+
+def test_store_roundtrip_and_request_lookup(tmp_path):
+    store = store_lib.SpanStore(str(tmp_path / 'traces.db'))
+    t_a, t_b = 'a' * 32, 'b' * 32
+    store.add_spans([
+        _mk_span(t_a, '1' * 16, name='sdk.launch', start=1.0,
+                 request_id='req-a'),
+        _mk_span(t_a, '2' * 16, parent_id='1' * 16, name='server.launch',
+                 hop='server', start=1.1),
+        _mk_span(t_b, '3' * 16, name='sdk.status', start=5.0,
+                 request_id='req-b'),
+    ])
+    spans = store.trace_for_request('req-a')
+    assert [s['name'] for s in spans] == ['sdk.launch', 'server.launch']
+    assert spans[0]['attrs']['request_id'] == 'req-a'
+    assert store.trace_id_for_request('req-b') == t_b
+    assert store.trace_for_request('req-none') == []
+    assert store.get_trace(t_b)[0]['name'] == 'sdk.status'
+    summaries = store.list_traces()
+    assert [t['trace_id'] for t in summaries] == [t_b, t_a]
+    assert summaries[1]['n_spans'] == 2
+    assert summaries[1]['root'] == 'sdk.launch'
+
+
+def test_store_gc_drops_oldest_whole_traces(tmp_path, monkeypatch):
+    store = store_lib.SpanStore(str(tmp_path / 'traces.db'))
+    for i in range(5):
+        tid = f'{i:032x}'
+        store.add_spans([
+            _mk_span(tid, f'{i:016x}', start=float(i)),
+            _mk_span(tid, f'{i + 100:016x}', parent_id=f'{i:016x}',
+                     start=float(i) + 0.1),
+        ])
+    assert store.count() == 10
+    monkeypatch.setenv(store_lib.MAX_SPANS_ENV, '5')
+    deleted = store.gc()
+    assert deleted == 6   # three oldest traces, whole (2 spans each)
+    assert store.count() == 4
+    # Survivors are the NEWEST traces, intact.
+    assert store.get_trace(f'{4:032x}') and store.get_trace(f'{3:032x}')
+    assert store.get_trace(f'{0:032x}') == []
+
+
+def test_ingest_feeds_span_metrics(tmp_path):
+    from skypilot_tpu.server import metrics as metrics_lib
+    store = store_lib.SpanStore(str(tmp_path / 'traces.db'))
+    store_lib.ingest([_mk_span('c' * 32, '9' * 16,
+                               name='launch.provision', hop='worker',
+                               dur=2.5)], store=store)
+    text = metrics_lib.render()
+    assert ('sky_tpu_span_duration_seconds_bucket'
+            '{op="launch.provision",hop="worker",le="5.0"}') in text
+    assert store.count() == 1
+
+
+def test_render_tree_and_perfetto_merge():
+    t = 'd' * 32
+    spans = [
+        _mk_span(t, '1' * 16, name='sdk.launch', start=1.0, dur=3.0),
+        _mk_span(t, '2' * 16, parent_id='1' * 16, name='server.launch',
+                 hop='server', start=1.1, dur=0.01),
+        _mk_span(t, '3' * 16, parent_id='2' * 16, name='worker.launch',
+                 hop='worker', start=1.2, dur=2.5),
+        # Orphan (its parent's ship was dropped): must render as a
+        # root, not vanish.
+        _mk_span(t, '4' * 16, parent_id='f' * 16, name='job.run',
+                 hop='agent', start=2.0, dur=1.0),
+    ]
+    txt = render_lib.render_tree(spans)
+    assert 'sdk.launch [client] 3.00s' in txt
+    assert 'server.launch [server]' in txt
+    assert 'worker.launch [worker] 2.50s' in txt
+    assert 'job.run' in txt
+    # Child indented under parent.
+    lines = txt.splitlines()
+    idx = {ln.split('[')[0].strip().lstrip('│├└─ '): i
+           for i, ln in enumerate(lines) if '[' in ln}
+    assert idx['server.launch'] > idx['sdk.launch']
+
+    timeline_ev = {'name': 'local.phase', 'ph': 'X', 'ts': 1.15e6,
+                   'dur': 5e4, 'pid': 1234, 'tid': 1}
+    doc = render_lib.to_perfetto(spans, extra_events=[timeline_ev])
+    names = [e['name'] for e in doc['traceEvents']]
+    assert 'local.phase' in names and 'sdk.launch' in names
+    xs = [e for e in doc['traceEvents'] if e['ph'] == 'X']
+    assert all('ts' in e and 'dur' in e for e in xs)
+    # Hops map to named pid rows.
+    metas = [e for e in doc['traceEvents'] if e['ph'] == 'M']
+    assert {m['args']['name'] for m in metas} == {'client', 'server',
+                                                  'worker', 'agent'}
+
+
+def test_trace_api_endpoints(api_server):
+    """POST /api/traces ingest (auth-exempt) → GET by id → listing →
+    /metrics series."""
+    t = 'e' * 32
+    spans = [_mk_span(t, '1' * 16, name='sdk.launch',
+                      request_id='req-api', dur=1.5),
+             _mk_span(t, '2' * 16, parent_id='1' * 16,
+                      name='server.launch', hop='server')]
+    req = urllib.request.Request(
+        f'{api_server}/api/traces',
+        data=json.dumps({'spans': spans}).encode(),
+        headers={'Content-Type': 'application/json'}, method='POST')
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert json.loads(resp.read())['ingested'] == 2
+    with urllib.request.urlopen(f'{api_server}/api/traces/req-api',
+                                timeout=10) as resp:
+        body = json.loads(resp.read())
+    assert body['trace_id'] == t
+    assert [s['name'] for s in body['spans']] == ['sdk.launch',
+                                                  'server.launch']
+    with urllib.request.urlopen(f'{api_server}/api/traces',
+                                timeout=10) as resp:
+        listing = json.loads(resp.read())['traces']
+    assert any(tr['trace_id'] == t for tr in listing)
+    with urllib.request.urlopen(f'{api_server}/metrics',
+                                timeout=10) as resp:
+        metrics = resp.read().decode()
+    assert 'sky_tpu_span_duration_seconds_bucket' in metrics
+    assert 'hop="server"' in metrics
+    # Malformed batches are rejected, not crashed on.
+    bad = urllib.request.Request(
+        f'{api_server}/api/traces', data=b'{"spans": 7}',
+        headers={'Content-Type': 'application/json'}, method='POST')
+    try:
+        urllib.request.urlopen(bad, timeout=10)
+        raise AssertionError('expected 400')
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+@pytest.fixture
+def traced_api_server(sky_tpu_home, monkeypatch):
+    """api_server fixture with tracing ON in both the server process
+    tree (server → workers → provisioner → agent) and this client."""
+    import subprocess
+    import sys
+
+    import requests
+
+    from skypilot_tpu.utils import common as common_lib
+    monkeypatch.setenv(trace.ENV_VAR, '1')
+    port = common_lib.free_port()
+    url = f'http://127.0.0.1:{port}'
+    with open(os.path.join(sky_tpu_home, 'api_server.log'), 'ab') as log:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.server.app',
+             '--host', '127.0.0.1', '--port', str(port)],
+            stdout=log, stderr=subprocess.STDOUT,
+            env={**os.environ, 'SKY_TPU_HOME': sky_tpu_home,
+                 trace.ENV_VAR: '1'})
+    deadline = time.time() + float(
+        os.environ.get('SKY_TPU_TEST_SERVER_DEADLINE_S', '90'))
+    while time.time() < deadline:
+        try:
+            if requests.get(f'{url}/api/health', timeout=1).ok:
+                break
+        except requests.RequestException:
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        raise RuntimeError('API server did not start')
+    monkeypatch.setenv('SKY_TPU_API_SERVER', url)
+    yield url
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_e2e_one_trace_spans_all_hops(traced_api_server):
+    """Acceptance: a request driven through SDK → API server → fake
+    agent → job runtime carries ONE trace_id across every hop, with
+    parent/child links intact, retrievable via the store API and
+    rendered by `sky-tpu trace <request_id>`."""
+    from skypilot_tpu import Resources, Task
+    from skypilot_tpu.client import sdk
+
+    task = Task('traced-job', run='echo TRACED',
+                resources=Resources(cloud='local', accelerators='v5e-1'))
+    rid = sdk._post('launch', {  # noqa: SLF001 — need the request id
+        'task': task.to_yaml_config(), 'cluster_name': 'tr-c'})
+    sdk.stream_and_get(rid, quiet=True)
+    try:
+        # job.run ships when the agent finishes the job — poll for the
+        # full span set.
+        want_names = {'sdk.launch', 'server.launch', 'worker.launch',
+                      'launch.provision', 'launch.exec',
+                      'agent_client.submit', 'agent./submit', 'job.run'}
+        deadline = time.time() + 90
+        spans = []
+        while time.time() < deadline:
+            spans = sdk.api_trace(rid)
+            if want_names <= {s['name'] for s in spans}:
+                break
+            time.sleep(1)
+        names = {s['name'] for s in spans}
+        assert want_names <= names, f'missing {want_names - names}'
+        # ONE trace across every hop.
+        assert len({s['trace_id'] for s in spans}) == 1
+        hops = {s['hop'] for s in spans}
+        assert {'client', 'server', 'worker', 'agent'} <= hops
+        # Parent/child links intact: every non-root parent exists.
+        ids = {s['span_id'] for s in spans}
+        by_name = {s['name']: s for s in spans}
+        for s in spans:
+            if s['parent_id']:
+                assert s['parent_id'] in ids, s
+        assert by_name['sdk.launch']['parent_id'] is None
+        assert (by_name['server.launch']['parent_id'] ==
+                by_name['sdk.launch']['span_id'])
+        assert (by_name['worker.launch']['parent_id'] ==
+                by_name['server.launch']['span_id'])
+        assert (by_name['agent./submit']['parent_id'] ==
+                by_name['agent_client.submit']['span_id'])
+        assert (by_name['job.run']['parent_id'] ==
+                by_name['agent./submit']['span_id'])
+        # Store API resolves the request id to the same trace.
+        from skypilot_tpu.observability import store as st
+        assert (st.SpanStore().trace_id_for_request(rid) ==
+                spans[0]['trace_id'])
+        # CLI rendering.
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client.cli import cli
+        res = CliRunner().invoke(cli, ['trace', rid])
+        assert res.exit_code == 0, res.output
+        assert 'sdk.launch [client]' in res.output
+        assert 'job.run [agent]' in res.output
+        assert spans[0]['trace_id'] in res.output
+    finally:
+        from skypilot_tpu import exceptions
+        try:
+            sdk.down('tr-c')
+        except exceptions.SkyTpuError:
+            pass
+
+
+# ---- Grafana / monitoring packaging (acceptance criterion) ---------------
+def test_packaging_grafana_and_scrape():
+    """packaging renders Grafana dashboard + datasource configmaps and
+    a metrics scrape service."""
+    import yaml
+
+    from skypilot_tpu.server import packaging
+    manifest = packaging.render_all()
+    items = manifest['items']
+
+    dash = next(i for i in items if i['kind'] == 'ConfigMap' and
+                i['metadata']['name'] == 'sky-tpu-grafana-dashboard')
+    assert dash['metadata']['labels']['grafana_dashboard'] == '1'
+    board = json.loads(dash['data']['sky-tpu-api.json'])
+    exprs = [t['expr'] for p in board['panels']
+             for t in p.get('targets', [])]
+    assert any('sky_tpu_requests_total' in e for e in exprs)
+    assert any('sky_tpu_span_duration_seconds' in e for e in exprs)
+
+    ds = next(i for i in items if i['kind'] == 'ConfigMap' and
+              i['metadata']['name'] == 'sky-tpu-grafana-datasource')
+    assert ds['metadata']['labels']['grafana_datasource'] == '1'
+    ds_doc = yaml.safe_load(ds['data']['sky-tpu.yaml'])
+    assert ds_doc['datasources'][0]['type'] == 'prometheus'
+
+    svc = next(i for i in items if i['kind'] == 'Service' and
+               i['metadata']['name'] == 'sky-tpu-api-metrics')
+    ann = svc['metadata']['annotations']
+    assert ann['prometheus.io/scrape'] == 'true'
+    assert ann['prometheus.io/path'] == '/metrics'
