@@ -24,6 +24,7 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.provision.common import ClusterInfo
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import retry as retry_lib
 
 
 def server_url() -> str:
@@ -99,30 +100,38 @@ def _http_get(path: str, *, timeout=30, stream: bool = False,
     exceptions (clients catch SkyTpuError only).
 
     GETs are idempotent — transient connection failures (server restart,
-    flaky proxy; the chaos suite injects exactly this) retry with
-    backoff before surfacing.
+    flaky proxy; the chaos suite injects exactly this) retry through the
+    shared Retrier (utils/retry.py) before surfacing.
     """
     url = server_url()
-    for attempt in range(retries + 1):
+
+    def _once():
+        r = requests_lib.get(f'{url}{path}', timeout=timeout,
+                             stream=stream, headers=_auth_headers())
+        r.raise_for_status()
+        return r
+
+    def _transient(exc: BaseException) -> bool:
+        # HTTP status errors are the server answering — not transient.
+        return (isinstance(exc, requests_lib.RequestException) and
+                not isinstance(exc, requests_lib.HTTPError))
+
+    try:
+        return retry_lib.Retrier(
+            'sdk.get', max_attempts=retries + 1, base_delay_s=0.4,
+            max_delay_s=5.0, transient=(),
+            retry_on=_transient).call(_once)
+    except requests_lib.HTTPError as e:
+        detail = ''
         try:
-            r = requests_lib.get(f'{url}{path}', timeout=timeout,
-                                 stream=stream, headers=_auth_headers())
-            r.raise_for_status()
-            return r
-        except requests_lib.HTTPError as e:
-            detail = ''
-            try:
-                detail = e.response.json().get('error', '')
-            except Exception:  # noqa: BLE001 — non-JSON error body
-                pass
-            raise exceptions.SkyTpuError(
-                f'API server error for GET {path}: '
-                f'{detail or e}') from e
-        except requests_lib.RequestException as e:
-            if attempt < retries:
-                time.sleep(0.4 * (2 ** attempt))
-                continue
-            raise exceptions.ApiServerConnectionError(url) from e
+            detail = e.response.json().get('error', '')
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            pass
+        raise exceptions.SkyTpuError(
+            f'API server error for GET {path}: '
+            f'{detail or e}') from e
+    except requests_lib.RequestException as e:
+        raise exceptions.ApiServerConnectionError(url) from e
 
 
 def get(request_id: str) -> Any:

@@ -11,10 +11,12 @@ transfer routes through one of two mechanisms:
 """
 from __future__ import annotations
 
+import re
 import subprocess
 import tempfile
 
 from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.utils import retry as retry_lib
 
 
 def _sync_cli(src_url: str, dst_url: str) -> list:
@@ -28,17 +30,60 @@ def _sync_cli(src_url: str, dst_url: str) -> list:
     return []
 
 
+class _SyncCliTransient(Exception):
+    """CLI failure that looks connection/throttle-shaped: retried."""
+
+
+class _SyncCliPermanent(Exception):
+    """Deterministic CLI failure (auth, missing bucket): retrying the
+    same command is wasted cloud calls — fall straight to staging."""
+
+
+# Markers of retry-worthy sync-CLI failures (case-insensitive): the
+# transport and throttling families, not the deterministic ones.
+_TRANSIENT_CLI_RE = re.compile(
+    r'(?i)(connection|timed? ?out|timeout|throttl|rate ?limit|'
+    r'temporar|slow ?down|service ?unavailable|\b50[0234]\b)')
+
+
 def transfer(src_url: str, dst_url: str) -> None:
-    """Copy all objects under src_url into dst_url."""
+    """Copy all objects under src_url into dst_url.
+
+    Both mechanisms run under the shared Retrier; transfers are
+    idempotent (rsync/sync semantics converge on re-run), but only
+    connection/throttle-shaped CLI failures are classified transient —
+    a missing bucket or auth denial fails the same way every time."""
     cmd = _sync_cli(src_url, dst_url)
     if cmd:
-        rc = subprocess.run(cmd, capture_output=True, text=True)
-        if rc.returncode == 0:
+        def _run_cli() -> None:
+            rc = subprocess.run(cmd, capture_output=True, text=True)
+            if rc.returncode == 0:
+                return
+            tail = rc.stderr[-500:]
+            if _TRANSIENT_CLI_RE.search(rc.stderr):
+                raise _SyncCliTransient(tail)
+            raise _SyncCliPermanent(tail)
+        try:
+            retry_lib.Retrier(
+                'data.transfer.cli', max_attempts=3, base_delay_s=1.0,
+                deadline_s=120.0,
+                transient=(_SyncCliTransient, OSError),
+                # CLI binary absent: deterministic — go straight to the
+                # staging path instead of re-exec'ing a missing tool.
+                fatal=(FileNotFoundError,
+                       NotADirectoryError)).call(_run_cli)
             return
-        # fall through to staging on CLI failure
-    src = storage_lib.store_from_url(src_url)
-    dst = storage_lib.store_from_url(dst_url)
-    with tempfile.TemporaryDirectory(prefix='sky_tpu_xfer_') as stage:
-        src.download(stage)
-        dst.create()
-        dst.upload(stage)
+        except Exception:  # noqa: BLE001 — fall through to staging
+            pass
+
+    def _stage() -> None:
+        src = storage_lib.store_from_url(src_url)
+        dst = storage_lib.store_from_url(dst_url)
+        with tempfile.TemporaryDirectory(prefix='sky_tpu_xfer_') as stage:
+            src.download(stage)
+            dst.create()
+            dst.upload(stage)
+
+    retry_lib.Retrier(
+        'data.transfer.stage', max_attempts=3, base_delay_s=1.0,
+        transient=(ConnectionError, TimeoutError, OSError)).call(_stage)

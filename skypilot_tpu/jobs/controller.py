@@ -36,6 +36,7 @@ from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.provision.common import ClusterInfo
 from skypilot_tpu.runtime import agent_client
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import failpoints
 
 logger = logging.getLogger(__name__)
 
@@ -259,6 +260,16 @@ class JobController:
                 continue
             status = self._job_status(info)
             provider_alive = self._provider_alive(info)
+            if provider_alive:
+                # Chaos seam for the preemption-storm suite: firing
+                # `jobs.provider.preempted` makes this tick see the
+                # slice as dead, driving the REAL recovery path —
+                # terminate + (EAGER_)failover relaunch + resubmit —
+                # with an `@N` budget bounding the storm.
+                try:
+                    failpoints.hit('jobs.provider.preempted')
+                except failpoints.FailpointError:
+                    provider_alive = False
             # Agent dead on a provider-healthy slice (e.g. OOM-killed
             # agent): after _AGENT_MISS_LIMIT consecutive misses the
             # workload is unobservable — recover the slice rather than

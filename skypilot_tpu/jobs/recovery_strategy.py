@@ -25,20 +25,36 @@ from skypilot_tpu import execution
 from skypilot_tpu import state as global_state
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.utils import failpoints
 
 logger = logging.getLogger(__name__)
 
 JOBS_RECOVERY_STRATEGY_REGISTRY: Dict[str, type] = {}
 
 DEFAULT_RECOVERY_STRATEGY = 'EAGER_FAILOVER'
+# Module attributes are OVERRIDES (tests monkeypatch them); None /
+# _UNSET means "read the env var at call time", so the chaos suite can
+# tune cadence via env after this module is already imported.
 # Seconds between provisioning retry rounds when no resources are
-# available anywhere (reference RETRY_INIT_GAP_SECONDS). Env-tunable so
-# tests run fast.
-_RETRY_GAP_S = float(os.environ.get('SKY_TPU_JOBS_RETRY_GAP_S', '30'))
-# Rounds of full-failover retries before giving up a launch. `None`
-# (default) = retry until up, the managed-jobs contract.
-_MAX_LAUNCH_ROUNDS = int(os.environ.get('SKY_TPU_JOBS_MAX_LAUNCH_ROUNDS',
-                                        '0')) or None
+# available anywhere (reference RETRY_INIT_GAP_SECONDS).
+_RETRY_GAP_S: Optional[float] = None
+# Rounds of full-failover retries before giving up a launch. `None` =
+# retry until up, the managed-jobs contract ('0' in the env means None).
+_UNSET = object()
+_MAX_LAUNCH_ROUNDS: Any = _UNSET
+
+
+def _retry_gap_s() -> float:
+    if _RETRY_GAP_S is not None:
+        return _RETRY_GAP_S
+    return float(os.environ.get('SKY_TPU_JOBS_RETRY_GAP_S', '30'))
+
+
+def _max_launch_rounds() -> Optional[int]:
+    if _MAX_LAUNCH_ROUNDS is not _UNSET:
+        return _MAX_LAUNCH_ROUNDS
+    return int(os.environ.get('SKY_TPU_JOBS_MAX_LAUNCH_ROUNDS',
+                              '0')) or None
 
 
 def _register(name: str):
@@ -118,21 +134,25 @@ class StrategyExecutor:
                     f'for resources')
             rounds += 1
             try:
+                # Chaos seam: `delay` widens the launch race window;
+                # `error` fails the stage (launch errors other than
+                # no-capacity are deliberately NOT absorbed here).
+                failpoints.hit('jobs.launch')
                 return execution.launch(self.task,
                                         cluster_name=self.cluster_name,
                                         backend=self.backend,
                                         detach_run=True,
                                         blocked_placements=blocked)
             except exceptions.ResourcesUnavailableError as e:
-                if (_MAX_LAUNCH_ROUNDS is not None and
-                        rounds >= _MAX_LAUNCH_ROUNDS):
+                max_rounds = _max_launch_rounds()
+                if max_rounds is not None and rounds >= max_rounds:
                     raise exceptions.ManagedJobReachedMaxRetriesError(
                         f'job {self.job_id}: no resources after {rounds} '
                         f'rounds: {e}') from e
+                gap = _retry_gap_s()
                 logger.info('job %s: no capacity anywhere (round %d); '
-                            'sleeping %.0fs', self.job_id, rounds,
-                            _RETRY_GAP_S)
-                time.sleep(_RETRY_GAP_S)
+                            'sleeping %.0fs', self.job_id, rounds, gap)
+                time.sleep(gap)
                 # After one full failed round, previously-blocked
                 # placements are fair game again (capacity moves).
                 blocked = None

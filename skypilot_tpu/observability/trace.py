@@ -371,10 +371,23 @@ def flush() -> int:
     if collector:
         try:
             import requests
-            r = requests.post(f'{collector}/api/traces',
-                              json={'spans': spans}, timeout=3)
-            if r.ok:
-                return len(spans)
+
+            # Lazy import: retry.py imports this module at its top
+            # level, so the dependency must only run at call time.
+            from skypilot_tpu.utils import retry as retry_lib
+
+            def _post() -> None:
+                r = requests.post(f'{collector}/api/traces',
+                                  json={'spans': spans}, timeout=3)
+                r.raise_for_status()
+
+            # Two quick tries, then fall back to the local store —
+            # shipping is fail-open and must never stall the caller.
+            retry_lib.Retrier(
+                'trace.ship', max_attempts=2, base_delay_s=0.1,
+                max_delay_s=0.5,
+                transient=(requests.RequestException,)).call(_post)
+            return len(spans)
         except Exception:  # noqa: BLE001 — fall through to local store
             pass
     try:

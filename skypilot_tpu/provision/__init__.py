@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision.common import ClusterInfo, ProvisionConfig
+from skypilot_tpu.utils import failpoints
 
 _PROVIDERS = {
     'local': 'skypilot_tpu.provision.local.instance',
@@ -48,6 +49,10 @@ def stop_instances(cloud: str, cluster_name: str,
 
 def terminate_instances(cloud: str, cluster_name: str,
                         provider_config: Dict[str, Any]) -> None:
+    # Chaos seam: teardown paths are all best-effort by contract, so an
+    # injected error here verifies no caller lets a failed terminate
+    # wedge recovery (the cleanup-is-never-on-the-critical-path rule).
+    failpoints.hit('provision.terminate')
     return _impl(cloud).terminate_instances(cluster_name, provider_config)
 
 

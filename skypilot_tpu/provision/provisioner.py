@@ -16,6 +16,7 @@ whole-slice (SURVEY.md §7 "hard parts").
 from __future__ import annotations
 
 import logging
+import os
 from typing import List, Optional, Tuple
 
 from skypilot_tpu import catalog
@@ -24,8 +25,26 @@ from skypilot_tpu import provision
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu.provision.common import ClusterInfo, ProvisionConfig
 from skypilot_tpu.runtime import agent_client
+from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import retry as retry_lib
 
 logger = logging.getLogger(__name__)
+
+
+def _create_retrier() -> retry_lib.Retrier:
+    """Retry policy for the cloud-API create call itself: transient
+    transport trouble (and injected chaos) is retried *within* one
+    placement attempt; ProvisionError/CapacityError are NOT transient —
+    those are the failover loop's granularity, not the Retrier's."""
+    return retry_lib.Retrier(
+        'provision.create',
+        max_attempts=int(os.environ.get(
+            'SKY_TPU_PROVISION_RETRIES', '3')),
+        base_delay_s=float(os.environ.get(
+            'SKY_TPU_PROVISION_RETRY_BASE_S', '0.5')),
+        deadline_s=60.0,
+        transient=(ConnectionError, TimeoutError, OSError,
+                   failpoints.FailpointError))
 
 
 def _make_config(candidate: catalog.Candidate,
@@ -76,7 +95,16 @@ def bulk_provision(candidate: catalog.Candidate,
     for the head agent (reference provisioner.py:122 + wait_for_ssh :389 —
     the agent replaces SSH-wait as the readiness signal)."""
     config = _make_config(candidate, cluster_name, res, data_disks)
-    info = provision.run_instances(candidate.cloud, config)
+
+    def _create() -> ClusterInfo:
+        # Failpoint inside the retried callable: an `@N` budget is
+        # consumed per attempt, so `provision.create=error:1@2` means
+        # "fail the first two create calls, then succeed".
+        failpoints.hit('provision.create')
+        return provision.run_instances(candidate.cloud, config)
+
+    info = _create_retrier().call(_create)
+    failpoints.hit('provision.bootstrap')
     provision.wait_instances(candidate.cloud, cluster_name,
                              info.provider_config)
     info.cost_per_hour = candidate.cost_per_hour * res.num_slices

@@ -42,6 +42,7 @@ from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.runtime import distributed_env
 from skypilot_tpu.runtime import job_lib
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import failpoints
 
 POLL_INTERVAL = 1.0
 AUTOSTOP_CHECK_INTERVAL = 5.0
@@ -115,6 +116,21 @@ class Agent:
         # accumulate handles forever on exec-heavy clusters).
         self._exec_counter = 0
         self._cancelled: set = set()
+        # submit_id -> job_id dedup map for idempotent /submit retries
+        # (insertion-ordered; oldest entries evicted past the cap).
+        self._submit_ids: Dict[str, int] = {}
+        # Restart reconciliation: a previous agent killed mid-job (stop,
+        # OOM, crash) leaves INIT/SETTING_UP/RUNNING rows behind with no
+        # process behind them. The FIFO scheduler gates on
+        # running_jobs(), so an unreconciled row would wedge the queue
+        # FOREVER (every later submit stays PENDING). This process just
+        # started: no job of ours can be running yet — mark the
+        # orphans FAILED (the managed-jobs controller treats a terminal
+        # status on a healthy slice per its restart policy; a preempted
+        # slice never restarts an agent, so the preemption-detection
+        # path in _kill_agent is unaffected).
+        for stale in self.jobs.running_jobs():
+            self.jobs.set_status(stale['job_id'], job_lib.JobStatus.FAILED)
         # Native orphan reaper (native/reaper.cc): if this agent is
         # SIGKILLed mid-job, the rank process groups recorded in the
         # pgid file are torn down so no leaked rank wedges the TPU chip
@@ -515,6 +531,9 @@ class Agent:
 
     # ---------------- HTTP handlers --------------------------------------
     async def h_health(self, _req: web.Request) -> web.Response:
+        # FailpointError surfaces as aiohttp's 500 — from the client's
+        # side, indistinguishable from a crashing agent (the point).
+        await failpoints.hit_async('agent.health')
         return web.json_response({
             'status': 'healthy',
             'uptime_s': time.time() - self.started_at,
@@ -525,7 +544,22 @@ class Agent:
         })
 
     async def h_submit(self, req: web.Request) -> web.Response:
+        # BEFORE any state change: an injected submit failure must be
+        # safely retryable (no half-created job row to double-run).
+        await failpoints.hit_async('agent.submit')
         body = await req.json()
+        # Idempotent retry: the client stamps each LOGICAL submit with a
+        # fresh submit_id and reuses it across retries. If the previous
+        # attempt's response was lost AFTER the job row committed, the
+        # retry must return the same job instead of double-running the
+        # workload. In-memory is enough: the dedup window is the
+        # client's retry loop, and an agent restart within it also loses
+        # the job row the duplicate would have shadowed.
+        submit_id = body.get('submit_id')
+        if submit_id:
+            prior = self._submit_ids.get(str(submit_id))
+            if prior is not None:
+                return web.json_response({'job_id': prior})
         log_dir = os.path.join(self.cluster_dir, 'job_logs')
         envs = dict(body.get('envs', {}))
         # Job execution is async (the scheduler loop picks it up later):
@@ -544,6 +578,10 @@ class Agent:
         self.jobs._conn.execute(  # set final log dir now that id is known
             'UPDATE jobs SET log_dir=? WHERE job_id=?', (log_dir, job_id))
         self.jobs._conn.commit()
+        if submit_id:
+            self._submit_ids[str(submit_id)] = job_id
+            if len(self._submit_ids) > 4096:   # bound the dedup window
+                self._submit_ids.pop(next(iter(self._submit_ids)))
         return web.json_response({'job_id': job_id})
 
     async def h_jobs(self, _req: web.Request) -> web.Response:
@@ -580,6 +618,7 @@ class Agent:
     async def h_logs(self, req: web.Request) -> web.StreamResponse:
         """Stream rank logs; ?follow=1 tails until the job ends
         (reference sky/skylet/log_lib.py tailing)."""
+        await failpoints.hit_async('agent.tail')
         job_id = int(req.match_info['job_id'])
         job = self.jobs.get(job_id)
         if job is None:

@@ -4,11 +4,22 @@ Counterpart of the reference's ``SkyletClient`` (reference
 cloud_vm_ray_backend.py:2718, gRPC over an SSH tunnel at :2305). Here the
 transport is plain HTTP to the head host's agent; on GCP the agent port is
 reachable over the VPC (or an SSH tunnel, handled by the backend).
+
+Every call goes through the shared ``Retrier`` (utils/retry.py):
+connection trouble and agent 5xx responses — an OOM-killed agent
+restarting, a TLS handshake racing an agent upgrade, an injected
+failpoint — are transient and retried with full-jitter backoff; 4xx
+responses are contract errors and surface immediately. The agent's
+mutating endpoints are safe to retry: /submit carries a per-logical-call
+``submit_id`` the agent dedups on (a response lost after the job row
+committed returns the same job on retry), /cancel and /autostop are
+idempotent.
 """
 from __future__ import annotations
 
 import os
 import time
+import uuid
 from typing import Any, Dict, Iterator, List, Optional
 
 import requests
@@ -16,7 +27,20 @@ import requests
 from skypilot_tpu import exceptions
 from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import retry as retry_lib
 from skypilot_tpu.utils import tls
+
+
+def _retry_on(exc: BaseException) -> bool:
+    """Transient for the agent hop: transport failures, agent 5xx, and
+    client-side injected chaos (`agent_client.request` failpoint)."""
+    if isinstance(exc, requests.HTTPError):
+        resp = exc.response
+        return resp is not None and resp.status_code >= 500
+    return isinstance(exc, (requests.ConnectionError, requests.Timeout,
+                            ConnectionError, TimeoutError, OSError,
+                            failpoints.FailpointError))
 
 
 class AgentClient:
@@ -53,6 +77,36 @@ class AgentClient:
         # tracing is off).
         return trace_lib.inject_headers(headers)
 
+    def _retrier(self, op: str,
+                 deadline_s: Optional[float] = None) -> retry_lib.Retrier:
+        return retry_lib.Retrier(
+            f'agent.{op}',
+            max_attempts=int(os.environ.get('SKY_TPU_AGENT_RETRIES',
+                                            '4')),
+            base_delay_s=float(os.environ.get(
+                'SKY_TPU_AGENT_RETRY_BASE_S', '0.2')),
+            max_delay_s=2.0,
+            deadline_s=deadline_s,
+            transient=(), retry_on=_retry_on,
+            fatal=(exceptions.JobNotFoundError,))
+
+    def _request(self, method: str, path: str, *, op: str,
+                 timeout: Optional[float],
+                 not_found: Optional[str] = None,
+                 **kw: Any) -> requests.Response:
+        def _once() -> requests.Response:
+            # Client-side chaos seam — fires in the CALLER's process
+            # (controller, provisioner), complementing the agent-side
+            # `agent.*` sites which fire in the agent daemon.
+            failpoints.hit('agent_client.request')
+            r = self._session.request(method, f'{self.url}{path}',
+                                      timeout=timeout, **kw)
+            if r.status_code == 404 and not_found is not None:
+                raise exceptions.JobNotFoundError(not_found)
+            r.raise_for_status()
+            return r
+        return self._retrier(op).call(_once)
+
     def wait_healthy(self, timeout: Optional[float] = None
                      ) -> Dict[str, Any]:
         if timeout is None:
@@ -60,75 +114,95 @@ class AgentClient:
             # cores) need longer than production's 60s to fork+import an
             # agent process.
             timeout = float(os.environ.get('SKY_TPU_AGENT_WAIT_S', '60'))
-        deadline = time.time() + timeout
-        last_err: Optional[Exception] = None
         with trace_lib.span('agent_client.wait_healthy', url=self.url):
-            while time.time() < deadline:
-                try:
-                    r = self._session.get(f'{self.url}/health', timeout=5)
-                    if r.ok:
-                        return r.json()
-                except requests.RequestException as e:
-                    last_err = e
-                time.sleep(0.5)
-        raise exceptions.ClusterNotUpError(
-            f'Agent at {self.url} not healthy after {timeout}s: {last_err}')
+            # Deadline-bound Retrier with a tight delay cap: the old
+            # 0.5s polling cadence, now with jitter + trace events. The
+            # attempt budget is sized WELL past the deadline (mean
+            # jittered delay is 0.25s, so timeout*4 attempts would
+            # exhaust before the deadline about half the time) — the
+            # deadline is the sole effective bound. Unlike normal
+            # calls, EVERY HTTP failure (including 4xx — e.g. a token
+            # or ingress still settling mid-bootstrap) keeps polling:
+            # only the deadline concludes an agent is not coming up.
+            r = retry_lib.Retrier(
+                'agent.wait_healthy',
+                max_attempts=max(16, int(timeout * 16)),
+                base_delay_s=0.5, max_delay_s=0.5, deadline_s=timeout,
+                transient=(requests.RequestException, ConnectionError,
+                           TimeoutError, OSError))
+            try:
+                def _once() -> requests.Response:
+                    resp = self._session.get(f'{self.url}/health',
+                                             timeout=5)
+                    resp.raise_for_status()
+                    return resp
+                return r.call(_once).json()
+            except Exception as e:  # noqa: BLE001 — deadline exhausted
+                raise exceptions.ClusterNotUpError(
+                    f'Agent at {self.url} not healthy after {timeout}s: '
+                    f'{e}') from e
 
     def health(self) -> Dict[str, Any]:
-        r = self._session.get(f'{self.url}/health', timeout=self.timeout)
-        r.raise_for_status()
-        return r.json()
+        return self._request('GET', '/health', op='health',
+                             timeout=self.timeout).json()
 
     def submit(self, name: str, run: str, setup: Optional[str] = None,
                envs: Optional[Dict[str, str]] = None) -> int:
         with trace_lib.span('agent_client.submit', job=name):
-            r = self._session.post(f'{self.url}/submit', json={
-                'name': name, 'run': run, 'setup': setup,
-                'envs': envs or {},
-            }, headers=self._headers(), timeout=self.timeout)
-            r.raise_for_status()
+            # One submit_id per LOGICAL submit, constant across retries:
+            # if a response is lost after the agent committed the job
+            # row, the retried POST returns the same job instead of
+            # creating a duplicate (the agent dedups on it).
+            r = self._request('POST', '/submit', op='submit',
+                              json={'name': name, 'run': run,
+                                    'setup': setup, 'envs': envs or {},
+                                    'submit_id': uuid.uuid4().hex},
+                              headers=self._headers(),
+                              timeout=self.timeout)
             return int(r.json()['job_id'])
 
     def job_status(self, job_id: int) -> common.JobStatus:
-        r = self._session.get(f'{self.url}/jobs/{job_id}',
-                         headers=self._headers(), timeout=self.timeout)
-        if r.status_code == 404:
-            raise exceptions.JobNotFoundError(f'job {job_id}')
-        r.raise_for_status()
+        r = self._request('GET', f'/jobs/{job_id}', op='job_status',
+                          not_found=f'job {job_id}',
+                          headers=self._headers(), timeout=self.timeout)
         return common.JobStatus(r.json()['status'])
 
     def jobs(self) -> List[Dict[str, Any]]:
-        r = self._session.get(f'{self.url}/jobs', headers=self._headers(),
-                         timeout=self.timeout)
-        r.raise_for_status()
+        r = self._request('GET', '/jobs', op='jobs',
+                          headers=self._headers(), timeout=self.timeout)
         return r.json()['jobs']
 
     def cancel(self, job_id: int) -> None:
-        r = self._session.post(f'{self.url}/cancel/{job_id}',
-                          headers=self._headers(), timeout=self.timeout)
-        if r.status_code == 404:
-            raise exceptions.JobNotFoundError(f'job {job_id}')
-        r.raise_for_status()
+        self._request('POST', f'/cancel/{job_id}', op='cancel',
+                      not_found=f'job {job_id}',
+                      headers=self._headers(), timeout=self.timeout)
 
     def exec_sync(self, cmd: str,
                   envs: Optional[Dict[str, str]] = None,
                   timeout: float = 600.0) -> Dict[str, Any]:
         with trace_lib.span('agent_client.exec'):
+            # NOT retried at the HTTP layer: /exec runs an arbitrary
+            # command — re-POSTing after an ambiguous failure could run
+            # it twice. Callers own exec retry semantics.
+            failpoints.hit('agent_client.request')
             r = self._session.post(f'{self.url}/exec',
-                              json={'cmd': cmd, 'envs': envs or {}},
-                              headers=self._headers(), timeout=timeout)
+                                   json={'cmd': cmd, 'envs': envs or {}},
+                                   headers=self._headers(),
+                                   timeout=timeout)
             r.raise_for_status()
             return r.json()
 
     def tail_logs(self, job_id: int, *, follow: bool = True,
                   rank: int = 0) -> Iterator[bytes]:
-        with self._session.get(
-                f'{self.url}/logs/{job_id}',
-                params={'follow': '1' if follow else '0', 'rank': rank},
-                headers=self._headers(), stream=True, timeout=None) as r:
-            if r.status_code == 404:
-                raise exceptions.JobNotFoundError(f'job {job_id}')
-            r.raise_for_status()
+        # Connection establishment is retried (the Retrier wraps the
+        # request + status check); a stream dropped MID-iteration is
+        # not — the caller decides whether replayed bytes are acceptable.
+        r = self._request(
+            'GET', f'/logs/{job_id}', op='tail_logs',
+            not_found=f'job {job_id}',
+            params={'follow': '1' if follow else '0', 'rank': rank},
+            headers=self._headers(), stream=True, timeout=None)
+        with r:
             yield from r.iter_content(chunk_size=None)
 
     def wait_job(self, job_id: int,
@@ -142,7 +216,6 @@ class AgentClient:
         raise TimeoutError(f'job {job_id} still running after {timeout}s')
 
     def set_autostop(self, idle_minutes: int, down: bool = False) -> None:
-        r = self._session.post(f'{self.url}/autostop', json={
-            'idle_minutes': idle_minutes, 'down': down,
-        }, headers=self._headers(), timeout=self.timeout)
-        r.raise_for_status()
+        self._request('POST', '/autostop', op='autostop',
+                      json={'idle_minutes': idle_minutes, 'down': down},
+                      headers=self._headers(), timeout=self.timeout)

@@ -7,6 +7,15 @@ session streams them to the selected replica. The ready-replica set is
 refreshed from the serve state DB every second (the reference syncs it
 from the controller over HTTP); request counts are flushed back to the DB
 as the autoscaler's QPS signal.
+
+Resilience (docs/robustness.md): a replica failure BEFORE the first
+response byte is retried on the next ready replica — a dead replica
+costs zero client-visible errors as long as one peer survives. Each
+replica has a circuit breaker (utils/retry.CircuitBreaker): consecutive
+pre-stream failures trip it OPEN so the selector stops offering the
+corpse, and a half-open probe re-admits it when it recovers. Mid-stream
+death cannot be retried (headers are gone): the stream is terminated and
+the truncation is the client's error signal.
 """
 from __future__ import annotations
 
@@ -14,8 +23,9 @@ import asyncio
 import collections
 import contextlib
 import logging
+import os
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Set
 
 import aiohttp
 from aiohttp import web
@@ -23,6 +33,8 @@ from aiohttp import web
 from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.serve import load_balancing_policies as lbp
 from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import retry as retry_lib
 
 logger = logging.getLogger(__name__)
 
@@ -33,6 +45,15 @@ _HOP_HEADERS = frozenset((
     'connection', 'keep-alive', 'proxy-authenticate',
     'proxy-authorization', 'te', 'trailers', 'transfer-encoding',
     'upgrade', 'host', 'content-length'))
+
+
+class _PreStreamFailure(Exception):
+    """Replica failed before any response byte reached the client —
+    safe to retry on another replica."""
+
+    def __init__(self, cause: BaseException) -> None:
+        super().__init__(str(cause))
+        self.cause = cause
 
 
 class LoadBalancer:
@@ -50,6 +71,17 @@ class LoadBalancer:
         self._ttfts: collections.deque = collections.deque(maxlen=4096)
         self._requests_total = 0
         self._requests_failed = 0
+        # "No capacity" is a different dashboard line than "replica
+        # died": 503s are counted here, never in requests_failed.
+        self._requests_no_replica = 0
+        # Pre-stream failovers onto another replica (each one is a
+        # client error that did NOT happen).
+        self._requests_retried = 0
+        self.breaker = retry_lib.CircuitBreaker(
+            failure_threshold=int(os.environ.get(
+                'SKY_TPU_LB_BREAKER_THRESHOLD', '3')),
+            cooldown_s=float(os.environ.get(
+                'SKY_TPU_LB_BREAKER_COOLDOWN_S', '10')))
 
     # -- background sync ---------------------------------------------------
     async def _sync_loop(self) -> None:
@@ -59,6 +91,9 @@ class LoadBalancer:
                     serve_state.ready_replica_info, self.service_name)
                 self.policy.set_replica_info(info)
                 self.policy.set_ready_replicas(list(info))
+                # Replicas that left the ready set drop their breaker
+                # state; a returning URL starts closed.
+                self.breaker.prune(info)
                 if hasattr(self.policy, 'set_target_qps_per_accelerator'):
                     # Instance-aware policy: refresh the per-accelerator
                     # QPS map from the (possibly updated) service spec.
@@ -106,44 +141,65 @@ class LoadBalancer:
         return {
             'requests_total': self._requests_total,
             'requests_failed': self._requests_failed,
+            'requests_no_replica': self._requests_no_replica,
+            'requests_retried': self._requests_retried,
             'ttft_p50_s': pct(0.50),
             'ttft_p90_s': pct(0.90),
             'ttft_p99_s': pct(0.99),
             'ttft_samples': len(ttfts),
             'ready_replicas': len(self.policy.ready_urls),
+            'breaker': self.breaker.snapshot(),
         }
 
-    async def handle(self, request: web.Request) -> web.StreamResponse:
-        if request.path == '/-/urls':   # introspection endpoint
-            return web.json_response(
-                {'ready_replica_urls': list(self.policy.ready_urls)})
-        if request.path == '/-/metrics':
-            return web.json_response(self.lb_metrics())
-        url = self.policy.select_replica()
-        if url is None:
-            self._requests_total += 1
-            self._requests_failed += 1
-            return web.Response(
-                status=503,
-                text=f'No ready replicas for service '
-                     f'{self.service_name!r}. Use `sky-tpu serve status` '
-                     f'to check replica health.\n')
-        self._pending_requests += 1
-        self._requests_total += 1
-        self._inflight += 1
-        t_arrival = time.monotonic()
-        self.policy.pre_execute(url)
+    def _select(self, tried: Set[str]) -> Optional[str]:
+        """Pick the next replica: the policy's choice if its breaker
+        admits it, else the first admissible candidate. If EVERY
+        breaker is open, fail open with any untried replica — turning
+        a possibly-wrong breaker into a total blackout is worse than
+        one wasted probe."""
+        candidates = [u for u in self.policy.ready_urls if u not in tried]
+        if not candidates:
+            return None
+        blocked: Set[str] = set()
+        # Bounded walk of policy picks (least-load may repeat itself).
+        for _ in range(len(self.policy.ready_urls) + 1):
+            url = self.policy.select_replica()
+            if url is None:
+                break
+            if url in tried or url in blocked:
+                continue
+            if self.breaker.allows(url):
+                return url
+            blocked.add(url)
+            if len(blocked) == len(candidates):
+                break
+        for url in candidates:
+            if url not in blocked and self.breaker.allows(url):
+                return url
+        # Every untried candidate's breaker is open: fail open with one
+        # anyway (a possibly-wrong breaker must not become a blackout).
+        return candidates[0]
+
+    async def _proxy_attempt(self, request: web.Request, url: str,
+                             body: bytes, headers: Dict[str, str],
+                             t_arrival: float):
+        """One proxy attempt to ``url``. Raises _PreStreamFailure when
+        nothing has been sent to the client yet (retryable); any
+        response it returns has been (at least partially) delivered.
+        Returns ``(resp, replica_ok)`` — ``replica_ok`` False means the
+        replica misbehaved even though bytes were delivered (died
+        mid-stream, or answered 5xx): not retryable, but a breaker
+        failure all the same, so a listening-but-wedged replica that
+        500s every request still trips out of the rotation."""
         resp: Optional[web.StreamResponse] = None
         # LB → replica is a traced hop: adopt the caller's context (if
         # any) and pass ours downstream, so serve-path TTFT decomposes
         # into LB time vs replica time. Span recording closes with the
-        # proxied response (stack.close() in the finally); the proxy
+        # proxied response (stack.aclose() in the finally); the proxy
         # loop stays allocation-free when tracing is off.
-        stack = contextlib.ExitStack()
+        stack = contextlib.AsyncExitStack()
         try:
             target = url.rstrip('/') + request.path_qs
-            headers = {k: v for k, v in request.headers.items()
-                       if k.lower() not in _HOP_HEADERS}
             if trace_lib.enabled():
                 with contextlib.suppress(Exception):
                     stack.enter_context(trace_lib.context_from(
@@ -152,25 +208,36 @@ class LoadBalancer:
                         'lb.proxy', hop='serve-lb', replica=url,
                         path=request.path))
                     trace_lib.inject_headers(headers)
-            body = await request.read()
+            try:
+                # Chaos seam: an injected error here behaves exactly
+                # like a replica that died pre-stream (failover +
+                # breaker bookkeeping), no real replica kill needed.
+                await failpoints.hit_async('lb.proxy')
+            except failpoints.FailpointError as e:
+                raise _PreStreamFailure(e) from e
             assert self._session is not None
-            async with self._session.request(
+            try:
+                upstream_cm = self._session.request(
                     request.method, target, headers=headers,
-                    data=body or None,
-                    allow_redirects=False) as upstream:
-                # Replica-level errors are failures for the metrics even
-                # though we faithfully proxy them — and their (instant)
-                # latency must not pollute the TTFT distribution.
-                upstream_ok = upstream.status < 500
-                if not upstream_ok:
-                    self._requests_failed += 1
+                    data=body or None, allow_redirects=False)
+                upstream = await stack.enter_async_context(upstream_cm)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                raise _PreStreamFailure(e) from e
+            # Replica-level errors are failures for the metrics even
+            # though we faithfully proxy them — and their (instant)
+            # latency must not pollute the TTFT distribution.
+            upstream_ok = upstream.status < 500
+            if not upstream_ok:
+                self._requests_failed += 1
+            try:
                 resp = web.StreamResponse(
                     status=upstream.status,
                     headers={k: v for k, v in upstream.headers.items()
                              if k.lower() not in _HOP_HEADERS})
                 await resp.prepare(request)
                 first = True
-                async for chunk in upstream.content.iter_chunked(64 * 1024):
+                async for chunk in upstream.content.iter_chunked(
+                        64 * 1024):
                     if first and upstream_ok:
                         self._ttfts.append(time.monotonic() - t_arrival)
                     first = False
@@ -178,26 +245,103 @@ class LoadBalancer:
                 if first and upstream_ok:  # empty body: headers counted
                     self._ttfts.append(time.monotonic() - t_arrival)
                 await resp.write_eof()
-                return resp
-        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-            self._requests_failed += 1
-            if resp is not None and resp.prepared:
+                return resp, upstream_ok
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                if resp is None or not resp.prepared:
+                    raise _PreStreamFailure(e) from e
                 # Headers (and possibly body) already went out: a 502
                 # now would corrupt the stream with a second status
-                # line. Terminate the response; the truncation IS the
-                # client's error signal.
+                # line, and a retry would replay delivered bytes.
+                # Terminate the response; the truncation IS the
+                # client's error signal. (A 5xx upstream was already
+                # counted failed above — don't count it twice.)
+                if upstream_ok:
+                    self._requests_failed += 1
                 logger.warning('replica %s died mid-stream: %s', url, e)
                 with contextlib.suppress(Exception):
                     await resp.write_eof()
-                return resp
-            return web.Response(
-                status=502,
-                text=f'Replica {url} failed: {type(e).__name__}: {e}\n')
+                return resp, False
         finally:
             with contextlib.suppress(Exception):
-                stack.close()
+                await stack.aclose()
+
+    async def handle(self, request: web.Request) -> web.StreamResponse:
+        if request.path == '/-/urls':   # introspection endpoint
+            return web.json_response(
+                {'ready_replica_urls': list(self.policy.ready_urls)})
+        if request.path == '/-/metrics':
+            return web.json_response(self.lb_metrics())
+        self._requests_total += 1
+        t_arrival = time.monotonic()
+        # Body read comes FIRST: nothing is selected or counted yet, so
+        # a client disconnecting mid-upload cannot leak the inflight
+        # gauge or burn a half-open breaker probe slot.
+        body = await request.read()
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in _HOP_HEADERS}
+        tried: Set[str] = set()
+        url = self._select(tried)
+        if url is None:
+            self._requests_no_replica += 1
+            return web.Response(
+                status=503,
+                # Capacity usually returns within a sync interval or
+                # two once a replica recovers; tell clients when to
+                # come back instead of letting them hammer.
+                headers={'Retry-After': str(max(
+                    1, int(SYNC_INTERVAL_S * 2)))},
+                text=f'No ready replicas for service '
+                     f'{self.service_name!r}. Use `sky-tpu serve status` '
+                     f'to check replica health.\n')
+        self._pending_requests += 1
+        self._inflight += 1
+        last_failure: Optional[_PreStreamFailure] = None
+        try:
+            while url is not None:
+                current = url
+                self.policy.pre_execute(current)
+                try:
+                    resp, replica_ok = await self._proxy_attempt(
+                        request, current, body, headers, t_arrival)
+                    # Mid-stream death / a 5xx answer is delivered
+                    # (can't retry) but it is still a replica failure —
+                    # it must feed the breaker, not reset it.
+                    if replica_ok:
+                        self.breaker.record_success(current)
+                    else:
+                        self.breaker.record_failure(current)
+                    return resp
+                except _PreStreamFailure as e:
+                    self.breaker.record_failure(current)
+                    tried.add(current)
+                    last_failure = e
+                    next_url = self._select(tried)
+                    if next_url is not None:
+                        self._requests_retried += 1
+                        logger.warning(
+                            'replica %s failed pre-stream (%s); '
+                            'retrying on %s', current,
+                            type(e.cause).__name__, next_url)
+                    url = next_url
+                except BaseException:
+                    # Died of something that is NOT the replica's fault
+                    # (client disconnect mid-write, task cancellation):
+                    # hand back any half-open probe slot _select may
+                    # have consumed, or the replica stays blacklisted
+                    # with probing=True forever.
+                    self.breaker.release(current)
+                    raise
+                finally:
+                    self.policy.post_execute(current)
+            # Every ready replica failed pre-stream.
+            self._requests_failed += 1
+            cause = last_failure.cause if last_failure else None
+            return web.Response(
+                status=502,
+                text=f'All {len(tried)} ready replica(s) failed: '
+                     f'{type(cause).__name__}: {cause}\n')
+        finally:
             self._inflight -= 1
-            self.policy.post_execute(url)
 
     # -- lifecycle ---------------------------------------------------------
     def make_app(self) -> web.Application:

@@ -33,6 +33,7 @@ from skypilot_tpu.serve import spot_placer as spot_placer_lib
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.serve.state import ReplicaStatus
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import failpoints
 
 logger = logging.getLogger(__name__)
 
@@ -227,6 +228,13 @@ class ReplicaManager:
             return False
 
     def _probe(self, replica: dict) -> bool:
+        # Chaos seam: `serve.probe=error:1@N` fails the next N readiness
+        # probes (driving NOT_READY / replacement without touching the
+        # replica); `delay` simulates a slow health endpoint.
+        try:
+            failpoints.hit('serve.probe')
+        except failpoints.FailpointError:
+            return False
         if self.spec.pool:
             return self._probe_pool_worker(replica['cluster_name'])
         return self._probe_url(replica['url'])

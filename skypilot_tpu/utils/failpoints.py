@@ -1,0 +1,229 @@
+"""Named, env-configurable fault-injection sites (failpoints).
+
+The deterministic chaos seam for the recovery paths the paper's workload
+model lives or dies by (whole-gang spot preemption, agent restarts,
+replica death): production code declares *sites* —
+``failpoints.hit('provision.create')`` — and an operator (or the chaos
+test suite) arms them through one env var:
+
+    SKY_TPU_FAILPOINTS='provision.create=error:0.5,agent.submit=delay:2,\
+agent.health=error:1@3'
+
+Spec grammar (comma-separated entries)::
+
+    <site>=<action>[:<arg>[:<prob>]][@<count>]
+
+    error[:p]            raise FailpointError with probability p (def. 1)
+    delay:seconds[:p]    sleep `seconds` with probability p
+    hang[:p]             sleep SKY_TPU_FAILPOINT_HANG_S (default 3600)
+
+    @N                   fire-count budget: the site fires at most N
+                         times, then goes inert (probability rolls that
+                         do not fire don't consume budget)
+
+Discipline (mirrors ``SKY_TPU_TRACE``): with the env var unset, ``hit``
+is a single ``os.environ.get`` miss and an immediate return — no parsing,
+no allocation, no lock. The spec is parsed once per distinct env value,
+so tests may arm/disarm sites mid-process via monkeypatch.setenv. A
+malformed spec raises ``FailpointSpecError`` loudly at first use:
+failpoints are only ever set deliberately, and a typo silently injecting
+nothing would invalidate the chaos run it was meant to drive.
+
+Sites are just strings; the catalog of live sites is documented in
+docs/robustness.md (kept in sync by the chaos suite).
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import threading
+import time
+from typing import Dict, Optional
+
+ENV_VAR = 'SKY_TPU_FAILPOINTS'
+HANG_ENV_VAR = 'SKY_TPU_FAILPOINT_HANG_S'
+
+_ACTIONS = ('error', 'delay', 'hang')
+
+
+class FailpointError(Exception):
+    """The injected failure. Deliberately a plain Exception so each
+    layer's *generic* transient-error handling must absorb it (the point
+    of the exercise) — except where a site's contract says otherwise."""
+
+
+class FailpointSpecError(ValueError):
+    """SKY_TPU_FAILPOINTS could not be parsed."""
+
+
+class _Site:
+    __slots__ = ('name', 'action', 'arg', 'prob', 'budget', 'fired',
+                 '_lock')
+
+    def __init__(self, name: str, action: str, arg: float, prob: float,
+                 budget: Optional[int]) -> None:
+        self.name = name
+        self.action = action
+        self.arg = arg
+        self.prob = prob
+        self.budget = budget
+        self.fired = 0
+        self._lock = threading.Lock()
+
+    def take(self) -> bool:
+        """Decide (atomically w.r.t. the budget) whether this hit fires."""
+        with self._lock:
+            if self.budget is not None and self.fired >= self.budget:
+                return False
+            if self.prob >= 1.0:
+                pass
+            elif self.prob <= 0.0:
+                return False
+            elif random.random() >= self.prob:
+                return False
+            self.fired += 1
+            return True
+
+
+def _parse_float(token: str, what: str, entry: str) -> float:
+    try:
+        return float(token)
+    except ValueError as e:
+        raise FailpointSpecError(
+            f'bad {ENV_VAR} entry {entry!r}: {what} {token!r} is not a '
+            f'number') from e
+
+
+def parse_specs(spec: str) -> Dict[str, _Site]:
+    """Parse a SKY_TPU_FAILPOINTS value. Raises FailpointSpecError with
+    the offending entry named on any malformation."""
+    sites: Dict[str, _Site] = {}
+    for entry in spec.split(','):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, sep, rhs = entry.partition('=')
+        site = site.strip()
+        if not sep or not site or not rhs:
+            raise FailpointSpecError(
+                f'bad {ENV_VAR} entry {entry!r}: expected '
+                f'<site>=<action>[:<arg>[:<prob>]][@<count>]')
+        rhs, at, count_s = rhs.partition('@')
+        budget: Optional[int] = None
+        if at:
+            try:
+                budget = int(count_s)
+            except ValueError as e:
+                raise FailpointSpecError(
+                    f'bad {ENV_VAR} entry {entry!r}: fire-count '
+                    f'{count_s!r} is not an integer') from e
+            if budget < 0:
+                raise FailpointSpecError(
+                    f'bad {ENV_VAR} entry {entry!r}: fire-count must '
+                    f'be >= 0')
+        parts = rhs.split(':')
+        action = parts[0].strip()
+        if action not in _ACTIONS:
+            raise FailpointSpecError(
+                f'bad {ENV_VAR} entry {entry!r}: unknown action '
+                f'{action!r}; choose from {list(_ACTIONS)}')
+        arg = 0.0
+        prob = 1.0
+        if action == 'error':
+            if len(parts) > 2:
+                raise FailpointSpecError(
+                    f'bad {ENV_VAR} entry {entry!r}: error takes at '
+                    f'most one argument (probability)')
+            if len(parts) == 2:
+                prob = _parse_float(parts[1], 'probability', entry)
+        elif action == 'delay':
+            if len(parts) < 2 or len(parts) > 3:
+                raise FailpointSpecError(
+                    f'bad {ENV_VAR} entry {entry!r}: delay needs '
+                    f'seconds (delay:<s>[:<prob>])')
+            arg = _parse_float(parts[1], 'delay seconds', entry)
+            if len(parts) == 3:
+                prob = _parse_float(parts[2], 'probability', entry)
+        else:   # hang
+            if len(parts) > 2:
+                raise FailpointSpecError(
+                    f'bad {ENV_VAR} entry {entry!r}: hang takes at '
+                    f'most one argument (probability)')
+            if len(parts) == 2:
+                prob = _parse_float(parts[1], 'probability', entry)
+        if not 0.0 <= prob <= 1.0:
+            raise FailpointSpecError(
+                f'bad {ENV_VAR} entry {entry!r}: probability {prob} '
+                f'outside [0, 1]')
+        if arg < 0:
+            raise FailpointSpecError(
+                f'bad {ENV_VAR} entry {entry!r}: delay must be >= 0')
+        sites[site] = _Site(site, action, arg, prob, budget)
+    return sites
+
+
+# Parsed-spec cache, keyed by the env value it was parsed from so a test
+# re-arming SKY_TPU_FAILPOINTS mid-process takes effect on the next hit
+# (and so fire-count state survives across hits of an unchanged spec).
+_cached_env: Optional[str] = None
+_sites: Dict[str, _Site] = {}
+_load_lock = threading.Lock()
+
+
+def _lookup(site: str) -> Optional[_Site]:
+    global _cached_env, _sites
+    env = os.environ.get(ENV_VAR)
+    if env != _cached_env:
+        with _load_lock:
+            if env != _cached_env:
+                _sites = parse_specs(env) if env else {}
+                _cached_env = env
+    fp = _sites.get(site)
+    if fp is None or not fp.take():
+        return None
+    return fp
+
+
+def _hang_s() -> float:
+    return float(os.environ.get(HANG_ENV_VAR, '3600'))
+
+
+def hit(site: str) -> None:
+    """Evaluate failpoint ``site``. The production no-op: with
+    SKY_TPU_FAILPOINTS unset this is one env-dict miss and a return."""
+    if os.environ.get(ENV_VAR) is None:
+        return
+    fp = _lookup(site)
+    if fp is None:
+        return
+    if fp.action == 'error':
+        raise FailpointError(f'injected failure at failpoint {site!r}')
+    time.sleep(fp.arg if fp.action == 'delay' else _hang_s())
+
+
+async def hit_async(site: str) -> None:
+    """``hit`` for event-loop code paths (agent handlers, the LB proxy):
+    delay/hang park on asyncio.sleep instead of blocking the loop."""
+    if os.environ.get(ENV_VAR) is None:
+        return
+    fp = _lookup(site)
+    if fp is None:
+        return
+    if fp.action == 'error':
+        raise FailpointError(f'injected failure at failpoint {site!r}')
+    await asyncio.sleep(fp.arg if fp.action == 'delay' else _hang_s())
+
+
+def fired(site: str) -> int:
+    """How many times ``site`` has fired under the current spec
+    (introspection for tests; 0 for unarmed sites)."""
+    fp = _sites.get(site)
+    return fp.fired if fp is not None else 0
+
+
+def _reset_for_tests() -> None:
+    global _cached_env, _sites
+    with _load_lock:
+        _cached_env = None
+        _sites = {}

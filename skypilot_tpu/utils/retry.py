@@ -1,0 +1,247 @@
+"""The one retry/backoff policy (plus the serve LB's circuit breaker).
+
+Before this module, every hop hand-rolled its own loop: the SDK retried
+GETs with bare exponential sleep, ``AgentClient`` did not retry at all,
+and the serve LB turned any pre-stream connection error into a 502.
+``Retrier`` replaces all of them with a single policy:
+
+- exponential backoff with **full jitter** (AWS-style: the delay is
+  uniform in [0, min(cap, base * 2^attempt)] — synchronized clients
+  hammering a recovering agent is exactly the thundering herd a gang
+  restart produces);
+- an **overall deadline** in addition to the attempt cap, so callers on
+  a budget (the LB, provisioning) bound wall clock, not just tries;
+- **transient vs fatal classification** by exception type — fatal wins
+  when both match, and anything matching neither propagates immediately
+  (an unknown error is not license to hammer);
+- every retry is recorded as a zero-duration span on the active trace
+  (``retry.<name>``), so `sky-tpu trace` shows *where* a request's
+  latency went to backoff.
+
+``CircuitBreaker`` is the replica-level complement used by the serve
+load balancer: consecutive pre-stream failures trip a replica OPEN
+(never selected); after a cooldown it goes HALF_OPEN and admits exactly
+one probe request — success closes it, failure re-opens and restarts
+the cooldown.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+from skypilot_tpu.observability import trace as trace_lib
+
+# Default transient set: connection-shaped trouble. requests exceptions
+# subclass OSError via ConnectionError only sometimes, so adopters pass
+# their own tuple when the transport is requests.
+DEFAULT_TRANSIENT: Tuple[Type[BaseException], ...] = (
+    ConnectionError, TimeoutError, OSError)
+
+
+def _record_retry_event(name: str, attempt: int, delay_s: float,
+                        exc: BaseException) -> None:
+    if not trace_lib.enabled():
+        return
+    try:
+        parent = trace_lib.current()
+        trace_lib.record_span(
+            name=f'retry.{name}',
+            trace_id=(parent.trace_id if parent
+                      else os.urandom(16).hex()),
+            span_id=os.urandom(8).hex(),
+            parent_id=parent.span_id if parent else None,
+            start=time.time(), dur_s=0.0,
+            status=f'retry:{type(exc).__name__}',
+            hop=trace_lib.get_hop(),
+            attrs={'attempt': attempt, 'delay_s': round(delay_s, 4),
+                   'error': str(exc)[:200]})
+    except Exception:  # noqa: BLE001 — observability must not fail calls
+        pass
+
+
+class Retrier:
+    """Call a function under the shared retry policy.
+
+    ``transient`` exceptions are retried while attempts and the deadline
+    allow; ``fatal`` exceptions (checked first) and anything matching
+    neither propagate immediately. ``retry_on`` gives callers a
+    predicate escape hatch (e.g. "HTTPError but only 5xx").
+    """
+
+    def __init__(self, name: str, *,
+                 max_attempts: int = 4,
+                 base_delay_s: float = 0.2,
+                 max_delay_s: float = 10.0,
+                 deadline_s: Optional[float] = None,
+                 transient: Tuple[Type[BaseException], ...] =
+                 DEFAULT_TRANSIENT,
+                 fatal: Tuple[Type[BaseException], ...] = (),
+                 retry_on: Optional[
+                     Callable[[BaseException], bool]] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: Callable[[], float] = random.random) -> None:
+        if max_attempts < 1:
+            raise ValueError('max_attempts must be >= 1')
+        self.name = name
+        self.max_attempts = max_attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.deadline_s = deadline_s
+        self.transient = transient
+        self.fatal = fatal
+        self.retry_on = retry_on
+        self._sleep = sleep
+        self._rng = rng
+
+    def _classify_transient(self, exc: BaseException) -> bool:
+        if isinstance(exc, self.fatal):
+            return False
+        if self.retry_on is not None and self.retry_on(exc):
+            return True
+        return isinstance(exc, self.transient)
+
+    def backoff_s(self, attempt: int) -> float:
+        """Full-jitter delay before retry number ``attempt`` (1-based)."""
+        cap = min(self.max_delay_s,
+                  self.base_delay_s * (2 ** (attempt - 1)))
+        return self._rng() * cap
+
+    def call(self, fn: Callable[..., Any], *args: Any,
+             **kwargs: Any) -> Any:
+        deadline = (time.monotonic() + self.deadline_s
+                    if self.deadline_s is not None else None)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if not self._classify_transient(e):
+                    raise
+                if attempt >= self.max_attempts:
+                    raise
+                delay = self.backoff_s(attempt)
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise
+                    delay = min(delay, remaining)
+                _record_retry_event(self.name, attempt, delay, e)
+                self._sleep(delay)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker (per-key; the LB keys by replica URL).
+
+STATE_CLOSED = 'closed'
+STATE_OPEN = 'open'
+STATE_HALF_OPEN = 'half-open'
+
+
+class _Breaker:
+    __slots__ = ('failures', 'opened_at', 'probing')
+
+    def __init__(self) -> None:
+        self.failures = 0
+        self.opened_at: Optional[float] = None
+        self.probing = False
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker over a dynamic key set.
+
+    closed --[threshold consecutive failures]--> open
+    open   --[cooldown elapsed]--> half-open (one probe admitted)
+    half-open --success--> closed | --failure--> open (cooldown restarts)
+
+    Keys never seen (or pruned) are closed. Thread-safe; ``allows`` is
+    the hot-path call and is one dict lookup for closed keys.
+    """
+
+    def __init__(self, *, failure_threshold: int = 3,
+                 cooldown_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if failure_threshold < 1:
+            raise ValueError('failure_threshold must be >= 1')
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, _Breaker] = {}
+
+    def _get(self, key: str) -> _Breaker:
+        b = self._breakers.get(key)
+        if b is None:
+            b = self._breakers[key] = _Breaker()
+        return b
+
+    def state(self, key: str) -> str:
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None or b.opened_at is None:
+                return STATE_CLOSED
+            if self._clock() - b.opened_at >= self.cooldown_s:
+                return STATE_HALF_OPEN
+            return STATE_OPEN
+
+    def allows(self, key: str) -> bool:
+        """May a request be sent to ``key`` right now? In HALF_OPEN only
+        the first caller gets True (the probe); others wait."""
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None or b.opened_at is None:
+                return True
+            if self._clock() - b.opened_at < self.cooldown_s:
+                return False
+            if b.probing:
+                return False
+            b.probing = True
+            return True
+
+    def record_success(self, key: str) -> None:
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None:
+                return
+            b.failures = 0
+            b.opened_at = None
+            b.probing = False
+
+    def release(self, key: str) -> None:
+        """Give back an admitted half-open probe slot WITHOUT recording
+        an outcome — for attempts that died of causes unrelated to the
+        replica (e.g. the client disconnected). Without this, a probe
+        that never reports back would blacklist the key forever."""
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is not None:
+                b.probing = False
+
+    def record_failure(self, key: str) -> None:
+        with self._lock:
+            b = self._get(key)
+            b.failures += 1
+            if b.opened_at is not None:
+                # Failed half-open probe (or a straggler request that
+                # was in flight when the breaker tripped): re-open and
+                # restart the cooldown.
+                b.opened_at = self._clock()
+                b.probing = False
+            elif b.failures >= self.failure_threshold:
+                b.opened_at = self._clock()
+                b.probing = False
+
+    def prune(self, live_keys) -> None:
+        """Drop state for keys no longer in the live set (dead replicas
+        must not pin breaker state forever)."""
+        live = set(live_keys)
+        with self._lock:
+            for k in list(self._breakers):
+                if k not in live:
+                    del self._breakers[k]
+
+    def snapshot(self) -> Dict[str, str]:
+        return {k: self.state(k) for k in list(self._breakers)}

@@ -36,7 +36,10 @@ class ChaosProxy:
         self._stop = threading.Event()
         self._conns: List[socket.socket] = []
         self._conns_lock = threading.Lock()
+        # Loop threads AND per-connection forwarder threads: stop()
+        # joins them all (they used to leak, one pair per connection).
         self._threads: List[threading.Thread] = []
+        self._threads_lock = threading.Lock()
         self.kills = 0
 
     # ---- lifecycle -----------------------------------------------------
@@ -44,16 +47,30 @@ class ChaosProxy:
         for fn in (self._accept_loop, self._chaos_loop):
             t = threading.Thread(target=fn, daemon=True)
             t.start()
-            self._threads.append(t)
+            with self._threads_lock:
+                self._threads.append(t)
         return self
 
-    def stop(self) -> None:
+    def stop(self, join_timeout_s: float = 2.0) -> None:
+        """Stop and reap. Joins every loop/forwarder thread with a
+        bounded timeout and closes both ends of each proxied pair, so
+        repeated chaos tests in one pytest process don't accumulate
+        daemon threads or leaked upstream sockets."""
         self._stop.set()
         try:
             self._listener.close()
         except OSError:
             pass
+        # _kill_all shutdown+closes BOTH sockets of every proxied pair,
+        # which also unblocks their forwarder threads' recv().
         self._kill_all()
+        deadline = time.monotonic() + join_timeout_s
+        with self._threads_lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        with self._threads_lock:
+            self._threads = [t for t in self._threads if t.is_alive()]
 
     # ---- internals -----------------------------------------------------
     def _accept_loop(self) -> None:
@@ -74,6 +91,14 @@ class ChaosProxy:
                 t = threading.Thread(target=self._pipe, args=(a, b),
                                      daemon=True)
                 t.start()
+                with self._threads_lock:
+                    self._threads.append(t)
+            # Opportunistic sweep so a long-lived proxy under heavy
+            # connection churn doesn't grow the list without bound.
+            with self._threads_lock:
+                if len(self._threads) > 256:
+                    self._threads = [x for x in self._threads
+                                     if x.is_alive()]
 
     def _pipe(self, src: socket.socket, dst: socket.socket) -> None:
         try:

@@ -1,10 +1,17 @@
 """Client/server resilience under connection chaos (reference
-tests/chaos: a killer TCP proxy between client and API server)."""
+tests/chaos: a killer TCP proxy between client and API server).
+
+Interval-driven (the proxy kills on a timer, so each case needs many
+wall-clock seconds of traffic): marked slow + chaos. The fast,
+deterministic failpoint-driven cases live in test_failpoints_chaos.py
+and run in tier-1."""
 import time
 
 import pytest
 
 from tests.chaos.chaos_proxy import ChaosProxy
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
 
 
 @pytest.fixture

@@ -1,0 +1,376 @@
+"""Failpoint-driven chaos suite (fast, deterministic — runs in tier-1).
+
+Each case arms SKY_TPU_FAILPOINTS and asserts the REAL recovery path
+absorbs the injected fault: the managed-jobs controller survives a
+whole-slice preemption storm, AgentClient retries through agent
+failures and restarts, and the serve LB fails over pre-stream so a dead
+replica costs zero client-visible errors. The interval-driven
+ChaosProxy cases (marked slow) live in test_chaos.py.
+"""
+import asyncio
+import http.server
+import os
+import threading
+import time
+
+import pytest
+import requests as req_lib
+
+import skypilot_tpu as sky
+from skypilot_tpu import execution
+from skypilot_tpu import jobs
+from skypilot_tpu.jobs import controller as controller_lib
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.runtime import agent_client
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.utils import common as common_lib
+from skypilot_tpu.utils import failpoints
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints(monkeypatch):
+    """Failpoint state (fire budgets) is per-process and cached per env
+    value: reset around every test so a spec string reused across tests
+    starts with a fresh budget."""
+    failpoints._reset_for_tests()
+    yield
+    failpoints._reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def fast_timers(monkeypatch):
+    monkeypatch.setattr(controller_lib, '_POLL_S', 0.1)
+    monkeypatch.setattr(recovery_strategy, '_RETRY_GAP_S', 0.1)
+    yield
+
+
+def _task(run, name='fpj', **res_kw):
+    return sky.Task(name, run=run,
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4',
+                                            **res_kw))
+
+
+def test_preemption_storm_eager_failover(monkeypatch):
+    """Acceptance: a managed job reaches SUCCEEDED through >= 3 injected
+    whole-slice preemptions under EAGER_FAILOVER. The storm is driven
+    entirely by the `jobs.provider.preempted` failpoint — each firing
+    makes one monitor tick see the slice as dead, driving the full
+    terminate → failover-relaunch → resubmit path."""
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'jobs.provider.preempted=error:1@3')
+    # The run command exits 0 only once the strategy's injected
+    # SKY_TPU_RECOVERY_COUNT shows three recoveries happened; earlier
+    # attempts park until preempted.
+    run = ('if [ "${SKY_TPU_RECOVERY_COUNT:-0}" -ge 3 ]; then exit 0; '
+           'fi; sleep 600')
+    monkeypatch.setattr(scheduler, '_spawn_controller',
+                        lambda job_id: None)
+    job_id = jobs.launch(
+        _task(run, use_spot=True, job_recovery='EAGER_FAILOVER'))
+    final = controller_lib.JobController(job_id).run()
+    assert final == ManagedJobStatus.SUCCEEDED
+    record = jobs_state.get_job(job_id)
+    assert record['recovery_count'] >= 3
+    assert failpoints.fired('jobs.provider.preempted') == 3
+
+
+def test_agent_client_retries_through_injected_agent_errors(monkeypatch):
+    """Acceptance: AgentClient calls succeed through transient agent
+    errors. `agent.submit=error:1@2` makes the agent daemon 500 the
+    first two /submit calls (the agent inherits the env at provision
+    time); the launch's submit must retry through them and the job must
+    still run."""
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS', 'agent.submit=error:1@2')
+    monkeypatch.setenv('SKY_TPU_AGENT_RETRIES', '5')
+    task = _task('echo FP_SUBMIT_OK', name='fp-submit')
+    job_id, info = execution.launch(task, cluster_name='fp-submit-c')
+    assert job_id >= 1
+    client = agent_client.AgentClient.for_info(info)
+    assert client.wait_job(job_id, timeout=60).value == 'SUCCEEDED'
+    # The injected failures really happened server-side: the agent log
+    # carries the failpoint tracebacks the retries absorbed.
+    cdir = info.provider_config['cluster_dir']
+    with open(os.path.join(cdir, 'agent.log'), encoding='utf-8',
+              errors='replace') as f:
+        assert 'FailpointError' in f.read()
+    sky.down('fp-submit-c')
+
+
+def test_agent_client_retries_client_side_failpoint(monkeypatch):
+    """Client-side seam: `agent_client.request` fires in the CALLER's
+    process and is classified transient, so budgeted injections are
+    absorbed by the shared Retrier."""
+    task = _task('echo up', name='fp-client')
+    _, info = execution.launch(task, cluster_name='fp-client-c')
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'agent_client.request=error:1@2')
+    client = agent_client.AgentClient.for_info(info)
+    assert client.health()['status'] == 'healthy'
+    assert failpoints.fired('agent_client.request') == 2
+    # Budget exhausted: calls keep succeeding.
+    assert client.health()['status'] == 'healthy'
+    monkeypatch.delenv('SKY_TPU_FAILPOINTS')
+    sky.down('fp-client-c')
+
+
+def test_agent_kill_restart(monkeypatch):
+    """Kill the on-host agent mid-job and restart it: the job table
+    persists, the orphaned in-flight job is reconciled to FAILED
+    instead of wedging the FIFO scheduler forever, and a client built
+    from refreshed cluster info works immediately."""
+    from skypilot_tpu import provision
+    from skypilot_tpu.provision.local import instance as local_instance
+    task = _task('echo AGENT_RESTART_OK', name='fp-kill')
+    job_id, info = execution.launch(task, cluster_name='fp-kill-c')
+    client = agent_client.AgentClient.for_info(info)
+    assert client.wait_job(job_id, timeout=60).value == 'SUCCEEDED'
+    cdir = info.provider_config['cluster_dir']
+
+    # An in-flight job at kill time: without startup reconciliation its
+    # stale RUNNING row blocks every later PENDING job (the restart-
+    # wedge bug this suite exists to catch).
+    stuck = client.submit('stuck', 'sleep 600')
+    deadline = time.time() + 30
+    while (time.time() < deadline and
+           client.job_status(stuck).value == 'PENDING'):
+        time.sleep(0.2)
+    assert client.job_status(stuck).value in ('INIT', 'SETTING_UP',
+                                              'RUNNING')
+    local_instance._kill_agent(cdir)
+    # Dead agent: the retrying client fails (bounded — no hang) ...
+    monkeypatch.setenv('SKY_TPU_AGENT_RETRIES', '2')
+    with pytest.raises(Exception):
+        agent_client.AgentClient.for_info(info, timeout=2).health()
+
+    # ... restart (new port), refresh the info, and everything works.
+    local_instance._start_agent('fp-kill-c')
+    info2 = provision.get_cluster_info('local', 'fp-kill-c',
+                                       info.provider_config)
+    client2 = agent_client.AgentClient.for_info(info2)
+    client2.wait_healthy(timeout=30)
+    # Pre-restart records survived; the orphan was reconciled FAILED.
+    assert client2.job_status(job_id).value == 'SUCCEEDED'
+    assert client2.job_status(stuck).value == 'FAILED'
+    # The queue is NOT wedged: a fresh job runs to completion.
+    job2 = client2.submit('post-restart', 'echo AFTER_RESTART')
+    assert client2.wait_job(job2, timeout=60).value == 'SUCCEEDED'
+    sky.down('fp-kill-c')
+
+
+def test_submit_retry_is_idempotent(monkeypatch):
+    """The retried-submit hazard: a response lost AFTER the agent
+    committed the job row must not double-run the job. The client
+    stamps a submit_id; re-POSTing it returns the SAME job_id."""
+    from skypilot_tpu.provision.common import ProvisionConfig
+    from skypilot_tpu.provision.local import instance as local_instance
+    from skypilot_tpu.utils import tls
+    cfg = ProvisionConfig(
+        cluster_name='fp-idem', region='local', zone='local',
+        instance_type='tpu-v5e-1', num_hosts=1, tpu_slice='v5e-1',
+        provider_config={})
+    info = local_instance.run_instances(cfg)
+    try:
+        client = agent_client.AgentClient.for_info(info)
+        client.wait_healthy()
+        sess = tls.pinned_session(
+            info.provider_config['agent_cert_fingerprint'])
+        url = info.head.agent_url
+        headers = {'Authorization':
+                   f'Bearer {info.provider_config["agent_token"]}'}
+        payload = {'name': 'idem', 'run': 'echo idem',
+                   'envs': {}, 'submit_id': 'retry-replay-1'}
+        r1 = sess.post(f'{url}/submit', json=payload, headers=headers,
+                       timeout=10).json()
+        r2 = sess.post(f'{url}/submit', json=payload, headers=headers,
+                       timeout=10).json()
+        assert r1['job_id'] == r2['job_id']
+        # A DIFFERENT submit_id is a new logical submit.
+        payload['submit_id'] = 'retry-replay-2'
+        r3 = sess.post(f'{url}/submit', json=payload, headers=headers,
+                       timeout=10).json()
+        assert r3['job_id'] != r1['job_id']
+        # AgentClient.submit sends a fresh id per call (two calls, two
+        # jobs) while its internal retries share one.
+        j1 = client.submit('idem-c', 'echo a')
+        j2 = client.submit('idem-c', 'echo a')
+        assert j1 != j2
+    finally:
+        local_instance.terminate_instances('fp-idem', {})
+
+
+class _Replica(http.server.BaseHTTPRequestHandler):
+    payload = b'replica-ok'
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        body = self.payload
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # silence per-request stderr noise
+        pass
+
+
+def _start_replica() -> http.server.ThreadingHTTPServer:
+    srv = http.server.ThreadingHTTPServer(('127.0.0.1', 0), _Replica)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _start_lb(service_name: str, urls):
+    """Seed serve-state rows (the sync loop reads them) and run an LB.
+
+    Returns (lb, port, stop)."""
+    serve_state.add_service(service_name, spec_json='{}', task_yaml='',
+                            lb_port=0, lb_policy='round_robin')
+    for i, url in enumerate(urls):
+        rid = serve_state.add_replica(service_name, f'{service_name}-r{i}',
+                                      version=1)
+        serve_state.set_replica_url(rid, url)
+        serve_state.set_replica_status(rid,
+                                       serve_state.ReplicaStatus.READY)
+    lb = lb_lib.LoadBalancer(service_name, 'round_robin')
+    lb.policy.set_ready_replicas(list(urls))
+    port = common_lib.free_port()
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(lb.run('127.0.0.1', port))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            if req_lib.get(f'http://127.0.0.1:{port}/-/urls',
+                           timeout=1).ok:
+                break
+        except req_lib.RequestException:
+            time.sleep(0.1)
+
+    def stop():
+        lb._running = False  # noqa: SLF001 — test teardown
+        t.join(timeout=10)
+
+    return lb, port, stop
+
+
+def test_lb_replica_death_zero_client_errors():
+    """Acceptance: killing one replica pre-stream yields ZERO
+    client-visible failures — the LB retries onto the survivor and the
+    dead replica's breaker trips so it stops being selected."""
+    alive = _start_replica()
+    dead = _start_replica()
+    alive_url = f'http://127.0.0.1:{alive.server_address[1]}'
+    dead_url = f'http://127.0.0.1:{dead.server_address[1]}'
+    lb, port, stop = _start_lb('svc-fp-death', [alive_url, dead_url])
+    try:
+        base = f'http://127.0.0.1:{port}'
+        # Warm both replicas through the LB.
+        for _ in range(4):
+            assert req_lib.get(base, timeout=5).status_code == 200
+
+        # Kill one replica hard (closed listener == connection refused,
+        # exactly what a dead slice's port looks like pre-stream).
+        dead.shutdown()
+        dead.server_close()
+
+        for _ in range(12):
+            r = req_lib.get(base, timeout=5)
+            assert r.status_code == 200, r.text
+            assert r.content == b'replica-ok'
+
+        m = req_lib.get(f'{base}/-/metrics', timeout=5).json()
+        assert m['requests_failed'] == 0
+        assert m['requests_retried'] >= 1
+        # Breaker tripped for the dead URL and stopped selecting it:
+        # once open, round-robin still alternates but every pick of the
+        # corpse is skipped without a connection attempt, so retries
+        # stop growing once the trip threshold (3) is crossed.
+        assert m['breaker'].get(dead_url) in ('open', 'half-open')
+        assert m['requests_retried'] <= lb.breaker.failure_threshold
+    finally:
+        stop()
+        alive.shutdown()
+        alive.server_close()
+
+
+def test_lb_injected_proxy_failure_fails_over(monkeypatch):
+    """The `lb.proxy` failpoint behaves exactly like a pre-stream
+    replica death: the request fails over and still succeeds."""
+    alive = _start_replica()
+    url = f'http://127.0.0.1:{alive.server_address[1]}'
+    # Two "replicas" pointing at the same live server: the first
+    # attempt eats the injected failure, the failover succeeds.
+    lb, port, stop = _start_lb('svc-fp-inject', [url, url + '/'])
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS', 'lb.proxy=error:1@1')
+    try:
+        r = req_lib.get(f'http://127.0.0.1:{port}', timeout=5)
+        assert r.status_code == 200
+        m = req_lib.get(f'http://127.0.0.1:{port}/-/metrics',
+                        timeout=5).json()
+        assert m['requests_retried'] >= 1
+        assert m['requests_failed'] == 0
+    finally:
+        stop()
+        alive.shutdown()
+        alive.server_close()
+
+
+def test_lb_no_replica_503_retry_after():
+    """No capacity is a 503 with Retry-After, counted separately from
+    replica failures."""
+    lb, port, stop = _start_lb('svc-fp-empty', [])
+    try:
+        r = req_lib.get(f'http://127.0.0.1:{port}', timeout=5)
+        assert r.status_code == 503
+        assert int(r.headers['Retry-After']) >= 1
+        m = req_lib.get(f'http://127.0.0.1:{port}/-/metrics',
+                        timeout=5).json()
+        assert m['requests_no_replica'] == 1
+        assert m['requests_failed'] == 0
+    finally:
+        stop()
+
+
+def test_serve_probe_failpoint_marks_not_ready():
+    """`serve.probe=error` fails readiness probes without touching the
+    replica — the NOT_READY path is drivable from the env alone."""
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve import spec as spec_lib
+    spec = spec_lib.ServiceSpec.from_config({
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 0,
+                            'timeout_seconds': 1},
+    })
+    mgr = replica_managers.ReplicaManager('svc-fp-probe', spec, '')
+    os.environ['SKY_TPU_FAILPOINTS'] = 'serve.probe=error:1@2'
+    try:
+        assert mgr._probe({'cluster_name': 'x', 'url': ''}) is False
+        assert mgr._probe({'cluster_name': 'x', 'url': ''}) is False
+        assert failpoints.fired('serve.probe') == 2
+    finally:
+        del os.environ['SKY_TPU_FAILPOINTS']
+        mgr.shutdown()
+
+
+def test_provision_create_retries_through_injected_failures(monkeypatch):
+    """`provision.create=error:1@2` fails the first two cloud create
+    calls; the provisioner's Retrier absorbs them within ONE placement
+    attempt (no failover burn) and the launch succeeds."""
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS', 'provision.create=error:1@2')
+    monkeypatch.setenv('SKY_TPU_PROVISION_RETRY_BASE_S', '0.05')
+    task = _task('echo PROV_OK', name='fp-prov')
+    job_id, info = execution.launch(task, cluster_name='fp-prov-c')
+    assert failpoints.fired('provision.create') == 2
+    client = agent_client.AgentClient.for_info(info)
+    assert client.wait_job(job_id, timeout=60).value == 'SUCCEEDED'
+    sky.down('fp-prov-c')

@@ -46,7 +46,7 @@ def pytest_collection_modifyitems(session, config, items):
     within each group is unchanged (some files order tests
     deliberately).
     """
-    def weight(item) -> int:
+    def weight(item) -> float:
         path = str(item.fspath)
         if f'{os.sep}unit_tests{os.sep}' in path:
             return 0
@@ -55,7 +55,11 @@ def pytest_collection_modifyitems(session, config, items):
         if f'{os.sep}load_tests{os.sep}' in path:
             return 3
         if f'{os.sep}chaos{os.sep}' in path:
-            return 4
+            # Fast failpoint-driven chaos runs right after the
+            # integration files (it is tier-1 acceptance coverage and
+            # must not sit behind the load suite under a wall-clock
+            # cap); interval-driven ChaosProxy cases stay last.
+            return 4 if item.get_closest_marker('slow') else 2.5
         return 2   # root-level integration/e2e files
 
     items.sort(key=weight)

@@ -644,12 +644,17 @@ def test_overprovision_with_queue_scaler_steps_correctly():
     assert d1.target_num_replicas == d2.target_num_replicas == 3
 
 
+@pytest.mark.slow
 def test_llm_multihost_replica_e2e():
     """Round-4: a serve replica that IS a multi-host slice. The local
     fake v5p-16 gang fans the server command to BOTH hosts with the
     jax.distributed env injected; they form a real 2-process CPU group
     (infer/multihost.py lockstep driver), host 0 binds
-    $SKYPILOT_SERVE_PORT, and the replica serves through it."""
+    $SKYPILOT_SERVE_PORT, and the replica serves through it.
+
+    slow: two JAX processes compile the model concurrently — minutes of
+    wall clock on a small CPU box, most of it inside the readiness
+    window (it times out outright on 1-core machines)."""
     import json
     import urllib.request as ur
     task = sky.Task(

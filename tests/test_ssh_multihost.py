@@ -102,8 +102,12 @@ def fake_ssh_transport(tmp_path, monkeypatch):
     time.sleep(0.2)
 
 
+@pytest.mark.slow
 def test_two_host_ssh_launch_rank_env(fake_ssh_transport, tmp_path,
                                       sky_tpu_home):
+    # slow: bootstraps two agents over the fake-ssh transport and waits
+    # out the full SKY_TPU_AGENT_WAIT_S budget when the sandbox can't
+    # bind the secondary loopback addresses (127.0.1.x) it needs.
     mgr = SSHNodePoolManager()
     key = tmp_path / 'id_fake'
     key.write_text('fake-key')

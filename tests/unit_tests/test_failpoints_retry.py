@@ -1,0 +1,363 @@
+"""Unit coverage for the robustness spine: failpoint spec parsing and
+firing semantics (utils/failpoints.py), the shared Retrier policy, and
+the LB's circuit breaker (utils/retry.py)."""
+import asyncio
+import time
+
+import pytest
+
+from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import retry as retry_lib
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints._reset_for_tests()
+    yield
+    failpoints._reset_for_tests()
+
+
+# ---------------- spec parsing --------------------------------------------
+
+def test_parse_full_grammar():
+    sites = failpoints.parse_specs(
+        'provision.create=error:0.5,agent.submit=delay:2,'
+        'agent.health=error:1@3,lb.proxy=hang@1,x.y=delay:0.1:0.25@7')
+    assert sites['provision.create'].action == 'error'
+    assert sites['provision.create'].prob == 0.5
+    assert sites['provision.create'].budget is None
+    assert sites['agent.submit'].action == 'delay'
+    assert sites['agent.submit'].arg == 2.0
+    assert sites['agent.health'].budget == 3
+    assert sites['lb.proxy'].action == 'hang'
+    assert sites['lb.proxy'].budget == 1
+    assert sites['x.y'].arg == 0.1
+    assert sites['x.y'].prob == 0.25
+    assert sites['x.y'].budget == 7
+
+
+@pytest.mark.parametrize('bad', [
+    'no-equals-sign',
+    'site=',
+    '=error',
+    'site=explode',                 # unknown action
+    'site=error:nan-ish-nope',      # non-numeric probability
+    'site=error:2',                 # probability out of [0,1]
+    'site=error:0.5:0.5',           # error takes one arg max
+    'site=delay',                   # delay needs seconds
+    'site=delay:-1',                # negative delay
+    'site=delay:1:2',               # probability out of range
+    'site=error@x',                 # non-integer budget
+    'site=error@-1',                # negative budget
+])
+def test_bad_specs_rejected_with_clear_error(bad):
+    with pytest.raises(failpoints.FailpointSpecError) as ei:
+        failpoints.parse_specs(bad)
+    # The offending entry is named in the message.
+    assert bad.split('=')[0].split(',')[0][:4] in str(ei.value)
+
+
+def test_empty_entries_tolerated():
+    assert failpoints.parse_specs('') == {}
+    sites = failpoints.parse_specs(' a.b=error , ,c.d=delay:1 ')
+    assert set(sites) == {'a.b', 'c.d'}
+
+
+# ---------------- firing semantics ----------------------------------------
+
+def test_unset_env_is_noop(monkeypatch):
+    monkeypatch.delenv(failpoints.ENV_VAR, raising=False)
+    failpoints.hit('any.site')   # no spec, no error
+    assert failpoints.fired('any.site') == 0
+
+
+def test_probability_one_always_fires(monkeypatch):
+    monkeypatch.setenv(failpoints.ENV_VAR, 's=error:1')
+    for _ in range(5):
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.hit('s')
+    assert failpoints.fired('s') == 5
+
+
+def test_probability_zero_never_fires(monkeypatch):
+    monkeypatch.setenv(failpoints.ENV_VAR, 's=error:0')
+    for _ in range(50):
+        failpoints.hit('s')
+    assert failpoints.fired('s') == 0
+
+
+def test_count_budget_exhausts(monkeypatch):
+    monkeypatch.setenv(failpoints.ENV_VAR, 's=error:1@3')
+    fired = 0
+    for _ in range(10):
+        try:
+            failpoints.hit('s')
+        except failpoints.FailpointError:
+            fired += 1
+    assert fired == 3
+    assert failpoints.fired('s') == 3
+
+
+def test_unarmed_site_is_dict_miss(monkeypatch):
+    monkeypatch.setenv(failpoints.ENV_VAR, 'other=error:1')
+    failpoints.hit('s')   # not armed: no-op
+    with pytest.raises(failpoints.FailpointError):
+        failpoints.hit('other')
+
+
+def test_delay_sleeps(monkeypatch):
+    monkeypatch.setenv(failpoints.ENV_VAR, 's=delay:0.05@1')
+    t0 = time.monotonic()
+    failpoints.hit('s')
+    assert time.monotonic() - t0 >= 0.05
+    t0 = time.monotonic()
+    failpoints.hit('s')   # budget spent: no sleep
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_hit_async(monkeypatch):
+    monkeypatch.setenv(failpoints.ENV_VAR,
+                       'e=error:1@1,d=delay:0.05@1')
+
+    async def go():
+        with pytest.raises(failpoints.FailpointError):
+            await failpoints.hit_async('e')
+        t0 = time.monotonic()
+        await failpoints.hit_async('d')
+        return time.monotonic() - t0
+
+    assert asyncio.run(go()) >= 0.05
+
+
+def test_respec_resets_budget(monkeypatch):
+    monkeypatch.setenv(failpoints.ENV_VAR, 's=error:1@1')
+    with pytest.raises(failpoints.FailpointError):
+        failpoints.hit('s')
+    failpoints.hit('s')   # exhausted
+    # A CHANGED spec re-arms (budgets are per parsed spec).
+    monkeypatch.setenv(failpoints.ENV_VAR, 's=error:1@1,t=error:0')
+    with pytest.raises(failpoints.FailpointError):
+        failpoints.hit('s')
+
+
+def test_bad_env_spec_raises_loudly(monkeypatch):
+    monkeypatch.setenv(failpoints.ENV_VAR, 'garbage')
+    with pytest.raises(failpoints.FailpointSpecError):
+        failpoints.hit('s')
+
+
+# ---------------- Retrier --------------------------------------------------
+
+def _flaky(n_failures, exc=ConnectionError):
+    state = {'calls': 0}
+
+    def fn():
+        state['calls'] += 1
+        if state['calls'] <= n_failures:
+            raise exc(f'boom {state["calls"]}')
+        return state['calls']
+    fn.state = state
+    return fn
+
+
+def test_retrier_retries_transient_to_success():
+    sleeps = []
+    r = retry_lib.Retrier('t', max_attempts=4, base_delay_s=0.1,
+                          sleep=sleeps.append)
+    assert r.call(_flaky(2)) == 3
+    assert len(sleeps) == 2
+
+
+def test_retrier_exhausts_attempts():
+    sleeps = []
+    r = retry_lib.Retrier('t', max_attempts=3, sleep=sleeps.append)
+    with pytest.raises(ConnectionError, match='boom 3'):
+        r.call(_flaky(99))
+    assert len(sleeps) == 2   # no sleep after the final failure
+
+
+def test_fatal_never_retried():
+    class Fatal(ConnectionError):
+        pass
+    sleeps = []
+    r = retry_lib.Retrier('t', max_attempts=5,
+                          transient=(ConnectionError,), fatal=(Fatal,),
+                          sleep=sleeps.append)
+    fn = _flaky(99, exc=Fatal)
+    with pytest.raises(Fatal):
+        r.call(fn)
+    assert fn.state['calls'] == 1
+    assert sleeps == []
+
+
+def test_unknown_exception_not_retried():
+    r = retry_lib.Retrier('t', max_attempts=5,
+                          transient=(ConnectionError,), sleep=lambda s: 0)
+    fn = _flaky(99, exc=KeyError)
+    with pytest.raises(KeyError):
+        r.call(fn)
+    assert fn.state['calls'] == 1
+
+
+def test_retry_on_predicate():
+    r = retry_lib.Retrier('t', max_attempts=3, transient=(),
+                          retry_on=lambda e: 'yes' in str(e),
+                          sleep=lambda s: 0)
+
+    calls = {'n': 0}
+
+    def fn():
+        calls['n'] += 1
+        raise RuntimeError('yes' if calls['n'] < 2 else 'no')
+    with pytest.raises(RuntimeError, match='no'):
+        r.call(fn)
+    assert calls['n'] == 2
+
+
+def test_deadline_respected():
+    """The overall deadline caps wall clock even with attempts left."""
+    t = {'now': 0.0}
+    slept = []
+
+    def sleep(s):
+        slept.append(s)
+        t['now'] += s
+
+    r = retry_lib.Retrier('t', max_attempts=100, base_delay_s=10.0,
+                          max_delay_s=10.0, deadline_s=25.0,
+                          sleep=sleep, rng=lambda: 1.0)
+    real_monotonic = time.monotonic
+    base = real_monotonic()
+    try:
+        time.monotonic = lambda: base + t['now']  # type: ignore
+        with pytest.raises(ConnectionError):
+            r.call(_flaky(99))
+    finally:
+        time.monotonic = real_monotonic
+    # 10s + 10s sleeps fit in the 25s budget; the next attempt's delay
+    # is clamped to the 5s remainder, and the attempt after finds the
+    # deadline exhausted.
+    assert sum(slept) <= 25.0 + 1e-9
+    assert len(slept) == 3
+
+
+def test_jitter_bounded():
+    """Full jitter: delay is uniform in [0, min(cap, base*2^k)] — never
+    above the exponential envelope, never negative."""
+    r = retry_lib.Retrier('t', base_delay_s=0.2, max_delay_s=3.0)
+    for attempt in range(1, 12):
+        envelope = min(3.0, 0.2 * 2 ** (attempt - 1))
+        for _ in range(50):
+            d = r.backoff_s(attempt)
+            assert 0.0 <= d <= envelope
+
+
+def test_retrier_records_trace_events(monkeypatch):
+    from skypilot_tpu.observability import trace as trace_lib
+    monkeypatch.setenv(trace_lib.ENV_VAR, '1')
+    trace_lib._reset_for_tests()
+    captured = []
+    trace_lib.set_sink(captured.extend)
+    r = retry_lib.Retrier('agent.submit', max_attempts=3,
+                          sleep=lambda s: 0)
+    assert r.call(_flaky(2)) == 3
+    trace_lib.flush()
+    trace_lib.set_sink(None)
+    trace_lib._reset_for_tests()
+    names = [s['name'] for s in captured]
+    assert names.count('retry.agent.submit') == 2
+    assert all(s['status'].startswith('retry:ConnectionError')
+               for s in captured)
+
+
+# ---------------- CircuitBreaker ------------------------------------------
+
+def test_breaker_lifecycle():
+    clock = {'now': 0.0}
+    b = retry_lib.CircuitBreaker(failure_threshold=3, cooldown_s=10.0,
+                                 clock=lambda: clock['now'])
+    url = 'http://r1'
+    assert b.state(url) == retry_lib.STATE_CLOSED
+    b.record_failure(url)
+    b.record_failure(url)
+    assert b.allows(url)                     # not yet tripped
+    b.record_failure(url)                    # 3rd consecutive: trip
+    assert b.state(url) == retry_lib.STATE_OPEN
+    assert not b.allows(url)
+
+    clock['now'] = 10.0                      # cooldown elapsed
+    assert b.state(url) == retry_lib.STATE_HALF_OPEN
+    assert b.allows(url)                     # the single probe
+    assert not b.allows(url)                 # second caller held back
+
+    b.record_success(url)                    # probe succeeded
+    assert b.state(url) == retry_lib.STATE_CLOSED
+    assert b.allows(url)
+
+
+def test_breaker_failed_probe_reopens():
+    clock = {'now': 0.0}
+    b = retry_lib.CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                                 clock=lambda: clock['now'])
+    b.record_failure('u')
+    clock['now'] = 5.0
+    assert b.allows('u')                     # half-open probe
+    b.record_failure('u')                    # probe failed
+    assert b.state('u') == retry_lib.STATE_OPEN
+    assert not b.allows('u')
+    clock['now'] = 9.0                       # cooldown restarted at t=5
+    assert b.state('u') == retry_lib.STATE_OPEN
+    clock['now'] = 10.0
+    assert b.allows('u')
+
+
+def test_breaker_release_returns_probe_slot():
+    """An outcome-less probe (client disconnected mid-attempt) must
+    give the slot back, not blacklist the key until pruned."""
+    clock = {'now': 0.0}
+    b = retry_lib.CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                                 clock=lambda: clock['now'])
+    b.record_failure('u')
+    clock['now'] = 5.0
+    assert b.allows('u')          # probe admitted (probing=True)
+    b.release('u')                # probe died of unrelated causes
+    assert b.allows('u')          # slot is available again
+    b.record_success('u')
+    assert b.state('u') == retry_lib.STATE_CLOSED
+
+
+def test_breaker_success_resets_streak():
+    b = retry_lib.CircuitBreaker(failure_threshold=2)
+    b.record_failure('u')
+    b.record_success('u')
+    b.record_failure('u')
+    assert b.state('u') == retry_lib.STATE_CLOSED
+
+
+def test_lb_select_fails_open_when_all_breakers_open():
+    """A wrong breaker must degrade to one wasted probe, not a 503
+    blackout: with EVERY ready replica's breaker open, _select still
+    returns a replica."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    lb = lb_lib.LoadBalancer('svc-x', 'round_robin')
+    urls = ['http://r1', 'http://r2']
+    lb.policy.set_ready_replicas(urls)
+    for u in urls:
+        for _ in range(lb.breaker.failure_threshold):
+            lb.breaker.record_failure(u)
+    assert all(lb.breaker.state(u) == retry_lib.STATE_OPEN for u in urls)
+    assert lb._select(set()) in urls
+    # And with one replica already tried, the other is still offered.
+    assert lb._select({urls[0]}) == urls[1]
+    # Nothing left untried -> genuinely no candidate.
+    assert lb._select(set(urls)) is None
+
+
+def test_breaker_prune():
+    b = retry_lib.CircuitBreaker(failure_threshold=1)
+    b.record_failure('dead')
+    b.record_failure('live')
+    b.prune(['live'])
+    assert b.snapshot() == {'live': retry_lib.STATE_OPEN}
+    # Pruned key returns closed (fresh state).
+    assert b.state('dead') == retry_lib.STATE_CLOSED

@@ -1,0 +1,71 @@
+"""Lint: no new hand-rolled sleep/retry loops in the wire-facing layers.
+
+Every retry in client/, runtime/, and serve/ must go through the shared
+``Retrier`` (skypilot_tpu/utils/retry.py) — that is what makes backoff
+jittered, deadline-bound, and trace-visible everywhere at once. This
+test pins the count of raw ``time.sleep(`` call sites per file to the
+audited allowlist below; a new one failing here means either route the
+wait through ``Retrier`` or (for genuine status-poll cadences, which are
+not retries) extend the allowlist with a justification in the diff.
+"""
+import os
+import re
+
+import skypilot_tpu
+
+_PKG_ROOT = os.path.dirname(skypilot_tpu.__file__)
+_CHECKED_DIRS = ('client', 'runtime', 'serve')
+
+# path (relative to the package) -> audited number of time.sleep sites.
+# All of these are status-poll cadences (waiting for a state change),
+# not error-retry loops: retries live in utils/retry.py.
+_ALLOWED = {
+    'client/sdk.py': 2,        # get() result poll; wait_job status poll
+    'runtime/agent_client.py': 1,   # wait_job status poll
+    'serve/controller.py': 2,  # controller tick cadence
+    'serve/__init__.py': 2,    # serve up/down status polls
+}
+
+_SLEEP_RE = re.compile(r'\btime\.sleep\(')
+
+
+def _sleep_sites():
+    found = {}
+    for d in _CHECKED_DIRS:
+        root = os.path.join(_PKG_ROOT, d)
+        for dirpath, _, files in os.walk(root):
+            for fname in files:
+                if not fname.endswith('.py'):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, _PKG_ROOT)
+                with open(path, encoding='utf-8') as f:
+                    n = len(_SLEEP_RE.findall(f.read()))
+                if n:
+                    found[rel.replace(os.sep, '/')] = n
+    return found
+
+
+def test_no_new_bare_sleep_retry_loops():
+    found = _sleep_sites()
+    offenders = {
+        rel: n for rel, n in found.items()
+        if n > _ALLOWED.get(rel, 0)
+    }
+    assert not offenders, (
+        f'New bare time.sleep() call sites in wire-facing layers: '
+        f'{offenders} (allowed: {_ALLOWED}). Retry/backoff belongs in '
+        f'the shared Retrier (skypilot_tpu/utils/retry.py); if this is '
+        f'a genuine status-poll cadence, update the allowlist with a '
+        f'justification.')
+
+
+def test_allowlist_not_stale():
+    """Entries whose sleeps were since removed must leave the allowlist
+    (otherwise it silently grants headroom for new ad-hoc loops)."""
+    found = _sleep_sites()
+    stale = {rel: cap for rel, cap in _ALLOWED.items()
+             if found.get(rel, 0) < cap}
+    assert not stale, (
+        f'Allowlist entries exceed the actual time.sleep() counts: '
+        f'{stale} vs found {found} — ratchet the allowlist down.')
