@@ -58,23 +58,40 @@ def _run_lb(service: str, port: int) -> None:
                                     port)
 
 
-def _streamed_ttft(url: str, prompt: str, max_new_tokens: int = 8,
-                   timeout: float = 300.0) -> float:
-    """One streamed /generate through the LB; returns send→first-byte
-    seconds (true client-observed TTFT)."""
+def _streamed_request(url: str, prompt: str, max_new_tokens: int = 8,
+                      timeout: float = 300.0) -> tuple:
+    """One streamed /generate through the LB. Returns
+    ``(ttft_s, itl_samples_s)``: send→first-byte seconds (true
+    client-observed TTFT) plus one inter-token latency sample per token
+    after the first — the arrival gap of each flushed line, amortized
+    over the tokens it carried (the engine may batch several tokens
+    into one flush under load)."""
     req = urllib.request.Request(
         url, data=json.dumps({'prompt': prompt,
                               'max_new_tokens': max_new_tokens,
                               'stream': True}).encode(),
         headers={'Content-Type': 'application/json'})
     t0 = time.perf_counter()
+    itls = []
     with urllib.request.urlopen(req, timeout=timeout) as r:
         first = r.read(1)          # first streamed byte = first token
-        ttft = time.perf_counter() - t0
+        t_prev = time.perf_counter()
+        ttft = t_prev - t0
         if not first:
             raise RuntimeError('empty stream')
-        r.read()                   # drain
-    return ttft
+        r.readline()               # rest of the first line
+        for line in iter(r.readline, b''):
+            now = time.perf_counter()
+            if not line.strip():
+                continue
+            try:
+                tokens = json.loads(line).get('tokens') or []
+            except ValueError:     # truncated tail line
+                tokens = []
+            if tokens:
+                itls.extend([(now - t_prev) / len(tokens)] * len(tokens))
+                t_prev = now
+    return ttft, itls
 
 
 def _pct(sorted_vals, p: float):
@@ -97,17 +114,21 @@ def _sweep_level(gen_url: str, concurrency: int, n_requests: int,
         return f'request {i} hello world'
 
     results = []   # (is_long, ttft)
+    itl_samples = []
     t0 = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
-        futs = {pool.submit(_streamed_ttft, gen_url, prompt_for(i),
+        futs = {pool.submit(_streamed_request, gen_url, prompt_for(i),
                             timeout=900): i
                 for i in range(n_requests)}
         for f in concurrent.futures.as_completed(futs):
             i = futs[f]
+            ttft, itls = f.result()
             results.append((bool(long_prompt_tokens and i % 8 == 7),
-                            f.result()))
+                            ttft))
+            itl_samples.extend(itls)
     wall = time.perf_counter() - t0
     ttfts = sorted(t for _, t in results)
+    itl_samples.sort()
     out = {
         'concurrency': concurrency,
         'samples': len(ttfts),
@@ -115,6 +136,14 @@ def _sweep_level(gen_url: str, concurrency: int, n_requests: int,
         'ttft_p90_s': _pct(ttfts, 0.90),
         'ttft_p99_s': _pct(ttfts, 0.99),
         'ttft_mean_s': round(statistics.fmean(ttfts), 5),
+        # Inter-token latency: the steady-state decode cadence a
+        # streaming client sees — the number the overlapped decode
+        # pipeline moves (TTFT is dominated by prefill+queueing).
+        'itl_p50_ms': (round(_pct(itl_samples, 0.50) * 1e3, 3)
+                       if itl_samples else None),
+        'itl_p99_ms': (round(_pct(itl_samples, 0.99) * 1e3, 3)
+                       if itl_samples else None),
+        'itl_samples': len(itl_samples),
         'throughput_rps': round(n_requests / wall, 2),
     }
     longs = sorted(t for is_long, t in results if is_long)
@@ -236,8 +265,8 @@ def main() -> None:
             gen_url = f'http://127.0.0.1:{lb_port}/generate'
             # 3. COLD: the first request eats any residual compile —
             #    reported separately, never mixed into warm percentiles.
-            cold_s = round(_streamed_ttft(gen_url, 'cold request',
-                                          timeout=600), 4)
+            cold_s = round(_streamed_request(gen_url, 'cold request',
+                                             timeout=600)[0], 4)
             # Warm every concurrency level's batch shapes off the clock.
             _sweep_level(gen_url, max(args.concurrency), 2 * args.slots,
                          args.long_prompt_tokens)
@@ -265,6 +294,8 @@ def main() -> None:
         'value': base.get('ttft_p50_s'),
         'unit': 'seconds',
         'ttft_warm_p99_s': base.get('ttft_p99_s'),
+        'itl_p50_ms': base.get('itl_p50_ms'),
+        'itl_p99_ms': base.get('itl_p99_ms'),
         'cold_first_request_s': cold_s,
         'sweep': sweep,
         'total_samples': sum(lv['samples'] for lv in sweep),

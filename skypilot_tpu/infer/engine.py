@@ -13,6 +13,16 @@ TPU-first structure:
   on-device (``sampling.py``).
 - Prompt lengths are bucketed (powers of two) to bound prefill
   compilations.
+- The step loop is OVERLAPPED (``pipeline_depth``): decode N+1 is
+  dispatched before step N's pair is read back (it depends only on the
+  device-resident last-token vector and cache), host bookkeeping runs
+  one step behind the device, and per-token operands (temps, active
+  mask, block table) live on device behind dirty flags instead of
+  being re-uploaded every token (docs/serving.md, "The decode
+  pipeline").
+- Token delivery is event-driven: every consumed token fires the
+  request's condition/listeners (``Request.wait_progress``), so the
+  server streams without sleep-polling.
 
 Metrics: per-request TTFT (submit → first token on host) and decode
 throughput, surfaced by ``metrics()`` for the serve layer's p50-TTFT
@@ -48,6 +58,18 @@ class EngineConfig:
     max_new_tokens: int = 256
     top_k: int = 0
     cache_dtype: str = 'bfloat16'
+    # Dispatch-ahead decode (the overlapped pipeline): up to this many
+    # decode steps may be in flight on the device before the host reads
+    # a result back, so host bookkeeping (finish checks, slot refill,
+    # page accounting) overlaps device compute instead of serializing
+    # with it. Host state runs stale-by-depth: a slot that finished at
+    # step N still decodes at N+1 (its token is dropped at consume) and
+    # is masked out at N+2. 0 = today's fully synchronous loop — the
+    # multihost lockstep driver pins 0 until its tick protocol learns
+    # overlap. Greedy outputs are bit-identical at any depth (sampling
+    # at temperature 0 is argmax, key-free; page-pressure decisions
+    # drain the in-flight queue before acting).
+    pipeline_depth: int = 1
     # Tensor-parallel degree: shard params (Megatron-style, the
     # column/row rules in parallel/sharding.py) and the KV cache (over
     # KV heads) across the first `tp` local devices. An 8B model in bf16
@@ -89,6 +111,14 @@ class Request:
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     finish_reason: Optional[str] = None
+    # Token-event delivery: the engine notifies after every appended
+    # token and on finish, so consumers (HTTP handlers, the lockstep
+    # warm-up) wait on the condition instead of sleep-polling the
+    # output list at a 2-5 ms cadence.
+    _cond: threading.Condition = dataclasses.field(
+        default_factory=threading.Condition, repr=False, compare=False)
+    _listeners: List[Any] = dataclasses.field(
+        default_factory=list, repr=False, compare=False)
 
     @property
     def done(self) -> bool:
@@ -99,6 +129,41 @@ class Request:
         if self.first_token_at is None:
             return None
         return self.first_token_at - self.submitted_at
+
+    # ---- token events ----------------------------------------------------
+    def add_listener(self, callback) -> None:
+        """Register a zero-arg callable fired (from the engine thread)
+        on every appended token and on finish — the asyncio bridge for
+        event-driven streaming (server._TokenWaiter)."""
+        self._listeners.append(callback)
+
+    def remove_listener(self, callback) -> None:
+        try:
+            self._listeners.remove(callback)
+        except ValueError:
+            pass
+
+    def _notify(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+        for cb in tuple(self._listeners):
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — a dying waiter (closed
+                pass           # event loop) must not wedge the engine
+
+    def wait_progress(self, n_seen: int,
+                      timeout: Optional[float] = None) -> bool:
+        """Block until more than ``n_seen`` tokens exist or the request
+        finishes. Returns whether there is progress to read."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: len(self.output_tokens) > n_seen or self.done,
+                timeout)
+
+    def wait_done(self, timeout: Optional[float] = None) -> bool:
+        with self._cond:
+            return self._cond.wait_for(lambda: self.done, timeout)
 
 
 def tp_mesh(tp: int) -> 'jax.sharding.Mesh':
@@ -232,7 +297,10 @@ class InferenceEngine:
             self._shard_tp()
         self._key = jax.random.PRNGKey(seed)
         self._ids = itertools.count(1)
-        self._lock = threading.Lock()
+        # Reentrant: _finish/_preempt take it for their slot/page
+        # mutations and are also called from _consume_one, which
+        # already holds it for the whole consume.
+        self._lock = threading.RLock()
         self._waiting: List[Request] = []
         self._slots: List[Optional[Request]] = [None] * self.ecfg.n_slots
         # slot -> prompt tokens already prefilled (chunked prefill in
@@ -248,6 +316,27 @@ class InferenceEngine:
                                             self._rep_sharding)
         self._slot_len = np.zeros((self.ecfg.n_slots,), np.int64)
         self._temps = np.zeros((self.ecfg.n_slots,), np.float32)
+        # ---- overlapped decode pipeline state ---------------------------
+        # Dispatched-but-unread decode steps (≤ _depth of them). Each
+        # record pins the [2, slots] pair (async host copy in flight)
+        # plus the slot→request assignment AT DISPATCH TIME, so consume
+        # can apply the stale-by-one rule: a token whose slot no longer
+        # holds the same request (finished / preempted meanwhile) is
+        # dropped.
+        self._depth = max(0, int(self.ecfg.pipeline_depth))
+        self._queue: collections.deque = collections.deque()
+        # Per-slot count of tokens in flight (page accounting must cover
+        # positions the device will have written before the host reads).
+        self._inflight_tok = [0] * self.ecfg.n_slots
+        # Device-resident copies of per-token decode operands, re-uploaded
+        # only when dirtied by submit/finish/preempt/extend — not three
+        # jnp.asarray uploads per token.
+        self._temps_dev = None
+        self._temps_dirty = True
+        self._active_dev = None
+        self._active_key: Optional[tuple] = None
+        self._table_dev = None
+        self._table_version = -1
         self._decode_steps = 0
         self._decode_tokens = 0
         self._decode_time = 0.0
@@ -455,7 +544,13 @@ class InferenceEngine:
         tl = min(remaining, bucket)
         if self.allocator is not None:
             if not self.allocator.extend(slot, off + bucket):
-                return None
+                # Pool dry by STALE accounting: in-flight steps may be
+                # about to free pages (finished slots). Catch up to the
+                # present before declaring the chunk deferred, so page
+                # decisions are identical at every pipeline depth.
+                self._drain_inflight()
+                if not self.allocator.extend(slot, off + bucket):
+                    return None
             table_row = jnp.asarray(self.allocator.table()[slot])
         padded = np.zeros((bucket,), np.int32)
         padded[:tl] = source[off:off + tl]
@@ -478,6 +573,7 @@ class InferenceEngine:
         del self._prefilling[slot]
         self._slot_len[slot] = n
         self._temps[slot] = req.temperature
+        self._temps_dirty = True
         return True
 
     def _finished(self, req: Request, slot: int, token: int) -> bool:
@@ -493,49 +589,68 @@ class InferenceEngine:
         return False
 
     def _finish(self, slot: int, req: Request) -> None:
-        req.finished_at = time.time()
-        self._slots[slot] = None
-        self._slot_len[slot] = 0
-        if self.allocator is not None:
-            self.allocator.free(slot)
-        self.cache = self._free(self.cache, jnp.int32(slot))
+        # Under the (reentrant) engine lock so metrics() never sees a
+        # half-applied finish (slot freed but pages not yet returned).
+        with self._lock:
+            req.finished_at = time.time()
+            self._slots[slot] = None
+            self._slot_len[slot] = 0
+            if self.allocator is not None:
+                self.allocator.free(slot)
+            self.cache = self._free(self.cache, jnp.int32(slot))
+        req._notify()
 
     def _preempt(self, slot: int) -> None:
         """Evict `slot` to reclaim its pages: the request goes back to
         the FRONT of the queue and resumes by recomputing
         prompt+generated (vLLM-style recompute preemption). Output
         already streamed is kept; TTFT is not re-recorded."""
-        req = self._slots[slot]
-        self._slots[slot] = None
-        self._slot_len[slot] = 0
-        self._prefilling.pop(slot, None)
-        self.allocator.free(slot)
-        self.cache = self._free(self.cache, jnp.int32(slot))
         with self._lock:
+            req = self._slots[slot]
+            self._slots[slot] = None
+            self._slot_len[slot] = 0
+            self._prefilling.pop(slot, None)
+            self.allocator.free(slot)
+            self.cache = self._free(self.cache, jnp.int32(slot))
             self._waiting.insert(0, req)
-        self._preemptions += 1
+            self._preemptions += 1
 
     def _ensure_decode_pages(self, decoding: List[int]) -> List[int]:
         """Guarantee every decoding slot owns the page its next token
         writes into, preempting the youngest other slot when the pool
-        is dry. Returns the (possibly shrunk) decoding list."""
+        is dry. Returns the (possibly shrunk) decoding list.
+
+        With dispatch-ahead, coverage must reach the position the
+        device will have written once the in-flight steps land
+        (slot_len + in-flight + 1), and any preempt/finish decision is
+        made only AFTER draining the in-flight queue — stale accounting
+        must never evict a victim that a pending consume was about to
+        free naturally (keeps page decisions depth-invariant)."""
         decoding = list(decoding)
+
+        def target(s: int) -> int:
+            return int(self._slot_len[s]) + self._inflight_tok[s] + 1
+
         for slot in list(decoding):
             if slot not in decoding:
                 continue   # preempted as an earlier slot's victim
             if self._slots[slot] is None:
                 decoding.remove(slot)
                 continue
-            while not self.allocator.extend(
-                    slot, int(self._slot_len[slot]) + 1):
+            while not self.allocator.extend(slot, target(slot)):
+                if self._queue:
+                    # Catch up: pending consumes may free pages (and
+                    # may finish THIS slot, handled by the re-checks).
+                    self._drain_inflight()
+                    if self._slots[slot] is None:
+                        break
+                    continue
                 # Per-slot ceiling: no amount of preemption helps.
-                if (self.allocator.pages_needed(
-                        int(self._slot_len[slot]) + 1)
+                if (self.allocator.pages_needed(target(slot))
                         > self.allocator.max_pages_per_slot):
                     req = self._slots[slot]
                     req.finish_reason = 'cache_full'
                     self._finish(slot, req)
-                    decoding.remove(slot)
                     break
                 victims = [s for s, r in enumerate(self._slots)
                            if r is not None and s != slot]
@@ -545,14 +660,18 @@ class InferenceEngine:
                     req = self._slots[slot]
                     req.finish_reason = 'cache_full'
                     self._finish(slot, req)
-                    decoding.remove(slot)
                     break
                 victim = max(victims,
                              key=lambda s: self._slots[s].submitted_at)
                 self._preempt(victim)
                 if victim in decoding:
                     decoding.remove(victim)
-        return decoding
+        # Drains above may have finished/preempted slots validated
+        # earlier in the walk — only currently-decoding slots may ride
+        # into the dispatch's active mask.
+        return [s for s in decoding
+                if self._slots[s] is not None
+                and s not in self._prefilling]
 
     # ---- the step --------------------------------------------------------
     # Traced only when SKY_TPU_TRACE is set at process start (the
@@ -618,64 +737,133 @@ class InferenceEngine:
                 self._prefilling.pop(keep, None)
                 self._finish(keep, req)
         # Decode phase: every fully-prefilled slot — including the ones
-        # that JUST finished (their first token is in _last_dev; they
-        # decode their second token in this same step). The step's ONE
-        # host sync reads the [2, slots] pair: row 0 carries their
-        # first tokens, row 1 everyone's new token.
+        # that JUST finished prefill (their first token is in _last_dev;
+        # they decode their second token in this same step). The step
+        # reads back ONE [2, slots] pair: row 0 carries first tokens,
+        # row 1 everyone's new token — but at pipeline_depth > 0 the
+        # pair read is the PREVIOUS step's, consumed only after this
+        # step's decode is already dispatched, so the device never
+        # waits on host bookkeeping.
         decoding = [s for s, r in enumerate(self._slots)
                     if r is not None and s not in self._prefilling]
         if self.allocator is not None and decoding:
             decoding = self._ensure_decode_pages(decoding)
-        if not decoding:
+        if not decoding and not self._queue:
             return len(self._prefilling)
-        active_mask = np.zeros((self.ecfg.n_slots,), np.bool_)
-        active_mask[decoding] = True
         t0 = time.perf_counter()
+        if decoding:
+            self._dispatch_decode(decoding, just_prefilled)
+        # Keep at most _depth steps in flight; with nothing newly
+        # dispatched there is no overlap left to win — drain fully so
+        # finished requests surface and idle() can flip.
+        allowed = self._depth if decoding else 0
+        while len(self._queue) > allowed:
+            self._consume_one()
+        self._decode_time += time.perf_counter() - t0
+        return len(decoding) + len(self._prefilling)
+
+    def _dispatch_decode(self, decoding: List[int],
+                         just_prefilled: List[int]) -> None:
+        """Dispatch one decode step (no host sync) and start its pair's
+        device→host copy; the result is consumed by a later
+        ``_consume_one``. Decode N+1 depends only on ``_last_dev`` and
+        the cache — both device-resident — so it never waits for the
+        host to have READ step N."""
+        if self._temps_dirty or self._temps_dev is None:
+            self._temps_dev = jnp.asarray(self._temps)
+            self._temps_dirty = False
+        key = tuple(decoding)
+        if key != self._active_key or self._active_dev is None:
+            active_mask = np.zeros((self.ecfg.n_slots,), np.bool_)
+            active_mask[decoding] = True
+            self._active_dev = jnp.asarray(active_mask)
+            self._active_key = key
         if self.allocator is not None:
+            if self._table_version != self.allocator.version:
+                self._table_dev = jnp.asarray(self.allocator.table())
+                self._table_version = self.allocator.version
             pair, self.cache = self._decode(
-                self.cache, self.params,
-                jnp.asarray(self.allocator.table()), self._last_dev,
-                self._next_key(), jnp.asarray(self._temps),
-                jnp.asarray(active_mask))
+                self.cache, self.params, self._table_dev,
+                self._last_dev, self._next_key(), self._temps_dev,
+                self._active_dev)
         else:
             pair, self.cache = self._decode(
                 self.cache, self.params, self._last_dev,
-                self._next_key(), jnp.asarray(self._temps),
-                jnp.asarray(active_mask))
+                self._next_key(), self._temps_dev, self._active_dev)
         self._last_dev = pair[1]
-        pair_host = np.asarray(pair)          # the step's single sync
-        self._decode_time += time.perf_counter() - t0
+        # Overlap the readback with everything that follows: by consume
+        # time the bytes are (usually) already on the host.
+        pair.copy_to_host_async()
         self._decode_steps += 1
-        self._decode_tokens += len(decoding)
+        for s in decoding:
+            self._inflight_tok[s] += 1
+        self._queue.append((
+            pair,
+            [(s, self._slots[s]) for s in decoding],
+            [(s, self._slots[s]) for s in just_prefilled]))
+
+    def _consume_one(self) -> None:
+        """Read back the OLDEST in-flight pair and apply its host-side
+        bookkeeping (token appends, TTFT stamps, finish detection, slot
+        frees). Stale-by-one rule: a slot that no longer holds the
+        request it held at dispatch time (finished or preempted since)
+        drops its token — for greedy decoding the resume path recomputes
+        the identical token, so outputs are depth-invariant."""
+        pair, decoded, prefilled = self._queue.popleft()
+        pair_host = np.asarray(pair)   # sync point (copy already async)
         now = time.time()
-        for slot in just_prefilled:
-            req = self._slots[slot]
-            if req is None or req.done:
-                continue   # preempted/finished by the page-pool pass
-            first = int(pair_host[0, slot])
-            if req.first_token_at is None:
-                req.first_token_at = now
-                self._ttfts.append(now - req.submitted_at)
-            req.output_tokens.append(first)
-            if self._finished(req, slot, first):
-                # First token already ends the request; the second
-                # token decoded this step is discarded with the slot.
-                self._finish(slot, req)
-        for slot in decoding:
-            req = self._slots[slot]
-            if req is None or req.done:
-                continue   # freed above (first token was terminal)
-            token = int(pair_host[1, slot])
-            req.output_tokens.append(token)
-            self._slot_len[slot] += 1
-            if self._finished(req, slot, token):
-                self._finish(slot, req)
-        return len(decoding) + len(self._prefilling)
+        touched: List[Request] = []
+        with self._lock:
+            for slot, req in prefilled:
+                if req is None or req.done or self._slots[slot] is not req:
+                    continue   # finished/preempted since dispatch
+                first = int(pair_host[0, slot])
+                if req.first_token_at is None:
+                    req.first_token_at = now
+                    self._ttfts.append(now - req.submitted_at)
+                req.output_tokens.append(first)
+                self._decode_tokens += 1
+                touched.append(req)
+                if self._finished(req, slot, first):
+                    # First token already ends the request; the second
+                    # token decoded the same step dies with the slot.
+                    self._finish(slot, req)
+            for slot, req in decoded:
+                self._inflight_tok[slot] = max(
+                    0, self._inflight_tok[slot] - 1)
+                if req is None or req.done or self._slots[slot] is not req:
+                    continue   # stale-by-one: post-finish token dropped
+                token = int(pair_host[1, slot])
+                req.output_tokens.append(token)
+                self._slot_len[slot] += 1
+                self._decode_tokens += 1
+                touched.append(req)
+                if self._finished(req, slot, token):
+                    self._finish(slot, req)
+        for req in touched:
+            if not req.done:       # _finish already notified
+                req._notify()
+
+    def _drain_inflight(self) -> None:
+        """Consume every in-flight step (host state catches up to the
+        device). Called before page-pressure decisions and by
+        ``set_pipeline_depth``."""
+        while self._queue:
+            self._consume_one()
+
+    def set_pipeline_depth(self, depth: int) -> None:
+        """Change the dispatch-ahead depth at runtime. The multihost
+        lockstep driver pins 0: its tick protocol requires every host
+        to observe identical request state after each tick."""
+        self._depth = max(0, int(depth))
+        while len(self._queue) > self._depth:
+            self._consume_one()
 
     def idle(self) -> bool:
         with self._lock:
-            return not self._waiting and all(
-                r is None for r in self._slots)
+            return (not self._waiting
+                    and all(r is None for r in self._slots)
+                    and not self._queue)
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:
         for _ in range(max_steps):
@@ -694,24 +882,52 @@ class InferenceEngine:
 
     # ---- metrics ---------------------------------------------------------
     def metrics(self) -> Dict[str, Any]:
-        ttfts = sorted(self._ttfts)
-        p50 = ttfts[len(ttfts) // 2] if ttfts else None
-        return {
-            'decode_steps': self._decode_steps,
-            'decode_tokens': self._decode_tokens,
-            'decode_tokens_per_sec': (
-                self._decode_tokens / self._decode_time
-                if self._decode_time else 0.0),
-            'ttft_p50_s': p50,
-            'num_waiting': len(self._waiting),
-            'num_active': sum(1 for r in self._slots if r is not None),
-            **({'paged': True,
-                'page_size': self.allocator.page_size,
-                'pages_total': self.allocator.n_pages,
-                'pages_free': self.allocator.free_pages,
-                'preemptions': self._preemptions}
-               if self.allocator is not None else {}),
-        }
+        # Snapshot under the engine lock: with the overlapped loop,
+        # counters (_decode_tokens, _ttfts, pages_free) are written one
+        # step behind the in-flight dispatch by the consume path — the
+        # lock keeps /metrics (and the LB reading it) from seeing a
+        # half-applied consume. pipeline_depth + tokens_in_flight make
+        # the staleness observable instead of mysterious.
+        with self._lock:
+            ttfts = sorted(self._ttfts)
+            p50 = ttfts[len(ttfts) // 2] if ttfts else None
+            return {
+                'decode_steps': self._decode_steps,
+                'decode_tokens': self._decode_tokens,
+                'decode_tokens_per_sec': (
+                    self._decode_tokens / self._decode_time
+                    if self._decode_time else 0.0),
+                'ttft_p50_s': p50,
+                'num_waiting': len(self._waiting),
+                'num_active': sum(
+                    1 for r in self._slots if r is not None),
+                'pipeline_depth': self._depth,
+                # Summed from the per-slot counters, NOT by iterating
+                # _queue: the engine thread appends/pops the deque
+                # outside this lock, and CPython raises on a deque
+                # mutated mid-iteration.
+                'tokens_in_flight': sum(self._inflight_tok),
+                **({'paged': True,
+                    'page_size': self.allocator.page_size,
+                    'pages_total': self.allocator.n_pages,
+                    'pages_free': self.allocator.free_pages,
+                    'preemptions': self._preemptions}
+                   if self.allocator is not None else {}),
+            }
+
+    def compiled_counts(self) -> Dict[str, int]:
+        """Distinct compiled programs per jitted entry point — the
+        recompile-stability guard: slot refill, dirty-flag re-uploads,
+        and dispatch-ahead must never introduce new shapes (prefill
+        compiles once per bucket; decode and free exactly once)."""
+        def n(fn) -> int:
+            try:
+                return int(fn._cache_size())
+            except Exception:  # noqa: BLE001 — private jit API moved
+                return -1
+        return {'prefill': n(self._prefill_chunk),
+                'decode': n(self._decode),
+                'free': n(self._free)}
 
 
 class EnginePool:
@@ -751,6 +967,10 @@ class EnginePool:
     def step(self) -> int:
         return sum(e.step() for e in self.engines)
 
+    def set_pipeline_depth(self, depth: int) -> None:
+        for e in self.engines:
+            e.set_pipeline_depth(depth)
+
     def idle(self) -> bool:
         return all(e.idle() for e in self.engines)
 
@@ -784,6 +1004,9 @@ class EnginePool:
             'ttft_p50_s': (ttfts[len(ttfts) // 2] if ttfts else None),
             'num_waiting': sum(t['num_waiting'] for t in tiers),
             'num_active': sum(t['num_active'] for t in tiers),
+            'pipeline_depth': max(t['pipeline_depth'] for t in tiers),
+            'tokens_in_flight': sum(t['tokens_in_flight']
+                                    for t in tiers),
             'tiers': [{'max_seq_len': e.ecfg.max_seq_len,
                        'n_slots': e.ecfg.n_slots, **t}
                       for e, t in zip(self.engines, tiers)],

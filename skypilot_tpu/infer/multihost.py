@@ -81,10 +81,20 @@ class MultihostEngineDriver:
     def __init__(self, engine) -> None:
         import jax
         self.engine = engine
+        # Lockstep REQUIRES the synchronous step loop: every host must
+        # observe identical request state after each tick, but the
+        # overlapped pipeline leaves host state stale-by-one behind an
+        # in-flight dispatch — pin depth 0 until the tick protocol
+        # carries the in-flight window in the broadcast.
+        if hasattr(engine, 'set_pipeline_depth'):
+            engine.set_pipeline_depth(0)
         self.rank = jax.process_index()
         self.world = jax.process_count()
         self._pending: List[Dict[str, Any]] = []   # rank0 only
         self._lock = threading.Lock()
+        # Set on submit so rank 0's idle loop wakes immediately instead
+        # of sleeping out its nap (event-driven, not a poll cadence).
+        self._work = threading.Event()
         self._stop = False
         self._tick_deadline = float(os.environ.get(
             TICK_DEADLINE_ENV, DEFAULT_TICK_DEADLINE_S))
@@ -173,6 +183,7 @@ class MultihostEngineDriver:
         }
         with self._lock:
             self._pending.append(entry)
+        self._work.set()
         entry['event'].wait()
         if entry['error'] is not None:
             raise entry['error']
@@ -180,6 +191,7 @@ class MultihostEngineDriver:
 
     def stop(self) -> None:
         self._stop = True
+        self._work.set()   # wake the idle loop to broadcast the stop
 
     # ---- the lockstep loop (every host) ---------------------------------
     def tick(self) -> bool:
@@ -222,13 +234,16 @@ class MultihostEngineDriver:
         self._last_tick = time.monotonic()
         return True
 
-    def run(self, idle_sleep: float = 0.002) -> None:
+    def run(self, idle_sleep: float = 0.05) -> None:
         """Follower loop (and usable as rank-0's loop body driver): tick
-        until stopped; nap only when the engine is idle AND nothing is
-        queued (followers block inside the broadcast instead). Runs
-        under the tick watchdog; a collective error (the distributed
-        runtime noticed a dead peer before the watchdog did) exits
-        nonzero the same way."""
+        until stopped; wait only when the engine is idle AND nothing is
+        queued (followers block inside the broadcast instead). The idle
+        wait is EVENT-DRIVEN: ``submit`` sets ``_work``, so a new
+        request triggers the next broadcast immediately —
+        ``idle_sleep`` is just the re-check cadence for the stop flag,
+        not a submission-poll interval. Runs under the tick watchdog; a
+        collective error (the distributed runtime noticed a dead peer
+        before the watchdog did) exits nonzero the same way."""
         self._last_tick = time.monotonic()   # arm the hard backstop
         self._start_watchdog()
         try:
@@ -237,7 +252,8 @@ class MultihostEngineDriver:
                     with self._lock:
                         quiet = not self._pending
                     if quiet and not self._stop:
-                        time.sleep(idle_sleep)
+                        self._work.wait(idle_sleep)
+                        self._work.clear()
         except Exception:  # noqa: BLE001 — any lockstep error is fatal
             logger.exception(
                 'lockstep host %d/%d: collective failed — exiting for '

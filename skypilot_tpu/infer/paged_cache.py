@@ -78,6 +78,11 @@ class PageAllocator:
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
         self._owned: List[List[int]] = [[] for _ in range(n_slots)]
         self._table = np.zeros((n_slots, max_pages_per_slot), np.int32)
+        # Bumped on every table mutation (pages assigned or returned):
+        # the engine keys its device-resident block-table copy on this,
+        # re-uploading only when the table actually changed instead of
+        # jnp.asarray(table) once per decoded token.
+        self.version = 0
 
     # -- queries -----------------------------------------------------------
     @property
@@ -111,10 +116,13 @@ class PageAllocator:
             pid = self._free.pop()
             self._table[slot, len(self._owned[slot])] = pid
             self._owned[slot].append(pid)
+        self.version += 1
         return True
 
     def free(self, slot: int) -> None:
         """Return all of `slot`'s pages to the pool."""
+        if self._owned[slot]:
+            self.version += 1
         self._free.extend(reversed(self._owned[slot]))
         self._owned[slot] = []
         self._table[slot, :] = 0

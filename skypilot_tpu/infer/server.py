@@ -23,12 +23,13 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import json
 import logging
 import os
 import threading
 import time
-from typing import List
+from typing import List, Optional
 
 import jax
 from aiohttp import web
@@ -154,6 +155,116 @@ def synthesize_wordlevel_tokenizer(vocab_size: int, path: str) -> str:
     return path
 
 
+class IncrementalDecoder:
+    """Streaming detokenizer with an O(window) cost per flush.
+
+    The cumulative approach (decode ALL tokens so far, emit the suffix)
+    was multibyte-correct but O(n²) over a stream: a 1k-token response
+    re-decoded ~500k token positions. This keeps the correctness and
+    drops the cost: decode a window starting at the last CLEAN commit
+    point (a flush whose text did not end in a dangling U+FFFD) and
+    emit only the stable part.
+
+    Stability rule: a truncated multibyte sequence at the end of the
+    byte stream collapses to exactly ONE trailing U+FFFD under
+    ``errors='replace'`` — so only the window's final U+FFFD can still
+    transform once more tokens arrive; everything before it is
+    permanent. Holding back just that one character keeps the
+    concatenated stream identical to the one-shot decode for the byte
+    fallback, clean text and garbage soup alike.
+
+    Window restarts keep ``_CONTEXT`` tokens of overlap: real
+    tokenizers (HF/sentencepiece) are NOT concatenative across a cut —
+    the joining space between tokens n-1 and n only renders when both
+    are decoded together — so each new window re-decodes a small
+    already-emitted suffix purely as context (the vLLM
+    detokenize-incrementally trick). ``_MAX_WINDOW`` bounds the window
+    (and so the per-flush cost) against a pathological never-clean
+    stream.
+    """
+
+    _CONTEXT = 4       # overlap tokens kept when the window restarts
+    _MAX_WINDOW = 64   # tokens; forces a boundary on pathological input
+
+    def __init__(self, tokenizer: 'Tokenizer') -> None:
+        self._tok = tokenizer
+        self._prefix = 0    # token index where the decode window starts
+        self._emitted = 0   # chars of decode(window) already emitted
+
+    def feed(self, tokens: List[int], n: Optional[int] = None) -> str:
+        """New text for ``tokens[:n]`` (the output list so far; ``n``
+        defaults to all of it, and passing the LIVE list plus an
+        explicit ``n`` avoids copying the cumulative prefix on every
+        flush); may be '' while a possibly-split multibyte character is
+        pending."""
+        if n is None:
+            n = len(tokens)
+        window = self._tok.decode(tokens[self._prefix:n])
+        if (not window.endswith('\ufffd')
+                or n - self._prefix >= self._MAX_WINDOW):
+            # Clean end (or a pathological never-clean stream hitting
+            # the cost bound): emit the rest, restart the window with
+            # _CONTEXT tokens of overlap marked as already emitted.
+            delta = window[self._emitted:]
+            self._prefix = max(0, n - self._CONTEXT)
+            self._emitted = len(
+                self._tok.decode(tokens[self._prefix:n]))
+            return delta
+        # Hold back ONLY the final replacement char — the sole char
+        # that can still become a real character; the rest is stable.
+        stable = len(window) - 1
+        delta = window[self._emitted:stable]
+        self._emitted = max(self._emitted, stable)
+        return delta
+
+    def flush(self, tokens: List[int], n: Optional[int] = None) -> str:
+        """Stream end: surface anything still held back."""
+        if n is None:
+            n = len(tokens)
+        window = self._tok.decode(tokens[self._prefix:n])
+        delta = window[self._emitted:]
+        self._prefix = n
+        self._emitted = 0
+        return delta
+
+
+class _TokenWaiter:
+    """asyncio bridge for engine token events.
+
+    The engine's consumer thread fires ``Request`` listeners on every
+    appended token and on finish; this relays them onto the handler's
+    event loop so ``h_generate`` awaits tokens instead of sleep-polling
+    ``output_tokens`` at a 2–5 ms cadence (which cost a poll interval
+    of added latency per flush and woke the loop ~400x/s per request).
+    The timeout passed to :meth:`wait` is only a safety net — it lets
+    the handler notice a dead engine, not deliver tokens.
+    """
+
+    def __init__(self, req) -> None:
+        self._req = req
+        self._ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+
+        def _on_progress() -> None:
+            try:
+                loop.call_soon_threadsafe(self._ev.set)
+            except RuntimeError:   # loop already closed mid-shutdown
+                pass
+
+        self._cb = _on_progress
+        req.add_listener(self._cb)
+        if req.output_tokens or req.done:
+            self._ev.set()   # progress predating the registration
+
+    async def wait(self, timeout: float) -> None:
+        with contextlib.suppress(asyncio.TimeoutError):
+            await asyncio.wait_for(self._ev.wait(), timeout)
+        self._ev.clear()
+
+    def close(self) -> None:
+        self._req.remove_listener(self._cb)
+
+
 class InferenceServer:
     def __init__(self, engine: engine_lib.InferenceEngine,
                  tokenizer: Tokenizer = None, driver=None) -> None:
@@ -187,8 +298,8 @@ class InferenceServer:
                             reqs.append(self.driver.submit(
                                 [1] * prev.ecfg.max_seq_len,
                                 max_new_tokens=2))
-                    while not all(r.done for r in reqs):
-                        time.sleep(0.01)
+                    for r in reqs:
+                        r.wait_done()   # token events, not sleep-polls
                     logger.info('engine warm in %.1fs',
                                 time.time() - t0)
                     self.ready = True
@@ -213,8 +324,10 @@ class InferenceServer:
             self.ready = True
             while not self._stop.is_set():
                 if self.engine.step() == 0:
-                    # Idle: sleep until a request arrives.
-                    self._woken.wait(timeout=0.005)
+                    # Idle: block until a submit wakes us (the timeout
+                    # is a safety net, not a poll cadence — h_generate
+                    # sets the event on every submission).
+                    self._woken.wait(timeout=0.1)
                     self._woken.clear()
         except Exception as e:  # noqa: BLE001 — a dead loop must unready
             logger.exception('engine loop died')
@@ -288,40 +401,55 @@ class InferenceServer:
             resp.content_type = 'application/jsonlines'
             await resp.prepare(request)
             sent = 0
-            text_sent = ''
-            while True:
-                if self.dead:
-                    await resp.write(json.dumps(
-                        {'error': f'engine died: {self.dead}'}).encode()
-                        + b'\n')
-                    break
-                n = len(req.output_tokens)
-                if n > sent:
-                    chunk = req.output_tokens[sent:n]
-                    # Decode the CUMULATIVE prefix and emit the delta:
-                    # per-chunk decode garbles multibyte characters
-                    # whose tokens split across flush boundaries.
-                    full = self.tokenizer.decode(req.output_tokens[:n])
-                    delta, text_sent = full[len(text_sent):], full
-                    await resp.write(json.dumps(
-                        {'tokens': chunk,
-                         'text': delta}).encode()
-                        + b'\n')
-                    sent = n
-                if req.done and sent == len(req.output_tokens):
-                    await resp.write(json.dumps(
-                        {'done': True, 'request_id': req.request_id,
-                         'finish_reason': req.finish_reason,
-                         'ttft_s': req.ttft}).encode() + b'\n')
-                    break
-                await asyncio.sleep(0.002)
+            # Incremental detokenization (O(window) per flush, not a
+            # cumulative re-decode) + event-driven flushes: each line
+            # leaves the moment the engine's consume appends tokens.
+            decoder = IncrementalDecoder(self.tokenizer)
+            waiter = _TokenWaiter(req)
+            try:
+                while True:
+                    if self.dead:
+                        await resp.write(json.dumps(
+                            {'error':
+                             f'engine died: {self.dead}'}).encode()
+                            + b'\n')
+                        break
+                    done = req.done       # read BEFORE the token count:
+                    n = len(req.output_tokens)   # done ⇒ n is final
+                    if n > sent:
+                        chunk = req.output_tokens[sent:n]
+                        delta = decoder.feed(req.output_tokens, n)
+                        await resp.write(json.dumps(
+                            {'tokens': chunk,
+                             'text': delta}).encode()
+                            + b'\n')
+                        sent = n
+                    if done and sent == len(req.output_tokens):
+                        tail = decoder.flush(req.output_tokens, sent)
+                        if tail:
+                            await resp.write(json.dumps(
+                                {'tokens': [],
+                                 'text': tail}).encode() + b'\n')
+                        await resp.write(json.dumps(
+                            {'done': True, 'request_id': req.request_id,
+                             'finish_reason': req.finish_reason,
+                             'ttft_s': req.ttft}).encode() + b'\n')
+                        break
+                    await waiter.wait(1.0)
+            finally:
+                waiter.close()
             await resp.write_eof()
             return resp
-        while not req.done:
-            if self.dead:
-                return web.json_response(
-                    {'error': f'engine died: {self.dead}'}, status=500)
-            await asyncio.sleep(0.005)
+        waiter = _TokenWaiter(req)
+        try:
+            while not req.done:
+                if self.dead:
+                    return web.json_response(
+                        {'error': f'engine died: {self.dead}'},
+                        status=500)
+                await waiter.wait(1.0)
+        finally:
+            waiter.close()
         return web.json_response({
             'request_id': req.request_id,
             'tokens': req.output_tokens,
@@ -381,6 +509,13 @@ def main() -> None:
     parser.add_argument('--tokenizer', default=None,
                         help='tokenizer.json (tokenizers format) or '
                              'sentencepiece .model for /generate text')
+    parser.add_argument('--pipeline-depth', type=int, default=1,
+                        help='Dispatch-ahead decode depth: decode N+1 '
+                             'is dispatched before step N is read '
+                             'back, overlapping host bookkeeping with '
+                             'device compute (docs/serving.md). 0 = '
+                             'synchronous loop; multi-host lockstep '
+                             'replicas always run 0.')
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     if args.paged and args.long_slots > 0:
@@ -483,7 +618,8 @@ def main() -> None:
             max_seq_len=min(args.max_seq_len, config.max_seq_len),
             tp=args.tp, quantize=args.quantize,
             paged=args.paged, page_size=args.page_size,
-            n_pages=args.n_pages))
+            n_pages=args.n_pages,
+            pipeline_depth=args.pipeline_depth))
     if args.long_slots > 0:
         short_cap = min(args.max_seq_len, config.max_seq_len)
         long_cap = min(args.long_seq_len, config.max_seq_len)
@@ -500,7 +636,8 @@ def main() -> None:
             engine_lib.EngineConfig(
                 n_slots=args.long_slots,
                 max_seq_len=long_cap,
-                tp=args.tp, quantize=False),   # params already int8
+                tp=args.tp, quantize=False,   # params already int8
+                pipeline_depth=args.pipeline_depth),
             seed=1)
         engine = engine_lib.EnginePool([engine, long_engine])
     driver = None

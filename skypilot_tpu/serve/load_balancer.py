@@ -69,6 +69,10 @@ class LoadBalancer:
         # streaming LLM endpoint this is time-to-first-token as the
         # client experiences it through the LB).
         self._ttfts: collections.deque = collections.deque(maxlen=4096)
+        # Inter-chunk gaps on proxied streams (for /generate streaming
+        # this tracks inter-token latency as the client experiences it
+        # — the metric the engine's overlapped decode pipeline moves).
+        self._itls: collections.deque = collections.deque(maxlen=8192)
         self._requests_total = 0
         self._requests_failed = 0
         # "No capacity" is a different dashboard line than "replica
@@ -133,20 +137,24 @@ class LoadBalancer:
     # directly; a Prometheus exposition can wrap lb_metrics() later.
     def lb_metrics(self) -> Dict[str, object]:
         ttfts = sorted(self._ttfts)
+        itls = sorted(self._itls)
 
-        def pct(p: float):
-            if not ttfts:
+        def pct(vals, p: float):
+            if not vals:
                 return None
-            return ttfts[min(len(ttfts) - 1, int(len(ttfts) * p))]
+            return vals[min(len(vals) - 1, int(len(vals) * p))]
         return {
             'requests_total': self._requests_total,
             'requests_failed': self._requests_failed,
             'requests_no_replica': self._requests_no_replica,
             'requests_retried': self._requests_retried,
-            'ttft_p50_s': pct(0.50),
-            'ttft_p90_s': pct(0.90),
-            'ttft_p99_s': pct(0.99),
+            'ttft_p50_s': pct(ttfts, 0.50),
+            'ttft_p90_s': pct(ttfts, 0.90),
+            'ttft_p99_s': pct(ttfts, 0.99),
             'ttft_samples': len(ttfts),
+            'itl_p50_s': pct(itls, 0.50),
+            'itl_p99_s': pct(itls, 0.99),
+            'itl_samples': len(itls),
             'ready_replicas': len(self.policy.ready_urls),
             'breaker': self.breaker.snapshot(),
         }
@@ -236,11 +244,32 @@ class LoadBalancer:
                              if k.lower() not in _HOP_HEADERS})
                 await resp.prepare(request)
                 first = True
+                t_prev = None
+                # Only token streams feed the ITL metric: a
+                # non-streaming body that merely spans several 64KB
+                # chunks would contribute microsecond gaps and drag
+                # itl_p50 toward zero.
+                is_token_stream = 'jsonlines' in (
+                    upstream.headers.get('Content-Type') or '')
+                # Each gap is recorded one chunk LATE so the stream's
+                # final gap — the terminal done/tail-flush line landing
+                # microseconds after the last token — is dropped
+                # instead of dragging itl_p50 toward zero.
+                pending_gap = None
                 async for chunk in upstream.content.iter_chunked(
                         64 * 1024):
-                    if first and upstream_ok:
-                        self._ttfts.append(time.monotonic() - t_arrival)
+                    now = time.monotonic()
+                    if upstream_ok:
+                        if first:
+                            self._ttfts.append(now - t_arrival)
+                        elif is_token_stream:
+                            # Gap between flushed lines = the
+                            # client-observed inter-token latency.
+                            if pending_gap is not None:
+                                self._itls.append(pending_gap)
+                            pending_gap = now - t_prev
                     first = False
+                    t_prev = now
                     await resp.write(chunk)
                 if first and upstream_ok:  # empty body: headers counted
                     self._ttfts.append(time.monotonic() - t_arrival)

@@ -69,3 +69,57 @@ def test_allowlist_not_stale():
     assert not stale, (
         f'Allowlist entries exceed the actual time.sleep() counts: '
         f'{stale} vs found {found} — ratchet the allowlist down.')
+
+
+# ---- infer hot path: token delivery must stay event-driven ---------------
+# The serve lane's decode/streaming path was converted from sleep-polling
+# (2-5 ms poll loops in h_generate and the lockstep idle nap) to token
+# events (Request._notify → condition/asyncio bridge). These caps pin the
+# TOTAL count of time.sleep( + asyncio.sleep( call sites per file so a
+# poll loop cannot quietly regrow in the per-token path; Event.wait /
+# Condition.wait with a safety-net timeout is the sanctioned idiom.
+_INFER_ALLOWED = {
+    # Lockstep watchdog heartbeat (monitoring cadence, not a token poll).
+    'infer/multihost.py': 1,
+    'infer/server.py': 0,
+    'infer/engine.py': 0,
+}
+
+_ANY_SLEEP_RE = re.compile(r'\b(?:time|asyncio)\.sleep\(')
+
+
+def _infer_sleep_sites():
+    found = {}
+    root = os.path.join(_PKG_ROOT, 'infer')
+    for dirpath, _, files in os.walk(root):
+        for fname in files:
+            if not fname.endswith('.py'):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, _PKG_ROOT).replace(os.sep, '/')
+            with open(path, encoding='utf-8') as f:
+                n = len(_ANY_SLEEP_RE.findall(f.read()))
+            if n:
+                found[rel] = n
+    return found
+
+
+def test_infer_hot_path_stays_event_driven():
+    found = _infer_sleep_sites()
+    offenders = {rel: n for rel, n in found.items()
+                 if n > _INFER_ALLOWED.get(rel, 0)}
+    assert not offenders, (
+        f'New time.sleep/asyncio.sleep call sites in the infer hot '
+        f'path: {offenders} (allowed: {_INFER_ALLOWED}). Token '
+        f'delivery is event-driven (Request.wait_progress / '
+        f'server._TokenWaiter); a poll loop here re-adds a poll '
+        f'interval of latency to every streamed token.')
+
+
+def test_infer_allowlist_not_stale():
+    found = _infer_sleep_sites()
+    stale = {rel: cap for rel, cap in _INFER_ALLOWED.items()
+             if found.get(rel, 0) < cap}
+    assert not stale, (
+        f'Infer allowlist exceeds actual sleep counts: {stale} vs '
+        f'{found} — ratchet it down.')
