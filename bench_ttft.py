@@ -27,6 +27,7 @@ import concurrent.futures
 import json
 import multiprocessing
 import os
+import random
 import statistics
 import subprocess
 import sys
@@ -58,18 +59,21 @@ def _run_lb(service: str, port: int) -> None:
                                     port)
 
 
-def _streamed_request(url: str, prompt: str, max_new_tokens: int = 8,
+def _streamed_request(url: str, payload, max_new_tokens: int = 8,
                       timeout: float = 300.0) -> tuple:
-    """One streamed /generate through the LB. Returns
-    ``(ttft_s, itl_samples_s)``: send→first-byte seconds (true
-    client-observed TTFT) plus one inter-token latency sample per token
-    after the first — the arrival gap of each flushed line, amortized
-    over the tokens it carried (the engine may batch several tokens
-    into one flush under load)."""
+    """One streamed /generate through the LB. ``payload`` is a prompt
+    string or a full request dict (the shared-prefix sweep sends token
+    ids directly). Returns ``(ttft_s, itl_samples_s)``: send→first-byte
+    seconds (true client-observed TTFT) plus one inter-token latency
+    sample per token after the first — the arrival gap of each flushed
+    line, amortized over the tokens it carried (the engine may batch
+    several tokens into one flush under load)."""
+    if not isinstance(payload, dict):
+        payload = {'prompt': payload}
+    payload = {'max_new_tokens': max_new_tokens, 'stream': True,
+               **payload}
     req = urllib.request.Request(
-        url, data=json.dumps({'prompt': prompt,
-                              'max_new_tokens': max_new_tokens,
-                              'stream': True}).encode(),
+        url, data=json.dumps(payload).encode(),
         headers={'Content-Type': 'application/json'})
     t0 = time.perf_counter()
     itls = []
@@ -102,22 +106,25 @@ def _pct(sorted_vals, p: float):
 
 
 def _sweep_level(gen_url: str, concurrency: int, n_requests: int,
-                 long_prompt_tokens: int = 0) -> dict:
+                 long_prompt_tokens: int = 0,
+                 payload_for=None) -> dict:
     """One concurrency level. With long_prompt_tokens, every 8th
     request carries a long prompt (the mixed-length workload a paged
     cache exists for); long/short TTFTs are reported separately so the
-    long lane cannot hide in the p50."""
+    long lane cannot hide in the p50. ``payload_for`` overrides the
+    request mix entirely (the shared-prefix sweep's token payloads)."""
     def prompt_for(i: int) -> str:
         if long_prompt_tokens and i % 8 == 7:
             filler = f'ctx{i} ' * (long_prompt_tokens // 5)
             return filler + ' summarize.'
         return f'request {i} hello world'
 
+    make = payload_for or prompt_for
     results = []   # (is_long, ttft)
     itl_samples = []
     t0 = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
-        futs = {pool.submit(_streamed_request, gen_url, prompt_for(i),
+        futs = {pool.submit(_streamed_request, gen_url, make(i),
                             timeout=900): i
                 for i in range(n_requests)}
         for f in concurrent.futures.as_completed(futs):
@@ -155,6 +162,71 @@ def _sweep_level(gen_url: str, concurrency: int, n_requests: int,
     return out
 
 
+def _block(seed: int, n: int) -> list:
+    """Deterministic token block, ids in [2, 201] (inside every model's
+    vocab). The seed is mixed through a PRNG so any two distinct seeds
+    give distinct leading blocks — a linear formula would collide for
+    seeds congruent mod the id range, silently serving the 'cold'
+    all-miss baseline from the prefix cache."""
+    rng = random.Random(seed)
+    return [2 + rng.randrange(200) for _ in range(n)]
+
+
+def _shared_prefix_level(gen_url: str, metrics_url: str,
+                         concurrency: int, n_requests: int,
+                         sys_tokens: int, uniq_base: int) -> dict:
+    """One concurrency level of the shared-system-prompt sweep: a COLD
+    pass (every request a unique same-length system block — all prefix
+    misses, the no-reuse baseline) then a SHARED pass (one system
+    block, unique tails — the production shape the prefix cache
+    exists for), with the replica's prefix counters sampled around the
+    shared pass so the hit rate and tokens saved are windowed to it.
+    The first shared request is issued alone (it seeds the radix tree;
+    its TTFT is a miss by construction and is excluded)."""
+    tail = 16
+
+    def cold_payload(i: int) -> dict:
+        return {'tokens': _block(uniq_base + 7 + i, sys_tokens)
+                + _block(uniq_base + 100003 + i, tail)}
+
+    shared_sys = _block(uniq_base, sys_tokens)
+
+    def shared_payload(i: int) -> dict:
+        return {'tokens': shared_sys + _block(uniq_base + 200003 + i,
+                                              tail)}
+
+    cold = _sweep_level(gen_url, concurrency, n_requests,
+                        payload_for=cold_payload)
+    _streamed_request(gen_url, shared_payload(0))   # seed the tree
+    m0 = _get(metrics_url)
+    shared = _sweep_level(gen_url, concurrency, n_requests,
+                          payload_for=lambda i: shared_payload(i + 1))
+    m1 = _get(metrics_url)
+    lookups = ((m1['prefix_hits'] + m1['prefix_misses'])
+               - (m0['prefix_hits'] + m0['prefix_misses']))
+    hit_rate = ((m1['prefix_hits'] - m0['prefix_hits']) / lookups
+                if lookups else 0.0)
+    out = {
+        'concurrency': concurrency,
+        'samples': cold['samples'] + shared['samples'],
+        'system_prompt_tokens': sys_tokens,
+        'cold': cold,
+        'shared': shared,
+        'prefix_hit_rate': round(hit_rate, 4),
+        'tokens_prefill_saved': (m1['prefix_tokens_saved']
+                                 - m0['prefix_tokens_saved']),
+    }
+    if shared['ttft_p50_s'] and cold['ttft_p50_s']:
+        out['ttft_improvement_x'] = round(
+            cold['ttft_p50_s'] / shared['ttft_p50_s'], 2)
+    if shared['itl_p50_ms'] and cold['itl_p50_ms']:
+        # >1 means the shared pass DECODES slower — the regression
+        # guard (prefix reuse must not tax steady-state decode).
+        out['itl_ratio_shared_over_cold'] = round(
+            shared['itl_p50_ms'] / cold['itl_p50_ms'], 3)
+    return out
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--requests-per-level', type=int, default=80)
@@ -165,7 +237,10 @@ def main() -> None:
                              'real ~1B-param LLaMA on the chip; random '
                              'weights — TTFT is a latency property of '
                              'the serving path, not the values)')
-    parser.add_argument('--max-seq-len', type=int, default=256)
+    parser.add_argument('--max-seq-len', type=int, default=None,
+                        help='default 256 (1024 for --sweep '
+                             'shared-prefix: the shared system block '
+                             'must span many pages)')
     parser.add_argument('--slots', type=int, default=16)
     parser.add_argument('--tp', type=int, default=1)
     parser.add_argument('--quantize', action='store_true',
@@ -174,6 +249,22 @@ def main() -> None:
                         help='paged KV engine (block-table pool)')
     parser.add_argument('--page-size', type=int, default=64)
     parser.add_argument('--n-pages', type=int, default=None)
+    parser.add_argument('--sweep', default='concurrency',
+                        choices=['concurrency', 'shared-prefix'],
+                        help="'shared-prefix': the shared-system-"
+                             'prompt workload (implies --paged '
+                             '--prefix-cache) — per level, a cold '
+                             'all-miss pass vs a shared-prefix pass, '
+                             'emitting prefix_hit_rate, '
+                             'tokens_prefill_saved and the TTFT '
+                             'improvement into the json')
+    parser.add_argument('--prefix-cache', action='store_true',
+                        help='enable shared-prefix KV reuse on the '
+                             'replica (requires --paged)')
+    parser.add_argument('--shared-prefix-tokens', type=int, default=768,
+                        help='system-block length for --sweep '
+                             'shared-prefix (multiple of --page-size '
+                             'keeps the whole block cacheable)')
     parser.add_argument('--long-prompt-tokens', type=int, default=0,
                         help='adds a long-context lane to the sweep: '
                              'this many prompt chars per long request, '
@@ -189,6 +280,15 @@ def main() -> None:
                              '24 MB file in the repo.')
     parser.add_argument('--output', default=None)
     args = parser.parse_args()
+    if args.sweep == 'shared-prefix':
+        args.paged = True
+        args.prefix_cache = True
+        if args.max_seq_len is None:
+            args.max_seq_len = 1024
+    if args.max_seq_len is None:
+        args.max_seq_len = 256
+    if args.prefix_cache and not args.paged:
+        raise SystemExit('--prefix-cache requires --paged')
 
     # Bench-owns-the-chip: wait for the test suite / another bench to
     # release the accelerator before measuring (VERDICT r5 weak #2).
@@ -233,6 +333,8 @@ def main() -> None:
         cmd += ['--paged', '--page-size', str(args.page_size)]
         if args.n_pages:
             cmd += ['--n-pages', str(args.n_pages)]
+    if args.prefix_cache:
+        cmd.append('--prefix-cache')
     if tokenizer:
         cmd += ['--tokenizer', tokenizer]
     infer_proc = subprocess.Popen(
@@ -263,18 +365,36 @@ def main() -> None:
                 time.sleep(0.5)
 
             gen_url = f'http://127.0.0.1:{lb_port}/generate'
+            metrics_url = f'http://127.0.0.1:{infer_port}/metrics'
             # 3. COLD: the first request eats any residual compile —
             #    reported separately, never mixed into warm percentiles.
             cold_s = round(_streamed_request(gen_url, 'cold request',
                                              timeout=600)[0], 4)
-            # Warm every concurrency level's batch shapes off the clock.
-            _sweep_level(gen_url, max(args.concurrency), 2 * args.slots,
-                         args.long_prompt_tokens)
-            # 4. The sweep.
-            for conc in args.concurrency:
-                sweep.append(_sweep_level(gen_url, conc,
-                                          args.requests_per_level,
-                                          args.long_prompt_tokens))
+            if args.sweep == 'shared-prefix':
+                # Warm with FULL-SIZE unique payloads so the big
+                # prefill buckets compile off the clock.
+                _sweep_level(
+                    gen_url, max(args.concurrency), 2 * args.slots,
+                    payload_for=lambda i: {
+                        'tokens': _block(900001 + i,
+                                         args.shared_prefix_tokens
+                                         + 16)})
+                for li, conc in enumerate(args.concurrency):
+                    sweep.append(_shared_prefix_level(
+                        gen_url, metrics_url, conc,
+                        args.requests_per_level,
+                        args.shared_prefix_tokens,
+                        uniq_base=(li + 1) * 1_000_000))
+            else:
+                # Warm every concurrency level's batch shapes off the
+                # clock.
+                _sweep_level(gen_url, max(args.concurrency),
+                             2 * args.slots, args.long_prompt_tokens)
+                # 4. The sweep.
+                for conc in args.concurrency:
+                    sweep.append(_sweep_level(gen_url, conc,
+                                              args.requests_per_level,
+                                              args.long_prompt_tokens))
         finally:
             lb_proc.terminate()
             lb_proc.join(timeout=10)
@@ -289,13 +409,34 @@ def main() -> None:
 
     import jax
     base = sweep[0] if sweep else {}
+    if args.sweep == 'shared-prefix':
+        head = {
+            'metric': 'shared_prefix_ttft_improvement_x',
+            'value': base.get('ttft_improvement_x'),
+            'unit': 'x (cold p50 / shared p50, same prompt length)',
+            'prefix_hit_rate': base.get('prefix_hit_rate'),
+            'tokens_prefill_saved': sum(
+                lv.get('tokens_prefill_saved', 0) for lv in sweep),
+            'shared_ttft_p50_s': (base.get('shared') or {}).get(
+                'ttft_p50_s'),
+            'cold_ttft_p50_s': (base.get('cold') or {}).get(
+                'ttft_p50_s'),
+            'itl_ratio_shared_over_cold': base.get(
+                'itl_ratio_shared_over_cold'),
+            'prefix_cache': True,
+        }
+    else:
+        head = {
+            'metric': 'serve_ttft_warm_p50_s',
+            'value': base.get('ttft_p50_s'),
+            'unit': 'seconds',
+            'ttft_warm_p99_s': base.get('ttft_p99_s'),
+            'itl_p50_ms': base.get('itl_p50_ms'),
+            'itl_p99_ms': base.get('itl_p99_ms'),
+        }
     result = {
-        'metric': 'serve_ttft_warm_p50_s',
-        'value': base.get('ttft_p50_s'),
-        'unit': 'seconds',
-        'ttft_warm_p99_s': base.get('ttft_p99_s'),
-        'itl_p50_ms': base.get('itl_p50_ms'),
-        'itl_p99_ms': base.get('itl_p99_ms'),
+        **head,
+        'sweep_mode': args.sweep,
         'cold_first_request_s': cold_s,
         'sweep': sweep,
         'total_samples': sum(lv['samples'] for lv in sweep),

@@ -44,6 +44,7 @@ import numpy as np
 from skypilot_tpu.infer import cache as cache_lib
 from skypilot_tpu.infer import model as model_lib
 from skypilot_tpu.infer import paged_cache as paged_cache_lib
+from skypilot_tpu.infer import prefix_cache as prefix_cache_lib
 from skypilot_tpu.infer import sampling as sampling_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.observability import trace
@@ -98,6 +99,15 @@ class EngineConfig:
     # dense-equivalent capacity (n_slots * max_seq_len / page_size + 1);
     # set lower to cap KV HBM at the expected tokens-in-flight.
     n_pages: Optional[int] = None
+    # Shared-prefix KV reuse (infer/prefix_cache.py, requires paged):
+    # finished/preempted requests donate their full clean pages to a
+    # radix tree keyed by per-page token blocks; a new request attaches
+    # the longest cached page-aligned prefix of its prompt (refcount++)
+    # and prefills only from the match boundary. Unreferenced cached
+    # pages are LRU-evicted strictly under page pressure, before
+    # preemption is considered. Greedy outputs are bit-identical with
+    # the cache on vs off (same determinism bar as pipeline_depth).
+    prefix_cache: bool = False
 
 
 @dataclasses.dataclass
@@ -111,6 +121,9 @@ class Request:
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     finish_reason: Optional[str] = None
+    # Prompt tokens served from the shared-prefix cache (their prefill
+    # was skipped); surfaced per request by the server's done-line.
+    cached_tokens: int = 0
     # Token-event delivery: the engine notifies after every appended
     # token and on finish, so consumers (HTTP handlers, the lockstep
     # warm-up) wait on the condition instead of sleep-polling the
@@ -286,6 +299,11 @@ class InferenceEngine:
                 config.n_kv_heads, config.head_dim,
                 dtype=jnp.dtype(self.ecfg.cache_dtype))
         else:
+            if self.ecfg.prefix_cache:
+                raise ValueError(
+                    'prefix_cache requires the paged KV cache '
+                    '(EngineConfig.paged=True): sharing is at page '
+                    'granularity')
             self.cache = cache_lib.init_cache(
                 config.n_layers, self.ecfg.n_slots,
                 self.ecfg.max_seq_len, config.n_kv_heads,
@@ -303,6 +321,18 @@ class InferenceEngine:
         self._lock = threading.RLock()
         self._waiting: List[Request] = []
         self._slots: List[Optional[Request]] = [None] * self.ecfg.n_slots
+        # Shared-prefix radix tree over the page pool (None = disabled).
+        self.prefix: Optional[prefix_cache_lib.PrefixCache] = None
+        # Slots that already ran their prefix match for the current
+        # residency (a rolled-back attach discards the entry so the
+        # retry re-matches).
+        self._matched: set = set()
+        # Slots currently mapping attached (possibly shared) pages —
+        # the only slots _unshare_write_range must scan; everyone else
+        # skips the per-token refcount walk entirely.
+        self._attached_slots: set = set()
+        if self.ecfg.prefix_cache:
+            self.prefix = prefix_cache_lib.PrefixCache(self.allocator)
         # slot -> prompt tokens already prefilled (chunked prefill in
         # flight); a slot decodes only once its prompt is fully cached.
         self._prefilling: Dict[int, int] = {}
@@ -385,6 +415,16 @@ class InferenceEngine:
             def _free_paged(kv_cache, slot):
                 return paged_cache_lib.free_slot(kv_cache, slot)
             self._free = _jit(_free_paged, donate=(0,))
+
+            if self.ecfg.prefix_cache:
+                # Copy-on-write page duplication. src/dst are traced
+                # scalars: ONE compiled program serves every CoW, so
+                # enabling the prefix cache adds zero compilations to
+                # the steady-state workload (this program only compiles
+                # if a CoW ever fires).
+                def _cow_paged(kv_cache, src, dst):
+                    return paged_cache_lib.copy_page(kv_cache, src, dst)
+                self._cow = _jit(_cow_paged, donate=(0,))
         else:
             def _prefill_chunk(kv_cache, params, slot, tokens, offset,
                                true_len, key, temp, last):
@@ -538,19 +578,72 @@ class InferenceEngine:
         req = self._slots[slot]
         off = self._prefilling[slot]
         source = self._source_tokens(req)
+        just_attached = 0
+        prev_cached = req.cached_tokens
+        if (self.prefix is not None and off == 0
+                and slot not in self._matched
+                and self.allocator.pages_of(slot) == 0):
+            self._matched.add(slot)
+            # First chunk of this slot's (re-)prefill: attach the
+            # longest cached page-aligned prefix and start past it.
+            # Attach and chunk dispatch are one atomic host step — IF
+            # the chunk defers, the attach is rolled back below, so no
+            # decode ever sees shared pages in the table while the
+            # device-side lengths[slot] is still 0 (the inactive-slot
+            # garbage write lands at table[slot, 0], which must be the
+            # sink, never a cached page).
+            pages, matched = self.prefix.match(source)
+            if matched:
+                self.allocator.attach(slot, pages)
+                self._attached_slots.add(slot)
+                self._prefilling[slot] = off = matched
+                req.cached_tokens = max(
+                    req.cached_tokens,
+                    min(matched, len(req.prompt_tokens)))
+                just_attached = matched
         n = len(source)
         remaining = n - off
         bucket = self._bucket(min(remaining, self._chunk_cap))
+        # A prefix-match offset is page-aligned, not cap-aligned, so
+        # the rounded bucket can overshoot the cache end (e.g. off=832,
+        # remaining=191 -> bucket 256 -> 1088 > max_seq_len 1024, which
+        # extend would refuse FOREVER as a per-slot-ceiling failure).
+        # Clamp to the largest bucket that fits, splitting the tail
+        # across more chunks — the page-sized bucket always fits, and
+        # only already-compiled buckets are used.
+        while off + bucket > self.ecfg.max_seq_len:
+            bucket = max(b for b in self._buckets if b < bucket)
         tl = min(remaining, bucket)
         if self.allocator is not None:
-            if not self.allocator.extend(slot, off + bucket):
+            ok = self._extend_pages(slot, off + bucket)
+            if not ok:
                 # Pool dry by STALE accounting: in-flight steps may be
                 # about to free pages (finished slots). Catch up to the
                 # present before declaring the chunk deferred, so page
                 # decisions are identical at every pipeline depth.
                 self._drain_inflight()
-                if not self.allocator.extend(slot, off + bucket):
-                    return None
+                ok = self._extend_pages(slot, off + bucket)
+            if ok:
+                # The chunk writes its whole padded bucket: every page
+                # in that range must be private before the dispatch (an
+                # un-CoW-able shared page defers like a dry pool).
+                ok = self._unshare_write_range(slot, off, off + bucket)
+            if not ok:
+                if just_attached:
+                    # Roll the attach back before deferring: a slot
+                    # with attached pages but NO dispatched prefill has
+                    # device lengths[slot] == 0, and the very next
+                    # decode step would scatter its garbage K/V row
+                    # into the shared page at table[slot, 0]. The retry
+                    # re-runs the match (stats un-counted here).
+                    self.allocator.free(slot)
+                    self._attached_slots.discard(slot)
+                    self._matched.discard(slot)
+                    self._prefilling[slot] = 0
+                    req.cached_tokens = prev_cached
+                    self.prefix.hits -= 1
+                    self.prefix.tokens_saved -= just_attached
+                return None
             table_row = jnp.asarray(self.allocator.table()[slot])
         padded = np.zeros((bucket,), np.int32)
         padded[:tl] = source[off:off + tl]
@@ -588,32 +681,119 @@ class InferenceEngine:
             return True
         return False
 
+    def _release_slot_pages(self, slot: int, req: Request,
+                            prefilled_to: Optional[int] = None) -> None:
+        """Give the slot's pages back — to the prefix tree when it is
+        enabled (full clean pages become cached prefixes; the partial
+        tail frees), to the pool otherwise. ``prefilled_to`` carries
+        the prefill frontier for a slot released mid-prefill, where
+        ``_slot_len`` is still 0 but [0, prefilled_to) is (or will be,
+        in program order) in the cache."""
+        if self.allocator is None:
+            return
+        self._attached_slots.discard(slot)
+        if self.prefix is None or not self.allocator.pages_of(slot):
+            self.allocator.free(slot)
+            return
+        covered = (prefilled_to if prefilled_to is not None
+                   else int(self._slot_len[slot]))
+        seq = (req.prompt_tokens + req.output_tokens)[:covered]
+        self.prefix.donate(seq, slot)
+
     def _finish(self, slot: int, req: Request) -> None:
         # Under the (reentrant) engine lock so metrics() never sees a
         # half-applied finish (slot freed but pages not yet returned).
         with self._lock:
             req.finished_at = time.time()
+            if req.first_token_at is None and req.output_tokens:
+                # Never report a None/0 TTFT for a request that DID
+                # stream tokens (a fully-cached prompt finishing the
+                # same step its first token landed).
+                req.first_token_at = req.finished_at
+                self._ttfts.append(req.finished_at - req.submitted_at)
             self._slots[slot] = None
+            # Release BEFORE zeroing _slot_len: donation covers exactly
+            # the positions whose K/V the pages hold, which is what
+            # _slot_len still records here.
+            self._release_slot_pages(slot, req)
             self._slot_len[slot] = 0
-            if self.allocator is not None:
-                self.allocator.free(slot)
             self.cache = self._free(self.cache, jnp.int32(slot))
         req._notify()
 
     def _preempt(self, slot: int) -> None:
         """Evict `slot` to reclaim its pages: the request goes back to
         the FRONT of the queue and resumes by recomputing
-        prompt+generated (vLLM-style recompute preemption). Output
+        prompt+generated (vLLM-style recompute preemption; with the
+        prefix cache its donated pages make the resume re-match its own
+        prefix, so the recompute shrinks to the partial tail). Output
         already streamed is kept; TTFT is not re-recorded."""
         with self._lock:
             req = self._slots[slot]
             self._slots[slot] = None
+            prefilled_to = self._prefilling.pop(slot, None)
+            self._release_slot_pages(slot, req, prefilled_to)
             self._slot_len[slot] = 0
-            self._prefilling.pop(slot, None)
-            self.allocator.free(slot)
             self.cache = self._free(self.cache, jnp.int32(slot))
             self._waiting.insert(0, req)
             self._preemptions += 1
+
+    def _unshare_write_range(self, slot: int, start_tok: int,
+                             end_tok: int) -> bool:
+        """Copy-on-write every shared page the coming writes to
+        positions [start_tok, end_tok) would touch, so no dispatch ever
+        mutates a page the radix tree (or another slot) still maps.
+        Returns False when a needed copy could not get a page (pool dry
+        and nothing evictable) — the caller treats that exactly like an
+        ``extend`` failure (defer the chunk / run the preemption
+        ladder), per ``PageAllocator.cow``'s contract.
+
+        Under the current match policy a CoW never fires — ``match``
+        caps at the last full page strictly before the prompt end, so
+        attached pages always sit strictly behind the write frontier —
+        but the invariant is enforced mechanically here rather than
+        implied by the matcher, so a future matching change (sharing
+        the frontier page) degrades to a page copy instead of silent
+        cross-request KV corruption."""
+        if self.prefix is None or slot not in self._attached_slots:
+            # Only a slot that attached cached pages can map a shared
+            # page (fresh extend pages are born refcount-1 and the tree
+            # never increfs a slot's private pages) — everyone else
+            # skips the per-token refcount walk.
+            return True
+        al = self.allocator
+        page = al.page_size
+        first = start_tok // page
+        last = (max(end_tok, start_tok + 1) - 1) // page
+        for idx in range(first, min(last + 1, al.pages_of(slot))):
+            if al.refcount(al.page_at(slot, idx)) <= 1:
+                continue
+            if not al.free_pages:
+                self.prefix.evict(1)
+            pair = al.cow(slot, idx)
+            if pair is None:
+                return False
+            self.cache = self._cow(self.cache, jnp.int32(pair[0]),
+                                   jnp.int32(pair[1]))
+        return True
+
+    def _extend_pages(self, slot: int, upto_tokens: int) -> bool:
+        """``allocator.extend`` with the prefix cache's LRU evictor as
+        the pressure valve: reclaim unreferenced cached pages (leaf
+        first) only when the free stack cannot cover the growth, and
+        only as many as the shortfall — BEFORE the caller escalates to
+        draining the pipeline or preempting a victim."""
+        if self.allocator.extend(slot, upto_tokens):
+            return True
+        if self.prefix is None:
+            return False
+        need = self.allocator.pages_needed(upto_tokens)
+        if need > self.allocator.max_pages_per_slot:
+            return False   # per-slot ceiling: eviction cannot help
+        shortfall = (need - self.allocator.pages_of(slot)
+                     - self.allocator.free_pages)
+        if shortfall <= 0 or not self.prefix.evict(shortfall):
+            return False
+        return self.allocator.extend(slot, upto_tokens)
 
     def _ensure_decode_pages(self, decoding: List[int]) -> List[int]:
         """Guarantee every decoding slot owns the page its next token
@@ -637,7 +817,13 @@ class InferenceEngine:
             if self._slots[slot] is None:
                 decoding.remove(slot)
                 continue
-            while not self.allocator.extend(slot, target(slot)):
+            # The unshare runs only once coverage exists; its failure
+            # (a shared page the pool cannot copy) walks the same
+            # drain → preempt → cache_full ladder as a dry pool.
+            while not (self._extend_pages(slot, target(slot))
+                       and self._unshare_write_range(
+                           slot, int(self._slot_len[slot]),
+                           target(slot))):
                 if self._queue:
                     # Catch up: pending consumes may free pages (and
                     # may finish THIS slot, handled by the re-checks).
@@ -695,6 +881,7 @@ class InferenceEngine:
                     req = self._waiting.pop(0)
                     self._slots[slot] = req   # reserve before releasing
                     self._prefilling[slot] = 0
+                    self._matched.discard(slot)
         # Chunk phase: bounded prefill work per step so decode latency
         # of active slots stays flat under prompt bursts. Chunks are
         # async dispatches (no sync), so several per step cost latency
@@ -913,6 +1100,8 @@ class InferenceEngine:
                     'pages_free': self.allocator.free_pages,
                     'preemptions': self._preemptions}
                    if self.allocator is not None else {}),
+                **(self.prefix.stats() if self.prefix is not None
+                   else {}),
             }
 
     def compiled_counts(self) -> Dict[str, int]:
@@ -927,7 +1116,13 @@ class InferenceEngine:
                 return -1
         return {'prefill': n(self._prefill_chunk),
                 'decode': n(self._decode),
-                'free': n(self._free)}
+                'free': n(self._free),
+                # Prefix cache adds exactly ONE potential program (the
+                # CoW page copy) which stays at 0 compiles unless a CoW
+                # actually fires — prefill-from-offset reuses the
+                # existing chunk buckets (offset is a traced scalar).
+                **({'cow': n(self._cow)} if self.prefix is not None
+                   else {})}
 
 
 class EnginePool:
@@ -996,7 +1191,25 @@ class EnginePool:
         total_time = sum(e._decode_time for e in self.engines)
         total_tokens = sum(t['decode_tokens'] for t in tiers)
         ttfts = sorted(x for e in self.engines for x in e._ttfts)
+        prefixed = [e.prefix for e in self.engines
+                    if e.prefix is not None]
+        prefix_agg = {}
+        if prefixed:
+            hits = sum(p.hits for p in prefixed)
+            total = hits + sum(p.misses for p in prefixed)
+            prefix_agg = {
+                'prefix_hit_rate': round(hits / total, 4) if total
+                else 0.0,
+                'prefix_tokens_saved': sum(p.tokens_saved
+                                           for p in prefixed),
+                'prefix_cached_pages': sum(p.cached_pages
+                                           for p in prefixed),
+                'prefix_evictions': sum(p.evictions for p in prefixed),
+                'prefix_hits': hits,
+                'prefix_misses': total - hits,
+            }
         return {
+            **prefix_agg,
             'decode_steps': sum(t['decode_steps'] for t in tiers),
             'decode_tokens': total_tokens,
             'decode_tokens_per_sec': (total_tokens / total_time

@@ -133,9 +133,11 @@ def paged_prefill_chunk(config: llama.LlamaConfig, params: llama.Params,
     — O(C * len) bandwidth instead of the dense path's O(C * S) fp32
     einsum over the whole static cache (VERDICT r4 weak #1).
 
-    The engine guarantees: chunk size C is a multiple of the page size,
-    offset is C-aligned (so page-aligned), and `table_row` already
-    covers positions [0, offset + C).
+    The engine guarantees: chunk size C is a multiple of the page
+    size, offset is PAGE-aligned (not necessarily C-aligned — a
+    prefix-cache match starts prefill at an arbitrary page boundary),
+    and `table_row` already covers positions [0, offset + C). Kernel
+    work must not assume offset % C == 0.
     """
     C = tokens.shape[0]
     x = quant_lib.qembed(params['embed'], tokens)[None]   # [1, C, d]

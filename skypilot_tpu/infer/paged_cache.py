@@ -62,6 +62,14 @@ class PageAllocator:
     Freed pages go back on the stack; their bytes stay in HBM untouched
     (a slot's length makes stale pages unreachable, same zero-memset
     rule as the dense cache's free_slot).
+
+    Pages are REFCOUNTED so the prefix cache (infer/prefix_cache.py)
+    can share one physical page between several slots' block-table rows
+    plus the radix tree itself: ``extend`` hands out fresh pages at
+    refcount 1, ``attach`` maps already-cached pages into a slot
+    (refcount++), and a page returns to the free stack only when its
+    LAST reference drops. Engines without the prefix cache never see a
+    refcount above 1 and behave exactly as before.
     """
 
     def __init__(self, n_pages: int, page_size: int, n_slots: int,
@@ -78,6 +86,7 @@ class PageAllocator:
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
         self._owned: List[List[int]] = [[] for _ in range(n_slots)]
         self._table = np.zeros((n_slots, max_pages_per_slot), np.int32)
+        self._ref = np.zeros((n_pages,), np.int32)
         # Bumped on every table mutation (pages assigned or returned):
         # the engine keys its device-resident block-table copy on this,
         # re-uploading only when the table actually changed instead of
@@ -91,6 +100,17 @@ class PageAllocator:
 
     def pages_of(self, slot: int) -> int:
         return len(self._owned[slot])
+
+    def owned_pages(self, slot: int) -> List[int]:
+        """The slot's page ids in block-table order (a copy)."""
+        return list(self._owned[slot])
+
+    def page_at(self, slot: int, idx: int) -> int:
+        """One page id, no list copy (per-token hot-path accessor)."""
+        return self._owned[slot][idx]
+
+    def refcount(self, pid: int) -> int:
+        return int(self._ref[pid])
 
     def pages_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
@@ -114,16 +134,75 @@ class PageAllocator:
             return False
         for _ in range(need):
             pid = self._free.pop()
+            self._ref[pid] = 1
             self._table[slot, len(self._owned[slot])] = pid
             self._owned[slot].append(pid)
         self.version += 1
         return True
 
-    def free(self, slot: int) -> None:
-        """Return all of `slot`'s pages to the pool."""
+    # -- reference counting (prefix sharing) -------------------------------
+    def incref(self, pid: int) -> None:
+        self._ref[pid] += 1
+
+    def decref(self, pid: int) -> None:
+        """Drop one reference; the page returns to the free stack when
+        the last reference goes (never the sink page)."""
+        assert self._ref[pid] > 0, f'double-free of page {pid}'
+        self._ref[pid] -= 1
+        if self._ref[pid] == 0 and pid != 0:
+            self._free.append(pid)
+
+    def attach(self, slot: int, pids: List[int]) -> None:
+        """Map already-resident (cached) pages as the PREFIX of an empty
+        slot's block table, taking one reference on each. The pages'
+        bytes are untouched — this is the whole prefix-cache win: the
+        slot starts life with its shared prefix already in HBM."""
+        assert not self._owned[slot], 'attach on a non-empty slot'
+        assert len(pids) <= self.max_pages_per_slot
+        for i, pid in enumerate(pids):
+            self.incref(pid)
+            self._table[slot, i] = pid
+        self._owned[slot] = list(pids)
+        if pids:
+            self.version += 1
+
+    def clear_slot(self, slot: int) -> None:
+        """Reset a slot's table WITHOUT touching refcounts — for callers
+        (PrefixCache.donate) that have already disposed of every
+        reference the slot held."""
         if self._owned[slot]:
             self.version += 1
-        self._free.extend(reversed(self._owned[slot]))
+        self._owned[slot] = []
+        self._table[slot, :] = 0
+
+    def cow(self, slot: int, page_idx: int) -> Optional[tuple]:
+        """Copy-on-write the slot's page at ``page_idx``: swap in a
+        fresh private page and drop the slot's reference on the shared
+        one. Returns (src_pid, dst_pid) for the engine's device-side
+        page copy, or None when the pool has no free page (the caller
+        evicts/preempts and retries). No-op (returns None) when the
+        page is not shared."""
+        pid = self._owned[slot][page_idx]
+        if self._ref[pid] <= 1:
+            return None
+        if not self._free:
+            return None
+        dst = self._free.pop()
+        self._ref[dst] = 1
+        self.decref(pid)
+        self._owned[slot][page_idx] = dst
+        self._table[slot, page_idx] = dst
+        self.version += 1
+        return pid, dst
+
+    def free(self, slot: int) -> None:
+        """Drop the slot's reference on all of its pages (pages shared
+        with the prefix tree or other slots survive; exclusive pages
+        return to the pool)."""
+        if self._owned[slot]:
+            self.version += 1
+        for pid in reversed(self._owned[slot]):
+            self.decref(pid)
         self._owned[slot] = []
         self._table[slot, :] = 0
 
@@ -137,3 +216,21 @@ def free_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
     ``free`` is the host half)."""
     return PagedKVCache(k_pages=cache.k_pages, v_pages=cache.v_pages,
                         lengths=cache.lengths.at[slot].set(0))
+
+
+def copy_page(cache: PagedKVCache, src: jnp.ndarray,
+              dst: jnp.ndarray) -> PagedKVCache:
+    """Device half of copy-on-write: duplicate physical page ``src``
+    into ``dst`` across all layers/heads (the allocator's ``cow`` is
+    the host half). src/dst are traced scalars, so one compiled program
+    covers every CoW."""
+    k_src = jax.lax.dynamic_index_in_dim(cache.k_pages, src, axis=2,
+                                         keepdims=True)
+    v_src = jax.lax.dynamic_index_in_dim(cache.v_pages, src, axis=2,
+                                         keepdims=True)
+    return PagedKVCache(
+        k_pages=jax.lax.dynamic_update_index_in_dim(
+            cache.k_pages, k_src, dst, axis=2),
+        v_pages=jax.lax.dynamic_update_index_in_dim(
+            cache.v_pages, v_src, dst, axis=2),
+        lengths=cache.lengths)

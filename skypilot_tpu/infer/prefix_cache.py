@@ -1,0 +1,199 @@
+"""Shared-prefix KV cache: radix-tree page reuse over the paged pool.
+
+Most production traffic shares long common prefixes — system prompts,
+few-shot templates, multi-turn history. Without sharing, every request
+re-prefills its whole prompt into private pages; with it, the repeated
+prefill becomes a host-side tree walk (vLLM's prefix caching, SGLang's
+RadixAttention — convergent design, re-derived here over this repo's
+``PageAllocator``).
+
+Structure: a radix tree at PAGE granularity. Each node is exactly one
+full page of tokens; its edge key is that page's token block (the
+``page_size``-tuple of token ids), so a node is reachable only through
+the exact chain of blocks that precede it. That chaining is what makes
+reuse SOUND: K/V at position p depends on every token <= p (causal
+attention through all layers), so a cached page may only be reused when
+the *entire* prefix matches — which the walk enforces structurally, and
+exact tuple keys (not hashes) make collision-proof.
+
+Ownership protocol (refcounts live in ``PageAllocator``):
+
+- The tree holds ONE reference on every cached page; each slot whose
+  block table maps the page holds one more. A page is *evictable* only
+  at refcount 1 (tree-only) — pages under active slots are pinned.
+- ``match`` returns the longest cached page-aligned prefix, capped at
+  the last full page strictly BEFORE the prompt end: at least one
+  token is always left to prefill (its logits seed the first sampled
+  token), so the slot's frontier page is always private and decode
+  never writes a shared page. The engine still guards the invariant
+  with copy-on-write (``PageAllocator.cow`` + ``copy_page``) in case a
+  future matching change shares the frontier.
+- ``donate`` (called by the engine on finish AND preempt) walks the
+  request's token sequence and hands the slot's full clean pages to the
+  tree instead of freeing them: new blocks transfer the slot's
+  reference to the tree; already-cached blocks just drop the slot's
+  reference (duplicates deallocate); the partial last page is freed.
+- ``evict`` reclaims leaf pages in LRU order, only under page pressure
+  (the engine calls it when ``extend`` fails, before considering
+  preemption). Leaves-first keeps every surviving node reachable.
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.infer import paged_cache as paged_cache_lib
+
+
+@dataclasses.dataclass
+class _Node:
+    block: Optional[Tuple[int, ...]]        # None only for the root
+    page_id: int                            # physical page (tree ref)
+    parent: Optional['_Node']
+    last_access: int
+    children: Dict[Tuple[int, ...], '_Node'] = dataclasses.field(
+        default_factory=dict)
+
+
+class PrefixCache:
+    """Radix tree of per-page token blocks -> physical page ids."""
+
+    def __init__(self,
+                 allocator: paged_cache_lib.PageAllocator) -> None:
+        self.allocator = allocator
+        self.page = allocator.page_size
+        self._root = _Node(block=None, page_id=-1, parent=None,
+                           last_access=0)
+        self._clock = 0
+        self.hits = 0
+        self.misses = 0
+        self.tokens_saved = 0
+        self.evictions = 0
+        self.cached_pages = 0
+
+    # -- lookup ------------------------------------------------------------
+    def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached page-aligned prefix of ``tokens``.
+
+        Returns (page_ids, n_tokens). Capped at the last full page
+        strictly before the end of ``tokens`` so the caller always
+        prefills >= 1 token (see module docstring). Touches the LRU
+        clock along the matched path. The caller must ``attach`` the
+        pages in the same engine step (nothing else runs between —
+        evictions happen only on the engine thread)."""
+        self._clock += 1
+        limit = (len(tokens) - 1) // self.page
+        node = self._root
+        pages: List[int] = []
+        for i in range(limit):
+            child = node.children.get(
+                tuple(tokens[i * self.page:(i + 1) * self.page]))
+            if child is None:
+                break
+            child.last_access = self._clock
+            pages.append(child.page_id)
+            node = child
+        matched = len(pages) * self.page
+        if matched:
+            self.hits += 1
+            self.tokens_saved += matched
+        else:
+            self.misses += 1
+        return pages, matched
+
+    # -- donation ----------------------------------------------------------
+    def donate(self, tokens: Sequence[int], slot: int) -> int:
+        """Release ``slot``'s pages into the tree: full pages covered by
+        ``tokens`` (the exact sequence whose K/V the pages hold) are
+        cached; everything else (the partial last page) is freed. Also
+        clears the slot's block table — this REPLACES
+        ``allocator.free(slot)`` on the finish/preempt paths. Returns
+        the number of newly cached pages."""
+        al = self.allocator
+        owned = al.owned_pages(slot)
+        self._clock += 1
+        full = min(len(tokens) // self.page, len(owned))
+        node = self._root
+        added = 0
+        for i in range(full):
+            blk = tuple(tokens[i * self.page:(i + 1) * self.page])
+            child = node.children.get(blk)
+            if child is None:
+                # Tree takes over the slot's reference — no decref.
+                child = _Node(block=blk, page_id=owned[i], parent=node,
+                              last_access=self._clock)
+                node.children[blk] = child
+                self.cached_pages += 1
+                added += 1
+            else:
+                # Block already cached (possibly by this very page, if
+                # it was attached at match time): drop the slot's ref;
+                # a privately-computed duplicate deallocates here.
+                child.last_access = self._clock
+                al.decref(owned[i])
+            node = child
+        for pid in owned[full:]:
+            al.decref(pid)
+        al.clear_slot(slot)
+        return added
+
+    # -- eviction ----------------------------------------------------------
+    def evict(self, n_pages: int) -> int:
+        """Reclaim up to ``n_pages`` cached pages, LRU leaf first.
+
+        Only refcount-1 pages (tree-only — no slot maps them) are
+        candidates; an attached page pins itself AND its ancestors
+        (ancestors are never leaves while it exists). Called by the
+        engine strictly under page pressure. Returns pages freed.
+
+        One tree walk total, not one per freed page: the walk seeds a
+        min-heap of evictable leaves; evicting a node may turn its
+        parent into a leaf, which is pushed then. Multi-page
+        shortfalls (a whole prefill chunk) stay O(tree + k log k)."""
+        freed = 0
+        heap = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            if (node is not self._root and not node.children
+                    and self.allocator.refcount(node.page_id) == 1):
+                heap.append((node.last_access, id(node), node))
+            stack.extend(node.children.values())
+        heapq.heapify(heap)
+        while freed < n_pages and heap:
+            _, _, victim = heapq.heappop(heap)
+            if (victim.children or victim.parent is None
+                    or victim.parent.children.get(victim.block)
+                    is not victim
+                    or self.allocator.refcount(victim.page_id) != 1):
+                continue   # stale heap entry
+            parent = victim.parent
+            del parent.children[victim.block]
+            self.allocator.decref(victim.page_id)
+            self.cached_pages -= 1
+            self.evictions += 1
+            freed += 1
+            if (parent is not self._root and not parent.children
+                    and self.allocator.refcount(parent.page_id) == 1):
+                heapq.heappush(heap,
+                               (parent.last_access, id(parent), parent))
+        return freed
+
+    # -- observability -----------------------------------------------------
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            'prefix_hit_rate': round(self.hit_rate(), 4),
+            'prefix_tokens_saved': self.tokens_saved,
+            'prefix_cached_pages': self.cached_pages,
+            'prefix_evictions': self.evictions,
+            # Raw counters so consumers (bench_ttft's shared-prefix
+            # sweep) can compute WINDOWED hit rates from deltas — the
+            # rate above is cumulative since engine start.
+            'prefix_hits': self.hits,
+            'prefix_misses': self.misses,
+        }

@@ -433,7 +433,11 @@ class InferenceServer:
                         await resp.write(json.dumps(
                             {'done': True, 'request_id': req.request_id,
                              'finish_reason': req.finish_reason,
-                             'ttft_s': req.ttft}).encode() + b'\n')
+                             'ttft_s': req.ttft,
+                             # Prompt tokens served from the shared-
+                             # prefix KV cache (prefill skipped).
+                             'cached_tokens': req.cached_tokens
+                             }).encode() + b'\n')
                         break
                     await waiter.wait(1.0)
             finally:
@@ -456,6 +460,7 @@ class InferenceServer:
             'text': self.tokenizer.decode(req.output_tokens),
             'finish_reason': req.finish_reason,
             'ttft_s': req.ttft,
+            'cached_tokens': req.cached_tokens,
         })
 
     def make_app(self) -> web.Application:
@@ -499,6 +504,13 @@ def main() -> None:
                         help='Page-pool size (default: dense-equivalent '
                              'slots*max_seq/page; lower it to cap KV '
                              'HBM at expected tokens-in-flight)')
+    parser.add_argument('--prefix-cache', action='store_true',
+                        help='Shared-prefix KV reuse over the paged '
+                             'pool (requires --paged): repeated prompt '
+                             'prefixes attach cached pages instead of '
+                             're-prefilling (infer/prefix_cache.py); '
+                             '/metrics gains prefix_* counters and '
+                             'responses a cached_tokens field.')
     parser.add_argument('--tp', type=int, default=1,
                         help='Tensor-parallel degree over local devices '
                              '(8B-class models need tp>=4 on v5e in '
@@ -523,6 +535,9 @@ def main() -> None:
         # checkpoint loading and KV allocation.
         raise SystemExit('--paged already serves mixed lengths from '
                          'one pool; drop --long-slots')
+    if args.prefix_cache and not args.paged:
+        raise SystemExit('--prefix-cache requires --paged (sharing is '
+                         'at page granularity)')
 
     # Multi-host replica: the agent runs this same command on EVERY host
     # of the slice with the jax.distributed env injected
@@ -618,7 +633,7 @@ def main() -> None:
             max_seq_len=min(args.max_seq_len, config.max_seq_len),
             tp=args.tp, quantize=args.quantize,
             paged=args.paged, page_size=args.page_size,
-            n_pages=args.n_pages,
+            n_pages=args.n_pages, prefix_cache=args.prefix_cache,
             pipeline_depth=args.pipeline_depth))
     if args.long_slots > 0:
         short_cap = min(args.max_seq_len, config.max_seq_len)

@@ -159,15 +159,26 @@ class LoadBalancer:
             'breaker': self.breaker.snapshot(),
         }
 
-    def _select(self, tried: Set[str]) -> Optional[str]:
-        """Pick the next replica: the policy's choice if its breaker
-        admits it, else the first admissible candidate. If EVERY
-        breaker is open, fail open with any untried replica — turning
-        a possibly-wrong breaker into a total blackout is worse than
-        one wasted probe."""
+    def _select(self, tried: Set[str],
+                affinity: Optional[str] = None) -> Optional[str]:
+        """Pick the next replica: the affinity-preferred replica (the
+        cache-aware policy's consistent-hash home for this prompt
+        prefix) when it is admissible, else the policy's choice if its
+        breaker admits it, else the first admissible candidate. If
+        EVERY breaker is open, fail open with any untried replica —
+        turning a possibly-wrong breaker into a total blackout is worse
+        than one wasted probe."""
         candidates = [u for u in self.policy.ready_urls if u not in tried]
         if not candidates:
             return None
+        if affinity is not None:
+            preferred = self.policy.preferred_replica(affinity)
+            # Breaker-open (or already-tried) preferred replica: fall
+            # through to the base policy below instead of routing into
+            # a corpse just to keep the cache warm.
+            if (preferred in candidates
+                    and self.breaker.allows(preferred)):
+                return preferred
         blocked: Set[str] = set()
         # Bounded walk of policy picks (least-load may repeat itself).
         for _ in range(len(self.policy.ready_urls) + 1):
@@ -308,8 +319,17 @@ class LoadBalancer:
         body = await request.read()
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in _HOP_HEADERS}
+        # Prefix affinity (cache-aware policy only): same-prefix
+        # /generate traffic keeps landing on the same replica so its
+        # radix tree actually accumulates hits. Other policies never
+        # consume the key, so they must not pay the body JSON parse on
+        # the proxy hot path.
+        affinity = (lbp.affinity_key(request.path, body)
+                    if request.method == 'POST'
+                    and isinstance(self.policy, lbp.CacheAwarePolicy)
+                    else None)
         tried: Set[str] = set()
-        url = self._select(tried)
+        url = self._select(tried, affinity)
         if url is None:
             self._requests_no_replica += 1
             return web.Response(
@@ -344,7 +364,7 @@ class LoadBalancer:
                     self.breaker.record_failure(current)
                     tried.add(current)
                     last_failure = e
-                    next_url = self._select(tried)
+                    next_url = self._select(tried, affinity)
                     if next_url is not None:
                         self._requests_retried += 1
                         logger.warning(

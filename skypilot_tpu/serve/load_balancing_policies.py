@@ -5,9 +5,21 @@ Counterpart of the reference's ``sky/serve/load_balancing_policies.py``
 InstanceAwareLeastLoadPolicy :151). Policies are synchronous and
 in-memory; the LB serializes calls through the asyncio event loop so no
 locking is needed.
+
+``CacheAwarePolicy`` is the serve half of the shared-prefix KV cache
+(infer/prefix_cache.py): each replica's radix tree only pays off if
+same-prefix traffic keeps landing on the SAME replica, so /generate
+requests are routed by a consistent hash of the prompt's leading
+token/char block — the host-side analogue of the per-page block hash
+the engine's radix tree is keyed by. Everything else (non-generate
+paths, no prompt, preferred replica's breaker open) falls back to
+least-load.
 """
 from __future__ import annotations
 
+import bisect
+import hashlib
+import json
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -34,6 +46,14 @@ class LoadBalancingPolicy:
 
     def select_replica(self) -> Optional[str]:
         raise NotImplementedError
+
+    def preferred_replica(self, affinity: str) -> Optional[str]:
+        """Affinity hint: the replica this request SHOULD land on (or
+        None when the policy has no opinion). The LB tries it first and
+        falls back to ``select_replica`` when it is untried-but-
+        inadmissible (breaker open) — only the cache-aware policy
+        implements it."""
+        return None
 
     def pre_execute(self, url: str) -> None:
         """Called before proxying a request to ``url``."""
@@ -119,10 +139,80 @@ class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
             return min(self.ready_urls, key=self._normalized_load)
 
 
+# Affinity key: the prompt's leading block. 64 tokens = one page at
+# the engine's default page_size, i.e. the first radix-tree edge; for
+# text prompts (the LB has no tokenizer) a char-block of the same order
+# of magnitude keys the same way — equal system prompts hash equal.
+AFFINITY_LEAD_TOKENS = 64
+AFFINITY_LEAD_CHARS = 256
+
+
+def affinity_key(path: str, body: bytes) -> Optional[str]:
+    """Derive the prefix-affinity key for a proxied request, or None
+    when the request has no prompt to key on. Tolerant by design: any
+    parse failure means 'no affinity', never an error."""
+    if not path.endswith('/generate') or not body:
+        return None
+    try:
+        payload = json.loads(body)
+    except Exception:  # noqa: BLE001 — the replica will 400 it anyway
+        return None
+    if not isinstance(payload, dict):
+        return None
+    tokens = payload.get('tokens')
+    if isinstance(tokens, list) and tokens:
+        return 'tok:' + ','.join(
+            str(t) for t in tokens[:AFFINITY_LEAD_TOKENS])
+    prompt = payload.get('prompt')
+    if isinstance(prompt, str) and prompt:
+        return 'txt:' + prompt[:AFFINITY_LEAD_CHARS]
+    return None
+
+
+class CacheAwarePolicy(LeastLoadPolicy):
+    """Consistent-hash same-prefix traffic onto the same replica.
+
+    A replica's shared-prefix KV cache (infer/prefix_cache.py) only
+    produces hits when requests sharing a prompt prefix revisit it, so
+    the selector maps the prompt's leading block onto a hash ring of
+    the ready replicas (vnodes smooth the distribution). Consistent
+    hashing — not modulo — so a replica joining or leaving only remaps
+    the keys on its own arcs instead of reshuffling every prefix's
+    home (which would cold every radix tree in the fleet at once).
+
+    Requests without a prompt, and preferred replicas the LB's breaker
+    refuses, fall back to the inherited least-load selection.
+    """
+
+    _VNODES = 64
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._ring: List[tuple] = []   # sorted (hash, url)
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(
+            hashlib.md5(key.encode()).digest()[:8], 'big')
+
+    def _on_replica_change(self, new_urls: List[str]) -> None:
+        self._ring = sorted(
+            (self._hash(f'{url}#{v}'), url)
+            for url in new_urls for v in range(self._VNODES))
+
+    def preferred_replica(self, affinity: str) -> Optional[str]:
+        with self._lock:
+            if not self._ring:
+                return None
+            i = bisect.bisect(self._ring, (self._hash(affinity), ''))
+            return self._ring[i % len(self._ring)][1]
+
+
 POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_load': LeastLoadPolicy,
     'instance_aware_least_load': InstanceAwareLeastLoadPolicy,
+    'cache_aware': CacheAwarePolicy,
 }
 
 

@@ -109,6 +109,50 @@ def test_least_load_policy():
     assert p.select_replica() in ('a', 'b')
 
 
+def test_cache_aware_policy_affinity_and_fallback():
+    import json as json_lib
+    p = lbp.CacheAwarePolicy()
+    assert p.preferred_replica('tok:1,2') is None   # no replicas yet
+    p.set_ready_replicas(['a', 'b', 'c'])
+
+    # Same leading block -> same key -> same home replica; different
+    # tails don't matter (that's the whole prefix-affinity point).
+    shared = 'SYSTEM PROMPT ' * 40
+    k1 = lbp.affinity_key('/generate', json_lib.dumps(
+        {'prompt': shared + 'user question one'}).encode())
+    k2 = lbp.affinity_key('/generate', json_lib.dumps(
+        {'prompt': shared + 'a totally different question'}).encode())
+    assert k1 == k2
+    assert p.preferred_replica(k1) == p.preferred_replica(k2)
+
+    # Token payloads key on the leading AFFINITY_LEAD_TOKENS ids.
+    t1 = lbp.affinity_key('/generate', json_lib.dumps(
+        {'tokens': list(range(100))}).encode())
+    t2 = lbp.affinity_key('/generate', json_lib.dumps(
+        {'tokens': list(range(lbp.AFFINITY_LEAD_TOKENS)) + [7] * 9}
+    ).encode())
+    assert t1 == t2
+
+    # No prompt / non-generate path / garbage body -> no affinity.
+    assert lbp.affinity_key('/generate', b'{}') is None
+    assert lbp.affinity_key('/metrics', b'{"prompt": "x"}') is None
+    assert lbp.affinity_key('/generate', b'not json') is None
+
+    # Consistent hashing: dropping one replica only remaps the keys
+    # that lived on it; every other prefix keeps its warm home.
+    keys = [lbp.affinity_key('/generate', json_lib.dumps(
+        {'tokens': [i] * 70}).encode()) for i in range(40)]
+    before = {k: p.preferred_replica(k) for k in keys}
+    p.set_ready_replicas(['a', 'c'])
+    for k in keys:
+        if before[k] != 'b':
+            assert p.preferred_replica(k) == before[k]
+
+    # Fallback selection is inherited least-load.
+    p.pre_execute('a')
+    assert p.select_replica() == 'c'
+
+
 # ---------- autoscaler ----------------------------------------------------
 def test_request_rate_autoscaler_hysteresis():
     name = 'as-svc'

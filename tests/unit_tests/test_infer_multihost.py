@@ -17,6 +17,19 @@ import pytest
 
 pytestmark = pytest.mark.jax
 
+
+def _require_multiprocess() -> None:
+    """Capability probe, not a test assertion: some XLA-CPU builds
+    cannot run computations spanning two processes ("Multiprocess
+    computations aren't implemented"). That is an environment limit —
+    skipping keeps tier-1 red meaning 'real regression' only. The
+    probe result is cached per test process."""
+    from skypilot_tpu.infer import multihost as mh
+    if not mh.xla_cpu_multiprocess_supported():
+        pytest.skip('XLA CPU lacks multiprocess computation support '
+                    'in this environment')
+
+
 _RANK_SCRIPT = textwrap.dedent("""
     import json, os, sys, threading, time
     import jax
@@ -54,6 +67,7 @@ _RANK_SCRIPT = textwrap.dedent("""
 
 
 def test_two_process_tp_matches_single_process(tmp_path):
+    _require_multiprocess()
     from skypilot_tpu.utils import common
     port = common.free_port()
     script = tmp_path / 'rank.py'
@@ -137,6 +151,7 @@ def test_watchdog_detects_dead_follower(tmp_path):
     broadcast — the tick watchdog exits it nonzero within the deadline
     so the serve replica manager can relaunch the slice (VERDICT r4
     weak #3)."""
+    _require_multiprocess()
     from skypilot_tpu.infer import multihost as mh
     from skypilot_tpu.utils import common
     port = common.free_port()
