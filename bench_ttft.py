@@ -53,9 +53,9 @@ def _wait_http(url: str, deadline_s: float) -> None:
     raise RuntimeError(f'{url} never became healthy: {last}')
 
 
-def _run_lb(service: str, port: int) -> None:
+def _run_lb(service: str, port: int, policy: str = 'least_load') -> None:
     from skypilot_tpu.serve import load_balancer
-    load_balancer.run_load_balancer(service, 'least_load', '127.0.0.1',
+    load_balancer.run_load_balancer(service, policy, '127.0.0.1',
                                     port)
 
 
@@ -227,6 +227,70 @@ def _shared_prefix_level(gen_url: str, metrics_url: str,
     return out
 
 
+def _chaos_request(gen_url: str, payload, max_new_tokens: int = 32,
+                   timeout: float = 300.0) -> dict:
+    """One streamed request under chaos: wall duration, the done-line's
+    LB-stamped resume count, and whether a complete stream arrived."""
+    if not isinstance(payload, dict):
+        payload = {'prompt': payload}
+    payload = {'max_new_tokens': max_new_tokens, 'stream': True,
+               **payload}
+    req = urllib.request.Request(
+        gen_url, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    t0 = time.perf_counter()
+    done = None
+    clean = True
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            for line in iter(r.readline, b''):
+                if not line.strip():
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if 'error' in obj:
+                    clean = False
+                if obj.get('done'):
+                    done = obj
+    except Exception:  # noqa: BLE001 — a truncated stream = incomplete
+        clean = False
+    return {'duration_s': time.perf_counter() - t0,
+            'resumed': int((done or {}).get('resumed', 0)),
+            'completed': bool(done) and clean}
+
+
+def _chaos_resume_level(gen_url: str, concurrency: int,
+                        n_requests: int,
+                        max_new_tokens: int = 32) -> dict:
+    """One concurrency level of the chaos-resume sweep: completed-
+    stream rate, resume count, and the p99 total latency of resumed vs
+    untouched streams (the price of a mid-stream failover)."""
+    with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
+        futs = [pool.submit(_chaos_request, gen_url,
+                            f'chaos request {i}', max_new_tokens)
+                for i in range(n_requests)]
+        results = [f.result()
+                   for f in concurrent.futures.as_completed(futs)]
+    clean = sorted(r['duration_s'] for r in results
+                   if r['completed'] and not r['resumed'])
+    resumed = sorted(r['duration_s'] for r in results
+                     if r['completed'] and r['resumed'])
+    completed = sum(r['completed'] for r in results)
+    out = {
+        'concurrency': concurrency,
+        'issued': n_requests,
+        'completed': completed,
+        'completed_rate': round(completed / n_requests, 4),
+        'resumes': sum(r['resumed'] for r in results),
+        'resumed_streams': len(resumed),
+        'clean_total_p99_s': _pct(clean, 0.99),
+        'resumed_total_p99_s': _pct(resumed, 0.99),
+    }
+    return out
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--requests-per-level', type=int, default=80)
@@ -250,14 +314,25 @@ def main() -> None:
     parser.add_argument('--page-size', type=int, default=64)
     parser.add_argument('--n-pages', type=int, default=None)
     parser.add_argument('--sweep', default='concurrency',
-                        choices=['concurrency', 'shared-prefix'],
+                        choices=['concurrency', 'shared-prefix',
+                                 'chaos-resume'],
                         help="'shared-prefix': the shared-system-"
                              'prompt workload (implies --paged '
                              '--prefix-cache) — per level, a cold '
                              'all-miss pass vs a shared-prefix pass, '
                              'emitting prefix_hit_rate, '
                              'tokens_prefill_saved and the TTFT '
-                             'improvement into the json')
+                             "improvement into the json. 'chaos-"
+                             "resume': mid-stream failover under a "
+                             'ChaosProxy that severs streams after '
+                             '--kill-after-chunks chunks — per level, '
+                             'an uninterrupted pass vs a chaos pass, '
+                             'emitting completed-request rate, resume '
+                             'count, and the p99 latency a resumed '
+                             'stream adds over an uninterrupted one')
+    parser.add_argument('--kill-after-chunks', type=int, default=6,
+                        help='chaos-resume: sever the proxied stream '
+                             'after this many response chunks')
     parser.add_argument('--prefix-cache', action='store_true',
                         help='enable shared-prefix KV reuse on the '
                              'replica (requires --paged)')
@@ -345,15 +420,20 @@ def main() -> None:
         _wait_http(f'http://127.0.0.1:{infer_port}/health', 600)
 
         # 2. Register it as a ready replica; start the REAL serve LB.
+        #    chaos-resume alternates replicas deterministically
+        #    (round_robin) so ~half the streams ride the doomed proxy.
         from skypilot_tpu.serve import state as serve_state
         from skypilot_tpu.serve.state import ReplicaStatus
+        lb_policy = ('round_robin' if args.sweep == 'chaos-resume'
+                     else 'least_load')
         serve_state.add_service(service, spec_json='{}', task_yaml='',
-                                lb_port=lb_port, lb_policy='least_load')
+                                lb_port=lb_port, lb_policy=lb_policy)
         rid = serve_state.add_replica(service, 'ttft-local', 1)
         serve_state.set_replica_url(rid, f'http://127.0.0.1:{infer_port}')
         serve_state.set_replica_status(rid, ReplicaStatus.READY)
         lb_proc = multiprocessing.Process(target=_run_lb,
-                                          args=(service, lb_port))
+                                          args=(service, lb_port,
+                                                lb_policy))
         lb_proc.start()
         try:
             _wait_http(f'http://127.0.0.1:{lb_port}/-/metrics', 60)
@@ -385,6 +465,62 @@ def main() -> None:
                         args.requests_per_level,
                         args.shared_prefix_tokens,
                         uniq_base=(li + 1) * 1_000_000))
+            elif args.sweep == 'chaos-resume':
+                # Importable because bench_ttft runs from the repo
+                # root (same reason the tests can).
+                from tests.chaos.chaos_proxy import ChaosProxy
+                lb_metrics_url = f'http://127.0.0.1:{lb_port}/-/metrics'
+                _sweep_level(gen_url, max(args.concurrency),
+                             2 * args.slots)   # warm off the clock
+                # Uninterrupted pass: the direct replica only.
+                clean_levels = [
+                    _chaos_resume_level(gen_url, conc,
+                                        args.requests_per_level)
+                    for conc in args.concurrency]
+                # Arm the chaos: a second "replica" through a proxy
+                # that severs every stream after N response chunks.
+                proxy = ChaosProxy(
+                    target_port=infer_port, kill_every_s=3600.0,
+                    kill_after_chunks=args.kill_after_chunks).start()
+                rid2 = serve_state.add_replica(service, 'ttft-chaos', 1)
+                serve_state.set_replica_url(
+                    rid2, f'http://127.0.0.1:{proxy.port}')
+                serve_state.set_replica_status(rid2, ReplicaStatus.READY)
+                try:
+                    deadline = time.time() + 30
+                    while time.time() < deadline:
+                        m = _get(lb_metrics_url)
+                        if m.get('ready_replicas', 0) >= 2:
+                            break
+                        time.sleep(0.5)
+                    m0 = _get(lb_metrics_url)
+                    chaos_levels = [
+                        _chaos_resume_level(gen_url, conc,
+                                            args.requests_per_level)
+                        for conc in args.concurrency]
+                    m1 = _get(lb_metrics_url)
+                finally:
+                    proxy.stop()
+                    serve_state.remove_replica(rid2)
+                for conc, cl, ch in zip(args.concurrency, clean_levels,
+                                        chaos_levels):
+                    lvl = {'concurrency': conc,
+                           'samples': cl['issued'] + ch['issued'],
+                           'uninterrupted': cl, 'chaos': ch,
+                           'completed_rate': ch['completed_rate'],
+                           'resumes': ch['resumes']}
+                    if (ch['resumed_total_p99_s']
+                            and cl['clean_total_p99_s']):
+                        # The latency price of a mid-stream failover:
+                        # resumed-stream p99 vs an untouched run.
+                        lvl['resume_added_p99_s'] = round(
+                            ch['resumed_total_p99_s']
+                            - cl['clean_total_p99_s'], 5)
+                    lvl['lb_requests_resumed'] = (
+                        m1['requests_resumed'] - m0['requests_resumed'])
+                    lvl['lb_requests_failed'] = (
+                        m1['requests_failed'] - m0['requests_failed'])
+                    sweep.append(lvl)
             else:
                 # Warm every concurrency level's batch shapes off the
                 # clock.
@@ -425,6 +561,20 @@ def main() -> None:
                 'itl_ratio_shared_over_cold'),
             'prefix_cache': True,
         }
+    elif args.sweep == 'chaos-resume':
+        head = {
+            'metric': 'chaos_resume_completed_rate',
+            'value': base.get('completed_rate'),
+            'unit': 'completed streams / issued (mid-stream kills '
+                    'armed on half the fleet)',
+            'resumes': sum(lv.get('resumes', 0) for lv in sweep),
+            'resume_added_p99_s': base.get('resume_added_p99_s'),
+            'lb_requests_resumed': sum(
+                lv.get('lb_requests_resumed', 0) for lv in sweep),
+            'lb_requests_failed': sum(
+                lv.get('lb_requests_failed', 0) for lv in sweep),
+            'kill_after_chunks': args.kill_after_chunks,
+        }
     else:
         head = {
             'metric': 'serve_ttft_warm_p50_s',
@@ -439,7 +589,8 @@ def main() -> None:
         'sweep_mode': args.sweep,
         'cold_first_request_s': cold_s,
         'sweep': sweep,
-        'total_samples': sum(lv['samples'] for lv in sweep),
+        'total_samples': sum(lv.get('samples', lv.get('issued', 0))
+                             for lv in sweep),
         'model': args.model,
         'tp': args.tp,
         'slots': args.slots,

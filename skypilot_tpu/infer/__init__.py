@@ -13,7 +13,7 @@ built TPU-first):
   handling, TTFT/throughput metrics).
 - ``server``: aiohttp HTTP front end replicas run under `sky-tpu serve`.
 """
-from skypilot_tpu.infer.engine import (EngineConfig, InferenceEngine,
-                                       Request)
+from skypilot_tpu.infer.engine import (AdmissionError, EngineConfig,
+                                       InferenceEngine, Request)
 
-__all__ = ['EngineConfig', 'InferenceEngine', 'Request']
+__all__ = ['AdmissionError', 'EngineConfig', 'InferenceEngine', 'Request']

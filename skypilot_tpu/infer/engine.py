@@ -48,6 +48,20 @@ from skypilot_tpu.infer import prefix_cache as prefix_cache_lib
 from skypilot_tpu.infer import sampling as sampling_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.observability import trace
+from skypilot_tpu.utils import failpoints
+
+
+class AdmissionError(ValueError):
+    """The engine refused new work because its queue is at capacity
+    (``EngineConfig.max_queue_requests`` / ``max_queue_tokens``): the
+    caller sheds (HTTP 429 + Retry-After at the server) instead of
+    queueing unboundedly. A ``ValueError`` subclass so the multihost
+    lockstep tick's uniform-rejection rule applies unchanged on every
+    host."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,6 +122,14 @@ class EngineConfig:
     # preemption is considered. Greedy outputs are bit-identical with
     # the cache on vs off (same determinism bar as pipeline_depth).
     prefix_cache: bool = False
+    # Admission control (docs/robustness.md "Zero-downtime serving"):
+    # bound the waiting queue so a saturated engine sheds load (the
+    # server answers 429 + Retry-After) instead of queueing without
+    # bound. None = unbounded. max_queue_tokens caps the total
+    # prompt+resume tokens parked in the queue — the companion knob for
+    # few-but-huge prompts.
+    max_queue_requests: Optional[int] = None
+    max_queue_tokens: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -124,6 +146,20 @@ class Request:
     # Prompt tokens served from the shared-prefix cache (their prefill
     # was skipped); surfaced per request by the server's done-line.
     cached_tokens: int = 0
+    # Tokens this request resumed from (mid-stream failover: the serve
+    # LB re-issues a died stream with the already-delivered tokens as
+    # ``resume_from``). They are pre-seeded into output_tokens and
+    # prefilled with the prompt; the server stream never re-emits them.
+    resumed_from: int = 0
+    # Wall-clock deadline (absolute time.time()): once passed, the
+    # engine finishes the request ('deadline') at its next step —
+    # queued or decoding — and frees its slot/pages. None = no deadline.
+    deadline: Optional[float] = None
+    # Cooperative cancellation (client disconnect): flagged by
+    # ``InferenceEngine.cancel``; only the engine thread acts on it
+    # (queued → dropped before admission, active → finished
+    # 'cancelled'), so device state is never touched from HTTP threads.
+    cancelled: bool = False
     # Token-event delivery: the engine notifies after every appended
     # token and on finish, so consumers (HTTP handlers, the lockstep
     # warm-up) wait on the condition instead of sleep-polling the
@@ -371,6 +407,16 @@ class InferenceEngine:
         self._decode_tokens = 0
         self._decode_time = 0.0
         self._preemptions = 0
+        # Zero-downtime-serving counters: queued requests dropped
+        # because the client vanished, requests cut by their deadline,
+        # active requests cancelled by a client disconnect.
+        self._abandoned = 0
+        self._expired = 0
+        self._cancelled = 0
+        # Wall-clock sweeps (deadline / cancel) read the LOCAL clock;
+        # the multihost lockstep driver disables them — every host must
+        # make identical request-state decisions each tick.
+        self.wallclock_cancel = True
         # Recent-window TTFTs: bounded so a long-lived replica's /metrics
         # stays O(1) in memory and p50 reflects current behavior.
         self._ttfts: collections.deque = collections.deque(maxlen=1024)
@@ -510,29 +556,42 @@ class InferenceEngine:
     # ---- submission ------------------------------------------------------
     def submit(self, prompt_tokens: Sequence[int],
                max_new_tokens: Optional[int] = None,
-               temperature: float = 0.0) -> Request:
+               temperature: float = 0.0,
+               resume_tokens: Optional[Sequence[int]] = None,
+               deadline: Optional[float] = None) -> Request:
+        """Queue a request. ``resume_tokens`` continues a stream whose
+        earlier tokens were already delivered elsewhere (mid-stream
+        failover): they are pre-seeded into ``output_tokens``, so
+        prefill covers prompt+resume (the same recompute path as paged
+        preemption — greedy continuation is bit-identical to an
+        uninterrupted run) and decoding picks up at the boundary.
+        ``deadline`` is an absolute wall-clock cutoff enforced by the
+        step loop. Raises :class:`AdmissionError` when the queue is at
+        the configured bound."""
         if not prompt_tokens:
             raise ValueError('empty prompt')
-        if len(prompt_tokens) > self.ecfg.max_seq_len - 1:
+        resume = list(map(int, resume_tokens)) if resume_tokens else []
+        total = len(prompt_tokens) + len(resume)
+        if total > self.ecfg.max_seq_len - 1:
             raise ValueError(
-                f'prompt ({len(prompt_tokens)} tokens) exceeds cache '
+                f'prompt+resume ({total} tokens) exceeds cache '
                 f'capacity ({self.ecfg.max_seq_len - 1})')
         if self.allocator is not None:
             # Peak prefill allocation is BUCKET-padded (the final chunk
             # writes its whole padded bucket), plus one decode page —
             # admitting on the raw token count would accept requests
             # that can never finish prefill (starvation, not an error).
-            n = len(prompt_tokens)
+            n = total
             off = (n // self._chunk_cap) * self._chunk_cap
             rem = n - off
             peak = self.allocator.pages_needed(
                 off + (self._bucket(rem) if rem else 0)) + 1
             if peak > self.allocator.n_pages - 1:
                 raise ValueError(
-                    f'prompt ({n} tokens; {peak} pages incl. padding + '
-                    f'first decode page) exceeds the page pool '
-                    f'({self.allocator.n_pages - 1} usable pages x '
-                    f'{self.allocator.page_size})')
+                    f'prompt+resume ({n} tokens; {peak} pages incl. '
+                    f'padding + first decode page) exceeds the page '
+                    f'pool ({self.allocator.n_pages - 1} usable pages '
+                    f'x {self.allocator.page_size})')
         if max_new_tokens is None:
             max_new_tokens = self.ecfg.max_new_tokens
         if max_new_tokens < 1:
@@ -541,10 +600,52 @@ class InferenceEngine:
             request_id=next(self._ids),
             prompt_tokens=list(map(int, prompt_tokens)),
             max_new_tokens=max_new_tokens,
-            temperature=float(temperature))
+            temperature=float(temperature),
+            output_tokens=resume,
+            resumed_from=len(resume),
+            deadline=deadline)
+        if resume and len(resume) >= max_new_tokens:
+            # The stream died on its very last token: the budget is
+            # already spent — finish without ever entering the queue
+            # (the caller emits the done line immediately).
+            req.finish_reason = 'max_tokens'
+            req.finished_at = time.time()
+            return req
+        try:
+            # Chaos seam: force the shed path without actually filling
+            # the queue.
+            failpoints.hit('infer.engine.admit_full')
+        except failpoints.FailpointError as e:
+            raise AdmissionError(f'injected admit-full: {e}') from e
         with self._lock:
+            cap = self.ecfg.max_queue_requests
+            if cap is not None and len(self._waiting) >= cap:
+                raise AdmissionError(
+                    f'engine queue full ({len(self._waiting)} waiting '
+                    f'>= max_queue_requests={cap})')
+            tcap = self.ecfg.max_queue_tokens
+            if tcap is not None:
+                queued = sum(len(r.prompt_tokens) + len(r.output_tokens)
+                             for r in self._waiting)
+                if queued + total > tcap:
+                    raise AdmissionError(
+                        f'engine queue full ({queued} queued tokens + '
+                        f'{total} > max_queue_tokens={tcap})')
             self._waiting.append(req)
         return req
+
+    def cancel(self, req: Request) -> bool:
+        """Request cancellation (thread-safe, cooperative): flags the
+        request; the engine thread drops it at its next step — a queued
+        request never admits ('requests_abandoned' — it stops occupying
+        an admission-control queue slot immediately), an active one
+        finishes 'cancelled' with its pages donated to the prefix cache
+        or freed. Returns False when the request already finished."""
+        with self._lock:
+            if req.done:
+                return False
+            req.cancelled = True
+        return True
 
     # ---- internals -------------------------------------------------------
     def _bucket(self, n: int) -> int:
@@ -720,6 +821,65 @@ class InferenceEngine:
             self.cache = self._free(self.cache, jnp.int32(slot))
         req._notify()
 
+    def _finish_queued(self, req: Request, reason: str) -> None:
+        """Finish a request that never reached a slot (abandoned or
+        expired while waiting). Under the engine lock."""
+        req.finish_reason = reason
+        req.finished_at = time.time()
+        req._notify()
+
+    def _finish_early(self, slot: int, req: Request, reason: str) -> None:
+        """Tear an ACTIVE slot down outside the natural finish path
+        (client gone / deadline passed): same page discipline as
+        ``_finish`` — donate-or-free BEFORE zeroing ``_slot_len`` — plus
+        mid-prefill cleanup (the ``_prefilling`` frontier is what the
+        pages cover). Engine thread only: it mutates device state. Any
+        in-flight pipeline steps for this slot drop their tokens via the
+        stale-by-one rule (``_slots[slot] is not req``)."""
+        with self._lock:
+            prefilled_to = self._prefilling.pop(slot, None)
+            req.finish_reason = reason
+            req.finished_at = time.time()
+            self._slots[slot] = None
+            self._matched.discard(slot)
+            self._release_slot_pages(slot, req, prefilled_to)
+            self._slot_len[slot] = 0
+            self.cache = self._free(self.cache, jnp.int32(slot))
+        req._notify()
+
+    def _sweep_dead_requests(self) -> None:
+        """Drop queued requests whose client is gone or whose deadline
+        passed — they must stop occupying admission-control queue slots
+        — and finish active ones ('cancelled'/'deadline' frees the slot
+        mid-decode and donates its clean pages exactly like a natural
+        finish). Called from the step loop under the engine lock.
+        Wall-clock gated: the multihost lockstep driver disables it
+        (hosts must make identical decisions; their clocks differ)."""
+        if not self.wallclock_cancel:
+            return
+        now = time.time()
+        if self._waiting:
+            keep: List[Request] = []
+            for r in self._waiting:
+                if r.cancelled:
+                    self._abandoned += 1
+                    self._finish_queued(r, 'cancelled')
+                elif r.deadline is not None and now > r.deadline:
+                    self._expired += 1
+                    self._finish_queued(r, 'deadline')
+                else:
+                    keep.append(r)
+            self._waiting = keep
+        for slot, r in enumerate(self._slots):
+            if r is None:
+                continue
+            if r.cancelled:
+                self._cancelled += 1
+                self._finish_early(slot, r, 'cancelled')
+            elif r.deadline is not None and now > r.deadline:
+                self._expired += 1
+                self._finish_early(slot, r, 'deadline')
+
     def _preempt(self, slot: int) -> None:
         """Evict `slot` to reclaim its pages: the request goes back to
         the FRONT of the queue and resumes by recomputing
@@ -876,6 +1036,7 @@ class InferenceEngine:
         on-device and must not block submit() (which HTTP handlers call
         from the event loop)."""
         with self._lock:
+            self._sweep_dead_requests()
             for slot in range(self.ecfg.n_slots):
                 if self._slots[slot] is None and self._waiting:
                     req = self._waiting.pop(0)
@@ -1046,6 +1207,13 @@ class InferenceEngine:
         while len(self._queue) > self._depth:
             self._consume_one()
 
+    def set_wallclock_cancel(self, enabled: bool) -> None:
+        """Enable/disable the deadline + client-cancel sweeps. The
+        multihost lockstep driver disables them (same reason it pins
+        pipeline_depth 0): the sweeps read the local wall clock, and
+        every host must reach identical request state each tick."""
+        self.wallclock_cancel = bool(enabled)
+
     def idle(self) -> bool:
         with self._lock:
             return (not self._waiting
@@ -1088,6 +1256,9 @@ class InferenceEngine:
                 'num_waiting': len(self._waiting),
                 'num_active': sum(
                     1 for r in self._slots if r is not None),
+                'requests_abandoned': self._abandoned,
+                'requests_expired': self._expired,
+                'requests_cancelled': self._cancelled,
                 'pipeline_depth': self._depth,
                 # Summed from the per-slot counters, NOT by iterating
                 # _queue: the engine thread appends/pops the deque
@@ -1149,15 +1320,25 @@ class EnginePool:
 
     def submit(self, prompt_tokens: Sequence[int],
                max_new_tokens: Optional[int] = None,
-               temperature: float = 0.0) -> Request:
-        n = len(prompt_tokens)
+               temperature: float = 0.0,
+               resume_tokens: Optional[Sequence[int]] = None,
+               deadline: Optional[float] = None) -> Request:
+        n = len(prompt_tokens) + len(resume_tokens or ())
         for eng in self.engines:
             if n <= eng.ecfg.max_seq_len - 1:
                 return eng.submit(prompt_tokens, max_new_tokens,
-                                  temperature)
+                                  temperature,
+                                  resume_tokens=resume_tokens,
+                                  deadline=deadline)
         raise ValueError(
             f'prompt ({n} tokens) exceeds every pool tier '
             f'(largest: {self.engines[-1].ecfg.max_seq_len - 1})')
+
+    def cancel(self, req: Request) -> bool:
+        for e in self.engines:
+            if e.cancel(req):
+                return True
+        return False
 
     def step(self) -> int:
         return sum(e.step() for e in self.engines)
@@ -1165,6 +1346,10 @@ class EnginePool:
     def set_pipeline_depth(self, depth: int) -> None:
         for e in self.engines:
             e.set_pipeline_depth(depth)
+
+    def set_wallclock_cancel(self, enabled: bool) -> None:
+        for e in self.engines:
+            e.set_wallclock_cancel(enabled)
 
     def idle(self) -> bool:
         return all(e.idle() for e in self.engines)
@@ -1217,6 +1402,11 @@ class EnginePool:
             'ttft_p50_s': (ttfts[len(ttfts) // 2] if ttfts else None),
             'num_waiting': sum(t['num_waiting'] for t in tiers),
             'num_active': sum(t['num_active'] for t in tiers),
+            'requests_abandoned': sum(t['requests_abandoned']
+                                      for t in tiers),
+            'requests_expired': sum(t['requests_expired'] for t in tiers),
+            'requests_cancelled': sum(t['requests_cancelled']
+                                      for t in tiers),
             'pipeline_depth': max(t['pipeline_depth'] for t in tiers),
             'tokens_in_flight': sum(t['tokens_in_flight']
                                     for t in tiers),

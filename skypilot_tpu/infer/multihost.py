@@ -88,6 +88,11 @@ class MultihostEngineDriver:
         # carries the in-flight window in the broadcast.
         if hasattr(engine, 'set_pipeline_depth'):
             engine.set_pipeline_depth(0)
+        if hasattr(engine, 'set_wallclock_cancel'):
+            # Deadline/disconnect sweeps read the LOCAL wall clock;
+            # lockstep hosts must never diverge on request state, so
+            # they are disabled (same rule as pipeline depth 0).
+            engine.set_wallclock_cancel(False)
         self.rank = jax.process_index()
         self.world = jax.process_count()
         self._pending: List[Dict[str, Any]] = []   # rank0 only
@@ -169,14 +174,20 @@ class MultihostEngineDriver:
 
     # ---- rank-0 API (called from HTTP handler threads) ------------------
     def submit(self, prompt_tokens, max_new_tokens=None,
-               temperature: float = 0.0):
+               temperature: float = 0.0, resume_tokens=None):
         """Queue a submission for the next tick; block until every host
-        has admitted it, then return this host's Request object."""
+        has admitted it, then return this host's Request object.
+        ``resume_tokens`` (mid-stream failover continuation) is part of
+        the broadcast spec, so every host pre-seeds identically;
+        wall-clock deadlines are NOT supported on the lockstep path
+        (hosts' clocks differ — see set_wallclock_cancel)."""
         assert self.rank == 0, 'only host 0 accepts requests'
         entry = {
             'spec': {'prompt_tokens': list(map(int, prompt_tokens)),
                      'max_new_tokens': max_new_tokens,
-                     'temperature': float(temperature)},
+                     'temperature': float(temperature),
+                     'resume_tokens': (list(map(int, resume_tokens))
+                                       if resume_tokens else None)},
             'event': threading.Event(),
             'request': None,
             'error': None,
@@ -217,7 +228,8 @@ class MultihostEngineDriver:
                 req = self.engine.submit(
                     spec['prompt_tokens'],
                     max_new_tokens=spec['max_new_tokens'],
-                    temperature=spec['temperature'])
+                    temperature=spec['temperature'],
+                    resume_tokens=spec.get('resume_tokens'))
             except ValueError as e:
                 # Every host rejects identically (same validation on the
                 # same spec) — lockstep is preserved.

@@ -36,6 +36,8 @@ from aiohttp import web
 
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.utils import common as common_lib
+from skypilot_tpu.utils import failpoints
 
 logger = logging.getLogger(__name__)
 
@@ -276,6 +278,17 @@ class InferenceServer:
         self.driver = driver
         self.ready = False
         self.dead: str = ''
+        # Graceful drain (docs/robustness.md "Zero-downtime serving"):
+        # once draining, /generate refuses new work (503), /health
+        # reports 'draining' so the serve layer pulls this replica from
+        # the ready set, and /drain long-polls until the last in-flight
+        # request finishes — event-driven, no poll loop anywhere.
+        self.draining = False
+        self._drain_started: Optional[float] = None
+        self.drain_duration_s: Optional[float] = None
+        self._active = 0            # in-flight /generate handlers
+        self._drained_ev = asyncio.Event()
+        self._requests_shed = 0     # 429s answered (admission control)
         self._stop = threading.Event()
         self._woken = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -340,14 +353,103 @@ class InferenceServer:
         if self.dead:
             return web.json_response(
                 {'status': 'dead', 'error': self.dead}, status=503)
+        if self.draining:
+            # 503 on purpose: the replica manager's readiness probe
+            # fails, so the LB pulls this replica from the ready set
+            # while the in-flight tail finishes.
+            return web.json_response(
+                {'status': 'draining', 'inflight': self._active},
+                status=503)
         if not self.ready:
             return web.json_response({'status': 'warming'}, status=503)
         return web.json_response({'status': 'ok'})
 
     async def h_metrics(self, _req: web.Request) -> web.Response:
-        return web.json_response(self.engine.metrics())
+        m = self.engine.metrics()
+        m['draining'] = self.draining
+        m['server_inflight'] = self._active
+        m['requests_shed'] = self._requests_shed
+        if self.drain_duration_s is not None:
+            m['drain_duration_s'] = round(self.drain_duration_s, 4)
+        return web.json_response(m)
+
+    # -- graceful drain ----------------------------------------------------
+    def _enter_drain(self) -> None:
+        if self.draining:
+            return
+        self.draining = True
+        self._drain_started = time.time()
+        logger.info('drain: stopped admitting (%d in flight)',
+                    self._active)
+        if self._active == 0:
+            self._mark_drained()
+
+    def _mark_drained(self) -> None:
+        if self.drain_duration_s is None:
+            self.drain_duration_s = time.time() - (self._drain_started
+                                                   or time.time())
+        self._drained_ev.set()
+
+    async def h_drain(self, request: web.Request) -> web.Response:
+        """Flip to draining and LONG-POLL until every in-flight request
+        finished (or ``deadline_s`` lapsed): the caller (the serve
+        replica manager, before terminating the slice) makes exactly
+        one blocking call — the response arrives the moment the last
+        stream ends, event-driven on both sides."""
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 — bare POST = default deadline
+            body = {}
+        try:
+            deadline_s = float(body.get('deadline_s', 30.0))
+        except (TypeError, ValueError):
+            deadline_s = 30.0
+        self._enter_drain()
+        # Chaos seam: `hang` parks the drain past the manager's HTTP
+        # timeout — teardown must proceed anyway (a wedged drain must
+        # never block replacement forever).
+        await failpoints.hit_async('infer.server.drain_hang')
+        if not self._drained_ev.is_set():
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._drained_ev.wait(),
+                                       max(0.0, deadline_s))
+        drained = self._drained_ev.is_set()
+        return web.json_response({
+            'status': 'drained' if drained else 'draining',
+            'inflight': self._active,
+            'drain_duration_s': self.drain_duration_s,
+        })
+
+    def _cancel_request(self, req) -> None:
+        """Client went away: free the engine slot now (queued → dropped
+        before admission, decoding → slot freed, clean pages donated to
+        the prefix cache) instead of generating to nobody. Lockstep
+        replicas skip it (request state must stay host-identical)."""
+        if self.driver is None and hasattr(self.engine, 'cancel'):
+            self.engine.cancel(req)
 
     async def h_generate(self, request: web.Request) -> web.Response:
+        # In-flight accounting starts BEFORE the first await: a request
+        # suspended in body-parse or engine submit must hold the drain
+        # open, or /drain could report 'drained' (and teardown proceed)
+        # while this handler goes on to admit work — the exact
+        # truncation the drain contract forbids.
+        self._active += 1
+        try:
+            return await self._admit_generate(request)
+        finally:
+            self._active -= 1
+            if self.draining and self._active == 0:
+                self._mark_drained()
+
+    async def _admit_generate(self, request: web.Request) -> web.Response:
+        if self.draining:
+            # Admission stops the moment drain begins; the LB routes
+            # around us (it pulls the replica once health flips, and
+            # retries a 503 on another replica meanwhile).
+            return web.json_response(
+                {'error': 'replica draining', 'draining': True},
+                status=503, headers={'Retry-After': '1'})
         try:
             body = await request.json()
         except Exception:  # noqa: BLE001
@@ -360,6 +462,41 @@ class InferenceServer:
         else:
             return web.json_response(
                 {'error': 'need "tokens" or "prompt"'}, status=400)
+        resume = body.get('resume_from')
+        if resume is not None:
+            # Mid-stream failover continuation (the serve LB re-issues
+            # a died stream with the tokens it already delivered): the
+            # engine prefills prompt+resume — a near-pure prefix-cache
+            # hit under cache_aware routing — and only NEW tokens are
+            # ever emitted below.
+            try:
+                resume = [int(t) for t in resume]
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {'error': '"resume_from" must be a token id list'},
+                    status=400)
+        deadline = None
+        hdr = request.headers.get(common_lib.DEADLINE_HEADER)
+        if hdr and self.driver is None:
+            # Wall-clock budget from the LB. Lockstep replicas ignore
+            # it (host clocks differ; see engine.set_wallclock_cancel).
+            try:
+                budget_s = float(hdr)
+            except ValueError:
+                return web.json_response(
+                    {'error': f'bad {common_lib.DEADLINE_HEADER} '
+                              f'header: {hdr!r}'}, status=400)
+            if budget_s <= 0:
+                return web.json_response(
+                    {'error': 'deadline already exceeded'}, status=504)
+            deadline = time.time() + budget_s
+        if self.draining:
+            # Drain may have begun while we were parsing the body —
+            # re-check at the admission edge (the in-flight counter is
+            # already held, so the drain cannot have completed).
+            return web.json_response(
+                {'error': 'replica draining', 'draining': True},
+                status=503, headers={'Retry-After': '1'})
         try:
             # Admission span parented to the LB's lb.proxy hop (the
             # traceparent header it forwards); decode time is the
@@ -376,15 +513,30 @@ class InferenceServer:
                     req = await asyncio.to_thread(
                         self.driver.submit, tokens,
                         body.get('max_new_tokens'),
-                        float(body.get('temperature', 0.0)))
+                        float(body.get('temperature', 0.0)),
+                        resume)
                 else:
                     req = self.engine.submit(
                         tokens,
                         max_new_tokens=body.get('max_new_tokens'),
-                        temperature=float(body.get('temperature', 0.0)))
+                        temperature=float(body.get('temperature', 0.0)),
+                        resume_tokens=resume,
+                        deadline=deadline)
+        except engine_lib.AdmissionError as e:
+            # Bounded admission: shed with 429 + Retry-After instead of
+            # queueing unboundedly (the LB tries other replicas first).
+            self._requests_shed += 1
+            return web.json_response(
+                {'error': str(e)}, status=429,
+                headers={'Retry-After':
+                         str(max(1, int(round(e.retry_after_s))))})
         except ValueError as e:
             return web.json_response({'error': str(e)}, status=400)
         self._woken.set()
+        return await self._answer_generate(request, body, req)
+
+    async def _answer_generate(self, request: web.Request, body: dict,
+                               req) -> web.Response:
         if body.get('stream'):
             # Token streaming (what a production LLM endpoint serves):
             # one JSON line per token batch, flushed as the engine emits
@@ -400,11 +552,18 @@ class InferenceServer:
             resp = web.StreamResponse()
             resp.content_type = 'application/jsonlines'
             await resp.prepare(request)
-            sent = 0
+            # A resumed stream (mid-stream failover) never re-emits the
+            # tokens the LB already delivered: emission starts at the
+            # resume boundary, and the decoder is primed with the
+            # resumed prefix (delta discarded — the pre-failover leg
+            # already streamed that text) so windows stay token-exact.
+            sent = req.resumed_from
             # Incremental detokenization (O(window) per flush, not a
             # cumulative re-decode) + event-driven flushes: each line
             # leaves the moment the engine's consume appends tokens.
             decoder = IncrementalDecoder(self.tokenizer)
+            if sent:
+                decoder.feed(req.output_tokens, sent)
             waiter = _TokenWaiter(req)
             try:
                 while True:
@@ -440,6 +599,17 @@ class InferenceServer:
                              }).encode() + b'\n')
                         break
                     await waiter.wait(1.0)
+            except ConnectionResetError:
+                # Client vanished mid-stream (aiohttp raises on the
+                # write): free the engine slot now — its clean pages
+                # donate to the prefix cache — instead of decoding to
+                # nobody. Return the broken response quietly; there is
+                # nobody left to answer.
+                self._cancel_request(req)
+                return resp
+            except asyncio.CancelledError:
+                self._cancel_request(req)
+                raise
             finally:
                 waiter.close()
             await resp.write_eof()
@@ -451,9 +621,27 @@ class InferenceServer:
                     return web.json_response(
                         {'error': f'engine died: {self.dead}'},
                         status=500)
+                tr = request.transport
+                if tr is None or tr.is_closing():
+                    # Non-streaming caller went away: nothing will ever
+                    # read the answer — cancel (frees the slot/pages).
+                    # Checked on each token event (≤1s safety net), not
+                    # on a poll cadence.
+                    self._cancel_request(req)
+                    return web.Response(status=499)
                 await waiter.wait(1.0)
+        except asyncio.CancelledError:
+            self._cancel_request(req)
+            raise
         finally:
             waiter.close()
+        if (req.finish_reason == 'deadline'
+                and len(req.output_tokens) <= req.resumed_from):
+            # Expired before producing anything: a real timeout, not a
+            # truncated-but-usable completion.
+            return web.json_response(
+                {'error': 'deadline exceeded before first token',
+                 'finish_reason': 'deadline'}, status=504)
         return web.json_response({
             'request_id': req.request_id,
             'tokens': req.output_tokens,
@@ -468,6 +656,7 @@ class InferenceServer:
         app.router.add_get('/health', self.h_health)
         app.router.add_get('/metrics', self.h_metrics)
         app.router.add_post('/generate', self.h_generate)
+        app.router.add_post('/drain', self.h_drain)
         return app
 
     def run(self, host: str, port: int) -> None:
@@ -521,6 +710,17 @@ def main() -> None:
     parser.add_argument('--tokenizer', default=None,
                         help='tokenizer.json (tokenizers format) or '
                              'sentencepiece .model for /generate text')
+    parser.add_argument('--max-queue-requests', type=int, default=None,
+                        help='Admission control: refuse new work (HTTP '
+                             '429 + Retry-After) once this many '
+                             'requests wait in the engine queue, '
+                             'instead of queueing unboundedly '
+                             '(docs/robustness.md "Zero-downtime '
+                             'serving"). Default: unbounded.')
+    parser.add_argument('--max-queue-tokens', type=int, default=None,
+                        help='Companion cap on total queued '
+                             'prompt+resume tokens (sheds few-but-'
+                             'huge prompts the request cap misses).')
     parser.add_argument('--pipeline-depth', type=int, default=1,
                         help='Dispatch-ahead decode depth: decode N+1 '
                              'is dispatched before step N is read '
@@ -634,7 +834,9 @@ def main() -> None:
             tp=args.tp, quantize=args.quantize,
             paged=args.paged, page_size=args.page_size,
             n_pages=args.n_pages, prefix_cache=args.prefix_cache,
-            pipeline_depth=args.pipeline_depth))
+            pipeline_depth=args.pipeline_depth,
+            max_queue_requests=args.max_queue_requests,
+            max_queue_tokens=args.max_queue_tokens))
     if args.long_slots > 0:
         short_cap = min(args.max_seq_len, config.max_seq_len)
         long_cap = min(args.long_seq_len, config.max_seq_len)
@@ -652,7 +854,9 @@ def main() -> None:
                 n_slots=args.long_slots,
                 max_seq_len=long_cap,
                 tp=args.tp, quantize=False,   # params already int8
-                pipeline_depth=args.pipeline_depth),
+                pipeline_depth=args.pipeline_depth,
+                max_queue_requests=args.max_queue_requests,
+                max_queue_tokens=args.max_queue_tokens),
             seed=1)
         engine = engine_lib.EnginePool([engine, long_engine])
     driver = None

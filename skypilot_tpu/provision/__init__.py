@@ -87,6 +87,29 @@ def probe_cluster_running(info: ClusterInfo) -> bool:
     return all(h.state == 'RUNNING' for h in live.hosts)
 
 
+def probe_preemption_notice(info: ClusterInfo) -> bool:
+    """Advance warning that the provider is about to reclaim the slice
+    (GCP TPU maintenance/preemption events expose one; most providers
+    don't). The serve replica manager turns a notice into a graceful
+    drain — the spot reclaim becomes a planned handoff instead of a
+    mid-stream corpse. Providers without the signal report False, and a
+    probe ERROR is never a notice (a flaky control-plane call must not
+    trigger churn). The `jobs.provider.preemption_notice` failpoint
+    injects a notice for the chaos suite."""
+    try:
+        failpoints.hit('jobs.provider.preemption_notice')
+    except failpoints.FailpointError:
+        return True
+    try:
+        probe = getattr(_impl(info.cloud), 'probe_preemption_notice',
+                        None)
+        if probe is None:
+            return False
+        return bool(probe(info.cluster_name, info.provider_config))
+    except Exception:  # noqa: BLE001 — flaky probe ≠ notice
+        return False
+
+
 def open_ports(cloud: str, cluster_name: str, ports,
                provider_config: Dict[str, Any]) -> None:
     return _impl(cloud).open_ports(cluster_name, ports, provider_config)

@@ -13,19 +13,33 @@ response byte is retried on the next ready replica — a dead replica
 costs zero client-visible errors as long as one peer survives. Each
 replica has a circuit breaker (utils/retry.CircuitBreaker): consecutive
 pre-stream failures trip it OPEN so the selector stops offering the
-corpse, and a half-open probe re-admits it when it recovers. Mid-stream
-death cannot be retried (headers are gone): the stream is terminated and
-the truncation is the client's error signal.
+corpse, and a half-open probe re-admits it when it recovers.
+
+Mid-stream death IS retried for /generate token streams (resumable
+generation, docs/robustness.md "Zero-downtime serving"): the LB tracks
+the token ids of every COMPLETE jsonlines line it forwarded; when the
+upstream dies before the done line, it re-issues the request to the
+next replica with ``resume_from = delivered_tokens`` and splices the
+continuation into the SAME client response. The replica prefills
+prompt+delivered (a near-pure prefix-cache hit under cache_aware
+routing) and emits only new tokens, so greedy output is bit-identical
+to an unkilled run and the client never sees the failure. Only
+non-resumable bodies keep the old rule (truncation = the error signal).
+Overload is routed around, not amplified: a replica answering 429/503
+is released (never a breaker failure) and the request tries the next
+replica; per-request deadlines (utils/common.DEADLINE_HEADER) forward
+the REMAINING budget on every retry leg.
 """
 from __future__ import annotations
 
 import asyncio
 import collections
 import contextlib
+import json
 import logging
 import os
 import time
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set
 
 import aiohttp
 from aiohttp import web
@@ -33,6 +47,7 @@ from aiohttp import web
 from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.serve import load_balancing_policies as lbp
 from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.utils import common
 from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils import retry as retry_lib
 
@@ -54,6 +69,88 @@ class _PreStreamFailure(Exception):
     def __init__(self, cause: BaseException) -> None:
         super().__init__(str(cause))
         self.cause = cause
+
+
+class _UpstreamDead(Exception):
+    """A resumable /generate stream's upstream died (pre- OR
+    mid-stream, it no longer matters): the handler re-issues the tail
+    on the next replica with ``resume_from`` and splices it into the
+    same client response. A breaker failure either way."""
+
+    def __init__(self, cause: BaseException) -> None:
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+class _ClientGone(Exception):
+    """The CLIENT side vanished while we were proxying (disconnect or
+    reset on a write to it). Never the replica's fault: the breaker
+    slot is released — not failed — on every leg, initial and resumed
+    alike."""
+
+    def __init__(self, cause: BaseException) -> None:
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+class _ReplicaSaturated(Exception):
+    """The replica shed a /generate request (429 admission-full, or
+    503 while draining) before any byte reached the client. Overload is
+    not death: the breaker is released, the next replica is tried, and
+    only when EVERY replica sheds does the client see the last 429/503
+    (headers preserved, Retry-After guaranteed). Scoped to /generate —
+    arbitrary proxied endpoints keep the old rule (a 5xx feeds the
+    breaker), so a replica whose app 503s every request still trips
+    out of rotation."""
+
+    def __init__(self, status: int, body: bytes,
+                 headers: Dict[str, str]) -> None:
+        super().__init__(f'replica shed with {status}')
+        self.status = status
+        self.body = body
+        self.headers = {k: v for k, v in headers.items()
+                        if k.lower() not in _HOP_HEADERS}
+        self.headers.setdefault('Retry-After', '1')
+
+
+class _StreamSplice:
+    """Cross-attempt state of one resumable /generate token stream.
+
+    The client sees exactly one response; legs against successive
+    replicas append to it. ``delivered`` holds the token ids of every
+    COMPLETE jsonlines line forwarded so far — the dedupe rule at the
+    resume boundary: a line cut mid-flight by the failure is discarded
+    (never counted, never forwarded), so the resume leg — which emits
+    only tokens after ``resume_from`` — regenerates exactly the
+    undelivered tail. Nothing is duplicated, nothing is lost, and for
+    greedy decoding the spliced stream is bit-identical to an unkilled
+    run."""
+
+    def __init__(self, payload: Dict[str, object],
+                 orig_body: bytes) -> None:
+        self.payload = payload
+        self.orig_body = orig_body
+        try:
+            self.client_resume = [
+                int(t) for t in (payload.get('resume_from') or ())]
+        except (TypeError, ValueError):
+            self.client_resume = []   # the replica will 400 it
+        self.resp: Optional[web.StreamResponse] = None
+        self.delivered: List[int] = []
+        self.buf = b''
+        self.done = False
+        self.resumes = 0
+        # TTFT/ITL bookkeeping carried across legs.
+        self.first = True
+        self.t_prev: Optional[float] = None
+        self.pending_gap: Optional[float] = None
+
+    def body(self) -> bytes:
+        if not self.resumes:
+            return self.orig_body
+        p = dict(self.payload)
+        p['resume_from'] = self.client_resume + self.delivered
+        return json.dumps(p).encode()
 
 
 class LoadBalancer:
@@ -81,6 +178,16 @@ class LoadBalancer:
         # Pre-stream failovers onto another replica (each one is a
         # client error that did NOT happen).
         self._requests_retried = 0
+        # Mid-stream failovers: a /generate stream whose upstream died
+        # was resumed on another replica and spliced into the same
+        # client response (counted per resume leg).
+        self._requests_resumed = 0
+        # Requests shed to the CLIENT with 429/503 after every replica
+        # refused (admission control end state).
+        self._requests_shed = 0
+        # Replicas currently draining (graceful scale-down/preemption
+        # handoff): out of the ready set, surfaced in /-/metrics.
+        self._draining_urls: List[str] = []
         self.breaker = retry_lib.CircuitBreaker(
             failure_threshold=int(os.environ.get(
                 'SKY_TPU_LB_BREAKER_THRESHOLD', '3')),
@@ -98,6 +205,11 @@ class LoadBalancer:
                 # Replicas that left the ready set drop their breaker
                 # state; a returning URL starts closed.
                 self.breaker.prune(info)
+                draining = await asyncio.to_thread(
+                    serve_state.get_replicas, self.service_name,
+                    [serve_state.ReplicaStatus.DRAINING])
+                self._draining_urls = sorted(
+                    r['url'] for r in draining if r['url'])
                 if hasattr(self.policy, 'set_target_qps_per_accelerator'):
                     # Instance-aware policy: refresh the per-accelerator
                     # QPS map from the (possibly updated) service spec.
@@ -148,6 +260,9 @@ class LoadBalancer:
             'requests_failed': self._requests_failed,
             'requests_no_replica': self._requests_no_replica,
             'requests_retried': self._requests_retried,
+            'requests_resumed': self._requests_resumed,
+            'requests_shed': self._requests_shed,
+            'draining': list(self._draining_urls),
             'ttft_p50_s': pct(ttfts, 0.50),
             'ttft_p90_s': pct(ttfts, 0.90),
             'ttft_p99_s': pct(ttfts, 0.99),
@@ -201,7 +316,7 @@ class LoadBalancer:
 
     async def _proxy_attempt(self, request: web.Request, url: str,
                              body: bytes, headers: Dict[str, str],
-                             t_arrival: float):
+                             t_arrival: float, gen: bool = False):
         """One proxy attempt to ``url``. Raises _PreStreamFailure when
         nothing has been sent to the client yet (retryable); any
         response it returns has been (at least partially) delivered.
@@ -242,6 +357,14 @@ class LoadBalancer:
                 upstream = await stack.enter_async_context(upstream_cm)
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 raise _PreStreamFailure(e) from e
+            if gen and upstream.status in (429, 503):
+                # Shed, not dead: admission-full or draining. Nothing
+                # reached the client yet, so route around it. /generate
+                # only — for arbitrary proxied endpoints a 5xx keeps
+                # feeding the breaker below.
+                raise _ReplicaSaturated(
+                    upstream.status, await upstream.read(),
+                    dict(upstream.headers))
             # Replica-level errors are failures for the metrics even
             # though we faithfully proxy them — and their (instant)
             # latency must not pollute the TTFT distribution.
@@ -253,7 +376,17 @@ class LoadBalancer:
                     status=upstream.status,
                     headers={k: v for k, v in upstream.headers.items()
                              if k.lower() not in _HOP_HEADERS})
-                await resp.prepare(request)
+                # Client-side write failures must NEVER look like
+                # replica failures (aiohttp raises its ClientError-
+                # derived ClientConnectionResetError on writes to a
+                # gone client, which the upstream-error handler below
+                # would otherwise swallow as a mid-stream death and
+                # feed the breaker): every write to the client converts
+                # to _ClientGone, which releases the breaker instead.
+                try:
+                    await resp.prepare(request)
+                except (ConnectionError, OSError) as e:
+                    raise _ClientGone(e) from e
                 first = True
                 t_prev = None
                 # Only token streams feed the ITL metric: a
@@ -281,20 +414,29 @@ class LoadBalancer:
                             pending_gap = now - t_prev
                     first = False
                     t_prev = now
-                    await resp.write(chunk)
+                    try:
+                        await resp.write(chunk)
+                    except (ConnectionError, OSError) as e:
+                        raise _ClientGone(e) from e
                 if first and upstream_ok:  # empty body: headers counted
                     self._ttfts.append(time.monotonic() - t_arrival)
-                await resp.write_eof()
+                with contextlib.suppress(ConnectionError, OSError):
+                    await resp.write_eof()
                 return resp, upstream_ok
+            except _ClientGone:
+                raise
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                # Only UPSTREAM trouble reaches here now (client-side
+                # writes raise _ClientGone above).
                 if resp is None or not resp.prepared:
                     raise _PreStreamFailure(e) from e
-                # Headers (and possibly body) already went out: a 502
-                # now would corrupt the stream with a second status
-                # line, and a retry would replay delivered bytes.
-                # Terminate the response; the truncation IS the
-                # client's error signal. (A 5xx upstream was already
-                # counted failed above — don't count it twice.)
+                # Headers (and possibly body) already went out and this
+                # body is not a resumable token stream: a 502 now would
+                # corrupt the stream with a second status line, and a
+                # retry would replay delivered bytes. Terminate the
+                # response; the truncation IS the client's error
+                # signal. (A 5xx upstream was already counted failed
+                # above — don't count it twice.)
                 if upstream_ok:
                     self._requests_failed += 1
                 logger.warning('replica %s died mid-stream: %s', url, e)
@@ -304,6 +446,174 @@ class LoadBalancer:
         finally:
             with contextlib.suppress(Exception):
                 await stack.aclose()
+
+    def _admit_stream_line(self, splice: _StreamSplice, line: bytes,
+                           t_arrival: float) -> Optional[bytes]:
+        """Process one COMPLETE upstream jsonlines line: record
+        TTFT/ITL, add its token ids to the delivered ledger, and stamp
+        the resume count onto the done line. Returns the bytes to
+        forward, or None when the line is a server-side error report
+        (an in-stream replica failure — resumable, not payload)."""
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            obj = None
+        if isinstance(obj, dict) and 'error' in obj:
+            return None
+        now = time.monotonic()
+        if splice.first:
+            self._ttfts.append(now - t_arrival)
+            splice.first = False
+        else:
+            # One line late, same as the plain proxy: the terminal
+            # done-line gap is dropped instead of dragging itl_p50.
+            if splice.pending_gap is not None:
+                self._itls.append(splice.pending_gap)
+            splice.pending_gap = now - (splice.t_prev or now)
+        splice.t_prev = now
+        if not isinstance(obj, dict):
+            return line + b'\n'     # opaque line: forward verbatim
+        if obj.get('done'):
+            splice.done = True
+            if splice.resumes:
+                obj['resumed'] = splice.resumes
+                return json.dumps(obj).encode() + b'\n'
+            return line + b'\n'
+        toks = obj.get('tokens')
+        if isinstance(toks, list):
+            splice.delivered.extend(int(t) for t in toks)
+        return line + b'\n'
+
+    async def _proxy_stream_attempt(
+            self, request: web.Request, url: str,
+            headers: Dict[str, str], t_arrival: float,
+            splice: _StreamSplice):
+        """One leg of a resumable /generate token stream against
+        ``url``. Forwards complete jsonlines lines into the (single)
+        client response; raises _UpstreamDead on ANY replica-side
+        failure before the done line (the handler resumes on the next
+        replica), _ClientGone on client-side write failures, and
+        _ReplicaSaturated on a pre-stream shed."""
+        stack = contextlib.AsyncExitStack()
+        splice.buf = b''    # a dead leg's partial line is DISCARDED
+        try:
+            target = url.rstrip('/') + request.path_qs
+            if trace_lib.enabled():
+                with contextlib.suppress(Exception):
+                    stack.enter_context(trace_lib.context_from(
+                        request.headers.get(trace_lib.HEADER)))
+                    stack.enter_context(trace_lib.span(
+                        'lb.proxy', hop='serve-lb', replica=url,
+                        path=request.path))
+                    trace_lib.inject_headers(headers)
+            try:
+                await failpoints.hit_async('lb.proxy')
+            except failpoints.FailpointError as e:
+                raise _UpstreamDead(e) from e
+            assert self._session is not None
+            try:
+                upstream_cm = self._session.request(
+                    request.method, target, headers=headers,
+                    data=splice.body(), allow_redirects=False)
+                upstream = await stack.enter_async_context(upstream_cm)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                raise _UpstreamDead(e) from e
+            ctype = upstream.headers.get('Content-Type') or ''
+            if upstream.status != 200 or 'jsonlines' not in ctype:
+                if upstream.status in (429, 503):
+                    raise _ReplicaSaturated(
+                        upstream.status, await upstream.read(),
+                        dict(upstream.headers))
+                if splice.resp is not None:
+                    # Mid-splice a non-stream answer cannot be relayed
+                    # (headers are gone); treat as a dead upstream.
+                    raise _UpstreamDead(RuntimeError(
+                        f'replica answered {upstream.status} on a '
+                        f'resume leg'))
+                # Plain (non-stream) answer — 400s, engine-died 500s:
+                # relay it exactly like the non-resumable path.
+                if upstream.status >= 500:
+                    self._requests_failed += 1
+                data = await upstream.read()
+                resp = web.Response(
+                    status=upstream.status, body=data,
+                    headers={k: v for k, v in upstream.headers.items()
+                             if k.lower() not in _HOP_HEADERS})
+                return resp, upstream.status < 500
+            if splice.resp is None:
+                resp = web.StreamResponse(
+                    status=200,
+                    headers={k: v for k, v in upstream.headers.items()
+                             if k.lower() not in _HOP_HEADERS})
+                try:
+                    await resp.prepare(request)
+                except (ConnectionError, OSError) as e:
+                    raise _ClientGone(e) from e
+                splice.resp = resp
+            try:
+                async for chunk in upstream.content.iter_chunked(
+                        64 * 1024):
+                    splice.buf += chunk
+                    while True:
+                        line, sep, rest = splice.buf.partition(b'\n')
+                        if not sep:
+                            break
+                        splice.buf = rest
+                        if not line.strip():
+                            continue
+                        out = self._admit_stream_line(splice, line,
+                                                      t_arrival)
+                        if out is None:
+                            raise _UpstreamDead(RuntimeError(
+                                'replica reported an in-stream error'))
+                        try:
+                            await splice.resp.write(out)
+                        except (ConnectionError, OSError) as e:
+                            raise _ClientGone(e) from e
+                        if splice.done:
+                            break
+                        # Chaos seam: sever THIS leg exactly as if the
+                        # replica died under the stream (drives the
+                        # resume path without killing anything real).
+                        try:
+                            await failpoints.hit_async(
+                                'serve.lb.midstream_kill')
+                        except failpoints.FailpointError as e:
+                            raise _UpstreamDead(e) from e
+                    if splice.done:
+                        break
+            except (_ClientGone, _UpstreamDead, _ReplicaSaturated):
+                raise
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError) as e:
+                raise _UpstreamDead(e) from e
+            if not splice.done:
+                # Upstream closed cleanly without a done line: the
+                # replica died politely — still a truncation to heal.
+                raise _UpstreamDead(ConnectionError(
+                    'upstream closed before the done line'))
+            try:
+                await splice.resp.write_eof()
+            except (ConnectionError, OSError) as e:
+                raise _ClientGone(e) from e
+            return splice.resp, True
+        finally:
+            with contextlib.suppress(Exception):
+                await stack.aclose()
+
+    def _next_url(self, tried: Set[str], affinity: Optional[str],
+                  t_deadline: Optional[float],
+                  headers: Dict[str, str]) -> Optional[str]:
+        """Next retry target, deadline-aware: refreshes the forwarded
+        deadline header to the REMAINING budget so the next replica's
+        engine enforces the same wall-clock cutoff. None when replicas
+        or budget ran out."""
+        if t_deadline is not None:
+            remaining = t_deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            headers[common.DEADLINE_HEADER] = f'{remaining:.3f}'
+        return self._select(tried, affinity)
 
     async def handle(self, request: web.Request) -> web.StreamResponse:
         if request.path == '/-/urls':   # introspection endpoint
@@ -319,15 +629,41 @@ class LoadBalancer:
         body = await request.read()
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in _HOP_HEADERS}
+        # /generate bodies are parsed once, up front: the resumable-
+        # stream splice needs the payload (to re-issue with
+        # resume_from) and the cache-aware policy needs the affinity
+        # key. Non-generate traffic skips the parse entirely.
+        payload: Optional[Dict[str, object]] = None
+        if (request.method == 'POST'
+                and request.path.endswith('/generate') and body):
+            try:
+                parsed = json.loads(body)
+                payload = parsed if isinstance(parsed, dict) else None
+            except ValueError:
+                payload = None   # the replica will 400 it
         # Prefix affinity (cache-aware policy only): same-prefix
         # /generate traffic keeps landing on the same replica so its
-        # radix tree actually accumulates hits. Other policies never
-        # consume the key, so they must not pay the body JSON parse on
-        # the proxy hot path.
-        affinity = (lbp.affinity_key(request.path, body)
-                    if request.method == 'POST'
+        # radix tree actually accumulates hits — keyed from the
+        # already-parsed payload, never a second body parse.
+        affinity = (lbp.affinity_key_from_payload(payload)
+                    if payload is not None
                     and isinstance(self.policy, lbp.CacheAwarePolicy)
                     else None)
+        # Token streams are RESUMABLE: mid-stream upstream death is
+        # healed by re-issuing to the next replica with the delivered
+        # tokens, splicing into the same client response.
+        splice = (_StreamSplice(payload, body)
+                  if payload is not None and payload.get('stream')
+                  else None)
+        # Per-request wall-clock budget: bounded end to end, forwarded
+        # (remaining) on every retry leg, enforced in the engine.
+        t_deadline: Optional[float] = None
+        hdr = request.headers.get(common.DEADLINE_HEADER)
+        if hdr:
+            try:
+                t_deadline = t_arrival + float(hdr)
+            except ValueError:
+                t_deadline = None   # the replica will 400 it
         tried: Set[str] = set()
         url = self._select(tried, affinity)
         if url is None:
@@ -344,14 +680,22 @@ class LoadBalancer:
                      f'to check replica health.\n')
         self._pending_requests += 1
         self._inflight += 1
-        last_failure: Optional[_PreStreamFailure] = None
+        last_cause: Optional[BaseException] = None
+        saturated: Optional[_ReplicaSaturated] = None
         try:
             while url is not None:
                 current = url
                 self.policy.pre_execute(current)
                 try:
-                    resp, replica_ok = await self._proxy_attempt(
-                        request, current, body, headers, t_arrival)
+                    if splice is not None:
+                        resp, replica_ok = (
+                            await self._proxy_stream_attempt(
+                                request, current, headers, t_arrival,
+                                splice))
+                    else:
+                        resp, replica_ok = await self._proxy_attempt(
+                            request, current, body, headers, t_arrival,
+                            gen=payload is not None)
                     # Mid-stream death / a 5xx answer is delivered
                     # (can't retry) but it is still a replica failure —
                     # it must feed the breaker, not reset it.
@@ -360,31 +704,103 @@ class LoadBalancer:
                     else:
                         self.breaker.record_failure(current)
                     return resp
+                except _ReplicaSaturated as e:
+                    # Overload is not death: release (never fail) the
+                    # breaker and route around it.
+                    self.breaker.release(current)
+                    tried.add(current)
+                    saturated, last_cause = e, None
+                    url = self._next_url(tried, affinity, t_deadline,
+                                         headers)
+                    if url is not None:
+                        self._requests_retried += 1
+                        logger.info(
+                            'replica %s shed with %d; rerouting to %s',
+                            current, e.status, url)
                 except _PreStreamFailure as e:
                     self.breaker.record_failure(current)
                     tried.add(current)
-                    last_failure = e
-                    next_url = self._select(tried, affinity)
-                    if next_url is not None:
+                    last_cause, saturated = e.cause, None
+                    url = self._next_url(tried, affinity, t_deadline,
+                                         headers)
+                    if url is not None:
                         self._requests_retried += 1
                         logger.warning(
                             'replica %s failed pre-stream (%s); '
                             'retrying on %s', current,
-                            type(e.cause).__name__, next_url)
-                    url = next_url
+                            type(e.cause).__name__, url)
+                except _UpstreamDead as e:
+                    self.breaker.record_failure(current)
+                    tried.add(current)
+                    last_cause, saturated = e.cause, None
+                    url = self._next_url(tried, affinity, t_deadline,
+                                         headers)
+                    if url is not None:
+                        if (splice.resp is not None
+                                or splice.delivered or splice.resumes):
+                            # Mid-stream: the next leg continues from
+                            # the delivered tokens (resume_from).
+                            splice.resumes += 1
+                            self._requests_resumed += 1
+                            logger.warning(
+                                'replica %s died mid-stream after %d '
+                                'delivered tokens (%s); resuming on '
+                                '%s', current, len(splice.delivered),
+                                type(e.cause).__name__, url)
+                        else:
+                            self._requests_retried += 1
+                            logger.warning(
+                                'replica %s failed pre-stream (%s); '
+                                'retrying on %s', current,
+                                type(e.cause).__name__, url)
+                except _ClientGone:
+                    # Satellite fix: the CLIENT vanished — never a
+                    # replica failure, on the initial and resumed legs
+                    # alike. Hand back any half-open probe slot.
+                    self.breaker.release(current)
+                    if splice is not None and splice.resp is not None:
+                        return splice.resp
+                    return web.Response(status=499)   # never reaches it
                 except BaseException:
                     # Died of something that is NOT the replica's fault
-                    # (client disconnect mid-write, task cancellation):
-                    # hand back any half-open probe slot _select may
-                    # have consumed, or the replica stays blacklisted
-                    # with probing=True forever.
+                    # (task cancellation, ...): hand back any half-open
+                    # probe slot _select may have consumed, or the
+                    # replica stays blacklisted with probing=True
+                    # forever.
                     self.breaker.release(current)
                     raise
                 finally:
                     self.policy.post_execute(current)
+            # Out of replicas (or out of deadline budget).
+            if splice is not None and splice.resp is not None:
+                # Headers are long gone: report in-band, terminate.
+                self._requests_failed += 1
+                with contextlib.suppress(Exception):
+                    await splice.resp.write(json.dumps(
+                        {'error': f'all {len(tried)} replica(s) failed '
+                                  f'mid-stream; giving up after '
+                                  f'{len(splice.delivered)} tokens'}
+                        ).encode() + b'\n')
+                    await splice.resp.write_eof()
+                return splice.resp
+            if saturated is not None:
+                # Every replica shed: relay the last 429/503 — headers
+                # intact — so the client backs off instead of hammering.
+                self._requests_shed += 1
+                return web.Response(
+                    status=saturated.status,
+                    body=saturated.body or b'',
+                    headers=saturated.headers)
+            if (t_deadline is not None
+                    and time.monotonic() >= t_deadline):
+                self._requests_failed += 1
+                return web.Response(
+                    status=504,
+                    text='deadline exceeded before any replica could '
+                         'serve the request\n')
             # Every ready replica failed pre-stream.
             self._requests_failed += 1
-            cause = last_failure.cause if last_failure else None
+            cause = last_cause
             return web.Response(
                 status=502,
                 text=f'All {len(tried)} ready replica(s) failed: '

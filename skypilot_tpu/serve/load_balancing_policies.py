@@ -159,6 +159,13 @@ def affinity_key(path: str, body: bytes) -> Optional[str]:
         return None
     if not isinstance(payload, dict):
         return None
+    return affinity_key_from_payload(payload)
+
+
+def affinity_key_from_payload(payload: dict) -> Optional[str]:
+    """``affinity_key`` for a body the caller already parsed (the LB
+    parses /generate bodies once for the resumable-stream splice; the
+    hot path must not pay a second O(body) json.loads)."""
     tokens = payload.get('tokens')
     if isinstance(tokens, list) and tokens:
         return 'tok:' + ','.join(

@@ -15,7 +15,9 @@ still answers.
 from __future__ import annotations
 
 import concurrent.futures
+import json
 import logging
+import os
 import time
 import urllib.error
 import urllib.request
@@ -47,6 +49,35 @@ NOT_READY_TERMINATE_FACTOR = 5
 
 
 _free_port = common.free_port
+
+
+def _drain_deadline_s() -> float:
+    """Read lazily (env-tunable post-import, like the recovery
+    strategy's knobs): how long a draining replica may take to finish
+    its in-flight requests before teardown proceeds anyway."""
+    return float(os.environ.get('SKY_TPU_SERVE_DRAIN_DEADLINE_S', '30'))
+
+
+def drain_replica(url: str, deadline_s: float) -> Optional[dict]:
+    """Tell the replica to stop admitting and LONG-POLL until its last
+    in-flight request finishes (or ``deadline_s`` lapses server-side).
+
+    ONE blocking call, no poll loop: the infer server's /drain endpoint
+    is event-driven — it answers the moment the in-flight count hits
+    zero (docs/robustness.md "Zero-downtime serving"). Returns the
+    drain report, or None when the replica cannot answer (a dead
+    replica has nothing in flight worth waiting for; teardown proceeds
+    — the timeout also bounds a drain wedged by `drain_hang`)."""
+    req = urllib.request.Request(
+        url.rstrip('/') + '/drain',
+        data=json.dumps({'deadline_s': deadline_s}).encode(),
+        headers={'Content-Type': 'application/json'}, method='POST')
+    try:
+        with urllib.request.urlopen(req,
+                                    timeout=deadline_s + 10) as resp:
+            return json.loads(resp.read())
+    except Exception:  # noqa: BLE001 — unreachable replica: drain done
+        return None
 
 
 class ReplicaManager:
@@ -147,16 +178,34 @@ class ReplicaManager:
         record = serve_state.get_replica(replica_id)
         if record is None:
             return
-        serve_state.set_replica_status(replica_id,
-                                       ReplicaStatus.SHUTTING_DOWN, reason)
+        # Graceful drain (docs/robustness.md "Zero-downtime serving"):
+        # a serving replica being scaled down / rolled forward /
+        # preempted-with-notice first goes DRAINING — the LB pulls it
+        # from the ready set within a sync interval, so NEW requests
+        # route to its peers — and its in-flight streams finish under
+        # the drain deadline before the slice dies. Replicas that never
+        # served (no URL, still launching) and pool workers skip
+        # straight to teardown.
+        drain_url = ''
+        if (not self.spec.pool and record['url']
+                and record['status'] in (ReplicaStatus.READY,
+                                         ReplicaStatus.NOT_READY)):
+            drain_url = record['url']
+            serve_state.set_replica_status(replica_id,
+                                           ReplicaStatus.DRAINING, reason)
+        else:
+            serve_state.set_replica_status(
+                replica_id, ReplicaStatus.SHUTTING_DOWN, reason)
         launch_fut = self._launching.pop(replica_id, None)
         fut = self._pool.submit(self._do_terminate, replica_id,
-                                record['cluster_name'], launch_fut)
+                                record['cluster_name'], launch_fut,
+                                drain_url)
         self._terminating[replica_id] = fut
 
     def _do_terminate(
             self, replica_id: int, cluster_name: str,
-            launch_fut: Optional[concurrent.futures.Future] = None
+            launch_fut: Optional[concurrent.futures.Future] = None,
+            drain_url: str = '',
     ) -> None:
         if launch_fut is not None:
             # An in-flight launch must finish (or fail) before teardown,
@@ -166,6 +215,17 @@ class ReplicaManager:
                 launch_fut.result(timeout=600)
             except Exception:  # noqa: BLE001 — failed launch, fine
                 pass
+        if drain_url:
+            deadline = _drain_deadline_s()
+            t0 = time.time()
+            report = drain_replica(drain_url, deadline)
+            logger.info(
+                'replica %d: drain %s in %.1fs (deadline %.0fs)',
+                replica_id,
+                (report or {}).get('status', 'unreachable'),
+                time.time() - t0, deadline)
+            serve_state.set_replica_status(replica_id,
+                                           ReplicaStatus.SHUTTING_DOWN)
         record = global_state.get_cluster(cluster_name)
         if record is not None and record.get('cluster_info'):
             info = ClusterInfo.from_dict(record['cluster_info'])
@@ -247,6 +307,16 @@ class ReplicaManager:
         return provision.probe_cluster_running(
             ClusterInfo.from_dict(record['cluster_info']))
 
+    def _preemption_notice(self, cluster_name: str) -> bool:
+        """Forward-looking sibling of the jobs-layer preemption
+        predicate: the provider's advance warning that it is about to
+        reclaim the slice (provision.probe_preemption_notice)."""
+        record = global_state.get_cluster(cluster_name)
+        if record is None or not record.get('cluster_info'):
+            return False
+        return provision.probe_preemption_notice(
+            ClusterInfo.from_dict(record['cluster_info']))
+
     # -- the tick ----------------------------------------------------------
     def sync(self) -> None:
         """One controller tick: reap launches, probe readiness, detect
@@ -271,6 +341,7 @@ class ReplicaManager:
             rid, status = r['replica_id'], r['status']
             if status in (ReplicaStatus.PENDING,
                           ReplicaStatus.PROVISIONING,
+                          ReplicaStatus.DRAINING,
                           ReplicaStatus.SHUTTING_DOWN,
                           ReplicaStatus.FAILED,
                           ReplicaStatus.PREEMPTED):
@@ -295,6 +366,21 @@ class ReplicaManager:
                 # Clean up the carcass asynchronously.
                 self._pool.submit(self._cleanup_carcass,
                                   r['cluster_name'])
+                continue
+            # Preemption NOTICE (spot reclaims with advance warning):
+            # the provider says the slice will die soon — drain NOW so
+            # the reclaim becomes a planned handoff (in-flight streams
+            # finish, new traffic routes to peers, the autoscaler's
+            # next tick launches the substitute) instead of a
+            # mid-stream corpse the resume path has to heal.
+            if (r['is_spot'] and not self.spec.pool
+                    and status in (ReplicaStatus.READY,
+                                   ReplicaStatus.NOT_READY)
+                    and self._preemption_notice(r['cluster_name'])):
+                logger.info(
+                    'replica %d: preemption notice; draining for a '
+                    'planned handoff', rid)
+                self.terminate_replica(rid, 'preemption notice')
                 continue
             if not r['url'] and not self.spec.pool:
                 continue

@@ -40,6 +40,8 @@ class ReplicaStatus(enum.Enum):
     STARTING = 'STARTING'            # provisioned; waiting on readiness
     READY = 'READY'
     NOT_READY = 'NOT_READY'          # was ready; probes now failing
+    DRAINING = 'DRAINING'            # leaving the ready set; finishing
+    #                                  in-flight requests, then teardown
     SHUTTING_DOWN = 'SHUTTING_DOWN'
     PREEMPTED = 'PREEMPTED'
     FAILED = 'FAILED'
@@ -299,7 +301,7 @@ def request_replica_restart(service_name: str,
         'UPDATE replicas SET restart_requested = 1 '
         'WHERE replica_id = ? AND service_name = ? '
         "AND status NOT IN ('FAILED','PREEMPTED','SHUTTING_DOWN',"
-        "'PENDING','PROVISIONING')",
+        "'DRAINING','PENDING','PROVISIONING')",
         (replica_id, service_name))
     conn.commit()
     return cur.rowcount > 0

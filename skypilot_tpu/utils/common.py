@@ -8,6 +8,13 @@ import uuid
 
 HOME_ENV_VAR = 'SKY_TPU_HOME'
 DEFAULT_API_PORT = 46580
+# Per-request wall-clock budget in seconds, propagated serve LB →
+# infer server → engine (docs/robustness.md "Zero-downtime serving"):
+# the LB forwards the REMAINING budget on every retry/resume leg, the
+# server turns it into an absolute deadline, and the engine cancels
+# queued or decoding requests past it. Lives here (not in serve/ or
+# infer/) so the LB never has to import the jax-heavy infer stack.
+DEADLINE_HEADER = 'X-SkyTpu-Deadline-S'
 
 
 def base_dir() -> str:

@@ -3,6 +3,13 @@ client<->server traffic and violently kills every live connection on an
 interval, so client resilience (retry, stream-reconnect) is tested
 against real connection resets rather than mocks.
 
+``kill_after_chunks=N`` adds a deterministic per-connection mode: the
+proxied pair is severed (linger-RST, both ends) after N response-
+direction chunks have been forwarded — mid-STREAM death on demand,
+without killing a real replica. The serve LB's resumable-generation
+path is tested against exactly this (docs/robustness.md
+"Zero-downtime serving").
+
 Usage (library):
     proxy = ChaosProxy(target_port=46580, kill_every_s=1.0)
     proxy.start()          # proxy.port is the listen port
@@ -11,7 +18,7 @@ Usage (library):
 
 Or standalone:
     python tests/chaos/chaos_proxy.py --target-port 46580 \
-        --kill-every 5
+        --kill-every 5 [--kill-after-chunks 4]
 """
 from __future__ import annotations
 
@@ -19,14 +26,19 @@ import argparse
 import socket
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 class ChaosProxy:
     def __init__(self, target_port: int, *, target_host: str = '127.0.0.1',
-                 listen_port: int = 0, kill_every_s: float = 2.0):
+                 listen_port: int = 0, kill_every_s: float = 2.0,
+                 kill_after_chunks: Optional[int] = None):
         self.target = (target_host, target_port)
         self.kill_every_s = kill_every_s
+        # Sever a proxied pair after this many upstream→client chunks
+        # (response direction only: request upload chunks don't count,
+        # so the kill always lands while the response streams).
+        self.kill_after_chunks = kill_after_chunks
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR,
                                   1)
@@ -87,9 +99,15 @@ class ChaosProxy:
                 continue
             with self._conns_lock:
                 self._conns += [client, upstream]
-            for a, b in ((client, upstream), (upstream, client)):
-                t = threading.Thread(target=self._pipe, args=(a, b),
-                                     daemon=True)
+            # Per-pair chunk counter for kill_after_chunks; shared by
+            # both pipe threads, only the response direction counts.
+            state: Dict[str, int] = {'chunks': 0}
+            for a, b, counted in ((client, upstream, False),
+                                  (upstream, client, True)):
+                t = threading.Thread(
+                    target=self._pipe,
+                    args=(a, b, state if counted else None),
+                    daemon=True)
                 t.start()
                 with self._threads_lock:
                     self._threads.append(t)
@@ -100,13 +118,28 @@ class ChaosProxy:
                     self._threads = [x for x in self._threads
                                      if x.is_alive()]
 
-    def _pipe(self, src: socket.socket, dst: socket.socket) -> None:
+    def _pipe(self, src: socket.socket, dst: socket.socket,
+              kill_state: Optional[Dict[str, int]] = None) -> None:
         try:
             while True:
                 data = src.recv(65536)
                 if not data:
                     break
                 dst.sendall(data)
+                if (kill_state is not None
+                        and self.kill_after_chunks is not None):
+                    kill_state['chunks'] += 1
+                    if kill_state['chunks'] >= self.kill_after_chunks:
+                        # Sever THIS pair mid-stream (linger-RST both
+                        # ends), exactly like a replica dying under a
+                        # live response.
+                        with self._conns_lock:
+                            self._conns = [c for c in self._conns
+                                           if c not in (src, dst)]
+                        for s in (src, dst):
+                            self._sever(s)
+                        self.kills += 1
+                        break
         except OSError:
             pass
         finally:
@@ -120,29 +153,33 @@ class ChaosProxy:
                 except OSError:
                     pass
 
+    @staticmethod
+    def _sever(s: socket.socket) -> None:
+        # shutdown() FIRST: close() alone never reaches the wire
+        # while a pipe thread is blocked in recv on the same socket
+        # (the in-flight syscall pins the open file description, so
+        # no FIN/RST is ever sent and the peer blocks forever).
+        # shutdown wakes the readers; the linger-RST close then
+        # resets the peer mid-stream.
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         b'\x01\x00\x00\x00\x00\x00\x00\x00')
+        except OSError:
+            pass
+        try:
+            s.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            s.close()
+        except OSError:
+            pass
+
     def _kill_all(self) -> None:
         with self._conns_lock:
             conns, self._conns = self._conns, []
         for s in conns:
-            # shutdown() FIRST: close() alone never reaches the wire
-            # while a pipe thread is blocked in recv on the same socket
-            # (the in-flight syscall pins the open file description, so
-            # no FIN/RST is ever sent and the peer blocks forever).
-            # shutdown wakes the readers; the linger-RST close then
-            # resets the peer mid-stream.
-            try:
-                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
-                             b'\x01\x00\x00\x00\x00\x00\x00\x00')
-            except OSError:
-                pass
-            try:
-                s.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                s.close()
-            except OSError:
-                pass
+            self._sever(s)
         if conns:
             self.kills += 1
 
@@ -157,10 +194,12 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument('--target-host', default='127.0.0.1')
     parser.add_argument('--listen-port', type=int, default=0)
     parser.add_argument('--kill-every', type=float, default=5.0)
+    parser.add_argument('--kill-after-chunks', type=int, default=None)
     args = parser.parse_args(argv)
     proxy = ChaosProxy(args.target_port, target_host=args.target_host,
                        listen_port=args.listen_port,
-                       kill_every_s=args.kill_every).start()
+                       kill_every_s=args.kill_every,
+                       kill_after_chunks=args.kill_after_chunks).start()
     print(f'chaos proxy :{proxy.port} -> {args.target_host}:'
           f'{args.target_port}, killing every {args.kill_every}s')
     try:

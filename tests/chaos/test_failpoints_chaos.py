@@ -9,6 +9,7 @@ ChaosProxy cases (marked slow) live in test_chaos.py.
 """
 import asyncio
 import http.server
+import json
 import os
 import threading
 import time
@@ -215,6 +216,10 @@ class _Replica(http.server.BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def do_POST(self):  # noqa: N802 — consume the body, same answer
+        self.rfile.read(int(self.headers.get('Content-Length') or 0))
+        self.do_GET()
+
     def log_message(self, *a):  # silence per-request stderr noise
         pass
 
@@ -360,6 +365,308 @@ def test_serve_probe_failpoint_marks_not_ready():
     finally:
         del os.environ['SKY_TPU_FAILPOINTS']
         mgr.shutdown()
+
+
+# ---- zero-downtime serving (ISSUE 5): resume / drain / shed ---------------
+def _start_infer_server():
+    """Real continuous-batching engine + aiohttp infer server on a
+    loopback port, driven from a side-thread event loop (the chaos
+    cases need a replica whose /generate actually streams tokens)."""
+    import jax
+    from aiohttp import web
+
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import server as infer_server
+    from skypilot_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = engine_lib.InferenceEngine(
+        cfg, params,
+        engine_lib.EngineConfig(n_slots=2, max_seq_len=128,
+                                prefill_buckets=(8, 16, 32)))
+    srv = infer_server.InferenceServer(eng)
+    srv._thread.start()
+    port = common_lib.free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def up():
+            runner = web.AppRunner(srv.make_app())
+            await runner.setup()
+            await web.TCPSite(runner, '127.0.0.1', port).start()
+        loop.run_until_complete(up())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(30)
+    deadline = time.time() + 180
+    while time.time() < deadline and not srv.ready:
+        time.sleep(0.1)
+    assert srv.ready, 'engine never warmed'
+
+    def stop():
+        srv._stop.set()
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=10)
+
+    return srv, port, stop
+
+
+@pytest.fixture(scope='module')
+def infer_replica():
+    """One warmed engine replica shared by the resume/shed cases (the
+    drain case needs its own — draining is one-way)."""
+    srv, port, stop = _start_infer_server()
+    yield srv, port
+    stop()
+
+
+def _gen_stream(url, tokens, max_new_tokens):
+    """Streamed /generate; returns the parsed jsonlines."""
+    r = req_lib.post(url, json={'tokens': tokens, 'stream': True,
+                                'max_new_tokens': max_new_tokens},
+                     stream=True, timeout=120)
+    assert r.status_code == 200, r.text
+    return [json.loads(ln) for ln in r.iter_lines() if ln.strip()]
+
+
+def _stream_tokens(lines):
+    return [t for ln in lines for t in ln.get('tokens', [])]
+
+
+def test_midstream_kill_resumed_stream_via_chaos_proxy(infer_replica):
+    """Acceptance: a replica killed mid-stream (ChaosProxy severs the
+    socket after N forwarded chunks) is invisible to the client — ONE
+    complete stream, greedy token ids BIT-IDENTICAL to an unkilled
+    run, zero client-visible errors, requests_resumed >= 1."""
+    from tests.chaos.chaos_proxy import ChaosProxy
+    _, port = infer_replica
+    direct = f'http://127.0.0.1:{port}'
+    oracle = _gen_stream(f'{direct}/generate', [5, 6, 7], 24)
+    assert oracle[-1].get('done')
+    proxy = ChaosProxy(target_port=port, kill_every_s=3600.0,
+                       kill_after_chunks=3).start()
+    # round_robin picks index 0 first: the doomed proxy leg, then the
+    # resume lands on the direct survivor.
+    lb, lport, stop = _start_lb(
+        'svc-resume-proxy', [f'http://127.0.0.1:{proxy.port}', direct])
+    try:
+        lines = _gen_stream(f'http://127.0.0.1:{lport}/generate',
+                            [5, 6, 7], 24)
+        done = lines[-1]
+        assert done.get('done') and done.get('resumed', 0) >= 1, done
+        assert _stream_tokens(lines) == _stream_tokens(oracle)
+        m = req_lib.get(f'http://127.0.0.1:{lport}/-/metrics',
+                        timeout=5).json()
+        assert m['requests_resumed'] >= 1
+        assert m['requests_failed'] == 0
+        assert proxy.kills >= 1
+    finally:
+        stop()
+        proxy.stop()
+
+
+def test_midstream_kill_failpoint_resumes(infer_replica, monkeypatch):
+    """The `serve.lb.midstream_kill` failpoint severs the stream leg
+    in-process — the resume path is drivable with no proxy at all."""
+    _, port = infer_replica
+    direct = f'http://127.0.0.1:{port}'
+    oracle = _gen_stream(f'{direct}/generate', [9, 8, 7], 16)
+    # Two "replicas" at the same live server (trailing-slash trick):
+    # leg one eats the injected kill, the resume leg completes.
+    lb, lport, stop = _start_lb('svc-resume-fp', [direct, direct + '/'])
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'serve.lb.midstream_kill=error:1@1')
+    try:
+        lines = _gen_stream(f'http://127.0.0.1:{lport}/generate',
+                            [9, 8, 7], 16)
+        done = lines[-1]
+        assert done.get('done') and done.get('resumed', 0) == 1, done
+        assert _stream_tokens(lines) == _stream_tokens(oracle)
+        assert failpoints.fired('serve.lb.midstream_kill') == 1
+        m = req_lib.get(f'http://127.0.0.1:{lport}/-/metrics',
+                        timeout=5).json()
+        assert m['requests_resumed'] == 1
+        assert m['requests_failed'] == 0
+    finally:
+        stop()
+
+
+def test_admit_full_sheds_429_with_retry_after(infer_replica,
+                                               monkeypatch):
+    """Acceptance: an engine at capacity answers 429 + Retry-After
+    (`infer.engine.admit_full` forces it); with every replica shedding,
+    the LB relays the 429 instead of queueing."""
+    srv, port = infer_replica
+    lb, lport, stop = _start_lb('svc-admit-full',
+                                [f'http://127.0.0.1:{port}'])
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'infer.engine.admit_full=error:1@1')
+    try:
+        r = req_lib.post(f'http://127.0.0.1:{lport}/generate',
+                         json={'tokens': [1], 'max_new_tokens': 2},
+                         timeout=30)
+        assert r.status_code == 429, r.text
+        assert int(r.headers['Retry-After']) >= 1
+        assert failpoints.fired('infer.engine.admit_full') == 1
+        m = req_lib.get(f'http://127.0.0.1:{lport}/-/metrics',
+                        timeout=5).json()
+        assert m['requests_shed'] == 1
+        assert m['requests_failed'] == 0
+        sm = req_lib.get(f'http://127.0.0.1:{port}/metrics',
+                         timeout=5).json()
+        assert sm['requests_shed'] >= 1
+        # Budget spent: the engine admits again (shedding recovers).
+        r = req_lib.post(f'http://127.0.0.1:{lport}/generate',
+                         json={'tokens': [1], 'max_new_tokens': 2},
+                         timeout=60)
+        assert r.status_code == 200
+    finally:
+        stop()
+
+
+def test_drain_completes_inflight_stream_and_routes_away(monkeypatch):
+    """Acceptance: scale-down of a replica with an in-flight stream
+    drains first — the stream completes (no truncation) before the
+    replica terminates, and new requests route to the other replica."""
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve import spec as spec_lib
+    monkeypatch.setenv('SKY_TPU_SERVE_DRAIN_DEADLINE_S', '60')
+    srv, port, stop_srv = _start_infer_server()
+    dummy = _start_replica()
+    engine_url = f'http://127.0.0.1:{port}'
+    dummy_url = f'http://127.0.0.1:{dummy.server_address[1]}'
+    lb, lport, stop_lb = _start_lb('svc-drain',
+                                   [engine_url, dummy_url])
+    spec = spec_lib.ServiceSpec.from_config({
+        'readiness_probe': {'path': '/health',
+                            'initial_delay_seconds': 0,
+                            'timeout_seconds': 1}})
+    mgr = replica_managers.ReplicaManager('svc-drain', spec, '')
+    try:
+        rows = serve_state.get_replicas('svc-drain')
+        r1 = next(r for r in rows if r['url'] == engine_url)
+        # round_robin index 0 → the engine replica gets the stream.
+        r = req_lib.post(f'http://127.0.0.1:{lport}/generate',
+                         json={'tokens': [5, 6], 'stream': True,
+                               'max_new_tokens': 100},
+                         stream=True, timeout=120)
+        assert r.status_code == 200
+        it = r.iter_lines()
+        first = json.loads(next(ln for ln in it if ln.strip()))
+        assert first.get('tokens'), first
+        # Scale-down lands mid-stream: DRAINING immediately, teardown
+        # only after the in-flight tail finishes.
+        mgr.terminate_replica(r1['replica_id'], 'scale-down')
+        row = serve_state.get_replica(r1['replica_id'])
+        assert row['status'] in (
+            serve_state.ReplicaStatus.DRAINING,
+            serve_state.ReplicaStatus.SHUTTING_DOWN)
+        lines = [first] + [json.loads(ln) for ln in it if ln.strip()]
+        done = lines[-1]
+        assert done.get('done'), 'stream truncated by scale-down'
+        assert len(_stream_tokens(lines)) == 100
+        assert 'error' not in done
+        # New traffic routes to the survivor while (and after) the
+        # drain: the LB drops the DRAINING replica within a sync tick.
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            urls = req_lib.get(f'http://127.0.0.1:{lport}/-/urls',
+                               timeout=5).json()['ready_replica_urls']
+            if urls == [dummy_url]:
+                break
+            time.sleep(0.2)
+        assert urls == [dummy_url]
+        r = req_lib.post(f'http://127.0.0.1:{lport}/generate',
+                         json={'tokens': [1]}, timeout=30)
+        assert r.content == b'replica-ok'
+        mgr.wait_terminations(timeout=60)
+        assert serve_state.get_replica(r1['replica_id']) is None
+        # The drain really ran on the replica, event-driven and done.
+        assert srv.draining
+        assert srv.drain_duration_s is not None
+        m = req_lib.get(f'http://127.0.0.1:{lport}/-/metrics',
+                        timeout=5).json()
+        assert m['requests_failed'] == 0
+    finally:
+        stop_lb()
+        mgr.shutdown()
+        stop_srv()
+        dummy.shutdown()
+        dummy.server_close()
+
+
+def test_preemption_notice_drains_before_reclaim(monkeypatch):
+    """A provider preemption NOTICE (injected via the
+    `jobs.provider.preemption_notice` failpoint) turns the spot reclaim
+    into a planned handoff: the replica is drained (its /drain endpoint
+    is actually called) and torn down by the manager's own sync tick,
+    never yanked mid-flight."""
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve import spec as spec_lib
+    drained = []
+
+    class _DrainAware(_Replica):
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get('Content-Length')
+                                or 0))
+            if self.path == '/drain':
+                drained.append(self.path)
+                body = json.dumps({'status': 'drained',
+                                   'inflight': 0}).encode()
+            else:
+                body = self.payload
+            self.send_response(200)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(('127.0.0.1', 0), _DrainAware)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f'http://127.0.0.1:{srv.server_address[1]}'
+    spec = spec_lib.ServiceSpec.from_config({
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 0,
+                            'timeout_seconds': 1}})
+    mgr = replica_managers.ReplicaManager('svc-notice', spec, '')
+    serve_state.add_service('svc-notice', spec_json='{}', task_yaml='',
+                            lb_port=0, lb_policy='round_robin')
+    rid = serve_state.add_replica('svc-notice', 'svc-notice-r0',
+                                  version=1, is_spot=True)
+    serve_state.set_replica_url(rid, url)
+    serve_state.set_replica_status(rid, serve_state.ReplicaStatus.READY)
+    monkeypatch.setattr(mgr, '_provider_alive', lambda name: True)
+    monkeypatch.setattr(mgr, '_preemption_notice',
+                        lambda name: _real_notice_probe())
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'jobs.provider.preemption_notice=error:1@1')
+    monkeypatch.setenv('SKY_TPU_SERVE_DRAIN_DEADLINE_S', '10')
+    try:
+        mgr.sync()
+        assert failpoints.fired('jobs.provider.preemption_notice') == 1
+        mgr.wait_terminations(timeout=30)
+        assert drained == ['/drain'], 'replica was never drained'
+        assert serve_state.get_replica(rid) is None
+        # Budget spent: a second tick must NOT churn anything.
+        mgr.sync()
+    finally:
+        mgr.shutdown()
+        srv.shutdown()
+        srv.server_close()
+
+
+def _real_notice_probe() -> bool:
+    """The provision-layer probe minus the cluster-record lookup (the
+    fake replica has no cluster record; the failpoint is the signal)."""
+    try:
+        failpoints.hit('jobs.provider.preemption_notice')
+    except failpoints.FailpointError:
+        return True
+    return False
 
 
 def test_provision_create_retries_through_injected_failures(monkeypatch):

@@ -123,3 +123,59 @@ def test_infer_allowlist_not_stale():
     assert not stale, (
         f'Infer allowlist exceeds actual sleep counts: {stale} vs '
         f'{found} — ratchet it down.')
+
+
+# ---- serve hot path: drain + resumable streams stay event-driven ---------
+# The zero-downtime-serving paths (LB mid-stream resume splice, the
+# replica manager's drain-before-terminate, the infer server's /drain
+# long-poll) are event-driven end to end: the LB wakes on upstream
+# chunks, /drain answers the instant the in-flight count hits zero, and
+# the manager makes ONE blocking drain call instead of polling health.
+# These caps pin the TOTAL time.sleep( + asyncio.sleep( sites per
+# serve/ file so a poll loop cannot quietly regrow in those paths (the
+# time.sleep-only lint above misses asyncio.sleep, which is what LB
+# code would reach for).
+_SERVE_ANY_ALLOWED = {
+    # Replica-set sync + stats-flush cadences + the run() idle loop —
+    # background maintenance ticks, none on the request path.
+    'serve/load_balancer.py': 3,
+    'serve/controller.py': 2,  # controller tick cadence
+    'serve/__init__.py': 2,    # serve up/down status polls
+}
+
+
+def _serve_any_sleep_sites():
+    found = {}
+    root = os.path.join(_PKG_ROOT, 'serve')
+    for dirpath, _, files in os.walk(root):
+        for fname in files:
+            if not fname.endswith('.py'):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, _PKG_ROOT).replace(os.sep, '/')
+            with open(path, encoding='utf-8') as f:
+                n = len(_ANY_SLEEP_RE.findall(f.read()))
+            if n:
+                found[rel] = n
+    return found
+
+
+def test_serve_drain_resume_paths_stay_event_driven():
+    found = _serve_any_sleep_sites()
+    offenders = {rel: n for rel, n in found.items()
+                 if n > _SERVE_ANY_ALLOWED.get(rel, 0)}
+    assert not offenders, (
+        f'New time.sleep/asyncio.sleep call sites in serve/: '
+        f'{offenders} (allowed: {_SERVE_ANY_ALLOWED}). The drain and '
+        f'mid-stream-resume paths are event-driven (the /drain '
+        f'long-poll and the splice loop wake on events); a poll loop '
+        f'here adds its interval to every failover or scale-down.')
+
+
+def test_serve_any_allowlist_not_stale():
+    found = _serve_any_sleep_sites()
+    stale = {rel: cap for rel, cap in _SERVE_ANY_ALLOWED.items()
+             if found.get(rel, 0) < cap}
+    assert not stale, (
+        f'Serve allowlist exceeds actual sleep counts: {stale} vs '
+        f'{found} — ratchet it down.')
