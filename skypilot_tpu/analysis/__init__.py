@@ -1,0 +1,72 @@
+"""`sky-tpu lint`: AST-based invariant checkers for the serving
+stack's unwritten rules.
+
+PRs 2–5 built correctness on conventions nothing enforced — engine
+state only under ``_lock``, event-driven waits, failpoint/metric names
+in sync with the docs catalogs, zero new compiled programs in jitted
+paths. This package is the static gate that holds those invariants
+through refactors (docs/static-analysis.md has the full catalog):
+
+=============  =======================================================
+SKY-LOCK       fields in a class's ``_GUARDED_BY`` registry accessed
+               only under their lock / declared context
+SKY-ASYNC      no blocking calls in ``async def``; waits stay
+               event-driven; retries go through ``Retrier``
+SKY-EXCEPT     async serve/infer code never swallows connection-reset
+               / cancellation signals under broad excepts
+SKY-TRACE      no concretization or data-dependent branching in
+               jit-reachable code (the recompile hazard)
+SKY-REGISTRY   failpoint sites and serving-metric keys match the docs
+               catalogs, both directions
+=============  =======================================================
+
+Usage::
+
+    sky-tpu lint                       # whole package, human output
+    sky-tpu lint --json                # machine-readable
+    sky-tpu lint skypilot_tpu/infer    # one subtree
+
+Exit status is non-zero when any finding exceeds the audited
+allowlist (``analysis/allowlist.py`` — entries are
+``'<path>:<CODE>': (count, justification)``) or an allowlist entry
+went stale. The tier-1 test ``tests/unit_tests/test_analysis.py``
+runs the same gate in CI.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.allowlist import ALLOWLIST
+from skypilot_tpu.analysis.async_check import AsyncChecker
+from skypilot_tpu.analysis.core import (Checker, Finding, Report,
+                                        RunContext, SourceFile)
+from skypilot_tpu.analysis.except_check import ExceptChecker
+from skypilot_tpu.analysis.lock_check import LockChecker
+from skypilot_tpu.analysis.registry_check import RegistryChecker
+from skypilot_tpu.analysis.trace_check import TraceChecker
+
+
+def all_checkers() -> List[core.Checker]:
+    """A fresh instance of every registered checker."""
+    return [LockChecker(), AsyncChecker(), ExceptChecker(),
+            TraceChecker(), RegistryChecker()]
+
+
+def run(root: Optional[str] = None,
+        pkg_root: Optional[str] = None,
+        docs_root: Optional[str] = None,
+        checkers: Optional[Sequence[core.Checker]] = None,
+        allowlist: Optional[core.Allowlist] = None) -> Report:
+    """Run the suite. Defaults: all checkers over the installed
+    package against the shipped allowlist."""
+    return core.run_checkers(
+        checkers if checkers is not None else all_checkers(),
+        root=root, pkg_root=pkg_root, docs_root=docs_root,
+        allowlist=ALLOWLIST if allowlist is None else allowlist)
+
+
+__all__ = ['run', 'all_checkers', 'ALLOWLIST', 'Checker', 'Finding',
+           'Report', 'RunContext', 'SourceFile', 'LockChecker',
+           'AsyncChecker', 'ExceptChecker', 'TraceChecker',
+           'RegistryChecker']

@@ -1,0 +1,62 @@
+"""The audited allowlist for `sky-tpu lint`.
+
+Entries: ``'<package-relative path>:<CODE>': (count, justification)``.
+Counts are exact caps per path+checker: MORE findings than the cap
+fails (a new violation crept in), FEWER fails too (the site was fixed
+— ratchet the entry down so it stops granting headroom). Every entry
+carries the one-line justification the audit produced; the detailed
+reasoning lives next to the code site.
+
+Populated during this checker suite's bring-up audit; edit only with
+a justification in the diff.
+
+The SKY-ASYNC caps migrate the grep-based pins of the pre-lint
+``tests/unit_tests/test_retry_lint.py`` one for one: client/sdk.py 2,
+runtime/agent_client.py 1, serve/controller.py 2, serve/__init__.py
+2, serve/load_balancer.py 3, infer/multihost.py 1 — no pinned site
+was lost in the migration, and the AST checker additionally covers
+blocking I/O in async defs (the open() entries) which the grep never
+saw.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+ALLOWLIST: Dict[str, Tuple[int, str]] = {
+    # ---- SKY-ASYNC: audited status-poll cadences (waiting for a
+    # state change is not an error retry; Retrier is for retries) ----
+    'client/sdk.py:SKY-ASYNC': (
+        2, 'get() result poll + wait_job status poll — state-change '
+           'cadences in a sync client, not retry loops'),
+    'runtime/agent_client.py:SKY-ASYNC': (
+        1, 'wait_job status poll cadence (sync client thread)'),
+    'serve/controller.py:SKY-ASYNC': (
+        2, 'controller tick cadence (own process, sync loop)'),
+    'serve/__init__.py:SKY-ASYNC': (
+        2, 'serve up/down status polls (sync CLI-facing helpers)'),
+    'infer/multihost.py:SKY-ASYNC': (
+        1, 'lockstep watchdog heartbeat — a monitoring cadence on its '
+           'own thread, never a token-delivery poll'),
+    'serve/load_balancer.py:SKY-ASYNC': (
+        3, 'replica-set sync + stats-flush cadences + the run() idle '
+           'loop — background maintenance ticks, none on the request '
+           'path (token forwarding wakes on upstream chunks)'),
+    # ---- SKY-ASYNC: blocking file I/O on non-serving event loops ---
+    'runtime/agent.py:SKY-ASYNC': (
+        6, 'local log/config file opens in agent handlers — small '
+           'bounded disk I/O on the per-host agent daemon; no token '
+           'stream rides this loop'),
+    'server/app.py:SKY-ASYNC': (
+        3, 'dashboard/static file serving + startup TLS reads on the '
+           'API-server loop — local files, request rate is human-'
+           'scale, not the serving hot path'),
+    # ---- SKY-EXCEPT: audited broad handlers in the LB --------------
+    'serve/load_balancer.py:SKY-EXCEPT': (
+        8, '2 fail-open maintenance loops (replica sync / stats '
+           'flush: DB hiccups must not stop serving; no client '
+           'connection in scope, CancelledError passes as '
+           'BaseException) + 6 suppress(Exception) on teardown '
+           'paths (trace-setup is fail-open by contract; write_eof/'
+           'aclose/final error-report run on already-failed streams '
+           'where any error has nobody left to report to)'),
+}

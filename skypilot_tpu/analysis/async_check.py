@@ -1,0 +1,140 @@
+"""SKY-ASYNC: async hygiene + the event-driven-waits discipline.
+
+Subsumes (and replaces) the grep-based sleep lints of
+``tests/unit_tests/test_retry_lint.py``, as real AST findings:
+
+1. ``time.sleep`` inside ``async def`` — anywhere in the package.
+   Blocks the event loop; never allowlisted lightly.
+2. Blocking I/O inside ``async def`` — ``requests.*``, ``urllib``,
+   ``socket`` connects, ``subprocess`` waits, ``open()``. The loop
+   serves every in-flight stream; one blocked handler stalls all.
+3. Hand-rolled retry backoff inside ``async def``: a loop whose
+   ``except`` handler sleeps. Retry/backoff belongs in the shared
+   ``Retrier`` (utils/retry.py) — that is what makes backoff
+   jittered, deadline-bound, and trace-visible everywhere at once.
+4. Bare ``time.sleep`` anywhere in the wire-facing layers
+   (``client/``, ``runtime/``, ``serve/``, ``infer/``) — sync context
+   included. Genuine status-poll cadences are allowlisted with a
+   justification; new sites fail.
+5. ANY sleep (``time`` or ``asyncio``) in the serve/infer hot paths
+   (``serve/``, ``infer/``): token delivery, drain, and resume are
+   event-driven end to end (``Request.wait_progress`` /
+   ``_TokenWaiter`` / the ``/drain`` long-poll); a poll loop here
+   re-adds its interval to every streamed token or failover.
+   Background maintenance cadences (LB replica sync) are the
+   allowlisted exceptions.
+
+One finding per call site; the allowlist pins the audited count per
+``path:SKY-ASYNC`` exactly like the old grep lint pinned counts per
+file — and the stale-entry check ratchets removed sites out.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Sequence
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import walker
+
+# Wire-facing layers where a bare time.sleep needs an audited
+# justification even in sync context (the old test_retry_lint scope).
+TIME_SLEEP_DIRS = ('client/', 'runtime/', 'serve/', 'infer/')
+# Hot paths where asyncio.sleep is ALSO pinned (event-driven waits).
+ANY_SLEEP_DIRS = ('serve/', 'infer/')
+
+_BLOCKING_CALLS = frozenset((
+    'urllib.request.urlopen', 'socket.create_connection',
+    'subprocess.run', 'subprocess.call', 'subprocess.check_call',
+    'subprocess.check_output', 'os.system', 'open', 'io.open',
+))
+_BLOCKING_PREFIXES = ('requests.',)
+_SLEEPS = frozenset(('time.sleep', 'asyncio.sleep'))
+
+
+def _in_dirs(rel: str, dirs) -> bool:
+    return any(rel.startswith(d) for d in dirs)
+
+
+class AsyncChecker(core.Checker):
+    code = 'SKY-ASYNC'
+    title = ('no blocking calls in async defs; waits stay '
+             'event-driven; retries go through Retrier')
+
+    def check(self, files: Sequence[core.SourceFile],
+              ctx: core.RunContext) -> Iterable[core.Finding]:
+        for src in files:
+            yield from self._check_file(src)
+
+    def _check_file(self,
+                    src: core.SourceFile) -> Iterable[core.Finding]:
+        # One finding per line: a sleep can match several rules (e.g.
+        # a retry-loop backoff is also a sleep site) but it is one
+        # violation for the allowlist count. The retry-loop rule wins
+        # (most specific).
+        found = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.While, ast.For)):
+                for f in self._check_retry_loop(src, node):
+                    found[f.line] = f
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                f = self._check_call(src, node)
+                if f is not None and f.line not in found:
+                    found[f.line] = f
+        yield from (found[line] for line in sorted(found))
+
+    def _check_call(self, src: core.SourceFile, node: ast.Call):
+        name = walker.call_name(node)
+        if name is None:
+            return None
+        in_async = walker.in_async_function(node)
+        if name == 'time.sleep':
+            if in_async:
+                return core.Finding(
+                    self.code, src.rel, node.lineno,
+                    'time.sleep inside async def blocks the event '
+                    'loop (await asyncio.sleep, or an event/condition '
+                    'wait off-loop)')
+            if _in_dirs(src.rel, TIME_SLEEP_DIRS):
+                return core.Finding(
+                    self.code, src.rel, node.lineno,
+                    'bare time.sleep in a wire-facing layer — '
+                    'retries go through utils/retry.Retrier; a '
+                    'genuine status-poll cadence needs an audited '
+                    'allowlist entry')
+        elif name == 'asyncio.sleep':
+            if _in_dirs(src.rel, ANY_SLEEP_DIRS):
+                return core.Finding(
+                    self.code, src.rel, node.lineno,
+                    'asyncio.sleep in the serve/infer hot path — '
+                    'token delivery, drain and resume are '
+                    'event-driven (Event/Condition waits); a poll '
+                    'loop re-adds its interval to every token or '
+                    'failover')
+        elif in_async and (name in _BLOCKING_CALLS
+                           or name.startswith(_BLOCKING_PREFIXES)):
+            return core.Finding(
+                self.code, src.rel, node.lineno,
+                f'blocking call {name}() inside async def — stalls '
+                f'every in-flight stream on this loop (use '
+                f'asyncio.to_thread or the aiohttp session)')
+        return None
+
+    def _check_retry_loop(self, src: core.SourceFile,
+                          loop: ast.AST) -> Iterable[core.Finding]:
+        if not walker.in_async_function(loop):
+            return
+        for sub in walker.walk_function_body(loop):
+            if not isinstance(sub, ast.ExceptHandler):
+                continue
+            for call in ast.walk(sub):
+                if (isinstance(call, ast.Call)
+                        and walker.call_name(call) in _SLEEPS):
+                    yield core.Finding(
+                        self.code, src.rel, call.lineno,
+                        'sleep inside an except handler inside a '
+                        'loop in async def — a hand-rolled retry '
+                        'backoff; route it through '
+                        'utils/retry.Retrier (jitter, deadlines, '
+                        'retry.<name> trace spans)')
+                    break

@@ -1,0 +1,258 @@
+"""Core of the `sky-tpu lint` static-analysis framework.
+
+The serving stack's correctness rests on conventions that ordinary
+tests cannot see: engine state is only touched under ``_lock``, waits
+are event-driven, failpoint/metric names stay in sync with the docs
+catalogs, and nothing in a jitted path branches on traced values. This
+module is the plumbing every checker shares:
+
+- :class:`Finding` — one violation, keyed ``path:code`` for the
+  allowlist;
+- :class:`SourceFile` — parsed module (text + AST + parent links);
+- :class:`Checker` — the plugin protocol (``check(files, ctx)``);
+- :class:`Report` — findings grouped against the audited allowlist,
+  with the same two-sided discipline as the old grep lints: counts
+  above an allowlist entry fail (new violation), counts below fail too
+  (stale entry silently granting headroom — ratchet it down).
+
+Allowlist semantics: entries are ``'<path>:<CODE>': (max_count,
+justification)`` with paths package-relative (posix). Counting per
+``path:code`` (not per line) keeps entries stable across unrelated
+edits to the same file while still refusing any *new* site.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str       # checker code, e.g. 'SKY-LOCK'
+    path: str       # package-relative posix path ('infer/engine.py')
+    line: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f'{self.path}:{self.code}'
+
+    def to_dict(self) -> Dict[str, object]:
+        return {'code': self.code, 'path': self.path,
+                'line': self.line, 'message': self.message}
+
+
+class SourceFile:
+    """One parsed module: text, lines, AST with parent links."""
+
+    def __init__(self, abs_path: str, rel: str) -> None:
+        self.abs_path = abs_path
+        self.rel = rel
+        with open(abs_path, encoding='utf-8') as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(
+                self.text, filename=rel)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = e
+            return
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._sky_parent = node    # type: ignore[attr-defined]
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ''
+
+
+@dataclasses.dataclass
+class RunContext:
+    pkg_root: str               # package root rel paths are relative to
+    docs_root: Optional[str]    # docs/ directory (registry checker)
+    full_package: bool          # scanned the whole package (enables
+    # the doc→code direction of SKY-REGISTRY, which would false-fire
+    # on a partial scan)
+
+
+class Checker:
+    """Plugin protocol. Subclasses set ``code``/``title`` and yield
+    findings from ``check``."""
+
+    code: str = ''
+    title: str = ''
+
+    def check(self, files: Sequence[SourceFile],
+              ctx: RunContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+Allowlist = Dict[str, Tuple[int, str]]
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]
+    allowlist: Allowlist
+    checker_codes: List[str]
+    # Rel paths actually scanned + whether this was the whole package
+    # — staleness is only judged for entries the scan could have seen
+    # (a partial `sky-tpu lint subdir` must not call every other
+    # file's pins stale).
+    scanned: frozenset = frozenset()
+    full_package: bool = True
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.key] = out.get(f.key, 0) + 1
+        return out
+
+    @property
+    def offenders(self) -> Dict[str, List[Finding]]:
+        """Findings beyond the allowlisted count, grouped by key."""
+        out: Dict[str, List[Finding]] = {}
+        for key, n in self.counts.items():
+            cap = self.allowlist.get(key, (0, ''))[0]
+            if n > cap:
+                out[key] = [f for f in self.findings if f.key == key]
+        return out
+
+    @property
+    def stale(self) -> Dict[str, Tuple[int, int]]:
+        """Allowlist entries whose sites were since removed (cap >
+        actual) — they must be ratcheted down, or they silently grant
+        headroom for new violations. Only entries whose checker ran
+        are judged (a single-checker run must not call every other
+        checker's pins stale)."""
+        counts = self.counts
+        out: Dict[str, Tuple[int, int]] = {}
+        for key, (cap, _why) in self.allowlist.items():
+            path, code = key.rsplit(':', 1)
+            if code not in self.checker_codes:
+                continue
+            if path not in self.scanned and not (
+                    self.full_package and path.startswith('docs/')):
+                continue
+            if counts.get(key, 0) < cap:
+                out[key] = (cap, counts.get(key, 0))
+        return out
+
+    @property
+    def ok(self) -> bool:
+        return not self.offenders and not self.stale
+
+    def to_json(self) -> str:
+        return json.dumps({
+            'ok': self.ok,
+            'findings': [f.to_dict() for f in self.findings],
+            'offenders': {k: [f.to_dict() for f in v]
+                          for k, v in self.offenders.items()},
+            'stale_allowlist': {k: {'allowed': cap, 'found': n}
+                                for k, (cap, n) in self.stale.items()},
+        }, indent=2, sort_keys=True)
+
+    def render_text(self, verbose: bool = False) -> str:
+        lines: List[str] = []
+        offenders = self.offenders
+        if verbose and self.findings:
+            lines.append('All findings (including allowlisted):')
+            for f in sorted(self.findings,
+                            key=lambda f: (f.path, f.line)):
+                lines.append(f'  {f.path}:{f.line} [{f.code}] '
+                             f'{f.message}')
+            lines.append('')
+        for key in sorted(offenders):
+            cap, why = self.allowlist.get(key, (0, ''))
+            head = f'{key}: {len(offenders[key])} finding(s)'
+            if cap:
+                head += f' (allowlist covers {cap}: {why})'
+            lines.append(head)
+            for f in offenders[key]:
+                lines.append(f'  {f.path}:{f.line} {f.message}')
+        for key, (cap, n) in sorted(self.stale.items()):
+            lines.append(
+                f'{key}: allowlist grants {cap} but only {n} found — '
+                f'ratchet the entry down (stale caps hide new sites)')
+        n_off = sum(len(v) for v in offenders.values())
+        if self.ok:
+            lines.append(
+                f'lint clean: {len(self.findings)} finding(s), all '
+                f'within the audited allowlist.')
+        else:
+            lines.append(
+                f'lint FAILED: {n_off} finding(s) beyond the '
+                f'allowlist, {len(self.stale)} stale allowlist '
+                f'entr(y/ies).')
+        return '\n'.join(lines)
+
+
+def load_files(root: str, pkg_root: str) -> List[SourceFile]:
+    """Every .py under ``root``; rel paths computed against
+    ``pkg_root`` so allowlist keys are stable for partial scans."""
+    files: List[SourceFile] = []
+    if os.path.isfile(root):
+        rel = os.path.relpath(root, pkg_root).replace(os.sep, '/')
+        return [SourceFile(root, rel)]
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != '__pycache__'
+                             and not d.startswith('.'))
+        for fname in sorted(filenames):
+            if not fname.endswith('.py'):
+                continue
+            abs_path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(abs_path, pkg_root).replace(
+                os.sep, '/')
+            files.append(SourceFile(abs_path, rel))
+    return files
+
+
+def run_checkers(checkers: Sequence[Checker],
+                 root: Optional[str] = None,
+                 pkg_root: Optional[str] = None,
+                 docs_root: Optional[str] = None,
+                 allowlist: Optional[Allowlist] = None) -> Report:
+    """Run ``checkers`` over ``root`` (default: the installed
+    skypilot_tpu package) and judge findings against ``allowlist``."""
+    if pkg_root is None:
+        import skypilot_tpu
+        pkg_root = os.path.dirname(os.path.abspath(
+            skypilot_tpu.__file__))
+    if root is None:
+        root = pkg_root
+    root = os.path.abspath(root)
+    pkg_root = os.path.abspath(pkg_root)
+    if not os.path.exists(root):
+        # A typo'd path must never read as a clean gate ('lint clean:
+        # 0 findings' with zero files scanned is a green light with
+        # no coverage).
+        raise FileNotFoundError(f'lint root does not exist: {root}')
+    if docs_root is None:
+        candidate = os.path.join(os.path.dirname(pkg_root), 'docs')
+        docs_root = candidate if os.path.isdir(candidate) else None
+    ctx = RunContext(pkg_root=pkg_root, docs_root=docs_root,
+                     full_package=(root == pkg_root))
+    files = load_files(root, pkg_root)
+    findings: List[Finding] = []
+    for src in files:
+        if src.parse_error is not None:
+            findings.append(Finding(
+                'SKY-PARSE', src.rel,
+                src.parse_error.lineno or 0,
+                f'file does not parse: {src.parse_error.msg}'))
+    parsed = [s for s in files if s.tree is not None]
+    for checker in checkers:
+        findings.extend(checker.check(parsed, ctx))
+    return Report(findings=findings,
+                  allowlist=dict(allowlist or {}),
+                  checker_codes=[c.code for c in checkers],
+                  scanned=frozenset(s.rel for s in files),
+                  full_package=ctx.full_package)

@@ -1,0 +1,146 @@
+"""SKY-EXCEPT: no broad exception swallowing in serve/infer network
+paths.
+
+The PR 5 bug class this checker exists for: the serve LB's
+upstream-error handler caught a broad exception family and thereby
+swallowed aiohttp's ``ClientConnectionResetError`` raised on writes to
+a *gone client* — mis-counting client aborts as replica failures and
+feeding the circuit breaker. The general shape: in async network code,
+a broad ``except`` absorbs connection-reset / cancellation signals
+that deserved their own classification, and the error accounting (or
+the cancellation itself) silently corrupts.
+
+Rule: inside ``async def`` bodies in ``serve/`` and ``infer/``, a
+broad handler — bare ``except:``, ``except Exception``,
+``except BaseException`` — or a broad ``contextlib.suppress(Exception
+| BaseException)`` is a finding UNLESS:
+
+- the handler re-raises (a ``raise`` statement anywhere in its body:
+  classification happened, the broad arm is a cleanup backstop), or
+- an EARLIER handler of the same ``try`` names a connection/
+  cancellation type (``asyncio.CancelledError``, ``ConnectionError``
+  family, ``OSError``, aiohttp client errors, or one of the LB's
+  classification exceptions) — the dangerous signals were explicitly
+  classified before the broad arm.
+
+Bare ``except:`` and ``except BaseException`` additionally swallow
+``asyncio.CancelledError`` (which ``except Exception`` does not — it
+is a ``BaseException`` since 3.8), so their message says so.
+
+Sync code and other packages are out of scope: the broad handlers
+there guard DB writes, JSON parses, and teardown paths where
+fail-open is the documented contract. Surviving in-scope sites carry
+a one-line justification in the allowlist.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Sequence
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import walker
+
+SCOPE_DIRS = ('serve/', 'infer/')
+
+_BROAD = frozenset(('Exception', 'BaseException'))
+# Types whose presence in an earlier handler counts as explicit
+# classification of the reset/cancellation family.
+_CLASSIFYING = frozenset((
+    'CancelledError', 'ConnectionError', 'ConnectionResetError',
+    'BrokenPipeError', 'OSError', 'TimeoutError', 'ClientError',
+    'ClientConnectionError', 'ClientConnectionResetError',
+    '_ClientGone', '_UpstreamDead', '_PreStreamFailure',
+    '_ReplicaSaturated'))
+
+
+def _type_names(expr: Optional[ast.AST]):
+    """Leaf type names of an except clause's type expression."""
+    if expr is None:
+        return []
+    if isinstance(expr, ast.Tuple):
+        out = []
+        for e in expr.elts:
+            out.extend(_type_names(e))
+        return out
+    name = walker.dotted_name(expr)
+    if name is None:
+        return []
+    return [name.rsplit('.', 1)[-1]]
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+    return False
+
+
+class ExceptChecker(core.Checker):
+    code = 'SKY-EXCEPT'
+    title = ('async serve/infer code must not swallow reset/'
+             'cancellation signals under broad excepts')
+
+    def check(self, files: Sequence[core.SourceFile],
+              ctx: core.RunContext) -> Iterable[core.Finding]:
+        for src in files:
+            if not any(src.rel.startswith(d) for d in SCOPE_DIRS):
+                continue
+            yield from self._check_file(src)
+
+    def _check_file(self,
+                    src: core.SourceFile) -> Iterable[core.Finding]:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Try):
+                if not walker.in_async_function(node):
+                    continue
+                yield from self._check_try(src, node)
+            elif isinstance(node, ast.Call):
+                if not walker.in_async_function(node):
+                    continue
+                f = self._check_suppress(src, node)
+                if f is not None:
+                    yield f
+
+    def _check_try(self, src: core.SourceFile,
+                   node: ast.Try) -> Iterable[core.Finding]:
+        classified = False
+        for handler in node.handlers:
+            names = _type_names(handler.type)
+            broad = (handler.type is None
+                     or any(n in _BROAD for n in names))
+            if not broad:
+                if any(n in _CLASSIFYING for n in names):
+                    classified = True
+                continue
+            if _reraises(handler) or classified:
+                continue
+            swallows = ('connection resets AND asyncio.CancelledError'
+                        if (handler.type is None
+                            or 'BaseException' in names)
+                        else 'connection-reset exceptions')
+            label = ('bare except'
+                     if handler.type is None else
+                     f'except {"/".join(names)}')
+            yield core.Finding(
+                self.code, src.rel, handler.lineno,
+                f'{label} in an async network path swallows '
+                f'{swallows} without re-raising or classifying them '
+                f'first (the PR-5 client-abort-counted-as-replica-'
+                f'death bug class) — add narrower handlers before '
+                f'it, re-raise, or allowlist with a justification')
+
+    def _check_suppress(self, src: core.SourceFile,
+                        node: ast.Call) -> Optional[core.Finding]:
+        name = walker.call_name(node)
+        if name is None or name.rsplit('.', 1)[-1] != 'suppress':
+            return None
+        broad = [a for a in node.args
+                 if walker.dotted_name(a) in _BROAD]
+        if not broad:
+            return None
+        return core.Finding(
+            self.code, src.rel, node.lineno,
+            f'contextlib.suppress({walker.dotted_name(broad[0])}) in '
+            f'an async network path discards connection-reset '
+            f'signals silently — suppress the specific expected '
+            f'types, or allowlist with a justification')

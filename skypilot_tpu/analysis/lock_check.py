@@ -1,0 +1,151 @@
+"""SKY-LOCK: lock discipline over declared guarded fields.
+
+A class declares its concurrency contract in a ``_GUARDED_BY`` class
+attribute (a dict literal of field name → guard spec); the checker
+then enforces, lexically and module-wide, that every access to a
+guarded field satisfies the spec. Guard specs:
+
+``'<lock>'``
+    Every access (read or write) must be inside ``with <x>.<lock>:``
+    or in a method annotated ``# holds: <lock>`` (a documented calling
+    contract — every caller holds the lock; the engine's
+    ``_sweep_dead_requests`` is the canonical example).
+
+``'<lock>:mut'``
+    Only MUTATIONS need the lock — the single-writer discipline:
+    one thread owns the field and mutates it under the lock so other
+    threads' readers (who do take the lock) never see a torn update;
+    the owning thread's own reads stay lock-free. Covers the engine's
+    ``_slots``/``_inflight_tok``.
+
+``'owner'``
+    Confinement: the field may only be touched from the declaring
+    class's own methods. External code must use the accessors — this
+    is what keeps ``PageAllocator``'s refcount bookkeeping atomic
+    under the engine lock without the allocator growing a lock of its
+    own.
+
+``'event-loop'``
+    Single-threaded asyncio state (the LB's counters): accesses only
+    from ``async def`` bodies (which run on the loop) or sync methods
+    annotated ``# holds: event-loop`` (callers are coroutines).
+
+``__init__`` is exempt everywhere: construction precedes sharing.
+
+Scope: accesses are checked across the whole MODULE that declares the
+registry (so a sibling class reaching into another class's guarded
+field — the EnginePool-reads-``engine._ttfts`` bug this checker was
+built on — is caught), but not across modules; cross-module reach-ins
+are already 'owner'-style API violations in review.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import walker
+
+REGISTRY_ATTR = '_GUARDED_BY'
+
+
+def _registries(src: core.SourceFile) -> Dict[str, List[Tuple[str, str]]]:
+    """field name -> [(class name, guard spec)] for this module."""
+    out: Dict[str, List[Tuple[str, str]]] = {}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                targets = [stmt.target]
+                value = stmt.value
+            else:
+                continue
+            if not any(isinstance(t, ast.Name)
+                       and t.id == REGISTRY_ATTR for t in targets):
+                continue
+            if not isinstance(value, ast.Dict):
+                continue
+            for k, v in zip(value.keys, value.values):
+                if (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    out.setdefault(k.value, []).append(
+                        (node.name, v.value))
+    return out
+
+
+class LockChecker(core.Checker):
+    code = 'SKY-LOCK'
+    title = ('guarded fields accessed only under their lock / '
+             'declared context')
+
+    def check(self, files: Sequence[core.SourceFile],
+              ctx: core.RunContext) -> Iterable[core.Finding]:
+        for src in files:
+            regs = _registries(src)
+            if not regs:
+                continue
+            yield from self._check_module(src, regs)
+
+    def _check_module(self, src: core.SourceFile,
+                      regs: Dict[str, List[Tuple[str, str]]]
+                      ) -> Iterable[core.Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            specs = regs.get(node.attr)
+            if not specs:
+                continue
+            func = walker.enclosing_function(node)
+            fname = getattr(func, 'name', '')
+            if fname in ('__init__', '__new__'):
+                continue
+            cls = walker.enclosing_class(node)
+            cls_name = cls.name if cls is not None else ''
+            holds = (walker.holds_annotations(src, func)
+                     if func is not None else set())
+            for decl_cls, spec in specs:
+                bad = self._violates(node, spec, decl_cls, cls_name,
+                                     holds, func)
+                if bad:
+                    yield core.Finding(
+                        self.code, src.rel, node.lineno,
+                        f'{decl_cls}.{node.attr} (guarded by '
+                        f'{spec!r}) {bad}')
+                    break   # one finding per access site
+
+    @staticmethod
+    def _violates(node: ast.Attribute, spec: str, decl_cls: str,
+                  cls_name: str, holds, func) -> str:
+        """Return a message when the access violates ``spec``, else
+        ''."""
+        if spec == 'owner':
+            if cls_name != decl_cls:
+                return (f'touched outside {decl_cls} — use the '
+                        f'accessor methods (confinement keeps its '
+                        f'bookkeeping atomic under the owner\'s '
+                        f'lock)')
+            return ''
+        if spec == 'event-loop':
+            if (isinstance(func, ast.AsyncFunctionDef)
+                    or 'event-loop' in holds):
+                return ''
+            return ('accessed from a sync def — event-loop state is '
+                    'only safe on the loop; annotate the method '
+                    '"# holds: event-loop" if every caller is a '
+                    'coroutine')
+        lock, _, mode = spec.partition(':')
+        if mode == 'mut' and not walker.is_mutating_access(node):
+            return ''
+        if lock in walker.held_locks(node) or lock in holds:
+            return ''
+        kind = 'mutated' if walker.is_mutating_access(node) else 'read'
+        return (f'{kind} outside "with self.{lock}" (annotate the '
+                f'method "# holds: {lock}" only if every caller '
+                f'holds it)')

@@ -1,0 +1,375 @@
+"""SKY-TRACE: recompile/abort hazards in jit-reachable code.
+
+The engine's performance contract is "zero new compiled programs in
+steady state" (prefill compiles once per bucket; decode/free/cow
+exactly once — ``InferenceEngine.compiled_counts`` and the
+recompile-stability test pin the counts at runtime). The two ways
+Python code breaks that contract are both *static* properties:
+
+1. **Concretization**: ``int()`` / ``float()`` / ``bool()`` /
+   ``.item()`` / ``.tolist()`` on a traced value. Under ``jit`` these
+   either abort tracing (``TracerBoolConversionError``) or force a
+   host sync; either way they do not belong in compiled code.
+2. **Data-dependent Python branching**: an ``if``/``while`` whose
+   condition depends on a traced value bakes the taken branch into
+   the compiled program — a different value traces a DIFFERENT
+   program (a new compile per distinct value, the recompile hazard).
+
+This checker is the static complement of the runtime test: it finds
+the hazard in code paths the test's workload never exercises.
+
+Reachability: roots are functions passed to ``jax.jit(fn, ...)`` or
+the engine's local ``_jit(fn, ...)`` wrapper, in ``infer/`` modules.
+From each root the call graph is followed through bare-name calls,
+locally-nested defs referenced by name (``jax.lax.scan(body, ...)``),
+and ``alias.func`` calls resolved through this package's imports —
+over every scanned module, so hazards in ``ops/`` or ``models/``
+reached from an ``infer/`` entry point are found too.
+
+Static-vs-traced, per function: ``self``/``config``/``cfg`` and
+parameters that are annotated with a Python scalar type or carry a
+literal default (``impl: str = 'auto'``, ``top_k: int = 0``) are
+STATIC — they select the program, they don't trace. Everything else
+(arrays, and locals assigned from them) is TRACED. A name used only
+under ``.shape``/``.dtype``/``.ndim``, inside ``len()``/
+``isinstance()``, or in an ``is (not) None`` test stays static —
+those are structural, known at trace time.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import walker
+
+ROOT_DIRS = ('infer/',)
+_STATIC_PARAM_NAMES = frozenset(('self', 'config', 'cfg'))
+_SCALAR_ANNOTATIONS = frozenset(('int', 'float', 'bool', 'str'))
+_CONCRETIZERS = frozenset(('int', 'float', 'bool'))
+_CONCRETIZER_METHODS = frozenset(('item', 'tolist'))
+_STRUCTURAL_ATTRS = frozenset(('shape', 'dtype', 'ndim', 'size',
+                               'at', 'sharding'))
+_STRUCTURAL_CALLS = frozenset(('len', 'isinstance', 'getattr',
+                               'hasattr', 'range', 'type'))
+
+# (module rel path, function qualname) — qualname is dotted nesting,
+# e.g. 'InferenceEngine.__init__._decode_paged'.
+FuncKey = Tuple[str, str]
+
+
+class _FuncInfo:
+    def __init__(self, src: core.SourceFile, node: ast.AST,
+                 qualname: str) -> None:
+        self.src = src
+        self.node = node
+        self.qualname = qualname
+
+
+def _index_functions(files: Sequence[core.SourceFile]
+                     ) -> Dict[str, Dict[str, _FuncInfo]]:
+    """module rel -> {qualname -> info} for every (nested) def."""
+    out: Dict[str, Dict[str, _FuncInfo]] = {}
+    for src in files:
+        funcs: Dict[str, _FuncInfo] = {}
+
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qn = (f'{prefix}.{child.name}' if prefix
+                          else child.name)
+                    funcs[qn] = _FuncInfo(src, child, qn)
+                    visit(child, qn)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, (f'{prefix}.{child.name}' if prefix
+                                  else child.name))
+                else:
+                    visit(child, prefix)
+
+        visit(src.tree, '')
+        out[src.rel] = funcs
+    return out
+
+
+def _imports(src: core.SourceFile) -> Dict[str, str]:
+    """alias -> candidate module rel path. The leading dotted
+    component is the package name (whatever the scanned root is
+    called), so it is stripped; aliases that do not resolve to a
+    scanned file simply yield no callees (jnp, np, ...)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom):
+            if not node.module or node.level:
+                continue
+            parts = node.module.split('.')
+            base = '/'.join(parts[1:])
+            for alias in node.names:
+                target = (f'{base}/{alias.name}.py' if base
+                          else f'{alias.name}.py')
+                out[alias.asname or alias.name] = target
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                parts = alias.name.split('.')
+                if len(parts) < 2:
+                    continue
+                rel = '/'.join(parts[1:]) + '.py'
+                out[alias.asname or parts[0]] = rel
+    return out
+
+
+def _enclosing_qualname(node: ast.AST) -> str:
+    parts: List[str] = []
+    for p in walker.parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            parts.append(p.name)
+    return '.'.join(reversed(parts))
+
+
+class TraceChecker(core.Checker):
+    code = 'SKY-TRACE'
+    title = ('no concretization or data-dependent branching in '
+             'jit-reachable code')
+
+    def check(self, files: Sequence[core.SourceFile],
+              ctx: core.RunContext) -> Iterable[core.Finding]:
+        index = _index_functions(files)
+        by_rel = {s.rel: s for s in files}
+        roots = self._find_roots(files)
+        reachable: List[_FuncInfo] = []
+        seen: Set[FuncKey] = set()
+        queue = [k for k in roots if k not in seen]
+        while queue:
+            key = queue.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            rel, qn = key
+            info = index.get(rel, {}).get(qn)
+            if info is None:
+                continue
+            reachable.append(info)
+            for callee in self._callees(info, index, by_rel):
+                if callee not in seen:
+                    queue.append(callee)
+        for info in sorted(reachable,
+                           key=lambda i: (i.src.rel, i.node.lineno)):
+            yield from self._check_function(info)
+
+    # -- reachability ------------------------------------------------------
+    def _find_roots(self, files: Sequence[core.SourceFile]
+                    ) -> List[FuncKey]:
+        roots: List[FuncKey] = []
+        for src in files:
+            if not any(src.rel.startswith(d) for d in ROOT_DIRS):
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = walker.call_name(node)
+                if name not in ('jax.jit', '_jit', 'jit'):
+                    continue
+                if not node.args:
+                    continue
+                fn = node.args[0]
+                if not isinstance(fn, ast.Name):
+                    continue
+                qn = _enclosing_qualname(node)
+                # The jitted function is defined in the enclosing
+                # scope chain: try innermost-out.
+                parts = qn.split('.') if qn else []
+                for depth in range(len(parts), -1, -1):
+                    cand = '.'.join(parts[:depth] + [fn.id])
+                    roots.append((src.rel, cand))
+        return roots
+
+    def _callees(self, info: _FuncInfo,
+                 index: Dict[str, Dict[str, _FuncInfo]],
+                 by_rel: Dict[str, core.SourceFile]) -> List[FuncKey]:
+        src = info.src
+        imports = _imports(src)
+        mod_funcs = index.get(src.rel, {})
+        out: List[FuncKey] = []
+        prefix_parts = info.qualname.split('.')
+        for node in ast.walk(info.node):
+            # Bare names referencing a function — covers direct calls
+            # AND functions passed as arguments (lax.scan(body, ...)).
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load):
+                for depth in range(len(prefix_parts), -1, -1):
+                    cand = '.'.join(prefix_parts[:depth] + [node.id])
+                    if cand in mod_funcs:
+                        out.append((src.rel, cand))
+                        break
+            elif isinstance(node, ast.Attribute):
+                name = walker.dotted_name(node)
+                if name is None or '.' not in name:
+                    continue
+                alias, func = name.split('.', 1)
+                target = imports.get(alias)
+                if target is None or '.' in func:
+                    continue
+                if func in index.get(target, {}):
+                    out.append((target, func))
+        return out
+
+    # -- per-function analysis ---------------------------------------------
+    @staticmethod
+    def _static_params(fn: ast.AST) -> Set[str]:
+        static: Set[str] = set()
+        args = fn.args
+        all_args = (list(args.posonlyargs) + list(args.args)
+                    + list(args.kwonlyargs))
+        defaults = dict(zip(
+            [a.arg for a in (list(args.posonlyargs)
+                             + list(args.args))[-len(args.defaults):]],
+            args.defaults)) if args.defaults else {}
+        for a, d in zip([a.arg for a in args.kwonlyargs],
+                        args.kw_defaults):
+            if d is not None:
+                defaults[a] = d
+        for a in all_args:
+            if a.arg in _STATIC_PARAM_NAMES:
+                static.add(a.arg)
+                continue
+            ann = a.annotation
+            if (isinstance(ann, ast.Name)
+                    and ann.id in _SCALAR_ANNOTATIONS):
+                static.add(a.arg)
+                continue
+            if isinstance(ann, ast.Constant) and isinstance(
+                    ann.value, str):
+                # String annotation like 'int' — strip Optional[...]
+                inner = ann.value.split('[')[0]
+                if inner in _SCALAR_ANNOTATIONS:
+                    static.add(a.arg)
+                    continue
+            d = defaults.get(a.arg)
+            if isinstance(d, ast.Constant):
+                # A literal default marks a program-selection knob
+                # (impl='auto', top_k=0, interpret=None) — traced
+                # array args never default to literals.
+                static.add(a.arg)
+        return static
+
+    def _traced_names_in(self, expr: ast.AST,
+                         traced: Set[str]) -> Set[str]:
+        """Traced names ``expr`` *concretely* depends on — names used
+        only structurally (.shape/len/isinstance/is-None) excluded."""
+        found: Set[str] = set()
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.Attribute):
+                if node.attr in _STRUCTURAL_ATTRS:
+                    return
+                visit(node.value)
+                return
+            if isinstance(node, ast.Call):
+                name = walker.call_name(node)
+                if name in _STRUCTURAL_CALLS:
+                    return
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                return
+            if isinstance(node, ast.Compare):
+                if all(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in node.ops):
+                    return
+            if isinstance(node, ast.Name):
+                if node.id in traced:
+                    found.add(node.id)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(expr)
+        return found
+
+    def _check_function(self,
+                        info: _FuncInfo) -> Iterable[core.Finding]:
+        fn = info.node
+        static = self._static_params(fn)
+        all_params = {a.arg for a in (list(fn.args.posonlyargs)
+                                      + list(fn.args.args)
+                                      + list(fn.args.kwonlyargs))}
+        if fn.args.vararg:
+            all_params.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            all_params.add(fn.args.kwarg.arg)
+        traced: Set[str] = set(all_params - static)
+        # Taint pass to a FIXPOINT, in source order, add-only: a local
+        # assigned from a traced value becomes traced, transitively
+        # (y = x; z = y). Monotone on purpose — a name once traced
+        # stays traced even if later re-bound to a static value (the
+        # over-approximation cannot oscillate and cannot silently
+        # un-taint through multi-step chains or `x += 1`, whose RHS
+        # alone looks static but whose result still carries x's old
+        # traced value).
+        assigns = [n for n in walker.walk_function_body(fn)
+                   if isinstance(n, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign))
+                   and n.value is not None]
+        assigns.sort(key=lambda n: (n.lineno, n.col_offset))
+        changed = True
+        while changed:
+            changed = False
+            for node in assigns:
+                tainted = bool(self._traced_names_in(node.value,
+                                                     traced))
+                if isinstance(node, ast.AugAssign) and not tainted:
+                    # x += e reads x's old value too.
+                    tainted = any(
+                        isinstance(leaf, ast.Name)
+                        and leaf.id in traced
+                        for leaf in ast.walk(node.target))
+                if not tainted:
+                    continue
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if (isinstance(leaf, ast.Name)
+                                and leaf.id not in traced):
+                            traced.add(leaf.id)
+                            changed = True
+        for node in walker.walk_function_body(fn):
+            yield from self._check_node(info, node, traced)
+
+    def _check_node(self, info: _FuncInfo, node: ast.AST,
+                    traced: Set[str]) -> Iterable[core.Finding]:
+        src = info.src
+        if isinstance(node, ast.Call):
+            name = walker.call_name(node)
+            if name in _CONCRETIZERS and node.args:
+                deps = self._traced_names_in(node.args[0], traced)
+                if deps:
+                    yield core.Finding(
+                        self.code, src.rel, node.lineno,
+                        f'{name}() on traced value '
+                        f'{"/".join(sorted(deps))} in jit-reachable '
+                        f'{info.qualname} — concretization aborts '
+                        f'tracing or forces a host sync')
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _CONCRETIZER_METHODS):
+                deps = self._traced_names_in(node.func.value, traced)
+                if deps:
+                    yield core.Finding(
+                        self.code, src.rel, node.lineno,
+                        f'.{node.func.attr}() on traced value '
+                        f'{"/".join(sorted(deps))} in jit-reachable '
+                        f'{info.qualname} — forces a device sync '
+                        f'inside the compiled path')
+        elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            deps = self._traced_names_in(node.test, traced)
+            if deps:
+                kind = ('while' if isinstance(node, ast.While)
+                        else 'if')
+                yield core.Finding(
+                    self.code, src.rel, node.lineno,
+                    f'data-dependent Python {kind} on traced value '
+                    f'{"/".join(sorted(deps))} in jit-reachable '
+                    f'{info.qualname} — bakes the branch into the '
+                    f'compiled program (one recompile per distinct '
+                    f'value); use jnp.where / lax.cond')

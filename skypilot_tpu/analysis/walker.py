@@ -1,0 +1,151 @@
+"""Shared AST-navigation helpers for the lint checkers.
+
+Every checker needs the same structural questions answered about a
+node: which function/class encloses it, is that function async, which
+locks are lexically held (``with self._lock:``), what does a call
+resolve to, is an attribute access a mutation. They live here once;
+checkers stay declarative.
+
+Parent links (``_sky_parent``) are attached by
+:class:`core.SourceFile` at parse time.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from skypilot_tpu.analysis import core
+
+_FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Method names that mutate their receiver in place — an access like
+# ``self._waiting.append(x)`` is a WRITE to ``_waiting`` for lock
+# discipline even though the attribute itself is only loaded.
+MUTATOR_METHODS = frozenset((
+    'append', 'appendleft', 'add', 'clear', 'discard', 'extend',
+    'insert', 'pop', 'popleft', 'popitem', 'remove', 'setdefault',
+    'sort', 'update'))
+
+
+def parents(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, '_sky_parent', None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, '_sky_parent', None)
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    """Nearest enclosing (async) function def, or None at module
+    scope."""
+    for p in parents(node):
+        if isinstance(p, _FUNC_TYPES):
+            return p
+    return None
+
+
+def enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    """Nearest enclosing class whose body (directly or through
+    functions) contains ``node``."""
+    for p in parents(node):
+        if isinstance(p, ast.ClassDef):
+            return p
+    return None
+
+
+def in_async_function(node: ast.AST) -> bool:
+    """Whether the NEAREST enclosing function is ``async def`` (a sync
+    helper nested inside an async def is not event-loop context)."""
+    return isinstance(enclosing_function(node), ast.AsyncFunctionDef)
+
+
+def dotted_name(expr: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = dotted_name(expr.value)
+        return f'{base}.{expr.attr}' if base else None
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def held_locks(node: ast.AST) -> Set[str]:
+    """Attribute names of every context manager lexically held at
+    ``node`` within its own function: ``with self._lock:`` (or any
+    ``with <expr>.<name>:``) contributes ``<name>``. Stops at the
+    function boundary — a ``with`` in an outer function does not
+    cover a nested def's body."""
+    held: Set[str] = set()
+    for p in parents(node):
+        if isinstance(p, _FUNC_TYPES):
+            break
+        if isinstance(p, (ast.With, ast.AsyncWith)):
+            for item in p.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Attribute):
+                    held.add(expr.attr)
+                elif isinstance(expr, ast.Name):
+                    held.add(expr.id)
+    return held
+
+
+def holds_annotations(src: 'core.SourceFile',
+                      func: ast.AST) -> Set[str]:
+    """Lock names asserted by a ``# holds: <name>[, <name>]`` comment
+    in the function header (the ``def`` line through the line of the
+    first body statement). The annotation documents a calling
+    contract — "every caller already holds this" — for helpers that
+    mutate guarded state without taking the lock themselves."""
+    names: Set[str] = set()
+    if not isinstance(func, _FUNC_TYPES) or not func.body:
+        return names
+    for lineno in range(func.lineno, func.body[0].lineno + 1):
+        line = src.line(lineno)
+        marker = '# holds:'
+        idx = line.find(marker)
+        if idx < 0:
+            continue
+        for tok in line[idx + len(marker):].split(','):
+            tok = tok.strip()
+            if tok:
+                names.add(tok)
+    return names
+
+
+def is_mutating_access(attr: ast.Attribute) -> bool:
+    """Whether this attribute access WRITES the attribute: direct
+    store/delete (incl. aug-assign), subscript store/delete on it, or
+    an in-place mutator method call (``.append`` & co)."""
+    if isinstance(attr.ctx, (ast.Store, ast.Del)):
+        return True
+    parent = getattr(attr, '_sky_parent', None)
+    if (isinstance(parent, ast.Subscript) and parent.value is attr
+            and isinstance(parent.ctx, (ast.Store, ast.Del))):
+        return True
+    if (isinstance(parent, ast.Attribute)
+            and parent.value is attr
+            and parent.attr in MUTATOR_METHODS):
+        grand = getattr(parent, '_sky_parent', None)
+        if isinstance(grand, ast.Call) and grand.func is parent:
+            return True
+    return False
+
+
+def walk_function_body(func: ast.AST,
+                       skip_nested: bool = True) -> Iterator[ast.AST]:
+    """Walk a function's body; by default nested function defs are not
+    descended into (they have their own scope/context)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if skip_nested and isinstance(node, _FUNC_TYPES):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}

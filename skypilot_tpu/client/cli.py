@@ -379,6 +379,44 @@ def cost_report() -> None:
                               f"{r['cost']:.2f}"))
 
 
+@cli.command('lint')
+@click.argument('path', required=False)
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='Machine-readable report (findings, offenders, '
+                   'stale allowlist entries).')
+@click.option('--verbose', '-v', is_flag=True, default=False,
+              help='Also list allowlisted findings.')
+@click.option('--no-allowlist', is_flag=True, default=False,
+              help='Ignore the audited allowlist: report, and fail '
+                   'on, every finding.')
+def lint_cmd(path: Optional[str], as_json: bool, verbose: bool,
+             no_allowlist: bool) -> None:
+    """Run the AST-based invariant checkers over the package.
+
+    Five checkers (docs/static-analysis.md): SKY-LOCK (guarded-field
+    lock discipline), SKY-ASYNC (no blocking calls / sleep-polls in
+    async and hot paths), SKY-EXCEPT (no swallowed reset/cancellation
+    in serve/infer network paths), SKY-TRACE (no concretization or
+    data-dependent branching in jit-reachable code), SKY-REGISTRY
+    (failpoint sites + serving-metric keys in sync with the docs
+    catalogs). PATH narrows the scan to one file or subtree (default:
+    the whole installed package). Exits non-zero on any finding
+    beyond the audited allowlist, or on a stale allowlist entry.
+    """
+    from skypilot_tpu import analysis
+    try:
+        report = analysis.run(
+            root=path, allowlist={} if no_allowlist else None)
+    except FileNotFoundError as e:
+        raise click.ClickException(str(e)) from e
+    if as_json:
+        click.echo(report.to_json())
+    else:
+        click.echo(report.render_text(verbose=verbose))
+    if not report.ok:
+        sys.exit(1)
+
+
 @cli.group()
 def jobs() -> None:
     """Managed jobs: auto-recovering (spot) task execution."""

@@ -266,6 +266,26 @@ def init_params_sharded(config: llama.LlamaConfig, tp: int,
 class InferenceEngine:
     """Slot-based continuous batching over one model replica."""
 
+    # Concurrency contract, enforced statically by `sky-tpu lint`
+    # (SKY-LOCK, docs/static-analysis.md). HTTP handler threads call
+    # submit()/cancel()/metrics(); the engine thread runs step().
+    # Plain '_lock' = every access under the lock (or in a method
+    # annotated '# holds: _lock' whose callers all hold it);
+    # '_lock:mut' = single-writer discipline — the engine thread owns
+    # the field and MUTATES it only under the lock so cross-thread
+    # readers (metrics/idle, which do lock) never see a torn update,
+    # while the owner's own reads stay lock-free.
+    _GUARDED_BY = {
+        '_waiting': '_lock',        # submit() threads vs step loop
+        '_ttfts': '_lock',          # consume appends vs snapshots
+        '_slots': '_lock:mut',      # engine-thread owned
+        '_inflight_tok': '_lock:mut',
+        '_abandoned': '_lock',      # sweep writes vs metrics reads
+        '_expired': '_lock',
+        '_cancelled': '_lock',
+        '_preemptions': '_lock',
+    }
+
     def __init__(self, config: llama.LlamaConfig, params: llama.Params,
                  engine_config: Optional[EngineConfig] = None,
                  seed: int = 0) -> None:
@@ -847,7 +867,7 @@ class InferenceEngine:
             self.cache = self._free(self.cache, jnp.int32(slot))
         req._notify()
 
-    def _sweep_dead_requests(self) -> None:
+    def _sweep_dead_requests(self) -> None:  # holds: _lock
         """Drop queued requests whose client is gone or whose deadline
         passed — they must stop occupying admission-control queue slots
         — and finish active ones ('cancelled'/'deadline' frees the slot
@@ -1143,8 +1163,13 @@ class InferenceEngine:
         # time the bytes are (usually) already on the host.
         pair.copy_to_host_async()
         self._decode_steps += 1
-        for s in decoding:
-            self._inflight_tok[s] += 1
+        with self._lock:
+            # Under the lock so metrics()' tokens_in_flight sum never
+            # reads a half-applied increment batch (consume decrements
+            # under the lock already; the RLock makes this free on the
+            # engine thread).
+            for s in decoding:
+                self._inflight_tok[s] += 1
         self._queue.append((
             pair,
             [(s, self._slots[s]) for s in decoding],
@@ -1236,6 +1261,15 @@ class InferenceEngine:
         return reqs
 
     # ---- metrics ---------------------------------------------------------
+    def ttft_window(self) -> List[float]:
+        """Snapshot of the recent-TTFT window, taken under the engine
+        lock. The accessor exists so cross-thread aggregators
+        (EnginePool.metrics, called from HTTP threads) never iterate
+        the live deque while the consume path appends to it — the
+        first genuine SKY-LOCK finding of the lint bring-up."""
+        with self._lock:
+            return list(self._ttfts)
+
     def metrics(self) -> Dict[str, Any]:
         # Snapshot under the engine lock: with the overlapped loop,
         # counters (_decode_tokens, _ttfts, pages_free) are written one
@@ -1375,7 +1409,11 @@ class EnginePool:
         # p50 merges every tier's TTFT window.
         total_time = sum(e._decode_time for e in self.engines)
         total_tokens = sum(t['decode_tokens'] for t in tiers)
-        ttfts = sorted(x for e in self.engines for x in e._ttfts)
+        # Per-engine snapshots under each engine's lock — iterating
+        # the live _ttfts deques here raced the consume threads'
+        # appends (CPython raises on a deque mutated mid-iteration).
+        ttfts = sorted(x for e in self.engines
+                       for x in e.ttft_window())
         prefixed = [e.prefix for e in self.engines
                     if e.prefix is not None]
         prefix_agg = {}

@@ -72,6 +72,19 @@ class PageAllocator:
     refcount above 1 and behave exactly as before.
     """
 
+    # Concurrency contract (SKY-LOCK, docs/static-analysis.md):
+    # 'owner' = confinement. The allocator has no lock of its own —
+    # every mutation happens on the engine thread (or under the
+    # engine's _lock via metrics()), and that only stays true if
+    # external code goes through the accessor methods instead of
+    # reaching into the free stack / block tables / refcounts.
+    _GUARDED_BY = {
+        '_free': 'owner',
+        '_owned': 'owner',
+        '_table': 'owner',
+        '_ref': 'owner',
+    }
+
     def __init__(self, n_pages: int, page_size: int, n_slots: int,
                  max_pages_per_slot: int) -> None:
         self.page_size = page_size

@@ -398,7 +398,11 @@ class InferenceServer:
         stream ends, event-driven on both sides."""
         try:
             body = await request.json()
-        except Exception:  # noqa: BLE001 — bare POST = default deadline
+        except (ValueError, UnicodeDecodeError):
+            # Bare/garbled POST = default deadline. Narrow on purpose
+            # (SKY-EXCEPT): a connection reset or cancellation during
+            # the body read must propagate, not be mistaken for an
+            # empty drain request.
             body = {}
         try:
             deadline_s = float(body.get('deadline_s', 30.0))
@@ -452,7 +456,12 @@ class InferenceServer:
                 status=503, headers={'Retry-After': '1'})
         try:
             body = await request.json()
-        except Exception:  # noqa: BLE001
+        except (ValueError, UnicodeDecodeError):
+            # Narrow on purpose (SKY-EXCEPT): only a genuinely
+            # malformed body earns a 400. A client that vanished
+            # mid-upload raises a reset/cancellation that must
+            # propagate — writing 400 to the dead socket would count
+            # a disconnect as a caller error.
             return web.json_response({'error': 'malformed JSON'},
                                      status=400)
         if 'tokens' in body:

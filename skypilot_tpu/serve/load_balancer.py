@@ -154,6 +154,27 @@ class _StreamSplice:
 
 
 class LoadBalancer:
+    # Concurrency contract (SKY-LOCK, docs/static-analysis.md):
+    # 'event-loop' = single-threaded asyncio state. Counters and
+    # gauges are only coherent because every touch happens on the
+    # loop — from `async def` bodies, or sync methods annotated
+    # '# holds: event-loop' whose callers are all coroutines. A
+    # thread (or executor callback) reaching in unsynchronized would
+    # tear the read-modify-writes.
+    _GUARDED_BY = {
+        '_pending_requests': 'event-loop',
+        '_inflight': 'event-loop',
+        '_ttfts': 'event-loop',
+        '_itls': 'event-loop',
+        '_requests_total': 'event-loop',
+        '_requests_failed': 'event-loop',
+        '_requests_no_replica': 'event-loop',
+        '_requests_retried': 'event-loop',
+        '_requests_resumed': 'event-loop',
+        '_requests_shed': 'event-loop',
+        '_draining_urls': 'event-loop',
+    }
+
     def __init__(self, service_name: str, policy_name: str) -> None:
         self.service_name = service_name
         self.policy = lbp.make(policy_name)
@@ -247,7 +268,7 @@ class LoadBalancer:
     # deliberate — the LB runs as its own process on the serve
     # controller and this shape feeds `serve status` + the TTFT bench
     # directly; a Prometheus exposition can wrap lb_metrics() later.
-    def lb_metrics(self) -> Dict[str, object]:
+    def lb_metrics(self) -> Dict[str, object]:  # holds: event-loop
         ttfts = sorted(self._ttfts)
         itls = sorted(self._itls)
 
@@ -448,7 +469,8 @@ class LoadBalancer:
                 await stack.aclose()
 
     def _admit_stream_line(self, splice: _StreamSplice, line: bytes,
-                           t_arrival: float) -> Optional[bytes]:
+                           t_arrival: float
+                           ) -> Optional[bytes]:  # holds: event-loop
         """Process one COMPLETE upstream jsonlines line: record
         TTFT/ITL, add its token ids to the delivered ledger, and stamp
         the resume count onto the done line. Returns the bytes to

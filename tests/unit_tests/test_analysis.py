@@ -1,0 +1,632 @@
+"""Tests for the `sky-tpu lint` static-analysis suite.
+
+Two layers:
+
+1. Per-checker fixture tests: small synthetic modules with a seeded
+   violation (positive), the compliant idiom (negative), and an
+   allowlisted case — each of the five checkers must catch exactly
+   its seeded class.
+2. The tier-1 gate: the full suite over the installed package must be
+   clean against the shipped allowlist (no offenders, no stale
+   entries). This is the static counterpart of the chaos/recompile
+   runtime tests — a refactor that breaks lock discipline, async
+   hygiene, jit purity, or a docs catalog fails HERE first.
+"""
+import shutil
+import textwrap
+
+from skypilot_tpu import analysis
+
+
+def _run(tmp_path, files, checkers, docs=None, allowlist=None):
+    pkg = tmp_path / 'pkg'
+    if pkg.exists():
+        shutil.rmtree(pkg)   # calls within one test are independent
+    for rel, body in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body), encoding='utf-8')
+    docs_root = None
+    if docs is not None:
+        droot = tmp_path / 'docs'
+        droot.mkdir(exist_ok=True)
+        for fname, body in docs.items():
+            (droot / fname).write_text(textwrap.dedent(body),
+                                       encoding='utf-8')
+        docs_root = str(droot)
+    return analysis.run(root=str(pkg), pkg_root=str(pkg),
+                        docs_root=docs_root, checkers=checkers,
+                        allowlist=allowlist or {})
+
+
+def _codes(report):
+    return [f.code for f in report.findings]
+
+
+# ---- SKY-LOCK ------------------------------------------------------------
+
+_LOCK_MODULE = '''
+import threading
+
+
+class Engine:
+    _GUARDED_BY = {
+        '_waiting': '_lock',
+        '_slots': '_lock:mut',
+    }
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._waiting = []      # __init__ is exempt
+        self._slots = [None]
+
+    def good_locked(self):
+        with self._lock:
+            self._waiting.append(1)
+            self._slots[0] = 2
+
+    def good_annotated(self):  # holds: _lock
+        return len(self._waiting)
+
+    def good_mut_read(self):
+        return self._slots[0]       # :mut allows lock-free reads
+
+    def bad_unlocked_write(self):
+        self._waiting.append(3)     # SEEDED: guarded write, no lock
+
+    def bad_mut_write(self):
+        self._slots[0] = 4          # SEEDED: :mut write, no lock
+
+
+class Pool:
+    def bad_cross_class(self, e):
+        return sorted(e._waiting)   # SEEDED: module-wide reach-in
+'''
+
+_OWNER_MODULE = '''
+class Allocator:
+    _GUARDED_BY = {'_free': 'owner'}
+
+    def __init__(self):
+        self._free = [1, 2]
+
+    def pop(self):
+        return self._free.pop()     # inside the owner: fine
+
+
+class Engine:
+    def bad(self, allocator):
+        return allocator._free.pop()   # SEEDED: confinement breach
+'''
+
+_LOOP_MODULE = '''
+class LB:
+    _GUARDED_BY = {'_count': 'event-loop'}
+
+    def __init__(self):
+        self._count = 0
+
+    async def handler(self):
+        self._count += 1            # coroutine: on the loop, fine
+
+    def metrics(self):  # holds: event-loop
+        return {'count': self._count}
+
+    def bad_sync(self):
+        self._count += 1            # SEEDED: sync def, no annotation
+'''
+
+
+def test_lock_checker_fixtures(tmp_path):
+    report = _run(tmp_path, {'infer/engine.py': _LOCK_MODULE},
+                  [analysis.LockChecker()])
+    lines = sorted(f.line for f in report.findings)
+    assert _codes(report) == ['SKY-LOCK'] * 3, report.findings
+    src = textwrap.dedent(_LOCK_MODULE).splitlines()
+    for line in lines:
+        assert 'SEEDED' in src[line - 1]
+
+
+def test_lock_checker_owner_confinement(tmp_path):
+    report = _run(tmp_path, {'infer/paged.py': _OWNER_MODULE},
+                  [analysis.LockChecker()])
+    assert len(report.findings) == 1
+    assert 'outside Allocator' in report.findings[0].message
+
+
+def test_lock_checker_event_loop(tmp_path):
+    report = _run(tmp_path, {'serve/lb.py': _LOOP_MODULE},
+                  [analysis.LockChecker()])
+    assert len(report.findings) == 1
+    assert 'sync def' in report.findings[0].message
+
+
+def test_lock_checker_allowlisted(tmp_path):
+    report = _run(tmp_path, {'serve/lb.py': _LOOP_MODULE},
+                  [analysis.LockChecker()],
+                  allowlist={'serve/lb.py:SKY-LOCK':
+                             (1, 'legacy sync mutation, audited')})
+    assert report.ok
+
+
+# ---- SKY-ASYNC -----------------------------------------------------------
+
+_ASYNC_MODULE = '''
+import asyncio
+import time
+
+
+async def bad_sleep():
+    time.sleep(1)                   # SEEDED: blocks the loop
+
+
+async def bad_blocking_io(path):
+    with open(path) as f:           # SEEDED: file I/O on the loop
+        return f.read()
+
+
+async def bad_retry_loop(fetch):
+    while True:
+        try:
+            return await fetch()
+        except ValueError:
+            await asyncio.sleep(1)  # SEEDED: hand-rolled backoff
+
+
+async def good_event_wait(ev):
+    await ev.wait()
+'''
+
+
+def test_async_checker_fixtures(tmp_path):
+    # Outside the watched dirs: only the in-async rules apply.
+    report = _run(tmp_path, {'jobs/poller.py': _ASYNC_MODULE},
+                  [analysis.AsyncChecker()])
+    assert _codes(report) == ['SKY-ASYNC'] * 3, report.findings
+    msgs = ' | '.join(f.message for f in report.findings)
+    assert 'blocks the event loop' in msgs
+    assert 'blocking call open()' in msgs
+    assert 'Retrier' in msgs
+
+
+def test_async_checker_watched_dirs(tmp_path):
+    body = 'import time\n\n\ndef poll():\n    time.sleep(1)\n'
+    report = _run(tmp_path, {'serve/x.py': body, 'jobs/x.py': body},
+                  [analysis.AsyncChecker()])
+    # Bare sync sleep: pinned in serve/ (wire-facing), free in jobs/.
+    assert [f.path for f in report.findings] == ['serve/x.py']
+    # asyncio.sleep: pinned in serve/, not in client/.
+    body2 = ('import asyncio\n\n\nasync def tick():\n'
+             '    await asyncio.sleep(1)\n')
+    report = _run(tmp_path, {'serve/y.py': body2, 'client/y.py': body2},
+                  [analysis.AsyncChecker()])
+    assert [f.path for f in report.findings] == ['serve/y.py']
+
+
+def test_async_checker_allowlist_and_ratchet(tmp_path):
+    body = 'import time\n\n\ndef poll():\n    time.sleep(1)\n'
+    al = {'serve/x.py:SKY-ASYNC': (1, 'status-poll cadence')}
+    report = _run(tmp_path, {'serve/x.py': body},
+                  [analysis.AsyncChecker()], allowlist=al)
+    assert report.ok
+    # The site goes away -> the entry is STALE and must fail (a stale
+    # cap silently grants headroom for a new ad-hoc loop).
+    report = _run(tmp_path, {'serve/x.py': 'x = 1\n'},
+                  [analysis.AsyncChecker()], allowlist=al)
+    assert not report.ok and report.stale
+
+
+# ---- SKY-EXCEPT ----------------------------------------------------------
+
+_EXCEPT_MODULE = '''
+import asyncio
+import contextlib
+
+
+async def bad_swallow(fetch):
+    try:
+        await fetch()
+    except Exception:               # SEEDED: swallows resets
+        pass
+
+
+async def bad_bare(fetch):
+    try:
+        await fetch()
+    except BaseException:           # SEEDED: swallows CancelledError
+        return None
+
+
+async def bad_suppress(resp):
+    with contextlib.suppress(Exception):   # SEEDED
+        await resp.write_eof()
+
+
+async def good_reraise(fetch):
+    try:
+        await fetch()
+    except Exception:
+        raise
+
+
+async def good_classified(fetch):
+    try:
+        await fetch()
+    except asyncio.CancelledError:
+        raise
+    except ConnectionResetError:
+        return 'client gone'
+    except Exception:
+        return 'replica died'       # broad arm AFTER classification
+
+
+async def good_narrow_suppress(resp):
+    with contextlib.suppress(ConnectionError, OSError):
+        await resp.write_eof()
+
+
+def sync_parse(raw):
+    try:
+        return int(raw)
+    except Exception:               # sync context: out of scope
+        return 0
+'''
+
+
+def test_except_checker_fixtures(tmp_path):
+    report = _run(tmp_path, {'serve/lb.py': _EXCEPT_MODULE},
+                  [analysis.ExceptChecker()])
+    assert _codes(report) == ['SKY-EXCEPT'] * 3, report.findings
+    msgs = ' | '.join(f.message for f in report.findings)
+    assert 'CancelledError' in msgs       # the bare/BaseException arm
+    # Identical file outside serve//infer/ is out of scope.
+    report = _run(tmp_path, {'jobs/lb.py': _EXCEPT_MODULE},
+                  [analysis.ExceptChecker()])
+    assert not report.findings
+
+
+def test_except_checker_allowlisted(tmp_path):
+    report = _run(tmp_path, {'infer/h.py': _EXCEPT_MODULE},
+                  [analysis.ExceptChecker()],
+                  allowlist={'infer/h.py:SKY-EXCEPT':
+                             (3, 'teardown paths, audited')})
+    assert report.ok
+
+
+# ---- SKY-TRACE -----------------------------------------------------------
+
+_TRACE_MODULE = '''
+import jax
+import jax.numpy as jnp
+
+from pkg.infer import helper as helper_lib
+
+
+def step(x, temps, top_k: int = 0):
+    if top_k > 0:                   # static knob: selects the program
+        x = x * 2
+    if x.shape[0] > 4:              # structural: known at trace time
+        x = x + 1
+    y = x + temps
+    if y > 0:                       # SEEDED: data-dependent branch
+        y = y - 1
+    n = int(y)                      # SEEDED: concretization
+    return helper_lib.finish(y), n
+
+
+step_c = jax.jit(step)
+'''
+
+_TRACE_HELPER = '''
+def finish(v):
+    if v.sum() > 0:                 # SEEDED: reached cross-module
+        return v
+    return v * 0
+
+
+def unreachable(v):
+    return int(v)                   # never jitted: not flagged
+'''
+
+
+def test_trace_checker_fixtures(tmp_path):
+    report = _run(tmp_path, {'infer/engine2.py': _TRACE_MODULE,
+                             'infer/helper.py': _TRACE_HELPER},
+                  [analysis.TraceChecker()])
+    assert _codes(report) == ['SKY-TRACE'] * 3, report.findings
+    by_path = {}
+    for f in report.findings:
+        by_path.setdefault(f.path, []).append(f)
+    # The cross-module callee is reached; its sibling is not.
+    assert len(by_path['infer/helper.py']) == 1
+    assert len(by_path['infer/engine2.py']) == 2
+    msgs = ' | '.join(f.message for f in report.findings)
+    assert 'int() on traced value' in msgs
+    assert 'data-dependent Python if' in msgs
+
+
+def test_trace_checker_transitive_taint(tmp_path):
+    """Regression: taint must flow through multi-step assignment
+    chains in source order (the first taint pass walked the AST
+    stack-order — reversed — so `z = y` ran before `y = x` was
+    tainted and the branch on z escaped)."""
+    body = '''
+    import jax
+
+
+    def f(x):
+        y = x
+        z = y
+        if z > 0:                   # SEEDED: traced through 2 hops
+            z = z - 1
+        return z
+
+
+    g = jax.jit(f)
+    '''
+    report = _run(tmp_path, {'infer/m.py': body},
+                  [analysis.TraceChecker()])
+    assert len(report.findings) == 1, report.findings
+    assert 'data-dependent' in report.findings[0].message
+
+
+def test_trace_checker_augassign_keeps_taint(tmp_path):
+    """Regression: `x += 1` reads x's old (traced) value — it must
+    not UN-taint x just because the RHS constant looks static."""
+    body = '''
+    import jax
+
+
+    def f(x):
+        x += 1
+        if x > 0:                   # SEEDED: still traced
+            x = x * 2
+        return int(x)               # SEEDED: still traced
+    g = jax.jit(f)
+    '''
+    report = _run(tmp_path, {'infer/m.py': body},
+                  [analysis.TraceChecker()])
+    assert len(report.findings) == 2, report.findings
+
+
+def test_trace_checker_is_none_and_item(tmp_path):
+    body = '''
+    import jax
+
+
+    def f(x, active=None):
+        if active is None:          # structural: fine
+            x = x + 1
+        return x.item()             # SEEDED: device sync
+
+
+    g = jax.jit(f)
+    '''
+    report = _run(tmp_path, {'infer/m.py': body},
+                  [analysis.TraceChecker()])
+    assert len(report.findings) == 1
+    assert '.item()' in report.findings[0].message
+
+
+# ---- SKY-REGISTRY --------------------------------------------------------
+
+_REG_CODE = '''
+from pkg.utils import failpoints
+
+
+def create():
+    failpoints.hit('provision.create')
+
+
+def undocumented():
+    failpoints.hit('provision.mystery')   # SEEDED: not in catalog
+'''
+
+_REG_ENGINE = '''
+class Engine:
+    def metrics(self):
+        return {'decode_tokens': 1,
+                'mystery_gauge': 2}       # SEEDED: not in catalog
+'''
+
+_REG_ROBUSTNESS = '''
+# Robustness
+
+### Site catalog
+
+| site | where |
+|---|---|
+| `provision.create` | create attempt |
+| `provision.ghost` | SEEDED: no code site |
+
+## Next section
+'''
+
+_REG_OBSERVABILITY = '''
+# Observability
+
+## Serving metrics
+
+| Key | Meaning |
+|---|---|
+| `decode_tokens` | tokens |
+| `ghost_metric` | SEEDED: no longer emitted |
+
+## Next
+'''
+
+
+def test_registry_checker_fixtures(tmp_path):
+    report = _run(tmp_path, {'provision/x.py': _REG_CODE,
+                             'infer/engine.py': _REG_ENGINE},
+                  [analysis.RegistryChecker()],
+                  docs={'robustness.md': _REG_ROBUSTNESS,
+                        'observability.md': _REG_OBSERVABILITY})
+    assert _codes(report) == ['SKY-REGISTRY'] * 4, report.findings
+    texts = ' | '.join(f.message for f in report.findings)
+    assert "'provision.mystery'" in texts    # code -> docs
+    assert "'provision.ghost'" in texts      # docs -> code
+    assert "'mystery_gauge'" in texts        # metric -> docs
+    assert "'ghost_metric'" in texts         # docs -> metric
+    doc_paths = {f.path for f in report.findings
+                 if f.path.startswith('docs/')}
+    assert doc_paths == {'docs/robustness.md',
+                         'docs/observability.md'}
+
+
+def test_registry_checker_in_sync(tmp_path):
+    docs = {'robustness.md': '''
+    ### Site catalog
+
+    | site | where |
+    |---|---|
+    | `provision.create` | create attempt |
+    ''',
+            'observability.md': '''
+    ## Serving metrics
+
+    | Key | Meaning |
+    |---|---|
+    | `decode_tokens` | tokens |
+    '''}
+    code = {'provision/x.py': '''
+    from pkg.utils import failpoints
+
+
+    def create():
+        failpoints.hit('provision.create')
+    ''',
+            'infer/engine.py': '''
+    class Engine:
+        def metrics(self):
+            return {'decode_tokens': 1}
+    '''}
+    report = _run(tmp_path, code, [analysis.RegistryChecker()],
+                  docs=docs)
+    assert not report.findings, report.findings
+
+
+# ---- the tier-1 gate -----------------------------------------------------
+
+def test_package_clean_against_shipped_allowlist():
+    """THE gate: the whole package, all five checkers, the shipped
+    allowlist. A finding here means a new invariant violation (fix
+    it, or — with a justification in the diff — extend
+    analysis/allowlist.py); a stale entry means a site was fixed and
+    the allowlist must ratchet down."""
+    report = analysis.run()
+    assert report.ok, '\n' + report.render_text()
+
+
+def test_package_run_has_real_coverage():
+    """The gate above is only meaningful if the checkers actually saw
+    the package: the audited allowlisted findings must be present
+    (zero findings would mean a silently-broken walker, not a clean
+    tree)."""
+    report = analysis.run(allowlist={})
+    counts = report.counts
+    # The migrated grep-lint pins (see analysis/allowlist.py).
+    for key in ('client/sdk.py:SKY-ASYNC',
+                'serve/controller.py:SKY-ASYNC',
+                'serve/load_balancer.py:SKY-ASYNC',
+                'infer/multihost.py:SKY-ASYNC',
+                'serve/load_balancer.py:SKY-EXCEPT'):
+        assert counts.get(key), f'expected audited findings at {key}'
+
+
+def test_package_run_checker_wiring_canaries():
+    """SKY-LOCK / SKY-TRACE / SKY-REGISTRY legitimately report zero
+    findings on the clean package, so 'clean' alone cannot prove
+    they are wired. Assert their INPUTS resolve on the real tree:
+    the _GUARDED_BY registries parse, the jit call graph reaches a
+    substantial function set, and both docs catalogs parse with
+    their real cardinality."""
+    import os
+
+    import skypilot_tpu
+    from skypilot_tpu.analysis import core as core_lib
+    from skypilot_tpu.analysis import lock_check
+    from skypilot_tpu.analysis import registry_check
+    from skypilot_tpu.analysis import trace_check
+
+    pkg = os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
+    files = [f for f in core_lib.load_files(pkg, pkg)
+             if f.tree is not None]
+    by_rel = {f.rel: f for f in files}
+
+    # SKY-LOCK: the three shipped registries parse out of the AST.
+    for rel, cls in (('infer/engine.py', 'InferenceEngine'),
+                     ('infer/paged_cache.py', 'PageAllocator'),
+                     ('serve/load_balancer.py', 'LoadBalancer')):
+        regs = lock_check._registries(by_rel[rel])
+        assert any(cls in [c for c, _ in specs]
+                   for specs in regs.values()), (
+            f'{rel}: {cls}._GUARDED_BY no longer parses')
+
+    # SKY-TRACE: jit roots found and the call graph actually fans out
+    # (engine entry points reach model/ops/sampling code).
+    tc = trace_check.TraceChecker()
+    index = trace_check._index_functions(files)
+    roots = tc._find_roots(files)
+    assert roots, 'no jax.jit/_jit roots found in infer/'
+    seen, queue, reachable = set(), list(roots), []
+    while queue:
+        key = queue.pop()
+        if key in seen:
+            continue
+        seen.add(key)
+        info = index.get(key[0], {}).get(key[1])
+        if info is None:
+            continue
+        reachable.append(key)
+        queue.extend(tc._callees(info, index, by_rel))
+    assert len(reachable) >= 20, (
+        f'jit reachability collapsed to {len(reachable)} functions')
+    assert any(rel.startswith('ops/') for rel, _ in reachable), (
+        'cross-module reachability (infer/ -> ops/) broke')
+
+    # SKY-REGISTRY: both docs catalogs parse at real cardinality.
+    docs = os.path.join(os.path.dirname(pkg), 'docs')
+    sites = registry_check._doc_section_names(
+        docs, 'robustness.md', '### Site catalog')
+    assert sites is not None and len(sites[0]) >= 10, (
+        'failpoint site catalog no longer parses')
+    keys = registry_check._doc_section_names(
+        docs, 'observability.md', '## Serving metrics')
+    assert keys is not None and len(keys[0]) >= 30, (
+        'serving-metrics catalog no longer parses')
+    # And the code side still yields sites/keys.
+    checker = registry_check.RegistryChecker()
+    assert len(checker._failpoint_sites(files)) >= 10
+    assert len(checker._metric_keys(files)) >= 30
+
+
+def test_missing_root_raises(tmp_path):
+    """A typo'd lint path must error, never read as a clean gate."""
+    import pytest
+    with pytest.raises(FileNotFoundError):
+        analysis.run(root=str(tmp_path / 'nope'),
+                     pkg_root=str(tmp_path), allowlist={})
+
+
+def test_guarded_by_registries_declared():
+    """The SKY-LOCK registries the lint contract is built on stay
+    declared (deleting one would silently disable the checker for
+    that class)."""
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import paged_cache
+    from skypilot_tpu.serve import load_balancer
+    assert '_waiting' in engine_lib.InferenceEngine._GUARDED_BY
+    assert '_free' in paged_cache.PageAllocator._GUARDED_BY
+    assert '_ttfts' in load_balancer.LoadBalancer._GUARDED_BY
+
+
+def test_report_json_roundtrip(tmp_path):
+    import json
+    report = _run(tmp_path, {'serve/x.py':
+                             'import time\n\n\ndef f():\n'
+                             '    time.sleep(1)\n'},
+                  [analysis.AsyncChecker()])
+    data = json.loads(report.to_json())
+    assert data['ok'] is False
+    assert data['findings'][0]['code'] == 'SKY-ASYNC'

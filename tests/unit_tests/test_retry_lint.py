@@ -1,181 +1,81 @@
-"""Lint: no new hand-rolled sleep/retry loops in the wire-facing layers.
+"""Lint: no new sleep-polls or hand-rolled retry loops in the
+wire-facing layers — now enforced by the SKY-ASYNC checker.
 
-Every retry in client/, runtime/, and serve/ must go through the shared
-``Retrier`` (skypilot_tpu/utils/retry.py) — that is what makes backoff
-jittered, deadline-bound, and trace-visible everywhere at once. This
-test pins the count of raw ``time.sleep(`` call sites per file to the
-audited allowlist below; a new one failing here means either route the
-wait through ``Retrier`` or (for genuine status-poll cadences, which are
-not retries) extend the allowlist with a justification in the diff.
+This file used to walk the tree with regexes and pin per-file
+``time.sleep`` / ``asyncio.sleep`` counts. Those pins migrated ONE
+FOR ONE into ``skypilot_tpu/analysis/allowlist.py`` (the
+``:SKY-ASYNC`` entries) and the regex walker was deleted: the
+AST-based checker (``skypilot_tpu/analysis/async_check.py``,
+docs/static-analysis.md) covers the same sites plus what grep could
+never see — blocking file/network I/O inside ``async def`` and
+sleep-in-except retry loops. The full five-checker gate lives in
+``test_analysis.py``; this test keeps the focused async-hygiene
+contract its predecessor pinned:
+
+- the audited legacy caps are still present and exact (no pinned
+  site was lost in the migration, none quietly grew);
+- the infer/serve hot paths stay event-driven (no sleep sites at all
+  in engine.py / server.py — the event-driven token delivery and
+  drain long-poll of PRs 3 and 5).
 """
-import os
-import re
+from skypilot_tpu import analysis
 
-import skypilot_tpu
-
-_PKG_ROOT = os.path.dirname(skypilot_tpu.__file__)
-_CHECKED_DIRS = ('client', 'runtime', 'serve')
-
-# path (relative to the package) -> audited number of time.sleep sites.
-# All of these are status-poll cadences (waiting for a state change),
-# not error-retry loops: retries live in utils/retry.py.
-_ALLOWED = {
-    'client/sdk.py': 2,        # get() result poll; wait_job status poll
-    'runtime/agent_client.py': 1,   # wait_job status poll
-    'serve/controller.py': 2,  # controller tick cadence
-    'serve/__init__.py': 2,    # serve up/down status polls
+# The audited pins carried over from the grep lint, file for file.
+_LEGACY_PINS = {
+    'client/sdk.py:SKY-ASYNC': 2,        # get() + wait_job polls
+    'runtime/agent_client.py:SKY-ASYNC': 1,   # wait_job status poll
+    'serve/controller.py:SKY-ASYNC': 2,  # controller tick cadence
+    'serve/__init__.py:SKY-ASYNC': 2,    # serve up/down status polls
+    'serve/load_balancer.py:SKY-ASYNC': 3,    # sync/stats/run ticks
+    'infer/multihost.py:SKY-ASYNC': 1,   # lockstep watchdog heartbeat
 }
 
-_SLEEP_RE = re.compile(r'\btime\.sleep\(')
+
+def _async_report(allowlist=None):
+    return analysis.run(checkers=[analysis.AsyncChecker()],
+                        allowlist=allowlist)
 
 
-def _sleep_sites():
-    found = {}
-    for d in _CHECKED_DIRS:
-        root = os.path.join(_PKG_ROOT, d)
-        for dirpath, _, files in os.walk(root):
-            for fname in files:
-                if not fname.endswith('.py'):
-                    continue
-                path = os.path.join(dirpath, fname)
-                rel = os.path.relpath(path, _PKG_ROOT)
-                with open(path, encoding='utf-8') as f:
-                    n = len(_SLEEP_RE.findall(f.read()))
-                if n:
-                    found[rel.replace(os.sep, '/')] = n
-    return found
-
-
-def test_no_new_bare_sleep_retry_loops():
-    found = _sleep_sites()
-    offenders = {
-        rel: n for rel, n in found.items()
-        if n > _ALLOWED.get(rel, 0)
-    }
-    assert not offenders, (
-        f'New bare time.sleep() call sites in wire-facing layers: '
-        f'{offenders} (allowed: {_ALLOWED}). Retry/backoff belongs in '
-        f'the shared Retrier (skypilot_tpu/utils/retry.py); if this is '
-        f'a genuine status-poll cadence, update the allowlist with a '
-        f'justification.')
+def test_no_new_sleep_or_retry_sites():
+    """SKY-ASYNC over the package against the shipped allowlist: a
+    new bare sleep, blocking call in async def, or hand-rolled retry
+    backoff fails here. Route the wait through utils/retry.Retrier
+    (or an event wait); a genuine status-poll cadence extends the
+    allowlist with a justification in the diff."""
+    report = _async_report()
+    assert not report.offenders, '\n' + report.render_text()
 
 
 def test_allowlist_not_stale():
-    """Entries whose sleeps were since removed must leave the allowlist
-    (otherwise it silently grants headroom for new ad-hoc loops)."""
-    found = _sleep_sites()
-    stale = {rel: cap for rel, cap in _ALLOWED.items()
-             if found.get(rel, 0) < cap}
-    assert not stale, (
-        f'Allowlist entries exceed the actual time.sleep() counts: '
-        f'{stale} vs found {found} — ratchet the allowlist down.')
+    """Entries whose sleep sites were since removed must leave the
+    allowlist (otherwise they silently grant headroom for new ad-hoc
+    loops) — the ratchet the grep lint enforced, inherited."""
+    report = _async_report()
+    assert not report.stale, '\n' + report.render_text()
 
 
-# ---- infer hot path: token delivery must stay event-driven ---------------
-# The serve lane's decode/streaming path was converted from sleep-polling
-# (2-5 ms poll loops in h_generate and the lockstep idle nap) to token
-# events (Request._notify → condition/asyncio bridge). These caps pin the
-# TOTAL count of time.sleep( + asyncio.sleep( call sites per file so a
-# poll loop cannot quietly regrow in the per-token path; Event.wait /
-# Condition.wait with a safety-net timeout is the sanctioned idiom.
-_INFER_ALLOWED = {
-    # Lockstep watchdog heartbeat (monitoring cadence, not a token poll).
-    'infer/multihost.py': 1,
-    'infer/server.py': 0,
-    'infer/engine.py': 0,
-}
-
-_ANY_SLEEP_RE = re.compile(r'\b(?:time|asyncio)\.sleep\(')
-
-
-def _infer_sleep_sites():
-    found = {}
-    root = os.path.join(_PKG_ROOT, 'infer')
-    for dirpath, _, files in os.walk(root):
-        for fname in files:
-            if not fname.endswith('.py'):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, _PKG_ROOT).replace(os.sep, '/')
-            with open(path, encoding='utf-8') as f:
-                n = len(_ANY_SLEEP_RE.findall(f.read()))
-            if n:
-                found[rel] = n
-    return found
+def test_legacy_pins_migrated_exactly():
+    """Every grep-era pin exists in the new allowlist at the same
+    audited count, and the checker still finds exactly that many
+    sites — no pinned site was lost in the migration."""
+    counts = _async_report(allowlist={}).counts
+    for key, cap in _LEGACY_PINS.items():
+        assert analysis.ALLOWLIST.get(key, (0, ''))[0] == cap, (
+            f'{key}: allowlist no longer carries the audited grep-'
+            f'lint cap {cap}')
+        assert counts.get(key, 0) == cap, (
+            f'{key}: checker found {counts.get(key, 0)} sites, the '
+            f'audited count is {cap}')
 
 
 def test_infer_hot_path_stays_event_driven():
-    found = _infer_sleep_sites()
-    offenders = {rel: n for rel, n in found.items()
-                 if n > _INFER_ALLOWED.get(rel, 0)}
-    assert not offenders, (
-        f'New time.sleep/asyncio.sleep call sites in the infer hot '
-        f'path: {offenders} (allowed: {_INFER_ALLOWED}). Token '
-        f'delivery is event-driven (Request.wait_progress / '
-        f'server._TokenWaiter); a poll loop here re-adds a poll '
-        f'interval of latency to every streamed token.')
-
-
-def test_infer_allowlist_not_stale():
-    found = _infer_sleep_sites()
-    stale = {rel: cap for rel, cap in _INFER_ALLOWED.items()
-             if found.get(rel, 0) < cap}
-    assert not stale, (
-        f'Infer allowlist exceeds actual sleep counts: {stale} vs '
-        f'{found} — ratchet it down.')
-
-
-# ---- serve hot path: drain + resumable streams stay event-driven ---------
-# The zero-downtime-serving paths (LB mid-stream resume splice, the
-# replica manager's drain-before-terminate, the infer server's /drain
-# long-poll) are event-driven end to end: the LB wakes on upstream
-# chunks, /drain answers the instant the in-flight count hits zero, and
-# the manager makes ONE blocking drain call instead of polling health.
-# These caps pin the TOTAL time.sleep( + asyncio.sleep( sites per
-# serve/ file so a poll loop cannot quietly regrow in those paths (the
-# time.sleep-only lint above misses asyncio.sleep, which is what LB
-# code would reach for).
-_SERVE_ANY_ALLOWED = {
-    # Replica-set sync + stats-flush cadences + the run() idle loop —
-    # background maintenance ticks, none on the request path.
-    'serve/load_balancer.py': 3,
-    'serve/controller.py': 2,  # controller tick cadence
-    'serve/__init__.py': 2,    # serve up/down status polls
-}
-
-
-def _serve_any_sleep_sites():
-    found = {}
-    root = os.path.join(_PKG_ROOT, 'serve')
-    for dirpath, _, files in os.walk(root):
-        for fname in files:
-            if not fname.endswith('.py'):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, _PKG_ROOT).replace(os.sep, '/')
-            with open(path, encoding='utf-8') as f:
-                n = len(_ANY_SLEEP_RE.findall(f.read()))
-            if n:
-                found[rel] = n
-    return found
-
-
-def test_serve_drain_resume_paths_stay_event_driven():
-    found = _serve_any_sleep_sites()
-    offenders = {rel: n for rel, n in found.items()
-                 if n > _SERVE_ANY_ALLOWED.get(rel, 0)}
-    assert not offenders, (
-        f'New time.sleep/asyncio.sleep call sites in serve/: '
-        f'{offenders} (allowed: {_SERVE_ANY_ALLOWED}). The drain and '
-        f'mid-stream-resume paths are event-driven (the /drain '
-        f'long-poll and the splice loop wake on events); a poll loop '
-        f'here adds its interval to every failover or scale-down.')
-
-
-def test_serve_any_allowlist_not_stale():
-    found = _serve_any_sleep_sites()
-    stale = {rel: cap for rel, cap in _SERVE_ANY_ALLOWED.items()
-             if found.get(rel, 0) < cap}
-    assert not stale, (
-        f'Serve allowlist exceeds actual sleep counts: {stale} vs '
-        f'{found} — ratchet it down.')
+    """Token delivery is event-driven (Request.wait_progress /
+    server._TokenWaiter): engine.py and server.py carry ZERO sleep
+    sites — enforced by the absence of any allowlist entry for them
+    (SKY-ASYNC flags every sleep in infer/)."""
+    counts = _async_report(allowlist={}).counts
+    assert 'infer/engine.py:SKY-ASYNC' not in counts
+    assert 'infer/server.py:SKY-ASYNC' not in counts
+    for key in ('infer/engine.py:SKY-ASYNC',
+                'infer/server.py:SKY-ASYNC'):
+        assert key not in analysis.ALLOWLIST
