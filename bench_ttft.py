@@ -63,11 +63,14 @@ def _streamed_request(url: str, payload, max_new_tokens: int = 8,
                       timeout: float = 300.0) -> tuple:
     """One streamed /generate through the LB. ``payload`` is a prompt
     string or a full request dict (the shared-prefix sweep sends token
-    ids directly). Returns ``(ttft_s, itl_samples_s)``: send→first-byte
-    seconds (true client-observed TTFT) plus one inter-token latency
-    sample per token after the first — the arrival gap of each flushed
-    line, amortized over the tokens it carried (the engine may batch
-    several tokens into one flush under load)."""
+    ids directly). Returns ``(ttft_s, itl_samples_s, queue_wait_s)``:
+    send→first-byte seconds (true client-observed TTFT), one
+    inter-token latency sample per token after the first — the arrival
+    gap of each flushed line, amortized over the tokens it carried
+    (the engine may batch several tokens into one flush under load) —
+    and the done-line's engine-stamped queue wait (submit → first
+    chunk dispatch), which decomposes TTFT into scheduling vs prefill
+    compute."""
     if not isinstance(payload, dict):
         payload = {'prompt': payload}
     payload = {'max_new_tokens': max_new_tokens, 'stream': True,
@@ -77,6 +80,7 @@ def _streamed_request(url: str, payload, max_new_tokens: int = 8,
         headers={'Content-Type': 'application/json'})
     t0 = time.perf_counter()
     itls = []
+    queue_wait = None
     with urllib.request.urlopen(req, timeout=timeout) as r:
         first = r.read(1)          # first streamed byte = first token
         t_prev = time.perf_counter()
@@ -89,13 +93,16 @@ def _streamed_request(url: str, payload, max_new_tokens: int = 8,
             if not line.strip():
                 continue
             try:
-                tokens = json.loads(line).get('tokens') or []
+                obj = json.loads(line)
             except ValueError:     # truncated tail line
-                tokens = []
+                continue
+            tokens = obj.get('tokens') or []
             if tokens:
                 itls.extend([(now - t_prev) / len(tokens)] * len(tokens))
                 t_prev = now
-    return ttft, itls
+            if obj.get('done'):
+                queue_wait = obj.get('queue_wait_s')
+    return ttft, itls, queue_wait
 
 
 def _pct(sorted_vals, p: float):
@@ -122,6 +129,7 @@ def _sweep_level(gen_url: str, concurrency: int, n_requests: int,
     make = payload_for or prompt_for
     results = []   # (is_long, ttft)
     itl_samples = []
+    queue_waits = []
     t0 = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
         futs = {pool.submit(_streamed_request, gen_url, make(i),
@@ -129,13 +137,16 @@ def _sweep_level(gen_url: str, concurrency: int, n_requests: int,
                 for i in range(n_requests)}
         for f in concurrent.futures.as_completed(futs):
             i = futs[f]
-            ttft, itls = f.result()
+            ttft, itls, qwait = f.result()
             results.append((bool(long_prompt_tokens and i % 8 == 7),
                             ttft))
             itl_samples.extend(itls)
+            if qwait is not None:
+                queue_waits.append(qwait)
     wall = time.perf_counter() - t0
     ttfts = sorted(t for _, t in results)
     itl_samples.sort()
+    queue_waits.sort()
     out = {
         'concurrency': concurrency,
         'samples': len(ttfts),
@@ -143,6 +154,14 @@ def _sweep_level(gen_url: str, concurrency: int, n_requests: int,
         'ttft_p90_s': _pct(ttfts, 0.90),
         'ttft_p99_s': _pct(ttfts, 0.99),
         'ttft_mean_s': round(statistics.fmean(ttfts), 5),
+        # TTFT decomposition: the engine-stamped queue wait (submit →
+        # first chunk dispatch). ttft - queue_wait ≈ prefill compute +
+        # transport, so a scheduling win is attributable apart from
+        # prefill speed.
+        'queue_wait_p50_ms': (round(_pct(queue_waits, 0.50) * 1e3, 3)
+                              if queue_waits else None),
+        'queue_wait_p99_ms': (round(_pct(queue_waits, 0.99) * 1e3, 3)
+                              if queue_waits else None),
         # Inter-token latency: the steady-state decode cadence a
         # streaming client sees — the number the overlapped decode
         # pipeline moves (TTFT is dominated by prefill+queueing).
@@ -225,6 +244,52 @@ def _shared_prefix_level(gen_url: str, metrics_url: str,
         out['itl_ratio_shared_over_cold'] = round(
             shared['itl_p50_ms'] / cold['itl_p50_ms'], 3)
     return out
+
+
+def _tenant_level(gen_url: str, lb_metrics_url: str, level: int,
+                  seed: int, duration_s: float,
+                  trace_path: str = None) -> dict:
+    """One level of the multi-tenant fairness sweep: replay a seeded
+    10:1 aggressor/victim trace (or ``trace_path``) through the LB
+    with the X-SkyTpu-Tenant header, and report per-tenant
+    TTFT/ITL/shed-rate plus the LB's own per-tenant view. ``level``
+    scales the offered rate (victim ≈ level rps, aggressor 10x)."""
+    from tests.load_tests import loadgen
+    if trace_path:
+        events, _ = loadgen.load_trace(trace_path)
+    else:
+        events = loadgen.synthesize(seed, {
+            'victim': {'rps': float(level), 'burst': 2,
+                       'prompt_mean': 16, 'prompt_max': 48,
+                       'max_new': 8},
+            'aggressor': {'rps': 10.0 * level, 'burst': 10,
+                          'prompt_mean': 24, 'prompt_max': 96,
+                          'max_new': 8},
+        }, duration_s=duration_s)
+    m0 = _get(lb_metrics_url)
+    records = loadgen.replay_over_http(events, gen_url)
+    m1 = _get(lb_metrics_url)
+    tenants = loadgen.tenant_summary(records)
+    shed_delta = (m1.get('requests_shed', 0)
+                  - m0.get('requests_shed', 0))
+
+    def lb_tenant_delta(key: str) -> dict:
+        # The LB's per-tenant counters are cumulative: delta them so
+        # each level reports ITS traffic, not every prior level's.
+        return {t: (row.get(key, 0)
+                    - ((m0.get('tenants') or {}).get(t) or {})
+                    .get(key, 0))
+                for t, row in (m1.get('tenants') or {}).items()}
+    return {
+        'concurrency': level,
+        'samples': len(records),
+        'trace_events': len(events),
+        'tenants': tenants,
+        'lb_requests_shed': shed_delta,
+        'lb_tenants_requests': lb_tenant_delta('requests_total'),
+        'lb_tenants_shed': lb_tenant_delta('requests_shed'),
+        'engine_queue_depth_after': m1.get('engine_queue_depth'),
+    }
 
 
 def _chaos_request(gen_url: str, payload, max_new_tokens: int = 32,
@@ -315,7 +380,7 @@ def main() -> None:
     parser.add_argument('--n-pages', type=int, default=None)
     parser.add_argument('--sweep', default='concurrency',
                         choices=['concurrency', 'shared-prefix',
-                                 'chaos-resume'],
+                                 'chaos-resume', 'tenants'],
                         help="'shared-prefix': the shared-system-"
                              'prompt workload (implies --paged '
                              '--prefix-cache) — per level, a cold '
@@ -329,7 +394,34 @@ def main() -> None:
                              'an uninterrupted pass vs a chaos pass, '
                              'emitting completed-request rate, resume '
                              'count, and the p99 latency a resumed '
-                             'stream adds over an uninterrupted one')
+                             "stream adds over an uninterrupted one. "
+                             "'tenants': multi-tenant fairness — "
+                             'replay a seeded 10:1 aggressor/victim '
+                             'trace (tests/load_tests/loadgen.py) '
+                             'with the X-SkyTpu-Tenant header, '
+                             'emitting per-tenant ttft_p50/p99, '
+                             'itl_p50/p99 and shed_rate per level '
+                             '(pair with --scheduler wfq vs fcfs to '
+                             'see the isolation win)')
+    parser.add_argument('--scheduler', default=None,
+                        choices=['fcfs', 'deadline', 'wfq'],
+                        help='engine scheduling policy for the '
+                             'replica (infer/sched/); defaults to '
+                             "the server default (fcfs), or wfq for "
+                             '--sweep tenants')
+    parser.add_argument('--tenant-weights', default=None,
+                        help="wfq weights, e.g. 'victim=2,"
+                             "aggressor=1' (forwarded to the server)")
+    parser.add_argument('--trace', default=None,
+                        help='tenants sweep: replay this trace file '
+                             '(loadgen JSONL) instead of synthesizing')
+    parser.add_argument('--trace-seed', type=int, default=7,
+                        help='tenants sweep: trace synthesis seed '
+                             '(fixed seed = identical replayable '
+                             'workload)')
+    parser.add_argument('--trace-duration', type=float, default=6.0,
+                        help='tenants sweep: seconds of trace per '
+                             'level')
     parser.add_argument('--kill-after-chunks', type=int, default=6,
                         help='chaos-resume: sever the proxied stream '
                              'after this many response chunks')
@@ -362,6 +454,8 @@ def main() -> None:
             args.max_seq_len = 1024
     if args.max_seq_len is None:
         args.max_seq_len = 256
+    if args.sweep == 'tenants' and args.scheduler is None:
+        args.scheduler = 'wfq'
     if args.prefix_cache and not args.paged:
         raise SystemExit('--prefix-cache requires --paged')
 
@@ -410,6 +504,15 @@ def main() -> None:
             cmd += ['--n-pages', str(args.n_pages)]
     if args.prefix_cache:
         cmd.append('--prefix-cache')
+    if args.scheduler:
+        cmd += ['--scheduler', args.scheduler]
+    if args.tenant_weights:
+        cmd += ['--tenant-weights', args.tenant_weights]
+    if args.sweep == 'tenants':
+        # Fairness needs a finite admission bound to shed against —
+        # the wfq quota split (and the fcfs counterexample) are both
+        # measured off it.
+        cmd += ['--max-queue-requests', str(4 * args.slots)]
     if tokenizer:
         cmd += ['--tokenizer', tokenizer]
     infer_proc = subprocess.Popen(
@@ -521,6 +624,16 @@ def main() -> None:
                     lvl['lb_requests_failed'] = (
                         m1['requests_failed'] - m0['requests_failed'])
                     sweep.append(lvl)
+            elif args.sweep == 'tenants':
+                lb_metrics_url = f'http://127.0.0.1:{lb_port}/-/metrics'
+                # Warm the prefill buckets off the clock.
+                _sweep_level(gen_url, max(args.concurrency),
+                             2 * args.slots)
+                for conc in args.concurrency:
+                    sweep.append(_tenant_level(
+                        gen_url, lb_metrics_url, conc,
+                        args.trace_seed, args.trace_duration,
+                        trace_path=args.trace))
             else:
                 # Warm every concurrency level's batch shapes off the
                 # clock.
@@ -575,6 +688,21 @@ def main() -> None:
                 lv.get('lb_requests_failed', 0) for lv in sweep),
             'kill_after_chunks': args.kill_after_chunks,
         }
+    elif args.sweep == 'tenants':
+        vict = (base.get('tenants') or {}).get('victim') or {}
+        aggr = (base.get('tenants') or {}).get('aggressor') or {}
+        head = {
+            'metric': 'tenants_victim_ttft_p99_s',
+            'value': vict.get('ttft_p99_s'),
+            'unit': 'seconds (victim p99 TTFT under a 10:1 '
+                    'aggressor tenant)',
+            'victim_shed_rate': vict.get('shed_rate'),
+            'aggressor_shed_rate': aggr.get('shed_rate'),
+            'victim_queue_wait_p99_ms': vict.get('queue_wait_p99_ms'),
+            'victim_itl_p99_ms': vict.get('itl_p99_ms'),
+            'scheduler': args.scheduler,
+            'trace_seed': args.trace_seed,
+        }
     else:
         head = {
             'metric': 'serve_ttft_warm_p50_s',
@@ -583,6 +711,8 @@ def main() -> None:
             'ttft_warm_p99_s': base.get('ttft_p99_s'),
             'itl_p50_ms': base.get('itl_p50_ms'),
             'itl_p99_ms': base.get('itl_p99_ms'),
+            'queue_wait_p50_ms': base.get('queue_wait_p50_ms'),
+            'queue_wait_p99_ms': base.get('queue_wait_p99_ms'),
         }
     result = {
         **head,

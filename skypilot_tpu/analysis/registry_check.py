@@ -43,6 +43,7 @@ from skypilot_tpu.analysis import walker
 METRIC_FUNCS: Tuple[Tuple[str, str], ...] = (
     ('infer/engine.py', 'metrics'),
     ('infer/prefix_cache.py', 'stats'),
+    ('infer/sched/base.py', 'aggregate_stats'),
     ('infer/server.py', 'h_metrics'),
     ('serve/load_balancer.py', 'lb_metrics'),
 )

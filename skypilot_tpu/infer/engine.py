@@ -46,22 +46,15 @@ from skypilot_tpu.infer import model as model_lib
 from skypilot_tpu.infer import paged_cache as paged_cache_lib
 from skypilot_tpu.infer import prefix_cache as prefix_cache_lib
 from skypilot_tpu.infer import sampling as sampling_lib
+from skypilot_tpu.infer import sched as sched_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.observability import trace
 from skypilot_tpu.utils import failpoints
 
-
-class AdmissionError(ValueError):
-    """The engine refused new work because its queue is at capacity
-    (``EngineConfig.max_queue_requests`` / ``max_queue_tokens``): the
-    caller sheds (HTTP 429 + Retry-After at the server) instead of
-    queueing unboundedly. A ``ValueError`` subclass so the multihost
-    lockstep tick's uniform-rejection rule applies unchanged on every
-    host."""
-
-    def __init__(self, msg: str, retry_after_s: float = 1.0) -> None:
-        super().__init__(msg)
-        self.retry_after_s = retry_after_s
+# Back-compat re-export: admission control moved into the scheduler
+# subsystem (infer/sched/), but the server and the lockstep driver
+# catch it by this name.
+AdmissionError = sched_lib.AdmissionError
 
 
 @dataclasses.dataclass(frozen=True)
@@ -127,9 +120,18 @@ class EngineConfig:
     # server answers 429 + Retry-After) instead of queueing without
     # bound. None = unbounded. max_queue_tokens caps the total
     # prompt+resume tokens parked in the queue — the companion knob for
-    # few-but-huge prompts.
+    # few-but-huge prompts. Under 'wfq' these bounds are split into
+    # per-tenant quotas by weight.
     max_queue_requests: Optional[int] = None
     max_queue_tokens: Optional[int] = None
+    # Step-loop scheduling policy (infer/sched/, docs/serving.md
+    # "Engine scheduler"): 'fcfs' (default — bit-identical to the
+    # historical inline behavior), 'deadline' (EDF over wall-clock
+    # budgets), 'wfq' (per-tenant weighted fair queueing).
+    scheduler: str = 'fcfs'
+    # tenant -> relative weight for 'wfq' (unknown tenants weigh 1.0).
+    # A mapping in a frozen dataclass: treat as immutable.
+    tenant_weights: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -143,6 +145,14 @@ class Request:
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     finish_reason: Optional[str] = None
+    # Multi-tenant identity (X-SkyTpu-Tenant end to end): the unit of
+    # fair queueing, quotas, and the per-tenant metric breakdown.
+    tenant: str = sched_lib.DEFAULT_TENANT
+    # When the engine dispatched this request's FIRST prefill chunk —
+    # the boundary that decomposes TTFT into queue wait (submit →
+    # first dispatch, the scheduler's doing) vs prefill compute
+    # (dispatch → first token). Not re-stamped on preemption resume.
+    first_dispatch_at: Optional[float] = None
     # Prompt tokens served from the shared-prefix cache (their prefill
     # was skipped); surfaced per request by the server's done-line.
     cached_tokens: int = 0
@@ -178,6 +188,14 @@ class Request:
         if self.first_token_at is None:
             return None
         return self.first_token_at - self.submitted_at
+
+    @property
+    def queue_wait(self) -> Optional[float]:
+        """Seconds from submit to the first prefill-chunk dispatch —
+        the scheduling (not compute) share of TTFT."""
+        if self.first_dispatch_at is None:
+            return None
+        return self.first_dispatch_at - self.submitted_at
 
     # ---- token events ----------------------------------------------------
     def add_listener(self, callback) -> None:
@@ -276,8 +294,12 @@ class InferenceEngine:
     # readers (metrics/idle, which do lock) never see a torn update,
     # while the owner's own reads stay lock-free.
     _GUARDED_BY = {
-        '_waiting': '_lock',        # submit() threads vs step loop
+        '_sched': '_lock',          # submit() threads vs step loop —
+                                    # the scheduler's own fields are
+                                    # declared in infer/sched/ and
+                                    # guarded by THIS lock too
         '_ttfts': '_lock',          # consume appends vs snapshots
+        '_queue_waits': '_lock',
         '_slots': '_lock:mut',      # engine-thread owned
         '_inflight_tok': '_lock:mut',
         '_abandoned': '_lock',      # sweep writes vs metrics reads
@@ -375,7 +397,14 @@ class InferenceEngine:
         # mutations and are also called from _consume_one, which
         # already holds it for the whole consume.
         self._lock = threading.RLock()
-        self._waiting: List[Request] = []
+        # Pluggable admission/ordering policy (infer/sched/): owns the
+        # waiting queue; every call into it happens under _lock.
+        self._sched = sched_lib.make(
+            self.ecfg.scheduler,
+            sched_lib.SchedulerConfig(
+                max_queue_requests=self.ecfg.max_queue_requests,
+                max_queue_tokens=self.ecfg.max_queue_tokens,
+                tenant_weights=self.ecfg.tenant_weights))
         self._slots: List[Optional[Request]] = [None] * self.ecfg.n_slots
         # Shared-prefix radix tree over the page pool (None = disabled).
         self.prefix: Optional[prefix_cache_lib.PrefixCache] = None
@@ -392,7 +421,6 @@ class InferenceEngine:
         # slot -> prompt tokens already prefilled (chunked prefill in
         # flight); a slot decodes only once its prompt is fully cached.
         self._prefilling: Dict[int, int] = {}
-        self._rr = 0   # round-robin cursor over prefilling slots
         # Last sampled token per slot lives ON DEVICE: reading it back
         # per step would add a host sync (decode consumes it directly;
         # the host sees tokens through the decode output pair).
@@ -440,6 +468,11 @@ class InferenceEngine:
         # Recent-window TTFTs: bounded so a long-lived replica's /metrics
         # stays O(1) in memory and p50 reflects current behavior.
         self._ttfts: collections.deque = collections.deque(maxlen=1024)
+        # Recent-window queue waits (submit → first chunk dispatch):
+        # the scheduling share of TTFT, reported separately so a
+        # scheduling win is attributable apart from prefill speed.
+        self._queue_waits: collections.deque = collections.deque(
+            maxlen=1024)
 
         # ---- compiled programs ------------------------------------------
         # Params are ARGUMENTS, never closure-captured: captured arrays
@@ -578,7 +611,8 @@ class InferenceEngine:
                max_new_tokens: Optional[int] = None,
                temperature: float = 0.0,
                resume_tokens: Optional[Sequence[int]] = None,
-               deadline: Optional[float] = None) -> Request:
+               deadline: Optional[float] = None,
+               tenant: str = sched_lib.DEFAULT_TENANT) -> Request:
         """Queue a request. ``resume_tokens`` continues a stream whose
         earlier tokens were already delivered elsewhere (mid-stream
         failover): they are pre-seeded into ``output_tokens``, so
@@ -586,8 +620,9 @@ class InferenceEngine:
         preemption — greedy continuation is bit-identical to an
         uninterrupted run) and decoding picks up at the boundary.
         ``deadline`` is an absolute wall-clock cutoff enforced by the
-        step loop. Raises :class:`AdmissionError` when the queue is at
-        the configured bound."""
+        step loop. ``tenant`` is the fair-queueing identity
+        (X-SkyTpu-Tenant). Raises :class:`AdmissionError` when the
+        scheduler's (global or per-tenant) queue bound is hit."""
         if not prompt_tokens:
             raise ValueError('empty prompt')
         resume = list(map(int, resume_tokens)) if resume_tokens else []
@@ -623,7 +658,8 @@ class InferenceEngine:
             temperature=float(temperature),
             output_tokens=resume,
             resumed_from=len(resume),
-            deadline=deadline)
+            deadline=deadline,
+            tenant=str(tenant) or sched_lib.DEFAULT_TENANT)
         if resume and len(resume) >= max_new_tokens:
             # The stream died on its very last token: the budget is
             # already spent — finish without ever entering the queue
@@ -638,20 +674,14 @@ class InferenceEngine:
         except failpoints.FailpointError as e:
             raise AdmissionError(f'injected admit-full: {e}') from e
         with self._lock:
-            cap = self.ecfg.max_queue_requests
-            if cap is not None and len(self._waiting) >= cap:
-                raise AdmissionError(
-                    f'engine queue full ({len(self._waiting)} waiting '
-                    f'>= max_queue_requests={cap})')
-            tcap = self.ecfg.max_queue_tokens
-            if tcap is not None:
-                queued = sum(len(r.prompt_tokens) + len(r.output_tokens)
-                             for r in self._waiting)
-                if queued + total > tcap:
-                    raise AdmissionError(
-                        f'engine queue full ({queued} queued tokens + '
-                        f'{total} > max_queue_tokens={tcap})')
-            self._waiting.append(req)
+            # Admission is the scheduler's call (global bounds under
+            # fcfs/deadline, per-tenant quotas under wfq); its
+            # AdmissionError carries a queue-drain Retry-After
+            # estimate computed from the recent decode throughput.
+            self._sched.admit(req, drain_tps=(
+                self._decode_tokens / self._decode_time
+                if self._decode_time else 0.0))
+            self._sched.enqueue(req)
         return req
 
     def cancel(self, req: Request) -> bool:
@@ -766,6 +796,16 @@ class InferenceEngine:
                     self.prefix.tokens_saved -= just_attached
                 return None
             table_row = jnp.asarray(self.allocator.table()[slot])
+        if req.first_dispatch_at is None:
+            # Queue-wait boundary: the request's first chunk is about
+            # to dispatch (page coverage secured above). Not re-stamped
+            # on preemption resume — the wait being measured is the
+            # scheduler's admission-to-service latency.
+            req.first_dispatch_at = time.time()
+            wait = req.first_dispatch_at - req.submitted_at
+            with self._lock:
+                self._queue_waits.append(wait)
+                self._sched.note_queue_wait(req, wait)
         padded = np.zeros((bucket,), np.int32)
         padded[:tl] = source[off:off + tl]
         if self.allocator is not None:
@@ -832,6 +872,8 @@ class InferenceEngine:
                 # same step its first token landed).
                 req.first_token_at = req.finished_at
                 self._ttfts.append(req.finished_at - req.submitted_at)
+                self._sched.note_first_token(
+                    req, req.finished_at - req.submitted_at)
             self._slots[slot] = None
             # Release BEFORE zeroing _slot_len: donation covers exactly
             # the positions whose K/V the pages hold, which is what
@@ -878,26 +920,22 @@ class InferenceEngine:
         if not self.wallclock_cancel:
             return
         now = time.time()
-        if self._waiting:
-            keep: List[Request] = []
-            for r in self._waiting:
-                if r.cancelled:
-                    self._abandoned += 1
-                    self._finish_queued(r, 'cancelled')
-                elif r.deadline is not None and now > r.deadline:
-                    self._expired += 1
-                    self._finish_queued(r, 'deadline')
-                else:
-                    keep.append(r)
-            self._waiting = keep
+        for r, reason in self._sched.sweep(now):
+            if reason == 'cancelled':
+                self._abandoned += 1
+            else:
+                self._expired += 1
+            self._finish_queued(r, reason)
         for slot, r in enumerate(self._slots):
             if r is None:
                 continue
             if r.cancelled:
                 self._cancelled += 1
+                self._sched.note_outcome(r, 'cancelled')
                 self._finish_early(slot, r, 'cancelled')
             elif r.deadline is not None and now > r.deadline:
                 self._expired += 1
+                self._sched.note_outcome(r, 'deadline')
                 self._finish_early(slot, r, 'deadline')
 
     def _preempt(self, slot: int) -> None:
@@ -914,7 +952,7 @@ class InferenceEngine:
             self._release_slot_pages(slot, req, prefilled_to)
             self._slot_len[slot] = 0
             self.cache = self._free(self.cache, jnp.int32(slot))
-            self._waiting.insert(0, req)
+            self._sched.requeue(req)
             self._preemptions += 1
 
     def _unshare_write_range(self, slot: int, start_tok: int,
@@ -1027,8 +1065,9 @@ class InferenceEngine:
                     req.finish_reason = 'cache_full'
                     self._finish(slot, req)
                     break
-                victim = max(victims,
-                             key=lambda s: self._slots[s].submitted_at)
+                with self._lock:
+                    victim = self._sched.pick_victim(victims,
+                                                     self._slots)
                 self._preempt(victim)
                 if victim in decoding:
                     decoding.remove(victim)
@@ -1058,8 +1097,10 @@ class InferenceEngine:
         with self._lock:
             self._sweep_dead_requests()
             for slot in range(self.ecfg.n_slots):
-                if self._slots[slot] is None and self._waiting:
-                    req = self._waiting.pop(0)
+                if self._slots[slot] is None:
+                    req = self._sched.pop_next()
+                    if req is None:
+                        break
                     self._slots[slot] = req   # reserve before releasing
                     self._prefilling[slot] = 0
                     self._matched.discard(slot)
@@ -1074,8 +1115,13 @@ class InferenceEngine:
                                 if s not in deferred)
             if not candidates:
                 break
-            self._rr = (self._rr + 1) % len(candidates)
-            slot = candidates[self._rr]
+            # The scheduler spends the chunk budget (fcfs: the
+            # historical round-robin cursor; deadline: most urgent
+            # first; wfq: rotate across tenants). Under the lock —
+            # scheduler state is lock-guarded by contract.
+            with self._lock:
+                slot = self._sched.next_prefill_slot(candidates,
+                                                     self._slots)
             result = self._do_chunk(slot)
             if result is None:
                 # Page pool dry: stop burning chunk budget on this slot
@@ -1097,8 +1143,10 @@ class InferenceEngine:
                        if r is not None and s != keep
                        and self.allocator.pages_of(s) > 0]
             if victims:
-                self._preempt(max(
-                    victims, key=lambda s: self._slots[s].submitted_at))
+                with self._lock:
+                    victim = self._sched.pick_victim(victims,
+                                                     self._slots)
+                self._preempt(victim)
             else:
                 req = self._slots[keep]
                 req.finish_reason = 'cache_full'
@@ -1194,8 +1242,11 @@ class InferenceEngine:
                 if req.first_token_at is None:
                     req.first_token_at = now
                     self._ttfts.append(now - req.submitted_at)
+                    self._sched.note_first_token(
+                        req, now - req.submitted_at)
                 req.output_tokens.append(first)
                 self._decode_tokens += 1
+                self._sched.note_tokens(req)
                 touched.append(req)
                 if self._finished(req, slot, first):
                     # First token already ends the request; the second
@@ -1210,6 +1261,7 @@ class InferenceEngine:
                 req.output_tokens.append(token)
                 self._slot_len[slot] += 1
                 self._decode_tokens += 1
+                self._sched.note_tokens(req)
                 touched.append(req)
                 if self._finished(req, slot, token):
                     self._finish(slot, req)
@@ -1239,9 +1291,44 @@ class InferenceEngine:
         every host must reach identical request state each tick."""
         self.wallclock_cancel = bool(enabled)
 
+    def set_scheduler(self, name: str,
+                      tenant_weights=None) -> None:
+        """Swap the scheduling policy at runtime (a bench/ops knob —
+        the same engine, compiled programs and KV state serve on).
+        Queued requests migrate in the OLD policy's service order;
+        per-tenant windows/counters restart with the new policy."""
+        cfg = sched_lib.SchedulerConfig(
+            max_queue_requests=self.ecfg.max_queue_requests,
+            max_queue_tokens=self.ecfg.max_queue_tokens,
+            tenant_weights=(tenant_weights
+                            if tenant_weights is not None
+                            else self.ecfg.tenant_weights))
+        with self._lock:
+            new = sched_lib.make(name, cfg)
+            old = self._sched
+            while True:
+                req = old.pop_next()
+                if req is None:
+                    break
+                new.enqueue(req)
+            self._sched = new
+
+    def set_tenant_weights(self, weights) -> None:
+        """Update wfq weights mid-flight (queued work keeps its
+        position; future decisions use the new weights)."""
+        with self._lock:
+            self._sched.set_tenant_weights(weights)
+
+    def sched_snapshot(self) -> Dict[str, Any]:
+        """Locked export of the scheduler's per-tenant raw stats —
+        the EnginePool merge path (same reason as ``ttft_window``:
+        cross-thread aggregators must never iterate live deques)."""
+        with self._lock:
+            return self._sched.snapshot()
+
     def idle(self) -> bool:
         with self._lock:
-            return (not self._waiting
+            return (not self._sched.pending()
                     and all(r is None for r in self._slots)
                     and not self._queue)
 
@@ -1270,6 +1357,12 @@ class InferenceEngine:
         with self._lock:
             return list(self._ttfts)
 
+    def queue_wait_window(self) -> List[float]:
+        """Locked snapshot of the recent queue-wait window (same
+        contract as ``ttft_window``)."""
+        with self._lock:
+            return list(self._queue_waits)
+
     def metrics(self) -> Dict[str, Any]:
         # Snapshot under the engine lock: with the overlapped loop,
         # counters (_decode_tokens, _ttfts, pages_free) are written one
@@ -1280,6 +1373,7 @@ class InferenceEngine:
         with self._lock:
             ttfts = sorted(self._ttfts)
             p50 = ttfts[len(ttfts) // 2] if ttfts else None
+            waits = sorted(self._queue_waits)
             return {
                 'decode_steps': self._decode_steps,
                 'decode_tokens': self._decode_tokens,
@@ -1287,7 +1381,20 @@ class InferenceEngine:
                     self._decode_tokens / self._decode_time
                     if self._decode_time else 0.0),
                 'ttft_p50_s': p50,
-                'num_waiting': len(self._waiting),
+                # TTFT decomposition: submit → first chunk dispatch
+                # (the scheduler's share), apart from prefill compute.
+                'queue_wait_p50_ms': (round(
+                    waits[len(waits) // 2] * 1e3, 3) if waits
+                    else None),
+                'queue_wait_p99_ms': (round(
+                    waits[min(len(waits) - 1,
+                              int(len(waits) * 0.99))] * 1e3, 3)
+                    if waits else None),
+                'scheduler': self._sched.name,
+                'num_waiting': self._sched.pending(),
+                'queued_tokens': self._sched.queued_tokens(),
+                'tenants': sched_lib.aggregate_stats(
+                    [self._sched.snapshot()], self._decode_time),
                 'num_active': sum(
                     1 for r in self._slots if r is not None),
                 'requests_abandoned': self._abandoned,
@@ -1356,14 +1463,15 @@ class EnginePool:
                max_new_tokens: Optional[int] = None,
                temperature: float = 0.0,
                resume_tokens: Optional[Sequence[int]] = None,
-               deadline: Optional[float] = None) -> Request:
+               deadline: Optional[float] = None,
+               tenant: str = sched_lib.DEFAULT_TENANT) -> Request:
         n = len(prompt_tokens) + len(resume_tokens or ())
         for eng in self.engines:
             if n <= eng.ecfg.max_seq_len - 1:
                 return eng.submit(prompt_tokens, max_new_tokens,
                                   temperature,
                                   resume_tokens=resume_tokens,
-                                  deadline=deadline)
+                                  deadline=deadline, tenant=tenant)
         raise ValueError(
             f'prompt ({n} tokens) exceeds every pool tier '
             f'(largest: {self.engines[-1].ecfg.max_seq_len - 1})')
@@ -1384,6 +1492,14 @@ class EnginePool:
     def set_wallclock_cancel(self, enabled: bool) -> None:
         for e in self.engines:
             e.set_wallclock_cancel(enabled)
+
+    def set_scheduler(self, name: str, tenant_weights=None) -> None:
+        for e in self.engines:
+            e.set_scheduler(name, tenant_weights)
+
+    def set_tenant_weights(self, weights) -> None:
+        for e in self.engines:
+            e.set_tenant_weights(weights)
 
     def idle(self) -> bool:
         return all(e.idle() for e in self.engines)
@@ -1431,6 +1547,8 @@ class EnginePool:
                 'prefix_hits': hits,
                 'prefix_misses': total - hits,
             }
+        waits = sorted(x for e in self.engines
+                       for x in e.queue_wait_window())
         return {
             **prefix_agg,
             'decode_steps': sum(t['decode_steps'] for t in tiers),
@@ -1438,7 +1556,20 @@ class EnginePool:
             'decode_tokens_per_sec': (total_tokens / total_time
                                       if total_time else 0.0),
             'ttft_p50_s': (ttfts[len(ttfts) // 2] if ttfts else None),
+            'queue_wait_p50_ms': (round(
+                waits[len(waits) // 2] * 1e3, 3) if waits else None),
+            'queue_wait_p99_ms': (round(
+                waits[min(len(waits) - 1,
+                          int(len(waits) * 0.99))] * 1e3, 3)
+                if waits else None),
+            'scheduler': tiers[0]['scheduler'],
             'num_waiting': sum(t['num_waiting'] for t in tiers),
+            'queued_tokens': sum(t['queued_tokens'] for t in tiers),
+            # Exact cross-tier merge from locked raw snapshots (never
+            # percentile-of-percentiles).
+            'tenants': sched_lib.aggregate_stats(
+                [e.sched_snapshot() for e in self.engines],
+                total_time),
             'num_active': sum(t['num_active'] for t in tiers),
             'requests_abandoned': sum(t['requests_abandoned']
                                       for t in tiers),

@@ -157,6 +157,30 @@ def synthesize_wordlevel_tokenizer(vocab_size: int, path: str) -> str:
     return path
 
 
+def parse_tenant_weights(spec: Optional[str]) -> Optional[dict]:
+    """``'tenantA=4,tenantB=1'`` → ``{'tenantA': 4.0, 'tenantB':
+    1.0}`` (None/empty → None). Loud on malformed entries — a silently
+    dropped weight is an unfair scheduler nobody can debug."""
+    if not spec:
+        return None
+    out = {}
+    for part in spec.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, val = part.partition('=')
+        try:
+            weight = float(val)
+        except ValueError:
+            weight = -1.0
+        if not sep or not name.strip() or weight <= 0:
+            raise SystemExit(
+                f'bad --tenant-weights entry {part!r}: expected '
+                f'name=positive_number')
+        out[name.strip()] = weight
+    return out or None
+
+
 class IncrementalDecoder:
     """Streaming detokenizer with an O(window) cost per flush.
 
@@ -499,6 +523,16 @@ class InferenceServer:
                 return web.json_response(
                     {'error': 'deadline already exceeded'}, status=504)
             deadline = time.time() + budget_s
+        # Multi-tenant identity: the X-SkyTpu-Tenant header (forwarded
+        # by the serve LB) wins; a 'tenant' body field is the
+        # header-less fallback. The scheduler uses it for fair
+        # queueing/quotas; metrics break down by it.
+        tenant = (request.headers.get(common_lib.TENANT_HEADER)
+                  or str(body.get('tenant') or '') or 'default')
+        if len(tenant) > 128:
+            return web.json_response(
+                {'error': 'tenant id too long (>128 chars)'},
+                status=400)
         if self.draining:
             # Drain may have begun while we were parsing the body —
             # re-check at the admission edge (the in-flight counter is
@@ -530,7 +564,8 @@ class InferenceServer:
                         max_new_tokens=body.get('max_new_tokens'),
                         temperature=float(body.get('temperature', 0.0)),
                         resume_tokens=resume,
-                        deadline=deadline)
+                        deadline=deadline,
+                        tenant=tenant)
         except engine_lib.AdmissionError as e:
             # Bounded admission: shed with 429 + Retry-After instead of
             # queueing unboundedly (the LB tries other replicas first).
@@ -602,6 +637,10 @@ class InferenceServer:
                             {'done': True, 'request_id': req.request_id,
                              'finish_reason': req.finish_reason,
                              'ttft_s': req.ttft,
+                             # TTFT's scheduling share (submit → first
+                             # chunk dispatch): lets the bench
+                             # attribute queueing apart from prefill.
+                             'queue_wait_s': req.queue_wait,
                              # Prompt tokens served from the shared-
                              # prefix KV cache (prefill skipped).
                              'cached_tokens': req.cached_tokens
@@ -657,6 +696,7 @@ class InferenceServer:
             'text': self.tokenizer.decode(req.output_tokens),
             'finish_reason': req.finish_reason,
             'ttft_s': req.ttft,
+            'queue_wait_s': req.queue_wait,
             'cached_tokens': req.cached_tokens,
         })
 
@@ -730,6 +770,17 @@ def main() -> None:
                         help='Companion cap on total queued '
                              'prompt+resume tokens (sheds few-but-'
                              'huge prompts the request cap misses).')
+    parser.add_argument('--scheduler', default='fcfs',
+                        choices=['fcfs', 'deadline', 'wfq'],
+                        help='Step-loop scheduling policy '
+                             '(docs/serving.md "Engine scheduler"): '
+                             'fcfs (default), deadline (EDF over '
+                             'X-SkyTpu-Deadline-S budgets), wfq '
+                             '(per-tenant weighted fair queueing over '
+                             'X-SkyTpu-Tenant with quota shedding).')
+    parser.add_argument('--tenant-weights', default=None,
+                        help="wfq weights as 'tenantA=4,tenantB=1' "
+                             '(unlisted tenants weigh 1.0).')
     parser.add_argument('--pipeline-depth', type=int, default=1,
                         help='Dispatch-ahead decode depth: decode N+1 '
                              'is dispatched before step N is read '
@@ -835,6 +886,7 @@ def main() -> None:
         logger.warning('no --checkpoint: serving random weights (%s)',
                        args.model)
         params = llama.init_params(config, jax.random.PRNGKey(0))
+    tenant_weights = parse_tenant_weights(args.tenant_weights)
     engine = engine_lib.InferenceEngine(
         config, params,
         engine_lib.EngineConfig(
@@ -845,7 +897,9 @@ def main() -> None:
             n_pages=args.n_pages, prefix_cache=args.prefix_cache,
             pipeline_depth=args.pipeline_depth,
             max_queue_requests=args.max_queue_requests,
-            max_queue_tokens=args.max_queue_tokens))
+            max_queue_tokens=args.max_queue_tokens,
+            scheduler=args.scheduler,
+            tenant_weights=tenant_weights))
     if args.long_slots > 0:
         short_cap = min(args.max_seq_len, config.max_seq_len)
         long_cap = min(args.long_seq_len, config.max_seq_len)
@@ -865,7 +919,9 @@ def main() -> None:
                 tp=args.tp, quantize=False,   # params already int8
                 pipeline_depth=args.pipeline_depth,
                 max_queue_requests=args.max_queue_requests,
-                max_queue_tokens=args.max_queue_tokens),
+                max_queue_tokens=args.max_queue_tokens,
+                scheduler=args.scheduler,
+                tenant_weights=tenant_weights),
             seed=1)
         engine = engine_lib.EnginePool([engine, long_engine])
     driver = None

@@ -201,7 +201,16 @@ class InstanceAwareRequestRateAutoscaler(RequestRateAutoscaler):
 
 
 class QueueLengthAutoscaler(_HysteresisAutoscaler):
-    """Scale on the LB's queue depth (reference :1073).
+    """Scale on the service's queue depth (reference :1073).
+
+    The signal is the LB's in-flight gauge PLUS the engines' real
+    scheduler backlog (summed ``num_waiting``, polled by the LB from
+    each replica's /metrics and flushed to the state DB). A request
+    parked in an engine queue appears in BOTH gauges — deliberately:
+    continuous batching absorbs concurrency (in-flight-but-decoding)
+    far better than queueing, so backlogged work weighs double
+    against the threshold, and the signal degrades gracefully to the
+    plain in-flight count when replicas expose no engine metrics.
 
     Steps ±1 replica per decision (rate-limited by the hysteresis
     delays); a queue of zero scales to min_replicas; a non-empty queue
@@ -212,7 +221,8 @@ class QueueLengthAutoscaler(_HysteresisAutoscaler):
                  replicas: Optional[List[dict]]) -> tuple:
         threshold = self.policy.queue_length_threshold
         assert threshold is not None
-        qlen = serve_state.get_inflight(self.service_name)
+        qlen = (serve_state.get_inflight(self.service_name)
+                + serve_state.get_queue_depth(self.service_name))
         current = self.target_num_replicas
         if qlen == 0:
             desired = self.policy.min_replicas

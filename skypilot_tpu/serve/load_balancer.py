@@ -126,10 +126,11 @@ class _StreamSplice:
     greedy decoding the spliced stream is bit-identical to an unkilled
     run."""
 
-    def __init__(self, payload: Dict[str, object],
-                 orig_body: bytes) -> None:
+    def __init__(self, payload: Dict[str, object], orig_body: bytes,
+                 tenant: Optional[str] = None) -> None:
         self.payload = payload
         self.orig_body = orig_body
+        self.tenant = tenant
         try:
             self.client_resume = [
                 int(t) for t in (payload.get('resume_from') or ())]
@@ -173,6 +174,8 @@ class LoadBalancer:
         '_requests_resumed': 'event-loop',
         '_requests_shed': 'event-loop',
         '_draining_urls': 'event-loop',
+        '_tenants': 'event-loop',
+        '_replica_queue_depth': 'event-loop',
     }
 
     def __init__(self, service_name: str, policy_name: str) -> None:
@@ -209,6 +212,15 @@ class LoadBalancer:
         # Replicas currently draining (graceful scale-down/preemption
         # handoff): out of the ready set, surfaced in /-/metrics.
         self._draining_urls: List[str] = []
+        # Per-tenant client-side view (X-SkyTpu-Tenant on /generate):
+        # request/shed counts + a TTFT window each, surfaced under
+        # /-/metrics 'tenants' so fairness is observable at the edge.
+        self._tenants: Dict[str, dict] = {}
+        # url -> engine num_waiting, refreshed by the sync loop from
+        # each ready replica's /metrics: the scheduler-backlog gauge
+        # the QueueLengthAutoscaler scales on (LB in-flight alone
+        # misses queued-but-unserved work inside the engines).
+        self._replica_queue_depth: Dict[str, int] = {}
         self.breaker = retry_lib.CircuitBreaker(
             failure_threshold=int(os.environ.get(
                 'SKY_TPU_LB_BREAKER_THRESHOLD', '3')),
@@ -241,6 +253,32 @@ class LoadBalancer:
                               .get('target_qps_per_replica'))
                         if isinstance(tq, dict):
                             self.policy.set_target_qps_per_accelerator(tq)
+                # Engine queue-depth gauge: each ready replica's
+                # /metrics num_waiting (the scheduler backlog),
+                # fetched CONCURRENTLY so one slow/blackholed replica
+                # costs the tick max(timeouts), not their sum — a
+                # warming/dead replica simply has no gauge this tick.
+                async def _depth_of(url: str):
+                    try:
+                        async with self._session.get(
+                                url.rstrip('/') + '/metrics',
+                                timeout=aiohttp.ClientTimeout(
+                                    total=2)) as r:
+                            if r.status == 200:
+                                m = await r.json()
+                                return url, int(
+                                    m.get('num_waiting') or 0)
+                    except (aiohttp.ClientError,
+                            asyncio.TimeoutError, ValueError,
+                            TypeError, OSError):
+                        pass
+                    return None
+                urls = list(self.policy.ready_urls)
+                fetched = (await asyncio.gather(
+                    *(_depth_of(u) for u in urls))
+                    if self._session is not None and urls else [])
+                self._replica_queue_depth = dict(
+                    pair for pair in fetched if pair is not None)
             except Exception:  # noqa: BLE001 — keep serving on DB hiccup
                 logger.warning('replica sync failed', exc_info=True)
             await asyncio.sleep(SYNC_INTERVAL_S)
@@ -260,6 +298,12 @@ class LoadBalancer:
                 await asyncio.to_thread(
                     serve_state.set_inflight, self.service_name,
                     self._inflight)
+                # Scheduler backlog inside the engines (summed
+                # num_waiting): lets QueueLengthAutoscaler scale on
+                # real queued work, not LB in-flight counts alone.
+                await asyncio.to_thread(
+                    serve_state.set_queue_depth, self.service_name,
+                    sum(self._replica_queue_depth.values()))
             except Exception:  # noqa: BLE001
                 logger.warning('stats flush failed', exc_info=True)
 
@@ -268,6 +312,27 @@ class LoadBalancer:
     # deliberate — the LB runs as its own process on the serve
     # controller and this shape feeds `serve status` + the TTFT bench
     # directly; a Prometheus exposition can wrap lb_metrics() later.
+    # Tenant ids are client-controlled: bound the per-tenant map so an
+    # id-minting client cannot grow LB memory (or /-/metrics payloads)
+    # without limit — oldest-created entries are evicted at the cap.
+    _MAX_TENANTS = 1024
+
+    def _tenant(self, tenant: str) -> dict:  # holds: event-loop
+        rec = self._tenants.get(tenant)
+        if rec is None:
+            while len(self._tenants) >= self._MAX_TENANTS:
+                self._tenants.pop(next(iter(self._tenants)))
+            rec = self._tenants[tenant] = {
+                'total': 0, 'shed': 0,
+                'ttfts': collections.deque(maxlen=1024)}
+        return rec
+
+    def _note_ttft(self, value: float,  # holds: event-loop
+                   tenant: Optional[str]) -> None:
+        self._ttfts.append(value)
+        if tenant:
+            self._tenant(tenant)['ttfts'].append(value)
+
     def lb_metrics(self) -> Dict[str, object]:  # holds: event-loop
         ttfts = sorted(self._ttfts)
         itls = sorted(self._itls)
@@ -276,7 +341,20 @@ class LoadBalancer:
             if not vals:
                 return None
             return vals[min(len(vals) - 1, int(len(vals) * p))]
+
+        def tenant_row(rec: dict) -> dict:
+            tt = sorted(rec['ttfts'])
+            return {'requests_total': rec['total'],
+                    'requests_shed': rec['shed'],
+                    'ttft_p50_s': pct(tt, 0.50),
+                    'ttft_p99_s': pct(tt, 0.99),
+                    'ttft_samples': len(tt)}
         return {
+            'tenants': {t: tenant_row(rec)
+                        for t, rec in sorted(self._tenants.items())},
+            'engine_queue_depth': sum(
+                self._replica_queue_depth.values()),
+            'replica_queue_depth': dict(self._replica_queue_depth),
             'requests_total': self._requests_total,
             'requests_failed': self._requests_failed,
             'requests_no_replica': self._requests_no_replica,
@@ -337,7 +415,8 @@ class LoadBalancer:
 
     async def _proxy_attempt(self, request: web.Request, url: str,
                              body: bytes, headers: Dict[str, str],
-                             t_arrival: float, gen: bool = False):
+                             t_arrival: float, gen: bool = False,
+                             tenant: Optional[str] = None):
         """One proxy attempt to ``url``. Raises _PreStreamFailure when
         nothing has been sent to the client yet (retryable); any
         response it returns has been (at least partially) delivered.
@@ -426,7 +505,7 @@ class LoadBalancer:
                     now = time.monotonic()
                     if upstream_ok:
                         if first:
-                            self._ttfts.append(now - t_arrival)
+                            self._note_ttft(now - t_arrival, tenant)
                         elif is_token_stream:
                             # Gap between flushed lines = the
                             # client-observed inter-token latency.
@@ -440,7 +519,8 @@ class LoadBalancer:
                     except (ConnectionError, OSError) as e:
                         raise _ClientGone(e) from e
                 if first and upstream_ok:  # empty body: headers counted
-                    self._ttfts.append(time.monotonic() - t_arrival)
+                    self._note_ttft(time.monotonic() - t_arrival,
+                                    tenant)
                 with contextlib.suppress(ConnectionError, OSError):
                     await resp.write_eof()
                 return resp, upstream_ok
@@ -484,7 +564,7 @@ class LoadBalancer:
             return None
         now = time.monotonic()
         if splice.first:
-            self._ttfts.append(now - t_arrival)
+            self._note_ttft(now - t_arrival, splice.tenant)
             splice.first = False
         else:
             # One line late, same as the plain proxy: the terminal
@@ -671,10 +751,24 @@ class LoadBalancer:
                     if payload is not None
                     and isinstance(self.policy, lbp.CacheAwarePolicy)
                     else None)
+        # Multi-tenant identity (/generate only): the header wins, a
+        # 'tenant' body field is the fallback — and is PROMOTED to the
+        # header on the forwarded legs so the replica's scheduler sees
+        # it without re-parsing the body.
+        tenant: Optional[str] = None     # recording label (/generate)
+        if payload is not None:
+            explicit = (request.headers.get(common.TENANT_HEADER)
+                        or str(payload.get('tenant') or '') or None)
+            if explicit:
+                # Promote a body-only tenant to the header so the
+                # replica's scheduler sees it without re-parsing.
+                headers[common.TENANT_HEADER] = explicit
+            tenant = explicit or 'default'
+            self._tenant(tenant)['total'] += 1
         # Token streams are RESUMABLE: mid-stream upstream death is
         # healed by re-issuing to the next replica with the delivered
         # tokens, splicing into the same client response.
-        splice = (_StreamSplice(payload, body)
+        splice = (_StreamSplice(payload, body, tenant=tenant)
                   if payload is not None and payload.get('stream')
                   else None)
         # Per-request wall-clock budget: bounded end to end, forwarded
@@ -717,7 +811,7 @@ class LoadBalancer:
                     else:
                         resp, replica_ok = await self._proxy_attempt(
                             request, current, body, headers, t_arrival,
-                            gen=payload is not None)
+                            gen=payload is not None, tenant=tenant)
                     # Mid-stream death / a 5xx answer is delivered
                     # (can't retry) but it is still a replica failure —
                     # it must feed the breaker, not reset it.
@@ -809,6 +903,8 @@ class LoadBalancer:
                 # Every replica shed: relay the last 429/503 — headers
                 # intact — so the client backs off instead of hammering.
                 self._requests_shed += 1
+                if tenant is not None:
+                    self._tenant(tenant)['shed'] += 1
                 return web.Response(
                     status=saturated.status,
                     body=saturated.body or b'',

@@ -96,7 +96,8 @@ CREATE TABLE IF NOT EXISTS lb_stats (
 CREATE TABLE IF NOT EXISTS lb_gauges (
     service_name TEXT PRIMARY KEY,
     updated_at REAL,
-    inflight INTEGER DEFAULT 0
+    inflight INTEGER DEFAULT 0,
+    queue_depth INTEGER DEFAULT 0
 );
 CREATE INDEX IF NOT EXISTS idx_replicas_service
     ON replicas (service_name);
@@ -124,6 +125,9 @@ def _db() -> db_util.Db:
              'ALTER TABLE replicas ADD COLUMN assigned_job INTEGER'),
             ('services', 'pool',
              'ALTER TABLE services ADD COLUMN pool INTEGER DEFAULT 0'),
+            ('lb_gauges', 'queue_depth',
+             'ALTER TABLE lb_gauges ADD COLUMN '
+             'queue_depth INTEGER DEFAULT 0'),
         ])
         _migrated.add(db.path)
     return db
@@ -493,6 +497,32 @@ def get_inflight(service_name: str,
     if row is None or time.time() - row['updated_at'] > max_age_s:
         return 0
     return int(row['inflight'])
+
+
+def set_queue_depth(service_name: str, queue_depth: int) -> None:
+    """Engine scheduler backlog (summed ``num_waiting`` across ready
+    replicas, polled by the LB from each replica's /metrics) — the
+    second queue signal for QueueLengthAutoscaler: requests the LB
+    already handed off but the engines have not started serving."""
+    conn = _db().conn
+    conn.execute(
+        'INSERT INTO lb_gauges (service_name, updated_at, queue_depth) '
+        'VALUES (?,?,?) ON CONFLICT(service_name) DO UPDATE SET '
+        'updated_at=excluded.updated_at, '
+        'queue_depth=excluded.queue_depth',
+        (service_name, time.time(), queue_depth))
+    conn.commit()
+
+
+def get_queue_depth(service_name: str,
+                    max_age_s: float = 30.0) -> int:
+    """Latest engine-backlog gauge; 0 when stale."""
+    row = _db().conn.execute(
+        'SELECT queue_depth, updated_at FROM lb_gauges WHERE '
+        'service_name = ?', (service_name,)).fetchone()
+    if row is None or time.time() - row['updated_at'] > max_age_s:
+        return 0
+    return int(row['queue_depth'] or 0)
 
 
 def prune_stats(service_name: str, older_than: float) -> None:

@@ -15,6 +15,12 @@ DEFAULT_API_PORT = 46580
 # queued or decoding requests past it. Lives here (not in serve/ or
 # infer/) so the LB never has to import the jax-heavy infer stack.
 DEADLINE_HEADER = 'X-SkyTpu-Deadline-S'
+# Multi-tenant identity on /generate, propagated serve LB → infer
+# server → engine scheduler (docs/serving.md "Engine scheduler"): the
+# unit of weighted fair queueing, per-tenant admission quotas, and the
+# per-tenant metric breakdown. Absent header = the 'default' tenant.
+# Same placement rationale as DEADLINE_HEADER.
+TENANT_HEADER = 'X-SkyTpu-Tenant'
 
 
 def base_dir() -> str:

@@ -1,0 +1,333 @@
+"""Replayable trace-driven load generator (the fairness harness).
+
+Synthesizes SEEDED, fully deterministic request traces with the shapes
+production traffic actually has — bursty arrivals, heavy-tail prompt
+lengths, shared-prefix cohorts, per-tenant mixes, mid-stream
+disconnects — and replays them either directly against an
+``InferenceEngine`` (the tier-1 starvation gates in
+``test_scheduler_fairness.py``) or over HTTP through the serve LB
+(``bench_ttft --sweep tenants``).
+
+Determinism contract: ``synthesize(seed=s, ...)`` returns an
+identical event list for identical arguments (one ``random.Random(s)``
+drives every draw), and a replay submits those events in a fixed
+order (arrival time, then index). Wall-clock latencies naturally vary
+run to run; the *workload* never does.
+
+Trace-file format (JSONL): line 1 is a header object
+``{"sky_tpu_trace": 1, ...meta}``; each further line is one event —
+``{"t": seconds, "tenant": str, "tokens": [ids], "max_new_tokens": n,
+"cohort": str|null, "disconnect_after": n|null,
+"deadline_s": s|null}`` — sorted by ``t``. ``save_trace`` /
+``load_trace`` round-trip exactly.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import json
+import random
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    t: float                 # arrival offset from trace start, seconds
+    tenant: str
+    tokens: List[int]        # prompt token ids
+    max_new_tokens: int
+    cohort: Optional[str] = None          # shared-prefix cohort label
+    disconnect_after: Optional[int] = None  # hang up after N tokens
+    deadline_s: Optional[float] = None    # per-request budget
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> 'TraceEvent':
+        return cls(t=float(d['t']), tenant=str(d['tenant']),
+                   tokens=[int(x) for x in d['tokens']],
+                   max_new_tokens=int(d['max_new_tokens']),
+                   cohort=d.get('cohort'),
+                   disconnect_after=d.get('disconnect_after'),
+                   deadline_s=d.get('deadline_s'))
+
+
+def _block(rng: random.Random, n: int) -> List[int]:
+    """n token ids in [2, 201] — inside every model's vocab (the same
+    id range bench_ttft uses)."""
+    return [2 + rng.randrange(200) for _ in range(n)]
+
+
+def synthesize(seed: int, tenants: Dict[str, Dict[str, Any]],
+               duration_s: float = 2.0) -> List[TraceEvent]:
+    """Build a deterministic trace. Per-tenant spec keys (all
+    optional but ``rps``):
+
+    - ``rps``: mean request rate (arrivals are bursty, not uniform)
+    - ``burst``: requests per arrival burst (default 1)
+    - ``prompt_mean`` / ``prompt_max``: heavy-tail (bounded Pareto)
+      prompt lengths (defaults 16 / 64)
+    - ``max_new``: decode budget per request (default 8)
+    - ``shared_prefix_frac``: fraction of requests opening with one of
+      the tenant's two cohort prefix blocks (default 0.0)
+    - ``prefix_tokens``: cohort block length (default 32)
+    - ``disconnect_frac``: fraction that hang up mid-stream, after
+      roughly half their decode budget (default 0.0)
+    - ``deadline_s``: per-request budget stamped on every event
+      (default None)
+    - ``start`` / ``until``: active window inside the trace
+      (defaults 0 / duration_s)
+    """
+    events: List[TraceEvent] = []
+    for name in sorted(tenants):
+        spec = tenants[name]
+        # One PRNG per (seed, tenant): adding a tenant to the mix
+        # never perturbs another tenant's arrivals.
+        rng = random.Random(f'{seed}/{name}')
+        rps = float(spec['rps'])
+        burst = max(1, int(spec.get('burst', 1)))
+        prompt_mean = int(spec.get('prompt_mean', 16))
+        prompt_max = int(spec.get('prompt_max', 64))
+        max_new = int(spec.get('max_new', 8))
+        shared_frac = float(spec.get('shared_prefix_frac', 0.0))
+        prefix_tokens = int(spec.get('prefix_tokens', 32))
+        disconnect_frac = float(spec.get('disconnect_frac', 0.0))
+        deadline_s = spec.get('deadline_s')
+        start = float(spec.get('start', 0.0))
+        until = float(spec.get('until', duration_s))
+        cohorts = [(f'{name}/c{i}',
+                    _block(random.Random(f'{seed}/{name}/cohort{i}'),
+                           prefix_tokens))
+                   for i in range(2)]
+        t = start
+        while t < until:
+            for b in range(burst):
+                n = max(1, min(prompt_max,
+                               int(prompt_mean
+                                   * rng.paretovariate(2.0) / 2)))
+                cohort = None
+                prefix: List[int] = []
+                if shared_frac and rng.random() < shared_frac:
+                    cohort, prefix = cohorts[rng.randrange(
+                        len(cohorts))]
+                tail = _block(rng, n)
+                disconnect = None
+                if disconnect_frac and rng.random() < disconnect_frac:
+                    disconnect = max(1, max_new // 2)
+                events.append(TraceEvent(
+                    t=round(t + b * 1e-4, 6), tenant=name,
+                    tokens=prefix + tail, max_new_tokens=max_new,
+                    cohort=cohort, disconnect_after=disconnect,
+                    deadline_s=deadline_s))
+            # Bursty inter-arrival: exponential gaps between bursts at
+            # the burst rate, so the mean request rate stays ~rps.
+            t += rng.expovariate(rps / burst)
+    events.sort(key=lambda e: e.t)
+    return events
+
+
+def save_trace(events: List[TraceEvent], path: str,
+               meta: Optional[Dict[str, Any]] = None) -> str:
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(json.dumps({'sky_tpu_trace': 1, **(meta or {})})
+                + '\n')
+        for ev in events:
+            f.write(json.dumps(ev.to_json()) + '\n')
+    return path
+
+
+def load_trace(path: str
+               ) -> Tuple[List[TraceEvent], Dict[str, Any]]:
+    with open(path, encoding='utf-8') as f:
+        header = json.loads(f.readline())
+        if header.get('sky_tpu_trace') != 1:
+            raise ValueError(f'{path}: not a sky-tpu trace file '
+                             f'(missing header line)')
+        events = [TraceEvent.from_json(json.loads(line))
+                  for line in f if line.strip()]
+    return events, header
+
+
+# ---- replay: directly against an engine ------------------------------------
+def replay_on_engine(events: List[TraceEvent], engine,
+                     speed: float = 1.0) -> List[Dict[str, Any]]:
+    """Drive ``engine.step()`` while submitting the trace's arrivals
+    at their (speed-scaled) offsets from the caller's thread — the
+    single-threaded analogue of the production server loop. Returns
+    one record per event: ``tenant``, ``shed`` (admission 429),
+    ``ttft``/``queue_wait`` (seconds, None when shed/never-started),
+    ``steps_waited`` (decode steps between submit and first token — a
+    machine-speed-independent fairness measure), ``finish_reason`` and
+    ``tokens``."""
+    from skypilot_tpu.infer import engine as engine_lib
+
+    records: List[Dict[str, Any]] = []
+    live: List[Tuple[TraceEvent, Any, Dict[str, Any]]] = []
+    t0 = time.perf_counter()
+    i = 0
+    while True:
+        now = (time.perf_counter() - t0) * speed
+        while i < len(events) and events[i].t <= now:
+            ev = events[i]
+            i += 1
+            rec: Dict[str, Any] = {
+                'tenant': ev.tenant, 'shed': False, 'ttft': None,
+                'queue_wait': None, 'steps_waited': None,
+                'finish_reason': None, 'tokens': 0}
+            records.append(rec)
+            deadline = (time.time() + ev.deadline_s
+                        if ev.deadline_s is not None else None)
+            try:
+                req = engine.submit(ev.tokens,
+                                    max_new_tokens=ev.max_new_tokens,
+                                    deadline=deadline,
+                                    tenant=ev.tenant)
+            except engine_lib.AdmissionError:
+                rec['shed'] = True
+                rec['finish_reason'] = 'shed'
+                continue
+            rec['_steps_at_submit'] = engine.metrics()['decode_steps']
+            live.append((ev, req, rec))
+        done_now = []
+        for ev, req, rec in live:
+            if rec['steps_waited'] is None and req.output_tokens:
+                rec['steps_waited'] = (
+                    engine.metrics()['decode_steps']
+                    - rec.pop('_steps_at_submit'))
+            if (ev.disconnect_after is not None and not req.done
+                    and len(req.output_tokens) >= ev.disconnect_after):
+                engine.cancel(req)
+            if req.done:
+                rec['ttft'] = req.ttft
+                rec['queue_wait'] = req.queue_wait
+                rec['finish_reason'] = req.finish_reason
+                rec['tokens'] = len(req.output_tokens)
+                rec.pop('_steps_at_submit', None)
+                done_now.append((ev, req, rec))
+        for item in done_now:
+            live.remove(item)
+        if i >= len(events) and not live and engine.idle():
+            break
+        if engine.idle() and i < len(events):
+            # Nothing to do until the next arrival: advance the clock
+            # without spinning (the trace drives a real wall clock).
+            time.sleep(min(0.002,
+                           max(0.0, events[i].t - now) / speed))
+        engine.step()
+    return records
+
+
+# ---- replay: over HTTP through the serve LB --------------------------------
+def _http_one(gen_url: str, ev: TraceEvent, tenant_header: str,
+              timeout: float) -> Dict[str, Any]:
+    rec: Dict[str, Any] = {
+        'tenant': ev.tenant, 'shed': False, 'ttft': None,
+        'queue_wait': None, 'itls': [], 'finish_reason': None,
+        'tokens': 0, 'completed': False}
+    payload = {'tokens': ev.tokens,
+               'max_new_tokens': ev.max_new_tokens, 'stream': True}
+    req = urllib.request.Request(
+        gen_url, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json',
+                 tenant_header: ev.tenant})
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            t_prev = None
+            for line in iter(r.readline, b''):
+                now = time.perf_counter()
+                if not line.strip():
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                toks = obj.get('tokens') or []
+                if toks:
+                    if rec['ttft'] is None:
+                        rec['ttft'] = now - t0
+                    elif t_prev is not None:
+                        rec['itls'].extend(
+                            [(now - t_prev) / len(toks)] * len(toks))
+                    t_prev = now
+                    rec['tokens'] += len(toks)
+                if obj.get('done'):
+                    rec['completed'] = True
+                    rec['finish_reason'] = obj.get('finish_reason')
+                    rec['queue_wait'] = obj.get('queue_wait_s')
+                    break
+                if (ev.disconnect_after is not None
+                        and rec['tokens'] >= ev.disconnect_after):
+                    rec['finish_reason'] = 'client_disconnect'
+                    break   # closing the response = the hang-up
+    except urllib.error.HTTPError as e:
+        if e.code == 429:
+            rec['shed'] = True
+            rec['finish_reason'] = 'shed'
+        else:
+            rec['finish_reason'] = f'http_{e.code}'
+    except Exception as e:  # noqa: BLE001 — a dead stream is data here
+        rec['finish_reason'] = f'error_{type(e).__name__}'
+    return rec
+
+
+def replay_over_http(events: List[TraceEvent], gen_url: str,
+                     tenant_header: str = 'X-SkyTpu-Tenant',
+                     speed: float = 1.0, timeout: float = 300.0,
+                     max_workers: int = 64) -> List[Dict[str, Any]]:
+    """Replay a trace through a live /generate endpoint (the serve LB
+    in ``bench_ttft --sweep tenants``): each event fires at its
+    speed-scaled offset on a worker thread, streams its response, and
+    reports client-observed TTFT/ITL, the done-line ``queue_wait_s``,
+    and shed/disconnect outcomes."""
+    t0 = time.perf_counter()
+
+    def run(ev: TraceEvent) -> Dict[str, Any]:
+        delay = ev.t / speed - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        return _http_one(gen_url, ev, tenant_header, timeout)
+
+    workers = min(max_workers, max(1, len(events)))
+    with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+        return list(pool.map(run, events))
+
+
+def tenant_summary(records: List[Dict[str, Any]]
+                   ) -> Dict[str, Dict[str, Any]]:
+    """Per-tenant rollup of replay records: issued/shed counts plus
+    TTFT, ITL and queue-wait percentiles (seconds; ITL in ms)."""
+    def pct(vals: List[float], p: float) -> Optional[float]:
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(len(vals) * p))]
+
+    out: Dict[str, Dict[str, Any]] = {}
+    for tenant in sorted({r['tenant'] for r in records}):
+        rs = [r for r in records if r['tenant'] == tenant]
+        ttfts = [r['ttft'] for r in rs if r['ttft'] is not None]
+        waits = [r['queue_wait'] for r in rs
+                 if r.get('queue_wait') is not None]
+        itls = [x for r in rs for x in r.get('itls', [])]
+        shed = sum(1 for r in rs if r['shed'])
+        out[tenant] = {
+            'issued': len(rs),
+            'shed': shed,
+            'shed_rate': round(shed / len(rs), 4),
+            'ttft_p50_s': pct(ttfts, 0.50),
+            'ttft_p99_s': pct(ttfts, 0.99),
+            'queue_wait_p50_ms': (
+                round(pct(waits, 0.50) * 1e3, 3) if waits else None),
+            'queue_wait_p99_ms': (
+                round(pct(waits, 0.99) * 1e3, 3) if waits else None),
+            'itl_p50_ms': (round(pct(itls, 0.50) * 1e3, 3)
+                           if itls else None),
+            'itl_p99_ms': (round(pct(itls, 0.99) * 1e3, 3)
+                           if itls else None),
+        }
+    return out

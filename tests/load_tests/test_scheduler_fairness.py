@@ -1,0 +1,167 @@
+"""Tier-1 fairness gates for the pluggable engine scheduler.
+
+The motivating production failure (ROADMAP "multi-tenant fairness"):
+one tenant's burst starves — or outright 429s — everyone else under
+FCFS. The starvation gate replays a SEEDED 10:1 aggressor/victim trace
+(tests/load_tests/loadgen.py) against the same engine under ``wfq``
+and ``fcfs`` and asserts the bound the wfq policy exists to provide:
+
+- under ``wfq`` (victim weighted 2:1, the --tenant-weights knob) the
+  victim's p99 TTFT stays within 3x of its ISOLATED-run value and its
+  shed rate is exactly 0 — per-tenant quotas shed the aggressor only;
+- under ``fcfs`` the SAME trace violates that bound (victim sheds
+  and/or its p99 blows past 3x) — asserted as the motivating
+  counterexample, not assumed.
+
+Plus the harness contracts: trace synthesis is deterministic for a
+fixed seed, the JSONL trace-file format round-trips exactly, and
+mid-stream disconnects in a trace cancel their requests (freeing
+slots) when replayed on an engine.
+"""
+import pytest
+
+pytestmark = pytest.mark.jax
+
+import jax  # noqa: E402
+
+from skypilot_tpu.infer import engine as engine_lib  # noqa: E402
+from skypilot_tpu.models import llama  # noqa: E402
+from tests.load_tests import loadgen  # noqa: E402
+
+CFG = llama.LlamaConfig.tiny()
+
+SEED = 7
+# Victim: a light, bursty tenant (6-request waves on a 2-slot engine,
+# so even its ISOLATED p99 includes genuine self-queueing — the
+# honest baseline for the 3x bound).
+VICTIM = {'victim': {'rps': 8.0, 'burst': 6, 'prompt_mean': 8,
+                     'prompt_max': 12, 'max_new': 12,
+                     'start': 0.3, 'until': 1.0}}
+# Aggressor: ~10:1 the victim's request volume (and far beyond the
+# engine's capacity — the admission bound stays saturated), short
+# decodes so slots keep turning over.
+AGGRESSOR = {'aggressor': {'rps': 600.0, 'burst': 30,
+                           'prompt_mean': 12, 'prompt_max': 16,
+                           'max_new': 6, 'until': 1.2}}
+
+
+@pytest.fixture(scope='module')
+def engine():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    eng = engine_lib.InferenceEngine(
+        CFG, params,
+        engine_lib.EngineConfig(n_slots=2, max_seq_len=64,
+                                prefill_buckets=(8, 16),
+                                prefill_chunk=16,
+                                max_queue_requests=16))
+    # Compile both prefill buckets + decode off the clock.
+    eng.generate([[3] * 12, [4] * 6], max_new_tokens=2)
+    return eng
+
+
+def test_trace_synthesis_deterministic():
+    spec = {**VICTIM, **AGGRESSOR}
+    a = loadgen.synthesize(SEED, spec, duration_s=1.5)
+    b = loadgen.synthesize(SEED, spec, duration_s=1.5)
+    assert a == b, 'same seed must replay the same trace'
+    c = loadgen.synthesize(SEED + 1, spec, duration_s=1.5)
+    assert a != c, 'different seeds must differ'
+    # Adding a tenant never perturbs another tenant's arrivals (each
+    # tenant draws from its own (seed, tenant) PRNG).
+    alone = [e for e in loadgen.synthesize(SEED, VICTIM,
+                                           duration_s=1.5)]
+    mixed = [e for e in a if e.tenant == 'victim']
+    assert alone == mixed
+
+
+def test_trace_file_roundtrip(tmp_path):
+    events = loadgen.synthesize(
+        SEED, {'t0': {'rps': 20, 'shared_prefix_frac': 0.5,
+                      'disconnect_frac': 0.3, 'deadline_s': 9.0}},
+        duration_s=0.5)
+    assert events, 'empty trace would gate nothing'
+    path = loadgen.save_trace(events, str(tmp_path / 'trace.jsonl'),
+                              meta={'seed': SEED})
+    loaded, header = loadgen.load_trace(path)
+    assert loaded == events
+    assert header['seed'] == SEED
+    # The spec knobs actually produced their shapes.
+    assert any(e.cohort for e in events), 'no shared-prefix cohort'
+    assert any(e.disconnect_after for e in events), 'no disconnects'
+    assert all(e.deadline_s == 9.0 for e in events)
+    cohorts = {e.cohort: tuple(e.tokens[:32]) for e in events
+               if e.cohort}
+    for e in events:
+        if e.cohort:
+            assert tuple(e.tokens[:32]) == cohorts[e.cohort], (
+                'cohort members must share their prefix block')
+
+
+def test_starvation_gate_wfq_vs_fcfs(engine):
+    """The seeded 10:1 aggressor/victim trace: wfq holds the victim's
+    p99 TTFT within 3x of its isolated run with zero victim sheds;
+    fcfs on the same trace violates that bound."""
+    trace_iso = loadgen.synthesize(SEED, VICTIM, duration_s=1.5)
+    trace_mix = loadgen.synthesize(SEED, {**VICTIM, **AGGRESSOR},
+                                   duration_s=1.5)
+    n_victim = sum(1 for e in trace_mix if e.tenant == 'victim')
+    n_aggr = len(trace_mix) - n_victim
+    assert n_aggr >= 10 * n_victim, (
+        f'trace lost its 10:1 shape ({n_aggr} vs {n_victim})')
+
+    def run(policy, trace, weights=None):
+        engine.set_scheduler(policy, tenant_weights=weights)
+        records = loadgen.replay_on_engine(trace, engine)
+        assert engine.idle()
+        return loadgen.tenant_summary(records)
+
+    iso = run('fcfs', trace_iso)['victim']
+    assert iso['shed'] == 0 and iso['ttft_p99_s'] is not None
+    wfq = run('wfq', trace_mix,
+              weights={'victim': 2.0, 'aggressor': 1.0})
+    fcfs = run('fcfs', trace_mix)
+
+    # The wfq bound: no victim shed, p99 within 3x of isolated.
+    assert wfq['victim']['shed'] == 0, (
+        f"wfq shed the victim: {wfq['victim']}")
+    assert wfq['victim']['ttft_p99_s'] <= 3 * iso['ttft_p99_s'], (
+        f"victim p99 {wfq['victim']['ttft_p99_s']:.4f}s under wfq "
+        f"blew past 3x its isolated {iso['ttft_p99_s']:.4f}s")
+    # The quotas actually bit: the aggressor (10x over its share) is
+    # the tenant that got shed.
+    assert wfq['aggressor']['shed'] > 0, (
+        'the aggressor never shed — the trace is not saturating the '
+        'admission bound, the gate is vacuous')
+
+    # The motivating counterexample: fcfs on the SAME trace breaks
+    # the bound — victim sheds (the "one burst 429s everyone"
+    # failure) and/or victim p99 blows past 3x.
+    fcfs_p99 = fcfs['victim']['ttft_p99_s']
+    fcfs_holds = (fcfs['victim']['shed'] == 0
+                  and fcfs_p99 is not None
+                  and fcfs_p99 <= 3 * iso['ttft_p99_s'])
+    assert not fcfs_holds, (
+        f'fcfs unexpectedly met the fairness bound '
+        f'(victim {fcfs["victim"]}) — the counterexample is gone; '
+        f'make the aggressor heavier')
+
+
+def test_replay_disconnects_cancel_requests(engine):
+    """Traced mid-stream disconnects cancel their engine requests:
+    slots free early and the per-tenant cancel counters move."""
+    engine.set_scheduler('fcfs')
+    events = loadgen.synthesize(
+        SEED, {'flaky': {'rps': 30, 'prompt_mean': 6, 'prompt_max': 8,
+                         'max_new': 24, 'disconnect_frac': 1.0,
+                         'until': 0.3}},
+        duration_s=0.4)
+    assert all(e.disconnect_after for e in events)
+    records = loadgen.replay_on_engine(events, engine)
+    assert engine.idle()
+    cancelled = [r for r in records
+                 if r['finish_reason'] == 'cancelled']
+    assert cancelled, 'no replayed disconnect ever cancelled'
+    assert all(r['tokens'] < 24 for r in cancelled), (
+        'cancelled streams must not run to their full budget')
+    tenants = engine.metrics()['tenants']
+    assert tenants['flaky']['requests_cancelled'] >= len(cancelled)

@@ -419,6 +419,32 @@ def test_queue_length_autoscaler_ticks():
     assert scaler.evaluate(3, now=t1 + 21).target_num_replicas == 1
 
 
+def test_queue_length_autoscaler_counts_engine_backlog():
+    """The signal is LB in-flight PLUS the engines' scheduler backlog
+    (the LB-polled num_waiting gauge): queued-in-engine work weighs
+    double against the threshold by design — batching absorbs
+    concurrency, not backlog — and the gauge falls back to plain
+    in-flight when replicas expose no engine metrics."""
+    name = 'qb-svc'
+    pol = spec_lib.ReplicaPolicy(
+        min_replicas=1, max_replicas=4, queue_length_threshold=3.0,
+        upscale_delay_seconds=1.0, downscale_delay_seconds=1000.0)
+    scaler = autoscalers.QueueLengthAutoscaler(name, pol)
+    t0 = time.time()
+    # In-flight alone is under threshold...
+    serve_state.set_inflight(name, 1)
+    serve_state.set_queue_depth(name, 0)
+    scaler.evaluate(1, now=t0)
+    assert scaler.evaluate(1, now=t0 + 2).target_num_replicas == 1
+    # ...but the engine backlog pushes the combined signal over.
+    serve_state.set_queue_depth(name, 7)
+    d = scaler.evaluate(1, now=t0 + 3)
+    d = scaler.evaluate(1, now=t0 + 5)
+    assert d.target_num_replicas == 2
+    assert 'queue=8' in d.reason
+    assert serve_state.get_queue_depth(name) == 7
+
+
 def test_queue_length_autoscaler_never_zero_with_queue():
     name = 'q0-svc'
     pol = spec_lib.ReplicaPolicy(

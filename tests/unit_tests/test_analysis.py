@@ -615,10 +615,14 @@ def test_guarded_by_registries_declared():
     that class)."""
     from skypilot_tpu.infer import engine as engine_lib
     from skypilot_tpu.infer import paged_cache
+    from skypilot_tpu.infer.sched import base as sched_base
+    from skypilot_tpu.infer.sched import wfq as sched_wfq
     from skypilot_tpu.serve import load_balancer
-    assert '_waiting' in engine_lib.InferenceEngine._GUARDED_BY
+    assert '_sched' in engine_lib.InferenceEngine._GUARDED_BY
     assert '_free' in paged_cache.PageAllocator._GUARDED_BY
     assert '_ttfts' in load_balancer.LoadBalancer._GUARDED_BY
+    assert '_queue' in sched_base.Scheduler._GUARDED_BY
+    assert '_deficit' in sched_wfq.WFQScheduler._GUARDED_BY
 
 
 def test_report_json_roundtrip(tmp_path):
