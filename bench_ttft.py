@@ -292,6 +292,89 @@ def _tenant_level(gen_url: str, lb_metrics_url: str, level: int,
     }
 
 
+def _collect_tokens(gen_url: str, payload: dict,
+                    timeout: float = 300.0) -> list:
+    """One streamed request, returning the full token id list — the
+    bench-side bit-identity probe for the speculative sweep."""
+    payload = {'stream': True, **payload}
+    req = urllib.request.Request(
+        gen_url, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    tokens = []
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        for line in iter(r.readline, b''):
+            if not line.strip():
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            tokens.extend(obj.get('tokens') or [])
+    return tokens
+
+
+def _speculative_level(gen_url: str, metrics_url: str,
+                       concurrency: int, n_requests: int,
+                       spec_k: int, max_new: int = 32,
+                       uniq_base: int = 0) -> dict:
+    """One concurrency level of the speculative sweep: the SAME
+    template-heavy workload with per-request speculation off (plain
+    decode steps — the honest baseline: the engine dispatches the
+    decode program when nobody drafts) vs on, with the replica's spec
+    counters sampled around the on pass so accepted_len_mean /
+    spec_accept_rate / tokens_per_step are windowed to it. Prompts are
+    a shared template block plus a short unique tail — the
+    template/repetition shape prompt-lookup drafting exists for."""
+    template = _block(9973, 12) * 4
+
+    def payload(i: int, spec: bool) -> dict:
+        return {'tokens': template + _block(uniq_base + 31 + i, 6),
+                'max_new_tokens': max_new, 'spec': spec}
+
+    off = _sweep_level(gen_url, concurrency, n_requests,
+                       payload_for=lambda i: payload(i, False))
+    m0 = _get(metrics_url)
+    on = _sweep_level(
+        gen_url, concurrency, n_requests,
+        payload_for=lambda i: payload(i + n_requests, True))
+    m1 = _get(metrics_url)
+
+    def delta(key: str) -> float:
+        return (m1.get(key) or 0) - (m0.get(key) or 0)
+
+    lanes = delta('spec_slot_steps')
+    drafted = delta('spec_drafted_tokens')
+    steps = delta('decode_steps')
+    # Greedy outputs must not drift: same payload through both lanes.
+    probe = payload(10**9, False)
+    identical = (_collect_tokens(gen_url, probe)
+                 == _collect_tokens(gen_url, {**probe, 'spec': True}))
+    out = {
+        'concurrency': concurrency,
+        'samples': off['samples'] + on['samples'],
+        'spec_k': spec_k,
+        'spec_off': off,
+        'spec_on': on,
+        'accepted_len_mean': (round(
+            delta('spec_emitted_tokens') / lanes, 4) if lanes
+            else None),
+        'spec_accept_rate': (round(
+            delta('spec_accepted_tokens') / drafted, 4) if drafted
+            else None),
+        'tokens_per_step': (round(delta('decode_tokens') / steps, 4)
+                            if steps else None),
+        'bit_identical': identical,
+    }
+    if on['itl_p50_ms'] and off['itl_p50_ms']:
+        # >1 = speculation CUT inter-token latency by that factor.
+        out['itl_improvement_x'] = round(
+            off['itl_p50_ms'] / on['itl_p50_ms'], 3)
+    if on['ttft_p50_s'] and off['ttft_p50_s']:
+        out['ttft_ratio_on_over_off'] = round(
+            on['ttft_p50_s'] / off['ttft_p50_s'], 3)
+    return out
+
+
 def _chaos_request(gen_url: str, payload, max_new_tokens: int = 32,
                    timeout: float = 300.0) -> dict:
     """One streamed request under chaos: wall duration, the done-line's
@@ -380,7 +463,8 @@ def main() -> None:
     parser.add_argument('--n-pages', type=int, default=None)
     parser.add_argument('--sweep', default='concurrency',
                         choices=['concurrency', 'shared-prefix',
-                                 'chaos-resume', 'tenants'],
+                                 'chaos-resume', 'tenants',
+                                 'speculative'],
                         help="'shared-prefix': the shared-system-"
                              'prompt workload (implies --paged '
                              '--prefix-cache) — per level, a cold '
@@ -402,7 +486,26 @@ def main() -> None:
                              'emitting per-tenant ttft_p50/p99, '
                              'itl_p50/p99 and shed_rate per level '
                              '(pair with --scheduler wfq vs fcfs to '
-                             'see the isolation win)')
+                             "see the isolation win). 'speculative': "
+                             'self-speculative decoding on a '
+                             'template-heavy workload — per level, a '
+                             'spec-off pass (per-request opt-out; '
+                             'plain decode steps) vs a spec-on pass, '
+                             'emitting accepted_len_mean, '
+                             'spec_accept_rate, tokens_per_step, the '
+                             'itl_improvement_x ratio and a '
+                             'bit-identity probe into the json '
+                             '(defaults --spec-k 6).')
+    parser.add_argument('--spec-k', type=int, default=0,
+                        help='speculative draft width for the replica '
+                             '(0 = off; --sweep speculative defaults '
+                             'it to 6)')
+    parser.add_argument('--spec-ngram', type=int, default=3,
+                        help='drafter n-gram width (forwarded)')
+    parser.add_argument('--spec-max-new', type=int, default=64,
+                        help='speculative sweep: tokens generated per '
+                             'request (longer runs amortize the '
+                             'drafting warm-up)')
     parser.add_argument('--scheduler', default=None,
                         choices=['fcfs', 'deadline', 'wfq'],
                         help='engine scheduling policy for the '
@@ -456,6 +559,8 @@ def main() -> None:
         args.max_seq_len = 256
     if args.sweep == 'tenants' and args.scheduler is None:
         args.scheduler = 'wfq'
+    if args.sweep == 'speculative' and not args.spec_k:
+        args.spec_k = 6
     if args.prefix_cache and not args.paged:
         raise SystemExit('--prefix-cache requires --paged')
 
@@ -504,6 +609,9 @@ def main() -> None:
             cmd += ['--n-pages', str(args.n_pages)]
     if args.prefix_cache:
         cmd.append('--prefix-cache')
+    if args.spec_k:
+        cmd += ['--spec-k', str(args.spec_k),
+                '--spec-ngram', str(args.spec_ngram)]
     if args.scheduler:
         cmd += ['--scheduler', args.scheduler]
     if args.tenant_weights:
@@ -634,6 +742,27 @@ def main() -> None:
                         gen_url, lb_metrics_url, conc,
                         args.trace_seed, args.trace_duration,
                         trace_path=args.trace))
+            elif args.sweep == 'speculative':
+                # Warm both programs (decode AND verify) off the
+                # clock: one spec-off mini-pass, one spec-on.
+                _sweep_level(
+                    gen_url, max(args.concurrency), args.slots,
+                    payload_for=lambda i: {
+                        'tokens': _block(777 + i, 54),
+                        'max_new_tokens': args.spec_max_new,
+                        'spec': False})
+                _sweep_level(
+                    gen_url, max(args.concurrency), args.slots,
+                    payload_for=lambda i: {
+                        'tokens': _block(8777 + i, 54),
+                        'max_new_tokens': args.spec_max_new,
+                        'spec': True})
+                for li, conc in enumerate(args.concurrency):
+                    sweep.append(_speculative_level(
+                        gen_url, metrics_url, conc,
+                        args.requests_per_level, args.spec_k,
+                        max_new=args.spec_max_new,
+                        uniq_base=(li + 1) * 1_000_000))
             else:
                 # Warm every concurrency level's batch shapes off the
                 # clock.
@@ -703,6 +832,23 @@ def main() -> None:
             'scheduler': args.scheduler,
             'trace_seed': args.trace_seed,
         }
+    elif args.sweep == 'speculative':
+        head = {
+            'metric': 'speculative_itl_improvement_x',
+            'value': base.get('itl_improvement_x'),
+            'unit': 'x (spec-off itl p50 / spec-on itl p50, same '
+                    'template-heavy workload)',
+            'accepted_len_mean': base.get('accepted_len_mean'),
+            'spec_accept_rate': base.get('spec_accept_rate'),
+            'tokens_per_step': base.get('tokens_per_step'),
+            'spec_on_itl_p50_ms': (base.get('spec_on') or {}).get(
+                'itl_p50_ms'),
+            'spec_off_itl_p50_ms': (base.get('spec_off') or {}).get(
+                'itl_p50_ms'),
+            'bit_identical': all(
+                lv.get('bit_identical') for lv in sweep),
+            'spec_k': args.spec_k,
+        }
     else:
         head = {
             'metric': 'serve_ttft_warm_p50_s',
@@ -729,6 +875,8 @@ def main() -> None:
         **({'page_size': args.page_size,
             'long_prompt_tokens': args.long_prompt_tokens}
            if args.paged or args.long_prompt_tokens else {}),
+        **({'spec_k': args.spec_k, 'spec_ngram': args.spec_ngram}
+           if args.spec_k else {}),
         'tokenizer': ('bpe-8k' if tokenizer else 'bytes'),
         'device': jax.devices()[0].device_kind,
         'path': ('client -> serve LB -> continuous-batching engine '

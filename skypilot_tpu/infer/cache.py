@@ -64,6 +64,41 @@ def append_token(cache_k_layer: jnp.ndarray, cache_v_layer: jnp.ndarray,
     return k, v
 
 
+def append_run(cache_k_layer: jnp.ndarray, cache_v_layer: jnp.ndarray,
+               k_new: jnp.ndarray, v_new: jnp.ndarray,
+               positions: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Append a RUN of R tokens' K/V per slot at positions
+    ``positions[slot] + i`` (the speculative-verify write: the input
+    token plus up to R-1 padded draft candidates land in one step).
+
+    cache_*_layer: [slots, S, kv, hd]; k_new/v_new: [slots, R, kv, hd];
+    positions: [slots] int32 run starts. Positions past the cache end
+    (a draft run padded beyond a near-full slot, or an inactive slot's
+    garbage lane) are clamped and REWRITE THE VALUE ALREADY THERE — a
+    run-shaped ``dynamic_update_slice`` would instead clamp the start
+    and shift the whole run over live positions. The per-position
+    writes are sequential (chained functional updates), so a guarded
+    rewrite always reads the latest value.
+    """
+    slots = cache_k_layer.shape[0]
+    S = cache_k_layer.shape[1]
+    R = k_new.shape[1]
+    rows = jnp.arange(slots)
+    for i in range(R):
+        pos = jnp.minimum(positions + i, S - 1)
+        valid = (positions + i) < S                     # [slots]
+        old_k = cache_k_layer[rows, pos]                # [slots, kv, hd]
+        old_v = cache_v_layer[rows, pos]
+        ki = jnp.where(valid[:, None, None],
+                       k_new[:, i].astype(cache_k_layer.dtype), old_k)
+        vi = jnp.where(valid[:, None, None],
+                       v_new[:, i].astype(cache_v_layer.dtype), old_v)
+        cache_k_layer = cache_k_layer.at[rows, pos].set(ki)
+        cache_v_layer = cache_v_layer.at[rows, pos].set(vi)
+    return cache_k_layer, cache_v_layer
+
+
 def free_slot(cache: KVCache, slot: int) -> KVCache:
     """Mark a slot reusable. K/V bytes are left in place — lengths=0
     makes them unreachable, so no memset traffic on the hot path."""

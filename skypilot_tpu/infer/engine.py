@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.infer import cache as cache_lib
+from skypilot_tpu.infer import drafter as drafter_lib
 from skypilot_tpu.infer import model as model_lib
 from skypilot_tpu.infer import paged_cache as paged_cache_lib
 from skypilot_tpu.infer import prefix_cache as prefix_cache_lib
@@ -124,6 +125,25 @@ class EngineConfig:
     # per-tenant quotas by weight.
     max_queue_requests: Optional[int] = None
     max_queue_tokens: Optional[int] = None
+    # Self-speculative decoding (docs/serving.md "Speculative
+    # decoding"): a host-side prompt-lookup drafter (infer/drafter.py)
+    # proposes up to spec_k candidate tokens per greedy slot and ONE
+    # fused `verify` program scores every candidate in a single device
+    # step (static draft length via padding + a per-slot draft_len
+    # mask, like the prefill buckets); the engine accepts the longest
+    # exact-greedy-matching prefix plus one corrected token, so a step
+    # emits 1..spec_k+1 tokens per slot while greedy outputs stay
+    # BIT-IDENTICAL to spec_k=0 (every emitted token is the model's
+    # own argmax — drafts only decide how many land per step). 0 = off
+    # (the default; sampled slots always decode token-at-a-time, and
+    # the multihost lockstep driver pins 0 — the tick spec does not
+    # carry draft tokens). The scheduler can narrow a request's draft
+    # width per step (Scheduler.spec_budget: wfq caps an over-share
+    # tenant under contention).
+    spec_k: int = 0
+    # Longest trailing n-gram the drafter matches (falls back to
+    # shorter grams down to 1).
+    spec_ngram: int = 3
     # Step-loop scheduling policy (infer/sched/, docs/serving.md
     # "Engine scheduler"): 'fcfs' (default — bit-identical to the
     # historical inline behavior), 'deadline' (EDF over wall-clock
@@ -170,6 +190,24 @@ class Request:
     # (queued → dropped before admission, active → finished
     # 'cancelled'), so device state is never touched from HTTP threads.
     cancelled: bool = False
+    # Per-request speculation opt-out (body {"spec": false}): the
+    # request is never drafted for — it emits one token per step (it
+    # may still co-ride another slot's verify dispatch as a
+    # draft_len=0 lane, which is compute-identical to decode for it) —
+    # the honest spec-off baseline lane of bench_ttft's speculative
+    # sweep (outputs are bit-identical either way; only step count
+    # differs).
+    spec: bool = True
+    # Verify-step accounting (engine thread only): steps this request
+    # rode a verify dispatch, and tokens those steps emitted for it —
+    # the per-request accepted_len_mean on the /generate done-line.
+    spec_steps: int = 0
+    spec_emitted: int = 0
+    # Prompt-lookup drafter memo (incremental n-gram index over
+    # prompt+output; engine thread only — survives slot moves and
+    # preemptions with the request).
+    draft_memo: dict = dataclasses.field(
+        default_factory=dict, repr=False, compare=False)
     # Token-event delivery: the engine notifies after every appended
     # token and on finish, so consumers (HTTP handlers, the lockstep
     # warm-up) wait on the condition instead of sleep-polling the
@@ -306,6 +344,13 @@ class InferenceEngine:
         '_expired': '_lock',
         '_cancelled': '_lock',
         '_preemptions': '_lock',
+        '_spec_k': '_lock',         # set_spec_k threads vs step loop
+        '_spec_pinned': '_lock',
+        '_spec_steps': '_lock',     # consume writes vs metrics reads
+        '_spec_slot_steps': '_lock',
+        '_spec_drafted': '_lock',
+        '_spec_accepted': '_lock',
+        '_spec_emitted': '_lock',
     }
 
     def __init__(self, config: llama.LlamaConfig, params: llama.Params,
@@ -461,6 +506,20 @@ class InferenceEngine:
         self._abandoned = 0
         self._expired = 0
         self._cancelled = 0
+        # ---- speculative decoding state ---------------------------------
+        # Runtime draft-width knob (set_spec_k); 0 = off. The lockstep
+        # driver PINS it off (pin_spec_off) — re-enabling then raises.
+        self._spec_k = max(0, int(self.ecfg.spec_k))
+        self._spec_pinned = False
+        self._drafter = drafter_lib.PromptLookupDrafter(
+            max_ngram=max(1, int(self.ecfg.spec_ngram)))
+        # Verify accounting: dispatches, (slot, step) lanes, drafted /
+        # accepted draft tokens, tokens emitted via verify consumes.
+        self._spec_steps = 0
+        self._spec_slot_steps = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_emitted = 0
         # Wall-clock sweeps (deadline / cancel) read the LOCAL clock;
         # the multihost lockstep driver disables them — every host must
         # make identical request-state decisions each tick.
@@ -486,6 +545,31 @@ class InferenceEngine:
             if out is not None and self.mesh is not None:
                 kw['out_shardings'] = out
             return jax.jit(fn, **kw)
+
+        def _accept(tokens, logits, drafts, draft_len, key, temps,
+                    active, lengths):
+            # Shared tail of both verify programs: exact-greedy draft
+            # acceptance plus the device-side state advance, FUSED with
+            # the verify forward pass so the device never waits on a
+            # host decision — lengths advance by accepted+1 and the
+            # corrected token becomes the next step's input ON DEVICE;
+            # the host reads the [spec_k+3, slots] pair back async
+            # (row 0 input echo, rows 1..spec_k+1 emitted candidates,
+            # last row the accepted count) purely for bookkeeping.
+            emitted, accepted = sampling_lib.speculative_accept(
+                logits, drafts, draft_len, key, temps,
+                top_k=self.ecfg.top_k)
+            accepted = jnp.where(active, accepted, 0)
+            next_tok = jnp.take_along_axis(
+                emitted, accepted[:, None], axis=1)[:, 0]
+            new_last = jnp.where(active, next_tok,
+                                 tokens[:, 0]).astype(tokens.dtype)
+            bump = jnp.where(active, accepted + 1, 0).astype(
+                lengths.dtype)
+            pair = jnp.concatenate(
+                [tokens[:, :1].T.astype(jnp.int32), emitted.T,
+                 accepted[None].astype(jnp.int32)], axis=0)
+            return pair, new_last, lengths + bump
 
         if self.ecfg.paged:
             def _prefill_chunk_paged(kv_cache, params, slot, table_row,
@@ -514,6 +598,20 @@ class InferenceEngine:
             def _free_paged(kv_cache, slot):
                 return paged_cache_lib.free_slot(kv_cache, slot)
             self._free = _jit(_free_paged, donate=(0,))
+
+            def _verify_paged(kv_cache, params, tables, last, drafts,
+                              draft_len, key, temps, active):
+                tokens = jnp.concatenate([last[:, None], drafts],
+                                         axis=1)
+                logits, new_cache = model_lib.paged_verify_step(
+                    config, params, kv_cache, tables, tokens)
+                pair, new_last, lengths = _accept(
+                    tokens, logits, drafts, draft_len, key, temps,
+                    active, new_cache.lengths)
+                return pair, new_last, paged_cache_lib.PagedKVCache(
+                    k_pages=new_cache.k_pages,
+                    v_pages=new_cache.v_pages, lengths=lengths)
+            self._verify = _jit(_verify_paged, donate=(0,))
 
             if self.ecfg.prefix_cache:
                 # Copy-on-write page duplication. src/dst are traced
@@ -566,6 +664,22 @@ class InferenceEngine:
             self._free = _jit(_free, donate=(0,),
                               out=self._cache_sharding)
 
+            def _verify_dense(kv_cache, params, last, drafts,
+                              draft_len, key, temps, active):
+                tokens = jnp.concatenate([last[:, None], drafts],
+                                         axis=1)
+                logits, new_cache = model_lib.verify_step(
+                    config, params, kv_cache, tokens)
+                pair, new_last, lengths = _accept(
+                    tokens, logits, drafts, draft_len, key, temps,
+                    active, new_cache.lengths)
+                return pair, new_last, cache_lib.KVCache(
+                    k=new_cache.k, v=new_cache.v, lengths=lengths)
+            self._verify = _jit(
+                _verify_dense, donate=(0,),
+                out=(self._rep_sharding, self._rep_sharding,
+                     self._cache_sharding))
+
     def _shard_tp(self) -> None:
         """Distribute params + KV cache over a `tp` mesh axis.
 
@@ -612,7 +726,8 @@ class InferenceEngine:
                temperature: float = 0.0,
                resume_tokens: Optional[Sequence[int]] = None,
                deadline: Optional[float] = None,
-               tenant: str = sched_lib.DEFAULT_TENANT) -> Request:
+               tenant: str = sched_lib.DEFAULT_TENANT,
+               spec: bool = True) -> Request:
         """Queue a request. ``resume_tokens`` continues a stream whose
         earlier tokens were already delivered elsewhere (mid-stream
         failover): they are pre-seeded into ``output_tokens``, so
@@ -621,8 +736,11 @@ class InferenceEngine:
         uninterrupted run) and decoding picks up at the boundary.
         ``deadline`` is an absolute wall-clock cutoff enforced by the
         step loop. ``tenant`` is the fair-queueing identity
-        (X-SkyTpu-Tenant). Raises :class:`AdmissionError` when the
-        scheduler's (global or per-tenant) queue bound is hit."""
+        (X-SkyTpu-Tenant). ``spec=False`` opts this request out of
+        speculative drafting (outputs are identical; only step count
+        changes — the bench's spec-off baseline lane). Raises
+        :class:`AdmissionError` when the scheduler's (global or
+        per-tenant) queue bound is hit."""
         if not prompt_tokens:
             raise ValueError('empty prompt')
         resume = list(map(int, resume_tokens)) if resume_tokens else []
@@ -659,7 +777,8 @@ class InferenceEngine:
             output_tokens=resume,
             resumed_from=len(resume),
             deadline=deadline,
-            tenant=str(tenant) or sched_lib.DEFAULT_TENANT)
+            tenant=str(tenant) or sched_lib.DEFAULT_TENANT,
+            spec=bool(spec))
         if resume and len(resume) >= max_new_tokens:
             # The stream died on its very last token: the budget is
             # already spent — finish without ever entering the queue
@@ -678,6 +797,11 @@ class InferenceEngine:
             # fcfs/deadline, per-tenant quotas under wfq); its
             # AdmissionError carries a queue-drain Retry-After
             # estimate computed from the recent decode throughput.
+            # _decode_tokens counts EMITTED tokens — under speculation
+            # a verify step lands 1..spec_k+1 of them — so the
+            # estimate's tokens/sec is the accepted-length-aware
+            # EFFECTIVE rate, not a 1-token/step assumption that would
+            # overshoot 429 backoff hints by the acceptance factor.
             self._sched.admit(req, drain_tps=(
                 self._decode_tokens / self._decode_time
                 if self._decode_time else 0.0))
@@ -1096,6 +1220,7 @@ class InferenceEngine:
         from the event loop)."""
         with self._lock:
             self._sweep_dead_requests()
+            spec_k = self._spec_k
             for slot in range(self.ecfg.n_slots):
                 if self._slots[slot] is None:
                     req = self._sched.pop_next()
@@ -1160,6 +1285,52 @@ class InferenceEngine:
         # pair read is the PREVIOUS step's, consumed only after this
         # step's decode is already dispatched, so the device never
         # waits on host bookkeeping.
+        if spec_k:
+            # Draft eligibility is knowable from host slot state alone
+            # (greedy, opted in, fully prefilled, not this step's
+            # fresh prefill) — and draining can only ever REMOVE
+            # eligibility (a consume may finish a slot), never create
+            # it. So a spec-enabled engine serving only sampled or
+            # opted-out traffic skips both the drain and the draft
+            # pass and keeps the full dispatch-ahead overlap — exactly
+            # the spec-off step.
+            fresh = set(just_prefilled)
+            eligible = [s for s in range(self.ecfg.n_slots)
+                        if self._spec_eligible(s, fresh)]
+            if not eligible:
+                spec_k = 0
+            elif self._queue and not any(
+                    self._drafter.propose(
+                        drafter_lib.cached_context(
+                            self._slots[s].prompt_tokens,
+                            self._slots[s].output_tokens,
+                            self._slots[s].draft_memo),
+                        1, memo=self._slots[s].draft_memo)
+                    for s in eligible):
+                # Eligible slots, but no trailing n-gram matches the
+                # (stale-by-one) host context: nobody would draft, so
+                # skip the drain too — greedy-but-non-repetitive
+                # traffic keeps the dispatch-ahead overlap instead of
+                # paying a device sync per step for nothing. A match
+                # that only the post-drain token would create just
+                # starts speculating one step later (the opportunistic
+                # contract); the memo index these probes build is the
+                # same one the real draft pass uses.
+                spec_k = 0
+        if spec_k and self._queue:
+            # Speculation: the drafter continues the host-known token
+            # sequence, but an in-flight step is about to append to it
+            # — catch up BEFORE drafting (and before the decoding list
+            # is built, so drain-side finishes are seen). The dispatch
+            # below still leaves up to _depth steps in flight, so the
+            # async-readback overlap survives; only the consume moved
+            # from after the dispatch to before the next draft.
+            # Timed as decode work: the consume's sync wait prices the
+            # effective tokens/sec that Retry-After estimates divide
+            # by.
+            t0 = time.perf_counter()
+            self._drain_inflight()
+            self._decode_time += time.perf_counter() - t0
         decoding = [s for s, r in enumerate(self._slots)
                     if r is not None and s not in self._prefilling]
         if self.allocator is not None and decoding:
@@ -1168,7 +1339,17 @@ class InferenceEngine:
             return len(self._prefilling)
         t0 = time.perf_counter()
         if decoding:
-            self._dispatch_decode(decoding, just_prefilled)
+            drafts = (self._build_drafts(decoding, just_prefilled,
+                                         spec_k) if spec_k else None)
+            if drafts is not None:
+                self._dispatch_verify(decoding, just_prefilled,
+                                      *drafts)
+            else:
+                # No drafts this step (spec off, sampled slots, or no
+                # n-gram matched): the plain decode program is the
+                # cheaper dispatch — a draftless verify would pay
+                # spec_k wasted lanes per slot.
+                self._dispatch_decode(decoding, just_prefilled)
         # Keep at most _depth steps in flight; with nothing newly
         # dispatched there is no overlap left to win — drain fully so
         # finished requests surface and idle() can flip.
@@ -1178,13 +1359,12 @@ class InferenceEngine:
         self._decode_time += time.perf_counter() - t0
         return len(decoding) + len(self._prefilling)
 
-    def _dispatch_decode(self, decoding: List[int],
-                         just_prefilled: List[int]) -> None:
-        """Dispatch one decode step (no host sync) and start its pair's
-        device→host copy; the result is consumed by a later
-        ``_consume_one``. Decode N+1 depends only on ``_last_dev`` and
-        the cache — both device-resident — so it never waits for the
-        host to have READ step N."""
+    def _refresh_dispatch_state(self, decoding: List[int]) -> None:
+        """Re-upload the per-token decode operands behind their dirty
+        flags (temps, active mask, paged block table) — the shared
+        preamble of the decode AND verify dispatchers, factored so an
+        invalidation fix can never land on one path and miss the
+        other."""
         if self._temps_dirty or self._temps_dev is None:
             self._temps_dev = jnp.asarray(self._temps)
             self._temps_dirty = False
@@ -1194,10 +1374,20 @@ class InferenceEngine:
             active_mask[decoding] = True
             self._active_dev = jnp.asarray(active_mask)
             self._active_key = key
+        if (self.allocator is not None
+                and self._table_version != self.allocator.version):
+            self._table_dev = jnp.asarray(self.allocator.table())
+            self._table_version = self.allocator.version
+
+    def _dispatch_decode(self, decoding: List[int],
+                         just_prefilled: List[int]) -> None:
+        """Dispatch one decode step (no host sync) and start its pair's
+        device→host copy; the result is consumed by a later
+        ``_consume_one``. Decode N+1 depends only on ``_last_dev`` and
+        the cache — both device-resident — so it never waits for the
+        host to have READ step N."""
+        self._refresh_dispatch_state(decoding)
         if self.allocator is not None:
-            if self._table_version != self.allocator.version:
-                self._table_dev = jnp.asarray(self.allocator.table())
-                self._table_version = self.allocator.version
             pair, self.cache = self._decode(
                 self.cache, self.params, self._table_dev,
                 self._last_dev, self._next_key(), self._temps_dev,
@@ -1221,7 +1411,124 @@ class InferenceEngine:
         self._queue.append((
             pair,
             [(s, self._slots[s]) for s in decoding],
-            [(s, self._slots[s]) for s in just_prefilled]))
+            [(s, self._slots[s]) for s in just_prefilled],
+            None))   # no verify payload: consume takes the decode path
+
+    def _spec_eligible(self, s: int, fresh: set) -> bool:
+        """May slot ``s`` draft this step? Greedy, opted in, fully
+        prefilled, and not one of this step's fresh prefills (their
+        first token is still device-side, so the host cannot continue
+        the sequence). ONE definition, shared by step()'s skip-the-
+        drain gate and ``_build_drafts`` — an eligibility change must
+        reach both or speculation silently diverges from the gate.
+        Engine thread only."""
+        r = self._slots[s]
+        return (r is not None and s not in self._prefilling
+                and s not in fresh and r.temperature == 0 and r.spec)
+
+    def _build_drafts(self, decoding: List[int],
+                      just_prefilled: List[int],
+                      spec_k: int) -> Optional[tuple]:
+        """Prompt-lookup drafts for this step's decoding slots.
+
+        Returns ``(draft_mat [slots, spec_k], draft_lens [slots])``
+        int32 (zero-padded; draft_lens is the static-pad active mask
+        the verify program honors), or None when nobody drafted — the
+        caller then dispatches the plain decode program. A slot drafts
+        only when it is greedy, opted in, NOT just-prefilled (its
+        first token is still device-side, so the host cannot continue
+        the sequence), within the scheduler's per-step budget
+        (wfq caps over-share tenants), short enough of the cache end
+        that every drafted position fits, and — paged — coverable
+        without evicting cached prefixes or preempting anyone
+        (speculation is opportunistic: a dry pool trims the draft,
+        never the workload)."""
+        lens = np.zeros((self.ecfg.n_slots,), np.int32)
+        mat = np.zeros((self.ecfg.n_slots, spec_k), np.int32)
+        fresh = set(just_prefilled)
+        eligible = [s for s in decoding
+                    if self._spec_eligible(s, fresh)]
+        if not eligible:
+            return None
+        with self._lock:
+            # One lock round-trip for the whole step, not one per slot
+            # — the budgets depend only on scheduler state.
+            budgets = {s: self._sched.spec_budget(self._slots[s],
+                                                  spec_k)
+                       for s in eligible}
+        any_draft = False
+        for s in eligible:
+            req = self._slots[s]
+            budget = min(
+                int(budgets[s]), spec_k,
+                # Every drafted position must sit strictly inside the
+                # cache: the run writes [len, len+draft_len] and the
+                # corrected token still needs a writable position.
+                self.ecfg.max_seq_len - 2 - int(self._slot_len[s]),
+                # Drafting past the request's remaining token budget
+                # wastes lanes/pages: the finish check would drop the
+                # surplus anyway.
+                req.max_new_tokens - len(req.output_tokens) - 1)
+            if budget <= 0:
+                continue
+            prop = self._drafter.propose(
+                drafter_lib.cached_context(req.prompt_tokens,
+                                           req.output_tokens,
+                                           req.draft_memo),
+                budget, memo=req.draft_memo)
+            if prop and self.allocator is not None:
+                base = int(self._slot_len[s])
+                if not self.allocator.extend(s, base + len(prop) + 1):
+                    covered = (self.allocator.pages_of(s)
+                               * self.allocator.page_size)
+                    prop = prop[:max(0, covered - base - 1)]
+                if prop and not self._unshare_write_range(
+                        s, base, base + len(prop) + 1):
+                    prop = []
+            if not prop:
+                continue
+            lens[s] = len(prop)
+            mat[s, :len(prop)] = prop
+            any_draft = True
+        return (mat, lens) if any_draft else None
+
+    def _dispatch_verify(self, decoding: List[int],
+                         just_prefilled: List[int],
+                         draft_mat: 'np.ndarray',
+                         draft_lens: 'np.ndarray') -> None:
+        """Dispatch one fused verify step (no host sync): the draft
+        run's K/V writes, every candidate's logits, exact-greedy
+        acceptance AND the device-side state advance (lengths +=
+        accepted+1, the corrected token into ``_last_dev``) are one
+        program — the device never waits for a host accept/reject.
+        The [spec_k+3, slots] pair rides the in-flight queue exactly
+        like a decode pair; consume applies host bookkeeping per
+        emitted token and rolls rejected pages back."""
+        self._refresh_dispatch_state(decoding)
+        drafts_dev = jnp.asarray(draft_mat)
+        lens_dev = jnp.asarray(draft_lens)
+        if self.allocator is not None:
+            pair, self._last_dev, self.cache = self._verify(
+                self.cache, self.params, self._table_dev,
+                self._last_dev, drafts_dev, lens_dev,
+                self._next_key(), self._temps_dev, self._active_dev)
+        else:
+            pair, self._last_dev, self.cache = self._verify(
+                self.cache, self.params, self._last_dev, drafts_dev,
+                lens_dev, self._next_key(), self._temps_dev,
+                self._active_dev)
+        pair.copy_to_host_async()
+        self._decode_steps += 1
+        with self._lock:
+            self._spec_steps += 1
+            for s in decoding:
+                self._inflight_tok[s] += int(draft_lens[s]) + 1
+        self._queue.append((
+            pair,
+            [(s, self._slots[s], int(draft_lens[s]))
+             for s in decoding],
+            [(s, self._slots[s]) for s in just_prefilled],
+            draft_mat.shape[1] + 1))
 
     def _consume_one(self) -> None:
         """Read back the OLDEST in-flight pair and apply its host-side
@@ -1230,7 +1537,7 @@ class InferenceEngine:
         request it held at dispatch time (finished or preempted since)
         drops its token — for greedy decoding the resume path recomputes
         the identical token, so outputs are depth-invariant."""
-        pair, decoded, prefilled = self._queue.popleft()
+        pair, decoded, prefilled, spec_r = self._queue.popleft()
         pair_host = np.asarray(pair)   # sync point (copy already async)
         now = time.time()
         touched: List[Request] = []
@@ -1252,22 +1559,80 @@ class InferenceEngine:
                     # First token already ends the request; the second
                     # token decoded the same step dies with the slot.
                     self._finish(slot, req)
-            for slot, req in decoded:
-                self._inflight_tok[slot] = max(
-                    0, self._inflight_tok[slot] - 1)
-                if req is None or req.done or self._slots[slot] is not req:
-                    continue   # stale-by-one: post-finish token dropped
-                token = int(pair_host[1, slot])
-                req.output_tokens.append(token)
-                self._slot_len[slot] += 1
-                self._decode_tokens += 1
-                self._sched.note_tokens(req)
-                touched.append(req)
-                if self._finished(req, slot, token):
-                    self._finish(slot, req)
+            if spec_r is None:
+                for slot, req in decoded:
+                    self._inflight_tok[slot] = max(
+                        0, self._inflight_tok[slot] - 1)
+                    if (req is None or req.done
+                            or self._slots[slot] is not req):
+                        continue   # stale-by-one: post-finish dropped
+                    token = int(pair_host[1, slot])
+                    req.output_tokens.append(token)
+                    self._slot_len[slot] += 1
+                    self._decode_tokens += 1
+                    self._sched.note_tokens(req)
+                    touched.append(req)
+                    if self._finished(req, slot, token):
+                        self._finish(slot, req)
+            else:
+                self._consume_verify(pair_host, decoded, spec_r,
+                                     touched)
         for req in touched:
             if not req.done:       # _finish already notified
                 req._notify()
+
+    def _consume_verify(self, pair_host, decoded, spec_r,
+                        touched) -> None:  # holds: _lock
+        """Verify-pair bookkeeping: emit the accepted run plus the
+        corrected token ONE token at a time through the exact same
+        finish ladder as plain decode — eos / max_tokens / cache_full
+        fire mid-run and drop the tail, which is precisely what
+        spec-off would have produced — then roll pages extended for
+        rejected draft positions back to the pool. ``decoded`` rows
+        are (slot, request-at-dispatch, draft_len); ``spec_r`` =
+        spec_k+1 (the accepted count sits in pair row spec_r+1)."""
+        for slot, req, dl in decoded:
+            self._inflight_tok[slot] = max(
+                0, self._inflight_tok[slot] - (dl + 1))
+            if req is None or req.done or self._slots[slot] is not req:
+                continue   # stale-by-one: post-finish tokens dropped
+            accepted = min(int(pair_host[spec_r + 1, slot]), dl)
+            if dl > 0:
+                # Only DRAFTING lanes feed the speculation gauges: a
+                # draft_len=0 slot co-riding this dispatch (sampled /
+                # opted-out / just-prefilled) emits exactly one token
+                # like plain decode, and counting it would dilute
+                # accepted_len_mean toward 1.0 under mixed traffic —
+                # the operator tuning spec_k would read the wrong
+                # signal.
+                self._spec_slot_steps += 1
+                self._spec_drafted += dl
+                self._spec_accepted += accepted
+                req.spec_steps += 1
+            for i in range(accepted + 1):
+                token = int(pair_host[1 + i, slot])
+                req.output_tokens.append(token)
+                self._slot_len[slot] += 1
+                self._decode_tokens += 1
+                if dl > 0:
+                    self._spec_emitted += 1
+                    req.spec_emitted += 1
+                self._sched.note_tokens(req)
+                if self._finished(req, slot, token):
+                    self._finish(slot, req)
+                    break
+            if req.done:
+                continue
+            touched.append(req)
+            if self.allocator is not None:
+                # Rejected-draft rollback: pages extended past the new
+                # frontier (the next token's write page is kept)
+                # return to the pool NOW, not at finish — rejected
+                # pages are freed, never leaked (the PR 4 refcount
+                # discipline applies, so a somehow-shared page merely
+                # loses this slot's reference).
+                self.allocator.shrink(slot,
+                                      int(self._slot_len[slot]) + 1)
 
     def _drain_inflight(self) -> None:
         """Consume every in-flight step (host state catches up to the
@@ -1290,6 +1655,30 @@ class InferenceEngine:
         pipeline_depth 0): the sweeps read the local wall clock, and
         every host must reach identical request state each tick."""
         self.wallclock_cancel = bool(enabled)
+
+    def set_spec_k(self, k: int) -> None:
+        """Runtime draft-width knob (0 = off). Each distinct k>0 is
+        its own verify program shape (drafts are [slots, k]); greedy
+        outputs are bit-identical at every k. Raises when the lockstep
+        driver pinned speculation off — enabling it there would let
+        hosts draft from host-local state and silently diverge."""
+        k = max(0, int(k))
+        with self._lock:
+            if k > 0 and self._spec_pinned:
+                raise RuntimeError(
+                    'speculative decoding is pinned off on the '
+                    'multihost lockstep path: the tick spec does not '
+                    'carry draft tokens, so host-local drafts would '
+                    'diverge the replicas')
+            self._spec_k = k
+
+    def pin_spec_off(self) -> None:
+        """Multihost lockstep: force spec_k=0 and refuse re-enabling
+        (like the pipeline_depth=0 pin) until the tick spec carries
+        draft tokens."""
+        with self._lock:
+            self._spec_k = 0
+            self._spec_pinned = True
 
     def set_scheduler(self, name: str,
                       tenant_weights=None) -> None:
@@ -1380,6 +1769,25 @@ class InferenceEngine:
                 'decode_tokens_per_sec': (
                     self._decode_tokens / self._decode_time
                     if self._decode_time else 0.0),
+                # Emitted tokens per dispatched step (batch-wide:
+                # ~active slots without speculation; accepted runs
+                # multiply it by the mean accepted length).
+                'tokens_per_step': (round(
+                    self._decode_tokens / self._decode_steps, 4)
+                    if self._decode_steps else None),
+                **({'spec_k': self._spec_k,
+                    'spec_steps': self._spec_steps,
+                    'spec_slot_steps': self._spec_slot_steps,
+                    'spec_drafted_tokens': self._spec_drafted,
+                    'spec_accepted_tokens': self._spec_accepted,
+                    'spec_emitted_tokens': self._spec_emitted,
+                    'spec_accept_rate': (round(
+                        self._spec_accepted / self._spec_drafted, 4)
+                        if self._spec_drafted else 0.0),
+                    'accepted_len_mean': (round(
+                        self._spec_emitted / self._spec_slot_steps, 4)
+                        if self._spec_slot_steps else None)}
+                   if (self._spec_k or self._spec_steps) else {}),
                 'ttft_p50_s': p50,
                 # TTFT decomposition: submit → first chunk dispatch
                 # (the scheduler's share), apart from prefill compute.
@@ -1426,6 +1834,8 @@ class InferenceEngine:
                 return int(fn._cache_size())
             except Exception:  # noqa: BLE001 — private jit API moved
                 return -1
+        with self._lock:
+            spec_on = bool(self._spec_k or self._spec_steps)
         return {'prefill': n(self._prefill_chunk),
                 'decode': n(self._decode),
                 'free': n(self._free),
@@ -1434,7 +1844,12 @@ class InferenceEngine:
                 # actually fires — prefill-from-offset reuses the
                 # existing chunk buckets (offset is a traced scalar).
                 **({'cow': n(self._cow)} if self.prefix is not None
-                   else {})}
+                   else {}),
+                # Speculation adds exactly ONE program per draft width
+                # (drafts are [slots, spec_k], static pad + draft_len
+                # mask — no per-draft-length shapes): verify=1 in
+                # steady state.
+                **({'verify': n(self._verify)} if spec_on else {})}
 
 
 class EnginePool:
@@ -1464,14 +1879,16 @@ class EnginePool:
                temperature: float = 0.0,
                resume_tokens: Optional[Sequence[int]] = None,
                deadline: Optional[float] = None,
-               tenant: str = sched_lib.DEFAULT_TENANT) -> Request:
+               tenant: str = sched_lib.DEFAULT_TENANT,
+               spec: bool = True) -> Request:
         n = len(prompt_tokens) + len(resume_tokens or ())
         for eng in self.engines:
             if n <= eng.ecfg.max_seq_len - 1:
                 return eng.submit(prompt_tokens, max_new_tokens,
                                   temperature,
                                   resume_tokens=resume_tokens,
-                                  deadline=deadline, tenant=tenant)
+                                  deadline=deadline, tenant=tenant,
+                                  spec=spec)
         raise ValueError(
             f'prompt ({n} tokens) exceeds every pool tier '
             f'(largest: {self.engines[-1].ecfg.max_seq_len - 1})')
@@ -1492,6 +1909,14 @@ class EnginePool:
     def set_wallclock_cancel(self, enabled: bool) -> None:
         for e in self.engines:
             e.set_wallclock_cancel(enabled)
+
+    def set_spec_k(self, k: int) -> None:
+        for e in self.engines:
+            e.set_spec_k(k)
+
+    def pin_spec_off(self) -> None:
+        for e in self.engines:
+            e.pin_spec_off()
 
     def set_scheduler(self, name: str, tenant_weights=None) -> None:
         for e in self.engines:
@@ -1549,12 +1974,37 @@ class EnginePool:
             }
         waits = sorted(x for e in self.engines
                        for x in e.queue_wait_window())
+        total_steps = sum(t['decode_steps'] for t in tiers)
+        spec_tiers = [t for t in tiers if 'spec_steps' in t]
+        spec_agg = {}
+        if spec_tiers:
+            drafted = sum(t['spec_drafted_tokens'] for t in spec_tiers)
+            accepted = sum(t['spec_accepted_tokens']
+                           for t in spec_tiers)
+            emitted = sum(t['spec_emitted_tokens'] for t in spec_tiers)
+            lanes = sum(t['spec_slot_steps'] for t in spec_tiers)
+            spec_agg = {
+                'spec_k': max(t['spec_k'] for t in spec_tiers),
+                'spec_steps': sum(t['spec_steps']
+                                  for t in spec_tiers),
+                'spec_slot_steps': lanes,
+                'spec_drafted_tokens': drafted,
+                'spec_accepted_tokens': accepted,
+                'spec_emitted_tokens': emitted,
+                'spec_accept_rate': (round(accepted / drafted, 4)
+                                     if drafted else 0.0),
+                'accepted_len_mean': (round(emitted / lanes, 4)
+                                      if lanes else None),
+            }
         return {
             **prefix_agg,
-            'decode_steps': sum(t['decode_steps'] for t in tiers),
+            **spec_agg,
+            'decode_steps': total_steps,
             'decode_tokens': total_tokens,
             'decode_tokens_per_sec': (total_tokens / total_time
                                       if total_time else 0.0),
+            'tokens_per_step': (round(total_tokens / total_steps, 4)
+                                if total_steps else None),
             'ttft_p50_s': (ttfts[len(ttfts) // 2] if ttfts else None),
             'queue_wait_p50_ms': (round(
                 waits[len(waits) // 2] * 1e3, 3) if waits else None),

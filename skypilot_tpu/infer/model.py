@@ -257,6 +257,143 @@ def _paged_decode_layer(config, x, layer, cos, sin, k_pages, v_pages,
     return x, k_pages, v_pages
 
 
+def verify_step(config: llama.LlamaConfig, params: llama.Params,
+                kv: cache_lib.KVCache, tokens: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, cache_lib.KVCache]:
+    """Speculative verify over the dense cache: R = spec_k+1 tokens
+    for EVERY slot in one fused step.
+
+    tokens: [slots, R] int32 — column 0 the slot's last sampled token,
+    columns 1..R-1 the (padded) draft candidates. K/V for all R
+    positions are written at lengths[slot]..lengths[slot]+R-1
+    (write-then-attend; ``cache_lib.append_run`` guards positions past
+    the cache end), each query attends causally through the cache plus
+    the run prefix up to itself, and the logits at every position come
+    back — the engine's acceptance rule (sampling.speculative_accept)
+    turns them into 1..R emitted tokens. ``lengths`` is NOT advanced
+    here: only the engine knows the accepted length (it bumps by
+    accepted+1 in its jitted wrapper).
+
+    Returns (logits [slots, R, vocab] fp32, cache with K/V written,
+    lengths unchanged).
+    """
+    slots, R = tokens.shape
+    positions = kv.lengths[:, None] + jnp.arange(
+        R, dtype=jnp.int32)[None, :]                  # [slots, R]
+    x = quant_lib.qembed(params['embed'], tokens)     # [slots, R, d]
+    cos, sin = rope_lib.rope_frequencies(config.head_dim,
+                                         config.max_seq_len,
+                                         config.rope_theta)
+    S = kv.max_seq_len
+    # [slots, R, S]: query i sees cached positions <= lengths + i
+    # (itself included — its K/V is written before the attend).
+    mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]
+
+    def body(carry, xs):
+        layer, k_layer, v_layer = xs
+        h, k_new, v_new = _verify_layer(config, carry, layer, cos, sin,
+                                        k_layer, v_layer, positions,
+                                        mask)
+        return h, (k_new, v_new)
+
+    x, (k_upd, v_upd) = jax.lax.scan(
+        body, x, (params['layers'], kv.k, kv.v))
+    x = norms.rms_norm(x, params['final_norm'], config.norm_eps)
+    logits = quant_lib.qdot(x, params['lm_head']).astype(jnp.float32)
+    return logits, cache_lib.KVCache(k=k_upd, v=v_upd,
+                                     lengths=kv.lengths)
+
+
+def _verify_layer(config, x, layer, cos, sin, k_cache, v_cache,
+                  positions, mask):
+    """One layer of the dense verify step. x: [slots, R, d];
+    positions: [slots, R]; mask: [slots, R, S]."""
+    slots, R, d = x.shape
+    hq, hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    group = hq // hkv
+
+    h = norms.rms_norm(x, layer['attn_norm'], config.norm_eps)
+    q = quant_lib.qdot(h, layer['wq']).reshape(slots, R, hq, hd)
+    k = quant_lib.qdot(h, layer['wk']).reshape(slots, R, hkv, hd)
+    v = quant_lib.qdot(h, layer['wv']).reshape(slots, R, hkv, hd)
+    q = rope_lib.apply_rope(q, cos, sin, positions)
+    k = rope_lib.apply_rope(k, cos, sin, positions)
+
+    k_cache, v_cache = cache_lib.append_run(
+        k_cache, v_cache, k, v, positions[:, 0])
+
+    qg = q.reshape(slots, R, hkv, group, hd).astype(jnp.float32)
+    kc = k_cache.astype(jnp.float32)             # [slots, S, kv, hd]
+    vc = v_cache.astype(jnp.float32)
+    scores = jnp.einsum('brkgd,bskd->brkgs', qg, kc) * (hd ** -0.5)
+    scores = jnp.where(mask[:, :, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    att = jnp.einsum('brkgs,bskd->brkgd', probs, vc)
+    att = att.reshape(slots, R, hq * hd).astype(x.dtype)
+    x = x + quant_lib.qdot(att, layer['wo'])
+    x = llama.mlp_block(config, x, layer)
+    return x, k_cache, v_cache
+
+
+def paged_verify_step(config: llama.LlamaConfig, params: llama.Params,
+                      pkv: paged_cache_lib.PagedKVCache,
+                      block_tables: jnp.ndarray, tokens: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray,
+                                 paged_cache_lib.PagedKVCache]:
+    """verify_step over the paged cache: the run's K/V land in the
+    slot's pages (positions past the block-table coverage redirect to
+    the sink page) and all R queries stream each owned page ONCE via
+    the verify kernel — the bandwidth bill of a single decode step for
+    up to R tokens of progress. ``lengths`` is not advanced (the
+    engine bumps by accepted+1)."""
+    slots, R = tokens.shape
+    positions = pkv.lengths[:, None] + jnp.arange(
+        R, dtype=jnp.int32)[None, :]                  # [slots, R]
+    x = quant_lib.qembed(params['embed'], tokens)     # [slots, R, d]
+    cos, sin = rope_lib.rope_frequencies(config.head_dim,
+                                         config.max_seq_len,
+                                         config.rope_theta)
+
+    def body(carry, xs):
+        layer, k_layer, v_layer = xs
+        h, k_new, v_new = _paged_verify_layer(
+            config, carry, layer, cos, sin, k_layer, v_layer,
+            block_tables, positions, pkv.lengths)
+        return h, (k_new, v_new)
+
+    x, (k_upd, v_upd) = jax.lax.scan(
+        body, x, (params['layers'], pkv.k_pages, pkv.v_pages))
+    x = norms.rms_norm(x, params['final_norm'], config.norm_eps)
+    logits = quant_lib.qdot(x, params['lm_head']).astype(jnp.float32)
+    return logits, paged_cache_lib.PagedKVCache(
+        k_pages=k_upd, v_pages=v_upd, lengths=pkv.lengths)
+
+
+def _paged_verify_layer(config, x, layer, cos, sin, k_pages, v_pages,
+                        block_tables, positions, lengths):
+    slots, R, d = x.shape
+    hq, hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    group = hq // hkv
+
+    h = norms.rms_norm(x, layer['attn_norm'], config.norm_eps)
+    q = quant_lib.qdot(h, layer['wq']).reshape(slots, R, hq, hd)
+    k = quant_lib.qdot(h, layer['wk']).reshape(slots, R, hkv, hd)
+    v = quant_lib.qdot(h, layer['wv']).reshape(slots, R, hkv, hd)
+    q = rope_lib.apply_rope(q, cos, sin, positions)
+    k = rope_lib.apply_rope(k, cos, sin, positions)
+
+    # Write-then-attend, run edition (sink-redirected past coverage).
+    k_pages, v_pages = paged_attn.append_run_pages(
+        k_pages, v_pages, k, v, block_tables, lengths)
+    qg = q.reshape(slots, R, hkv, group, hd)
+    att = paged_attn.paged_verify_attention(
+        qg, k_pages, v_pages, block_tables, lengths)
+    att = att.reshape(slots, R, hq * hd).astype(x.dtype)
+    x = x + quant_lib.qdot(att, layer['wo'])
+    x = llama.mlp_block(config, x, layer)
+    return x, k_pages, v_pages
+
+
 def decode_step(config: llama.LlamaConfig, params: llama.Params,
                 kv: cache_lib.KVCache, tokens: jnp.ndarray,
                 active: Optional[jnp.ndarray] = None

@@ -93,6 +93,14 @@ class MultihostEngineDriver:
             # lockstep hosts must never diverge on request state, so
             # they are disabled (same rule as pipeline depth 0).
             engine.set_wallclock_cancel(False)
+        if hasattr(engine, 'pin_spec_off'):
+            # Speculative drafting reads host-LOCAL state (each host's
+            # prompt-lookup index) — until the tick spec carries the
+            # draft tokens in the broadcast, hosts could propose
+            # different drafts and diverge. Pinned OFF, and the pin is
+            # sticky: a later set_spec_k(k>0) raises instead of
+            # silently forking the replicas.
+            engine.pin_spec_off()
         self.rank = jax.process_index()
         self.world = jax.process_count()
         self._pending: List[Dict[str, Any]] = []   # rank0 only

@@ -208,6 +208,25 @@ class PageAllocator:
         self.version += 1
         return pid, dst
 
+    def shrink(self, slot: int, upto_tokens: int) -> int:
+        """Trim the slot's TAIL pages down to what covers
+        ``upto_tokens`` positions — the speculative-decoding rollback:
+        pages extended for draft positions the verify step rejected go
+        straight back to the pool (refcount-dropped, so a page somehow
+        still shared merely loses this slot's reference) instead of
+        riding the slot as dead weight until finish. Returns the
+        number of pages released."""
+        keep = max(self.pages_needed(max(upto_tokens, 0)), 0)
+        dropped = 0
+        while len(self._owned[slot]) > keep:
+            pid = self._owned[slot].pop()
+            self._table[slot, len(self._owned[slot])] = 0
+            self.decref(pid)
+            dropped += 1
+        if dropped:
+            self.version += 1
+        return dropped
+
     def free(self, slot: int) -> None:
         """Drop the slot's reference on all of its pages (pages shared
         with the prefix tree or other slots survive; exclusive pages

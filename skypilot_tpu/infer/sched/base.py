@@ -269,6 +269,18 @@ class Scheduler:
             else:
                 st.expired += 1
 
+    # ---- speculation budget ---------------------------------------------
+    def spec_budget(self, req, spec_k: int) -> int:  # holds: _lock
+        """Draft width allowed for ``req`` THIS step (speculative
+        decoding, docs/serving.md). Speculation spends extra page and
+        verify-lane budget chasing latency, so the scheduler — the
+        owner of contention policy — gets the last word on how wide a
+        request may draft. fcfs/deadline grant the global
+        ``EngineConfig.spec_k`` unconditionally; wfq caps an
+        over-share tenant's width under contention (its override)."""
+        del req
+        return spec_k
+
     # ---- step work selection --------------------------------------------
     def next_prefill_slot(self, candidates: List[int],  # holds: _lock
                           slots: List[Any]) -> int:

@@ -255,6 +255,25 @@ class WFQScheduler(base.Scheduler):
             return req
         return None
 
+    # ---- speculation budget ---------------------------------------------
+    def spec_budget(self, req, spec_k: int) -> int:  # holds: _lock
+        """Weight-share draft width under contention: speculation
+        burns extra pages and verify lanes for latency, and when other
+        tenants hold queued work that headroom belongs to the rotation
+        — so a tenant drafts ``spec_k`` scaled by its weight share of
+        the contending set (the uncontended engine always drafts full
+        width). Floor 1, not 0: with many contenders the truncated
+        share would zero EVERYONE's width and turn speculation off
+        fleet-wide — a tenant at its fair share keeps at least one
+        draft lane; the scaling only narrows wide drafting, it never
+        disables it."""
+        if not (set(self._order) - {req.tenant}):
+            return spec_k
+        # The same weight-share-of-the-contending-set rule admission
+        # quotas use (_share) — one definition of "fair share".
+        return min(spec_k,
+                   max(1, int(spec_k * self._share(req.tenant))))
+
     # ---- step work selection --------------------------------------------
     def next_prefill_slot(self, candidates: List[int],  # holds: _lock
                           slots: List[Any]) -> int:

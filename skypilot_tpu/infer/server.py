@@ -565,7 +565,12 @@ class InferenceServer:
                         temperature=float(body.get('temperature', 0.0)),
                         resume_tokens=resume,
                         deadline=deadline,
-                        tenant=tenant)
+                        tenant=tenant,
+                        # Per-request speculation opt-out ("spec":
+                        # false) — the spec-off baseline lane of
+                        # bench_ttft --sweep speculative; outputs are
+                        # bit-identical either way.
+                        spec=bool(body.get('spec', True)))
         except engine_lib.AdmissionError as e:
             # Bounded admission: shed with 429 + Retry-After instead of
             # queueing unboundedly (the LB tries other replicas first).
@@ -643,7 +648,13 @@ class InferenceServer:
                              'queue_wait_s': req.queue_wait,
                              # Prompt tokens served from the shared-
                              # prefix KV cache (prefill skipped).
-                             'cached_tokens': req.cached_tokens
+                             'cached_tokens': req.cached_tokens,
+                             # Mean tokens landed per verify step for
+                             # THIS request (speculative decoding);
+                             # None when it never rode a verify step.
+                             'accepted_len_mean': (round(
+                                 req.spec_emitted / req.spec_steps, 3)
+                                 if req.spec_steps else None)
                              }).encode() + b'\n')
                         break
                     await waiter.wait(1.0)
@@ -698,6 +709,9 @@ class InferenceServer:
             'ttft_s': req.ttft,
             'queue_wait_s': req.queue_wait,
             'cached_tokens': req.cached_tokens,
+            'accepted_len_mean': (round(
+                req.spec_emitted / req.spec_steps, 3)
+                if req.spec_steps else None),
         })
 
     def make_app(self) -> web.Application:
@@ -781,6 +795,20 @@ def main() -> None:
     parser.add_argument('--tenant-weights', default=None,
                         help="wfq weights as 'tenantA=4,tenantB=1' "
                              '(unlisted tenants weigh 1.0).')
+    parser.add_argument('--spec-k', type=int, default=0,
+                        help='Self-speculative decoding draft width '
+                             '(docs/serving.md "Speculative '
+                             'decoding"): a prompt-lookup drafter '
+                             'proposes up to this many tokens per '
+                             'greedy slot and one fused verify step '
+                             'scores them all — accepted runs emit '
+                             'up to spec_k+1 tokens per engine step '
+                             'with BIT-IDENTICAL greedy output. 0 = '
+                             'off (default; multi-host lockstep '
+                             'replicas always run 0).')
+    parser.add_argument('--spec-ngram', type=int, default=3,
+                        help='Longest trailing n-gram the drafter '
+                             'matches (falls back to shorter grams).')
     parser.add_argument('--pipeline-depth', type=int, default=1,
                         help='Dispatch-ahead decode depth: decode N+1 '
                              'is dispatched before step N is read '
@@ -896,6 +924,7 @@ def main() -> None:
             paged=args.paged, page_size=args.page_size,
             n_pages=args.n_pages, prefix_cache=args.prefix_cache,
             pipeline_depth=args.pipeline_depth,
+            spec_k=args.spec_k, spec_ngram=args.spec_ngram,
             max_queue_requests=args.max_queue_requests,
             max_queue_tokens=args.max_queue_tokens,
             scheduler=args.scheduler,
@@ -918,6 +947,7 @@ def main() -> None:
                 max_seq_len=long_cap,
                 tp=args.tp, quantize=False,   # params already int8
                 pipeline_depth=args.pipeline_depth,
+                spec_k=args.spec_k, spec_ngram=args.spec_ngram,
                 max_queue_requests=args.max_queue_requests,
                 max_queue_tokens=args.max_queue_tokens,
                 scheduler=args.scheduler,

@@ -404,6 +404,163 @@ def paged_prefill_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# Verify kernel (speculative decoding): R query tokens per slot
+# ---------------------------------------------------------------------------
+def paged_verify_attention_reference(
+        q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+        block_tables: jnp.ndarray, lengths: jnp.ndarray,
+        *, sm_scale: Optional[float] = None) -> jnp.ndarray:
+    """q: [slots, R, hkv, group, hd] — R = spec_k+1 verify queries per
+    slot at positions lengths[slot]..lengths[slot]+R-1 (their K/V
+    already written, the decode write-then-attend contract). Query i
+    attends to positions < lengths[slot] + i + 1 (causal within the
+    draft run). Returns [slots, R, hkv, group, hd] fp32."""
+    slots, R, hkv, group, hd = q.shape
+    page = k_pages.shape[2]
+    maxp = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = hd ** -0.5
+    k = k_pages[:, block_tables]          # [hkv, slots, maxp, page, hd]
+    v = v_pages[:, block_tables]
+    k = k.transpose(1, 0, 2, 3, 4).reshape(slots, hkv, maxp * page, hd)
+    v = v.transpose(1, 0, 2, 3, 4).reshape(slots, hkv, maxp * page, hd)
+    s = jnp.einsum('brkgd,bksd->brkgs', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    pos = jnp.arange(maxp * page)
+    horizon = (lengths[:, None] + jnp.arange(R)[None, :] + 1)
+    valid = pos[None, None, :] < horizon[:, :, None]   # [slots, R, S]
+    s = jnp.where(valid[:, :, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('brkgs,bksd->brkgd', p, v.astype(jnp.float32))
+
+
+def _verify_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *,
+                   page_size: int, sm_scale: float, max_pages: int,
+                   hkv: int, group: int, r_queries: int):
+    """The decode kernel with R queries per (slot, head): rows are
+    queries x group flattened (group fastest), each row's causal
+    horizon is its query's position — one extra iota/div over the
+    decode kernel, the same online-softmax accumulation per page."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    del tables_ref  # consumed by the index_maps
+    length = lengths_ref[b]
+    # Pages holding ANY attendable position: the furthest query
+    # (r_queries-1) sees positions < length + r_queries.
+    n_pages = pl.cdiv(length + r_queries, page_size)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(p < n_pages)
+    def _accumulate():
+        for h in range(hkv):
+            q = q_ref[0, h].astype(jnp.float32) * sm_scale  # [R*g, hd]
+            k = k_ref[h, 0].astype(jnp.float32)             # [page, hd]
+            v = v_ref[h, 0].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [R*g, page]
+            kpos = p * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            qi = jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0) // group
+            s = jnp.where(kpos < length + qi + 1, s, _NEG_INF)
+            m_prev = m_ref[h]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(s, axis=-1, keepdims=True))
+            pr = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[h] = l_ref[h] * alpha + jnp.sum(pr, axis=-1,
+                                                  keepdims=True)
+            acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
+                pr, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[h] = m_new
+
+    @pl.when(p == max_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_verify_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
+                           v_pages: jnp.ndarray,
+                           block_tables: jnp.ndarray,
+                           lengths: jnp.ndarray, *,
+                           sm_scale: Optional[float] = None,
+                           interpret: Optional[bool] = None
+                           ) -> jnp.ndarray:
+    """Speculative verify: R = spec_k+1 query tokens for EVERY slot in
+    one kernel launch over the paged cache.
+
+    q: [slots, R, hkv, group, hd]; lengths: [slots] int32 — the
+    PRE-RUN length (query i sits at position lengths[slot]+i and
+    attends to positions < lengths[slot]+i+1; the run's K/V must
+    already be written, see ``append_run_pages``). The whole point:
+    scoring R candidates streams each owned page through the chip
+    ONCE — the same HBM traffic as a single decode step — so accepted
+    drafts are nearly free bandwidth-wise. Fully-masked trailing pages
+    accumulate exact zeros, so each query's result is bitwise the
+    result the decode kernel produces for that position (the
+    exact-greedy acceptance rule depends on this).
+
+    Returns [slots, R, hkv, group, hd] fp32.
+    """
+    slots, R, hkv, group, hd = q.shape
+    page_size = k_pages.shape[2]
+    max_pages = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = hd ** -0.5
+    interpret = _interpret_default(interpret)
+    # [slots, hkv, R*group, hd], group fastest: row r is query
+    # r // group — same flattening rule as the prefill kernel.
+    qf = q.transpose(0, 2, 1, 3, 4).reshape(slots, hkv, R * group, hd)
+
+    def _page_index(b, p, tables, lengths_):
+        # Same revisiting-block rule as decode: steps past the slot's
+        # attendable pages re-map to its last real page (no DMA).
+        n_pages = jax.lax.div(lengths_[b] + R + page_size - 1,
+                              page_size)
+        j = jnp.minimum(p, jnp.maximum(n_pages - 1, 0))
+        j = jnp.minimum(j, max_pages - 1)
+        return (0, tables[b, j], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, hkv, R * group, hd),
+                         lambda b, p, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((hkv, 1, page_size, hd), _page_index),
+            pl.BlockSpec((hkv, 1, page_size, hd), _page_index),
+        ],
+        out_specs=pl.BlockSpec((1, hkv, R * group, hd),
+                               lambda b, p, *_: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, R * group, hd), jnp.float32),
+            pltpu.VMEM((hkv, R * group, 1), jnp.float32),
+            pltpu.VMEM((hkv, R * group, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_verify_kernel, page_size=page_size,
+                               sm_scale=sm_scale, max_pages=max_pages,
+                               hkv=hkv, group=group, r_queries=R)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, hkv, R * group, hd),
+                                       jnp.float32),
+        interpret=interpret,
+    )(block_tables, lengths, qf, k_pages, v_pages)
+    return out.reshape(slots, hkv, R, group, hd).transpose(0, 2, 1, 3, 4)
+
+
+# ---------------------------------------------------------------------------
 # Paged cache writes (pure JAX; XLA lowers to scatters)
 # ---------------------------------------------------------------------------
 def write_chunk_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
@@ -431,6 +588,40 @@ def write_chunk_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         v_pages = jax.lax.dynamic_update_slice(
             v_pages, vc[:, i * page:(i + 1) * page][:, None],
             (0, pid, 0, 0))
+    return k_pages, v_pages
+
+
+def append_run_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                     k_new: jnp.ndarray, v_new: jnp.ndarray,
+                     block_tables: jnp.ndarray, lengths: jnp.ndarray
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Append a RUN of R tokens' K/V per slot at positions
+    ``lengths[slot] + i`` — the speculative-verify write (input token
+    plus padded draft candidates in one step).
+
+    k_new/v_new: [slots, R, hkv, hd]. One scatter per run position,
+    chained sequentially. Positions past the slot's block-table
+    coverage (padded drafts of a slot the engine capped, inactive
+    slots' garbage lanes) redirect to the SINK page 0 — the table
+    lookup is clamped and overridden, never allowed to alias a live
+    page the way a clamped index would.
+    """
+    page = k_pages.shape[2]
+    maxp = block_tables.shape[1]
+    R = k_new.shape[1]
+    for i in range(R):
+        pos = lengths + i
+        col = pos // page
+        valid = col < maxp
+        pids = jnp.take_along_axis(
+            block_tables, jnp.minimum(col, maxp - 1)[:, None],
+            axis=1)[:, 0]
+        pids = jnp.where(valid, pids, 0)
+        rows = pos % page
+        k_pages = k_pages.at[:, pids, rows].set(
+            k_new[:, i].transpose(1, 0, 2).astype(k_pages.dtype))
+        v_pages = v_pages.at[:, pids, rows].set(
+            v_new[:, i].transpose(1, 0, 2).astype(v_pages.dtype))
     return k_pages, v_pages
 
 

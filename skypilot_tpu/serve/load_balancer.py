@@ -154,6 +154,14 @@ class _StreamSplice:
         return json.dumps(p).encode()
 
 
+def _mean_gauge(stats: 'Dict[str, dict]', key: str):
+    """Mean of a per-replica gauge over the replicas reporting it
+    (None when nobody does) — fleet decode-efficiency rollup."""
+    vals = [row[key] for row in stats.values()
+            if isinstance(row, dict) and row.get(key) is not None]
+    return round(sum(vals) / len(vals), 4) if vals else None
+
+
 class LoadBalancer:
     # Concurrency contract (SKY-LOCK, docs/static-analysis.md):
     # 'event-loop' = single-threaded asyncio state. Counters and
@@ -176,6 +184,7 @@ class LoadBalancer:
         '_draining_urls': 'event-loop',
         '_tenants': 'event-loop',
         '_replica_queue_depth': 'event-loop',
+        '_replica_decode_stats': 'event-loop',
     }
 
     def __init__(self, service_name: str, policy_name: str) -> None:
@@ -221,6 +230,11 @@ class LoadBalancer:
         # the QueueLengthAutoscaler scales on (LB in-flight alone
         # misses queued-but-unserved work inside the engines).
         self._replica_queue_depth: Dict[str, int] = {}
+        # url -> decode-efficiency gauges from the same /metrics fetch
+        # (tokens_per_step, accepted_len_mean, spec_accept_rate) —
+        # how many tokens each replica lands per engine step under
+        # speculative decoding.
+        self._replica_decode_stats: Dict[str, dict] = {}
         self.breaker = retry_lib.CircuitBreaker(
             failure_threshold=int(os.environ.get(
                 'SKY_TPU_LB_BREAKER_THRESHOLD', '3')),
@@ -266,8 +280,19 @@ class LoadBalancer:
                                     total=2)) as r:
                             if r.status == 200:
                                 m = await r.json()
+                                # Decode-efficiency gauges ride the
+                                # same fetch: tokens/step (>1 under
+                                # speculative decoding) and the spec
+                                # acceptance stats the bench and
+                                # dashboards watch.
+                                eff = {
+                                    k: m.get(k) for k in (
+                                        'tokens_per_step',
+                                        'accepted_len_mean',
+                                        'spec_accept_rate')
+                                    if m.get(k) is not None}
                                 return url, int(
-                                    m.get('num_waiting') or 0)
+                                    m.get('num_waiting') or 0), eff
                     except (aiohttp.ClientError,
                             asyncio.TimeoutError, ValueError,
                             TypeError, OSError):
@@ -277,8 +302,11 @@ class LoadBalancer:
                 fetched = (await asyncio.gather(
                     *(_depth_of(u) for u in urls))
                     if self._session is not None and urls else [])
-                self._replica_queue_depth = dict(
-                    pair for pair in fetched if pair is not None)
+                rows = [row for row in fetched if row is not None]
+                self._replica_queue_depth = {
+                    url: depth for url, depth, _ in rows}
+                self._replica_decode_stats = {
+                    url: eff for url, _, eff in rows}
             except Exception:  # noqa: BLE001 — keep serving on DB hiccup
                 logger.warning('replica sync failed', exc_info=True)
             await asyncio.sleep(SYNC_INTERVAL_S)
@@ -355,6 +383,15 @@ class LoadBalancer:
             'engine_queue_depth': sum(
                 self._replica_queue_depth.values()),
             'replica_queue_depth': dict(self._replica_queue_depth),
+            # Fleet decode efficiency (speculative decoding): mean of
+            # each reporting replica's gauge — null until a ready
+            # replica reports one.
+            'engine_tokens_per_step': _mean_gauge(
+                self._replica_decode_stats, 'tokens_per_step'),
+            'engine_accepted_len_mean': _mean_gauge(
+                self._replica_decode_stats, 'accepted_len_mean'),
+            'engine_spec_accept_rate': _mean_gauge(
+                self._replica_decode_stats, 'spec_accept_rate'),
             'requests_total': self._requests_total,
             'requests_failed': self._requests_failed,
             'requests_no_replica': self._requests_no_replica,

@@ -70,6 +70,85 @@ def test_paged_prefill_kernel_matches_reference():
                                    err_msg=f'off={off} tl={tl}')
 
 
+def test_paged_verify_kernel_matches_reference():
+    """The speculative verify kernel (R queries per slot) against its
+    dense-gather reference, including slots whose run crosses a page
+    boundary and a slot right at the pool's coverage edge."""
+    rng = np.random.default_rng(2)
+    slots, hkv, group, hd, R = 4, 2, 4, 64, 5
+    page, P, maxp = 16, 32, 8
+    q = jnp.asarray(rng.normal(size=(slots, R, hkv, group, hd)),
+                    jnp.float32)
+    k_pages, v_pages = _rand_pages(rng, hkv, P, page, hd)
+    ids = rng.permutation(np.arange(1, P))[:slots * maxp - slots]
+    tables = np.zeros((slots, maxp), np.int32)
+    tables.flat[:len(ids)] = ids
+    tables = jnp.asarray(tables)
+    # 13+5 crosses a page; 64 starts a fresh page; 123+5 reaches the
+    # table's final page (maxp*page = 128).
+    lengths = jnp.asarray([13, 64, 1, 123], jnp.int32)
+    ref = pa.paged_verify_attention_reference(q, k_pages, v_pages,
+                                              tables, lengths)
+    out = pa.paged_verify_attention(q, k_pages, v_pages, tables,
+                                    lengths, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_paged_verify_query0_bitwise_matches_decode_kernel():
+    """Query 0 of a verify run attends to exactly what a decode step
+    at the same position attends to, and trailing fully-masked pages
+    are exact no-ops in the online softmax — so the verify kernel's
+    first lane must be BITWISE the decode kernel's output (the
+    exact-greedy acceptance rule rides on this)."""
+    rng = np.random.default_rng(3)
+    slots, hkv, group, hd, R = 4, 2, 4, 64, 4
+    page, P, maxp = 16, 32, 8
+    qv = jnp.asarray(rng.normal(size=(slots, R, hkv, group, hd)),
+                     jnp.float32)
+    k_pages, v_pages = _rand_pages(rng, hkv, P, page, hd)
+    ids = rng.permutation(np.arange(1, P))[:slots * maxp - slots]
+    tables = np.zeros((slots, maxp), np.int32)
+    tables.flat[:len(ids)] = ids
+    tables = jnp.asarray(tables)
+    lengths = jnp.asarray([13, 64, 0, 100], jnp.int32)
+    ver = pa.paged_verify_attention(qv, k_pages, v_pages, tables,
+                                    lengths, interpret=True)
+    # Decode attends to pos < length (callers pass the already-bumped
+    # length); verify query 0 sees pos < lengths + 1.
+    dec = pa.paged_decode_attention(qv[:, 0], k_pages, v_pages,
+                                    tables, lengths + 1,
+                                    interpret=True, impl='native')
+    np.testing.assert_array_equal(np.asarray(ver)[:, 0],
+                                  np.asarray(dec))
+
+
+def test_append_run_pages_writes_and_sink_redirects():
+    """The run write lands each position in the owned page/row; the
+    pad tail past the block table's coverage redirects to the sink
+    page 0 instead of aliasing a live page through a clamped index."""
+    hkv, hd, page, P, maxp = 2, 8, 4, 6, 2
+    slots, R = 2, 3
+    k_pages = jnp.zeros((hkv, P, page, hd), jnp.float32)
+    v_pages = jnp.zeros((hkv, P, page, hd), jnp.float32)
+    tables = jnp.asarray([[3, 4], [5, 0]], jnp.int32)
+    # Slot 0 at len 3: run covers positions 3,4,5 -> page 3 row 3 then
+    # page 4 rows 0,1. Slot 1 at len 7: position 7 = page 0 (its table
+    # col 1 is the sink already), 8.. past maxp*page -> sink too.
+    lengths = jnp.asarray([3, 7], jnp.int32)
+    k_new = jnp.arange(slots * R * hkv * hd, dtype=jnp.float32).reshape(
+        slots, R, hkv, hd) + 1.0
+    k2, v2 = pa.append_run_pages(k_pages, v_pages, k_new, k_new,
+                                 tables, lengths)
+    k2 = np.asarray(k2)
+    np.testing.assert_array_equal(k2[:, 3, 3], np.asarray(k_new[0, 0]))
+    np.testing.assert_array_equal(k2[:, 4, 0], np.asarray(k_new[0, 1]))
+    np.testing.assert_array_equal(k2[:, 4, 1], np.asarray(k_new[0, 2]))
+    # Live pages other than the written ones stay zero.
+    assert not k2[:, 5].any() and not k2[:, 1].any()
+    assert not k2[:, 2].any()
+
+
 def test_append_token_pages_lands_in_right_page_rows():
     hkv, P, page, hd, slots = 2, 6, 4, 8, 3
     k_pages = jnp.zeros((hkv, P, page, hd), jnp.float32)

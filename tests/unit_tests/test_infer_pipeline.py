@@ -154,6 +154,32 @@ def test_recompile_stability_dense(dense_runs):
     assert counts == {'prefill': 2, 'decode': 1, 'free': 1}, counts
 
 
+def test_recompile_stability_speculative(params):
+    """With speculation on, the program budget grows by EXACTLY the
+    verify program (static draft pad + draft_len mask — no
+    per-draft-length shapes): verify=1, still prefill=buckets,
+    decode=1, free=1; a second pass compiles nothing new. Spec-off
+    engines (above) must not even carry the key."""
+    eng = engine_lib.InferenceEngine(
+        CFG, params,
+        engine_lib.EngineConfig(n_slots=2, max_seq_len=64,
+                                prefill_buckets=(8,), prefill_chunk=8,
+                                spec_k=3))
+    reqs = eng.generate([[11] * 40, [9, 9, 3, 9, 9]],
+                        max_new_tokens=16)
+    assert all(r.done for r in reqs)
+    counts = eng.compiled_counts()
+    if -1 in counts.values():
+        pytest.skip('jit._cache_size unavailable in this jax')
+    assert counts == {'prefill': 1, 'decode': 1, 'free': 1,
+                      'verify': 1}, counts
+    eng.generate([[7] * 12], max_new_tokens=10)
+    assert eng.compiled_counts() == counts, (
+        'steady-state speculation triggered a recompile')
+    assert eng.metrics()['spec_steps'] >= 1, (
+        'workload never dispatched a verify step — pin is vacuous')
+
+
 def test_token_events_wake_waiters(params):
     """wait_progress/wait_done return on engine progress without the
     waiter polling; listeners fire for every appended token."""
