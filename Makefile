@@ -1,0 +1,21 @@
+# Developer entry points. `make lint` is the pre-commit gate: the same
+# AST invariant checkers CI runs (docs/static-analysis.md), scoped to
+# your git-changed files for speed; `make lint-full` is the whole
+# package (what the tier-1 test and the deploy/Dockerfile `lint` stage
+# enforce).
+
+PYTHON ?= python
+
+.PHONY: lint lint-full lint-json test-analysis
+
+lint:
+	$(PYTHON) -m skypilot_tpu.client.cli lint --changed
+
+lint-full:
+	$(PYTHON) -m skypilot_tpu.client.cli lint
+
+lint-json:
+	$(PYTHON) -m skypilot_tpu.client.cli lint --json
+
+test-analysis:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/unit_tests/test_analysis.py -q

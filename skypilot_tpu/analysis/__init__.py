@@ -9,7 +9,17 @@ through refactors (docs/static-analysis.md has the full catalog):
 
 =============  =======================================================
 SKY-LOCK       fields in a class's ``_GUARDED_BY`` registry accessed
-               only under their lock / declared context
+               only under their lock / declared context — now
+               INTERPROCEDURAL: helpers are legal when the lock is
+               held at every resolved call site, and every
+               ``# holds:`` annotation is verified against its real
+               callers (lockflow.py)
+SKY-ORDER      the global lock-acquisition-order graph is acyclic;
+               non-reentrant locks are never re-acquired; the
+               canonical ``LOCK_ORDER`` is never contradicted
+SKY-HOLD       no blocking operation (await / sleep / net /
+               subprocess / device readback / file IO) while a lock
+               is held, with severity tiers
 SKY-ASYNC      no blocking calls in ``async def``; waits stay
                event-driven; retries go through ``Retrier``
 SKY-EXCEPT     async serve/infer code never swallows connection-reset
@@ -25,6 +35,7 @@ Usage::
     sky-tpu lint                       # whole package, human output
     sky-tpu lint --json                # machine-readable
     sky-tpu lint skypilot_tpu/infer    # one subtree
+    sky-tpu lint --changed             # only files changed vs git
 
 Exit status is non-zero when any finding exceeds the audited
 allowlist (``analysis/allowlist.py`` — entries are
@@ -37,36 +48,45 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 from skypilot_tpu.analysis import core
-from skypilot_tpu.analysis.allowlist import ALLOWLIST
+from skypilot_tpu.analysis.allowlist import ALLOWLIST, LOCK_ORDER
 from skypilot_tpu.analysis.async_check import AsyncChecker
 from skypilot_tpu.analysis.core import (Checker, Finding, Report,
                                         RunContext, SourceFile)
 from skypilot_tpu.analysis.except_check import ExceptChecker
+from skypilot_tpu.analysis.hold_check import HoldChecker
 from skypilot_tpu.analysis.lock_check import LockChecker
+from skypilot_tpu.analysis.order_check import OrderChecker
 from skypilot_tpu.analysis.registry_check import RegistryChecker
 from skypilot_tpu.analysis.trace_check import TraceChecker
 
 
 def all_checkers() -> List[core.Checker]:
     """A fresh instance of every registered checker."""
-    return [LockChecker(), AsyncChecker(), ExceptChecker(),
-            TraceChecker(), RegistryChecker()]
+    return [LockChecker(), OrderChecker(), HoldChecker(),
+            AsyncChecker(), ExceptChecker(), TraceChecker(),
+            RegistryChecker()]
 
 
 def run(root: Optional[str] = None,
         pkg_root: Optional[str] = None,
         docs_root: Optional[str] = None,
         checkers: Optional[Sequence[core.Checker]] = None,
-        allowlist: Optional[core.Allowlist] = None) -> Report:
+        allowlist: Optional[core.Allowlist] = None,
+        report_paths: Optional[frozenset] = None) -> Report:
     """Run the suite. Defaults: all checkers over the installed
-    package against the shipped allowlist."""
+    package against the shipped allowlist. ``report_paths`` scopes
+    the REPORT (not the scan — interprocedural passes always see the
+    whole tree) to the given package-relative paths; the CLI's
+    ``--changed`` feeds it the git diff."""
     return core.run_checkers(
         checkers if checkers is not None else all_checkers(),
         root=root, pkg_root=pkg_root, docs_root=docs_root,
-        allowlist=ALLOWLIST if allowlist is None else allowlist)
+        allowlist=ALLOWLIST if allowlist is None else allowlist,
+        report_paths=report_paths)
 
 
-__all__ = ['run', 'all_checkers', 'ALLOWLIST', 'Checker', 'Finding',
-           'Report', 'RunContext', 'SourceFile', 'LockChecker',
-           'AsyncChecker', 'ExceptChecker', 'TraceChecker',
-           'RegistryChecker']
+__all__ = ['run', 'all_checkers', 'ALLOWLIST', 'LOCK_ORDER',
+           'Checker', 'Finding', 'Report', 'RunContext',
+           'SourceFile', 'LockChecker', 'OrderChecker',
+           'HoldChecker', 'AsyncChecker', 'ExceptChecker',
+           'TraceChecker', 'RegistryChecker']

@@ -20,7 +20,34 @@ saw.
 """
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, List, Tuple
+
+# The canonical global lock-acquisition order (SKY-ORDER). A thread
+# may only acquire locks left-to-right along this list; an edge that
+# contradicts it fails lint even before a full cycle closes. Entries
+# are lockflow ids (``Class.attr`` / ``module.attr``); bare names
+# match any class. Populated during the PR 10 bring-up audit — every
+# entry carries the reasoning for its position. The audit found ZERO
+# cross-lock nestings in shipped code (every critical section is
+# leaf-level by design); this list exists so the first nesting anyone
+# adds must conform to a reviewed order instead of inventing one.
+LOCK_ORDER: List[str] = [
+    # Outermost: the lockstep driver serializes submissions BEFORE any
+    # engine state is touched (tick drains _pending under it, then
+    # calls engine.submit after release — if they ever nest, driver
+    # first).
+    'MultihostEngineDriver._lock',
+    # The engine lock is the serving hot path's hub: submit/cancel/
+    # metrics threads vs the step loop. Anything engine code calls out
+    # to (scheduler — same lock by contract — allocator, prefix tree)
+    # must be lock-free or leaf-level below it.
+    'InferenceEngine._lock',
+    # LB-side leaf locks: policy bookkeeping and breaker state are
+    # touched from the event loop in O(replicas) critical sections and
+    # never call back into the engine or driver.
+    'LoadBalancingPolicy._lock',
+    'CircuitBreaker._lock',
+]
 
 ALLOWLIST: Dict[str, Tuple[int, str]] = {
     # ---- SKY-ASYNC: audited status-poll cadences (waiting for a

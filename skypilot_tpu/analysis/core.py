@@ -23,6 +23,7 @@ edits to the same file while still refusing any *new* site.
 from __future__ import annotations
 
 import ast
+import collections
 import dataclasses
 import json
 import os
@@ -35,14 +36,27 @@ class Finding:
     path: str       # package-relative posix path ('infer/engine.py')
     line: int
     message: str
+    # 'error' findings beyond the allowlist fail the gate; 'warn'
+    # findings are reported (and counted by the ratchet) but do not
+    # flip Report.ok — the SKY-HOLD severity tiers.
+    severity: str = 'error'
+    # Interprocedural findings carry the call chain that produced
+    # them (outermost caller first), e.g.
+    # ('h_metrics', 'EnginePool.metrics', '_merge_tenants').
+    chain: Optional[Tuple[str, ...]] = None
 
     @property
     def key(self) -> str:
         return f'{self.path}:{self.code}'
 
     def to_dict(self) -> Dict[str, object]:
-        return {'code': self.code, 'path': self.path,
-                'line': self.line, 'message': self.message}
+        out: Dict[str, object] = {
+            'code': self.code, 'path': self.path,
+            'line': self.line, 'message': self.message,
+            'severity': self.severity}
+        if self.chain:
+            out['chain'] = list(self.chain)
+        return out
 
 
 class SourceFile:
@@ -126,6 +140,14 @@ class Report:
         return out
 
     @property
+    def hard_offenders(self) -> Dict[str, List[Finding]]:
+        """Offender keys with at least one error-severity finding —
+        the set that fails the gate. Warn-tier-only offender keys
+        (SKY-HOLD's lower tiers) are surfaced but advisory."""
+        return {k: v for k, v in self.offenders.items()
+                if any(f.severity == 'error' for f in v)}
+
+    @property
     def stale(self) -> Dict[str, Tuple[int, int]]:
         """Allowlist entries whose sites were since removed (cap >
         actual) — they must be ratcheted down, or they silently grant
@@ -147,14 +169,17 @@ class Report:
 
     @property
     def ok(self) -> bool:
-        return not self.offenders and not self.stale
+        return not self.hard_offenders and not self.stale
 
     def to_json(self) -> str:
+        hard = self.hard_offenders
         return json.dumps({
             'ok': self.ok,
             'findings': [f.to_dict() for f in self.findings],
             'offenders': {k: [f.to_dict() for f in v]
                           for k, v in self.offenders.items()},
+            'warn_offenders': sorted(
+                k for k in self.offenders if k not in hard),
             'stale_allowlist': {k: {'allowed': cap, 'found': n}
                                 for k, (cap, n) in self.stale.items()},
         }, indent=2, sort_keys=True)
@@ -162,6 +187,7 @@ class Report:
     def render_text(self, verbose: bool = False) -> str:
         lines: List[str] = []
         offenders = self.offenders
+        hard = self.hard_offenders
         if verbose and self.findings:
             lines.append('All findings (including allowlisted):')
             for f in sorted(self.findings,
@@ -172,16 +198,21 @@ class Report:
         for key in sorted(offenders):
             cap, why = self.allowlist.get(key, (0, ''))
             head = f'{key}: {len(offenders[key])} finding(s)'
+            if key not in hard:
+                head += ' [warn tier — advisory, does not fail]'
             if cap:
                 head += f' (allowlist covers {cap}: {why})'
             lines.append(head)
             for f in offenders[key]:
                 lines.append(f'  {f.path}:{f.line} {f.message}')
+                if f.chain:
+                    lines.append(
+                        f'    call chain: {" -> ".join(f.chain)}')
         for key, (cap, n) in sorted(self.stale.items()):
             lines.append(
                 f'{key}: allowlist grants {cap} but only {n} found — '
                 f'ratchet the entry down (stale caps hide new sites)')
-        n_off = sum(len(v) for v in offenders.values())
+        n_off = sum(len(v) for v in hard.values())
         if self.ok:
             lines.append(
                 f'lint clean: {len(self.findings)} finding(s), all '
@@ -194,13 +225,48 @@ class Report:
         return '\n'.join(lines)
 
 
+# Parsed-module cache: (mtime_ns, size, SourceFile) by absolute path,
+# LRU-bounded (a long-lived process linting many trees — the test
+# suite's per-test fixture packages — must not accumulate dead ASTs
+# forever). Parsing + parent-linking dominates lint wall-clock;
+# repeated runs in one process (the tier-1 gate + canaries,
+# `--changed` after a full run) reuse the tree. Identity stability
+# doubles as the content signature lockflow's memo keys on.
+_SOURCE_CACHE: ('collections.OrderedDict'
+                '[str, Tuple[int, int, SourceFile]]') = (
+    collections.OrderedDict())
+_SOURCE_CACHE_LIMIT = 2048
+
+
+def _load_source(abs_path: str, rel: str) -> 'SourceFile':
+    try:
+        st = os.stat(abs_path)
+        sig = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return SourceFile(abs_path, rel)
+    hit = _SOURCE_CACHE.get(abs_path)
+    if hit is not None and hit[:2] == sig and hit[2].rel == rel:
+        _SOURCE_CACHE.move_to_end(abs_path)
+        return hit[2]
+    src = SourceFile(abs_path, rel)
+    _SOURCE_CACHE[abs_path] = (sig[0], sig[1], src)
+    _SOURCE_CACHE.move_to_end(abs_path)
+    while len(_SOURCE_CACHE) > _SOURCE_CACHE_LIMIT:
+        _SOURCE_CACHE.popitem(last=False)
+    return src
+
+
+def clear_source_cache() -> None:
+    _SOURCE_CACHE.clear()
+
+
 def load_files(root: str, pkg_root: str) -> List[SourceFile]:
     """Every .py under ``root``; rel paths computed against
     ``pkg_root`` so allowlist keys are stable for partial scans."""
     files: List[SourceFile] = []
     if os.path.isfile(root):
         rel = os.path.relpath(root, pkg_root).replace(os.sep, '/')
-        return [SourceFile(root, rel)]
+        return [_load_source(root, rel)]
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = sorted(d for d in dirnames
                              if d != '__pycache__'
@@ -211,7 +277,7 @@ def load_files(root: str, pkg_root: str) -> List[SourceFile]:
             abs_path = os.path.join(dirpath, fname)
             rel = os.path.relpath(abs_path, pkg_root).replace(
                 os.sep, '/')
-            files.append(SourceFile(abs_path, rel))
+            files.append(_load_source(abs_path, rel))
     return files
 
 
@@ -219,9 +285,16 @@ def run_checkers(checkers: Sequence[Checker],
                  root: Optional[str] = None,
                  pkg_root: Optional[str] = None,
                  docs_root: Optional[str] = None,
-                 allowlist: Optional[Allowlist] = None) -> Report:
+                 allowlist: Optional[Allowlist] = None,
+                 report_paths: Optional[frozenset] = None) -> Report:
     """Run ``checkers`` over ``root`` (default: the installed
-    skypilot_tpu package) and judge findings against ``allowlist``."""
+    skypilot_tpu package) and judge findings against ``allowlist``.
+
+    ``report_paths`` is the incremental (`sky-tpu lint --changed`)
+    contract: the WHOLE tree is still scanned — the interprocedural
+    passes need the full call graph to be sound — but findings are
+    reported, and allowlist staleness judged, only for the given
+    package-relative paths."""
     if pkg_root is None:
         import skypilot_tpu
         pkg_root = os.path.dirname(os.path.abspath(
@@ -251,8 +324,21 @@ def run_checkers(checkers: Sequence[Checker],
     parsed = [s for s in files if s.tree is not None]
     for checker in checkers:
         findings.extend(checker.check(parsed, ctx))
+    scanned = frozenset(s.rel for s in files)
+    if report_paths is not None:
+        # Interprocedural (chain-carrying) findings always survive the
+        # filter: a changed callee can introduce a violation whose
+        # report site is an UNCHANGED caller (annotation verification
+        # fires at the call site) — dropping it would make the
+        # pre-commit `--changed` gate print clean while full-package
+        # CI fails on the same tree.
+        findings = [f for f in findings
+                    if f.path in report_paths or f.chain]
+        scanned = frozenset(report_paths) & (
+            scanned | frozenset(p for p in report_paths
+                                if p.startswith('docs/')))
     return Report(findings=findings,
                   allowlist=dict(allowlist or {}),
                   checker_codes=[c.code for c in checkers],
-                  scanned=frozenset(s.rel for s in files),
+                  scanned=scanned,
                   full_package=ctx.full_package)

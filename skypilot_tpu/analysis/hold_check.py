@@ -1,0 +1,171 @@
+"""SKY-HOLD: no blocking operations while a lock is held.
+
+Every thread that wants the lock pays for whatever the holder does
+under it. The engine's ``_lock`` is taken by HTTP handler threads on
+every submit/cancel/metrics call — holding it across a device
+readback turns one slow request into a stalled step loop; holding ANY
+threading lock across ``await`` parks the event loop's other
+coroutines behind a mutex that only a *thread* can release (the
+classic async deadlock).
+
+Sinks, found lexically AND transitively (a helper that sleeps is just
+as blocking when its caller holds the lock three frames up —
+lockflow's MAY-entry sets carry the held locks down the call graph):
+
+===============  ========================================================
+``await``        any Await expression while a *threading* lock is held
+sleep            ``time.sleep``
+net              ``requests.*``, ``urllib.request.urlopen``,
+                 ``socket.create_connection``
+subprocess       ``subprocess.run/call/check_*``, ``os.system``
+device-sync      ``.block_until_ready()``, ``jax.device_get``,
+                 ``np.asarray`` / ``numpy.asarray`` (the engine's
+                 readback sync point), ``.item()``, ``.tolist()``
+file-io          ``open()`` / ``io.open()``          (warn tier)
+event-wait       ``.wait()`` on events/conditions    (warn tier)
+===============  ========================================================
+
+Severity tiers: ``await``/sleep/net/subprocess are hard errors under
+any lock. Device-sync is a hard error when the held lock is declared
+in ``infer/`` (the engine hot path — the exact "readback under
+``_lock``" stall ROADMAP's p99 numbers point at) and a warning
+elsewhere. File I/O and event waits are warnings: bounded local
+operations that still deserve an audit. Warnings beyond the allowlist
+cap are reported but do not fail the gate (``Report.ok`` counts only
+error-severity offenders); the allowlist ratchet counts both.
+
+asyncio locks are exempt everywhere (holding one across ``await`` is
+their purpose); the ``event-loop`` pseudo-lock is confinement, not a
+mutex, and never counts as held.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import lockflow
+from skypilot_tpu.analysis import walker
+
+_SINK_CALLS = {
+    'time.sleep': ('sleep', 'error'),
+    'urllib.request.urlopen': ('net', 'error'),
+    'socket.create_connection': ('net', 'error'),
+    'subprocess.run': ('subprocess', 'error'),
+    'subprocess.call': ('subprocess', 'error'),
+    'subprocess.check_call': ('subprocess', 'error'),
+    'subprocess.check_output': ('subprocess', 'error'),
+    'subprocess.Popen': ('subprocess', 'error'),
+    'os.system': ('subprocess', 'error'),
+    'jax.device_get': ('device-sync', 'device'),
+    'np.asarray': ('device-sync', 'device'),
+    'numpy.asarray': ('device-sync', 'device'),
+    'open': ('file-io', 'warn'),
+    'io.open': ('file-io', 'warn'),
+}
+_NET_PREFIXES = ('requests.',)
+_SINK_METHODS = {
+    'block_until_ready': ('device-sync', 'device'),
+    'item': ('device-sync', 'device'),
+    'tolist': ('device-sync', 'device'),
+    'wait': ('event-wait', 'warn'),
+}
+
+
+class HoldChecker(core.Checker):
+    code = 'SKY-HOLD'
+    title = 'no blocking operations while a lock is held'
+
+    def check(self, files: Sequence[core.SourceFile],
+              ctx: core.RunContext) -> Iterable[core.Finding]:
+        flow = lockflow.analyze(files)
+        for key in sorted(flow.summaries):
+            info = flow.funcs[key]
+            entry = {
+                l for l in flow._entry_locks(key)
+                if l != lockflow.EVENT_LOOP
+                and flow.kind(l) != 'asyncio'}
+            yield from self._check_function(flow, info, entry)
+
+    def _check_function(self, flow: 'lockflow.LockFlow', info,
+                        entry: Set[str]) -> Iterable[core.Finding]:
+        for node in walker.walk_function_body(info.node):
+            sink = self._classify(node)
+            if sink is None:
+                continue
+            label, tier = sink
+            lexical = {l for l, _ in flow.held_at(node, info)
+                       if flow.kind(l) != 'asyncio'}
+            held = lexical | entry
+            if not held:
+                continue
+            severity = self._severity(flow, tier, held)
+            locks = sorted(held)
+            primary = next((l for l in locks if l in lexical),
+                           locks[0])
+            chain: Optional[Tuple[str, ...]] = None
+            if primary not in lexical:
+                chain = tuple(flow.holding_chain(info.key, primary))
+            via = (f' (held on the call chain '
+                   f'{" -> ".join(chain)})' if chain else '')
+            yield core.Finding(
+                self.code, info.src.rel,
+                getattr(node, 'lineno', info.node.lineno),
+                f'{label} [{severity}]: {self._describe(node)} while '
+                f'holding {", ".join(locks)} in {info.qualname}{via} '
+                f'— every waiter on the lock stalls behind it; '
+                f'snapshot under the lock, then release before '
+                f'blocking',
+                severity=severity, chain=chain)
+
+    @staticmethod
+    def _classify(node: ast.AST) -> Optional[Tuple[str, str]]:
+        if isinstance(node, ast.Await):
+            return ('await', 'error')
+        if not isinstance(node, ast.Call):
+            return None
+        # Method sinks match on the attribute alone, BEFORE the dotted
+        # name is required: `self._pairs[0].block_until_ready()` has a
+        # Subscript receiver that dotted_name cannot render, and it is
+        # exactly the readback shape this checker exists for. `wait`
+        # is the one arg-sensitive sink: `q.wait()` blocks forever,
+        # `ev.wait(0.05)` is a bounded nap.
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SINK_METHODS):
+            if node.func.attr != 'wait' or not (node.args
+                                                or node.keywords):
+                return _SINK_METHODS[node.func.attr]
+        name = walker.call_name(node)
+        if name is None:
+            return None
+        hit = _SINK_CALLS.get(name)
+        if hit is not None:
+            return hit
+        if name.startswith(_NET_PREFIXES):
+            return ('net', 'error')
+        return None
+
+    @staticmethod
+    def _severity(flow: 'lockflow.LockFlow', tier: str,
+                  held: Set[str]) -> str:
+        if tier == 'device':
+            # Fail closed: a bare held name (`# holds: _lock`) matches
+            # every same-base declaration — if ANY candidate lives in
+            # infer/, treat the readback as the engine-stall case.
+            for lock in held:
+                if any(rel.startswith('infer/')
+                       for rel in flow.declared_rels(lock)):
+                    return 'error'
+            return 'warn'
+        return tier
+
+    @staticmethod
+    def _describe(node: ast.AST) -> str:
+        if isinstance(node, ast.Await):
+            return 'await'
+        name = walker.call_name(node)
+        if name:
+            return f'{name}()'
+        if isinstance(node.func, ast.Attribute):
+            return f'.{node.func.attr}()'
+        return 'call'

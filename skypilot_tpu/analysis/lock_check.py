@@ -37,13 +37,32 @@ registry (so a sibling class reaching into another class's guarded
 field — the EnginePool-reads-``engine._ttfts`` bug this checker was
 built on — is caught), but not across modules; cross-module reach-ins
 are already 'owner'-style API violations in review.
+
+v2 — interprocedural (PR 10): on top of the lexical rules, the
+lock-flow dataflow (lockflow.py) makes two upgrades:
+
+1. A helper that touches a guarded field WITHOUT taking the lock or
+   carrying a ``# holds:`` annotation is now legal if the lock is
+   provably held at **all** resolved call sites reaching it (the
+   MUST-entry set). When it is not, the finding reports the unlocked
+   call chain (``h_metrics -> EnginePool.metrics ->
+   _merge_tenants``) instead of just the access line.
+2. Every ``# holds: <lock>`` annotation is **verified** against its
+   real callers instead of being trusted: a resolved call site that
+   does not hold the lock is its own finding, at the call site. An
+   annotation with no resolved callers stays trusted (entry points
+   and dispatch the resolver cannot see).
+
+``# holds: event-loop`` verifies the same way — callers must be
+coroutines or provably on-loop themselves.
 """
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import lockflow
 from skypilot_tpu.analysis import walker
 
 REGISTRY_ATTR = '_GUARDED_BY'
@@ -87,14 +106,16 @@ class LockChecker(core.Checker):
 
     def check(self, files: Sequence[core.SourceFile],
               ctx: core.RunContext) -> Iterable[core.Finding]:
+        flow = lockflow.analyze(files)
         for src in files:
             regs = _registries(src)
-            if not regs:
-                continue
-            yield from self._check_module(src, regs)
+            if regs:
+                yield from self._check_module(src, regs, flow)
+        yield from self._verify_annotations(flow)
 
     def _check_module(self, src: core.SourceFile,
-                      regs: Dict[str, List[Tuple[str, str]]]
+                      regs: Dict[str, List[Tuple[str, str]]],
+                      flow: 'lockflow.LockFlow'
                       ) -> Iterable[core.Finding]:
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.Attribute):
@@ -110,21 +131,43 @@ class LockChecker(core.Checker):
             cls_name = cls.name if cls is not None else ''
             holds = (walker.holds_annotations(src, func)
                      if func is not None else set())
+            key = None
+            if func is not None:
+                prefix = walker.enclosing_qualname(func)
+                key = (src.rel, f'{prefix}.{func.name}'
+                       if prefix else func.name)
+            must = (flow.must_entry.get(key, frozenset())
+                    if key is not None else frozenset())
             for decl_cls, spec in specs:
                 bad = self._violates(node, spec, decl_cls, cls_name,
-                                     holds, func)
+                                     holds, func, must)
                 if bad:
+                    chain: Optional[Tuple[str, ...]] = None
+                    lock = spec.partition(':')[0]
+                    if (key is not None
+                            and flow.in_edges.get(key)
+                            and spec not in ('owner',)):
+                        chain = tuple(flow.unlocked_chain(
+                            key,
+                            lock if spec != 'event-loop'
+                            else lockflow.EVENT_LOOP))
+                    via = (f'; unlocked call chain: '
+                           f'{" -> ".join(chain)}'
+                           if chain and len(chain) > 1 else '')
                     yield core.Finding(
                         self.code, src.rel, node.lineno,
                         f'{decl_cls}.{node.attr} (guarded by '
-                        f'{spec!r}) {bad}')
+                        f'{spec!r}) {bad}{via}',
+                        chain=chain)
                     break   # one finding per access site
 
     @staticmethod
     def _violates(node: ast.Attribute, spec: str, decl_cls: str,
-                  cls_name: str, holds, func) -> str:
+                  cls_name: str, holds, func, must) -> str:
         """Return a message when the access violates ``spec``, else
-        ''."""
+        ''. ``must`` is the lock-flow MUST-entry set of the enclosing
+        function — locks provably held at entry on every resolved
+        call chain."""
         if spec == 'owner':
             if cls_name != decl_cls:
                 return (f'touched outside {decl_cls} — use the '
@@ -134,7 +177,8 @@ class LockChecker(core.Checker):
             return ''
         if spec == 'event-loop':
             if (isinstance(func, ast.AsyncFunctionDef)
-                    or 'event-loop' in holds):
+                    or 'event-loop' in holds
+                    or lockflow.EVENT_LOOP in must):
                 return ''
             return ('accessed from a sync def — event-loop state is '
                     'only safe on the loop; annotate the method '
@@ -145,7 +189,64 @@ class LockChecker(core.Checker):
             return ''
         if lock in walker.held_locks(node) or lock in holds:
             return ''
+        if lockflow.has_base(must, lock):
+            # Interprocedurally proven: the lock is held at every
+            # resolved call site reaching this helper.
+            return ''
         kind = 'mutated' if walker.is_mutating_access(node) else 'read'
-        return (f'{kind} outside "with self.{lock}" (annotate the '
-                f'method "# holds: {lock}" only if every caller '
-                f'holds it)')
+        return (f'{kind} outside "with self.{lock}" and not provably '
+                f'locked at every call site (annotate the method '
+                f'"# holds: {lock}" only if every caller holds it)')
+
+    # -- `# holds:` verification ------------------------------------------
+    def _verify_annotations(self, flow: 'lockflow.LockFlow'
+                            ) -> Iterable[core.Finding]:
+        """An annotation is a claim about CALLERS; check it against
+        every resolved call site instead of trusting it. Chains in the
+        findings name the unlocked path (the PR 10 contract: a lie in
+        an annotation must fail lint, not deadlock in production)."""
+        for key in sorted(flow.summaries):
+            summ = flow.summaries[key]
+            for ann in sorted(summ.annotations):
+                seen: set = set()
+                for e in flow.in_edges.get(key, []):
+                    caller_summ = flow.summaries.get(e.caller)
+                    if caller_summ is None:
+                        continue
+                    if len(e.targets) > 1 and not all(
+                            lockflow.has_base(
+                                flow.summaries[t].annotations, ann)
+                            for t in e.targets
+                            if t in flow.summaries):
+                        # Ambiguous (duck) dispatch where some
+                        # candidates do NOT carry the contract: the
+                        # call is presumably to one of those
+                        # (EnginePool calling each ENGINE's
+                        # set_tenant_weights, not the scheduler's).
+                        # Only same-contract candidate sets verify.
+                        continue
+                    caller_locks = set(e.held)
+                    if not e.deferred:
+                        # A deferred reference to an annotated helper
+                        # runs outside the caller's lock context — the
+                        # caller's own holds say nothing about it.
+                        caller_locks |= set(flow.must_entry.get(
+                            e.caller, frozenset()))
+                        caller_locks |= set(caller_summ.annotations)
+                    if lockflow.has_base(caller_locks, ann):
+                        continue
+                    info = flow.funcs[e.caller]
+                    site = (info.src.rel, e.line)
+                    if site in seen:
+                        continue
+                    seen.add(site)
+                    chain = tuple(flow.unlocked_chain(e.caller, ann)
+                                  + [flow.qualname(key)])
+                    yield core.Finding(
+                        self.code, info.src.rel, e.line,
+                        f'call to {flow.qualname(key)} (annotated '
+                        f'"# holds: {ann}") without {ann} held in '
+                        f'{info.qualname} — the annotation is a '
+                        f'calling contract, and this chain breaks '
+                        f'it: {" -> ".join(chain)}',
+                        chain=chain)

@@ -1,0 +1,836 @@
+"""Interprocedural lock-set dataflow over the whole package.
+
+PR 6's checkers were *lexical*: SKY-LOCK only saw a guarded field
+mutated outside ``with self._lock`` in the same function, so a helper
+called from under the lock — or a second lock acquired in a different
+order three frames down — was invisible. This module computes, for
+every function in the scanned set, the set of locks possibly (MAY)
+and provably (MUST) held at its entry, by propagating lexical
+``with <lock>:`` blocks and ``# holds:`` annotations through the call
+graph. Three checkers consume it:
+
+- **SKY-ORDER** (order_check.py): the global lock-acquisition-order
+  graph — cycles (potential deadlock) and re-entrant acquisition of a
+  non-reentrant lock;
+- **SKY-HOLD** (hold_check.py): blocking operations while a lock is
+  held, with severity tiers;
+- **SKY-LOCK v2** (lock_check.py): guarded-field accesses are legal
+  when the lock is held at *all* call sites reaching the accessor —
+  and every ``# holds:`` annotation is verified against its real
+  callers instead of being trusted.
+
+Lock identity
+-------------
+A lock is identified by a qualified id ``Class.attr`` when the
+acquisition is ``with self.attr:`` inside a class (or the attr was
+assigned ``threading.Lock()`` in that class), ``module.attr`` for
+module-level locks, or the bare attribute name when the receiver
+class cannot be determined. ``# holds:`` annotations and
+``_GUARDED_BY`` specs use bare names; matching is by base name
+(``InferenceEngine._lock`` satisfies ``# holds: _lock``) — the same
+over-approximation the lexical checker used, now applied
+transitively. The pseudo-lock ``event-loop`` models asyncio
+confinement: every ``async def`` holds it at entry by construction.
+
+Call-graph resolution
+---------------------
+Bare names resolve through the module scope chain (SKY-TRACE's rule);
+``self.meth()`` resolves through the enclosing class and its bases;
+``alias.func()`` through this package's imports; ``super().meth()``
+through the base-class chain; and ``obj.meth()`` falls back to *duck
+dispatch* — every class method of that name across the scanned
+package, provided the name is specific enough (≤ ``_DUCK_LIMIT``
+defining classes, not a builtin-collection verb). Duck dispatch is
+what connects the engine's ``self._sched.pop_next()`` to every
+scheduler policy — the lock-crossing chains PR 7/8 added.
+
+The analysis is memoized per (rel, SourceFile-identity) set, so the
+three consuming checkers and repeated `sky-tpu lint` calls in one
+process (tests, ``--changed``) pay for it once.
+"""
+from __future__ import annotations
+
+import ast
+import collections
+from typing import (Dict, FrozenSet, Iterable, List, Optional,
+                    Sequence, Set, Tuple)
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import walker
+
+FuncKey = walker.FuncKey
+
+EVENT_LOOP = 'event-loop'
+
+# Lock factory calls -> kind. Condition() wraps an RLock by default,
+# so re-entry through it is safe; asyncio locks are loop-confined and
+# excluded from blocking/ordering analysis entirely.
+_LOCK_FACTORIES = {
+    'threading.Lock': 'Lock',
+    'threading.RLock': 'RLock',
+    'threading.Condition': 'Condition',
+    'multiprocessing.Lock': 'Lock',
+    'asyncio.Lock': 'asyncio',
+    'asyncio.Condition': 'asyncio',
+    'asyncio.Semaphore': 'asyncio',
+}
+
+# Method names too generic to duck-dispatch on: collection/threading/
+# IO verbs that would wire unrelated classes together.
+_DUCK_DENY = frozenset(walker.MUTATOR_METHODS) | frozenset((
+    'get', 'put', 'set', 'items', 'keys', 'values', 'copy', 'join',
+    'split', 'strip', 'read', 'write', 'readline', 'readlines',
+    'flush', 'close', 'open', 'send', 'recv', 'encode', 'decode',
+    'format', 'count', 'index', 'startswith', 'endswith', 'lower',
+    'upper', 'replace', 'wait', 'notify', 'notify_all', 'acquire',
+    'release', 'start', 'is_set', 'result', 'done', 'info', 'debug',
+    'warning', 'error', 'exception', 'critical', 'log', 'get_event_loop',
+))
+
+# A method name defined in more than this many classes is treated as
+# too generic to dispatch on (the edges would be mostly noise).
+_DUCK_LIMIT = 8
+
+# Consumers of a bare method REFERENCE (`key=self._normalized_load`)
+# that invoke it synchronously, on the referencing thread, while the
+# reference site's locks are still held — only these let the held set
+# flow into the callee's entry sets. Everything else (executor.submit,
+# threading.Timer, storing the reference for later) runs the callback
+# AFTER the with-block exits, usually on another thread: claiming the
+# lock is held there would let SKY-LOCK v2 bless a real data race.
+_SYNC_REF_CONSUMERS = frozenset((
+    'min', 'max', 'sorted', 'map', 'filter', 'next', 'any', 'all',
+    'sum', 'list', 'tuple', 'set', 'functools.reduce',
+))
+# asyncio deferrals stay ON the loop: the callback keeps event-loop
+# confinement but no threading lock survives until it runs.
+_LOOP_DEFER_CONSUMERS = frozenset((
+    'call_soon', 'call_later', 'call_at', 'call_soon_threadsafe',
+    'create_task', 'ensure_future',
+))
+
+
+class Acquire:
+    """One lock acquisition site (with-block or manual acquire())."""
+
+    __slots__ = ('lock', 'line', 'held_before')
+
+    def __init__(self, lock: str, line: int,
+                 held_before: Tuple[str, ...]) -> None:
+        self.lock = lock
+        self.line = line
+        self.held_before = held_before
+
+
+class CallSite:
+    """One resolved call: targets + the locks lexically held at it.
+
+    ``deferred`` marks method-reference edges whose callee runs LATER
+    (executor.submit, Timer, stored callback) — ``held`` is already
+    stripped for those, and the fixpoints must not let the CALLER's
+    entry locks flow across either (the callback does not inherit its
+    creator's lock context)."""
+
+    __slots__ = ('targets', 'line', 'held', 'deferred')
+
+    def __init__(self, targets: Tuple[FuncKey, ...], line: int,
+                 held: FrozenSet[str],
+                 deferred: bool = False) -> None:
+        self.targets = targets
+        self.line = line
+        self.held = held
+        self.deferred = deferred
+
+
+class Summary:
+    __slots__ = ('acquires', 'calls', 'annotations', 'is_async')
+
+    def __init__(self) -> None:
+        self.acquires: List[Acquire] = []
+        self.calls: List[CallSite] = []
+        self.annotations: FrozenSet[str] = frozenset()
+        self.is_async = False
+
+
+class Edge:
+    __slots__ = ('caller', 'line', 'held', 'targets', 'deferred')
+
+    def __init__(self, caller: FuncKey, line: int,
+                 held: FrozenSet[str],
+                 targets: Tuple[FuncKey, ...] = (),
+                 deferred: bool = False) -> None:
+        self.caller = caller
+        self.line = line
+        self.held = held
+        # Every candidate the originating call site resolved to —
+        # when dispatch was ambiguous (duck), callers can tell.
+        self.targets = targets
+        self.deferred = deferred
+
+
+def base(lock: str) -> str:
+    return lock.rsplit('.', 1)[-1]
+
+
+def has_base(locks: Iterable[str], name: str) -> bool:
+    """Whether any lock id in ``locks`` matches ``name`` by base name
+    (bare annotations match any class-qualified id)."""
+    want = base(name)
+    return any(base(l) == want for l in locks)
+
+
+class LockFlow:
+    """The computed dataflow for one file set."""
+
+    def __init__(self, files: Sequence[core.SourceFile]) -> None:
+        self.files = list(files)
+        self.by_rel = {s.rel: s for s in self.files}
+        self.index = walker.index_functions(self.files)
+        self.funcs: Dict[FuncKey, walker.FuncInfo] = {}
+        for rel, funcs in self.index.items():
+            for info in funcs.values():
+                self.funcs[info.key] = info
+        # lock id -> (kind, declaring module rel)
+        self.universe: Dict[str, Tuple[str, str]] = {}
+        # class name -> (module rel, {method -> qualname}, [base names])
+        self._classes: Dict[str, List[Tuple[str, Dict[str, str],
+                                            List[str]]]] = {}
+        # method name -> [FuncKey, ...] (duck-dispatch index)
+        self._methods: Dict[str, List[FuncKey]] = {}
+        # (class name, attr) -> attr's class, from `self.attr =
+        # ClassName(...)` constructor assignments — lets
+        # `self.breaker.snapshot()` resolve to CircuitBreaker.snapshot
+        # precisely instead of duck-matching every `.snapshot` in the
+        # package.
+        self._attr_types: Dict[Tuple[str, str], str] = {}
+        # base class name -> direct subclasses (virtual dispatch:
+        # `self._on_replica_change()` in the base must reach every
+        # override, or overrides look caller-less).
+        self._subs: Dict[str, Set[str]] = {}
+        self._collect_universe_and_classes()
+        self.summaries: Dict[FuncKey, Summary] = {}
+        self._build_summaries()
+        self.in_edges: Dict[FuncKey, List[Edge]] = (
+            collections.defaultdict(list))
+        for key, summ in self.summaries.items():
+            for call in summ.calls:
+                for tgt in call.targets:
+                    self.in_edges[tgt].append(
+                        Edge(key, call.line, call.held,
+                             call.targets, call.deferred))
+        # may_entry[f]: lock -> provenance. Provenance is None when
+        # the lock comes from f's own `# holds:` annotation, else
+        # (caller key, call line, lexical: bool) — lexical True means
+        # the caller held it lexically AT the call site (chain ends
+        # there), False means it flowed from the caller's own entry.
+        self.may_entry: Dict[FuncKey, Dict[
+            str, Optional[Tuple[FuncKey, int, bool]]]] = {}
+        self._fixpoint_may()
+        # must_entry[f]: locks provably held at entry on EVERY
+        # resolved chain (annotation-trusted for root functions).
+        self.must_entry: Dict[FuncKey, FrozenSet[str]] = {}
+        self._fixpoint_must()
+
+    # -- construction ------------------------------------------------------
+    def _collect_universe_and_classes(self) -> None:
+        for src in self.files:
+            mod = src.rel.rsplit('/', 1)[-1][:-3]  # basename, no .py
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ClassDef):
+                    methods = {
+                        stmt.name: f'{walker.enclosing_qualname(node)}'
+                                   f'{"." if walker.enclosing_qualname(node) else ""}'
+                                   f'{node.name}.{stmt.name}'
+                        for stmt in node.body
+                        if isinstance(stmt, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))}
+                    bases = [b for b in
+                             (walker.dotted_name(e) for e in node.bases)
+                             if b is not None]
+                    self._classes.setdefault(node.name, []).append(
+                        (src.rel, methods, bases))
+                    for b in bases:
+                        self._subs.setdefault(
+                            b.rsplit('.', 1)[-1], set()).add(
+                            node.name)
+                    for name, qn in methods.items():
+                        self._methods.setdefault(name, []).append(
+                            (src.rel, qn))
+                elif isinstance(node, ast.Assign):
+                    self._note_lock_assign(node, src, mod)
+                    self._note_attr_type(node)
+
+    def _note_lock_assign(self, node: ast.Assign,
+                          src: core.SourceFile, mod: str) -> None:
+        if not isinstance(node.value, ast.Call):
+            return
+        factory = walker.call_name(node.value)
+        kind = _LOCK_FACTORIES.get(factory or '')
+        if kind is None:
+            return
+        for target in node.targets:
+            dotted = walker.dotted_name(target)
+            if dotted is None:
+                continue
+            if dotted.startswith('self.'):
+                cls = walker.enclosing_class(node)
+                lock_id = (f'{cls.name}.{dotted[5:]}' if cls is not None
+                           else dotted[5:])
+            elif '.' not in dotted:
+                lock_id = (f'{mod}.{dotted}'
+                           if walker.enclosing_function(node) is None
+                           else dotted)
+            else:
+                lock_id = base(dotted)
+            self.universe[lock_id] = (kind, src.rel)
+
+    def _note_attr_type(self, node: ast.Assign) -> None:
+        if not (isinstance(node.value, ast.Call)
+                and len(node.targets) == 1):
+            return
+        target = walker.dotted_name(node.targets[0])
+        if (target is None or not target.startswith('self.')
+                or target.count('.') != 1):
+            return
+        ctor = walker.call_name(node.value)
+        if ctor is None:
+            return
+        cls_name = ctor.rsplit('.', 1)[-1]
+        if not cls_name[:1].isupper():
+            return
+        owner = walker.enclosing_class(node)
+        if owner is None:
+            return
+        self._attr_types[(owner.name, target[5:])] = cls_name
+
+    def kind(self, lock: str) -> Optional[str]:
+        """Lock kind ('Lock'/'RLock'/'Condition'/'asyncio') or None
+        when unknown. Bare ids resolve only if every universe entry
+        with that base agrees on the kind."""
+        hit = self.universe.get(lock)
+        if hit is not None:
+            return hit[0]
+        kinds = {k for l, (k, _) in self.universe.items()
+                 if base(l) == base(lock)}
+        return kinds.pop() if len(kinds) == 1 else None
+
+    def declared_in(self, lock: str) -> Optional[str]:
+        hit = self.universe.get(lock)
+        return hit[1] if hit is not None else None
+
+    def declared_rels(self, lock: str) -> Set[str]:
+        """Every module that declares a lock matching ``lock`` — exact
+        id, or ALL same-base declarations for a bare annotation name
+        (`# holds: _lock` could be any `*._lock`; severity decisions
+        must fail closed over the candidates)."""
+        hit = self.universe.get(lock)
+        if hit is not None and hit[1]:
+            return {hit[1]}
+        return {rel for l, (_k, rel) in self.universe.items()
+                if rel and base(l) == base(lock)}
+
+    def _known_lock(self, lock_id: str) -> bool:
+        if lock_id == EVENT_LOOP:
+            return True
+        if lock_id in self.universe:
+            return True
+        return has_base(self.universe, lock_id)
+
+    def qualify(self, dotted: str, info: walker.FuncInfo) -> str:
+        """Map a held dotted expression to a lock id in ``info``'s
+        context: ``self.X`` -> ``Class.X``; a bare module-level name
+        -> ``module.X``; anything else -> bare attribute name."""
+        parts = dotted.split('.')
+        if parts[0] == 'self' and len(parts) == 2 and info.cls:
+            cand = f'{info.cls}.{parts[1]}'
+            if cand in self.universe:
+                return cand
+            # The class may inherit the lock from a base in another
+            # module; keep the class-qualified id anyway so ORDER
+            # nodes stay distinct per class.
+            return cand
+        if len(parts) == 1:
+            mod = info.src.rel.rsplit('/', 1)[-1][:-3]
+            cand = f'{mod}.{parts[0]}'
+            if cand in self.universe:
+                return cand
+            return parts[0]
+        return parts[-1]
+
+    def held_at(self, node: ast.AST,
+                info: walker.FuncInfo) -> List[Tuple[str, int]]:
+        """Qualified lock ids lexically held at ``node`` (filtered to
+        known locks / annotation names), in acquisition order."""
+        out: List[Tuple[str, int]] = []
+        for dotted, line in walker.held_lock_sites(node):
+            lock_id = self.qualify(dotted, info)
+            if self._known_lock(lock_id):
+                out.append((lock_id, line))
+        return out
+
+    def _build_summaries(self) -> None:
+        # Annotation names join the known-lock set so `# holds: foo`
+        # on a lockless helper still matches `with self.foo:` sites.
+        for key, info in self.funcs.items():
+            summ = Summary()
+            summ.is_async = isinstance(info.node, ast.AsyncFunctionDef)
+            summ.annotations = frozenset(
+                walker.holds_annotations(info.src, info.node))
+            self.summaries[key] = summ
+        for ann in {a for s in self.summaries.values()
+                    for a in s.annotations}:
+            if ann != EVENT_LOOP and not self._known_lock(ann):
+                self.universe.setdefault(ann, ('unknown', ''))
+        for key, info in self.funcs.items():
+            self._summarize(key, info)
+
+    def _summarize(self, key: FuncKey, info: walker.FuncInfo) -> None:
+        summ = self.summaries[key]
+        imports = walker.module_imports(info.src)
+        ext_names = walker.import_bound_names(info.src)
+        seen_acq: Set[Tuple[str, int]] = set()
+
+        def resolve_lock(node: ast.AST, dotted: str) -> Optional[str]:
+            aliases = walker.lock_aliases(
+                walker.enclosing_function(node))
+            head, _, rest = dotted.partition('.')
+            if head in aliases:
+                dotted = aliases[head] + (f'.{rest}' if rest else '')
+            lock_id = self.qualify(dotted, info)
+            return lock_id if self._known_lock(lock_id) else None
+
+        for node in walker.walk_function_body(info.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                # Items acquire left to right: item i's held-before is
+                # the outer context plus items 0..i-1 — NOT its later
+                # siblings (a naive same-line scan would read
+                # `with (a, b):` as both a->b and b->a, a fake cycle).
+                outer = [l for l, _ in self.held_at(node, info)]
+                sofar: List[str] = []
+                for item in node.items:
+                    for expr in walker._with_item_exprs(item):
+                        dotted = walker.dotted_name(expr)
+                        if dotted is None:
+                            continue
+                        lock_id = resolve_lock(node, dotted)
+                        if (lock_id is None
+                                or (lock_id, node.lineno) in seen_acq):
+                            continue
+                        seen_acq.add((lock_id, node.lineno))
+                        summ.acquires.append(Acquire(
+                            lock_id, node.lineno,
+                            tuple(outer + sofar)))
+                        sofar.append(lock_id)
+            elif isinstance(node, ast.Call):
+                cname = walker.call_name(node)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == 'acquire'
+                        and cname is not None):
+                    lock_id = resolve_lock(node,
+                                           cname.rsplit('.', 1)[0])
+                    if (lock_id is not None
+                            and (lock_id, node.lineno) not in seen_acq):
+                        seen_acq.add((lock_id, node.lineno))
+                        # held_at excludes this acquire itself (its
+                        # interval starts strictly after its line).
+                        summ.acquires.append(Acquire(
+                            lock_id, node.lineno,
+                            tuple(l for l, _ in
+                                  self.held_at(node, info))))
+                targets = self._resolve_call(node, info, imports,
+                                             ext_names)
+                if targets:
+                    held = frozenset(
+                        l for l, _ in self.held_at(node, info))
+                    if summ.is_async:
+                        held = held | {EVENT_LOOP}
+                    summ.calls.append(CallSite(
+                        tuple(sorted(set(targets))), node.lineno,
+                        held))
+            elif (isinstance(node, ast.Attribute)
+                  and isinstance(node.ctx, ast.Load)
+                  and isinstance(node.value, ast.Name)
+                  and node.value.id == 'self'
+                  and info.cls):
+                # A bare method REFERENCE (`key=self._normalized_load`,
+                # callbacks). Only a SYNCHRONOUS consumer (min/sorted/
+                # map ...) runs the callee while the reference site's
+                # locks are still held; a deferring consumer (executor
+                # .submit, threading.Timer, storing it) runs it after
+                # release — often on another thread — so its held set
+                # must NOT flow into the callee (the soundness hole a
+                # review caught: `with lock: pool.submit(self._flush)`
+                # must not prove _flush locked). asyncio deferrals
+                # keep event-loop confinement only.
+                parent = getattr(node, '_sky_parent', None)
+                if (isinstance(parent, ast.Call)
+                        and parent.func is node):
+                    continue   # a real call, handled above
+                targets = self._resolve_in_class(info.cls, node.attr,
+                                                 info.src.rel)
+                targets += self._override_targets(
+                    info.cls, node.attr, set(targets))
+                if targets:
+                    mode = self._ref_consumer_mode(node)
+                    if mode == 'sync':
+                        held = frozenset(
+                            l for l, _ in self.held_at(node, info))
+                        if summ.is_async:
+                            held = held | {EVENT_LOOP}
+                    elif mode == 'loop' and summ.is_async:
+                        held = frozenset({EVENT_LOOP})
+                    else:
+                        held = frozenset()
+                    summ.calls.append(CallSite(
+                        tuple(sorted(set(targets))), node.lineno,
+                        held, deferred=(mode != 'sync')))
+
+    @staticmethod
+    def _ref_consumer_mode(node: ast.AST) -> str:
+        """How a method reference's consumer runs it: 'sync' (on this
+        thread, locks still held), 'loop' (asyncio deferral — stays on
+        the event loop, threading locks released), or 'deferred'
+        (anything else: later and/or elsewhere)."""
+        parent = getattr(node, '_sky_parent', None)
+        if isinstance(parent, ast.keyword):
+            parent = getattr(parent, '_sky_parent', None)
+        if not isinstance(parent, ast.Call):
+            return 'deferred'   # stored / returned: runs later
+        consumer = walker.call_name(parent)
+        if consumer is None:
+            return 'deferred'
+        base_name = consumer.rsplit('.', 1)[-1]
+        if consumer in _SYNC_REF_CONSUMERS or (
+                base_name in _SYNC_REF_CONSUMERS and '.' not in consumer):
+            return 'sync'
+        if base_name in _LOOP_DEFER_CONSUMERS:
+            return 'loop'
+        return 'deferred'
+
+    # -- call resolution ---------------------------------------------------
+    def _resolve_call(self, node: ast.Call, info: walker.FuncInfo,
+                      imports: Dict[str, str],
+                      ext_names: Optional[Set[str]] = None
+                      ) -> List[FuncKey]:
+        func = node.func
+        # super().meth() -> base-class chain.
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Call)
+                and walker.call_name(func.value) == 'super'
+                and info.cls):
+            return self._resolve_in_bases(info.cls, func.attr,
+                                          info.src.rel)
+        name = walker.dotted_name(func)
+        if name is None:
+            return []
+        parts = name.split('.')
+        mod_funcs = self.index.get(info.src.rel, {})
+        if len(parts) == 1:
+            # Bare name: scope chain innermost-out (SKY-TRACE's rule).
+            prefix = info.qualname.split('.')
+            for depth in range(len(prefix), -1, -1):
+                cand = '.'.join(prefix[:depth] + [parts[0]])
+                if cand in mod_funcs:
+                    return [(info.src.rel, cand)]
+            return []
+        if parts[0] == 'self':
+            if len(parts) == 2 and info.cls:
+                hit = self._resolve_in_class(info.cls, parts[1],
+                                             info.src.rel)
+                hit += self._override_targets(info.cls, parts[1],
+                                              set(hit))
+                if hit:
+                    return hit
+            if len(parts) == 3 and info.cls:
+                # self.attr.meth() with attr's class known from its
+                # constructor assignment: resolve precisely.
+                attr_cls = self._attr_types.get((info.cls, parts[1]))
+                if attr_cls is not None:
+                    hit = self._resolve_in_class(attr_cls, parts[2],
+                                                 info.src.rel)
+                    if hit:
+                        return hit
+            return self._duck(parts[-1],
+                              parts[-2] if len(parts) >= 2 else None)
+        # alias.func() / alias.Class.meth() through imports.
+        target_rel = imports.get(parts[0])
+        if target_rel is not None:
+            rest = parts[1:]
+            if len(rest) == 1 and rest[0] in self.index.get(
+                    target_rel, {}):
+                return [(target_rel, rest[0])]
+            if len(rest) == 2:
+                qn = '.'.join(rest)
+                if qn in self.index.get(target_rel, {}):
+                    return [(target_rel, qn)]
+            return []
+        # ClassName.meth() in the same module (or imported name).
+        if len(parts) == 2 and parts[0] in self._classes:
+            hit = self._resolve_in_class(parts[0], parts[1], None)
+            if hit:
+                return hit
+        # A receiver that is an imported EXTERNAL module (os, np,
+        # requests, ...) is not one of our objects — duck dispatch on
+        # `os.path.exists()` would wire `GcsStore.exists` into the
+        # config loader's call graph.
+        if ext_names is not None and parts[0] in ext_names:
+            return []
+        # `f.g()` with f a local object falls through to duck
+        # dispatch on the method name.
+        return self._duck(parts[-1],
+                          parts[-2] if len(parts) >= 2 else None)
+
+    def _resolve_in_class(self, cls: str, meth: str,
+                          prefer_rel: Optional[str]) -> List[FuncKey]:
+        entries = self._classes.get(cls, [])
+        if prefer_rel is not None:
+            entries = sorted(entries,
+                             key=lambda e: e[0] != prefer_rel)
+        for rel, methods, bases in entries:
+            if meth in methods:
+                return [(rel, methods[meth])]
+        # Walk base classes (first entry's bases).
+        for rel, methods, bases in entries[:1]:
+            for b in bases:
+                b_cls = b.rsplit('.', 1)[-1]
+                if b_cls != cls and b_cls in self._classes:
+                    hit = self._resolve_in_bases(b_cls, meth, rel,
+                                                 _self_ok=True)
+                    if hit:
+                        return hit
+        return []
+
+    def _resolve_in_bases(self, cls: str, meth: str,
+                          rel: Optional[str],
+                          _self_ok: bool = False) -> List[FuncKey]:
+        """Resolve ``meth`` in ``cls``'s base classes (or ``cls``
+        itself when ``_self_ok``)."""
+        if _self_ok:
+            return self._resolve_in_class(cls, meth, rel)
+        for entry_rel, methods, bases in self._classes.get(cls, []):
+            for b in bases:
+                b_cls = b.rsplit('.', 1)[-1]
+                if b_cls != cls and b_cls in self._classes:
+                    hit = self._resolve_in_class(b_cls, meth,
+                                                 entry_rel)
+                    if hit:
+                        return hit
+        return []
+
+    def _override_targets(self, cls: str, meth: str,
+                          have: Set[FuncKey],
+                          depth: int = 4) -> List[FuncKey]:
+        """Virtual dispatch: overrides of ``meth`` in transitive
+        subclasses of ``cls`` (bounded depth)."""
+        out: List[FuncKey] = []
+        frontier = {cls}
+        seen = {cls}
+        for _ in range(depth):
+            nxt: Set[str] = set()
+            for c in frontier:
+                for sub in self._subs.get(c, ()):
+                    if sub in seen:
+                        continue
+                    seen.add(sub)
+                    nxt.add(sub)
+                    for rel, methods, _bases in self._classes.get(
+                            sub, []):
+                        if meth in methods:
+                            key = (rel, methods[meth])
+                            if key not in have:
+                                have.add(key)
+                                out.append(key)
+            frontier = nxt
+            if not frontier:
+                break
+        return out
+
+    def _duck(self, meth: str,
+              receiver: Optional[str] = None) -> List[FuncKey]:
+        """Duck dispatch: every class method named ``meth`` in the
+        scanned set, unless the name is a generic verb or defined too
+        widely. This is how `self._sched.pop_next()` reaches every
+        scheduler policy and `pool.submit()` reaches the engine.
+
+        When the receiver's name is descriptive (``self._sched.…``,
+        ``breaker.…``) and matches a strict subset of the candidate
+        classes, dispatch narrows to that subset — `sched_snapshot`'s
+        ``self._sched.snapshot()`` must not wire the engine lock into
+        ``CircuitBreaker.snapshot``."""
+        if meth in _DUCK_DENY or meth.startswith('__'):
+            return []
+        candidates = self._methods.get(meth, [])
+        if not candidates or len(candidates) > _DUCK_LIMIT:
+            return []
+        hint = (receiver or '').strip('_').lower()
+        if len(hint) >= 4:
+            hinted = [
+                k for k in candidates
+                if hint in k[1].rsplit('.', 2)[-2].lower()]
+            if hinted:
+                return hinted
+        return list(candidates)
+
+    # -- fixpoints ---------------------------------------------------------
+    def _entry_locks(self, key: FuncKey) -> Set[str]:
+        summ = self.summaries[key]
+        out = set(self.may_entry.get(key, {}))
+        out.update(summ.annotations)
+        if summ.is_async:
+            out.add(EVENT_LOOP)
+        return out
+
+    def _fixpoint_may(self) -> None:
+        for key, summ in self.summaries.items():
+            self.may_entry[key] = {a: None for a in summ.annotations}
+        work = collections.deque(self.summaries)
+        while work:
+            key = work.popleft()
+            summ = self.summaries[key]
+            entry = self._entry_locks(key)
+            for call in summ.calls:
+                # A deferred callback does not inherit its creator's
+                # lock context — only the (already-stripped) site held
+                # set crosses the edge, never the caller's entry set.
+                effective = (set(call.held) if call.deferred
+                             else set(call.held) | entry)
+                for tgt in call.targets:
+                    m = self.may_entry.get(tgt)
+                    if m is None:
+                        continue
+                    added = False
+                    for lock in effective:
+                        if lock not in m:
+                            m[lock] = (key, call.line,
+                                       lock in call.held)
+                            added = True
+                    if added:
+                        work.append(tgt)
+
+    def _fixpoint_must(self) -> None:
+        TOP = None   # sentinel: not yet constrained (= universe)
+        must: Dict[FuncKey, Optional[FrozenSet[str]]] = {}
+        for key, summ in self.summaries.items():
+            extra = ({EVENT_LOOP} if summ.is_async else set())
+            if not self.in_edges.get(key):
+                must[key] = frozenset(summ.annotations | extra)
+            else:
+                must[key] = TOP
+        # Monotone-decreasing iteration from TOP: a caller leaving TOP
+        # adds an intersection member (shrinks), a caller's must-set
+        # shrinking shrinks its contribution — so plain recompute-
+        # until-stable terminates in the finite lock lattice.
+        changed = True
+        while changed:
+            changed = False
+            for key in self.summaries:
+                edges = self.in_edges.get(key)
+                if not edges:
+                    continue
+                contribs: List[Set[str]] = []
+                for e in edges:
+                    if e.deferred:
+                        # The callback runs later/elsewhere: the
+                        # caller's must-set and annotations say
+                        # nothing about the callee's entry context.
+                        contribs.append(set(e.held))
+                        continue
+                    caller_must = must.get(e.caller)
+                    if caller_must is TOP:
+                        continue   # optimistic: unconstrained yet
+                    caller_ann = (self.summaries[e.caller].annotations
+                                  if e.caller in self.summaries
+                                  else frozenset())
+                    contribs.append(set(e.held) | set(caller_must)
+                                    | set(caller_ann))
+                if not contribs:
+                    continue
+                new: Set[str] = set.intersection(*contribs)
+                if self.summaries[key].is_async:
+                    new.add(EVENT_LOOP)
+                new |= set(self.summaries[key].annotations)
+                frozen = frozenset(new)
+                if must[key] is TOP or frozen != must[key]:
+                    must[key] = frozen
+                    changed = True
+        for key, val in must.items():
+            if val is TOP:
+                val = frozenset(self.summaries[key].annotations)
+            self.must_entry[key] = val
+
+    # -- chain reporting ---------------------------------------------------
+    def qualname(self, key: FuncKey) -> str:
+        return key[1]
+
+    def holding_chain(self, key: FuncKey, lock: str,
+                      limit: int = 8) -> List[str]:
+        """Why might ``lock`` be held at ``key``'s entry — the caller
+        chain from the acquiring frame down to ``key``."""
+        names = [self.qualname(key)]
+        cur = key
+        for _ in range(limit):
+            prov = self.may_entry.get(cur, {}).get(lock)
+            if prov is None:
+                break
+            caller, _line, lexical = prov
+            names.append(self.qualname(caller))
+            if lexical:
+                break
+            cur = caller
+        return list(reversed(names))
+
+    def unlocked_chain(self, key: FuncKey, lock: str,
+                       limit: int = 8) -> List[str]:
+        """An example call chain reaching ``key`` on which ``lock`` is
+        NOT held — the witness for a must-hold violation."""
+        path = [key]
+        cur = key
+        seen = {key}
+        for _ in range(limit):
+            edges = self.in_edges.get(cur, [])
+            pick = None
+            for e in sorted(edges, key=lambda e: (e.caller, e.line)):
+                if e.caller in seen or e.caller not in self.summaries:
+                    continue
+                caller_locks = set(e.held)
+                if not e.deferred:
+                    caller_locks |= set(self.must_entry.get(
+                        e.caller, frozenset()))
+                    caller_locks |= set(self.summaries[
+                        e.caller].annotations)
+                if not has_base(caller_locks, lock):
+                    pick = e
+                    break
+            if pick is None:
+                break
+            path.append(pick.caller)
+            seen.add(pick.caller)
+            cur = pick.caller
+        return [self.qualname(k) for k in reversed(path)]
+
+
+# -- memoization ------------------------------------------------------------
+
+_MEMO: 'collections.OrderedDict[Tuple, LockFlow]' = (
+    collections.OrderedDict())
+_MEMO_LIMIT = 4
+
+
+def analyze(files: Sequence[core.SourceFile]) -> LockFlow:
+    """Memoized whole-set analysis. SourceFile objects are cached by
+    (mtime, size) in core.load_files, so object identity doubles as a
+    content signature for the incremental path."""
+    sig = tuple(sorted((s.rel, id(s)) for s in files))
+    flow = _MEMO.get(sig)
+    if flow is None:
+        flow = LockFlow(files)
+        _MEMO[sig] = flow
+        while len(_MEMO) > _MEMO_LIMIT:
+            _MEMO.popitem(last=False)
+    else:
+        _MEMO.move_to_end(sig)
+    return flow
+
+
+def clear_memo() -> None:
+    _MEMO.clear()

@@ -1,0 +1,221 @@
+"""SKY-ORDER: global lock-acquisition-order discipline.
+
+Deadlock by lock-order inversion is the highest-severity latent bug
+class in a system whose step thread, HTTP handler threads, LB event
+loop and lockstep drivers all share locks: thread 1 acquires A then
+B, thread 2 acquires B then A, and both park forever. The inversion
+is invisible to lexical checks because the two acquisitions usually
+live in different functions — PR 7/8 added exactly such lock-crossing
+call chains (engine -> scheduler -> policy dispatch).
+
+On top of the lock-flow dataflow (lockflow.py) this checker builds
+the global acquisition-order graph: an edge ``A -> B`` whenever B is
+acquired while A may be held — lexically nested ``with`` blocks, or
+transitively (a call made under A reaches a function that acquires
+B). Findings:
+
+1. **Cycles** in the graph (potential deadlock): reported once per
+   cycle, at the lexicographically-first contributing acquisition
+   site, with the full edge list and an example call chain per edge.
+2. **Re-entrant acquisition of a non-reentrant lock**: acquiring L
+   while L may already be held, when L is a plain ``threading.Lock``
+   (or ``multiprocessing.Lock``). ``RLock``/``Condition`` (which
+   wraps an RLock) are exempt; locks whose kind cannot be determined
+   statically are skipped rather than guessed.
+3. **Canonical-order violations**: ``analysis/allowlist.py`` may
+   declare ``LOCK_ORDER``, the audited global acquisition order. Any
+   edge contradicting it fails even before a full cycle closes — the
+   ratchet that keeps a second inversion from ever landing.
+
+The pseudo-lock ``event-loop`` (asyncio confinement) never
+participates: it is not a mutex.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import lockflow
+
+
+class _OrderEdge:
+    __slots__ = ('src', 'dst', 'path', 'line', 'chain')
+
+    def __init__(self, src: str, dst: str, path: str, line: int,
+                 chain: List[str]) -> None:
+        self.src = src
+        self.dst = dst
+        self.path = path
+        self.line = line
+        self.chain = chain
+
+
+class OrderChecker(core.Checker):
+    code = 'SKY-ORDER'
+    title = ('lock acquisition order is globally acyclic and '
+             'non-reentrant locks are never re-acquired')
+
+    def __init__(self,
+                 lock_order: Optional[Sequence[str]] = None) -> None:
+        if lock_order is None:
+            from skypilot_tpu.analysis import allowlist
+            lock_order = getattr(allowlist, 'LOCK_ORDER', ())
+        self.lock_order = list(lock_order)
+
+    def check(self, files: Sequence[core.SourceFile],
+              ctx: core.RunContext) -> Iterable[core.Finding]:
+        flow = lockflow.analyze(files)
+        edges: Dict[Tuple[str, str], _OrderEdge] = {}
+        for key, summ in flow.summaries.items():
+            info = flow.funcs[key]
+            entry = flow._entry_locks(key)
+            for acq in summ.acquires:
+                if flow.kind(acq.lock) == 'asyncio':
+                    # asyncio primitives are loop-confined; mixing
+                    # them into the THREAD deadlock graph only adds
+                    # noise (they cannot park an OS thread).
+                    continue
+                prior = set(acq.held_before) | entry
+                prior.discard(lockflow.EVENT_LOOP)
+                prior = {p for p in prior
+                         if flow.kind(p) != 'asyncio'}
+                yield from self._check_reentry(flow, info, acq, prior)
+                for p in sorted(prior):
+                    if p == acq.lock:
+                        continue
+                    edge_key = (p, acq.lock)
+                    if edge_key in edges:
+                        continue
+                    chain = (flow.holding_chain(key, p)
+                             if p not in acq.held_before
+                             else [info.qualname])
+                    edges[edge_key] = _OrderEdge(
+                        p, acq.lock, info.src.rel, acq.line, chain)
+        yield from self._check_canonical(edges)
+        yield from self._check_cycles(edges)
+
+    # -- re-entrancy -------------------------------------------------------
+    def _check_reentry(self, flow: 'lockflow.LockFlow', info, acq,
+                       prior) -> Iterable[core.Finding]:
+        already = [p for p in prior
+                   if p == acq.lock
+                   or (lockflow.base(p) == lockflow.base(acq.lock)
+                       and ('.' not in p or '.' not in acq.lock))]
+        if not already:
+            return
+        kind = flow.kind(acq.lock)
+        if kind in (None, 'RLock', 'Condition', 'asyncio'):
+            return
+        held_via = already[0]
+        chain = (flow.holding_chain(info.key, held_via)
+                 if held_via not in acq.held_before
+                 else [info.qualname])
+        yield core.Finding(
+            self.code, info.src.rel, acq.line,
+            f're-entrant acquisition of non-reentrant lock '
+            f'{acq.lock} (threading.Lock) in {info.qualname} — the '
+            f'second acquire self-deadlocks; use RLock or hoist the '
+            f'inner acquisition out of the held region',
+            chain=tuple(chain))
+
+    # -- canonical order ---------------------------------------------------
+    def _order_index(self, lock: str) -> Optional[int]:
+        for i, entry in enumerate(self.lock_order):
+            if entry == lock or (
+                    lockflow.base(entry) == lockflow.base(lock)
+                    and ('.' not in entry or '.' not in lock)):
+                return i
+        return None
+
+    def _check_canonical(self, edges: Dict[Tuple[str, str],
+                                           _OrderEdge]
+                         ) -> Iterable[core.Finding]:
+        for (src, dst), e in sorted(edges.items()):
+            i, j = self._order_index(src), self._order_index(dst)
+            if i is None or j is None or i <= j:
+                continue
+            yield core.Finding(
+                self.code, e.path, e.line,
+                f'acquisition order {src} -> {dst} contradicts the '
+                f'canonical LOCK_ORDER (analysis/allowlist.py ranks '
+                f'{dst} before {src}) — a thread honoring the '
+                f'canonical order can deadlock against this path',
+                chain=tuple(e.chain))
+
+    # -- cycles ------------------------------------------------------------
+    def _check_cycles(self, edges: Dict[Tuple[str, str], _OrderEdge]
+                      ) -> Iterable[core.Finding]:
+        graph: Dict[str, List[str]] = {}
+        for (src, dst) in edges:
+            graph.setdefault(src, []).append(dst)
+            graph.setdefault(dst, [])
+        for scc in _sccs(graph):
+            if len(scc) < 2:
+                continue
+            members = sorted(scc)
+            cyc_edges = sorted(
+                (e for (s, d), e in edges.items()
+                 if s in scc and d in scc),
+                key=lambda e: (e.path, e.line))
+            site = cyc_edges[0]
+            detail = '; '.join(
+                f'{e.src} -> {e.dst} at {e.path}:{e.line} '
+                f'(via {" -> ".join(e.chain)})'
+                for e in cyc_edges[:4])
+            yield core.Finding(
+                self.code, site.path, site.line,
+                f'lock-order cycle {{{", ".join(members)}}} — '
+                f'potential deadlock: {detail}. Pick one global '
+                f'order, refactor the inverted path, and document it '
+                f'in LOCK_ORDER (analysis/allowlist.py)',
+                chain=tuple(site.chain))
+
+
+def _sccs(graph: Dict[str, List[str]]) -> List[List[str]]:
+    """Tarjan's strongly-connected components, iterative."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(graph):
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack[node] = True
+            advanced = False
+            succs = graph.get(node, [])
+            while pi < len(succs):
+                succ = succs[pi]
+                pi += 1
+                if succ not in index:
+                    work[-1] = (node, pi)
+                    work.append((succ, 0))
+                    advanced = True
+                    break
+                if on_stack.get(succ):
+                    low[node] = min(low[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index[node]:
+                comp: List[str] = []
+                while True:
+                    top = stack.pop()
+                    on_stack[top] = False
+                    comp.append(top)
+                    if top == node:
+                        break
+                out.append(comp)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return out

@@ -53,78 +53,15 @@ _STRUCTURAL_ATTRS = frozenset(('shape', 'dtype', 'ndim', 'size',
 _STRUCTURAL_CALLS = frozenset(('len', 'isinstance', 'getattr',
                                'hasattr', 'range', 'type'))
 
-# (module rel path, function qualname) — qualname is dotted nesting,
-# e.g. 'InferenceEngine.__init__._decode_paged'.
-FuncKey = Tuple[str, str]
-
-
-class _FuncInfo:
-    def __init__(self, src: core.SourceFile, node: ast.AST,
-                 qualname: str) -> None:
-        self.src = src
-        self.node = node
-        self.qualname = qualname
-
-
-def _index_functions(files: Sequence[core.SourceFile]
-                     ) -> Dict[str, Dict[str, _FuncInfo]]:
-    """module rel -> {qualname -> info} for every (nested) def."""
-    out: Dict[str, Dict[str, _FuncInfo]] = {}
-    for src in files:
-        funcs: Dict[str, _FuncInfo] = {}
-
-        def visit(node: ast.AST, prefix: str) -> None:
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef)):
-                    qn = (f'{prefix}.{child.name}' if prefix
-                          else child.name)
-                    funcs[qn] = _FuncInfo(src, child, qn)
-                    visit(child, qn)
-                elif isinstance(child, ast.ClassDef):
-                    visit(child, (f'{prefix}.{child.name}' if prefix
-                                  else child.name))
-                else:
-                    visit(child, prefix)
-
-        visit(src.tree, '')
-        out[src.rel] = funcs
-    return out
-
-
-def _imports(src: core.SourceFile) -> Dict[str, str]:
-    """alias -> candidate module rel path. The leading dotted
-    component is the package name (whatever the scanned root is
-    called), so it is stripped; aliases that do not resolve to a
-    scanned file simply yield no callees (jnp, np, ...)."""
-    out: Dict[str, str] = {}
-    for node in ast.walk(src.tree):
-        if isinstance(node, ast.ImportFrom):
-            if not node.module or node.level:
-                continue
-            parts = node.module.split('.')
-            base = '/'.join(parts[1:])
-            for alias in node.names:
-                target = (f'{base}/{alias.name}.py' if base
-                          else f'{alias.name}.py')
-                out[alias.asname or alias.name] = target
-        elif isinstance(node, ast.Import):
-            for alias in node.names:
-                parts = alias.name.split('.')
-                if len(parts) < 2:
-                    continue
-                rel = '/'.join(parts[1:]) + '.py'
-                out[alias.asname or parts[0]] = rel
-    return out
-
-
-def _enclosing_qualname(node: ast.AST) -> str:
-    parts: List[str] = []
-    for p in walker.parents(node):
-        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
-                          ast.ClassDef)):
-            parts.append(p.name)
-    return '.'.join(reversed(parts))
+# Shared with the lock-flow pass — the call-graph index, import
+# resolution and qualname helpers live in walker.py now. The old
+# underscore names stay as aliases (tests and downstream callers use
+# them as the canonical entry points).
+FuncKey = walker.FuncKey
+_FuncInfo = walker.FuncInfo
+_index_functions = walker.index_functions
+_imports = walker.module_imports
+_enclosing_qualname = walker.enclosing_qualname
 
 
 class TraceChecker(core.Checker):

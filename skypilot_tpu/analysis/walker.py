@@ -6,13 +6,26 @@ locks are lexically held (``with self._lock:``), what does a call
 resolve to, is an attribute access a mutation. They live here once;
 checkers stay declarative.
 
+Lock-holding detection covers three idioms, each of which burned a
+real checker blind spot (the PR 10 walker bugfix sweep):
+
+- aliasing: ``lock = self._lock`` followed by ``with lock:`` counts
+  as holding ``_lock`` (the alias map is per-function);
+- manual ``try/finally`` pairs: ``self._lock.acquire()`` …
+  ``self._lock.release()`` hold the lock for every statement between
+  the acquire and the first matching release (line-interval
+  approximation — sound for the straight-line try/finally idiom);
+- parenthesized multi-item ``with (a, b):``, which parses as ONE
+  withitem whose context expression is a Tuple on 3.9/3.10 grammars.
+
 Parent links (``_sky_parent``) are attached by
 :class:`core.SourceFile` at parse time.
 """
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List, Optional, Set
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from skypilot_tpu.analysis import core
 
@@ -72,24 +85,126 @@ def call_name(call: ast.Call) -> Optional[str]:
     return dotted_name(call.func)
 
 
-def held_locks(node: ast.AST) -> Set[str]:
-    """Attribute names of every context manager lexically held at
-    ``node`` within its own function: ``with self._lock:`` (or any
-    ``with <expr>.<name>:``) contributes ``<name>``. Stops at the
-    function boundary — a ``with`` in an outer function does not
-    cover a nested def's body."""
-    held: Set[str] = set()
+def lock_aliases(func: Optional[ast.AST]) -> Dict[str, str]:
+    """Per-function map of local alias -> dotted source expression for
+    simple rebinding assignments (``lock = self._lock``). Chains
+    resolve through up to three hops (``a = self._lock; b = a``).
+    Memoized on the function node (checkers ask per-node; the scan is
+    per-function)."""
+    out: Dict[str, str] = {}
+    if func is None or not isinstance(func, _FUNC_TYPES):
+        return out
+    cached = getattr(func, '_sky_lock_aliases', None)
+    if cached is not None:
+        return cached
+    for node in walk_function_body(func):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        value = dotted_name(node.value)
+        if value is not None and value != target.id:
+            out[target.id] = value
+    for _ in range(3):
+        changed = False
+        for alias, expr in list(out.items()):
+            head, _, rest = expr.partition('.')
+            if head in out and head != alias:
+                out[alias] = out[head] + (f'.{rest}' if rest else '')
+                changed = True
+        if not changed:
+            break
+    func._sky_lock_aliases = out   # type: ignore[attr-defined]
+    return out
+
+
+def _with_item_exprs(item: ast.withitem) -> List[ast.AST]:
+    """Expressions a withitem holds — the context expr itself, or each
+    element of a parenthesized ``with (a, b):`` Tuple (which the
+    3.9/3.10 grammar parses as a single Tuple-valued item)."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Tuple):
+        return list(expr.elts)
+    return [expr]
+
+
+def held_lock_sites(node: ast.AST) -> List[Tuple[str, int]]:
+    """``(dotted lock expr, acquisition line)`` for every context
+    manager lexically held at ``node`` within its own function, in
+    acquisition (line) order. Covers ``with``/``async with`` blocks
+    (including tuple items), alias-resolved names (``lock =
+    self._lock; with lock:``), and manual ``.acquire()`` calls whose
+    first subsequent ``.release()`` (or the function end) lies beyond
+    ``node``. Stops at the function boundary."""
+    func = enclosing_function(node)
+    aliases = lock_aliases(func)
+
+    def resolve(expr: ast.AST) -> Optional[str]:
+        dotted = dotted_name(expr)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition('.')
+        if head in aliases:
+            return aliases[head] + (f'.{rest}' if rest else '')
+        return dotted
+
+    held: List[Tuple[str, int]] = []
     for p in parents(node):
         if isinstance(p, _FUNC_TYPES):
             break
         if isinstance(p, (ast.With, ast.AsyncWith)):
             for item in p.items:
-                expr = item.context_expr
-                if isinstance(expr, ast.Attribute):
-                    held.add(expr.attr)
-                elif isinstance(expr, ast.Name):
-                    held.add(expr.id)
-    return held
+                for expr in _with_item_exprs(item):
+                    dotted = resolve(expr)
+                    if dotted is not None:
+                        held.append((dotted, p.lineno))
+    lineno = getattr(node, 'lineno', None)
+    if func is not None and lineno is not None:
+        cached = getattr(func, '_sky_acqrel', None)
+        if cached is None:
+            acquires: List[Tuple[int, str]] = []
+            releases: List[Tuple[int, str]] = []
+            for sub in walk_function_body(func):
+                if not (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)):
+                    continue
+                if sub.func.attr not in ('acquire', 'release'):
+                    continue
+                base = resolve(sub.func.value)
+                if base is None:
+                    continue
+                (acquires if sub.func.attr == 'acquire'
+                 else releases).append((sub.lineno, base))
+            func._sky_acqrel = (   # type: ignore[attr-defined]
+                acquires, releases)
+        else:
+            acquires, releases = cached
+        for acq_line, base in acquires:
+            if acq_line >= lineno:
+                continue
+            rel_line = min((ln for ln, b in releases
+                            if b == base and ln > acq_line),
+                           default=None)
+            if rel_line is None or lineno <= rel_line:
+                if not any(b == base for b, _ in held):
+                    held.append((base, acq_line))
+    return sorted(set(held), key=lambda pair: pair[1])
+
+
+def held_locks(node: ast.AST) -> Set[str]:
+    """Attribute names of every context manager lexically held at
+    ``node`` within its own function: ``with self._lock:`` (or any
+    ``with <expr>.<name>:``, an aliased ``with lock:``, a manual
+    ``acquire()/release()`` interval, or a tuple item of
+    ``with (a, b):``) contributes ``<name>``. Stops at the function
+    boundary — a ``with`` in an outer function does not cover a
+    nested def's body."""
+    return {dotted.rsplit('.', 1)[-1]
+            for dotted, _ in held_lock_sites(node)}
+
+
+_HOLDS_NAME = re.compile(r'^[A-Za-z_][A-Za-z0-9_\-]*$')
 
 
 def holds_annotations(src: 'core.SourceFile',
@@ -98,7 +213,11 @@ def holds_annotations(src: 'core.SourceFile',
     in the function header (the ``def`` line through the line of the
     first body statement). The annotation documents a calling
     contract — "every caller already holds this" — for helpers that
-    mutate guarded state without taking the lock themselves."""
+    mutate guarded state without taking the lock themselves.
+
+    Tokens must be identifiers (or ``event-loop``): a docstring that
+    *mentions* the annotation syntax (``# holds: <name>``) must not
+    read as a real annotation now that annotations are verified."""
     names: Set[str] = set()
     if not isinstance(func, _FUNC_TYPES) or not func.body:
         return names
@@ -110,7 +229,7 @@ def holds_annotations(src: 'core.SourceFile',
             continue
         for tok in line[idx + len(marker):].split(','):
             tok = tok.strip()
-            if tok:
+            if tok and _HOLDS_NAME.match(tok):
                 names.add(tok)
     return names
 
@@ -149,3 +268,114 @@ def walk_function_body(func: ast.AST,
 
 def names_in(node: ast.AST) -> Set[str]:
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+# ---------------------------------------------------------------------------
+# Whole-package call-graph machinery (shared by SKY-TRACE and the
+# interprocedural lock-flow pass)
+# ---------------------------------------------------------------------------
+
+# (module rel path, function qualname) — qualname is dotted nesting,
+# e.g. 'InferenceEngine.__init__._decode_paged'.
+FuncKey = Tuple[str, str]
+
+
+class FuncInfo:
+    """One (possibly nested) function def: its module, AST node,
+    dotted qualname, and the name of its directly-enclosing class (for
+    ``self.`` resolution), if any."""
+
+    def __init__(self, src: 'core.SourceFile', node: ast.AST,
+                 qualname: str, cls: Optional[str] = None) -> None:
+        self.src = src
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls
+
+    @property
+    def key(self) -> FuncKey:
+        return (self.src.rel, self.qualname)
+
+
+def index_functions(files) -> Dict[str, Dict[str, FuncInfo]]:
+    """module rel -> {qualname -> FuncInfo} for every (nested) def."""
+    out: Dict[str, Dict[str, FuncInfo]] = {}
+    for src in files:
+        funcs: Dict[str, FuncInfo] = {}
+
+        def visit(node: ast.AST, prefix: str,
+                  cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_TYPES):
+                    qn = (f'{prefix}.{child.name}' if prefix
+                          else child.name)
+                    funcs[qn] = FuncInfo(src, child, qn, cls)
+                    visit(child, qn, None)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, (f'{prefix}.{child.name}' if prefix
+                                  else child.name), child.name)
+                else:
+                    visit(child, prefix, cls)
+
+        visit(src.tree, '', None)
+        out[src.rel] = funcs
+    return out
+
+
+def module_imports(src: 'core.SourceFile') -> Dict[str, str]:
+    """alias -> candidate module rel path. The leading dotted
+    component is the package name (whatever the scanned root is
+    called), so it is stripped; aliases that do not resolve to a
+    scanned file simply yield no callees (jnp, np, ...). Memoized on
+    the SourceFile (callers ask per-function; the walk is
+    per-module)."""
+    cached = getattr(src, '_sky_imports', None)
+    if cached is not None:
+        return cached
+    out: Dict[str, str] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom):
+            if not node.module or node.level:
+                continue
+            parts = node.module.split('.')
+            base = '/'.join(parts[1:])
+            for alias in node.names:
+                target = (f'{base}/{alias.name}.py' if base
+                          else f'{alias.name}.py')
+                out[alias.asname or alias.name] = target
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                parts = alias.name.split('.')
+                if len(parts) < 2:
+                    continue
+                rel = '/'.join(parts[1:]) + '.py'
+                out[alias.asname or parts[0]] = rel
+    src._sky_imports = out   # type: ignore[attr-defined]
+    return out
+
+
+def import_bound_names(src: 'core.SourceFile') -> Set[str]:
+    """EVERY name bound by an import statement in the module —
+    including externals (`os`, `np`, `requests`) that
+    :func:`module_imports` cannot resolve to a scanned file. Call
+    resolution uses this to refuse duck dispatch on
+    ``os.path.exists()``-style calls (the receiver is a module, not
+    one of our objects). Memoized on the SourceFile."""
+    cached = getattr(src, '_sky_ext_names', None)
+    if cached is not None:
+        return cached
+    out: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                out.add(alias.asname or alias.name.split('.')[0])
+    src._sky_ext_names = out   # type: ignore[attr-defined]
+    return out
+
+
+def enclosing_qualname(node: ast.AST) -> str:
+    parts: List[str] = []
+    for p in parents(node):
+        if isinstance(p, (_FUNC_TYPES + (ast.ClassDef,))):
+            parts.append(p.name)
+    return '.'.join(reversed(parts))

@@ -379,6 +379,37 @@ def cost_report() -> None:
                               f"{r['cost']:.2f}"))
 
 
+def _changed_lint_paths() -> frozenset:
+    """Package-relative paths of files changed vs git (worktree diff
+    against HEAD + untracked), for `sky-tpu lint --changed`."""
+    import subprocess
+
+    import skypilot_tpu
+    pkg_root = os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
+    repo_root = os.path.dirname(pkg_root)
+    pkg_name = os.path.basename(pkg_root)
+    try:
+        diff = subprocess.run(
+            ['git', '-C', repo_root, 'diff', '--name-only', 'HEAD'],
+            capture_output=True, text=True, check=True)
+        untracked = subprocess.run(
+            ['git', '-C', repo_root, 'ls-files', '--others',
+             '--exclude-standard'],
+            capture_output=True, text=True, check=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, 'stderr', '') or str(e)
+        raise click.ClickException(
+            f'--changed needs a git worktree: {detail.strip()}') from e
+    out = set()
+    for line in (diff.stdout + untracked.stdout).splitlines():
+        line = line.strip()
+        if line.startswith(f'{pkg_name}/') and line.endswith('.py'):
+            out.add(line[len(pkg_name) + 1:])
+        elif line.startswith('docs/') and line.endswith('.md'):
+            out.add(line)
+    return frozenset(out)
+
+
 @cli.command('lint')
 @click.argument('path', required=False)
 @click.option('--json', 'as_json', is_flag=True, default=False,
@@ -389,24 +420,46 @@ def cost_report() -> None:
 @click.option('--no-allowlist', is_flag=True, default=False,
               help='Ignore the audited allowlist: report, and fail '
                    'on, every finding.')
+@click.option('--changed', is_flag=True, default=False,
+              help='Report only findings in files changed vs git '
+                   '(diff against HEAD + untracked). The whole '
+                   'package is still parsed — the interprocedural '
+                   'passes need the full call graph — but the '
+                   'parsed-module cache makes the re-scan cheap.')
 def lint_cmd(path: Optional[str], as_json: bool, verbose: bool,
-             no_allowlist: bool) -> None:
+             no_allowlist: bool, changed: bool) -> None:
     """Run the AST-based invariant checkers over the package.
 
-    Five checkers (docs/static-analysis.md): SKY-LOCK (guarded-field
-    lock discipline), SKY-ASYNC (no blocking calls / sleep-polls in
-    async and hot paths), SKY-EXCEPT (no swallowed reset/cancellation
-    in serve/infer network paths), SKY-TRACE (no concretization or
-    data-dependent branching in jit-reachable code), SKY-REGISTRY
-    (failpoint sites + serving-metric keys in sync with the docs
-    catalogs). PATH narrows the scan to one file or subtree (default:
-    the whole installed package). Exits non-zero on any finding
-    beyond the audited allowlist, or on a stale allowlist entry.
+    Checkers (docs/static-analysis.md): SKY-LOCK (guarded-field lock
+    discipline, interprocedural: `# holds:` annotations verified
+    against real callers), SKY-ORDER (global lock-acquisition-order
+    cycles + re-entrant non-reentrant acquisition), SKY-HOLD (no
+    blocking operations — await/sleep/net/subprocess/device readback —
+    while a lock is held), SKY-ASYNC (no blocking calls / sleep-polls
+    in async and hot paths), SKY-EXCEPT (no swallowed reset/
+    cancellation in serve/infer network paths), SKY-TRACE (no
+    concretization or data-dependent branching in jit-reachable
+    code), SKY-REGISTRY (failpoint sites + serving-metric keys in
+    sync with the docs catalogs). PATH narrows the scan to one file
+    or subtree (default: the whole installed package);
+    ``--changed`` scopes the REPORT to git-changed files instead.
+    Exits non-zero on any error-severity finding beyond the audited
+    allowlist, or on a stale allowlist entry.
     """
     from skypilot_tpu import analysis
+    report_paths = None
+    if changed:
+        if path:
+            raise click.ClickException(
+                'PATH and --changed are mutually exclusive')
+        report_paths = _changed_lint_paths()
+        if not report_paths:
+            click.echo('lint --changed: no changed package files.')
+            return
     try:
         report = analysis.run(
-            root=path, allowlist={} if no_allowlist else None)
+            root=path, allowlist={} if no_allowlist else None,
+            report_paths=report_paths)
     except FileNotFoundError as e:
         raise click.ClickException(str(e)) from e
     if as_json:

@@ -35,14 +35,21 @@ _local = threading.local()
 def _load_global() -> Dict[str, Any]:
     global _global_config
     with _lock:
+        if _global_config is not None:
+            return _global_config
+    # Read + parse OUTSIDE the lock (SKY-HOLD: file I/O under _lock
+    # would stall every config read behind a cold disk). Two racing
+    # first-loaders may both parse; the second assignment wins —
+    # idempotent, same file.
+    path = os.path.expanduser(
+        os.environ.get(CONFIG_ENV_VAR) or _default_config_path())
+    loaded: Dict[str, Any] = {}
+    if os.path.exists(path):
+        with open(path, 'r', encoding='utf-8') as f:
+            loaded = yaml.safe_load(f) or {}
+    with _lock:
         if _global_config is None:
-            path = os.path.expanduser(
-                os.environ.get(CONFIG_ENV_VAR) or _default_config_path())
-            if os.path.exists(path):
-                with open(path, 'r', encoding='utf-8') as f:
-                    _global_config = yaml.safe_load(f) or {}
-            else:
-                _global_config = {}
+            _global_config = loaded
         return _global_config
 
 

@@ -340,6 +340,16 @@ class InferenceEngine:
         '_queue_waits': '_lock',
         '_slots': '_lock:mut',      # engine-thread owned
         '_inflight_tok': '_lock:mut',
+        # Throughput accumulators: submit()'s Retry-After estimate and
+        # metrics()' tokens_per_step read the (tokens, steps, time)
+        # TRIPLE under the lock — the engine thread must mutate each
+        # member under it too, or a reader between two of the
+        # increments computes a rate from a half-applied pair (the
+        # PR 6 _inflight_tok bug class; found by SKY-LOCK v2 at
+        # bring-up: _decode_time/_decode_steps were bumped outside).
+        '_decode_tokens': '_lock:mut',
+        '_decode_steps': '_lock:mut',
+        '_decode_time': '_lock:mut',
         '_abandoned': '_lock',      # sweep writes vs metrics reads
         '_expired': '_lock',
         '_cancelled': '_lock',
@@ -1330,7 +1340,8 @@ class InferenceEngine:
             # by.
             t0 = time.perf_counter()
             self._drain_inflight()
-            self._decode_time += time.perf_counter() - t0
+            with self._lock:
+                self._decode_time += time.perf_counter() - t0
         decoding = [s for s, r in enumerate(self._slots)
                     if r is not None and s not in self._prefilling]
         if self.allocator is not None and decoding:
@@ -1356,7 +1367,8 @@ class InferenceEngine:
         allowed = self._depth if decoding else 0
         while len(self._queue) > allowed:
             self._consume_one()
-        self._decode_time += time.perf_counter() - t0
+        with self._lock:
+            self._decode_time += time.perf_counter() - t0
         return len(decoding) + len(self._prefilling)
 
     def _refresh_dispatch_state(self, decoding: List[int]) -> None:
@@ -1400,12 +1412,13 @@ class InferenceEngine:
         # Overlap the readback with everything that follows: by consume
         # time the bytes are (usually) already on the host.
         pair.copy_to_host_async()
-        self._decode_steps += 1
         with self._lock:
             # Under the lock so metrics()' tokens_in_flight sum never
             # reads a half-applied increment batch (consume decrements
             # under the lock already; the RLock makes this free on the
-            # engine thread).
+            # engine thread), and tokens_per_step never divides by a
+            # step count the token counter hasn't caught up with.
+            self._decode_steps += 1
             for s in decoding:
                 self._inflight_tok[s] += 1
         self._queue.append((
@@ -1518,8 +1531,8 @@ class InferenceEngine:
                 lens_dev, self._next_key(), self._temps_dev,
                 self._active_dev)
         pair.copy_to_host_async()
-        self._decode_steps += 1
         with self._lock:
+            self._decode_steps += 1
             self._spec_steps += 1
             for s in decoding:
                 self._inflight_tok[s] += int(draft_lens[s]) + 1

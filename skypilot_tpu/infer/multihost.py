@@ -78,6 +78,15 @@ class MultihostEngineDriver:
     """Lockstep wrapper around an ``InferenceEngine`` replicated on
     every host of the slice."""
 
+    # Concurrency contract (SKY-LOCK): `_pending` is the only state
+    # shared between HTTP handler threads (submit) and the rank-0 tick
+    # loop — every touch is under `_lock`. `_stop`/`_collective_since`
+    # /`_last_tick` are GIL-atomic scalar flags (single writer,
+    # watchdog reader) and stay unregistered by design.
+    _GUARDED_BY = {
+        '_pending': '_lock',
+    }
+
     def __init__(self, engine) -> None:
         import jax
         self.engine = engine

@@ -59,6 +59,16 @@ class _Node:
 class PrefixCache:
     """Radix tree of per-page token blocks -> physical page ids."""
 
+    # Concurrency contract (SKY-LOCK): the tree is confined to the
+    # engine thread under the ENGINE's lock discipline — external code
+    # (EnginePool, the server) must go through match/donate/evict/
+    # stats, never the node structures (a reach-in would race the
+    # step loop's donations and corrupt refcount bookkeeping).
+    _GUARDED_BY = {
+        '_root': 'owner',
+        '_clock': 'owner',
+    }
+
     def __init__(self,
                  allocator: paged_cache_lib.PageAllocator) -> None:
         self.allocator = allocator

@@ -292,6 +292,22 @@ class _TokenWaiter:
 
 
 class InferenceServer:
+    # Concurrency contract (SKY-LOCK): the drain/admission state below
+    # is asyncio-confined — only /drain, /generate and /metrics
+    # handlers (and their sync helpers, which the interprocedural pass
+    # proves are only reached from coroutines) touch it. The ENGINE
+    # thread must never write these: it reports through
+    # engine.metrics() under the engine lock instead. `ready`/`dead`
+    # stay unregistered on purpose — they are GIL-atomic one-way flags
+    # the engine thread flips exactly once.
+    _GUARDED_BY = {
+        '_active': 'event-loop',
+        '_requests_shed': 'event-loop',
+        'draining': 'event-loop',
+        '_drain_started': 'event-loop',
+        'drain_duration_s': 'event-loop',
+    }
+
     def __init__(self, engine: engine_lib.InferenceEngine,
                  tokenizer: Tokenizer = None, driver=None) -> None:
         self.engine = engine

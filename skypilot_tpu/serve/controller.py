@@ -30,6 +30,17 @@ _TICK_S = float(os.environ.get('SKY_TPU_SERVE_TICK_S', '2'))
 class ServeController:
     """Drives one service until shutdown is requested."""
 
+    # Concurrency contract (SKY-LOCK): rollout state is confined to
+    # the controller tick thread — shutdown is signalled through the
+    # state DB, never by another thread poking these fields (a version
+    # write racing _refresh_version's spec/autoscaler rebuild would
+    # mix two rollouts).
+    _GUARDED_BY = {
+        'version': 'owner',
+        'spec': 'owner',
+        'autoscaler': 'owner',
+    }
+
     def __init__(self, service_name: str) -> None:
         record = serve_state.get_service(service_name)
         if record is None:

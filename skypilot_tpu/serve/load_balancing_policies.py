@@ -27,6 +27,25 @@ from typing import Any, Dict, List, Optional
 class LoadBalancingPolicy:
     """Tracks the ready-replica set and selects one per request."""
 
+    # Concurrency contract (SKY-LOCK, docs/static-analysis.md): the
+    # LB's event loop calls the selectors, but set_ready_replicas
+    # arrives from the replica-sync task and tests poke policies from
+    # plain threads — every selector/bookkeeping field lives under
+    # the policy's own lock. `ready_urls` is ':mut' (the list is
+    # REPLACED atomically under the lock; lock-free readers like
+    # lb_metrics' gauge see the old or the new list, never a torn
+    # one). The subclass helpers (`_on_replica_change`,
+    # `_normalized_load`) carry no lock of their own: the
+    # interprocedural pass proves every call site already holds it.
+    _GUARDED_BY = {
+        'ready_urls': '_lock:mut',
+        '_index': '_lock',
+        '_inflight': '_lock',
+        '_replica_info': '_lock',
+        '_target_qps': '_lock',
+        '_ring': '_lock',
+    }
+
     def __init__(self) -> None:
         self.ready_urls: List[str] = []
         self._lock = threading.Lock()

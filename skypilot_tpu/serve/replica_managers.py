@@ -83,6 +83,17 @@ def drain_replica(url: str, deadline_s: float) -> Optional[dict]:
 class ReplicaManager:
     """Owns the replica set of one service."""
 
+    # Concurrency contract (SKY-LOCK): the launch/terminate future
+    # maps and probe streaks are confined to the controller tick
+    # thread that owns this manager — pool worker threads write ONLY
+    # the state DB (serve_state), never these maps. A reach-in from
+    # another class would race the tick's refresh sweep.
+    _GUARDED_BY = {
+        '_launching': 'owner',
+        '_terminating': 'owner',
+        '_probe_ok_streak': 'owner',
+    }
+
     def __init__(self, service_name: str, spec: spec_lib.ServiceSpec,
                  task_yaml: str) -> None:
         self.service_name = service_name

@@ -25,6 +25,16 @@ _started_at = time.time()
 
 
 class _Registry:
+    # Counters arrive from every request-handler thread; the scrape
+    # endpoint renders from another — all four maps live under the
+    # registry lock (SKY-LOCK).
+    _GUARDED_BY = {
+        '_counters': '_lock',
+        '_hist': '_lock',
+        '_hist_sum': '_lock',
+        '_gauges': '_lock',
+    }
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple], float] = {}

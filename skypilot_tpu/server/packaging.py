@@ -25,7 +25,12 @@ DOCKERFILE = '''\
 # API server image (control plane only — TPU slices are provisioned by
 # it, not inside it). Build from the repo root:
 #   docker build -f deploy/Dockerfile -t skypilot-tpu-api .
-FROM python:3.12-slim
+# The `lint` stage is the static gate (docs/static-analysis.md): the
+# final stage depends on it, so a plain `docker build` runs
+# `sky-tpu lint --json` and FAILS on any invariant violation — exit
+# code wired straight into the image build. Skip it explicitly with
+#   docker build --target base ...
+FROM python:3.12-slim AS base
 
 RUN apt-get update && apt-get install -y --no-install-recommends \\
         openssh-client rsync curl && \\
@@ -40,6 +45,23 @@ COPY native ./native
 # pyproject declares the control-plane deps; jax/orbax are NOT needed
 # here: the API server provisions TPU slices, it does not compute.
 RUN pip install --no-cache-dir .
+
+# ---- static-analysis gate --------------------------------------------
+FROM base AS lint
+# docs/ rides along only here: SKY-REGISTRY cross-checks the failpoint
+# and serving-metric catalogs against the code, both directions.
+COPY docs ./docs
+# `python -m` from the WORKDIR so the SOURCE tree (with ./docs next to
+# it) is what gets linted — the pip-installed site-packages copy has no
+# docs/ sibling, and lint would silently skip the registry checks.
+RUN python -m skypilot_tpu.client.cli lint --json > /tmp/lint-report.json \\
+    || (cat /tmp/lint-report.json && exit 1)
+
+# ---- runtime ---------------------------------------------------------
+FROM base AS runtime
+# The COPY forces the lint stage to build: no image without a green
+# gate. The report ships in the image for provenance.
+COPY --from=lint /tmp/lint-report.json /opt/skypilot-tpu/lint-report.json
 
 # State lives under SKY_TPU_HOME: mount a volume (or point db.url at
 # postgres and treat the volume as cache/logs only).

@@ -172,6 +172,14 @@ class CircuitBreaker:
         self._lock = threading.Lock()
         self._breakers: Dict[str, _Breaker] = {}
 
+    # The per-replica breaker map is read from the LB event loop and
+    # (in tests / sync callers) plain threads — every access goes
+    # through the lock; `_get` is lock-free itself because the
+    # interprocedural pass proves all its callers hold it (SKY-LOCK).
+    _GUARDED_BY = {
+        '_breakers': '_lock',
+    }
+
     def _get(self, key: str) -> _Breaker:
         b = self._breakers.get(key)
         if b is None:
@@ -244,4 +252,11 @@ class CircuitBreaker:
                     del self._breakers[k]
 
     def snapshot(self) -> Dict[str, str]:
-        return {k: self.state(k) for k in list(self._breakers)}
+        # Key snapshot under the lock (SKY-LOCK): prune() deletes
+        # entries concurrently, and the declared contract is that
+        # _breakers is only touched under _lock. state() re-locks per
+        # key — the RLock-free double hop is fine, a pruned key just
+        # reads CLOSED.
+        with self._lock:
+            keys = list(self._breakers)
+        return {k: self.state(k) for k in keys}

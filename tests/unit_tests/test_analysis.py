@@ -12,10 +12,17 @@ Two layers:
    runtime tests — a refactor that breaks lock discipline, async
    hygiene, jit purity, or a docs catalog fails HERE first.
 """
+import itertools
+import os
 import shutil
 import textwrap
 
 from skypilot_tpu import analysis
+
+# Re-writes of a fixture path within one test can land in the same
+# kernel timestamp tick with the same byte size; a unique synthetic
+# mtime per write keeps the parsed-module cache honest.
+_MTIME_TICK = itertools.count(1)
 
 
 def _run(tmp_path, files, checkers, docs=None, allowlist=None):
@@ -26,6 +33,7 @@ def _run(tmp_path, files, checkers, docs=None, allowlist=None):
         p = pkg / rel
         p.parent.mkdir(parents=True, exist_ok=True)
         p.write_text(textwrap.dedent(body), encoding='utf-8')
+        os.utime(p, ns=(tick := next(_MTIME_TICK), tick))
     docs_root = None
     if docs is not None:
         droot = tmp_path / 'docs'
@@ -506,6 +514,606 @@ def test_registry_checker_in_sync(tmp_path):
     assert not report.findings, report.findings
 
 
+# ---- SKY-ORDER -----------------------------------------------------------
+
+_ORDER_CYCLE = '''
+import threading
+
+
+class A:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def path_one(self):
+        with self._la:
+            self.grab_b()
+
+    def grab_b(self):
+        with self._lb:
+            pass
+
+    def path_two(self):
+        with self._lb:
+            self.grab_a()
+
+    def grab_a(self):
+        with self._la:              # SEEDED: closes the A->B->A cycle
+            pass
+'''
+
+
+def test_order_checker_interprocedural_cycle(tmp_path):
+    """The seeded deadlock: thread 1 takes la then (transitively) lb,
+    thread 2 takes lb then (transitively) la. Neither nesting is
+    visible lexically — only the lock-set dataflow sees it."""
+    report = _run(tmp_path, {'infer/a.py': _ORDER_CYCLE},
+                  [analysis.OrderChecker(lock_order=[])])
+    msgs = [f.message for f in report.findings
+            if 'cycle' in f.message]
+    assert len(msgs) == 1, report.findings
+    assert 'A._la' in msgs[0] and 'A._lb' in msgs[0]
+    # With the inversion fixed (grab_a takes la FIRST, matching
+    # path_one's order), the cycle disappears: the checker is
+    # non-vacuous in both directions.
+    fixed = _ORDER_CYCLE.replace(
+        'with self._lb:\n            self.grab_a()',
+        'with self._la:\n            self.grab_b()')
+    report = _run(tmp_path, {'infer/a.py': fixed},
+                  [analysis.OrderChecker(lock_order=[])])
+    assert not report.findings, report.findings
+
+
+_REENTRY = '''
+import threading
+
+
+class R:
+    def __init__(self):
+        self._m = threading.{KIND}()
+
+    def outer(self):
+        with self._m:
+            self.inner()
+
+    def inner(self):
+        with self._m:               # SEEDED when KIND=Lock
+            pass
+'''
+
+
+def test_order_checker_reentrancy(tmp_path):
+    report = _run(tmp_path,
+                  {'infer/r.py': _REENTRY.format(KIND='Lock')},
+                  [analysis.OrderChecker(lock_order=[])])
+    assert len(report.findings) == 1, report.findings
+    assert 're-entrant' in report.findings[0].message
+    assert 'R.outer' in ' -> '.join(report.findings[0].chain or ())
+    # The same shape over an RLock is the engine's own idiom: legal.
+    report = _run(tmp_path,
+                  {'infer/r.py': _REENTRY.format(KIND='RLock')},
+                  [analysis.OrderChecker(lock_order=[])])
+    assert not report.findings, report.findings
+
+
+def test_order_checker_canonical_order(tmp_path):
+    body = '''
+    import threading
+
+
+    class R:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def f(self):
+            with self._b:
+                with self._a:       # SEEDED: contradicts a-before-b
+                    pass
+    '''
+    checker = analysis.OrderChecker(lock_order=['R._a', 'R._b'])
+    report = _run(tmp_path, {'serve/r.py': body}, [checker])
+    assert len(report.findings) == 1, report.findings
+    assert 'canonical LOCK_ORDER' in report.findings[0].message
+
+
+def test_lock_order_declared():
+    """The canonical order ships non-empty: the first cross-lock
+    nesting anyone adds must conform to a reviewed order."""
+    assert analysis.LOCK_ORDER
+    assert 'InferenceEngine._lock' in analysis.LOCK_ORDER
+
+
+# ---- SKY-HOLD ------------------------------------------------------------
+
+_HOLD_MODULE = '''
+import subprocess
+import threading
+import time
+
+import numpy as np
+import requests
+
+
+class H:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(1)           # SEEDED: sleep under lock
+
+    def bad_net(self):
+        with self._lock:
+            requests.get('http://x')    # SEEDED: net under lock
+
+    def bad_subprocess(self):
+        with self._lock:
+            subprocess.run(['ls'])  # SEEDED: subprocess under lock
+
+    def bad_device(self, arr):
+        with self._lock:
+            return np.asarray(arr)  # SEEDED: device readback
+
+    def bad_file(self, p):
+        with self._lock:
+            with open(p) as f:      # SEEDED (warn tier): file IO
+                return f.read()
+
+    def good_outside(self):
+        with self._lock:
+            n = 1
+        time.sleep(n)
+
+    def helper_sleeps(self):
+        time.sleep(1)               # SEEDED: via bad_transitive chain
+
+    def bad_transitive(self):
+        with self._lock:
+            self.helper_sleeps()
+
+    async def bad_await(self, coro):
+        with self._lock:
+            await coro()            # SEEDED: await holding a Lock
+'''
+
+
+def test_hold_checker_sink_categories(tmp_path):
+    report = _run(tmp_path, {'infer/h.py': _HOLD_MODULE},
+                  [analysis.HoldChecker()])
+    src = textwrap.dedent(_HOLD_MODULE).splitlines()
+    for f in report.findings:
+        assert 'SEEDED' in src[f.line - 1], f
+    labels = {f.message.split(' ')[0] for f in report.findings}
+    assert labels == {'sleep', 'net', 'subprocess', 'device-sync',
+                      'file-io', 'await'}, labels
+    assert len(report.findings) == 7, report.findings
+    by_sev = {f.line: f.severity for f in report.findings}
+    # File IO is warn tier; device readback under an infer/ lock and
+    # everything else is a hard error.
+    file_line = next(i + 1 for i, l in enumerate(src)
+                     if 'warn tier' in l)
+    assert by_sev[file_line] == 'warn'
+    assert all(sev == 'error' for line, sev in by_sev.items()
+               if line != file_line)
+    transitive = [f for f in report.findings
+                  if 'helper_sleeps' in f.message]
+    assert transitive and 'bad_transitive' in ' '.join(
+        transitive[0].chain or ()), transitive
+
+
+def test_hold_checker_warn_tier_does_not_fail_gate(tmp_path):
+    body = '''
+    import threading
+
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def warn_only(self, p):
+            with self._lock:
+                with open(p) as f:
+                    return f.read()
+    '''
+    report = _run(tmp_path, {'serve/w.py': body},
+                  [analysis.HoldChecker()])
+    assert len(report.findings) == 1
+    assert report.findings[0].severity == 'warn'
+    # Reported as an offender but advisory: the gate stays green.
+    assert report.offenders and not report.hard_offenders
+    assert report.ok
+
+
+# ---- SKY-LOCK v2: interprocedural guarded-by + annotation checks ---------
+
+_FLOW_OK = '''
+import threading
+
+
+class Pool:
+    _GUARDED_BY = {'_stats': '_lock'}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {}
+
+    def metrics(self):
+        with self._lock:
+            return self._merge()
+
+    def _merge(self):
+        return self._mix()
+
+    def _mix(self):
+        self._stats['n'] = 1
+        return dict(self._stats)
+'''
+
+_FLOW_BAD = _FLOW_OK + '''
+
+    def h_metrics(self):
+        return self._merge()        # SEEDED: unlocked path to _mix
+'''
+
+
+def test_lock_v2_three_deep_chain(tmp_path):
+    """A helper three frames below the lock is legal when EVERY call
+    chain holds it (the relaxation) and a finding naming the unlocked
+    chain when one does not (the enforcement)."""
+    report = _run(tmp_path, {'infer/pool.py': _FLOW_OK},
+                  [analysis.LockChecker()])
+    assert not report.findings, report.findings
+    report = _run(tmp_path, {'infer/pool.py': _FLOW_BAD},
+                  [analysis.LockChecker()])
+    assert report.findings, 'unlocked chain went undetected'
+    chains = [f for f in report.findings
+              if 'unlocked call chain' in f.message]
+    assert chains, report.findings
+    joined = ' | '.join(f.message for f in chains)
+    assert 'h_metrics' in joined and '_merge' in joined
+
+
+_ANN_MODULE = '''
+import threading
+
+
+class E:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def locked_caller(self):
+        with self._lock:
+            self.helper()
+
+    def bad_caller(self):
+        self.helper()               # SEEDED: annotation violated
+
+    def helper(self):  # holds: _lock
+        pass
+'''
+
+
+def test_lock_v2_annotation_verified_against_callers(tmp_path):
+    report = _run(tmp_path, {'infer/e.py': _ANN_MODULE},
+                  [analysis.LockChecker()])
+    assert len(report.findings) == 1, report.findings
+    f = report.findings[0]
+    src = textwrap.dedent(_ANN_MODULE).splitlines()
+    assert 'SEEDED' in src[f.line - 1]
+    assert 'calling contract' in f.message
+    assert 'E.bad_caller' in (f.chain or ())
+
+
+def test_lock_v2_deferred_callback_is_not_proven(tmp_path):
+    """Soundness regression (review finding): a method reference
+    handed to a DEFERRING consumer under the lock
+    (`with self._lock: pool.submit(self._flush)`) runs after release,
+    usually on another thread — it must NOT prove the callee locked.
+    A synchronous consumer (`min(..., key=self._helper)`) still
+    does."""
+    body = '''
+    import threading
+
+
+    class C:
+        _GUARDED_BY = {'_buf': '_lock'}
+
+        def __init__(self, pool):
+            self._lock = threading.Lock()
+            self._buf = []
+            self._pool = pool
+
+        def kick(self):
+            with self._lock:
+                self._pool.submit(self._flush)
+
+        def _flush(self):
+            self._buf.clear()       # SEEDED: runs WITHOUT the lock
+
+        def best(self):
+            with self._lock:
+                return min(self._buf, key=self._rank)
+
+        def _rank(self, item):
+            return len(self._buf) + item    # sync consumer: proven
+    '''
+    report = _run(tmp_path, {'infer/c.py': body},
+                  [analysis.LockChecker()])
+    src = textwrap.dedent(body).splitlines()
+    assert len(report.findings) == 1, report.findings
+    assert 'SEEDED' in src[report.findings[0].line - 1]
+
+
+def test_lock_v2_deferred_edge_blocks_inherited_must(tmp_path):
+    """Soundness regression (second review pass): the caller's OWN
+    must-entry locks must not cross a deferred edge either —
+    `kick -> _defer` proves _defer locked, but `_defer`'s
+    `pool.submit(self._flush)` still runs _flush on a worker thread
+    without it."""
+    body = '''
+    import threading
+
+
+    class C:
+        _GUARDED_BY = {'_buf': '_lock'}
+
+        def __init__(self, pool):
+            self._lock = threading.Lock()
+            self._buf = []
+            self._pool = pool
+
+        def kick(self):
+            with self._lock:
+                self._defer()
+
+        def _defer(self):
+            self._pool.submit(self._flush)
+
+        def _flush(self):
+            self._buf.clear()       # SEEDED: runs WITHOUT the lock
+    '''
+    report = _run(tmp_path, {'infer/c2.py': body},
+                  [analysis.LockChecker()])
+    src = textwrap.dedent(body).splitlines()
+    assert len(report.findings) == 1, report.findings
+    assert 'SEEDED' in src[report.findings[0].line - 1]
+
+
+def test_hold_checker_subscript_receiver_sink(tmp_path):
+    """Regression (review finding): `.block_until_ready()` on a
+    Subscript receiver — the engine's actual in-flight-pair shape —
+    must still classify as a device sink."""
+    body = '''
+    import threading
+
+
+    class P:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pairs = []
+
+        def bad(self):
+            with self._lock:
+                self._pairs[0].block_until_ready()   # SEEDED
+    '''
+    report = _run(tmp_path, {'infer/p.py': body},
+                  [analysis.HoldChecker()])
+    assert len(report.findings) == 1, report.findings
+    assert report.findings[0].severity == 'error'
+    assert 'device-sync' in report.findings[0].message
+
+
+def test_lock_v2_docstring_mention_is_not_annotation(tmp_path):
+    """A docstring explaining the `# holds:` syntax must not turn the
+    function into an annotated one now that annotations are
+    verified."""
+    body = """
+    def explain():
+        '''Document the ``# holds: <name>`` convention.'''
+        return 1
+
+
+    def caller():
+        explain()
+    """
+    report = _run(tmp_path, {'infer/doc.py': body},
+                  [analysis.LockChecker()])
+    assert not report.findings, report.findings
+
+
+# ---- walker regressions (aliasing / manual acquire / tuple with) ---------
+
+_WALKER_MODULE = '''
+import threading
+
+
+class W:
+    _GUARDED_BY = {'_q': '_lock'}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self._q = []
+
+    def good_alias(self):
+        lock = self._lock
+        with lock:
+            self._q.append(1)
+
+    def good_manual(self):
+        self._lock.acquire()
+        try:
+            self._q.append(2)
+        finally:
+            self._lock.release()
+
+    def good_tuple(self):
+        with (self._aux, self._lock):
+            self._q.append(3)
+
+    def bad_after_release(self):
+        self._lock.acquire()
+        self._lock.release()
+        self._q.append(4)           # SEEDED: lock already released
+'''
+
+
+def test_walker_lock_idioms(tmp_path):
+    """Regressions for the PR 10 walker sweep: aliasing
+    (`lock = self._lock; with lock:`), try/finally manual
+    acquire()/release() intervals, and parenthesized multi-item
+    `with (a, b):` all count as holding; releasing stops counting."""
+    report = _run(tmp_path, {'infer/w.py': _WALKER_MODULE},
+                  [analysis.LockChecker()])
+    src = textwrap.dedent(_WALKER_MODULE).splitlines()
+    assert len(report.findings) == 1, report.findings
+    assert 'SEEDED' in src[report.findings[0].line - 1]
+
+
+def test_walker_tuple_with_orders_left_to_right(tmp_path):
+    """`with (a, b):` acquires left-to-right — it must contribute the
+    a->b edge only, never a fake b->a (which would read as a
+    cycle)."""
+    body = '''
+    import threading
+
+
+    class T:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def f(self):
+            with (self._a, self._b):
+                pass
+    '''
+    report = _run(tmp_path, {'infer/t.py': body},
+                  [analysis.OrderChecker(lock_order=[])])
+    assert not report.findings, report.findings
+
+
+# ---- incremental path: report scoping + parse cache ----------------------
+
+def test_report_paths_scopes_findings_and_staleness(tmp_path):
+    """--changed semantics: the whole tree is scanned (call-graph
+    soundness) but findings and allowlist staleness are judged only
+    for the changed paths."""
+    body = 'import time\n\n\ndef f():\n    time.sleep(1)\n'
+    pkg = tmp_path / 'pkg'
+    for rel in ('serve/a.py', 'serve/b.py'):
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(body, encoding='utf-8')
+    al = {'serve/b.py:SKY-ASYNC': (5, 'stale cap, out of scope')}
+    report = analysis.run(
+        root=str(pkg), pkg_root=str(pkg),
+        checkers=[analysis.AsyncChecker()], allowlist=al,
+        report_paths=frozenset({'serve/a.py'}))
+    assert {f.path for f in report.findings} == {'serve/a.py'}
+    # b.py's over-generous cap is NOT judged (out of report scope)...
+    assert not report.stale
+    # ...but a full run still catches it.
+    report = analysis.run(root=str(pkg), pkg_root=str(pkg),
+                          checkers=[analysis.AsyncChecker()],
+                          allowlist=al)
+    assert report.stale
+
+
+def test_source_cache_reuses_parsed_modules(tmp_path):
+    from skypilot_tpu.analysis import core as core_lib
+    p = tmp_path / 'pkg' / 'm.py'
+    p.parent.mkdir(parents=True)
+    p.write_text('x = 1\n', encoding='utf-8')
+    a = core_lib.load_files(str(tmp_path / 'pkg'),
+                            str(tmp_path / 'pkg'))[0]
+    b = core_lib.load_files(str(tmp_path / 'pkg'),
+                            str(tmp_path / 'pkg'))[0]
+    assert a is b, 'unchanged module re-parsed'
+    p.write_text('x = 2\n', encoding='utf-8')
+    import os as _os
+    _os.utime(p, ns=(1, 1))   # force a distinct mtime signature
+    c = core_lib.load_files(str(tmp_path / 'pkg'),
+                            str(tmp_path / 'pkg'))[0]
+    assert c is not a and 'x = 2' in c.text
+
+
+# ---- coverage + wall-clock canaries --------------------------------------
+
+def test_lockflow_covers_trace_reachability():
+    """The ISSUE's coverage canary: the lock-set dataflow must visit
+    (at least) every function SKY-TRACE's jit call graph reaches — a
+    resolver regression that silently shrinks lockflow's function
+    index would hollow out all three lock checkers."""
+    import os
+
+    import skypilot_tpu
+    from skypilot_tpu.analysis import core as core_lib
+    from skypilot_tpu.analysis import lockflow
+    from skypilot_tpu.analysis import trace_check
+
+    pkg = os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
+    files = [f for f in core_lib.load_files(pkg, pkg)
+             if f.tree is not None]
+    flow = lockflow.analyze(files)
+    tc = trace_check.TraceChecker()
+    index = trace_check._index_functions(files)
+    by_rel = {f.rel: f for f in files}
+    seen, queue = set(), list(tc._find_roots(files))
+    reached = []
+    while queue:
+        key = queue.pop()
+        if key in seen:
+            continue
+        seen.add(key)
+        info = index.get(key[0], {}).get(key[1])
+        if info is None:
+            continue
+        reached.append(key)
+        queue.extend(tc._callees(info, index, by_rel))
+    assert reached, 'trace reachability collapsed'
+    missing = [k for k in reached if k not in flow.summaries]
+    assert not missing, (
+        f'lock-set dataflow misses jit-reachable functions: '
+        f'{missing[:5]}')
+    # And the dataflow itself is non-vacuous on the real tree: the
+    # engine lock provably flows into the scheduler contract.
+    assert sum(1 for v in flow.may_entry.values()
+               if 'InferenceEngine._lock' in v) >= 20
+    sched_admit = ('infer/sched/base.py', 'Scheduler.admit')
+    assert 'InferenceEngine._lock' in flow.must_entry[sched_admit]
+
+
+def test_lint_wall_clock_canary():
+    """Pins full-package lint wall-clock so the interprocedural pass
+    cannot silently blow up CI time. Bounds are ~15x the measured
+    cold/warm times on the slowest observed box — a REGRESSION here
+    means accidental quadratic work (per-node module re-walks were
+    exactly that during bring-up), not a slow machine."""
+    import time as _time
+
+    from skypilot_tpu.analysis import core as core_lib
+    from skypilot_tpu.analysis import lockflow
+
+    # Earlier tests in this process already parsed the package — drop
+    # both caches so `cold` really measures the cold path (a
+    # regression confined to parse/summary construction must not hide
+    # behind a warm cache).
+    core_lib.clear_source_cache()
+    lockflow.clear_memo()
+    t0 = _time.perf_counter()
+    report = analysis.run()
+    cold = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    analysis.run()
+    warm = _time.perf_counter() - t0
+    assert report.ok, report.render_text()
+    assert cold < 45.0, f'full lint took {cold:.1f}s (budget 45s)'
+    assert warm < 15.0, (
+        f'cached lint took {warm:.1f}s (budget 15s) — the parse/'
+        f'lockflow memo stopped working')
+
+
 # ---- the tier-1 gate -----------------------------------------------------
 
 def test_package_clean_against_shipped_allowlist():
@@ -614,15 +1222,33 @@ def test_guarded_by_registries_declared():
     declared (deleting one would silently disable the checker for
     that class)."""
     from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import multihost
     from skypilot_tpu.infer import paged_cache
+    from skypilot_tpu.infer import prefix_cache
+    from skypilot_tpu.infer import server as infer_server
     from skypilot_tpu.infer.sched import base as sched_base
     from skypilot_tpu.infer.sched import wfq as sched_wfq
     from skypilot_tpu.serve import load_balancer
+    from skypilot_tpu.serve import load_balancing_policies
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.server import metrics as server_metrics
+    from skypilot_tpu.utils import retry
     assert '_sched' in engine_lib.InferenceEngine._GUARDED_BY
+    assert '_decode_time' in engine_lib.InferenceEngine._GUARDED_BY
     assert '_free' in paged_cache.PageAllocator._GUARDED_BY
     assert '_ttfts' in load_balancer.LoadBalancer._GUARDED_BY
     assert '_queue' in sched_base.Scheduler._GUARDED_BY
     assert '_deficit' in sched_wfq.WFQScheduler._GUARDED_BY
+    # The PR 10 annotation-surface expansion.
+    assert '_pending' in multihost.MultihostEngineDriver._GUARDED_BY
+    assert '_root' in prefix_cache.PrefixCache._GUARDED_BY
+    assert '_active' in infer_server.InferenceServer._GUARDED_BY
+    assert ('ready_urls' in
+            load_balancing_policies.LoadBalancingPolicy._GUARDED_BY)
+    assert ('_terminating' in
+            replica_managers.ReplicaManager._GUARDED_BY)
+    assert '_breakers' in retry.CircuitBreaker._GUARDED_BY
+    assert '_counters' in server_metrics._Registry._GUARDED_BY
 
 
 def test_report_json_roundtrip(tmp_path):
