@@ -439,6 +439,217 @@ def _chaos_resume_level(gen_url: str, concurrency: int,
     return out
 
 
+def _chunked_build_engine(config, params, *, fused: bool, slots: int,
+                          max_seq_len: int, page_size: int,
+                          kv_dtype: str = 'bfloat16',
+                          n_pages=None, prefix_cache: bool = False):
+    from skypilot_tpu.infer import engine as engine_lib
+    return engine_lib.InferenceEngine(
+        config, params,
+        engine_lib.EngineConfig(
+            n_slots=slots, max_seq_len=max_seq_len,
+            prefill_buckets=(64, 128), prefill_chunk=128,
+            paged=True, page_size=page_size, n_pages=n_pages,
+            prefix_cache=prefix_cache, kv_dtype=kv_dtype,
+            fused_prefill=fused))
+
+
+def _chunked_warm(eng, aggr_prompt: list) -> None:
+    """Compile every program off the clock: standalone prefill (idle
+    admission), then BOTH chunk buckets through the mid-decode path
+    the measurement exercises (fused engines compile their mixed
+    programs here, unfused their standalone ladder)."""
+    a = eng.submit([9] * 16, max_new_tokens=120)
+    while not a.output_tokens:
+        eng.step()
+    for warm_prompt in ([8] * 8, [9] * len(aggr_prompt)):
+        r = eng.submit(warm_prompt, max_new_tokens=4)
+        while not r.done:
+            eng.step()
+    eng.cancel(a)
+    eng.run_until_idle()
+
+
+def _chunked_victim_run(engine, conc: int, aggr_prompt: list,
+                        repeats: int) -> dict:
+    """Victims decode continuously; a long-prompt aggressor arrives
+    mid-decode-batch ``repeats`` times. Records every victim
+    inter-token gap from each aggressor's submission until its first
+    token — the window a standalone prefill dispatch stalls — plus the
+    aggressor's TTFT. Engine-level (in-process step loop): the stall
+    being measured is a device-dispatch property, not an HTTP one."""
+    victims = [engine.submit([3 + i] * 8, max_new_tokens=400)
+               for i in range(conc)]
+    while any(len(v.output_tokens) < 4 for v in victims):
+        engine.step()
+    itls, ttfts = [], []
+    seen = {i: len(v.output_tokens) for i, v in enumerate(victims)}
+    last = {i: None for i in range(len(victims))}
+    for r in range(repeats):
+        aggr = engine.submit(aggr_prompt, max_new_tokens=4)
+        t0 = time.perf_counter()
+        for i in range(len(victims)):
+            last[i] = None          # fresh window per aggressor
+        while not aggr.done:
+            engine.step()
+            now = time.perf_counter()
+            for i, v in enumerate(victims):
+                n = len(v.output_tokens)
+                if n > seen[i]:
+                    if last[i] is not None:
+                        gap = (now - last[i]) / (n - seen[i])
+                        itls.extend([gap] * (n - seen[i]))
+                    last[i] = now
+                    seen[i] = n
+            if aggr.output_tokens and len(ttfts) == r:
+                ttfts.append(time.perf_counter() - t0)
+    for v in victims:
+        engine.cancel(v)
+    engine.run_until_idle()
+    m = engine.metrics()
+    itls.sort()
+    ttfts.sort()
+    return {
+        'victim_itl_p50_ms': (round(_pct(itls, 0.50) * 1e3, 3)
+                              if itls else None),
+        'victim_itl_p99_ms': (round(_pct(itls, 0.99) * 1e3, 3)
+                              if itls else None),
+        'aggressor_ttft_p50_s': _pct(ttfts, 0.50),
+        'itl_samples': len(itls),
+        'fused_steps': m['fused_steps'],
+        'decode_stall_steps': m['decode_stall_steps'],
+        'prefill_tokens_per_step': m['prefill_tokens_per_step'],
+    }
+
+
+def _chunked_kv_axis(config, params, *, slots: int, max_seq_len: int,
+                     page_size: int) -> dict:
+    """The int8 lever at a FIXED HBM byte budget: how many pages each
+    kv_dtype keeps resident, and what that extra residency buys the
+    prefix cache (hit-rate delta on a shared-prefix workload sized to
+    overflow the bf16 pool)."""
+    # Bytes one (k+v) page costs across all layers: values at their
+    # dtype plus, for int8, one fp32 scale per row per head — the
+    # closed form InferenceEngine._kv_page_bytes reports.
+    engines = {
+        dt: (2 * config.n_layers * config.n_kv_heads * page_size
+             * (config.head_dim * (1 if dt == 'int8' else 2)
+                + (4 if dt == 'int8' else 0)))
+        for dt in ('bfloat16', 'int8')}
+    budget = 48 * engines['bfloat16']   # 48 bf16 pages of HBM
+    axis = {'kv_page_bytes_bf16': engines['bfloat16'],
+            'kv_page_bytes_int8': engines['int8'],
+            'hbm_budget_bytes': budget}
+    # 30 distinct 2-page cohort prefixes (60 cached pages when all
+    # stay resident): they FIT the int8 pool at this budget (~76
+    # pages at head_dim 16, more at production widths) and OVERFLOW
+    # the 48-page bf16 one, so wave 2's hit rate is precisely what
+    # the denser pages bought.
+    n_cohorts = 30
+    cohorts = [[(7 + c) % 250] * (2 * page_size)
+               for c in range(n_cohorts)]
+    for dt in ('bfloat16', 'int8'):
+        n_pages = budget // engines[dt] + 1   # +1: the sink page
+        eng = _chunked_build_engine(
+            config, params, fused=True, slots=slots,
+            max_seq_len=max_seq_len, page_size=page_size, kv_dtype=dt,
+            n_pages=int(n_pages), prefix_cache=True)
+        for wave in range(2):
+            for c, prefix in enumerate(cohorts):
+                eng.generate(
+                    [prefix + [11 + c + 100 * wave] * 8],
+                    max_new_tokens=4)
+        m = eng.metrics()
+        key = 'int8' if dt == 'int8' else 'bf16'
+        axis[f'resident_pages_{key}'] = int(n_pages) - 1
+        axis[f'prefix_hit_rate_{key}'] = m['prefix_hit_rate']
+        axis[f'prefix_cached_pages_{key}'] = m['prefix_cached_pages']
+        axis[f'prefix_evictions_{key}'] = m['prefix_evictions']
+    axis['resident_page_ratio'] = round(
+        axis['resident_pages_int8'] / axis['resident_pages_bf16'], 4)
+    axis['prefix_hit_rate_delta'] = round(
+        axis['prefix_hit_rate_int8'] - axis['prefix_hit_rate_bf16'], 4)
+    return axis
+
+
+def _run_chunked_sweep(args) -> dict:
+    """--sweep chunked: in-process engines (no HTTP hop — the stall
+    under test is the standalone prefill dispatch between decode
+    dispatches, a device-step property), fused vs unfused at each
+    concurrency, plus the kv-dtype residency axis."""
+    import jax
+
+    import dataclasses
+
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.models import llama
+    config = server_lib.MODELS[args.model]()
+    if config.max_seq_len < args.max_seq_len:
+        # The aggressor prompt must span several chunks; widening the
+        # rope/cache horizon of a small preset is free.
+        config = dataclasses.replace(config,
+                                     max_seq_len=args.max_seq_len)
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    max_seq_len = min(args.max_seq_len, config.max_seq_len)
+    page_size = min(args.page_size, 64)
+    aggr_prompt = [5] * min(6 * 128, max_seq_len - 144)  # 6 chunks
+    repeats = max(4, args.requests_per_level // 10)
+    sweep = []
+    for conc in args.concurrency:
+        conc = min(conc, args.slots - 1)   # one slot for the aggressor
+        level = {'concurrency': conc, 'aggressor_prompt_tokens':
+                 len(aggr_prompt), 'repeats': repeats}
+        for fused in (False, True):
+            eng = _chunked_build_engine(
+                config, params, fused=fused, slots=args.slots,
+                max_seq_len=max_seq_len, page_size=page_size)
+            _chunked_warm(eng, aggr_prompt)
+            level['fused' if fused else 'unfused'] = (
+                _chunked_victim_run(eng, conc, aggr_prompt, repeats))
+        fp, up = level['fused'], level['unfused']
+        if fp['victim_itl_p99_ms'] and up['victim_itl_p99_ms']:
+            level['victim_itl_p99_improvement_x'] = round(
+                up['victim_itl_p99_ms'] / fp['victim_itl_p99_ms'], 3)
+            level['victim_itl_p50_improvement_x'] = round(
+                up['victim_itl_p50_ms'] / fp['victim_itl_p50_ms'], 3)
+        level['samples'] = fp['itl_samples'] + up['itl_samples']
+        sweep.append(level)
+    axis = _chunked_kv_axis(config, params, slots=args.slots,
+                            max_seq_len=max_seq_len,
+                            page_size=page_size)
+    base = sweep[0] if sweep else {}
+    head = {
+        'metric': 'chunked_victim_itl_p99_improvement_x',
+        'value': base.get('victim_itl_p99_improvement_x'),
+        'unit': 'x (unfused victim itl p99 / fused victim itl p99, '
+                'long-prompt aggressor arriving mid-decode-batch)',
+        'victim_itl_p50_improvement_x': base.get(
+            'victim_itl_p50_improvement_x'),
+        'aggressor_ttft_fused_s': (base.get('fused') or {}).get(
+            'aggressor_ttft_p50_s'),
+        'aggressor_ttft_unfused_s': (base.get('unfused') or {}).get(
+            'aggressor_ttft_p50_s'),
+        'resident_page_ratio_int8_over_bf16': axis[
+            'resident_page_ratio'],
+        'prefix_hit_rate_delta_int8': axis['prefix_hit_rate_delta'],
+        'fused_prefill': True,
+    }
+    return {
+        **head,
+        'sweep_mode': 'chunked',
+        'sweep': sweep,
+        'kv_dtype_axis': axis,
+        'total_samples': sum(lv.get('samples', 0) for lv in sweep),
+        'model': args.model,
+        'slots': args.slots,
+        'paged': True,
+        'page_size': page_size,
+        'device': jax.devices()[0].device_kind,
+        'path': ('in-process engine step loop (fused vs unfused '
+                 'mixed steps; engine-side per-token clock)'),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--requests-per-level', type=int, default=80)
@@ -464,7 +675,7 @@ def main() -> None:
     parser.add_argument('--sweep', default='concurrency',
                         choices=['concurrency', 'shared-prefix',
                                  'chaos-resume', 'tenants',
-                                 'speculative'],
+                                 'speculative', 'chunked'],
                         help="'shared-prefix': the shared-system-"
                              'prompt workload (implies --paged '
                              '--prefix-cache) — per level, a cold '
@@ -495,7 +706,15 @@ def main() -> None:
                              'spec_accept_rate, tokens_per_step, the '
                              'itl_improvement_x ratio and a '
                              'bit-identity probe into the json '
-                             '(defaults --spec-k 6).')
+                             "(defaults --spec-k 6). 'chunked': "
+                             'fused mixed steps — a long-prompt '
+                             'aggressor arrives mid-decode-batch and '
+                             'the victim decode ITL is measured '
+                             'fused vs unfused (in-process engines; '
+                             'implies --paged), plus the int8 '
+                             'kv-dtype residency axis (resident '
+                             'pages + prefix_hit_rate delta at a '
+                             'fixed HBM budget).')
     parser.add_argument('--spec-k', type=int, default=0,
                         help='speculative draft width for the replica '
                              '(0 = off; --sweep speculative defaults '
@@ -555,6 +774,12 @@ def main() -> None:
         args.prefix_cache = True
         if args.max_seq_len is None:
             args.max_seq_len = 1024
+    if args.sweep == 'chunked':
+        args.paged = True
+        if args.max_seq_len is None:
+            # The aggressor prompt must span several chunks for the
+            # stall to be visible.
+            args.max_seq_len = 1024
     if args.max_seq_len is None:
         args.max_seq_len = 256
     if args.sweep == 'tenants' and args.scheduler is None:
@@ -568,6 +793,18 @@ def main() -> None:
     # release the accelerator before measuring (VERDICT r5 weak #2).
     from skypilot_tpu.utils import locks
     locks.acquire_chip_lock('bench_ttft')
+
+    if args.sweep == 'chunked':
+        # In-process engines (no server/LB hop): the stall under test
+        # is the standalone prefill dispatch between decode
+        # dispatches — a device-step property the HTTP path would only
+        # blur with transport jitter.
+        result = _run_chunked_sweep(args)
+        print(json.dumps(result))
+        if args.output:
+            with open(args.output, 'w', encoding='utf-8') as f:
+                json.dump(result, f, indent=1)
+        return
 
     if args.tokenizer == '128k':
         from skypilot_tpu.infer import server as server_lib

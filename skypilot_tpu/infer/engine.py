@@ -92,6 +92,19 @@ class EngineConfig:
     # engine step.
     prefill_chunk: int = 256
     prefill_chunks_per_step: int = 4
+    # Fused mixed steps (docs/serving.md "Fused mixed steps"): while
+    # any slot is decoding, ONE prefill chunk rides the decode
+    # dispatch as a single fused device program (model.mixed_step /
+    # paged_mixed_step) instead of a standalone prefill dispatch
+    # landing BETWEEN decode dispatches — the decode batch's
+    # inter-token latency stops absorbing whole prefill chunks under
+    # long-prompt admissions, and each layer's weights stream once
+    # for chunk + decode combined. The scheduler's chunk-budget hook
+    # (Scheduler.next_prefill_slot) picks which prefilling slot gets
+    # the fused lane. Greedy outputs are BIT-IDENTICAL fused on vs
+    # off (dense+paged, any pipeline depth, spec on/off); only step
+    # timing changes. Off by default (the historical step shape).
+    fused_prefill: bool = False
     # int8 weight-only quantization (ops/quant.py): halves weight HBM
     # bytes (8B fits one v5e chip) and speeds the bandwidth-bound decode.
     quantize: bool = False
@@ -103,6 +116,16 @@ class EngineConfig:
     # preempted and resumed later by re-prefilling prompt+output.
     paged: bool = False
     page_size: int = 64
+    # KV page value dtype (paged only): 'bfloat16' (default — the
+    # cache_dtype path, bit-for-bit the pre-quantization engine) or
+    # 'int8' — pages hold int8 values plus one fp32 absmax scale per
+    # token row per KV head (quant-on-write, dequant-in-kernel;
+    # ops/paged_attention.py), halving KV bytes per token so the same
+    # HBM budget holds ~2x the resident pages (bigger prefix cache,
+    # less preemption). Greedy outputs are NOT bit-identical to bf16 —
+    # they are gated at a pinned tolerance (max logit delta + a
+    # greedy-divergence-step floor, tests/unit_tests/test_infer_fused.py).
+    kv_dtype: str = 'bfloat16'
     # Total pool pages (page 0 is a reserved garbage sink). None →
     # dense-equivalent capacity (n_slots * max_seq_len / page_size + 1);
     # set lower to cap KV HBM at the expected tokens-in-flight.
@@ -271,6 +294,22 @@ class Request:
             return self._cond.wait_for(lambda: self.done, timeout)
 
 
+@dataclasses.dataclass
+class _ChunkPlan:
+    """A prepared-but-not-yet-dispatched prefill chunk: page coverage
+    secured, bucket chosen, tokens padded. Dispatches either standalone
+    (``_dispatch_chunk_plan``) or fused into the decode dispatch
+    (``_dispatch_mixed``). Engine thread only."""
+    slot: int
+    req: Request
+    off: int           # prefill offset this chunk starts at
+    bucket: int        # padded chunk length (compiled shape)
+    tl: int            # valid tokens in the chunk
+    total: int         # prompt+resume tokens the slot must cache
+    padded: 'np.ndarray'
+    table_row: Optional[Any] = None   # paged: slot's block-table row
+
+
 def tp_mesh(tp: int) -> 'jax.sharding.Mesh':
     """The engine's tensor-parallel mesh ((tp, fsdp=1) so the training
     param rules apply directly).
@@ -350,6 +389,11 @@ class InferenceEngine:
         '_decode_tokens': '_lock:mut',
         '_decode_steps': '_lock:mut',
         '_decode_time': '_lock:mut',
+        # Prefill-stall decomposition gauges (metrics() reads the
+        # set under the lock; the engine thread bumps them there too).
+        '_prefill_tokens': '_lock:mut',
+        '_fused_steps': '_lock:mut',
+        '_stall_steps': '_lock:mut',
         '_abandoned': '_lock',      # sweep writes vs metrics reads
         '_expired': '_lock',
         '_cancelled': '_lock',
@@ -427,11 +471,21 @@ class InferenceEngine:
                     f'(needs >= {min_pages} incl. the sink page)')
             self.allocator = paged_cache_lib.PageAllocator(
                 n_pages, page, self.ecfg.n_slots, max_pages_per_slot)
+            if self.ecfg.kv_dtype not in ('bfloat16', 'int8'):
+                raise ValueError(
+                    f"kv_dtype must be 'bfloat16' or 'int8', got "
+                    f'{self.ecfg.kv_dtype!r}')
+            kv_dtype = (jnp.int8 if self.ecfg.kv_dtype == 'int8'
+                        else jnp.dtype(self.ecfg.cache_dtype))
             self.cache = paged_cache_lib.init_paged_cache(
                 config.n_layers, self.ecfg.n_slots, n_pages, page,
-                config.n_kv_heads, config.head_dim,
-                dtype=jnp.dtype(self.ecfg.cache_dtype))
+                config.n_kv_heads, config.head_dim, dtype=kv_dtype)
         else:
+            if self.ecfg.kv_dtype not in ('bfloat16',):
+                raise ValueError(
+                    'kv_dtype=int8 requires the paged KV cache '
+                    '(EngineConfig.paged=True): quantization is at '
+                    'page granularity')
             if self.ecfg.prefix_cache:
                 raise ValueError(
                     'prefix_cache requires the paged KV cache '
@@ -510,6 +564,23 @@ class InferenceEngine:
         self._decode_tokens = 0
         self._decode_time = 0.0
         self._preemptions = 0
+        # ---- fused mixed-step state -------------------------------------
+        self._fused = bool(self.ecfg.fused_prefill)
+        # Prefill-stall decomposition: prompt tokens dispatched into
+        # prefill chunks (fused or standalone), fused mixed dispatches,
+        # and steps where an active decode batch waited on a
+        # STANDALONE prefill dispatch (the ITL stall fused mode
+        # removes — ~0 with fused_prefill on).
+        self._prefill_tokens = 0
+        self._fused_steps = 0
+        self._stall_steps = 0
+        # Slots whose prompt finished prefilling WITHOUT joining a
+        # decode dispatch yet (fused-mode edge: the decode batch
+        # evaporated under page pressure, so the completing chunk went
+        # out standalone): their first token sits in _last_dev and
+        # surfaces via the NEXT dispatch's pair row 0. Engine thread
+        # only.
+        self._pending_first: Dict[int, Request] = {}
         # Zero-downtime-serving counters: queued requests dropped
         # because the client vanished, requests cut by their deadline,
         # active requests cancelled by a client disconnect.
@@ -620,8 +691,36 @@ class InferenceEngine:
                     active, new_cache.lengths)
                 return pair, new_last, paged_cache_lib.PagedKVCache(
                     k_pages=new_cache.k_pages,
-                    v_pages=new_cache.v_pages, lengths=lengths)
+                    v_pages=new_cache.v_pages, lengths=lengths,
+                    k_scales=new_cache.k_scales,
+                    v_scales=new_cache.v_scales)
             self._verify = _jit(_verify_paged, donate=(0,))
+
+            def _mixed_paged(kv_cache, params, slot, table_row,
+                             chunk_tokens, offset, true_len, chunk_key,
+                             chunk_temp, tables, last, key, temps,
+                             active):
+                # One fused launch: the chunk's first-token sample
+                # lands in the last-token vector (meaningful only on
+                # the final chunk, like the standalone prefill), the
+                # decode half samples every active slot — pair row 0
+                # echoes the post-chunk last vector so a completing
+                # chunk's first token surfaces through the SAME host
+                # read as the decode tokens.
+                chunk_logits, dec_logits, new_cache = (
+                    model_lib.paged_mixed_step(
+                        config, params, kv_cache, slot, table_row,
+                        chunk_tokens, offset, true_len, tables, last,
+                        active))
+                first = sampling_lib.sample(
+                    chunk_logits[None], chunk_key, chunk_temp[None],
+                    top_k=self.ecfg.top_k)[0]
+                last1 = last.at[slot].set(first.astype(last.dtype))
+                sampled = sampling_lib.sample(dec_logits, key, temps,
+                                              top_k=self.ecfg.top_k)
+                toks_out = jnp.where(active, sampled, last1)
+                return jnp.stack([last1, toks_out]), new_cache
+            self._mixed = _jit(_mixed_paged, donate=(0,))
 
             if self.ecfg.prefix_cache:
                 # Copy-on-write page duplication. src/dst are traced
@@ -689,6 +788,25 @@ class InferenceEngine:
                 _verify_dense, donate=(0,),
                 out=(self._rep_sharding, self._rep_sharding,
                      self._cache_sharding))
+
+            def _mixed_dense(kv_cache, params, slot, chunk_tokens,
+                             offset, true_len, chunk_key, chunk_temp,
+                             last, key, temps, active):
+                chunk_logits, dec_logits, new_cache = (
+                    model_lib.mixed_step(
+                        config, params, kv_cache, slot, chunk_tokens,
+                        offset, true_len, last, active))
+                first = sampling_lib.sample(
+                    chunk_logits[None], chunk_key, chunk_temp[None],
+                    top_k=self.ecfg.top_k)[0]
+                last1 = last.at[slot].set(first.astype(last.dtype))
+                sampled = sampling_lib.sample(dec_logits, key, temps,
+                                              top_k=self.ecfg.top_k)
+                toks_out = jnp.where(active, sampled, last1)
+                return jnp.stack([last1, toks_out]), new_cache
+            self._mixed = _jit(
+                _mixed_dense, donate=(0,),
+                out=(self._rep_sharding, self._cache_sharding))
 
     def _shard_tp(self) -> None:
         """Distribute params + KV cache over a `tp` mesh axis.
@@ -860,6 +978,18 @@ class InferenceEngine:
         cached (slot joins this step's decode), False on progress, None
         when the page pool cannot cover the chunk right now (deferred;
         decode continues and finishing slots free pages)."""
+        plan = self._prepare_chunk(slot)
+        if plan is None:
+            return None
+        return self._dispatch_chunk_plan(plan)
+
+    def _prepare_chunk(self, slot: int) -> Optional[_ChunkPlan]:
+        """Host half of advancing one prefilling slot by ONE chunk:
+        prefix-cache attach (with the defer-time rollback), page
+        coverage, bucket choice, padded token block — everything
+        except the device call, so the chunk can dispatch standalone
+        OR fused into the decode dispatch. Returns None when the page
+        pool cannot cover the chunk right now (deferred)."""
         req = self._slots[slot]
         off = self._prefilling[slot]
         source = self._source_tokens(req)
@@ -930,37 +1060,59 @@ class InferenceEngine:
                     self.prefix.tokens_saved -= just_attached
                 return None
             table_row = jnp.asarray(self.allocator.table()[slot])
+        else:
+            table_row = None
+        padded = np.zeros((bucket,), np.int32)
+        padded[:tl] = source[off:off + tl]
+        return _ChunkPlan(slot=slot, req=req, off=off, bucket=bucket,
+                          tl=tl, total=n, padded=padded,
+                          table_row=table_row)
+
+    def _note_first_dispatch(self, req: Request) -> None:
+        """Queue-wait boundary: the request's first chunk is about to
+        dispatch (page coverage secured). Not re-stamped on preemption
+        resume — the wait being measured is the scheduler's
+        admission-to-service latency."""
         if req.first_dispatch_at is None:
-            # Queue-wait boundary: the request's first chunk is about
-            # to dispatch (page coverage secured above). Not re-stamped
-            # on preemption resume — the wait being measured is the
-            # scheduler's admission-to-service latency.
             req.first_dispatch_at = time.time()
             wait = req.first_dispatch_at - req.submitted_at
             with self._lock:
                 self._queue_waits.append(wait)
                 self._sched.note_queue_wait(req, wait)
-        padded = np.zeros((bucket,), np.int32)
-        padded[:tl] = source[off:off + tl]
+
+    def _dispatch_chunk_plan(self, plan: _ChunkPlan) -> bool:
+        """Standalone dispatch of a prepared chunk via the prefill
+        program (no host sync). Returns True when the prompt is now
+        fully cached."""
+        self._note_first_dispatch(plan.req)
         if self.allocator is not None:
             self.cache, self._last_dev = self._prefill_chunk(
-                self.cache, self.params, jnp.int32(slot), table_row,
-                jnp.asarray(padded), jnp.int32(off), jnp.int32(tl),
-                self._next_key(), jnp.float32(req.temperature),
+                self.cache, self.params, jnp.int32(plan.slot),
+                plan.table_row, jnp.asarray(plan.padded),
+                jnp.int32(plan.off), jnp.int32(plan.tl),
+                self._next_key(), jnp.float32(plan.req.temperature),
                 self._last_dev)
         else:
             self.cache, self._last_dev = self._prefill_chunk(
-                self.cache, self.params, jnp.int32(slot),
-                jnp.asarray(padded), jnp.int32(off), jnp.int32(tl),
-                self._next_key(), jnp.float32(req.temperature),
-                self._last_dev)
-        off += tl
-        if off < n:
-            self._prefilling[slot] = off
+                self.cache, self.params, jnp.int32(plan.slot),
+                jnp.asarray(plan.padded), jnp.int32(plan.off),
+                jnp.int32(plan.tl), self._next_key(),
+                jnp.float32(plan.req.temperature), self._last_dev)
+        with self._lock:
+            self._prefill_tokens += plan.tl
+        return self._note_chunk_dispatched(plan)
+
+    def _note_chunk_dispatched(self, plan: _ChunkPlan) -> bool:
+        """Post-dispatch bookkeeping shared by the standalone and
+        fused paths: advance (or retire) the prefill frontier. True =
+        the slot's prompt is fully cached."""
+        off = plan.off + plan.tl
+        if off < plan.total:
+            self._prefilling[plan.slot] = off
             return False
-        del self._prefilling[slot]
-        self._slot_len[slot] = n
-        self._temps[slot] = req.temperature
+        del self._prefilling[plan.slot]
+        self._slot_len[plan.slot] = plan.total
+        self._temps[plan.slot] = plan.req.temperature
         self._temps_dirty = True
         return True
 
@@ -1245,25 +1397,52 @@ class InferenceEngine:
         # only in device compute.
         just_prefilled: List[int] = []
         deferred: set = set()
-        for _ in range(self.ecfg.prefill_chunks_per_step):
-            candidates = sorted(s for s in self._prefilling
-                                if s not in deferred)
-            if not candidates:
-                break
-            # The scheduler spends the chunk budget (fcfs: the
-            # historical round-robin cursor; deadline: most urgent
-            # first; wfq: rotate across tenants). Under the lock —
-            # scheduler state is lock-guarded by contract.
+        plan: Optional[_ChunkPlan] = None
+        has_decode = any(r is not None and s not in self._prefilling
+                         for s, r in enumerate(self._slots))
+        if self._fused and has_decode and self._prefilling:
+            # Fused mode with an active decode batch: exactly ONE
+            # chunk rides the decode dispatch (standalone prefill
+            # dispatches landing between decode dispatches are the
+            # ITL stall this mode removes). The scheduler's
+            # chunk-budget hook picks which prefilling slot gets the
+            # fused lane; a dry pool defers the chunk — decode keeps
+            # running and freeing pages, so no livelock is possible
+            # while anything decodes.
+            candidates = sorted(self._prefilling)
             with self._lock:
                 slot = self._sched.next_prefill_slot(candidates,
                                                      self._slots)
-            result = self._do_chunk(slot)
-            if result is None:
-                # Page pool dry: stop burning chunk budget on this slot
-                # until decode frees pages.
-                deferred.add(slot)
-            elif result:
-                just_prefilled.append(slot)
+            plan = self._prepare_chunk(slot)
+        else:
+            chunks_dispatched = 0
+            for _ in range(self.ecfg.prefill_chunks_per_step):
+                candidates = sorted(s for s in self._prefilling
+                                    if s not in deferred)
+                if not candidates:
+                    break
+                # The scheduler spends the chunk budget (fcfs: the
+                # historical round-robin cursor; deadline: most urgent
+                # first; wfq: rotate across tenants). Under the lock —
+                # scheduler state is lock-guarded by contract.
+                with self._lock:
+                    slot = self._sched.next_prefill_slot(candidates,
+                                                         self._slots)
+                result = self._do_chunk(slot)
+                if result is None:
+                    # Page pool dry: stop burning chunk budget on this
+                    # slot until decode frees pages.
+                    deferred.add(slot)
+                else:
+                    chunks_dispatched += 1
+                    if result:
+                        just_prefilled.append(slot)
+            if chunks_dispatched and has_decode:
+                # Decode-ready slots waited on standalone prefill
+                # dispatch(es) this step — the stall the fused mode
+                # exists to remove (its gauge stays ~0 fused-on).
+                with self._lock:
+                    self._stall_steps += 1
         if (deferred and self.allocator is not None
                 and not any(r is not None and s not in self._prefilling
                             for s, r in enumerate(self._slots))):
@@ -1295,6 +1474,13 @@ class InferenceEngine:
         # pair read is the PREVIOUS step's, consumed only after this
         # step's decode is already dispatched, so the device never
         # waits on host bookkeeping.
+        if plan is not None:
+            # A chunk is riding this step's dispatch: the fused mixed
+            # program has no draft lanes, so speculation stands down
+            # for the step (prefill progress outranks drafting — the
+            # opportunistic contract; outputs are unchanged either
+            # way, only step counts move).
+            spec_k = 0
         if spec_k:
             # Draft eligibility is knowable from host slot state alone
             # (greedy, opted in, fully prefilled, not this step's
@@ -1346,10 +1532,28 @@ class InferenceEngine:
                     if r is not None and s not in self._prefilling]
         if self.allocator is not None and decoding:
             decoding = self._ensure_decode_pages(decoding)
-        if not decoding and not self._queue:
+        if plan is not None and (
+                self._slots[plan.slot] is not plan.req
+                or self._prefilling.get(plan.slot) != plan.off):
+            # The chunk's slot was preempted while decode page
+            # pressure resolved: the request is back in the queue and
+            # will re-prefill from scratch — drop the stale plan.
+            plan = None
+        if not decoding and not self._queue and plan is None:
             return len(self._prefilling)
         t0 = time.perf_counter()
-        if decoding:
+        if plan is not None:
+            if decoding:
+                self._dispatch_mixed(decoding, plan)
+            else:
+                # The decode batch evaporated (page-pressure drains
+                # finished every decoder): the prepared chunk goes out
+                # standalone; a completed prompt's first token parks
+                # in _last_dev and surfaces via the NEXT dispatch's
+                # pair row 0 (_pending_first).
+                if self._dispatch_chunk_plan(plan):
+                    self._pending_first[plan.slot] = plan.req
+        elif decoding:
             drafts = (self._build_drafts(decoding, just_prefilled,
                                          spec_k) if spec_k else None)
             if drafts is not None:
@@ -1424,8 +1628,70 @@ class InferenceEngine:
         self._queue.append((
             pair,
             [(s, self._slots[s]) for s in decoding],
-            [(s, self._slots[s]) for s in just_prefilled],
+            self._take_pending_first()
+            + [(s, self._slots[s]) for s in just_prefilled],
             None))   # no verify payload: consume takes the decode path
+
+    def _take_pending_first(self) -> List[tuple]:
+        """Drain the fused-mode pending-first-token slots into this
+        dispatch's pair record (their first token is already in
+        ``_last_dev``, so pair row 0 will echo it). Identity-checked:
+        a slot preempted or refilled since simply re-prefills and
+        re-samples. Engine thread only."""
+        if not self._pending_first:
+            return []
+        out = [(s, r) for s, r in self._pending_first.items()
+               if self._slots[s] is r]
+        self._pending_first.clear()
+        return out
+
+    def _dispatch_mixed(self, decoding: List[int],
+                        plan: _ChunkPlan) -> None:
+        """Dispatch ONE fused mixed step (no host sync): the plan's
+        prefill chunk AND the decode batch in a single device program
+        — the weights stream once for both, and no standalone prefill
+        dispatch sits between decode dispatches. The [2, slots] pair
+        rides the in-flight queue exactly like a decode pair; a chunk
+        that completes its prompt surfaces its first token through
+        pair row 0 (the prefilled list) and joins the NEXT step's
+        decode — one extra step, zero token-sequence difference
+        (greedy outputs are gated bit-identical fused on vs off)."""
+        self._refresh_dispatch_state(decoding)
+        self._note_first_dispatch(plan.req)
+        chunk_key = self._next_key()
+        dec_key = self._next_key()
+        if self.allocator is not None:
+            pair, self.cache = self._mixed(
+                self.cache, self.params, jnp.int32(plan.slot),
+                plan.table_row, jnp.asarray(plan.padded),
+                jnp.int32(plan.off), jnp.int32(plan.tl), chunk_key,
+                jnp.float32(plan.req.temperature), self._table_dev,
+                self._last_dev, dec_key, self._temps_dev,
+                self._active_dev)
+        else:
+            pair, self.cache = self._mixed(
+                self.cache, self.params, jnp.int32(plan.slot),
+                jnp.asarray(plan.padded), jnp.int32(plan.off),
+                jnp.int32(plan.tl), chunk_key,
+                jnp.float32(plan.req.temperature), self._last_dev,
+                dec_key, self._temps_dev, self._active_dev)
+        self._last_dev = pair[1]
+        pair.copy_to_host_async()
+        with self._lock:
+            self._decode_steps += 1
+            self._fused_steps += 1
+            self._prefill_tokens += plan.tl
+            for s in decoding:
+                self._inflight_tok[s] += 1
+        completes = self._note_chunk_dispatched(plan)
+        prefilled = self._take_pending_first()
+        if completes:
+            prefilled.append((plan.slot, plan.req))
+        self._queue.append((
+            pair,
+            [(s, self._slots[s]) for s in decoding],
+            prefilled,
+            None))
 
     def _spec_eligible(self, s: int, fresh: set) -> bool:
         """May slot ``s`` draft this step? Greedy, opted in, fully
@@ -1540,7 +1806,8 @@ class InferenceEngine:
             pair,
             [(s, self._slots[s], int(draft_lens[s]))
              for s in decoding],
-            [(s, self._slots[s]) for s in just_prefilled],
+            self._take_pending_first()
+            + [(s, self._slots[s]) for s in just_prefilled],
             draft_mat.shape[1] + 1))
 
     def _consume_one(self) -> None:
@@ -1788,6 +2055,17 @@ class InferenceEngine:
                 'tokens_per_step': (round(
                     self._decode_tokens / self._decode_steps, 4)
                     if self._decode_steps else None),
+                # Prefill-stall decomposition (docs/serving.md "Fused
+                # mixed steps"): prompt tokens dispatched into chunks,
+                # how many rode a fused dispatch, and how often an
+                # active decode batch waited on a STANDALONE prefill
+                # dispatch instead (~0 with fused_prefill on).
+                'prefill_tokens': self._prefill_tokens,
+                'prefill_tokens_per_step': (round(
+                    self._prefill_tokens / self._decode_steps, 4)
+                    if self._decode_steps else None),
+                'fused_steps': self._fused_steps,
+                'decode_stall_steps': self._stall_steps,
                 **({'spec_k': self._spec_k,
                     'spec_steps': self._spec_steps,
                     'spec_slot_steps': self._spec_slot_steps,
@@ -1831,11 +2109,30 @@ class InferenceEngine:
                     'page_size': self.allocator.page_size,
                     'pages_total': self.allocator.n_pages,
                     'pages_free': self.allocator.free_pages,
-                    'preemptions': self._preemptions}
+                    'preemptions': self._preemptions,
+                    # Page value dtype + per-(k+v)-page HBM bytes
+                    # across all layers (int8 incl. its fp32 row
+                    # scales) — the denominator behind the "~2x
+                    # resident pages per HBM byte" claim.
+                    'kv_dtype': self.ecfg.kv_dtype,
+                    'kv_page_bytes': self._kv_page_bytes()}
                    if self.allocator is not None else {}),
                 **(self.prefix.stats() if self.prefix is not None
                    else {}),
             }
+
+    def _kv_page_bytes(self) -> int:
+        """HBM bytes one physical page costs across every layer — K
+        plus V values at their dtype, plus the fp32 row scales on the
+        int8 flavor."""
+        per = self.cache.k_pages.dtype.itemsize
+        page = self.allocator.page_size
+        vals = (2 * self.config.n_layers * self.config.n_kv_heads
+                * page * self.config.head_dim * per)
+        if self.cache.k_scales is not None:
+            vals += (2 * self.config.n_layers * self.config.n_kv_heads
+                     * page * self.cache.k_scales.dtype.itemsize)
+        return vals
 
     def compiled_counts(self) -> Dict[str, int]:
         """Distinct compiled programs per jitted entry point — the
@@ -1852,6 +2149,12 @@ class InferenceEngine:
         return {'prefill': n(self._prefill_chunk),
                 'decode': n(self._decode),
                 'free': n(self._free),
+                # Fused mode adds one mixed program per CHUNK BUCKET
+                # (the chunk shape is the only varying operand — the
+                # decode half is static), mirroring the prefill
+                # ladder; fused-off engines never compile (or report)
+                # it.
+                **({'mixed': n(self._mixed)} if self._fused else {}),
                 # Prefix cache adds exactly ONE potential program (the
                 # CoW page copy) which stays at 0 compiles unless a CoW
                 # actually fires — prefill-from-offset reuses the
@@ -2009,6 +2312,7 @@ class EnginePool:
                 'accepted_len_mean': (round(emitted / lanes, 4)
                                       if lanes else None),
             }
+        total_prefill = sum(t['prefill_tokens'] for t in tiers)
         return {
             **prefix_agg,
             **spec_agg,
@@ -2018,6 +2322,13 @@ class EnginePool:
                                       if total_time else 0.0),
             'tokens_per_step': (round(total_tokens / total_steps, 4)
                                 if total_steps else None),
+            'prefill_tokens': total_prefill,
+            'prefill_tokens_per_step': (round(
+                total_prefill / total_steps, 4)
+                if total_steps else None),
+            'fused_steps': sum(t['fused_steps'] for t in tiers),
+            'decode_stall_steps': sum(t['decode_stall_steps']
+                                      for t in tiers),
             'ttft_p50_s': (ttfts[len(ttfts) // 2] if ttfts else None),
             'queue_wait_p50_ms': (round(
                 waits[len(waits) // 2] * 1e3, 3) if waits else None),

@@ -147,14 +147,14 @@ def paged_prefill_chunk(config: llama.LlamaConfig, params: llama.Params,
     positions = offset + jnp.arange(C, dtype=jnp.int32)
 
     def body(carry, xs):
-        layer, k_layer, v_layer = xs
-        h, k_new, v_new = _paged_chunk_layer(
+        layer, k_layer, v_layer, ks, vs = _unpack_layer_xs(xs)
+        h, k_new, v_new, ks, vs = _paged_chunk_layer(
             config, carry, layer, cos, sin, k_layer, v_layer,
-            table_row, positions, offset, true_len)
-        return h, (k_new, v_new)
+            table_row, positions, offset, true_len, ks, vs)
+        return h, _pack_layer_ys(k_new, v_new, ks, vs)
 
-    x, (k_upd, v_upd) = jax.lax.scan(
-        body, x, (params['layers'], pkv.k_pages, pkv.v_pages))
+    x, ys = jax.lax.scan(body, x, _layer_xs(params, pkv))
+    k_upd, v_upd, ks_upd, vs_upd = _unpack_layer_upd(pkv, ys)
     x = norms.rms_norm(x, params['final_norm'], config.norm_eps)
     last = jax.lax.dynamic_index_in_dim(x[0], true_len - 1, axis=0,
                                         keepdims=False)
@@ -163,11 +163,42 @@ def paged_prefill_chunk(config: llama.LlamaConfig, params: llama.Params,
     lengths = pkv.lengths.at[slot].set(
         (offset + true_len).astype(jnp.int32))
     return paged_cache_lib.PagedKVCache(
-        k_pages=k_upd, v_pages=v_upd, lengths=lengths), logits
+        k_pages=k_upd, v_pages=v_upd, lengths=lengths,
+        k_scales=ks_upd, v_scales=vs_upd), logits
+
+
+def _layer_xs(params, pkv):
+    """Per-layer scan operands: pages, plus the scale pages on the
+    int8 flavor (lax.scan cannot carry None leaves in xs)."""
+    if pkv.k_scales is not None:
+        return (params['layers'], pkv.k_pages, pkv.v_pages,
+                pkv.k_scales, pkv.v_scales)
+    return (params['layers'], pkv.k_pages, pkv.v_pages)
+
+
+def _unpack_layer_xs(xs):
+    if len(xs) == 5:
+        return xs
+    layer, kp, vp = xs
+    return layer, kp, vp, None, None
+
+
+def _pack_layer_ys(k_new, v_new, ks, vs):
+    if ks is not None:
+        return (k_new, v_new, ks, vs)
+    return (k_new, v_new)
+
+
+def _unpack_layer_upd(pkv, ys):
+    if pkv.k_scales is not None:
+        return ys
+    k_upd, v_upd = ys
+    return k_upd, v_upd, None, None
 
 
 def _paged_chunk_layer(config, x, layer, cos, sin, k_pages, v_pages,
-                       table_row, positions, offset, true_len):
+                       table_row, positions, offset, true_len,
+                       k_scales=None, v_scales=None):
     """One layer of paged chunked prefill. k_pages/v_pages:
     [hkv, P, page, hd] (this layer); x: [1, C, d]."""
     _, C, d = x.shape
@@ -181,16 +212,25 @@ def _paged_chunk_layer(config, x, layer, cos, sin, k_pages, v_pages,
     q = rope_lib.apply_rope(q, cos, sin, positions[None])
     k = rope_lib.apply_rope(k, cos, sin, positions[None])
 
-    # Write-then-attend, page edition.
-    k_pages, v_pages = paged_attn.write_chunk_pages(
-        k_pages, v_pages, k[0], v[0], table_row, offset)
+    # Write-then-attend, page edition (quant-on-write on int8 pages:
+    # the chunk's own self-attention reads its rows back dequantized,
+    # exactly what every later decode step will see).
+    if k_scales is not None:
+        k_pages, v_pages, k_scales, v_scales = (
+            paged_attn.write_chunk_pages(k_pages, v_pages, k[0], v[0],
+                                         table_row, offset,
+                                         k_scales, v_scales))
+    else:
+        k_pages, v_pages = paged_attn.write_chunk_pages(
+            k_pages, v_pages, k[0], v[0], table_row, offset)
     qg = q[0].reshape(C, hkv, group, hd)
     att = paged_attn.paged_prefill_attention(
-        qg, k_pages, v_pages, table_row, offset, true_len)
+        qg, k_pages, v_pages, table_row, offset, true_len,
+        k_scales=k_scales, v_scales=v_scales)
     att = att.reshape(1, C, hq * hd).astype(x.dtype)
     x = x + quant_lib.qdot(att, layer['wo'])
     x = llama.mlp_block(config, x, layer)
-    return x, k_pages, v_pages
+    return x, k_pages, v_pages, k_scales, v_scales
 
 
 def paged_decode_step(config: llama.LlamaConfig, params: llama.Params,
@@ -213,26 +253,28 @@ def paged_decode_step(config: llama.LlamaConfig, params: llama.Params,
                                          config.rope_theta)
 
     def body(carry, xs):
-        layer, k_layer, v_layer = xs
-        h, k_new, v_new = _paged_decode_layer(
+        layer, k_layer, v_layer, ks, vs = _unpack_layer_xs(xs)
+        h, k_new, v_new, ks, vs = _paged_decode_layer(
             config, carry, layer, cos, sin, k_layer, v_layer,
-            block_tables, positions)
-        return h, (k_new, v_new)
+            block_tables, positions, ks, vs)
+        return h, _pack_layer_ys(k_new, v_new, ks, vs)
 
-    x, (k_upd, v_upd) = jax.lax.scan(
-        body, x, (params['layers'], pkv.k_pages, pkv.v_pages))
+    x, ys = jax.lax.scan(body, x, _layer_xs(params, pkv))
+    k_upd, v_upd, ks_upd, vs_upd = _unpack_layer_upd(pkv, ys)
     x = norms.rms_norm(x, params['final_norm'], config.norm_eps)
     logits = quant_lib.qdot(x[:, 0],
                             params['lm_head']).astype(jnp.float32)
     bump = (jnp.ones_like(pkv.lengths) if active is None
             else active.astype(pkv.lengths.dtype))
     new_cache = paged_cache_lib.PagedKVCache(
-        k_pages=k_upd, v_pages=v_upd, lengths=pkv.lengths + bump)
+        k_pages=k_upd, v_pages=v_upd, lengths=pkv.lengths + bump,
+        k_scales=ks_upd, v_scales=vs_upd)
     return logits, new_cache
 
 
 def _paged_decode_layer(config, x, layer, cos, sin, k_pages, v_pages,
-                        block_tables, positions):
+                        block_tables, positions,
+                        k_scales=None, v_scales=None):
     slots, _, d = x.shape
     hq, hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     group = hq // hkv
@@ -246,15 +288,23 @@ def _paged_decode_layer(config, x, layer, cos, sin, k_pages, v_pages,
 
     # Write the new K/V into the slot's current page, then attend over
     # positions <= length (the new token sees itself).
-    k_pages, v_pages = paged_attn.append_token_pages(
-        k_pages, v_pages, k[:, 0], v[:, 0], block_tables, positions)
+    if k_scales is not None:
+        k_pages, v_pages, k_scales, v_scales = (
+            paged_attn.append_token_pages(
+                k_pages, v_pages, k[:, 0], v[:, 0], block_tables,
+                positions, k_scales, v_scales))
+    else:
+        k_pages, v_pages = paged_attn.append_token_pages(
+            k_pages, v_pages, k[:, 0], v[:, 0], block_tables,
+            positions)
     qg = q[:, 0].reshape(slots, hkv, group, hd)
     att = paged_attn.paged_decode_attention(
-        qg, k_pages, v_pages, block_tables, positions + 1)
+        qg, k_pages, v_pages, block_tables, positions + 1,
+        k_scales=k_scales, v_scales=v_scales)
     att = att.reshape(slots, 1, hq * hd).astype(x.dtype)
     x = x + quant_lib.qdot(att, layer['wo'])
     x = llama.mlp_block(config, x, layer)
-    return x, k_pages, v_pages
+    return x, k_pages, v_pages, k_scales, v_scales
 
 
 def verify_step(config: llama.LlamaConfig, params: llama.Params,
@@ -355,22 +405,24 @@ def paged_verify_step(config: llama.LlamaConfig, params: llama.Params,
                                          config.rope_theta)
 
     def body(carry, xs):
-        layer, k_layer, v_layer = xs
-        h, k_new, v_new = _paged_verify_layer(
+        layer, k_layer, v_layer, ks, vs = _unpack_layer_xs(xs)
+        h, k_new, v_new, ks, vs = _paged_verify_layer(
             config, carry, layer, cos, sin, k_layer, v_layer,
-            block_tables, positions, pkv.lengths)
-        return h, (k_new, v_new)
+            block_tables, positions, pkv.lengths, ks, vs)
+        return h, _pack_layer_ys(k_new, v_new, ks, vs)
 
-    x, (k_upd, v_upd) = jax.lax.scan(
-        body, x, (params['layers'], pkv.k_pages, pkv.v_pages))
+    x, ys = jax.lax.scan(body, x, _layer_xs(params, pkv))
+    k_upd, v_upd, ks_upd, vs_upd = _unpack_layer_upd(pkv, ys)
     x = norms.rms_norm(x, params['final_norm'], config.norm_eps)
     logits = quant_lib.qdot(x, params['lm_head']).astype(jnp.float32)
     return logits, paged_cache_lib.PagedKVCache(
-        k_pages=k_upd, v_pages=v_upd, lengths=pkv.lengths)
+        k_pages=k_upd, v_pages=v_upd, lengths=pkv.lengths,
+        k_scales=ks_upd, v_scales=vs_upd)
 
 
 def _paged_verify_layer(config, x, layer, cos, sin, k_pages, v_pages,
-                        block_tables, positions, lengths):
+                        block_tables, positions, lengths,
+                        k_scales=None, v_scales=None):
     slots, R, d = x.shape
     hq, hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     group = hq // hkv
@@ -383,15 +435,22 @@ def _paged_verify_layer(config, x, layer, cos, sin, k_pages, v_pages,
     k = rope_lib.apply_rope(k, cos, sin, positions)
 
     # Write-then-attend, run edition (sink-redirected past coverage).
-    k_pages, v_pages = paged_attn.append_run_pages(
-        k_pages, v_pages, k, v, block_tables, lengths)
+    if k_scales is not None:
+        k_pages, v_pages, k_scales, v_scales = (
+            paged_attn.append_run_pages(k_pages, v_pages, k, v,
+                                        block_tables, lengths,
+                                        k_scales, v_scales))
+    else:
+        k_pages, v_pages = paged_attn.append_run_pages(
+            k_pages, v_pages, k, v, block_tables, lengths)
     qg = q.reshape(slots, R, hkv, group, hd)
     att = paged_attn.paged_verify_attention(
-        qg, k_pages, v_pages, block_tables, lengths)
+        qg, k_pages, v_pages, block_tables, lengths,
+        k_scales=k_scales, v_scales=v_scales)
     att = att.reshape(slots, R, hq * hd).astype(x.dtype)
     x = x + quant_lib.qdot(att, layer['wo'])
     x = llama.mlp_block(config, x, layer)
-    return x, k_pages, v_pages
+    return x, k_pages, v_pages, k_scales, v_scales
 
 
 def decode_step(config: llama.LlamaConfig, params: llama.Params,
@@ -467,3 +526,129 @@ def _decode_layer(config, x, layer, cos, sin, k_cache, v_cache,
 
     x = llama.mlp_block(config, x, layer)
     return x, k_cache, v_cache
+
+
+def mixed_step(config: llama.LlamaConfig, params: llama.Params,
+               kv: cache_lib.KVCache, slot: jnp.ndarray,
+               chunk_tokens: jnp.ndarray, offset: jnp.ndarray,
+               true_len: jnp.ndarray, decode_tokens: jnp.ndarray,
+               active: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                          cache_lib.KVCache]:
+    """FUSED mixed step over the dense cache: ONE prefill chunk of one
+    slot AND one decode token for every active slot in a single
+    compiled program (docs/serving.md "Fused mixed steps").
+
+    Per layer the chunk half runs first (write-then-attend into
+    ``slot``), then the decode half (append-then-attend for every
+    slot) — exactly the order the unfused step produced with two
+    dispatches, so the cache state and both logit sets are the same
+    math as ``prefill_chunk`` followed by ``decode_step``. The win is
+    the layer scan itself: each layer's weights stream through the
+    chip ONCE for chunk + decode combined, and the standalone prefill
+    dispatch that used to sit between two decode dispatches (the ITL
+    stall) is gone.
+
+    The chunk's slot must NOT be in ``active``: a chunk that completes
+    its prompt joins the NEXT step's decode (its first token is
+    sampled from ``chunk_logits`` by the engine wrapper and parked in
+    the last-token vector — one extra step, zero token-sequence
+    difference). Returns (chunk_logits [vocab] at local position
+    true_len-1, decode_logits [slots, vocab], cache') with lengths =
+    chunk frontier advanced to offset+true_len, then +1 per active
+    decode slot.
+    """
+    C = chunk_tokens.shape[0]
+    xc = quant_lib.qembed(params['embed'],
+                          chunk_tokens)[None]         # [1, C, d]
+    xd = quant_lib.qembed(params['embed'],
+                          decode_tokens)[:, None]     # [slots, 1, d]
+    cos, sin = rope_lib.rope_frequencies(config.head_dim,
+                                         config.max_seq_len,
+                                         config.rope_theta)
+    S = kv.max_seq_len
+    cpos = offset + jnp.arange(C, dtype=jnp.int32)    # [C]
+    cmask = jnp.arange(S)[None, :] <= cpos[:, None]
+    # The decode half sees the chunk's frontier advance — the unfused
+    # decode program ran AFTER the prefill program had set lengths.
+    lengths_mid = kv.lengths.at[slot].set(
+        (offset + true_len).astype(jnp.int32))
+    dpos = lengths_mid
+    dmask = jnp.arange(S)[None, :] <= dpos[:, None]
+
+    def body(carry, xs):
+        hc, hd_ = carry
+        layer, k_layer, v_layer = xs
+        hc, k_layer, v_layer = _chunk_layer(
+            config, hc, layer, cos, sin, k_layer, v_layer, slot,
+            cpos, cmask)
+        hd_, k_layer, v_layer = _decode_layer(
+            config, hd_, layer, cos, sin, k_layer, v_layer, dpos,
+            dmask)
+        return (hc, hd_), (k_layer, v_layer)
+
+    (xc, xd), (k_upd, v_upd) = jax.lax.scan(
+        body, (xc, xd), (params['layers'], kv.k, kv.v))
+    xc = norms.rms_norm(xc, params['final_norm'], config.norm_eps)
+    last = jax.lax.dynamic_index_in_dim(xc[0], true_len - 1, axis=0,
+                                        keepdims=False)
+    chunk_logits = quant_lib.qdot(
+        last, params['lm_head']).astype(jnp.float32)
+    xd = norms.rms_norm(xd, params['final_norm'], config.norm_eps)
+    dec_logits = quant_lib.qdot(
+        xd[:, 0], params['lm_head']).astype(jnp.float32)
+    bump = active.astype(lengths_mid.dtype)
+    return chunk_logits, dec_logits, cache_lib.KVCache(
+        k=k_upd, v=v_upd, lengths=lengths_mid + bump)
+
+
+def paged_mixed_step(config: llama.LlamaConfig, params: llama.Params,
+                     pkv: paged_cache_lib.PagedKVCache,
+                     slot: jnp.ndarray, table_row: jnp.ndarray,
+                     chunk_tokens: jnp.ndarray, offset: jnp.ndarray,
+                     true_len: jnp.ndarray,
+                     block_tables: jnp.ndarray,
+                     decode_tokens: jnp.ndarray, active: jnp.ndarray
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                paged_cache_lib.PagedKVCache]:
+    """``mixed_step`` over the paged cache (both KV flavors): the
+    chunk's K/V land in ``table_row``'s pages and the decode appends
+    ride ``block_tables``, same per-layer chunk-then-decode order as
+    the dense version — the unfused two-dispatch state, one launch."""
+    C = chunk_tokens.shape[0]
+    xc = quant_lib.qembed(params['embed'], chunk_tokens)[None]
+    xd = quant_lib.qembed(params['embed'], decode_tokens)[:, None]
+    cos, sin = rope_lib.rope_frequencies(config.head_dim,
+                                         config.max_seq_len,
+                                         config.rope_theta)
+    cpos = offset + jnp.arange(C, dtype=jnp.int32)
+    lengths_mid = pkv.lengths.at[slot].set(
+        (offset + true_len).astype(jnp.int32))
+    dpos = lengths_mid
+
+    def body(carry, xs):
+        hc, hd_ = carry
+        layer, k_layer, v_layer, ks, vs = _unpack_layer_xs(xs)
+        hc, k_layer, v_layer, ks, vs = _paged_chunk_layer(
+            config, hc, layer, cos, sin, k_layer, v_layer,
+            table_row, cpos, offset, true_len, ks, vs)
+        hd_, k_layer, v_layer, ks, vs = _paged_decode_layer(
+            config, hd_, layer, cos, sin, k_layer, v_layer,
+            block_tables, dpos, ks, vs)
+        return (hc, hd_), _pack_layer_ys(k_layer, v_layer, ks, vs)
+
+    (xc, xd), ys = jax.lax.scan(body, (xc, xd),
+                                _layer_xs(params, pkv))
+    k_upd, v_upd, ks_upd, vs_upd = _unpack_layer_upd(pkv, ys)
+    xc = norms.rms_norm(xc, params['final_norm'], config.norm_eps)
+    last = jax.lax.dynamic_index_in_dim(xc[0], true_len - 1, axis=0,
+                                        keepdims=False)
+    chunk_logits = quant_lib.qdot(
+        last, params['lm_head']).astype(jnp.float32)
+    xd = norms.rms_norm(xd, params['final_norm'], config.norm_eps)
+    dec_logits = quant_lib.qdot(
+        xd[:, 0], params['lm_head']).astype(jnp.float32)
+    bump = active.astype(lengths_mid.dtype)
+    return chunk_logits, dec_logits, paged_cache_lib.PagedKVCache(
+        k_pages=k_upd, v_pages=v_upd, lengths=lengths_mid + bump,
+        k_scales=ks_upd, v_scales=vs_upd)

@@ -31,9 +31,16 @@ import numpy as np
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class PagedKVCache:
-    k_pages: jnp.ndarray   # [L, hkv, P, page, hd]
+    k_pages: jnp.ndarray   # [L, hkv, P, page, hd] (bf16, or int8 quantized)
     v_pages: jnp.ndarray   # [L, hkv, P, page, hd]
     lengths: jnp.ndarray   # [slots] int32
+    # int8 KV ("kv_dtype=int8"): per-page, per-head absmax scales — one
+    # fp32 scale per cached token row of each page, pool-aligned with
+    # the pages themselves so a page id addresses its values AND its
+    # scales. None on the bf16 flavor (pytree-wise None is an empty
+    # subtree, so bf16 caches flatten exactly as before).
+    k_scales: Optional[jnp.ndarray] = None   # [L, hkv, P, page] f32
+    v_scales: Optional[jnp.ndarray] = None   # [L, hkv, P, page] f32
 
     @property
     def n_pages(self) -> int:
@@ -48,6 +55,18 @@ def init_paged_cache(n_layers: int, n_slots: int, n_pages: int,
                      page_size: int, n_kv_heads: int, head_dim: int,
                      dtype=jnp.bfloat16) -> PagedKVCache:
     shape = (n_layers, n_kv_heads, n_pages, page_size, head_dim)
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.int8:
+        # Quantized pages halve the KV bytes per token (int8 values +
+        # a 4-byte row scale vs 2-byte bf16 x head_dim), so the same
+        # HBM budget holds ~2x the resident pages — which multiplies
+        # the prefix cache (PR 4) and shrinks preemption pressure.
+        return PagedKVCache(
+            k_pages=jnp.zeros(shape, jnp.int8),
+            v_pages=jnp.zeros(shape, jnp.int8),
+            lengths=jnp.zeros((n_slots,), jnp.int32),
+            k_scales=jnp.zeros(shape[:-1], jnp.float32),
+            v_scales=jnp.zeros(shape[:-1], jnp.float32))
     return PagedKVCache(
         k_pages=jnp.zeros(shape, dtype),
         v_pages=jnp.zeros(shape, dtype),
@@ -247,7 +266,9 @@ def free_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
     """Device half of freeing: zero the slot's length (the allocator's
     ``free`` is the host half)."""
     return PagedKVCache(k_pages=cache.k_pages, v_pages=cache.v_pages,
-                        lengths=cache.lengths.at[slot].set(0))
+                        lengths=cache.lengths.at[slot].set(0),
+                        k_scales=cache.k_scales,
+                        v_scales=cache.v_scales)
 
 
 def copy_page(cache: PagedKVCache, src: jnp.ndarray,
@@ -255,14 +276,18 @@ def copy_page(cache: PagedKVCache, src: jnp.ndarray,
     """Device half of copy-on-write: duplicate physical page ``src``
     into ``dst`` across all layers/heads (the allocator's ``cow`` is
     the host half). src/dst are traced scalars, so one compiled program
-    covers every CoW."""
-    k_src = jax.lax.dynamic_index_in_dim(cache.k_pages, src, axis=2,
-                                         keepdims=True)
-    v_src = jax.lax.dynamic_index_in_dim(cache.v_pages, src, axis=2,
-                                         keepdims=True)
+    covers every CoW. On the int8 flavor the page's row scales copy
+    with it — a page id is only meaningful as a (values, scales) pair."""
+    def dup(arr):
+        row = jax.lax.dynamic_index_in_dim(arr, src, axis=2,
+                                           keepdims=True)
+        return jax.lax.dynamic_update_index_in_dim(arr, row, dst,
+                                                   axis=2)
     return PagedKVCache(
-        k_pages=jax.lax.dynamic_update_index_in_dim(
-            cache.k_pages, k_src, dst, axis=2),
-        v_pages=jax.lax.dynamic_update_index_in_dim(
-            cache.v_pages, v_src, dst, axis=2),
-        lengths=cache.lengths)
+        k_pages=dup(cache.k_pages),
+        v_pages=dup(cache.v_pages),
+        lengths=cache.lengths,
+        k_scales=(dup(cache.k_scales)
+                  if cache.k_scales is not None else None),
+        v_scales=(dup(cache.v_scales)
+                  if cache.v_scales is not None else None))

@@ -772,6 +772,25 @@ def main() -> None:
                         help='Page-pool size (default: dense-equivalent '
                              'slots*max_seq/page; lower it to cap KV '
                              'HBM at expected tokens-in-flight)')
+    parser.add_argument('--kv-dtype', default='bfloat16',
+                        choices=['bfloat16', 'int8'],
+                        help='KV page value dtype (requires --paged '
+                             'for int8): int8 pages carry per-row '
+                             'absmax scales (quant-on-write, dequant-'
+                             'in-kernel) — half the KV bytes per '
+                             'token, ~2x resident pages per HBM '
+                             'budget. Greedy output is gated at a '
+                             'pinned tolerance vs bf16, not '
+                             'bit-identical.')
+    parser.add_argument('--fused-prefill', action='store_true',
+                        help='Fused mixed steps (docs/serving.md): '
+                             'while slots decode, one prefill chunk '
+                             'rides the decode dispatch as a single '
+                             'device program instead of a standalone '
+                             'prefill dispatch stalling the decode '
+                             'batch — long prompts stop showing up '
+                             'as victim ITL spikes. Greedy outputs '
+                             'are bit-identical fused on/off.')
     parser.add_argument('--prefix-cache', action='store_true',
                         help='Shared-prefix KV reuse over the paged '
                              'pool (requires --paged): repeated prompt '
@@ -842,6 +861,9 @@ def main() -> None:
     if args.prefix_cache and not args.paged:
         raise SystemExit('--prefix-cache requires --paged (sharing is '
                          'at page granularity)')
+    if args.kv_dtype != 'bfloat16' and not args.paged:
+        raise SystemExit('--kv-dtype int8 requires --paged '
+                         '(quantization is at page granularity)')
 
     # Multi-host replica: the agent runs this same command on EVERY host
     # of the slice with the jax.distributed env injected
@@ -939,6 +961,8 @@ def main() -> None:
             tp=args.tp, quantize=args.quantize,
             paged=args.paged, page_size=args.page_size,
             n_pages=args.n_pages, prefix_cache=args.prefix_cache,
+            kv_dtype=args.kv_dtype,
+            fused_prefill=args.fused_prefill,
             pipeline_depth=args.pipeline_depth,
             spec_k=args.spec_k, spec_ngram=args.spec_ngram,
             max_queue_requests=args.max_queue_requests,
@@ -962,6 +986,7 @@ def main() -> None:
                 n_slots=args.long_slots,
                 max_seq_len=long_cap,
                 tp=args.tp, quantize=False,   # params already int8
+                fused_prefill=args.fused_prefill,
                 pipeline_depth=args.pipeline_depth,
                 spec_k=args.spec_k, spec_ngram=args.spec_ngram,
                 max_queue_requests=args.max_queue_requests,

@@ -41,6 +41,16 @@ Two entry points, one numerically-identical reference each:
 
 GQA is native: q carries [group] query heads per KV head and the
 kernels never replicate K/V.
+
+int8 KV pages (``kv_dtype=int8``): pages hold int8 values plus one
+fp32 absmax scale per cached token row per KV head
+(``k_scales/v_scales: [hkv, P, page]``), pool-aligned with the pages.
+Quantization happens ON WRITE (each row is quantized independently, so
+appending never rescales earlier rows) and dequantization happens IN
+KERNEL (one multiply per page row before the matmul) — the HBM stream
+is int8, roughly doubling the resident pages per chip. Every entry
+point takes optional ``k_scales``/``v_scales``; None means the bf16
+path, which is bit-for-bit the pre-quantization code.
 """
 from __future__ import annotations
 
@@ -62,12 +72,41 @@ def _interpret_default(interpret: Optional[bool]) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# int8 row quantization (quant-on-write / dequant-in-kernel)
+# ---------------------------------------------------------------------------
+def quantize_rows(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-row int8 quantization over the trailing head_dim
+    axis: returns ``(values int8[...], scales f32[...[:-1]])`` with
+    ``x ≈ values * scales[..., None]``. Deterministic round-to-nearest
+    (NOT stochastic): the same K/V row must quantize identically on
+    every host and every re-prefill, or preemption-resume and multihost
+    lockstep would diverge. An all-zero row gets scale 1.0 so the
+    dequant never divides by (or multiplies garbage into) zero."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _deq(pages: jnp.ndarray, scales: Optional[jnp.ndarray]
+         ) -> jnp.ndarray:
+    """Reference-path dequant: fp32 values, scale applied per row."""
+    out = pages.astype(jnp.float32)
+    if scales is not None:
+        out = out * scales.astype(jnp.float32)[..., None]
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Reference implementations (ground truth in tests; CPU-friendly)
 # ---------------------------------------------------------------------------
 def paged_decode_attention_reference(
         q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         block_tables: jnp.ndarray, lengths: jnp.ndarray,
-        *, sm_scale: Optional[float] = None) -> jnp.ndarray:
+        *, sm_scale: Optional[float] = None,
+        k_scales: Optional[jnp.ndarray] = None,
+        v_scales: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """q: [slots, hkv, group, hd]; pages: [hkv, P, page, hd];
     block_tables: [slots, maxp]; lengths: [slots]. Attends to positions
     < lengths[slot]. Returns [slots, hkv, group, hd] fp32."""
@@ -77,8 +116,8 @@ def paged_decode_attention_reference(
     if sm_scale is None:
         sm_scale = hd ** -0.5
     # Gather each slot's pages: [slots, hkv, maxp*page, hd].
-    k = k_pages[:, block_tables]          # [hkv, slots, maxp, page, hd]
-    v = v_pages[:, block_tables]
+    k = _deq(k_pages, k_scales)[:, block_tables]
+    v = _deq(v_pages, v_scales)[:, block_tables]
     k = k.transpose(1, 0, 2, 3, 4).reshape(slots, hkv, maxp * page, hd)
     v = v.transpose(1, 0, 2, 3, 4).reshape(slots, hkv, maxp * page, hd)
     s = jnp.einsum('bkgd,bksd->bkgs', q.astype(jnp.float32),
@@ -93,7 +132,9 @@ def paged_prefill_attention_reference(
         q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         table_row: jnp.ndarray, offset: jnp.ndarray,
         true_len: jnp.ndarray, *,
-        sm_scale: Optional[float] = None) -> jnp.ndarray:
+        sm_scale: Optional[float] = None,
+        k_scales: Optional[jnp.ndarray] = None,
+        v_scales: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """q: [C, hkv, group, hd] (chunk queries of ONE slot, global
     positions offset..offset+C); pages: [hkv, P, page, hd]; table_row:
     [maxp]. Causal over prefix+chunk: query at global position i attends
@@ -103,8 +144,10 @@ def paged_prefill_attention_reference(
     maxp = table_row.shape[0]
     if sm_scale is None:
         sm_scale = hd ** -0.5
-    k = k_pages[:, table_row].reshape(hkv, maxp * page, hd)
-    v = v_pages[:, table_row].reshape(hkv, maxp * page, hd)
+    k = _deq(k_pages, k_scales)[:, table_row].reshape(
+        hkv, maxp * page, hd)
+    v = _deq(v_pages, v_scales)[:, table_row].reshape(
+        hkv, maxp * page, hd)
     s = jnp.einsum('ckgd,ksd->ckgs', q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
     qpos = offset + jnp.arange(C)
@@ -118,10 +161,14 @@ def paged_prefill_attention_reference(
 # ---------------------------------------------------------------------------
 # Decode kernel
 # ---------------------------------------------------------------------------
-def _decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *,
+def _decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, *refs,
                    page_size: int, sm_scale: float, max_pages: int,
-                   hkv: int):
+                   hkv: int, quantized: bool):
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     p = pl.program_id(1)
     del tables_ref  # consumed by the index_maps
@@ -147,6 +194,11 @@ def _decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
             q = q_ref[0, h].astype(jnp.float32) * sm_scale  # [group, hd]
             k = k_ref[h, 0].astype(jnp.float32)             # [page, hd]
             v = v_ref[h, 0].astype(jnp.float32)
+            if quantized:
+                # Dequant in kernel: the HBM stream stays int8; the
+                # per-row fp32 scale multiplies once in VMEM.
+                k = k * ks_ref[h, 0][:, None]
+                v = v * vs_ref[h, 0][:, None]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)         # [group, page]
@@ -175,7 +227,10 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
                            lengths: jnp.ndarray, *,
                            sm_scale: Optional[float] = None,
                            interpret: Optional[bool] = None,
-                           impl: str = 'auto') -> jnp.ndarray:
+                           impl: str = 'auto',
+                           k_scales: Optional[jnp.ndarray] = None,
+                           v_scales: Optional[jnp.ndarray] = None
+                           ) -> jnp.ndarray:
     """One decode token for every slot over the paged cache.
 
     q: [slots, hkv, group, hd]; k_pages/v_pages: [hkv, P, page, hd];
@@ -183,6 +238,9 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     kernel attends to positions < length — callers that write the new
     token's K/V first pass the already-bumped length, mirroring the
     dense decode path's write-then-attend contract).
+    k_scales/v_scales: [hkv, P, page] f32 row scales on the int8
+    flavor (forces the native kernel — the library kernel has no
+    dequant hook); None = bf16 pages, the pre-quantization path.
 
     impl: 'native' runs this module's grid kernel everywhere; 'jax'
     runs jax's tuned JetStream decode kernel (same page layout —
@@ -192,14 +250,19 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     is always the ground truth in tests.
     """
     slots, hkv, group, hd = q.shape
+    quantized = k_scales is not None
     interpret_resolved = _interpret_default(interpret)
     if impl == 'auto':
         # The library kernel needs lane-aligned blocks (hd multiple of
         # 128; its output block carries `group` in the sublane dim, so
         # tiny test models fall back to the native kernel).
-        jax_ok = (hd % 128 == 0 and k_pages.shape[2] % 8 == 0)
+        jax_ok = (hd % 128 == 0 and k_pages.shape[2] % 8 == 0
+                  and not quantized)
         impl = ('jax' if jax_ok and not interpret_resolved
                 else 'native')
+    if impl == 'jax' and quantized:
+        raise ValueError("impl='jax' has no int8 dequant hook; use "
+                         "the native kernel for kv_dtype=int8")
     if impl == 'jax' and not interpret_resolved:
         from jax.experimental.pallas.ops.tpu.paged_attention import (
             paged_attention as jax_paged_attention)
@@ -231,15 +294,27 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
         j = jnp.minimum(p, jnp.maximum(n_pages - 1, 0))
         return (0, tables[b, j], 0, 0)
 
+    def _scale_index(*args):
+        # Scales live beside their pages: same index map minus the
+        # head_dim axis, DERIVED so a clamp-rule fix can never land on
+        # the value DMA and miss the scale DMA.
+        return _page_index(*args)[:-1]
+
+    in_specs = [
+        pl.BlockSpec((1, hkv, group, hd),
+                     lambda b, p, *_: (b, 0, 0, 0)),
+        pl.BlockSpec((hkv, 1, page_size, hd), _page_index),
+        pl.BlockSpec((hkv, 1, page_size, hd), _page_index),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((hkv, 1, page_size), _scale_index),
+                     pl.BlockSpec((hkv, 1, page_size), _scale_index)]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(slots, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, hkv, group, hd),
-                         lambda b, p, *_: (b, 0, 0, 0)),
-            pl.BlockSpec((hkv, 1, page_size, hd), _page_index),
-            pl.BlockSpec((hkv, 1, page_size, hd), _page_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, hkv, group, hd),
                                lambda b, p, *_: (b, 0, 0, 0)),
         scratch_shapes=[
@@ -250,14 +325,14 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     )
     kernel = functools.partial(_decode_kernel, page_size=page_size,
                                sm_scale=sm_scale, max_pages=max_pages,
-                               hkv=hkv)
+                               hkv=hkv, quantized=quantized)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((slots, hkv, group, hd),
                                        jnp.float32),
         interpret=interpret,
-    )(block_tables, lengths, q, k_pages, v_pages)
+    )(block_tables, lengths, *operands)
 
 
 # ---------------------------------------------------------------------------
@@ -265,15 +340,22 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
 # ---------------------------------------------------------------------------
 def _prefill_kernel(table_ref, meta_ref, q_ref, *refs,
                     page_size: int, sm_scale: float, n_groups: int,
-                    chunk: int, fan: int):
+                    chunk: int, fan: int, quantized: bool):
     """One grid step processes `fan` pages (each its own scalar-
     prefetched in_spec/DMA): the fixed per-grid-step cost — not the
     bytes — dominates a one-page-per-step kernel, so fanning pages into
     a step amortizes it `fan`-fold."""
     k_refs = refs[:fan]
     v_refs = refs[fan:2 * fan]
-    o_ref = refs[2 * fan]
-    acc_ref, m_ref, l_ref = refs[2 * fan + 1:]
+    refs = refs[2 * fan:]
+    if quantized:
+        ks_refs = refs[:fan]
+        vs_refs = refs[fan:2 * fan]
+        refs = refs[2 * fan:]
+    else:
+        ks_refs = vs_refs = None
+    o_ref = refs[0]
+    acc_ref, m_ref, l_ref = refs[1:]
     g = pl.program_id(1)
     del table_ref
     offset = meta_ref[0]
@@ -298,6 +380,9 @@ def _prefill_kernel(table_ref, meta_ref, q_ref, *refs,
         def _do():
             k = k_refs[f][0, 0].astype(jnp.float32)   # [page, hd]
             v = v_refs[f][0, 0].astype(jnp.float32)
+            if quantized:
+                k = k * ks_refs[f][0, 0][:, None]
+                v = v * vs_refs[f][0, 0][:, None]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)   # [C*g, page]
@@ -336,7 +421,10 @@ def paged_prefill_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
                             true_len: jnp.ndarray, *,
                             sm_scale: Optional[float] = None,
                             interpret: Optional[bool] = None,
-                            pages_per_step: int = 8) -> jnp.ndarray:
+                            pages_per_step: int = 8,
+                            k_scales: Optional[jnp.ndarray] = None,
+                            v_scales: Optional[jnp.ndarray] = None
+                            ) -> jnp.ndarray:
     """One prompt chunk of ONE slot attending over its paged prefix.
 
     q: [C, hkv, group, hd] (global positions offset..offset+C-1, the
@@ -363,6 +451,8 @@ def paged_prefill_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     meta = jnp.stack([jnp.asarray(offset, jnp.int32),
                       jnp.asarray(true_len, jnp.int32)])
 
+    quantized = k_scales is not None
+
     def _page_index(f):
         def index(h, g, table, meta_):
             total = meta_[0] + meta_[1]
@@ -371,17 +461,33 @@ def paged_prefill_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
             return (h, table[j], 0, 0)
         return index
 
+    def _scale_index(f):
+        # Derived from the page map (minus the head_dim axis): value
+        # and scale DMA targets cannot desynchronize.
+        page_f = _page_index(f)
+
+        def index(*args):
+            return page_f(*args)[:-1]
+        return index
+
     page_spec = [pl.BlockSpec((1, 1, page_size, hd), _page_index(f))
                  for f in range(fan)]
+    in_specs = [
+        pl.BlockSpec((1, C * group, hd),
+                     lambda h, g, *_: (h, 0, 0)),
+        *page_spec,          # k pages, fan of them
+        *page_spec,          # v pages
+    ]
+    operands = [qf, *([k_pages] * fan), *([v_pages] * fan)]
+    if quantized:
+        scale_spec = [pl.BlockSpec((1, 1, page_size), _scale_index(f))
+                      for f in range(fan)]
+        in_specs += [*scale_spec, *scale_spec]
+        operands += [*([k_scales] * fan), *([v_scales] * fan)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(hkv, n_groups),
-        in_specs=[
-            pl.BlockSpec((1, C * group, hd),
-                         lambda h, g, *_: (h, 0, 0)),
-            *page_spec,          # k pages, fan of them
-            *page_spec,          # v pages
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, C * group, hd),
                                lambda h, g, *_: (h, 0, 0)),
         scratch_shapes=[
@@ -392,14 +498,14 @@ def paged_prefill_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     )
     kernel = functools.partial(_prefill_kernel, page_size=page_size,
                                sm_scale=sm_scale, n_groups=n_groups,
-                               chunk=C, fan=fan)
+                               chunk=C, fan=fan, quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((hkv, C * group, hd),
                                        jnp.float32),
         interpret=interpret,
-    )(table_row, meta, qf, *([k_pages] * fan), *([v_pages] * fan))
+    )(table_row, meta, *operands)
     return out.reshape(hkv, C, group, hd).transpose(1, 0, 2, 3)
 
 
@@ -409,7 +515,9 @@ def paged_prefill_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
 def paged_verify_attention_reference(
         q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         block_tables: jnp.ndarray, lengths: jnp.ndarray,
-        *, sm_scale: Optional[float] = None) -> jnp.ndarray:
+        *, sm_scale: Optional[float] = None,
+        k_scales: Optional[jnp.ndarray] = None,
+        v_scales: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """q: [slots, R, hkv, group, hd] — R = spec_k+1 verify queries per
     slot at positions lengths[slot]..lengths[slot]+R-1 (their K/V
     already written, the decode write-then-attend contract). Query i
@@ -420,8 +528,8 @@ def paged_verify_attention_reference(
     maxp = block_tables.shape[1]
     if sm_scale is None:
         sm_scale = hd ** -0.5
-    k = k_pages[:, block_tables]          # [hkv, slots, maxp, page, hd]
-    v = v_pages[:, block_tables]
+    k = _deq(k_pages, k_scales)[:, block_tables]
+    v = _deq(v_pages, v_scales)[:, block_tables]
     k = k.transpose(1, 0, 2, 3, 4).reshape(slots, hkv, maxp * page, hd)
     v = v.transpose(1, 0, 2, 3, 4).reshape(slots, hkv, maxp * page, hd)
     s = jnp.einsum('brkgd,bksd->brkgs', q.astype(jnp.float32),
@@ -434,14 +542,19 @@ def paged_verify_attention_reference(
     return jnp.einsum('brkgs,bksd->brkgd', p, v.astype(jnp.float32))
 
 
-def _verify_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *,
+def _verify_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, *refs,
                    page_size: int, sm_scale: float, max_pages: int,
-                   hkv: int, group: int, r_queries: int):
+                   hkv: int, group: int, r_queries: int,
+                   quantized: bool):
     """The decode kernel with R queries per (slot, head): rows are
     queries x group flattened (group fastest), each row's causal
     horizon is its query's position — one extra iota/div over the
     decode kernel, the same online-softmax accumulation per page."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     p = pl.program_id(1)
     del tables_ref  # consumed by the index_maps
@@ -462,6 +575,9 @@ def _verify_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
             q = q_ref[0, h].astype(jnp.float32) * sm_scale  # [R*g, hd]
             k = k_ref[h, 0].astype(jnp.float32)             # [page, hd]
             v = v_ref[h, 0].astype(jnp.float32)
+            if quantized:
+                k = k * ks_ref[h, 0][:, None]
+                v = v * vs_ref[h, 0][:, None]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)         # [R*g, page]
@@ -493,7 +609,9 @@ def paged_verify_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
                            block_tables: jnp.ndarray,
                            lengths: jnp.ndarray, *,
                            sm_scale: Optional[float] = None,
-                           interpret: Optional[bool] = None
+                           interpret: Optional[bool] = None,
+                           k_scales: Optional[jnp.ndarray] = None,
+                           v_scales: Optional[jnp.ndarray] = None
                            ) -> jnp.ndarray:
     """Speculative verify: R = spec_k+1 query tokens for EVERY slot in
     one kernel launch over the paged cache.
@@ -521,6 +639,8 @@ def paged_verify_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     # r // group — same flattening rule as the prefill kernel.
     qf = q.transpose(0, 2, 1, 3, 4).reshape(slots, hkv, R * group, hd)
 
+    quantized = k_scales is not None
+
     def _page_index(b, p, tables, lengths_):
         # Same revisiting-block rule as decode: steps past the slot's
         # attendable pages re-map to its last real page (no DMA).
@@ -530,15 +650,27 @@ def paged_verify_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
         j = jnp.minimum(j, max_pages - 1)
         return (0, tables[b, j], 0, 0)
 
+    def _scale_index(*args):
+        # Derived from the page map (minus the head_dim axis): the
+        # lengths+R horizon rule can never change on one and not the
+        # other.
+        return _page_index(*args)[:-1]
+
+    in_specs = [
+        pl.BlockSpec((1, hkv, R * group, hd),
+                     lambda b, p, *_: (b, 0, 0, 0)),
+        pl.BlockSpec((hkv, 1, page_size, hd), _page_index),
+        pl.BlockSpec((hkv, 1, page_size, hd), _page_index),
+    ]
+    operands = [qf, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((hkv, 1, page_size), _scale_index),
+                     pl.BlockSpec((hkv, 1, page_size), _scale_index)]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(slots, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, hkv, R * group, hd),
-                         lambda b, p, *_: (b, 0, 0, 0)),
-            pl.BlockSpec((hkv, 1, page_size, hd), _page_index),
-            pl.BlockSpec((hkv, 1, page_size, hd), _page_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, hkv, R * group, hd),
                                lambda b, p, *_: (b, 0, 0, 0)),
         scratch_shapes=[
@@ -549,14 +681,15 @@ def paged_verify_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     )
     kernel = functools.partial(_verify_kernel, page_size=page_size,
                                sm_scale=sm_scale, max_pages=max_pages,
-                               hkv=hkv, group=group, r_queries=R)
+                               hkv=hkv, group=group, r_queries=R,
+                               quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((slots, hkv, R * group, hd),
                                        jnp.float32),
         interpret=interpret,
-    )(block_tables, lengths, qf, k_pages, v_pages)
+    )(block_tables, lengths, *operands)
     return out.reshape(slots, hkv, R, group, hd).transpose(0, 2, 1, 3, 4)
 
 
@@ -565,20 +698,31 @@ def paged_verify_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
 # ---------------------------------------------------------------------------
 def write_chunk_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
                       k_new: jnp.ndarray, v_new: jnp.ndarray,
-                      table_row: jnp.ndarray, offset: jnp.ndarray
-                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                      table_row: jnp.ndarray, offset: jnp.ndarray,
+                      k_scales: Optional[jnp.ndarray] = None,
+                      v_scales: Optional[jnp.ndarray] = None):
     """Write a C-token chunk's K/V into a slot's pages.
 
     k_new/v_new: [C, hkv, hd] with C a multiple of page_size and offset
     page-aligned (the engine's chunk cap guarantees both), so the chunk
     covers whole pages: C/page dynamic_update_slice ops at table-looked-
     up page ids, no read-modify-write.
+
+    With ``k_scales``/``v_scales`` (the int8 flavor) the chunk rows are
+    quantized on write and the per-row scales land in the pool-aligned
+    scale pages; returns ``(k_pages, v_pages, k_scales, v_scales)``
+    then, the plain pair otherwise.
     """
     C, hkv, hd = k_new.shape
     page = k_pages.shape[2]
     assert C % page == 0, (C, page)
-    kc = k_new.transpose(1, 0, 2).astype(k_pages.dtype)   # [hkv, C, hd]
-    vc = v_new.transpose(1, 0, 2).astype(v_pages.dtype)
+    quantized = k_scales is not None
+    if quantized:
+        kc, ksc = quantize_rows(k_new.transpose(1, 0, 2))  # [hkv, C, *]
+        vc, vsc = quantize_rows(v_new.transpose(1, 0, 2))
+    else:
+        kc = k_new.transpose(1, 0, 2).astype(k_pages.dtype)
+        vc = v_new.transpose(1, 0, 2).astype(v_pages.dtype)
     first = jax.lax.div(offset, page)
     for i in range(C // page):
         pid = table_row[first + i]
@@ -588,13 +732,23 @@ def write_chunk_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         v_pages = jax.lax.dynamic_update_slice(
             v_pages, vc[:, i * page:(i + 1) * page][:, None],
             (0, pid, 0, 0))
+        if quantized:
+            k_scales = jax.lax.dynamic_update_slice(
+                k_scales, ksc[:, i * page:(i + 1) * page][:, None],
+                (0, pid, 0))
+            v_scales = jax.lax.dynamic_update_slice(
+                v_scales, vsc[:, i * page:(i + 1) * page][:, None],
+                (0, pid, 0))
+    if quantized:
+        return k_pages, v_pages, k_scales, v_scales
     return k_pages, v_pages
 
 
 def append_run_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
                      k_new: jnp.ndarray, v_new: jnp.ndarray,
-                     block_tables: jnp.ndarray, lengths: jnp.ndarray
-                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                     block_tables: jnp.ndarray, lengths: jnp.ndarray,
+                     k_scales: Optional[jnp.ndarray] = None,
+                     v_scales: Optional[jnp.ndarray] = None):
     """Append a RUN of R tokens' K/V per slot at positions
     ``lengths[slot] + i`` — the speculative-verify write (input token
     plus padded draft candidates in one step).
@@ -604,11 +758,13 @@ def append_run_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     coverage (padded drafts of a slot the engine capped, inactive
     slots' garbage lanes) redirect to the SINK page 0 — the table
     lookup is clamped and overridden, never allowed to alias a live
-    page the way a clamped index would.
+    page the way a clamped index would. With scales (int8 flavor) each
+    run row is quantized on write and returns a 4-tuple.
     """
     page = k_pages.shape[2]
     maxp = block_tables.shape[1]
     R = k_new.shape[1]
+    quantized = k_scales is not None
     for i in range(R):
         pos = lengths + i
         col = pos // page
@@ -618,28 +774,48 @@ def append_run_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
             axis=1)[:, 0]
         pids = jnp.where(valid, pids, 0)
         rows = pos % page
-        k_pages = k_pages.at[:, pids, rows].set(
-            k_new[:, i].transpose(1, 0, 2).astype(k_pages.dtype))
-        v_pages = v_pages.at[:, pids, rows].set(
-            v_new[:, i].transpose(1, 0, 2).astype(v_pages.dtype))
+        if quantized:
+            kq, ks = quantize_rows(k_new[:, i].transpose(1, 0, 2))
+            vq, vs = quantize_rows(v_new[:, i].transpose(1, 0, 2))
+            k_pages = k_pages.at[:, pids, rows].set(kq)
+            v_pages = v_pages.at[:, pids, rows].set(vq)
+            k_scales = k_scales.at[:, pids, rows].set(ks)
+            v_scales = v_scales.at[:, pids, rows].set(vs)
+        else:
+            k_pages = k_pages.at[:, pids, rows].set(
+                k_new[:, i].transpose(1, 0, 2).astype(k_pages.dtype))
+            v_pages = v_pages.at[:, pids, rows].set(
+                v_new[:, i].transpose(1, 0, 2).astype(v_pages.dtype))
+    if quantized:
+        return k_pages, v_pages, k_scales, v_scales
     return k_pages, v_pages
 
 
 def append_token_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
                        k_new: jnp.ndarray, v_new: jnp.ndarray,
-                       block_tables: jnp.ndarray, lengths: jnp.ndarray
-                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                       block_tables: jnp.ndarray, lengths: jnp.ndarray,
+                       k_scales: Optional[jnp.ndarray] = None,
+                       v_scales: Optional[jnp.ndarray] = None):
     """Append one token's K/V per slot at position lengths[slot].
 
     k_new/v_new: [slots, hkv, hd]. One vectorized scatter per array:
     slot i's row lands in page table[i, len//page] at row len%page.
     Distinct slots own distinct pages, so the scatter indices never
-    collide (XLA may apply them in any order).
+    collide (XLA may apply them in any order). With scales (int8
+    flavor) the row quantizes on write and returns a 4-tuple.
     """
     page = k_pages.shape[2]
     pids = jnp.take_along_axis(
         block_tables, (lengths // page)[:, None], axis=1)[:, 0]
     rows = lengths % page
+    if k_scales is not None:
+        kq, ks = quantize_rows(k_new.transpose(1, 0, 2))
+        vq, vs = quantize_rows(v_new.transpose(1, 0, 2))
+        k_pages = k_pages.at[:, pids, rows].set(kq)
+        v_pages = v_pages.at[:, pids, rows].set(vq)
+        k_scales = k_scales.at[:, pids, rows].set(ks)
+        v_scales = v_scales.at[:, pids, rows].set(vs)
+        return k_pages, v_pages, k_scales, v_scales
     k_pages = k_pages.at[:, pids, rows].set(
         k_new.transpose(1, 0, 2).astype(k_pages.dtype))
     v_pages = v_pages.at[:, pids, rows].set(

@@ -313,6 +313,8 @@ def tenant_summary(records: List[Dict[str, Any]]
         ttfts = [r['ttft'] for r in rs if r['ttft'] is not None]
         waits = [r['queue_wait'] for r in rs
                  if r.get('queue_wait') is not None]
+        steps = [r['steps_waited'] for r in rs
+                 if r.get('steps_waited') is not None]
         itls = [x for r in rs for x in r.get('itls', [])]
         shed = sum(1 for r in rs if r['shed'])
         out[tenant] = {
@@ -321,6 +323,12 @@ def tenant_summary(records: List[Dict[str, Any]]
             'shed_rate': round(shed / len(rs), 4),
             'ttft_p50_s': pct(ttfts, 0.50),
             'ttft_p99_s': pct(ttfts, 0.99),
+            # Scheduler-owned VIRTUAL time (engine replays only):
+            # decode steps between submit and first token. Immune to
+            # wall-clock noise from concurrent CPU load — the fairness
+            # gates assert on these, not on wall percentiles.
+            'steps_waited_p50': pct(steps, 0.50),
+            'steps_waited_p99': pct(steps, 0.99),
             'queue_wait_p50_ms': (
                 round(pct(waits, 0.50) * 1e3, 3) if waits else None),
             'queue_wait_p99_ms': (

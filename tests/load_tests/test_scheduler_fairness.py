@@ -4,14 +4,23 @@ The motivating production failure (ROADMAP "multi-tenant fairness"):
 one tenant's burst starves — or outright 429s — everyone else under
 FCFS. The starvation gate replays a SEEDED 10:1 aggressor/victim trace
 (tests/load_tests/loadgen.py) against the same engine under ``wfq``
-and ``fcfs`` and asserts the bound the wfq policy exists to provide:
+and ``fcfs`` and asserts the bound the wfq policy exists to provide.
+
+The bound is stated in SCHEDULER-OWNED VIRTUAL TIME — ``steps_waited``
+(decode steps between submit and first token, recorded by
+``replay_on_engine``) — not wall-clock TTFT: a loaded CI box slows
+every step uniformly, which a steps-denominated bound cannot see,
+while the wall-p99 bound this gate used to assert flaked under
+concurrent CPU load (the multiplier measured machine weather, not the
+scheduler). The fcfs-violates / wfq-holds CONTRAST survives the move:
 
 - under ``wfq`` (victim weighted 2:1, the --tenant-weights knob) the
-  victim's p99 TTFT stays within 3x of its ISOLATED-run value and its
-  shed rate is exactly 0 — per-tenant quotas shed the aggressor only;
+  victim's p99 steps_waited stays within 3x of its ISOLATED-run value
+  and its shed rate is exactly 0 — per-tenant quotas shed the
+  aggressor only;
 - under ``fcfs`` the SAME trace violates that bound (victim sheds
-  and/or its p99 blows past 3x) — asserted as the motivating
-  counterexample, not assumed.
+  and/or its p99 steps_waited blows past 3x) — asserted as the
+  motivating counterexample, not assumed.
 
 Plus the harness contracts: trace synthesis is deterministic for a
 fixed seed, the JSONL trace-file format round-trips exactly, and
@@ -98,9 +107,11 @@ def test_trace_file_roundtrip(tmp_path):
 
 
 def test_starvation_gate_wfq_vs_fcfs(engine):
-    """The seeded 10:1 aggressor/victim trace: wfq holds the victim's
-    p99 TTFT within 3x of its isolated run with zero victim sheds;
-    fcfs on the same trace violates that bound."""
+    """The seeded 10:1 aggressor/victim trace, gated in virtual time:
+    wfq holds the victim's p99 steps_waited (decode steps from submit
+    to first token — the scheduler's own clock, immune to wall-clock
+    noise from concurrent CPU load) within 3x of its isolated run with
+    zero victim sheds; fcfs on the same trace violates that bound."""
     trace_iso = loadgen.synthesize(SEED, VICTIM, duration_s=1.5)
     trace_mix = loadgen.synthesize(SEED, {**VICTIM, **AGGRESSOR},
                                    duration_s=1.5)
@@ -116,17 +127,22 @@ def test_starvation_gate_wfq_vs_fcfs(engine):
         return loadgen.tenant_summary(records)
 
     iso = run('fcfs', trace_iso)['victim']
-    assert iso['shed'] == 0 and iso['ttft_p99_s'] is not None
+    assert iso['shed'] == 0 and iso['steps_waited_p99'] is not None
+    # The isolated run includes genuine self-queueing (6-request
+    # waves on 2 slots), so the baseline is never ~0 steps — but
+    # floor it anyway: a degenerate baseline would make 3x vacuously
+    # tight and the gate flaky in the other direction.
+    iso_p99 = max(iso['steps_waited_p99'], 4)
     wfq = run('wfq', trace_mix,
               weights={'victim': 2.0, 'aggressor': 1.0})
     fcfs = run('fcfs', trace_mix)
 
-    # The wfq bound: no victim shed, p99 within 3x of isolated.
+    # The wfq bound: no victim shed, p99 steps within 3x of isolated.
     assert wfq['victim']['shed'] == 0, (
         f"wfq shed the victim: {wfq['victim']}")
-    assert wfq['victim']['ttft_p99_s'] <= 3 * iso['ttft_p99_s'], (
-        f"victim p99 {wfq['victim']['ttft_p99_s']:.4f}s under wfq "
-        f"blew past 3x its isolated {iso['ttft_p99_s']:.4f}s")
+    assert wfq['victim']['steps_waited_p99'] <= 3 * iso_p99, (
+        f"victim p99 steps_waited {wfq['victim']['steps_waited_p99']} "
+        f"under wfq blew past 3x its isolated {iso_p99}")
     # The quotas actually bit: the aggressor (10x over its share) is
     # the tenant that got shed.
     assert wfq['aggressor']['shed'] > 0, (
@@ -135,11 +151,11 @@ def test_starvation_gate_wfq_vs_fcfs(engine):
 
     # The motivating counterexample: fcfs on the SAME trace breaks
     # the bound — victim sheds (the "one burst 429s everyone"
-    # failure) and/or victim p99 blows past 3x.
-    fcfs_p99 = fcfs['victim']['ttft_p99_s']
+    # failure) and/or victim p99 steps_waited blows past 3x.
+    fcfs_p99 = fcfs['victim']['steps_waited_p99']
     fcfs_holds = (fcfs['victim']['shed'] == 0
                   and fcfs_p99 is not None
-                  and fcfs_p99 <= 3 * iso['ttft_p99_s'])
+                  and fcfs_p99 <= 3 * iso_p99)
     assert not fcfs_holds, (
         f'fcfs unexpectedly met the fairness bound '
         f'(victim {fcfs["victim"]}) — the counterexample is gone; '

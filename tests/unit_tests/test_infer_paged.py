@@ -166,6 +166,100 @@ def test_append_token_pages_lands_in_right_page_rows():
     assert k2.sum() == hkv * hd * (1 + 2 + 3)   # nothing else touched
 
 
+# ---------- int8 KV pages -------------------------------------------------
+def test_quantize_rows_roundtrip_bound():
+    """Per-row absmax int8: dequantized values stay within one scale
+    step of the input (scale = absmax/127), and all-zero rows survive
+    (scale 1.0, not a divide-by-zero)."""
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(3, 7, 64)) * 5.0, jnp.float32)
+    x = x.at[1, 2].set(0.0)                       # an all-zero row
+    q, s = pa.quantize_rows(x)
+    assert q.dtype == jnp.int8 and s.shape == (3, 7)
+    deq = np.asarray(q, np.float32) * np.asarray(s)[..., None]
+    err = np.abs(deq - np.asarray(x))
+    bound = np.asarray(s)[..., None] * 0.5 + 1e-6
+    assert (err <= bound).all(), float(err.max())
+    assert (np.asarray(q[1, 2]) == 0).all()
+    assert float(s[1, 2]) == 1.0
+
+
+@pytest.mark.parametrize('which', ['decode', 'prefill', 'verify'])
+def test_int8_kernels_match_int8_references(which):
+    """Each quantized kernel against the quantized reference on the
+    SAME int8 pages + scales: kernel dequant must be the reference
+    dequant (a missing/misaxed scale multiply shows up here even when
+    the end-to-end divergence floor would absorb it)."""
+    rng = np.random.default_rng(7)
+    slots, hkv, group, hd, R = 4, 2, 4, 64, 4
+    page, P, maxp, C = 16, 32, 8, 32
+    kf, vf = _rand_pages(rng, hkv, P, page, hd)
+    k_pages, k_scales = pa.quantize_rows(kf)
+    v_pages, v_scales = pa.quantize_rows(vf)
+    ids = rng.permutation(np.arange(1, P))[:slots * maxp - slots]
+    tables = np.zeros((slots, maxp), np.int32)
+    tables.flat[:len(ids)] = ids
+    tables = jnp.asarray(tables)
+    lengths = jnp.asarray([17, 64, 1, 100], jnp.int32)
+    if which == 'decode':
+        q = jnp.asarray(rng.normal(size=(slots, hkv, group, hd)),
+                        jnp.float32)
+        ref = pa.paged_decode_attention_reference(
+            q, k_pages, v_pages, tables, lengths,
+            k_scales=k_scales, v_scales=v_scales)
+        out = pa.paged_decode_attention(
+            q, k_pages, v_pages, tables, lengths, interpret=True,
+            k_scales=k_scales, v_scales=v_scales)
+    elif which == 'verify':
+        q = jnp.asarray(rng.normal(size=(slots, R, hkv, group, hd)),
+                        jnp.float32)
+        ref = pa.paged_verify_attention_reference(
+            q, k_pages, v_pages, tables, lengths,
+            k_scales=k_scales, v_scales=v_scales)
+        out = pa.paged_verify_attention(
+            q, k_pages, v_pages, tables, lengths, interpret=True,
+            k_scales=k_scales, v_scales=v_scales)
+    else:
+        q = jnp.asarray(rng.normal(size=(C, hkv, group, hd)),
+                        jnp.float32)
+        row = tables[0]
+        ref = pa.paged_prefill_attention_reference(
+            q, k_pages, v_pages, row, 16, 20,
+            k_scales=k_scales, v_scales=v_scales)
+        out = pa.paged_prefill_attention(
+            q, k_pages, v_pages, row, jnp.int32(16), jnp.int32(20),
+            interpret=True, k_scales=k_scales, v_scales=v_scales)
+        ref, out = ref[:20], out[:20]   # pad rows are garbage
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_int8_write_paths_quantize_on_write():
+    """append_token_pages with scales: the written row dequantizes
+    back to (approximately) the input, and its scale row is set."""
+    hkv, P, page, hd, slots = 2, 6, 4, 8, 2
+    k_pages = jnp.zeros((hkv, P, page, hd), jnp.int8)
+    v_pages = jnp.zeros_like(k_pages)
+    k_scales = jnp.zeros((hkv, P, page), jnp.float32)
+    v_scales = jnp.zeros_like(k_scales)
+    tables = jnp.asarray([[1, 2], [3, 0]], jnp.int32)
+    lengths = jnp.asarray([5, 2], jnp.int32)
+    rng = np.random.default_rng(5)
+    k_new = jnp.asarray(rng.normal(size=(slots, hkv, hd)) * 3,
+                        jnp.float32)
+    k2, v2, ks2, vs2 = pa.append_token_pages(
+        k_pages, v_pages, k_new, k_new, tables, lengths,
+        k_scales, v_scales)
+    # Slot 0 -> page 2 row 1; slot 1 -> page 3 row 2.
+    deq = np.asarray(k2[:, 2, 1], np.float32) * np.asarray(
+        ks2[:, 2, 1])[:, None]
+    want = np.asarray(k_new[0])
+    assert np.abs(deq - want).max() <= np.abs(want).max() / 127 + 1e-6
+    assert float(ks2[0, 3, 2]) > 0.0
+    # Untouched pages keep zero scales.
+    assert not np.asarray(ks2[:, 1]).any()
+
+
 # ---------- allocator -----------------------------------------------------
 def test_allocator_extend_free_and_sink_page():
     al = paged_cache_lib.PageAllocator(n_pages=9, page_size=4,

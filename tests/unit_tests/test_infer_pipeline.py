@@ -180,6 +180,69 @@ def test_recompile_stability_speculative(params):
         'workload never dispatched a verify step — pin is vacuous')
 
 
+@pytest.mark.parametrize('kv_dtype', ['bfloat16', 'int8'])
+def test_recompile_stability_fused(params, kv_dtype):
+    """Fused mixed steps extend the program budget by EXACTLY the
+    mixed programs (one per chunk bucket actually fused — the chunk
+    shape is the only varying operand): mixed=chunk-buckets,
+    decode=1, verify=1, free=1, cow<=1, and a further pass of warm
+    shapes compiles nothing — for BOTH kv dtypes (int8's scale
+    threading must not introduce shapes of its own). The int8 variant
+    carries the prefix cache (pinning cow and the prefix-offset
+    shapes); the bf16 variant runs prefix-off, whose program set is
+    complete after ONE pass — tier-1 wall-clock is a budget."""
+    prefix = kv_dtype == 'int8'
+    eng = engine_lib.InferenceEngine(
+        CFG, params,
+        engine_lib.EngineConfig(n_slots=3, max_seq_len=128,
+                                prefill_buckets=(16, 32),
+                                prefill_chunk=32,
+                                paged=True, page_size=16, n_pages=25,
+                                prefix_cache=prefix,
+                                kv_dtype=kv_dtype,
+                                fused_prefill=True, spec_k=3))
+
+    def one_pass():
+        # Two multi-chunk prompts admitted at idle (standalone 32s),
+        # then a short and a long prompt arriving MID-DECODE so both
+        # chunk buckets (16-pad and 32) deterministically ride fused
+        # dispatches. Repetition makes speculation verify.
+        rs = [eng.submit([11] * 60, max_new_tokens=8),
+              eng.submit([9] * 60, max_new_tokens=8)]
+        while not any(r.output_tokens for r in rs):
+            eng.step()
+        rs.append(eng.submit([5, 17, 101, 7], max_new_tokens=8))
+        rs.append(eng.submit([13] * 60, max_new_tokens=8))
+        eng.run_until_idle()
+        return rs
+
+    reqs = one_pass()
+    assert all(r.done for r in reqs)
+    if prefix:
+        # Pass 2 warms the shapes pass 1 couldn't reach: prefix-cache
+        # hits shift chunk offsets, so a bucket that only ever rode
+        # FUSED in the cold pass goes out standalone in the warm one
+        # (both ladders stay bucket-bounded — that is the pin).
+        one_pass()
+    counts = eng.compiled_counts()
+    if -1 in counts.values():
+        pytest.skip('jit._cache_size unavailable in this jax')
+    assert counts['decode'] == 1 and counts['free'] == 1, counts
+    assert counts['verify'] == 1, counts
+    # The chunk-bucket ladders: 16-token short prompts + 32-token
+    # chunks of the long ones — the mixed AND standalone prefill
+    # program sets are each capped by the bucket count, nothing more.
+    assert counts['mixed'] == 2, counts
+    assert counts['prefill'] == (2 if prefix else 1), counts
+    if prefix:
+        assert counts['cow'] <= 1, counts
+    assert eng.metrics()['fused_steps'] > 0, (
+        'workload never fused a chunk — the pin is vacuous')
+    one_pass()
+    assert eng.compiled_counts() == counts, (
+        'steady-state fused workload triggered a recompile')
+
+
 def test_token_events_wake_waiters(params):
     """wait_progress/wait_done return on engine progress without the
     waiter polling; listeners fire for every appended token."""
