@@ -6,7 +6,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-full lint-json test-analysis bench-ttft
+.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke
 
 lint:
 	$(PYTHON) -m skypilot_tpu.client.cli lint --changed
@@ -29,3 +29,10 @@ TTFT_ARGS ?= --model tiny --slots 8 --concurrency 4 8
 
 bench-ttft:
 	$(PYTHON) bench_ttft.py --sweep chunked $(TTFT_ARGS) --output $(TTFT_OUT)
+
+# Flight-recorder smoke (docs/observability.md "Flight recorder"): a
+# tiny in-process workload with the recorder on, a forced anomaly
+# dump, and Perfetto-schema validation of both the live export and
+# the span-store round trip. Exit 0 = the black box works end to end.
+profile-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.observability.stepline

@@ -507,6 +507,11 @@ def _chunked_victim_run(engine, conc: int, aggr_prompt: list,
         engine.cancel(v)
     engine.run_until_idle()
     m = engine.metrics()
+    # Recorder-derived step-time decomposition (the flight recorder's
+    # ring over this run): where a step's wall clock actually went —
+    # dispatch vs drain vs readback vs host shares.
+    breakdown = engine.stepline_summary()
+    breakdown.pop('enabled', None)
     itls.sort()
     ttfts.sort()
     return {
@@ -519,6 +524,7 @@ def _chunked_victim_run(engine, conc: int, aggr_prompt: list,
         'fused_steps': m['fused_steps'],
         'decode_stall_steps': m['decode_stall_steps'],
         'prefill_tokens_per_step': m['prefill_tokens_per_step'],
+        'step_time_breakdown': breakdown,
     }
 
 

@@ -351,6 +351,141 @@ def trace_cmd(request_id: Optional[str],
         click.echo(f'wrote {perfetto_path}')
 
 
+@cli.command('profile')
+@click.argument('target', required=False)
+@click.option('--perfetto', 'perfetto_path', default=None,
+              help='Write a Perfetto/Chrome-trace JSON of the '
+                   'timeline (open in ui.perfetto.dev).')
+@click.option('--steps', 'n_steps', default=20, show_default=True,
+              help='Step records shown in the text summary.')
+def profile_cmd(target: Optional[str], perfetto_path: Optional[str],
+                n_steps: int) -> None:
+    """Read the engine flight recorder (docs/observability.md
+    "Flight recorder").
+
+    TARGET is a replica URL (``http://host:port`` — fetches the live
+    ``/debug/stepline`` ring) or a request id / dump trace id (reads
+    the anomaly dumps the recorder snapshotted into the span store).
+    With no argument, lists recorded dumps.
+    """
+    import http.client
+    import json as json_lib
+    import urllib.request
+
+    from skypilot_tpu.observability import render as render_lib
+    from skypilot_tpu.observability import stepline as stepline_lib
+    from skypilot_tpu.observability import store as store_lib
+
+    def _write_perfetto(make_doc) -> None:
+        """``make_doc`` is a thunk: a full ring renders to tens of
+        thousands of trace events — built only when --perfetto
+        actually asked for them."""
+        if not perfetto_path:
+            return
+        doc = make_doc()
+        errs = stepline_lib.validate_perfetto(doc)
+        if errs:
+            raise click.ClickException(
+                f'exported trace failed validation: {errs[:3]}')
+        with open(perfetto_path, 'w', encoding='utf-8') as f:
+            json_lib.dump(doc, f)
+        click.echo(f'wrote {perfetto_path}')
+
+    if target and target.startswith(('http://', 'https://')):
+        url = target.rstrip('/') + '/debug/stepline'
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                snap = json_lib.loads(r.read())
+        except (OSError, ValueError,
+                http.client.HTTPException) as e:
+            # ValueError covers a non-JSON body and HTTPException a
+            # non-HTTP peer (wrong port, a reverse proxy's HTML error
+            # page) — same friendly error as an unreachable replica,
+            # never a raw traceback.
+            raise click.ClickException(
+                f'could not fetch {url}: {e}')
+        if not snap.get('enabled', True):
+            click.echo('flight recorder disabled on this replica '
+                       '(--no-stepline).')
+            return
+        # Tolerate a replica on an older build whose records miss a
+        # newer field — a version skew must degrade to zeros, not a
+        # KeyError traceback.
+        _defaults = {'kind': '?', 'tenant_depths': None}
+        summ = stepline_lib.summarize([
+            stepline_lib.StepRecord(**{
+                k: rec.get(k, _defaults.get(k, 0))
+                for k in stepline_lib.StepRecord.__slots__})
+            for rec in snap.get('steps', ())])
+        click.echo(f"steps recorded: {snap.get('steps_total', 0)} "
+                   f"(ring keeps {len(snap.get('steps', []))}); "
+                   f"anomaly dumps: {snap.get('dumps', 0)}")
+        if summ['steps']:
+            click.echo(
+                'step time: mean {:.3f} ms — dispatch {:.0%}, drain '
+                '{:.0%}, readback {:.0%}, host {:.0%}'.format(
+                    summ['step_mean_ms'],
+                    summ['dispatch_share'] or 0,
+                    summ['drain_share'] or 0,
+                    summ['readback_share'] or 0,
+                    summ['host_share'] or 0))
+            click.echo(f"step kinds: {summ['step_kinds']}")
+            fmt = '{:>8} {:>8} {:>9} {:>6} {:>7} {:>7} {:>7}'
+            click.echo(fmt.format('STEP', 'KIND', 'DUR_MS', 'BATCH',
+                                  'CHUNK', 'QUEUE', 'FREEPG'))
+            for rec in snap.get('steps', [])[-max(1, n_steps):]:
+                click.echo(fmt.format(
+                    rec.get('idx', 0), rec.get('kind', '?'),
+                    f"{rec.get('dur_s', 0) * 1e3:.2f}",
+                    rec.get('batch', 0), rec.get('chunk_tokens', 0),
+                    rec.get('queue_depth', 0),
+                    rec.get('pages_free', -1)))
+        _write_perfetto(lambda: stepline_lib.to_perfetto(snap))
+        return
+
+    store = store_lib.SpanStore()
+    if not target:
+        dumps = store.list_traces(limit=200,
+                                  trace_id_prefix='stepline-')
+        if not dumps:
+            click.echo(
+                'No flight-recorder dumps. Dumps appear after an '
+                'anomaly (TTFT-SLO breach, preemption, cache_full, '
+                'admission shed, breaker open); profile a live '
+                'replica with `sky-tpu profile <url>`.')
+            return
+        fmt = '{:36} {:>8} {}'
+        click.echo(fmt.format('DUMP', 'SPANS', 'REQUEST'))
+        for t in dumps:
+            click.echo(fmt.format(t['trace_id'], t['n_spans'],
+                                  t.get('request_id') or '-'))
+        return
+    # A request id can live in both its ordinary PR-1 span trace and
+    # a recorder dump; `profile` reads the black box, so prefer the
+    # newest stepline-* trace and never silently render the plain
+    # request trace (`sky-tpu trace` is the command for that).
+    spans: list = []
+    for tid in store.trace_ids_for_request(target):
+        if str(tid).startswith('stepline-'):
+            spans = store.get_trace(tid)
+            break
+    if not spans:
+        spans = store.get_trace(target)
+    spans = [s for s in spans or []]
+    if not spans:
+        raise click.ClickException(
+            f'no flight-recorder dump for {target!r} — run '
+            f'`sky-tpu profile` for the dump list, or profile a '
+            f'live replica with its URL.')
+    trigger = next((s for s in spans
+                    if s['name'] == 'stepline.trigger'), None)
+    if trigger is not None:
+        click.echo(f"trigger: {trigger['status']} "
+                   f"{trigger.get('attrs') or {}}")
+    click.echo(render_lib.render_tree(spans))
+    _write_perfetto(lambda: render_lib.to_perfetto(spans))
+
+
 @cli.command('show-accelerators')
 @click.option('--filter', 'name_filter', default=None)
 def show_accelerators(name_filter: Optional[str]) -> None:

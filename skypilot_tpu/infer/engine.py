@@ -49,6 +49,7 @@ from skypilot_tpu.infer import prefix_cache as prefix_cache_lib
 from skypilot_tpu.infer import sampling as sampling_lib
 from skypilot_tpu.infer import sched as sched_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.observability import stepline as stepline_lib
 from skypilot_tpu.observability import trace
 from skypilot_tpu.utils import failpoints
 
@@ -172,6 +173,23 @@ class EngineConfig:
     # historical inline behavior), 'deadline' (EDF over wall-clock
     # budgets), 'wfq' (per-tenant weighted fair queueing).
     scheduler: str = 'fcfs'
+    # Flight recorder (observability/stepline.py, docs/observability.md
+    # "Flight recorder"): an always-on ring of per-step records
+    # (stage wall-time shares, batch/chunk sizes, speculation accepts,
+    # page pressure, per-tenant queue depth) plus per-request timeline
+    # events, surfaced at GET /debug/stepline and snapshotted into the
+    # span store on anomalies. Pure observation: greedy outputs are
+    # BIT-IDENTICAL recorder on vs off (it reads clocks and counters,
+    # never scheduling state the step loop acts on).
+    stepline: bool = True
+    # Ring capacity in step records (None -> SKY_TPU_STEPLINE_CAP or
+    # 1024); the request-event ring holds 4x as many.
+    stepline_cap: Optional[int] = None
+    # TTFT SLO in seconds: a request whose first token lands slower
+    # than this triggers an anomaly dump (the ring snapshots into the
+    # span store, read later with `sky-tpu profile`). None = no SLO
+    # trigger.
+    ttft_slo_s: Optional[float] = None
     # tenant -> relative weight for 'wfq' (unknown tenants weigh 1.0).
     # A mapping in a frozen dataclass: treat as immutable.
     tenant_weights: Optional[Any] = None
@@ -405,6 +423,14 @@ class InferenceEngine:
         '_spec_drafted': '_lock',
         '_spec_accepted': '_lock',
         '_spec_emitted': '_lock',
+        # Flight recorder: the step loop appends records under the
+        # lock; HTTP snapshot readers (stepline_snapshot) copy under
+        # it too — the rings themselves own no lock (the scheduler
+        # contract). _pending_dumps defers anomaly-dump handoff to
+        # OUTSIDE the lock so the engine lock never nests the dump
+        # writer's condition (LOCK_ORDER stays leaf-level).
+        '_stepline': '_lock',
+        '_pending_dumps': '_lock',
     }
 
     def __init__(self, config: llama.LlamaConfig, params: llama.Params,
@@ -613,6 +639,22 @@ class InferenceEngine:
         # scheduling win is attributable apart from prefill speed.
         self._queue_waits: collections.deque = collections.deque(
             maxlen=1024)
+        # ---- flight recorder (observability/stepline.py) ----------------
+        # _sl_on is an immutable config flag (like wallclock_cancel's
+        # one-way discipline): read lock-free on hot paths; the rings
+        # behind it are the lock-guarded state.
+        self._sl_on = bool(self.ecfg.stepline)
+        self._stepline = (stepline_lib.StepRecorder(
+            self.ecfg.stepline_cap) if self._sl_on else None)
+        self._pending_dumps: List[tuple] = []
+        # Engine-thread stage accumulators, reset at each step start
+        # (plain floats, never read cross-thread): dispatch = device
+        # program launches, drain = consume bookkeeping, readback =
+        # blocked on the pair's device→host copy.
+        self._sl_dispatch = 0.0
+        self._sl_drain = 0.0
+        self._sl_readback = 0.0
+        self._sl_batch = 0
 
         # ---- compiled programs ------------------------------------------
         # Params are ARGUMENTS, never closure-captured: captured arrays
@@ -920,20 +962,43 @@ class InferenceEngine:
             failpoints.hit('infer.engine.admit_full')
         except failpoints.FailpointError as e:
             raise AdmissionError(f'injected admit-full: {e}') from e
-        with self._lock:
-            # Admission is the scheduler's call (global bounds under
-            # fcfs/deadline, per-tenant quotas under wfq); its
-            # AdmissionError carries a queue-drain Retry-After
-            # estimate computed from the recent decode throughput.
-            # _decode_tokens counts EMITTED tokens — under speculation
-            # a verify step lands 1..spec_k+1 of them — so the
-            # estimate's tokens/sec is the accepted-length-aware
-            # EFFECTIVE rate, not a 1-token/step assumption that would
-            # overshoot 429 backoff hints by the acceptance factor.
-            self._sched.admit(req, drain_tps=(
-                self._decode_tokens / self._decode_time
-                if self._decode_time else 0.0))
-            self._sched.enqueue(req)
+        try:
+            with self._lock:
+                # Admission is the scheduler's call (global bounds under
+                # fcfs/deadline, per-tenant quotas under wfq); its
+                # AdmissionError carries a queue-drain Retry-After
+                # estimate computed from the recent decode throughput.
+                # _decode_tokens counts EMITTED tokens — under speculation
+                # a verify step lands 1..spec_k+1 of them — so the
+                # estimate's tokens/sec is the accepted-length-aware
+                # EFFECTIVE rate, not a 1-token/step assumption that would
+                # overshoot 429 backoff hints by the acceptance factor.
+                try:
+                    self._sched.admit(req, drain_tps=(
+                        self._decode_tokens / self._decode_time
+                        if self._decode_time else 0.0))
+                except AdmissionError:
+                    # Anomaly trigger: an admission shed is exactly the
+                    # incident the black box exists for — what was the
+                    # engine doing when it started refusing work?
+                    self._note_anomaly('admission_shed', {
+                        'request_id': req.request_id,
+                        'tenant': req.tenant,
+                        'prompt_tokens': len(req.prompt_tokens)})
+                    raise
+                self._sched.enqueue(req)
+                if self._sl_on:
+                    self._stepline.note_event(
+                        req.request_id, req.tenant, 'submit',
+                        req.submitted_at,
+                        prompt_tokens=len(req.prompt_tokens),
+                        **({'resumed_from': req.resumed_from}
+                           if req.resumed_from else {}))
+        finally:
+            # Outside the lock: the dump handoff takes the writer's
+            # own condition, which must never nest under the engine
+            # lock. A shed request still flushes its dump.
+            self._flush_stepline_dumps()
         return req
 
     def cancel(self, req: Request) -> bool:
@@ -1079,12 +1144,18 @@ class InferenceEngine:
             with self._lock:
                 self._queue_waits.append(wait)
                 self._sched.note_queue_wait(req, wait)
+                if self._sl_on:
+                    self._stepline.note_event(
+                        req.request_id, req.tenant, 'first_dispatch',
+                        req.first_dispatch_at,
+                        queue_wait_s=round(wait, 6))
 
     def _dispatch_chunk_plan(self, plan: _ChunkPlan) -> bool:
         """Standalone dispatch of a prepared chunk via the prefill
         program (no host sync). Returns True when the prompt is now
         fully cached."""
         self._note_first_dispatch(plan.req)
+        t_d = time.perf_counter() if self._sl_on else 0.0
         if self.allocator is not None:
             self.cache, self._last_dev = self._prefill_chunk(
                 self.cache, self.params, jnp.int32(plan.slot),
@@ -1098,6 +1169,8 @@ class InferenceEngine:
                 jnp.asarray(plan.padded), jnp.int32(plan.off),
                 jnp.int32(plan.tl), self._next_key(),
                 jnp.float32(plan.req.temperature), self._last_dev)
+        if self._sl_on:
+            self._sl_dispatch += time.perf_counter() - t_d
         with self._lock:
             self._prefill_tokens += plan.tl
         return self._note_chunk_dispatched(plan)
@@ -1160,6 +1233,20 @@ class InferenceEngine:
                 self._ttfts.append(req.finished_at - req.submitted_at)
                 self._sched.note_first_token(
                     req, req.finished_at - req.submitted_at)
+                self._sl_first_token(
+                    req, req.finished_at - req.submitted_at)
+            if self._sl_on:
+                self._stepline.note_event(
+                    req.request_id, req.tenant, 'done',
+                    req.finished_at, finish_reason=req.finish_reason,
+                    tokens=len(req.output_tokens))
+                if req.finish_reason == 'cache_full':
+                    # Anomaly trigger: the request was cut by cache
+                    # exhaustion — page pressure in the retained steps
+                    # explains why.
+                    self._note_anomaly('cache_full', {
+                        'request_id': req.request_id,
+                        'tenant': req.tenant, 'slot': slot})
             self._slots[slot] = None
             # Release BEFORE zeroing _slot_len: donation covers exactly
             # the positions whose K/V the pages hold, which is what
@@ -1174,6 +1261,10 @@ class InferenceEngine:
         expired while waiting). Under the engine lock."""
         req.finish_reason = reason
         req.finished_at = time.time()
+        if self._sl_on:
+            self._stepline.note_event(
+                req.request_id, req.tenant, 'done', req.finished_at,
+                finish_reason=reason, tokens=len(req.output_tokens))
         req._notify()
 
     def _finish_early(self, slot: int, req: Request, reason: str) -> None:
@@ -1188,6 +1279,11 @@ class InferenceEngine:
             prefilled_to = self._prefilling.pop(slot, None)
             req.finish_reason = reason
             req.finished_at = time.time()
+            if self._sl_on:
+                self._stepline.note_event(
+                    req.request_id, req.tenant, 'done',
+                    req.finished_at, finish_reason=reason,
+                    tokens=len(req.output_tokens))
             self._slots[slot] = None
             self._matched.discard(slot)
             self._release_slot_pages(slot, req, prefilled_to)
@@ -1240,6 +1336,14 @@ class InferenceEngine:
             self.cache = self._free(self.cache, jnp.int32(slot))
             self._sched.requeue(req)
             self._preemptions += 1
+            # Anomaly trigger: a preemption is the canonical "why was
+            # this request slow" incident — the retained steps show
+            # the page pressure that caused it.
+            self._note_anomaly('preemption', {
+                'request_id': req.request_id, 'tenant': req.tenant,
+                'slot': slot,
+                'tokens_recomputed': len(req.prompt_tokens)
+                + len(req.output_tokens)})
 
     def _unshare_write_range(self, slot: int, start_tok: int,
                              end_tok: int) -> bool:
@@ -1377,6 +1481,31 @@ class InferenceEngine:
         one token for every fully-prefilled slot. Returns the number of
         slots worked on.
 
+        With the flight recorder on (the default), the step body runs
+        between a counter pre-snapshot and a ring append: the record
+        is derived purely from clocks and counter deltas, so the
+        recorded step is bit-identical to the unrecorded one."""
+        if not self._sl_on:
+            return self._step_inner()
+        t0 = time.perf_counter()
+        t_wall = time.time()
+        self._sl_dispatch = 0.0
+        self._sl_drain = 0.0
+        self._sl_readback = 0.0
+        self._sl_batch = 0
+        with self._lock:
+            pre = (self._prefill_tokens, self._spec_drafted,
+                   self._spec_accepted, self._decode_steps,
+                   self._spec_steps, self._fused_steps,
+                   self._decode_tokens)
+        worked = self._step_inner()
+        self._sl_record(t_wall, time.perf_counter() - t0, pre)
+        self._flush_stepline_dumps()
+        return worked
+
+    def _step_inner(self) -> int:
+        """The step body (see :meth:`step`).
+
         The lock guards only the waiting queue — prefill compiles/executes
         on-device and must not block submit() (which HTTP handlers call
         from the event loop)."""
@@ -1391,6 +1520,13 @@ class InferenceEngine:
                     self._slots[slot] = req   # reserve before releasing
                     self._prefilling[slot] = 0
                     self._matched.discard(slot)
+                    if self._sl_on and req.first_dispatch_at is not None:
+                        # A request re-entering a slot with a dispatch
+                        # already stamped is a preemption resume — the
+                        # timeline shows the gap it paid.
+                        self._stepline.note_event(
+                            req.request_id, req.tenant, 'resume',
+                            time.time(), slot=slot)
         # Chunk phase: bounded prefill work per step so decode latency
         # of active slots stays flat under prompt bursts. Chunks are
         # async dispatches (no sync), so several per step cost latency
@@ -1602,6 +1738,7 @@ class InferenceEngine:
         ``_consume_one``. Decode N+1 depends only on ``_last_dev`` and
         the cache — both device-resident — so it never waits for the
         host to have READ step N."""
+        t_d = time.perf_counter() if self._sl_on else 0.0
         self._refresh_dispatch_state(decoding)
         if self.allocator is not None:
             pair, self.cache = self._decode(
@@ -1616,6 +1753,9 @@ class InferenceEngine:
         # Overlap the readback with everything that follows: by consume
         # time the bytes are (usually) already on the host.
         pair.copy_to_host_async()
+        if self._sl_on:
+            self._sl_dispatch += time.perf_counter() - t_d
+            self._sl_batch = len(decoding)
         with self._lock:
             # Under the lock so metrics()' tokens_in_flight sum never
             # reads a half-applied increment batch (consume decrements
@@ -1656,6 +1796,7 @@ class InferenceEngine:
         pair row 0 (the prefilled list) and joins the NEXT step's
         decode — one extra step, zero token-sequence difference
         (greedy outputs are gated bit-identical fused on vs off)."""
+        t_d = time.perf_counter() if self._sl_on else 0.0
         self._refresh_dispatch_state(decoding)
         self._note_first_dispatch(plan.req)
         chunk_key = self._next_key()
@@ -1677,6 +1818,9 @@ class InferenceEngine:
                 dec_key, self._temps_dev, self._active_dev)
         self._last_dev = pair[1]
         pair.copy_to_host_async()
+        if self._sl_on:
+            self._sl_dispatch += time.perf_counter() - t_d
+            self._sl_batch = len(decoding)
         with self._lock:
             self._decode_steps += 1
             self._fused_steps += 1
@@ -1783,6 +1927,7 @@ class InferenceEngine:
         The [spec_k+3, slots] pair rides the in-flight queue exactly
         like a decode pair; consume applies host bookkeeping per
         emitted token and rolls rejected pages back."""
+        t_d = time.perf_counter() if self._sl_on else 0.0
         self._refresh_dispatch_state(decoding)
         drafts_dev = jnp.asarray(draft_mat)
         lens_dev = jnp.asarray(draft_lens)
@@ -1797,6 +1942,9 @@ class InferenceEngine:
                 lens_dev, self._next_key(), self._temps_dev,
                 self._active_dev)
         pair.copy_to_host_async()
+        if self._sl_on:
+            self._sl_dispatch += time.perf_counter() - t_d
+            self._sl_batch = len(decoding)
         with self._lock:
             self._decode_steps += 1
             self._spec_steps += 1
@@ -1818,7 +1966,14 @@ class InferenceEngine:
         drops its token — for greedy decoding the resume path recomputes
         the identical token, so outputs are depth-invariant."""
         pair, decoded, prefilled, spec_r = self._queue.popleft()
+        t_rb = time.perf_counter() if self._sl_on else 0.0
         pair_host = np.asarray(pair)   # sync point (copy already async)
+        if self._sl_on:
+            # Readback = blocked on the device→host copy; everything
+            # after is drain (host bookkeeping catching up). Both
+            # accumulate into the current step's record.
+            t_bk = time.perf_counter()
+            self._sl_readback += t_bk - t_rb
         now = time.time()
         touched: List[Request] = []
         with self._lock:
@@ -1831,6 +1986,7 @@ class InferenceEngine:
                     self._ttfts.append(now - req.submitted_at)
                     self._sched.note_first_token(
                         req, now - req.submitted_at)
+                    self._sl_first_token(req, now - req.submitted_at)
                 req.output_tokens.append(first)
                 self._decode_tokens += 1
                 self._sched.note_tokens(req)
@@ -1860,6 +2016,8 @@ class InferenceEngine:
         for req in touched:
             if not req.done:       # _finish already notified
                 req._notify()
+        if self._sl_on:
+            self._sl_drain += time.perf_counter() - t_bk
 
     def _consume_verify(self, pair_host, decoded, spec_r,
                         touched) -> None:  # holds: _lock
@@ -1995,6 +2153,147 @@ class InferenceEngine:
         with self._lock:
             return self._sched.snapshot()
 
+    # ---- flight recorder -------------------------------------------------
+    def _sl_first_token(self, req: Request,  # holds: _lock
+                        ttft: float) -> None:
+        """Timeline event + the TTFT-SLO anomaly trigger, at the one
+        moment TTFT becomes known."""
+        if not self._sl_on:
+            return
+        self._stepline.note_event(
+            req.request_id, req.tenant, 'first_token',
+            req.first_token_at, ttft_s=round(ttft, 6))
+        slo = self.ecfg.ttft_slo_s
+        if slo is not None and ttft > slo:
+            self._note_anomaly('ttft_slo', {
+                'request_id': req.request_id, 'tenant': req.tenant,
+                'ttft_s': round(ttft, 6), 'slo_s': slo})
+
+    def _note_anomaly(self, trigger: str,  # holds: _lock
+                      detail: Dict[str, Any]) -> None:
+        """Record the anomaly in the event ring and queue a ring dump
+        (rate-limited per trigger kind). The sqlite write happens on
+        the stepline writer thread strictly AFTER the engine lock is
+        released (`_flush_stepline_dumps`) — nothing blocks, and the
+        engine lock never nests another lock."""
+        if not self._sl_on:
+            return
+        now = time.time()
+        detail = dict(detail, t=now,
+                      step_idx=self._stepline.steps.total)
+        self._stepline.note_event(
+            int(detail.get('request_id') or 0),
+            str(detail.get('tenant') or ''), trigger, now,
+            **{k: v for k, v in detail.items()
+               if k not in ('request_id', 'tenant', 't')})
+        if self._stepline.should_dump(trigger, now):
+            self._pending_dumps.append((trigger, detail))
+
+    def _flush_stepline_dumps(self) -> None:
+        """Hand queued anomaly dumps to the background writer. Called
+        OUTSIDE the engine lock (step()/submit() tails): the ring
+        snapshot is copied under the lock; the enqueue — which takes
+        the writer's own condition — runs strictly after release."""
+        if not self._sl_on:
+            return
+        with self._lock:
+            if not self._pending_dumps:
+                return
+            pending = self._pending_dumps
+            self._pending_dumps = []
+            raw = self._stepline.raw()   # O(n) pointer copy only
+        # The O(ring) per-record dict rendering happens on the WRITER
+        # thread (raw()'s records are write-once, safe to share): the
+        # step loop / HTTP event loop pays only the pointer copy.
+
+        def _render(pending=pending, raw=raw):
+            snap = stepline_lib.render_snapshot(raw)
+            spans = []
+            for trigger, detail in pending:
+                spans.extend(
+                    stepline_lib.dump_spans(trigger, detail, snap))
+            return spans
+
+        stepline_lib.enqueue_dump(_render)
+
+    def _sl_record(self, t_wall: float, dur: float,
+                   pre: tuple) -> None:
+        """Classify and append this step's record from counter deltas
+        (recorder on only; pure observation — no scheduling state is
+        read that the step loop acts on)."""
+        (pre_pref, pre_drafted, pre_accepted, pre_steps, pre_spec,
+         pre_fused, pre_tok) = pre
+        with self._lock:
+            d_disp = self._decode_steps - pre_steps
+            d_chunk = self._prefill_tokens - pre_pref
+            d_tok = self._decode_tokens - pre_tok
+            if d_disp:
+                kind = ('mixed' if self._fused_steps - pre_fused
+                        else 'verify' if self._spec_steps - pre_spec
+                        else 'decode')
+            elif d_chunk:
+                kind = 'prefill'
+            elif d_tok or self._sl_readback or self._sl_drain:
+                # Consumes only: the step drained in-flight results /
+                # freed finishing slots without dispatching new work.
+                kind = 'free'
+            else:
+                return   # pure idle tick: not worth a ring slot
+            depth = self._sched.pending()
+            tenant_depths = None
+            # Per-tenant decomposition is bounded: beyond this depth
+            # the O(queue) walk would tax every step exactly when the
+            # engine is most loaded — the record keeps the total, and
+            # the per-tenant split is still in metrics()['tenants'].
+            if 0 < depth <= 512:
+                td: Dict[str, int] = {}
+                for r in self._sched.queued_requests():
+                    td[r.tenant] = td.get(r.tenant, 0) + 1
+                tenant_depths = td
+            self._stepline.note_step(stepline_lib.StepRecord(
+                idx=self._stepline.steps.total,
+                t=t_wall, dur_s=dur, kind=kind,
+                dispatch_s=self._sl_dispatch,
+                drain_s=self._sl_drain,
+                readback_s=self._sl_readback,
+                batch=self._sl_batch,
+                chunk_tokens=d_chunk,
+                prefilling=len(self._prefilling),
+                spec_drafted=self._spec_drafted - pre_drafted,
+                spec_accepted=self._spec_accepted - pre_accepted,
+                pages_free=(self.allocator.free_pages
+                            if self.allocator is not None else -1),
+                prefix_evictions=(self.prefix.evictions
+                                  if self.prefix is not None else 0),
+                preemptions=self._preemptions,
+                queue_depth=depth,
+                tenant_depths=tenant_depths))
+
+    def stepline_snapshot(self) -> Dict[str, Any]:
+        """Locked copy of the flight-recorder rings — the
+        ``GET /debug/stepline`` payload (the ``ttft_window`` snapshot
+        contract: HTTP readers never touch the live rings)."""
+        if not self._sl_on:
+            return {'enabled': False, 'steps': [], 'events': []}
+        with self._lock:
+            raw = self._stepline.raw()   # O(n) pointer copy only
+        snap = stepline_lib.render_snapshot(raw)
+        snap['enabled'] = True
+        snap['ttft_slo_s'] = self.ecfg.ttft_slo_s
+        return snap
+
+    def stepline_summary(self) -> Dict[str, Any]:
+        """Aggregate stage breakdown over the retained window (the
+        bench's recorder-derived step-time decomposition). The
+        summarize math runs OUTSIDE the lock on a snapshot copy."""
+        if not self._sl_on:
+            return {'enabled': False}
+        with self._lock:
+            recs = self._stepline.steps.snapshot()
+        out = stepline_lib.summarize(recs)
+        out['enabled'] = True
+        return out
+
     def idle(self) -> bool:
         with self._lock:
             return (not self._sched.pending()
@@ -2032,94 +2331,149 @@ class InferenceEngine:
         with self._lock:
             return list(self._queue_waits)
 
-    def metrics(self) -> Dict[str, Any]:
-        # Snapshot under the engine lock: with the overlapped loop,
-        # counters (_decode_tokens, _ttfts, pages_free) are written one
-        # step behind the in-flight dispatch by the consume path — the
-        # lock keeps /metrics (and the LB reading it) from seeing a
-        # half-applied consume. pipeline_depth + tokens_in_flight make
-        # the staleness observable instead of mysterious.
+    def _metrics_snapshot(self) -> tuple:
+        """Raw counter/window snapshot taken under the engine lock —
+        the data half of :meth:`metrics`, hoisted out of it so
+        SKY-REGISTRY's key scan sees only EMITTED metric names (the
+        accumulator keys below are internal, same rule as
+        sched/base._merge_snapshots). Returns ``(ttfts, waits,
+        sched_snapshot, counters, prefix_stats)``."""
         with self._lock:
-            ttfts = sorted(self._ttfts)
-            p50 = ttfts[len(ttfts) // 2] if ttfts else None
-            waits = sorted(self._queue_waits)
-            return {
-                'decode_steps': self._decode_steps,
-                'decode_tokens': self._decode_tokens,
-                'decode_tokens_per_sec': (
-                    self._decode_tokens / self._decode_time
-                    if self._decode_time else 0.0),
-                # Emitted tokens per dispatched step (batch-wide:
-                # ~active slots without speculation; accepted runs
-                # multiply it by the mean accepted length).
-                'tokens_per_step': (round(
-                    self._decode_tokens / self._decode_steps, 4)
-                    if self._decode_steps else None),
-                # Prefill-stall decomposition (docs/serving.md "Fused
-                # mixed steps"): prompt tokens dispatched into chunks,
-                # how many rode a fused dispatch, and how often an
-                # active decode batch waited on a STANDALONE prefill
-                # dispatch instead (~0 with fused_prefill on).
-                'prefill_tokens': self._prefill_tokens,
-                'prefill_tokens_per_step': (round(
-                    self._prefill_tokens / self._decode_steps, 4)
-                    if self._decode_steps else None),
-                'fused_steps': self._fused_steps,
-                'decode_stall_steps': self._stall_steps,
-                **({'spec_k': self._spec_k,
-                    'spec_steps': self._spec_steps,
-                    'spec_slot_steps': self._spec_slot_steps,
-                    'spec_drafted_tokens': self._spec_drafted,
-                    'spec_accepted_tokens': self._spec_accepted,
-                    'spec_emitted_tokens': self._spec_emitted,
-                    'spec_accept_rate': (round(
-                        self._spec_accepted / self._spec_drafted, 4)
-                        if self._spec_drafted else 0.0),
-                    'accepted_len_mean': (round(
-                        self._spec_emitted / self._spec_slot_steps, 4)
-                        if self._spec_slot_steps else None)}
-                   if (self._spec_k or self._spec_steps) else {}),
-                'ttft_p50_s': p50,
-                # TTFT decomposition: submit → first chunk dispatch
-                # (the scheduler's share), apart from prefill compute.
-                'queue_wait_p50_ms': (round(
-                    waits[len(waits) // 2] * 1e3, 3) if waits
-                    else None),
-                'queue_wait_p99_ms': (round(
-                    waits[min(len(waits) - 1,
-                              int(len(waits) * 0.99))] * 1e3, 3)
-                    if waits else None),
-                'scheduler': self._sched.name,
-                'num_waiting': self._sched.pending(),
-                'queued_tokens': self._sched.queued_tokens(),
-                'tenants': sched_lib.aggregate_stats(
-                    [self._sched.snapshot()], self._decode_time),
-                'num_active': sum(
+            counters = dict(
+                decode_steps=self._decode_steps,
+                decode_tokens=self._decode_tokens,
+                decode_time=self._decode_time,
+                prefill_tokens=self._prefill_tokens,
+                fused_steps=self._fused_steps,
+                stall_steps=self._stall_steps,
+                spec_k=self._spec_k,
+                spec_steps=self._spec_steps,
+                spec_slot_steps=self._spec_slot_steps,
+                spec_drafted=self._spec_drafted,
+                spec_accepted=self._spec_accepted,
+                spec_emitted=self._spec_emitted,
+                scheduler=self._sched.name,
+                num_waiting=self._sched.pending(),
+                queued_tokens=self._sched.queued_tokens(),
+                num_active=sum(
                     1 for r in self._slots if r is not None),
-                'requests_abandoned': self._abandoned,
-                'requests_expired': self._expired,
-                'requests_cancelled': self._cancelled,
-                'pipeline_depth': self._depth,
+                abandoned=self._abandoned,
+                expired=self._expired,
+                cancelled=self._cancelled,
+                preemptions=self._preemptions,
                 # Summed from the per-slot counters, NOT by iterating
                 # _queue: the engine thread appends/pops the deque
                 # outside this lock, and CPython raises on a deque
                 # mutated mid-iteration.
-                'tokens_in_flight': sum(self._inflight_tok),
-                **({'paged': True,
-                    'page_size': self.allocator.page_size,
-                    'pages_total': self.allocator.n_pages,
-                    'pages_free': self.allocator.free_pages,
-                    'preemptions': self._preemptions,
-                    # Page value dtype + per-(k+v)-page HBM bytes
-                    # across all layers (int8 incl. its fp32 row
-                    # scales) — the denominator behind the "~2x
-                    # resident pages per HBM byte" claim.
-                    'kv_dtype': self.ecfg.kv_dtype,
-                    'kv_page_bytes': self._kv_page_bytes()}
-                   if self.allocator is not None else {}),
-                **(self.prefix.stats() if self.prefix is not None
-                   else {}),
-            }
+                tokens_in_flight=sum(self._inflight_tok),
+                pages_free=(self.allocator.free_pages
+                            if self.allocator is not None else 0),
+                stepline_steps=(self._stepline.steps.total
+                                if self._sl_on else 0),
+                stepline_dumps=(self._stepline.dumps
+                                if self._sl_on else 0))
+            return (list(self._ttfts), list(self._queue_waits),
+                    self._sched.snapshot(), counters,
+                    self.prefix.stats() if self.prefix is not None
+                    else {})
+
+    def metrics(self) -> Dict[str, Any]:
+        # Snapshot RAW state under the engine lock
+        # (_metrics_snapshot), derive everything else outside it.
+        # With the overlapped loop, counters (_decode_tokens, _ttfts,
+        # pages_free) are written one step behind the in-flight
+        # dispatch by the consume path — the lock keeps /metrics (and
+        # the LB reading it) from seeing a half-applied consume. But
+        # the O(n log n) percentile sorts (TTFT/queue-wait windows,
+        # the per-tenant aggregate_stats merge) must NOT run under
+        # it: every poll would stall the step loop for the sort's
+        # duration (the ttft_window snapshot contract, applied to the
+        # engine's own poll path).
+        (ttfts_raw, waits_raw, sched_snap, c,
+         prefix_stats) = self._metrics_snapshot()
+        ttfts = sorted(ttfts_raw)
+        p50 = ttfts[len(ttfts) // 2] if ttfts else None
+        waits = sorted(waits_raw)
+        return {
+            'decode_steps': c['decode_steps'],
+            'decode_tokens': c['decode_tokens'],
+            'decode_tokens_per_sec': (
+                c['decode_tokens'] / c['decode_time']
+                if c['decode_time'] else 0.0),
+            # Emitted tokens per dispatched step (batch-wide:
+            # ~active slots without speculation; accepted runs
+            # multiply it by the mean accepted length).
+            'tokens_per_step': (round(
+                c['decode_tokens'] / c['decode_steps'], 4)
+                if c['decode_steps'] else None),
+            # Prefill-stall decomposition (docs/serving.md "Fused
+            # mixed steps"): prompt tokens dispatched into chunks,
+            # how many rode a fused dispatch, and how often an
+            # active decode batch waited on a STANDALONE prefill
+            # dispatch instead (~0 with fused_prefill on).
+            'prefill_tokens': c['prefill_tokens'],
+            'prefill_tokens_per_step': (round(
+                c['prefill_tokens'] / c['decode_steps'], 4)
+                if c['decode_steps'] else None),
+            'fused_steps': c['fused_steps'],
+            'decode_stall_steps': c['stall_steps'],
+            **({'spec_k': c['spec_k'],
+                'spec_steps': c['spec_steps'],
+                'spec_slot_steps': c['spec_slot_steps'],
+                'spec_drafted_tokens': c['spec_drafted'],
+                'spec_accepted_tokens': c['spec_accepted'],
+                'spec_emitted_tokens': c['spec_emitted'],
+                'spec_accept_rate': (round(
+                    c['spec_accepted'] / c['spec_drafted'], 4)
+                    if c['spec_drafted'] else 0.0),
+                'accepted_len_mean': (round(
+                    c['spec_emitted'] / c['spec_slot_steps'], 4)
+                    if c['spec_slot_steps'] else None)}
+               if (c['spec_k'] or c['spec_steps']) else {}),
+            'ttft_p50_s': p50,
+            # TTFT decomposition: submit → first chunk dispatch
+            # (the scheduler's share), apart from prefill compute.
+            'queue_wait_p50_ms': (round(
+                waits[len(waits) // 2] * 1e3, 3) if waits
+                else None),
+            'queue_wait_p99_ms': (round(
+                waits[min(len(waits) - 1,
+                          int(len(waits) * 0.99))] * 1e3, 3)
+                if waits else None),
+            'scheduler': c['scheduler'],
+            'num_waiting': c['num_waiting'],
+            'queued_tokens': c['queued_tokens'],
+            # Per-tenant percentile merge from the LOCKED raw
+            # snapshot, computed outside the lock (the new per-tenant
+            # windows follow the same contract as the engine ones).
+            'tenants': sched_lib.aggregate_stats(
+                [sched_snap], c['decode_time']),
+            'num_active': c['num_active'],
+            'requests_abandoned': c['abandoned'],
+            'requests_expired': c['expired'],
+            'requests_cancelled': c['cancelled'],
+            'pipeline_depth': self._depth,
+            'tokens_in_flight': c['tokens_in_flight'],
+            # Flight recorder: total steps recorded (monotonic; the
+            # ring keeps the last `stepline_cap`) and anomaly dumps
+            # TRIGGERED (the store write is fail-open + bounded, so
+            # `sky-tpu profile` may list fewer after a storm).
+            'stepline_steps': c['stepline_steps'],
+            'stepline_dumps': c['stepline_dumps'],
+            **({'paged': True,
+                'page_size': self.allocator.page_size,
+                'pages_total': self.allocator.n_pages,
+                'pages_free': c['pages_free'],
+                'preemptions': c['preemptions'],
+                # Page value dtype + per-(k+v)-page HBM bytes
+                # across all layers (int8 incl. its fp32 row
+                # scales) — the denominator behind the "~2x
+                # resident pages per HBM byte" claim.
+                'kv_dtype': self.ecfg.kv_dtype,
+                'kv_page_bytes': self._kv_page_bytes()}
+               if self.allocator is not None else {}),
+            **prefix_stats,
+        }
 
     def _kv_page_bytes(self) -> int:
         """HBM bytes one physical page costs across every layer — K
@@ -2189,6 +2543,15 @@ class EnginePool:
             raise ValueError('empty engine pool')
         self.engines = sorted(engines,
                               key=lambda e: e.ecfg.max_seq_len)
+        # Disjoint request-id spaces per tier (tier i counts
+        # i+1, i+1+n, ...): merged flight-recorder snapshots, the
+        # span-store dumps, and `sky-tpu profile <request_id>` all
+        # key per-request timelines by request_id — two tiers each
+        # counting 1, 2, 3, ... would fold DIFFERENT requests into
+        # one timeline. Deterministic in submission order, so
+        # multi-host lockstep still agrees on every id.
+        for i, eng in enumerate(self.engines):
+            eng._ids = itertools.count(i + 1, len(self.engines))
 
     def submit(self, prompt_tokens: Sequence[int],
                max_new_tokens: Optional[int] = None,
@@ -2241,6 +2604,33 @@ class EnginePool:
     def set_tenant_weights(self, weights) -> None:
         for e in self.engines:
             e.set_tenant_weights(weights)
+
+    def stepline_snapshot(self) -> Dict[str, Any]:
+        """Merged flight-recorder snapshot across tiers (records
+        interleave on the shared wall clock)."""
+        tiers = [e.stepline_snapshot() for e in self.engines]
+        return {
+            'enabled': any(t.get('enabled') for t in tiers),
+            'dumps': sum(t.get('dumps', 0) for t in tiers),
+            'steps_total': sum(t.get('steps_total', 0)
+                               for t in tiers),
+            'steps': sorted((r for t in tiers
+                             for r in t.get('steps', [])),
+                            key=lambda r: r['t']),
+            'events': sorted((ev for t in tiers
+                              for ev in t.get('events', [])),
+                             key=lambda ev: ev['t']),
+            'tiers': len(tiers),
+        }
+
+    def stepline_summary(self) -> Dict[str, Any]:
+        tiers = [e.stepline_summary() for e in self.engines]
+        on = [t for t in tiers if t.get('enabled')]
+        if not on:
+            return {'enabled': False}
+        if len(on) == 1:
+            return on[0]
+        return {'enabled': True, 'tiers': on}
 
     def idle(self) -> bool:
         return all(e.idle() for e in self.engines)
@@ -2353,6 +2743,14 @@ class EnginePool:
             'pipeline_depth': max(t['pipeline_depth'] for t in tiers),
             'tokens_in_flight': sum(t['tokens_in_flight']
                                     for t in tiers),
+            # Flight recorder, summed across tiers — the cataloged
+            # top-level keys must survive the two-tier config, or a
+            # dashboard keyed on them flatlines when --long-slots is
+            # enabled.
+            'stepline_steps': sum(t.get('stepline_steps', 0)
+                                  for t in tiers),
+            'stepline_dumps': sum(t.get('stepline_dumps', 0)
+                                  for t in tiers),
             'tiers': [{'max_seq_len': e.ecfg.max_seq_len,
                        'n_slots': e.ecfg.n_slots, **t}
                       for e, t in zip(self.engines, tiers)],

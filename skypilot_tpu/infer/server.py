@@ -413,6 +413,21 @@ class InferenceServer:
             m['drain_duration_s'] = round(self.drain_duration_s, 4)
         return web.json_response(m)
 
+    async def h_stepline(self, _req: web.Request) -> web.Response:
+        """Flight-recorder snapshot (docs/observability.md "Flight
+        recorder"): the step ring + request timeline as JSON.
+        ``sky-tpu profile <replica-url>`` fetches this and renders it
+        as a Perfetto trace. The engine lock is held only for the
+        ring's pointer copy; the O(ring) dict rendering AND the
+        multi-MB json.dumps both run off the event loop — a 1 Hz
+        profile poll must not inject stalls into in-flight token
+        streams."""
+        def _render() -> str:
+            return json.dumps(self.engine.stepline_snapshot())
+        body = await asyncio.to_thread(_render)
+        return web.Response(text=body,
+                            content_type='application/json')
+
     # -- graceful drain ----------------------------------------------------
     def _enter_drain(self) -> None:
         if self.draining:
@@ -734,6 +749,7 @@ class InferenceServer:
         app = web.Application()
         app.router.add_get('/health', self.h_health)
         app.router.add_get('/metrics', self.h_metrics)
+        app.router.add_get('/debug/stepline', self.h_stepline)
         app.router.add_post('/generate', self.h_generate)
         app.router.add_post('/drain', self.h_drain)
         return app
@@ -844,6 +860,25 @@ def main() -> None:
     parser.add_argument('--spec-ngram', type=int, default=3,
                         help='Longest trailing n-gram the drafter '
                              'matches (falls back to shorter grams).')
+    parser.add_argument('--no-stepline', action='store_true',
+                        help='Disable the engine flight recorder '
+                             '(docs/observability.md "Flight '
+                             'recorder"). On by default: a fixed-size '
+                             'ring of per-step records + request '
+                             'timelines at GET /debug/stepline, '
+                             'snapshotted into the span store on '
+                             'anomalies (TTFT-SLO breach, preemption, '
+                             'cache_full, admission shed).')
+    parser.add_argument('--stepline-cap', type=int, default=None,
+                        help='Flight-recorder ring capacity in step '
+                             'records (default: SKY_TPU_STEPLINE_CAP '
+                             'or 1024).')
+    parser.add_argument('--ttft-slo-s', type=float, default=None,
+                        help='TTFT SLO in seconds: a first token '
+                             'slower than this triggers a flight-'
+                             'recorder anomaly dump (read later with '
+                             '`sky-tpu profile`). Default: no SLO '
+                             'trigger.')
     parser.add_argument('--pipeline-depth', type=int, default=1,
                         help='Dispatch-ahead decode depth: decode N+1 '
                              'is dispatched before step N is read '
@@ -968,7 +1003,10 @@ def main() -> None:
             max_queue_requests=args.max_queue_requests,
             max_queue_tokens=args.max_queue_tokens,
             scheduler=args.scheduler,
-            tenant_weights=tenant_weights))
+            tenant_weights=tenant_weights,
+            stepline=not args.no_stepline,
+            stepline_cap=args.stepline_cap,
+            ttft_slo_s=args.ttft_slo_s))
     if args.long_slots > 0:
         short_cap = min(args.max_seq_len, config.max_seq_len)
         long_cap = min(args.long_seq_len, config.max_seq_len)
@@ -992,7 +1030,10 @@ def main() -> None:
                 max_queue_requests=args.max_queue_requests,
                 max_queue_tokens=args.max_queue_tokens,
                 scheduler=args.scheduler,
-                tenant_weights=tenant_weights),
+                tenant_weights=tenant_weights,
+                stepline=not args.no_stepline,
+                stepline_cap=args.stepline_cap,
+                ttft_slo_s=args.ttft_slo_s),
             seed=1)
         engine = engine_lib.EnginePool([engine, long_engine])
     driver = None

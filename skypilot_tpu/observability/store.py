@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import common
@@ -22,6 +23,12 @@ from skypilot_tpu.utils import db as db_util
 # never half-deleted (a broken parent chain renders as orphans).
 MAX_SPANS_ENV = 'SKY_TPU_TRACE_MAX_SPANS'
 DEFAULT_MAX_SPANS = 100_000
+# Age-based retention: whole traces whose NEWEST span is older than
+# this many seconds are dropped at GC time, regardless of the row
+# count — a long-lived replica under the size cap must not keep
+# week-old flight-recorder rings around. 0/unset disables the TTL;
+# both caps compose (age first, then size).
+TTL_ENV = 'SKY_TPU_TRACE_TTL_S'
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS spans (
@@ -109,14 +116,38 @@ class SpanStore:
             return []
         return self.get_trace(trace_id)
 
-    def list_traces(self, limit: int = 50) -> List[Dict[str, Any]]:
+    def trace_ids_for_request(self, request_id: str) -> List[str]:
+        """Every trace containing the request, newest first. A request
+        can appear in both its ordinary propagated-span trace and one
+        or more flight-recorder dumps (``stepline-*``); callers that
+        want a specific kind filter on the trace-id prefix."""
+        rows = self._conn.execute(
+            'SELECT trace_id, MAX(start_ts) AS newest FROM spans '
+            'WHERE request_id=? GROUP BY trace_id '
+            'ORDER BY newest DESC', (request_id,)).fetchall()
+        return [r['trace_id'] for r in rows]
+
+    def list_traces(self, limit: int = 50,
+                    trace_id_prefix: Optional[str] = None,
+                    ) -> List[Dict[str, Any]]:
         """Most-recent-first trace summaries (for `sky-tpu trace` with
-        no argument / the API listing)."""
+        no argument / the API listing). ``trace_id_prefix`` filters
+        SERVER-side (``stepline-`` for flight-recorder dumps) — a
+        post-filtered page would lose dumps behind ``limit`` newer
+        ordinary traces on a busy store."""
+        where = ''
+        args: tuple = ()
+        if trace_id_prefix:
+            esc = (trace_id_prefix.replace('\\', '\\\\')
+                   .replace('%', '\\%').replace('_', '\\_'))
+            where = "WHERE trace_id LIKE ? ESCAPE '\\' "
+            args = (esc + '%',)
         rows = self._conn.execute(
             'SELECT trace_id, MIN(start_ts) AS start_ts,'
             ' COUNT(*) AS n_spans, MAX(request_id) AS request_id '
-            'FROM spans GROUP BY trace_id '
-            'ORDER BY start_ts DESC LIMIT ?', (limit,)).fetchall()
+            'FROM spans ' + where + 'GROUP BY trace_id '
+            'ORDER BY start_ts DESC LIMIT ?',
+            args + (limit,)).fetchall()
         out = []
         for r in rows:
             d = dict(r)
@@ -132,20 +163,42 @@ class SpanStore:
         return self._conn.execute(
             'SELECT COUNT(*) AS n FROM spans').fetchone()['n']
 
-    def gc(self, max_spans: Optional[int] = None) -> int:
-        """Drop oldest whole traces until the row count fits the cap.
-        Returns rows deleted.
+    def gc(self, max_spans: Optional[int] = None,
+           ttl_s: Optional[float] = None) -> int:
+        """Drop whole traces past the age TTL (``SKY_TPU_TRACE_TTL_S``;
+        a trace's age is its NEWEST span), then oldest whole traces
+        until the row count fits the size cap. The two caps compose:
+        age first — so the size pass only ever sees live-window traces
+        — then size. Returns total rows deleted.
 
-        Set-based: one aggregate scan picks the oldest traces whose
-        removal brings the store under cap, one DELETE drops them — a
-        per-trace loop would re-COUNT the full table thousands of
-        times when small SDK traces pushed it over cap."""
+        Set-based: one aggregate scan picks the victim traces, one
+        DELETE drops them — a per-trace loop would re-COUNT the full
+        table thousands of times when small SDK traces pushed it over
+        cap."""
         if max_spans is None:
             max_spans = int(os.environ.get(MAX_SPANS_ENV,
                                            DEFAULT_MAX_SPANS))
+        if ttl_s is None:
+            try:
+                ttl_s = float(os.environ.get(TTL_ENV, '0') or 0)
+            except ValueError:
+                ttl_s = 0.0
+        deleted = 0
+        if ttl_s and ttl_s > 0:
+            cutoff = time.time() - ttl_s
+            # Single statement with ONE bound variable: a populated
+            # store's first TTL pass can expire tens of thousands of
+            # small traces, and an IN (?,?,...) victim list would
+            # blow sqlite's bound-variable limit and fail ingest.
+            cur = self._conn.execute(
+                'DELETE FROM spans WHERE trace_id IN ('
+                'SELECT trace_id FROM spans GROUP BY trace_id '
+                'HAVING MAX(start_ts) < ?)', (cutoff,))
+            self._conn.commit()
+            deleted += cur.rowcount
         excess = self.count() - max_spans
         if excess <= 0:
-            return 0
+            return deleted
         rows = self._conn.execute(
             'SELECT trace_id, COUNT(*) AS n FROM spans '
             'GROUP BY trace_id ORDER BY MIN(start_ts)').fetchall()
@@ -156,13 +209,13 @@ class SpanStore:
             victims.append(r['trace_id'])
             excess -= r['n']
         if not victims:
-            return 0
+            return deleted
         marks = ','.join('?' for _ in victims)
         cur = self._conn.execute(
             f'DELETE FROM spans WHERE trace_id IN ({marks})',
             tuple(victims))
         self._conn.commit()
-        return cur.rowcount
+        return deleted + cur.rowcount
 
 
 _ingest_count = 0

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Set
 import aiohttp
 from aiohttp import web
 
+from skypilot_tpu.observability import stepline as stepline_lib
 from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.serve import load_balancing_policies as lbp
 from skypilot_tpu.serve import state as serve_state
@@ -55,6 +56,23 @@ logger = logging.getLogger(__name__)
 
 SYNC_INTERVAL_S = 1.0
 STATS_FLUSH_S = 2.0
+# Fleet metrics history: samples retained per replica (one per sync
+# tick — 120 at the 1 s default ≈ two minutes of signal), surfaced at
+# /-/metrics/history and as windowed-rate gauges in /-/metrics. The
+# signal shape the catalog autoscaler and the fleet digital twin
+# consume (docs/observability.md "Flight recorder").
+def _history_len() -> int:
+    # Fail-open like every other recorder knob (store TTL, dump
+    # interval): a malformed value must never keep the LB from
+    # starting, and deque(maxlen=<1) would break the sync tick.
+    try:
+        n = int(os.environ.get('SKY_TPU_LB_HISTORY', '120'))
+    except (TypeError, ValueError):
+        return 120
+    return max(1, n)
+
+
+HISTORY_LEN = _history_len()
 # Hop-by-hop headers never forwarded by proxies (RFC 9110 §7.6.1).
 _HOP_HEADERS = frozenset((
     'connection', 'keep-alive', 'proxy-authenticate',
@@ -185,6 +203,12 @@ class LoadBalancer:
         '_tenants': 'event-loop',
         '_replica_queue_depth': 'event-loop',
         '_replica_decode_stats': 'event-loop',
+        '_replica_history': 'event-loop',
+        '_sync_tick': 'event-loop',
+        '_history_tick': 'event-loop',
+        '_breaker_open_seen': 'event-loop',
+        '_breaker_pending': 'event-loop',
+        '_breaker_dump_at': 'event-loop',
     }
 
     def __init__(self, service_name: str, policy_name: str) -> None:
@@ -235,6 +259,32 @@ class LoadBalancer:
         # how many tokens each replica lands per engine step under
         # speculative decoding.
         self._replica_decode_stats: Dict[str, dict] = {}
+        # url -> bounded history ring of those per-tick samples (plus
+        # the raw decode/prefix counters, so windowed RATES derive
+        # from deltas): the fleet tier of the flight recorder.
+        # Pruned with the ready set, like the breaker.
+        self._replica_history: Dict[str, collections.deque] = {}
+        # Sync-tick counter + per-url tick of the last successful
+        # /metrics sample: the staleness signal for the windowed
+        # gauges. Ticks advance even when every fetch fails, so a
+        # fleet whose ONLY replica hangs still goes stale (a
+        # newest-ring-relative guard alone cannot see that — the
+        # frozen ring is its own freshest).
+        self._sync_tick = 0
+        self._history_tick: Dict[str, int] = {}
+        # Breaker states seen OPEN last tick — the edge detector for
+        # the breaker_open anomaly dump (fleet history → span store)
+        # — and the last dump's wall time: a hard-down replica
+        # re-edges open every cooldown cycle (open → half-open →
+        # failed probe → open), and without the same per-trigger rate
+        # limit the engine triggers have, a flapping replica would
+        # write a full fleet dump every ~10 s indefinitely.
+        self._breaker_open_seen: Set[str] = set()
+        # Edges that arrived rate-limited: still owed a fleet dump
+        # once the interval passes, even if the breaker has closed
+        # again by then (the edge is the incident, not the state).
+        self._breaker_pending: Set[str] = set()
+        self._breaker_dump_at = 0.0
         self.breaker = retry_lib.CircuitBreaker(
             failure_threshold=int(os.environ.get(
                 'SKY_TPU_LB_BREAKER_THRESHOLD', '3')),
@@ -244,6 +294,12 @@ class LoadBalancer:
     # -- background sync ---------------------------------------------------
     async def _sync_loop(self) -> None:
         while self._running:
+            # The tick advances OUTSIDE the try: the staleness guard
+            # on the windowed gauges relies on it outrunning frozen
+            # rings even when the sync body itself fails (state-DB
+            # hiccup) — inside, a failing body would freeze counter
+            # and rings together and the phantom rate would survive.
+            self._sync_tick += 1
             try:
                 info = await asyncio.to_thread(
                     serve_state.ready_replica_info, self.service_name)
@@ -289,7 +345,14 @@ class LoadBalancer:
                                     k: m.get(k) for k in (
                                         'tokens_per_step',
                                         'accepted_len_mean',
-                                        'spec_accept_rate')
+                                        'spec_accept_rate',
+                                        # Raw counters ride along so
+                                        # the history tier can derive
+                                        # windowed RATES from deltas.
+                                        'decode_tokens',
+                                        'prefix_hits',
+                                        'prefix_misses',
+                                        'prefix_hit_rate')
                                     if m.get(k) is not None}
                                 return url, int(
                                     m.get('num_waiting') or 0), eff
@@ -307,9 +370,66 @@ class LoadBalancer:
                     url: depth for url, depth, _ in rows}
                 self._replica_decode_stats = {
                     url: eff for url, _, eff in rows}
+                # Fleet history tier: one sample per replica per tick,
+                # bounded per replica; replicas leaving the ready set
+                # drop their ring (same lifetime rule as the breaker).
+                now = time.time()
+                for url, depth, eff in rows:
+                    ring = self._replica_history.get(url)
+                    if ring is None:
+                        ring = self._replica_history[url] = (
+                            collections.deque(maxlen=HISTORY_LEN))
+                    ring.append({'t': now, 'queue_depth': depth,
+                                 **eff})
+                    self._history_tick[url] = self._sync_tick
+                for url in list(self._replica_history):
+                    if url not in info:
+                        del self._replica_history[url]
+                        self._history_tick.pop(url, None)
+                await self._dump_breaker_edges()
             except Exception:  # noqa: BLE001 — keep serving on DB hiccup
                 logger.warning('replica sync failed', exc_info=True)
             await asyncio.sleep(SYNC_INTERVAL_S)
+
+    async def _dump_breaker_edges(self) -> None:
+        """breaker_open anomaly: on a closed→open EDGE, snapshot the
+        whole fleet metrics history into the span store (the black
+        box for "why did that replica trip") — sqlite I/O off the
+        event loop. Called once per sync tick."""
+        # Anything not CLOSED counts as "still open" for the edge
+        # detector: a hard-down replica cycles open → half-open →
+        # failed probe → open every cooldown, and keying on 'open'
+        # alone would re-arm the edge each cycle — an identical fleet
+        # dump per rate-limit interval, forever, until the repeated
+        # dumps GC ordinary request traces out of the span store.
+        open_now = {u for u, s in self.breaker.snapshot().items()
+                    if s != retry_lib.STATE_CLOSED}
+        # A breaker that closed re-arms its edge; open ones we have
+        # already dumped stay consumed. Pending edges (rate-limited
+        # earlier) stay owed even if the breaker closed meanwhile —
+        # the edge is the incident, and the ring still holds ~2 min
+        # of the evidence.
+        self._breaker_open_seen &= open_now
+        new_open = ((open_now - self._breaker_open_seen)
+                    | self._breaker_pending)
+        if not new_open:
+            return
+        now = time.time()
+        min_s = stepline_lib.dump_interval_s()
+        if min_s > 0 and now - self._breaker_dump_at < min_s:
+            # Deferred, not dropped: a second replica tripping inside
+            # the interval dumps on a later tick (unlike engine
+            # triggers, a breaker edge is one-shot — dropping it
+            # would lose the incident).
+            self._breaker_pending = new_open
+            return
+        self._breaker_dump_at = now
+        self._breaker_pending = set()
+        self._breaker_open_seen |= new_open & open_now
+        spans = stepline_lib.fleet_history_spans(
+            'breaker_open', {'replicas_open': sorted(new_open)},
+            {u: list(r) for u, r in self._replica_history.items()})
+        await asyncio.to_thread(stepline_lib.write_dump_sync, spans)
 
     async def _stats_loop(self) -> None:
         while self._running:
@@ -361,9 +481,79 @@ class LoadBalancer:
         if tenant:
             self._tenant(tenant)['ttfts'].append(value)
 
+    def _history_gauges(self) -> Dict[str, object]:  # holds: event-loop
+        """Windowed-rate gauges derived from the per-replica history
+        rings (counter DELTAS over each ring's span — the flight
+        recorder's fleet tier): the shape the catalog autoscaler and
+        the digital twin consume. Internal names; the emitted keys
+        live in ``lb_metrics`` (SKY-REGISTRY)."""
+        window = 0.0
+        tps = 0.0
+        any_tps = False
+        d_hits = 0
+        d_lookups = 0
+        # Staleness guard: a ready-but-unresponsive replica's ring
+        # stops appending (fetches fail) but survives pruning — its
+        # frozen window must not contribute a constant phantom rate
+        # to the fleet gauges forever. Two complementary signals: a
+        # ring whose newest sample lags the freshest ring's by a few
+        # sync ticks (relative, not wall-clock, so replayed/synthetic
+        # histories still aggregate), and a ring whose last
+        # successful fetch lags the sync-tick COUNTER — the counter
+        # advances even when every fetch fails, which catches the
+        # all-frozen fleet the relative check cannot (a lone hung
+        # replica's ring is its own freshest).
+        newest = max((ring[-1]['t']
+                      for ring in self._replica_history.values()
+                      if ring), default=0.0)
+        stale_s = 3 * SYNC_INTERVAL_S
+        stale_ticks = 3
+        for url, ring in self._replica_history.items():
+            if len(ring) < 2:
+                continue
+            a, b = ring[0], ring[-1]
+            if newest - b['t'] > stale_s:
+                continue   # frozen ring: replica stopped reporting
+            if (self._sync_tick - self._history_tick.get(
+                    url, self._sync_tick)) > stale_ticks:
+                continue   # fetches failing: whole fleet may be dark
+            span = b['t'] - a['t']
+            if span <= 0:
+                continue
+            window = max(window, span)
+            if (a.get('decode_tokens') is not None
+                    and b.get('decode_tokens') is not None):
+                tps += max(0, b['decode_tokens']
+                           - a['decode_tokens']) / span
+                any_tps = True
+            if (a.get('prefix_hits') is not None
+                    and b.get('prefix_hits') is not None):
+                dh = max(0, b['prefix_hits'] - a['prefix_hits'])
+                dm = max(0, (b.get('prefix_misses') or 0)
+                         - (a.get('prefix_misses') or 0))
+                d_hits += dh
+                d_lookups += dh + dm
+        return {
+            'window_s': round(window, 3) if window else None,
+            'tokens_per_sec': round(tps, 4) if any_tps else None,
+            'hit_rate': (round(d_hits / d_lookups, 4)
+                         if d_lookups else None),
+        }
+
+    def lb_history(self) -> Dict[str, object]:  # holds: event-loop
+        """The raw per-replica history rings (``/-/metrics/history``):
+        one row per sync tick per replica, oldest first."""
+        return {
+            'history_len': HISTORY_LEN,
+            'sync_interval_s': SYNC_INTERVAL_S,
+            'replicas': {u: list(ring) for u, ring in
+                         sorted(self._replica_history.items())},
+        }
+
     def lb_metrics(self) -> Dict[str, object]:  # holds: event-loop
         ttfts = sorted(self._ttfts)
         itls = sorted(self._itls)
+        hist = self._history_gauges()
 
         def pct(vals, p: float):
             if not vals:
@@ -392,6 +582,13 @@ class LoadBalancer:
                 self._replica_decode_stats, 'accepted_len_mean'),
             'engine_spec_accept_rate': _mean_gauge(
                 self._replica_decode_stats, 'spec_accept_rate'),
+            # Windowed-rate gauges from the fleet history rings
+            # (counter deltas over the retained window; the raw rings
+            # are at /-/metrics/history): null until two sync ticks
+            # of history exist.
+            'history_window_s': hist['window_s'],
+            'engine_tokens_per_sec_w': hist['tokens_per_sec'],
+            'prefix_hit_rate_w': hist['hit_rate'],
             'requests_total': self._requests_total,
             'requests_failed': self._requests_failed,
             'requests_no_replica': self._requests_no_replica,
@@ -760,6 +957,8 @@ class LoadBalancer:
                 {'ready_replica_urls': list(self.policy.ready_urls)})
         if request.path == '/-/metrics':
             return web.json_response(self.lb_metrics())
+        if request.path == '/-/metrics/history':
+            return web.json_response(self.lb_history())
         self._requests_total += 1
         t_arrival = time.monotonic()
         # Body read comes FIRST: nothing is selected or counted yet, so

@@ -89,6 +89,25 @@ def _chip_guard():
         lock.release()
 
 
+@pytest.fixture(scope='session', autouse=True)
+def _stepline_dumps_to_tmp(tmp_path_factory):
+    """Pin the flight recorder's anomaly-dump store to a session-tmp
+    sqlite for the WHOLE suite. The dump writer is a background
+    thread that resolves SpanStore() at write time — racing the
+    per-test SKY_TPU_HOME monkeypatch below, so without this pin a
+    dump triggered late in a test (preemption, cache_full) can land
+    in the operator's real ~/.sky_tpu/traces.db. Tests that assert on
+    dumps install their own store on top and restore this one."""
+    from skypilot_tpu.observability import stepline
+    from skypilot_tpu.observability import store as store_lib
+    st = store_lib.SpanStore(db_path=str(
+        tmp_path_factory.mktemp('stepline') / 'dumps.db'))
+    stepline.set_dump_store(st)
+    yield
+    stepline.flush_dumps(5.0)
+    stepline.set_dump_store(None)
+
+
 @pytest.fixture(autouse=True)
 def sky_tpu_home(tmp_path, monkeypatch):
     """Isolate all state (sqlite DB, logs, cluster dirs) per test."""
