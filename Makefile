@@ -6,7 +6,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke
+.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke
 
 lint:
 	$(PYTHON) -m skypilot_tpu.client.cli lint --changed
@@ -36,3 +36,10 @@ bench-ttft:
 # the span-store round trip. Exit 0 = the black box works end to end.
 profile-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.observability.stepline
+
+# Digital-twin smoke (docs/robustness.md "Digital twin"): replay the
+# reclaim-storm scenario against the REAL control plane in virtual
+# time, twice, and fail on any client-visible error or a decision-log
+# byte mismatch between the two same-seed runs.
+sim-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.sim --scenario reclaim_storm --verify-determinism

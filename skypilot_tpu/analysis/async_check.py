@@ -23,6 +23,11 @@ Subsumes (and replaces) the grep-based sleep lints of
    re-adds its interval to every streamed token or failover.
    Background maintenance cadences (LB replica sync) are the
    allowlisted exceptions.
+6. Bare ``time.time()`` / ``time.monotonic()`` in ``serve/``: the
+   control plane is clock-injectable (``utils/vclock``) so the fleet
+   digital twin (docs/robustness.md "Digital twin") replays a day of
+   control decisions in virtual seconds — a direct wall-clock read
+   anchors a decision to machine time the twin cannot control.
 
 One finding per call site; the allowlist pins the audited count per
 ``path:SKY-ASYNC`` exactly like the old grep lint pinned counts per
@@ -41,6 +46,13 @@ from skypilot_tpu.analysis import walker
 TIME_SLEEP_DIRS = ('client/', 'runtime/', 'serve/', 'infer/')
 # Hot paths where asyncio.sleep is ALSO pinned (event-driven waits).
 ANY_SLEEP_DIRS = ('serve/', 'infer/')
+# Clock-seam discipline (docs/robustness.md "Digital twin"): the serve
+# control plane reads time ONLY through utils/vclock (or an injected
+# Clock), so the fleet digital twin can replay every control decision
+# in virtual time. A bare wall-clock read here silently anchors a
+# decision to machine time the twin cannot control.
+CLOCK_SEAM_DIRS = ('serve/',)
+_WALL_CLOCK_CALLS = frozenset(('time.time', 'time.monotonic'))
 
 _BLOCKING_CALLS = frozenset((
     'urllib.request.urlopen', 'socket.create_connection',
@@ -102,6 +114,15 @@ class AsyncChecker(core.Checker):
                     'retries go through utils/retry.Retrier; a '
                     'genuine status-poll cadence needs an audited '
                     'allowlist entry')
+        elif (name in _WALL_CLOCK_CALLS
+                and _in_dirs(src.rel, CLOCK_SEAM_DIRS)):
+            return core.Finding(
+                self.code, src.rel, node.lineno,
+                f'bare {name}() in the serve control plane — read '
+                f'through the utils/vclock clock seam (vclock.now()/'
+                f'.monotonic() or an injected Clock) so the fleet '
+                f'digital twin can replay this decision in virtual '
+                f'time (docs/robustness.md "Digital twin")')
         elif name == 'asyncio.sleep':
             if _in_dirs(src.rel, ANY_SLEEP_DIRS):
                 return core.Finding(

@@ -146,6 +146,34 @@ def down(cluster_name: str) -> None:
     """Reference sky/core.py:798."""
     with locks.cluster_lock(cluster_name):
         record = _get_record(cluster_name)
+        if not record.get('cluster_info'):
+            # Half-provisioned carcass: the launch died between create
+            # and the UP write (e.g. a bootstrap failure), so no
+            # provider handle was ever saved. Tear down best-effort by
+            # name and free the record — a wedged INIT row must never
+            # force a rename (teardown is never on the critical path,
+            # docs/robustness.md).
+            cloud = (record.get('resources') or {}).get('cloud')
+            detail = 'down (half-provisioned carcass)'
+            if cloud:
+                try:
+                    # Best-effort: without a saved provider_config some
+                    # providers cannot locate the slice (the local
+                    # provider resolves by name; GCP needs the zone).
+                    provision.terminate_instances(cloud, cluster_name, {})
+                except Exception:  # noqa: BLE001 — carcass cleanup is best-effort
+                    detail = ('down (half-provisioned carcass; provider '
+                              'terminate FAILED — check the console for '
+                              'a leaked slice)')
+                    logger.warning(
+                        'carcass terminate of %s on %s failed — the '
+                        'create may have succeeded before the launch '
+                        'died, so a provider-side slice can be leaked; '
+                        'verify in the cloud console', cluster_name,
+                        cloud, exc_info=True)
+            state.remove_cluster(cluster_name)
+            state.add_cluster_event(cluster_name, 'TERMINATED', detail)
+            return
         backend_lib.TpuVmBackend().teardown(_info_of(record),
                                             terminate=True)
 

@@ -85,6 +85,7 @@ def launch(
     stages: Optional[List[Stage]] = None,
     quiet: bool = True,
     blocked_placements: Optional[List[Tuple[str, str]]] = None,
+    avoid_placements: Optional[List[Tuple[str, str]]] = None,
     caller: Optional[Dict[str, Any]] = None,
 ) -> Tuple[int, ClusterInfo]:
     """Provision (or reuse) a cluster and run the task on it.
@@ -123,12 +124,21 @@ def launch(
             # Best-first candidate list feeds the failover loop (reference:
             # the optimizer's output seeds RetryingVmProvisioner's zones).
             candidates = _failover_candidates(task, optimize_target)
+            # Two relaxation tiers (serve/spot_placer.py): HARD blocks
+            # (preemption cooldowns) are only relaxed when they exclude
+            # EVERY candidate — capacity moved on — while SOFT avoids
+            # (zone spreading) are dropped against the already-filtered
+            # list, so spreading pressure can never push a launch back
+            # into a zone that just preempted.
             if blocked_placements:
                 blocked_set = set(blocked_placements)
                 keep = [c for c in candidates
                         if (c.region, c.zone) not in blocked_set]
-                # An all-blocked list means capacity moved on — fall back
-                # to the full list rather than failing the launch.
+                candidates = keep or candidates
+            if avoid_placements:
+                avoid_set = set(avoid_placements)
+                keep = [c for c in candidates
+                        if (c.region, c.zone) not in avoid_set]
                 candidates = keep or candidates
             with trace.span('launch.provision', cluster=cluster_name):
                 info = backend.provision(task, cluster_name, candidates)

@@ -25,6 +25,7 @@ from skypilot_tpu.serve import spec as spec_lib
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.serve.state import ReplicaStatus, ServiceStatus  # noqa: F401
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import vclock
 
 
 def _validate(task: task_lib.Task) -> spec_lib.ServiceSpec:
@@ -123,8 +124,11 @@ def down_record(record: Dict[str, Any], *, purge: bool = False,
                 pass
         serve_state.remove_service(name)
         return
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    # SYSTEM on purpose: this poll sleeps REAL seconds, so its deadline
+    # must count real seconds too — under an installed VirtualClock a
+    # frozen monotonic() would never let the timeout elapse.
+    deadline = vclock.SYSTEM.monotonic() + timeout
+    while vclock.SYSTEM.monotonic() < deadline:
         if serve_state.get_service(name) is None:
             return
         time.sleep(0.2)
@@ -175,8 +179,10 @@ def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
 def wait_ready(service_name: str, timeout: float = 300.0,
                poll_s: float = 0.5) -> Dict[str, Any]:
     """Block until the service is READY (SDK/test helper)."""
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    # SYSTEM on purpose (see down_record): a real-sleep poll needs a
+    # real-time deadline even when a VirtualClock is installed.
+    deadline = vclock.SYSTEM.monotonic() + timeout
+    while vclock.SYSTEM.monotonic() < deadline:
         record = serve_state.get_service(service_name)
         if record is None:
             raise exceptions.JobNotFoundError(f'service {service_name!r}')

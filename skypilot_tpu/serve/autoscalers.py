@@ -19,11 +19,11 @@ from __future__ import annotations
 import dataclasses
 import logging
 import math
-import time
 from typing import Dict, List, Optional
 
 from skypilot_tpu.serve import spec as spec_lib
 from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.utils import vclock
 
 logger = logging.getLogger(__name__)
 
@@ -93,7 +93,10 @@ class _HysteresisAutoscaler(Autoscaler):
         # ``target_num_replicas`` is kept overprovision-FREE: relative
         # scalers (queue-length ±1) step from the demand-driven base;
         # overprovision is added once, on the emitted decision.
-        now = time.time() if now is None else now
+        # Clock seam (utils/vclock): the hysteresis windows run on the
+        # installed clock, so the digital twin's virtual 24h exercises
+        # the same upscale/downscale delays production would.
+        now = vclock.now() if now is None else now
         pol = self.policy
         if not pol.autoscaling:
             return self._finalize(

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import logging
 import os
-import time
+import threading
 from typing import Optional
 
 from skypilot_tpu.serve import autoscalers as autoscalers_lib
@@ -21,6 +21,7 @@ from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import spec as spec_lib
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.serve.state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.utils import vclock
 
 logger = logging.getLogger(__name__)
 
@@ -41,17 +42,28 @@ class ServeController:
         'autoscaler': 'owner',
     }
 
-    def __init__(self, service_name: str) -> None:
+    def __init__(self, service_name: str, *,
+                 cloud: Optional['replica_managers.CloudAdapter'] = None,
+                 executor=None) -> None:
         record = serve_state.get_service(service_name)
         if record is None:
             raise ValueError(f'service {service_name!r} not in state DB')
         self.service_name = service_name
         self.version = record['version']
         self.spec = spec_lib.ServiceSpec.from_config(record['spec'])
+        # ``cloud``/``executor`` thread the replica manager's provider
+        # and thread-pool seams through (the digital twin injects a
+        # virtual cloud + deterministic executor; production passes
+        # neither and gets the real ones).
         self.rm = replica_managers.ReplicaManager(
-            service_name, self.spec, record['task_yaml'])
+            service_name, self.spec, record['task_yaml'],
+            cloud=cloud, executor=executor)
         self.autoscaler = autoscalers_lib.make(
             service_name, self.spec.replica_policy)
+        # Prompt-teardown signal for run(): stop() (tests, embedding
+        # processes) wakes the tick loop immediately instead of letting
+        # it finish a full _TICK_S sleep.
+        self._stop = threading.Event()
 
     # -- version rollout ---------------------------------------------------
     def _refresh_version(self) -> None:
@@ -104,10 +116,19 @@ class ServeController:
 
     # -- one tick ----------------------------------------------------------
     def tick(self, now: Optional[float] = None) -> None:
+        # Clock seam: every time-based decision this tick makes (probe
+        # grace, hysteresis, stats pruning) reads ONE instant, so a
+        # virtual-time replay is coherent within the tick.
+        now = vclock.now() if now is None else now
         self._refresh_version()
-        self.rm.sync()
+        # sync() returns the rows with its status decisions mirrored
+        # in-memory (teardown paths are approximated as SHUTTING_DOWN —
+        # either way out of the live set), so the tick filters one
+        # scan instead of re-reading the whole table.
+        synced = self.rm.sync(now=now)
 
-        live = self.rm.live_replicas()
+        live = [r for r in synced
+                if r['status'] in ReplicaStatus.live()]
         num_ready = sum(1 for r in live
                         if r['status'] == ReplicaStatus.READY)
         decision = self.autoscaler.evaluate(num_ready, now=now,
@@ -178,15 +199,21 @@ class ServeController:
         # Trim LB stats older than the QPS window.
         serve_state.prune_stats(
             self.service_name,
-            time.time() - 2 * autoscalers_lib.QPS_WINDOW_S)
+            now - 2 * autoscalers_lib.QPS_WINDOW_S)
 
     # -- lifecycle ---------------------------------------------------------
+    def stop(self) -> None:
+        """Wake run() out of its inter-tick wait immediately (prompt
+        in-process teardown; `down` keeps signalling through the state
+        DB as before)."""
+        self._stop.set()
+
     def run(self) -> None:
         logger.info('service %s: controller up (pid %d)',
                     self.service_name, os.getpid())
         serve_state.set_controller_pid(self.service_name, os.getpid())
         try:
-            while True:
+            while not self._stop.is_set():
                 if serve_state.shutdown_requested(self.service_name):
                     self._shutdown()
                     return
@@ -198,10 +225,12 @@ class ServeController:
                 if record['status'] == ServiceStatus.FAILED:
                     # Keep replicas down, stay alive for `down`.
                     self.rm.terminate_all()
-                    time.sleep(_TICK_S)
+                    self._stop.wait(_TICK_S)
                     continue
                 self.tick()
-                time.sleep(_TICK_S)
+                # Event wait, not time.sleep: stop() tears the loop
+                # down promptly instead of after a full tick cadence.
+                self._stop.wait(_TICK_S)
         except Exception:  # noqa: BLE001 — a controller crash is a state
             logger.exception('service %s: controller crashed',
                              self.service_name)

@@ -38,8 +38,7 @@ import contextlib
 import json
 import logging
 import os
-import time
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
 import aiohttp
 from aiohttp import web
@@ -51,11 +50,23 @@ from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.utils import common
 from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils import retry as retry_lib
+from skypilot_tpu.utils import vclock
 
 logger = logging.getLogger(__name__)
 
 SYNC_INTERVAL_S = 1.0
 STATS_FLUSH_S = 2.0
+
+
+def _env_interval(name: str, default: float) -> float:
+    """Fail-open float knob (the SKY_TPU_LB_HISTORY rule): a malformed
+    value must never keep the LB from starting, and a non-positive
+    interval would spin the maintenance loops — floor at 10ms."""
+    try:
+        v = float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+    return max(0.01, v)
 # Fleet metrics history: samples retained per replica (one per sync
 # tick — 120 at the 1 s default ≈ two minutes of signal), surfaced at
 # /-/metrics/history and as windowed-rate gauges in /-/metrics. The
@@ -211,13 +222,30 @@ class LoadBalancer:
         '_breaker_dump_at': 'event-loop',
     }
 
-    def __init__(self, service_name: str, policy_name: str) -> None:
+    def __init__(self, service_name: str, policy_name: str, *,
+                 clock: Optional[vclock.Clock] = None) -> None:
         self.service_name = service_name
         self.policy = lbp.make(policy_name)
+        # Clock seam (utils/vclock): wall reads (history stamps, dump
+        # rate limits) and interval reads (TTFT/ITL stopwatches,
+        # deadlines, breaker cooldowns) both route through here so the
+        # digital twin replays the whole request path in virtual time.
+        self._clock = clock or vclock.get()
+        # Maintenance cadences, env-tunable fail-open (a fleet-scale
+        # twin or a 1000-replica deployment wants a coarser sync tick
+        # than the 1s default; docs/robustness.md "Digital twin").
+        self.sync_interval_s = _env_interval(
+            'SKY_TPU_LB_SYNC_INTERVAL_S', SYNC_INTERVAL_S)
+        self.stats_flush_s = _env_interval(
+            'SKY_TPU_LB_STATS_FLUSH_S', STATS_FLUSH_S)
         self._session: Optional[aiohttp.ClientSession] = None
         self._pending_requests = 0
         self._inflight = 0
         self._running = True
+        # run()'s idle wait parks on this event instead of a sleep
+        # poll; stop() sets it for prompt teardown.
+        self._stop_event: Optional[asyncio.Event] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         # TTFT per proxied request: arrival -> first response byte from
         # the replica (the BASELINE.md north-star serving metric; for a
         # streaming LLM endpoint this is time-to-first-token as the
@@ -289,107 +317,122 @@ class LoadBalancer:
             failure_threshold=int(os.environ.get(
                 'SKY_TPU_LB_BREAKER_THRESHOLD', '3')),
             cooldown_s=float(os.environ.get(
-                'SKY_TPU_LB_BREAKER_COOLDOWN_S', '10')))
+                'SKY_TPU_LB_BREAKER_COOLDOWN_S', '10')),
+            clock=self._clock.monotonic)
 
     # -- background sync ---------------------------------------------------
+    async def _offload(self, fn: Callable, *args):
+        """Run blocking state-DB / span-store work off the event loop.
+        Seam: the digital twin overrides this to run inline — its
+        sqlite lives on the sim thread and determinism forbids real
+        thread hops."""
+        return await asyncio.to_thread(fn, *args)
+
     async def _sync_loop(self) -> None:
         while self._running:
-            # The tick advances OUTSIDE the try: the staleness guard
-            # on the windowed gauges relies on it outrunning frozen
-            # rings even when the sync body itself fails (state-DB
-            # hiccup) — inside, a failing body would freeze counter
-            # and rings together and the phantom rate would survive.
-            self._sync_tick += 1
-            try:
-                info = await asyncio.to_thread(
-                    serve_state.ready_replica_info, self.service_name)
-                self.policy.set_replica_info(info)
-                self.policy.set_ready_replicas(list(info))
-                # Replicas that left the ready set drop their breaker
-                # state; a returning URL starts closed.
-                self.breaker.prune(info)
-                draining = await asyncio.to_thread(
-                    serve_state.get_replicas, self.service_name,
-                    [serve_state.ReplicaStatus.DRAINING])
-                self._draining_urls = sorted(
-                    r['url'] for r in draining if r['url'])
-                if hasattr(self.policy, 'set_target_qps_per_accelerator'):
-                    # Instance-aware policy: refresh the per-accelerator
-                    # QPS map from the (possibly updated) service spec.
-                    record = await asyncio.to_thread(
-                        serve_state.get_service, self.service_name)
-                    if record is not None:
-                        tq = ((record['spec'].get('replica_policy') or {})
-                              .get('target_qps_per_replica'))
-                        if isinstance(tq, dict):
-                            self.policy.set_target_qps_per_accelerator(tq)
-                # Engine queue-depth gauge: each ready replica's
-                # /metrics num_waiting (the scheduler backlog),
-                # fetched CONCURRENTLY so one slow/blackholed replica
-                # costs the tick max(timeouts), not their sum — a
-                # warming/dead replica simply has no gauge this tick.
-                async def _depth_of(url: str):
-                    try:
-                        async with self._session.get(
-                                url.rstrip('/') + '/metrics',
-                                timeout=aiohttp.ClientTimeout(
-                                    total=2)) as r:
-                            if r.status == 200:
-                                m = await r.json()
-                                # Decode-efficiency gauges ride the
-                                # same fetch: tokens/step (>1 under
-                                # speculative decoding) and the spec
-                                # acceptance stats the bench and
-                                # dashboards watch.
-                                eff = {
-                                    k: m.get(k) for k in (
-                                        'tokens_per_step',
-                                        'accepted_len_mean',
-                                        'spec_accept_rate',
-                                        # Raw counters ride along so
-                                        # the history tier can derive
-                                        # windowed RATES from deltas.
-                                        'decode_tokens',
-                                        'prefix_hits',
-                                        'prefix_misses',
-                                        'prefix_hit_rate')
-                                    if m.get(k) is not None}
-                                return url, int(
-                                    m.get('num_waiting') or 0), eff
-                    except (aiohttp.ClientError,
-                            asyncio.TimeoutError, ValueError,
-                            TypeError, OSError):
-                        pass
-                    return None
-                urls = list(self.policy.ready_urls)
-                fetched = (await asyncio.gather(
-                    *(_depth_of(u) for u in urls))
-                    if self._session is not None and urls else [])
-                rows = [row for row in fetched if row is not None]
-                self._replica_queue_depth = {
-                    url: depth for url, depth, _ in rows}
-                self._replica_decode_stats = {
-                    url: eff for url, _, eff in rows}
-                # Fleet history tier: one sample per replica per tick,
-                # bounded per replica; replicas leaving the ready set
-                # drop their ring (same lifetime rule as the breaker).
-                now = time.time()
-                for url, depth, eff in rows:
-                    ring = self._replica_history.get(url)
-                    if ring is None:
-                        ring = self._replica_history[url] = (
-                            collections.deque(maxlen=HISTORY_LEN))
-                    ring.append({'t': now, 'queue_depth': depth,
-                                 **eff})
-                    self._history_tick[url] = self._sync_tick
-                for url in list(self._replica_history):
-                    if url not in info:
-                        del self._replica_history[url]
-                        self._history_tick.pop(url, None)
-                await self._dump_breaker_edges()
-            except Exception:  # noqa: BLE001 — keep serving on DB hiccup
-                logger.warning('replica sync failed', exc_info=True)
-            await asyncio.sleep(SYNC_INTERVAL_S)
+            await self._sync_once()
+            await asyncio.sleep(self.sync_interval_s)
+
+    async def _sync_once(self) -> None:
+        """One replica-set sync tick (factored out of the loop so the
+        digital twin can drive ticks at virtual-time cadence)."""
+        # The tick advances OUTSIDE the try: the staleness guard
+        # on the windowed gauges relies on it outrunning frozen
+        # rings even when the sync body itself fails (state-DB
+        # hiccup) — inside, a failing body would freeze counter
+        # and rings together and the phantom rate would survive.
+        self._sync_tick += 1
+        try:
+            info = await self._offload(
+                serve_state.ready_replica_info, self.service_name)
+            self.policy.set_replica_info(info)
+            self.policy.set_ready_replicas(list(info))
+            # Replicas that left the ready set drop their breaker
+            # state; a returning URL starts closed.
+            self.breaker.prune(info)
+            draining = await self._offload(
+                serve_state.get_replicas, self.service_name,
+                [serve_state.ReplicaStatus.DRAINING])
+            self._draining_urls = sorted(
+                r['url'] for r in draining if r['url'])
+            if hasattr(self.policy, 'set_target_qps_per_accelerator'):
+                # Instance-aware policy: refresh the per-accelerator
+                # QPS map from the (possibly updated) service spec.
+                record = await self._offload(
+                    serve_state.get_service, self.service_name)
+                if record is not None:
+                    tq = ((record['spec'].get('replica_policy') or {})
+                          .get('target_qps_per_replica'))
+                    if isinstance(tq, dict):
+                        self.policy.set_target_qps_per_accelerator(tq)
+            rows = await self._fetch_all_metrics(
+                list(self.policy.ready_urls))
+            self._replica_queue_depth = {
+                url: depth for url, depth, _ in rows}
+            self._replica_decode_stats = {
+                url: eff for url, _, eff in rows}
+            # Fleet history tier: one sample per replica per tick,
+            # bounded per replica; replicas leaving the ready set
+            # drop their ring (same lifetime rule as the breaker).
+            now = self._clock.time()
+            for url, depth, eff in rows:
+                ring = self._replica_history.get(url)
+                if ring is None:
+                    ring = self._replica_history[url] = (
+                        collections.deque(maxlen=HISTORY_LEN))
+                ring.append({'t': now, 'queue_depth': depth,
+                             **eff})
+                self._history_tick[url] = self._sync_tick
+            for url in list(self._replica_history):
+                if url not in info:
+                    del self._replica_history[url]
+                    self._history_tick.pop(url, None)
+            await self._dump_breaker_edges()
+        except Exception:  # noqa: BLE001 — keep serving on DB hiccup
+            logger.warning('replica sync failed', exc_info=True)
+
+    async def _fetch_all_metrics(self, urls: List[str]) -> List[tuple]:
+        """Engine queue-depth gauge: each ready replica's /metrics
+        num_waiting (the scheduler backlog), fetched CONCURRENTLY so
+        one slow/blackholed replica costs the tick max(timeouts), not
+        their sum — a warming/dead replica simply has no gauge this
+        tick. Seam: the twin overrides this to read its modeled
+        replicas directly."""
+        if self._session is None or not urls:
+            return []
+        fetched = await asyncio.gather(
+            *(self._fetch_replica_metrics(u) for u in urls))
+        return [row for row in fetched if row is not None]
+
+    async def _fetch_replica_metrics(self, url: str) -> Optional[tuple]:
+        try:
+            async with self._session.get(
+                    url.rstrip('/') + '/metrics',
+                    timeout=aiohttp.ClientTimeout(total=2)) as r:
+                if r.status == 200:
+                    m = await r.json()
+                    # Decode-efficiency gauges ride the same fetch:
+                    # tokens/step (>1 under speculative decoding) and
+                    # the spec acceptance stats the bench and
+                    # dashboards watch.
+                    eff = {
+                        k: m.get(k) for k in (
+                            'tokens_per_step',
+                            'accepted_len_mean',
+                            'spec_accept_rate',
+                            # Raw counters ride along so the history
+                            # tier can derive windowed RATES from
+                            # deltas.
+                            'decode_tokens',
+                            'prefix_hits',
+                            'prefix_misses',
+                            'prefix_hit_rate')
+                        if m.get(k) is not None}
+                    return url, int(m.get('num_waiting') or 0), eff
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError,
+                TypeError, OSError):
+            pass
+        return None
 
     async def _dump_breaker_edges(self) -> None:
         """breaker_open anomaly: on a closed→open EDGE, snapshot the
@@ -404,6 +447,8 @@ class LoadBalancer:
         # dumps GC ordinary request traces out of the span store.
         open_now = {u for u, s in self.breaker.snapshot().items()
                     if s != retry_lib.STATE_CLOSED}
+        # (Wall reads below go through the clock seam so the twin's
+        # rate-limit arithmetic is deterministic.)
         # A breaker that closed re-arms its edge; open ones we have
         # already dumped stay consumed. Pending edges (rate-limited
         # earlier) stay owed even if the breaker closed meanwhile —
@@ -414,7 +459,7 @@ class LoadBalancer:
                     | self._breaker_pending)
         if not new_open:
             return
-        now = time.time()
+        now = self._clock.time()
         min_s = stepline_lib.dump_interval_s()
         if min_s > 0 and now - self._breaker_dump_at < min_s:
             # Deferred, not dropped: a second replica tripping inside
@@ -429,31 +474,35 @@ class LoadBalancer:
         spans = stepline_lib.fleet_history_spans(
             'breaker_open', {'replicas_open': sorted(new_open)},
             {u: list(r) for u, r in self._replica_history.items()})
-        await asyncio.to_thread(stepline_lib.write_dump_sync, spans)
+        await self._offload(stepline_lib.write_dump_sync, spans)
 
     async def _stats_loop(self) -> None:
         while self._running:
-            await asyncio.sleep(STATS_FLUSH_S)
-            n, self._pending_requests = self._pending_requests, 0
-            try:
-                if n:
-                    await asyncio.to_thread(
-                        serve_state.record_requests, self.service_name, n,
-                        time.time())
-                # In-flight gauge: the queue-depth signal for
-                # QueueLengthAutoscaler (requests accepted but not yet
-                # finished across all replicas).
-                await asyncio.to_thread(
-                    serve_state.set_inflight, self.service_name,
-                    self._inflight)
-                # Scheduler backlog inside the engines (summed
-                # num_waiting): lets QueueLengthAutoscaler scale on
-                # real queued work, not LB in-flight counts alone.
-                await asyncio.to_thread(
-                    serve_state.set_queue_depth, self.service_name,
-                    sum(self._replica_queue_depth.values()))
-            except Exception:  # noqa: BLE001
-                logger.warning('stats flush failed', exc_info=True)
+            await asyncio.sleep(self.stats_flush_s)
+            await self._flush_stats_once()
+
+    async def _flush_stats_once(self) -> None:
+        """One stats flush (factored out of the loop for the twin)."""
+        n, self._pending_requests = self._pending_requests, 0
+        try:
+            if n:
+                await self._offload(
+                    serve_state.record_requests, self.service_name, n,
+                    self._clock.time())
+            # In-flight gauge: the queue-depth signal for
+            # QueueLengthAutoscaler (requests accepted but not yet
+            # finished across all replicas).
+            await self._offload(
+                serve_state.set_inflight, self.service_name,
+                self._inflight)
+            # Scheduler backlog inside the engines (summed
+            # num_waiting): lets QueueLengthAutoscaler scale on
+            # real queued work, not LB in-flight counts alone.
+            await self._offload(
+                serve_state.set_queue_depth, self.service_name,
+                sum(self._replica_queue_depth.values()))
+        except Exception:  # noqa: BLE001
+            logger.warning('stats flush failed', exc_info=True)
 
     # -- request path ------------------------------------------------------
     # NOTE: JSON (not the API server's Prometheus registry) is
@@ -506,7 +555,7 @@ class LoadBalancer:
         newest = max((ring[-1]['t']
                       for ring in self._replica_history.values()
                       if ring), default=0.0)
-        stale_s = 3 * SYNC_INTERVAL_S
+        stale_s = 3 * self.sync_interval_s
         stale_ticks = 3
         for url, ring in self._replica_history.items():
             if len(ring) < 2:
@@ -545,7 +594,7 @@ class LoadBalancer:
         one row per sync tick per replica, oldest first."""
         return {
             'history_len': HISTORY_LEN,
-            'sync_interval_s': SYNC_INTERVAL_S,
+            'sync_interval_s': self.sync_interval_s,
             'replicas': {u: list(ring) for u, ring in
                          sorted(self._replica_history.items())},
         }
@@ -736,7 +785,7 @@ class LoadBalancer:
                 pending_gap = None
                 async for chunk in upstream.content.iter_chunked(
                         64 * 1024):
-                    now = time.monotonic()
+                    now = self._clock.monotonic()
                     if upstream_ok:
                         if first:
                             self._note_ttft(now - t_arrival, tenant)
@@ -753,7 +802,7 @@ class LoadBalancer:
                     except (ConnectionError, OSError) as e:
                         raise _ClientGone(e) from e
                 if first and upstream_ok:  # empty body: headers counted
-                    self._note_ttft(time.monotonic() - t_arrival,
+                    self._note_ttft(self._clock.monotonic() - t_arrival,
                                     tenant)
                 with contextlib.suppress(ConnectionError, OSError):
                     await resp.write_eof()
@@ -796,7 +845,7 @@ class LoadBalancer:
             obj = None
         if isinstance(obj, dict) and 'error' in obj:
             return None
-        now = time.monotonic()
+        now = self._clock.monotonic()
         if splice.first:
             self._note_ttft(now - t_arrival, splice.tenant)
             splice.first = False
@@ -945,7 +994,7 @@ class LoadBalancer:
         engine enforces the same wall-clock cutoff. None when replicas
         or budget ran out."""
         if t_deadline is not None:
-            remaining = t_deadline - time.monotonic()
+            remaining = t_deadline - self._clock.monotonic()
             if remaining <= 0:
                 return None
             headers[common.DEADLINE_HEADER] = f'{remaining:.3f}'
@@ -960,7 +1009,7 @@ class LoadBalancer:
         if request.path == '/-/metrics/history':
             return web.json_response(self.lb_history())
         self._requests_total += 1
-        t_arrival = time.monotonic()
+        t_arrival = self._clock.monotonic()
         # Body read comes FIRST: nothing is selected or counted yet, so
         # a client disconnecting mid-upload cannot leak the inflight
         # gauge or burn a half-open breaker probe slot.
@@ -1026,7 +1075,7 @@ class LoadBalancer:
                 # two once a replica recovers; tell clients when to
                 # come back instead of letting them hammer.
                 headers={'Retry-After': str(max(
-                    1, int(SYNC_INTERVAL_S * 2)))},
+                    1, int(self.sync_interval_s * 2)))},
                 text=f'No ready replicas for service '
                      f'{self.service_name!r}. Use `sky-tpu serve status` '
                      f'to check replica health.\n')
@@ -1146,7 +1195,7 @@ class LoadBalancer:
                     body=saturated.body or b'',
                     headers=saturated.headers)
             if (t_deadline is not None
-                    and time.monotonic() >= t_deadline):
+                    and self._clock.monotonic() >= t_deadline):
                 self._requests_failed += 1
                 return web.Response(
                     status=504,
@@ -1168,6 +1217,18 @@ class LoadBalancer:
         app.router.add_route('*', '/{tail:.*}', self.handle)
         return app
 
+    def stop(self) -> None:
+        """Request shutdown: wakes run() out of its idle wait
+        immediately (thread-safe — the controller thread calls this
+        when its own loop exits)."""
+        self._running = False
+        loop, event = self._loop, self._stop_event
+        if loop is not None and event is not None:
+            try:
+                loop.call_soon_threadsafe(event.set)
+            except RuntimeError:
+                pass   # loop already closed: run() is past the wait
+
     async def run(self, host: str, port: int,
                   ssl_context=None) -> None:
         self._session = aiohttp.ClientSession(
@@ -1179,11 +1240,17 @@ class LoadBalancer:
         logger.info('service %s: load balancer on %s://%s:%d',
                     self.service_name,
                     'https' if ssl_context else 'http', host, port)
+        self._loop = asyncio.get_running_loop()
+        self._stop_event = asyncio.Event()
         tasks = [asyncio.create_task(self._sync_loop()),
                  asyncio.create_task(self._stats_loop())]
         try:
+            # Event-driven idle: stop() ends the LB the moment it is
+            # called instead of after a 0.2s poll interval (and the
+            # loop no longer wakes 5x/s for nothing).
             while self._running:
-                await asyncio.sleep(0.2)
+                await self._stop_event.wait()
+                self._stop_event.clear()
         finally:
             for t in tasks:
                 t.cancel()

@@ -18,24 +18,20 @@ import concurrent.futures
 import json
 import logging
 import os
-import time
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
 import yaml
 
-from skypilot_tpu import execution
-from skypilot_tpu import provision
-from skypilot_tpu import state as global_state
 from skypilot_tpu import task as task_lib
-from skypilot_tpu.provision.common import ClusterInfo
 from skypilot_tpu.serve import spec as spec_lib
 from skypilot_tpu.serve import spot_placer as spot_placer_lib
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.serve.state import ReplicaStatus
 from skypilot_tpu.utils import common
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import vclock
 
 logger = logging.getLogger(__name__)
 
@@ -80,6 +76,108 @@ def drain_replica(url: str, deadline_s: float) -> Optional[dict]:
         return None
 
 
+class CloudAdapter:
+    """The provider seam: every call the replica manager makes that
+    leaves the process — cluster launch/teardown, readiness probes,
+    provider-plane liveness, preemption notices, the drain long-poll —
+    goes through one of these methods. The default implementation is
+    the real thing (``execution.launch``, ``provision.*``, urllib
+    probes); the fleet digital twin (``skypilot_tpu/sim/``) substitutes
+    a virtual cloud so the REAL lifecycle state machine in
+    :class:`ReplicaManager` runs against modeled slices in virtual
+    time (docs/robustness.md "Digital twin").
+
+    Stateless by design — all replica state stays in the serve state
+    DB and the manager's own maps, so swapping the adapter never
+    changes what the controller believes."""
+
+    def launch(self, task: task_lib.Task, cluster_name: str,
+               blocked_placements, avoid_placements=None):
+        """Provision the slice; returns the ``ClusterInfo``-shaped
+        object (``.head``, ``.region``, ``.zone``, ``.tpu_slice``).
+        ``blocked_placements`` are hard (preemption cooldowns),
+        ``avoid_placements`` soft (spreading) — see SpotPlacer."""
+        from skypilot_tpu import execution
+        _, info = execution.launch(task, cluster_name,
+                                   blocked_placements=blocked_placements,
+                                   avoid_placements=avoid_placements)
+        return info
+
+    def probe_url(self, url: str, probe: spec_lib.ReadinessProbe) -> bool:
+        full = url.rstrip('/') + probe.path
+        try:
+            with urllib.request.urlopen(
+                    full, timeout=probe.timeout_seconds) as resp:
+                return 200 <= resp.status < 300
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    def probe_pool_worker(self, cluster_name: str,
+                          timeout_s: float) -> bool:
+        """Pool readiness: every host agent of the worker slice answers
+        /health (a gang worker with one dead host can't run a job)."""
+        from skypilot_tpu import state as global_state
+        from skypilot_tpu.provision.common import ClusterInfo
+        from skypilot_tpu.runtime import agent_client
+        record = global_state.get_cluster(cluster_name)
+        if record is None or not record.get('cluster_info'):
+            return False
+        info = ClusterInfo.from_dict(record['cluster_info'])
+        try:
+            for i in range(len(info.hosts)):
+                agent_client.AgentClient.for_info(
+                    info, timeout=timeout_s, host=i).health()
+            return True
+        except Exception:  # noqa: BLE001 — any failure = not ready
+            return False
+
+    def provider_alive(self, cluster_name: str) -> Optional[bool]:
+        """True/False = provider verdict; None = no cluster record."""
+        from skypilot_tpu import provision
+        from skypilot_tpu import state as global_state
+        from skypilot_tpu.provision.common import ClusterInfo
+        record = global_state.get_cluster(cluster_name)
+        if record is None or not record.get('cluster_info'):
+            return None
+        return provision.probe_cluster_running(
+            ClusterInfo.from_dict(record['cluster_info']))
+
+    def preemption_notice(self, cluster_name: str) -> bool:
+        """The provider's advance warning that it is about to reclaim
+        the slice (``provision.probe_preemption_notice``; the
+        ``jobs.provider.preemption_notice`` failpoint fires inside)."""
+        from skypilot_tpu import provision
+        from skypilot_tpu import state as global_state
+        from skypilot_tpu.provision.common import ClusterInfo
+        record = global_state.get_cluster(cluster_name)
+        if record is None or not record.get('cluster_info'):
+            return False
+        return provision.probe_preemption_notice(
+            ClusterInfo.from_dict(record['cluster_info']))
+
+    def drain(self, url: str, deadline_s: float) -> Optional[dict]:
+        return drain_replica(url, deadline_s)
+
+    def terminate(self, cluster_name: str) -> None:
+        """Tear the slice down (already-gone is success) and drop its
+        cluster record."""
+        from skypilot_tpu import provision
+        from skypilot_tpu import state as global_state
+        from skypilot_tpu.provision.common import ClusterInfo
+        record = global_state.get_cluster(cluster_name)
+        if record is None:
+            return
+        if record.get('cluster_info'):
+            info = ClusterInfo.from_dict(record['cluster_info'])
+            try:
+                provision.terminate_instances(info.cloud, cluster_name,
+                                              info.provider_config)
+            except Exception:  # noqa: BLE001 — already-gone is success
+                logger.warning('terminate %s: provider call failed',
+                               cluster_name, exc_info=True)
+        global_state.remove_cluster(cluster_name)
+
+
 class ReplicaManager:
     """Owns the replica set of one service."""
 
@@ -95,12 +193,19 @@ class ReplicaManager:
     }
 
     def __init__(self, service_name: str, spec: spec_lib.ServiceSpec,
-                 task_yaml: str) -> None:
+                 task_yaml: str, *,
+                 cloud: Optional[CloudAdapter] = None,
+                 executor=None) -> None:
         self.service_name = service_name
         self.spec = spec
         self.task_yaml = task_yaml
         self.spot_placer = spot_placer_lib.SpotPlacer(service_name)
-        self._pool = concurrent.futures.ThreadPoolExecutor(
+        # Provider + executor seams: production gets the real cloud and
+        # a thread pool (launches must not block the controller tick);
+        # the digital twin injects a virtual cloud and a deterministic
+        # executor that runs work as ordered virtual-time events.
+        self.cloud = cloud or CloudAdapter()
+        self._pool = executor or concurrent.futures.ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f'serve-{service_name}')
         self._launching: Dict[int, concurrent.futures.Future] = {}
         self._terminating: Dict[int, concurrent.futures.Future] = {}
@@ -154,10 +259,12 @@ class ReplicaManager:
 
     def _do_launch(self, replica_id: int, cluster_name: str,
                    task: task_lib.Task, port: int) -> None:
-        blocked = (self.spot_placer.blocked_placements()
-                   if task.resources.use_spot else None)
-        _, info = execution.launch(task, cluster_name,
-                                   blocked_placements=blocked)
+        blocked = avoid = None
+        if task.resources.use_spot:
+            blocked = self.spot_placer.preempted_placements()
+            avoid = self.spot_placer.spread_placements()
+        info = self.cloud.launch(task, cluster_name, blocked,
+                                 avoid_placements=avoid)
         if self.spec.pool:
             # Readiness for a worker is its agent plane, not a workload
             # port — record the head agent URL for observability.
@@ -177,7 +284,7 @@ class ReplicaManager:
         conn.execute(
             'UPDATE replicas SET zone = ?, starting_at = ? '
             'WHERE replica_id = ?',
-            (f'{info.region}/{info.zone}', time.time(), replica_id))
+            (f'{info.region}/{info.zone}', vclock.now(), replica_id))
         conn.commit()
         serve_state.set_replica_status(replica_id, ReplicaStatus.STARTING)
 
@@ -228,25 +335,16 @@ class ReplicaManager:
                 pass
         if drain_url:
             deadline = _drain_deadline_s()
-            t0 = time.time()
-            report = drain_replica(drain_url, deadline)
+            t0 = vclock.now()
+            report = self.cloud.drain(drain_url, deadline)
             logger.info(
                 'replica %d: drain %s in %.1fs (deadline %.0fs)',
                 replica_id,
                 (report or {}).get('status', 'unreachable'),
-                time.time() - t0, deadline)
+                vclock.now() - t0, deadline)
             serve_state.set_replica_status(replica_id,
                                            ReplicaStatus.SHUTTING_DOWN)
-        record = global_state.get_cluster(cluster_name)
-        if record is not None and record.get('cluster_info'):
-            info = ClusterInfo.from_dict(record['cluster_info'])
-            try:
-                provision.terminate_instances(info.cloud, cluster_name,
-                                              info.provider_config)
-            except Exception:  # noqa: BLE001 — already-gone is success
-                logger.warning('terminate %s: provider call failed',
-                               cluster_name, exc_info=True)
-            global_state.remove_cluster(cluster_name)
+        self.cloud.terminate(cluster_name)
         serve_state.remove_replica(replica_id)
 
     def terminate_all(self) -> None:
@@ -271,68 +369,58 @@ class ReplicaManager:
                              self._terminating.items() if not f.done()}
 
     # -- health ------------------------------------------------------------
-    def _probe_url(self, url: str) -> bool:
-        probe = self.spec.readiness_probe
-        full = url.rstrip('/') + probe.path
-        try:
-            with urllib.request.urlopen(
-                    full, timeout=probe.timeout_seconds) as resp:
-                return 200 <= resp.status < 300
-        except (urllib.error.URLError, OSError, ValueError):
-            return False
-
-    def _probe_pool_worker(self, cluster_name: str) -> bool:
-        """Pool readiness: every host agent of the worker slice answers
-        /health (a gang worker with one dead host can't run a job)."""
-        from skypilot_tpu.runtime import agent_client
-        record = global_state.get_cluster(cluster_name)
-        if record is None or not record.get('cluster_info'):
-            return False
-        info = ClusterInfo.from_dict(record['cluster_info'])
-        timeout = self.spec.readiness_probe.timeout_seconds
-        try:
-            for i in range(len(info.hosts)):
-                agent_client.AgentClient.for_info(
-                    info, timeout=timeout, host=i).health()
-            return True
-        except Exception:  # noqa: BLE001 — any failure = not ready
-            return False
-
     def _probe(self, replica: dict) -> bool:
         # Chaos seam: `serve.probe=error:1@N` fails the next N readiness
         # probes (driving NOT_READY / replacement without touching the
-        # replica); `delay` simulates a slow health endpoint.
+        # replica); `delay` simulates a slow health endpoint. The site
+        # stays HERE — in front of the adapter — so failpoint chaos and
+        # the virtual cloud compose.
         try:
             failpoints.hit('serve.probe')
         except failpoints.FailpointError:
             return False
         if self.spec.pool:
-            return self._probe_pool_worker(replica['cluster_name'])
-        return self._probe_url(replica['url'])
+            return self.cloud.probe_pool_worker(
+                replica['cluster_name'],
+                self.spec.readiness_probe.timeout_seconds)
+        return self.cloud.probe_url(replica['url'],
+                                    self.spec.readiness_probe)
 
     def _provider_alive(self, cluster_name: str) -> Optional[bool]:
         """True/False = provider verdict; None = no cluster record."""
-        record = global_state.get_cluster(cluster_name)
-        if record is None or not record.get('cluster_info'):
-            return None
-        return provision.probe_cluster_running(
-            ClusterInfo.from_dict(record['cluster_info']))
+        return self.cloud.provider_alive(cluster_name)
 
     def _preemption_notice(self, cluster_name: str) -> bool:
         """Forward-looking sibling of the jobs-layer preemption
         predicate: the provider's advance warning that it is about to
         reclaim the slice (provision.probe_preemption_notice)."""
-        record = global_state.get_cluster(cluster_name)
-        if record is None or not record.get('cluster_info'):
-            return False
-        return provision.probe_preemption_notice(
-            ClusterInfo.from_dict(record['cluster_info']))
+        return self.cloud.preemption_notice(cluster_name)
 
     # -- the tick ----------------------------------------------------------
-    def sync(self) -> None:
+    def _mark(self, r: dict, status: 'ReplicaStatus',
+              reason: Optional[str] = None) -> None:
+        """Write ``status`` to the DB AND stamp the in-memory row in
+        ONE step. sync() returns its rows straight to the controller
+        tick, so a DB write without the mirror would desync the
+        autoscaler's live count for a tick — coupling them here makes
+        the invariant structural instead of copy-paste."""
+        serve_state.set_replica_status(r['replica_id'], status, reason)
+        r['status'] = status
+
+    def _terminate_marked(self, r: dict, reason: str) -> None:
+        """terminate_replica + row mirror. The teardown is mirrored as
+        SHUTTING_DOWN — terminate_replica may write DRAINING first,
+        but either way the replica leaves the live set this tick."""
+        self.terminate_replica(r['replica_id'], reason)
+        r['status'] = ReplicaStatus.SHUTTING_DOWN
+
+    def sync(self, now: Optional[float] = None) -> List[dict]:
         """One controller tick: reap launches, probe readiness, detect
-        preemption/failure."""
-        now = time.time()
+        preemption/failure. Returns the replica rows with this sync's
+        status decisions applied — the controller consumes them
+        directly, so a 1000-replica fleet pays ONE table scan per
+        tick, not two."""
+        now = vclock.now() if now is None else now
         # Reap finished launch futures.
         for rid, fut in list(self._launching.items()):
             if not fut.done():
@@ -348,7 +436,8 @@ class ReplicaManager:
                 self.launch_failures = 0
         self.wait_terminations(timeout=0)
 
-        for r in serve_state.get_replicas(self.service_name):
+        rows = serve_state.get_replicas(self.service_name)
+        for r in rows:
             rid, status = r['replica_id'], r['status']
             if status in (ReplicaStatus.PENDING,
                           ReplicaStatus.PROVISIONING,
@@ -363,7 +452,7 @@ class ReplicaManager:
                 # a substitute to hold the target count.
                 serve_state.consume_restart_request(rid)
                 logger.info('replica %d: restart requested', rid)
-                self.terminate_replica(rid, 'restart requested')
+                self._terminate_marked(r, 'restart requested')
                 continue
             # STARTING / READY / NOT_READY: check provider plane first.
             alive = self._provider_alive(r['cluster_name'])
@@ -372,8 +461,8 @@ class ReplicaManager:
                 region, _, zone = (r['zone'] or '/').partition('/')
                 if r['is_spot']:
                     self.spot_placer.report_preemption(region, zone)
-                serve_state.set_replica_status(
-                    rid, ReplicaStatus.PREEMPTED, 'slice not RUNNING')
+                self._mark(r, ReplicaStatus.PREEMPTED,
+                           'slice not RUNNING')
                 # Clean up the carcass asynchronously.
                 self._pool.submit(self._cleanup_carcass,
                                   r['cluster_name'])
@@ -391,7 +480,7 @@ class ReplicaManager:
                 logger.info(
                     'replica %d: preemption notice; draining for a '
                     'planned handoff', rid)
-                self.terminate_replica(rid, 'preemption notice')
+                self._terminate_marked(r, 'preemption notice')
                 continue
             if not r['url'] and not self.spec.pool:
                 continue
@@ -405,9 +494,9 @@ class ReplicaManager:
                     self._probe_ok_streak[rid] = streak
                     if (streak >=
                             self.spec.readiness_probe.success_threshold):
-                        serve_state.set_replica_status(
-                            rid, ReplicaStatus.READY)
-                        serve_state.reset_replica_failures(rid)
+                        self._mark(r, ReplicaStatus.READY)
+                        if r['consecutive_failures']:
+                            serve_state.reset_replica_failures(rid)
                         logger.info('replica %d: READY', rid)
                 else:
                     self._probe_ok_streak[rid] = 0
@@ -415,23 +504,29 @@ class ReplicaManager:
                         fails = serve_state.bump_replica_failures(rid)
                         if (fails >=
                                 self.spec.readiness_probe.failure_threshold):
-                            serve_state.set_replica_status(
-                                rid, ReplicaStatus.FAILED,
-                                'readiness probe never succeeded')
+                            self._mark(r, ReplicaStatus.FAILED,
+                                       'readiness probe never succeeded')
+                            # The mirror stays FAILED (terminate may
+                            # write DRAINING to the DB, but this tick
+                            # counts the replica as failed, not
+                            # draining).
                             self.terminate_replica(rid, 'probe timeout')
             elif status in (ReplicaStatus.READY, ReplicaStatus.NOT_READY):
                 if probe_ok:
                     if status == ReplicaStatus.NOT_READY:
-                        serve_state.set_replica_status(
-                            rid, ReplicaStatus.READY)
-                    serve_state.reset_replica_failures(rid)
+                        self._mark(r, ReplicaStatus.READY)
+                    # Healthy steady state is the overwhelmingly common
+                    # case: skip the per-replica UPDATE when the
+                    # counter is already zero (1000 no-op writes per
+                    # tick is real money at fleet scale).
+                    if r['consecutive_failures']:
+                        serve_state.reset_replica_failures(rid)
                 else:
                     fails = serve_state.bump_replica_failures(rid)
                     threshold = self.spec.readiness_probe.failure_threshold
                     if fails >= threshold and status == ReplicaStatus.READY:
-                        serve_state.set_replica_status(
-                            rid, ReplicaStatus.NOT_READY,
-                            'readiness probes failing')
+                        self._mark(r, ReplicaStatus.NOT_READY,
+                                   'readiness probes failing')
                     elif fails >= threshold * NOT_READY_TERMINATE_FACTOR:
                         if self.spec.pool and r.get('assigned_job'):
                             # Never tear a worker out from under its
@@ -445,29 +540,17 @@ class ReplicaManager:
                         logger.warning(
                             'replica %d: unhealthy for %d probes; '
                             'replacing', rid, fails)
-                        self.terminate_replica(rid, 'unhealthy too long')
+                        self._terminate_marked(r, 'unhealthy too long')
+        return rows
 
     def _cleanup_carcass(self, cluster_name: str) -> None:
-        record = global_state.get_cluster(cluster_name)
-        if record is None:
-            return
-        if record.get('cluster_info'):
-            info = ClusterInfo.from_dict(record['cluster_info'])
-            try:
-                provision.terminate_instances(info.cloud, cluster_name,
-                                              info.provider_config)
-            except Exception:  # noqa: BLE001
-                pass
-        global_state.remove_cluster(cluster_name)
+        self.cloud.terminate(cluster_name)
 
     # -- views -------------------------------------------------------------
     def live_replicas(self) -> List[dict]:
         """Replicas that count toward the target (not terminal/shutting)."""
-        return serve_state.get_replicas(
-            self.service_name,
-            [ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
-             ReplicaStatus.STARTING, ReplicaStatus.READY,
-             ReplicaStatus.NOT_READY])
+        return serve_state.get_replicas(self.service_name,
+                                        list(ReplicaStatus.live()))
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)

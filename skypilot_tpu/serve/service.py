@@ -49,7 +49,7 @@ def run_service(service_name: str) -> None:
         try:
             ctl.run()
         finally:
-            lb._running = False  # noqa: SLF001 — shutdown signal
+            lb.stop()            # wakes the LB's idle wait immediately
             os._exit(0)          # controller done ⇒ service process done
 
     t = threading.Thread(target=controller_thread, daemon=True,

@@ -3,18 +3,25 @@
 Counterpart of the reference's ``sky/serve/spot_placer.py`` — spot
 capacity reclaims are zone-correlated, so spreading replicas over zones
 bounds the blast radius of one reclaim. Implementation detail that
-differs: rather than rewriting the task's zone, the placer emits a
-*blocked placement list* for ``execution.launch`` — the same mechanism
+differs: rather than rewriting the task's zone, the placer emits
+*blocked placement lists* for ``execution.launch`` — the same mechanism
 the failover loop already honors — steering the optimizer's best-first
 candidate order away from zones that already host (or recently lost)
 replicas of this service.
+
+Two tiers, relaxed independently by the launch path: HARD preemption
+cooldowns (``preempted_placements``) survive the all-blocked fallback
+that SOFT spreading blocks (``spread_placements``) do not — otherwise a
+fleet already spanning every zone would relax BOTH at once and happily
+relaunch into the zone that just burned (the regional-failover twin
+scenario pins this).
 """
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional, Tuple
 
 from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.utils import vclock
 
 # A zone that preempted a replica is avoided for this long.
 PREEMPTION_COOLDOWN_S = 600.0
@@ -29,23 +36,28 @@ class SpotPlacer:
                           zone: Optional[str]) -> None:
         if zone is None:
             return
-        self._preempted_at[(region or '', zone)] = time.time()
+        self._preempted_at[(region or '', zone)] = vclock.now()
 
-    def blocked_placements(self) -> List[Tuple[str, str]]:
-        """Zones to steer away from: active-replica zones + recently
-        preempted zones. launch() falls back to the full candidate list
-        if everything is blocked, so this can never strand a launch."""
-        now = time.time()
-        blocked: List[Tuple[str, str]] = [
-            k for k, t in self._preempted_at.items()
-            if now - t < PREEMPTION_COOLDOWN_S]
-        active = serve_state.get_replicas(
-            self.service_name,
-            [serve_state.ReplicaStatus.PROVISIONING,
-             serve_state.ReplicaStatus.STARTING,
-             serve_state.ReplicaStatus.READY])
-        for r in active:
-            if r['zone']:
-                region, _, zone = r['zone'].partition('/')
-                blocked.append((region, zone))
+    def preempted_placements(self) -> List[Tuple[str, str]]:
+        """HARD blocks: zones inside their preemption cooldown. Relaxed
+        by the launch path only when every candidate is blocked (the
+        capacity-moved-on fallback) — NOT when merely spreading would
+        strand the launch, so a zone-wide reclaim can never win a
+        relaunch just because the surviving zones already host
+        replicas."""
+        now = vclock.now()
+        return [k for k, t in self._preempted_at.items()
+                if now - t < PREEMPTION_COOLDOWN_S]
+
+    def spread_placements(self) -> List[Tuple[str, str]]:
+        """SOFT blocks: zones already hosting replicas of this service
+        (de-correlation). Best-effort — the launch path drops these
+        first when they would otherwise strand the launch."""
+        blocked: List[Tuple[str, str]] = []
+        # Distinct zones via sqlite aggregation — a launch during a
+        # 1000-replica storm must not pay a full replica-table scan
+        # just to learn the ~3 zones already in use.
+        for z in serve_state.active_zones(self.service_name):
+            region, _, zone = z.partition('/')
+            blocked.append((region, zone))
         return blocked

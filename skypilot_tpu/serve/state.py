@@ -13,11 +13,11 @@ import enum
 import json
 import os
 import sqlite3
-import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import common
 from skypilot_tpu.utils import db as db_util
+from skypilot_tpu.utils import vclock
 
 
 class ServiceStatus(enum.Enum):
@@ -48,6 +48,16 @@ class ReplicaStatus(enum.Enum):
 
     def is_terminal(self) -> bool:
         return self in (ReplicaStatus.FAILED,)
+
+    @classmethod
+    def live(cls) -> 'tuple':
+        """The ONE definition of "counts toward the target": not
+        terminal, not on the way out. Shared by the replica manager's
+        live set and the controller tick's filter. (The spot placer's
+        ``active_zones`` query deliberately uses the narrower
+        placed-somewhere subset — PENDING has no zone yet.)"""
+        return (cls.PENDING, cls.PROVISIONING, cls.STARTING,
+                cls.READY, cls.NOT_READY)
 
     def is_launching(self) -> bool:
         return self in (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
@@ -156,7 +166,7 @@ def add_service(name: str, spec_json: str, task_yaml: str, lb_port: int,
             'version, lb_port, lb_policy, requested_at, pool) '
             'VALUES (?,?,?,?,1,?,?,?,?)',
             (name, ServiceStatus.CONTROLLER_INIT.value, spec_json,
-             task_yaml, lb_port, lb_policy, time.time(), int(pool)))
+             task_yaml, lb_port, lb_policy, vclock.now(), int(pool)))
         conn.commit()
         return True
     except sqlite3.IntegrityError:
@@ -267,7 +277,7 @@ def add_replica(service_name: str, cluster_name: str, version: int,
         'INSERT INTO replicas (service_name, cluster_name, status, '
         'version, is_spot, zone, launched_at) VALUES (?,?,?,?,?,?,?)',
         (service_name, cluster_name, ReplicaStatus.PENDING.value, version,
-         int(is_spot), zone, time.time()))
+         int(is_spot), zone, vclock.now()))
     conn.commit()
     return int(cur.lastrowid)
 
@@ -276,16 +286,23 @@ def set_replica_status(replica_id: int, status: ReplicaStatus,
                        failure_reason: Optional[str] = None) -> None:
     conn = _db().conn
     extra = ''
+    args: List[Any] = [status.value, failure_reason]
+    # Transition stamps come from the clock seam (not sqlite's
+    # strftime) so a virtual-time replay writes virtual timestamps —
+    # scale-down victim ordering and readiness ages stay meaningful
+    # inside the digital twin.
     if status == ReplicaStatus.READY:
-        extra = ', ready_at = COALESCE(ready_at, strftime("%s","now"))'
+        extra = ', ready_at = COALESCE(ready_at, ?)'
+        args.append(vclock.now())
     elif status in (ReplicaStatus.SHUTTING_DOWN, ReplicaStatus.FAILED,
                     ReplicaStatus.PREEMPTED):
-        extra = ', terminated_at = COALESCE(terminated_at, ' \
-                'strftime("%s","now"))'
+        extra = ', terminated_at = COALESCE(terminated_at, ?)'
+        args.append(vclock.now())
+    args.append(replica_id)
     conn.execute(
         f'UPDATE replicas SET status = ?, failure_reason = '
         f'COALESCE(?, failure_reason){extra} WHERE replica_id = ?',
-        (status.value, failure_reason, replica_id))
+        args)
     conn.commit()
 
 
@@ -339,9 +356,19 @@ def bump_replica_failures(replica_id: int) -> int:
 
 def reset_replica_failures(replica_id: int) -> None:
     conn = _db().conn
+    # No-op guard: the controller calls this for EVERY healthy READY
+    # replica EVERY tick, and the common case is already-zero. Skipping
+    # the write (and the commit) keeps a 1000-replica fleet's tick from
+    # paying 1000 journal flushes for nothing.
     conn.execute(
         'UPDATE replicas SET consecutive_failures = 0 '
-        'WHERE replica_id = ?', (replica_id,))
+        'WHERE replica_id = ? AND consecutive_failures != 0',
+        (replica_id,))
+    # Commit unconditionally: a 0-row UPDATE still opened sqlite's
+    # implicit deferred transaction, and leaving it open pins a stale
+    # read snapshot on this connection (and blocks WAL checkpointing)
+    # until some unrelated commit. A no-write commit is nearly free —
+    # the journal-flush saving comes from the WHERE clause above.
     conn.commit()
 
 
@@ -386,6 +413,22 @@ def ready_replica_info(service_name: str) -> Dict[str, Dict[str, Any]]:
             for r in rows if r['url']}
 
 
+def active_zones(service_name: str) -> List[str]:
+    """Distinct zones currently hosting (or about to host) replicas —
+    the spot placer's anti-affinity input. Aggregated in sqlite so a
+    1000-replica fleet answers in a handful of rows instead of a full
+    replica scan per launch."""
+    statuses = [s.value for s in (ReplicaStatus.PROVISIONING,
+                                  ReplicaStatus.STARTING,
+                                  ReplicaStatus.READY)]
+    rows = _db().conn.execute(
+        f'SELECT DISTINCT zone FROM replicas WHERE service_name = ? '
+        f"AND status IN ({','.join('?' * len(statuses))}) "
+        f'AND zone IS NOT NULL',
+        (service_name, *statuses)).fetchall()
+    return [r['zone'] for r in rows]
+
+
 def set_replica_accelerator(replica_id: int,
                             accelerator: Optional[str]) -> None:
     conn = _db().conn
@@ -394,9 +437,16 @@ def set_replica_accelerator(replica_id: int,
     conn.commit()
 
 
+# Enum.__call__ costs ~1µs of descriptor machinery; a value->member
+# map is a dict hit. At fleet scale (1000-replica scans every
+# controller tick / LB sync) the difference is whole seconds per
+# simulated day.
+_REPLICA_STATUS_BY_VALUE = {s.value: s for s in ReplicaStatus}
+
+
 def _replica_row(row: sqlite3.Row) -> Dict[str, Any]:
     d = dict(row)
-    d['status'] = ReplicaStatus(d['status'])
+    d['status'] = _REPLICA_STATUS_BY_VALUE[d['status']]
     d['is_spot'] = bool(d['is_spot'])
     return d
 
@@ -464,7 +514,7 @@ def record_requests(service_name: str, num: int,
     conn.execute(
         'INSERT INTO lb_stats (service_name, window_start, num_requests) '
         'VALUES (?,?,?)',
-        (service_name, window_start or time.time(), num))
+        (service_name, window_start or vclock.now(), num))
     conn.commit()
 
 
@@ -484,7 +534,7 @@ def set_inflight(service_name: str, inflight: int) -> None:
         'INSERT INTO lb_gauges (service_name, updated_at, inflight) '
         'VALUES (?,?,?) ON CONFLICT(service_name) DO UPDATE SET '
         'updated_at=excluded.updated_at, inflight=excluded.inflight',
-        (service_name, time.time(), inflight))
+        (service_name, vclock.now(), inflight))
     conn.commit()
 
 
@@ -494,7 +544,7 @@ def get_inflight(service_name: str,
     row = _db().conn.execute(
         'SELECT inflight, updated_at FROM lb_gauges WHERE '
         'service_name = ?', (service_name,)).fetchone()
-    if row is None or time.time() - row['updated_at'] > max_age_s:
+    if row is None or vclock.now() - row['updated_at'] > max_age_s:
         return 0
     return int(row['inflight'])
 
@@ -510,7 +560,7 @@ def set_queue_depth(service_name: str, queue_depth: int) -> None:
         'VALUES (?,?,?) ON CONFLICT(service_name) DO UPDATE SET '
         'updated_at=excluded.updated_at, '
         'queue_depth=excluded.queue_depth',
-        (service_name, time.time(), queue_depth))
+        (service_name, vclock.now(), queue_depth))
     conn.commit()
 
 
@@ -520,7 +570,7 @@ def get_queue_depth(service_name: str,
     row = _db().conn.execute(
         'SELECT queue_depth, updated_at FROM lb_gauges WHERE '
         'service_name = ?', (service_name,)).fetchone()
-    if row is None or time.time() - row['updated_at'] > max_age_s:
+    if row is None or vclock.now() - row['updated_at'] > max_age_s:
         return 0
     return int(row['queue_depth'] or 0)
 

@@ -1,0 +1,66 @@
+"""``python -m skypilot_tpu.sim`` — run a digital-twin scenario.
+
+The ``make sim-smoke`` entry: replays a scenario, prints the summary
+and gate-relevant rollups, exits non-zero on client-visible errors or
+a determinism violation (``--verify-determinism`` replays twice and
+compares decision logs byte for byte).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from skypilot_tpu.sim import SCENARIOS, DigitalTwin
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description='fleet digital twin (docs/robustness.md)')
+    parser.add_argument('--scenario', default='reclaim_storm',
+                        choices=sorted(SCENARIOS))
+    parser.add_argument('--seed', type=int, default=1)
+    parser.add_argument('--replicas', type=int, default=None,
+                        help='override the scenario fleet size')
+    parser.add_argument('--verify-determinism', action='store_true',
+                        help='replay twice, compare decision logs')
+    parser.add_argument('--json', dest='json_out', default=None,
+                        help='write the full report JSON here')
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.ERROR)
+
+    kwargs = {}
+    if args.replicas is not None:
+        kwargs['replicas'] = args.replicas
+    scenario = SCENARIOS[args.scenario](**kwargs)
+    report = DigitalTwin(scenario, seed=args.seed).run()
+    summary = report.summary()
+    print(json.dumps(summary, indent=1))
+
+    rc = 0
+    if report.client_errors:
+        print(f'FAIL: {len(report.client_errors)} client-visible '
+              f'error(s); first: {report.client_errors[0]}',
+              file=sys.stderr)
+        rc = 1
+    if args.verify_determinism:
+        again = DigitalTwin(SCENARIOS[args.scenario](**kwargs),
+                            seed=args.seed).run()
+        if (again.decision_log_jsonl()
+                != report.decision_log_jsonl()):
+            print('FAIL: same seed produced a different decision log',
+                  file=sys.stderr)
+            rc = 1
+        else:
+            print(f'determinism: OK '
+                  f'({len(report.decisions)} decisions identical)')
+    if args.json_out:
+        with open(args.json_out, 'w', encoding='utf-8') as f:
+            json.dump({'summary': summary,
+                       'decisions': report.decisions}, f, indent=1)
+    return rc
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,225 @@
+"""Virtual cloud + deterministic executor behind the RM's seams.
+
+The REAL ``ReplicaManager`` lifecycle state machine (PENDING →
+PROVISIONING → STARTING → READY, preemption-notice drains, probe
+streaks, carcass cleanup) runs unmodified; this module supplies its
+two injection points:
+
+- :class:`SimExecutor` replaces the launch/teardown thread pool with
+  kernel events — work still runs "asynchronously" w.r.t. the
+  controller tick (it is a later event at the same virtual instant),
+  but in a deterministic order on one thread.
+- :class:`VirtualCloud` implements ``CloudAdapter``: launches model a
+  provisioning delay (probes fail until the slice is "up"), zone
+  placement honors the spot placer's blocked list (so
+  regional-failover scenarios prove relaunches avoid the dead zone),
+  and the fault API (``reclaim``, ``zone_outage``) feeds storms.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import random
+from types import SimpleNamespace
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.sim import kernel as kernel_lib
+from skypilot_tpu.sim import replica as replica_lib
+
+
+class SimExecutor:
+    """``concurrent.futures``-shaped executor whose submissions run as
+    kernel events. Real ``Future`` objects are returned so the replica
+    manager's ``fut.done()`` / ``fut.exception()`` reaping works
+    untouched."""
+
+    def __init__(self, kern: kernel_lib.Kernel) -> None:
+        self.kernel = kern
+
+    def submit(self, fn: Callable, *args: Any,
+               **kwargs: Any) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        fut.set_running_or_notify_cancel()
+
+        def run() -> None:
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 — reaped by sync()
+                fut.set_exception(e)
+
+        self.kernel.call_later(0.0, run)
+        return fut
+
+    def shutdown(self, wait: bool = False) -> None:
+        del wait
+
+
+class _Slice:
+    __slots__ = ('cluster_name', 'url', 'region', 'zone', 'is_spot',
+                 'accelerator', 'provisioned_at', 'alive', 'notice',
+                 'model')
+
+    def __init__(self, cluster_name: str, url: str, region: str,
+                 zone: str, is_spot: bool, accelerator: Optional[str],
+                 provisioned_at: float,
+                 model: replica_lib.ModelReplica) -> None:
+        self.cluster_name = cluster_name
+        self.url = url
+        self.region = region
+        self.zone = zone
+        self.is_spot = is_spot
+        self.accelerator = accelerator
+        self.provisioned_at = provisioned_at
+        self.alive = True
+        self.notice = False
+        self.model = model
+
+
+class VirtualCloud(replica_managers.CloudAdapter):
+    """The provider the twin's replica manager provisions against."""
+
+    def __init__(self, kern: kernel_lib.Kernel, *,
+                 make_replica: Callable[[str], replica_lib.ModelReplica],
+                 log: Callable[..., None],
+                 zones: Optional[List[Tuple[str, str]]] = None,
+                 provision_delay_s: Tuple[float, float] = (30.0, 90.0),
+                 seed: int = 0) -> None:
+        self.kernel = kern
+        self.make_replica = make_replica
+        self.log = log
+        self.zones = zones or [('sim-r1', f'sim-r1-{z}')
+                               for z in 'abc']
+        self.provision_delay_s = provision_delay_s
+        self.rng = random.Random(f'cloud/{seed}')
+        self.slices: Dict[str, _Slice] = {}
+        self.by_url: Dict[str, _Slice] = {}
+        self._ip = 0
+
+    # ---- CloudAdapter --------------------------------------------------
+    def launch(self, task, cluster_name: str, blocked_placements,
+               avoid_placements=None):
+        blocked = {tuple(b) for b in (blocked_placements or [])}
+        avoid = {tuple(b) for b in (avoid_placements or [])}
+        counts: Dict[Tuple[str, str], int] = {
+            z: 0 for z in self.zones}
+        for s in self.slices.values():
+            if s.alive and (s.region, s.zone) in counts:
+                counts[(s.region, s.zone)] += 1
+        # Placement: least-populated zone (lexical ties) — the
+        # candidate order the optimizer's best-first walk would
+        # produce — under execution.launch's two relaxation tiers:
+        # HARD preemption blocks fall back to the full list only when
+        # they exclude everything; SOFT spreading avoids are dropped
+        # against the hard-filtered list.
+        candidates = [z for z in self.zones if z not in blocked] \
+            or list(self.zones)
+        candidates = [z for z in candidates if z not in avoid] \
+            or candidates
+        region, zone = min(candidates, key=lambda z: (counts[z], z))
+        self._ip += 1
+        ip = f'10.{(self._ip >> 16) & 255}.{(self._ip >> 8) & 255}' \
+             f'.{self._ip & 255}'
+        port = int(task.envs.get('SKYPILOT_SERVE_PORT', 8080) or 8080)
+        url = f'http://{ip}:{port}'
+        lo, hi = self.provision_delay_s
+        delay = self.rng.uniform(lo, hi)
+        model = self.make_replica(url)
+        accel = None
+        if task.resources.accelerators:
+            accel = next(iter(task.resources.accelerators))
+        s = _Slice(cluster_name, url, region, zone,
+                   task.resources.use_spot, accel,
+                   self.kernel.now + delay, model)
+        self.slices[cluster_name] = s
+        self.by_url[url] = s
+        self.log('launch', cluster=cluster_name, zone=f'{region}/{zone}',
+                 spot=bool(task.resources.use_spot),
+                 provision_s=round(delay, 3))
+        return SimpleNamespace(
+            head=SimpleNamespace(external_ip=ip, internal_ip=ip,
+                                 agent_url=url),
+            tpu_slice=accel, region=region, zone=zone)
+
+    def probe_url(self, url: str, probe) -> bool:
+        s = self.by_url.get(url)
+        # A wedged or browned-out replica still answers its health
+        # endpoint — that is precisely what makes those failure modes
+        # interesting to the LB's breaker.
+        return (s is not None and s.alive and s.model.alive
+                and self.kernel.now >= s.provisioned_at)
+
+    def probe_pool_worker(self, cluster_name: str,
+                          timeout_s: float) -> bool:
+        s = self.slices.get(cluster_name)
+        return (s is not None and s.alive
+                and self.kernel.now >= s.provisioned_at)
+
+    def provider_alive(self, cluster_name: str) -> Optional[bool]:
+        s = self.slices.get(cluster_name)
+        if s is None:
+            return None
+        return s.alive
+
+    def preemption_notice(self, cluster_name: str) -> bool:
+        s = self.slices.get(cluster_name)
+        return s is not None and s.notice
+
+    def drain(self, url: str, deadline_s: float) -> Optional[dict]:
+        s = self.by_url.get(url)
+        if s is None or not s.model.alive:
+            return None
+        n = len(s.model.active) + s.model.sched.pending()
+        s.model.drain_flush()
+        self.log('drain', cluster=s.cluster_name, flushed=n)
+        return {'status': 'drained', 'flushed': n}
+
+    def terminate(self, cluster_name: str) -> None:
+        s = self.slices.pop(cluster_name, None)
+        if s is None:
+            return
+        self.by_url.pop(s.url, None)
+        s.alive = False
+        s.model.kill()
+        self.log('terminate', cluster=cluster_name)
+
+    # ---- fault API (the scenario schedule calls these) -----------------
+    def live_slices(self) -> List[_Slice]:
+        return [self.slices[k] for k in sorted(self.slices)
+                if self.slices[k].alive]
+
+    def reclaim(self, cluster_name: str, *,
+                notice_lead_s: float = 0.0) -> None:
+        """Spot reclaim. With a notice lead the provider warns first
+        (the manager's next tick turns it into a planned drain) and
+        the hard kill lands ``notice_lead_s`` later IF the slice still
+        exists — the real race between drain and reclaim."""
+        s = self.slices.get(cluster_name)
+        if s is None or not s.alive:
+            return
+        if notice_lead_s > 0:
+            s.notice = True
+            self.log('preemption_notice', cluster=cluster_name,
+                     lead_s=notice_lead_s)
+            self.kernel.call_later(notice_lead_s, self.hard_kill,
+                                   cluster_name)
+        else:
+            self.hard_kill(cluster_name)
+
+    def hard_kill(self, cluster_name: str) -> None:
+        s = self.slices.get(cluster_name)
+        if s is None or not s.alive:
+            return
+        s.alive = False
+        s.model.kill()
+        self.log('reclaim_kill', cluster=cluster_name,
+                 zone=f'{s.region}/{s.zone}')
+
+    def zone_outage(self, zone_suffix: str) -> int:
+        """Kill every live slice in a zone (regional failover)."""
+        n = 0
+        for s in self.live_slices():
+            if s.zone == zone_suffix:
+                self.hard_kill(s.cluster_name)
+                n += 1
+        self.log('zone_outage', zone=zone_suffix, killed=n)
+        return n

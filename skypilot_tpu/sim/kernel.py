@@ -1,0 +1,173 @@
+"""Discrete-event kernel + coroutine trampoline for the digital twin.
+
+The determinism contract (docs/robustness.md "Digital twin"): every
+state change in a replay happens inside a kernel callback, callbacks
+execute in strict ``(virtual_time, sequence)`` order, and the only
+sources of randomness are seeded ``random.Random`` instances owned by
+the scenario. No real threads, no asyncio event loop, no wall clock —
+so two runs with the same seed take byte-identical decision paths.
+
+The trampoline is what lets the REAL ``LoadBalancer.handle``
+coroutine run here unmodified: the twin's transport overrides make
+every ``await`` inside the request path terminate in either a plain
+coroutine (runs inline, e.g. ``request.read()``) or a
+:class:`SimFuture` resolved by a later kernel event (a modeled
+replica's next token). ``Kernel.spawn`` drives the coroutine with
+``send``/``throw`` until it completes — a ~40-line deterministic
+substitute for asyncio.
+"""
+from __future__ import annotations
+
+import heapq
+from typing import Any, Callable, List, Optional, Tuple
+
+from skypilot_tpu.utils import vclock
+
+
+class SimFuture:
+    """Minimal awaitable resolved by a kernel callback. Awaiting a
+    pending future suspends the coroutine (yields the future to the
+    trampoline); awaiting a resolved one continues inline — which is
+    how a stream consumer drains an already-buffered burst of token
+    lines without bouncing through the heap."""
+
+    __slots__ = ('_done', '_value', '_exc', '_callbacks')
+
+    def __init__(self) -> None:
+        self._done = False
+        self._value: Any = None
+        self._exc: Optional[BaseException] = None
+        self._callbacks: List[Callable[['SimFuture'], None]] = []
+
+    def done(self) -> bool:
+        return self._done
+
+    def set_result(self, value: Any = None) -> None:
+        if self._done:
+            raise RuntimeError('SimFuture already resolved')
+        self._done = True
+        self._value = value
+        self._fire()
+
+    def set_exception(self, exc: BaseException) -> None:
+        if self._done:
+            raise RuntimeError('SimFuture already resolved')
+        self._done = True
+        self._exc = exc
+        self._fire()
+
+    def _fire(self) -> None:
+        callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            cb(self)
+
+    def add_done_callback(self,
+                          cb: Callable[['SimFuture'], None]) -> None:
+        if self._done:
+            cb(self)
+        else:
+            self._callbacks.append(cb)
+
+    def result(self) -> Any:
+        if not self._done:
+            raise RuntimeError('SimFuture pending')
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def __await__(self):
+        if not self._done:
+            yield self
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class Kernel:
+    """The event heap + virtual clock + trampoline."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self.clock = vclock.VirtualClock(start)
+        self._heap: List[Tuple[float, int, Callable, tuple]] = []
+        self._seq = 0
+        self.events_run = 0
+
+    @property
+    def now(self) -> float:
+        return self.clock.time()
+
+    # ---- scheduling ------------------------------------------------------
+    def call_at(self, t: float, fn: Callable, *args: Any) -> None:
+        """Run ``fn(*args)`` at virtual time ``t`` (clamped to now —
+        the past is not schedulable). Ties execute in scheduling
+        order."""
+        self._seq += 1
+        heapq.heappush(self._heap,
+                       (max(t, self.now), self._seq, fn, args))
+
+    def call_later(self, delay: float, fn: Callable,
+                   *args: Any) -> None:
+        self.call_at(self.now + max(0.0, delay), fn, *args)
+
+    def every(self, interval: float, fn: Callable[[], Any], *,
+              start: float = 0.0, until: Optional[float] = None) -> None:
+        """A fixed virtual cadence (control-loop ticks). ``fn`` runs at
+        start, start+interval, ... while ``until`` allows."""
+        def tick() -> None:
+            fn()
+            nxt = self.now + interval
+            if until is None or nxt <= until:
+                self.call_at(nxt, tick)
+        self.call_at(start, tick)
+
+    # ---- coroutines ------------------------------------------------------
+    def create_future(self) -> SimFuture:
+        return SimFuture()
+
+    def spawn(self, coro) -> SimFuture:
+        """Drive ``coro`` to completion across kernel events; the
+        returned future resolves with its return value (or its
+        exception — the twin inspects, never silently drops)."""
+        result = SimFuture()
+
+        def advance(value: Any = None,
+                    exc: Optional[BaseException] = None) -> None:
+            try:
+                if exc is not None:
+                    awaited = coro.throw(exc)
+                else:
+                    awaited = coro.send(value)
+            except StopIteration as s:
+                result.set_result(s.value)
+                return
+            except BaseException as e:  # noqa: BLE001 — surfaced via future
+                result.set_exception(e)
+                return
+            if not isinstance(awaited, SimFuture):
+                result.set_exception(RuntimeError(
+                    f'sim coroutine awaited a non-sim awaitable '
+                    f'{awaited!r} — a transport seam is missing '
+                    f'(asyncio primitives cannot run on the kernel)'))
+                return
+            awaited.add_done_callback(
+                lambda f: advance(f._value, f._exc))
+
+        advance()
+        return result
+
+    # ---- the loop --------------------------------------------------------
+    def run(self, until: Optional[float] = None) -> None:
+        """Execute events in (time, seq) order until the heap drains
+        (or virtual ``until`` passes). Callback exceptions propagate —
+        a crashed control loop must fail the replay loudly."""
+        while self._heap:
+            t, _, fn, args = self._heap[0]
+            if until is not None and t > until:
+                break
+            heapq.heappop(self._heap)
+            self.clock.advance_to(t)
+            self.events_run += 1
+            fn(*args)
+
+    def pending(self) -> int:
+        return len(self._heap)

@@ -1,0 +1,379 @@
+"""Modeled replicas: a REAL engine scheduler fronting virtual slots.
+
+Each modeled replica embeds a real ``infer/sched`` policy instance
+(fcfs / EDF / wfq — the exact admission, quota, and ordering code the
+production step loop drives), so fleet-scale gates prove the REAL
+per-tenant shed and starvation behavior. Only the device is modeled:
+decode advances one token per slot per virtual step, and the step
+cadence follows the measured ITL-vs-concurrency curve from the bench
+JSONs (TTFT_r06/r07) — so queueing, batching pressure, and admission
+interact with arrival shapes the way the real engine's do.
+
+Failure surface (what the scenarios drive):
+
+- ``kill()`` — hard preemption: every in-flight stream dies mid-line
+  (the LB's resume splice heals it);
+- ``drain_flush()`` — the planned handoff: stop admitting, finish all
+  in-flight work at the drain instant (the twin models drain latency
+  as an atomic flush — ORDERING is what it proves: DRAINING before
+  teardown, ready-set removal before death, zero client errors);
+- ``wedged`` — answers probes but fails requests (breaker-flap food);
+- ``slow_factor`` — brownout: steps stretch, tails grow, probes pass.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu.infer import sched as sched_lib
+from skypilot_tpu.sim import kernel as kernel_lib
+
+
+class ReplicaShed(Exception):
+    """The modeled replica refused the request (429 admission-full
+    from the REAL scheduler's quota logic, or 503 while draining)."""
+
+    def __init__(self, status: int, message: str,
+                 retry_after_s: float) -> None:
+        super().__init__(message)
+        self.status = status
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass
+class PerfModel:
+    """Measured performance curves: virtual step time as a function of
+    decode concurrency (piecewise-linear over the bench sweep levels),
+    plus the prefill budget per step that sets modeled TTFT."""
+
+    # (concurrency, step_seconds), ascending concurrency.
+    itl_curve: List[Tuple[float, float]]
+    prefill_tokens_per_step: float = 256.0
+    # Uniform stretch: the bench box's tiny-model ITLs are ~ms; a
+    # scenario can scale toward production-shaped tens of ms without
+    # re-deriving the curve's SHAPE.
+    scale: float = 1.0
+
+    def step_s(self, concurrency: int) -> float:
+        c = max(1.0, float(concurrency))
+        curve = self.itl_curve
+        if c <= curve[0][0]:
+            base = curve[0][1]
+        elif c >= curve[-1][0]:
+            base = curve[-1][1]
+        else:
+            base = curve[-1][1]
+            for (ca, sa), (cb, sb) in zip(curve, curve[1:]):
+                if c < cb:
+                    base = sa + (sb - sa) * (c - ca) / (cb - ca)
+                    break
+        return base * self.scale
+
+    @classmethod
+    def default(cls, scale: float = 1.0) -> 'PerfModel':
+        return cls(itl_curve=[(1, 0.020), (8, 0.030), (16, 0.045)],
+                   scale=scale)
+
+    @classmethod
+    def from_bench_json(cls, path: str, *, scale: float = 1.0,
+                        lane: str = 'spec_on') -> 'PerfModel':
+        """Derive the curve from a ``bench_ttft`` sweep JSON
+        (TTFT_r06-style: per-level ``concurrency`` + per-lane
+        ``itl_p50_ms``). Falls back to :meth:`default` when the file
+        has no usable sweep — a missing bench must not fail a replay."""
+        try:
+            with open(path, encoding='utf-8') as f:
+                doc = json.load(f)
+            pts: List[Tuple[float, float]] = []
+            for level in doc.get('sweep') or []:
+                conc = level.get('concurrency')
+                row = level.get(lane) if isinstance(level.get(lane),
+                                                   dict) else level
+                itl = (row or {}).get('itl_p50_ms')
+                if conc and itl:
+                    pts.append((float(conc), float(itl) / 1e3))
+            if pts:
+                return cls(itl_curve=sorted(pts), scale=scale)
+        except (OSError, ValueError, TypeError):
+            pass
+        return cls.default(scale=scale)
+
+
+class _Req:
+    """The request object handed to the REAL scheduler: exactly the
+    attribute surface ``infer/sched`` relies on (tenant, prompt and
+    output token lists for ``request_cost``, cancelled/deadline for
+    sweeps, submitted_at for victim choice)."""
+
+    __slots__ = ('tenant', 'prompt_tokens', 'output_tokens',
+                 'cancelled', 'deadline', 'submitted_at',
+                 'max_new_tokens', 'resume_len', 'stream',
+                 'submit_step', 'first_token_step', 'prefill_left',
+                 'dispatched_at', 'prompt_key')
+
+    def __init__(self, tenant: str, prompt_tokens: List[int],
+                 max_new_tokens: int, resume_from: List[int],
+                 submitted_at: float, submit_step: int,
+                 prefill_left: int) -> None:
+        self.tenant = tenant
+        self.prompt_tokens = list(prompt_tokens)
+        # Resume tokens pre-seed the output exactly like the engine's
+        # resume_from splice path: they count toward request_cost (the
+        # re-prefill the scheduler charges) and are never re-emitted.
+        self.output_tokens: List[int] = list(resume_from)
+        self.cancelled = False
+        self.deadline: Optional[float] = None
+        self.submitted_at = submitted_at
+        self.max_new_tokens = max_new_tokens
+        self.resume_len = len(resume_from)
+        self.stream = SimStream()
+        self.submit_step = submit_step
+        self.first_token_step: Optional[int] = None
+        self.prefill_left = prefill_left
+        self.dispatched_at: Optional[float] = None
+        # The whole greedy continuation is a pure function of the
+        # prompt (deterministic resume bit-identity); hash it once.
+        self.prompt_key = zlib.crc32(
+            json.dumps(self.prompt_tokens).encode())
+
+
+class SimStream:
+    """The virtual wire between a modeled replica and one LB proxy
+    leg: the replica pushes ``('line', dict)`` events, the transport
+    awaits them; ``('dead', None)`` models the connection dying with
+    the replica."""
+
+    __slots__ = ('_buf', '_waiter', '_dead')
+
+    def __init__(self) -> None:
+        self._buf: List[Tuple[str, Any]] = []
+        self._waiter: Optional[kernel_lib.SimFuture] = None
+        self._dead = False
+
+    def push_line(self, obj: Dict[str, Any]) -> None:
+        self._push(('line', obj))
+
+    def fail(self) -> None:
+        self._dead = True
+        self._push(('dead', None))
+
+    def _push(self, event: Tuple[str, Any]) -> None:
+        waiter, self._waiter = self._waiter, None
+        if waiter is not None:
+            waiter.set_result(event)
+        else:
+            self._buf.append(event)
+
+    def next_event(self) -> kernel_lib.SimFuture:
+        fut = kernel_lib.SimFuture()
+        if self._buf:
+            fut.set_result(self._buf.pop(0))
+        elif self._dead:
+            fut.set_result(('dead', None))
+        else:
+            if self._waiter is not None:
+                raise RuntimeError('one consumer per stream')
+            self._waiter = fut
+        return fut
+
+
+def expected_continuation(prompt_tokens: List[int],
+                          n: int) -> List[int]:
+    """The exact token ids an UNKILLED run of this prompt produces —
+    the oracle the twin audits every delivered stream against (a
+    resumed/spliced stream must match it byte for byte)."""
+    key = zlib.crc32(
+        json.dumps([int(t) for t in prompt_tokens]).encode())
+    return [_token(key, i) for i in range(n)]
+
+
+def _token(prompt_key: int, index: int) -> int:
+    """Deterministic, process-stable token id (NEVER builtin hash():
+    PYTHONHASHSEED would break the cross-run byte-identity gate). A
+    killed-and-resumed request regenerates the exact continuation, so
+    the LB's splice is bit-identical to an unkilled run — same
+    contract the real engine's greedy resume provides."""
+    return 2 + (zlib.crc32(f'{prompt_key}/{index}'.encode())
+                % 200)
+
+
+class ModelReplica:
+    """One modeled serving replica on the virtual transport."""
+
+    def __init__(self, kern: kernel_lib.Kernel, url: str, *,
+                 scheduler: str = 'fcfs',
+                 sched_config: Optional[sched_lib.SchedulerConfig] = None,
+                 slots: int = 8,
+                 perf: Optional[PerfModel] = None,
+                 on_request_done: Optional[Callable[..., None]] = None
+                 ) -> None:
+        self.kernel = kern
+        self.url = url
+        self.sched = sched_lib.make(scheduler, sched_config)
+        self.slots = slots
+        self.perf = perf or PerfModel.default()
+        self.on_request_done = on_request_done
+        self.alive = True
+        self.draining = False
+        self.wedged = False
+        self.slow_factor = 1.0
+        self.active: List[_Req] = []
+        self.steps = 0
+        self.decode_tokens = 0
+        self._step_scheduled = False
+
+    # ---- ingress ---------------------------------------------------------
+    def submit(self, payload: Dict[str, Any], tenant: str,
+               resume_from: List[int]) -> SimStream:
+        if not self.alive:
+            raise ConnectionError(f'{self.url} is dead')
+        now = self.kernel.now
+        if self.draining:
+            raise ReplicaShed(503, 'draining', retry_after_s=1.0)
+        prompt = [int(t) for t in payload.get('tokens') or []]
+        max_new = int(payload.get('max_new_tokens') or 8)
+        prefill_left = max(1, math.ceil(
+            len(prompt) / self.perf.prefill_tokens_per_step))
+        req = _Req(tenant or sched_lib.DEFAULT_TENANT, prompt, max_new,
+                   resume_from, now, self.steps, prefill_left)
+        try:
+            # THE real admission code: global bounds under fcfs/EDF,
+            # weight-share quotas + tenant-scoped Retry-After under
+            # wfq.
+            self.sched.admit(req, drain_tps=self._drain_tps())
+        except sched_lib.AdmissionError as e:
+            raise ReplicaShed(429, str(e),
+                              retry_after_s=e.retry_after_s) from e
+        self.sched.enqueue(req)
+        self._ensure_step()
+        return req.stream
+
+    def _drain_tps(self) -> float:
+        if not self.steps:
+            return 0.0
+        return self.decode_tokens / max(
+            1e-9, self.steps * self.perf.step_s(self.slots))
+
+    # ---- the virtual step loop -------------------------------------------
+    def _ensure_step(self) -> None:
+        if (self._step_scheduled or not self.alive
+                or (not self.active and not self.sched.pending())):
+            return
+        self._step_scheduled = True
+        delay = self.perf.step_s(max(1, len(self.active))) \
+            * self.slow_factor
+        self.kernel.call_later(delay, self._step)
+
+    def _step(self) -> None:
+        self._step_scheduled = False
+        if not self.alive:
+            return
+        self.steps += 1
+        now = self.kernel.now
+        # Slot refill through the real policy (wfq rotates tenants,
+        # EDF picks the most urgent, fcfs pops FIFO).
+        while len(self.active) < self.slots:
+            req = self.sched.pop_next()
+            if req is None:
+                break
+            req.dispatched_at = now
+            self.sched.note_queue_wait(req, now - req.submitted_at)
+            self.active.append(req)
+        for req in list(self.active):
+            if len(req.output_tokens) >= req.max_new_tokens:
+                # A resume leg whose boundary already covers the whole
+                # budget (the kill landed after the last token but
+                # before the done line): only the done line is owed.
+                self._finish(req, 'length')
+                continue
+            if req.prefill_left > 0:
+                req.prefill_left -= 1
+                continue
+            self._emit_one(req)
+        self._ensure_step()
+
+    def _emit_one(self, req: _Req) -> None:
+        idx = len(req.output_tokens)
+        tok = _token(req.prompt_key, idx)
+        req.output_tokens.append(tok)
+        self.decode_tokens += 1
+        self.sched.note_tokens(req, 1)
+        if req.first_token_step is None:
+            req.first_token_step = self.steps
+            self.sched.note_first_token(
+                req, self.kernel.now - req.submitted_at)
+        # Only post-resume-boundary tokens go on the wire (the engine's
+        # resume contract — the LB already delivered the rest); the
+        # budget is TOTAL output across legs, so the spliced stream
+        # carries exactly max_new_tokens like an unkilled run.
+        req.stream.push_line({'tokens': [tok]})
+        if len(req.output_tokens) >= req.max_new_tokens:
+            self._finish(req, 'length')
+
+    def _finish(self, req: _Req, reason: str) -> None:
+        self.active.remove(req)
+        waited = ((req.first_token_step or self.steps)
+                  - req.submit_step)
+        req.stream.push_line({
+            'done': True, 'finish_reason': reason,
+            'queue_wait_s': round(
+                (req.dispatched_at or req.submitted_at)
+                - req.submitted_at, 6),
+            # Scheduler-virtual fairness clock (the starvation gates
+            # assert on this, not wall time — the PR 11 rule).
+            'steps_waited': waited,
+        })
+        if self.on_request_done is not None:
+            self.on_request_done(self.url, req, reason)
+
+    # ---- failure surface -------------------------------------------------
+    def kill(self) -> None:
+        """Hard death (spot reclaim without notice, zone outage):
+        every in-flight and queued stream dies mid-flight; the LB's
+        resume path is what heals the clients."""
+        if not self.alive:
+            return
+        self.alive = False
+        for req in self.active:
+            req.stream.fail()
+        self.active.clear()
+        while True:
+            req = self.sched.pop_next()
+            if req is None:
+                break
+            req.stream.fail()
+
+    def drain_flush(self) -> None:
+        """The planned handoff: stop admitting (new requests shed 503
+        and reroute), then finish EVERY admitted request — active and
+        queued — at the drain instant. Latency of the drain itself is
+        modeled as atomic; what the twin proves is the ordering
+        contract (drain before teardown ⇒ zero client-visible
+        errors)."""
+        self.draining = True
+        while True:
+            req = self.sched.pop_next()
+            if req is None:
+                break
+            req.dispatched_at = req.dispatched_at or self.kernel.now
+            self.active.append(req)
+        for req in list(self.active):
+            req.prefill_left = 0
+            while len(req.output_tokens) < req.max_new_tokens:
+                self._emit_one(req)
+            if req in self.active:    # boundary-covered resume leg
+                self._finish(req, 'length')
+
+    # ---- observability (the LB's /metrics fetch) -------------------------
+    def metrics_row(self) -> Tuple[str, int, Dict[str, Any]]:
+        """The ``(url, num_waiting, eff)`` row the LB sync tick
+        ingests — same keys the real ``/metrics`` fetch extracts."""
+        tps = (round(self.decode_tokens / self.steps, 4)
+               if self.steps else None)
+        eff = {'decode_tokens': self.decode_tokens}
+        if tps is not None:
+            eff['tokens_per_step'] = tps
+        return self.url, self.sched.pending(), eff

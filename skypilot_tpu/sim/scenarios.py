@@ -1,0 +1,232 @@
+"""Scenario library for the digital twin (docs/robustness.md).
+
+A :class:`Scenario` is everything one replay needs: the fleet shape
+(service spec the REAL controller consumes), the traffic (a seeded
+``tests/load_tests/loadgen`` tenant spec, diurnal/flash envelopes
+included), the fault schedule, and the control-loop cadences. The
+factories below are the shipped catalog; a new scenario is one
+function returning a ``Scenario`` — see "How to add a scenario" in
+docs/robustness.md.
+
+Cadence note: fleet-scale replays run the controller/LB loops at
+coarser virtual intervals than the 1s production defaults — exactly
+what a 1000-replica deployment does in practice (and what the
+env-tunable ``SKY_TPU_LB_SYNC_INTERVAL_S`` exists for). Gates assert
+on outcomes (zero client errors, convergence, starvation bounds),
+which do not depend on the cadence being 1s.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Fault:
+    """One scheduled fault. ``t`` is virtual seconds from replay
+    start. Kinds: ``reclaim_storm`` (``frac`` of the live spot fleet;
+    ``notice_frac`` of victims get a ``notice_lead_s`` advance warning
+    — the drain path — the rest die hard — the resume path),
+    ``zone_outage`` (every slice in ``zone``), ``brownout``
+    (``frac`` of the fleet runs ``factor``x slower for
+    ``duration_s``), ``wedge`` (``count`` replicas answer probes but
+    fail every request for ``duration_s`` — breaker food)."""
+
+    t: float
+    kind: str
+    frac: float = 0.2
+    notice_frac: float = 0.7
+    notice_lead_s: float = 45.0
+    zone: str = ''
+    duration_s: float = 120.0
+    factor: float = 8.0
+    count: int = 1
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    # Fleet shape (feeds the REAL ServiceSpec/ReplicaPolicy).
+    replicas: int = 8
+    max_replicas: Optional[int] = None
+    queue_length_threshold: Optional[float] = None
+    upscale_delay_s: float = 60.0
+    downscale_delay_s: float = 600.0
+    use_spot: bool = True
+    lb_policy: str = 'round_robin'
+    # Traffic (loadgen tenant spec; envelope shapes welcome).
+    tenants: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
+    traffic_start_s: float = 420.0
+    duration_s: float = 3600.0
+    # Modeled replica shape (REAL scheduler inside).
+    scheduler: str = 'fcfs'
+    tenant_weights: Optional[Dict[str, float]] = None
+    max_queue_requests: Optional[int] = 64
+    max_queue_tokens: Optional[int] = None
+    slots: int = 8
+    perf_scale: float = 1.0
+    bench_json: Optional[str] = None
+    # Virtual cloud.
+    provision_delay_s: Tuple[float, float] = (30.0, 90.0)
+    zones: Optional[List[Tuple[str, str]]] = None
+    # Control-loop cadences (virtual seconds).
+    controller_tick_s: float = 15.0
+    lb_sync_s: float = 5.0
+    stats_flush_s: float = 10.0
+    initial_delay_s: float = 300.0
+    faults: List[Fault] = dataclasses.field(default_factory=list)
+
+
+def reclaim_storm(*, replicas: int = 40, duration_s: float = 2400.0,
+                  storm_frac: float = 0.25,
+                  rps: float = 10.0) -> Scenario:
+    """A quarter-fleet spot-reclaim storm mid-replay: half the victims
+    get the advance notice (drain handoff), the rest die hard
+    mid-stream (resume splice). Streams run long enough (32 tokens at
+    a 2x-scaled ITL curve) that hard kills reliably land MID-stream —
+    the resume gate must be non-vacuous. Gate: ZERO client-visible
+    errors."""
+    storm_t = duration_s * 0.5
+    return Scenario(
+        name='reclaim_storm', replicas=replicas, use_spot=True,
+        duration_s=duration_s, perf_scale=2.0,
+        tenants={'prod': {'rps': rps, 'prompt_mean': 48,
+                          'prompt_max': 256, 'max_new': 32,
+                          'until': duration_s * 0.75}},
+        faults=[Fault(t=storm_t, kind='reclaim_storm',
+                      frac=storm_frac, notice_frac=0.5)])
+
+
+def flash_crowd(*, base_replicas: int = 2, max_replicas: int = 10,
+                duration_s: float = 5400.0) -> Scenario:
+    """A 15x flash crowd against the REAL QueueLengthAutoscaler: the
+    crowd saturates the base fleet (slots x step-time make per-replica
+    throughput ~2 rps), queue depth crosses the threshold, the target
+    climbs with hysteresis, and drains back down after the crowd.
+    Gate: scale-up happened, settled back, and the target moved in at
+    most two directions (up, then down — no oscillation)."""
+    flash_at = duration_s * 0.3
+    return Scenario(
+        name='flash_crowd', replicas=base_replicas,
+        max_replicas=max_replicas, queue_length_threshold=6.0,
+        upscale_delay_s=30.0, downscale_delay_s=240.0,
+        duration_s=duration_s, slots=2, max_queue_requests=64,
+        perf_scale=3.0, controller_tick_s=15.0,
+        provision_delay_s=(20.0, 45.0),
+        tenants={'web': {
+            'rps': 1.0, 'prompt_mean': 24, 'prompt_max': 64,
+            'max_new': 12, 'until': duration_s * 0.8,
+            'envelope': {'kind': 'flash', 'at': flash_at,
+                         'duration_s': 420.0, 'mult': 15.0}}})
+
+
+def regional_failover(*, replicas: int = 12,
+                      duration_s: float = 2400.0) -> Scenario:
+    """A whole zone dies at once. Gates: the fleet relaunches to
+    target, every relaunch lands OUTSIDE the dead zone (spot placer's
+    blocked placements), clients ride through on retry/resume."""
+    return Scenario(
+        name='regional_failover', replicas=replicas,
+        duration_s=duration_s,
+        tenants={'prod': {'rps': 4.0, 'prompt_mean': 32,
+                          'prompt_max': 96, 'max_new': 10,
+                          'until': duration_s * 0.75}},
+        faults=[Fault(t=duration_s * 0.5, kind='zone_outage',
+                      zone='sim-r1-a')])
+
+
+def slow_brownout(*, replicas: int = 8,
+                  duration_s: float = 2400.0) -> Scenario:
+    """A quarter of the fleet browns out (8x slower steps, probes
+    still green). Gate: no client-visible errors — slow is not dead,
+    and the breaker must NOT amputate replicas that still answer."""
+    return Scenario(
+        name='slow_brownout', replicas=replicas, duration_s=duration_s,
+        lb_policy='least_load',
+        tenants={'prod': {'rps': 5.0, 'prompt_mean': 24,
+                          'prompt_max': 64, 'max_new': 8,
+                          'until': duration_s * 0.75}},
+        faults=[Fault(t=duration_s * 0.45, kind='brownout', frac=0.25,
+                      duration_s=600.0, factor=8.0)])
+
+
+def breaker_flap(*, replicas: int = 6,
+                 duration_s: float = 2400.0) -> Scenario:
+    """One replica wedges (probes green, every request fails) for two
+    breaker cooldowns, then heals. Gates: the breaker OPENS (stops the
+    bleeding), re-CLOSES after recovery, and no client ever sees the
+    wedge (pre-stream failover)."""
+    return Scenario(
+        name='breaker_flap', replicas=replicas, duration_s=duration_s,
+        tenants={'prod': {'rps': 6.0, 'prompt_mean': 16,
+                          'prompt_max': 48, 'max_new': 8,
+                          'until': duration_s * 0.75}},
+        faults=[Fault(t=duration_s * 0.45, kind='wedge', count=1,
+                      duration_s=300.0)])
+
+
+def wfq_fleet(*, replicas: int = 4, duration_s: float = 900.0,
+              aggressor: bool = True) -> Scenario:
+    """Fleet-scale starvation gate: the REAL wfq scheduler (weights +
+    per-tenant quotas) inside every modeled replica, a 10:1 aggressor
+    flood through the REAL LB. Run once with the aggressor and once
+    without (same seed) — the victim's scheduler-virtual steps_waited
+    must hold the 3x bound with zero victim sheds."""
+    tenants: Dict[str, Dict[str, Any]] = {
+        'victim': {'rps': 2.0, 'burst': 3, 'prompt_mean': 12,
+                   'prompt_max': 24, 'max_new': 8,
+                   'until': duration_s * 0.7}}
+    if aggressor:
+        tenants['aggressor'] = {
+            'rps': 20.0, 'burst': 10, 'prompt_mean': 24,
+            'prompt_max': 48, 'max_new': 8,
+            'until': duration_s * 0.7}
+    # Saturation is the point: per-replica throughput ~= slots /
+    # (max_new x step) ~= 2 rps, fleet ~= 8 rps, offered load ~= 22 —
+    # the aggressor MUST outrun its share or the quota gate is
+    # vacuous.
+    return Scenario(
+        name='wfq_fleet', replicas=replicas, duration_s=duration_s,
+        scheduler='wfq', slots=4, max_queue_requests=16,
+        perf_scale=5.0,
+        tenant_weights={'victim': 2.0, 'aggressor': 1.0},
+        tenants=tenants)
+
+
+def fleet_storm_24h(*, replicas: int = 1000,
+                    requests: float = 0.12) -> Scenario:
+    """THE acceptance gate: a 24h diurnal day at 1000 modeled
+    replicas, a 20%-fleet reclaim storm at the evening peak — replayed
+    in seconds of wall clock, byte-identical per seed. ``requests``
+    scales the diurnal rate (0.12 rps peak-mean ≈ several thousand
+    requests over the day — the decision density that matters; the
+    fleet-size axis is what this gate exists to prove)."""
+    day = 86400.0
+    return Scenario(
+        name='fleet_storm_24h', replicas=replicas, use_spot=True,
+        duration_s=day + 2400.0, traffic_start_s=900.0,
+        controller_tick_s=60.0, lb_sync_s=60.0, stats_flush_s=45.0,
+        provision_delay_s=(60.0, 240.0), initial_delay_s=600.0,
+        max_queue_requests=128,
+        tenants={'world': {
+            'rps': requests, 'prompt_mean': 48, 'prompt_max': 192,
+            'max_new': 10, 'until': day,
+            'envelope': {'kind': 'diurnal', 'period_s': day,
+                         'low': 0.15}}},
+        # Notice lead MUST clear the controller tick cadence or the
+        # drain never happens: a notice only turns into a planned
+        # handoff when a tick observes it before the provider's kill.
+        faults=[Fault(t=900.0 + day * 0.58, kind='reclaim_storm',
+                      frac=0.2, notice_lead_s=240.0)])
+
+
+SCENARIOS = {
+    'reclaim_storm': reclaim_storm,
+    'flash_crowd': flash_crowd,
+    'regional_failover': regional_failover,
+    'slow_brownout': slow_brownout,
+    'breaker_flap': breaker_flap,
+    'wfq_fleet': wfq_fleet,
+    'fleet_storm_24h': fleet_storm_24h,
+}

@@ -1,0 +1,154 @@
+"""Virtual transport: the REAL LoadBalancer over modeled replicas.
+
+:class:`TwinLoadBalancer` subclasses the production ``LoadBalancer``
+and overrides ONLY its transport seams — the proxy attempt, the
+/metrics fetch, and the blocking-DB offload. Everything that makes
+the LB interesting runs for real: ``handle()``'s retry/resume loop,
+``_select``'s breaker-aware choice with cache-affinity fallback, the
+circuit breaker itself, saturation rerouting (429/503), deadline
+budget forwarding, the ``_StreamSplice`` delivered-token ledger and
+its dedupe rule, per-tenant edge metrics, and the fleet history tier.
+
+The failpoint seams (``lb.proxy``, ``serve.lb.midstream_kill``) are
+re-armed at the same positions as the real transport, so env-driven
+chaos composes with scenario faults inside a replay (``error``
+actions only — a ``delay`` would need an asyncio loop the kernel
+deliberately does not have).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.sim import replica as replica_lib
+from skypilot_tpu.utils import common
+from skypilot_tpu.utils import failpoints
+
+
+class SimRequest:
+    """Duck-typed stand-in for ``aiohttp.web.Request`` — exactly the
+    attribute surface ``LoadBalancer.handle`` touches."""
+
+    __slots__ = ('method', 'path', 'headers', '_body')
+
+    def __init__(self, path: str, body: bytes,
+                 headers: Optional[Dict[str, str]] = None,
+                 method: str = 'POST') -> None:
+        self.method = method
+        self.path = path
+        self.headers = dict(headers or {})
+        self._body = body
+
+    @property
+    def path_qs(self) -> str:
+        return self.path
+
+    async def read(self) -> bytes:
+        return self._body
+
+
+class SimStreamResponse:
+    """What ``splice.resp`` becomes on the virtual wire: records the
+    forwarded jsonlines so the twin can audit exactly what the client
+    received (token ids, done line, resume stamps, in-band errors)."""
+
+    __slots__ = ('status', 'chunks', 'eof')
+
+    def __init__(self) -> None:
+        self.status = 200
+        self.chunks: List[bytes] = []
+        self.eof = False
+
+    async def write(self, data: bytes) -> None:
+        self.chunks.append(data)
+
+    async def write_eof(self) -> None:
+        self.eof = True
+
+    def lines(self) -> List[Dict[str, Any]]:
+        out = []
+        for raw in b''.join(self.chunks).splitlines():
+            if raw.strip():
+                out.append(json.loads(raw))
+        return out
+
+
+class TwinLoadBalancer(lb_lib.LoadBalancer):
+    """The real LB bound to the twin's kernel clock and replica map."""
+
+    def __init__(self, service_name: str, policy_name: str, *,
+                 clock, model_by_url) -> None:
+        super().__init__(service_name, policy_name, clock=clock)
+        self._model_by_url = model_by_url
+
+    # ---- seams ---------------------------------------------------------
+    async def _offload(self, fn, *args):
+        # One thread, one sqlite, deterministic order: run inline.
+        return fn(*args)
+
+    async def _fetch_all_metrics(self, urls: List[str]) -> List[tuple]:
+        rows = []
+        for url in urls:
+            model = self._model_by_url(url)
+            if model is not None and model.alive:
+                rows.append(model.metrics_row())
+        return rows
+
+    async def _proxy_stream_attempt(self, request, url: str,
+                                    headers: Dict[str, str],
+                                    t_arrival: float, splice):
+        splice.buf = b''
+        try:
+            await failpoints.hit_async('lb.proxy')
+        except failpoints.FailpointError as e:
+            raise lb_lib._UpstreamDead(e) from e  # noqa: SLF001
+        model = self._model_by_url(url)
+        if model is None or not model.alive or model.wedged:
+            raise lb_lib._UpstreamDead(  # noqa: SLF001
+                ConnectionError(f'replica {url} unreachable'))
+        resume = list(splice.client_resume) + list(splice.delivered)
+        try:
+            stream = model.submit(
+                splice.payload, headers.get(common.TENANT_HEADER),
+                resume)
+        except replica_lib.ReplicaShed as e:
+            raise lb_lib._ReplicaSaturated(  # noqa: SLF001
+                e.status, str(e).encode(),
+                {'Retry-After': f'{e.retry_after_s:.0f}'}) from e
+        except ConnectionError as e:
+            raise lb_lib._UpstreamDead(e) from e  # noqa: SLF001
+        if splice.resp is None:
+            splice.resp = SimStreamResponse()
+        while True:
+            kind, obj = await stream.next_event()
+            if kind == 'dead':
+                raise lb_lib._UpstreamDead(  # noqa: SLF001
+                    ConnectionError(f'replica {url} died mid-stream'))
+            line = json.dumps(obj).encode()
+            # THE real ledger: TTFT/ITL stamps, delivered-token
+            # bookkeeping, done-line resume stamping.
+            out = self._admit_stream_line(splice, line, t_arrival)
+            if out is None:
+                raise lb_lib._UpstreamDead(  # noqa: SLF001
+                    RuntimeError('replica reported an in-stream error'))
+            await splice.resp.write(out)
+            if splice.done:
+                break
+            try:
+                await failpoints.hit_async('serve.lb.midstream_kill')
+            except failpoints.FailpointError as e:
+                raise lb_lib._UpstreamDead(e) from e  # noqa: SLF001
+        await splice.resp.write_eof()
+        return splice.resp, True
+
+    async def _proxy_attempt(self, request, url: str, body: bytes,
+                             headers: Dict[str, str], t_arrival: float,
+                             gen: bool = False,
+                             tenant: Optional[str] = None
+                             ) -> Tuple[Any, bool]:
+        # The twin's traffic is streaming /generate; a non-stream
+        # attempt reaching here means a scenario forgot stream=True.
+        raise NotImplementedError(
+            'the digital twin models streaming /generate only — set '
+            "payload['stream'] = True in the trace")

@@ -1,0 +1,414 @@
+"""The digital twin orchestrator: one scenario → one deterministic
+replay → one report.
+
+Wiring (all REAL control-plane code, only the edges virtualized):
+
+- a scratch ``SKY_TPU_HOME`` holds the run's serve state DB (fresh per
+  run, so sqlite AUTOINCREMENT ids — which appear in the decision
+  log — are identical across same-seed runs);
+- the kernel's :class:`~skypilot_tpu.utils.vclock.VirtualClock` is
+  installed process-wide for the replay, so every ``vclock`` read in
+  ``serve/`` observes virtual time;
+- the REAL :class:`ServeController` ticks at the scenario cadence
+  (launch/terminate through the REAL ``ReplicaManager`` over the
+  virtual cloud), the REAL LB syncs/flushes at its cadences, and
+  every trace event becomes a REAL ``LoadBalancer.handle`` coroutine
+  on the kernel trampoline;
+- the decision log records every launch (with placement), terminate,
+  drain, preemption notice, reclaim kill, autoscaler target change,
+  and per-request outcome, stamped with virtual time + sequence.
+  ``SimReport.decision_log_jsonl()`` is the byte-identity surface the
+  determinism gate hashes.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import shutil
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import yaml
+
+from skypilot_tpu.infer import sched as sched_lib
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.sim import cloud as cloud_lib
+from skypilot_tpu.sim import kernel as kernel_lib
+from skypilot_tpu.sim import replica as replica_lib
+from skypilot_tpu.sim import transport as transport_lib
+from skypilot_tpu.sim.scenarios import Fault, Scenario
+from skypilot_tpu.utils import common
+from skypilot_tpu.utils import db as db_lib
+from skypilot_tpu.utils import retry as retry_lib
+from skypilot_tpu.utils import vclock
+
+logger = logging.getLogger(__name__)
+
+
+class SimReport:
+    """Everything a gate asserts on."""
+
+    def __init__(self, scenario: str, seed: int) -> None:
+        self.scenario = scenario
+        self.seed = seed
+        self.decisions: List[Dict[str, Any]] = []
+        self.records: List[Dict[str, Any]] = []
+        self.lb_metrics: Dict[str, Any] = {}
+        self.wall_s = 0.0
+        self.events_run = 0
+
+    # ---- rollups -------------------------------------------------------
+    def _count(self, kind: str) -> int:
+        return sum(1 for d in self.decisions if d['kind'] == kind)
+
+    @property
+    def launches(self) -> int:
+        return self._count('launch')
+
+    @property
+    def drains(self) -> int:
+        return self._count('drain')
+
+    @property
+    def reclaim_kills(self) -> int:
+        return self._count('reclaim_kill')
+
+    @property
+    def preemption_notices(self) -> int:
+        return self._count('preemption_notice')
+
+    @property
+    def scale_targets(self) -> List[int]:
+        return [d['target'] for d in self.decisions
+                if d['kind'] == 'scale_target']
+
+    @property
+    def completed(self) -> int:
+        return sum(1 for r in self.records if r['completed'])
+
+    @property
+    def shed(self) -> int:
+        return sum(1 for r in self.records if r['shed'])
+
+    @property
+    def resumed_requests(self) -> int:
+        return sum(1 for r in self.records if r.get('resumed'))
+
+    @property
+    def client_errors(self) -> List[Dict[str, Any]]:
+        """Client-visible failures: anything that neither completed
+        nor was an orderly admission shed (the zero-errors gates
+        assert this list is empty)."""
+        return [r for r in self.records
+                if not r['completed'] and not r['shed']]
+
+    def tenant_summary(self) -> Dict[str, Dict[str, Any]]:
+        from tests.load_tests import loadgen
+        return loadgen.tenant_summary(self.records)
+
+    def decision_log_jsonl(self) -> str:
+        """The byte-identity surface: same seed ⇒ identical string."""
+        return '\n'.join(
+            json.dumps(d, sort_keys=True) for d in self.decisions)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            'scenario': self.scenario, 'seed': self.seed,
+            'virtual_events': self.events_run,
+            'wall_s': round(self.wall_s, 3),
+            'requests': len(self.records),
+            'completed': self.completed, 'shed': self.shed,
+            'client_errors': len(self.client_errors),
+            'resumed_requests': self.resumed_requests,
+            'launches': self.launches, 'drains': self.drains,
+            'preemption_notices': self.preemption_notices,
+            'reclaim_kills': self.reclaim_kills,
+            'scale_targets': self.scale_targets,
+            'ready_replicas': self.lb_metrics.get('ready_replicas'),
+            'lb_ttft_p50_s': self.lb_metrics.get('ttft_p50_s'),
+            'lb_ttft_p99_s': self.lb_metrics.get('ttft_p99_s'),
+        }
+
+
+class DigitalTwin:
+    """One replay of one scenario at one seed."""
+
+    SERVICE = 'twin'
+
+    def __init__(self, scenario: Scenario, seed: int = 0, *,
+                 keep_home: bool = False) -> None:
+        self.sc = scenario
+        self.seed = seed
+        self.keep_home = keep_home
+        self.kernel = kernel_lib.Kernel()
+        self.report = SimReport(scenario.name, seed)
+        self._perf = self._make_perf()
+        self._cloud: Optional[cloud_lib.VirtualCloud] = None
+        self._lb: Optional[transport_lib.TwinLoadBalancer] = None
+        self._controller = None
+
+    # ---- pieces --------------------------------------------------------
+    def _make_perf(self) -> replica_lib.PerfModel:
+        if self.sc.bench_json:
+            return replica_lib.PerfModel.from_bench_json(
+                self.sc.bench_json, scale=self.sc.perf_scale)
+        return replica_lib.PerfModel.default(scale=self.sc.perf_scale)
+
+    def _log(self, kind: str, **fields: Any) -> None:
+        self.report.decisions.append(
+            {'t': round(self.kernel.now, 6),
+             'seq': len(self.report.decisions), 'kind': kind,
+             **fields})
+
+    def _make_replica(self, url: str) -> replica_lib.ModelReplica:
+        cfg = sched_lib.SchedulerConfig(
+            max_queue_requests=self.sc.max_queue_requests,
+            max_queue_tokens=self.sc.max_queue_tokens,
+            tenant_weights=self.sc.tenant_weights)
+        return replica_lib.ModelReplica(
+            self.kernel, url, scheduler=self.sc.scheduler,
+            sched_config=cfg, slots=self.sc.slots, perf=self._perf)
+
+    def _model_by_url(self, url: str):
+        s = self._cloud.by_url.get(url)
+        return s.model if s is not None else None
+
+    def _service_config(self) -> Dict[str, Any]:
+        sc = self.sc
+        policy: Dict[str, Any] = {'min_replicas': sc.replicas}
+        if sc.max_replicas is not None:
+            policy['max_replicas'] = sc.max_replicas
+        if sc.queue_length_threshold is not None:
+            policy['queue_length_threshold'] = sc.queue_length_threshold
+        policy['upscale_delay_seconds'] = sc.upscale_delay_s
+        policy['downscale_delay_seconds'] = sc.downscale_delay_s
+        return {
+            'readiness_probe': {
+                'path': '/health',
+                'initial_delay_seconds': sc.initial_delay_s,
+                'success_threshold': 1, 'failure_threshold': 3},
+            'replica_policy': policy,
+            'load_balancing_policy': sc.lb_policy,
+        }
+
+    # ---- traffic -------------------------------------------------------
+    def _synthesize(self) -> list:
+        from tests.load_tests import loadgen
+        return loadgen.synthesize(
+            self.seed, self.sc.tenants,
+            duration_s=max(0.0,
+                           self.sc.duration_s - self.sc.traffic_start_s))
+
+    def _fire_request(self, ev) -> None:
+        payload = {'tokens': ev.tokens,
+                   'max_new_tokens': ev.max_new_tokens,
+                   'stream': True, 'tenant': ev.tenant}
+        req = transport_lib.SimRequest(
+            '/generate', json.dumps(payload).encode(),
+            headers={common.TENANT_HEADER: ev.tenant})
+        t0 = self.kernel.now
+        fut = self.kernel.spawn(self._lb.handle(req))
+        fut.add_done_callback(
+            lambda f: self._on_request_done(ev, t0, f))
+
+    def _on_request_done(self, ev, t0: float,
+                         fut: kernel_lib.SimFuture) -> None:
+        rec: Dict[str, Any] = {
+            'tenant': ev.tenant, 'shed': False, 'completed': False,
+            'resumed': 0, 'tokens': 0, 'ttft': None,
+            'queue_wait': None, 'steps_waited': None,
+            'finish_reason': None, 'itls': []}
+        try:
+            resp = fut.result()
+        except BaseException as e:  # noqa: BLE001 — a gate failure, kept loud
+            rec['finish_reason'] = f'exception_{type(e).__name__}: {e}'
+            self.report.records.append(rec)
+            self._log('request', tenant=ev.tenant,
+                      outcome=rec['finish_reason'])
+            return
+        if isinstance(resp, transport_lib.SimStreamResponse):
+            done_line = None
+            token_ids: List[int] = []
+            for line in resp.lines():
+                toks = line.get('tokens')
+                if isinstance(toks, list):
+                    rec['tokens'] += len(toks)
+                    token_ids.extend(toks)
+                if line.get('done'):
+                    done_line = line
+                if 'error' in line:
+                    rec['finish_reason'] = 'stream_error'
+            if done_line is not None and rec['finish_reason'] is None:
+                rec['completed'] = True
+                # Bit-identity audit: whatever failovers/resumes
+                # happened on the way, a completed stream's delivered
+                # tokens must equal the deterministic unkilled
+                # continuation, full length — no loss, no dupes.
+                rec['tokens_ok'] = (
+                    token_ids == replica_lib.expected_continuation(
+                        ev.tokens, ev.max_new_tokens))
+                rec['finish_reason'] = done_line.get('finish_reason')
+                rec['resumed'] = int(done_line.get('resumed') or 0)
+                rec['queue_wait'] = done_line.get('queue_wait_s')
+                rec['steps_waited'] = done_line.get('steps_waited')
+            elif rec['finish_reason'] is None:
+                rec['finish_reason'] = 'truncated'
+        else:
+            status = getattr(resp, 'status', None)
+            if status in (429, 503):
+                rec['shed'] = True
+                rec['finish_reason'] = f'shed_{status}'
+            else:
+                rec['finish_reason'] = f'http_{status}'
+        self.report.records.append(rec)
+        self._log('request', tenant=ev.tenant,
+                  outcome=rec['finish_reason'],
+                  tokens=rec['tokens'], resumed=rec['resumed'])
+
+    # ---- faults --------------------------------------------------------
+    def _apply_fault(self, fault: Fault) -> None:
+        rng = random.Random(f'fault/{self.seed}/{fault.kind}/{fault.t}')
+        cloud = self._cloud
+        if fault.kind == 'reclaim_storm':
+            victims = [s for s in cloud.live_slices() if s.is_spot]
+            n = max(1, round(len(victims) * fault.frac))
+            chosen = rng.sample(victims, min(n, len(victims)))
+            self._log('storm', victims=len(chosen),
+                      fleet=len(victims))
+            for s in chosen:
+                if rng.random() < fault.notice_frac:
+                    cloud.reclaim(s.cluster_name,
+                                  notice_lead_s=fault.notice_lead_s)
+                else:
+                    cloud.reclaim(s.cluster_name)
+        elif fault.kind == 'zone_outage':
+            cloud.zone_outage(fault.zone)
+        elif fault.kind == 'brownout':
+            live = cloud.live_slices()
+            n = max(1, round(len(live) * fault.frac))
+            chosen = rng.sample(live, min(n, len(live)))
+            self._log('brownout', victims=len(chosen),
+                      factor=fault.factor,
+                      duration_s=fault.duration_s)
+            for s in chosen:
+                s.model.slow_factor = fault.factor
+                self.kernel.call_later(
+                    fault.duration_s,
+                    lambda m=s.model: setattr(m, 'slow_factor', 1.0))
+        elif fault.kind == 'wedge':
+            live = cloud.live_slices()
+            chosen = rng.sample(live, min(fault.count, len(live)))
+            self._log('wedge', victims=[s.cluster_name for s in chosen],
+                      duration_s=fault.duration_s)
+            for s in chosen:
+                s.model.wedged = True
+                self.kernel.call_later(
+                    fault.duration_s,
+                    lambda m=s.model: setattr(m, 'wedged', False))
+        else:
+            raise ValueError(f'unknown fault kind {fault.kind!r}')
+
+    # ---- control loops -------------------------------------------------
+    def _watch_breakers(self) -> None:
+        """Log breaker state EDGES into the decision log (the
+        breaker-flap gate asserts open ↦ re-closed; the REAL breaker
+        decides, the twin only observes)."""
+        open_now = {u for u, s in self._lb.breaker.snapshot().items()
+                    if s != retry_lib.STATE_CLOSED}
+        prev = getattr(self, '_breakers_open', set())
+        for url in sorted(open_now - prev):
+            self._log('breaker_open', url=url)
+        for url in sorted(prev - open_now):
+            self._log('breaker_closed', url=url)
+        self._breakers_open = open_now
+
+    def _controller_tick(self) -> None:
+        before = self._controller.autoscaler.target_num_replicas
+        self._controller.tick(now=self.kernel.now)
+        after = self._controller.autoscaler.target_num_replicas
+        if after != before:
+            self._log('scale_target', target=after)
+
+    # ---- the replay ----------------------------------------------------
+    def run(self) -> SimReport:
+        home = tempfile.mkdtemp(prefix='sky-tpu-twin-')
+        prev_home = os.environ.get(common.HOME_ENV_VAR)
+        os.environ[common.HOME_ENV_VAR] = home
+        t_wall = time.perf_counter()
+        try:
+            with vclock.installed(self.kernel.clock):
+                self._setup()
+                self.kernel.run()
+                self.report.lb_metrics = self._lb.lb_metrics()
+        finally:
+            if prev_home is None:
+                os.environ.pop(common.HOME_ENV_VAR, None)
+            else:
+                os.environ[common.HOME_ENV_VAR] = prev_home
+            if not self.keep_home:
+                # Close the scratch DB's cached connection BEFORE the
+                # rmtree — an open handle would pin the unlinked file's
+                # disk space (and one fd per replay) until process exit.
+                db_lib.evict_under(home)
+                shutil.rmtree(home, ignore_errors=True)
+        self.report.wall_s = time.perf_counter() - t_wall
+        self.report.events_run = self.kernel.events_run
+        return self.report
+
+    def _setup(self) -> None:
+        sc = self.sc
+        # The replay's state DB is scratch (fresh dir, deleted after):
+        # skip fsync so 10k+ virtual-day commits don't buy durability
+        # nobody needs. Production DBs never see this pragma.
+        serve_state._db().conn.execute(  # noqa: SLF001
+            'PRAGMA synchronous=OFF')
+        task_yaml = yaml.safe_dump({
+            'name': 'twin-svc', 'run': 'serve',
+            'resources': {'use_spot': bool(sc.use_spot)}})
+        ok = serve_state.add_service(
+            self.SERVICE, json.dumps(self._service_config()), task_yaml,
+            lb_port=0, lb_policy=sc.lb_policy)
+        if not ok:
+            raise RuntimeError('twin service row already exists — '
+                               'scratch home is not scratch')
+        self._cloud = cloud_lib.VirtualCloud(
+            self.kernel, make_replica=self._make_replica,
+            log=self._log, zones=sc.zones,
+            provision_delay_s=sc.provision_delay_s, seed=self.seed)
+        executor = cloud_lib.SimExecutor(self.kernel)
+        self._controller = controller_lib.ServeController(
+            self.SERVICE, cloud=self._cloud, executor=executor)
+        self._lb = transport_lib.TwinLoadBalancer(
+            self.SERVICE, sc.lb_policy, clock=self.kernel.clock,
+            model_by_url=self._model_by_url)
+        # Override the env-derived cadences with the scenario's.
+        self._lb.sync_interval_s = sc.lb_sync_s
+        self._lb.stats_flush_s = sc.stats_flush_s
+        # Control loops at their virtual cadences. The kernel's
+        # trampoline drives the LB's REAL async bodies; every await
+        # inside resolves inline (the twin's _offload) so each spawn
+        # completes within its event.
+        self.kernel.every(sc.controller_tick_s, self._controller_tick,
+                          until=sc.duration_s)
+        def lb_sync() -> None:
+            self.kernel.spawn(self._lb._sync_once())  # noqa: SLF001
+            self._watch_breakers()
+
+        self.kernel.every(sc.lb_sync_s, lb_sync,
+                          start=sc.lb_sync_s * 0.5,
+                          until=sc.duration_s)
+        self.kernel.every(
+            sc.stats_flush_s,
+            lambda: self.kernel.spawn(self._lb._flush_stats_once()),  # noqa: SLF001
+            start=sc.stats_flush_s * 0.7, until=sc.duration_s)
+        # Traffic.
+        for ev in self._synthesize():
+            self.kernel.call_at(sc.traffic_start_s + ev.t,
+                                self._fire_request, ev)
+        # Faults.
+        for fault in sc.faults:
+            self.kernel.call_at(fault.t, self._apply_fault, fault)

@@ -23,10 +23,23 @@ DEADLINE_HEADER = 'X-SkyTpu-Deadline-S'
 TENANT_HEADER = 'X-SkyTpu-Tenant'
 
 
+# Directories base_dir() has already created this process: the call
+# sits on hot DB paths (every serve-state query resolves the root),
+# and an unconditional os.makedirs per call is measurable at fleet
+# scale (~1µs*4 syscalls x millions of state reads in the twin).
+_made_dirs: set = set()
+
+
 def base_dir() -> str:
     """Framework state root (~/.sky_tpu, overridable for tests)."""
     d = os.path.expanduser(os.environ.get(HOME_ENV_VAR, '~/.sky_tpu'))
-    os.makedirs(d, exist_ok=True)
+    # isdir-guarded memo: one cheap stat instead of four makedirs
+    # syscalls on the hot path, but a root deleted mid-process (test
+    # cleanup, operator rm -rf) is still recreated — direct writers
+    # like api_server.json depend on it.
+    if d not in _made_dirs or not os.path.isdir(d):
+        os.makedirs(d, exist_ok=True)
+        _made_dirs.add(d)
     return d
 
 

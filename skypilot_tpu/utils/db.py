@@ -270,6 +270,34 @@ def get_db(path: str, schema: str) -> Db:
         return _instances[key]
 
 
+def evict_under(root: str) -> None:
+    """Close and forget every cached handle for stores under ``root``.
+
+    For callers that create a scratch state home (the digital twin's
+    per-replay SKY_TPU_HOME) and delete it afterward: without eviction
+    the unlinked sqlite file's disk space and fd stay pinned by the
+    cached connection until process exit, one per replay. Only the
+    calling thread's connections can be closed (they are thread-local);
+    the process-wide registry entry is dropped too, so a later store at
+    the same path starts fresh."""
+    root = os.path.abspath(root) + os.sep
+    with _GLOBAL_LOCK:
+        for key in [k for k in _instances if k[0].startswith(root)]:
+            del _instances[key]
+    cache = getattr(_local, 'conns', None)
+    if cache is not None:
+        for key in list(cache):
+            # rsplit: the key is '<url-or-sqlite>::<path>' and a
+            # postgres URL may itself contain '::' (IPv6 literal) —
+            # the path is always the last component.
+            path = key.rsplit('::', 1)[1]
+            if os.path.abspath(path).startswith(root):
+                try:
+                    cache.pop(key).close()
+                except Exception:  # noqa: BLE001 — eviction is best-effort
+                    pass
+
+
 def ensure_columns(conn, migrations) -> None:
     """Apply add-column migrations to a live DB (CREATE IF NOT EXISTS
     does not evolve existing tables). `migrations` is a sequence of

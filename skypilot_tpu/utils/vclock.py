@@ -1,0 +1,114 @@
+"""The injectable clock seam (the digital twin's enabling refactor).
+
+Every control loop in ``serve/`` — the LB's replica sync and stats
+flush, the controller tick, the autoscaler hysteresis windows, replica
+provision/readiness timing, the serve-state gauge staleness checks —
+reads time through this module instead of calling ``time.time()`` /
+``time.monotonic()`` directly (SKY-ASYNC pins the discipline: a bare
+wall-clock read in ``serve/`` fails lint, docs/static-analysis.md).
+
+In production nothing changes: the installed clock is
+:data:`SYSTEM`, a pass-through to the ``time`` module. The fleet
+digital twin (``skypilot_tpu/sim/``, docs/robustness.md "Digital
+twin") installs a :class:`VirtualClock` for the duration of a replay,
+so a 24h diurnal trace against the REAL control-plane code advances in
+discrete virtual steps and finishes in seconds — deterministically,
+because no decision ever observes the machine's wall clock.
+
+Two dials on one face:
+
+- ``time()`` is the WALL clock: row timestamps, QPS windows, gauge
+  staleness, hysteresis anchors.
+- ``monotonic()`` is the INTERVAL clock: TTFT/ITL stopwatches, request
+  deadlines, breaker cooldowns.
+
+A virtual clock returns the same value for both (virtual time never
+steps backward), which also closes the historical seam where
+autoscalers used ``time.time()`` while the LB used
+``time.monotonic()`` — both now route here.
+
+Components should prefer an injected ``Clock`` parameter (defaulting
+to :func:`get`) so tests can drive them directly; module-level helpers
+(``serve/state.py``'s row stamps) read the process-global installation
+via :func:`now` / :func:`monotonic`.
+"""
+from __future__ import annotations
+
+import contextlib
+import time as _time
+from typing import Iterator
+
+
+class Clock:
+    """The system clock — and the interface a virtual clock implements."""
+
+    def time(self) -> float:
+        """Wall-clock seconds (``time.time`` semantics)."""
+        return _time.time()
+
+    def monotonic(self) -> float:
+        """Interval seconds (``time.monotonic`` semantics)."""
+        return _time.monotonic()
+
+
+class VirtualClock(Clock):
+    """A manually-advanced clock: ``time()`` and ``monotonic()`` both
+    read one virtual instant. Advancing is the owner's job (the sim
+    kernel advances it to each event's timestamp); it never moves on
+    its own and never goes backward."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._now = float(start)
+
+    def time(self) -> float:
+        return self._now
+
+    def monotonic(self) -> float:
+        return self._now
+
+    def advance_to(self, t: float) -> None:
+        if t < self._now:
+            raise ValueError(
+                f'virtual clock cannot rewind: {t} < {self._now}')
+        self._now = t
+
+
+SYSTEM = Clock()
+_current: Clock = SYSTEM
+
+
+def get() -> Clock:
+    """The process-wide installed clock (SYSTEM unless a sim replay is
+    running)."""
+    return _current
+
+
+def set_clock(clock: Clock) -> Clock:
+    """Install ``clock`` process-wide; returns the previous one so the
+    caller can restore it (prefer :func:`installed`)."""
+    global _current
+    prev = _current
+    _current = clock
+    return prev
+
+
+@contextlib.contextmanager
+def installed(clock: Clock) -> Iterator[Clock]:
+    """Scoped install: the digital twin wraps a whole replay in this so
+    an exploding scenario can never leak virtual time into the next
+    test's serve components."""
+    prev = set_clock(clock)
+    try:
+        yield clock
+    finally:
+        set_clock(prev)
+
+
+def now() -> float:
+    """Wall-clock read through the installed clock."""
+    return _current.time()
+
+
+def monotonic() -> float:
+    """Interval read through the installed clock."""
+    return _current.monotonic()

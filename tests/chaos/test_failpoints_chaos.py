@@ -263,7 +263,7 @@ def _start_lb(service_name: str, urls):
             time.sleep(0.1)
 
     def stop():
-        lb._running = False  # noqa: SLF001 — test teardown
+        lb.stop()            # wakes the idle wait immediately
         t.join(timeout=10)
 
     return lb, port, stop
@@ -368,10 +368,13 @@ def test_serve_probe_failpoint_marks_not_ready():
 
 
 # ---- zero-downtime serving (ISSUE 5): resume / drain / shed ---------------
-def _start_infer_server():
+def _start_infer_server(wait_ready: bool = True):
     """Real continuous-batching engine + aiohttp infer server on a
     loopback port, driven from a side-thread event loop (the chaos
-    cases need a replica whose /generate actually streams tokens)."""
+    cases need a replica whose /generate actually streams tokens).
+    ``wait_ready=False`` skips the engine warm — for cases that only
+    talk to the control endpoints (/drain), where paying a compile
+    would be pure wall clock."""
     import jax
     from aiohttp import web
 
@@ -404,10 +407,11 @@ def _start_infer_server():
     t = threading.Thread(target=run, daemon=True)
     t.start()
     assert started.wait(30)
-    deadline = time.time() + 180
-    while time.time() < deadline and not srv.ready:
-        time.sleep(0.1)
-    assert srv.ready, 'engine never warmed'
+    if wait_ready:
+        deadline = time.time() + 180
+        while time.time() < deadline and not srv.ready:
+            time.sleep(0.1)
+        assert srv.ready, 'engine never warmed'
 
     def stop():
         srv._stop.set()
@@ -681,3 +685,112 @@ def test_provision_create_retries_through_injected_failures(monkeypatch):
     client = agent_client.AgentClient.for_info(info)
     assert client.wait_job(job_id, timeout=60).value == 'SUCCEEDED'
     sky.down('fp-prov-c')
+
+
+def test_provision_bootstrap_failure_fails_loudly_not_wedged(monkeypatch):
+    """`provision.bootstrap` fires AFTER create, outside the create
+    Retrier: the bootstrap failure of a fresh slice fails the launch
+    LOUDLY (no silent absorption — it is not a transient create), and
+    the half-provisioned carcass does not wedge the name: `down` tears
+    it down cleanly and a relaunch under the SAME cluster name then
+    succeeds (the ad-hoc flavor of the managed path's terminate →
+    relaunch recovery)."""
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'provision.bootstrap=error:1@1')
+    task = _task('echo FP_BOOT_OK', name='fp-boot')
+    with pytest.raises(Exception):
+        execution.launch(task, cluster_name='fp-boot-c')
+    assert failpoints.fired('provision.bootstrap') == 1
+    sky.down('fp-boot-c')
+    job_id, info = execution.launch(task, cluster_name='fp-boot-c')
+    client = agent_client.AgentClient.for_info(info)
+    assert client.wait_job(job_id, timeout=60).value == 'SUCCEEDED'
+    sky.down('fp-boot-c')
+
+
+def test_agent_tail_retries_through_injected_errors(monkeypatch):
+    """`agent.tail=error:1@2` makes the agent daemon 500 the first two
+    /logs opens (the agent inherits the env at provision time); the
+    client's connection-establishment Retrier absorbs them and the
+    tail still delivers the job's output."""
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS', 'agent.tail=error:1@2')
+    monkeypatch.setenv('SKY_TPU_AGENT_RETRIES', '5')
+    task = _task('echo FP_TAIL_OK', name='fp-tail')
+    job_id, info = execution.launch(task, cluster_name='fp-tail-c')
+    client = agent_client.AgentClient.for_info(info)
+    assert client.wait_job(job_id, timeout=60).value == 'SUCCEEDED'
+    out = b''.join(client.tail_logs(job_id, follow=False))
+    assert b'FP_TAIL_OK' in out
+    # The injected failures really happened agent-side: the agent log
+    # carries the failpoint tracebacks the retries absorbed.
+    cdir = info.provider_config['cluster_dir']
+    with open(os.path.join(cdir, 'agent.log'), encoding='utf-8',
+              errors='replace') as f:
+        assert 'FailpointError' in f.read()
+    sky.down('fp-tail-c')
+
+
+def test_drain_hang_bounded_teardown_proceeds(monkeypatch):
+    """`infer.server.drain_hang=hang` parks the /drain answer far past
+    any deadline. The replica manager's one blocking drain call is
+    bounded client-side (`deadline_s + 10`): it returns None — drain
+    treated as done — so a wedged drain can never block replacement.
+    (No engine warm: /drain is a control endpoint.)"""
+    from skypilot_tpu.serve import replica_managers
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'infer.server.drain_hang=hang')
+    monkeypatch.setenv('SKY_TPU_FAILPOINT_HANG_S', '600')
+    srv, port, stop = _start_infer_server(wait_ready=False)
+    try:
+        t0 = time.time()
+        report = replica_managers.drain_replica(
+            f'http://127.0.0.1:{port}', deadline_s=0.2)
+        assert report is None, (
+            f'a hung drain answered: {report} — the client-side bound '
+            f'is gone')
+        assert time.time() - t0 < 60
+        assert failpoints.fired('infer.server.drain_hang') == 1
+    finally:
+        stop()
+
+
+def test_agent_health_errors_absorbed_by_wait_healthy(monkeypatch):
+    """`agent.health=error:1@3` makes a fresh agent 500 its first three
+    liveness checks (the agent inherits the env at provision time);
+    `wait_healthy` treats everything as transient on its 0.5s cadence,
+    so the launch rides through and the job still runs."""
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS', 'agent.health=error:1@3')
+    monkeypatch.setenv('SKY_TPU_AGENT_RETRIES', '5')
+    task = _task('echo FP_HEALTH_OK', name='fp-health')
+    job_id, info = execution.launch(task, cluster_name='fp-health-c')
+    client = agent_client.AgentClient.for_info(info)
+    assert client.wait_job(job_id, timeout=60).value == 'SUCCEEDED'
+    cdir = info.provider_config['cluster_dir']
+    with open(os.path.join(cdir, 'agent.log'), encoding='utf-8',
+              errors='replace') as f:
+        assert 'FailpointError' in f.read()
+    sky.down('fp-health-c')
+
+
+def test_terminate_failure_never_wedges_recovery(monkeypatch):
+    """The `provision.terminate` contract: teardown is best-effort at
+    EVERY caller. A preemption whose terminate dispatch FAILS must
+    still recover the managed job to SUCCEEDED — cleanup is never on
+    the critical path. (The park is short: with the injected terminate
+    failure the fake slice's old gang survives, and the recovered
+    submit queues behind it in the agent's FIFO — on a real cloud the
+    preempted gang is simply gone.)"""
+    monkeypatch.setenv(
+        'SKY_TPU_FAILPOINTS',
+        'jobs.provider.preempted=error:1@1,provision.terminate=error:1@1')
+    run = ('if [ "${SKY_TPU_RECOVERY_COUNT:-0}" -ge 1 ]; then exit 0; '
+           'fi; sleep 20')
+    monkeypatch.setattr(scheduler, '_spawn_controller',
+                        lambda job_id: None)
+    job_id = jobs.launch(
+        _task(run, use_spot=True, job_recovery='EAGER_FAILOVER'))
+    final = controller_lib.JobController(job_id).run()
+    assert final == ManagedJobStatus.SUCCEEDED
+    assert failpoints.fired('provision.terminate') == 1
+    record = jobs_state.get_job(job_id)
+    assert record['recovery_count'] >= 1

@@ -1,0 +1,77 @@
+"""Failpoint-coverage canary (docs/robustness.md "Site catalog").
+
+SKY-REGISTRY keeps docs ↔ code in sync: every ``hit()`` site in the
+package has a catalog row and every row names a live site. This canary
+extends the same two-way contract to code ↔ tests:
+
+- every cataloged site is EXERCISED by at least one chaos or sim test
+  (a failpoint nobody fires is a recovery path nobody proves — the
+  catalog must not outgrow the suite);
+- every site a test arms exists in the catalog (arming a typo'd name
+  injects nothing: the run goes green while testing nothing, the worst
+  failure mode a chaos suite has).
+
+Lexical on purpose, like SKY-REGISTRY itself: the site string must
+appear in a test source under ``tests/chaos/`` or ``tests/sim/``.
+The production mirrors in ``skypilot_tpu/sim/transport.py`` are site
+DECLARATIONS, not exercises, and are deliberately out of scope.
+"""
+import os
+import re
+from typing import Iterator, Set, Tuple
+
+from skypilot_tpu.analysis import registry_check
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     '..', '..'))
+_TEST_DIRS = (os.path.join(_REPO, 'tests', 'chaos'),
+              os.path.join(_REPO, 'tests', 'sim'))
+
+# An armed spec entry: `<site>=<action>` with a dotted site name
+# (the SKY_TPU_FAILPOINTS grammar in utils/failpoints.py).
+_ARM_RE = re.compile(r'([a-z_]+(?:\.[a-z_]+)+)=(?:error|delay|hang)')
+
+
+def _test_sources() -> Iterator[Tuple[str, str]]:
+    for d in _TEST_DIRS:
+        for name in sorted(os.listdir(d)):
+            if not name.endswith('.py'):
+                continue
+            path = os.path.join(d, name)
+            with open(path, encoding='utf-8') as f:
+                yield os.path.relpath(path, _REPO), f.read()
+
+
+def _catalog() -> Set[str]:
+    parsed = registry_check._doc_section_names(
+        os.path.join(_REPO, 'docs'), 'robustness.md', '### Site catalog')
+    assert parsed is not None, (
+        'docs/robustness.md "### Site catalog" no longer parses')
+    names, _ = parsed
+    assert len(names) >= 10, f'catalog collapsed to {len(names)} sites'
+    return names
+
+
+def test_every_cataloged_site_is_exercised():
+    sources = list(_test_sources())
+    assert len(sources) >= 4, 'test-source scan came up empty'
+    missing = sorted(site for site in _catalog()
+                     if not any(site in text for _, text in sources))
+    assert not missing, (
+        f'cataloged failpoint sites with NO chaos/sim test exercising '
+        f'them: {missing} — add a case to tests/chaos/ or tests/sim/ '
+        f'(or retire the site and its docs/robustness.md row)')
+
+
+def test_every_armed_site_is_cataloged():
+    catalog = _catalog()
+    # This file's own grammar example would self-trip; skip it.
+    me = os.path.relpath(__file__, _REPO)
+    rogue = sorted({(rel, site) for rel, text in _test_sources()
+                    if rel != me
+                    for site in _ARM_RE.findall(text)
+                    if site not in catalog})
+    assert not rogue, (
+        f'tests arm failpoint sites missing from the catalog (typo? '
+        f'retired site?): {rogue} — an unknown site never fires, so '
+        f'the test is green while injecting nothing')

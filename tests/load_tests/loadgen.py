@@ -26,11 +26,12 @@ from __future__ import annotations
 import concurrent.futures
 import dataclasses
 import json
+import math
 import random
 import time
 import urllib.error
 import urllib.request
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 @dataclasses.dataclass
@@ -62,6 +63,59 @@ def _block(rng: random.Random, n: int) -> List[int]:
     return [2 + rng.randrange(200) for _ in range(n)]
 
 
+def rate_envelope(spec: Any) -> Optional[Tuple[Callable[[float], float],
+                                               float]]:
+    """Compile a tenant's ``envelope`` spec into ``(multiplier(t),
+    peak)`` — the rate SHAPE over (virtual) trace time that the
+    digital twin and ``bench_ttft --sweep tenants`` both replay.
+    ``rps`` stays the rate at multiplier 1.0. Shapes:
+
+    - ``{'kind': 'diurnal', 'period_s': 86400, 'low': 0.2}`` — a
+      sinusoid from ``low`` (trough, at t=0) up to 1.0 (peak at
+      period/2): the classic day curve.
+    - ``{'kind': 'flash', 'at': t0, 'duration_s': d, 'mult': m}`` —
+      baseline 1.0 with an ``m``x flash crowd during [t0, t0+d).
+    - ``[[t, mult], ...]`` — piecewise-linear breakpoints (held flat
+      before the first and after the last).
+
+    Returns None for no envelope (the constant-rate legacy shape)."""
+    if spec is None:
+        return None
+    if isinstance(spec, dict):
+        kind = spec.get('kind')
+        if kind == 'diurnal':
+            period = float(spec.get('period_s', 86400.0))
+            low = float(spec.get('low', 0.2))
+            span = 1.0 - low
+
+            def diurnal(t: float) -> float:
+                return low + span * 0.5 * (
+                    1.0 - math.cos(2.0 * math.pi * t / period))
+            return diurnal, 1.0
+        if kind == 'flash':
+            t0 = float(spec['at'])
+            t1 = t0 + float(spec.get('duration_s', 60.0))
+            mult = float(spec.get('mult', 10.0))
+
+            def flash(t: float) -> float:
+                return mult if t0 <= t < t1 else 1.0
+            return flash, max(1.0, mult)
+        raise ValueError(f'unknown envelope kind {kind!r} '
+                         f"(have: 'diurnal', 'flash', or breakpoints)")
+    points = sorted((float(t), float(m)) for t, m in spec)
+    if not points:
+        return None
+
+    def piecewise(t: float) -> float:
+        if t <= points[0][0]:
+            return points[0][1]
+        for (ta, ma), (tb, mb) in zip(points, points[1:]):
+            if t < tb:
+                return ma + (mb - ma) * (t - ta) / (tb - ta)
+        return points[-1][1]
+    return piecewise, max(m for _, m in points)
+
+
 def synthesize(seed: int, tenants: Dict[str, Dict[str, Any]],
                duration_s: float = 2.0) -> List[TraceEvent]:
     """Build a deterministic trace. Per-tenant spec keys (all
@@ -81,6 +135,12 @@ def synthesize(seed: int, tenants: Dict[str, Dict[str, Any]],
       (default None)
     - ``start`` / ``until``: active window inside the trace
       (defaults 0 / duration_s)
+    - ``envelope``: a rate SHAPE over trace time (see
+      :func:`rate_envelope`): diurnal day-curves and flash crowds for
+      the digital twin's 24h replays and ``bench_ttft --sweep
+      tenants``. ``rps`` is the rate at multiplier 1.0; arrivals are
+      thinned deterministically (same seed → same trace). Absent ⇒
+      the legacy constant-rate shape, byte-identical to before.
     """
     events: List[TraceEvent] = []
     for name in sorted(tenants):
@@ -99,12 +159,26 @@ def synthesize(seed: int, tenants: Dict[str, Dict[str, Any]],
         deadline_s = spec.get('deadline_s')
         start = float(spec.get('start', 0.0))
         until = float(spec.get('until', duration_s))
+        envelope = rate_envelope(spec.get('envelope'))
         cohorts = [(f'{name}/c{i}',
                     _block(random.Random(f'{seed}/{name}/cohort{i}'),
                            prefix_tokens))
                    for i in range(2)]
         t = start
         while t < until:
+            if envelope is not None:
+                # Non-homogeneous arrivals by THINNING: candidate
+                # bursts are drawn at the envelope's PEAK rate (the
+                # expovariate below) and each is accepted with
+                # probability multiplier(t)/peak — the standard
+                # Lewis-Shedler construction, deterministic for a
+                # fixed seed. The no-envelope path draws exactly the
+                # sequence it always did (old traces stay
+                # byte-identical).
+                mult, peak = envelope
+                if rng.random() >= mult(t) / peak:
+                    t += rng.expovariate(rps * peak / burst)
+                    continue
             for b in range(burst):
                 n = max(1, min(prompt_max,
                                int(prompt_mean
@@ -124,8 +198,10 @@ def synthesize(seed: int, tenants: Dict[str, Dict[str, Any]],
                     cohort=cohort, disconnect_after=disconnect,
                     deadline_s=deadline_s))
             # Bursty inter-arrival: exponential gaps between bursts at
-            # the burst rate, so the mean request rate stays ~rps.
-            t += rng.expovariate(rps / burst)
+            # the burst rate, so the mean request rate stays ~rps (the
+            # thinning above scales it by the envelope's multiplier).
+            t += rng.expovariate(
+                rps * (envelope[1] if envelope else 1.0) / burst)
     events.sort(key=lambda e: e.t)
     return events
 

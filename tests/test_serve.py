@@ -220,7 +220,7 @@ def test_service_up_ready_proxy_down():
         except Exception:
             time.sleep(0.3)
     assert ok, 'LB never proxied a request'
-    lb._running = False  # noqa: SLF001
+    lb.stop()
 
     # status() surfaces it; down() cleans everything.
     snap = serve.status('svc-e2e')[0]
@@ -644,7 +644,7 @@ def test_queue_pressure_scales_replicas_e2e(sky_tpu_home, tmp_path):
             timeout=90)
     finally:
         stop.set()
-        lb._running = False  # noqa: SLF001
+        lb.stop()
     # The scale-up decision came from queue pressure.
     assert serve_state.get_inflight('svc-qp') >= 1
     serve.down('svc-qp')
@@ -856,7 +856,7 @@ def test_lb_tls_termination_e2e(sky_tpu_home, tmp_path):
     with pytest.raises(requests_lib.exceptions.SSLError):
         tls_lib.pinned_session('0' * 64).get(lb_url, timeout=5)
 
-    lb._running = False  # noqa: SLF001
+    lb.stop()
     serve.down('svc-tls')
 
 

@@ -1080,8 +1080,15 @@ def test_lockflow_covers_trace_reachability():
     # engine lock provably flows into the scheduler contract.
     assert sum(1 for v in flow.may_entry.values()
                if 'InferenceEngine._lock' in v) >= 20
+    # The MUST-entry proof is asserted on the PRODUCTION tree: the
+    # digital twin (sim/) drives real scheduler instances lock-free
+    # from its single kernel thread — the audited SKY-LOCK allowlist
+    # carve-out — and those extra call sites would (correctly) break
+    # the every-caller-holds-it intersection.
+    prod_flow = lockflow.analyze(
+        [f for f in files if not f.rel.startswith('sim/')])
     sched_admit = ('infer/sched/base.py', 'Scheduler.admit')
-    assert 'InferenceEngine._lock' in flow.must_entry[sched_admit]
+    assert 'InferenceEngine._lock' in prod_flow.must_entry[sched_admit]
 
 
 def test_lint_wall_clock_canary():
@@ -1134,8 +1141,11 @@ def test_package_run_has_real_coverage():
     report = analysis.run(allowlist={})
     counts = report.counts
     # The migrated grep-lint pins (see analysis/allowlist.py).
+    # serve/controller.py left the list in PR 13: its tick loop waits
+    # on the shutdown Event now — zero sleep sites is the CORRECT
+    # count there, so it can no longer serve as a coverage canary.
     for key in ('client/sdk.py:SKY-ASYNC',
-                'serve/controller.py:SKY-ASYNC',
+                'serve/__init__.py:SKY-ASYNC',
                 'serve/load_balancer.py:SKY-ASYNC',
                 'infer/multihost.py:SKY-ASYNC',
                 'serve/load_balancer.py:SKY-EXCEPT'):

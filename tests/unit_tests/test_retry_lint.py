@@ -21,12 +21,15 @@ contract its predecessor pinned:
 from skypilot_tpu import analysis
 
 # The audited pins carried over from the grep lint, file for file.
+# PR 13 (digital twin) RETIRED two of the original six: the
+# controller tick loop waits on its shutdown Event (0 sleeps) and the
+# LB run() idle loop is event-driven (3 → 2, sync + stats cadences
+# remain) — the ratchet moved down, never up.
 _LEGACY_PINS = {
     'client/sdk.py:SKY-ASYNC': 2,        # get() + wait_job polls
     'runtime/agent_client.py:SKY-ASYNC': 1,   # wait_job status poll
-    'serve/controller.py:SKY-ASYNC': 2,  # controller tick cadence
     'serve/__init__.py:SKY-ASYNC': 2,    # serve up/down status polls
-    'serve/load_balancer.py:SKY-ASYNC': 3,    # sync/stats/run ticks
+    'serve/load_balancer.py:SKY-ASYNC': 2,    # sync/stats cadences
     'infer/multihost.py:SKY-ASYNC': 1,   # lockstep watchdog heartbeat
 }
 
