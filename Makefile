@@ -6,7 +6,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke
+.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke sim-crash-sweep
 
 lint:
 	$(PYTHON) -m skypilot_tpu.client.cli lint --changed
@@ -43,3 +43,12 @@ profile-smoke:
 # byte mismatch between the two same-seed runs.
 sim-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.sim --scenario reclaim_storm --verify-determinism
+
+# Kill-anywhere crash-consistency sweep (docs/robustness.md "Crash
+# safety"): replay the crash_sweep storm once unkilled, then once per
+# control-plane decision boundary with a virtual kill -9 of the
+# controller (and separately the LB) injected there; run the whole
+# sweep twice and fail on any client-visible error, convergence
+# mismatch, non-idempotent recovery, or decision-log byte mismatch.
+sim-crash-sweep:
+	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.sim --crash-sweep --verify-determinism

@@ -874,6 +874,11 @@ def serve_up(task_yaml: str, service_name: Optional[str], env: tuple,
             f'Starting service {service_name or task.name or task_yaml} '
             f'({task.resources!r} per replica). Proceed?', abort=True)
     out = _serve_engine().up(task, service_name)
+    if out.get('respawned'):
+        click.echo(f'Re-attached a controller to existing service '
+                   f'{out["name"]} (crash recovery).')
+    if out.get('warning'):
+        click.echo(f'WARNING: {out["warning"]}')
     click.echo(f'Service: {out["name"]}  endpoint: {out["endpoint"]}')
     click.echo(f'Watch replicas: sky-tpu serve status {out["name"]}')
 
@@ -926,6 +931,18 @@ def serve_status(service_name: Optional[str]) -> None:
     for s in snaps:
         click.echo(f'{s["name"]}: {s["status"]} v{s["version"]} '
                    f'endpoint={s["endpoint"]} policy={s["policy"]}')
+        if s.get('degraded_reason'):
+            # Stale-pid detection (docs/robustness.md "Crash safety"):
+            # the controller process is dead — say how to recover.
+            click.echo(f'  !! {s["degraded_reason"]}')
+            # Open intents are a normal in-flight journal when the
+            # controller lives (every launch holds one while
+            # provisioning); they are only an ALARM when nothing is
+            # left alive to finish them.
+            if s.get('intents_open'):
+                click.echo(f'  !! {s["intents_open"]} lifecycle '
+                           f'intent(s) open — recovery owed to the '
+                           f'respawned controller')
         fmt = '  {:<4} {:<22} {:<14} {:<4} {:<24}'
         click.echo(fmt.format('ID', 'CLUSTER', 'STATUS', 'VER', 'URL'))
         for r in s['replicas']:

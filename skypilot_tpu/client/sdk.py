@@ -93,6 +93,32 @@ def call(op: str, payload: Optional[Dict[str, Any]] = None) -> Any:
     return get(resp['request_id'])
 
 
+def _http_transient(exc: BaseException) -> bool:
+    """SDK GET retry classification: connection trouble is transient;
+    HTTP status errors are the server answering — NOT transient, with
+    one exception: 429/503 are the server saying "come back later"
+    (admission shed / draining), and a GET is idempotent, so they
+    retry honoring the server's Retry-After as the backoff floor."""
+    if isinstance(exc, requests_lib.HTTPError):
+        resp = exc.response
+        return resp is not None and resp.status_code in (429, 503)
+    return isinstance(exc, requests_lib.RequestException)
+
+
+def _http_retry_after(exc: BaseException) -> Optional[float]:
+    """Server-supplied backoff floor: the Retry-After header the serve
+    stack computes as a queue-drain estimate (PR 7) — emitted on every
+    429/503 and, until now, ignored by this retry path."""
+    resp = getattr(exc, 'response', None)
+    if resp is None:
+        return None
+    ra = resp.headers.get('Retry-After')
+    try:
+        return float(ra) if ra is not None else None
+    except (TypeError, ValueError):
+        return None   # HTTP-date form (or garbage): no floor
+
+
 def _http_get(path: str, *, timeout=30, stream: bool = False,
               retries: int = 3):
     """GET with the same error contract as _post: connection trouble and
@@ -100,8 +126,10 @@ def _http_get(path: str, *, timeout=30, stream: bool = False,
     exceptions (clients catch SkyTpuError only).
 
     GETs are idempotent — transient connection failures (server restart,
-    flaky proxy; the chaos suite injects exactly this) retry through the
-    shared Retrier (utils/retry.py) before surfacing.
+    flaky proxy; the chaos suite injects exactly this) and 429/503
+    sheds retry through the shared Retrier (utils/retry.py) before
+    surfacing, honoring a server-supplied Retry-After as the backoff
+    floor.
     """
     url = server_url()
 
@@ -111,16 +139,12 @@ def _http_get(path: str, *, timeout=30, stream: bool = False,
         r.raise_for_status()
         return r
 
-    def _transient(exc: BaseException) -> bool:
-        # HTTP status errors are the server answering — not transient.
-        return (isinstance(exc, requests_lib.RequestException) and
-                not isinstance(exc, requests_lib.HTTPError))
-
     try:
         return retry_lib.Retrier(
             'sdk.get', max_attempts=retries + 1, base_delay_s=0.4,
             max_delay_s=5.0, transient=(),
-            retry_on=_transient).call(_once)
+            retry_on=_http_transient,
+            retry_after=_http_retry_after).call(_once)
     except requests_lib.HTTPError as e:
         detail = ''
         try:

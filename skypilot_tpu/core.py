@@ -142,6 +142,32 @@ def stop(cluster_name: str) -> None:
                                             terminate=False)
 
 
+def terminate_carcass_by_name(cluster_name: str,
+                              cloud: Optional[str]) -> bool:
+    """Best-effort provider terminate of a slice with NO saved provider
+    handle — the half-provisioned carcass a launch leaves when it dies
+    between create and the UP write, or a crashed serve controller
+    leaves between cloud-call and DB-write (the reconcile-by-name path
+    shared by ``down`` and ``ReplicaManager.reconcile``). Returns True
+    when the provider call went through. Without a saved
+    provider_config some providers cannot locate the slice (the local
+    provider resolves by name; GCP needs the zone), so False means
+    "check the console for a leaked slice", never an exception —
+    teardown is off the critical path (docs/robustness.md)."""
+    if not cloud:
+        return False
+    try:
+        provision.terminate_instances(cloud, cluster_name, {})
+        return True
+    except Exception:  # noqa: BLE001 — carcass cleanup is best-effort
+        logger.warning(
+            'carcass terminate of %s on %s failed — the create may '
+            'have succeeded before the launch died, so a provider-side '
+            'slice can be leaked; verify in the cloud console',
+            cluster_name, cloud, exc_info=True)
+        return False
+
+
 def down(cluster_name: str) -> None:
     """Reference sky/core.py:798."""
     with locks.cluster_lock(cluster_name):
@@ -151,26 +177,13 @@ def down(cluster_name: str) -> None:
             # and the UP write (e.g. a bootstrap failure), so no
             # provider handle was ever saved. Tear down best-effort by
             # name and free the record — a wedged INIT row must never
-            # force a rename (teardown is never on the critical path,
-            # docs/robustness.md).
+            # force a rename.
             cloud = (record.get('resources') or {}).get('cloud')
-            detail = 'down (half-provisioned carcass)'
-            if cloud:
-                try:
-                    # Best-effort: without a saved provider_config some
-                    # providers cannot locate the slice (the local
-                    # provider resolves by name; GCP needs the zone).
-                    provision.terminate_instances(cloud, cluster_name, {})
-                except Exception:  # noqa: BLE001 — carcass cleanup is best-effort
-                    detail = ('down (half-provisioned carcass; provider '
-                              'terminate FAILED — check the console for '
-                              'a leaked slice)')
-                    logger.warning(
-                        'carcass terminate of %s on %s failed — the '
-                        'create may have succeeded before the launch '
-                        'died, so a provider-side slice can be leaked; '
-                        'verify in the cloud console', cluster_name,
-                        cloud, exc_info=True)
+            ok = terminate_carcass_by_name(cluster_name, cloud)
+            detail = ('down (half-provisioned carcass)' if ok or not cloud
+                      else 'down (half-provisioned carcass; provider '
+                           'terminate FAILED — check the console for '
+                           'a leaked slice)')
             state.remove_cluster(cluster_name)
             state.add_cluster_event(cluster_name, 'TERMINATED', detail)
             return

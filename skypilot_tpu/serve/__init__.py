@@ -79,6 +79,35 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
         name, json.dumps(spec.to_config()), task.to_yaml(), lb_port,
         spec.load_balancing_policy)
     if not ok:
+        # Crash recovery (docs/robustness.md "Crash safety"): a name
+        # collision with a service whose controller pid is DEAD is not
+        # a conflict — it is the respawn path. The existing row (and
+        # its replicas, and its intent journal) are the service; a new
+        # process re-attaches, and the controller's startup
+        # reconciliation replays whatever the dead one left half-done.
+        record = serve_state.get_service(name)
+        pid = (record or {}).get('controller_pid')
+        if (record is not None and not record.get('pool')
+                and pid and not common.pid_alive(pid)
+                and not record['status'].is_terminal()):
+            # The STORED spec is what respawns — a changed task on the
+            # respawn path must not silently apply (or silently
+            # vanish): say so, and point at `serve.update`.
+            warning = None
+            if spec.to_config() != record['spec']:
+                warning = (
+                    f'service {name!r} respawned on its STORED spec; '
+                    f'the task you passed differs — run `sky-tpu '
+                    f'serve update {name} <task>` to roll it out')
+            if _spawn:
+                service_lib.spawn_detached(name)
+            scheme = 'https' if (record.get('spec') or {}).get('tls') \
+                else 'http'
+            return {'name': name,
+                    'endpoint':
+                        f'{scheme}://127.0.0.1:{record["lb_port"]}',
+                    'respawned': True,
+                    'warning': warning}
         raise exceptions.InvalidTaskError(
             f'service {name!r} already exists; use `serve.update` to '
             f'roll it, or pick another name')

@@ -21,6 +21,8 @@ from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import spec as spec_lib
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.serve.state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.utils import common
+from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils import vclock
 
 logger = logging.getLogger(__name__)
@@ -116,6 +118,13 @@ class ServeController:
 
     # -- one tick ----------------------------------------------------------
     def tick(self, now: Optional[float] = None) -> None:
+        # Chaos seam: a process kill at a tick boundary. run() lets the
+        # injected FailpointError out WITHOUT the FAILED write — the
+        # service row keeps its (now stale) controller_pid, exactly the
+        # state a kill -9 leaves; `serve status` flags it DEGRADED and
+        # `serve up` respawns + reconciles (docs/robustness.md
+        # "Crash safety").
+        failpoints.hit('serve.controller.crash')
         # Clock seam: every time-based decision this tick makes (probe
         # grace, hysteresis, stats pruning) reads ONE instant, so a
         # virtual-time replay is coherent within the tick.
@@ -169,7 +178,8 @@ class ServeController:
                 if self.spec.pool and r.get('assigned_job'):
                     continue   # drain pool workers only when idle
                 self.rm.terminate_replica(r['replica_id'],
-                                          'superseded version')
+                                          'superseded version',
+                                          replace=True)
         # Scale down excess current-version replicas.
         if to_launch < 0:
             victims = self._scale_down_victims(current, -to_launch)
@@ -213,6 +223,13 @@ class ServeController:
                     self.service_name, os.getpid())
         serve_state.set_controller_pid(self.service_name, os.getpid())
         try:
+            # Startup reconciliation (docs/robustness.md "Crash
+            # safety"): before the first tick, replay any intents a
+            # previous controller left open against cloud reality —
+            # adopt healthy orphans, finish half-done drains,
+            # terminate carcasses. A fresh service has no journal and
+            # pays one empty table scan.
+            self.rm.reconcile()
             while not self._stop.is_set():
                 if serve_state.shutdown_requested(self.service_name):
                     self._shutdown()
@@ -231,6 +248,13 @@ class ServeController:
                 # Event wait, not time.sleep: stop() tears the loop
                 # down promptly instead of after a full tick cadence.
                 self._stop.wait(_TICK_S)
+        except failpoints.FailpointError:
+            # Injected process crash (serve.controller.crash): die
+            # abruptly, leaving the state DB EXACTLY as a kill -9
+            # would — no FAILED write, the stale pid stays. Recovery
+            # is the respawned controller's reconcile, not this
+            # handler.
+            raise
         except Exception:  # noqa: BLE001 — a controller crash is a state
             logger.exception('service %s: controller crashed',
                              self.service_name)
@@ -254,9 +278,42 @@ def service_snapshot(name: str) -> Optional[dict]:
     if record is None:
         return None
     replicas = serve_state.get_replicas(name)
+    # Stale-pid detection (docs/robustness.md "Crash safety"): a
+    # recorded controller pid that no longer answers kill(pid, 0) means
+    # the control loop is DEAD even though the replicas may still be
+    # serving — report DEGRADED with the recovery hint instead of
+    # letting a healthy-looking status hide a control plane that will
+    # never scale, probe, or drain again. pid None (controller not yet
+    # booted, or an in-process test controller) stays unknown, not
+    # dead.
+    pid = record.get('controller_pid')
+    controller_alive = common.pid_alive(pid) if pid else None
+    status = record['status'].value
+    degraded_reason = None
+    if controller_alive is False and not record['status'].is_terminal():
+        status = 'DEGRADED'
+        if record.get('pool'):
+            # Worker pools recover through the jobs surface — the
+            # serve.up respawn path deliberately refuses pools.
+            degraded_reason = (
+                f'pool controller pid {pid} is dead; re-run '
+                f'`sky-tpu jobs pool apply` for {name!r} to respawn '
+                f'it, or `sky-tpu jobs pool down {name}` to tear the '
+                f'pool down')
+        else:
+            degraded_reason = (
+                f'controller pid {pid} is dead; re-run `sky-tpu serve '
+                f'up` with the service task (same name) to respawn '
+                f'it, or `sky-tpu serve down {name}` to tear the '
+                f'service down')
     return {
         'name': record['name'],
-        'status': record['status'].value,
+        'status': status,
+        'controller_alive': controller_alive,
+        'degraded_reason': degraded_reason,
+        'intents_open': serve_state.count_open_intents(name),
+        'recoveries_total': int(record.get('recoveries_total') or 0),
+        'orphans_adopted': int(record.get('orphans_adopted') or 0),
         'version': record['version'],
         'endpoint': (
             f'{"https" if (record.get("spec") or {}).get("tls") else "http"}'

@@ -336,6 +336,12 @@ class LoadBalancer:
     async def _sync_once(self) -> None:
         """One replica-set sync tick (factored out of the loop so the
         digital twin can drive ticks at virtual-time cadence)."""
+        # Chaos seam: an injected process crash of the LB
+        # (docs/robustness.md "Crash safety") — the error escapes the
+        # fail-open try below on purpose, so the sync plane dies the
+        # way a killed process would; recovery is a NEW LoadBalancer
+        # calling bootstrap_from_state(), not this loop healing.
+        await failpoints.hit_async('serve.lb.crash')
         # The tick advances OUTSIDE the try: the staleness guard
         # on the windowed gauges relies on it outrunning frozen
         # rings even when the sync body itself fails (state-DB
@@ -350,11 +356,8 @@ class LoadBalancer:
             # Replicas that left the ready set drop their breaker
             # state; a returning URL starts closed.
             self.breaker.prune(info)
-            draining = await self._offload(
-                serve_state.get_replicas, self.service_name,
-                [serve_state.ReplicaStatus.DRAINING])
-            self._draining_urls = sorted(
-                r['url'] for r in draining if r['url'])
+            self._draining_urls = await self._offload(
+                serve_state.draining_replica_urls, self.service_name)
             if hasattr(self.policy, 'set_target_qps_per_accelerator'):
                 # Instance-aware policy: refresh the per-accelerator
                 # QPS map from the (possibly updated) service spec.
@@ -1212,6 +1215,20 @@ class LoadBalancer:
             self._inflight -= 1
 
     # -- lifecycle ---------------------------------------------------------
+    async def bootstrap_from_state(self) -> None:
+        """Crash-restart rebuild (docs/robustness.md "Crash safety"):
+        repopulate the ready-replica set, the policy's affinity ring,
+        and the per-replica breaker map from the serve state DB BEFORE
+        the listener accepts a byte — a restarted LB must not answer
+        its first requests blind (503 "no ready replicas" on a fleet
+        that is perfectly healthy). One sync tick IS the rebuild: the
+        ready set and replica info come straight from ``serve_state``,
+        the cache-aware ring re-derives from the ready URLs, and every
+        breaker re-enters closed — the correct prior for replicas the
+        state DB still calls READY (a corpse re-trips within
+        ``failure_threshold`` requests)."""
+        await self._sync_once()
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_route('*', '/{tail:.*}', self.handle)
@@ -1233,6 +1250,10 @@ class LoadBalancer:
                   ssl_context=None) -> None:
         self._session = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=600))
+        # Rebuild before listening: a crash-restarted LB serves its
+        # first request against the state DB's replica set, never an
+        # empty one.
+        await self.bootstrap_from_state()
         runner = web.AppRunner(self.make_app())
         await runner.setup()
         site = web.TCPSite(runner, host, port, ssl_context=ssl_context)

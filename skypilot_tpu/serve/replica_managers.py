@@ -155,6 +155,40 @@ class CloudAdapter:
         return provision.probe_preemption_notice(
             ClusterInfo.from_dict(record['cluster_info']))
 
+    def describe_cluster(self, cluster_name: str,
+                         port: int) -> Optional[dict]:
+        """Adoption view for startup reconciliation: where a slice this
+        manager launched (but never recorded UP) actually lives —
+        ``{'url', 'zone', 'accelerator'}`` — or None when the provider
+        has no usable handle (the orphan cannot be adopted and must be
+        terminated by name instead)."""
+        from skypilot_tpu import state as global_state
+        from skypilot_tpu.provision.common import ClusterInfo
+        record = global_state.get_cluster(cluster_name)
+        if record is None or not record.get('cluster_info'):
+            return None
+        info = ClusterInfo.from_dict(record['cluster_info'])
+        ip = (info.head.external_ip or info.head.internal_ip
+              or '127.0.0.1')
+        return {'url': (f'http://{ip}:{port}' if port
+                        else (info.head.agent_url or '')),
+                'zone': f'{info.region}/{info.zone}',
+                'accelerator': info.tpu_slice}
+
+    def terminate_by_name(self, cluster_name: str,
+                          cloud_hint: Optional[str] = None) -> None:
+        """Reconcile-by-name teardown (the ``core.down`` carcass path,
+        shared): with a cluster record the normal terminate runs;
+        without one — the crash landed between the provider create and
+        the record write — fall back to a best-effort provider
+        terminate by name."""
+        from skypilot_tpu import core
+        from skypilot_tpu import state as global_state
+        if global_state.get_cluster(cluster_name) is not None:
+            self.terminate(cluster_name)
+            return
+        core.terminate_carcass_by_name(cluster_name, cloud_hint)
+
     def drain(self, url: str, deadline_s: float) -> Optional[dict]:
         return drain_replica(url, deadline_s)
 
@@ -237,16 +271,17 @@ class ReplicaManager:
             port = _free_port()
         else:
             port = self.spec.replica_port or DEFAULT_REPLICA_PORT
-        cluster_name = None  # assigned after the row gives us an id
-        replica_id = serve_state.add_replica(
-            self.service_name, cluster_name or '', version,
-            is_spot=task.resources.use_spot)
-        cluster_name = f'{self.service_name}-r{replica_id}'
-        conn = serve_state._db().conn  # noqa: SLF001 — same-module family
-        conn.execute(
-            'UPDATE replicas SET cluster_name = ? WHERE replica_id = ?',
-            (cluster_name, replica_id))
-        conn.commit()
+        # Crash-safe begin (docs/robustness.md "Crash safety"): the
+        # replica row AND its LAUNCHING intent commit in one
+        # transaction, with everything recovery needs to adopt or roll
+        # back the orphan (the workload port to rebuild the url, the
+        # cloud for a by-name carcass terminate).
+        replica_id, cluster_name = serve_state.add_replica_with_intent(
+            self.service_name, version,
+            is_spot=task.resources.use_spot,
+            payload={'port': port,
+                     'cloud': task.resources.cloud,
+                     'pool': self.spec.pool})
         serve_state.set_replica_status(replica_id,
                                        ReplicaStatus.PROVISIONING)
         if not self.spec.pool:
@@ -265,32 +300,38 @@ class ReplicaManager:
             avoid = self.spot_placer.spread_placements()
         info = self.cloud.launch(task, cluster_name, blocked,
                                  avoid_placements=avoid)
+        # Chaos seam: the torn crash window — the slice exists, the DB
+        # doesn't know. `error` dies here exactly like a controller
+        # killed between cloud-call and DB-write; startup
+        # reconciliation must adopt or roll back the orphan.
+        failpoints.hit('serve.controller.crash')
         if self.spec.pool:
             # Readiness for a worker is its agent plane, not a workload
-            # port — record the head agent URL for observability.
-            serve_state.set_replica_url(replica_id,
-                                        info.head.agent_url or '')
+            # port — the head agent URL is recorded for observability.
+            url = info.head.agent_url or ''
         else:
             ip = (info.head.external_ip or info.head.internal_ip
                   or '127.0.0.1')
-            serve_state.set_replica_url(replica_id, f'http://{ip}:{port}')
+            url = f'http://{ip}:{port}'
         acc = info.tpu_slice
         if not acc and task.resources.accelerators:
             acc = next(iter(task.resources.accelerators))
-        serve_state.set_replica_accelerator(replica_id, acc)
-        conn = serve_state._db().conn  # noqa: SLF001
-        # starting_at anchors the readiness grace period: provisioning can
-        # take arbitrarily long and must not eat initial_delay_seconds.
-        conn.execute(
-            'UPDATE replicas SET zone = ?, starting_at = ? '
-            'WHERE replica_id = ?',
-            (f'{info.region}/{info.zone}', vclock.now(), replica_id))
-        conn.commit()
-        serve_state.set_replica_status(replica_id, ReplicaStatus.STARTING)
+        # Crash-safe commit: url/zone/accelerator, the STARTING
+        # transition (starting_at anchors the readiness grace period:
+        # provisioning can take arbitrarily long and must not eat
+        # initial_delay_seconds), and the LAUNCHING intent retire all
+        # in ONE transaction.
+        serve_state.finish_replica_launch(
+            replica_id, url, acc, f'{info.region}/{info.zone}')
 
     # -- scale down --------------------------------------------------------
     def terminate_replica(self, replica_id: int,
-                          reason: str = 'scale-down') -> None:
+                          reason: str = 'scale-down',
+                          replace: bool = False) -> None:
+        """``replace`` marks teardowns whose capacity the autoscaler
+        re-launches (restart requests, unhealthy-too-long, superseded
+        versions) — journaled as a REPLACING intent so recovery can
+        tell a shrink from a swap."""
         if replica_id in self._terminating:
             return
         record = serve_state.get_replica(replica_id)
@@ -309,11 +350,19 @@ class ReplicaManager:
                 and record['status'] in (ReplicaStatus.READY,
                                          ReplicaStatus.NOT_READY)):
             drain_url = record['url']
-            serve_state.set_replica_status(replica_id,
-                                           ReplicaStatus.DRAINING, reason)
+            status = ReplicaStatus.DRAINING
+            kind = 'DRAINING'
         else:
-            serve_state.set_replica_status(
-                replica_id, ReplicaStatus.SHUTTING_DOWN, reason)
+            status = ReplicaStatus.SHUTTING_DOWN
+            kind = 'TERMINATING'
+        if replace:
+            kind = 'REPLACING'
+        # Crash-safe begin: status transition + teardown intent in one
+        # transaction (the intent retires with the row in
+        # remove_replica — same-transaction commit).
+        serve_state.mark_replica_teardown(
+            replica_id, status, reason, kind,
+            payload={'drain_url': drain_url, 'reason': reason})
         launch_fut = self._launching.pop(replica_id, None)
         fut = self._pool.submit(self._do_terminate, replica_id,
                                 record['cluster_name'], launch_fut,
@@ -344,6 +393,11 @@ class ReplicaManager:
                 vclock.now() - t0, deadline)
             serve_state.set_replica_status(replica_id,
                                            ReplicaStatus.SHUTTING_DOWN)
+        # Chaos seam: the half-done-drain crash window — the replica
+        # drained (or began to) but the slice still exists and the row
+        # survives. Recovery must finish the teardown, not re-drain a
+        # corpse forever.
+        failpoints.hit('serve.controller.crash')
         self.cloud.terminate(cluster_name)
         serve_state.remove_replica(replica_id)
 
@@ -367,6 +421,151 @@ class ReplicaManager:
         del done
         self._terminating = {rid: f for rid, f in
                              self._terminating.items() if not f.done()}
+
+    # -- startup reconciliation (crash recovery) ---------------------------
+    def _cloud_hint(self) -> Optional[str]:
+        """The task's cloud, for by-name carcass terminates when no
+        provider handle was ever saved."""
+        try:
+            cfg = yaml.safe_load(self.task_yaml) or {}
+            return (cfg.get('resources') or {}).get('cloud')
+        except yaml.YAMLError:
+            return None
+
+    def _recover_launch(self, intent: dict, row: Optional[dict],
+                        report: dict) -> None:
+        """One open LAUNCHING intent: the controller died somewhere
+        between the row insert and the STARTING write. Probe cloud
+        reality and roll forward (adopt the healthy orphan) or back
+        (terminate the carcass, mark the row FAILED)."""
+        payload = intent.get('payload') or {}
+        cluster_name = payload.get('cluster_name') or (
+            row['cluster_name'] if row else '')
+        if row is None:
+            # Row gone but the intent survived — nothing to adopt into;
+            # make sure no slice leaks, then retire the intent.
+            if cluster_name:
+                self.cloud.terminate_by_name(
+                    cluster_name,
+                    payload.get('cloud') or self._cloud_hint())
+            serve_state.resolve_intent(intent['intent_id'])
+            report['rolled_back'].append(cluster_name)
+            return
+        rid = row['replica_id']
+        if rid in self._launching:
+            return   # this manager's own launch is still in flight
+        if row['status'] not in (ReplicaStatus.PENDING,
+                                 ReplicaStatus.PROVISIONING):
+            # The STARTING (or later) write landed; only the intent
+            # retire was lost. Pure roll-forward.
+            serve_state.resolve_intent(intent['intent_id'])
+            report['resolved'].append(rid)
+            return
+        alive = self.cloud.provider_alive(cluster_name)
+        desc = (self.cloud.describe_cluster(
+                    cluster_name, int(payload.get('port') or 0))
+                if alive else None)
+        if alive and desc is not None and (desc.get('url')
+                                           or payload.get('pool')):
+            # Healthy orphan the dead controller launched but never
+            # recorded UP: adopt it — finish_replica_launch retires the
+            # intent in the same transaction as the STARTING write.
+            serve_state.finish_replica_launch(
+                rid, desc.get('url') or '', desc.get('accelerator'),
+                desc.get('zone'))
+            logger.info('replica %d: adopted orphan %s (recovered '
+                        'from controller crash)', rid, cluster_name)
+            report['adopted'].append(rid)
+            return
+        # Carcass (slice dead, vanished, or unadoptable): roll back —
+        # the FAILED write retires the intent in the same transaction.
+        self.cloud.terminate_by_name(
+            cluster_name, payload.get('cloud') or self._cloud_hint())
+        serve_state.fail_replica_launch(
+            rid, 'launch interrupted by controller crash')
+        logger.info('replica %d: rolled back interrupted launch of %s',
+                    rid, cluster_name)
+        report['rolled_back'].append(rid)
+
+    def reconcile(self, now: Optional[float] = None) -> dict:
+        """Startup recovery (docs/robustness.md "Crash safety"): replay
+        the intent journal against cloud reality. Healthy orphans the
+        dead controller launched but never recorded UP are ADOPTED;
+        carcasses are terminated and their rows rolled back; half-done
+        drains and teardowns are rolled FORWARD to completion. Running
+        it twice is a no-op: every decision keys off an open intent or
+        an unattended teardown row, and both are consumed (or guarded
+        by the in-flight maps) by the first pass."""
+        del now
+        report = {'adopted': [], 'rolled_back': [], 'resolved': [],
+                  'resumed_teardowns': []}
+        rows = {r['replica_id']: r
+                for r in serve_state.get_replicas(self.service_name)}
+        for intent in serve_state.open_intents(self.service_name):
+            row = rows.get(intent['replica_id'])
+            if intent['kind'] == 'LAUNCHING':
+                self._recover_launch(intent, row, report)
+            # Teardown intents (DRAINING/TERMINATING/REPLACING) are
+            # normally recovered through their rows below — the row IS
+            # the roll-forward signal, and remove_replica retires the
+            # intent with it.
+            elif row is None:
+                serve_state.resolve_intent(intent['intent_id'])
+                report['resolved'].append(intent['replica_id'])
+            elif (row['status'] not in (ReplicaStatus.DRAINING,
+                                        ReplicaStatus.SHUTTING_DOWN)
+                  and intent['replica_id'] not in self._terminating):
+                # A teardown intent whose row no longer SAYS teardown:
+                # the replica was terminated while its launch was still
+                # in flight, and the launch's STARTING commit raced
+                # over the SHUTTING_DOWN write before the crash. The
+                # intent is the only survivor of the teardown decision
+                # — roll it forward (the row's old state owed no
+                # drain), or the slice leaks and the intent stays open
+                # forever.
+                rid = intent['replica_id']
+                fut = self._pool.submit(self._do_terminate, rid,
+                                        row['cluster_name'], None, '')
+                self._terminating[rid] = fut
+                report['resumed_teardowns'].append(rid)
+        # Unattended teardowns: DRAINING/SHUTTING_DOWN rows with no
+        # in-flight future belong to a dead controller — finish the
+        # job (drain first if the row still owes one) or the slice
+        # leaks and the service name wedges.
+        for rid, r in rows.items():
+            if rid in self._terminating:
+                continue
+            if r['status'] in (ReplicaStatus.DRAINING,
+                               ReplicaStatus.SHUTTING_DOWN):
+                drain_url = ''
+                if (r['status'] == ReplicaStatus.DRAINING and r['url']
+                        and not self.spec.pool):
+                    drain_url = r['url']
+                fut = self._pool.submit(self._do_terminate, rid,
+                                        r['cluster_name'], None,
+                                        drain_url)
+                self._terminating[rid] = fut
+                report['resumed_teardowns'].append(rid)
+            elif r['status'] == ReplicaStatus.PREEMPTED:
+                # Carcass cleanups die with the controller's pool: a
+                # PREEMPTED row whose provider still knows the slice
+                # means the queued terminate never ran — resubmit it
+                # (terminating an already-gone slice is a no-op, and
+                # the provider forgetting the name makes later
+                # reconciles skip it).
+                if self.cloud.provider_alive(r['cluster_name']) is None:
+                    continue
+                fut = self._pool.submit(self._cleanup_carcass,
+                                        r['cluster_name'])
+                self._terminating[rid] = fut
+                report['resumed_teardowns'].append(rid)
+        recovered = sum(len(v) for v in report.values())
+        serve_state.note_recovery(self.service_name, recovered,
+                                  len(report['adopted']))
+        if recovered:
+            logger.info('service %s: crash recovery — %s',
+                        self.service_name, report)
+        return report
 
     # -- health ------------------------------------------------------------
     def _probe(self, replica: dict) -> bool:
@@ -407,11 +606,12 @@ class ReplicaManager:
         serve_state.set_replica_status(r['replica_id'], status, reason)
         r['status'] = status
 
-    def _terminate_marked(self, r: dict, reason: str) -> None:
+    def _terminate_marked(self, r: dict, reason: str,
+                          replace: bool = False) -> None:
         """terminate_replica + row mirror. The teardown is mirrored as
         SHUTTING_DOWN — terminate_replica may write DRAINING first,
         but either way the replica leaves the live set this tick."""
-        self.terminate_replica(r['replica_id'], reason)
+        self.terminate_replica(r['replica_id'], reason, replace=replace)
         r['status'] = ReplicaStatus.SHUTTING_DOWN
 
     def sync(self, now: Optional[float] = None) -> List[dict]:
@@ -430,8 +630,28 @@ class ReplicaManager:
             if exc is not None:
                 self.launch_failures += 1
                 logger.warning('replica %d: launch failed: %s', rid, exc)
-                serve_state.set_replica_status(
-                    rid, ReplicaStatus.FAILED, f'launch failed: {exc}')
+                # The launch may have died AFTER the provider create
+                # (bootstrap failure, the serve.controller.crash
+                # failpoint against a live controller): read the
+                # journaled payload BEFORE retiring it, then
+                # best-effort terminate the carcass — but only when
+                # the provider actually KNOWS the cluster. A quota or
+                # capacity error fails before anything exists, and
+                # firing a by-name terminate (with its leaked-slice
+                # warning) once per failed launch per tick would bury
+                # the one real carcass alarm in false ones.
+                payload = serve_state.launch_intent_payload(rid)
+                # FAILED write + LAUNCHING-intent retire in one txn —
+                # a reaped failure IS the launch's outcome, so the
+                # journal entry must die with it.
+                serve_state.fail_replica_launch(
+                    rid, f'launch failed: {exc}')
+                cname = payload.get('cluster_name')
+                if (cname and
+                        self.cloud.provider_alive(cname) is not None):
+                    self._pool.submit(
+                        self.cloud.terminate_by_name, cname,
+                        payload.get('cloud') or self._cloud_hint())
             else:
                 self.launch_failures = 0
         self.wait_terminations(timeout=0)
@@ -452,7 +672,8 @@ class ReplicaManager:
                 # a substitute to hold the target count.
                 serve_state.consume_restart_request(rid)
                 logger.info('replica %d: restart requested', rid)
-                self._terminate_marked(r, 'restart requested')
+                self._terminate_marked(r, 'restart requested',
+                                       replace=True)
                 continue
             # STARTING / READY / NOT_READY: check provider plane first.
             alive = self._provider_alive(r['cluster_name'])
@@ -540,7 +761,8 @@ class ReplicaManager:
                         logger.warning(
                             'replica %d: unhealthy for %d probes; '
                             'replacing', rid, fails)
-                        self._terminate_marked(r, 'unhealthy too long')
+                        self._terminate_marked(r, 'unhealthy too long',
+                                               replace=True)
         return rows
 
     def _cleanup_carcass(self, cluster_name: str) -> None:

@@ -30,15 +30,20 @@ Layout:
 - ``scenarios``: the scenario library (flash crowd, reclaim storm,
   regional failover, brownout, breaker flap) and its gates.
 """
-from skypilot_tpu.sim.scenarios import (SCENARIOS, Scenario,
-                                        breaker_flap, flash_crowd,
+from skypilot_tpu.sim.crash import run_crash_sweep
+from skypilot_tpu.sim.scenarios import (SCENARIOS, KillSpec, Scenario,
+                                        breaker_flap,
+                                        crash_controller_mid_storm,
+                                        crash_lb_mid_stream,
+                                        crash_sweep, flash_crowd,
                                         fleet_storm_24h,
                                         reclaim_storm,
                                         regional_failover,
                                         slow_brownout, wfq_fleet)
 from skypilot_tpu.sim.twin import DigitalTwin, SimReport
 
-__all__ = ['DigitalTwin', 'SCENARIOS', 'Scenario', 'SimReport',
-           'breaker_flap', 'flash_crowd', 'fleet_storm_24h',
-           'reclaim_storm', 'regional_failover', 'slow_brownout',
-           'wfq_fleet']
+__all__ = ['DigitalTwin', 'KillSpec', 'SCENARIOS', 'Scenario',
+           'SimReport', 'breaker_flap', 'crash_controller_mid_storm',
+           'crash_lb_mid_stream', 'crash_sweep', 'flash_crowd',
+           'fleet_storm_24h', 'reclaim_storm', 'regional_failover',
+           'run_crash_sweep', 'slow_brownout', 'wfq_fleet']

@@ -12,28 +12,87 @@ import json
 import logging
 import sys
 
-from skypilot_tpu.sim import SCENARIOS, DigitalTwin
+from skypilot_tpu.sim import SCENARIOS, DigitalTwin, run_crash_sweep
+
+
+def _run_crash_sweep(args, parser) -> int:
+    """The kill-anywhere gate from the command line
+    (``make sim-crash-sweep``): sweep controller and LB kills across
+    every control-plane decision boundary of a storm replay (the
+    ``crash_sweep`` scenario unless --scenario picks another kill-free
+    one); with --verify-determinism, sweep twice and compare the
+    concatenated decision logs byte for byte."""
+    kwargs = {}
+    if args.replicas is not None:
+        kwargs['replicas'] = args.replicas
+    # --scenario composes: any scenario can be swept, as long as it
+    # does not embed its own kills (the baseline must be unkilled).
+    # None default distinguishes "unset" from an explicit choice.
+    name = args.scenario or 'crash_sweep'
+
+    def factory():
+        return SCENARIOS[name](**kwargs)
+
+    if factory().kills:
+        parser.error(f'--crash-sweep needs a kill-free base scenario; '
+                     f'{name!r} embeds its own kills')
+    sweep = run_crash_sweep(factory, seed=args.seed,
+                            on_progress=print)
+    summary = {
+        'scenario': name, 'seed': args.seed,
+        'boundaries': len(sweep['boundaries']),
+        'runs': len(sweep['runs']),
+        'failures': len(sweep['failures']),
+    }
+    print(json.dumps(summary, indent=1))
+    if args.json_out:
+        with open(args.json_out, 'w', encoding='utf-8') as f:
+            json.dump({'summary': summary, 'runs': sweep['runs']},
+                      f, indent=1)
+    rc = 0
+    if sweep['failures']:
+        print(f"FAIL: {len(sweep['failures'])} killed replay(s) "
+              f"violated the crash-safety gate; first: "
+              f"{sweep['failures'][0]}", file=sys.stderr)
+        rc = 1
+    if args.verify_determinism:
+        again = run_crash_sweep(factory, seed=args.seed)
+        if again['log'] != sweep['log']:
+            print('FAIL: same-seed crash sweeps produced different '
+                  'decision logs', file=sys.stderr)
+            rc = 1
+        else:
+            print('determinism: OK (sweep decision logs identical)')
+    return rc
 
 
 def main() -> int:
     parser = argparse.ArgumentParser(
         description='fleet digital twin (docs/robustness.md)')
-    parser.add_argument('--scenario', default='reclaim_storm',
+    # Default None so --crash-sweep can tell an explicit scenario from
+    # an unset one (its default base differs: crash_sweep).
+    parser.add_argument('--scenario', default=None,
                         choices=sorted(SCENARIOS))
     parser.add_argument('--seed', type=int, default=1)
     parser.add_argument('--replicas', type=int, default=None,
                         help='override the scenario fleet size')
     parser.add_argument('--verify-determinism', action='store_true',
                         help='replay twice, compare decision logs')
+    parser.add_argument('--crash-sweep', action='store_true',
+                        help='run the kill-anywhere crash-consistency '
+                             'sweep instead of a single replay')
     parser.add_argument('--json', dest='json_out', default=None,
                         help='write the full report JSON here')
     args = parser.parse_args()
     logging.basicConfig(level=logging.ERROR)
 
+    if args.crash_sweep:
+        return _run_crash_sweep(args, parser)
+
     kwargs = {}
     if args.replicas is not None:
         kwargs['replicas'] = args.replicas
-    scenario = SCENARIOS[args.scenario](**kwargs)
+    scenario = SCENARIOS[args.scenario or 'reclaim_storm'](**kwargs)
     report = DigitalTwin(scenario, seed=args.seed).run()
     summary = report.summary()
     print(json.dumps(summary, indent=1))

@@ -27,14 +27,25 @@ from skypilot_tpu.sim import kernel as kernel_lib
 from skypilot_tpu.sim import replica as replica_lib
 
 
+class SimCrashError(Exception):
+    """The virtual kill -9: raised by the twin's crash gate inside a
+    cloud-facing operation to tear it at the real crash window (slice
+    created, DB not yet written; drain done, terminate not). Escapes
+    into the dead executor's future, which nobody reaps — the manager
+    object is gone, exactly like the process."""
+
+
 class SimExecutor:
     """``concurrent.futures``-shaped executor whose submissions run as
     kernel events. Real ``Future`` objects are returned so the replica
     manager's ``fut.done()`` / ``fut.exception()`` reaping works
-    untouched."""
+    untouched. ``kill()`` models the controller process dying: queued
+    submissions never run (their threads died with the process) and
+    their futures stay pending forever."""
 
     def __init__(self, kern: kernel_lib.Kernel) -> None:
         self.kernel = kern
+        self.dead = False
 
     def submit(self, fn: Callable, *args: Any,
                **kwargs: Any) -> concurrent.futures.Future:
@@ -42,6 +53,8 @@ class SimExecutor:
         fut.set_running_or_notify_cancel()
 
         def run() -> None:
+            if self.dead:
+                return   # the pool died with its controller
             try:
                 fut.set_result(fn(*args, **kwargs))
             except BaseException as e:  # noqa: BLE001 — reaped by sync()
@@ -49,6 +62,9 @@ class SimExecutor:
 
         self.kernel.call_later(0.0, run)
         return fut
+
+    def kill(self) -> None:
+        self.dead = True
 
     def shutdown(self, wait: bool = False) -> None:
         del wait
@@ -94,6 +110,16 @@ class VirtualCloud(replica_managers.CloudAdapter):
         self.slices: Dict[str, _Slice] = {}
         self.by_url: Dict[str, _Slice] = {}
         self._ip = 0
+        # Crash gate (kill-anywhere sweep): the twin installs a
+        # callable invoked at each real crash window of a cloud-facing
+        # operation — after the provider side-effect, before the
+        # manager's DB write. Raising SimCrashError there tears the
+        # operation exactly where a kill -9 would.
+        self.crash_gate: Optional[Callable[[str], None]] = None
+
+    def _gate(self, window: str) -> None:
+        if self.crash_gate is not None:
+            self.crash_gate(window)
 
     # ---- CloudAdapter --------------------------------------------------
     def launch(self, task, cluster_name: str, blocked_placements,
@@ -135,6 +161,9 @@ class VirtualCloud(replica_managers.CloudAdapter):
         self.log('launch', cluster=cluster_name, zone=f'{region}/{zone}',
                  spot=bool(task.resources.use_spot),
                  provision_s=round(delay, 3))
+        # The torn window: the slice exists, the replica row doesn't
+        # know — a kill here leaves the orphan reconcile must adopt.
+        self._gate('launch.post_create')
         return SimpleNamespace(
             head=SimpleNamespace(external_ip=ip, internal_ip=ip,
                                  agent_url=url),
@@ -171,6 +200,10 @@ class VirtualCloud(replica_managers.CloudAdapter):
         n = len(s.model.active) + s.model.sched.pending()
         s.model.drain_flush()
         self.log('drain', cluster=s.cluster_name, flushed=n)
+        # Half-done drain: the replica drained but its slice survives
+        # and the row still says DRAINING — recovery must finish the
+        # teardown.
+        self._gate('drain.post_flush')
         return {'status': 'drained', 'flushed': n}
 
     def terminate(self, cluster_name: str) -> None:
@@ -181,6 +214,24 @@ class VirtualCloud(replica_managers.CloudAdapter):
         s.alive = False
         s.model.kill()
         self.log('terminate', cluster=cluster_name)
+        # Slice dead, replica row still present: recovery re-runs the
+        # teardown (terminate of a gone slice is a no-op) and drops
+        # the row.
+        self._gate('terminate.post_kill')
+
+    def describe_cluster(self, cluster_name: str,
+                         port: int) -> Optional[dict]:
+        del port   # the virtual slice already knows its url
+        s = self.slices.get(cluster_name)
+        if s is None or not s.alive:
+            return None
+        return {'url': s.url, 'zone': f'{s.region}/{s.zone}',
+                'accelerator': s.accelerator}
+
+    def terminate_by_name(self, cluster_name: str,
+                          cloud_hint: Optional[str] = None) -> None:
+        del cloud_hint   # the virtual provider always resolves by name
+        self.terminate(cluster_name)
 
     # ---- fault API (the scenario schedule calls these) -----------------
     def live_slices(self) -> List[_Slice]:

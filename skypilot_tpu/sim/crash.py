@@ -1,0 +1,153 @@
+"""The kill-anywhere crash-consistency sweep (docs/robustness.md
+"Crash safety").
+
+One seeded storm replay runs unkilled to produce the baseline decision
+log and final fleet state. Then, for EVERY control-plane decision
+boundary in that log — every launch, drain, terminate, preemption
+notice, reclaim kill, storm, and autoscaler move — the same scenario
+replays with a virtual ``kill -9`` of the controller (and, in a second
+pass, the LB) injected exactly at that boundary, followed by a
+restart. A kill armed at a cloud-facing decision tears the operation
+at its real crash window (slice created / drain done, DB not yet
+written) via the VirtualCloud crash gate.
+
+Each killed replay must prove the whole crash-safety contract at once:
+
+- **zero client-visible errors** — streams severed by the dead LB are
+  retried with ``resume_from`` and every completed stream's tokens are
+  bit-identical to the unkilled continuation;
+- **convergence** — the recovered control plane lands on the SAME
+  final fleet state as the baseline (same ready count, nothing stuck
+  mid-transition, an empty intent journal);
+- **idempotent recovery** — the restarted controller runs startup
+  reconciliation twice and the second pass is a no-op.
+
+Request-outcome decisions are not kill boundaries: the control plane's
+crash windows are its own mutations, and killing it after client
+stream #217 vs #218 exercises the identical recovery path (the
+mid-stream cases are covered by the LB-target sweep severing whatever
+is in flight at each control boundary).
+
+``run_crash_sweep`` is the ``make sim-crash-sweep`` / tier-1 entry;
+its ``log`` string (every killed run's decision log, concatenated) is
+the byte-identity surface the determinism gate hashes.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from skypilot_tpu.sim.scenarios import KillSpec, Scenario
+from skypilot_tpu.sim.twin import DigitalTwin, SimReport
+
+# Decision kinds that are control-plane mutations — the kill
+# boundaries. Everything else in the log (per-request outcomes,
+# breaker-edge observations) observes the control plane rather than
+# mutating it.
+CONTROL_KINDS = frozenset((
+    'launch', 'terminate', 'drain', 'preemption_notice',
+    'reclaim_kill', 'storm', 'zone_outage', 'scale_target',
+    'brownout', 'wedge'))
+
+
+def control_boundaries(report: SimReport) -> List[int]:
+    """Decision-log seqs of every control-plane mutation."""
+    return [d['seq'] for d in report.decisions
+            if d['kind'] in CONTROL_KINDS]
+
+
+def check_run(report: SimReport, baseline: SimReport) -> List[str]:
+    """The per-killed-run acceptance checks; returns human-readable
+    violations (empty = the run passed)."""
+    problems: List[str] = []
+    if report.client_errors:
+        problems.append(
+            f'{len(report.client_errors)} client-visible error(s); '
+            f'first: {report.client_errors[0]}')
+    bad_tokens = [r for r in report.records
+                  if r['completed'] and not r.get('tokens_ok')]
+    if bad_tokens:
+        problems.append(
+            f'{len(bad_tokens)} completed stream(s) diverged from the '
+            f'unkilled continuation; first: {bad_tokens[0]}')
+    ff, bf = report.final_fleet, baseline.final_fleet
+    if ff.get('ready') != bf.get('ready'):
+        problems.append(
+            f"final ready count {ff.get('ready')} != baseline "
+            f"{bf.get('ready')}")
+    if ff.get('transitional'):
+        problems.append(
+            f"{ff['transitional']} replica(s) stuck mid-transition: "
+            f"{ff.get('statuses')}")
+    if ff.get('open_intents'):
+        problems.append(
+            f"{ff['open_intents']} intent(s) still open — recovery "
+            f'left journal entries behind')
+    if ff.get('cloud_slices') != bf.get('cloud_slices'):
+        problems.append(
+            f"provider holds {ff.get('cloud_slices')} slice(s) vs "
+            f"baseline {bf.get('cloud_slices')} — a carcass leaked "
+            f'(or a teardown over-fired)')
+    for rec in report.recoveries:
+        if not rec.get('second_pass_noop'):
+            problems.append(
+                f'reconciliation was not idempotent at t={rec["t"]}: '
+                f'{rec}')
+    return problems
+
+
+def run_crash_sweep(factory: Callable[[], Scenario], *, seed: int = 3,
+                    targets: Sequence[str] = ('controller', 'lb'),
+                    restart_delay_s: float = 30.0,
+                    stride: int = 1,
+                    on_progress: Optional[Callable[[str], None]] = None
+                    ) -> Dict[str, Any]:
+    """Sweep kills across every control boundary (``stride`` thins the
+    boundary list for quick local runs; tier-1 uses 1). Returns::
+
+        {'baseline': SimReport, 'boundaries': [...], 'runs': [...],
+         'failures': [...], 'log': '<concatenated decision logs>'}
+
+    ``failures`` empty means the kill-anywhere gate holds; ``log`` is
+    byte-identical across same-seed sweeps (the determinism gate).
+    """
+    baseline = DigitalTwin(factory(), seed=seed).run()
+    base_problems = check_run(baseline, baseline)
+    if base_problems:
+        raise AssertionError(
+            f'baseline replay is not clean, the sweep would prove '
+            f'nothing: {base_problems}')
+    boundaries = control_boundaries(baseline)[::max(1, stride)]
+    if not boundaries:
+        raise AssertionError('baseline log has no control-plane '
+                             'decisions — wrong scenario?')
+    runs: List[Dict[str, Any]] = []
+    failures: List[Dict[str, Any]] = []
+    logs: List[str] = [baseline.decision_log_jsonl()]
+    for target in targets:
+        for seq in boundaries:
+            spec = KillSpec(target=target, at_seq=seq,
+                            restart_delay_s=restart_delay_s)
+            report = DigitalTwin(factory(), seed=seed,
+                                 kill=spec).run()
+            logs.append(report.decision_log_jsonl())
+            problems = check_run(report, baseline)
+            row = {'target': target, 'at_seq': seq,
+                   'crashes': report.crashes,
+                   'requests': len(report.records),
+                   'completed': report.completed,
+                   'client_retries': report.client_retries,
+                   'problems': problems}
+            runs.append(row)
+            if problems:
+                failures.append(row)
+            if on_progress is not None:
+                on_progress(
+                    f'kill {target}@{seq}: '
+                    f'{"FAIL " + str(problems) if problems else "ok"}')
+    return {
+        'baseline': baseline,
+        'boundaries': boundaries,
+        'runs': runs,
+        'failures': failures,
+        'log': '\n'.join(logs),
+    }

@@ -31,13 +31,24 @@ class SimFuture:
     how a stream consumer drains an already-buffered burst of token
     lines without bouncing through the heap."""
 
-    __slots__ = ('_done', '_value', '_exc', '_callbacks')
+    __slots__ = ('_done', '_value', '_exc', '_callbacks', '_cancel')
 
     def __init__(self) -> None:
         self._done = False
         self._value: Any = None
         self._exc: Optional[BaseException] = None
         self._callbacks: List[Callable[['SimFuture'], None]] = []
+        # Set by Kernel.spawn on the future it returns: abandons the
+        # driven coroutine (a process crash severing its connections).
+        self._cancel: Optional[Callable[[], None]] = None
+
+    def cancel(self) -> None:
+        """Abandon the spawned coroutine this future tracks (no-op on
+        plain futures and on already-finished ones). The crash seam of
+        the kill-anywhere sweep: a killed LB's in-flight request
+        coroutines stop mid-await exactly where the process died."""
+        if self._cancel is not None and not self._done:
+            self._cancel()
 
     def done(self) -> bool:
         return self._done
@@ -127,11 +138,26 @@ class Kernel:
     def spawn(self, coro) -> SimFuture:
         """Drive ``coro`` to completion across kernel events; the
         returned future resolves with its return value (or its
-        exception — the twin inspects, never silently drops)."""
+        exception — the twin inspects, never silently drops).
+        ``result.cancel()`` abandons the coroutine: finally blocks run
+        (GeneratorExit at the suspension point), later resolutions of
+        futures it awaited are ignored, and ``result`` stays pending
+        forever — the caller models the severed connection."""
         result = SimFuture()
+        cancelled = [False]
+
+        def cancel() -> None:
+            if cancelled[0] or result._done:
+                return
+            cancelled[0] = True
+            coro.close()
+
+        result._cancel = cancel
 
         def advance(value: Any = None,
                     exc: Optional[BaseException] = None) -> None:
+            if cancelled[0]:
+                return
             try:
                 if exc is not None:
                     awaited = coro.throw(exc)

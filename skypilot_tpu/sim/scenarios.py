@@ -44,6 +44,27 @@ class Fault:
 
 
 @dataclasses.dataclass
+class KillSpec:
+    """A virtual-time process kill of one control-plane component
+    (docs/robustness.md "Crash safety"). ``target`` is ``'controller'``
+    or ``'lb'``; the kill lands either at virtual time ``at_t`` or the
+    instant decision-log entry ``at_seq`` is appended (the
+    kill-anywhere sweep's boundary injection — a kill armed at a
+    cloud-facing decision tears the operation at its real crash
+    window via the VirtualCloud crash gate). The component restarts
+    ``restart_delay_s`` later: a fresh ``ServeController`` whose
+    startup reconciliation replays the journal (run twice — the gate
+    asserts the second pass is a no-op), or a fresh LB rebuilt from
+    the state DB, with severed client streams retried against it
+    carrying ``resume_from`` (the PR 5 splice contract, client side)."""
+
+    target: str                         # 'controller' | 'lb'
+    at_t: Optional[float] = None
+    at_seq: Optional[int] = None
+    restart_delay_s: float = 30.0
+
+
+@dataclasses.dataclass
 class Scenario:
     name: str
     # Fleet shape (feeds the REAL ServiceSpec/ReplicaPolicy).
@@ -76,6 +97,9 @@ class Scenario:
     stats_flush_s: float = 10.0
     initial_delay_s: float = 300.0
     faults: List[Fault] = dataclasses.field(default_factory=list)
+    # Process kills (crash scenarios embed one; the kill-anywhere
+    # sweep injects its own per boundary).
+    kills: List[KillSpec] = dataclasses.field(default_factory=list)
 
 
 def reclaim_storm(*, replicas: int = 40, duration_s: float = 2400.0,
@@ -194,6 +218,87 @@ def wfq_fleet(*, replicas: int = 4, duration_s: float = 900.0,
         tenants=tenants)
 
 
+def crash_controller_mid_storm(*, replicas: int = 12,
+                               duration_s: float = 1800.0) -> Scenario:
+    """kill -9 the controller in the MIDDLE of a reclaim storm — half
+    the fleet's recovery (drains in flight, replacements mid-launch,
+    carcass cleanups queued) dies with it. Gates: the restarted
+    controller's startup reconciliation converges the fleet back to
+    target (adopting orphans it launched but never recorded, finishing
+    half-done teardowns), reconciliation is idempotent, and clients
+    ride through on the LB's retry/resume with ZERO visible errors."""
+    storm_t = duration_s * 0.4
+    return Scenario(
+        name='crash_controller_mid_storm', replicas=replicas,
+        use_spot=True, duration_s=duration_s, perf_scale=2.0,
+        tenants={'prod': {'rps': 3.0, 'prompt_mean': 32,
+                          'prompt_max': 128, 'max_new': 12,
+                          'until': duration_s * 0.7}},
+        faults=[Fault(t=storm_t, kind='reclaim_storm', frac=0.3,
+                      notice_frac=0.5)],
+        # Landing 20s after the storm hits puts the kill inside the
+        # drain/replace churn (controller tick is 15s: the first
+        # recovery tick has run, its launches/drains are in flight).
+        kills=[KillSpec(target='controller', at_t=storm_t + 20.0,
+                        restart_delay_s=45.0)])
+
+
+def crash_lb_mid_stream(*, replicas: int = 6,
+                        duration_s: float = 1200.0) -> Scenario:
+    """kill -9 the LB with token streams in flight. The severed
+    clients retry against the restarted LB with
+    ``resume_from = delivered`` (the SDK-visible half of PR 5's resume
+    splice), which rebuilds its replica set from the state DB before
+    serving. Gates: zero client-visible errors, retried streams
+    bit-identical to unkilled runs, retries non-vacuous."""
+    kill_t = duration_s * 0.55
+    # Streams must reliably be IN FLIGHT at the kill instant (the
+    # resume-retry gate is vacuous otherwise): 32 tokens at a
+    # 6x-scaled ITL curve keeps each stream alive ~6 virtual seconds,
+    # so 3 rps holds ~19 concurrent through the kill window even at a
+    # burst trough — while fleet capacity (~8 rps) stays ahead of
+    # offered load, so admission never sheds and the zero-error gate
+    # is pure.
+    return Scenario(
+        name='crash_lb_mid_stream', replicas=replicas,
+        duration_s=duration_s, perf_scale=6.0,
+        tenants={'prod': {'rps': 3.0, 'prompt_mean': 48,
+                          'prompt_max': 128, 'max_new': 32,
+                          'until': duration_s * 0.7}},
+        kills=[KillSpec(target='lb', at_t=kill_t,
+                        restart_delay_s=10.0)])
+
+
+def crash_sweep(*, replicas: int = 4,
+                duration_s: float = 600.0) -> Scenario:
+    """The kill-anywhere sweep's BASE replay: a small spot fleet, a
+    half-fleet storm with a notice/hard mix, steady short streams —
+    small enough that one full replay is milliseconds, rich enough
+    that its decision log crosses every lifecycle edge (launch, drain,
+    terminate, notice, reclaim, scale). ``sim/crash.py`` replays it
+    once unkilled, then once per control-plane decision boundary per
+    target with a kill injected there (docs/robustness.md
+    "Crash safety")."""
+    # The storm MUST land inside the traffic window: its drains, hard
+    # kills, and replacement launches are the boundaries where kills
+    # meet in-flight streams. 24-token streams at a 4x ITL curve live
+    # ~2-3 virtual seconds, so several ride through every storm-window
+    # boundary — LB kills sever real streams (client resume-retry
+    # non-vacuous) and the storm's hard kills land mid-stream (LB
+    # resume splice non-vacuous). Sized for tier-1 wall clock: every
+    # killed replay of the sweep replays this whole scenario.
+    storm_t = duration_s * 0.7
+    return Scenario(
+        name='crash_sweep', replicas=replicas, use_spot=True,
+        duration_s=duration_s, perf_scale=4.0,
+        traffic_start_s=240.0,
+        tenants={'prod': {'rps': 2.0, 'burst': 2, 'prompt_mean': 24,
+                          'prompt_max': 64, 'max_new': 24,
+                          'until': duration_s * 0.6}},
+        faults=[Fault(t=storm_t, kind='reclaim_storm', frac=0.5,
+                      notice_frac=0.5)])
+
+
 def fleet_storm_24h(*, replicas: int = 1000,
                     requests: float = 0.12) -> Scenario:
     """THE acceptance gate: a 24h diurnal day at 1000 modeled
@@ -228,5 +333,8 @@ SCENARIOS = {
     'slow_brownout': slow_brownout,
     'breaker_flap': breaker_flap,
     'wfq_fleet': wfq_fleet,
+    'crash_controller_mid_storm': crash_controller_mid_storm,
+    'crash_lb_mid_stream': crash_lb_mid_stream,
+    'crash_sweep': crash_sweep,
     'fleet_storm_24h': fleet_storm_24h,
 }

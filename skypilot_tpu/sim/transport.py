@@ -28,9 +28,13 @@ from skypilot_tpu.utils import failpoints
 
 class SimRequest:
     """Duck-typed stand-in for ``aiohttp.web.Request`` — exactly the
-    attribute surface ``LoadBalancer.handle`` touches."""
+    attribute surface ``LoadBalancer.handle`` touches. ``splice`` is
+    the twin's window into the in-flight stream state: the transport
+    stamps the LB's ``_StreamSplice`` here so a kill-anywhere LB crash
+    can read how many tokens the "client" already holds (the
+    resume_from of its retry against the restarted LB)."""
 
-    __slots__ = ('method', 'path', 'headers', '_body')
+    __slots__ = ('method', 'path', 'headers', '_body', 'splice')
 
     def __init__(self, path: str, body: bytes,
                  headers: Optional[Dict[str, str]] = None,
@@ -39,6 +43,7 @@ class SimRequest:
         self.path = path
         self.headers = dict(headers or {})
         self._body = body
+        self.splice = None
 
     @property
     def path_qs(self) -> str:
@@ -98,6 +103,7 @@ class TwinLoadBalancer(lb_lib.LoadBalancer):
     async def _proxy_stream_attempt(self, request, url: str,
                                     headers: Dict[str, str],
                                     t_arrival: float, splice):
+        request.splice = splice   # the LB-crash resume window
         splice.buf = b''
         try:
             await failpoints.hit_async('lb.proxy')

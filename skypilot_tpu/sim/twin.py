@@ -36,13 +36,15 @@ import yaml
 from skypilot_tpu.infer import sched as sched_lib
 from skypilot_tpu.serve import controller as controller_lib
 from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.serve.state import ReplicaStatus
 from skypilot_tpu.sim import cloud as cloud_lib
 from skypilot_tpu.sim import kernel as kernel_lib
 from skypilot_tpu.sim import replica as replica_lib
 from skypilot_tpu.sim import transport as transport_lib
-from skypilot_tpu.sim.scenarios import Fault, Scenario
+from skypilot_tpu.sim.scenarios import Fault, KillSpec, Scenario
 from skypilot_tpu.utils import common
 from skypilot_tpu.utils import db as db_lib
+from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils import retry as retry_lib
 from skypilot_tpu.utils import vclock
 
@@ -58,6 +60,10 @@ class SimReport:
         self.decisions: List[Dict[str, Any]] = []
         self.records: List[Dict[str, Any]] = []
         self.lb_metrics: Dict[str, Any] = {}
+        # End-of-replay control-plane convergence view (captured before
+        # the scratch home is torn down): the crash gates compare a
+        # killed run's final fleet against the unkilled baseline's.
+        self.final_fleet: Dict[str, Any] = {}
         self.wall_s = 0.0
         self.events_run = 0
 
@@ -99,6 +105,22 @@ class SimReport:
         return sum(1 for r in self.records if r.get('resumed'))
 
     @property
+    def crashes(self) -> int:
+        return self._count('crash')
+
+    @property
+    def recoveries(self) -> List[Dict[str, Any]]:
+        """The 'recover' decisions — one per controller restart, with
+        the reconcile report rollup and the idempotence verdict."""
+        return [d for d in self.decisions if d['kind'] == 'recover']
+
+    @property
+    def client_retries(self) -> int:
+        """Streams severed by an LB kill and retried (with resume_from)
+        against the restarted LB."""
+        return sum(int(r.get('lb_retries') or 0) for r in self.records)
+
+    @property
     def client_errors(self) -> List[Dict[str, Any]]:
         """Client-visible failures: anything that neither completed
         nor was an orderly admission shed (the zero-errors gates
@@ -127,6 +149,9 @@ class SimReport:
             'launches': self.launches, 'drains': self.drains,
             'preemption_notices': self.preemption_notices,
             'reclaim_kills': self.reclaim_kills,
+            'crashes': self.crashes,
+            'client_retries': self.client_retries,
+            'final_fleet': self.final_fleet,
             'scale_targets': self.scale_targets,
             'ready_replicas': self.lb_metrics.get('ready_replicas'),
             'lb_ttft_p50_s': self.lb_metrics.get('ttft_p50_s'),
@@ -134,13 +159,32 @@ class SimReport:
         }
 
 
+class _ClientCall:
+    """One logical client request across LB crash-restarts: a severed
+    leg's delivered tokens become the next leg's ``resume_from`` (the
+    SDK-visible half of PR 5's resume splice)."""
+
+    __slots__ = ('ev', 't0', 'resume', 'retries', 'req', 'fut')
+
+    def __init__(self, ev, t0: float) -> None:
+        self.ev = ev
+        self.t0 = t0
+        self.resume: List[int] = []
+        self.retries = 0
+        self.req: Optional[transport_lib.SimRequest] = None
+        self.fut: Optional[kernel_lib.SimFuture] = None
+
+
 class DigitalTwin:
-    """One replay of one scenario at one seed."""
+    """One replay of one scenario at one seed. ``kill`` injects an
+    extra :class:`KillSpec` on top of the scenario's own (the
+    kill-anywhere sweep's per-boundary knob)."""
 
     SERVICE = 'twin'
 
     def __init__(self, scenario: Scenario, seed: int = 0, *,
-                 keep_home: bool = False) -> None:
+                 keep_home: bool = False,
+                 kill: Optional[KillSpec] = None) -> None:
         self.sc = scenario
         self.seed = seed
         self.keep_home = keep_home
@@ -150,6 +194,21 @@ class DigitalTwin:
         self._cloud: Optional[cloud_lib.VirtualCloud] = None
         self._lb: Optional[transport_lib.TwinLoadBalancer] = None
         self._controller = None
+        self._executor: Optional[cloud_lib.SimExecutor] = None
+        # Kill-anywhere machinery (docs/robustness.md "Crash safety").
+        self.kills: List[KillSpec] = list(scenario.kills)
+        if kill is not None:
+            self.kills.append(kill)
+        self._kills_fired: set = set()
+        # Armed between a controller kill and its restart: the next
+        # VirtualCloud crash-window gate tears the op on the stack
+        # (slice created / drain done, DB not yet written).
+        self._crash_armed = False
+        # In-flight logical client calls (insertion-ordered — the kill
+        # handler's severing order is deterministic) and legs parked
+        # while the LB is dead.
+        self._inflight_calls: Dict[int, _ClientCall] = {}
+        self._pending_legs: List[_ClientCall] = []
 
     # ---- pieces --------------------------------------------------------
     def _make_perf(self) -> replica_lib.PerfModel:
@@ -163,6 +222,18 @@ class DigitalTwin:
             {'t': round(self.kernel.now, 6),
              'seq': len(self.report.decisions), 'kind': kind,
              **fields})
+        # Kill-anywhere boundary injection: a KillSpec armed at this
+        # decision's seq fires the virtual kill -9 the instant the
+        # decision lands — if the decision was logged from inside a
+        # cloud-facing op (launch/drain/terminate), the crash gate
+        # tears that op at its real crash window before it can write
+        # the DB.
+        seq = len(self.report.decisions) - 1
+        for i, k in enumerate(self.kills):
+            if (k.at_seq is not None and k.at_seq == seq
+                    and i not in self._kills_fired):
+                self._kills_fired.add(i)
+                self._kill(k.target, k.restart_delay_s)
 
     def _make_replica(self, url: str) -> replica_lib.ModelReplica:
         cfg = sched_lib.SchedulerConfig(
@@ -204,24 +275,44 @@ class DigitalTwin:
                            self.sc.duration_s - self.sc.traffic_start_s))
 
     def _fire_request(self, ev) -> None:
-        payload = {'tokens': ev.tokens,
-                   'max_new_tokens': ev.max_new_tokens,
-                   'stream': True, 'tenant': ev.tenant}
-        req = transport_lib.SimRequest(
+        self._start_leg(_ClientCall(ev, self.kernel.now))
+
+    def _start_leg(self, call: _ClientCall) -> None:
+        """Issue (or re-issue) one logical request against the current
+        LB. With the LB dead — mid crash-restart — the leg parks and
+        the restarted LB replays it, exactly like an SDK retry loop
+        waiting out a connection refused."""
+        if self._lb is None:
+            self._pending_legs.append(call)
+            return
+        ev = call.ev
+        payload: Dict[str, Any] = {
+            'tokens': ev.tokens, 'max_new_tokens': ev.max_new_tokens,
+            'stream': True, 'tenant': ev.tenant}
+        if call.resume:
+            # The client-side half of PR 5's resume splice: tokens the
+            # dead LB already delivered seed resume_from, so the new
+            # stream emits only the undelivered tail.
+            payload['resume_from'] = list(call.resume)
+        call.req = transport_lib.SimRequest(
             '/generate', json.dumps(payload).encode(),
             headers={common.TENANT_HEADER: ev.tenant})
-        t0 = self.kernel.now
-        fut = self.kernel.spawn(self._lb.handle(req))
-        fut.add_done_callback(
-            lambda f: self._on_request_done(ev, t0, f))
+        call.fut = self.kernel.spawn(self._lb.handle(call.req))
+        self._inflight_calls[id(call)] = call
+        call.fut.add_done_callback(
+            lambda f, c=call: self._on_leg_done(c, f))
 
-    def _on_request_done(self, ev, t0: float,
-                         fut: kernel_lib.SimFuture) -> None:
+    def _on_leg_done(self, call: _ClientCall,
+                     fut: kernel_lib.SimFuture) -> None:
+        if self._inflight_calls.pop(id(call), None) is None:
+            return   # severed by an LB kill; the retry leg owns it
+        ev = call.ev
         rec: Dict[str, Any] = {
             'tenant': ev.tenant, 'shed': False, 'completed': False,
             'resumed': 0, 'tokens': 0, 'ttft': None,
             'queue_wait': None, 'steps_waited': None,
-            'finish_reason': None, 'itls': []}
+            'finish_reason': None, 'itls': [],
+            'lb_retries': call.retries}
         try:
             resp = fut.result()
         except BaseException as e:  # noqa: BLE001 — a gate failure, kept loud
@@ -232,21 +323,21 @@ class DigitalTwin:
             return
         if isinstance(resp, transport_lib.SimStreamResponse):
             done_line = None
-            token_ids: List[int] = []
+            token_ids: List[int] = list(call.resume)
             for line in resp.lines():
                 toks = line.get('tokens')
                 if isinstance(toks, list):
-                    rec['tokens'] += len(toks)
                     token_ids.extend(toks)
                 if line.get('done'):
                     done_line = line
                 if 'error' in line:
                     rec['finish_reason'] = 'stream_error'
+            rec['tokens'] = len(token_ids)
             if done_line is not None and rec['finish_reason'] is None:
                 rec['completed'] = True
-                # Bit-identity audit: whatever failovers/resumes
-                # happened on the way, a completed stream's delivered
-                # tokens must equal the deterministic unkilled
+                # Bit-identity audit: whatever failovers, resumes, and
+                # LB crash-retries happened on the way, the tokens the
+                # client holds must equal the deterministic unkilled
                 # continuation, full length — no loss, no dupes.
                 rec['tokens_ok'] = (
                     token_ids == replica_lib.expected_continuation(
@@ -265,9 +356,96 @@ class DigitalTwin:
             else:
                 rec['finish_reason'] = f'http_{status}'
         self.report.records.append(rec)
+        extra = {'retries': call.retries} if call.retries else {}
         self._log('request', tenant=ev.tenant,
                   outcome=rec['finish_reason'],
-                  tokens=rec['tokens'], resumed=rec['resumed'])
+                  tokens=rec['tokens'], resumed=rec['resumed'],
+                  **extra)
+
+    # ---- process kills (docs/robustness.md "Crash safety") -------------
+    def _crash_gate(self, window: str) -> None:
+        """Installed as the VirtualCloud's crash gate: when a
+        controller kill just landed, tear the cloud-facing op on the
+        stack at its real crash window (after the provider
+        side-effect, before the manager's DB write)."""
+        if self._crash_armed:
+            self._crash_armed = False
+            raise cloud_lib.SimCrashError(window)
+
+    def _kill(self, target: str, restart_delay_s: float) -> None:
+        if target == 'controller':
+            self._kill_controller(restart_delay_s)
+        elif target == 'lb':
+            self._kill_lb(restart_delay_s)
+        else:
+            raise ValueError(f'unknown kill target {target!r}')
+
+    def _kill_controller(self, restart_delay_s: float) -> None:
+        if self._controller is None:
+            return   # already dead (overlapping kills)
+        self._controller = None
+        # The thread pool dies with the process: queued launches and
+        # teardowns never run; the one on the stack (if any) is torn
+        # by the crash gate at its window.
+        self._executor.kill()
+        self._crash_armed = True
+        self._log('crash', target='controller')
+        self.kernel.call_later(restart_delay_s,
+                               self._restart_controller)
+
+    def _restart_controller(self) -> None:
+        self._crash_armed = False
+        self._executor = cloud_lib.SimExecutor(self.kernel)
+        self._controller = controller_lib.ServeController(
+            self.SERVICE, cloud=self._cloud, executor=self._executor)
+        # Startup reconciliation, run TWICE: the second pass must be a
+        # no-op (the idempotence half of the acceptance gate — rolled
+        # into every killed replay, not just the unit test).
+        rep = self._controller.rm.reconcile(now=self.kernel.now)
+        rep2 = self._controller.rm.reconcile(now=self.kernel.now)
+        self._log('recover', target='controller',
+                  adopted=len(rep['adopted']),
+                  rolled_back=len(rep['rolled_back']),
+                  resolved=len(rep['resolved']),
+                  resumed_teardowns=len(rep['resumed_teardowns']),
+                  second_pass_noop=not any(rep2.values()))
+
+    def _kill_lb(self, restart_delay_s: float) -> None:
+        if self._lb is None:
+            return
+        self._lb = None
+        calls = list(self._inflight_calls.values())
+        self._inflight_calls.clear()
+        for call in calls:
+            # The process died: its proxy coroutines stop mid-await
+            # (finally blocks run, like sockets closing), and the
+            # client keeps what was already flushed to it.
+            call.fut.cancel()
+            splice = call.req.splice if call.req is not None else None
+            if splice is not None:
+                call.resume.extend(int(t) for t in splice.delivered)
+            call.retries += 1
+            self._pending_legs.append(call)
+        self._log('crash', target='lb', severed=len(calls))
+        self.kernel.call_later(restart_delay_s, self._restart_lb)
+
+    def _restart_lb(self) -> None:
+        self._lb = transport_lib.TwinLoadBalancer(
+            self.SERVICE, self.sc.lb_policy, clock=self.kernel.clock,
+            model_by_url=self._model_by_url)
+        self._lb.sync_interval_s = self.sc.lb_sync_s
+        self._lb.stats_flush_s = self.sc.stats_flush_s
+        # The crash-restart rebuild under test: ready set, affinity
+        # ring, and breaker state repopulated from serve_state before
+        # the first retried leg lands.
+        self.kernel.spawn(self._lb.bootstrap_from_state())
+        self._breakers_open = set()
+        self._log('lb_restart',
+                  ready=len(self._lb.policy.ready_urls),
+                  replayed=len(self._pending_legs))
+        legs, self._pending_legs = self._pending_legs, []
+        for call in legs:
+            self._start_leg(call)
 
     # ---- faults --------------------------------------------------------
     def _apply_fault(self, fault: Fault) -> None:
@@ -317,6 +495,8 @@ class DigitalTwin:
         """Log breaker state EDGES into the decision log (the
         breaker-flap gate asserts open ↦ re-closed; the REAL breaker
         decides, the twin only observes)."""
+        if self._lb is None:
+            return
         open_now = {u for u, s in self._lb.breaker.snapshot().items()
                     if s != retry_lib.STATE_CLOSED}
         prev = getattr(self, '_breakers_open', set())
@@ -327,8 +507,18 @@ class DigitalTwin:
         self._breakers_open = open_now
 
     def _controller_tick(self) -> None:
+        if self._controller is None:
+            return   # dead between kill and restart
         before = self._controller.autoscaler.target_num_replicas
-        self._controller.tick(now=self.kernel.now)
+        try:
+            self._controller.tick(now=self.kernel.now)
+        except failpoints.FailpointError:
+            # The serve.controller.crash failpoint at the tick
+            # boundary, armed from the environment: becomes a virtual
+            # process kill (the kill-anywhere seam composes with
+            # env-driven chaos like every other failpoint mirror).
+            self._kill('controller', restart_delay_s=30.0)
+            return
         after = self._controller.autoscaler.target_num_replicas
         if after != before:
             self._log('scale_target', target=after)
@@ -343,7 +533,9 @@ class DigitalTwin:
             with vclock.installed(self.kernel.clock):
                 self._setup()
                 self.kernel.run()
-                self.report.lb_metrics = self._lb.lb_metrics()
+                if self._lb is not None:
+                    self.report.lb_metrics = self._lb.lb_metrics()
+                self.report.final_fleet = self._final_fleet()
         finally:
             if prev_home is None:
                 os.environ.pop(common.HOME_ENV_VAR, None)
@@ -358,6 +550,33 @@ class DigitalTwin:
         self.report.wall_s = time.perf_counter() - t_wall
         self.report.events_run = self.kernel.events_run
         return self.report
+
+    def _final_fleet(self) -> Dict[str, Any]:
+        """End-of-replay convergence view: the crash gates assert a
+        killed-and-recovered run lands on the SAME fleet state as the
+        unkilled baseline — same ready count, nothing stuck mid-
+        transition, an empty intent journal."""
+        rows = serve_state.get_replicas(self.SERVICE)
+        statuses: Dict[str, int] = {}
+        for r in rows:
+            s = r['status'].value
+            statuses[s] = statuses.get(s, 0) + 1
+        transitional = (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+                        ReplicaStatus.STARTING, ReplicaStatus.DRAINING,
+                        ReplicaStatus.SHUTTING_DOWN)
+        return {
+            'ready': statuses.get('READY', 0),
+            'transitional': sum(statuses.get(s.value, 0)
+                                for s in transitional),
+            'open_intents': serve_state.count_open_intents(self.SERVICE),
+            'statuses': statuses,
+            # Provider-side truth: dead-but-uncleaned slices linger
+            # here — a stranded carcass cleanup is invisible to the
+            # replica table (PREEMPTED is terminal) but not to the
+            # cloud.
+            'cloud_slices': (len(self._cloud.slices)
+                             if self._cloud is not None else None),
+        }
 
     def _setup(self) -> None:
         sc = self.sc
@@ -379,9 +598,10 @@ class DigitalTwin:
             self.kernel, make_replica=self._make_replica,
             log=self._log, zones=sc.zones,
             provision_delay_s=sc.provision_delay_s, seed=self.seed)
-        executor = cloud_lib.SimExecutor(self.kernel)
+        self._cloud.crash_gate = self._crash_gate
+        self._executor = cloud_lib.SimExecutor(self.kernel)
         self._controller = controller_lib.ServeController(
-            self.SERVICE, cloud=self._cloud, executor=executor)
+            self.SERVICE, cloud=self._cloud, executor=self._executor)
         self._lb = transport_lib.TwinLoadBalancer(
             self.SERVICE, sc.lb_policy, clock=self.kernel.clock,
             model_by_url=self._model_by_url)
@@ -394,17 +614,34 @@ class DigitalTwin:
         # completes within its event.
         self.kernel.every(sc.controller_tick_s, self._controller_tick,
                           until=sc.duration_s)
+
+        def check_lb_crash(fut: kernel_lib.SimFuture) -> None:
+            # The serve.lb.crash failpoint fires at the top of the
+            # REAL _sync_once; env-armed, it becomes a virtual LB
+            # process kill here (same composition rule as the
+            # lb.proxy mirrors).
+            if isinstance(fut._exc,  # noqa: SLF001
+                          failpoints.FailpointError):
+                self._kill('lb', restart_delay_s=30.0)
+
         def lb_sync() -> None:
-            self.kernel.spawn(self._lb._sync_once())  # noqa: SLF001
+            if self._lb is None:
+                return
+            fut = self.kernel.spawn(self._lb._sync_once())  # noqa: SLF001
+            fut.add_done_callback(check_lb_crash)
             self._watch_breakers()
+
+        def stats_flush() -> None:
+            if self._lb is not None:
+                self.kernel.spawn(
+                    self._lb._flush_stats_once())  # noqa: SLF001
 
         self.kernel.every(sc.lb_sync_s, lb_sync,
                           start=sc.lb_sync_s * 0.5,
                           until=sc.duration_s)
-        self.kernel.every(
-            sc.stats_flush_s,
-            lambda: self.kernel.spawn(self._lb._flush_stats_once()),  # noqa: SLF001
-            start=sc.stats_flush_s * 0.7, until=sc.duration_s)
+        self.kernel.every(sc.stats_flush_s, stats_flush,
+                          start=sc.stats_flush_s * 0.7,
+                          until=sc.duration_s)
         # Traffic.
         for ev in self._synthesize():
             self.kernel.call_at(sc.traffic_start_s + ev.t,
@@ -412,3 +649,12 @@ class DigitalTwin:
         # Faults.
         for fault in sc.faults:
             self.kernel.call_at(fault.t, self._apply_fault, fault)
+        # Scheduled process kills (crash scenarios; seq-armed kills
+        # fire from _log instead).
+        for i, k in enumerate(self.kills):
+            if k.at_t is not None:
+                def fire(idx=i, spec=k) -> None:
+                    if idx not in self._kills_fired:
+                        self._kills_fired.add(idx)
+                        self._kill(spec.target, spec.restart_delay_s)
+                self.kernel.call_at(k.at_t, fire)

@@ -108,6 +108,20 @@ def pid_alive(pid: int) -> bool:
         return False
     try:
         os.kill(pid, 0)
-        return True
     except (ProcessLookupError, PermissionError):
         return False
+    # A zombie still answers kill(pid, 0) but is dead. This matters for
+    # crash detection (docs/robustness.md "Crash safety"): a kill -9'd
+    # detached controller is orphaned onto pid 1, and in containers
+    # whose init does not reap, the corpse lingers as Z forever — it
+    # must read as crashed, or `serve status` reports a dead control
+    # plane healthy and `serve down` waits on it. The comm field in
+    # /proc/<pid>/stat may contain spaces/parens; the state letter is
+    # the first field after the LAST ')'.
+    try:
+        with open(f'/proc/{pid}/stat', encoding='ascii',
+                  errors='replace') as f:
+            stat = f.read()
+        return stat.rsplit(')', 1)[1].split()[0] != 'Z'
+    except (OSError, IndexError):
+        return True   # no procfs (macOS): keep the kill(0) verdict

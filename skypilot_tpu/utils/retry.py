@@ -14,6 +14,12 @@ and the serve LB turned any pre-stream connection error into a 502.
 - **transient vs fatal classification** by exception type — fatal wins
   when both match, and anything matching neither propagates immediately
   (an unknown error is not license to hammer);
+- an optional **server-supplied backoff floor** (``retry_after``): when
+  the failed call carries a ``Retry-After`` the server computed (the
+  serve stack's queue-drain estimate on 429/503), the jittered delay is
+  raised to at least that value — the server knows its backlog better
+  than our exponential guess, and ignoring it turns a polite shed into
+  a hammer;
 - every retry is recorded as a zero-duration span on the active trace
   (``retry.<name>``), so `sky-tpu trace` shows *where* a request's
   latency went to backoff.
@@ -39,6 +45,11 @@ from skypilot_tpu.observability import trace as trace_lib
 # their own tuple when the transport is requests.
 DEFAULT_TRANSIENT: Tuple[Type[BaseException], ...] = (
     ConnectionError, TimeoutError, OSError)
+
+# Ceiling on an honored server-supplied Retry-After: the serve stack
+# clamps its own queue-drain estimates to [1, 60] s, and a client must
+# not sleep unboundedly on a hostile/buggy header.
+RETRY_AFTER_CAP_S = 60.0
 
 
 def _record_retry_event(name: str, attempt: int, delay_s: float,
@@ -81,6 +92,8 @@ class Retrier:
                  fatal: Tuple[Type[BaseException], ...] = (),
                  retry_on: Optional[
                      Callable[[BaseException], bool]] = None,
+                 retry_after: Optional[
+                     Callable[[BaseException], Optional[float]]] = None,
                  sleep: Callable[[float], None] = time.sleep,
                  rng: Callable[[], float] = random.random) -> None:
         if max_attempts < 1:
@@ -93,6 +106,10 @@ class Retrier:
         self.transient = transient
         self.fatal = fatal
         self.retry_on = retry_on
+        # Private name on purpose: a field called `retry_after` would
+        # collide with the engine schedulers' lock-annotated
+        # Scheduler.retry_after in the lint's duck dispatch.
+        self._retry_after = retry_after
         self._sleep = sleep
         self._rng = rng
 
@@ -109,6 +126,19 @@ class Retrier:
                   self.base_delay_s * (2 ** (attempt - 1)))
         return self._rng() * cap
 
+    def _floor_s(self, exc: BaseException) -> Optional[float]:
+        """Server-supplied backoff floor for this failure, if any —
+        extraction errors never fail the retry loop."""
+        if self._retry_after is None:
+            return None
+        try:
+            floor = self._retry_after(exc)
+        except Exception:  # noqa: BLE001 — a bad header is no floor
+            return None
+        if floor is None or floor <= 0:
+            return None
+        return min(float(floor), RETRY_AFTER_CAP_S)
+
     def call(self, fn: Callable[..., Any], *args: Any,
              **kwargs: Any) -> Any:
         deadline = (time.monotonic() + self.deadline_s
@@ -124,6 +154,12 @@ class Retrier:
                 if attempt >= self.max_attempts:
                     raise
                 delay = self.backoff_s(attempt)
+                # The server's Retry-After (serve-stack queue-drain
+                # estimate) is a FLOOR on the jittered delay, never a
+                # cap — but the overall deadline still wins below.
+                floor = self._floor_s(e)
+                if floor is not None:
+                    delay = max(delay, floor)
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:

@@ -1,0 +1,285 @@
+"""Control-plane crash safety against the REAL code paths
+(docs/robustness.md "Crash safety").
+
+The `serve.controller.crash` / `serve.lb.crash` failpoints simulate a
+kill -9 at the real crash windows — a tick boundary, the gap between
+cloud-call and DB-write inside a launch, the gap between drain and
+terminate inside a teardown, an LB sync tick — and each case then
+plays the OTHER process's part: a fresh ReplicaManager (the respawned
+controller) runs startup reconciliation, or a fresh LoadBalancer
+rebuilds itself from the state DB. The fleet-scale version of these
+windows (a kill at every decision boundary of a storm replay) lives in
+tests/sim/test_crash_sweep.py.
+"""
+import asyncio
+import concurrent.futures
+import json
+from types import SimpleNamespace
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import spec as spec_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.serve.state import ReplicaStatus
+from skypilot_tpu.utils import failpoints
+
+pytestmark = pytest.mark.chaos
+
+SVC = 'crashsvc'
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints._reset_for_tests()
+    yield
+    failpoints._reset_for_tests()
+
+
+class InlineExecutor:
+    """Run manager pool work synchronously — each test IS the thread."""
+
+    def submit(self, fn, *args, **kwargs):
+        fut = concurrent.futures.Future()
+        fut.set_running_or_notify_cancel()
+        try:
+            fut.set_result(fn(*args, **kwargs))
+        except BaseException as e:  # noqa: BLE001 — reaped like the pool's
+            fut.set_exception(e)
+        return fut
+
+    def shutdown(self, wait=False):
+        del wait
+
+
+class FakeCloud(replica_managers.CloudAdapter):
+    """Provider double with inspectable reality: which slices exist,
+    what got drained/terminated."""
+
+    def __init__(self):
+        self.slices = {}
+        self.drained = []
+        self.terminated = []
+
+    def launch(self, task, cluster_name, blocked, avoid_placements=None):
+        self.slices[cluster_name] = True
+        return SimpleNamespace(
+            head=SimpleNamespace(external_ip='127.0.0.1',
+                                 internal_ip=None,
+                                 agent_url='http://127.0.0.1:1/agent'),
+            tpu_slice='v5e-4', region='r1', zone='a')
+
+    def probe_url(self, url, probe):
+        return True
+
+    def provider_alive(self, cluster_name):
+        return True if cluster_name in self.slices else None
+
+    def preemption_notice(self, cluster_name):
+        return False
+
+    def describe_cluster(self, cluster_name, port):
+        if cluster_name not in self.slices:
+            return None
+        return {'url': f'http://127.0.0.1:{port or 80}',
+                'zone': 'r1/a', 'accelerator': 'v5e-4'}
+
+    def drain(self, url, deadline_s):
+        self.drained.append(url)
+        return {'status': 'drained'}
+
+    def terminate(self, cluster_name):
+        self.slices.pop(cluster_name, None)
+        self.terminated.append(cluster_name)
+
+    def terminate_by_name(self, cluster_name, cloud_hint=None):
+        self.terminate(cluster_name)
+
+
+def _mk_service(name=SVC):
+    spec_cfg = {'readiness_probe': '/',
+                'replica_policy': {'min_replicas': 1}}
+    task = sky.Task(name, run='serve-workload',
+                    resources=sky.Resources(cloud='local',
+                                            accelerators='v5e-4'))
+    assert serve_state.add_service(name, json.dumps(spec_cfg),
+                                   task.to_yaml(), 18080, 'round_robin')
+    return spec_lib.ServiceSpec.from_config(spec_cfg), task.to_yaml()
+
+
+def _mk_rm(cloud, spec, task_yaml, name=SVC):
+    return replica_managers.ReplicaManager(
+        name, spec, task_yaml, cloud=cloud, executor=InlineExecutor())
+
+
+def test_crash_between_cloud_call_and_db_write_adopts_orphan(
+        monkeypatch):
+    """The torn launch window: the slice exists, the DB says
+    PROVISIONING, the intent is open. The respawned controller's
+    reconcile adopts the orphan (url/zone written, STARTING, journal
+    clean) — and running it again is a no-op."""
+    spec, task_yaml = _mk_service()
+    cloud = FakeCloud()
+    rm = _mk_rm(cloud, spec, task_yaml)
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'serve.controller.crash=error@1')
+    rid = rm.launch_replica(1)
+    # The "crash": the launch worker died after the provider create,
+    # before any DB write — its exception is never reaped because the
+    # controller that owned it is gone.
+    assert rm._launching[rid].exception() is not None
+    row = serve_state.get_replica(rid)
+    assert row['status'] == ReplicaStatus.PROVISIONING
+    assert serve_state.count_open_intents(SVC) == 1
+    assert cloud.slices   # the orphan is real
+
+    rm2 = _mk_rm(cloud, spec, task_yaml)   # the respawned controller
+    report = rm2.reconcile()
+    assert report['adopted'] == [rid]
+    row = serve_state.get_replica(rid)
+    assert row['status'] == ReplicaStatus.STARTING
+    assert row['url']
+    assert serve_state.count_open_intents(SVC) == 0
+    assert not cloud.terminated
+    # Idempotence: the second pass finds nothing to do.
+    report2 = rm2.reconcile()
+    assert not any(report2.values()), report2
+    # Counters persisted for `serve status`.
+    svc = serve_state.get_service(SVC)
+    assert svc['orphans_adopted'] == 1
+    assert svc['recoveries_total'] >= 1
+
+
+def test_crash_with_dead_slice_rolls_launch_back(monkeypatch):
+    """Same torn window, but the provider lost the slice (create
+    failed after all, or it was reclaimed before recovery ran):
+    reconcile rolls the launch BACK — best-effort terminate by name,
+    row FAILED, journal clean."""
+    spec, task_yaml = _mk_service()
+    cloud = FakeCloud()
+    rm = _mk_rm(cloud, spec, task_yaml)
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'serve.controller.crash=error@1')
+    rid = rm.launch_replica(1)
+    cloud.slices.clear()   # the provider never really made it
+
+    rm2 = _mk_rm(cloud, spec, task_yaml)
+    report = rm2.reconcile()
+    assert report['rolled_back'] == [rid]
+    row = serve_state.get_replica(rid)
+    assert row['status'] == ReplicaStatus.FAILED
+    assert 'controller crash' in row['failure_reason']
+    assert serve_state.count_open_intents(SVC) == 0
+    assert not any(rm2.reconcile().values())
+
+
+def test_crash_mid_teardown_rolls_drain_forward(monkeypatch):
+    """The half-done-drain window: DRAINING/SHUTTING_DOWN written,
+    drain issued, crash before the provider terminate. Reconcile
+    resumes the teardown: slice terminated, row (and its intent)
+    gone."""
+    spec, task_yaml = _mk_service()
+    cloud = FakeCloud()
+    rm = _mk_rm(cloud, spec, task_yaml)
+    rid = rm.launch_replica(1)
+    serve_state.set_replica_status(rid, ReplicaStatus.READY)
+    row = serve_state.get_replica(rid)
+    assert row['url']
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'serve.controller.crash=error@1')
+    rm.terminate_replica(rid, 'scale-down')
+    assert rm._terminating[rid].exception() is not None   # died mid-op
+    assert cloud.drained                                   # drain DID run
+    assert cloud.slices                                    # slice survives
+    assert serve_state.count_open_intents(SVC) == 1
+
+    rm2 = _mk_rm(cloud, spec, task_yaml)
+    report = rm2.reconcile()
+    assert report['resumed_teardowns'] == [rid]
+    assert serve_state.get_replica(rid) is None
+    assert not cloud.slices
+    assert serve_state.count_open_intents(SVC) == 0
+    assert not any(rm2.reconcile().values())
+
+
+def test_teardown_intent_survives_racing_launch_commit():
+    """The interleaved window: a replica is terminated while its
+    launch is still in flight, and the launch's STARTING commit races
+    over the SHUTTING_DOWN write before the crash. The row no longer
+    says teardown — the open TERMINATING intent is the only survivor
+    of the decision, and reconcile must roll it forward (terminate +
+    drop the row) instead of leaving the slice leaked and the journal
+    open forever."""
+    spec, task_yaml = _mk_service()
+    cloud = FakeCloud()
+    # Build the torn state directly: row + LAUNCHING intent, then the
+    # teardown begin, then the launch commit overwriting it.
+    rid, cname = serve_state.add_replica_with_intent(
+        SVC, 1, is_spot=False, payload={'port': 8080})
+    cloud.slices[cname] = True
+    serve_state.mark_replica_teardown(
+        rid, ReplicaStatus.SHUTTING_DOWN, 'scale-down', 'TERMINATING')
+    serve_state.finish_replica_launch(rid, 'http://127.0.0.1:2',
+                                      'v5e-4', 'r1/a')
+    row = serve_state.get_replica(rid)
+    assert row['status'] == ReplicaStatus.STARTING   # the race
+    assert serve_state.count_open_intents(SVC) == 1  # TERMINATING
+
+    rm = _mk_rm(cloud, spec, task_yaml)
+    report = rm.reconcile()
+    assert report['resumed_teardowns'] == [rid]
+    assert serve_state.get_replica(rid) is None
+    assert cname in cloud.terminated
+    assert serve_state.count_open_intents(SVC) == 0
+    assert not any(rm.reconcile().values())
+
+
+def test_controller_tick_crash_leaves_no_failed_write(monkeypatch):
+    """serve.controller.crash at a tick boundary must die like
+    kill -9: the FailpointError escapes run() WITHOUT the FAILED
+    write, so the service row keeps its status (and its stale pid) for
+    `serve status` to flag and `serve up` to respawn."""
+    from skypilot_tpu.serve import controller as controller_lib
+    _mk_service()
+    serve_state.set_service_status(SVC,
+                                   serve_state.ServiceStatus.READY)
+    ctl = controller_lib.ServeController(
+        SVC, cloud=FakeCloud(), executor=InlineExecutor())
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'serve.controller.crash=error@1')
+    with pytest.raises(failpoints.FailpointError):
+        ctl.run()
+    record = serve_state.get_service(SVC)
+    assert record['status'] == serve_state.ServiceStatus.READY
+    assert record['controller_pid']   # the stale pid stays behind
+
+
+def test_lb_crash_and_bootstrap_from_state(monkeypatch):
+    """serve.lb.crash kills the sync plane mid-tick; a NEW LoadBalancer
+    (the restarted process) rebuilds its ready set and affinity ring
+    from the state DB via bootstrap_from_state before serving — no
+    blind 503 window, breakers re-enter closed."""
+    spec, task_yaml = _mk_service()
+    cloud = FakeCloud()
+    rm = _mk_rm(cloud, spec, task_yaml)
+    urls = []
+    for _ in range(2):
+        rid = rm.launch_replica(1)
+        serve_state.set_replica_status(rid, ReplicaStatus.READY)
+        urls.append(serve_state.get_replica(rid)['url'])
+
+    lb = lb_lib.LoadBalancer(SVC, 'cache_aware')
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS', 'serve.lb.crash=error@1')
+    with pytest.raises(failpoints.FailpointError):
+        asyncio.run(lb._sync_once())
+    assert lb.policy.ready_urls == []   # it died blind — that's the bug
+
+    lb2 = lb_lib.LoadBalancer(SVC, 'cache_aware')   # the restart
+    asyncio.run(lb2.bootstrap_from_state())
+    assert sorted(lb2.policy.ready_urls) == sorted(urls)
+    # The cache-aware affinity ring re-derived from the rebuilt set.
+    assert lb2.policy.preferred_replica('tok:1,2,3') in urls
+    # Breakers re-enter closed: every rebuilt replica is admissible.
+    assert all(lb2.breaker.allows(u) for u in urls)

@@ -874,3 +874,128 @@ def test_spec_tls_validation():
     with pytest.raises(exceptions.InvalidTaskError):
         spec_lib.ServiceSpec.from_config(
             {'replicas': 1, 'tls': 'not-a-mapping'})
+
+
+# ---------- crash safety (docs/robustness.md "Crash safety") --------------
+def _dead_pid():
+    """A pid that is certainly not running: a reaped child's."""
+    import subprocess
+    proc = subprocess.Popen(['true'])
+    proc.wait()
+    return proc.pid
+
+
+def test_service_snapshot_flags_dead_controller_degraded():
+    """Stale-pid detection: `serve status` must not report a service
+    healthy when its controller process is dead — the replicas may
+    still answer, but nothing will ever scale, probe, or drain them
+    again. DEGRADED + a recovery hint instead."""
+    task = _service_task(name='svc-deg')
+    serve.up(task, _spawn=False)
+    serve_state.set_service_status('svc-deg', ServiceStatus.READY)
+
+    # No pid recorded yet (controller not booted): unknown, not dead.
+    snap = controller_lib.service_snapshot('svc-deg')
+    assert snap['status'] == 'READY'
+    assert snap['controller_alive'] is None
+    assert snap['degraded_reason'] is None
+    assert snap['intents_open'] == 0
+
+    serve_state.set_controller_pid('svc-deg', _dead_pid())
+    snap = controller_lib.service_snapshot('svc-deg')
+    assert snap['status'] == 'DEGRADED'
+    assert snap['controller_alive'] is False
+    assert 'serve up' in snap['degraded_reason']
+
+    # A live pid (ours) reads healthy again.
+    import os as os_lib
+    serve_state.set_controller_pid('svc-deg', os_lib.getpid())
+    snap = controller_lib.service_snapshot('svc-deg')
+    assert snap['status'] == 'READY'
+    assert snap['controller_alive'] is True
+    serve_state.remove_service('svc-deg')
+
+
+def test_up_respawns_dead_controller(monkeypatch):
+    """`serve up` on an existing name whose controller pid is dead is
+    the respawn path, not a name conflict: the row (and journal) stay,
+    a new controller process re-attaches and reconciles."""
+    from skypilot_tpu.serve import service as service_lib
+    spawned = []
+    monkeypatch.setattr(service_lib, 'spawn_detached', spawned.append)
+    task = _service_task(name='svc-respawn')
+    serve.up(task)
+    assert spawned == ['svc-respawn']
+
+    # Controller "crashed": stale dead pid on the row.
+    serve_state.set_controller_pid('svc-respawn', _dead_pid())
+    out = serve.up(task)
+    assert out.get('respawned') is True
+    assert spawned == ['svc-respawn', 'svc-respawn']
+
+    # A LIVE controller is still a name conflict.
+    import os as os_lib
+    serve_state.set_controller_pid('svc-respawn', os_lib.getpid())
+    with pytest.raises(exceptions.InvalidTaskError):
+        serve.up(task)
+    serve_state.remove_service('svc-respawn')
+
+
+def test_reconcile_is_idempotent_and_journal_transactional():
+    """Unit-level recovery contract: a LAUNCHING intent + PENDING row
+    (the crash-before-cloud-call state) rolls back; running startup
+    reconciliation twice finds nothing the second time. The journal is
+    retired in the same transaction as the row transitions —
+    finish_replica_launch leaves no intent behind, remove_replica
+    drops the teardown intent with the row."""
+    from skypilot_tpu.serve import replica_managers
+
+    class NoCloud(replica_managers.CloudAdapter):
+        def provider_alive(self, cluster_name):
+            return None
+
+        def describe_cluster(self, cluster_name, port):
+            return None
+
+        def terminate_by_name(self, cluster_name, cloud_hint=None):
+            pass
+
+    task = _service_task(name='svc-journal')
+    serve.up(task, _spawn=False)
+    spec = spec_lib.ServiceSpec.from_config(
+        serve_state.get_service('svc-journal')['spec'])
+
+    # Crash-before-cloud-call: row + intent exist, nothing else.
+    rid, cname = serve_state.add_replica_with_intent(
+        'svc-journal', 1, is_spot=False,
+        payload={'port': 8080, 'cloud': 'local'})
+    assert cname == f'svc-journal-r{rid}'
+    assert serve_state.count_open_intents('svc-journal') == 1
+
+    rm = replica_managers.ReplicaManager(
+        'svc-journal', spec,
+        serve_state.get_service('svc-journal')['task_yaml'],
+        cloud=NoCloud())
+    report = rm.reconcile()
+    assert report['rolled_back'] == [rid]
+    assert serve_state.count_open_intents('svc-journal') == 0
+    assert (serve_state.get_replica(rid)['status']
+            == ReplicaStatus.FAILED)
+    assert not any(rm.reconcile().values())   # second pass: no-op
+
+    # Transactional commits: a completed launch leaves no intent...
+    rid2, _ = serve_state.add_replica_with_intent(
+        'svc-journal', 1, is_spot=False, payload={'port': 8080})
+    serve_state.finish_replica_launch(rid2, 'http://127.0.0.1:1',
+                                      'v5e-4', 'r/z')
+    assert serve_state.count_open_intents('svc-journal') == 0
+    row = serve_state.get_replica(rid2)
+    assert row['status'] == ReplicaStatus.STARTING and row['url']
+    # ...and a completed teardown retires its intent with the row.
+    serve_state.mark_replica_teardown(
+        rid2, ReplicaStatus.SHUTTING_DOWN, 'down', 'TERMINATING')
+    assert serve_state.count_open_intents('svc-journal') == 1
+    serve_state.remove_replica(rid2)
+    assert serve_state.count_open_intents('svc-journal') == 0
+    rm.shutdown()
+    serve_state.remove_service('svc-journal')

@@ -168,6 +168,82 @@ def test_retrier_retries_transient_to_success():
     assert len(sleeps) == 2
 
 
+def test_retry_after_is_backoff_floor():
+    """A server-supplied Retry-After (the serve stack's queue-drain
+    estimate on 429/503) floors the jittered delay — the server knows
+    its backlog better than our exponential guess."""
+    sleeps = []
+    r = retry_lib.Retrier('t', max_attempts=3, base_delay_s=0.1,
+                          sleep=sleeps.append,
+                          retry_after=lambda e: 7.5)
+    assert r.call(_flaky(2)) == 3
+    assert sleeps == [7.5, 7.5]   # jitter (<0.2s) floored to 7.5
+
+
+def test_retry_after_capped_and_fail_open():
+    """A hostile/buggy header cannot park the client for an hour, and
+    an extractor that blows up (or returns junk) means no floor — the
+    ordinary jittered backoff applies."""
+    sleeps = []
+    r = retry_lib.Retrier('t', max_attempts=2, base_delay_s=0.1,
+                          sleep=sleeps.append,
+                          retry_after=lambda e: 86400.0)
+    r.call(_flaky(1))
+    assert sleeps == [retry_lib.RETRY_AFTER_CAP_S]
+
+    def boom(e):
+        raise ValueError('no header')
+
+    sleeps2 = []
+    r2 = retry_lib.Retrier('t', max_attempts=2, base_delay_s=0.1,
+                           sleep=sleeps2.append, retry_after=boom)
+    r2.call(_flaky(1))
+    assert len(sleeps2) == 1 and sleeps2[0] <= 0.1
+
+
+def test_retry_after_deadline_still_wins():
+    """The overall deadline caps even a server-supplied floor: a
+    caller on a budget never overshoots it to honor a Retry-After."""
+    sleeps = []
+    r = retry_lib.Retrier('t', max_attempts=3, base_delay_s=0.1,
+                          deadline_s=2.0, sleep=sleeps.append,
+                          retry_after=lambda e: 30.0)
+    r.call(_flaky(1))
+    assert sleeps and sleeps[0] <= 2.0
+
+
+def test_sdk_get_retries_429_with_retry_after_floor(monkeypatch):
+    """The SDK GET path (client/sdk._http_get) treats 429/503 as
+    retryable — idempotent GETs — and honors the response's
+    Retry-After header as the backoff floor (the PR 7 queue-drain
+    estimate was emitted but ignored until now)."""
+    import requests as requests_lib
+
+    from skypilot_tpu.client import sdk
+
+    class _Resp:
+        def __init__(self, status, headers=None):
+            self.status_code = status
+            self.headers = headers or {}
+
+    err_429 = requests_lib.HTTPError(
+        response=_Resp(429, {'Retry-After': '12.5'}))
+    err_500 = requests_lib.HTTPError(response=_Resp(500))
+    conn = requests_lib.ConnectionError('reset')
+
+    assert sdk._http_transient(err_429)
+    assert not sdk._http_transient(err_500)
+    assert sdk._http_transient(conn)
+    assert sdk._http_retry_after(err_429) == 12.5
+    assert sdk._http_retry_after(err_500) is None
+    assert sdk._http_retry_after(conn) is None
+    # HTTP-date Retry-After: valid per RFC, not a float — no floor,
+    # never an exception.
+    dated = requests_lib.HTTPError(response=_Resp(
+        503, {'Retry-After': 'Wed, 21 Oct 2026 07:28:00 GMT'}))
+    assert sdk._http_retry_after(dated) is None
+
+
 def test_retrier_exhausts_attempts():
     sleeps = []
     r = retry_lib.Retrier('t', max_attempts=3, sleep=sleeps.append)
