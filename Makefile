@@ -6,7 +6,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke sim-crash-sweep
+.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke sim-crash-sweep slo-smoke
 
 lint:
 	$(PYTHON) -m skypilot_tpu.client.cli lint --changed
@@ -43,6 +43,16 @@ profile-smoke:
 # byte mismatch between the two same-seed runs.
 sim-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.sim --scenario reclaim_storm --verify-determinism
+
+# SLO alert round-trip smoke (docs/observability.md "SLOs and
+# alerting"): replay the reclaim-storm scenario in the digital twin
+# with a TTFT objective armed and assert the whole alert loop end to
+# end — the page tier fires after the storm, clears after recovery,
+# the firing edge wrote a flight-recorder fleet dump, and the
+# availability objective stayed silent (zero false positives on a
+# zero-error storm).
+slo-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.observability.slo
 
 # Kill-anywhere crash-consistency sweep (docs/robustness.md "Crash
 # safety"): replay the crash_sweep storm once unkilled, then once per

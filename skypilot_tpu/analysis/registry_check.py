@@ -48,6 +48,18 @@ METRIC_FUNCS: Tuple[Tuple[str, str], ...] = (
     ('serve/load_balancer.py', 'lb_metrics'),
 )
 
+# Functions whose string literals starting with the exposition prefix
+# name Prometheus metric families (observability/prometheus.py's
+# curated maps): every family must appear in docs/observability.md's
+# "## Prometheus exposition" catalog, both directions — a renamed
+# family is a silently-flatlined scrape.
+EXPOSITION_FUNCS: Tuple[Tuple[str, str], ...] = (
+    ('observability/prometheus.py', 'lb_exposition'),
+    ('observability/prometheus.py', 'replica_exposition'),
+    ('observability/prometheus.py', 'label_families'),
+)
+EXPOSITION_PREFIX = 'sky_tpu_'
+
 _ROW_RE = re.compile(r'^\|\s*`([^`]+)`')
 
 
@@ -96,6 +108,7 @@ class RegistryChecker(core.Checker):
             return
         yield from self._check_failpoints(files, ctx)
         yield from self._check_metrics(files, ctx)
+        yield from self._check_exposition(files, ctx)
 
     # -- failpoint sites ---------------------------------------------------
     def _failpoint_sites(self, files: Sequence[core.SourceFile]
@@ -219,3 +232,65 @@ class RegistryChecker(core.Checker):
                     f'cataloged metric key {key!r} is no longer '
                     f'emitted by any serving metric surface — a '
                     f'dashboard graphing it has flatlined')
+
+    # -- Prometheus exposition families --------------------------------------
+    @staticmethod
+    def _exposition_families(files: Sequence[core.SourceFile]
+                             ) -> List[Tuple[str, str, int]]:
+        """Every ``sky_tpu_*`` string literal inside the curated
+        exposition maps — the family namespace a scrape sees."""
+        by_rel = {s.rel: s for s in files}
+        fams: List[Tuple[str, str, int]] = []
+        for rel, fn_name in EXPOSITION_FUNCS:
+            src = by_rel.get(rel)
+            if src is None:
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if node.name != fn_name:
+                    continue
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Constant)
+                            and isinstance(sub.value, str)
+                            and sub.value.startswith(
+                                EXPOSITION_PREFIX)):
+                        fams.append((sub.value, rel, sub.lineno))
+        return fams
+
+    def _check_exposition(self, files: Sequence[core.SourceFile],
+                          ctx: core.RunContext
+                          ) -> Iterable[core.Finding]:
+        relevant = {rel for rel, _ in EXPOSITION_FUNCS}
+        if not relevant & {s.rel for s in files}:
+            return   # partial scan without the exposition module
+        doc = _doc_section_names(ctx.docs_root, 'observability.md',
+                                 '## Prometheus exposition')
+        if doc is None:
+            yield core.Finding(
+                self.code, 'docs/observability.md', 0,
+                'Prometheus exposition catalog ("## Prometheus '
+                'exposition") not found in docs/observability.md')
+            return
+        documented, where = doc
+        fams = self._exposition_families(files)
+        seen: Set[str] = set()
+        for fam, rel, lineno in fams:
+            if fam in documented or fam in seen:
+                continue
+            seen.add(fam)
+            yield core.Finding(
+                self.code, rel, lineno,
+                f'exposition family {fam!r} is not in '
+                f'docs/observability.md\'s Prometheus exposition '
+                f'catalog — scrape configs cannot discover it')
+        if ctx.full_package:
+            in_code = {f for f, _, _ in fams}
+            for fam in sorted(documented - in_code):
+                yield core.Finding(
+                    self.code, 'docs/observability.md',
+                    where.get(fam, 0),
+                    f'cataloged exposition family {fam!r} is no '
+                    f'longer emitted — a dashboard scraping it has '
+                    f'flatlined')

@@ -351,6 +351,21 @@ def trace_cmd(request_id: Optional[str],
         click.echo(f'wrote {perfetto_path}')
 
 
+def _fetch_json(url: str, timeout: float = 10.0):
+    """GET + parse a control endpoint's JSON, converting transport
+    and parse errors into one friendly ClickException (ValueError
+    covers a non-JSON body, HTTPException a non-HTTP peer — wrong
+    port, a reverse proxy's HTML error page)."""
+    import http.client
+    import json as json_lib
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json_lib.loads(r.read())
+    except (OSError, ValueError, http.client.HTTPException) as e:
+        raise click.ClickException(f'could not fetch {url}: {e}')
+
+
 @cli.command('profile')
 @click.argument('target', required=False)
 @click.option('--perfetto', 'perfetto_path', default=None,
@@ -368,9 +383,7 @@ def profile_cmd(target: Optional[str], perfetto_path: Optional[str],
     the anomaly dumps the recorder snapshotted into the span store).
     With no argument, lists recorded dumps.
     """
-    import http.client
     import json as json_lib
-    import urllib.request
 
     from skypilot_tpu.observability import render as render_lib
     from skypilot_tpu.observability import stepline as stepline_lib
@@ -392,18 +405,7 @@ def profile_cmd(target: Optional[str], perfetto_path: Optional[str],
         click.echo(f'wrote {perfetto_path}')
 
     if target and target.startswith(('http://', 'https://')):
-        url = target.rstrip('/') + '/debug/stepline'
-        try:
-            with urllib.request.urlopen(url, timeout=10) as r:
-                snap = json_lib.loads(r.read())
-        except (OSError, ValueError,
-                http.client.HTTPException) as e:
-            # ValueError covers a non-JSON body and HTTPException a
-            # non-HTTP peer (wrong port, a reverse proxy's HTML error
-            # page) — same friendly error as an unreachable replica,
-            # never a raw traceback.
-            raise click.ClickException(
-                f'could not fetch {url}: {e}')
+        snap = _fetch_json(target.rstrip('/') + '/debug/stepline')
         if not snap.get('enabled', True):
             click.echo('flight recorder disabled on this replica '
                        '(--no-stepline).')
@@ -484,6 +486,61 @@ def profile_cmd(target: Optional[str], perfetto_path: Optional[str],
                    f"{trigger.get('attrs') or {}}")
     click.echo(render_lib.render_tree(spans))
     _write_perfetto(lambda: render_lib.to_perfetto(spans))
+
+
+@cli.command('slo')
+@click.argument('lb_url')
+@click.option('--json', 'as_json', is_flag=True,
+              help='Raw /-/alerts JSON instead of the table.')
+def slo_cmd(lb_url: str, as_json: bool) -> None:
+    """Show a live LB's SLO objectives, error budgets, and firing
+    alerts (docs/observability.md "SLOs and alerting").
+
+    LB_URL is the service endpoint (``http://host:port``); this reads
+    its ``/-/alerts`` view: per-objective burn rates on the page
+    (5m/1h) and ticket (30m/6h) windows, the error budget remaining,
+    and the live firing set with recent transitions.
+    """
+    import json as json_lib
+
+    doc = _fetch_json(lb_url.rstrip('/') + '/-/alerts')
+    if as_json:
+        click.echo(json_lib.dumps(doc, indent=1))
+        return
+    if not doc.get('enabled', False):
+        click.echo('No SLO objectives declared for this service — '
+                   'add an `slo:` section to the service spec '
+                   '(docs/observability.md "SLOs and alerting").')
+        return
+    fmt = ('{:<24} {:<20} {:>7} {:>8} {:>9} {:>9} {:>8}')
+    click.echo(fmt.format('OBJECTIVE', 'METRIC', 'TARGET', 'BUDGET',
+                          'PAGE_5M', 'PAGE_1H', 'STATE'))
+    for key, row in sorted(doc.get('objectives', {}).items()):
+        state = ('PAGE' if row.get('page_firing')
+                 else 'ticket' if row.get('ticket_firing') else 'ok')
+        metric = row.get('metric', '?')
+        if row.get('threshold_s') is not None:
+            metric += f"<={row['threshold_s']:g}s"
+        if row.get('tenant'):
+            metric += f" [{row['tenant']}]"
+        click.echo(fmt.format(
+            key, metric, f"{row.get('target', 0):g}",
+            f"{row.get('error_budget_remaining', 0):.2%}",
+            f"{row.get('page_burn_short', 0):g}",
+            f"{row.get('page_burn_long', 0):g}", state))
+    firing = doc.get('firing') or []
+    if firing:
+        click.echo('\nFIRING:')
+        for f in firing:
+            click.echo(f"  [{f['tier']}] {f['objective']} "
+                       f"since t={f.get('since_t')}")
+    tail = (doc.get('transitions') or [])[-5:]
+    if tail:
+        click.echo('\nrecent transitions:')
+        for t in tail:
+            click.echo(f"  t={t['t']} {t['tier']} {t['objective']} "
+                       f"-> {t['state']} (burn {t['burn_short']}/"
+                       f"{t['burn_long']})")
 
 
 @cli.command('show-accelerators')

@@ -36,6 +36,7 @@ from aiohttp import web
 
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.observability import prometheus as prom_lib
 from skypilot_tpu.utils import common as common_lib
 from skypilot_tpu.utils import failpoints
 
@@ -404,13 +405,21 @@ class InferenceServer:
             return web.json_response({'status': 'warming'}, status=503)
         return web.json_response({'status': 'ok'})
 
-    async def h_metrics(self, _req: web.Request) -> web.Response:
+    async def h_metrics(self, req: web.Request) -> web.Response:
         m = self.engine.metrics()
         m['draining'] = self.draining
         m['server_inflight'] = self._active
         m['requests_shed'] = self._requests_shed
         if self.drain_duration_s is not None:
             m['drain_duration_s'] = round(self.drain_duration_s, 4)
+        # `?format=prometheus` wraps the same gauges in text
+        # exposition (docs/observability.md "Prometheus exposition");
+        # JSON stays the default — the LB sync tick and the bench
+        # parse it.
+        if req.query.get('format') == 'prometheus':
+            return web.Response(text=prom_lib.render_replica(m),
+                                content_type='text/plain',
+                                charset='utf-8')
         return web.json_response(m)
 
     async def h_stepline(self, _req: web.Request) -> web.Response:

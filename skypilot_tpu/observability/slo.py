@@ -1,0 +1,600 @@
+"""Fleet SLO engine: declarative objectives + multi-window
+multi-burn-rate alerting over the serving tier's live signals.
+
+Everything before this module *exported* signals (per-tenant TTFT and
+queue-wait, LB edge counters, the PR 12 fleet history rings); nothing
+*interpreted* them — there was no machine-checkable answer to "is the
+fleet meeting its latency objective for tenant X right now?". This
+module adds the interpretation layer:
+
+- :class:`SloObjective` — a declarative objective over one service
+  level indicator (SLI): TTFT p99, ITL p99, request availability,
+  shed rate, or replica responsiveness; fleet-wide or scoped to one
+  tenant; loaded from the service spec's ``slo:`` section (validated
+  at ``serve up`` time) or the ``SKY_TPU_LB_SLO`` env override.
+- :class:`SloEvaluator` — the SRE-workbook multi-window multi-burn
+  evaluator: each SLI is a time-bucketed good/bad event series; an
+  alert **tier** fires when the burn rate (error rate over the
+  window, divided by the objective's error budget ``1 - target``)
+  exceeds the tier's threshold on BOTH its short and long window.
+  Two shipped tiers: **page** (5m/1h at burn 14.4 — burning a 30-day
+  budget in ~2 days) and **ticket** (30m/6h at burn 6). The long
+  window proves the burn is sustained; the short window clears the
+  alert promptly after recovery.
+
+The evaluator is clock-free by construction: every entry point takes
+``now`` explicitly, so the SAME code runs on the production wall
+clock (the LB passes its injected ``vclock`` reads) and inside the
+digital twin's virtual time — which is what makes alert FIDELITY
+provable: ``tests/sim/test_slo_alerts.py`` replays incident and
+brownout scenarios and asserts the page tier fires within a bounded
+number of virtual minutes, clears after recovery, and stays silent
+on degraded-but-within-SLO fleets, with the alert decision log
+byte-identical per seed.
+
+Wiring (docs/observability.md "SLOs and alerting"): the serve LB
+drives :meth:`SloEvaluator.evaluate` from its existing sync tick,
+feeds latency samples from its TTFT/ITL stopwatches, outcome counters
+by delta, and replica freshness from the PR 12 history-ring staleness
+rule (a hung replica counts BAD instead of silently masking a fleet
+burn). Surfaces: alert/budget gauges in ``lb_metrics()``, the
+``/-/alerts`` endpoint, Prometheus exposition
+(``observability/prometheus.py``), a page-tier firing edge triggers a
+``stepline.fleet_dump`` flight-recorder capture, and the max page
+burn is flushed to the state DB as the autoscaler's ``slo_burn``
+scale-up input.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+# Supported SLI metrics. Latency metrics classify each sample against
+# ``threshold_s`` (the objective "p99 TTFT <= threshold" IS the SLI
+# "fraction of requests faster than threshold >= target"); the
+# counter metrics classify request outcomes; ``replica_availability``
+# classifies per-sync-tick replica responsiveness (the PR 12
+# freshest-ring staleness rule).
+LATENCY_METRICS = ('ttft_p99', 'itl_p99')
+COUNTER_METRICS = ('availability', 'shed_rate')
+REPLICA_METRICS = ('replica_availability',)
+METRICS = LATENCY_METRICS + COUNTER_METRICS + REPLICA_METRICS
+
+# Bucket width of the good/bad event series. Finer than the shortest
+# window by >10x so window sums are sharp at tick cadence.
+DEFAULT_BUCKET_S = 15.0
+# A window with fewer total events returns burn 0.0 — two bad events
+# out of three must not page anyone (the sparse-sample rule).
+DEFAULT_MIN_SAMPLES = 12
+# Error-budget accounting horizon (the "remaining budget" gauge; a
+# 30-day horizon is meaningless inside a replay, so it is a knob).
+DEFAULT_BUDGET_WINDOW_S = 24 * 3600.0
+# Env override for a stand-alone LB without a service spec.
+SLO_ENV = 'SKY_TPU_LB_SLO'
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnTier:
+    """One alert tier: fires when burn >= ``burn`` on BOTH windows."""
+    tier: str
+    short_s: float
+    long_s: float
+    burn: float
+
+
+# The SRE-workbook defaults: page = fast burn (14.4x eats a 30-day
+# budget in ~2 days), ticket = slow burn worth a work-hours look.
+PAGE = BurnTier('page', 300.0, 3600.0, 14.4)
+TICKET = BurnTier('ticket', 1800.0, 21600.0, 6.0)
+DEFAULT_TIERS = (PAGE, TICKET)
+
+
+@dataclasses.dataclass(frozen=True)
+class SloObjective:
+    """One declarative objective. ``target`` is the good-event
+    fraction (0.99 = "99% of events good", error budget 1%);
+    ``tenant`` scopes the SLI to one tenant's events (None =
+    fleet-wide); ``threshold_s`` classifies latency samples."""
+    metric: str
+    target: float = 0.99
+    threshold_s: Optional[float] = None
+    tenant: Optional[str] = None
+    name: str = ''
+
+    @property
+    def key(self) -> str:
+        if self.name:
+            return self.name
+        if self.tenant:
+            return f'{self.metric}:{self.tenant}'
+        return self.metric
+
+    def to_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {'metric': self.metric,
+                               'target': self.target}
+        if self.threshold_s is not None:
+            out['threshold_s'] = self.threshold_s
+        if self.tenant is not None:
+            out['tenant'] = self.tenant
+        if self.name:
+            out['name'] = self.name
+        return out
+
+
+def objectives_from_spec(config: Any) -> List[SloObjective]:
+    """Parse + validate the ``slo:`` list of a service spec (also the
+    ``SKY_TPU_LB_SLO`` env JSON). Raises ``InvalidTaskError`` on a bad
+    entry so `serve up` rejects a misconfigured objective instead of
+    the LB silently evaluating garbage."""
+    if config is None:
+        return []
+    if not isinstance(config, (list, tuple)):
+        raise exceptions.InvalidTaskError(
+            f'service slo must be a list of objectives, got '
+            f'{type(config).__name__}')
+    out: List[SloObjective] = []
+    seen: set = set()
+    for i, entry in enumerate(config):
+        if not isinstance(entry, dict):
+            raise exceptions.InvalidTaskError(
+                f'slo[{i}] must be a mapping, got '
+                f'{type(entry).__name__}')
+        unknown = set(entry) - {'metric', 'target', 'threshold_s',
+                                'tenant', 'name'}
+        if unknown:
+            raise exceptions.InvalidTaskError(
+                f'slo[{i}]: unknown fields {sorted(unknown)}')
+        metric = str(entry.get('metric') or '')
+        if metric not in METRICS:
+            raise exceptions.InvalidTaskError(
+                f'slo[{i}]: unknown metric {metric!r}; choose from '
+                f'{list(METRICS)}')
+        try:
+            target = float(entry.get('target', 0.99))
+        except (TypeError, ValueError):
+            raise exceptions.InvalidTaskError(
+                f'slo[{i}]: target must be a number') from None
+        if not 0.0 < target < 1.0:
+            raise exceptions.InvalidTaskError(
+                f'slo[{i}]: target must be in (0, 1), got {target}')
+        threshold = entry.get('threshold_s')
+        if metric in LATENCY_METRICS:
+            try:
+                threshold = float(threshold)
+            except (TypeError, ValueError):
+                raise exceptions.InvalidTaskError(
+                    f'slo[{i}]: {metric} requires a positive '
+                    f'threshold_s') from None
+            if threshold <= 0:
+                raise exceptions.InvalidTaskError(
+                    f'slo[{i}]: threshold_s must be > 0')
+        elif threshold is not None:
+            raise exceptions.InvalidTaskError(
+                f'slo[{i}]: threshold_s only applies to latency '
+                f'metrics ({list(LATENCY_METRICS)})')
+        tenant = entry.get('tenant')
+        if tenant is not None:
+            tenant = str(tenant)
+            if metric in REPLICA_METRICS:
+                raise exceptions.InvalidTaskError(
+                    f'slo[{i}]: {metric} is fleet-wide only')
+        obj = SloObjective(metric=metric, target=target,
+                           threshold_s=threshold, tenant=tenant,
+                           name=str(entry.get('name') or ''))
+        if obj.key in seen:
+            raise exceptions.InvalidTaskError(
+                f'slo[{i}]: duplicate objective key {obj.key!r} '
+                f'(set a distinct name)')
+        seen.add(obj.key)
+        out.append(obj)
+    return out
+
+
+class _Series:
+    """Time-bucketed good/bad event counts: O(1) append into the
+    newest bucket, bounded deque so the ring wraps (oldest buckets
+    drop) instead of growing without bound. Not thread-safe — the
+    owning evaluator serializes (same contract as the stepline
+    rings)."""
+
+    __slots__ = ('width', 'buckets')
+
+    def __init__(self, width_s: float, keep_s: float) -> None:
+        self.width = max(1.0, float(width_s))
+        self.buckets: collections.deque = collections.deque(
+            maxlen=int(keep_s / self.width) + 2)
+
+    def add(self, now: float, good: int = 0, bad: int = 0) -> None:
+        idx = int(now // self.width)
+        if self.buckets and self.buckets[-1][0] >= idx:
+            # Same bucket (or a stale stamp — never with vclock, but
+            # fold rather than rewind: the series is append-only).
+            cell = self.buckets[-1][1]
+            cell[0] += good
+            cell[1] += bad
+        else:
+            self.buckets.append((idx, [good, bad]))
+
+    def window(self, now: float, window_s: float) -> Tuple[int, int]:
+        """(good, bad) totals over ``[now - window_s, now]``."""
+        cutoff = now - window_s
+        good = bad = 0
+        for idx, (g, b) in reversed(self.buckets):
+            if (idx + 1) * self.width <= cutoff:
+                break
+            good += g
+            bad += b
+        return good, bad
+
+
+class SloEvaluator:
+    """The burn-rate evaluator: per-objective event series, tiered
+    alert state, budget gauges, and an append-only transition log
+    (the byte-identity surface the twin gates hash).
+
+    Clock-free: every method takes ``now``; the caller (the LB)
+    passes its injected clock's reads, so production and the digital
+    twin run the identical code path. Single-context by contract —
+    every field is owner-confined (``_GUARDED_BY``): the LB touches
+    it only from its event loop, unit tests from one thread.
+    """
+
+    _GUARDED_BY = {
+        '_series': 'owner',
+        '_last_counters': 'owner',
+        '_last_tenants': 'owner',
+        '_firing': 'owner',
+        '_firing_since': 'owner',
+        '_transitions': 'owner',
+        '_seq': 'owner',
+    }
+
+    def __init__(self, objectives: List[SloObjective], *,
+                 tiers: Tuple[BurnTier, ...] = DEFAULT_TIERS,
+                 bucket_s: float = DEFAULT_BUCKET_S,
+                 min_samples: int = DEFAULT_MIN_SAMPLES,
+                 budget_window_s: float = DEFAULT_BUDGET_WINDOW_S
+                 ) -> None:
+        self.objectives = list(objectives)
+        self.tiers = tuple(tiers)
+        self.min_samples = max(1, int(min_samples))
+        self.budget_window_s = float(budget_window_s)
+        keep_s = max([t.long_s for t in self.tiers]
+                     + [self.budget_window_s])
+        self._series: Dict[str, _Series] = {
+            obj.key: _Series(bucket_s, keep_s)
+            for obj in self.objectives}
+        # Counter baselines for delta ingestion (first ingest is the
+        # baseline, not a burst of phantom events).
+        self._last_counters: Optional[Dict[str, int]] = None
+        self._last_tenants: Dict[str, Tuple[int, int, int, int]] = {}
+        # (objective key, tier) -> firing? + since-when, and the
+        # append-only transition log.
+        self._firing: Dict[Tuple[str, str], bool] = {}
+        self._firing_since: Dict[Tuple[str, str], float] = {}
+        self._transitions: collections.deque = collections.deque(
+            maxlen=4096)
+        self._seq = 0
+
+    # -- event ingestion ---------------------------------------------------
+    def note_latency(self, kind: str, value_s: float,
+                     tenant: Optional[str], now: float) -> None:
+        """One latency sample (``kind`` 'ttft' or 'itl'), classified
+        against every matching latency objective's threshold."""
+        metric = f'{kind}_p99'
+        for obj in self.objectives:
+            if obj.metric != metric:
+                continue
+            if obj.tenant is not None and obj.tenant != tenant:
+                continue
+            ok = value_s <= (obj.threshold_s or 0.0)
+            self._series[obj.key].add(now, good=int(ok),
+                                      bad=int(not ok))
+
+    @staticmethod
+    def _tenant_row(row: Any) -> Tuple[int, int, int, int]:
+        """(total, shed, failed, no_replica), padded so an older
+        3-field writer still ingests."""
+        vals = tuple(int(v) for v in row)[:4]
+        return vals + (0,) * (4 - len(vals))
+
+    def ingest_counters(self, counters: Dict[str, Any],
+                        now: float) -> None:
+        """Outcome counters by DELTA (the LB passes its monotonic
+        edge counters each sync tick): ``total`` / ``failed`` /
+        ``no_replica`` / ``shed``, plus per-tenant
+        ``tenants: {t: (total, shed, failed, no_replica)}``."""
+        cur = {k: int(counters.get(k) or 0)
+               for k in ('total', 'failed', 'no_replica', 'shed')}
+        tenants: Dict[str, Tuple[int, int, int, int]] = {
+            str(t): self._tenant_row(row)
+            for t, row in (counters.get('tenants') or {}).items()}
+        prev, self._last_counters = self._last_counters, cur
+        prev_tenants, self._last_tenants = self._last_tenants, tenants
+        if prev is None:
+            return   # baseline tick
+        d = {k: max(0, cur[k] - prev[k]) for k in cur}
+        dt = {}
+        for t, row in tenants.items():
+            p = prev_tenants.get(t, (0, 0, 0, 0))
+            dt[t] = tuple(max(0, a - b) for a, b in zip(row, p))
+        for obj in self.objectives:
+            if obj.metric == 'availability':
+                if obj.tenant is None:
+                    bad = d['failed'] + d['no_replica']
+                    total = d['total']
+                else:
+                    t_total, _, t_failed, t_norep = dt.get(
+                        obj.tenant, (0, 0, 0, 0))
+                    # An empty ready set is BAD for the tenant too —
+                    # the all-replicas-lost outage must burn this
+                    # objective, not read as 100% good.
+                    bad, total = t_failed + t_norep, t_total
+            elif obj.metric == 'shed_rate':
+                if obj.tenant is None:
+                    bad, total = d['shed'], d['total']
+                else:
+                    t_total, t_shed, _, _ = dt.get(obj.tenant,
+                                                   (0, 0, 0, 0))
+                    bad, total = t_shed, t_total
+            else:
+                continue
+            # `total` counts request ARRIVALS; failures/sheds land at
+            # completion, routinely a later tick for long streams.
+            # Bad events are therefore ingested in full even when this
+            # tick saw fewer (or zero) new arrivals — clamping bad to
+            # the arrival delta would read an outage of in-flight
+            # traffic as 100% good.
+            good = max(0, total - bad)
+            if good or bad:
+                self._series[obj.key].add(now, good=good, bad=bad)
+
+    def note_replica_freshness(self, fresh: int, stale: int,
+                               now: float) -> None:
+        """Per-sync-tick replica responsiveness, classified by the
+        PR 12 freshest-ring staleness rule at the LB: a ready replica
+        whose metrics ring has frozen counts as a BAD event — a hung
+        replica must not silently mask a fleet-wide burn by simply
+        not reporting."""
+        for obj in self.objectives:
+            if obj.metric != 'replica_availability':
+                continue
+            if fresh or stale:
+                self._series[obj.key].add(now, good=fresh, bad=stale)
+
+    # -- burn math ---------------------------------------------------------
+    def burn_rate(self, obj: SloObjective, window_s: float,
+                  now: float) -> float:
+        """Error rate over the window divided by the error budget
+        (``1 - target``). 0.0 below ``min_samples`` — sparse windows
+        must not page anyone."""
+        good, bad = self._series[obj.key].window(now, window_s)
+        total = good + bad
+        if total < self.min_samples:
+            return 0.0
+        return (bad / total) / (1.0 - obj.target)
+
+    def budget_remaining(self, obj: SloObjective,
+                         now: float) -> float:
+        """Fraction of the error budget left over the accounting
+        window, clamped to [0, 1]. 1.0 with no traffic (an idle
+        service has spent nothing)."""
+        good, bad = self._series[obj.key].window(
+            now, self.budget_window_s)
+        total = good + bad
+        if not total:
+            return 1.0
+        consumed = (bad / total) / (1.0 - obj.target)
+        return max(0.0, min(1.0, 1.0 - consumed))
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate(self, now: float) -> List[Dict[str, Any]]:
+        """One evaluation pass (the LB calls this each sync tick):
+        recompute every (objective, tier) burn pair, flip alert
+        states, and return the transitions this pass produced. A tier
+        fires when BOTH windows breach its burn threshold; it
+        resolves the moment the short window recovers (the long
+        window alone holding the breach means the incident is over
+        but the budget is still scorched — ticket territory, not a
+        live page)."""
+        transitions: List[Dict[str, Any]] = []
+        for obj in self.objectives:
+            for tier in self.tiers:
+                burn_short = self.burn_rate(obj, tier.short_s, now)
+                burn_long = self.burn_rate(obj, tier.long_s, now)
+                firing = (burn_short >= tier.burn
+                          and burn_long >= tier.burn)
+                key = (obj.key, tier.tier)
+                if firing == self._firing.get(key, False):
+                    continue
+                self._firing[key] = firing
+                if firing:
+                    self._firing_since[key] = now
+                else:
+                    self._firing_since.pop(key, None)
+                record = {
+                    't': round(now, 6), 'seq': self._seq,
+                    'objective': obj.key, 'tier': tier.tier,
+                    'state': 'firing' if firing else 'resolved',
+                    'burn_short': round(burn_short, 3),
+                    'burn_long': round(burn_long, 3),
+                }
+                self._seq += 1
+                self._transitions.append(record)
+                transitions.append(record)
+        return transitions
+
+    def disarm(self, now: float) -> List[Dict[str, Any]]:
+        """Resolve every firing alert (the evaluator is being
+        replaced — a config change mid-incident must not leave
+        dangling 'firing' edges in the decision log; a still-ongoing
+        burn re-fires cleanly on the successor). Returns the
+        synthetic transitions, shaped exactly like evaluate()'s."""
+        transitions: List[Dict[str, Any]] = []
+        for key, tier in self.firing():
+            self._firing[(key, tier)] = False
+            self._firing_since.pop((key, tier), None)
+            record = {
+                't': round(now, 6), 'seq': self._seq,
+                'objective': key, 'tier': tier, 'state': 'resolved',
+                'burn_short': 0.0, 'burn_long': 0.0,
+            }
+            self._seq += 1
+            self._transitions.append(record)
+            transitions.append(record)
+        return transitions
+
+    # -- surfaces ----------------------------------------------------------
+    def firing(self, tier: Optional[str] = None
+               ) -> List[Tuple[str, str]]:
+        """Currently-firing (objective key, tier) pairs."""
+        return sorted(k for k, v in self._firing.items()
+                      if v and (tier is None or k[1] == tier))
+
+    def page_burn(self, now: float) -> float:
+        """The autoscaler's ``slo_burn`` scale-up input: the max over
+        objectives of the page tier's effective burn (min of the two
+        windows — the same AND the alert condition applies), so the
+        signal crosses ``PAGE.burn`` exactly when a page fires."""
+        best = 0.0
+        for obj in self.objectives:
+            b = min(self.burn_rate(obj, PAGE.short_s, now),
+                    self.burn_rate(obj, PAGE.long_s, now))
+            best = max(best, b)
+        return round(best, 3)
+
+    def gauges(self, now: float) -> Dict[str, Dict[str, Any]]:
+        """Per-objective gauge rows for ``lb_metrics()['slo']``."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for obj in self.objectives:
+            row: Dict[str, Any] = {
+                'metric': obj.metric, 'target': obj.target,
+                'tenant': obj.tenant,
+                'threshold_s': obj.threshold_s,
+                'error_budget_remaining': round(
+                    self.budget_remaining(obj, now), 4),
+            }
+            for tier in self.tiers:
+                row[f'{tier.tier}_burn_short'] = round(
+                    self.burn_rate(obj, tier.short_s, now), 3)
+                row[f'{tier.tier}_burn_long'] = round(
+                    self.burn_rate(obj, tier.long_s, now), 3)
+                row[f'{tier.tier}_firing'] = bool(
+                    self._firing.get((obj.key, tier.tier), False))
+            out[obj.key] = row
+        return out
+
+    def snapshot(self, now: float) -> Dict[str, Any]:
+        """The ``/-/alerts`` payload: objectives with live gauges,
+        the firing set, and the transition-log tail."""
+        firing = [{'objective': k, 'tier': tier,
+                   'since_t': round(
+                       self._firing_since.get((k, tier), now), 6)}
+                  for k, tier in self.firing()]
+        return {
+            'enabled': True,
+            'tiers': [dataclasses.asdict(t) for t in self.tiers],
+            'objectives': self.gauges(now),
+            'firing': firing,
+            'transitions': list(self._transitions)[-64:],
+        }
+
+    def decision_log_jsonl(self) -> str:
+        """Alert transitions as one JSON line each — the
+        byte-identity surface (same seed => identical string in the
+        twin gates)."""
+        return '\n'.join(json.dumps(t, sort_keys=True)
+                         for t in self._transitions)
+
+
+def _smoke() -> int:
+    """``make slo-smoke``: replay the reclaim-storm scenario in the
+    digital twin with a TTFT objective armed and prove the alert
+    round trip end to end — the page tier fires after the storm,
+    clears after recovery, and the firing edge produced a
+    flight-recorder fleet dump in the span store. Exit 0 = the SLO
+    engine works end to end."""
+    import logging
+    import os
+    import tempfile
+
+    from skypilot_tpu.observability import stepline as stepline_lib
+    from skypilot_tpu.observability import store as store_lib
+    from skypilot_tpu.sim import DigitalTwin, reclaim_storm
+
+    logging.disable(logging.WARNING)
+    # Sized so the page tier provably crosses: losing 3 of 4 replicas
+    # halves the service rate below the offered load, and replacement
+    # provisioning (~4-5 virtual minutes — readiness follows the
+    # probe, so provision time IS the recovery time) keeps the burn
+    # going long enough for the LONG page window to breach — the
+    # multi-window rule needs a sustained incident, not a blip.
+    sc = reclaim_storm(replicas=4, duration_s=1800.0,
+                       storm_frac=0.75, rps=8.0)
+    sc.provision_delay_s = (240.0, 300.0)
+    sc.slo = [{'metric': 'ttft_p99', 'threshold_s': 2.0,
+               'target': 0.99},
+              {'metric': 'availability', 'target': 0.999}]
+    with tempfile.TemporaryDirectory() as tmp:
+        store = store_lib.SpanStore(
+            db_path=os.path.join(tmp, 'slo-smoke-traces.db'))
+        stepline_lib.set_dump_store(store)
+        try:
+            report = DigitalTwin(sc, seed=3).run()
+        finally:
+            stepline_lib.set_dump_store(None)
+            logging.disable(logging.NOTSET)
+    alerts = [d for d in report.decisions
+              if d['kind'] == 'slo_alert']
+    pages = [a for a in alerts if a['tier'] == 'page']
+    fired = [a for a in pages if a['state'] == 'firing']
+    resolved = [a for a in pages if a['state'] == 'resolved']
+    if not fired:
+        print('slo-smoke: the storm never fired the page alert')
+        return 1
+    if not resolved or resolved[-1]['t'] <= fired[0]['t']:
+        print('slo-smoke: the page alert never cleared after '
+              'recovery')
+        return 1
+    avail = [a for a in alerts if a['objective'] == 'availability']
+    if avail:
+        print(f'slo-smoke: availability alert fired on a zero-error '
+              f'storm (false positive): {avail[:2]}')
+        return 1
+    dumps = [t for t in store.list_traces(
+                 limit=200, trace_id_prefix='stepline-fleet')]
+    slo_dumps = []
+    for t in dumps:
+        spans = store.get_trace(t['trace_id'])
+        root = next((s for s in spans
+                     if s['name'] == 'stepline.fleet_dump'), None)
+        if root and root['attrs'].get('trigger') == 'slo_page':
+            slo_dumps.append(t['trace_id'])
+    if not slo_dumps:
+        print('slo-smoke: no slo_page fleet dump in the span store')
+        return 1
+    if report.client_errors:
+        print(f'slo-smoke: {len(report.client_errors)} client-visible '
+              f'error(s) in the replay; first: '
+              f'{report.client_errors[0]}')
+        return 1
+    print('slo-smoke OK:', json.dumps({
+        'page_fired_t': fired[0]['t'],
+        'page_resolved_t': resolved[-1]['t'],
+        'transitions': len(alerts),
+        'fleet_dumps': len(slo_dumps)}))
+    return 0
+
+
+if __name__ == '__main__':
+    import sys
+
+    # `python -m` runs this file as `__main__` — a second module
+    # object. Delegate to the canonical package import (the stepline
+    # rule) so module globals are the ones the LB uses.
+    from skypilot_tpu.observability import slo as _canonical
+    sys.exit(_canonical._smoke())

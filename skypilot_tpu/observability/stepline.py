@@ -56,7 +56,7 @@ DUMP_INTERVAL_ENV = 'SKY_TPU_STEPLINE_DUMP_INTERVAL_S'
 DEFAULT_DUMP_INTERVAL_S = 30.0
 
 TRIGGERS = ('ttft_slo', 'preemption', 'cache_full', 'admission_shed',
-            'breaker_open')
+            'breaker_open', 'slo_page')
 
 # Step-loop stage keys, in the order they run inside one step. 'host'
 # is the remainder (scheduling, page accounting, drafting).

@@ -220,14 +220,20 @@ class SpanStore:
 
 _ingest_count = 0
 
-# Spans can arrive over the auth-exempt collector endpoint: label
+# Spans can arrive over the auth-exempt collector endpoint, and
+# serving-tier labels (tenant ids) are client-controlled: label
 # values fed to Prometheus must not be able to corrupt the exposition
-# format (quotes/newlines) or carry unbounded payloads.
+# format (quotes/newlines) or carry unbounded payloads. This is THE
+# canonical sanitization rule — observability/prometheus.py reuses it
+# for every serving-exposition label.
 _LABEL_RE = re.compile(r'[^A-Za-z0-9_.:/\-]')
 
 
-def _label(value: Any) -> str:
+def sanitize_label(value: Any) -> str:
     return _LABEL_RE.sub('_', str(value))[:64]
+
+
+_label = sanitize_label
 
 
 def ingest(spans: List[Dict[str, Any]],

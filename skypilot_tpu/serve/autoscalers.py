@@ -21,6 +21,7 @@ import logging
 import math
 from typing import Dict, List, Optional
 
+from skypilot_tpu.observability import slo as slo_lib
 from skypilot_tpu.serve import spec as spec_lib
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.utils import vclock
@@ -50,6 +51,10 @@ class Autoscaler:
         self.service_name = service_name
         self.policy = policy
         self.target_num_replicas = policy.min_replicas
+        # Set by make() from the service spec: only services that
+        # DECLARE objectives pay the per-tick slo_burn gauge read —
+        # the controller tick's DB scans are a profiled hot path.
+        self.has_slo = False
 
     def update_policy(self, policy: spec_lib.ReplicaPolicy) -> None:
         self.policy = policy
@@ -67,13 +72,34 @@ class Autoscaler:
 class _HysteresisAutoscaler(Autoscaler):
     """Shared hysteresis machinery (reference _AutoscalerWithHysteresis):
     subclasses supply ``_desired(...)``; a change of target only lands
-    after persisting for the configured delay."""
+    after persisting for the configured delay.
+
+    SLO-class scaling (docs/observability.md "SLOs and alerting"):
+    when the service declares SLOs, the LB flushes its max page-tier
+    burn rate to the state DB (``slo_burn``). A page-level burn forces
+    a scale-up step even if the subclass's own signal (QPS, queue) has
+    not crossed its threshold yet — the budget burning IS the demand
+    signal — and any ticket-level burn vetoes downscales: shrinking a
+    fleet that is eating its error budget is how brownouts become
+    outages. Off per service via ``slo_burn_upscale: false``.
+    """
 
     def __init__(self, service_name: str,
                  policy: spec_lib.ReplicaPolicy) -> None:
         super().__init__(service_name, policy)
         self._overload_since: Optional[float] = None
         self._underload_since: Optional[float] = None
+
+    def _apply_slo_burn(self, demand: int, why: str) -> tuple:
+        if not self.has_slo or not self.policy.slo_burn_upscale:
+            return demand, why
+        burn = serve_state.get_slo_burn(self.service_name)
+        current = self.target_num_replicas
+        if burn >= slo_lib.PAGE.burn and demand <= current:
+            return current + 1, f'{why} slo_burn={burn:g} (page)'
+        if burn >= slo_lib.TICKET.burn and demand < current:
+            return current, f'{why} slo_burn={burn:g} (hold)'
+        return demand, why
 
     def _desired(self, now: float, num_ready: int,
                  replicas: Optional[List[dict]]) -> tuple:
@@ -102,6 +128,7 @@ class _HysteresisAutoscaler(Autoscaler):
             return self._finalize(
                 pol.min_replicas + pol.num_overprovision, 'fixed')
         demand, why = self._desired(now, num_ready, replicas)
+        demand, why = self._apply_slo_burn(demand, why)
         desired = self._clip(demand)
         current = self.target_num_replicas
 
@@ -282,16 +309,21 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
 
 
 def make(service_name: str,
-         policy: spec_lib.ReplicaPolicy) -> Autoscaler:
+         policy: spec_lib.ReplicaPolicy,
+         has_slo: bool = False) -> Autoscaler:
     if policy.queue_length_threshold is not None:
-        return QueueLengthAutoscaler(service_name, policy)
-    if policy.use_ondemand_fallback:
-        return FallbackRequestRateAutoscaler(service_name, policy)
-    if policy.instance_aware:
-        return InstanceAwareRequestRateAutoscaler(service_name, policy)
-    if policy.autoscaling:
-        return RequestRateAutoscaler(service_name, policy)
-    return Autoscaler(service_name, policy)
+        scaler = QueueLengthAutoscaler(service_name, policy)
+    elif policy.use_ondemand_fallback:
+        scaler = FallbackRequestRateAutoscaler(service_name, policy)
+    elif policy.instance_aware:
+        scaler = InstanceAwareRequestRateAutoscaler(service_name,
+                                                    policy)
+    elif policy.autoscaling:
+        scaler = RequestRateAutoscaler(service_name, policy)
+    else:
+        scaler = Autoscaler(service_name, policy)
+    scaler.has_slo = has_slo
+    return scaler
 
 
 def select_replicas_to_scale_down(

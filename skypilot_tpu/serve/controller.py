@@ -61,7 +61,8 @@ class ServeController:
             service_name, self.spec, record['task_yaml'],
             cloud=cloud, executor=executor)
         self.autoscaler = autoscalers_lib.make(
-            service_name, self.spec.replica_policy)
+            service_name, self.spec.replica_policy,
+            has_slo=bool(self.spec.slo))
         # Prompt-teardown signal for run(): stop() (tests, embedding
         # processes) wakes the tick loop immediately instead of letting
         # it finish a full _TICK_S sleep.
@@ -85,7 +86,8 @@ class ServeController:
             # target over so the fleet doesn't jump on the rollover.
             old_target = self.autoscaler.target_num_replicas
             self.autoscaler = autoscalers_lib.make(
-                self.service_name, self.spec.replica_policy)
+                self.service_name, self.spec.replica_policy,
+                has_slo=bool(self.spec.slo))
             self.autoscaler.target_num_replicas = max(
                 self.spec.replica_policy.min_replicas, old_target)
 

@@ -43,6 +43,9 @@ from typing import Callable, Dict, List, Optional, Set
 import aiohttp
 from aiohttp import web
 
+from skypilot_tpu import exceptions
+from skypilot_tpu.observability import prometheus as prom_lib
+from skypilot_tpu.observability import slo as slo_lib
 from skypilot_tpu.observability import stepline as stepline_lib
 from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.serve import load_balancing_policies as lbp
@@ -220,6 +223,11 @@ class LoadBalancer:
         '_breaker_open_seen': 'event-loop',
         '_breaker_pending': 'event-loop',
         '_breaker_dump_at': 'event-loop',
+        'slo': 'event-loop',
+        '_slo_cfg': 'event-loop',
+        '_slo_reload_tick': 'event-loop',
+        '_slo_pending': 'event-loop',
+        '_slo_dump_at': 'event-loop',
     }
 
     def __init__(self, service_name: str, policy_name: str, *,
@@ -313,6 +321,23 @@ class LoadBalancer:
         # again by then (the edge is the incident, not the state).
         self._breaker_pending: Set[str] = set()
         self._breaker_dump_at = 0.0
+        # SLO burn-rate evaluator (docs/observability.md "SLOs and
+        # alerting"): objectives load from the service spec's `slo:`
+        # section (or SKY_TPU_LB_SLO) on the first sync tick and
+        # re-read every _SLO_RELOAD_TICKS so a `serve update` that
+        # adds/changes objectives arms the running LB (the evaluator
+        # rebuilds — burn history resets — only when the normalized
+        # config actually changed). None = no objectives, inert.
+        self.slo: Optional[slo_lib.SloEvaluator] = None
+        self._slo_cfg: Optional[list] = None
+        self._slo_reload_tick = 0
+        # Page-tier firing edges owed a fleet dump (rate-limited like
+        # breaker edges — deferred, never dropped) + the observation
+        # seam the digital twin hangs its decision log on (called with
+        # each alert transition record; never touches LB state).
+        self._slo_pending: Set[str] = set()
+        self._slo_dump_at = 0.0
+        self.slo_transition_hook: Optional[Callable] = None
         self.breaker = retry_lib.CircuitBreaker(
             failure_threshold=int(os.environ.get(
                 'SKY_TPU_LB_BREAKER_THRESHOLD', '3')),
@@ -390,6 +415,7 @@ class LoadBalancer:
                 if url not in info:
                     del self._replica_history[url]
                     self._history_tick.pop(url, None)
+            await self._slo_tick(now)
             await self._dump_breaker_edges()
         except Exception:  # noqa: BLE001 — keep serving on DB hiccup
             logger.warning('replica sync failed', exc_info=True)
@@ -479,6 +505,126 @@ class LoadBalancer:
             {u: list(r) for u, r in self._replica_history.items()})
         await self._offload(stepline_lib.write_dump_sync, spans)
 
+    # -- SLO evaluation (docs/observability.md "SLOs and alerting") --------
+    # Sync ticks between objective-config re-reads: `serve update`
+    # adding/changing the `slo:` section must arm the RUNNING LB, so
+    # the spec is re-read on this cadence (30 ticks = ~30s at the 1s
+    # production sync) and the evaluator rebuilds only on a real
+    # config change. One narrow read per cadence, not per tick.
+    _SLO_RELOAD_TICKS = 30
+
+    def _emit_slo_transitions(self,  # holds: event-loop
+                              transitions: List[dict]) -> None:
+        for tr in transitions:
+            log = (logger.warning if tr['tier'] == 'page'
+                   else logger.info)
+            log('SLO %s alert %s: %s (burn %s/%s)', tr['tier'],
+                tr['state'], tr['objective'], tr['burn_short'],
+                tr['burn_long'])
+            if self.slo_transition_hook is not None:
+                self.slo_transition_hook(tr)
+            if tr['tier'] == 'page' and tr['state'] == 'firing':
+                self._slo_pending.add(tr['objective'])
+
+    async def _load_slo(self, now: float) -> None:
+        """(Re)load objectives: the SKY_TPU_LB_SLO env JSON wins (a
+        stand-alone LB without a service row, process-static), else
+        the service spec's `slo:` section. A malformed config logs
+        and leaves the layer as-is — alerting must never keep the LB
+        from serving. The reload clock is only advanced AFTER the
+        spec read succeeds: a transient DB hiccup (swallowed by
+        _sync_once's fail-open except, like every other sync read)
+        retries next tick instead of waiting out a reload period."""
+        cfg = None
+        raw = os.environ.get(slo_lib.SLO_ENV)
+        if raw:
+            try:
+                cfg = json.loads(raw)
+            except ValueError:
+                logger.warning('malformed %s JSON; ignoring',
+                               slo_lib.SLO_ENV)
+        if cfg is None:
+            record = await self._offload(
+                serve_state.get_service, self.service_name)
+            if record is not None:
+                cfg = record['spec'].get('slo')
+        self._slo_reload_tick = (self._sync_tick
+                                 + self._SLO_RELOAD_TICKS)
+        try:
+            objectives = slo_lib.objectives_from_spec(cfg)
+        except exceptions.InvalidTaskError as e:
+            # Config error, fail as-is: `serve up`/`update` validate
+            # the spec path; this catches the env override and
+            # version skew.
+            logger.warning('invalid SLO config ignored: %s', e)
+            return
+        norm = [o.to_config() for o in objectives]
+        if norm == (self._slo_cfg or []):
+            return   # unchanged: keep the evaluator's burn history
+        self._slo_cfg = norm
+        if self.slo is not None:
+            # A replaced evaluator must not leave dangling 'firing'
+            # edges: resolve them (logged + hooked like any
+            # transition) so firing/resolved stay paired in the log;
+            # a still-ongoing burn re-fires on the successor.
+            self._emit_slo_transitions(self.slo.disarm(now))
+        if objectives:
+            self.slo = slo_lib.SloEvaluator(objectives)
+            logger.info('SLO evaluator armed: %s',
+                        [o.key for o in objectives])
+        else:
+            self.slo = None
+            logger.info('SLO objectives removed; alerting disarmed')
+
+    async def _slo_tick(self, now: float) -> None:
+        """One burn-rate evaluation pass, riding the sync tick (so
+        the twin drives it at virtual cadence): ingest outcome-counter
+        deltas + replica freshness, evaluate every (objective, tier)
+        pair, and turn page-tier firing edges into flight-recorder
+        fleet dumps."""
+        if self._sync_tick >= self._slo_reload_tick:
+            await self._load_slo(now)
+        if self.slo is not None:
+            self.slo.ingest_counters({
+                'total': self._requests_total,
+                'failed': self._requests_failed,
+                'no_replica': self._requests_no_replica,
+                'shed': self._requests_shed,
+                'tenants': {t: (rec['total'], rec['shed'],
+                                rec['failed'], rec['no_replica'])
+                            for t, rec in self._tenants.items()},
+            }, now)
+            stale = self._stale_rings()
+            with_ring = [u for u, r in self._replica_history.items()
+                         if len(r) >= 2]
+            self.slo.note_replica_freshness(
+                len(with_ring) - len(stale), len(stale), now)
+            self._emit_slo_transitions(self.slo.evaluate(now))
+        # OUTSIDE the armed-guard on purpose: a rate-limit-deferred
+        # page dump stays owed even if a `serve update` disarmed the
+        # objectives meanwhile — the edge is the incident (the
+        # breaker-edge rule), and the evidence must still land.
+        await self._dump_slo_edges(now)
+
+    async def _dump_slo_edges(self, now: float) -> None:
+        """Every page-tier firing comes with evidence: snapshot the
+        fleet metrics history into the span store (the same black box
+        a breaker edge writes), rate-limited per the dump interval
+        with the breaker rule — a deferred edge stays owed, so a
+        second objective paging inside the interval dumps on a later
+        tick instead of losing the incident."""
+        if not self._slo_pending:
+            return
+        min_s = stepline_lib.dump_interval_s()
+        if min_s > 0 and now - self._slo_dump_at < min_s:
+            return
+        firing, self._slo_pending = sorted(self._slo_pending), set()
+        self._slo_dump_at = now
+        spans = stepline_lib.fleet_history_spans(
+            'slo_page', {'objectives': firing},
+            {u: list(r) for u, r in self._replica_history.items()})
+        await self._offload(stepline_lib.write_dump_sync, spans)
+
     async def _stats_loop(self) -> None:
         while self._running:
             await asyncio.sleep(self.stats_flush_s)
@@ -504,14 +650,25 @@ class LoadBalancer:
             await self._offload(
                 serve_state.set_queue_depth, self.service_name,
                 sum(self._replica_queue_depth.values()))
+            if self.slo is not None:
+                # SLO-class scaling input: the max page-tier burn
+                # rate, read by the autoscaler as a scale-up signal
+                # (docs/observability.md "SLOs and alerting"). The
+                # flush cadence rides along so the reader's staleness
+                # window scales with it.
+                await self._offload(
+                    serve_state.set_slo_burn, self.service_name,
+                    self.slo.page_burn(self._clock.time()),
+                    self.stats_flush_s)
         except Exception:  # noqa: BLE001
             logger.warning('stats flush failed', exc_info=True)
 
     # -- request path ------------------------------------------------------
-    # NOTE: JSON (not the API server's Prometheus registry) is
-    # deliberate — the LB runs as its own process on the serve
-    # controller and this shape feeds `serve status` + the TTFT bench
-    # directly; a Prometheus exposition can wrap lb_metrics() later.
+    # NOTE: JSON (not the API server's Prometheus registry) stays the
+    # default — the LB runs as its own process on the serve controller
+    # and this shape feeds `serve status` + the TTFT bench directly;
+    # `?format=prometheus` wraps lb_metrics() in text exposition
+    # (observability/prometheus.py) for scrape-based stacks.
     # Tenant ids are client-controlled: bound the per-tenant map so an
     # id-minting client cannot grow LB memory (or /-/metrics payloads)
     # without limit — oldest-created entries are evicted at the cap.
@@ -523,7 +680,7 @@ class LoadBalancer:
             while len(self._tenants) >= self._MAX_TENANTS:
                 self._tenants.pop(next(iter(self._tenants)))
             rec = self._tenants[tenant] = {
-                'total': 0, 'shed': 0,
+                'total': 0, 'shed': 0, 'failed': 0, 'no_replica': 0,
                 'ttfts': collections.deque(maxlen=1024)}
         return rec
 
@@ -532,6 +689,56 @@ class LoadBalancer:
         self._ttfts.append(value)
         if tenant:
             self._tenant(tenant)['ttfts'].append(value)
+        if self.slo is not None:
+            self.slo.note_latency('ttft', value, tenant,
+                                  self._clock.time())
+
+    def _note_itl(self, gap: float,  # holds: event-loop
+                  tenant: Optional[str]) -> None:
+        self._itls.append(gap)
+        if self.slo is not None:
+            self.slo.note_latency('itl', gap, tenant,
+                                  self._clock.time())
+
+    def _note_failed(self,  # holds: event-loop
+                     tenant: Optional[str]) -> None:
+        """One replica-side failure the client could see — the edge
+        counter plus the per-tenant ledger the availability SLO
+        ingests by delta."""
+        self._requests_failed += 1
+        if tenant:
+            self._tenant(tenant)['failed'] += 1
+
+    def _stale_rings(self) -> Set[str]:  # holds: event-loop
+        """The PR 12 freshest-ring staleness rule, as a set: rings
+        (len >= 2) whose replica has stopped reporting. A
+        ready-but-unresponsive replica's ring stops appending
+        (fetches fail) but survives pruning — its frozen window must
+        not contribute a constant phantom rate to the fleet gauges
+        (or silently mask a fleet-wide SLO burn: the evaluator counts
+        these BAD). Two complementary signals: a ring whose newest
+        sample lags the freshest ring's by a few sync ticks
+        (relative, not wall-clock, so replayed/synthetic histories
+        still aggregate), and a ring whose last successful fetch lags
+        the sync-tick COUNTER — the counter advances even when every
+        fetch fails, which catches the all-frozen fleet the relative
+        check cannot (a lone hung replica's ring is its own
+        freshest)."""
+        newest = max((ring[-1]['t']
+                      for ring in self._replica_history.values()
+                      if ring), default=0.0)
+        stale_s = 3 * self.sync_interval_s
+        stale_ticks = 3
+        stale: Set[str] = set()
+        for url, ring in self._replica_history.items():
+            if len(ring) < 2:
+                continue
+            if newest - ring[-1]['t'] > stale_s:
+                stale.add(url)   # frozen ring: stopped reporting
+            elif (self._sync_tick - self._history_tick.get(
+                    url, self._sync_tick)) > stale_ticks:
+                stale.add(url)   # fetches failing: fleet may be dark
+        return stale
 
     def _history_gauges(self) -> Dict[str, object]:  # holds: event-loop
         """Windowed-rate gauges derived from the per-replica history
@@ -544,31 +751,11 @@ class LoadBalancer:
         any_tps = False
         d_hits = 0
         d_lookups = 0
-        # Staleness guard: a ready-but-unresponsive replica's ring
-        # stops appending (fetches fail) but survives pruning — its
-        # frozen window must not contribute a constant phantom rate
-        # to the fleet gauges forever. Two complementary signals: a
-        # ring whose newest sample lags the freshest ring's by a few
-        # sync ticks (relative, not wall-clock, so replayed/synthetic
-        # histories still aggregate), and a ring whose last
-        # successful fetch lags the sync-tick COUNTER — the counter
-        # advances even when every fetch fails, which catches the
-        # all-frozen fleet the relative check cannot (a lone hung
-        # replica's ring is its own freshest).
-        newest = max((ring[-1]['t']
-                      for ring in self._replica_history.values()
-                      if ring), default=0.0)
-        stale_s = 3 * self.sync_interval_s
-        stale_ticks = 3
+        stale = self._stale_rings()
         for url, ring in self._replica_history.items():
-            if len(ring) < 2:
+            if len(ring) < 2 or url in stale:
                 continue
             a, b = ring[0], ring[-1]
-            if newest - b['t'] > stale_s:
-                continue   # frozen ring: replica stopped reporting
-            if (self._sync_tick - self._history_tick.get(
-                    url, self._sync_tick)) > stale_ticks:
-                continue   # fetches failing: whole fleet may be dark
             span = b['t'] - a['t']
             if span <= 0:
                 continue
@@ -616,9 +803,12 @@ class LoadBalancer:
             tt = sorted(rec['ttfts'])
             return {'requests_total': rec['total'],
                     'requests_shed': rec['shed'],
+                    'requests_failed': rec.get('failed', 0),
+                    'requests_no_replica': rec.get('no_replica', 0),
                     'ttft_p50_s': pct(tt, 0.50),
                     'ttft_p99_s': pct(tt, 0.99),
                     'ttft_samples': len(tt)}
+        now = self._clock.time()
         return {
             'tenants': {t: tenant_row(rec)
                         for t, rec in sorted(self._tenants.items())},
@@ -657,6 +847,17 @@ class LoadBalancer:
             'itl_samples': len(itls),
             'ready_replicas': len(self.policy.ready_urls),
             'breaker': self.breaker.snapshot(),
+            # SLO layer (docs/observability.md "SLOs and alerting"):
+            # null/zero until the service declares objectives.
+            'slo': (self.slo.gauges(now)
+                    if self.slo is not None else None),
+            'slo_alerts_firing': (len(self.slo.firing())
+                                  if self.slo is not None else 0),
+            'slo_page_alerts_firing': (
+                len(self.slo.firing('page'))
+                if self.slo is not None else 0),
+            'slo_burn': (self.slo.page_burn(now)
+                         if self.slo is not None else 0.0),
         }
 
     def _select(self, tried: Set[str],
@@ -756,7 +957,7 @@ class LoadBalancer:
             # latency must not pollute the TTFT distribution.
             upstream_ok = upstream.status < 500
             if not upstream_ok:
-                self._requests_failed += 1
+                self._note_failed(tenant)
             try:
                 resp = web.StreamResponse(
                     status=upstream.status,
@@ -796,7 +997,7 @@ class LoadBalancer:
                             # Gap between flushed lines = the
                             # client-observed inter-token latency.
                             if pending_gap is not None:
-                                self._itls.append(pending_gap)
+                                self._note_itl(pending_gap, tenant)
                             pending_gap = now - t_prev
                     first = False
                     t_prev = now
@@ -825,7 +1026,7 @@ class LoadBalancer:
                 # signal. (A 5xx upstream was already counted failed
                 # above — don't count it twice.)
                 if upstream_ok:
-                    self._requests_failed += 1
+                    self._note_failed(tenant)
                 logger.warning('replica %s died mid-stream: %s', url, e)
                 with contextlib.suppress(Exception):
                     await resp.write_eof()
@@ -856,7 +1057,7 @@ class LoadBalancer:
             # One line late, same as the plain proxy: the terminal
             # done-line gap is dropped instead of dragging itl_p50.
             if splice.pending_gap is not None:
-                self._itls.append(splice.pending_gap)
+                self._note_itl(splice.pending_gap, splice.tenant)
             splice.pending_gap = now - (splice.t_prev or now)
         splice.t_prev = now
         if not isinstance(obj, dict):
@@ -921,7 +1122,7 @@ class LoadBalancer:
                 # Plain (non-stream) answer — 400s, engine-died 500s:
                 # relay it exactly like the non-resumable path.
                 if upstream.status >= 500:
-                    self._requests_failed += 1
+                    self._note_failed(splice.tenant)
                 data = await upstream.read()
                 resp = web.Response(
                     status=upstream.status, body=data,
@@ -1008,9 +1209,25 @@ class LoadBalancer:
             return web.json_response(
                 {'ready_replica_urls': list(self.policy.ready_urls)})
         if request.path == '/-/metrics':
+            # JSON by default (feeds `serve status` + the TTFT bench);
+            # `?format=prometheus` wraps the same gauges in text
+            # exposition for a scrape-based stack.
+            if request.query.get('format') == 'prometheus':
+                return web.Response(
+                    text=prom_lib.render_lb(self.lb_metrics()),
+                    content_type='text/plain', charset='utf-8')
             return web.json_response(self.lb_metrics())
         if request.path == '/-/metrics/history':
             return web.json_response(self.lb_history())
+        if request.path == '/-/alerts':
+            # Alert state + error-budget view (docs/observability.md
+            # "SLOs and alerting"); `sky-tpu slo <lb-url>` reads this.
+            if self.slo is None:
+                return web.json_response(
+                    {'enabled': False, 'objectives': {},
+                     'firing': [], 'transitions': []})
+            return web.json_response(
+                self.slo.snapshot(self._clock.time()))
         self._requests_total += 1
         t_arrival = self._clock.monotonic()
         # Body read comes FIRST: nothing is selected or counted yet, so
@@ -1072,6 +1289,12 @@ class LoadBalancer:
         url = self._select(tried, affinity)
         if url is None:
             self._requests_no_replica += 1
+            if tenant is not None:
+                # The per-tenant availability SLI counts an empty
+                # ready set as BAD (the fleet-wide branch already
+                # does) — an all-replicas-lost outage must burn the
+                # tenant objective too, not read as 100% good.
+                self._tenant(tenant)['no_replica'] += 1
             return web.Response(
                 status=503,
                 # Capacity usually returns within a sync interval or
@@ -1178,7 +1401,7 @@ class LoadBalancer:
             # Out of replicas (or out of deadline budget).
             if splice is not None and splice.resp is not None:
                 # Headers are long gone: report in-band, terminate.
-                self._requests_failed += 1
+                self._note_failed(tenant)
                 with contextlib.suppress(Exception):
                     await splice.resp.write(json.dumps(
                         {'error': f'all {len(tried)} replica(s) failed '
@@ -1199,13 +1422,13 @@ class LoadBalancer:
                     headers=saturated.headers)
             if (t_deadline is not None
                     and self._clock.monotonic() >= t_deadline):
-                self._requests_failed += 1
+                self._note_failed(tenant)
                 return web.Response(
                     status=504,
                     text='deadline exceeded before any replica could '
                          'serve the request\n')
             # Every ready replica failed pre-stream.
-            self._requests_failed += 1
+            self._note_failed(tenant)
             cause = last_cause
             return web.Response(
                 status=502,

@@ -23,9 +23,10 @@ the reference.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.observability import slo as slo_lib
 
 
 @dataclasses.dataclass
@@ -87,6 +88,12 @@ class ReplicaPolicy:
     # ...and/or launch an on-demand stand-in for every spot replica that
     # is not (yet) ready.
     dynamic_ondemand_fallback: bool = False
+    # SLO-class scaling (docs/observability.md "SLOs and alerting"):
+    # when the service declares SLOs, a page-tier burn rate flushed by
+    # the LB (`slo_burn`) forces a scale-up step and holds off
+    # downscales while the budget is burning. On by default — it only
+    # engages when objectives exist.
+    slo_burn_upscale: bool = True
 
     @classmethod
     def from_config(cls, config: Any) -> 'ReplicaPolicy':
@@ -119,6 +126,8 @@ class ReplicaPolicy:
                 config.get('base_ondemand_fallback_replicas', 0)),
             dynamic_ondemand_fallback=bool(
                 config.get('dynamic_ondemand_fallback', False)),
+            slo_burn_upscale=bool(
+                config.get('slo_burn_upscale', True)),
         )
         if pol.min_replicas < 0:
             raise exceptions.InvalidTaskError('min_replicas must be >= 0')
@@ -211,6 +220,12 @@ class ServiceSpec:
     # worker clusters — readiness is the on-cluster agent's health, no
     # HTTP workload, no load balancer.
     pool: bool = False
+    # Service-level objectives (docs/observability.md "SLOs and
+    # alerting"): a list of objective mappings the LB's burn-rate
+    # evaluator consumes. Validated here so `serve up` rejects a bad
+    # objective; stored normalized (observability/slo.py owns the
+    # schema).
+    slo: Optional[List[Dict[str, Any]]] = None
 
     @classmethod
     def from_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -218,7 +233,8 @@ class ServiceSpec:
             raise exceptions.InvalidTaskError(
                 f'service must be a mapping, got {type(config).__name__}')
         known = {'readiness_probe', 'replica_policy', 'replicas',
-                 'load_balancing_policy', 'replica_port', 'pool', 'tls'}
+                 'load_balancing_policy', 'replica_port', 'pool', 'tls',
+                 'slo'}
         unknown = set(config) - known
         if unknown:
             raise exceptions.InvalidTaskError(
@@ -243,6 +259,9 @@ class ServiceSpec:
             pool=bool(config.get('pool', False)),
             tls=(TlsCredential.from_config(config['tls'])
                  if config.get('tls') is not None else None),
+            slo=([o.to_config() for o in slo_lib.objectives_from_spec(
+                     config['slo'])]
+                 if config.get('slo') is not None else None),
         )
 
     def to_config(self) -> Dict[str, Any]:
@@ -253,6 +272,7 @@ class ServiceSpec:
             'replica_port': self.replica_port,
             'pool': self.pool,
             'tls': self.tls.to_config() if self.tls else None,
+            'slo': self.slo,
         }
 
 

@@ -122,7 +122,9 @@ CREATE TABLE IF NOT EXISTS lb_gauges (
     service_name TEXT PRIMARY KEY,
     updated_at REAL,
     inflight INTEGER DEFAULT 0,
-    queue_depth INTEGER DEFAULT 0
+    queue_depth INTEGER DEFAULT 0,
+    slo_burn REAL DEFAULT 0,
+    slo_burn_interval REAL DEFAULT 0
 );
 CREATE INDEX IF NOT EXISTS idx_replicas_service
     ON replicas (service_name);
@@ -155,6 +157,12 @@ def _db() -> db_util.Db:
             ('lb_gauges', 'queue_depth',
              'ALTER TABLE lb_gauges ADD COLUMN '
              'queue_depth INTEGER DEFAULT 0'),
+            ('lb_gauges', 'slo_burn',
+             'ALTER TABLE lb_gauges ADD COLUMN '
+             'slo_burn REAL DEFAULT 0'),
+            ('lb_gauges', 'slo_burn_interval',
+             'ALTER TABLE lb_gauges ADD COLUMN '
+             'slo_burn_interval REAL DEFAULT 0'),
             ('services', 'recoveries_total',
              'ALTER TABLE services ADD COLUMN '
              'recoveries_total INTEGER DEFAULT 0'),
@@ -791,6 +799,47 @@ def get_queue_depth(service_name: str,
     if row is None or vclock.now() - row['updated_at'] > max_age_s:
         return 0
     return int(row['queue_depth'] or 0)
+
+
+def set_slo_burn(service_name: str, burn: float,
+                 interval_s: float = 0.0) -> None:
+    """The LB's max page-tier SLO burn rate (docs/observability.md
+    "SLOs and alerting") — the autoscaler's SLO-class scale-up
+    input: >= the page threshold means the error budget is burning
+    fast enough to page a human, so the fleet grows without waiting
+    for the queue signal to agree. ``interval_s`` declares the
+    writer's flush cadence so the reader's staleness window scales
+    with it (a coarser twin/fleet cadence must not read as a dead
+    LB)."""
+    conn = _db().conn
+    conn.execute(
+        'INSERT INTO lb_gauges (service_name, updated_at, slo_burn, '
+        'slo_burn_interval) '
+        'VALUES (?,?,?,?) ON CONFLICT(service_name) DO UPDATE SET '
+        'updated_at=excluded.updated_at, slo_burn=excluded.slo_burn, '
+        'slo_burn_interval=excluded.slo_burn_interval',
+        (service_name, vclock.now(), float(burn), float(interval_s)))
+    conn.commit()
+
+
+def get_slo_burn(service_name: str,
+                 max_age_s: Optional[float] = None) -> float:
+    """Latest SLO burn gauge; 0.0 when stale (LB down => no signal,
+    never a phantom page). Staleness defaults to three of the
+    WRITER's declared flush intervals (floor 30s) — a 45s cadence
+    must not make SLO-class scaling flicker off between flushes."""
+    row = _db().conn.execute(
+        'SELECT slo_burn, updated_at, slo_burn_interval FROM '
+        'lb_gauges WHERE service_name = ?',
+        (service_name,)).fetchone()
+    if row is None:
+        return 0.0
+    if max_age_s is None:
+        max_age_s = max(30.0, 3 * float(row['slo_burn_interval']
+                                        or 0.0))
+    if vclock.now() - row['updated_at'] > max_age_s:
+        return 0.0
+    return float(row['slo_burn'] or 0.0)
 
 
 def prune_stats(service_name: str, older_than: float) -> None:

@@ -504,7 +504,12 @@ def render_grafana_dashboard(namespace: str = 'sky-tpu'
     sidecar watching the ``grafana_dashboard`` label, it charts the
     API server's /metrics — request rates/latency plus the per-hop
     span series the tracing subsystem derives (observability/), so
-    "launch p95 regressed" points at a hop without leaving Grafana."""
+    "launch p95 regressed" points at a hop without leaving Grafana —
+    and the serving-SLO row (docs/observability.md "SLOs and
+    alerting"): burn rates vs the page/ticket thresholds, error
+    budget remaining, firing alerts, and LB TTFT p99, from the
+    serving tier's Prometheus exposition
+    (`/-/metrics?format=prometheus`)."""
     import json
     dashboard = {
         'uid': 'sky-tpu-api',
@@ -540,6 +545,26 @@ def render_grafana_dashboard(namespace: str = 'sky-tpu'
                 6, 'API server RSS',
                 'sky_tpu_process_resident_memory_bytes', 'rss',
                 y=16, x=12, unit='bytes'),
+            # ---- serving SLO row (docs/observability.md) ----------
+            _grafana_panel(
+                7, 'SLO burn rate (page windows)',
+                'max by (objective, window) '
+                '(sky_tpu_lb_slo_burn_rate{tier="page"})',
+                '{{objective}} {{window}}', y=24, x=0),
+            _grafana_panel(
+                8, 'SLO error budget remaining',
+                'min by (objective) '
+                '(sky_tpu_lb_slo_error_budget_remaining)',
+                '{{objective}}', y=24, x=12, unit='percentunit'),
+            _grafana_panel(
+                9, 'SLO alerts firing',
+                'sum by (objective, tier) '
+                '(sky_tpu_lb_slo_alert_firing)',
+                '{{tier}}: {{objective}}', y=32, x=0),
+            _grafana_panel(
+                10, 'Serving TTFT p99 through the LB',
+                'sky_tpu_lb_ttft_p99_seconds',
+                'ttft p99', y=32, x=12, unit='s'),
         ],
     }
     return {

@@ -100,6 +100,12 @@ class Scenario:
     # Process kills (crash scenarios embed one; the kill-anywhere
     # sweep injects its own per boundary).
     kills: List[KillSpec] = dataclasses.field(default_factory=list)
+    # Service-level objectives (docs/observability.md "SLOs and
+    # alerting"): flows through the REAL spec validation into the
+    # service row, where the REAL LB's burn-rate evaluator loads it —
+    # the alert-fidelity gates in tests/sim/test_slo_alerts.py arm
+    # these. None = no objectives, the SLO layer stays inert.
+    slo: Optional[List[Dict[str, Any]]] = None
 
 
 def reclaim_storm(*, replicas: int = 40, duration_s: float = 2400.0,

@@ -49,6 +49,12 @@ class SimRequest:
     def path_qs(self) -> str:
         return self.path
 
+    @property
+    def query(self) -> Dict[str, str]:
+        # aiohttp's parsed query surface (the LB's format= switch);
+        # the twin's traffic never carries one.
+        return {}
+
     async def read(self) -> bytes:
         return self._body
 

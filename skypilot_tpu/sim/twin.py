@@ -121,6 +121,19 @@ class SimReport:
         return sum(int(r.get('lb_retries') or 0) for r in self.records)
 
     @property
+    def slo_alerts(self) -> List[Dict[str, Any]]:
+        """Alert transitions from the REAL burn-rate evaluator
+        (docs/observability.md "SLOs and alerting"); the fidelity
+        gates assert on these."""
+        return [d for d in self.decisions if d['kind'] == 'slo_alert']
+
+    def slo_log_jsonl(self) -> str:
+        """The alert decision log alone, one JSON line per
+        transition — byte-identical across same-seed runs."""
+        return '\n'.join(json.dumps(d, sort_keys=True)
+                         for d in self.slo_alerts)
+
+    @property
     def client_errors(self) -> List[Dict[str, Any]]:
         """Client-visible failures: anything that neither completed
         nor was an orderly admission shed (the zero-errors gates
@@ -257,7 +270,7 @@ class DigitalTwin:
             policy['queue_length_threshold'] = sc.queue_length_threshold
         policy['upscale_delay_seconds'] = sc.upscale_delay_s
         policy['downscale_delay_seconds'] = sc.downscale_delay_s
-        return {
+        config = {
             'readiness_probe': {
                 'path': '/health',
                 'initial_delay_seconds': sc.initial_delay_s,
@@ -265,6 +278,9 @@ class DigitalTwin:
             'replica_policy': policy,
             'load_balancing_policy': sc.lb_policy,
         }
+        if sc.slo is not None:
+            config['slo'] = sc.slo
+        return config
 
     # ---- traffic -------------------------------------------------------
     def _synthesize(self) -> list:
@@ -435,6 +451,7 @@ class DigitalTwin:
             model_by_url=self._model_by_url)
         self._lb.sync_interval_s = self.sc.lb_sync_s
         self._lb.stats_flush_s = self.sc.stats_flush_s
+        self._lb.slo_transition_hook = self._on_slo_transition
         # The crash-restart rebuild under test: ready set, affinity
         # ring, and breaker state repopulated from serve_state before
         # the first retried leg lands.
@@ -491,6 +508,16 @@ class DigitalTwin:
             raise ValueError(f'unknown fault kind {fault.kind!r}')
 
     # ---- control loops -------------------------------------------------
+    def _on_slo_transition(self, tr: Dict[str, Any]) -> None:
+        """Alert transitions from the REAL burn-rate evaluator land
+        in the decision log (the byte-identity surface): the
+        alert-fidelity gates assert firing/resolve times and the
+        zero-false-positive scenarios assert absence."""
+        self._log('slo_alert', objective=tr['objective'],
+                  tier=tr['tier'], state=tr['state'],
+                  burn_short=tr['burn_short'],
+                  burn_long=tr['burn_long'])
+
     def _watch_breakers(self) -> None:
         """Log breaker state EDGES into the decision log (the
         breaker-flap gate asserts open ↦ re-closed; the REAL breaker
@@ -608,6 +635,7 @@ class DigitalTwin:
         # Override the env-derived cadences with the scenario's.
         self._lb.sync_interval_s = sc.lb_sync_s
         self._lb.stats_flush_s = sc.stats_flush_s
+        self._lb.slo_transition_hook = self._on_slo_transition
         # Control loops at their virtual cadences. The kernel's
         # trampoline drives the LB's REAL async bodies; every await
         # inside resolves inline (the twin's _offload) so each spawn
