@@ -6,7 +6,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke sim-crash-sweep slo-smoke
+.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke sim-crash-sweep slo-smoke cost-smoke
 
 lint:
 	$(PYTHON) -m skypilot_tpu.client.cli lint --changed
@@ -62,3 +62,10 @@ slo-smoke:
 # mismatch, non-idempotent recovery, or decision-log byte mismatch.
 sim-crash-sweep:
 	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.sim --crash-sweep --verify-determinism
+
+# Cost-plane smoke (docs/cost.md): replay the seeded spot-market
+# scenario in the digital twin cost-optimized and all-on-demand (same
+# seed), print the dollars saved and the SLO page-alert count, and
+# fail on any page alert, any client-visible error, or zero savings.
+cost-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.serve.costplane

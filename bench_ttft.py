@@ -656,6 +656,81 @@ def _run_chunked_sweep(args) -> dict:
     }
 
 
+def _coldstart_boot(args, cache_dir: str, boot_idx: int) -> dict:
+    """One full server boot against a shared persistent compile
+    cache: spawn → /health ready → first streamed token, plus the
+    server's own cold-start stepline stamps (weights_loaded /
+    compiled) pulled from /debug/stepline."""
+    from skypilot_tpu.utils import common
+    port = common.free_port()
+    cmd = [sys.executable, '-m', 'skypilot_tpu.infer.server',
+           '--port', str(port), '--model', args.model,
+           '--slots', str(args.slots),
+           '--max-seq-len', str(args.max_seq_len),
+           '--compile-cache-dir', cache_dir]
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT)
+    try:
+        _wait_http(f'http://127.0.0.1:{port}/health', 600)
+        ready_s = time.time() - t0
+        ttft, _, _ = _streamed_request(
+            f'http://127.0.0.1:{port}/generate', 'hello',
+            max_new_tokens=4)
+        stamps = {}
+        try:
+            snap = _get(f'http://127.0.0.1:{port}/debug/stepline')
+            for ev in snap.get('events', ()):
+                name = ev.get('event', '')
+                if name.startswith('coldstart.'):
+                    stamps[name.split('.', 1)[1]] = {
+                        k: v for k, v in ev.items()
+                        if k.endswith('_s')}
+        except Exception:  # noqa: BLE001 — stamps are best-effort
+            pass           # (--no-stepline builds have none)
+        return {'boot': boot_idx,
+                'time_to_ready_s': round(ready_s, 3),
+                'first_token_s': round(ready_s + ttft, 3),
+                'ttft_after_ready_s': round(ttft, 5),
+                'stamps': stamps}
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
+def _run_coldstart_sweep(args) -> dict:
+    """--sweep coldstart: the scale-to-zero wake path's replica half
+    (docs/cost.md "Scale to zero"). Boot the real server TWICE against
+    one persistent compile-cache dir — boot 1 compiles cold and
+    populates the cache, boot 2 deserializes — and emit the cold-start
+    curve (spawn → weights → compile → first token) for both, plus the
+    ready-time ratio the cache buys. No improvement assertion: backends
+    without persistent-cache support degrade to two cold boots."""
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix='sky-tpu-ccache-') as cache:
+        boots = [_coldstart_boot(args, cache, i) for i in range(2)]
+    cold, warm = boots[0], boots[1]
+    ratio = (round(cold['time_to_ready_s'] / warm['time_to_ready_s'], 3)
+             if warm['time_to_ready_s'] else None)
+    return {
+        'metric': 'coldstart_ready_ratio_cold_over_warm',
+        'value': ratio,
+        'unit': ('x (boot-1 cold-compile time-to-ready / boot-2 '
+                 'cache-hit time-to-ready, same compile-cache dir)'),
+        'cold_time_to_ready_s': cold['time_to_ready_s'],
+        'warm_time_to_ready_s': warm['time_to_ready_s'],
+        'cold_first_token_s': cold['first_token_s'],
+        'warm_first_token_s': warm['first_token_s'],
+        'sweep_mode': 'coldstart',
+        'sweep': boots,
+        'model': args.model,
+        'slots': args.slots,
+        'path': ('full server boot (spawn -> /health -> first '
+                 'streamed token), persistent XLA compile cache '
+                 'shared across boots'),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--requests-per-level', type=int, default=80)
@@ -681,7 +756,8 @@ def main() -> None:
     parser.add_argument('--sweep', default='concurrency',
                         choices=['concurrency', 'shared-prefix',
                                  'chaos-resume', 'tenants',
-                                 'speculative', 'chunked'],
+                                 'speculative', 'chunked',
+                                 'coldstart'],
                         help="'shared-prefix': the shared-system-"
                              'prompt workload (implies --paged '
                              '--prefix-cache) — per level, a cold '
@@ -720,7 +796,13 @@ def main() -> None:
                              'implies --paged), plus the int8 '
                              'kv-dtype residency axis (resident '
                              'pages + prefix_hit_rate delta at a '
-                             'fixed HBM budget).')
+                             "fixed HBM budget). 'coldstart': the "
+                             'scale-to-zero wake path — boot the real '
+                             'server twice against one persistent '
+                             'compile-cache dir and emit the '
+                             'cold-start curve (spawn -> weights -> '
+                             'compile -> first token) for the '
+                             'cold-compile and cache-hit boots.')
     parser.add_argument('--spec-k', type=int, default=0,
                         help='speculative draft width for the replica '
                              '(0 = off; --sweep speculative defaults '
@@ -806,6 +888,14 @@ def main() -> None:
         # dispatches — a device-step property the HTTP path would only
         # blur with transport jitter.
         result = _run_chunked_sweep(args)
+        print(json.dumps(result))
+        if args.output:
+            with open(args.output, 'w', encoding='utf-8') as f:
+                json.dump(result, f, indent=1)
+        return
+
+    if args.sweep == 'coldstart':
+        result = _run_coldstart_sweep(args)
         print(json.dumps(result))
         if args.output:
             with open(args.output, 'w', encoding='utf-8') as f:

@@ -128,10 +128,31 @@ def _load(cloud: str) -> List[CatalogEntry]:
     return out
 
 
+@functools.lru_cache(maxsize=None)
+def preemption_rates(cloud: str) -> Dict[tuple, float]:
+    """(generation, region, zone) → observed spot preemptions per
+    slice-hour, from the bundled <cloud>_preemption.csv. A static
+    seed snapshot, like the price catalog: the serve tier's
+    FleetCatalog (serve/costplane/) layers a pluggable fetcher and
+    staleness handling on top of it. Missing file → empty (every rate
+    reads as the conservative default the caller picks)."""
+    path = os.path.join(_DATA_DIR, f'{cloud}_preemption.csv')
+    out: Dict[tuple, float] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, newline='', encoding='utf-8') as f:
+        for row in csv.DictReader(f):
+            out[(row['name'].strip(), row['region'].strip(),
+                 row['zone'].strip())] = float(
+                     row['preemption_rate_per_hour'])
+    return out
+
+
 def refresh() -> None:
     """Drop cached catalog data (hook for a future hosted-catalog fetcher)."""
     _load.cache_clear()
     _az_mappings.cache_clear()
+    preemption_rates.cache_clear()
 
 
 def list_accelerators(name_filter: Optional[str] = None,

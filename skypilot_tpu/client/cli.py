@@ -543,6 +543,54 @@ def slo_cmd(lb_url: str, as_json: bool) -> None:
                        f"{t['burn_long']})")
 
 
+@cli.command('cost')
+@click.argument('lb_url')
+@click.option('--json', 'as_json', is_flag=True,
+              help='Raw cost keys of /-/metrics instead of the '
+                   'report.')
+def cost_cmd(lb_url: str, as_json: bool) -> None:
+    """Show a live service's fleet cost report (docs/cost.md
+    "Reading a cost report").
+
+    LB_URL is the service endpoint (``http://host:port``); this reads
+    the cost-plane keys of its ``/-/metrics`` view: the fleet's
+    current $/hour and spot fraction (from the controller's catalog
+    snapshot), the efficiency rate in $ per 1k good tokens, and the
+    scale-to-zero counters (parked requests, cold starts).
+    """
+    import json as json_lib
+
+    m = _fetch_json(lb_url.rstrip('/') + '/-/metrics')
+    keys = ('fleet_cost_per_hour', 'cost_per_1k_good_tokens',
+            'spot_fraction', 'cost_catalog_stale', 'parked_requests',
+            'cold_starts_total', 'cold_start_p50_s')
+    if as_json:
+        click.echo(json_lib.dumps({k: m.get(k) for k in keys},
+                                  indent=1))
+        return
+    rate = m.get('fleet_cost_per_hour') or 0.0
+    per_1k = m.get('cost_per_1k_good_tokens')
+    click.echo(f'fleet cost:      ${rate:.4f}/hour '
+               f'(${rate * 24 * 30:.2f}/month at this rate)')
+    click.echo('cost efficiency: '
+               + (f'${per_1k:.6f} per 1k good tokens'
+                  if per_1k is not None else
+                  'n/a (no recent token throughput)'))
+    click.echo(f"spot fraction:   {m.get('spot_fraction', 0.0):.0%} "
+               f"of {m.get('ready_replicas', 0)} ready replica(s)")
+    if m.get('cost_catalog_stale'):
+        click.echo('WARNING: price catalog is STALE — placement is '
+                   'running on last-known prices (the fetcher is '
+                   'failing; see serve.costplane.catalog_stale).')
+    cold = m.get('cold_starts_total') or 0
+    if cold or m.get('parked_requests'):
+        p50 = m.get('cold_start_p50_s')
+        click.echo(f"scale-to-zero:   {m.get('parked_requests', 0)} "
+                   f'parked request(s), {cold} cold start(s)'
+                   + (f', p50 wake {p50:.1f}s'
+                      if p50 is not None else ''))
+
+
 @cli.command('show-accelerators')
 @click.option('--filter', 'name_filter', default=None)
 def show_accelerators(name_filter: Optional[str]) -> None:

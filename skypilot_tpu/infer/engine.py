@@ -2169,6 +2169,23 @@ class InferenceEngine:
                 'request_id': req.request_id, 'tenant': req.tenant,
                 'ttft_s': round(ttft, 6), 'slo_s': slo})
 
+    def note_lifecycle_event(self, event: str,
+                             t: Optional[float] = None,
+                             **detail: Any) -> None:
+        """Stamp a replica-lifecycle milestone (cold-start timeline:
+        ``coldstart.weights_loaded`` / ``coldstart.compiled`` / ...)
+        into the flight-recorder event ring, where it interleaves with
+        per-request timelines on the same wall clock — `sky-tpu
+        profile` and the span dumps see exactly when the replica
+        became serviceable relative to its first requests. Request id
+        -1 keys the pseudo-timeline (real ids start at 1)."""
+        if not self._sl_on:
+            return
+        with self._lock:
+            self._stepline.note_event(-1, '_lifecycle', event,
+                                      t if t is not None else time.time(),
+                                      **detail)
+
     def _note_anomaly(self, trigger: str,  # holds: _lock
                       detail: Dict[str, Any]) -> None:
         """Record the anomaly in the event ring and queue a ring dump
@@ -2604,6 +2621,13 @@ class EnginePool:
     def set_tenant_weights(self, weights) -> None:
         for e in self.engines:
             e.set_tenant_weights(weights)
+
+    def note_lifecycle_event(self, event: str,
+                             t: Optional[float] = None,
+                             **detail: Any) -> None:
+        """Lifecycle milestones land on tier 0 (the merged snapshot
+        interleaves them with every tier's requests anyway)."""
+        self.engines[0].note_lifecycle_event(event, t, **detail)
 
     def stepline_snapshot(self) -> Dict[str, Any]:
         """Merged flight-recorder snapshot across tiers (records

@@ -182,6 +182,41 @@ def parse_tenant_weights(spec: Optional[str]) -> Optional[dict]:
     return out or None
 
 
+def setup_compile_cache(cache_dir: str) -> bool:
+    """Point XLA's persistent compilation cache at ``cache_dir`` so a
+    relaunched replica deserializes its warm-path programs instead of
+    recompiling them — the dominant term of a scale-to-zero cold start
+    after weights (docs/cost.md "Scale to zero"). The threshold tuning
+    makes the very first boot populate the cache even for small
+    programs, so the SECOND boot is the fast one.
+
+    Degradation, not failure: on the ``infer.server.compile_cache_miss``
+    failpoint or any real setup error (read-only dir, an XLA build
+    without the flag) the server warms with a cold compile — slower
+    first tokens, never a crash."""
+    try:
+        failpoints.hit('infer.server.compile_cache_miss')
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+        # Cache everything: the default min-compile-time gate would
+        # skip exactly the small warm-path programs a cold start
+        # replays.
+        jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                          0.0)
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes',
+                          -1)
+        logger.info('persistent compile cache at %s', cache_dir)
+        return True
+    except failpoints.FailpointError as e:
+        logger.warning('compile cache miss injected (%s): serving '
+                       'with a cold compile', e)
+        return False
+    except Exception as e:  # noqa: BLE001 — cache is an optimization
+        logger.warning('compile cache setup failed (%s: %s): serving '
+                       'with a cold compile', type(e).__name__, e)
+        return False
+
+
 class IncrementalDecoder:
     """Streaming detokenizer with an O(window) cost per flush.
 
@@ -310,9 +345,14 @@ class InferenceServer:
     }
 
     def __init__(self, engine: engine_lib.InferenceEngine,
-                 tokenizer: Tokenizer = None, driver=None) -> None:
+                 tokenizer: Tokenizer = None, driver=None,
+                 boot_t0: Optional[float] = None) -> None:
         self.engine = engine
         self.tokenizer = tokenizer or Tokenizer()
+        # Cold-start stopwatch origin: process start (main() stamps
+        # it) — the compile stamp reports total time-to-serviceable,
+        # not just the warm loop.
+        self.boot_t0 = boot_t0 if boot_t0 is not None else time.time()
         # Multi-host replica: submissions go through the lockstep
         # broadcast driver (infer/multihost.py) instead of the local
         # engine queue.
@@ -356,6 +396,10 @@ class InferenceServer:
                         r.wait_done()   # token events, not sleep-polls
                     logger.info('engine warm in %.1fs',
                                 time.time() - t0)
+                    self.engine.note_lifecycle_event(
+                        'coldstart.compiled',
+                        warm_s=round(time.time() - t0, 3),
+                        total_s=round(time.time() - self.boot_t0, 3))
                     self.ready = True
                 threading.Thread(target=_warm, daemon=True).start()
                 self.driver.run()
@@ -375,6 +419,13 @@ class InferenceServer:
             while not all(w.done for w in warm_reqs):
                 self.engine.step()
             logger.info('engine warm in %.1fs', time.time() - t0)
+            # Cold-start timeline (docs/cost.md "Scale to zero"):
+            # weights_loaded was stamped by main(); this is the
+            # compile→serviceable edge the wake path waits on.
+            self.engine.note_lifecycle_event(
+                'coldstart.compiled',
+                warm_s=round(time.time() - t0, 3),
+                total_s=round(time.time() - self.boot_t0, 3))
             self.ready = True
             while not self._stop.is_set():
                 if self.engine.step() == 0:
@@ -888,6 +939,14 @@ def main() -> None:
                              'recorder anomaly dump (read later with '
                              '`sky-tpu profile`). Default: no SLO '
                              'trigger.')
+    parser.add_argument('--compile-cache-dir', default=None,
+                        help='Persistent XLA compilation cache dir '
+                             '(docs/cost.md "Scale to zero"): a '
+                             'relaunched replica deserializes its '
+                             'warm-path programs instead of '
+                             'recompiling, cutting cold-start '
+                             'time-to-ready. Survives restarts; share '
+                             'it across replicas of one service.')
     parser.add_argument('--pipeline-depth', type=int, default=1,
                         help='Dispatch-ahead decode depth: decode N+1 '
                              'is dispatched before step N is read '
@@ -897,6 +956,9 @@ def main() -> None:
                              'replicas always run 0.')
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    boot_t0 = time.time()
+    if args.compile_cache_dir:
+        setup_compile_cache(args.compile_cache_dir)
     if args.paged and args.long_slots > 0:
         # Usage error: fail in milliseconds, not after minutes of
         # checkpoint loading and KV allocation.
@@ -997,6 +1059,7 @@ def main() -> None:
                        args.model)
         params = llama.init_params(config, jax.random.PRNGKey(0))
     tenant_weights = parse_tenant_weights(args.tenant_weights)
+    t_weights = time.time()
     engine = engine_lib.InferenceEngine(
         config, params,
         engine_lib.EngineConfig(
@@ -1045,6 +1108,11 @@ def main() -> None:
                 ttft_slo_s=args.ttft_slo_s),
             seed=1)
         engine = engine_lib.EnginePool([engine, long_engine])
+    # Cold-start timeline stamp #1 (t_weights covers checkpoint
+    # restore/random init; the KV allocation above rides in the gap
+    # before the compile stamp).
+    engine.note_lifecycle_event('coldstart.weights_loaded',
+                                load_s=round(t_weights - boot_t0, 3))
     driver = None
     if world > 1:
         driver = multihost.MultihostEngineDriver(engine)
@@ -1055,8 +1123,8 @@ def main() -> None:
             return
     tokenizer = Tokenizer(args.tokenizer,
                           vocab_limit=config.vocab_size)
-    InferenceServer(engine, tokenizer, driver=driver).run(
-        args.host, args.port)
+    InferenceServer(engine, tokenizer, driver=driver,
+                    boot_t0=boot_t0).run(args.host, args.port)
 
 
 if __name__ == '__main__':

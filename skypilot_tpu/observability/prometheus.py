@@ -58,6 +58,20 @@ def lb_exposition() -> Dict[str, Tuple[str, str]]:
         'slo_alerts_firing': (
             'sky_tpu_lb_slo_alerts_firing', 'gauge'),
         'slo_burn': ('sky_tpu_lb_slo_burn', 'gauge'),
+        # Cost plane (docs/cost.md).
+        'fleet_cost_per_hour': (
+            'sky_tpu_lb_fleet_cost_per_hour', 'gauge'),
+        'cost_per_1k_good_tokens': (
+            'sky_tpu_lb_cost_per_1k_good_tokens', 'gauge'),
+        'spot_fraction': ('sky_tpu_lb_spot_fraction', 'gauge'),
+        'cost_catalog_stale': (
+            'sky_tpu_lb_cost_catalog_stale', 'gauge'),
+        # Scale to zero (docs/cost.md "Scale to zero").
+        'parked_requests': ('sky_tpu_lb_parked_requests', 'gauge'),
+        'cold_starts_total': (
+            'sky_tpu_lb_cold_starts_total', 'counter'),
+        'cold_start_p50_s': (
+            'sky_tpu_lb_cold_start_p50_seconds', 'gauge'),
     }
 
 

@@ -19,6 +19,8 @@ from typing import Optional
 from skypilot_tpu.serve import autoscalers as autoscalers_lib
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import spec as spec_lib
+from skypilot_tpu.serve.costplane import catalog as cost_catalog_lib
+from skypilot_tpu.serve.costplane import placer as placer_lib
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.serve.state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.utils import common
@@ -42,11 +44,15 @@ class ServeController:
         'version': 'owner',
         'spec': 'owner',
         'autoscaler': 'owner',
+        'placer': 'owner',
+        'cost_catalog': 'owner',
     }
 
     def __init__(self, service_name: str, *,
                  cloud: Optional['replica_managers.CloudAdapter'] = None,
-                 executor=None) -> None:
+                 executor=None,
+                 cost_catalog: Optional[
+                     'cost_catalog_lib.FleetCatalog'] = None) -> None:
         record = serve_state.get_service(service_name)
         if record is None:
             raise ValueError(f'service {service_name!r} not in state DB')
@@ -63,10 +69,33 @@ class ServeController:
         self.autoscaler = autoscalers_lib.make(
             service_name, self.spec.replica_policy,
             has_slo=bool(self.spec.slo))
+        # Cost plane (docs/cost.md): an injected catalog (the twin's
+        # market model) or the bundled seed. The placer itself is
+        # stateless, so _refresh_version only has to re-check the
+        # policy toggle, never migrate placer state.
+        self.cost_catalog = cost_catalog
+        # Decision-log seam: the twin installs a callable receiving
+        # every plan's log_fields() so placement lands in the
+        # byte-identity decision log. None in production.
+        self.place_hook = None
+        self.placer: Optional[placer_lib.FleetPlacer] = None
+        self._ensure_placer()
         # Prompt-teardown signal for run(): stop() (tests, embedding
         # processes) wakes the tick loop immediately instead of letting
         # it finish a full _TICK_S sleep.
         self._stop = threading.Event()
+
+    def _ensure_placer(self) -> None:
+        """(Re)build the placer to match the CURRENT policy — a
+        rollout may toggle ``cost_optimized`` either way."""
+        if not self.spec.replica_policy.cost_optimized:
+            self.placer = None
+            self.rm.placement_plan = None
+            return
+        if self.cost_catalog is None:
+            self.cost_catalog = cost_catalog_lib.FleetCatalog()
+        self.placer = placer_lib.FleetPlacer(
+            self.service_name, self.cost_catalog)
 
     # -- version rollout ---------------------------------------------------
     def _refresh_version(self) -> None:
@@ -90,6 +119,7 @@ class ServeController:
                 has_slo=bool(self.spec.slo))
             self.autoscaler.target_num_replicas = max(
                 self.spec.replica_policy.min_replicas, old_target)
+            self._ensure_placer()
 
     def _scale_down_victims(self, group: list, n: int) -> list:
         """Scale-down victims. For pools, a worker with a job assigned is
@@ -146,6 +176,25 @@ class ServeController:
                                             replicas=live)
         target = decision.target_num_replicas
 
+        if self.placer is not None and decision.target_spot is None:
+            # Cost plane (docs/cost.md): split the homogeneous target
+            # into a spot/on-demand mix. Autoscalers that already emit
+            # a per-kind split (the fallback family) own it — spec
+            # validation rejects that combination up front, so this
+            # branch never fights one.
+            self.cost_catalog.refresh()
+            plan = self.placer.plan(
+                target, self.spec.replica_policy, live,
+                blocked=self.rm.spot_placer.preempted_placements(),
+                avoid=self.rm.spot_placer.spread_placements())
+            decision.target_spot = plan.target_spot
+            decision.target_ondemand = plan.target_ondemand
+            decision.reason = (f'{decision.reason} | {plan.reason}'
+                               if decision.reason else plan.reason)
+            self.rm.placement_plan = plan
+            if self.place_hook is not None:
+                self.place_hook(plan.log_fields())
+
         current = [r for r in live if r['version'] == self.version]
         stale = [r for r in live if r['version'] != self.version]
         stale_ready = [r for r in stale
@@ -199,15 +248,37 @@ class ServeController:
                 f'failures')
             return
         total_ready = num_ready
+        pol = self.spec.replica_policy
         if total_ready > 0:
             serve_state.set_service_status(self.service_name,
                                            ServiceStatus.READY)
         elif any(r['status'].is_launching() for r in live):
             serve_state.set_service_status(self.service_name,
                                            ServiceStatus.REPLICA_INIT)
+        elif (pol.min_replicas == 0 and pol.wake_on_request
+              and target == 0 and not live):
+            # Scaled to zero ON PURPOSE (docs/cost.md "Scale to
+            # zero"): distinct from NO_REPLICA so `serve status` never
+            # reads an idle parked fleet as an outage. The LB keeps
+            # accepting requests and parks them; the first parked
+            # request raises the queue signal and the next tick's
+            # target wakes the fleet.
+            serve_state.set_service_status(self.service_name,
+                                           ServiceStatus.PARKED)
         else:
             serve_state.set_service_status(self.service_name,
                                            ServiceStatus.NO_REPLICA)
+        # Fleet economics gauges (docs/observability.md): billed rate
+        # of the live fleet + its spot share, flushed for the LB's
+        # /-/metrics. Priced only when the cost plane is on — unpriced
+        # fleets report nothing rather than a misleading $0 rate.
+        if self.cost_catalog is not None:
+            snap = placer_lib.fleet_cost_snapshot(self.cost_catalog,
+                                                  live)
+            serve_state.set_cost_gauges(
+                self.service_name, snap['cost_per_hour'],
+                snap['spot_fraction'],
+                catalog_stale=self.cost_catalog.stale)
         # Trim LB stats older than the QPS window.
         serve_state.prune_stats(
             self.service_name,

@@ -1,0 +1,43 @@
+"""Fleet cost plane: $/good-token placement for the serving fleet.
+
+The economic half of the serving story (docs/cost.md): the reference
+SkyPilot's identity is its cost optimizer — ``sky/optimizer.py`` plus a
+price catalog deciding *where* and *on what pricing tier* work runs —
+but its serve tier still scales on demand alone. Here the two meet:
+
+- :class:`FleetCatalog` (catalog.py) — per-zone, per-accelerator spot
+  and on-demand prices plus observed preemption-rate estimates, seeded
+  from the bundled ``catalog/data`` snapshot with a pluggable fetcher
+  on top. Fetch failure degrades to last-known prices with a staleness
+  gauge (never a placement stall).
+- :class:`FleetPlacer` (placer.py) — converts the autoscaler's replica
+  target into a per-zone spot/on-demand mix minimizing expected
+  $/good-token. Expected spot cost folds in preemption-rate-weighted
+  relaunch overhead; the LB's flushed ``slo_burn`` is a hard
+  constraint (page-level burn forces on-demand top-up, ticket-level
+  burn vetoes spot-ward rebalancing). The spot placer's HARD
+  preemption cooldowns and SOFT spread lists are *inputs* here, not a
+  parallel decision path.
+
+``python -m skypilot_tpu.serve.costplane`` (``make cost-smoke``)
+replays the seeded spot-market week in the digital twin and proves
+real dollars saved vs an all-on-demand baseline with zero SLO pages —
+the $-saved-at-SLO gate.
+"""
+from skypilot_tpu.serve.costplane.catalog import (DEFAULT_PREEMPTION_RATE,
+                                                  FleetCatalog,
+                                                  ZoneEconomics,
+                                                  seed_economics)
+from skypilot_tpu.serve.costplane.placer import (FleetPlacer,
+                                                 PlacementPlan,
+                                                 expected_spot_cost_per_hour)
+
+__all__ = [
+    'DEFAULT_PREEMPTION_RATE',
+    'FleetCatalog',
+    'FleetPlacer',
+    'PlacementPlan',
+    'ZoneEconomics',
+    'expected_spot_cost_per_hour',
+    'seed_economics',
+]

@@ -1,0 +1,207 @@
+"""FleetCatalog: per-zone fleet economics behind a narrow query API.
+
+The serve tier's view of the price catalog (docs/cost.md "Catalog
+schema"): where ``catalog/`` answers launch-time feasibility questions
+("what can run this task, at what price?"), the fleet cost plane asks a
+running service's questions — "what does a chip-hour cost in THIS zone
+right now, spot vs on-demand, and how often does spot capacity there
+get reclaimed?" — thousands of times per day from the controller tick.
+
+Data flow: seeded from the bundled static snapshot
+(``catalog/data/<cloud>.csv`` joined with
+``<cloud>_preemption.csv``), optionally refreshed through a pluggable
+``fetcher`` callable (a hosted-catalog HTTP pull, a preemption-events
+aggregator, the digital twin's market model). A fetch failure NEVER
+propagates to placement: the catalog keeps serving the last-known
+economics and raises its ``stale`` gauge — the failpoint site
+``serve.costplane.catalog_stale`` injects exactly this failure in the
+chaos suite.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from skypilot_tpu import catalog as base_catalog
+from skypilot_tpu.utils import failpoints
+
+logger = logging.getLogger(__name__)
+
+# Observed spot reclaim rate assumed for zones with no measurement —
+# deliberately mid-range: an unmeasured zone should neither win nor
+# lose a placement on optimism alone.
+DEFAULT_PREEMPTION_RATE = 0.08
+
+
+@dataclasses.dataclass(frozen=True)
+class ZoneEconomics:
+    """One zone's economics for one accelerator generation.
+
+    Prices are per chip-hour for real TPU generations (the
+    ``catalog/data`` unit); the digital twin injects per-replica-hour
+    entries for its modeled zones — every consumer works in
+    "price units x chips", so the unit rides through unchanged.
+    """
+    accelerator: str              # tpu generation ('v5e') / 'sim'
+    region: str
+    zone: str
+    ondemand_price: float
+    spot_price: float
+    # Observed spot preemptions per slice-hour in this zone.
+    preemption_rate_per_hour: float
+
+
+def seed_economics(cloud: str = 'gcp') -> List[ZoneEconomics]:
+    """The bundled static snapshot: TPU price rows joined with the
+    observed preemption-rate seed (``<cloud>_preemption.csv``)."""
+    rates = base_catalog.preemption_rates(cloud)
+    out: List[ZoneEconomics] = []
+    for e in base_catalog._load(cloud):  # noqa: SLF001 — same package
+        if e.kind != 'tpu':
+            continue
+        out.append(ZoneEconomics(
+            accelerator=e.name, region=e.region, zone=e.zone,
+            ondemand_price=e.price, spot_price=e.spot_price,
+            preemption_rate_per_hour=rates.get(
+                (e.name, e.region, e.zone), DEFAULT_PREEMPTION_RATE)))
+    return out
+
+
+class FleetCatalog:
+    """Narrow, always-answering economics lookup for the cost plane.
+
+    Thread/process story: constructed and queried by the controller
+    tick (single-threaded); the LB never touches it (it reads the
+    controller's flushed gauges from the state DB instead).
+    """
+
+    def __init__(self, cloud: str = 'gcp', *,
+                 entries: Optional[Iterable[ZoneEconomics]] = None,
+                 fetcher: Optional[
+                     Callable[[], Iterable[ZoneEconomics]]] = None
+                 ) -> None:
+        self._fetcher = fetcher
+        self._by_key: Dict[Tuple[str, str, str], ZoneEconomics] = {}
+        self._by_zone: Dict[Tuple[str, str], ZoneEconomics] = {}
+        # Last-known-good economics survive every failed refresh.
+        self.stale = False
+        self.fetch_failures = 0
+        self._install(entries if entries is not None
+                      else seed_economics(cloud))
+
+    def _install(self, entries: Iterable[ZoneEconomics]) -> None:
+        by_key: Dict[Tuple[str, str, str], ZoneEconomics] = {}
+        by_zone: Dict[Tuple[str, str], ZoneEconomics] = {}
+        for z in entries:
+            by_key[(z.accelerator, z.region, z.zone)] = z
+            # Accelerator-agnostic fallback: first (sorted) generation
+            # priced in the zone represents it.
+            key = (z.region, z.zone)
+            cur = by_zone.get(key)
+            if cur is None or z.accelerator < cur.accelerator:
+                by_zone[key] = z
+        if not by_key:
+            raise ValueError('FleetCatalog needs at least one '
+                             'ZoneEconomics entry')
+        self._by_key = by_key
+        self._by_zone = by_zone
+
+    # -- refresh -----------------------------------------------------------
+    def refresh(self) -> bool:
+        """Pull fresh economics through the fetcher (no-op without
+        one). NEVER raises: on any fetch failure the last-known
+        entries keep serving and ``stale`` goes up — a dead catalog
+        feed must degrade placement quality, not stall placement."""
+        if self._fetcher is None:
+            return True
+        try:
+            # Chaos seam (docs/robustness.md site catalog): injects a
+            # catalog-feed outage right where a real fetch would die.
+            failpoints.hit('serve.costplane.catalog_stale')
+            entries = list(self._fetcher())
+            if not entries:
+                raise ValueError('catalog fetcher returned no entries')
+            self._install(entries)
+            self.stale = False
+            return True
+        except Exception:  # noqa: BLE001 — degrade, never stall
+            self.fetch_failures += 1
+            self.stale = True
+            logger.warning(
+                'fleet catalog refresh failed (%d so far); serving '
+                'last-known prices', self.fetch_failures, exc_info=True)
+            return False
+
+    # -- queries -----------------------------------------------------------
+    def zones(self, accelerator: Optional[str] = None
+              ) -> List[ZoneEconomics]:
+        """Every priced zone (for one generation when given), in a
+        deterministic (region, zone) order — the placer's candidate
+        universe."""
+        if accelerator is None:
+            rows = self._by_zone.values()
+        else:
+            rows = (z for z in self._by_key.values()
+                    if z.accelerator == accelerator)
+        return sorted(rows, key=lambda z: (z.region, z.zone))
+
+    def economics(self, region: str, zone: str,
+                  accelerator: Optional[str] = None
+                  ) -> Optional[ZoneEconomics]:
+        if accelerator is not None:
+            hit = self._by_key.get((accelerator, region, zone))
+            if hit is not None:
+                return hit
+            # Region-representative row: the catalog prices per region
+            # with one representative zone, but az-mappings may launch
+            # into siblings — same regional price applies.
+            for (acc, r, _), z in sorted(self._by_key.items()):
+                if acc == accelerator and r == region:
+                    return z
+            return None
+        return self._by_zone.get((region, zone))
+
+    def price_per_hour(self, region: str, zone: str, *,
+                       use_spot: bool,
+                       accelerator: Optional[str] = None,
+                       chips: int = 1) -> Optional[float]:
+        z = self.economics(region, zone, accelerator)
+        if z is None:
+            return None
+        unit = z.spot_price if use_spot else z.ondemand_price
+        return unit * max(1, chips)
+
+    def preemption_rate(self, region: str, zone: str,
+                        accelerator: Optional[str] = None) -> float:
+        z = self.economics(region, zone, accelerator)
+        return (z.preemption_rate_per_hour if z is not None
+                else DEFAULT_PREEMPTION_RATE)
+
+
+def parse_accelerator(acc: Optional[str]) -> Tuple[Optional[str], int]:
+    """(generation, chips) from a replica row's accelerator string —
+    'v5e-16' → ('v5e', 16). Unparseable names (the twin's modeled
+    accelerators, local fakes) pass through whole with chips=1, so
+    injected catalogs keyed on the same names still match."""
+    if not acc:
+        return None, 1
+    try:
+        from skypilot_tpu import topology
+        s = topology.parse_tpu(acc)
+        return s.generation, s.num_chips
+    except Exception:  # noqa: BLE001 — non-TPU accelerator strings
+        return acc, 1
+
+
+def replica_cost_per_hour(cat: FleetCatalog, row: Dict) -> float:
+    """One live replica's billed rate from its recorded placement
+    (``region/zone`` string) and accelerator — 0.0 when the catalog
+    cannot price it (local fakes), so unpriced replicas never poison
+    the fleet gauge."""
+    region, _, zone = (row.get('zone') or '/').partition('/')
+    gen, chips = parse_accelerator(row.get('accelerator'))
+    price = cat.price_per_hour(region, zone,
+                               use_spot=bool(row.get('is_spot')),
+                               accelerator=gen, chips=chips)
+    return price or 0.0

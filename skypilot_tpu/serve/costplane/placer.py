@@ -1,0 +1,212 @@
+"""FleetPlacer: autoscaler target → per-zone spot/on-demand mix.
+
+The objective (docs/cost.md "Placer objective"): minimize expected
+$/good-token. Good tokens scale with replica-hours actually serving,
+so per replica the placer compares *expected cost per useful hour*:
+
+    on-demand:  price_od(z)                      (never reclaimed)
+    spot:       price_spot(z) * (1 + rate(z) * overhead_s / 3600)
+
+``rate(z)`` is the zone's observed preemption rate (reclaims per
+slice-hour, from :class:`FleetCatalog`) and ``overhead_s`` the
+declared serving time one preemption costs (drain + relaunch + warm —
+``ReplicaPolicy.relaunch_overhead_seconds``): each expected reclaim
+inflates the effective price by the fraction of an hour it destroys.
+
+Constraint tiers, strongest first (docs/cost.md "Constraint tiers"):
+
+1. HARD preemption cooldowns (``SpotPlacer.preempted_placements``) —
+   zones that just burned are not spot candidates at all; with every
+   zone burned, the whole target falls back to on-demand.
+2. SLO burn (the LB-flushed ``slo_burn`` gauge, PR 15): page-level
+   burn forces on-demand top-up — only already-READY spot is kept,
+   all growth and every not-yet-ready slot lands on-demand; ticket-
+   level burn vetoes spot-ward rebalancing — the spot count may not
+   grow, but standing spot capacity is not churned.
+3. Economics — spot wins only where its overhead-adjusted price beats
+   the cheapest on-demand price.
+4. SOFT spreading (``SpotPlacer.spread_placements``) and cost
+   steering: non-cheapest zones become soft avoids, relaxed by the
+   launch path before it would strand a launch.
+
+The placer is deliberately stateless: ``plan()`` is a pure function
+of its inputs, so controller version refreshes need no rebuild and
+the digital twin's byte-identity gate holds for free.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.observability import slo as slo_lib
+from skypilot_tpu.serve import spec as spec_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.serve.costplane import catalog as fleet_catalog
+
+# A zone joins the preferred (cheapest) tier when its expected spot
+# cost is within this factor of the best zone's; everything pricier
+# becomes a soft avoid.
+PREFER_MARGIN = 1.05
+
+
+def expected_spot_cost_per_hour(
+        econ: 'fleet_catalog.ZoneEconomics',
+        relaunch_overhead_s: float) -> float:
+    """The pinned formula: spot price inflated by the expected
+    relaunch overhead — ``rate * overhead_s / 3600`` is the expected
+    fraction of each hour lost to reclaims."""
+    return econ.spot_price * (
+        1.0 + econ.preemption_rate_per_hour
+        * relaunch_overhead_s / 3600.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementPlan:
+    """One tick's placement decision — the twin's 'place' log row."""
+    target_spot: int
+    target_ondemand: int
+    # 'region/zone' strings, cheapest expected cost first: the zones
+    # spot launches should land in.
+    preferred_zones: Tuple[str, ...]
+    # (region, zone) soft avoids for spot launches: the incoming
+    # spread list plus every non-preferred (pricier) zone.
+    avoid_zones: Tuple[Tuple[str, str], ...]
+    reason: str
+    # Planned mix's expected price (per chip-hour units): informational
+    # — the twin's market bills actual slice lifetimes.
+    expected_cost_per_hour: float
+
+    def log_fields(self) -> Dict[str, object]:
+        return {
+            'spot': self.target_spot,
+            'ondemand': self.target_ondemand,
+            'preferred': list(self.preferred_zones),
+            'avoided': len(self.avoid_zones),
+            'expected_cost_per_hour':
+                round(self.expected_cost_per_hour, 6),
+            'reason': self.reason,
+        }
+
+
+class FleetPlacer:
+    def __init__(self, service_name: str,
+                 catalog: 'fleet_catalog.FleetCatalog', *,
+                 accelerator: Optional[str] = None) -> None:
+        self.service_name = service_name
+        self.catalog = catalog
+        # Pin the candidate universe to one generation when known
+        # (real fleets are homogeneous per service); None = every
+        # priced zone (the twin's injected catalogs).
+        self.accelerator = accelerator
+
+    def plan(self, target: int, policy: spec_lib.ReplicaPolicy,
+             replicas: Sequence[dict], *,
+             blocked: Sequence[Tuple[str, str]] = (),
+             avoid: Sequence[Tuple[str, str]] = (),
+             burn: Optional[float] = None) -> PlacementPlan:
+        """Split ``target`` into (spot, on-demand) + zone steering.
+
+        ``replicas`` are the live rows (the controller's sync
+        output); ``blocked``/``avoid`` are the spot placer's HARD and
+        SOFT tiers; ``burn`` defaults to the LB-flushed gauge.
+        """
+        target = max(0, target)
+        if burn is None:
+            burn = serve_state.get_slo_burn(self.service_name)
+        blocked_set = {tuple(b) for b in blocked}
+        overhead = policy.relaunch_overhead_seconds
+        zones = self.catalog.zones(self.accelerator)
+        candidates = [z for z in zones
+                      if (z.region, z.zone) not in blocked_set]
+        ranked = sorted(
+            candidates,
+            key=lambda z: (expected_spot_cost_per_hour(z, overhead),
+                           z.region, z.zone))
+        od_price = min((z.ondemand_price for z in zones), default=0.0)
+
+        current_spot = sum(1 for r in replicas if r.get('is_spot'))
+        ready_spot = sum(
+            1 for r in replicas if r.get('is_spot')
+            and r.get('status') == serve_state.ReplicaStatus.READY)
+
+        if not ranked:
+            spot = 0
+            why = 'all zones in preemption cooldown: on-demand'
+        elif (od_price > 0 and expected_spot_cost_per_hour(
+                ranked[0], overhead) >= od_price):
+            spot = 0
+            why = ('spot not cheaper after preemption overhead: '
+                   'on-demand')
+        else:
+            spot = target
+            best = ranked[0]
+            why = (f'spot@{best.region}/{best.zone} expected '
+                   f'{expected_spot_cost_per_hour(best, overhead):.4f}'
+                   f' < od {od_price:.4f}')
+
+        if burn >= slo_lib.PAGE.burn:
+            # Page-level burn: on-demand top-up. Only spot that is
+            # ALREADY serving keeps its slot; every launching slot
+            # and all growth goes on-demand until the page clears.
+            spot = min(spot, ready_spot)
+            why += f' | slo_burn={burn:g} page: on-demand top-up'
+        elif burn >= slo_lib.TICKET.burn:
+            # Ticket-level burn: no spot-ward rebalancing — standing
+            # spot stays (churning it would burn more budget), but
+            # the spot count may not grow.
+            spot = min(spot, current_spot)
+            why += f' | slo_burn={burn:g} ticket: spot growth vetoed'
+
+        spot = max(0, min(spot, target))
+        if ranked and spot:
+            floor = expected_spot_cost_per_hour(ranked[0], overhead)
+            preferred = tuple(
+                f'{z.region}/{z.zone}' for z in ranked
+                if expected_spot_cost_per_hour(z, overhead)
+                <= floor * PREFER_MARGIN)
+            pricier = [(z.region, z.zone) for z in ranked
+                       if f'{z.region}/{z.zone}' not in preferred]
+        else:
+            preferred = ()
+            pricier = []
+        avoid_all = _dedupe([tuple(a) for a in avoid] + pricier)
+        expected = 0.0
+        if spot and ranked:
+            expected += spot * expected_spot_cost_per_hour(
+                ranked[0], overhead)
+        expected += (target - spot) * od_price
+        return PlacementPlan(
+            target_spot=spot, target_ondemand=target - spot,
+            preferred_zones=preferred,
+            avoid_zones=tuple(avoid_all),
+            reason=why, expected_cost_per_hour=expected)
+
+
+def _dedupe(pairs: List[Tuple[str, str]]
+            ) -> List[Tuple[str, str]]:
+    seen = set()
+    out: List[Tuple[str, str]] = []
+    for p in pairs:
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+def fleet_cost_snapshot(cat: 'fleet_catalog.FleetCatalog',
+                        replicas: Sequence[dict]
+                        ) -> Dict[str, float]:
+    """Current billed rate of the live fleet: the controller's
+    per-tick gauge source (``fleet_cost_per_hour``/``spot_fraction``
+    in docs/observability.md)."""
+    cost = 0.0
+    spot = 0
+    for r in replicas:
+        cost += fleet_catalog.replica_cost_per_hour(cat, r)
+        if r.get('is_spot'):
+            spot += 1
+    n = len(replicas)
+    return {
+        'cost_per_hour': round(cost, 6),
+        'spot_fraction': round(spot / n, 6) if n else 0.0,
+    }

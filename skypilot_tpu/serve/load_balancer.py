@@ -59,6 +59,11 @@ logger = logging.getLogger(__name__)
 
 SYNC_INTERVAL_S = 1.0
 STATS_FLUSH_S = 2.0
+# How long a parked (scale-to-zero wake) request waits for capacity
+# before shedding — a full cold start is provision + weights + compile,
+# so this is minutes, not the retry-loop's seconds.
+WAKE_TIMEOUT_S = float(os.environ.get('SKY_TPU_LB_WAKE_TIMEOUT_S',
+                                      '600'))
 
 
 def _env_interval(name: str, default: float) -> float:
@@ -228,6 +233,14 @@ class LoadBalancer:
         '_slo_reload_tick': 'event-loop',
         '_slo_pending': 'event-loop',
         '_slo_dump_at': 'event-loop',
+        '_wake_cfg': 'event-loop',
+        '_wake_reload_tick': 'event-loop',
+        '_parked': 'event-loop',
+        '_parked_total': 'event-loop',
+        '_wake_started_t': 'event-loop',
+        '_cold_starts': 'event-loop',
+        '_cold_starts_total': 'event-loop',
+        '_cost_gauges': 'event-loop',
     }
 
     def __init__(self, service_name: str, policy_name: str, *,
@@ -338,6 +351,28 @@ class LoadBalancer:
         self._slo_pending: Set[str] = set()
         self._slo_dump_at = 0.0
         self.slo_transition_hook: Optional[Callable] = None
+        # Scale-to-zero parking (docs/cost.md "Scale to zero"): when
+        # the service declares `min_replicas: 0` + `wake_on_request`,
+        # a request arriving at an empty ready set parks in a bounded
+        # queue instead of bouncing off the 503 branch — the parked
+        # in-flight count IS the queue signal the autoscaler wakes the
+        # fleet on. Config piggybacks the sync tick's spec reload
+        # (same cadence as the SLO reload); None = parking off.
+        self._wake_cfg: Optional[dict] = None
+        self._wake_reload_tick = 0
+        self._parked: List[dict] = []
+        self._parked_total = 0
+        # Cold-start stopwatch: armed when the first request parks
+        # against an empty fleet, sampled when the ready set comes
+        # back — the client-experienced wake latency (provision +
+        # weights + compile + first readiness).
+        self._wake_started_t: Optional[float] = None
+        self._cold_starts: collections.deque = collections.deque(
+            maxlen=256)
+        self._cold_starts_total = 0
+        # Fleet economics gauges flushed by the controller
+        # (state.get_cost_gauges), refreshed on the sync tick.
+        self._cost_gauges: Optional[Dict[str, float]] = None
         self.breaker = retry_lib.CircuitBreaker(
             failure_threshold=int(os.environ.get(
                 'SKY_TPU_LB_BREAKER_THRESHOLD', '3')),
@@ -416,6 +451,9 @@ class LoadBalancer:
                     del self._replica_history[url]
                     self._history_tick.pop(url, None)
             await self._slo_tick(now)
+            await self._wake_tick()
+            self._cost_gauges = await self._offload(
+                serve_state.get_cost_gauges, self.service_name)
             await self._dump_breaker_edges()
         except Exception:  # noqa: BLE001 — keep serving on DB hiccup
             logger.warning('replica sync failed', exc_info=True)
@@ -625,6 +663,93 @@ class LoadBalancer:
             {u: list(r) for u, r in self._replica_history.items()})
         await self._offload(stepline_lib.write_dump_sync, spans)
 
+    # -- scale-to-zero parking (docs/cost.md "Scale to zero") --------------
+    def _new_waiter(self):  # holds: event-loop
+        """One parked request's wake handle. Seam: the digital twin
+        overrides this to hand out its kernel's SimFuture — the
+        trampoline rejects foreign awaitables, and parked requests
+        must suspend in virtual time."""
+        return asyncio.get_running_loop().create_future()
+
+    @staticmethod
+    def _resolve_waiter(waiter, value: bool) -> None:
+        if not waiter.done():
+            waiter.set_result(value)
+
+    async def _wake_tick(self) -> None:
+        """Riding the sync tick: reload the wake policy from the
+        service spec (same cadence as the SLO reload) and settle
+        parked requests — ALL of them wake the moment the ready set
+        is non-empty; expired ones shed. No per-request timers: the
+        tick is the timeout wheel, which is also what lets the twin
+        replay parking deterministically."""
+        if self._sync_tick >= self._wake_reload_tick:
+            record = await self._offload(
+                serve_state.get_service, self.service_name)
+            # Clock advances only after a successful read (the
+            # _load_slo rule): a DB hiccup retries next tick.
+            self._wake_reload_tick = (self._sync_tick
+                                      + self._SLO_RELOAD_TICKS)
+            pol = (((record or {}).get('spec') or {})
+                   .get('replica_policy') or {})
+            if (pol.get('min_replicas') == 0
+                    and pol.get('wake_on_request')):
+                self._wake_cfg = {
+                    'max_parked': max(1, int(
+                        pol.get('max_parked_requests') or 32))}
+            else:
+                self._wake_cfg = None
+        if not self._parked:
+            return
+        now = self._clock.monotonic()
+        if self.policy.ready_urls:
+            # Capacity is back: one cold-start sample per wake EVENT
+            # (not per parked request) — the stopwatch started when
+            # the first request parked against the empty fleet.
+            if self._wake_started_t is not None:
+                self._cold_starts.append(now - self._wake_started_t)
+                self._cold_starts_total += 1
+                self._wake_started_t = None
+            woke, self._parked = self._parked, []
+            for entry in woke:
+                self._resolve_waiter(entry['waiter'], True)
+            return
+        still: List[dict] = []
+        for entry in self._parked:
+            if now >= entry['deadline']:
+                self._resolve_waiter(entry['waiter'], False)
+            else:
+                still.append(entry)
+        self._parked = still
+
+    async def _park_for_wake(self, counted: bool = False) -> bool:
+        """Park the current request until the fleet wakes. True =
+        capacity arrived (re-select and serve); False = parking is
+        off, the queue is full, or the wake timed out (fall through
+        to the 503 branch). While parked the request counts as
+        in-flight — that gauge is exactly the queue signal
+        QueueLengthAutoscaler wakes a zero-replica fleet on.
+        ``counted``: the caller already holds an inflight increment
+        (the mid-retry path), so don't double-count the gauge."""
+        cfg = self._wake_cfg
+        if cfg is None or len(self._parked) >= cfg['max_parked']:
+            return False
+        now = self._clock.monotonic()
+        if self._wake_started_t is None and not self.policy.ready_urls:
+            self._wake_started_t = now
+        waiter = self._new_waiter()
+        self._parked.append({'waiter': waiter,
+                             'deadline': now + WAKE_TIMEOUT_S})
+        self._parked_total += 1
+        if not counted:
+            self._inflight += 1
+        try:
+            return bool(await waiter)
+        finally:
+            # The normal request path re-increments after selection.
+            if not counted:
+                self._inflight -= 1
+
     async def _stats_loop(self) -> None:
         while self._running:
             await asyncio.sleep(self.stats_flush_s)
@@ -793,6 +918,15 @@ class LoadBalancer:
         ttfts = sorted(self._ttfts)
         itls = sorted(self._itls)
         hist = self._history_gauges()
+        cold = sorted(self._cold_starts)
+        cost = self._cost_gauges or {}
+        cost_rate = float(cost.get('cost_per_hour') or 0.0)
+        tps_w = hist['tokens_per_sec']
+        # $/h over (tokens/s * 3600 s/h / 1000) = $ per 1k tokens;
+        # null until both a billed rate and a windowed token rate
+        # exist (an idle or unpriced fleet has no unit cost).
+        cost_per_1k = (round(cost_rate / (tps_w * 3.6), 6)
+                       if cost_rate > 0 and tps_w else None)
 
         def pct(vals, p: float):
             if not vals:
@@ -858,6 +992,18 @@ class LoadBalancer:
                 if self.slo is not None else 0),
             'slo_burn': (self.slo.page_burn(now)
                          if self.slo is not None else 0.0),
+            # Fleet cost plane (docs/cost.md): controller-flushed
+            # economics gauges + the LB-side unit cost and the
+            # scale-to-zero wake ledger. Zero/null until the cost
+            # plane prices the fleet.
+            'fleet_cost_per_hour': cost_rate,
+            'cost_per_1k_good_tokens': cost_per_1k,
+            'spot_fraction': float(cost.get('spot_fraction') or 0.0),
+            'cost_catalog_stale': int(cost.get('catalog_stale') or 0),
+            'parked_requests': len(self._parked),
+            'cold_starts_total': self._cold_starts_total,
+            'cold_start_p50_s': (round(pct(cold, 0.50), 3)
+                                 if cold else None),
         }
 
     def _select(self, tried: Set[str],
@@ -1204,6 +1350,37 @@ class LoadBalancer:
             headers[common.DEADLINE_HEADER] = f'{remaining:.3f}'
         return self._select(tried, affinity)
 
+    async def _next_url_or_wake(self, tried: Set[str],
+                                affinity: Optional[str],
+                                t_deadline: Optional[float],
+                                headers: Dict[str, str],
+                                splice) -> Optional[str]:
+        """Pre-stream retry target with the scale-to-zero fallback: a
+        request caught mid-retry while the fleet drains to zero (every
+        ready replica failed, NO tokens delivered) parks for the wake
+        instead of 502ing. Bounded: a stale ready set resolves parks
+        immediately, so a few park->reselect cycles may pass before
+        the sync loop catches up with reality — cap them so the
+        request can't orbit forever."""
+        url = self._next_url(tried, affinity, t_deadline, headers)
+        if url is not None or self._wake_cfg is None:
+            return url
+        if splice is not None and (splice.resp is not None
+                                   or splice.delivered
+                                   or splice.resumes):
+            return None   # mid-stream: resume needs a live leg NOW
+        for _ in range(4):
+            if (t_deadline is not None
+                    and self._clock.monotonic() >= t_deadline):
+                return None
+            if not await self._park_for_wake(counted=True):
+                return None
+            tried.clear()   # a woken fleet is a NEW fleet
+            url = self._next_url(tried, affinity, t_deadline, headers)
+            if url is not None:
+                return url
+        return None
+
     async def handle(self, request: web.Request) -> web.StreamResponse:
         if request.path == '/-/urls':   # introspection endpoint
             return web.json_response(
@@ -1287,6 +1464,12 @@ class LoadBalancer:
                 t_deadline = None   # the replica will 400 it
         tried: Set[str] = set()
         url = self._select(tried, affinity)
+        if url is None and self._wake_cfg is not None:
+            # Scale-to-zero wake (docs/cost.md): park instead of 503.
+            # A True wake means the ready set refilled — re-select;
+            # False (overflow/timeout) falls through to the shed path.
+            if await self._park_for_wake():
+                url = self._select(tried, affinity)
         if url is None:
             self._requests_no_replica += 1
             if tenant is not None:
@@ -1348,8 +1531,8 @@ class LoadBalancer:
                     self.breaker.record_failure(current)
                     tried.add(current)
                     last_cause, saturated = e.cause, None
-                    url = self._next_url(tried, affinity, t_deadline,
-                                         headers)
+                    url = await self._next_url_or_wake(
+                        tried, affinity, t_deadline, headers, splice)
                     if url is not None:
                         self._requests_retried += 1
                         logger.warning(
@@ -1360,8 +1543,8 @@ class LoadBalancer:
                     self.breaker.record_failure(current)
                     tried.add(current)
                     last_cause, saturated = e.cause, None
-                    url = self._next_url(tried, affinity, t_deadline,
-                                         headers)
+                    url = await self._next_url_or_wake(
+                        tried, affinity, t_deadline, headers, splice)
                     if url is not None:
                         if (splice.resp is not None
                                 or splice.delivered or splice.resumes):

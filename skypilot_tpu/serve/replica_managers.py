@@ -225,6 +225,11 @@ class ReplicaManager:
         '_terminating': 'owner',
         '_probe_ok_streak': 'owner',
     }
+    # ``placement_plan`` is deliberately NOT in the registry: it is
+    # lock-free by design. The tick writes it as a whole-object swap
+    # of a frozen plan and pool launch threads only read — attribute
+    # assignment is the atomic publish, so a launch racing a swap
+    # reads the previous coherent plan, never a torn one.
 
     def __init__(self, service_name: str, spec: spec_lib.ServiceSpec,
                  task_yaml: str, *,
@@ -245,6 +250,11 @@ class ReplicaManager:
         self._terminating: Dict[int, concurrent.futures.Future] = {}
         self._probe_ok_streak: Dict[int, int] = {}
         self.launch_failures = 0
+        # Cost-plane zone steering (docs/cost.md): the controller
+        # installs its latest FleetPlacer plan here; spot launches fold
+        # the plan's pricier-zone avoids into the spot placer's SOFT
+        # tier. None = cost plane off, spot placer steers alone.
+        self.placement_plan = None
 
     def update_version(self, spec: spec_lib.ServiceSpec,
                        task_yaml: str) -> None:
@@ -298,6 +308,15 @@ class ReplicaManager:
         if task.resources.use_spot:
             blocked = self.spot_placer.preempted_placements()
             avoid = self.spot_placer.spread_placements()
+            plan = self.placement_plan
+            if plan is not None:
+                # Cost steering rides the SOFT tier: pricier zones are
+                # avoided like already-occupied ones, and the launch
+                # path's existing relaxation drops them before it would
+                # strand a launch (docs/cost.md "Constraint tiers").
+                seen = set(avoid)
+                avoid = avoid + [z for z in plan.avoid_zones
+                                 if z not in seen]
         info = self.cloud.launch(task, cluster_name, blocked,
                                  avoid_placements=avoid)
         # Chaos seam: the torn crash window — the slice exists, the DB

@@ -94,6 +94,22 @@ class ReplicaPolicy:
     # downscales while the budget is burning. On by default — it only
     # engages when objectives exist.
     slo_burn_upscale: bool = True
+    # Cost-plane placement (docs/cost.md): the controller runs the
+    # FleetPlacer each tick, splitting the autoscaler's target into a
+    # per-zone spot/on-demand mix that minimizes expected $/good-token
+    # under the SLO burn constraints.
+    cost_optimized: bool = False
+    # Scale-to-zero (docs/cost.md "Scale to zero"): min_replicas: 0 is
+    # only serviceable with a wake policy — the LB parks arriving
+    # requests (bounded) while the autoscaler wakes the fleet.
+    wake_on_request: bool = False
+    # Park-queue bound: requests beyond this are shed with 503 while
+    # the fleet is waking (only meaningful with wake_on_request).
+    max_parked_requests: int = 32
+    # Expected serving time lost to one preemption (drain + relaunch +
+    # warm) — the overhead the placer's expected-cost formula weights
+    # by each zone's observed preemption rate.
+    relaunch_overhead_seconds: float = 180.0
 
     @classmethod
     def from_config(cls, config: Any) -> 'ReplicaPolicy':
@@ -128,9 +144,34 @@ class ReplicaPolicy:
                 config.get('dynamic_ondemand_fallback', False)),
             slo_burn_upscale=bool(
                 config.get('slo_burn_upscale', True)),
+            cost_optimized=bool(config.get('cost_optimized', False)),
+            wake_on_request=bool(config.get('wake_on_request', False)),
+            max_parked_requests=int(
+                config.get('max_parked_requests', 32)),
+            relaunch_overhead_seconds=float(
+                config.get('relaunch_overhead_seconds', 180.0)),
         )
         if pol.min_replicas < 0:
             raise exceptions.InvalidTaskError('min_replicas must be >= 0')
+        if pol.min_replicas == 0 and not pol.wake_on_request:
+            # A zero-floor fleet with no wake policy would park at zero
+            # replicas and silently never serve — reject at `serve up`
+            # instead of letting the service look healthy while dead.
+            raise exceptions.InvalidTaskError(
+                'min_replicas: 0 requires wake_on_request: true (a '
+                'scale-to-zero fleet needs a declared wake policy; '
+                'see docs/cost.md "Scale to zero")')
+        if pol.wake_on_request and pol.max_parked_requests < 1:
+            raise exceptions.InvalidTaskError(
+                'wake_on_request requires max_parked_requests >= 1 '
+                '(the park queue is how a wake completes)')
+        if pol.relaunch_overhead_seconds < 0:
+            raise exceptions.InvalidTaskError(
+                'relaunch_overhead_seconds must be >= 0')
+        if pol.cost_optimized and pol.use_ondemand_fallback:
+            raise exceptions.InvalidTaskError(
+                'cost_optimized and on-demand fallback both own the '
+                'spot/on-demand split; pick one')
         if (pol.max_replicas is not None
                 and pol.max_replicas < pol.min_replicas):
             raise exceptions.InvalidTaskError(

@@ -26,6 +26,8 @@ class ServiceStatus(enum.Enum):
     REPLICA_INIT = 'REPLICA_INIT'    # replicas launching, none ready yet
     READY = 'READY'                  # >=1 ready replica
     NO_REPLICA = 'NO_REPLICA'        # running but zero ready replicas
+    PARKED = 'PARKED'                # scaled to zero by policy; wakes
+    #                                  on the first parked request
     SHUTTING_DOWN = 'SHUTTING_DOWN'
     FAILED = 'FAILED'
 
@@ -124,7 +126,11 @@ CREATE TABLE IF NOT EXISTS lb_gauges (
     inflight INTEGER DEFAULT 0,
     queue_depth INTEGER DEFAULT 0,
     slo_burn REAL DEFAULT 0,
-    slo_burn_interval REAL DEFAULT 0
+    slo_burn_interval REAL DEFAULT 0,
+    cost_per_hour REAL DEFAULT 0,
+    cost_spot_fraction REAL DEFAULT 0,
+    cost_catalog_stale INTEGER DEFAULT 0,
+    cost_updated_at REAL DEFAULT 0
 );
 CREATE INDEX IF NOT EXISTS idx_replicas_service
     ON replicas (service_name);
@@ -169,6 +175,18 @@ def _db() -> db_util.Db:
             ('services', 'orphans_adopted',
              'ALTER TABLE services ADD COLUMN '
              'orphans_adopted INTEGER DEFAULT 0'),
+            ('lb_gauges', 'cost_per_hour',
+             'ALTER TABLE lb_gauges ADD COLUMN '
+             'cost_per_hour REAL DEFAULT 0'),
+            ('lb_gauges', 'cost_spot_fraction',
+             'ALTER TABLE lb_gauges ADD COLUMN '
+             'cost_spot_fraction REAL DEFAULT 0'),
+            ('lb_gauges', 'cost_catalog_stale',
+             'ALTER TABLE lb_gauges ADD COLUMN '
+             'cost_catalog_stale INTEGER DEFAULT 0'),
+            ('lb_gauges', 'cost_updated_at',
+             'ALTER TABLE lb_gauges ADD COLUMN '
+             'cost_updated_at REAL DEFAULT 0'),
         ])
         _migrated.add(db.path)
     return db
@@ -840,6 +858,49 @@ def get_slo_burn(service_name: str,
     if vclock.now() - row['updated_at'] > max_age_s:
         return 0.0
     return float(row['slo_burn'] or 0.0)
+
+
+def set_cost_gauges(service_name: str, cost_per_hour: float,
+                    spot_fraction: float,
+                    catalog_stale: bool = False) -> None:
+    """The controller's per-tick fleet-economics flush (docs/cost.md):
+    billed rate of the live fleet, its spot share, and whether the
+    price catalog is serving stale data. Writes its OWN freshness
+    stamp (``cost_updated_at``) — ``updated_at`` belongs to the LB's
+    queue-signal writers and must not be touched from the controller
+    side."""
+    conn = _db().conn
+    conn.execute(
+        'INSERT INTO lb_gauges (service_name, cost_updated_at, '
+        'cost_per_hour, cost_spot_fraction, cost_catalog_stale) '
+        'VALUES (?,?,?,?,?) ON CONFLICT(service_name) DO UPDATE SET '
+        'cost_updated_at=excluded.cost_updated_at, '
+        'cost_per_hour=excluded.cost_per_hour, '
+        'cost_spot_fraction=excluded.cost_spot_fraction, '
+        'cost_catalog_stale=excluded.cost_catalog_stale',
+        (service_name, vclock.now(), float(cost_per_hour),
+         float(spot_fraction), int(bool(catalog_stale))))
+    conn.commit()
+
+
+def get_cost_gauges(service_name: str,
+                    max_age_s: float = 900.0) -> Dict[str, float]:
+    """Latest fleet-economics gauges; zeros when stale (controller
+    down => no bill to report). The window is generous — the
+    controller tick is the writer and fleet cadences run coarse."""
+    row = _db().conn.execute(
+        'SELECT cost_per_hour, cost_spot_fraction, '
+        'cost_catalog_stale, cost_updated_at FROM lb_gauges WHERE '
+        'service_name = ?', (service_name,)).fetchone()
+    if (row is None or not row['cost_updated_at']
+            or vclock.now() - row['cost_updated_at'] > max_age_s):
+        return {'cost_per_hour': 0.0, 'spot_fraction': 0.0,
+                'catalog_stale': 0.0}
+    return {
+        'cost_per_hour': float(row['cost_per_hour'] or 0.0),
+        'spot_fraction': float(row['cost_spot_fraction'] or 0.0),
+        'catalog_stale': float(row['cost_catalog_stale'] or 0),
+    }
 
 
 def prune_stats(service_name: str, older_than: float) -> None:

@@ -73,12 +73,13 @@ class SimExecutor:
 class _Slice:
     __slots__ = ('cluster_name', 'url', 'region', 'zone', 'is_spot',
                  'accelerator', 'provisioned_at', 'alive', 'notice',
-                 'model')
+                 'model', 'created_at', 'billed')
 
     def __init__(self, cluster_name: str, url: str, region: str,
                  zone: str, is_spot: bool, accelerator: Optional[str],
                  provisioned_at: float,
-                 model: replica_lib.ModelReplica) -> None:
+                 model: replica_lib.ModelReplica,
+                 created_at: float = 0.0) -> None:
         self.cluster_name = cluster_name
         self.url = url
         self.region = region
@@ -89,6 +90,10 @@ class _Slice:
         self.alive = True
         self.notice = False
         self.model = model
+        # Billing meter (market model): clouds bill from provision
+        # START, and a slice is billed exactly once.
+        self.created_at = created_at
+        self.billed = False
 
 
 class VirtualCloud(replica_managers.CloudAdapter):
@@ -99,7 +104,10 @@ class VirtualCloud(replica_managers.CloudAdapter):
                  log: Callable[..., None],
                  zones: Optional[List[Tuple[str, str]]] = None,
                  provision_delay_s: Tuple[float, float] = (30.0, 90.0),
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 market: Optional[Dict[Tuple[str, str], dict]] = None,
+                 market_horizon_s: float = 0.0,
+                 reclaim_notice_s: float = 30.0) -> None:
         self.kernel = kern
         self.make_replica = make_replica
         self.log = log
@@ -110,6 +118,29 @@ class VirtualCloud(replica_managers.CloudAdapter):
         self.slices: Dict[str, _Slice] = {}
         self.by_url: Dict[str, _Slice] = {}
         self._ip = 0
+        # Spot-market model (docs/cost.md "The market week"): per-zone
+        # prices + a Poisson reclaim process. Every zone's reclaim
+        # event times are PRE-SAMPLED from a purpose-keyed RNG at
+        # construction, so the reclaim stream is a property of
+        # (seed, zone) alone — fleet state (how many launches have
+        # consumed self.rng) can never perturb it, which is what keeps
+        # the placer decision log byte-identical across replays.
+        self.market: Dict[Tuple[str, str], dict] = market or {}
+        self.reclaim_notice_s = reclaim_notice_s
+        self._billed = {'spot_cost': 0.0, 'ondemand_cost': 0.0,
+                        'spot_hours': 0.0, 'ondemand_hours': 0.0}
+        if self.market and market_horizon_s > 0:
+            for (region, zone) in sorted(self.market):
+                rate = float(self.market[(region, zone)]
+                             .get('reclaim_per_hour') or 0.0)
+                if rate <= 0:
+                    continue
+                zrng = random.Random(f'market/{seed}/{region}/{zone}')
+                t = zrng.expovariate(rate / 3600.0)
+                while t < market_horizon_s:
+                    self.kernel.call_later(t, self._market_reclaim,
+                                           region, zone)
+                    t += zrng.expovariate(rate / 3600.0)
         # Crash gate (kill-anywhere sweep): the twin installs a
         # callable invoked at each real crash window of a cloud-facing
         # operation — after the provider side-effect, before the
@@ -155,7 +186,8 @@ class VirtualCloud(replica_managers.CloudAdapter):
             accel = next(iter(task.resources.accelerators))
         s = _Slice(cluster_name, url, region, zone,
                    task.resources.use_spot, accel,
-                   self.kernel.now + delay, model)
+                   self.kernel.now + delay, model,
+                   created_at=self.kernel.now)
         self.slices[cluster_name] = s
         self.by_url[url] = s
         self.log('launch', cluster=cluster_name, zone=f'{region}/{zone}',
@@ -211,6 +243,7 @@ class VirtualCloud(replica_managers.CloudAdapter):
         if s is None:
             return
         self.by_url.pop(s.url, None)
+        self._bill(s)
         s.alive = False
         s.model.kill()
         self.log('terminate', cluster=cluster_name)
@@ -260,10 +293,72 @@ class VirtualCloud(replica_managers.CloudAdapter):
         s = self.slices.get(cluster_name)
         if s is None or not s.alive:
             return
+        self._bill(s)
         s.alive = False
         s.model.kill()
         self.log('reclaim_kill', cluster=cluster_name,
                  zone=f'{s.region}/{s.zone}')
+
+    def _market_reclaim(self, region: str, zone: str) -> None:
+        """One pre-sampled market reclaim event: the provider takes
+        back every live SPOT slice in the zone (capacity reclaims are
+        zone-correlated — that correlation is why the spot placer
+        spreads), each with the standard preemption notice lead.
+        On-demand capacity is never touched."""
+        victims = [s for s in self.live_slices()
+                   if s.is_spot and s.region == region
+                   and s.zone == zone]
+        if not victims:
+            return
+        self.log('market_reclaim', zone=f'{region}/{zone}',
+                 killed=len(victims))
+        for s in victims:
+            self.reclaim(s.cluster_name,
+                         notice_lead_s=self.reclaim_notice_s)
+
+    def _bill(self, s: _Slice) -> None:
+        """Close a slice's billing meter exactly once: lifetime
+        (provision start → now) times the zone's market price for its
+        pricing tier. Zones outside the market model bill $0 but
+        still count hours, so the utilization denominators stay
+        honest."""
+        if s.billed:
+            return
+        s.billed = True
+        hours = max(0.0, self.kernel.now - s.created_at) / 3600.0
+        econ = self.market.get((s.region, s.zone)) or {}
+        if s.is_spot:
+            self._billed['spot_hours'] += hours
+            self._billed['spot_cost'] += hours * float(
+                econ.get('spot') or 0.0)
+        else:
+            self._billed['ondemand_hours'] += hours
+            self._billed['ondemand_cost'] += hours * float(
+                econ.get('ondemand') or 0.0)
+
+    def billing(self) -> Dict[str, float]:
+        """Cumulative fleet bill at the current virtual instant,
+        including still-running slices (their meters are read, not
+        closed). The twin's $-saved-at-SLO gate compares this total
+        across the cost-optimized and all-on-demand runs."""
+        out = dict(self._billed)
+        for s in self.slices.values():
+            if s.billed:
+                continue
+            hours = max(0.0, self.kernel.now - s.created_at) / 3600.0
+            econ = self.market.get((s.region, s.zone)) or {}
+            if s.is_spot:
+                out['spot_hours'] += hours
+                out['spot_cost'] += hours * float(
+                    econ.get('spot') or 0.0)
+            else:
+                out['ondemand_hours'] += hours
+                out['ondemand_cost'] += hours * float(
+                    econ.get('ondemand') or 0.0)
+        out = {k: round(v, 6) for k, v in out.items()}
+        out['total_cost'] = round(
+            out['spot_cost'] + out['ondemand_cost'], 6)
+        return out
 
     def zone_outage(self, zone_suffix: str) -> int:
         """Kill every live slice in a zone (regional failover)."""

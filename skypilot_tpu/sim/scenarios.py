@@ -70,11 +70,27 @@ class Scenario:
     # Fleet shape (feeds the REAL ServiceSpec/ReplicaPolicy).
     replicas: int = 8
     max_replicas: Optional[int] = None
+    # Floor override: None keeps the historical behavior (floor ==
+    # ``replicas``); 0 + wake_on_request is the scale-to-zero shape.
+    min_replicas: Optional[int] = None
     queue_length_threshold: Optional[float] = None
     upscale_delay_s: float = 60.0
     downscale_delay_s: float = 600.0
     use_spot: bool = True
     lb_policy: str = 'round_robin'
+    # Cost plane (docs/cost.md): when ``cost_optimized`` the REAL
+    # FleetPlacer runs inside the twin's controller against a
+    # FleetCatalog built from ``market`` (per-(region, zone)
+    # {'ondemand', 'spot', 'reclaim_per_hour'} — prices per
+    # replica-hour, reclaims per slice-hour). The same market dict
+    # drives VirtualCloud's pre-sampled Poisson reclaim streams and
+    # its billing meters, market or not cost-optimized.
+    cost_optimized: bool = False
+    market: Optional[Dict[Tuple[str, str], Dict[str, float]]] = None
+    relaunch_overhead_s: float = 180.0
+    # Scale-to-zero (docs/cost.md "Scale to zero").
+    wake_on_request: bool = False
+    max_parked_requests: int = 32
     # Traffic (loadgen tenant spec; envelope shapes welcome).
     tenants: Dict[str, Dict[str, Any]] = dataclasses.field(
         default_factory=dict)
@@ -332,6 +348,82 @@ def fleet_storm_24h(*, replicas: int = 1000,
                       frac=0.2, notice_lead_s=240.0)])
 
 
+def spot_market_week(*, replicas: int = 6, days: float = 7.0,
+                     cost_optimized: bool = True,
+                     use_spot: bool = True) -> Scenario:
+    """THE cost-plane acceptance gate (docs/cost.md): a week of
+    diurnal traffic over a three-zone spot market with distinct
+    prices and reclaim intensities. Run cost-optimized (the REAL
+    FleetPlacer chooses the spot/on-demand mix per tick) and once
+    more all-on-demand (``cost_optimized=False, use_spot=False``,
+    same seed) — the gate asserts real dollars saved at SLO: billed
+    total well under the baseline, ZERO client-visible errors, ZERO
+    page-tier SLO alert transitions, and the placement decision log
+    byte-identical across same-seed replays.
+
+    Deliberately a FIXED-target fleet (no ``queue_length_threshold``):
+    the week-scale cadences (90s stats flush) sit far beyond the
+    inflight gauge's 30s staleness window, so a queue-length
+    autoscaler would always read zero here — the market mix, not the
+    replica count, is what this scenario exercises."""
+    day = 86400.0
+    duration = days * day + 3600.0
+    market = {
+        ('sim-r1', 'sim-r1-a'): {'ondemand': 10.0, 'spot': 3.0,
+                                 'reclaim_per_hour': 0.05},
+        ('sim-r1', 'sim-r1-b'): {'ondemand': 10.0, 'spot': 3.5,
+                                 'reclaim_per_hour': 0.12},
+        ('sim-r2', 'sim-r2-a'): {'ondemand': 11.0, 'spot': 4.2,
+                                 'reclaim_per_hour': 0.02},
+    }
+    return Scenario(
+        name='spot_market_week', replicas=replicas,
+        use_spot=use_spot, cost_optimized=cost_optimized,
+        market=market, relaunch_overhead_s=420.0,
+        zones=sorted(market),
+        duration_s=duration, traffic_start_s=1800.0,
+        controller_tick_s=120.0, lb_sync_s=120.0, stats_flush_s=90.0,
+        provision_delay_s=(120.0, 300.0), initial_delay_s=600.0,
+        tenants={'world': {
+            'rps': 0.03, 'prompt_mean': 32, 'prompt_max': 96,
+            'max_new': 8, 'until': days * day,
+            'envelope': {'kind': 'diurnal', 'period_s': day,
+                         'low': 0.25}}},
+        # Armed objectives make the zero-page gate non-vacuous: a
+        # placer that chases cheap spot into reclaim churn pages here.
+        slo=[{'metric': 'ttft_p99', 'threshold_s': 2.0,
+              'target': 0.99},
+             {'metric': 'availability', 'target': 0.999}])
+
+
+def scale_to_zero(*, duration_s: float = 7200.0) -> Scenario:
+    """Scale-to-zero lifecycle (docs/cost.md "Scale to zero"): the
+    fleet parks (min_replicas 0) before traffic arrives, the first
+    request parks in the LB's bounded wake queue, the inflight gauge
+    wakes the autoscaler, a replica cold-starts, the parked requests
+    drain, and after the burst the fleet parks again. Gates: at least
+    one real cold start sampled (park -> ready wall time), zero
+    client-visible errors, final service status PARKED.
+
+    ``stats_flush_s`` MUST stay under the inflight gauge's 30s
+    staleness window — a coarser cadence reads parked requests as
+    zero and the fleet never wakes."""
+    return Scenario(
+        name='scale_to_zero', replicas=1, max_replicas=3,
+        min_replicas=0, wake_on_request=True, max_parked_requests=32,
+        queue_length_threshold=4.0,
+        upscale_delay_s=15.0, downscale_delay_s=600.0,
+        duration_s=duration_s, traffic_start_s=2400.0,
+        controller_tick_s=15.0, lb_sync_s=10.0, stats_flush_s=20.0,
+        provision_delay_s=(30.0, 90.0), initial_delay_s=120.0,
+        # Trace times are RELATIVE to traffic_start_s: a 900s burst at
+        # t=2400..3300, then quiet — the fleet must be PARKED at both
+        # ends of the replay.
+        tenants={'jobs': {'rps': 0.2, 'prompt_mean': 24,
+                          'prompt_max': 64, 'max_new': 8,
+                          'until': 900.0}})
+
+
 SCENARIOS = {
     'reclaim_storm': reclaim_storm,
     'flash_crowd': flash_crowd,
@@ -343,4 +435,6 @@ SCENARIOS = {
     'crash_lb_mid_stream': crash_lb_mid_stream,
     'crash_sweep': crash_sweep,
     'fleet_storm_24h': fleet_storm_24h,
+    'spot_market_week': spot_market_week,
+    'scale_to_zero': scale_to_zero,
 }

@@ -21,6 +21,7 @@ import json
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.sim import kernel as kernel_lib
 from skypilot_tpu.sim import replica as replica_lib
 from skypilot_tpu.utils import common
 from skypilot_tpu.utils import failpoints
@@ -97,6 +98,12 @@ class TwinLoadBalancer(lb_lib.LoadBalancer):
     async def _offload(self, fn, *args):
         # One thread, one sqlite, deterministic order: run inline.
         return fn(*args)
+
+    def _new_waiter(self):
+        # Scale-to-zero parking: the kernel trampoline rejects foreign
+        # awaitables, so a parked request suspends on a SimFuture and
+        # resumes when the wake tick resolves it — in virtual time.
+        return kernel_lib.SimFuture()
 
     async def _fetch_all_metrics(self, urls: List[str]) -> List[tuple]:
         rows = []

@@ -60,6 +60,9 @@ class SimReport:
         self.decisions: List[Dict[str, Any]] = []
         self.records: List[Dict[str, Any]] = []
         self.lb_metrics: Dict[str, Any] = {}
+        # VirtualCloud billing totals (market scenarios): what the
+        # $-saved-at-SLO gate compares across runs.
+        self.cost: Dict[str, Any] = {}
         # End-of-replay control-plane convergence view (captured before
         # the scratch home is torn down): the crash gates compare a
         # killed run's final fleet against the unkilled baseline's.
@@ -134,6 +137,18 @@ class SimReport:
                          for d in self.slo_alerts)
 
     @property
+    def placements(self) -> List[Dict[str, Any]]:
+        """The FleetPlacer's per-tick decisions (cost-optimized
+        scenarios only; docs/cost.md)."""
+        return [d for d in self.decisions if d['kind'] == 'place']
+
+    def placement_log_jsonl(self) -> str:
+        """The placer decision log alone — the cost gate's
+        byte-identity surface (same seed ⇒ identical string)."""
+        return '\n'.join(json.dumps(d, sort_keys=True)
+                         for d in self.placements)
+
+    @property
     def client_errors(self) -> List[Dict[str, Any]]:
         """Client-visible failures: anything that neither completed
         nor was an orderly admission shed (the zero-errors gates
@@ -166,6 +181,9 @@ class SimReport:
             'client_retries': self.client_retries,
             'final_fleet': self.final_fleet,
             'scale_targets': self.scale_targets,
+            'placements': len(self.placements),
+            'cost': self.cost,
+            'cold_starts': self.lb_metrics.get('cold_starts_total'),
             'ready_replicas': self.lb_metrics.get('ready_replicas'),
             'lb_ttft_p50_s': self.lb_metrics.get('ttft_p50_s'),
             'lb_ttft_p99_s': self.lb_metrics.get('ttft_p99_s'),
@@ -263,13 +281,24 @@ class DigitalTwin:
 
     def _service_config(self) -> Dict[str, Any]:
         sc = self.sc
-        policy: Dict[str, Any] = {'min_replicas': sc.replicas}
+        floor = (sc.replicas if sc.min_replicas is None
+                 else sc.min_replicas)
+        policy: Dict[str, Any] = {'min_replicas': floor}
         if sc.max_replicas is not None:
             policy['max_replicas'] = sc.max_replicas
         if sc.queue_length_threshold is not None:
             policy['queue_length_threshold'] = sc.queue_length_threshold
         policy['upscale_delay_seconds'] = sc.upscale_delay_s
         policy['downscale_delay_seconds'] = sc.downscale_delay_s
+        # Cost plane + scale-to-zero (docs/cost.md): the REAL spec
+        # validation sees these — a scenario declaring min_replicas 0
+        # without a wake policy fails exactly like a user task would.
+        if sc.cost_optimized:
+            policy['cost_optimized'] = True
+            policy['relaunch_overhead_seconds'] = sc.relaunch_overhead_s
+        if sc.wake_on_request:
+            policy['wake_on_request'] = True
+            policy['max_parked_requests'] = sc.max_parked_requests
         config = {
             'readiness_probe': {
                 'path': '/health',
@@ -413,7 +442,9 @@ class DigitalTwin:
         self._crash_armed = False
         self._executor = cloud_lib.SimExecutor(self.kernel)
         self._controller = controller_lib.ServeController(
-            self.SERVICE, cloud=self._cloud, executor=self._executor)
+            self.SERVICE, cloud=self._cloud, executor=self._executor,
+            cost_catalog=getattr(self, '_cost_catalog', None))
+        self._controller.place_hook = self._on_place
         # Startup reconciliation, run TWICE: the second pass must be a
         # no-op (the idempotence half of the acceptance gate — rolled
         # into every killed replay, not just the unit test).
@@ -508,6 +539,11 @@ class DigitalTwin:
             raise ValueError(f'unknown fault kind {fault.kind!r}')
 
     # ---- control loops -------------------------------------------------
+    def _on_place(self, fields: Dict[str, Any]) -> None:
+        """Every FleetPlacer plan lands in the decision log — the
+        cost gate's byte-identity surface (docs/cost.md)."""
+        self._log('place', **fields)
+
     def _on_slo_transition(self, tr: Dict[str, Any]) -> None:
         """Alert transitions from the REAL burn-rate evaluator land
         in the decision log (the byte-identity surface): the
@@ -562,6 +598,8 @@ class DigitalTwin:
                 self.kernel.run()
                 if self._lb is not None:
                     self.report.lb_metrics = self._lb.lb_metrics()
+                if self._cloud is not None:
+                    self.report.cost = self._cloud.billing()
                 self.report.final_fleet = self._final_fleet()
         finally:
             if prev_home is None:
@@ -591,7 +629,10 @@ class DigitalTwin:
         transitional = (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
                         ReplicaStatus.STARTING, ReplicaStatus.DRAINING,
                         ReplicaStatus.SHUTTING_DOWN)
+        record = serve_state.get_service(self.SERVICE)
         return {
+            'service_status': (record['status'].value
+                               if record is not None else None),
             'ready': statuses.get('READY', 0),
             'transitional': sum(statuses.get(s.value, 0)
                                 for s in transitional),
@@ -621,14 +662,33 @@ class DigitalTwin:
         if not ok:
             raise RuntimeError('twin service row already exists — '
                                'scratch home is not scratch')
+        market = dict(sc.market or {})
         self._cloud = cloud_lib.VirtualCloud(
             self.kernel, make_replica=self._make_replica,
-            log=self._log, zones=sc.zones,
-            provision_delay_s=sc.provision_delay_s, seed=self.seed)
+            log=self._log,
+            zones=sc.zones or (sorted(market) or None),
+            provision_delay_s=sc.provision_delay_s, seed=self.seed,
+            market=market, market_horizon_s=sc.duration_s)
         self._cloud.crash_gate = self._crash_gate
+        # Cost-optimized scenarios run the REAL FleetPlacer against a
+        # catalog built from the same market the cloud bills — per
+        # replica-hour, accelerator-agnostic ('sim').
+        self._cost_catalog = None
+        if sc.cost_optimized:
+            from skypilot_tpu.serve import costplane
+            self._cost_catalog = costplane.FleetCatalog(entries=[
+                costplane.ZoneEconomics(
+                    accelerator='sim', region=region, zone=zone,
+                    ondemand_price=float(econ['ondemand']),
+                    spot_price=float(econ['spot']),
+                    preemption_rate_per_hour=float(
+                        econ.get('reclaim_per_hour') or 0.0))
+                for (region, zone), econ in sorted(market.items())])
         self._executor = cloud_lib.SimExecutor(self.kernel)
         self._controller = controller_lib.ServeController(
-            self.SERVICE, cloud=self._cloud, executor=self._executor)
+            self.SERVICE, cloud=self._cloud, executor=self._executor,
+            cost_catalog=self._cost_catalog)
+        self._controller.place_hook = self._on_place
         self._lb = transport_lib.TwinLoadBalancer(
             self.SERVICE, sc.lb_policy, clock=self.kernel.clock,
             model_by_url=self._model_by_url)

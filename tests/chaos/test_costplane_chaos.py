@@ -1,0 +1,95 @@
+"""Cost-plane failpoints (docs/robustness.md "Site catalog").
+
+Two sites, two degradation contracts:
+
+- ``serve.costplane.catalog_stale``: a catalog-feed outage must leave
+  the FleetCatalog serving its last-known prices with the ``stale``
+  gauge up — placement DEGRADES (older prices) but never stalls, and
+  recovery clears the gauge with fresh entries installed.
+- ``infer.server.compile_cache_miss``: a persistent-compile-cache
+  failure must fall back to a cold compile — slower first tokens,
+  never a crashed replica.
+"""
+import pytest
+
+from skypilot_tpu.serve.costplane import catalog as cost_catalog
+from skypilot_tpu.serve.costplane import placer as placer_lib
+from skypilot_tpu.utils import failpoints
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints._reset_for_tests()
+    yield
+    failpoints._reset_for_tests()
+
+
+def _entries(spot=3.0):
+    return [cost_catalog.ZoneEconomics(
+        accelerator='sim', region='r1', zone='r1-a',
+        ondemand_price=10.0, spot_price=spot,
+        preemption_rate_per_hour=0.05)]
+
+
+class _Policy:
+    min_replicas = 0
+    relaunch_overhead_seconds = 300.0
+
+
+def test_catalog_stale_degrades_to_last_known_prices(monkeypatch):
+    """An injected fetch outage: refresh() reports failure, the stale
+    gauge rises, the OLD prices keep answering, and the placer still
+    produces a plan — a dead catalog feed never stalls placement."""
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'serve.costplane.catalog_stale=error:1@2')
+    cat = cost_catalog.FleetCatalog(
+        entries=_entries(spot=3.0), fetcher=lambda: _entries(spot=4.0))
+    assert cat.refresh() is False
+    assert cat.refresh() is False
+    assert cat.stale and cat.fetch_failures == 2
+    assert failpoints.fired('serve.costplane.catalog_stale') == 2
+    # Last-known economics, not an empty catalog.
+    assert cat.price_per_hour('r1', 'r1-a', use_spot=True) == 3.0
+    plan = placer_lib.FleetPlacer('svc', cat).plan(
+        4, _Policy(), [], burn=0.0)
+    assert plan.target_spot + plan.target_ondemand == 4
+    # Budget exhausted: the fetch succeeds and the gauge clears with
+    # the FRESH prices installed.
+    assert cat.refresh() is True
+    assert not cat.stale
+    assert cat.price_per_hour('r1', 'r1-a', use_spot=True) == 4.0
+
+
+def test_catalog_fetcher_exception_never_raises():
+    """A real fetcher exception (no failpoint) takes the same
+    degradation path as the injected one."""
+    def _dead_fetcher():
+        raise ConnectionError('catalog feed down')
+    cat = cost_catalog.FleetCatalog(entries=_entries(),
+                                    fetcher=_dead_fetcher)
+    assert cat.refresh() is False
+    assert cat.stale and cat.fetch_failures == 1
+    assert cat.price_per_hour('r1', 'r1-a', use_spot=False) == 10.0
+
+
+def test_catalog_empty_fetch_counts_as_failure():
+    cat = cost_catalog.FleetCatalog(entries=_entries(),
+                                    fetcher=lambda: [])
+    assert cat.refresh() is False
+    assert cat.stale
+    assert cat.zones()   # last-known entries survive
+
+
+def test_compile_cache_miss_degrades_to_cold_compile(monkeypatch,
+                                                     tmp_path):
+    """The compile-cache failpoint: setup reports the miss and the
+    server boots with a cold compile instead of crashing."""
+    from skypilot_tpu.infer import server as server_lib
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'infer.server.compile_cache_miss=error:1@1')
+    assert server_lib.setup_compile_cache(str(tmp_path)) is False
+    assert failpoints.fired('infer.server.compile_cache_miss') == 1
+    # Budget spent: the next boot attaches the cache for real.
+    assert server_lib.setup_compile_cache(str(tmp_path)) is True

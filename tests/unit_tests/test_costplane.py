@@ -1,0 +1,270 @@
+"""Unit tests for the fleet cost plane (docs/cost.md).
+
+The twin gate (tests/sim/test_cost_gate.py) proves dollars saved at
+SLO end to end; these pin the pieces: the expected-cost formula, the
+placer's constraint tiers (preemption cooldowns, SLO burn force/veto,
+economics, soft spreading), plan purity, catalog lookup fallbacks,
+the scale-to-zero spec validation, and the cost-gauge round trip.
+"""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.observability import slo as slo_lib
+from skypilot_tpu.serve import spec as spec_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.serve.costplane import catalog as cost_catalog
+from skypilot_tpu.serve.costplane import placer as placer_lib
+
+
+def _zone(region='r1', zone='r1-a', od=10.0, spot=3.0, rate=0.05,
+          acc='sim'):
+    return cost_catalog.ZoneEconomics(
+        accelerator=acc, region=region, zone=zone,
+        ondemand_price=od, spot_price=spot,
+        preemption_rate_per_hour=rate)
+
+
+def _cat(*entries):
+    return cost_catalog.FleetCatalog(entries=list(entries))
+
+
+def _policy(**kw):
+    kw.setdefault('relaunch_overhead_seconds', 420.0)
+    return spec_lib.ReplicaPolicy(**kw)
+
+
+def _replica(status=serve_state.ReplicaStatus.READY, is_spot=True,
+             zone='r1/r1-a', acc='sim'):
+    return {'status': status, 'is_spot': is_spot, 'zone': zone,
+            'accelerator': acc}
+
+
+# ---- the pinned cost formula ----------------------------------------------
+
+def test_expected_spot_cost_formula():
+    # 3.0 * (1 + 0.05 * 420 / 3600) = 3.0175 — the docs/cost.md number.
+    z = _zone(spot=3.0, rate=0.05)
+    assert placer_lib.expected_spot_cost_per_hour(z, 420.0) == (
+        pytest.approx(3.0175))
+    # Zero overhead or zero rate: raw spot price.
+    assert placer_lib.expected_spot_cost_per_hour(z, 0.0) == 3.0
+    z0 = _zone(rate=0.0)
+    assert placer_lib.expected_spot_cost_per_hour(z0, 7200.0) == 3.0
+
+
+def test_high_preemption_rate_erases_spot_discount():
+    # 6.0 * (1 + 2.0 * 1800 / 3600) = 12.0 >= od 10.0: spot loses.
+    cat = _cat(_zone(od=10.0, spot=6.0, rate=2.0))
+    plan = placer_lib.FleetPlacer('svc', cat).plan(
+        4, _policy(relaunch_overhead_seconds=1800.0), [], burn=0.0)
+    assert plan.target_spot == 0
+    assert plan.target_ondemand == 4
+    assert 'not cheaper' in plan.reason
+
+
+# ---- constraint tiers ------------------------------------------------------
+
+def test_spot_wins_when_cheaper_and_burn_quiet():
+    cat = _cat(_zone())
+    plan = placer_lib.FleetPlacer('svc', cat).plan(
+        4, _policy(), [], burn=0.0)
+    assert (plan.target_spot, plan.target_ondemand) == (4, 0)
+    assert plan.preferred_zones == ('r1/r1-a',)
+    assert plan.expected_cost_per_hour == pytest.approx(4 * 3.0175)
+
+
+def test_all_zones_blocked_falls_back_to_ondemand():
+    cat = _cat(_zone(), _zone(zone='r1-b', spot=3.5))
+    plan = placer_lib.FleetPlacer('svc', cat).plan(
+        3, _policy(), [],
+        blocked=[('r1', 'r1-a'), ('r1', 'r1-b')], burn=0.0)
+    assert plan.target_spot == 0
+    assert plan.target_ondemand == 3
+    assert 'cooldown' in plan.reason
+
+
+def test_blocked_zone_excluded_but_others_serve():
+    cat = _cat(_zone(spot=3.0), _zone(zone='r1-b', spot=3.5))
+    plan = placer_lib.FleetPlacer('svc', cat).plan(
+        3, _policy(), [], blocked=[('r1', 'r1-a')], burn=0.0)
+    assert plan.target_spot == 3
+    assert plan.preferred_zones == ('r1/r1-b',)
+
+
+def test_page_burn_forces_ondemand_topup():
+    """Page-level burn: only already-READY spot keeps its slot;
+    launching spot and all growth lands on-demand."""
+    cat = _cat(_zone())
+    replicas = [
+        _replica(status=serve_state.ReplicaStatus.READY),
+        _replica(status=serve_state.ReplicaStatus.STARTING),
+        _replica(status=serve_state.ReplicaStatus.PROVISIONING),
+    ]
+    plan = placer_lib.FleetPlacer('svc', cat).plan(
+        6, _policy(), replicas, burn=slo_lib.PAGE.burn)
+    assert plan.target_spot == 1          # the one READY spot replica
+    assert plan.target_ondemand == 5
+    assert 'page: on-demand top-up' in plan.reason
+
+
+def test_ticket_burn_vetoes_spot_growth():
+    """Ticket-level burn: standing spot stays (no churn), but the
+    spot count may not grow."""
+    cat = _cat(_zone())
+    replicas = [_replica(), _replica(
+        status=serve_state.ReplicaStatus.STARTING)]
+    plan = placer_lib.FleetPlacer('svc', cat).plan(
+        5, _policy(), replicas, burn=slo_lib.TICKET.burn)
+    assert plan.target_spot == 2          # current spot, frozen
+    assert plan.target_ondemand == 3
+    assert 'ticket: spot growth vetoed' in plan.reason
+
+
+def test_burn_defaults_to_state_gauge():
+    name = 'costplane-burn-gauge'
+    cat = _cat(_zone())
+    serve_state.set_slo_burn(name, 20.0)
+    try:
+        plan = placer_lib.FleetPlacer(name, cat).plan(
+            4, _policy(), [])
+        assert plan.target_spot == 0
+        assert 'page' in plan.reason
+    finally:
+        serve_state.set_slo_burn(name, 0.0)
+
+
+def test_soft_spreading_prefers_cheapest_tier():
+    # r1-a 3.0175; r1-b 3.0276 (within 5%); r2-a 5.029 (avoided).
+    cat = _cat(_zone(spot=3.0), _zone(zone='r1-b', spot=3.01),
+               _zone(region='r2', zone='r2-a', spot=5.0, od=11.0))
+    plan = placer_lib.FleetPlacer('svc', cat).plan(
+        4, _policy(), [], avoid=[('r9', 'r9-a')], burn=0.0)
+    assert plan.preferred_zones == ('r1/r1-a', 'r1/r1-b')
+    # Incoming spread avoids first, then the pricier zone — deduped.
+    assert plan.avoid_zones == (('r9', 'r9-a'), ('r2', 'r2-a'))
+
+
+def test_plan_is_pure_and_deterministic():
+    cat = _cat(_zone(), _zone(zone='r1-b', spot=3.5))
+    placer = placer_lib.FleetPlacer('svc', cat)
+    a = placer.plan(4, _policy(), [_replica()], burn=0.0)
+    b = placer.plan(4, _policy(), [_replica()], burn=0.0)
+    assert a == b
+    assert a.log_fields() == b.log_fields()
+
+
+def test_zero_and_negative_targets():
+    cat = _cat(_zone())
+    plan = placer_lib.FleetPlacer('svc', cat).plan(
+        0, _policy(), [], burn=0.0)
+    assert (plan.target_spot, plan.target_ondemand) == (0, 0)
+    plan = placer_lib.FleetPlacer('svc', cat).plan(
+        -3, _policy(), [], burn=0.0)
+    assert (plan.target_spot, plan.target_ondemand) == (0, 0)
+
+
+# ---- catalog lookups -------------------------------------------------------
+
+def test_catalog_seed_has_priced_zones_with_preemption_rates():
+    cat = cost_catalog.FleetCatalog('gcp')
+    zones = cat.zones('v5e')
+    assert zones, 'bundled gcp catalog must price v5e zones'
+    assert all(z.ondemand_price > z.spot_price > 0 for z in zones)
+    # The seeded preemption CSV joins in: at least one zone carries a
+    # measured (non-default) rate.
+    assert any(z.preemption_rate_per_hour
+               != cost_catalog.DEFAULT_PREEMPTION_RATE for z in zones)
+
+
+def test_catalog_region_representative_fallback():
+    cat = _cat(_zone(acc='v5e'))
+    # Exact zone hit.
+    assert cat.economics('r1', 'r1-a', 'v5e').spot_price == 3.0
+    # Sibling zone in a priced region: the regional price applies.
+    assert cat.economics('r1', 'r1-z', 'v5e').spot_price == 3.0
+    # Unpriced region: None, and the rate query degrades to default.
+    assert cat.economics('r9', 'r9-a', 'v5e') is None
+    assert cat.preemption_rate('r9', 'r9-a') == (
+        cost_catalog.DEFAULT_PREEMPTION_RATE)
+
+
+def test_parse_accelerator():
+    assert cost_catalog.parse_accelerator('v5e-16') == ('v5e', 16)
+    assert cost_catalog.parse_accelerator(None) == (None, 1)
+    # The twin's modeled accelerators pass through whole.
+    assert cost_catalog.parse_accelerator('sim') == ('sim', 1)
+
+
+def test_replica_cost_per_hour_and_snapshot():
+    cat = _cat(_zone())
+    rows = [_replica(is_spot=True), _replica(is_spot=False),
+            {'zone': None, 'is_spot': False}]   # unpriceable: 0.0
+    assert cost_catalog.replica_cost_per_hour(cat, rows[0]) == 3.0
+    assert cost_catalog.replica_cost_per_hour(cat, rows[1]) == 10.0
+    assert cost_catalog.replica_cost_per_hour(cat, rows[2]) == 0.0
+    snap = placer_lib.fleet_cost_snapshot(cat, rows)
+    assert snap == {'cost_per_hour': 13.0,
+                    'spot_fraction': pytest.approx(1 / 3)}
+    assert placer_lib.fleet_cost_snapshot(cat, []) == {
+        'cost_per_hour': 0.0, 'spot_fraction': 0.0}
+
+
+def test_catalog_rejects_empty_install():
+    with pytest.raises(ValueError):
+        cost_catalog.FleetCatalog(entries=[])
+
+
+# ---- spec validation -------------------------------------------------------
+
+def test_min_replicas_zero_requires_wake_policy():
+    with pytest.raises(exceptions.InvalidTaskError,
+                       match='wake_on_request'):
+        spec_lib.ReplicaPolicy.from_config({'min_replicas': 0})
+    pol = spec_lib.ReplicaPolicy.from_config(
+        {'min_replicas': 0, 'max_replicas': 2,
+         'queue_length_threshold': 4.0, 'wake_on_request': True})
+    assert pol.min_replicas == 0 and pol.wake_on_request
+
+
+def test_wake_policy_needs_park_capacity():
+    with pytest.raises(exceptions.InvalidTaskError,
+                       match='max_parked_requests'):
+        spec_lib.ReplicaPolicy.from_config(
+            {'min_replicas': 1, 'wake_on_request': True,
+             'max_parked_requests': 0})
+
+
+def test_cost_optimized_conflicts_with_ondemand_fallback():
+    with pytest.raises(exceptions.InvalidTaskError, match='pick one'):
+        spec_lib.ReplicaPolicy.from_config(
+            {'min_replicas': 1, 'cost_optimized': True,
+             'dynamic_ondemand_fallback': True})
+
+
+def test_negative_relaunch_overhead_rejected():
+    with pytest.raises(exceptions.InvalidTaskError,
+                       match='relaunch_overhead_seconds'):
+        spec_lib.ReplicaPolicy.from_config(
+            {'min_replicas': 1, 'relaunch_overhead_seconds': -1})
+
+
+# ---- cost gauges round trip ------------------------------------------------
+
+def test_cost_gauges_round_trip_and_staleness():
+    from skypilot_tpu.utils import vclock
+    name = 'costplane-gauges'
+    clk = vclock.VirtualClock(start=5000.0)
+    with vclock.installed(clk):
+        serve_state.set_cost_gauges(name, 12.5, 0.75,
+                                    catalog_stale=True)
+        g = serve_state.get_cost_gauges(name)
+        assert g == {'cost_per_hour': 12.5, 'spot_fraction': 0.75,
+                     'catalog_stale': 1.0}
+        # Stale window: zeros, never a phantom bill.
+        clk.advance_to(5000.0 + 901.0)
+        g = serve_state.get_cost_gauges(name)
+        assert g['cost_per_hour'] == 0.0
+    # Unknown service: zeros.
+    assert serve_state.get_cost_gauges('costplane-nope') == {
+        'cost_per_hour': 0.0, 'spot_fraction': 0.0,
+        'catalog_stale': 0.0}

@@ -470,6 +470,10 @@ def _full_lb_metrics():
         'engine_tokens_per_sec_w': 100.0, 'prefix_hit_rate_w': 0.5,
         'history_window_s': 60.0, 'slo_alerts_firing': 0,
         'slo_burn': 0.0, 'slo': ev.gauges(600.0),
+        'fleet_cost_per_hour': 12.4,
+        'cost_per_1k_good_tokens': 0.0031, 'spot_fraction': 0.8,
+        'cost_catalog_stale': 0, 'parked_requests': 0,
+        'cold_starts_total': 2, 'cold_start_p50_s': 84.0,
         'draining': ['http://r2:1'],
         'tenants': {'web': {'requests_total': 5, 'requests_shed': 1,
                             'requests_failed': 0,
