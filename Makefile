@@ -6,7 +6,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke sim-crash-sweep slo-smoke cost-smoke
+.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke sim-crash-sweep slo-smoke cost-smoke integrity-smoke golden-refresh
 
 lint:
 	$(PYTHON) -m skypilot_tpu.client.cli lint --changed
@@ -69,3 +69,19 @@ sim-crash-sweep:
 # fail on any page alert, any client-visible error, or zero savings.
 cost-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.serve.costplane
+
+# Data-integrity smoke (docs/robustness.md "Data integrity"): replay
+# the sdc_storm scenario in the digital twin — token-flip and NaN
+# corruption mid-traffic — and assert detect → quarantine → replace
+# with zero wrong tokens in completed streams; then replay the
+# brownout scenario with probes armed and assert zero false
+# quarantines (slow is not corrupt).
+integrity-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.observability.integrity
+
+# Re-mint the golden-probe fixture store
+# (skypilot_tpu/observability/golden_probes.json) after a model,
+# tokenizer, or sim-oracle change. A stale golden refuses to ARM
+# (StaleGoldenError) instead of quarantining the whole fleet.
+golden-refresh:
+	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.observability.integrity --refresh

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from typing import Optional
 
 import click
@@ -1054,6 +1055,16 @@ def serve_status(service_name: Optional[str]) -> None:
             click.echo(fmt.format(r['replica_id'], r['cluster_name'],
                                   r['status'], r['version'],
                                   r['url'] or '-'))
+            # Integrity quarantine (docs/robustness.md "Data
+            # integrity"): say WHY and for how long — the reason
+            # column survives the drain-and-replace transitions.
+            if r.get('quarantine_reason'):
+                age = ''
+                if r.get('quarantined_at'):
+                    age = (f', {time.time() - r["quarantined_at"]:.0f}s'
+                           f' ago')
+                click.echo(f'       !! quarantined: '
+                           f'{r["quarantine_reason"]}{age}')
 
 
 @cli.group()

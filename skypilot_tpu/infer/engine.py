@@ -35,6 +35,7 @@ import dataclasses
 import itertools
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -193,6 +194,18 @@ class EngineConfig:
     # tenant -> relative weight for 'wfq' (unknown tenants weigh 1.0).
     # A mapping in a frozen dataclass: treat as immutable.
     tenant_weights: Optional[Any] = None
+    # On-device SDC sentinel (docs/robustness.md "Data integrity"): a
+    # jnp.isfinite reduction over each step's logits rides the
+    # existing readback pair as one extra int32 row — no extra
+    # device->host transfer, no new compiled programs (the flag is a
+    # trace-time branch inside the SAME pinned program set). A NaN/inf
+    # hit finishes the slot with reason 'sdc', marks the engine
+    # integrity_suspect (one-way; /health flips to 503 "corrupt") and
+    # fires an 'sdc' stepline anomaly dump. Greedy outputs and
+    # decode_steps are BIT-IDENTICAL sentinel on vs off — the row is
+    # appended after the token rows, so every consume index is
+    # unchanged.
+    sdc_sentinel: bool = True
 
 
 @dataclasses.dataclass
@@ -431,6 +444,11 @@ class InferenceEngine:
         # writer's condition (LOCK_ORDER stays leaf-level).
         '_stepline': '_lock',
         '_pending_dumps': '_lock',
+        # SDC sentinel: consume bumps under the lock; metrics reads
+        # under it. (_integrity_suspect itself is a GIL-atomic one-way
+        # bool like the server's ready/dead flags — readers tolerate
+        # one stale step.)
+        '_sdc_events': '_lock',
     }
 
     def __init__(self, config: llama.LlamaConfig, params: llama.Params,
@@ -627,6 +645,16 @@ class InferenceEngine:
         self._spec_drafted = 0
         self._spec_accepted = 0
         self._spec_emitted = 0
+        # ---- SDC sentinel state -----------------------------------------
+        # _sentinel gates the trace-time branch that appends the
+        # finite-flags row to decode/mixed/verify outputs; immutable
+        # after init (compiled programs bake it in). _integrity_suspect
+        # is a one-way GIL-atomic flag (the server's ready/dead rule):
+        # flipped by the engine thread on the first NaN/inf hit, read
+        # lock-free by /health and /generate admission.
+        self._sentinel = bool(self.ecfg.sdc_sentinel)
+        self._integrity_suspect = False
+        self._sdc_events = 0
         # Wall-clock sweeps (deadline / cancel) read the LOCAL clock;
         # the multihost lockstep driver disables them — every host must
         # make identical request-state decisions each tick.
@@ -669,6 +697,17 @@ class InferenceEngine:
                 kw['out_shardings'] = out
             return jax.jit(fn, **kw)
 
+        def _finite_row(logits):
+            # SDC sentinel row: per-slot "every logit finite" flags,
+            # reduced over every non-slot axis (vocab, plus the
+            # candidate axis in verify) ON DEVICE — int32 so the row
+            # stacks with the token rows and rides the existing
+            # readback, costing zero extra transfers. Appended LAST so
+            # every existing consume index is unchanged.
+            axes = tuple(range(1, logits.ndim))
+            return jnp.all(jnp.isfinite(logits),
+                           axis=axes).astype(jnp.int32)
+
         def _accept(tokens, logits, drafts, draft_len, key, temps,
                     active, lengths):
             # Shared tail of both verify programs: exact-greedy draft
@@ -692,6 +731,9 @@ class InferenceEngine:
             pair = jnp.concatenate(
                 [tokens[:, :1].T.astype(jnp.int32), emitted.T,
                  accepted[None].astype(jnp.int32)], axis=0)
+            if self._sentinel:
+                pair = jnp.concatenate(
+                    [pair, _finite_row(logits)[None]], axis=0)
             return pair, new_last, lengths + bump
 
         if self.ecfg.paged:
@@ -715,7 +757,10 @@ class InferenceEngine:
                 sampled = sampling_lib.sample(logits, key, temps,
                                               top_k=self.ecfg.top_k)
                 toks_out = jnp.where(active, sampled, tokens)
-                return jnp.stack([tokens, toks_out]), new_cache
+                rows = [tokens, toks_out]
+                if self._sentinel:
+                    rows.append(_finite_row(logits))
+                return jnp.stack(rows), new_cache
             self._decode = _jit(_decode_paged, donate=(0,))
 
             def _free_paged(kv_cache, slot):
@@ -761,7 +806,17 @@ class InferenceEngine:
                 sampled = sampling_lib.sample(dec_logits, key, temps,
                                               top_k=self.ecfg.top_k)
                 toks_out = jnp.where(active, sampled, last1)
-                return jnp.stack([last1, toks_out]), new_cache
+                rows = [last1, toks_out]
+                if self._sentinel:
+                    # The chunk slot's flag folds in the chunk logits
+                    # too — a NaN in the fused prefill half must not
+                    # hide behind a clean decode half.
+                    flags = _finite_row(dec_logits)
+                    chunk_ok = jnp.all(jnp.isfinite(
+                        chunk_logits)).astype(jnp.int32)
+                    flags = flags.at[slot].set(flags[slot] * chunk_ok)
+                    rows.append(flags)
+                return jnp.stack(rows), new_cache
             self._mixed = _jit(_mixed_paged, donate=(0,))
 
             if self.ecfg.prefix_cache:
@@ -805,7 +860,10 @@ class InferenceEngine:
                 # sampled token of any slot that finished prefill this
                 # step), row 1 the new tokens — ONE host read serves
                 # both.
-                return jnp.stack([tokens, toks_out]), new_cache
+                rows = [tokens, toks_out]
+                if self._sentinel:
+                    rows.append(_finite_row(logits))
+                return jnp.stack(rows), new_cache
             self._decode = _jit(
                 _decode, donate=(0,),
                 out=(self._rep_sharding, self._cache_sharding))
@@ -845,7 +903,14 @@ class InferenceEngine:
                 sampled = sampling_lib.sample(dec_logits, key, temps,
                                               top_k=self.ecfg.top_k)
                 toks_out = jnp.where(active, sampled, last1)
-                return jnp.stack([last1, toks_out]), new_cache
+                rows = [last1, toks_out]
+                if self._sentinel:
+                    flags = _finite_row(dec_logits)
+                    chunk_ok = jnp.all(jnp.isfinite(
+                        chunk_logits)).astype(jnp.int32)
+                    flags = flags.at[slot].set(flags[slot] * chunk_ok)
+                    rows.append(flags)
+                return jnp.stack(rows), new_cache
             self._mixed = _jit(
                 _mixed_dense, donate=(0,),
                 out=(self._rep_sharding, self._cache_sharding))
@@ -1975,11 +2040,26 @@ class InferenceEngine:
             t_bk = time.perf_counter()
             self._sl_readback += t_bk - t_rb
         now = time.time()
+        bad: set = set()
+        if self._sentinel:
+            # Sentinel row (appended LAST — all token-row indices are
+            # unchanged): flag 0 = this step produced non-finite
+            # logits for that slot. The failpoint simulates a device
+            # NaN on hosts without a corruptible chip.
+            flags = pair_host[pair_host.shape[0] - 1]
+            try:
+                failpoints.hit('infer.engine.sdc_nan')
+            except failpoints.FailpointError:
+                flags = np.zeros_like(flags)
+            bad = {s for s in range(flags.shape[0]) if not flags[s]}
         touched: List[Request] = []
         with self._lock:
             for slot, req in prefilled:
                 if req is None or req.done or self._slots[slot] is not req:
                     continue   # finished/preempted since dispatch
+                if slot in bad:
+                    self._sdc_hit(slot, req)
+                    continue
                 first = int(pair_host[0, slot])
                 if req.first_token_at is None:
                     req.first_token_at = now
@@ -2002,6 +2082,10 @@ class InferenceEngine:
                     if (req is None or req.done
                             or self._slots[slot] is not req):
                         continue   # stale-by-one: post-finish dropped
+                    if slot in bad:
+                        # Drop the garbage token; tear the slot down.
+                        self._sdc_hit(slot, req)
+                        continue
                     token = int(pair_host[1, slot])
                     req.output_tokens.append(token)
                     self._slot_len[slot] += 1
@@ -2012,7 +2096,7 @@ class InferenceEngine:
                         self._finish(slot, req)
             else:
                 self._consume_verify(pair_host, decoded, spec_r,
-                                     touched)
+                                     touched, bad)
         for req in touched:
             if not req.done:       # _finish already notified
                 req._notify()
@@ -2020,7 +2104,7 @@ class InferenceEngine:
             self._sl_drain += time.perf_counter() - t_bk
 
     def _consume_verify(self, pair_host, decoded, spec_r,
-                        touched) -> None:  # holds: _lock
+                        touched, bad=()) -> None:  # holds: _lock
         """Verify-pair bookkeeping: emit the accepted run plus the
         corrected token ONE token at a time through the exact same
         finish ladder as plain decode — eos / max_tokens / cache_full
@@ -2034,6 +2118,9 @@ class InferenceEngine:
                 0, self._inflight_tok[slot] - (dl + 1))
             if req is None or req.done or self._slots[slot] is not req:
                 continue   # stale-by-one: post-finish tokens dropped
+            if slot in bad:
+                self._sdc_hit(slot, req)
+                continue
             accepted = min(int(pair_host[spec_r + 1, slot]), dl)
             if dl > 0:
                 # Only DRAFTING lanes feed the speculation gauges: a
@@ -2071,6 +2158,45 @@ class InferenceEngine:
                 # loses this slot's reference).
                 self.allocator.shrink(slot,
                                       int(self._slot_len[slot]) + 1)
+
+    def _sdc_hit(self, slot: int, req: Request) -> None:  # holds: _lock
+        """Non-finite logits observed for a live slot: the garbage
+        token is never appended; the request finishes with reason
+        'sdc'; the engine flips integrity_suspect (ONE-WAY — the
+        server's /health turns 503 "corrupt", admission sheds with the
+        quarantined marker, and the control plane's golden-probe loop
+        quarantines and replaces the replica). An 'sdc' anomaly dump
+        snapshots the flight recorder around the hit."""
+        self._sdc_events += 1
+        self._integrity_suspect = True
+        self._note_anomaly('sdc', {
+            'slot': slot, 'request_id': req.request_id,
+            'tenant': req.tenant})
+        self._finish_early(slot, req, 'sdc')
+
+    def integrity_suspect(self) -> bool:
+        """One-way corruption verdict (the /health + admission read).
+        Lock-free on purpose: a GIL-atomic bool read, one stale step
+        tolerated — the same contract as the server's ready/dead
+        flags."""
+        return self._integrity_suspect
+
+    def output_digest(self) -> int:
+        """Order-independent-free digest of live decode state: a
+        stable CRC over each active slot's (request id, output
+        tokens), slot-ordered. The multihost lockstep driver
+        all-gathers this each tick and fails the slice loudly on any
+        mismatch (a desynced host is SDC at slice scope — diverged
+        tokens must never stream). zlib.crc32, never builtin hash()
+        (per-process salted)."""
+        with self._lock:
+            parts = []
+            for slot, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                parts.append(f'{slot}:{req.request_id}:'
+                             f'{",".join(map(str, req.output_tokens))}')
+        return zlib.crc32(';'.join(parts).encode())
 
     def _drain_inflight(self) -> None:
         """Consume every in-flight step (host state catches up to the
@@ -2388,7 +2514,9 @@ class InferenceEngine:
                 stepline_steps=(self._stepline.steps.total
                                 if self._sl_on else 0),
                 stepline_dumps=(self._stepline.dumps
-                                if self._sl_on else 0))
+                                if self._sl_on else 0),
+                sdc_events=self._sdc_events,
+                integrity_suspect=self._integrity_suspect)
             return (list(self._ttfts), list(self._queue_waits),
                     self._sched.snapshot(), counters,
                     self.prefix.stats() if self.prefix is not None
@@ -2477,6 +2605,13 @@ class InferenceEngine:
             # `sky-tpu profile` may list fewer after a storm).
             'stepline_steps': c['stepline_steps'],
             'stepline_dumps': c['stepline_dumps'],
+            # Data-integrity plane (docs/robustness.md "Data
+            # integrity"): on-device sentinel hits and the one-way
+            # corruption verdict ('ok'/'suspect' — a state set in the
+            # Prometheus rendering, never a numeric sample).
+            'sdc_events_total': c['sdc_events'],
+            'integrity': ('suspect' if c['integrity_suspect']
+                          else 'ok'),
             **({'paged': True,
                 'page_size': self.allocator.page_size,
                 'pages_total': self.allocator.n_pages,
@@ -2656,6 +2791,13 @@ class EnginePool:
             return on[0]
         return {'enabled': True, 'tiers': on}
 
+    def integrity_suspect(self) -> bool:
+        return any(e.integrity_suspect() for e in self.engines)
+
+    def output_digest(self) -> int:
+        return zlib.crc32(','.join(
+            str(e.output_digest()) for e in self.engines).encode())
+
     def idle(self) -> bool:
         return all(e.idle() for e in self.engines)
 
@@ -2775,6 +2917,13 @@ class EnginePool:
                                   for t in tiers),
             'stepline_dumps': sum(t.get('stepline_dumps', 0)
                                   for t in tiers),
+            # Integrity: one suspect tier poisons the whole pool (the
+            # tiers share a chip — corruption is a device property).
+            'sdc_events_total': sum(t.get('sdc_events_total', 0)
+                                    for t in tiers),
+            'integrity': ('suspect' if any(
+                t.get('integrity') == 'suspect' for t in tiers)
+                else 'ok'),
             'tiers': [{'max_seq_len': e.ecfg.max_seq_len,
                        'n_slots': e.ecfg.n_slots, **t}
                       for e, t in zip(self.engines, tiers)],

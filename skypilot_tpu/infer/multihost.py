@@ -260,8 +260,45 @@ class MultihostEngineDriver:
         if msg.get('stop'):
             return False
         self.engine.step()
+        if self.world > 1 and hasattr(self.engine, 'output_digest'):
+            # Desync detection (docs/robustness.md "Data integrity"):
+            # every host's request state is supposed to be a pure
+            # function of the broadcast order — all-gather a digest of
+            # it each tick and fail the slice LOUDLY on any mismatch.
+            # A diverged host is SDC at slice scope; streaming its
+            # tokens is the one outcome this check forbids. The raise
+            # rides run()'s catch-everything → os._exit(42) → the
+            # replica manager relaunches the slice (slice-level
+            # quarantine).
+            self._collective_since = time.monotonic()
+            try:
+                digests = self._gather_digests(
+                    int(self.engine.output_digest()))
+            finally:
+                self._collective_since = None
+            self._check_digests(digests)
         self._last_tick = time.monotonic()
         return True
+
+    def _gather_digests(self, digest: int) -> List[int]:
+        """All-gather this host's output digest (one uint32 per host —
+        a fixed-shape collective, same transport rules as the
+        submission broadcast)."""
+        from jax.experimental import multihost_utils
+        out = multihost_utils.process_allgather(
+            np.array([digest], np.uint32))
+        return [int(x) for x in np.asarray(out).ravel()]
+
+    def _check_digests(self, digests: List[int]) -> None:
+        """Raise on any cross-host divergence. Isolated from the
+        gather so tests can drive the verdict with synthetic digest
+        sets (no multiprocess runtime needed)."""
+        if len(set(digests)) > 1:
+            raise RuntimeError(
+                f'lockstep desync: host {self.rank}/{self.world} '
+                f'sees per-host output digests {digests} — a host '
+                f'diverged (slice-scope SDC); failing the slice '
+                f'instead of streaming diverged tokens')
 
     def run(self, idle_sleep: float = 0.05) -> None:
         """Follower loop (and usable as rank-0's loop body driver): tick

@@ -445,6 +445,13 @@ class InferenceServer:
         if self.dead:
             return web.json_response(
                 {'status': 'dead', 'error': self.dead}, status=503)
+        if self.engine.integrity_suspect():
+            # The on-device SDC sentinel tripped: this replica's
+            # device produces garbage. Mirrors the draining contract
+            # (503 pulls it from the ready set) — the golden-probe
+            # plane quarantines and replaces it
+            # (docs/robustness.md "Data integrity").
+            return web.json_response({'status': 'corrupt'}, status=503)
         if self.draining:
             # 503 on purpose: the replica manager's readiness probe
             # fails, so the LB pulls this replica from the ready set
@@ -562,6 +569,15 @@ class InferenceServer:
                 self._mark_drained()
 
     async def _admit_generate(self, request: web.Request) -> web.Response:
+        if self.engine.integrity_suspect():
+            # The SDC sentinel tripped: this device emits garbage —
+            # shed EVERYTHING with the quarantined marker. The LB
+            # treats it like a drain 503 (release, never a breaker
+            # failure) and retries elsewhere; Retry-After covers the
+            # window until the control plane replaces us.
+            return web.json_response(
+                {'error': 'replica corrupt', 'quarantined': True},
+                status=503, headers={'Retry-After': '1'})
         if self.draining:
             # Admission stops the moment drain begins; the LB routes
             # around us (it pulls the replica once health flips, and
@@ -624,6 +640,12 @@ class InferenceServer:
             return web.json_response(
                 {'error': 'tenant id too long (>128 chars)'},
                 status=400)
+        if self.engine.integrity_suspect():
+            # Sentinel may have tripped while we were parsing the
+            # body — re-check at the admission edge, like drain.
+            return web.json_response(
+                {'error': 'replica corrupt', 'quarantined': True},
+                status=503, headers={'Retry-After': '1'})
         if self.draining:
             # Drain may have begun while we were parsing the body —
             # re-check at the admission edge (the in-flight counter is
@@ -947,6 +969,15 @@ def main() -> None:
                              'recompiling, cutting cold-start '
                              'time-to-ready. Survives restarts; share '
                              'it across replicas of one service.')
+    parser.add_argument('--no-sdc-sentinel', action='store_true',
+                        help='Disable the on-device SDC sentinel '
+                             '(docs/robustness.md "Data integrity"). '
+                             'On by default: an isfinite reduction '
+                             'over each step\'s logits rides the '
+                             'existing readback; a NaN/inf hit marks '
+                             'the replica corrupt (503 /health) until '
+                             'it is replaced. Greedy outputs are '
+                             'bit-identical either way.')
     parser.add_argument('--pipeline-depth', type=int, default=1,
                         help='Dispatch-ahead decode depth: decode N+1 '
                              'is dispatched before step N is read '
@@ -1078,7 +1109,8 @@ def main() -> None:
             tenant_weights=tenant_weights,
             stepline=not args.no_stepline,
             stepline_cap=args.stepline_cap,
-            ttft_slo_s=args.ttft_slo_s))
+            ttft_slo_s=args.ttft_slo_s,
+            sdc_sentinel=not args.no_sdc_sentinel))
     if args.long_slots > 0:
         short_cap = min(args.max_seq_len, config.max_seq_len)
         long_cap = min(args.long_seq_len, config.max_seq_len)
@@ -1105,7 +1137,8 @@ def main() -> None:
                 tenant_weights=tenant_weights,
                 stepline=not args.no_stepline,
                 stepline_cap=args.stepline_cap,
-                ttft_slo_s=args.ttft_slo_s),
+                ttft_slo_s=args.ttft_slo_s,
+                sdc_sentinel=not args.no_sdc_sentinel),
             seed=1)
         engine = engine_lib.EnginePool([engine, long_engine])
     # Cold-start timeline stamp #1 (t_weights covers checkpoint

@@ -1,0 +1,267 @@
+"""Data-integrity plane: golden probes, SDC verdicts, quarantine.
+
+The serving tier survives replicas that are *dead* (breakers),
+*slow* (burn-rate alerting + the brownout gates), and control planes
+that are *killed* (the intent journal) — this module is the layer for
+replicas that are **wrong**: silent data corruption (a bad HBM bank,
+a flaky chip, a desynced lockstep host) that serves divergent tokens
+while every liveness probe reads healthy. The repo's greedy
+bit-determinism invariant makes byte-exact integrity checking
+uniquely cheap: a correct replica's greedy continuation of a fixed
+prompt is a known constant, so "is this replica wrong?" is one tiny
+``/generate`` round trip and a CRC compare.
+
+Three detectors feed one quarantine state machine
+(docs/robustness.md "Data integrity"):
+
+- **On-device SDC sentinel** (``infer/engine.py``): a
+  ``jnp.isfinite`` reduction over each step's logits rides the
+  existing readback pair as one extra int32 row — no extra transfer,
+  no new compiled programs. A NaN/inf hit marks the engine
+  ``integrity_suspect``; ``/health`` flips to 503 ``"corrupt"`` and
+  ``/generate`` sheds with a ``"quarantined"`` reason body.
+- **Golden-probe canaries** (``serve/load_balancer.py``): the LB
+  periodically replays a versioned golden prompt (this module's
+  fixtures) against each READY replica through the normal
+  ``/generate`` path and compares the delivered token ids' CRC
+  against the fixture. Mismatch or a corrupt self-report =>
+  ``ReplicaStatus.QUARANTINED`` (status + intent in one txn —
+  crash-safe) => drain-and-replace, with in-flight streams re-issued
+  via the resume splice. Probe traffic is invisible to tenant
+  ledgers, SLO windows and wfq quotas; a probe *transport* failure
+  counts integrity (``probe_failures_total``), never availability.
+- **Multihost desync detection** (``infer/multihost.py``): each
+  lockstep tick all-gathers a per-host output digest; any mismatch
+  fails the slice loudly (watchdog exit => relaunch) instead of
+  streaming diverged tokens.
+
+Golden fixtures are keyed by the model+tokenizer identity and carry
+the oracle **fingerprint** they were minted against. Arming probes
+validates the fingerprint first (:func:`check_fixture`): a stale
+golden fails loudly at arm time — the alternative failure mode is
+every healthy replica "failing" the probe, i.e. a fleet-wide
+quarantine storm. ``make golden-refresh`` re-mints the fixtures.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import zlib
+from typing import Any, Dict, Optional, Sequence
+
+# Bumped when the fixture JSON schema changes (not when a model's
+# golden continuation changes — that is the fingerprint's job).
+GOLDEN_VERSION = 1
+
+# The tenant id probe requests ride under. Reserved: the LB refuses
+# to ledger it, the SLO evaluator never ingests it, and a leading
+# underscore keeps it out of any real tenant namespace.
+PROBE_TENANT = '_probe'
+
+
+class StaleGoldenError(Exception):
+    """The golden fixture was minted against a different oracle
+    (model/tokenizer/sim-oracle version) than the one now serving.
+    Raised at probe-ARM time on purpose: armed anyway, every healthy
+    replica would fail the probe and the fleet would quarantine
+    itself."""
+
+
+def token_crc(tokens: Sequence[int]) -> int:
+    """Stable digest of a delivered token-id sequence (zlib.crc32
+    over the canonical JSON — never builtin ``hash``, which is
+    per-process salted)."""
+    return zlib.crc32(json.dumps([int(t) for t in tokens]).encode())
+
+
+@dataclasses.dataclass(frozen=True)
+class GoldenFixture:
+    """One versioned golden probe: a tiny fixed greedy prompt and the
+    CRC of its known-correct continuation."""
+    model: str           # model+tokenizer identity key (e.g. 'sim')
+    fingerprint: str     # oracle identity the golden was minted for
+    prompt_tokens: tuple
+    max_new_tokens: int
+    token_crc: int
+    version: int = GOLDEN_VERSION
+
+    def payload(self) -> Dict[str, Any]:
+        """The probe's ``/generate`` body — the NORMAL serving path
+        (greedy, streaming), so the probe exercises exactly what
+        tenants ride."""
+        return {'tokens': list(self.prompt_tokens),
+                'max_new_tokens': int(self.max_new_tokens),
+                'temperature': 0.0, 'stream': True,
+                'tenant': PROBE_TENANT}
+
+
+def fixtures_path() -> str:
+    """The in-tree fixture store (``make golden-refresh`` rewrites
+    it); ``SKY_TPU_GOLDEN_FIXTURES`` points deployments elsewhere."""
+    return (os.environ.get('SKY_TPU_GOLDEN_FIXTURES')
+            or os.path.join(os.path.dirname(__file__),
+                            'golden_probes.json'))
+
+
+def load_fixture(model: str,
+                 path: Optional[str] = None) -> GoldenFixture:
+    """Load the golden fixture for ``model``. Raises
+    :class:`StaleGoldenError` on a missing/unreadable store, an
+    unknown model, or a fixture-schema version mismatch — arming
+    probes without a trustworthy golden is the quarantine-storm
+    failure mode this loud path exists to prevent."""
+    p = path or fixtures_path()
+    try:
+        with open(p, encoding='utf-8') as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        raise StaleGoldenError(
+            f'golden fixture store {p!r} unreadable: {e}; run '
+            f'`make golden-refresh`') from e
+    if int(doc.get('version') or 0) != GOLDEN_VERSION:
+        raise StaleGoldenError(
+            f'golden fixture store {p!r} is schema v'
+            f'{doc.get("version")}, expected v{GOLDEN_VERSION}; run '
+            f'`make golden-refresh`')
+    row = (doc.get('fixtures') or {}).get(model)
+    if row is None:
+        raise StaleGoldenError(
+            f'no golden fixture for model {model!r} in {p!r}; run '
+            f'`make golden-refresh`')
+    return GoldenFixture(
+        model=model, fingerprint=str(row['fingerprint']),
+        prompt_tokens=tuple(int(t) for t in row['prompt_tokens']),
+        max_new_tokens=int(row['max_new_tokens']),
+        token_crc=int(row['token_crc']))
+
+
+def check_fixture(fixture: GoldenFixture,
+                  current_fingerprint: str) -> GoldenFixture:
+    """The probe-ARM gate: the fixture must have been minted against
+    the oracle now serving. Returns the fixture for chaining."""
+    if fixture.fingerprint != current_fingerprint:
+        raise StaleGoldenError(
+            f'golden fixture for {fixture.model!r} was minted for '
+            f'oracle {fixture.fingerprint!r} but the serving oracle '
+            f'is {current_fingerprint!r} — refusing to arm probes '
+            f'(a stale golden reads as a fleet-wide quarantine '
+            f'storm); run `make golden-refresh`')
+    return fixture
+
+
+def refresh_golden(path: Optional[str] = None) -> Dict[str, Any]:
+    """``make golden-refresh``: re-mint the fixture store from the
+    oracles available in-tree. Today that is the digital twin's sim
+    oracle (real model fixtures are minted at deploy time against
+    the served checkpoint by the same schema); the prompt is
+    deliberately TINY — a handful of tokens — so a probe costs a few
+    decode steps and rides admission like any small request."""
+    from skypilot_tpu.sim import replica as replica_lib
+    prompt = (2, 3, 5, 7)
+    n = 4
+    golden = replica_lib.expected_continuation(list(prompt), n)
+    doc = {
+        'version': GOLDEN_VERSION,
+        'fixtures': {
+            'sim': {
+                'fingerprint': replica_lib.oracle_fingerprint(),
+                'prompt_tokens': list(prompt),
+                'max_new_tokens': n,
+                'token_crc': token_crc(golden),
+            },
+        },
+    }
+    p = path or fixtures_path()
+    with open(p, 'w', encoding='utf-8') as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write('\n')
+    return doc
+
+
+def _smoke() -> int:
+    """``make integrity-smoke``: replay the ``sdc_storm`` scenario in
+    the digital twin and prove the whole plane end to end — every
+    poisoned replica detected and QUARANTINED within the probe
+    budget, replaced by the autoscaler, zero wrong tokens in any
+    completed client stream — then replay the brownout scenario with
+    probes armed and prove zero false quarantines (slow is NOT
+    corrupt). Exit 0 = the data-integrity plane works end to end."""
+    import dataclasses as dc
+    import logging
+
+    from skypilot_tpu.sim import DigitalTwin, sdc_storm, slow_brownout
+
+    logging.disable(logging.WARNING)
+    try:
+        sc = sdc_storm()
+        report = DigitalTwin(sc, seed=3).run()
+        poisoned = sum(f.count for f in sc.faults if f.kind == 'sdc')
+        quarantines = [d for d in report.decisions
+                       if d['kind'] == 'quarantine']
+        if len(quarantines) != poisoned:
+            print(f'integrity-smoke: {poisoned} replicas poisoned '
+                  f'but {len(quarantines)} quarantined: '
+                  f'{quarantines}')
+            return 1
+        budget_s = 3 * (sc.probe_interval_s or 0) + 3 * sc.lb_sync_s
+        for fault in (f for f in sc.faults if f.kind == 'sdc'):
+            hits = [q for q in quarantines
+                    if fault.t <= q['t'] <= fault.t + budget_s]
+            if not hits:
+                print(f'integrity-smoke: the {fault.flavor} fault at '
+                      f't={fault.t} was not quarantined within '
+                      f'{budget_s:.0f}s (3 probe rounds)')
+                return 1
+        bad = [r for r in report.records
+               if r['completed'] and not r['tokens_ok']]
+        if bad:
+            print(f'integrity-smoke: {len(bad)} completed stream(s) '
+                  f'delivered wrong tokens; first: {bad[0]}')
+            return 1
+        fleet = report.final_fleet or {}
+        if (fleet.get('ready') or 0) < sc.replicas:
+            print(f'integrity-smoke: fleet never healed — '
+                  f'{fleet.get("ready")} ready < {sc.replicas}: '
+                  f'{fleet}')
+            return 1
+        # Slow is NOT corrupt: the brownout replay with probes armed
+        # must produce ZERO quarantines (the probe rides admission
+        # and tolerates latency; only wrong bytes quarantine).
+        brown = dc.replace(slow_brownout(),
+                           probe_interval_s=sc.probe_interval_s)
+        brown_report = DigitalTwin(brown, seed=3).run()
+        false_q = [d for d in brown_report.decisions
+                   if d['kind'] == 'quarantine']
+        if false_q:
+            print(f'integrity-smoke: brownout replay produced false '
+                  f'quarantines: {false_q}')
+            return 1
+        if brown_report.client_errors:
+            print(f'integrity-smoke: brownout replay had client '
+                  f'errors: {brown_report.client_errors[:3]}')
+            return 1
+    finally:
+        logging.disable(logging.NOTSET)
+    print('integrity-smoke OK:', json.dumps({
+        'poisoned': poisoned,
+        'quarantined': len(quarantines),
+        'resumed': report.lb_metrics['requests_resumed'],
+        'completed': report.completed,
+        'brownout_quarantines': 0}))
+    return 0
+
+
+if __name__ == '__main__':
+    import sys
+
+    # `python -m` runs this file as `__main__` — a second module
+    # object. Delegate to the canonical package import (the stepline
+    # rule) so module globals are the ones the LB uses.
+    from skypilot_tpu.observability import integrity as _canonical
+    if '--refresh' in sys.argv:
+        doc = _canonical.refresh_golden()
+        print('golden-refresh OK:', json.dumps(sorted(
+            doc['fixtures'])))
+        sys.exit(0)
+    sys.exit(_canonical._smoke())

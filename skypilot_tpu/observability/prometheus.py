@@ -72,6 +72,13 @@ def lb_exposition() -> Dict[str, Tuple[str, str]]:
             'sky_tpu_lb_cold_starts_total', 'counter'),
         'cold_start_p50_s': (
             'sky_tpu_lb_cold_start_p50_seconds', 'gauge'),
+        # Data-integrity plane (docs/robustness.md "Data integrity").
+        'replicas_quarantined': (
+            'sky_tpu_lb_replicas_quarantined', 'counter'),
+        'probe_failures_total': (
+            'sky_tpu_lb_probe_failures_total', 'counter'),
+        'probe_interval_s': (
+            'sky_tpu_lb_probe_interval_seconds', 'gauge'),
     }
 
 
@@ -130,6 +137,11 @@ def replica_exposition() -> Dict[str, Tuple[str, str]]:
             'sky_tpu_engine_stepline_steps', 'counter'),
         'stepline_dumps': (
             'sky_tpu_engine_stepline_dumps', 'counter'),
+        # Data-integrity plane (docs/robustness.md "Data integrity");
+        # the string-valued ``integrity`` state renders as a labeled
+        # state-set, not a scalar.
+        'sdc_events_total': (
+            'sky_tpu_engine_sdc_events_total', 'counter'),
     }
 
 
@@ -151,6 +163,10 @@ def label_families() -> Dict[str, Tuple[str, str]]:
         'lb_breaker_state': ('sky_tpu_lb_breaker_state', 'gauge'),
         'lb_draining_replicas': (
             'sky_tpu_lb_draining_replicas', 'gauge'),
+        'lb_quarantined_replicas': (
+            'sky_tpu_lb_quarantined_replicas', 'gauge'),
+        'engine_integrity': (
+            'sky_tpu_engine_integrity_state', 'gauge'),
         'slo_burn_rate': ('sky_tpu_lb_slo_burn_rate', 'gauge'),
         'slo_budget': (
             'sky_tpu_lb_slo_error_budget_remaining', 'gauge'),
@@ -233,6 +249,8 @@ def render_lb(metrics: Dict[str, Any]) -> str:
     _emit_scalars(doc, metrics, lb_exposition())
     fam, t = fams['lb_draining_replicas']
     doc.add(fam, t, len(metrics.get('draining') or ()))
+    fam, t = fams['lb_quarantined_replicas']
+    doc.add(fam, t, len(metrics.get('quarantined') or ()))
     for tenant, row in sorted(
             (metrics.get('tenants') or {}).items()):
         labels = {'tenant': tenant}
@@ -275,6 +293,13 @@ def render_replica(metrics: Dict[str, Any]) -> str:
     doc = _Doc()
     fams = label_families()
     _emit_scalars(doc, metrics, replica_exposition())
+    integ = metrics.get('integrity')
+    if isinstance(integ, str):
+        # State-set encoding (the breaker-state rule): one series per
+        # state, value 1 for the active one — a string never survives
+        # _Doc.add as a scalar.
+        fam, t = fams['engine_integrity']
+        doc.add(fam, t, 1, {'state': integ})
     for tenant, row in sorted(
             (metrics.get('tenants') or {}).items()):
         if not isinstance(row, dict):

@@ -412,6 +412,12 @@ def service_snapshot(name: str) -> Optional[dict]:
             'ready_at': r['ready_at'],
             'assigned_job': r.get('assigned_job'),
             'failure_reason': r['failure_reason'],
+            # Integrity quarantine (docs/robustness.md "Data
+            # integrity"): reason/stamp survive the drain-and-replace
+            # transitions so status surfaces can say WHY a replica
+            # left the fleet.
+            'quarantine_reason': r.get('quarantine_reason'),
+            'quarantined_at': r.get('quarantined_at'),
         } for r in replicas],
     }
 

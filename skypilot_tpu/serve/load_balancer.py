@@ -44,6 +44,7 @@ import aiohttp
 from aiohttp import web
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.observability import integrity
 from skypilot_tpu.observability import prometheus as prom_lib
 from skypilot_tpu.observability import slo as slo_lib
 from skypilot_tpu.observability import stepline as stepline_lib
@@ -150,6 +151,16 @@ class _ReplicaSaturated(Exception):
         self.headers.setdefault('Retry-After', '1')
 
 
+class _QuarantineCut(Exception):
+    """The replica serving this stream leg was QUARANTINED (golden
+    probe mismatch / corrupt self-report) while tokens were in flight:
+    the leg is severed on the next line boundary and the stream
+    resumes on a healthy replica — delivered tokens were CRC-verified
+    up to the cut, so the spliced stream stays bit-identical. Breaker
+    is RELEASED, never failed: quarantine is the integrity plane's
+    verdict, not a liveness failure."""
+
+
 class _StreamSplice:
     """Cross-attempt state of one resumable /generate token stream.
 
@@ -241,10 +252,21 @@ class LoadBalancer:
         '_cold_starts': 'event-loop',
         '_cold_starts_total': 'event-loop',
         '_cost_gauges': 'event-loop',
+        # Golden-probe canary plane (docs/robustness.md "Data
+        # integrity"): all touched from the sync tick + probe tasks,
+        # both on the loop.
+        '_probe_inflight': 'event-loop',
+        '_probe_last': 'event-loop',
+        '_probe_failures': 'event-loop',
+        '_replicas_quarantined': 'event-loop',
+        '_quarantined_urls': 'event-loop',
+        '_replica_ids': 'event-loop',
     }
 
     def __init__(self, service_name: str, policy_name: str, *,
-                 clock: Optional[vclock.Clock] = None) -> None:
+                 clock: Optional[vclock.Clock] = None,
+                 probe_fixture=None, probe_fingerprint=None,
+                 probe_interval_s: Optional[float] = None) -> None:
         self.service_name = service_name
         self.policy = lbp.make(policy_name)
         # Clock seam (utils/vclock): wall reads (history stamps, dump
@@ -379,6 +401,46 @@ class LoadBalancer:
             cooldown_s=float(os.environ.get(
                 'SKY_TPU_LB_BREAKER_COOLDOWN_S', '10')),
             clock=self._clock.monotonic)
+        # Golden-probe canaries (docs/robustness.md "Data integrity"):
+        # armed only when a fixture is configured — ctor args win (the
+        # digital twin), else SKY_TPU_LB_PROBE_MODEL +
+        # SKY_TPU_LB_PROBE_FINGERPRINT + SKY_TPU_LB_PROBE_INTERVAL_S.
+        # Arming VALIDATES the fixture against the serving oracle's
+        # fingerprint and raises StaleGoldenError on mismatch — loud
+        # at startup, because armed-anyway the stale golden reads as a
+        # fleet-wide quarantine storm. Unarmed = the whole plane is
+        # inert (zero new syscalls, zero log lines).
+        self._probe_fixture: Optional[integrity.GoldenFixture] = None
+        self.probe_interval_s: Optional[float] = None
+        self._probe_inflight: Set[str] = set()
+        self._probe_last: Dict[str, float] = {}
+        self._probe_failures = 0
+        self._replicas_quarantined = 0
+        # Sticky across QUARANTINED → DRAINING (the DB row leaves the
+        # quarantined status the moment the drain starts, but the
+        # mid-stream cut + _select exclusion must hold until the
+        # replica is actually gone); repopulated from the DB each sync
+        # tick, so a crash-restarted LB rebuilds it in bootstrap.
+        self._quarantined_urls: Set[str] = set()
+        self._replica_ids: Dict[str, int] = {}
+        # Twin observation seam: called with (url, replica_id, reason)
+        # whenever THIS LB commits a quarantine; never touches state.
+        self.quarantine_hook: Optional[Callable] = None
+        env_model = os.environ.get('SKY_TPU_LB_PROBE_MODEL')
+        if probe_fixture is None and env_model:
+            probe_fixture = integrity.load_fixture(env_model)
+            probe_fingerprint = os.environ.get(
+                'SKY_TPU_LB_PROBE_FINGERPRINT')
+            probe_interval_s = _env_interval(
+                'SKY_TPU_LB_PROBE_INTERVAL_S', 15.0)
+        if probe_fixture is not None:
+            if probe_fingerprint is not None:
+                integrity.check_fixture(probe_fixture,
+                                        probe_fingerprint)
+            self._probe_fixture = probe_fixture
+            self.probe_interval_s = float(probe_interval_s
+                                          if probe_interval_s
+                                          else 15.0)
 
     # -- background sync ---------------------------------------------------
     async def _offload(self, fn: Callable, *args):
@@ -418,6 +480,22 @@ class LoadBalancer:
             self.breaker.prune(info)
             self._draining_urls = await self._offload(
                 serve_state.draining_replica_urls, self.service_name)
+            self._replica_ids = {
+                url: row['replica_id'] for url, row in info.items()}
+            # Quarantine exclusion set: the DB rows are authoritative,
+            # but a quarantined replica moves QUARANTINED → DRAINING
+            # the moment the replica manager picks it up — keep a url
+            # sticky while it is still ready/draining/quarantined and
+            # drop it when the replica is gone (replaced). A restarted
+            # LB rebuilds the set here (bootstrap_from_state runs one
+            # sync tick).
+            db_q = set(await self._offload(
+                serve_state.quarantined_replica_urls,
+                self.service_name))
+            self._quarantined_urls = (
+                (self._quarantined_urls
+                 & (set(info) | set(self._draining_urls) | db_q))
+                | db_q)
             if hasattr(self.policy, 'set_target_qps_per_accelerator'):
                 # Instance-aware policy: refresh the per-accelerator
                 # QPS map from the (possibly updated) service spec.
@@ -452,6 +530,7 @@ class LoadBalancer:
                     self._history_tick.pop(url, None)
             await self._slo_tick(now)
             await self._wake_tick()
+            self._probe_round(now)
             self._cost_gauges = await self._offload(
                 serve_state.get_cost_gauges, self.service_name)
             await self._dump_breaker_edges()
@@ -542,6 +621,145 @@ class LoadBalancer:
             'breaker_open', {'replicas_open': sorted(new_open)},
             {u: list(r) for u, r in self._replica_history.items()})
         await self._offload(stepline_lib.write_dump_sync, spans)
+
+    # -- golden-probe canaries (docs/robustness.md "Data integrity") -------
+    def _spawn_task(self, coro):  # holds: event-loop
+        """Fire-and-forget task seam: the digital twin overrides this
+        with its kernel's spawn so probes run in virtual time (the
+        trampoline rejects foreign awaitables)."""
+        return asyncio.ensure_future(coro)
+
+    def _probe_round(self, now: float) -> None:  # holds: event-loop
+        """Riding the sync tick: start a golden probe against every
+        READY replica that is due (per-url interval) and not already
+        being probed (≤1 in flight per replica — probe cost is bounded
+        by construction, not by luck). Quarantined/draining urls are
+        skipped: their verdict is already in."""
+        if self._probe_fixture is None:
+            return
+        for url in sorted(self.policy.ready_urls):
+            if (url in self._probe_inflight
+                    or url in self._quarantined_urls):
+                continue
+            last = self._probe_last.get(url)
+            if last is not None and now - last < self.probe_interval_s:
+                continue
+            self._probe_last[url] = now
+            self._probe_inflight.add(url)
+            self._spawn_task(self._probe_one(url))
+
+    async def _probe_one(self, url: str) -> None:
+        """One golden probe: replay the fixture prompt through the
+        replica's NORMAL /generate path and compare the delivered
+        token ids' CRC against the golden. Three verdicts:
+        ``corrupt`` (the replica self-reported its sentinel tripped)
+        and a CRC mismatch both QUARANTINE; a transport failure only
+        counts ``probe_failures_total`` — integrity, never
+        availability (a slow or momentarily unreachable replica is the
+        breaker/brownout planes' business; only wrong BYTES quarantine
+        — slow is not corrupt)."""
+        fixture = self._probe_fixture
+        try:
+            status, data = await self._probe_transport(
+                url, fixture.payload())
+            if status == 'corrupt':
+                await self._quarantine(url, 'sentinel')
+                return
+            if status != 'ok':
+                self._probe_failures += 1
+                return
+            crc = integrity.token_crc(data)
+            try:
+                # Chaos seam: corrupt THIS compare (drives the
+                # quarantine machinery without poisoning a replica).
+                await failpoints.hit_async('serve.lb.probe_corrupt')
+            except failpoints.FailpointError:
+                crc = ~crc
+            if crc != fixture.token_crc:
+                await self._quarantine(url, 'probe_mismatch')
+        except asyncio.CancelledError:
+            raise  # LB shutdown — never a probe failure
+        except Exception:  # noqa: BLE001 — a probe bug must not kill sync
+            logger.warning('golden probe against %s errored', url,
+                           exc_info=True)
+            self._probe_failures += 1
+        finally:
+            self._probe_inflight.discard(url)
+
+    async def _probe_transport(self, url: str, payload: dict):
+        """Issue one probe request; returns ``('ok', token_ids)``,
+        ``('corrupt', detail)`` when the replica sheds with the
+        quarantined marker (its own sentinel tripped), or
+        ``('error', detail)`` on any transport/shed/5xx outcome.
+        Probes ride the PROBE_TENANT header and never touch the
+        tenant ledgers, TTFT/ITL windows, or SLO ingestion — they
+        bypass handle() entirely. Seam: the twin overrides this to
+        drive its modeled replicas."""
+        if self._session is None:
+            return 'error', 'no session'
+        try:
+            async with self._session.post(
+                    url.rstrip('/') + '/generate', json=payload,
+                    headers={common.TENANT_HEADER:
+                             integrity.PROBE_TENANT},
+                    timeout=aiohttp.ClientTimeout(total=30)) as r:
+                if r.status == 503:
+                    try:
+                        body = json.loads(await r.read() or b'{}')
+                    except ValueError:
+                        body = {}
+                    if isinstance(body, dict) and body.get(
+                            'quarantined'):
+                        return 'corrupt', body.get('error') or ''
+                    return 'error', f'shed {r.status}'
+                if r.status != 200:
+                    return 'error', f'status {r.status}'
+                tokens: List[int] = []
+                async for line in r.content:
+                    if not line.strip():
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except ValueError:
+                        return 'error', 'bad stream line'
+                    if not isinstance(obj, dict):
+                        return 'error', 'bad stream line'
+                    if obj.get('error'):
+                        return 'error', obj['error']
+                    toks = obj.get('tokens')
+                    if isinstance(toks, list):
+                        tokens.extend(int(t) for t in toks)
+                    if obj.get('done'):
+                        return 'ok', tokens
+                return 'error', 'stream ended without done'
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                OSError) as e:
+            return 'error', f'{type(e).__name__}: {e}'
+
+    async def _quarantine(self, url: str, reason: str) -> None:
+        """Commit the quarantine: status + intent in ONE state-DB
+        transaction (PR 14 crash machinery — a controller killed
+        mid-quarantine reconciles to the same replace), then pull the
+        url from routing immediately (the sync tick would catch it a
+        tick later; in-flight streams cut at the next line boundary
+        and resume elsewhere). The guarded UPDATE returns False when
+        the replica already left READY/NOT_READY — two probes racing
+        one bad replica count ONE quarantine."""
+        rid = self._replica_ids.get(url)
+        if rid is None:
+            return
+        did = await self._offload(
+            serve_state.quarantine_replica, self.service_name, rid,
+            reason)
+        if not did:
+            return
+        self._replicas_quarantined += 1
+        self._quarantined_urls.add(url)
+        logger.warning(
+            'replica %d (%s) QUARANTINED: %s — draining from routing '
+            'and replacing', rid, url, reason)
+        if self.quarantine_hook is not None:
+            self.quarantine_hook(url, rid, reason)
 
     # -- SLO evaluation (docs/observability.md "SLOs and alerting") --------
     # Sync ticks between objective-config re-reads: `serve update`
@@ -1004,6 +1222,14 @@ class LoadBalancer:
             'cold_starts_total': self._cold_starts_total,
             'cold_start_p50_s': (round(pct(cold, 0.50), 3)
                                  if cold else None),
+            # Data-integrity plane (docs/robustness.md "Data
+            # integrity"): golden-probe canaries + quarantine ledger.
+            # probe_interval_s is null when probes are unarmed (no
+            # golden fixture for the served model).
+            'replicas_quarantined': self._replicas_quarantined,
+            'probe_failures_total': self._probe_failures,
+            'probe_interval_s': self.probe_interval_s,
+            'quarantined': sorted(self._quarantined_urls),
         }
 
     def _select(self, tried: Set[str],
@@ -1015,7 +1241,9 @@ class LoadBalancer:
         EVERY breaker is open, fail open with any untried replica —
         turning a possibly-wrong breaker into a total blackout is worse
         than one wasted probe."""
-        candidates = [u for u in self.policy.ready_urls if u not in tried]
+        candidates = [u for u in self.policy.ready_urls
+                      if u not in tried
+                      and u not in self._quarantined_urls]
         if not candidates:
             return None
         if affinity is not None:
@@ -1032,7 +1260,10 @@ class LoadBalancer:
             url = self.policy.select_replica()
             if url is None:
                 break
-            if url in tried or url in blocked:
+            # The policy walks its own ready list, which still holds a
+            # just-quarantined url until the sync tick prunes it — the
+            # candidates filter must bind this path too.
+            if url in tried or url in blocked or url not in candidates:
                 continue
             if self.breaker.allows(url):
                 return url
@@ -1315,6 +1546,13 @@ class LoadBalancer:
                                 'serve.lb.midstream_kill')
                         except failpoints.FailpointError as e:
                             raise _UpstreamDead(e) from e
+                        # A probe quarantined THIS replica under the
+                        # stream: cut at the line boundary (every
+                        # delivered line predates the verdict and is
+                        # ledgered) and resume elsewhere — the splice
+                        # keeps the client stream bit-identical.
+                        if url in self._quarantined_urls:
+                            raise _QuarantineCut()
                     if splice.done:
                         break
             except (_ClientGone, _UpstreamDead, _ReplicaSaturated):
@@ -1527,6 +1765,28 @@ class LoadBalancer:
                         logger.info(
                             'replica %s shed with %d; rerouting to %s',
                             current, e.status, url)
+                except _QuarantineCut:
+                    # The replica was QUARANTINED under this stream.
+                    # Integrity's verdict, not an availability event:
+                    # release (never fail) the breaker — the replica is
+                    # already leaving via drain-and-replace — and
+                    # resume the stream on a healthy peer.
+                    self.breaker.release(current)
+                    tried.add(current)
+                    last_cause, saturated = None, None
+                    url = await self._next_url_or_wake(
+                        tried, affinity, t_deadline, headers, splice)
+                    if url is not None:
+                        if (splice.resp is not None
+                                or splice.delivered or splice.resumes):
+                            splice.resumes += 1
+                            self._requests_resumed += 1
+                        else:
+                            self._requests_retried += 1
+                        logger.warning(
+                            'replica %s quarantined under stream '
+                            '(%d delivered tokens); resuming on %s',
+                            current, len(splice.delivered), url)
                 except _PreStreamFailure as e:
                     self.breaker.record_failure(current)
                     tried.add(current)

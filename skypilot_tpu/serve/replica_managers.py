@@ -367,7 +367,8 @@ class ReplicaManager:
         drain_url = ''
         if (not self.spec.pool and record['url']
                 and record['status'] in (ReplicaStatus.READY,
-                                         ReplicaStatus.NOT_READY)):
+                                         ReplicaStatus.NOT_READY,
+                                         ReplicaStatus.QUARANTINED)):
             drain_url = record['url']
             status = ReplicaStatus.DRAINING
             kind = 'DRAINING'
@@ -531,6 +532,20 @@ class ReplicaManager:
             elif row is None:
                 serve_state.resolve_intent(intent['intent_id'])
                 report['resolved'].append(intent['replica_id'])
+            elif (intent['kind'] == 'QUARANTINING'
+                  and row['status'] == ReplicaStatus.QUARANTINED
+                  and intent['replica_id'] not in self._terminating):
+                # A quarantine committed (integrity verdict journaled)
+                # but the controller died before the drain-and-replace
+                # began: resume it. The QUARANTINING intent retires
+                # with the row in remove_replica; a second reconcile
+                # sees the row DRAINING (or gone) and does nothing.
+                rid = intent['replica_id']
+                reason = (intent['payload'].get('reason')
+                          or 'integrity quarantine')
+                self.terminate_replica(rid, f'quarantined: {reason}',
+                                       replace=True)
+                report['resumed_teardowns'].append(rid)
             elif (row['status'] not in (ReplicaStatus.DRAINING,
                                         ReplicaStatus.SHUTTING_DOWN)
                   and intent['replica_id'] not in self._terminating):
@@ -684,6 +699,20 @@ class ReplicaManager:
                           ReplicaStatus.SHUTTING_DOWN,
                           ReplicaStatus.FAILED,
                           ReplicaStatus.PREEMPTED):
+                continue
+            if status == ReplicaStatus.QUARANTINED:
+                # Integrity quarantine (docs/robustness.md "Data
+                # integrity"): the verdict is already journaled (one
+                # txn with the status flip) — this tick turns it into
+                # the drain-and-replace. terminate_replica's own
+                # in-flight guard makes a repeat visit a no-op.
+                logger.warning(
+                    'replica %d: quarantined (%s); replacing', rid,
+                    r.get('quarantine_reason') or 'integrity')
+                self._terminate_marked(
+                    r, f"quarantined: "
+                       f"{r.get('quarantine_reason') or 'integrity'}",
+                    replace=True)
                 continue
             if r.get('restart_requested'):
                 # Operator-initiated replacement (dashboard/CLI): tear

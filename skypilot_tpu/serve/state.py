@@ -44,6 +44,8 @@ class ReplicaStatus(enum.Enum):
     NOT_READY = 'NOT_READY'          # was ready; probes now failing
     DRAINING = 'DRAINING'            # leaving the ready set; finishing
     #                                  in-flight requests, then teardown
+    QUARANTINED = 'QUARANTINED'      # integrity-failed (SDC): pulled
+    #                                  from routing, pending replace
     SHUTTING_DOWN = 'SHUTTING_DOWN'
     PREEMPTED = 'PREEMPTED'
     FAILED = 'FAILED'
@@ -68,6 +70,10 @@ class ReplicaStatus(enum.Enum):
                         ReplicaStatus.STARTING)
 
 
+# QUARANTINED is deliberately NOT live: a replica that failed an
+# integrity check stops counting toward the target the moment the
+# quarantine commits, so the autoscaler launches its replacement on
+# the next tick — before the drain even starts.
 _LIVE_STATUSES = (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
                   ReplicaStatus.STARTING, ReplicaStatus.READY,
                   ReplicaStatus.NOT_READY)
@@ -105,7 +111,9 @@ CREATE TABLE IF NOT EXISTS replicas (
     consecutive_failures INTEGER DEFAULT 0,
     failure_reason TEXT,
     restart_requested INTEGER DEFAULT 0,
-    assigned_job INTEGER
+    assigned_job INTEGER,
+    quarantine_reason TEXT,
+    quarantined_at REAL
 );
 CREATE TABLE IF NOT EXISTS intents (
     intent_id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -187,6 +195,10 @@ def _db() -> db_util.Db:
             ('lb_gauges', 'cost_updated_at',
              'ALTER TABLE lb_gauges ADD COLUMN '
              'cost_updated_at REAL DEFAULT 0'),
+            ('replicas', 'quarantine_reason',
+             'ALTER TABLE replicas ADD COLUMN quarantine_reason TEXT'),
+            ('replicas', 'quarantined_at',
+             'ALTER TABLE replicas ADD COLUMN quarantined_at REAL'),
         ])
         _migrated.add(db.path)
     return db
@@ -496,6 +508,48 @@ def mark_replica_teardown(replica_id: int, status: ReplicaStatus,
     conn.commit()
 
 
+def quarantine_replica(service_name: str, replica_id: int,
+                       reason: str) -> bool:
+    """Integrity quarantine begin, crash-safe: the QUARANTINED
+    transition, its reason/age stamps, and a QUARANTINING intent land
+    in ONE transaction — a controller (or LB) killed right after this
+    commit leaves a durable record, and recovery/sync resumes the
+    drain-and-replace from the row alone. Idempotent and guarded: only
+    a replica still in the routable set (READY / NOT_READY) moves —
+    a second probe verdict racing the first, or a quarantine landing
+    on a replica already draining for another reason, is a no-op.
+    Returns True iff THIS call performed the transition (the caller's
+    signal to count the quarantine exactly once)."""
+    conn = _db().conn
+    cur = conn.execute(
+        'UPDATE replicas SET status = ?, quarantine_reason = ?, '
+        'quarantined_at = ? WHERE replica_id = ? AND service_name = ? '
+        'AND status IN (?, ?)',
+        (ReplicaStatus.QUARANTINED.value, reason, vclock.now(),
+         replica_id, service_name, ReplicaStatus.READY.value,
+         ReplicaStatus.NOT_READY.value))
+    if cur.rowcount == 0:
+        conn.commit()   # close the implicit deferred txn
+        return False
+    _insert_intent(conn, service_name, 'QUARANTINING', replica_id,
+                   {'reason': reason})
+    conn.commit()
+    return True
+
+
+def quarantined_replica_urls(service_name: str) -> List[str]:
+    """Sorted urls of QUARANTINED replicas — the LB sync tick's
+    integrity scan, same narrow-SELECT rule as
+    :func:`ready_replica_info` (the LB must stop routing to, and cut
+    in-flight streams away from, a poisoned replica even when another
+    component performed the quarantine)."""
+    rows = _db().conn.execute(
+        'SELECT url FROM replicas WHERE service_name = ? '
+        'AND status = ? AND url IS NOT NULL ORDER BY url',
+        (service_name, ReplicaStatus.QUARANTINED.value)).fetchall()
+    return [r[0] for r in rows if r[0]]
+
+
 def _update_status(conn, replica_id: int, status: ReplicaStatus,
                    failure_reason: Optional[str]) -> None:
     """The ONE status-transition UPDATE (no commit — callers compose
@@ -542,7 +596,7 @@ def request_replica_restart(service_name: str,
         'UPDATE replicas SET restart_requested = 1 '
         'WHERE replica_id = ? AND service_name = ? '
         "AND status NOT IN ('FAILED','PREEMPTED','SHUTTING_DOWN',"
-        "'DRAINING','PENDING','PROVISIONING')",
+        "'DRAINING','QUARANTINED','PENDING','PROVISIONING')",
         (replica_id, service_name))
     conn.commit()
     return cur.rowcount > 0

@@ -38,7 +38,7 @@ from skypilot_tpu.sim.scenarios import (SCENARIOS, KillSpec, Scenario,
                                         crash_sweep, flash_crowd,
                                         fleet_storm_24h,
                                         reclaim_storm,
-                                        regional_failover,
+                                        regional_failover, sdc_storm,
                                         slow_brownout, wfq_fleet)
 from skypilot_tpu.sim.twin import DigitalTwin, SimReport
 
@@ -46,4 +46,5 @@ __all__ = ['DigitalTwin', 'KillSpec', 'SCENARIOS', 'Scenario',
            'SimReport', 'breaker_flap', 'crash_controller_mid_storm',
            'crash_lb_mid_stream', 'crash_sweep', 'flash_crowd',
            'fleet_storm_24h', 'reclaim_storm', 'regional_failover',
-           'run_crash_sweep', 'slow_brownout', 'wfq_fleet']
+           'run_crash_sweep', 'sdc_storm', 'slow_brownout',
+           'wfq_fleet']

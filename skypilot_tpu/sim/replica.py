@@ -18,7 +18,14 @@ Failure surface (what the scenarios drive):
   as an atomic flush — ORDERING is what it proves: DRAINING before
   teardown, ready-set removal before death, zero client errors);
 - ``wedged`` — answers probes but fails requests (breaker-flap food);
-- ``slow_factor`` — brownout: steps stretch, tails grow, probes pass.
+- ``slow_factor`` — brownout: steps stretch, tails grow, probes pass;
+- ``poison(flavor)`` — silent data corruption (docs/robustness.md
+  "Data integrity"): ``token_flip`` serves deterministically WRONG
+  tokens for short prompts (address-localized corruption — the golden
+  probe's tiny prompt hits it, long tenant prompts do not), ``nan``
+  models a sentinel trip (in-flight streams die, new submits shed a
+  503 with the ``quarantined`` marker). Probes pass either way — only
+  the integrity plane can tell a poisoned replica from a healthy one.
 """
 from __future__ import annotations
 
@@ -41,6 +48,41 @@ class ReplicaShed(Exception):
         super().__init__(message)
         self.status = status
         self.retry_after_s = retry_after_s
+
+
+class ReplicaQuarantined(ReplicaShed):
+    """503 from a sentinel-tripped replica: the body carries the
+    ``quarantined`` reason marker — the twin's mirror of the infer
+    server's corrupt-health contract (503 + ``Retry-After`` +
+    ``{'error': 'replica corrupt', 'quarantined': true}``). The LB
+    releases (never breaker-fails) it, exactly like a drain 503."""
+
+    def __init__(self) -> None:
+        super().__init__(
+            503, json.dumps({'error': 'replica corrupt',
+                             'quarantined': True}),
+            retry_after_s=1.0)
+
+
+# Address-locality bound of the token_flip corruption model: only
+# prompts at most this many tokens long hit the corrupt rows (a bad
+# HBM bank corrupts SOME addresses, not the whole model — modeled as
+# the embedding rows the golden probe's tiny prompt touches). Long
+# tenant prompts decode correctly, which is exactly what makes the
+# corruption SILENT to every liveness signal and non-vacuous for the
+# probe plane to catch.
+CORRUPT_SHORT_PROMPT_MAX = 6
+
+# Bumped when the sim oracle's token function changes — the golden
+# fixture fingerprint (observability/integrity.py) is minted against
+# it, and a mismatch must fail loudly at probe-arm time.
+ORACLE_VERSION = 1
+
+
+def oracle_fingerprint() -> str:
+    """The sim oracle's identity string — what a golden fixture for
+    model key ``'sim'`` must have been minted against."""
+    return f'sim-greedy-v{ORACLE_VERSION}'
 
 
 @dataclasses.dataclass
@@ -220,6 +262,7 @@ class ModelReplica:
         self.draining = False
         self.wedged = False
         self.slow_factor = 1.0
+        self.corrupt_flavor: Optional[str] = None
         self.active: List[_Req] = []
         self.steps = 0
         self.decode_tokens = 0
@@ -231,6 +274,12 @@ class ModelReplica:
         if not self.alive:
             raise ConnectionError(f'{self.url} is dead')
         now = self.kernel.now
+        if self.corrupt_flavor == 'nan':
+            # The on-device sentinel tripped: the server's admission
+            # edge sheds everything with the quarantined marker
+            # (mirroring infer/server._admit_generate's corrupt 503)
+            # until the control plane replaces the replica.
+            raise ReplicaQuarantined()
         if self.draining:
             raise ReplicaShed(503, 'draining', retry_after_s=1.0)
         prompt = [int(t) for t in payload.get('tokens') or []]
@@ -298,6 +347,12 @@ class ModelReplica:
     def _emit_one(self, req: _Req) -> None:
         idx = len(req.output_tokens)
         tok = _token(req.prompt_key, idx)
+        if (self.corrupt_flavor == 'token_flip'
+                and len(req.prompt_tokens) <= CORRUPT_SHORT_PROMPT_MAX):
+            # Silent corruption: a deterministically WRONG token (the
+            # oracle never emits it for this position), only on
+            # prompts short enough to hit the corrupt addresses.
+            tok += 1
         req.output_tokens.append(tok)
         self.decode_tokens += 1
         self.sched.note_tokens(req, 1)
@@ -330,13 +385,11 @@ class ModelReplica:
             self.on_request_done(self.url, req, reason)
 
     # ---- failure surface -------------------------------------------------
-    def kill(self) -> None:
-        """Hard death (spot reclaim without notice, zone outage):
-        every in-flight and queued stream dies mid-flight; the LB's
-        resume path is what heals the clients."""
-        if not self.alive:
-            return
-        self.alive = False
+    def _fail_all_streams(self) -> None:
+        """Fail every admitted stream — active and queued — at this
+        instant. Shared by kill (power loss) and poison('nan') (the
+        sentinel sheds the whole batch); the LB resume splice is what
+        heals the clients either way."""
         for req in self.active:
             req.stream.fail()
         self.active.clear()
@@ -345,6 +398,33 @@ class ModelReplica:
             if req is None:
                 break
             req.stream.fail()
+
+    def kill(self) -> None:
+        """Hard death (spot reclaim without notice, zone outage):
+        every in-flight and queued stream dies mid-flight; the LB's
+        resume path is what heals the clients."""
+        if not self.alive:
+            return
+        self.alive = False
+        self._fail_all_streams()
+
+    def poison(self, flavor: str) -> None:
+        """Silent data corruption onset (bad HBM bank, flaky chip).
+
+        ``token_flip``: the replica keeps serving but emits WRONG
+        tokens for short prompts (address-localized corruption) — the
+        liveness probe still passes; only the golden-probe canary's
+        byte compare can see it. ``nan``: the on-device sentinel
+        trips — in-flight streams die (their clients heal through the
+        LB resume splice), and every new submit sheds 503 with the
+        quarantined marker; the HTTP surface stays up (alive=True) so
+        death-detection never fires — quarantine must come from the
+        integrity plane, not the breaker."""
+        if flavor not in ('token_flip', 'nan'):
+            raise ValueError(f'unknown corruption flavor {flavor!r}')
+        self.corrupt_flavor = flavor
+        if flavor == 'nan':
+            self._fail_all_streams()
 
     def drain_flush(self) -> None:
         """The planned handoff: stop admitting (new requests shed 503

@@ -41,6 +41,11 @@ class Fault:
     duration_s: float = 120.0
     factor: float = 8.0
     count: int = 1
+    # ``sdc`` faults only: ``token_flip`` serves silently wrong
+    # tokens on short prompts (golden-probe food); ``nan`` trips the
+    # modeled on-device sentinel (docs/robustness.md "Data
+    # integrity").
+    flavor: str = 'token_flip'
 
 
 @dataclasses.dataclass
@@ -122,6 +127,11 @@ class Scenario:
     # the alert-fidelity gates in tests/sim/test_slo_alerts.py arm
     # these. None = no objectives, the SLO layer stays inert.
     slo: Optional[List[Dict[str, Any]]] = None
+    # Data-integrity plane (docs/robustness.md "Data integrity"):
+    # a per-replica golden-probe cadence arms the REAL LB probe
+    # scheduler against the sim oracle's golden fixture. None = probes
+    # unarmed — every pre-existing scenario replays byte-identically.
+    probe_interval_s: Optional[float] = None
 
 
 def reclaim_storm(*, replicas: int = 40, duration_s: float = 2400.0,
@@ -210,6 +220,35 @@ def breaker_flap(*, replicas: int = 6,
                           'until': duration_s * 0.75}},
         faults=[Fault(t=duration_s * 0.45, kind='wedge', count=1,
                       duration_s=300.0)])
+
+
+def sdc_storm(*, replicas: int = 8,
+              duration_s: float = 2400.0) -> Scenario:
+    """Silent data corruption mid-fleet (docs/robustness.md "Data
+    integrity"): one replica starts flipping tokens (silently wrong
+    bytes, liveness probes green) and later another's logits go
+    non-finite (the modeled on-device sentinel). Golden probes run
+    every ``probe_interval_s`` against every READY replica. Gates:
+    every poisoned replica QUARANTINED within three probe rounds and
+    replaced by the autoscaler; every COMPLETED client stream
+    bit-identical to a same-seed uncorrupted run (the quarantine cut
+    + resume splice — non-vacuous: streams are long enough to be in
+    flight at quarantine time); zero false quarantines.
+
+    Tenant prompts are sized ≥ ``prompt_mean/2`` = 12 tokens — above
+    the modeled corruptor's short-prompt reach (the 4-token golden
+    probe is inside it), mirroring real SDC's address-dependence:
+    the probe sees corruption tenants have not hit yet."""
+    return Scenario(
+        name='sdc_storm', replicas=replicas, duration_s=duration_s,
+        perf_scale=2.0, probe_interval_s=20.0,
+        tenants={'prod': {'rps': 4.0, 'prompt_mean': 24,
+                          'prompt_max': 64, 'max_new': 32,
+                          'until': duration_s * 0.75}},
+        faults=[Fault(t=duration_s * 0.40, kind='sdc', count=1,
+                      flavor='token_flip'),
+                Fault(t=duration_s * 0.55, kind='sdc', count=1,
+                      flavor='nan')])
 
 
 def wfq_fleet(*, replicas: int = 4, duration_s: float = 900.0,
@@ -430,6 +469,7 @@ SCENARIOS = {
     'regional_failover': regional_failover,
     'slow_brownout': slow_brownout,
     'breaker_flap': breaker_flap,
+    'sdc_storm': sdc_storm,
     'wfq_fleet': wfq_fleet,
     'crash_controller_mid_storm': crash_controller_mid_storm,
     'crash_lb_mid_stream': crash_lb_mid_stream,

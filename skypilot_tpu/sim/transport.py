@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional, Tuple
 
+from skypilot_tpu.observability import integrity
 from skypilot_tpu.serve import load_balancer as lb_lib
 from skypilot_tpu.sim import kernel as kernel_lib
 from skypilot_tpu.sim import replica as replica_lib
@@ -90,14 +91,56 @@ class TwinLoadBalancer(lb_lib.LoadBalancer):
     """The real LB bound to the twin's kernel clock and replica map."""
 
     def __init__(self, service_name: str, policy_name: str, *,
-                 clock, model_by_url) -> None:
-        super().__init__(service_name, policy_name, clock=clock)
+                 clock, model_by_url, kernel=None,
+                 probe_fixture=None, probe_fingerprint=None,
+                 probe_interval_s=None) -> None:
+        super().__init__(service_name, policy_name, clock=clock,
+                         probe_fixture=probe_fixture,
+                         probe_fingerprint=probe_fingerprint,
+                         probe_interval_s=probe_interval_s)
         self._model_by_url = model_by_url
+        self._kernel = kernel
 
     # ---- seams ---------------------------------------------------------
     async def _offload(self, fn, *args):
         # One thread, one sqlite, deterministic order: run inline.
         return fn(*args)
+
+    def _spawn_task(self, coro):
+        # Probes must run on the kernel trampoline, in virtual time —
+        # asyncio.ensure_future would need a real loop.
+        return self._kernel.spawn(coro)
+
+    async def _probe_transport(self, url: str, payload: dict):
+        """Golden probe against the modeled replica: same verdict
+        surface as the real aiohttp transport. ``ReplicaQuarantined``
+        (the modeled sentinel self-report) maps to ``corrupt``; every
+        other shed/death is a transport ``error`` — never a
+        quarantine."""
+        model = self._model_by_url(url)
+        if model is None or not model.alive or model.wedged:
+            return 'error', f'replica {url} unreachable'
+        try:
+            stream = model.submit(payload, integrity.PROBE_TENANT,
+                                  [])
+        except replica_lib.ReplicaQuarantined as e:
+            return 'corrupt', str(e)
+        except replica_lib.ReplicaShed as e:
+            return 'error', f'shed {e.status}'
+        except ConnectionError as e:
+            return 'error', str(e)
+        tokens: List[int] = []
+        while True:
+            kind, obj = await stream.next_event()
+            if kind == 'dead':
+                return 'error', f'replica {url} died mid-probe'
+            if obj.get('error'):
+                return 'error', obj['error']
+            toks = obj.get('tokens')
+            if isinstance(toks, list):
+                tokens.extend(int(t) for t in toks)
+            if obj.get('done'):
+                return 'ok', tokens
 
     def _new_waiter(self):
         # Scale-to-zero parking: the kernel trampoline rejects foreign
@@ -158,6 +201,9 @@ class TwinLoadBalancer(lb_lib.LoadBalancer):
                 await failpoints.hit_async('serve.lb.midstream_kill')
             except failpoints.FailpointError as e:
                 raise lb_lib._UpstreamDead(e) from e  # noqa: SLF001
+            # Same line-boundary quarantine cut as the real transport.
+            if url in self._quarantined_urls:
+                raise lb_lib._QuarantineCut()  # noqa: SLF001
         await splice.resp.write_eof()
         return splice.resp, True
 

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional
 import yaml
 
 from skypilot_tpu.infer import sched as sched_lib
+from skypilot_tpu.observability import integrity
 from skypilot_tpu.serve import controller as controller_lib
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.serve.state import ReplicaStatus
@@ -476,13 +477,43 @@ class DigitalTwin:
         self._log('crash', target='lb', severed=len(calls))
         self.kernel.call_later(restart_delay_s, self._restart_lb)
 
+    def _make_lb(self) -> transport_lib.TwinLoadBalancer:
+        """Build the twin's LB (initial boot and crash-restarts take
+        the identical path). When the scenario arms golden probes, the
+        fixture is minted from the live sim oracle — the same mint
+        ``make golden-refresh`` performs — so the LB's arm-time
+        fingerprint gate runs for real."""
+        sc = self.sc
+        fixture = fingerprint = None
+        if sc.probe_interval_s is not None:
+            prompt = (2, 3, 5, 7)
+            golden = replica_lib.expected_continuation(list(prompt), 4)
+            fingerprint = replica_lib.oracle_fingerprint()
+            fixture = integrity.GoldenFixture(
+                model='sim', fingerprint=fingerprint,
+                prompt_tokens=prompt, max_new_tokens=4,
+                token_crc=integrity.token_crc(golden))
+        lb = transport_lib.TwinLoadBalancer(
+            self.SERVICE, sc.lb_policy, clock=self.kernel.clock,
+            model_by_url=self._model_by_url, kernel=self.kernel,
+            probe_fixture=fixture, probe_fingerprint=fingerprint,
+            probe_interval_s=sc.probe_interval_s)
+        lb.sync_interval_s = sc.lb_sync_s
+        lb.stats_flush_s = sc.stats_flush_s
+        lb.slo_transition_hook = self._on_slo_transition
+        lb.quarantine_hook = self._on_quarantine
+        return lb
+
+    def _on_quarantine(self, url: str, replica_id: int,
+                       reason: str) -> None:
+        """Every quarantine verdict lands in the decision log (the
+        byte-identity surface): the sdc_storm gates assert count,
+        latency, and the false-positive scenarios assert absence."""
+        self._log('quarantine', url=url, replica_id=replica_id,
+                  reason=reason)
+
     def _restart_lb(self) -> None:
-        self._lb = transport_lib.TwinLoadBalancer(
-            self.SERVICE, self.sc.lb_policy, clock=self.kernel.clock,
-            model_by_url=self._model_by_url)
-        self._lb.sync_interval_s = self.sc.lb_sync_s
-        self._lb.stats_flush_s = self.sc.stats_flush_s
-        self._lb.slo_transition_hook = self._on_slo_transition
+        self._lb = self._make_lb()
         # The crash-restart rebuild under test: ready set, affinity
         # ring, and breaker state repopulated from serve_state before
         # the first retried leg lands.
@@ -535,6 +566,19 @@ class DigitalTwin:
                 self.kernel.call_later(
                     fault.duration_s,
                     lambda m=s.model: setattr(m, 'wedged', False))
+        elif fault.kind == 'sdc':
+            # Silent data corruption (docs/robustness.md "Data
+            # integrity"): poison healthy replicas — liveness probes
+            # stay green; only the golden probes / sentinel self-
+            # reports can see it. Never un-poisoned: detection and
+            # replacement IS the recovery path under test.
+            live = [s for s in cloud.live_slices()
+                    if s.model.corrupt_flavor is None]
+            chosen = rng.sample(live, min(fault.count, len(live)))
+            self._log('sdc', flavor=fault.flavor,
+                      victims=[s.cluster_name for s in chosen])
+            for s in chosen:
+                s.model.poison(fault.flavor)
         else:
             raise ValueError(f'unknown fault kind {fault.kind!r}')
 
@@ -628,7 +672,8 @@ class DigitalTwin:
             statuses[s] = statuses.get(s, 0) + 1
         transitional = (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
                         ReplicaStatus.STARTING, ReplicaStatus.DRAINING,
-                        ReplicaStatus.SHUTTING_DOWN)
+                        ReplicaStatus.SHUTTING_DOWN,
+                        ReplicaStatus.QUARANTINED)
         record = serve_state.get_service(self.SERVICE)
         return {
             'service_status': (record['status'].value
@@ -689,13 +734,7 @@ class DigitalTwin:
             self.SERVICE, cloud=self._cloud, executor=self._executor,
             cost_catalog=self._cost_catalog)
         self._controller.place_hook = self._on_place
-        self._lb = transport_lib.TwinLoadBalancer(
-            self.SERVICE, sc.lb_policy, clock=self.kernel.clock,
-            model_by_url=self._model_by_url)
-        # Override the env-derived cadences with the scenario's.
-        self._lb.sync_interval_s = sc.lb_sync_s
-        self._lb.stats_flush_s = sc.stats_flush_s
-        self._lb.slo_transition_hook = self._on_slo_transition
+        self._lb = self._make_lb()
         # Control loops at their virtual cadences. The kernel's
         # trampoline drives the LB's REAL async bodies; every await
         # inside resolves inline (the twin's _offload) so each spawn

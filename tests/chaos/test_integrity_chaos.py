@@ -1,0 +1,216 @@
+"""Failpoint-driven chaos for the data-integrity plane
+(docs/robustness.md "Data integrity").
+
+Covers both integrity failpoint sites end to end against REAL code:
+
+- ``infer.engine.sdc_nan`` simulates a device NaN on hosts without a
+  corruptible chip: the in-flight request finishes with reason
+  ``sdc``, the engine flips one-way to ``integrity_suspect``,
+  ``/health`` reports 503 ``corrupt`` and ``/generate`` sheds with
+  the ``quarantined`` marker + ``Retry-After`` — the surface the LB
+  classifies as release-and-reroute, never a breaker failure;
+- ``serve.lb.probe_corrupt`` corrupts ONE golden-probe CRC compare
+  inside the real LB's ``_probe_one``, driving the full quarantine
+  verdict path without poisoning any replica — and the same probe
+  with the failpoint disarmed quarantines nothing (the healthy-pass
+  control);
+- the crash leg: a QUARANTINING intent journaled by
+  ``quarantine_replica`` survives a controller death — the respawned
+  manager's reconcile resumes the drain-and-replace from the row
+  alone, idempotently.
+"""
+import asyncio
+import json
+
+import pytest
+
+from skypilot_tpu.observability import integrity
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.serve.state import ReplicaStatus
+from skypilot_tpu.utils import failpoints
+
+from tests.chaos.test_crash_recovery import (FakeCloud, SVC, _mk_rm,
+                                             _mk_service)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints._reset_for_tests()
+    yield
+    failpoints._reset_for_tests()
+
+
+# ---- infer.engine.sdc_nan --------------------------------------------------
+
+@pytest.mark.jax
+def test_engine_sentinel_trips_on_injected_nan(monkeypatch):
+    import jax
+
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.models import llama
+
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'infer.engine.sdc_nan=error@1')
+    failpoints._reset_for_tests()
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = engine_lib.InferenceEngine(
+        cfg, params, engine_lib.EngineConfig(n_slots=2, max_seq_len=64,
+                                             prefill_buckets=(8,)))
+    [req] = eng.generate([[5, 17, 101]], max_new_tokens=8)
+    # The poisoned step finished the stream early with the sdc verdict
+    # instead of delivering garbage tokens.
+    assert req.finish_reason == 'sdc'
+    assert eng.integrity_suspect()
+    m = eng.metrics()
+    assert m['sdc_events_total'] == 1
+    assert m['integrity'] == 'suspect'
+
+    # The HTTP surface a suspect replica presents (the contract the
+    # LB and the readiness probe classify on): /health 503 "corrupt",
+    # /generate sheds with the quarantined marker + Retry-After.
+    handler = server_lib.InferenceServer(eng)
+    handler.ready = True
+
+    async def surfaces():
+        health = await handler.h_health(None)
+        shed = await handler._admit_generate(None)
+        return health, shed
+    health, shed = asyncio.run(surfaces())
+    assert health.status == 503
+    assert json.loads(health.text)['status'] == 'corrupt'
+    assert shed.status == 503
+    body = json.loads(shed.text)
+    assert body['quarantined'] is True
+    assert shed.headers['Retry-After']
+
+    # One-way: the next (un-poisoned) step does not clear the verdict.
+    eng.generate([[3, 9]], max_new_tokens=2)
+    assert eng.integrity_suspect()
+
+
+# ---- serve.lb.probe_corrupt ------------------------------------------------
+
+def _probed_lb(golden):
+    fx = integrity.GoldenFixture(
+        model='test', fingerprint='test-v1', prompt_tokens=(1, 2),
+        max_new_tokens=len(golden),
+        token_crc=integrity.token_crc(golden))
+    lb = lb_lib.LoadBalancer('svc', 'round_robin', probe_fixture=fx,
+                             probe_fingerprint='test-v1',
+                             probe_interval_s=5.0)
+    lb.policy.set_ready_replicas(['http://r1'])
+    lb._replica_ids = {'http://r1': 1}
+    return lb
+
+
+def test_probe_corrupt_failpoint_drives_quarantine(monkeypatch):
+    """Arming serve.lb.probe_corrupt corrupts the CRC compare of one
+    probe against a HEALTHY replica: the real _probe_one must reach a
+    probe_mismatch quarantine verdict; the identical probe with the
+    failpoint disarmed must reach none."""
+    golden = [11, 12, 13, 14]
+    verdicts = []
+
+    async def one_probe():
+        lb = _probed_lb(golden)
+
+        async def transport(url, payload):
+            assert payload['tokens'] == [1, 2]
+            assert payload['tenant'] == integrity.PROBE_TENANT
+            return 'ok', list(golden)
+
+        async def quarantine(url, reason):
+            verdicts.append((url, reason))
+        lb._probe_transport = transport
+        lb._quarantine = quarantine
+        await lb._probe_one('http://r1')
+        assert not lb._probe_inflight
+
+    monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                       'serve.lb.probe_corrupt=error@1')
+    failpoints._reset_for_tests()
+    asyncio.run(one_probe())
+    assert verdicts == [('http://r1', 'probe_mismatch')]
+    assert failpoints.fired('serve.lb.probe_corrupt') == 1
+
+    # Control: disarmed, the same healthy probe quarantines nothing.
+    verdicts.clear()
+    monkeypatch.delenv('SKY_TPU_FAILPOINTS')
+    failpoints._reset_for_tests()
+    asyncio.run(one_probe())
+    assert verdicts == []
+
+
+def test_corrupt_self_report_quarantines_with_sentinel_reason():
+    """A replica shedding with the quarantined marker (its own
+    sentinel tripped) earns the 'sentinel' verdict without any CRC
+    compare."""
+    verdicts = []
+
+    async def one_probe():
+        lb = _probed_lb([11, 12, 13, 14])
+
+        async def transport(url, payload):
+            return 'corrupt', 'shed 503 quarantined'
+
+        async def quarantine(url, reason):
+            verdicts.append((url, reason))
+        lb._probe_transport = transport
+        lb._quarantine = quarantine
+        await lb._probe_one('http://r1')
+    asyncio.run(one_probe())
+    assert verdicts == [('http://r1', 'sentinel')]
+
+
+def test_probe_transport_failure_counts_integrity_not_quarantine():
+    """A probe that cannot complete (replica mid-restart, timeout) is
+    a transport failure: probe_failures_total ticks, no verdict — the
+    'slow/unreachable is not corrupt' rule at the unit level."""
+    verdicts = []
+
+    async def one_probe():
+        lb = _probed_lb([11, 12, 13, 14])
+
+        async def transport(url, payload):
+            return 'error', 'timeout'
+
+        async def quarantine(url, reason):
+            verdicts.append((url, reason))
+        lb._probe_transport = transport
+        lb._quarantine = quarantine
+        await lb._probe_one('http://r1')
+        return lb._probe_failures
+    failures = asyncio.run(one_probe())
+    assert failures == 1
+    assert verdicts == []
+
+
+# ---- crash safety of the quarantine intent ---------------------------------
+
+def test_quarantine_intent_survives_controller_crash():
+    """quarantine_replica journals status + QUARANTINING intent in one
+    txn; a controller killed right after the commit leaves enough for
+    the respawned manager's reconcile to resume the drain-and-replace
+    — and a second reconcile finds nothing to do."""
+    spec, task_yaml = _mk_service()
+    cloud = FakeCloud()
+    rm = _mk_rm(cloud, spec, task_yaml)
+    rid = rm.launch_replica(1)
+    serve_state.set_replica_status(rid, ReplicaStatus.READY)
+
+    assert serve_state.quarantine_replica(SVC, rid, 'probe_mismatch')
+    # The "crash": nothing else runs before a NEW manager reconciles.
+    rm2 = _mk_rm(cloud, spec, task_yaml)
+    report = rm2.reconcile()
+    assert rid in report['resumed_teardowns']
+    rm2.wait_terminations(timeout=10)
+    row = serve_state.get_replica(rid)
+    assert row is None or row['status'] in (
+        ReplicaStatus.DRAINING, ReplicaStatus.SHUTTING_DOWN)
+    report2 = rm2.reconcile()
+    assert rid not in report2['resumed_teardowns']

@@ -351,3 +351,30 @@ def test_engine_pool_two_tier_routing(params):
     assert len(m['tiers']) == 2 and m['num_active'] == 0
     with pytest.raises(ValueError, match='every pool tier'):
         pool.submit(list(range(70)))
+
+
+def test_sdc_sentinel_off_hot_path(params):
+    """docs/robustness.md "Data integrity": the on-device SDC sentinel
+    rides the existing readback pair — greedy outputs AND decode_steps
+    are bit-identical sentinel on vs off, and the sentinel mints ZERO
+    additional compiled programs (the recompile-stability pin)."""
+    prompts = [[5, 17, 101, 7], [9, 8, 7]]
+    runs = {}
+    for flag in (True, False):
+        eng = InferenceEngine(
+            CFG, params, EngineConfig(n_slots=2, max_seq_len=64,
+                                      prefill_buckets=(8, 16),
+                                      sdc_sentinel=flag))
+        reqs = eng.generate(prompts, max_new_tokens=6)
+        m = eng.metrics()
+        runs[flag] = ([r.output_tokens for r in reqs],
+                      m['decode_steps'], eng.compiled_counts(),
+                      m['integrity'], m['sdc_events_total'])
+    on, off = runs[True], runs[False]
+    assert on[0] == off[0] == [
+        _oracle_greedy(params, p, 6) for p in prompts]
+    assert on[1] == off[1], 'sentinel changed the step count'
+    assert on[2] == off[2], (
+        f'sentinel minted a new compiled program: {on[2]} != {off[2]}')
+    # A clean run never trips the verdict.
+    assert on[3] == 'ok' and on[4] == 0

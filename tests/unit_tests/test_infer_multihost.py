@@ -308,3 +308,16 @@ def test_watchdog_hard_backstop_covers_wedged_step(monkeypatch):
     drv.stop()
     wedged.set()
     t.join(timeout=5)
+
+
+def test_desync_digest_check_fails_slice_loudly():
+    """docs/robustness.md "Data integrity": identical per-host output
+    digests pass the lockstep tick; ANY divergence raises — the slice
+    fails loudly (watchdog exit -> relaunch) instead of streaming
+    diverged tokens to clients."""
+    from skypilot_tpu.infer import multihost
+    drv = multihost.MultihostEngineDriver(_FakeEngine())
+    drv._check_digests([0xdeadbeef] * 4)   # noqa: SLF001
+    drv._check_digests([5])                # noqa: SLF001
+    with pytest.raises(RuntimeError, match='lockstep desync'):
+        drv._check_digests([7, 7, 8, 7])   # noqa: SLF001

@@ -1,0 +1,233 @@
+"""Data-integrity plane, unit layer (docs/robustness.md "Data
+integrity"): golden fixtures + the stale-golden arm gate, the
+quarantine state machine's one-transaction guarantees, and the
+golden-probe scheduler's economics (rate limit, single-flight,
+tenant-ledger invisibility).
+"""
+import asyncio
+import dataclasses
+import json
+import zlib
+
+import pytest
+
+from skypilot_tpu.observability import integrity
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.serve.state import ReplicaStatus
+
+SVC = 'integsvc'
+
+
+# ---- fixtures + the stale-golden guard -------------------------------------
+
+def test_token_crc_is_stable_and_type_coercing():
+    # Never builtin hash (per-process salted): the digest is crc32
+    # over canonical JSON, so it is comparable across processes,
+    # restarts, and hosts.
+    assert integrity.token_crc([1, 2, 3]) == zlib.crc32(b'[1, 2, 3]')
+    assert integrity.token_crc([1, 2, 3]) == integrity.token_crc(
+        (1, 2, 3))
+    import numpy as np
+    assert integrity.token_crc(np.asarray([1, 2, 3])) == (
+        integrity.token_crc([1, 2, 3]))
+    assert integrity.token_crc([]) != integrity.token_crc([0])
+
+
+def test_refresh_and_load_round_trip(tmp_path):
+    from skypilot_tpu.sim import replica as replica_lib
+    p = str(tmp_path / 'goldens.json')
+    doc = integrity.refresh_golden(path=p)
+    assert 'sim' in doc['fixtures']
+    fx = integrity.load_fixture('sim', path=p)
+    assert fx.fingerprint == replica_lib.oracle_fingerprint()
+    golden = replica_lib.expected_continuation(
+        list(fx.prompt_tokens), fx.max_new_tokens)
+    assert fx.token_crc == integrity.token_crc(golden)
+    # The arm gate passes against the live oracle...
+    assert integrity.check_fixture(
+        fx, replica_lib.oracle_fingerprint()) is fx
+    # ...and the probe payload rides the reserved tenant through the
+    # NORMAL /generate path (greedy, streaming).
+    payload = fx.payload()
+    assert payload['tenant'] == integrity.PROBE_TENANT
+    assert payload['temperature'] == 0.0
+
+
+def test_shipped_golden_store_matches_live_oracle():
+    """The in-tree golden_probes.json must be fresh: a commit that
+    changes the sim oracle without `make golden-refresh` would arm
+    every probed twin run into a quarantine storm — fail HERE
+    instead."""
+    from skypilot_tpu.sim import replica as replica_lib
+    fx = integrity.load_fixture('sim')
+    integrity.check_fixture(fx, replica_lib.oracle_fingerprint())
+    golden = replica_lib.expected_continuation(
+        list(fx.prompt_tokens), fx.max_new_tokens)
+    assert fx.token_crc == integrity.token_crc(golden), (
+        'stale golden_probes.json — run `make golden-refresh`')
+
+
+def test_stale_golden_fails_loudly_at_arm_time(tmp_path):
+    # Missing store.
+    with pytest.raises(integrity.StaleGoldenError):
+        integrity.load_fixture('sim', path=str(tmp_path / 'nope.json'))
+    # Schema-version mismatch.
+    p = tmp_path / 'old.json'
+    p.write_text(json.dumps({'version': 99, 'fixtures': {}}))
+    with pytest.raises(integrity.StaleGoldenError):
+        integrity.load_fixture('sim', path=str(p))
+    # Unknown model.
+    p2 = str(tmp_path / 'goldens.json')
+    integrity.refresh_golden(path=p2)
+    with pytest.raises(integrity.StaleGoldenError):
+        integrity.load_fixture('llama-8b', path=p2)
+    # Fingerprint drift refuses to ARM (the quarantine-storm guard) —
+    # both via check_fixture and via the LB constructor itself.
+    fx = integrity.load_fixture('sim', path=p2)
+    with pytest.raises(integrity.StaleGoldenError):
+        integrity.check_fixture(fx, 'some-other-oracle-v2')
+    with pytest.raises(integrity.StaleGoldenError):
+        lb_lib.LoadBalancer(SVC, 'round_robin', probe_fixture=fx,
+                            probe_fingerprint='some-other-oracle-v2',
+                            probe_interval_s=5.0)
+
+
+# ---- the quarantine state machine ------------------------------------------
+
+def _ready_replica(rid_url='http://10.0.0.3:8080'):
+    rid = serve_state.add_replica(SVC, f'{SVC}-r', 1)
+    serve_state.set_replica_url(rid, rid_url)
+    serve_state.set_replica_status(rid, ReplicaStatus.READY)
+    return rid
+
+
+def test_quarantine_commits_once_and_journals_intent():
+    rid = _ready_replica()
+    assert serve_state.quarantine_replica(SVC, rid, 'probe_mismatch')
+    row = serve_state.get_replica(rid)
+    assert row['status'] == ReplicaStatus.QUARANTINED
+    assert row['quarantine_reason'] == 'probe_mismatch'
+    assert row['quarantined_at'] is not None
+    assert serve_state.quarantined_replica_urls(SVC) == [
+        'http://10.0.0.3:8080']
+    # Status flip + intent in ONE transaction: the journal row is the
+    # crash-recovery signal (reconcile resumes the drain-and-replace).
+    intents = serve_state.open_intents(SVC)
+    assert [i['kind'] for i in intents] == ['QUARANTINING']
+    assert intents[0]['replica_id'] == rid
+    assert intents[0]['payload']['reason'] == 'probe_mismatch'
+    # A racing second verdict (two probes, or probe + sentinel) is a
+    # no-op: False = do NOT count another quarantine.
+    assert not serve_state.quarantine_replica(SVC, rid, 'sentinel')
+    assert serve_state.get_replica(rid)['quarantine_reason'] == (
+        'probe_mismatch')
+    assert len(serve_state.open_intents(SVC)) == 1
+
+
+def test_quarantine_skips_replicas_already_leaving():
+    """Only routable replicas (READY/NOT_READY) move: a verdict
+    landing on a replica already draining for another reason must not
+    resurrect it into QUARANTINED."""
+    rid = _ready_replica('http://10.0.0.4:8080')
+    serve_state.set_replica_status(rid, ReplicaStatus.DRAINING)
+    assert not serve_state.quarantine_replica(SVC, rid, 'sentinel')
+    assert serve_state.get_replica(rid)['status'] == (
+        ReplicaStatus.DRAINING)
+    assert not serve_state.open_intents(SVC)
+
+
+# ---- probe economics -------------------------------------------------------
+
+def _armed_lb(interval_s=10.0):
+    golden = [7, 8]
+    fx = integrity.GoldenFixture(
+        model='test', fingerprint='f1', prompt_tokens=(1,),
+        max_new_tokens=2, token_crc=integrity.token_crc(golden))
+    lb = lb_lib.LoadBalancer(SVC, 'round_robin', probe_fixture=fx,
+                             probe_fingerprint='f1',
+                             probe_interval_s=interval_s)
+    return lb, golden
+
+
+def test_probe_rate_limit_and_single_flight():
+    """<= 1 probe in flight per replica, re-probe only after the
+    configured interval — probe cost is bounded and constant, no
+    matter how often the sync tick fires."""
+    async def main():
+        lb, golden = _armed_lb(interval_s=10.0)
+        lb.policy.set_ready_replicas(['http://a', 'http://b'])
+        lb._replica_ids = {'http://a': 1, 'http://b': 2}
+        calls = []
+        gate = asyncio.Event()
+
+        async def transport(url, payload):
+            calls.append(url)
+            await gate.wait()
+            return 'ok', list(golden)
+        lb._probe_transport = transport
+
+        lb._probe_round(now=100.0)
+        await asyncio.sleep(0)
+        assert sorted(calls) == ['http://a', 'http://b']
+        # Same tick cadence, interval not elapsed: nothing new.
+        lb._probe_round(now=105.0)
+        await asyncio.sleep(0)
+        assert len(calls) == 2
+        # Interval elapsed but the first probes are still in flight:
+        # the single-flight guard holds the line.
+        lb._probe_round(now=120.0)
+        await asyncio.sleep(0)
+        assert len(calls) == 2
+        # Probes complete -> the next elapsed tick probes again.
+        gate.set()
+        await asyncio.sleep(0.01)
+        assert not lb._probe_inflight
+        lb._probe_round(now=130.0)
+        await asyncio.sleep(0)
+        assert len(calls) == 4
+        return lb
+    lb = asyncio.run(main())
+    # Probe traffic never rode the tenant plane: no ledger for the
+    # reserved tenant, none for anything else either (probes bypass
+    # handle() entirely), and zero availability counters moved.
+    m = lb.lb_metrics()
+    assert integrity.PROBE_TENANT not in m['tenants']
+    assert not m['tenants']
+    assert m['requests_total'] == 0
+    assert m['probe_failures_total'] == 0
+    assert m['probe_interval_s'] == 10.0
+
+
+def test_quarantined_url_not_probed_or_selected():
+    """A quarantined replica is out of BOTH planes until replaced: no
+    further probes land on it, and _select never routes to it even
+    while the sync tick still lists it ready."""
+    async def main():
+        lb, golden = _armed_lb()
+        lb.policy.set_ready_replicas(['http://a', 'http://b'])
+        lb._replica_ids = {'http://a': 1, 'http://b': 2}
+        lb._quarantined_urls.add('http://a')
+        calls = []
+
+        async def transport(url, payload):
+            calls.append(url)
+            return 'ok', list(golden)
+        lb._probe_transport = transport
+        lb._probe_round(now=10.0)
+        await asyncio.sleep(0.01)
+        assert calls == ['http://b']
+        for _ in range(8):
+            assert lb._select(set()) == 'http://b'
+    asyncio.run(main())
+
+
+def test_unarmed_lb_probe_plane_is_inert():
+    lb = lb_lib.LoadBalancer(SVC, 'round_robin')
+    assert lb._probe_fixture is None
+    lb.policy.set_ready_replicas(['http://a'])
+    lb._probe_round(now=10.0)   # no loop needed: must not spawn
+    assert not lb._probe_inflight and not lb._probe_last
+    m = lb.lb_metrics()
+    assert m['probe_interval_s'] is None
+    assert m['replicas_quarantined'] == 0

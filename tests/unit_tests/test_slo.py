@@ -474,6 +474,9 @@ def _full_lb_metrics():
         'cost_per_1k_good_tokens': 0.0031, 'spot_fraction': 0.8,
         'cost_catalog_stale': 0, 'parked_requests': 0,
         'cold_starts_total': 2, 'cold_start_p50_s': 84.0,
+        'replicas_quarantined': 1, 'probe_failures_total': 2,
+        'probe_interval_s': 15.0,
+        'quarantined': ['http://r3:1'],
         'draining': ['http://r2:1'],
         'tenants': {'web': {'requests_total': 5, 'requests_shed': 1,
                             'requests_failed': 0,
