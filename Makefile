@@ -6,7 +6,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke sim-crash-sweep slo-smoke cost-smoke integrity-smoke golden-refresh
+.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke sim-crash-sweep slo-smoke cost-smoke integrity-smoke disagg-smoke golden-refresh
 
 lint:
 	$(PYTHON) -m skypilot_tpu.client.cli lint --changed
@@ -78,6 +78,16 @@ cost-smoke:
 # quarantines (slow is not corrupt).
 integrity-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.observability.integrity
+
+# Disaggregation smoke (docs/serving.md "Disaggregated
+# prefill/decode"): replay the 1000-replica shared-system-prompt
+# diurnal storm in the digital twin — prefill donors, decode pullers,
+# a donor reclaimed mid-transfer — twice with the same seed, and fail
+# on a fleet prefix hit rate below 2x owner-only routing, any
+# client-visible error, a vacuous donor-death fallback, or a
+# decision-log byte mismatch between the two runs.
+disagg-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.sim --scenario disagg_fleet --verify-determinism
 
 # Re-mint the golden-probe fixture store
 # (skypilot_tpu/observability/golden_probes.json) after a model,

@@ -731,6 +731,242 @@ def _run_coldstart_sweep(args) -> dict:
     }
 
 
+def _run_lb_env(service: str, port: int, policy: str,
+                env: dict) -> None:
+    """LB child-process target with env knobs applied before import
+    (the fleet-routing and sync-interval switches are read at LB
+    construction)."""
+    os.environ.update(env)
+    from skypilot_tpu.serve import load_balancer
+    load_balancer.run_load_balancer(service, policy, '127.0.0.1',
+                                    port)
+
+
+def _disagg_level(owner_url: str, fleet_url: str,
+                  fleet_metrics_url: str, replica_metrics_urls: list,
+                  donor_gen_url: str, concurrency: int,
+                  n_requests: int, sys_tokens: int,
+                  uniq_base: int) -> dict:
+    """One concurrency level of the disaggregation sweep: the SAME
+    shared-system-prompt cohort shape routed two ways. OWNER-ONLY
+    pass (fleet routing off): the legacy lead-block affinity key sees
+    the divergent tails and scatters the cohort across the ring, so
+    every replica prefills the shared block for itself. FLEET pass
+    (index armed): the block is computed ONCE on the prefill donor,
+    the index routes the whole cohort at the decode replica, and the
+    first request pulls the pages over the wire — per-pass hit rates
+    are windowed from the replicas' own counters so neither pass can
+    hide in cumulative totals."""
+    tail = 16
+
+    def cohort(base):
+        shared = _block(base, sys_tokens)
+        return lambda i: {'tokens': shared
+                          + _block(base + 200003 + i, tail)}
+
+    def hit_window(before, after):
+        hits = (sum(m['prefix_hits'] for m in after)
+                - sum(m['prefix_hits'] for m in before))
+        lookups = hits + (sum(m['prefix_misses'] for m in after)
+                          - sum(m['prefix_misses'] for m in before))
+        return round(hits / lookups, 4) if lookups else 0.0
+
+    # Owner-only pass: seed through the same LB (the seed's ring
+    # owner warms first; the rest of the cohort scatters).
+    pay = cohort(uniq_base)
+    _streamed_request(owner_url, pay(0))
+    r0 = [_get(u) for u in replica_metrics_urls]
+    owner = _sweep_level(owner_url, concurrency, n_requests,
+                         payload_for=lambda i: pay(i + 1))
+    r1 = [_get(u) for u in replica_metrics_urls]
+
+    # Fleet pass: the donor prefills the shared block once (a
+    # prefill-role replica never serves under fleet routing — it
+    # donates); wait for a sync tick to fold its radix summary.
+    pay = cohort(uniq_base + 5_000_000)
+    m_seed = _get(fleet_metrics_url)
+    _streamed_request(donor_gen_url, pay(0))
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if (_get(fleet_metrics_url).get('fleet_prefix_pages') or 0) \
+                > (m_seed.get('fleet_prefix_pages') or 0):
+            break
+        time.sleep(0.2)
+    else:
+        raise RuntimeError('fleet prefix index never folded the '
+                           'donor radix summary')
+    f0 = [_get(u) for u in replica_metrics_urls]
+    m0 = _get(fleet_metrics_url)
+    fleet = _sweep_level(fleet_url, concurrency, n_requests,
+                         payload_for=lambda i: pay(i + 1))
+    time.sleep(1.2)   # one sync tick: the LB's kv rollup lags a poll
+    f1 = [_get(u) for u in replica_metrics_urls]
+    m1 = _get(fleet_metrics_url)
+
+    out = {
+        'concurrency': concurrency,
+        'samples': owner['samples'] + fleet['samples'],
+        'system_prompt_tokens': sys_tokens,
+        'owner_only': owner,
+        'fleet': fleet,
+        'owner_hit_rate': hit_window(r0, r1),
+        'fleet_hit_rate': hit_window(f0, f1),
+        'fleet_prefix_hit_rate': m1.get('fleet_prefix_hit_rate'),
+        'transfer_p99_s': m1.get('kv_transfer_p99_s'),
+        'kv_transfers': (m1['kv_transfers_total']
+                         - m0['kv_transfers_total']),
+        'kv_transfer_failures': (m1['kv_transfer_failures']
+                                 - m0['kv_transfer_failures']),
+    }
+    if owner['ttft_p50_s'] and fleet['ttft_p50_s']:
+        out['ttft_improvement_x'] = round(
+            owner['ttft_p50_s'] / fleet['ttft_p50_s'], 3)
+    return out
+
+
+def _run_disagg_sweep(args) -> dict:
+    """--sweep disagg: prefill/decode disaggregation through TWO real
+    LBs over the same two-replica int8 fleet — one with the fleet
+    prefix index armed (the shipped default), one owner-only
+    (SKY_TPU_LB_FLEET_ROUTING=0) — replicas in prefill/decode roles.
+    The cohort's shared block sits INSIDE the legacy 64-token
+    affinity lead with divergent tails: exactly the shape the
+    lead-block key scatters and the indexed key unifies
+    (docs/serving.md "Disaggregated prefill/decode")."""
+    from skypilot_tpu.serve import load_balancing_policies as lbp
+    from skypilot_tpu.utils import common
+    tail = 16
+    sys_tokens = min(args.shared_prefix_tokens,
+                     lbp.AFFINITY_LEAD_TOKENS - tail)
+
+    roles = ('prefill', 'decode')
+    ports = [common.free_port() for _ in roles]
+    procs = []
+    for port, role in zip(ports, roles):
+        cmd = [sys.executable, '-m', 'skypilot_tpu.infer.server',
+               '--port', str(port), '--model', args.model,
+               '--slots', str(args.slots),
+               '--max-seq-len', str(args.max_seq_len),
+               '--paged', '--page-size', str(args.page_size),
+               '--prefix-cache', '--kv-dtype', 'int8',
+               '--role', role]
+        if args.n_pages:
+            cmd += ['--n-pages', str(args.n_pages)]
+        procs.append(subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                      stderr=subprocess.STDOUT))
+
+    service = f'ttft-disagg-{os.getpid()}'
+    owner_port, fleet_port = common.free_port(), common.free_port()
+    sweep = []
+    cold_s = None
+    try:
+        for port in ports:
+            _wait_http(f'http://127.0.0.1:{port}/health', 600)
+        from skypilot_tpu.serve import state as serve_state
+        from skypilot_tpu.serve.state import ReplicaStatus
+        serve_state.add_service(service, spec_json='{}', task_yaml='',
+                                lb_port=fleet_port,
+                                lb_policy='cache_aware')
+        rids = []
+        for i, port in enumerate(ports):
+            rid = serve_state.add_replica(service, f'disagg-r{i}', 1)
+            serve_state.set_replica_url(rid,
+                                        f'http://127.0.0.1:{port}')
+            serve_state.set_replica_status(rid, ReplicaStatus.READY)
+            rids.append(rid)
+        sync = {'SKY_TPU_LB_SYNC_INTERVAL_S': '0.5'}
+        lbs = [multiprocessing.Process(
+                   target=_run_lb_env,
+                   args=(service, p, 'cache_aware',
+                         {**sync, 'SKY_TPU_LB_FLEET_ROUTING': on}))
+               for p, on in ((owner_port, '0'), (fleet_port, '1'))]
+        for lb in lbs:
+            lb.start()
+        try:
+            for p in (owner_port, fleet_port):
+                _wait_http(f'http://127.0.0.1:{p}/-/metrics', 60)
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    m = _get(f'http://127.0.0.1:{p}/-/metrics')
+                    if m.get('ready_replicas', 0) >= len(ports):
+                        break
+                    time.sleep(0.5)
+
+            replica_metrics = [f'http://127.0.0.1:{p}/metrics'
+                               for p in ports]
+            donor_gen = f'http://127.0.0.1:{ports[0]}/generate'
+            # Cold + warm: compile every replica's prefill buckets
+            # off the clock with full-size unique payloads.
+            cold_s = round(_streamed_request(
+                donor_gen, {'tokens': _block(55, sys_tokens + tail)},
+                timeout=600)[0], 4)
+            for port in ports:
+                _sweep_level(
+                    f'http://127.0.0.1:{port}/generate',
+                    max(args.concurrency), 2 * args.slots,
+                    payload_for=lambda i: {
+                        'tokens': _block(900001 + i,
+                                         sys_tokens + tail)})
+
+            for li, conc in enumerate(args.concurrency):
+                sweep.append(_disagg_level(
+                    f'http://127.0.0.1:{owner_port}/generate',
+                    f'http://127.0.0.1:{fleet_port}/generate',
+                    f'http://127.0.0.1:{fleet_port}/-/metrics',
+                    replica_metrics, donor_gen, conc,
+                    args.requests_per_level, sys_tokens,
+                    uniq_base=(li + 1) * 1_000_000))
+        finally:
+            for lb in lbs:
+                lb.terminate()
+            for lb in lbs:
+                lb.join(timeout=10)
+            try:
+                for rid in rids:
+                    serve_state.remove_replica(rid)
+                serve_state.remove_service(service)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+
+    import jax
+    base = sweep[0] if sweep else {}
+    return {
+        'metric': 'disagg_ttft_improvement_x',
+        'value': base.get('ttft_improvement_x'),
+        'unit': 'x (owner-only routed shared-cohort ttft p50 / '
+                'fleet-index routed p50, shared block inside the '
+                'legacy affinity lead window)',
+        'fleet_prefix_hit_rate': base.get('fleet_prefix_hit_rate'),
+        'transfer_p99_s': base.get('transfer_p99_s'),
+        'owner_hit_rate': base.get('owner_hit_rate'),
+        'fleet_hit_rate': base.get('fleet_hit_rate'),
+        'kv_transfers_total': sum(
+            lv.get('kv_transfers', 0) for lv in sweep),
+        'kv_transfer_failures': sum(
+            lv.get('kv_transfer_failures', 0) for lv in sweep),
+        'sweep_mode': 'disagg',
+        'cold_first_request_s': cold_s,
+        'sweep': sweep,
+        'total_samples': sum(lv.get('samples', 0) for lv in sweep),
+        'model': args.model,
+        'slots': args.slots,
+        'paged': True,
+        'page_size': args.page_size,
+        'kv_dtype': 'int8',
+        'roles': list(roles),
+        'device': jax.devices()[0].device_kind,
+        'path': ('client -> cache_aware LB (owner-only vs fleet '
+                 'prefix index) -> prefill donor + decode puller '
+                 '(int8 KV page streaming; client-side '
+                 'send->first-byte clock)'),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--requests-per-level', type=int, default=80)
@@ -757,7 +993,7 @@ def main() -> None:
                         choices=['concurrency', 'shared-prefix',
                                  'chaos-resume', 'tenants',
                                  'speculative', 'chunked',
-                                 'coldstart'],
+                                 'coldstart', 'disagg'],
                         help="'shared-prefix': the shared-system-"
                              'prompt workload (implies --paged '
                              '--prefix-cache) — per level, a cold '
@@ -802,7 +1038,19 @@ def main() -> None:
                              'compile-cache dir and emit the '
                              'cold-start curve (spawn -> weights -> '
                              'compile -> first token) for the '
-                             'cold-compile and cache-hit boots.')
+                             "cold-compile and cache-hit boots. "
+                             "'disagg': prefill/decode "
+                             'disaggregation — a shared-system-'
+                             'prompt cohort through two real '
+                             'cache_aware LBs over the same int8 '
+                             'prefill+decode replica pair, owner-'
+                             'only routing vs the fleet prefix '
+                             'index, emitting fleet_prefix_hit_rate, '
+                             'transfer_p99_s and ttft_improvement_x '
+                             'per level (boots TWO engine processes '
+                             '— on a single-chip host run with '
+                             'JAX_PLATFORMS=cpu or give each its '
+                             'own device).')
     parser.add_argument('--spec-k', type=int, default=0,
                         help='speculative draft width for the replica '
                              '(0 = off; --sweep speculative defaults '
@@ -868,6 +1116,13 @@ def main() -> None:
             # The aggressor prompt must span several chunks for the
             # stall to be visible.
             args.max_seq_len = 1024
+    if args.sweep == 'disagg':
+        args.paged = True
+        args.prefix_cache = True
+        if args.page_size == 64:
+            # The shared block must cover several whole pages while
+            # staying inside the 64-token legacy affinity lead.
+            args.page_size = 16
     if args.max_seq_len is None:
         args.max_seq_len = 256
     if args.sweep == 'tenants' and args.scheduler is None:
@@ -896,6 +1151,14 @@ def main() -> None:
 
     if args.sweep == 'coldstart':
         result = _run_coldstart_sweep(args)
+        print(json.dumps(result))
+        if args.output:
+            with open(args.output, 'w', encoding='utf-8') as f:
+                json.dump(result, f, indent=1)
+        return
+
+    if args.sweep == 'disagg':
+        result = _run_disagg_sweep(args)
         print(json.dumps(result))
         if args.output:
             with open(args.output, 'w', encoding='utf-8') as f:

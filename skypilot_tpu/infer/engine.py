@@ -44,6 +44,7 @@ import numpy as np
 
 from skypilot_tpu.infer import cache as cache_lib
 from skypilot_tpu.infer import drafter as drafter_lib
+from skypilot_tpu.infer import kv_wire
 from skypilot_tpu.infer import model as model_lib
 from skypilot_tpu.infer import paged_cache as paged_cache_lib
 from skypilot_tpu.infer import prefix_cache as prefix_cache_lib
@@ -53,6 +54,7 @@ from skypilot_tpu.models import llama
 from skypilot_tpu.observability import stepline as stepline_lib
 from skypilot_tpu.observability import trace
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import prefix_hash
 
 # Back-compat re-export: admission control moved into the scheduler
 # subsystem (infer/sched/), but the server and the lockstep driver
@@ -389,6 +391,34 @@ def init_params_sharded(config: llama.LlamaConfig, tp: int,
     return jax.jit(init, out_shardings=shardings)()
 
 
+class _KVJob:
+    """One queued KV transfer operation (export or import).
+
+    Any thread may enqueue (request_kv_export / request_kv_import);
+    only the STEPPING thread services — the radix tree and page pool
+    are engine-thread-confined, so the job queue is how the HTTP
+    handlers borrow the owner thread instead of racing it. The waiter
+    blocks on the event (the server does so via asyncio.to_thread, off
+    the event loop)."""
+
+    def __init__(self, kind: str, payload: Any,
+                 fetch_s: float = 0.0) -> None:
+        self.kind = kind          # 'export' | 'import'
+        self.payload = payload    # export: token list; import: blob
+        self.fetch_s = fetch_s    # import: upstream fetch wall time
+        self.result: Any = None
+        self.error: Optional[Exception] = None
+        self._done = threading.Event()
+
+    def finish(self, result: Any = None,
+               error: Optional[Exception] = None) -> None:
+        self.result, self.error = result, error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
 class InferenceEngine:
     """Slot-based continuous batching over one model replica."""
 
@@ -449,6 +479,16 @@ class InferenceEngine:
         # bool like the server's ready/dead flags — readers tolerate
         # one stale step.)
         '_sdc_events': '_lock',
+        # Fleet KV transfers: HTTP threads enqueue jobs and read the
+        # published index/counters; the stepping thread pops jobs and
+        # publishes — all handoffs under the lock (the tree and pool
+        # themselves stay engine-thread-confined).
+        '_kv_jobs': '_lock',
+        '_kv_transfers': '_lock',
+        '_kv_transfer_bytes': '_lock',
+        '_kv_transfer_failures': '_lock',
+        '_kv_transfer_window': '_lock',
+        '_kv_index_pub': '_lock',
     }
 
     def __init__(self, config: llama.LlamaConfig, params: llama.Params,
@@ -571,6 +611,21 @@ class InferenceEngine:
         self._attached_slots: set = set()
         if self.ecfg.prefix_cache:
             self.prefix = prefix_cache_lib.PrefixCache(self.allocator)
+        # ---- fleet KV transfer state (docs/serving.md "Disaggregated
+        # prefill/decode"): queued export/import jobs serviced at step
+        # start, transfer counters + a bounded duration window for the
+        # p99, and the last published index snapshot (gen, crc, page,
+        # journal, hashes) the HTTP thread builds wire summaries from.
+        self._kv_jobs: collections.deque = collections.deque()
+        self._kv_transfers = 0
+        self._kv_transfer_bytes = 0
+        self._kv_transfer_failures = 0
+        self._kv_transfer_window: collections.deque = collections.deque(
+            maxlen=512)
+        self._kv_index_pub: tuple = (
+            0, 0,
+            self.allocator.page_size if self.allocator is not None
+            else 0, (), frozenset())
         # slot -> prompt tokens already prefilled (chunked prefill in
         # flight); a slot decodes only once its prompt is fully cached.
         self._prefilling: Dict[int, int] = {}
@@ -1079,6 +1134,202 @@ class InferenceEngine:
             req.cancelled = True
         return True
 
+    # ---- fleet KV transfers (docs/serving.md "Disaggregated
+    # prefill/decode") --------------------------------------------------
+    def kv_index_armed(self) -> bool:
+        """Whether this engine advertises a fleet prefix index."""
+        return self.prefix is not None
+
+    def kv_page_size(self) -> int:
+        """KV page size in tokens (0 when unpaged) — the server's
+        export-cap arithmetic needs it without reaching into cfg."""
+        return self.ecfg.page_size if self.ecfg.paged else 0
+
+    def kv_index_snapshot(self, since_gen: int = -1
+                          ) -> Optional[Dict[str, Any]]:
+        """Wire summary of the radix index for the LB's sync tick,
+        delta-encoded against ``since_gen``. Thread-safe: built from
+        the step loop's published copy, never the live tree. None when
+        the prefix cache is off (the index is unarmed)."""
+        if self.prefix is None:
+            return None
+        with self._lock:
+            gen, crc, page, journal, hashes = self._kv_index_pub
+        return prefix_hash.build_snapshot(gen, crc, page, journal,
+                                          hashes, since_gen)
+
+    def request_kv_export(self, tokens: Sequence[int]) -> _KVJob:
+        """Queue an export of the cached prefix of ``tokens`` (any
+        thread). The stepping thread serializes it at its next step;
+        ``job.result`` is the wire blob, or None when nothing is
+        cached. The donor's refcounts are never touched."""
+        job = _KVJob('export', list(tokens))
+        with self._lock:
+            self._kv_jobs.append(job)
+        return job
+
+    def request_kv_import(self, blob: bytes,
+                          fetch_s: float = 0.0) -> _KVJob:
+        """Queue the import of a transferred prefix blob (any thread).
+        ``fetch_s`` — the upstream pull's wall time — folds into the
+        transfer-duration window so ``kv_transfer_p99_s`` prices the
+        whole pull, not just the local attach."""
+        job = _KVJob('import', blob, fetch_s=fetch_s)
+        with self._lock:
+            self._kv_jobs.append(job)
+        return job
+
+    def note_kv_transfer_failure(self) -> None:
+        """Count a transfer that died before reaching the engine
+        (donor fetch error, stall timeout) — the replica's failure
+        counter covers the whole pull path, not just the attach."""
+        with self._lock:
+            self._kv_transfer_failures += 1
+
+    def kv_transfer_window(self) -> List[float]:
+        """Recent per-transfer durations (bounded window), snapshotted
+        under the lock — same contract as ttft_window."""
+        with self._lock:
+            return list(self._kv_transfer_window)
+
+    def _service_kv_jobs(self) -> None:
+        """Pop and run queued KV transfer jobs, then (re)publish the
+        index snapshot — on the STEPPING thread, which owns the tree
+        and the page pool. The device readback (export) and scatter
+        (import) run OUTSIDE the lock: a transfer must never block
+        submit() on a device sync."""
+        with self._lock:
+            jobs = list(self._kv_jobs)
+            self._kv_jobs.clear()
+        for job in jobs:
+            t0 = time.perf_counter()
+            try:
+                if job.kind == 'export':
+                    result = self._kv_export(job.payload)
+                else:
+                    result = self._kv_import(job.payload)
+            except Exception as exc:
+                # Degrade, never crash the step loop: the caller
+                # recomputes (the fallback contract) and the failure
+                # is counted.
+                with self._lock:
+                    self._kv_transfer_failures += 1
+                job.finish(error=exc)
+                continue
+            if job.kind == 'export' and result is None:
+                job.finish(result=None)   # nothing cached: not a
+                continue                  # transfer, not a failure
+            dur = time.perf_counter() - t0 + job.fetch_s
+            nbytes = (len(result) if job.kind == 'export'
+                      else len(job.payload))
+            with self._lock:
+                self._kv_transfers += 1
+                self._kv_transfer_bytes += nbytes
+                self._kv_transfer_window.append(dur)
+            job.finish(result=result)
+        if self.prefix is not None:
+            pub = self.prefix.publishable()
+            with self._lock:
+                if pub[0] != self._kv_index_pub[0]:
+                    self._kv_index_pub = pub
+
+    def _kv_export(self, tokens: List[int]) -> Optional[bytes]:
+        """Serialize the cached prefix of ``tokens`` into the int8
+        wire format (engine thread). bf16 pools quantize on export
+        with the exact scheme the int8 cache uses on write. Returns
+        None when no prefix is cached. READ-ONLY: no refcount moves,
+        no LRU touch — and no eviction point between the peek and the
+        readback (both on the owner thread within one servicing)."""
+        if self.prefix is None or self.allocator is None:
+            raise ValueError(
+                'KV export requires the paged prefix cache')
+        pages, matched = self.prefix.peek(tokens)
+        if not pages:
+            return None
+        pids = jnp.asarray(np.asarray(pages, np.int32))
+        k = self.cache.k_pages[:, :, pids]
+        v = self.cache.v_pages[:, :, pids]
+        if self.cache.k_scales is not None:
+            kq, vq = np.asarray(k), np.asarray(v)
+            ks = np.asarray(self.cache.k_scales[:, :, pids])
+            vs = np.asarray(self.cache.v_scales[:, :, pids])
+        else:
+            kq, ks = kv_wire.quantize_rows_np(np.asarray(k))
+            vq, vs = kv_wire.quantize_rows_np(np.asarray(v))
+        return kv_wire.pack(tokens[:matched],
+                            self.allocator.page_size, kq, vq, ks, vs)
+
+    def _kv_import(self, blob: bytes) -> int:
+        """Decode, verify, scatter, and graft a transferred prefix
+        (engine thread). Returns pages grafted (0 when everything was
+        already cached locally). Raises WireError on anything corrupt,
+        mismatched, or unsatisfiable — the caller degrades to plain
+        recompute."""
+        if self.prefix is None or self.allocator is None:
+            raise ValueError(
+                'KV import requires the paged prefix cache')
+        blk = kv_wire.unpack(blob)
+        if blk.page_size != self.allocator.page_size:
+            raise kv_wire.WireError(
+                f'page size {blk.page_size} != local '
+                f'{self.allocator.page_size}')
+        if (blk.k.shape[0] != self.config.n_layers
+                or blk.k.shape[1] != self.config.n_kv_heads
+                or blk.k.shape[4] != self.config.head_dim):
+            raise kv_wire.WireError(
+                f'KV geometry {blk.k.shape} does not match this model')
+        page = blk.page_size
+        n = blk.n_pages
+        if len(blk.tokens) != n * page:
+            raise kv_wire.WireError(
+                f'{len(blk.tokens)} tokens do not fill {n} pages')
+        _, have = self.prefix.peek(blk.tokens, whole=True)
+        start = have // page
+        need = n - start
+        if need <= 0:
+            return 0
+        new = self.allocator.alloc_pages(need)
+        if new is None:
+            # Page pressure: lean on the same LRU eviction the local
+            # attach path uses before giving up.
+            self.prefix.evict(need - self.allocator.free_pages)
+            new = self.allocator.alloc_pages(need)
+        if new is None:
+            raise kv_wire.WireError(
+                f'page pool dry ({need} pages needed)')
+        pids = jnp.asarray(np.asarray(new, np.int32))
+        if self.cache.k_scales is not None:
+            # int8 pool: the transferred bytes land verbatim —
+            # byte-exact with what the donor holds.
+            self.cache = paged_cache_lib.PagedKVCache(
+                k_pages=self.cache.k_pages.at[:, :, pids].set(
+                    jnp.asarray(blk.k[:, :, start:])),
+                v_pages=self.cache.v_pages.at[:, :, pids].set(
+                    jnp.asarray(blk.v[:, :, start:])),
+                lengths=self.cache.lengths,
+                k_scales=self.cache.k_scales.at[:, :, pids].set(
+                    jnp.asarray(blk.k_scales[:, :, start:])),
+                v_scales=self.cache.v_scales.at[:, :, pids].set(
+                    jnp.asarray(blk.v_scales[:, :, start:])))
+        else:
+            dt = self.cache.k_pages.dtype
+            kd = jnp.asarray(kv_wire.dequantize_rows_np(
+                blk.k[:, :, start:],
+                blk.k_scales[:, :, start:])).astype(dt)
+            vd = jnp.asarray(kv_wire.dequantize_rows_np(
+                blk.v[:, :, start:],
+                blk.v_scales[:, :, start:])).astype(dt)
+            self.cache = paged_cache_lib.PagedKVCache(
+                k_pages=self.cache.k_pages.at[:, :, pids].set(kd),
+                v_pages=self.cache.v_pages.at[:, :, pids].set(vd),
+                lengths=self.cache.lengths)
+        added = self.prefix.insert_remote(
+            blk.tokens, [None] * start + list(new))
+        assert added == need, (
+            f'import diff went stale on the owner thread: grafted '
+            f'{added} of {need}')
+        return added
+
     # ---- internals -------------------------------------------------------
     def _bucket(self, n: int) -> int:
         for b in self._buckets:
@@ -1574,6 +1825,7 @@ class InferenceEngine:
         The lock guards only the waiting queue — prefill compiles/executes
         on-device and must not block submit() (which HTTP handlers call
         from the event loop)."""
+        self._service_kv_jobs()
         with self._lock:
             self._sweep_dead_requests()
             spec_k = self._spec_k
@@ -2516,7 +2768,11 @@ class InferenceEngine:
                 stepline_dumps=(self._stepline.dumps
                                 if self._sl_on else 0),
                 sdc_events=self._sdc_events,
-                integrity_suspect=self._integrity_suspect)
+                integrity_suspect=self._integrity_suspect,
+                kv_transfers=self._kv_transfers,
+                kv_bytes=self._kv_transfer_bytes,
+                kv_failures=self._kv_transfer_failures,
+                kv_window=list(self._kv_transfer_window))
             return (list(self._ttfts), list(self._queue_waits),
                     self._sched.snapshot(), counters,
                     self.prefix.stats() if self.prefix is not None
@@ -2539,6 +2795,7 @@ class InferenceEngine:
         ttfts = sorted(ttfts_raw)
         p50 = ttfts[len(ttfts) // 2] if ttfts else None
         waits = sorted(waits_raw)
+        kvw = sorted(c['kv_window'])
         return {
             'decode_steps': c['decode_steps'],
             'decode_tokens': c['decode_tokens'],
@@ -2612,6 +2869,17 @@ class InferenceEngine:
             'sdc_events_total': c['sdc_events'],
             'integrity': ('suspect' if c['integrity_suspect']
                           else 'ok'),
+            # Fleet KV streaming (docs/serving.md "Disaggregated
+            # prefill/decode"): transfers this replica took part in
+            # (exports served + imports applied), wire bytes moved,
+            # transfers that died anywhere on the pull path, and the
+            # p99 transfer wall time over a recent window.
+            'kv_transfers_total': c['kv_transfers'],
+            'kv_transfer_bytes': c['kv_bytes'],
+            'kv_transfer_failures': c['kv_failures'],
+            'kv_transfer_p99_s': (round(
+                kvw[min(len(kvw) - 1, int(len(kvw) * 0.99))], 6)
+                if kvw else None),
             **({'paged': True,
                 'page_size': self.allocator.page_size,
                 'pages_total': self.allocator.n_pages,
@@ -2733,6 +3001,42 @@ class EnginePool:
     def step(self) -> int:
         return sum(e.step() for e in self.engines)
 
+    # -- fleet KV transfers: one advertised index per replica, so the
+    # pool delegates to its first prefix-enabled tier (mixed pools are
+    # a transitional config; the paged cache subsumes tiering).
+    def _kv_engine(self) -> 'InferenceEngine':
+        for e in self.engines:
+            if e.prefix is not None:
+                return e
+        raise ValueError('no engine in the pool has a prefix cache')
+
+    def kv_index_armed(self) -> bool:
+        return any(e.prefix is not None for e in self.engines)
+
+    def kv_page_size(self) -> int:
+        return (self._kv_engine().kv_page_size()
+                if self.kv_index_armed() else 0)
+
+    def kv_index_snapshot(self, since_gen: int = -1):
+        if not self.kv_index_armed():
+            return None
+        return self._kv_engine().kv_index_snapshot(since_gen)
+
+    def request_kv_export(self, tokens: Sequence[int]) -> _KVJob:
+        return self._kv_engine().request_kv_export(tokens)
+
+    def request_kv_import(self, blob: bytes,
+                          fetch_s: float = 0.0) -> _KVJob:
+        return self._kv_engine().request_kv_import(blob,
+                                                   fetch_s=fetch_s)
+
+    def note_kv_transfer_failure(self) -> None:
+        self._kv_engine().note_kv_transfer_failure()
+
+    def kv_transfer_window(self) -> 'List[float]':
+        return sorted(x for e in self.engines
+                      for x in e.kv_transfer_window())
+
     def set_pipeline_depth(self, depth: int) -> None:
         for e in self.engines:
             e.set_pipeline_depth(depth)
@@ -2843,6 +3147,8 @@ class EnginePool:
                 'prefix_evictions': sum(p.evictions for p in prefixed),
                 'prefix_hits': hits,
                 'prefix_misses': total - hits,
+                'prefix_indexed_pages': sum(p.indexed_pages
+                                            for p in prefixed),
             }
         waits = sorted(x for e in self.engines
                        for x in e.queue_wait_window())
@@ -2869,9 +3175,19 @@ class EnginePool:
                                       if lanes else None),
             }
         total_prefill = sum(t['prefill_tokens'] for t in tiers)
+        kvw = self.kv_transfer_window()
         return {
             **prefix_agg,
             **spec_agg,
+            'kv_transfers_total': sum(t['kv_transfers_total']
+                                      for t in tiers),
+            'kv_transfer_bytes': sum(t['kv_transfer_bytes']
+                                     for t in tiers),
+            'kv_transfer_failures': sum(t['kv_transfer_failures']
+                                        for t in tiers),
+            'kv_transfer_p99_s': (round(
+                kvw[min(len(kvw) - 1, int(len(kvw) * 0.99))], 6)
+                if kvw else None),
             'decode_steps': total_steps,
             'decode_tokens': total_tokens,
             'decode_tokens_per_sec': (total_tokens / total_time

@@ -172,6 +172,23 @@ class PageAllocator:
         self.version += 1
         return True
 
+    def alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` fresh pages at refcount 1 WITHOUT binding them to
+        a slot — for KV-import (fleet prefix streaming): pulled pages
+        land in the radix tree directly, owned by the tree's reference
+        alone until some slot attaches them. All-or-nothing; returns
+        None when the pool can't cover it (the import degrades to
+        recompute). The caller must hand every returned page to the
+        tree (or decref it) — these pages have no slot to free them."""
+        if n > len(self._free):
+            return None
+        out: List[int] = []
+        for _ in range(n):
+            pid = self._free.pop()
+            self._ref[pid] = 1
+            out.append(pid)
+        return out
+
     # -- reference counting (prefix sharing) -------------------------------
     def incref(self, pid: int) -> None:
         self._ref[pid] += 1

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import List, Optional
 
+import aiohttp
 import jax
 from aiohttp import web
 
@@ -346,9 +347,26 @@ class InferenceServer:
 
     def __init__(self, engine: engine_lib.InferenceEngine,
                  tokenizer: Tokenizer = None, driver=None,
-                 boot_t0: Optional[float] = None) -> None:
+                 boot_t0: Optional[float] = None,
+                 role: str = 'mixed',
+                 kv_pull_timeout_s: float = 10.0,
+                 kv_export_max_pages: int = 64) -> None:
         self.engine = engine
         self.tokenizer = tokenizer or Tokenizer()
+        # Disaggregation role (docs/serving.md "Disaggregated
+        # prefill/decode"): advertised via /metrics so the LB routes
+        # by it. The server itself never refuses work by role — the
+        # LB steers; a mis-routed request still computes correctly.
+        if role not in ('mixed', 'prefill', 'decode'):
+            raise ValueError(f'role must be mixed|prefill|decode, '
+                             f'got {role!r}')
+        self.role = role
+        # KV streaming knobs: donor-pull budget (fetch + attach), and
+        # the largest prefix one export ships (pages beyond the cap
+        # are recomputed locally — bounds donor readback and blob
+        # size).
+        self.kv_pull_timeout_s = kv_pull_timeout_s
+        self.kv_export_max_pages = kv_export_max_pages
         # Cold-start stopwatch origin: process start (main() stamps
         # it) — the compile stamp reports total time-to-serviceable,
         # not just the warm loop.
@@ -468,8 +486,22 @@ class InferenceServer:
         m['draining'] = self.draining
         m['server_inflight'] = self._active
         m['requests_shed'] = self._requests_shed
+        m['role'] = self.role
         if self.drain_duration_s is not None:
             m['drain_duration_s'] = round(self.drain_duration_s, 4)
+        if self.engine.kv_index_armed():
+            # Radix summary for the LB's fleet prefix index
+            # (docs/serving.md "Disaggregated prefill/decode"):
+            # `?prefix_gen=N` is the caller's last-seen generation, so
+            # steady-state ticks carry a tiny journal delta instead of
+            # the full hash list. Rendering rides the same sync-tick
+            # fetch — no extra endpoint, no extra poll.
+            try:
+                since_gen = int(req.query.get('prefix_gen', -1))
+            except ValueError:
+                since_gen = -1
+            m['kv_prefix_index'] = self.engine.kv_index_snapshot(
+                since_gen)
         # `?format=prometheus` wraps the same gauges in text
         # exposition (docs/observability.md "Prometheus exposition");
         # JSON stays the default — the LB sync tick and the bench
@@ -494,6 +526,92 @@ class InferenceServer:
         body = await asyncio.to_thread(_render)
         return web.Response(text=body,
                             content_type='application/json')
+
+    # -- KV prefix streaming (disaggregated prefill/decode) ----------------
+    async def h_kv_export(self, request: web.Request) -> web.Response:
+        """Ship this replica's cached KV pages for a prompt prefix in
+        the int8 on-wire page format (infer/kv_wire.py): the donor half
+        of a fleet-routed prefix transfer. The readback itself runs on
+        the engine thread between steps (request_kv_export), so an
+        export never races a decode dispatch; the handler only waits.
+
+        Responses: 200 + octet-stream blob, 404 when nothing is cached
+        for the prompt (a clean miss — the puller just recomputes), 409
+        when the prefix cache is off, 503 on an engine-side error or a
+        wait past the transfer budget. Every non-200 degrades the
+        puller to plain recompute — never a client-visible error.
+        """
+        if not self.engine.kv_index_armed():
+            return web.json_response(
+                {'error': 'prefix cache disabled'}, status=409)
+        try:
+            body = await request.json()
+            tokens = [int(t) for t in body['tokens']]
+        except (ValueError, UnicodeDecodeError, KeyError, TypeError):
+            # Narrow on purpose (SKY-EXCEPT): resets/cancellations
+            # during the body read must propagate.
+            return web.json_response(
+                {'error': 'need {"tokens": [int, ...]}'}, status=400)
+        cap = self.kv_export_max_pages * (self.engine.kv_page_size()
+                                          or 1)
+        job = self.engine.request_kv_export(tokens[:cap])
+        self._woken.set()
+        done = await asyncio.to_thread(job.wait, self.kv_pull_timeout_s)
+        if not done or job.error is not None:
+            return web.json_response(
+                {'error': 'export failed' if done else 'export timed '
+                 'out'}, status=503)
+        if job.result is None:
+            return web.json_response(
+                {'error': 'no cached prefix'}, status=404)
+        blob = job.result
+        # Chaos seam (docs/robustness.md site catalog): `error` mode
+        # flips payload bytes IN FLIGHT — the importer's per-page CRC
+        # must catch it and the puller must degrade to recompute, which
+        # is exactly what tests/chaos/test_disagg_chaos.py gates.
+        try:
+            failpoints.hit('infer.server.kv_export_corrupt')
+        except failpoints.FailpointError:
+            blob = blob[:-1] + bytes([blob[-1] ^ 0xFF])
+        return web.Response(body=blob,
+                            content_type='application/octet-stream')
+
+    async def _pull_kv(self, donor_url: str, tokens: List[int]) -> None:
+        """Pull the donor's cached prefix and attach it locally before
+        prefilling (the decode half of a fleet-routed transfer).
+        Best-effort end to end: ANY failure — donor unreachable, donor
+        evicted the prefix, stalled link past the budget, CRC mismatch,
+        local page-pool dry — lands on plain recompute; the request
+        never sees an error. A donor 404 is a clean stale-index miss,
+        not a transfer failure."""
+        url = donor_url.rstrip('/') + '/kv/export'
+        t0 = time.monotonic()
+        try:
+            timeout = aiohttp.ClientTimeout(total=self.kv_pull_timeout_s)
+            async with aiohttp.ClientSession(timeout=timeout) as sess:
+                async with sess.post(url,
+                                     json={'tokens': tokens}) as resp:
+                    if resp.status == 404:
+                        return
+                    if resp.status != 200:
+                        self.engine.note_kv_transfer_failure()
+                        return
+                    blob = await resp.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            self.engine.note_kv_transfer_failure()
+            return
+        # Attach on the engine thread (request_kv_import): the fetch
+        # wall time rides along so kv_transfer_p99_s covers the whole
+        # pull, not just the attach.
+        job = self.engine.request_kv_import(
+            blob, fetch_s=time.monotonic() - t0)
+        self._woken.set()
+        done = await asyncio.to_thread(job.wait, self.kv_pull_timeout_s)
+        if not done:
+            # Import errors (CRC, geometry, pool dry) are already
+            # counted by the engine; only a wait past the budget is
+            # ours to count.
+            self.engine.note_kv_transfer_failure()
 
     # -- graceful drain ----------------------------------------------------
     def _enter_drain(self) -> None:
@@ -653,6 +771,17 @@ class InferenceServer:
             return web.json_response(
                 {'error': 'replica draining', 'draining': True},
                 status=503, headers={'Retry-After': '1'})
+        donor = request.headers.get(common_lib.KV_DONOR_HEADER)
+        if (donor and self.driver is None
+                and self.engine.kv_index_armed()):
+            # Fleet-routed miss-with-remote-hit: the LB saw a longer
+            # cached prefix on `donor` than here. Pull those pages
+            # before submit so the prefill below starts from the
+            # transferred boundary (a near-pure prefix-cache hit);
+            # every failure path inside degrades to plain recompute.
+            # Lockstep replicas skip it (per-host page pools would
+            # diverge).
+            await self._pull_kv(donor, tokens)
         try:
             # Admission span parented to the LB's lb.proxy hop (the
             # traceparent header it forwards); decode time is the
@@ -833,6 +962,7 @@ class InferenceServer:
         app.router.add_get('/metrics', self.h_metrics)
         app.router.add_get('/debug/stepline', self.h_stepline)
         app.router.add_post('/generate', self.h_generate)
+        app.router.add_post('/kv/export', self.h_kv_export)
         app.router.add_post('/drain', self.h_drain)
         return app
 
@@ -978,6 +1108,27 @@ def main() -> None:
                              'the replica corrupt (503 /health) until '
                              'it is replaced. Greedy outputs are '
                              'bit-identical either way.')
+    parser.add_argument('--role', default='mixed',
+                        choices=['mixed', 'prefill', 'decode'],
+                        help='Disaggregation role (docs/serving.md '
+                             '"Disaggregated prefill/decode"): '
+                             'advertised via /metrics so the serve LB '
+                             'routes first-chunk work to prefill '
+                             'replicas and steers decode replicas to '
+                             'pull cached KV prefixes from donors. '
+                             'mixed (default) behaves exactly as '
+                             'before.')
+    parser.add_argument('--kv-pull-timeout-s', type=float, default=10.0,
+                        help='Budget for one donor KV pull (fetch + '
+                             'attach) and for serving one /kv/export; '
+                             'past it the request falls back to plain '
+                             'recompute.')
+    parser.add_argument('--kv-export-max-pages', type=int, default=64,
+                        help='Largest cached prefix one /kv/export '
+                             'ships, in KV pages — bounds donor '
+                             'readback time and blob size; tokens '
+                             'past the cap are recomputed by the '
+                             'puller.')
     parser.add_argument('--pipeline-depth', type=int, default=1,
                         help='Dispatch-ahead decode depth: decode N+1 '
                              'is dispatched before step N is read '
@@ -1157,7 +1308,10 @@ def main() -> None:
     tokenizer = Tokenizer(args.tokenizer,
                           vocab_limit=config.vocab_size)
     InferenceServer(engine, tokenizer, driver=driver,
-                    boot_t0=boot_t0).run(args.host, args.port)
+                    boot_t0=boot_t0, role=args.role,
+                    kv_pull_timeout_s=args.kv_pull_timeout_s,
+                    kv_export_max_pages=args.kv_export_max_pages,
+                    ).run(args.host, args.port)
 
 
 if __name__ == '__main__':

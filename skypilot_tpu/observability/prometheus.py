@@ -79,6 +79,19 @@ def lb_exposition() -> Dict[str, Tuple[str, str]]:
             'sky_tpu_lb_probe_failures_total', 'counter'),
         'probe_interval_s': (
             'sky_tpu_lb_probe_interval_seconds', 'gauge'),
+        # Disaggregated prefill/decode (docs/serving.md).
+        'kv_transfers_total': (
+            'sky_tpu_lb_kv_transfers_total', 'counter'),
+        'kv_transfer_bytes': (
+            'sky_tpu_lb_kv_transfer_bytes', 'counter'),
+        'kv_transfer_failures': (
+            'sky_tpu_lb_kv_transfer_failures', 'counter'),
+        'kv_transfer_p99_s': (
+            'sky_tpu_lb_kv_transfer_p99_seconds', 'gauge'),
+        'fleet_prefix_hit_rate': (
+            'sky_tpu_lb_fleet_prefix_hit_rate', 'gauge'),
+        'fleet_prefix_pages': (
+            'sky_tpu_lb_fleet_prefix_pages', 'gauge'),
     }
 
 
@@ -142,6 +155,17 @@ def replica_exposition() -> Dict[str, Tuple[str, str]]:
         # state-set, not a scalar.
         'sdc_events_total': (
             'sky_tpu_engine_sdc_events_total', 'counter'),
+        # Disaggregated prefill/decode (docs/serving.md).
+        'kv_transfers_total': (
+            'sky_tpu_engine_kv_transfers_total', 'counter'),
+        'kv_transfer_bytes': (
+            'sky_tpu_engine_kv_transfer_bytes', 'counter'),
+        'kv_transfer_failures': (
+            'sky_tpu_engine_kv_transfer_failures', 'counter'),
+        'kv_transfer_p99_s': (
+            'sky_tpu_engine_kv_transfer_p99_seconds', 'gauge'),
+        'prefix_indexed_pages': (
+            'sky_tpu_engine_prefix_indexed_pages', 'gauge'),
     }
 
 

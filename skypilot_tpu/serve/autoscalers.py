@@ -251,8 +251,21 @@ class QueueLengthAutoscaler(_HysteresisAutoscaler):
                  replicas: Optional[List[dict]]) -> tuple:
         threshold = self.policy.queue_length_threshold
         assert threshold is not None
-        qlen = (serve_state.get_inflight(self.service_name)
-                + serve_state.get_queue_depth(self.service_name))
+        # Disaggregated pools scale on their OWN signal
+        # (docs/serving.md "Disaggregated prefill/decode"): a prefill
+        # pool's pressure is the engines' scheduler backlog (prompts
+        # queued for first-chunk work), a decode pool's is the
+        # in-flight stream count (decode slots occupied) — summing
+        # both would make each pool chase the other's load. Mixed
+        # (default) keeps the combined signal.
+        role = getattr(self.policy, 'role', 'mixed')
+        if role == 'prefill':
+            qlen = serve_state.get_queue_depth(self.service_name)
+        elif role == 'decode':
+            qlen = serve_state.get_inflight(self.service_name)
+        else:
+            qlen = (serve_state.get_inflight(self.service_name)
+                    + serve_state.get_queue_depth(self.service_name))
         current = self.target_num_replicas
         if qlen == 0:
             desired = self.policy.min_replicas
@@ -264,7 +277,9 @@ class QueueLengthAutoscaler(_HysteresisAutoscaler):
             desired = current
         if desired == 0 and qlen > 0:
             desired = 1
-        return desired, f'queue={qlen} threshold={threshold:g}'
+        sig = {'prefill': 'prefill_backlog',
+               'decode': 'inflight_decode'}.get(role, 'queue')
+        return desired, f'{sig}={qlen} threshold={threshold:g}'
 
 
 class FallbackRequestRateAutoscaler(RequestRateAutoscaler):

@@ -1,0 +1,172 @@
+"""Fleet prefix index: which replica holds which cached KV prefix.
+
+The LB half of disaggregated prefill/decode (docs/serving.md
+"Disaggregated prefill/decode"). Each replica's sync-tick ``/metrics``
+fetch carries a compact radix summary (utils/prefix_hash.build_snapshot
+— chained page-block hashes, CRC-stamped, delta-encoded against the
+LB's last-seen generation); this module folds those into one inverted
+view so ``cache_aware`` routing can send a request to ANY replica
+holding the longest cached prefix of its prompt — not just the
+consistent-hash owner — and name a donor for KV streaming when the
+selected replica holds less than the best one.
+
+Deliberately tolerant: the index is a routing HINT. A stale entry costs
+one wasted transfer attempt that degrades to recompute (the engine
+verifies everything it attaches); a CRC mismatch between the
+delta-maintained mirror and the replica's self-reported fold forces a
+full resync on the next tick, never an error. Single-threaded by
+construction — every touch happens on the LB's event loop (SKY-LOCK
+'event-loop' in the LoadBalancer).
+"""
+from __future__ import annotations
+
+import collections
+import logging
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu.utils import prefix_hash
+
+logger = logging.getLogger(__name__)
+
+# Per-replica mirror cap: a replica's own index is bounded (index_cap
+# in infer/prefix_cache.py, default 4096); this is the LB-side backstop
+# against a misbehaving replica growing the mirror without limit.
+MAX_HASHES_PER_REPLICA = 65536
+
+
+class FleetPrefixIndex:
+    """Per-replica hash-set mirrors + the lookup the selector uses.
+
+    ``apply(url, snap)`` folds one sync-tick snapshot in;
+    ``lookup(chain)`` answers "who holds the longest prefix of this
+    chain, and how deep". Iteration orders are sorted everywhere so two
+    LBs fed the same snapshots give byte-identical answers (the digital
+    twin's decision-log determinism rides on this).
+    """
+
+    def __init__(self) -> None:
+        self._held: Dict[str, Set[int]] = {}
+        self._gen: Dict[str, int] = {}
+        self._page: Dict[str, int] = {}
+        self._role: Dict[str, str] = {}
+        self.resyncs = 0
+
+    # -- maintenance (sync tick) ------------------------------------------
+    def last_gen(self, url: str) -> int:
+        """Generation to ask the replica to delta against (-1 = cold:
+        the replica answers with the full hash list)."""
+        return self._gen.get(url, -1)
+
+    def set_role(self, url: str, role: Optional[str]) -> None:
+        self._role[url] = role if role in ('prefill', 'decode') \
+            else 'mixed'
+
+    def apply(self, url: str, snap: dict) -> None:
+        """Fold one replica snapshot into the mirror. Malformed or
+        CRC-inconsistent snapshots drop the url's state (forcing a full
+        resync next tick) instead of raising — the sync tick must keep
+        serving the rest of the fleet."""
+        try:
+            gen = int(snap['gen'])
+            crc = int(snap['crc'])
+            page = int(snap['page'])
+        except (KeyError, TypeError, ValueError):
+            self.drop(url)
+            return
+        held = self._held.get(url)
+        if 'full' in snap:
+            try:
+                held = {int(h) for h in snap['full']}
+            except (TypeError, ValueError):
+                self.drop(url)
+                return
+        elif 'delta' in snap and held is not None:
+            try:
+                for op, h in snap['delta']:
+                    if op == '+':
+                        held.add(int(h))
+                    else:
+                        held.discard(int(h))
+            except (TypeError, ValueError):
+                self.drop(url)
+                return
+        else:
+            # Delta against state we no longer hold (e.g. just
+            # dropped): resync next tick.
+            self.drop(url)
+            return
+        if (prefix_hash.fold_crc(held) != crc
+                or len(held) > MAX_HASHES_PER_REPLICA):
+            # Mirror drift (lost tick, replica restart reusing gens,
+            # journal bug): drop and resync rather than route on a
+            # wrong map. Worst case before the resync lands is a
+            # wasted transfer attempt — the engine re-verifies
+            # everything.
+            self.resyncs += 1
+            logger.warning('fleet prefix index: CRC mismatch for %s '
+                           '(gen %d) — forcing full resync', url, gen)
+            self.drop(url)
+            return
+        self._held[url] = held
+        self._gen[url] = gen
+        self._page[url] = page
+
+    def drop(self, url: str) -> None:
+        self._held.pop(url, None)
+        self._gen.pop(url, None)
+        self._page.pop(url, None)
+
+    def prune(self, keep: Iterable[str]) -> None:
+        """Replicas leaving the ready set drop their mirror AND role —
+        the breaker's lifetime rule."""
+        alive = set(keep)
+        for url in list(self._held):
+            if url not in alive:
+                self.drop(url)
+        for url in list(self._role):
+            if url not in alive:
+                self._role.pop(url, None)
+
+    # -- queries (request path) -------------------------------------------
+    @property
+    def armed(self) -> bool:
+        """True once any ready replica advertises an index — the
+        switch between fleet-index routing and the legacy
+        consistent-hash-only path."""
+        return bool(self._held)
+
+    @property
+    def page(self) -> int:
+        """The fleet's page size (0 when unarmed): the block length
+        the LB chains request tokens at. Mixed page sizes pick the
+        most common (sorted tie-break) — replicas on another size
+        simply never match, which is correct, just unprofitable."""
+        if not self._page:
+            return 0
+        counts = collections.Counter(self._page.values())
+        return sorted(counts, key=lambda p: (-counts[p], p))[0]
+
+    def role(self, url: str) -> str:
+        return self._role.get(url, 'mixed')
+
+    def role_counts(self) -> Dict[str, int]:
+        c = collections.Counter(self._role.values())
+        return {r: c.get(r, 0) for r in ('prefill', 'decode', 'mixed')}
+
+    def total_pages(self) -> int:
+        return sum(len(h) for h in self._held.values())
+
+    def lookup(self, chain: Sequence[int]
+               ) -> Tuple[int, List[str]]:
+        """Longest indexed prefix across the fleet: (depth in pages,
+        holders at that depth, sorted). (0, []) when nobody holds even
+        the first page."""
+        best = 0
+        holders: List[str] = []
+        for url in sorted(self._held):
+            d = prefix_hash.match_depth(chain, self._held[url])
+            if d > best:
+                best, holders = d, [url]
+            elif d == best and best > 0:
+                holders.append(url)
+        return best, holders

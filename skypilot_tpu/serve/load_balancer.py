@@ -49,9 +49,11 @@ from skypilot_tpu.observability import prometheus as prom_lib
 from skypilot_tpu.observability import slo as slo_lib
 from skypilot_tpu.observability import stepline as stepline_lib
 from skypilot_tpu.observability import trace as trace_lib
+from skypilot_tpu.serve import fleet_index as fleet_index_lib
 from skypilot_tpu.serve import load_balancing_policies as lbp
 from skypilot_tpu.serve import state as serve_state
 from skypilot_tpu.utils import common
+from skypilot_tpu.utils import prefix_hash
 from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils import retry as retry_lib
 from skypilot_tpu.utils import vclock
@@ -261,14 +263,43 @@ class LoadBalancer:
         '_replicas_quarantined': 'event-loop',
         '_quarantined_urls': 'event-loop',
         '_replica_ids': 'event-loop',
+        # Fleet prefix tier (docs/serving.md "Disaggregated
+        # prefill/decode"): the index folds on the sync tick, the
+        # selector reads it per request — both on the loop.
+        'fleet_index': 'event-loop',
+        '_fleet_lookups': 'event-loop',
+        '_fleet_hits': 'event-loop',
+        '_pending_donor': 'event-loop',
     }
+
+    # Per-request chaining cap: at most this many page blocks of the
+    # prompt are hashed for the fleet lookup (the replica-side export
+    # cap bounds what a donor would ship anyway).
+    _CHAIN_LIMIT = 64
 
     def __init__(self, service_name: str, policy_name: str, *,
                  clock: Optional[vclock.Clock] = None,
                  probe_fixture=None, probe_fingerprint=None,
-                 probe_interval_s: Optional[float] = None) -> None:
+                 probe_interval_s: Optional[float] = None,
+                 fleet_routing: Optional[bool] = None) -> None:
         self.service_name = service_name
         self.policy = lbp.make(policy_name)
+        # Fleet prefix tier (docs/serving.md "Disaggregated prefill/
+        # decode"): on by default; SKY_TPU_LB_FLEET_ROUTING=0 (or the
+        # ctor arg — the twin's scenario switch) pins the legacy
+        # owner-only consistent-hash path. The tier only ever acts on
+        # cache_aware + token prompts + an armed index, so "on" is
+        # inert everywhere else.
+        if fleet_routing is None:
+            fleet_routing = os.environ.get(
+                'SKY_TPU_LB_FLEET_ROUTING', '1') != '0'
+        self.fleet_routing = bool(fleet_routing)
+        self.fleet_index = fleet_index_lib.FleetPrefixIndex()
+        self._fleet_lookups = 0
+        self._fleet_hits = 0
+        # Donor handoff between _select and the attempt loop (reset at
+        # every selection; consumed before the next await).
+        self._pending_donor: Optional[str] = None
         # Clock seam (utils/vclock): wall reads (history stamps, dump
         # rate limits) and interval reads (TTFT/ITL stopwatches,
         # deadlines, breaker cooldowns) both route through here so the
@@ -508,6 +539,15 @@ class LoadBalancer:
                         self.policy.set_target_qps_per_accelerator(tq)
             rows = await self._fetch_all_metrics(
                 list(self.policy.ready_urls))
+            # Fleet prefix tier: the radix summary and the replica's
+            # role ride the same fetch — fold them into the index and
+            # POP them so the history rings stay flat scalar rows.
+            for url, _, eff in rows:
+                self.fleet_index.set_role(url, eff.pop('role', None))
+                snap = eff.pop('kv_prefix_index', None)
+                if snap is not None and self.fleet_routing:
+                    self.fleet_index.apply(url, snap)
+            self.fleet_index.prune(info)
             self._replica_queue_depth = {
                 url: depth for url, depth, _ in rows}
             self._replica_decode_stats = {
@@ -552,8 +592,13 @@ class LoadBalancer:
 
     async def _fetch_replica_metrics(self, url: str) -> Optional[tuple]:
         try:
+            # `prefix_gen` asks the replica to delta-encode its radix
+            # summary against our mirror's generation — steady-state
+            # ticks carry a tiny journal, not the full hash list.
+            qs = (f'?prefix_gen={self.fleet_index.last_gen(url)}'
+                  if self.fleet_routing else '')
             async with self._session.get(
-                    url.rstrip('/') + '/metrics',
+                    url.rstrip('/') + '/metrics' + qs,
                     timeout=aiohttp.ClientTimeout(total=2)) as r:
                 if r.status == 200:
                     m = await r.json()
@@ -572,8 +617,22 @@ class LoadBalancer:
                             'decode_tokens',
                             'prefix_hits',
                             'prefix_misses',
-                            'prefix_hit_rate')
+                            'prefix_hit_rate',
+                            # KV streaming counters (docs/serving.md
+                            # "Disaggregated prefill/decode") for the
+                            # fleet rollup.
+                            'kv_transfers_total',
+                            'kv_transfer_bytes',
+                            'kv_transfer_failures',
+                            'kv_transfer_p99_s')
                         if m.get(k) is not None}
+                    # Non-scalar riders for the fleet prefix index —
+                    # the sync tick POPS these before the history
+                    # append.
+                    if m.get('role') is not None:
+                        eff['role'] = m['role']
+                    if m.get('kv_prefix_index') is not None:
+                        eff['kv_prefix_index'] = m['kv_prefix_index']
                     return url, int(m.get('num_waiting') or 0), eff
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError,
                 TypeError, OSError):
@@ -1230,22 +1289,121 @@ class LoadBalancer:
             'probe_failures_total': self._probe_failures,
             'probe_interval_s': self.probe_interval_s,
             'quarantined': sorted(self._quarantined_urls),
+            # Fleet prefix tier (docs/serving.md "Disaggregated
+            # prefill/decode"): LB routing hit rate + the replica KV
+            # streaming counters rolled up from the same sync-tick
+            # fetch that feeds the index.
+            'fleet_prefix_hit_rate': (
+                round(self._fleet_hits / self._fleet_lookups, 4)
+                if self._fleet_lookups else None),
+            'fleet_prefix_pages': self.fleet_index.total_pages(),
+            'kv_transfers_total': sum(
+                int(r.get('kv_transfers_total') or 0)
+                for r in self._replica_decode_stats.values()),
+            'kv_transfer_bytes': sum(
+                int(r.get('kv_transfer_bytes') or 0)
+                for r in self._replica_decode_stats.values()),
+            'kv_transfer_failures': sum(
+                int(r.get('kv_transfer_failures') or 0)
+                for r in self._replica_decode_stats.values()),
+            # Worst replica tail, not a mean of p99s — the fleet's
+            # transfer SLI is its slowest link.
+            'kv_transfer_p99_s': max(
+                (r['kv_transfer_p99_s']
+                 for r in self._replica_decode_stats.values()
+                 if r.get('kv_transfer_p99_s') is not None),
+                default=None),
         }
 
+    def _select_fleet(self, chain: List[int], candidates: List[str]
+                      ) -> Optional[str]:  # holds: event-loop
+        """Fleet-index tier of _select (docs/serving.md "Disaggregated
+        prefill/decode"). Three outcomes: a replica already HOLDING the
+        longest indexed prefix (least-load tiebreak among equal-depth
+        holders, prefill replicas excluded — they donate, not decode);
+        a decode/mixed replica with ``_pending_donor`` armed so it
+        PULLS the prefix from the best holder; or None — no fleet
+        opinion, the caller falls through to the consistent-hash ring
+        and the base policy. Deterministic given equal state: every
+        tiebreak is (load, url)-ordered."""
+        roles = self.fleet_index.role
+        depth, holders = self.fleet_index.lookup(chain)
+        if depth > 0:
+            live = [u for u in holders if u in candidates
+                    and self.breaker.allows(u)]
+            serving = [u for u in live if roles(u) != 'prefill']
+            if serving:
+                best = min(serving, key=lambda u:
+                           (self.policy.load(u), u))
+                # Warm-set expansion: when even the least-loaded
+                # holder is busier than a cold replica, a transfer is
+                # cheaper than queuing behind it — replicate the
+                # prefix onto the least-loaded decode replica (it
+                # pulls from the holder). Under steady load the warm
+                # set grows to the offered concurrency; the replicas'
+                # idle TTL trims it back when load recedes.
+                if self.policy.load(best) > 0:
+                    rest = [u for u in candidates
+                            if u not in serving
+                            and roles(u) != 'prefill'
+                            and self.breaker.allows(u)]
+                    if rest:
+                        grow = min(rest, key=lambda u:
+                                   (self.policy.load(u), u))
+                        if self.policy.load(grow) \
+                                < self.policy.load(best):
+                            # Donor preference: a prefill-pool holder
+                            # (prefill-and-donate is its job — keeps
+                            # export load off busy decode replicas),
+                            # else the holder we would have queued on.
+                            pre = [u for u in holders
+                                   if roles(u) == 'prefill']
+                            self._pending_donor = (
+                                min(pre) if pre else best)
+                            return grow
+                return best
+            # A holder exists but cannot serve (prefill role, tried,
+            # breaker-open): route a decode/mixed replica and have it
+            # pull the prefix from the holder. The donor only answers
+            # /kv/export — it need not be admissible for serving.
+            pool = ([u for u in candidates if roles(u) != 'prefill']
+                    or candidates)
+            admissible = ([u for u in pool if self.breaker.allows(u)]
+                          or pool)
+            self._pending_donor = holders[0]
+            return min(admissible, key=lambda u:
+                       (self.policy.load(u), u))
+        # Cold prefix = first-chunk work: steer it to the prefill pool
+        # (it prefills, caches, and donates from then on). All-mixed
+        # fleets have no pool, so this is a no-op by default.
+        pre = [u for u in candidates
+               if roles(u) == 'prefill' and self.breaker.allows(u)]
+        if pre:
+            return min(pre, key=lambda u: (self.policy.load(u), u))
+        return None
+
     def _select(self, tried: Set[str],
-                affinity: Optional[str] = None) -> Optional[str]:
-        """Pick the next replica: the affinity-preferred replica (the
+                affinity: Optional[str] = None,
+                chain: Optional[List[int]] = None) -> Optional[str]:
+        """Pick the next replica: any replica the fleet prefix index
+        says holds the longest cached prefix of this prompt (see
+        _select_fleet), else the affinity-preferred replica (the
         cache-aware policy's consistent-hash home for this prompt
         prefix) when it is admissible, else the policy's choice if its
         breaker admits it, else the first admissible candidate. If
         EVERY breaker is open, fail open with any untried replica —
         turning a possibly-wrong breaker into a total blackout is worse
         than one wasted probe."""
+        self._pending_donor = None
         candidates = [u for u in self.policy.ready_urls
                       if u not in tried
                       and u not in self._quarantined_urls]
         if not candidates:
             return None
+        if chain and self.fleet_routing and self.fleet_index.armed:
+            pick = self._select_fleet(chain, candidates)
+            if pick is not None:
+                return pick
         if affinity is not None:
             preferred = self.policy.preferred_replica(affinity)
             # Breaker-open (or already-tried) preferred replica: fall
@@ -1576,7 +1734,8 @@ class LoadBalancer:
 
     def _next_url(self, tried: Set[str], affinity: Optional[str],
                   t_deadline: Optional[float],
-                  headers: Dict[str, str]) -> Optional[str]:
+                  headers: Dict[str, str],
+                  chain: Optional[List[int]] = None) -> Optional[str]:
         """Next retry target, deadline-aware: refreshes the forwarded
         deadline header to the REMAINING budget so the next replica's
         engine enforces the same wall-clock cutoff. None when replicas
@@ -1586,13 +1745,15 @@ class LoadBalancer:
             if remaining <= 0:
                 return None
             headers[common.DEADLINE_HEADER] = f'{remaining:.3f}'
-        return self._select(tried, affinity)
+        return self._select(tried, affinity, chain)
 
     async def _next_url_or_wake(self, tried: Set[str],
                                 affinity: Optional[str],
                                 t_deadline: Optional[float],
                                 headers: Dict[str, str],
-                                splice) -> Optional[str]:
+                                splice,
+                                chain: Optional[List[int]] = None
+                                ) -> Optional[str]:
         """Pre-stream retry target with the scale-to-zero fallback: a
         request caught mid-retry while the fleet drains to zero (every
         ready replica failed, NO tokens delivered) parks for the wake
@@ -1600,7 +1761,8 @@ class LoadBalancer:
         immediately, so a few park->reselect cycles may pass before
         the sync loop catches up with reality — cap them so the
         request can't orbit forever."""
-        url = self._next_url(tried, affinity, t_deadline, headers)
+        url = self._next_url(tried, affinity, t_deadline, headers,
+                             chain)
         if url is not None or self._wake_cfg is None:
             return url
         if splice is not None and (splice.resp is not None
@@ -1614,7 +1776,8 @@ class LoadBalancer:
             if not await self._park_for_wake(counted=True):
                 return None
             tried.clear()   # a woken fleet is a NEW fleet
-            url = self._next_url(tried, affinity, t_deadline, headers)
+            url = self._next_url(tried, affinity, t_deadline, headers,
+                                 chain)
             if url is not None:
                 return url
         return None
@@ -1663,14 +1826,45 @@ class LoadBalancer:
                 payload = parsed if isinstance(parsed, dict) else None
             except ValueError:
                 payload = None   # the replica will 400 it
+        # The donor header is LB-internal routing state: never honor a
+        # client-supplied value (a hostile client could point replicas
+        # at arbitrary pull targets).
+        headers.pop(common.KV_DONOR_HEADER, None)
+        # Fleet prefix chain (docs/serving.md "Disaggregated prefill/
+        # decode"): token prompts chain into page-block hashes — the
+        # key space shared with every replica's radix index. Text
+        # prompts (no tokenizer here) stay on the legacy char key.
+        chain: Optional[List[int]] = None
+        if (payload is not None and self.fleet_routing
+                and isinstance(self.policy, lbp.CacheAwarePolicy)):
+            page = self.fleet_index.page
+            toks = payload.get('tokens')
+            if page and isinstance(toks, list) and toks:
+                try:
+                    chain = prefix_hash.chain_hashes(
+                        [int(t) for t in toks], page,
+                        limit=self._CHAIN_LIMIT) or None
+                except (TypeError, ValueError):
+                    chain = None
         # Prefix affinity (cache-aware policy only): same-prefix
         # /generate traffic keeps landing on the same replica so its
         # radix tree actually accumulates hits — keyed from the
-        # already-parsed payload, never a second body parse.
-        affinity = (lbp.affinity_key_from_payload(payload)
-                    if payload is not None
-                    and isinstance(self.policy, lbp.CacheAwarePolicy)
-                    else None)
+        # already-parsed payload, never a second body parse. With the
+        # fleet index armed, the key is the chain hash at the longest
+        # INDEXED match instead of a fixed-length lead block, so
+        # prompts sharing a cached prefix key identically however they
+        # diverge afterwards.
+        affinity: Optional[str] = None
+        if (payload is not None
+                and isinstance(self.policy, lbp.CacheAwarePolicy)):
+            if chain:
+                self._fleet_lookups += 1
+                depth, _ = self.fleet_index.lookup(chain)
+                if depth > 0:
+                    self._fleet_hits += 1
+                affinity = lbp.indexed_affinity_key(chain, depth)
+            else:
+                affinity = lbp.affinity_key_from_payload(payload)
         # Multi-tenant identity (/generate only): the header wins, a
         # 'tenant' body field is the fallback — and is PROMOTED to the
         # header on the forwarded legs so the replica's scheduler sees
@@ -1701,13 +1895,13 @@ class LoadBalancer:
             except ValueError:
                 t_deadline = None   # the replica will 400 it
         tried: Set[str] = set()
-        url = self._select(tried, affinity)
+        url = self._select(tried, affinity, chain)
         if url is None and self._wake_cfg is not None:
             # Scale-to-zero wake (docs/cost.md): park instead of 503.
             # A True wake means the ready set refilled — re-select;
             # False (overflow/timeout) falls through to the shed path.
             if await self._park_for_wake():
-                url = self._select(tried, affinity)
+                url = self._select(tried, affinity, chain)
         if url is None:
             self._requests_no_replica += 1
             if tenant is not None:
@@ -1733,6 +1927,22 @@ class LoadBalancer:
         try:
             while url is not None:
                 current = url
+                donor, self._pending_donor = self._pending_donor, None
+                if donor and donor != current:
+                    try:
+                        # Chaos seam (docs/robustness.md "Site
+                        # catalog"): a stalled/severed transfer link —
+                        # `delay` stalls this leg's dispatch, `error`
+                        # drops the donor so the replica recomputes
+                        # plain (the fallback the twin's reclaim storm
+                        # gates on).
+                        await failpoints.hit_async(
+                            'serve.lb.kv_transfer_stall')
+                        headers[common.KV_DONOR_HEADER] = donor
+                    except failpoints.FailpointError:
+                        headers.pop(common.KV_DONOR_HEADER, None)
+                else:
+                    headers.pop(common.KV_DONOR_HEADER, None)
                 self.policy.pre_execute(current)
                 try:
                     if splice is not None:
@@ -1759,7 +1969,7 @@ class LoadBalancer:
                     tried.add(current)
                     saturated, last_cause = e, None
                     url = self._next_url(tried, affinity, t_deadline,
-                                         headers)
+                                         headers, chain)
                     if url is not None:
                         self._requests_retried += 1
                         logger.info(
@@ -1775,7 +1985,8 @@ class LoadBalancer:
                     tried.add(current)
                     last_cause, saturated = None, None
                     url = await self._next_url_or_wake(
-                        tried, affinity, t_deadline, headers, splice)
+                        tried, affinity, t_deadline, headers, splice,
+                        chain)
                     if url is not None:
                         if (splice.resp is not None
                                 or splice.delivered or splice.resumes):
@@ -1792,7 +2003,8 @@ class LoadBalancer:
                     tried.add(current)
                     last_cause, saturated = e.cause, None
                     url = await self._next_url_or_wake(
-                        tried, affinity, t_deadline, headers, splice)
+                        tried, affinity, t_deadline, headers, splice,
+                        chain)
                     if url is not None:
                         self._requests_retried += 1
                         logger.warning(
@@ -1804,7 +2016,8 @@ class LoadBalancer:
                     tried.add(current)
                     last_cause, saturated = e.cause, None
                     url = await self._next_url_or_wake(
-                        tried, affinity, t_deadline, headers, splice)
+                        tried, affinity, t_deadline, headers, splice,
+                        chain)
                     if url is not None:
                         if (splice.resp is not None
                                 or splice.delivered or splice.resumes):

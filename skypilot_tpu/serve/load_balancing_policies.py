@@ -114,6 +114,12 @@ class LeastLoadPolicy(LoadBalancingPolicy):
             return min(self.ready_urls,
                        key=lambda u: self._inflight.get(u, 0))
 
+    def load(self, url: str) -> int:
+        """In-flight count for ``url`` — the LB's fleet-prefix tier
+        least-load tiebreak among equal-prefix holders reads it."""
+        with self._lock:
+            return self._inflight.get(url, 0)
+
     def pre_execute(self, url: str) -> None:
         with self._lock:
             self._inflight[url] = self._inflight.get(url, 0) + 1
@@ -179,6 +185,19 @@ def affinity_key(path: str, body: bytes) -> Optional[str]:
     if not isinstance(payload, dict):
         return None
     return affinity_key_from_payload(payload)
+
+
+def indexed_affinity_key(chain: List[int], depth: int) -> Optional[str]:
+    """Affinity key when the LB's fleet prefix index is armed: the
+    CHAIN HASH at the longest indexed match (``depth`` pages; the first
+    block for a still-cold prefix). Two prompts sharing the cached
+    prefix but diverging after it key IDENTICALLY — the fixed
+    64-token/256-char lead block (the unarmed fallback below) would
+    split them across ring arcs whenever the shared prefix is shorter
+    than the lead, cooling the very radix paths the cache built."""
+    if not chain:
+        return None
+    return f'idx:{chain[depth - 1 if depth > 0 else 0]:x}'
 
 
 def affinity_key_from_payload(payload: dict) -> Optional[str]:

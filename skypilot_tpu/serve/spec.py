@@ -110,6 +110,15 @@ class ReplicaPolicy:
     # warm) — the overhead the placer's expected-cost formula weights
     # by each zone's observed preemption rate.
     relaunch_overhead_seconds: float = 180.0
+    # Disaggregated prefill/decode (docs/serving.md): every replica of
+    # this service runs with this role — `prefill` replicas absorb
+    # first-chunk (cold-prefix) work and donate cached KV pages,
+    # `decode` replicas pull prefixes from donors and stream tokens,
+    # `mixed` (default) does both and behaves exactly as before. The
+    # LB routes by role + its fleet prefix index; the autoscaler
+    # scales each pool on its own signal (queue depth vs in-flight
+    # decode).
+    role: str = 'mixed'
 
     @classmethod
     def from_config(cls, config: Any) -> 'ReplicaPolicy':
@@ -150,7 +159,13 @@ class ReplicaPolicy:
                 config.get('max_parked_requests', 32)),
             relaunch_overhead_seconds=float(
                 config.get('relaunch_overhead_seconds', 180.0)),
+            role=str(config.get('role', 'mixed')),
         )
+        if pol.role not in ('mixed', 'prefill', 'decode'):
+            raise exceptions.InvalidTaskError(
+                f'replica_policy.role must be one of mixed|prefill|'
+                f'decode, got {pol.role!r} (docs/serving.md '
+                f'"Disaggregated prefill/decode")')
         if pol.min_replicas < 0:
             raise exceptions.InvalidTaskError('min_replicas must be >= 0')
         if pol.min_replicas == 0 and not pol.wake_on_request:

@@ -107,7 +107,9 @@ class VirtualCloud(replica_managers.CloudAdapter):
                  seed: int = 0,
                  market: Optional[Dict[Tuple[str, str], dict]] = None,
                  market_horizon_s: float = 0.0,
-                 reclaim_notice_s: float = 30.0) -> None:
+                 reclaim_notice_s: float = 30.0,
+                 kv_link_gbps: float = 10.0,
+                 kv_transfer_floor_s: float = 0.005) -> None:
         self.kernel = kern
         self.make_replica = make_replica
         self.log = log
@@ -127,6 +129,13 @@ class VirtualCloud(replica_managers.CloudAdapter):
         # the placer decision log byte-identical across replays.
         self.market: Dict[Tuple[str, str], dict] = market or {}
         self.reclaim_notice_s = reclaim_notice_s
+        # KV-transfer latency curve (docs/serving.md "Disaggregated
+        # prefill/decode"): replica-to-replica page streaming pays a
+        # per-transfer floor (connection + header round trip) plus the
+        # serialization time of the int8 pages over the modeled
+        # inter-replica link.
+        self.kv_link_gbps = kv_link_gbps
+        self.kv_transfer_floor_s = kv_transfer_floor_s
         self._billed = {'spot_cost': 0.0, 'ondemand_cost': 0.0,
                         'spot_hours': 0.0, 'ondemand_hours': 0.0}
         if self.market and market_horizon_s > 0:
@@ -151,6 +160,12 @@ class VirtualCloud(replica_managers.CloudAdapter):
     def _gate(self, window: str) -> None:
         if self.crash_gate is not None:
             self.crash_gate(window)
+
+    def kv_transfer_s(self, nbytes: int) -> float:
+        """Virtual seconds one donor-to-puller KV prefix transfer of
+        ``nbytes`` takes: floor + wire time at the link bandwidth."""
+        return (self.kv_transfer_floor_s
+                + nbytes * 8.0 / (self.kv_link_gbps * 1e9))
 
     # ---- CloudAdapter --------------------------------------------------
     def launch(self, task, cluster_name: str, blocked_placements,

@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from skypilot_tpu.infer import sched as sched_lib
 from skypilot_tpu.sim import kernel as kernel_lib
+from skypilot_tpu.utils import prefix_hash
 
 
 class ReplicaShed(Exception):
@@ -154,7 +155,7 @@ class _Req:
                  'cancelled', 'deadline', 'submitted_at',
                  'max_new_tokens', 'resume_len', 'stream',
                  'submit_step', 'first_token_step', 'prefill_left',
-                 'dispatched_at', 'prompt_key')
+                 'dispatched_at', 'prompt_key', 'chain')
 
     def __init__(self, tenant: str, prompt_tokens: List[int],
                  max_new_tokens: int, resume_from: List[int],
@@ -176,6 +177,9 @@ class _Req:
         self.first_token_step: Optional[int] = None
         self.prefill_left = prefill_left
         self.dispatched_at: Optional[float] = None
+        # Chained page hashes of the prompt (fleet KV index key
+        # space); empty when the replica's KV modeling is unarmed.
+        self.chain: List[int] = []
         # The whole greedy continuation is a pure function of the
         # prompt (deterministic resume bit-identity); hash it once.
         self.prompt_key = zlib.crc32(
@@ -245,12 +249,27 @@ def _token(prompt_key: int, index: int) -> int:
 class ModelReplica:
     """One modeled serving replica on the virtual transport."""
 
+    # Modeled radix index bound (mirrors the engine's bounded wire
+    # summary): oldest chains evict first, journaled as removals so
+    # the LB's delta mirror tracks them.
+    MAX_KV_HASHES = 8192
+    _KV_JOURNAL_KEEP = 1024
+    _KV_WINDOW = 256
+
     def __init__(self, kern: kernel_lib.Kernel, url: str, *,
                  scheduler: str = 'fcfs',
                  sched_config: Optional[sched_lib.SchedulerConfig] = None,
                  slots: int = 8,
                  perf: Optional[PerfModel] = None,
-                 on_request_done: Optional[Callable[..., None]] = None
+                 on_request_done: Optional[Callable[..., None]] = None,
+                 role: str = 'mixed',
+                 kv_page: int = 0,
+                 kv_ttl_s: float = 0.0,
+                 kv_bytes_per_token: int = 65536,
+                 kv_pull: Optional[Callable[[str], Any]] = None,
+                 transfer_s: Optional[Callable[[int], float]] = None,
+                 kv_stats: Optional[Dict[str, int]] = None,
+                 on_kv_event: Optional[Callable[..., None]] = None
                  ) -> None:
         self.kernel = kern
         self.url = url
@@ -267,10 +286,39 @@ class ModelReplica:
         self.steps = 0
         self.decode_tokens = 0
         self._step_scheduled = False
+        # Disaggregated prefill/decode modeling (docs/serving.md):
+        # ``kv_page`` 0 keeps the whole plane inert — pre-existing
+        # scenarios replay byte-identically. The modeled radix index
+        # lives in the SAME chained-hash key space as real engines
+        # (utils/prefix_hash.py), so the REAL FleetPrefixIndex folds
+        # it without knowing it is modeled.
+        self.role = role
+        self.kv_page = int(kv_page)
+        # Idle TTL — the model of decode-page-pressure eviction: a
+        # prefix nobody re-touches for ``kv_ttl_s`` virtual seconds is
+        # gone (LRU under allocator pressure, abstracted to idle
+        # lifetime). 0 = never expires.
+        self.kv_ttl_s = float(kv_ttl_s)
+        self.kv_bytes_per_token = int(kv_bytes_per_token)
+        self.kv_pull = kv_pull
+        self.transfer_s = transfer_s
+        self.kv_stats = kv_stats
+        self.on_kv_event = on_kv_event
+        # hash -> last-touch virtual time (insertion-ordered) + the
+        # (gen, op, hash) journal build_snapshot delta-encodes from.
+        self.kv_hashes: Dict[int, float] = {}
+        self.kv_gen = 0
+        self.kv_journal: List[Tuple[int, str, int]] = []
+        self.kv_transfers = 0
+        self.kv_transfer_bytes = 0
+        self.kv_transfer_failures = 0
+        self.kv_transfer_durs: List[float] = []
+        self._kv_pending: List[_Req] = []
 
     # ---- ingress ---------------------------------------------------------
     def submit(self, payload: Dict[str, Any], tenant: str,
-               resume_from: List[int]) -> SimStream:
+               resume_from: List[int],
+               donor: Optional[str] = None) -> SimStream:
         if not self.alive:
             raise ConnectionError(f'{self.url} is dead')
         now = self.kernel.now
@@ -296,9 +344,151 @@ class ModelReplica:
         except sched_lib.AdmissionError as e:
             raise ReplicaShed(429, str(e),
                               retry_after_s=e.retry_after_s) from e
+        if self.kv_page and not self._kv_admit(req, donor):
+            return req.stream   # enqueue deferred behind a KV pull
+        self._enqueue_ready(req)
+        return req.stream
+
+    def _enqueue_ready(self, req: _Req) -> None:
+        """The one enqueue edge — shared by plain admission and the
+        deferred KV-pull path so the kernel thread's scheduler calls
+        stay at a single audited site."""
         self.sched.enqueue(req)
         self._ensure_step()
-        return req.stream
+
+    # ---- KV prefix tier (docs/serving.md "Disaggregated
+    # prefill/decode") ----------------------------------------------------
+    def _kv_stat(self, key: str, n: int = 1) -> None:
+        if self.kv_stats is not None:
+            self.kv_stats[key] = self.kv_stats.get(key, 0) + n
+
+    def _kv_admit(self, req: _Req, donor: Optional[str]) -> bool:
+        """Price the request's prefill against the modeled radix index
+        and (when the LB named a donor holding a longer prefix) start
+        the donor pull. Returns False when the enqueue is deferred
+        until the transfer lands — the caller must NOT enqueue."""
+        req.chain = prefix_hash.chain_hashes(req.prompt_tokens,
+                                             self.kv_page)
+        self._kv_stat('submits')
+        self._kv_sweep()
+        local = prefix_hash.match_depth(req.chain, self.kv_hashes)
+        if local:
+            self._kv_touch(req.chain[:local])
+        if donor is not None and self.kv_pull is not None:
+            dm = self.kv_pull(donor)
+            d_depth = (prefix_hash.match_depth(req.chain, dm.kv_hashes)
+                       if dm is not None and dm.alive else 0)
+            if dm is None or not dm.alive:
+                # The LB routed against a donor that died before the
+                # pull: degrade to recompute, never an error.
+                self.kv_transfer_failures += 1
+                self._kv_stat('failures')
+                self._kv_event(req, donor, ok=False, pages=0)
+            elif d_depth > local:
+                pages = d_depth - local
+                nbytes = pages * self.kv_page * self.kv_bytes_per_token
+                delay = (self.transfer_s(nbytes)
+                         if self.transfer_s is not None else 0.0)
+                self._kv_pending.append(req)
+                self.kernel.call_later(
+                    delay, self._kv_pull_done, req, donor, d_depth,
+                    nbytes, delay)
+                return False
+        if local > 0:
+            self._kv_stat('warm')
+            self._kv_stat('local_warm')
+        self._set_prefill(req, local)
+        return True
+
+    def _kv_pull_done(self, req: _Req, donor: str, d_depth: int,
+                      nbytes: int, dur: float) -> None:
+        """The deferred half of a donor pull: the transfer's virtual
+        latency has elapsed — attach (donor still alive) or fall back
+        to plain recompute (donor died mid-transfer)."""
+        if req not in self._kv_pending:
+            return   # this replica died first; the stream already failed
+        self._kv_pending.remove(req)
+        if not self.alive:
+            return
+        local = prefix_hash.match_depth(req.chain, self.kv_hashes)
+        dm = self.kv_pull(donor) if self.kv_pull is not None else None
+        if dm is None or not dm.alive:
+            # Donor died mid-transfer: recompute from whatever the
+            # local index already covers. Client-invisible by design.
+            self.kv_transfer_failures += 1
+            self._kv_stat('failures')
+            self._kv_event(req, donor, ok=False, pages=d_depth - local)
+        else:
+            depth = max(local,
+                        min(d_depth, prefix_hash.match_depth(
+                            req.chain, dm.kv_hashes)))
+            self._kv_add(req.chain[:depth])
+            self.kv_transfers += 1
+            self.kv_transfer_bytes += nbytes
+            self.kv_transfer_durs.append(dur)
+            del self.kv_transfer_durs[:-self._KV_WINDOW]
+            self._kv_stat('transfers')
+            self._kv_stat('transfer_bytes', nbytes)
+            self._kv_stat('warm')
+            self._kv_event(req, donor, ok=True, pages=depth - local)
+            local = depth
+        self._set_prefill(req, local)
+        self._enqueue_ready(req)
+
+    def _kv_event(self, req: _Req, donor: str, *, ok: bool,
+                  pages: int) -> None:
+        if self.on_kv_event is not None:
+            self.on_kv_event(url=self.url, donor=donor, ok=ok,
+                             pages=pages, tenant=req.tenant)
+
+    def _set_prefill(self, req: _Req, warm_depth: int) -> None:
+        """Re-price the prefill with ``warm_depth`` pages already
+        attached — the boundary-only prefill that makes transfers
+        faster than recompute."""
+        warm = warm_depth * self.kv_page
+        req.prefill_left = max(1, math.ceil(
+            max(0, len(req.prompt_tokens) - warm)
+            / self.perf.prefill_tokens_per_step))
+
+    def _kv_add(self, hashes: List[int]) -> None:
+        """Index chain links (journaled adds), evicting oldest past
+        the bound (journaled removals) — the delta wire the REAL
+        FleetPrefixIndex mirrors."""
+        now = self.kernel.now
+        for h in hashes:
+            if h in self.kv_hashes:
+                self.kv_hashes[h] = now   # refresh idle TTL
+                continue
+            self.kv_hashes[h] = now
+            self.kv_gen += 1
+            self.kv_journal.append((self.kv_gen, '+', h))
+        while len(self.kv_hashes) > self.MAX_KV_HASHES:
+            old = next(iter(self.kv_hashes))
+            del self.kv_hashes[old]
+            self.kv_gen += 1
+            self.kv_journal.append((self.kv_gen, '-', old))
+        del self.kv_journal[:-self._KV_JOURNAL_KEEP]
+
+    def _kv_touch(self, hashes: List[int]) -> None:
+        now = self.kernel.now
+        for h in hashes:
+            if h in self.kv_hashes:
+                self.kv_hashes[h] = now
+
+    def _kv_sweep(self) -> None:
+        """Expire idle prefixes — the model of decode-page-pressure
+        eviction (an untouched prefix loses its pages to the
+        allocator). Journaled like any other removal so the LB mirror
+        converges through the same delta wire."""
+        if self.kv_ttl_s <= 0.0 or not self.kv_hashes:
+            return
+        cutoff = self.kernel.now - self.kv_ttl_s
+        dead = [h for h, t in self.kv_hashes.items() if t < cutoff]
+        for h in dead:
+            del self.kv_hashes[h]
+            self.kv_gen += 1
+            self.kv_journal.append((self.kv_gen, '-', h))
+        del self.kv_journal[:-self._KV_JOURNAL_KEEP]
 
     def _drain_tps(self) -> float:
         if not self.steps:
@@ -340,6 +530,11 @@ class ModelReplica:
                 continue
             if req.prefill_left > 0:
                 req.prefill_left -= 1
+                if req.prefill_left == 0 and req.chain:
+                    # Prefill landed: the prompt's pages are now
+                    # cached here — index the whole chain so the next
+                    # sync tick advertises it fleet-wide.
+                    self._kv_add(req.chain)
                 continue
             self._emit_one(req)
         self._ensure_step()
@@ -393,6 +588,11 @@ class ModelReplica:
         for req in self.active:
             req.stream.fail()
         self.active.clear()
+        # Requests parked behind an in-flight KV pull die with the
+        # replica too (their enqueue never happened).
+        for req in self._kv_pending:
+            req.stream.fail()
+        self._kv_pending.clear()
         while True:
             req = self.sched.pop_next()
             if req is None:
@@ -448,12 +648,32 @@ class ModelReplica:
                 self._finish(req, 'length')
 
     # ---- observability (the LB's /metrics fetch) -------------------------
-    def metrics_row(self) -> Tuple[str, int, Dict[str, Any]]:
+    def metrics_row(self, since_gen: Optional[int] = None
+                    ) -> Tuple[str, int, Dict[str, Any]]:
         """The ``(url, num_waiting, eff)`` row the LB sync tick
-        ingests — same keys the real ``/metrics`` fetch extracts."""
+        ingests — same keys the real ``/metrics`` fetch extracts.
+        ``since_gen`` (the LB mirror's generation) asks for the
+        delta-encoded radix summary, exactly like the real fetch's
+        ``?prefix_gen=`` query."""
         tps = (round(self.decode_tokens / self.steps, 4)
                if self.steps else None)
         eff = {'decode_tokens': self.decode_tokens}
         if tps is not None:
             eff['tokens_per_step'] = tps
+        if self.kv_page:
+            self._kv_sweep()
+            durs = sorted(self.kv_transfer_durs)
+            eff['kv_transfers_total'] = self.kv_transfers
+            eff['kv_transfer_bytes'] = self.kv_transfer_bytes
+            eff['kv_transfer_failures'] = self.kv_transfer_failures
+            if durs:
+                eff['kv_transfer_p99_s'] = round(
+                    durs[min(len(durs) - 1, int(len(durs) * 0.99))], 6)
+            eff['role'] = self.role
+            if since_gen is not None:
+                eff['kv_prefix_index'] = prefix_hash.build_snapshot(
+                    self.kv_gen,
+                    prefix_hash.fold_crc(self.kv_hashes),
+                    self.kv_page, self.kv_journal, self.kv_hashes,
+                    since_gen)
         return self.url, self.sched.pending(), eff

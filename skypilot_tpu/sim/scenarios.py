@@ -132,6 +132,30 @@ class Scenario:
     # scheduler against the sim oracle's golden fixture. None = probes
     # unarmed — every pre-existing scenario replays byte-identically.
     probe_interval_s: Optional[float] = None
+    # Disaggregated prefill/decode (docs/serving.md "Disaggregated
+    # prefill/decode"): ``kv_page`` > 0 arms the modeled KV prefix
+    # tier — replicas index chained page hashes, the REAL
+    # FleetPrefixIndex folds them at the LB, donor pulls ride the
+    # VirtualCloud's transfer-latency curve. 0 keeps every
+    # pre-existing scenario byte-identical. ``prefill_fraction``
+    # carves that share of launches into dedicated prefill replicas
+    # (role-steered by the LB, donors for the decode pool);
+    # ``fleet_routing`` False is the owner-only baseline the hit-rate
+    # gate compares against.
+    kv_page: int = 0
+    kv_bytes_per_token: int = 65536
+    kv_link_gbps: float = 10.0
+    kv_transfer_floor_s: float = 0.005
+    # Idle TTL on a replica's indexed prefixes — the model of
+    # decode-page-pressure eviction (a prefix nobody re-touches loses
+    # its pages to the allocator). 0 = never expires.
+    kv_ttl_s: float = 0.0
+    prefill_fraction: float = 0.0
+    fleet_routing: bool = True
+    # Prefill budget override (tokens per virtual step); None keeps
+    # the PerfModel default. Disagg scenarios lower it so warm-prefix
+    # prefill is measurably cheaper than cold.
+    prefill_tokens_per_step: Optional[float] = None
 
 
 def reclaim_storm(*, replicas: int = 40, duration_s: float = 2400.0,
@@ -463,6 +487,58 @@ def scale_to_zero(*, duration_s: float = 7200.0) -> Scenario:
                           'until': 900.0}})
 
 
+def disagg_fleet(*, replicas: int = 1000, duration_s: float = 3600.0,
+                 fleet_routing: bool = True,
+                 rps: float = 2.0) -> Scenario:
+    """THE disaggregation acceptance gate (docs/serving.md
+    "Disaggregated prefill/decode"): a 1000-replica fleet serving a
+    shared-system-prompt diurnal cohort through the REAL cache-aware
+    LB with the fleet prefix index armed, a 20% spot-reclaim storm
+    landing mid-window so donors die with transfers pending. Run
+    once fleet-routed and once ``fleet_routing=False`` (owner-only
+    consistent hashing, same seed): the gates assert the fleet index
+    at least DOUBLES the warm-prefix rate, TTFT p99 improves, zero
+    client-visible errors ride through the storm (donor-death
+    recompute fallback non-vacuous), and two same-seed replays emit
+    byte-identical decision logs.
+
+    Prompt shape: a 48-token shared system prompt (3 pages at
+    ``kv_page`` 16) on ~nine of ten requests, heavy-tail user tails.
+    48 < the LB's 64-token affinity lead, so the owner-only baseline
+    keys on prefix+tail and SCATTERS the cohort across the ring —
+    each replica sees a cohort request every ~8 virtual minutes,
+    past the 300 s idle TTL (the decode-page-pressure eviction
+    model), so its prefix is cold again.  The fleet index instead
+    keys on the longest indexed chain link and steers to live
+    holders, which stay hot.  ``prefill_tokens_per_step`` 32 makes a
+    cold ~72-token prefill cost ~3 virtual steps and a warm one 1 —
+    the TTFT gap the transfer either buys (fleet) or does not."""
+    storm_t = duration_s * 0.55
+    return Scenario(
+        name='disagg_fleet', replicas=replicas, use_spot=True,
+        duration_s=duration_s, traffic_start_s=600.0,
+        controller_tick_s=60.0, lb_sync_s=30.0, stats_flush_s=45.0,
+        provision_delay_s=(60.0, 240.0), initial_delay_s=480.0,
+        lb_policy='cache_aware', max_queue_requests=64,
+        perf_scale=2.0, prefill_tokens_per_step=32.0,
+        kv_page=16, kv_ttl_s=300.0, prefill_fraction=0.1,
+        fleet_routing=fleet_routing,
+        tenants={'world': {
+            'rps': rps, 'prompt_mean': 48, 'prompt_max': 128,
+            'max_new': 10, 'shared_prefix_frac': 0.9,
+            'prefix_tokens': 48, 'until': duration_s * 0.8,
+            'envelope': {'kind': 'diurnal', 'period_s': duration_s,
+                         'low': 0.3}}},
+        faults=[
+            # Targeted reclaim of the active donor, trapped to land
+            # mid-transfer — the recompute-fallback gate's worst case,
+            # deterministic across seeds (a storm alone only fells
+            # the donor by luck).
+            Fault(t=duration_s * 0.4, kind='donor_reclaim'),
+            Fault(t=storm_t, kind='reclaim_storm', frac=0.2,
+                  notice_frac=0.25, notice_lead_s=120.0)])
+
+
 SCENARIOS = {
     'reclaim_storm': reclaim_storm,
     'flash_crowd': flash_crowd,
@@ -477,4 +553,5 @@ SCENARIOS = {
     'fleet_storm_24h': fleet_storm_24h,
     'spot_market_week': spot_market_week,
     'scale_to_zero': scale_to_zero,
+    'disagg_fleet': disagg_fleet,
 }

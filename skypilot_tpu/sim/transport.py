@@ -93,11 +93,12 @@ class TwinLoadBalancer(lb_lib.LoadBalancer):
     def __init__(self, service_name: str, policy_name: str, *,
                  clock, model_by_url, kernel=None,
                  probe_fixture=None, probe_fingerprint=None,
-                 probe_interval_s=None) -> None:
+                 probe_interval_s=None, fleet_routing=None) -> None:
         super().__init__(service_name, policy_name, clock=clock,
                          probe_fixture=probe_fixture,
                          probe_fingerprint=probe_fingerprint,
-                         probe_interval_s=probe_interval_s)
+                         probe_interval_s=probe_interval_s,
+                         fleet_routing=fleet_routing)
         self._model_by_url = model_by_url
         self._kernel = kernel
 
@@ -153,7 +154,12 @@ class TwinLoadBalancer(lb_lib.LoadBalancer):
         for url in urls:
             model = self._model_by_url(url)
             if model is not None and model.alive:
-                rows.append(model.metrics_row())
+                # Same delta-encoding handshake as the real fetch's
+                # ?prefix_gen= query: the modeled replica snapshots
+                # its radix index against our mirror's generation.
+                since = (self.fleet_index.last_gen(url)
+                         if self.fleet_routing else None)
+                rows.append(model.metrics_row(since_gen=since))
         return rows
 
     async def _proxy_stream_attempt(self, request, url: str,
@@ -171,9 +177,11 @@ class TwinLoadBalancer(lb_lib.LoadBalancer):
                 ConnectionError(f'replica {url} unreachable'))
         resume = list(splice.client_resume) + list(splice.delivered)
         try:
+            # The donor header the REAL handle() armed from the fleet
+            # index rides the virtual wire like any other header.
             stream = model.submit(
                 splice.payload, headers.get(common.TENANT_HEADER),
-                resume)
+                resume, donor=headers.get(common.KV_DONOR_HEADER))
         except replica_lib.ReplicaShed as e:
             raise lb_lib._ReplicaSaturated(  # noqa: SLF001
                 e.status, str(e).encode(),

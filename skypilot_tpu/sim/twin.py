@@ -64,6 +64,10 @@ class SimReport:
         # VirtualCloud billing totals (market scenarios): what the
         # $-saved-at-SLO gate compares across runs.
         self.cost: Dict[str, Any] = {}
+        # KV prefix tier rollup (disagg scenarios): fleet-wide
+        # submit/warm/transfer/failure counters from the modeled
+        # replicas — the hit-rate and fallback gates assert on these.
+        self.kv: Dict[str, Any] = {}
         # End-of-replay control-plane convergence view (captured before
         # the scratch home is torn down): the crash gates compare a
         # killed run's final fleet against the unkilled baseline's.
@@ -184,6 +188,9 @@ class SimReport:
             'scale_targets': self.scale_targets,
             'placements': len(self.placements),
             'cost': self.cost,
+            'kv': self.kv,
+            'fleet_prefix_hit_rate': self.lb_metrics.get(
+                'fleet_prefix_hit_rate'),
             'cold_starts': self.lb_metrics.get('cold_starts_total'),
             'ready_replicas': self.lb_metrics.get('ready_replicas'),
             'lb_ttft_p50_s': self.lb_metrics.get('ttft_p50_s'),
@@ -241,13 +248,29 @@ class DigitalTwin:
         # while the LB is dead.
         self._inflight_calls: Dict[int, _ClientCall] = {}
         self._pending_legs: List[_ClientCall] = []
+        # Disagg role carving: launch-order-deterministic, so the
+        # prefill/decode split is identical across same-seed runs.
+        self._replicas_made = 0
+        self._prefill_made = 0
+        self._kv_stats: Dict[str, int] = {}
+        # One-shot donor trap (the 'donor_reclaim' fault): the next
+        # donor pull after arming gets its donor hard-killed
+        # mid-transfer — the deterministic worst-case race the
+        # recompute fallback exists for.
+        self._donor_trap = False
 
     # ---- pieces --------------------------------------------------------
     def _make_perf(self) -> replica_lib.PerfModel:
         if self.sc.bench_json:
-            return replica_lib.PerfModel.from_bench_json(
+            perf = replica_lib.PerfModel.from_bench_json(
                 self.sc.bench_json, scale=self.sc.perf_scale)
-        return replica_lib.PerfModel.default(scale=self.sc.perf_scale)
+        else:
+            perf = replica_lib.PerfModel.default(
+                scale=self.sc.perf_scale)
+        if self.sc.prefill_tokens_per_step is not None:
+            perf.prefill_tokens_per_step = float(
+                self.sc.prefill_tokens_per_step)
+        return perf
 
     def _log(self, kind: str, **fields: Any) -> None:
         self.report.decisions.append(
@@ -272,9 +295,56 @@ class DigitalTwin:
             max_queue_requests=self.sc.max_queue_requests,
             max_queue_tokens=self.sc.max_queue_tokens,
             tenant_weights=self.sc.tenant_weights)
+        kw: Dict[str, Any] = {}
+        if self.sc.kv_page:
+            # Role carve by launch order: keep the prefill pool at
+            # ``prefill_fraction`` of the fleet as launches accrue.
+            self._replicas_made += 1
+            role = 'mixed'
+            if (self.sc.prefill_fraction > 0
+                    and self._prefill_made < self.sc.prefill_fraction
+                    * self._replicas_made):
+                self._prefill_made += 1
+                role = 'prefill'
+            kw = {
+                'role': role, 'kv_page': self.sc.kv_page,
+                'kv_ttl_s': self.sc.kv_ttl_s,
+                'kv_bytes_per_token': self.sc.kv_bytes_per_token,
+                'kv_pull': self._kv_donor_model,
+                'transfer_s': self._cloud.kv_transfer_s,
+                'kv_stats': self._kv_stats,
+                'on_kv_event': self._on_kv_transfer,
+            }
         return replica_lib.ModelReplica(
             self.kernel, url, scheduler=self.sc.scheduler,
-            sched_config=cfg, slots=self.sc.slots, perf=self._perf)
+            sched_config=cfg, slots=self.sc.slots, perf=self._perf,
+            **kw)
+
+    def _kv_donor_model(self, url: str):
+        """Donor resolver for modeled pulls: the donor's model while
+        its slice is still alive (a reclaimed donor resolves to a
+        dead model — the recompute-fallback path). An armed
+        ``donor_reclaim`` trap reclaims the donor's slice halfway
+        through the transfer floor — the pull was admitted against a
+        live donor and completes against a dead one."""
+        model = self._model_by_url(url)
+        if self._donor_trap and model is not None and model.alive:
+            cluster = next(
+                (k for k in sorted(self._cloud.slices)
+                 if self._cloud.slices[k].url == url
+                 and self._cloud.slices[k].alive), None)
+            if cluster is not None:
+                self._donor_trap = False
+                self.kernel.call_later(
+                    self.sc.kv_transfer_floor_s * 0.5,
+                    self._cloud.hard_kill, cluster)
+        return model
+
+    def _on_kv_transfer(self, **fields: Any) -> None:
+        """Every modeled KV transfer outcome lands in the decision
+        log (the byte-identity surface) — the disagg gates assert
+        transfer and fallback counts from here too."""
+        self._log('kv_transfer', **fields)
 
     def _model_by_url(self, url: str):
         s = self._cloud.by_url.get(url)
@@ -497,7 +567,8 @@ class DigitalTwin:
             self.SERVICE, sc.lb_policy, clock=self.kernel.clock,
             model_by_url=self._model_by_url, kernel=self.kernel,
             probe_fixture=fixture, probe_fingerprint=fingerprint,
-            probe_interval_s=sc.probe_interval_s)
+            probe_interval_s=sc.probe_interval_s,
+            fleet_routing=sc.fleet_routing)
         lb.sync_interval_s = sc.lb_sync_s
         lb.stats_flush_s = sc.stats_flush_s
         lb.slo_transition_hook = self._on_slo_transition
@@ -542,6 +613,14 @@ class DigitalTwin:
                                   notice_lead_s=fault.notice_lead_s)
                 else:
                     cloud.reclaim(s.cluster_name)
+        elif fault.kind == 'donor_reclaim':
+            # Targeted spot reclaim of the active KV donor, timed by
+            # the trap to land mid-transfer (docs/serving.md
+            # "Disaggregated prefill/decode") — makes the gate's
+            # recompute-fallback assertion non-vacuous by
+            # construction instead of by storm luck.
+            self._donor_trap = True
+            self._log('donor_trap_armed')
         elif fault.kind == 'zone_outage':
             cloud.zone_outage(fault.zone)
         elif fault.kind == 'brownout':
@@ -644,6 +723,9 @@ class DigitalTwin:
                     self.report.lb_metrics = self._lb.lb_metrics()
                 if self._cloud is not None:
                     self.report.cost = self._cloud.billing()
+                if self._kv_stats:
+                    self.report.kv = dict(sorted(
+                        self._kv_stats.items()))
                 self.report.final_fleet = self._final_fleet()
         finally:
             if prev_home is None:
@@ -713,7 +795,9 @@ class DigitalTwin:
             log=self._log,
             zones=sc.zones or (sorted(market) or None),
             provision_delay_s=sc.provision_delay_s, seed=self.seed,
-            market=market, market_horizon_s=sc.duration_s)
+            market=market, market_horizon_s=sc.duration_s,
+            kv_link_gbps=sc.kv_link_gbps,
+            kv_transfer_floor_s=sc.kv_transfer_floor_s)
         self._cloud.crash_gate = self._crash_gate
         # Cost-optimized scenarios run the REAL FleetPlacer against a
         # catalog built from the same market the cloud bills — per

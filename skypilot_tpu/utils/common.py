@@ -21,6 +21,14 @@ DEADLINE_HEADER = 'X-SkyTpu-Deadline-S'
 # per-tenant metric breakdown. Absent header = the 'default' tenant.
 # Same placement rationale as DEADLINE_HEADER.
 TENANT_HEADER = 'X-SkyTpu-Tenant'
+# Disaggregated prefill/decode (docs/serving.md): when the serve LB's
+# fleet prefix index knows another replica holds a longer cached prefix
+# of this prompt than the selected replica, it names that donor's URL
+# here; the receiving server pulls the cached KV pages from the donor
+# (/kv/export) before prefilling, so only the boundary is recomputed.
+# Best-effort end to end — any pull failure degrades to plain
+# recompute, never a client-visible error.
+KV_DONOR_HEADER = 'X-SkyTpu-KV-Donor'
 
 
 # Directories base_dir() has already created this process: the call

@@ -1,0 +1,264 @@
+"""Disaggregation chaos: both KV-streaming failpoints, end to end.
+
+Two injection sites (docs/robustness.md "Site catalog") guard the two
+halves of a fleet KV transfer, and both must degrade to plain
+recompute with ZERO client-visible errors:
+
+- ``infer.server.kv_export_corrupt`` — the donor ships a blob whose
+  payload was flipped in flight: the puller's per-page CRC rejects it,
+  the engine counts a transfer failure, and the request recomputes to
+  the exact tokens a clean run produces (real donor + puller
+  InferenceServers over real HTTP).
+- ``serve.lb.kv_transfer_stall`` — the LB-to-donor link is severed at
+  dispatch: the LB drops the donor header instead of forwarding a pull
+  it can't honor, and the selected replica serves the request plain
+  (real LoadBalancer with the fleet index folded from stub replica
+  /metrics).
+"""
+import asyncio
+import http.server
+import json
+import threading
+import time
+
+import pytest
+import requests as req_lib
+
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.utils import common as common_lib
+from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import prefix_hash
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints._reset_for_tests()
+    yield
+    failpoints._reset_for_tests()
+
+
+# ---------- donor corruption (real servers, real HTTP) --------------------
+_P1 = [(i * 7 + 3) % 250 for i in range(40)]     # 2 full pages + tail
+_P2 = [(i * 13 + 5) % 250 for i in range(40)]    # a second cohort
+
+
+def test_corrupt_export_degrades_to_recompute(monkeypatch):
+    """Donor->puller over real HTTP: a clean pull transfers; with
+    `infer.server.kv_export_corrupt=error` armed the CRC rejects the
+    blob, the failure is counted on the puller, and the client still
+    gets the exact recompute tokens. The donor's own counters see both
+    exports."""
+    jax = pytest.importorskip('jax')
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def _server(role):
+        eng = engine_lib.InferenceEngine(
+            cfg, params,
+            engine_lib.EngineConfig(n_slots=3, max_seq_len=128,
+                                    prefill_buckets=(16, 32),
+                                    prefill_chunk=32, paged=True,
+                                    page_size=16, n_pages=13,
+                                    prefix_cache=True,
+                                    kv_dtype='int8'))
+        srv = server_lib.InferenceServer(eng, role=role,
+                                         kv_pull_timeout_s=30.0)
+        srv._thread.start()
+        return srv
+
+    async def flow():
+        donor, puller = _server('prefill'), _server('decode')
+        dts = TestServer(donor.make_app())
+        pts = TestServer(puller.make_app())
+        dc, pc = TestClient(dts), TestClient(pts)
+        await dc.start_server()
+        await pc.start_server()
+        donor_hdr = {common_lib.KV_DONOR_HEADER:
+                     f'http://127.0.0.1:{dts.port}'}
+        try:
+            # Warm both cohorts on the donor; its answers are the
+            # recompute oracles for the puller.
+            oracle = {}
+            for toks in (_P1, _P2):
+                r = await dc.post('/generate',
+                                  json={'tokens': toks,
+                                        'max_new_tokens': 6})
+                assert r.status == 200
+                oracle[tuple(toks)] = (await r.json())['tokens']
+
+            # Clean pull: the transfer lands and the answer matches.
+            r = await pc.post('/generate',
+                              json={'tokens': _P1,
+                                    'max_new_tokens': 6},
+                              headers=donor_hdr)
+            assert r.status == 200
+            assert (await r.json())['tokens'] == oracle[tuple(_P1)]
+            m = await (await pc.get('/metrics')).json()
+            assert m['kv_transfers_total'] >= 1
+            assert m['kv_transfer_failures'] == 0
+            assert m['kv_transfer_p99_s'] > 0
+
+            # Corrupt leg: every byte the donor ships is damaged.
+            monkeypatch.setenv(
+                'SKY_TPU_FAILPOINTS',
+                'infer.server.kv_export_corrupt=error')
+            r = await pc.post('/generate',
+                              json={'tokens': _P2,
+                                    'max_new_tokens': 6},
+                              headers=donor_hdr)
+            assert r.status == 200, 'corrupt donor must not surface'
+            assert (await r.json())['tokens'] == oracle[tuple(_P2)], (
+                'recompute fallback changed greedy output')
+            m = await (await pc.get('/metrics')).json()
+            assert m['kv_transfer_failures'] >= 1, (
+                'CRC rejection was not counted — the failpoint never '
+                'reached the import path')
+            dm = await (await dc.get('/metrics')).json()
+            assert dm['kv_transfers_total'] >= 2   # both exports
+            assert dm['role'] == 'prefill'
+            assert dm['kv_prefix_index']['page'] == 16
+        finally:
+            await pc.close()
+            await dc.close()
+            donor._stop.set()
+            puller._stop.set()
+
+    asyncio.run(flow())
+
+
+# ---------- LB stall (real LoadBalancer, stub replicas) -------------------
+_PAGE = 16
+_TOKS = [(i * 3 + 1) % 250 for i in range(_PAGE + 4)]
+_CHAIN = prefix_hash.chain_hashes(_TOKS, _PAGE)
+
+
+def _stub_replica(role, snap):
+    """A replica the LB can sync against: /metrics advertises the role
+    and (optionally) a radix summary; /generate records the headers it
+    was proxied."""
+    seen = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _json(self, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(200)
+            self.send_header('Content-Type', 'application/json')
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path.startswith('/metrics'):
+                m = {'num_waiting': 0, 'role': role}
+                if snap is not None:
+                    m['kv_prefix_index'] = snap
+                self._json(m)
+            else:
+                self._json({'status': 'ok'})
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get('Content-Length')
+                                or 0))
+            if self.path.endswith('/generate'):
+                seen.append(
+                    self.headers.get(common_lib.KV_DONOR_HEADER))
+            self._json({'tokens': [1, 2, 3], 'done': True})
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(('127.0.0.1', 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, seen
+
+
+def test_lb_transfer_stall_drops_donor_not_request(monkeypatch):
+    """cache_aware LB, fleet index armed via the real sync tick: a
+    holder-behind-prefill-role forces the donor path, so the proxied
+    request carries the donor header — until
+    `serve.lb.kv_transfer_stall=error` severs the link, after which
+    the SAME request shape goes through WITHOUT the header and still
+    succeeds (recompute beats stalling)."""
+    monkeypatch.setenv('SKY_TPU_LB_SYNC_INTERVAL_S', '0.2')
+    snap = {'gen': 1, 'crc': prefix_hash.fold_crc(_CHAIN[:1]),
+            'page': _PAGE, 'full': sorted(_CHAIN[:1])}
+    donor_srv, donor_seen = _stub_replica('prefill', snap)
+    decode_srv, decode_seen = _stub_replica('decode', None)
+    donor_url = f'http://127.0.0.1:{donor_srv.server_address[1]}'
+    decode_url = f'http://127.0.0.1:{decode_srv.server_address[1]}'
+
+    serve_state.add_service('svc-disagg-stall', spec_json='{}',
+                            task_yaml='', lb_port=0,
+                            lb_policy='cache_aware')
+    for i, url in enumerate((donor_url, decode_url)):
+        rid = serve_state.add_replica('svc-disagg-stall',
+                                      f'svc-disagg-stall-r{i}',
+                                      version=1)
+        serve_state.set_replica_url(rid, url)
+        serve_state.set_replica_status(
+            rid, serve_state.ReplicaStatus.READY)
+    lb = lb_lib.LoadBalancer('svc-disagg-stall', 'cache_aware')
+    lb.policy.set_ready_replicas([donor_url, decode_url])
+    port = common_lib.free_port()
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(lb.run('127.0.0.1', port))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    base = f'http://127.0.0.1:{port}'
+    try:
+        # Wait for the sync tick to fold the stub's radix summary.
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                m = req_lib.get(f'{base}/-/metrics', timeout=2).json()
+                if m.get('fleet_prefix_pages'):
+                    break
+            except (req_lib.RequestException, ValueError):
+                pass
+            time.sleep(0.2)
+        else:
+            pytest.fail('fleet index never armed from stub /metrics')
+
+        # Clean: the holder is prefill-role, so the LB routes the
+        # decode replica and names the holder as donor.
+        r = req_lib.post(f'{base}/generate',
+                         json={'tokens': _TOKS}, timeout=10)
+        assert r.status_code == 200
+        assert decode_seen and decode_seen[-1] == donor_url, (
+            'donor header never reached the decode replica — the '
+            'stall leg below would be vacuous')
+        assert not donor_seen, 'prefill holder must donate, not serve'
+
+        # Severed transfer link: header dropped, request unharmed.
+        monkeypatch.setenv('SKY_TPU_FAILPOINTS',
+                           'serve.lb.kv_transfer_stall=error')
+        r = req_lib.post(f'{base}/generate',
+                         json={'tokens': _TOKS}, timeout=10)
+        assert r.status_code == 200
+        assert decode_seen[-1] is None, (
+            'stalled transfer leg still forwarded the donor header')
+        m = req_lib.get(f'{base}/-/metrics', timeout=2).json()
+        assert m['requests_failed'] == 0
+        assert m['fleet_prefix_hit_rate'] == 1.0
+        assert lb.fleet_index.role_counts() == {
+            'prefill': 1, 'decode': 1, 'mixed': 0}
+    finally:
+        lb.stop()
+        t.join(timeout=10)
+        donor_srv.shutdown()
+        donor_srv.server_close()
+        decode_srv.shutdown()
+        decode_srv.server_close()

@@ -1,0 +1,127 @@
+"""Tier-1 gates for disaggregated prefill/decode (docs/serving.md
+"Disaggregated prefill/decode"), replayed against the REAL LB +
+controller in the digital twin:
+
+- the ``disagg_fleet`` acceptance gate: a 1000-replica fleet serving
+  a shared-system-prompt cohort through the REAL cache-aware LB with
+  the fleet prefix index armed AT LEAST DOUBLES the warm-prefix rate
+  of the same trace under owner-only consistent hashing (same seed),
+  and improves TTFT p99 while doing it;
+- resilience: a 20% spot-reclaim storm plus a targeted reclaim of
+  the active KV donor land mid-window — zero client-visible errors
+  ride through both, and the donor-death recompute fallback is
+  asserted NON-VACUOUS (the targeted reclaim fells the donor with a
+  pull in flight, so at least one transfer failure degraded to
+  recompute instead of erroring);
+- determinism: two same-seed fleet-routed replays produce
+  BYTE-IDENTICAL decision logs, KV transfer events included.
+"""
+import logging
+
+import pytest
+
+from skypilot_tpu.sim import DigitalTwin, disagg_fleet
+
+pytestmark = pytest.mark.sim
+
+
+def _run(scenario, seed=3):
+    logging.disable(logging.WARNING)
+    try:
+        return DigitalTwin(scenario, seed=seed).run()
+    finally:
+        logging.disable(logging.NOTSET)
+
+
+@pytest.fixture(scope='module')
+def fleet():
+    return _run(disagg_fleet())
+
+
+@pytest.fixture(scope='module')
+def owner():
+    return _run(disagg_fleet(fleet_routing=False))
+
+
+def _warm_rate(rep):
+    return rep.kv.get('warm', 0) / rep.kv['submits']
+
+
+def test_fleet_index_doubles_warm_prefix_rate(fleet, owner):
+    """THE perf gate: same trace, same seed — routing by the fleet
+    prefix index must at least double the fraction of requests whose
+    prefill starts from a cached prefix. Owner-only consistent
+    hashing scatters the cohort (its 48-token shared prefix is
+    shorter than the 64-token affinity lead, so every tail lands on
+    a different ring arc) and each replica's prefix expires idle."""
+    assert fleet.kv['submits'] > 2000, 'trace too thin to prove anything'
+    # Same trace in both runs (kv submits differ by a handful of
+    # replica-side retries, so compare the client-level record count).
+    assert len(fleet.records) == len(owner.records), (
+        'the two runs replayed different traces — not comparable')
+    fleet_rate, owner_rate = _warm_rate(fleet), _warm_rate(owner)
+    assert fleet_rate >= 2.0 * owner_rate, (
+        f'fleet index did not double the warm-prefix rate: '
+        f'{fleet_rate:.3f} vs owner-only {owner_rate:.3f}')
+    # The LB-side routing SLI agrees: most fleet lookups found a
+    # holder, and the folded index holds real pages.
+    assert fleet.lb_metrics['fleet_prefix_hit_rate'] >= 0.5
+    assert fleet.lb_metrics['fleet_prefix_pages'] > 0
+    # Owner-only never consulted the index.
+    assert owner.lb_metrics['fleet_prefix_hit_rate'] is None
+
+
+def test_ttft_p99_improves(fleet, owner):
+    """Warm boundary-only prefill is the whole point: the fleet run's
+    TTFT p99 must beat owner-only on the same trace."""
+    assert (fleet.lb_metrics['ttft_p99_s']
+            < owner.lb_metrics['ttft_p99_s']), (
+        f"fleet {fleet.lb_metrics['ttft_p99_s']} vs "
+        f"owner {owner.lb_metrics['ttft_p99_s']}")
+    assert (fleet.lb_metrics['ttft_p50_s']
+            <= owner.lb_metrics['ttft_p50_s'])
+
+
+def test_zero_client_errors_through_storms(fleet, owner):
+    """A 20% reclaim storm plus the targeted donor reclaim: every
+    degradation must be client-invisible (retries, resumes,
+    recompute) in BOTH routing modes."""
+    assert not fleet.client_errors, fleet.client_errors[:3]
+    assert not owner.client_errors, owner.client_errors[:3]
+    assert fleet.reclaim_kills > 100, 'the storm never landed'
+
+
+def test_donor_death_fallback_non_vacuous(fleet):
+    """The recompute fallback actually ran: the targeted donor
+    reclaim fells the donor with a pull in flight, so at least one
+    transfer failed and degraded — and transfers still succeeded
+    around it (the tier is live, not dead)."""
+    assert fleet.kv.get('failures', 0) >= 1, (
+        'no donor-death fallback exercised — the zero-error gate '
+        'above is vacuous for the transfer path')
+    assert fleet.kv.get('transfers', 0) > 5
+    events = [d for d in fleet.decisions if d['kind'] == 'kv_transfer']
+    assert any(not d['ok'] for d in events), events
+    assert any(d['ok'] for d in events)
+    # The LB rolled the replica-side failure counters up through the
+    # sync tick (docs/observability.md).
+    assert fleet.lb_metrics['kv_transfers_total'] > 0
+    assert fleet.lb_metrics['kv_transfer_failures'] >= 1
+    assert fleet.lb_metrics['kv_transfer_p99_s'] > 0
+
+
+def test_roles_carved_and_steered(fleet):
+    """The prefill pool exists (role carve) and donates: modeled
+    transfers name a donor, and the pullers are decode-side."""
+    events = [d for d in fleet.decisions if d['kind'] == 'kv_transfer']
+    assert events and all(d['donor'] for d in events)
+    assert all(d['url'] != d['donor'] for d in events)
+
+
+def test_disagg_replay_is_deterministic(fleet):
+    """Same seed => byte-identical decision logs, KV transfer events
+    and donor-trap reclaim included — the disagg plane inherits the
+    twin's determinism contract."""
+    again = _run(disagg_fleet())
+    assert fleet.decision_log_jsonl() == again.decision_log_jsonl()
+    assert [d for d in again.decisions if d['kind'] == 'kv_transfer']

@@ -1,0 +1,231 @@
+"""Fleet prefix index + chained page-block hashes + the affinity-key
+regression (docs/serving.md "Disaggregated prefill/decode").
+
+Host-only: the whole LB half of disaggregation is hashlib + dict
+plumbing by design, so these tests pin its contracts without a device
+or an engine — chain commitment, delta/full snapshot folding,
+CRC-forced resyncs, prune-on-leave, deterministic lookups, and the
+cache_aware affinity-key switch (indexed chain hash when the index is
+armed, the legacy 64-token/256-char lead block as the unarmed
+fallback).
+"""
+import pytest
+
+from skypilot_tpu.serve import fleet_index as fi
+from skypilot_tpu.serve import load_balancing_policies as lbp
+from skypilot_tpu.utils import prefix_hash
+
+PAGE = 16
+
+
+def _snap(hashes, page=PAGE, gen=None, **extra):
+    s = {'gen': len(hashes) if gen is None else gen,
+         'crc': prefix_hash.fold_crc(hashes), 'page': page,
+         'full': sorted(hashes)}
+    s.update(extra)
+    return s
+
+
+# ---------- prefix_hash ---------------------------------------------------
+def test_chain_commits_to_entire_prefix():
+    """h_i equality iff the FULL prefix through page i is equal: a
+    divergence at block 0 changes every later link even when the later
+    blocks' tokens match."""
+    a = list(range(64))
+    b = [99] + a[1:]
+    ca = prefix_hash.chain_hashes(a + [1], PAGE)
+    cb = prefix_hash.chain_hashes(b + [1], PAGE)
+    assert len(ca) == 4
+    assert all(x != y for x, y in zip(ca, cb))
+    # Shared head, diverging tail: links agree exactly through the
+    # shared pages and never after.
+    c = a[:32] + [7] * 32
+    cc = prefix_hash.chain_hashes(c + [1], PAGE)
+    assert cc[:2] == ca[:2] and cc[2:] != ca[2:]
+
+
+def test_chain_boundary_rule_matches_radix_cap():
+    """Capped at the last full page STRICTLY before the prompt end —
+    the PrefixCache.match rule — so an exact-multiple prompt hashes
+    one link short, and ``limit`` bounds per-request work."""
+    toks = list(range(48))
+    assert len(prefix_hash.chain_hashes(toks, PAGE)) == 2
+    assert len(prefix_hash.chain_hashes(toks + [0], PAGE)) == 3
+    assert prefix_hash.chain_hashes([], PAGE) == []
+    assert len(prefix_hash.chain_hashes(toks + [0], PAGE, limit=1)) == 1
+
+
+def test_match_depth_stops_at_first_miss():
+    chain = prefix_hash.chain_hashes(list(range(80)) + [1], PAGE)
+    assert prefix_hash.match_depth(chain, set(chain)) == 5
+    assert prefix_hash.match_depth(chain, set(chain[:2])) == 2
+    # A held deeper link without its ancestors never matches (the
+    # chain is walked from the root).
+    assert prefix_hash.match_depth(chain, {chain[3]}) == 0
+    assert prefix_hash.match_depth(chain, set()) == 0
+
+
+def test_fold_crc_is_order_independent_set_digest():
+    hs = [prefix_hash.block_hash(0, [i]) for i in range(5)]
+    assert prefix_hash.fold_crc(hs) == prefix_hash.fold_crc(hs[::-1])
+    assert prefix_hash.fold_crc(hs) != prefix_hash.fold_crc(hs[:-1])
+    assert prefix_hash.fold_crc([]) == 0
+
+
+def test_build_snapshot_delta_vs_full():
+    hashes = {10, 20, 30}
+    journal = [(2, '+', 20), (3, '+', 30), (4, '-', 40)]
+    # Covered consumer: ops after since_gen only.
+    snap = prefix_hash.build_snapshot(4, 0, PAGE, journal, hashes, 2)
+    assert snap['delta'] == [['+', 30], ['-', 40]]
+    # Up to date: empty delta, not a full dump.
+    assert prefix_hash.build_snapshot(4, 0, PAGE, journal, hashes,
+                                      4)['delta'] == []
+    # Cold (-1) or lapsed (journal no longer reaches since_gen+1):
+    # deterministic full list.
+    for since in (-1, 0):
+        snap = prefix_hash.build_snapshot(4, 0, PAGE, journal, hashes,
+                                          since)
+        assert snap['full'] == sorted(hashes)
+
+
+# ---------- FleetPrefixIndex ----------------------------------------------
+def test_apply_full_then_delta_and_lookup():
+    idx = fi.FleetPrefixIndex()
+    assert not idx.armed and idx.page == 0
+    assert idx.last_gen('http://a') == -1
+
+    toks = list(range(64)) + [1]
+    chain = prefix_hash.chain_hashes(toks, PAGE)
+    idx.apply('http://a', _snap(chain[:2], gen=2))
+    idx.apply('http://b', _snap(chain, gen=4))
+    assert idx.armed and idx.page == PAGE
+    assert idx.last_gen('http://a') == 2
+    assert idx.total_pages() == 6
+
+    # Deepest holder wins; ties list every holder, sorted.
+    assert idx.lookup(chain) == (4, ['http://b'])
+    assert idx.lookup(chain[:2]) == (2, ['http://a', 'http://b'])
+    assert idx.lookup([12345]) == (0, [])
+
+    # Delta fold: 'a' grows one link, CRC over the new set.
+    idx.apply('http://a', {
+        'gen': 3, 'crc': prefix_hash.fold_crc(chain[:3]),
+        'page': PAGE, 'delta': [['+', chain[2]]]})
+    assert idx.last_gen('http://a') == 3
+    assert idx.lookup(chain[:3]) == (3, ['http://a', 'http://b'])
+
+
+def test_crc_mismatch_forces_full_resync():
+    idx = fi.FleetPrefixIndex()
+    idx.apply('http://a', _snap([1, 2, 3]))
+    assert idx.last_gen('http://a') == 3
+    # A delta whose result doesn't fold to the advertised CRC (mirror
+    # drift): drop, count, resync next tick — never route on it.
+    idx.apply('http://a', {'gen': 4, 'crc': 999, 'page': PAGE,
+                           'delta': [['+', 4]]})
+    assert idx.resyncs == 1
+    assert idx.last_gen('http://a') == -1       # full list next tick
+    assert idx.lookup([1]) == (0, [])
+
+
+def test_malformed_and_uncovered_snapshots_drop_not_raise():
+    idx = fi.FleetPrefixIndex()
+    idx.apply('http://a', _snap([5]))
+    idx.apply('http://a', {'gen': 'x'})          # malformed: drop
+    assert idx.last_gen('http://a') == -1
+    # Delta against state the LB no longer holds: drop for resync.
+    idx.apply('http://a', {'gen': 2, 'crc': 0, 'page': PAGE,
+                           'delta': []})
+    assert idx.last_gen('http://a') == -1
+    # Replica overflowing the per-replica mirror cap is dropped too.
+    big = list(range(fi.MAX_HASHES_PER_REPLICA + 1))
+    idx.apply('http://a', _snap(big))
+    assert idx.last_gen('http://a') == -1 and not idx.armed
+
+
+def test_prune_drops_mirror_and_role():
+    idx = fi.FleetPrefixIndex()
+    idx.apply('http://a', _snap([1]))
+    idx.apply('http://b', _snap([2]))
+    idx.set_role('http://a', 'prefill')
+    idx.set_role('http://b', 'decode')
+    idx.set_role('http://c', 'bogus')            # unknown -> mixed
+    assert idx.role('http://c') == 'mixed'
+    assert idx.role_counts() == {'prefill': 1, 'decode': 1, 'mixed': 1}
+    idx.prune(['http://b'])
+    assert idx.last_gen('http://a') == -1
+    assert idx.role('http://a') == 'mixed'       # default after prune
+    assert idx.lookup([2]) == (1, ['http://b'])
+    assert idx.role_counts() == {'prefill': 0, 'decode': 1, 'mixed': 0}
+
+
+def test_fleet_page_majority_with_sorted_tiebreak():
+    idx = fi.FleetPrefixIndex()
+    idx.apply('http://a', _snap([1], page=16))
+    idx.apply('http://b', _snap([2], page=32))
+    assert idx.page == 16                        # tie -> smaller page
+    idx.apply('http://c', _snap([3], page=32))
+    assert idx.page == 32                        # majority
+
+
+# ---------- affinity-key regression (the cache_aware switch) --------------
+def test_indexed_key_unifies_what_lead_block_splits():
+    """The regression satellite: a 48-token shared prefix with
+    diverging tails. The legacy 64-token lead block keys the two
+    requests DIFFERENTLY (they scatter across ring arcs — the unarmed
+    fallback, pinned here); the armed fleet index keys both on the
+    chain hash at the longest indexed match, so they land together."""
+    shared = [(i * 11 + 5) % 250 for i in range(48)]
+    pay_a = {'tokens': shared + [1, 2, 3, 4] * 8}
+    pay_b = {'tokens': shared + [9, 8, 7] * 11}
+
+    key_a = lbp.affinity_key_from_payload(pay_a)
+    key_b = lbp.affinity_key_from_payload(pay_b)
+    assert key_a != key_b, (
+        'lead-block fallback changed: 48 shared + divergent tail '
+        'inside the 64-token lead must split (this is WHY the fleet '
+        'index exists)')
+    assert key_a.startswith('tok:') and key_a.count(',') == \
+        lbp.AFFINITY_LEAD_TOKENS - 1
+
+    chain_a = prefix_hash.chain_hashes(pay_a['tokens'], PAGE)
+    chain_b = prefix_hash.chain_hashes(pay_b['tokens'], PAGE)
+    idx = fi.FleetPrefixIndex()
+    idx.apply('http://a', _snap(chain_a[:3]))    # the 48-token prefix
+    da, _ = idx.lookup(chain_a)
+    db, _ = idx.lookup(chain_b)
+    assert da == db == 3
+    assert (lbp.indexed_affinity_key(chain_a, da)
+            == lbp.indexed_affinity_key(chain_b, db)
+            == f'idx:{chain_a[2]:x}')
+
+
+def test_indexed_key_cold_prefix_keys_on_first_block():
+    """Nobody holds the prefix yet (depth 0): key on the FIRST chain
+    link so the cohort still converges on one arc and warms it."""
+    chain = prefix_hash.chain_hashes(list(range(80)) + [1], PAGE)
+    assert lbp.indexed_affinity_key(chain, 0) == f'idx:{chain[0]:x}'
+    assert lbp.indexed_affinity_key([], 0) is None
+
+
+def test_legacy_text_and_token_fallbacks_pinned():
+    assert (lbp.affinity_key_from_payload({'prompt': 'x' * 300})
+            == 'txt:' + 'x' * lbp.AFFINITY_LEAD_CHARS)
+    assert lbp.affinity_key_from_payload({'prompt': ''}) is None
+    assert lbp.affinity_key_from_payload({}) is None
+    short = {'tokens': [4, 5, 6]}
+    assert lbp.affinity_key_from_payload(short) == 'tok:4,5,6'
+
+
+def test_lookup_is_deterministic_across_insertion_orders():
+    """Two LBs fed the same snapshots in different orders answer
+    identically — the twin's decision-log determinism rides on it."""
+    chain = prefix_hash.chain_hashes(list(range(64)) + [1], PAGE)
+    urls = [f'http://r{i}' for i in range(5)]
+    a, b = fi.FleetPrefixIndex(), fi.FleetPrefixIndex()
+    for u in urls:
+        a.apply(u, _snap(chain[:2]))
+    for u in reversed(urls):
+        b.apply(u, _snap(chain[:2]))
+    assert a.lookup(chain) == b.lookup(chain) == (2, sorted(urls))

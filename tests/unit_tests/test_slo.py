@@ -476,6 +476,9 @@ def _full_lb_metrics():
         'cold_starts_total': 2, 'cold_start_p50_s': 84.0,
         'replicas_quarantined': 1, 'probe_failures_total': 2,
         'probe_interval_s': 15.0,
+        'kv_transfers_total': 4, 'kv_transfer_bytes': 65536,
+        'kv_transfer_failures': 1, 'kv_transfer_p99_s': 0.4,
+        'fleet_prefix_hit_rate': 0.75, 'fleet_prefix_pages': 96,
         'quarantined': ['http://r3:1'],
         'draining': ['http://r2:1'],
         'tenants': {'web': {'requests_total': 5, 'requests_shed': 1,
@@ -641,6 +644,9 @@ def test_replica_metrics_prometheus_format_end_to_end():
             return {'decode_steps': 3, 'num_waiting': 1,
                     'tenants': {'web': {'queue_depth': 1}}}
 
+        def kv_index_armed(self):
+            return False
+
     srv = infer_server.InferenceServer.__new__(
         infer_server.InferenceServer)
     srv.engine = _FakeEngine()
@@ -648,6 +654,7 @@ def test_replica_metrics_prometheus_format_end_to_end():
     srv._active = 0
     srv._requests_shed = 0
     srv.drain_duration_s = None
+    srv.role = 'mixed'
 
     class _Req:
         def __init__(self, query):
