@@ -6,7 +6,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke sim-crash-sweep slo-smoke cost-smoke integrity-smoke disagg-smoke golden-refresh
+.PHONY: lint lint-full lint-json test-analysis bench-ttft profile-smoke sim-smoke sim-crash-sweep slo-smoke cost-smoke integrity-smoke disagg-smoke golden-refresh incident-smoke simulate-smoke
 
 lint:
 	$(PYTHON) -m skypilot_tpu.client.cli lint --changed
@@ -24,7 +24,7 @@ test-analysis:
 # steps"): long-prompt aggressor mid-decode-batch, victim ITL fused vs
 # unfused, plus the kv-dtype residency axis. Override e.g.
 # `make bench-ttft TTFT_ARGS='--model 1b --slots 16'`.
-TTFT_OUT ?= TTFT_r07.json
+TTFT_OUT ?= auto
 TTFT_ARGS ?= --model tiny --slots 8 --concurrency 4 8
 
 bench-ttft:
@@ -88,6 +88,24 @@ integrity-smoke:
 # decision-log byte mismatch between the two runs.
 disagg-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.sim --scenario disagg_fleet --verify-determinism
+
+# Incident-replay smoke (docs/simulation.md "Incident replay"): run
+# the cold-start-crush + reclaim-storm scenario in the digital twin
+# with the flight recorder armed, export the triggering slo_page
+# fleet dump to a versioned incident trace, replay it, and fail
+# unless the replay reproduces the recorded page-alert classes in
+# the recorded order, two same-seed exports are byte-identical, and
+# two same-seed replays produce byte-identical decision logs.
+incident-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.observability.incident
+
+# What-if simulation smoke (docs/simulation.md "What-if API"):
+# synthesize a loadgen trace, round-trip it through the versioned
+# trace format, run `sky-tpu simulate` headless twice with the same
+# seed (must match byte for byte), then a one-knob sweep with ranked
+# results and per-run decision-log digests.
+simulate-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m skypilot_tpu.sim.whatif
 
 # Re-mint the golden-probe fixture store
 # (skypilot_tpu/observability/golden_probes.json) after a model,

@@ -28,6 +28,7 @@ import json
 import multiprocessing
 import os
 import random
+import re
 import statistics
 import subprocess
 import sys
@@ -967,6 +968,32 @@ def _run_disagg_sweep(args) -> dict:
     }
 
 
+_REVISION_RE = re.compile(r'^TTFT_r(\d+)\.json$')
+
+
+def _resolve_output(output: Optional[str],
+                    clobber: bool) -> Optional[str]:
+    """Bench artifacts are an append-only revision series:
+    ``--output auto`` derives the next free ``TTFT_rNN.json`` from
+    the files that actually exist (max + 1 — a hard-coded revision
+    arg once overwrote r08 between r07 and r09), and an explicit
+    path that already exists is refused unless ``--clobber`` says the
+    overwrite is intentional."""
+    if not output:
+        return output
+    if output == 'auto':
+        revs = [int(m.group(1)) for m in
+                (_REVISION_RE.match(name) for name in os.listdir('.'))
+                if m]
+        return f'TTFT_r{(max(revs) + 1 if revs else 1):02d}.json'
+    if os.path.exists(output) and not clobber:
+        raise SystemExit(
+            f'refusing to overwrite existing {output!r} '
+            f'(pass --clobber to allow, or --output auto for the '
+            f'next free revision)')
+    return output
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--requests-per-level', type=int, default=80)
@@ -1103,8 +1130,18 @@ def main() -> None:
                              'bench time (cached under ~/.sky_tpu) — '
                              'the 128k-vocab serving lane without a '
                              '24 MB file in the repo.')
-    parser.add_argument('--output', default=None)
+    parser.add_argument('--output', default=None,
+                        help="result json path. 'auto' derives the "
+                             'next free TTFT_rNN.json from the files '
+                             'already present (r08 was once lost to '
+                             'an out-of-order hard-coded arg); an '
+                             'explicit existing path refuses to '
+                             'clobber without --clobber.')
+    parser.add_argument('--clobber', action='store_true',
+                        help='allow --output to overwrite an '
+                             'existing file')
     args = parser.parse_args()
+    args.output = _resolve_output(args.output, args.clobber)
     if args.sweep == 'shared-prefix':
         args.paged = True
         args.prefix_cache = True

@@ -592,6 +592,191 @@ def cost_cmd(lb_url: str, as_json: bool) -> None:
                       if p50 is not None else ''))
 
 
+@cli.group('incident')
+def incident() -> None:
+    """Incident replay plane (docs/simulation.md): convert
+    flight-recorder anomaly dumps into replayable twin scenarios."""
+
+
+@incident.command('list')
+def incident_list() -> None:
+    """List exportable flight-recorder dumps in the span store."""
+    from skypilot_tpu.observability import incident as incident_lib
+    from skypilot_tpu.observability import store as store_lib
+
+    dumps = incident_lib.list_dumps(store_lib.SpanStore())
+    if not dumps:
+        click.echo('No flight-recorder dumps. Dumps appear after an '
+                   'anomaly (slo_page, breaker_open, quarantine, '
+                   'engine stepline triggers).')
+        return
+    fmt = '{:36} {:14} {:>8}'
+    click.echo(fmt.format('DUMP', 'TRIGGER', 'SPANS'))
+    for d in dumps:
+        click.echo(fmt.format(d['dump_id'], d['trigger'] or '-',
+                              d['n_spans']))
+
+
+@incident.command('export')
+@click.argument('dump_id')
+@click.option('--output', '-o', default=None,
+              help='Incident trace path (default '
+                   '<dump-id>.incident.jsonl).')
+def incident_export(dump_id: str, output: Optional[str]) -> None:
+    """Export a flight-recorder dump as a versioned incident trace.
+
+    DUMP_ID is a span-store dump trace id (or unique prefix) from
+    `sky-tpu incident list` / `sky-tpu profile`. The exported JSONL
+    carries the reconstructed arrival process and inferred fault
+    timeline, scrubbed to lengths + cohort hashes — no prompt
+    content. Replay it with `sky-tpu incident replay` or commit it
+    under tests/sim/incidents/ as a permanent regression gate.
+    """
+    from skypilot_tpu.observability import incident as incident_lib
+    from skypilot_tpu.observability import store as store_lib
+
+    try:
+        trace = incident_lib.trace_from_spans(
+            incident_lib.find_dump(store_lib.SpanStore(), dump_id))
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    path = output or f"{trace.meta.get('dump_id', dump_id)}" \
+                     f'.incident.jsonl'
+    from skypilot_tpu.sim import tracefmt
+    tracefmt.save(trace, path)
+    click.echo(f'wrote {path}: trigger='
+               f"{trace.meta.get('trigger')}, "
+               f'{len(trace.requests)} request(s), '
+               f'{len(trace.faults)} fault(s), '
+               f'{len(trace.kills)} kill(s)')
+    if trace.truncated:
+        # No-silent-caps: a wrapped evidence ring makes a PARTIAL
+        # incident — say exactly how much history fell off.
+        click.echo(
+            f'WARNING: evidence rings wrapped before the dump — '
+            f"{trace.meta.get('dropped_request_events', 0)} request "
+            f'event(s) and '
+            f"{trace.meta.get('dropped_fleet_events', 0)} fleet "
+            f'event(s) fell off; the trace is marked '
+            f'truncated: true')
+
+
+@incident.command('replay')
+@click.argument('trace_file')
+@click.option('--seed', default=0, show_default=True)
+@click.option('--json', 'as_json', is_flag=True,
+              help='Machine-readable verdict JSON.')
+def incident_replay(trace_file: str, seed: int,
+                    as_json: bool) -> None:
+    """Replay an exported incident in the digital twin and verify the
+    recorded anomaly class reproduces (same page-alert sequence)."""
+    import json as json_lib
+
+    from skypilot_tpu.observability import incident as incident_lib
+    from skypilot_tpu.sim import tracefmt
+
+    try:
+        trace = tracefmt.load(trace_file)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    report = incident_lib.replay(trace, seed=seed)
+    problems = incident_lib.verify_replay(trace, report)
+    if as_json:
+        click.echo(json_lib.dumps({
+            'reproduced': not problems, 'problems': problems,
+            'recorded_page_firing':
+                trace.meta.get('expected_page_firing') or [],
+            'summary': report.summary()}, indent=1, sort_keys=True))
+    else:
+        click.echo(f'replayed {len(report.records)} request(s), '
+                   f'{len(report.slo_alerts)} alert transition(s)')
+        for p in problems:
+            click.echo(f'PROBLEM: {p}')
+        click.echo('reproduced: ' + ('yes' if not problems else 'NO'))
+    if problems:
+        sys.exit(1)
+
+
+@cli.command('simulate')
+@click.option('--spec', 'spec_path', default=None,
+              help='Service YAML whose replica_policy/'
+                   'load_balancing_policy/slo sections override the '
+                   "trace's recorded config (optional `sim:` section "
+                   'for twin-only knobs).')
+@click.option('--trace', 'trace_path', required=True,
+              help='Trace file: a loadgen trace (replayed verbatim) '
+                   'or an exported incident (arrival process + fault '
+                   'timeline reconstruction).')
+@click.option('--seed', default=0, show_default=True)
+@click.option('--sweep', 'sweep_arg', default=None,
+              help='One-knob sweep key=v1,v2,... over Scenario '
+                   'fields (e.g. slots=4,8 or lb_sync_s=5,15); '
+                   'emits a ranked table with per-run decision-log '
+                   'digests.')
+@click.option('--json', 'as_json', is_flag=True,
+              help='Raw summary JSON instead of the report.')
+def simulate_cmd(spec_path: Optional[str], trace_path: str,
+                 seed: int, sweep_arg: Optional[str],
+                 as_json: bool) -> None:
+    """What-if simulation (docs/simulation.md): run a recorded trace
+    through the digital twin headless and report SLO burn, shed/
+    resume/quarantine counts, autoscaler churn, and metered cost —
+    deterministically per seed."""
+    import json as json_lib
+
+    from skypilot_tpu.sim import tracefmt
+    from skypilot_tpu.sim import whatif
+
+    try:
+        trace = tracefmt.load(trace_path)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    spec: dict = {}
+    if spec_path:
+        import yaml as yaml_lib
+        with open(os.path.expanduser(spec_path),
+                  encoding='utf-8') as f:
+            doc = yaml_lib.safe_load(f) or {}
+        spec = doc.get('service') or doc
+    try:
+        scenario = whatif.scenario_from_spec(spec, trace)
+        if sweep_arg:
+            key, values = whatif.parse_sweep(sweep_arg)
+            rows = whatif.run_sweep(scenario, key, values, seed=seed)
+            if as_json:
+                click.echo(json_lib.dumps(rows, indent=1,
+                                          sort_keys=True))
+            else:
+                click.echo(whatif.sweep_table(rows))
+            return
+        summary = whatif.run_simulate(scenario, seed=seed)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    if as_json:
+        click.echo(json_lib.dumps(summary, indent=1, sort_keys=True))
+        return
+    click.echo(f"scenario {summary['scenario']} @ seed {seed}: "
+               f"{summary['requests']} request(s), "
+               f"{summary['completed']} completed, "
+               f"{summary['shed']} shed, "
+               f"{summary['client_errors']} client error(s), "
+               f"{summary['resumed']} resumed, "
+               f"{summary['quarantines']} quarantine(s)")
+    slo = summary['slo']
+    click.echo(f"SLO: page firing {slo['page_firing'] or 'none'}; "
+               f"alerts by tier {slo['alerts_by_tier'] or '{}'}")
+    auto = summary['autoscaler']
+    click.echo(f"autoscaler: {auto['launches']} launch(es), "
+               f"{auto['drains']} drain(s), churn {auto['churn']} "
+               f"over targets {auto['targets'] or '[]'}")
+    if summary['cost']:
+        click.echo(f"cost: {summary['cost']}")
+    click.echo(f"ttft: p50 {summary['ttft_p50_s']} "
+               f"p99 {summary['ttft_p99_s']}")
+    click.echo(f"decision log sha256: "
+               f"{summary['decision_log_sha256']}")
+
+
 @cli.command('show-accelerators')
 @click.option('--filter', 'name_filter', default=None)
 def show_accelerators(name_filter: Optional[str]) -> None:

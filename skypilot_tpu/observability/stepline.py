@@ -408,6 +408,14 @@ def dump_spans(trigger: str, detail: Dict[str, Any],
         'status': 'ok',
         'attrs': {'trigger': trigger, 'steps': len(steps),
                   'events': len(events),
+                  # Monotonic ring totals ride along so an exporter
+                  # can tell how much history wrapped off the rings
+                  # before the dump (no-silent-caps: a truncated
+                  # incident must say so; docs/simulation.md).
+                  'steps_total': int(snapshot.get('steps_total')
+                                     or len(steps)),
+                  'events_total': int(snapshot.get('events_total')
+                                      or len(events)),
                   'request_id': detail.get('request_id'), **detail},
     }, {
         'trace_id': trace_id, 'span_id': os.urandom(8).hex(),
@@ -439,11 +447,22 @@ def dump_spans(trigger: str, detail: Dict[str, Any],
 
 
 def fleet_history_spans(trigger: str, detail: Dict[str, Any],
-                        history: Dict[str, List[Dict[str, Any]]]
+                        history: Dict[str, List[Dict[str, Any]]],
+                        *,
+                        request_events: List[Dict[str, Any]] = (),
+                        request_events_total: int = 0,
+                        fleet_events: List[Dict[str, Any]] = (),
+                        fleet_events_total: int = 0
                         ) -> List[Dict[str, Any]]:
     """The LB-tier analog of :func:`dump_spans`: one span per
     retained per-replica history sample (``breaker_open`` is the
-    trigger that snapshots the fleet)."""
+    trigger that snapshots the fleet), plus the LB's incident-replay
+    evidence rings (docs/simulation.md) — one ``fleet.request`` span
+    per retained scrubbed request record and one ``fleet.event`` span
+    per retained fleet event (replica joins/losses, breaker edges,
+    quarantines, SLO transitions). The root carries the monotonic
+    ring totals so an exporter can report how many records wrapped
+    off before the dump (no-silent-caps)."""
     trace_id = 'stepline-fleet-' + os.urandom(10).hex()
     now = time.time()
     root_id = os.urandom(8).hex()
@@ -452,7 +471,14 @@ def fleet_history_spans(trigger: str, detail: Dict[str, Any],
         'name': 'stepline.fleet_dump', 'hop': 'serve-lb',
         'start': now, 'dur_s': 0.0, 'status': f'anomaly:{trigger}',
         'attrs': {'trigger': trigger,
-                  'replicas': sorted(history), **detail},
+                  'replicas': sorted(history),
+                  'request_events': len(request_events),
+                  'request_events_total': int(request_events_total
+                                              or len(request_events)),
+                  'fleet_events': len(fleet_events),
+                  'fleet_events_total': int(fleet_events_total
+                                            or len(fleet_events)),
+                  **detail},
     }]
     for url, rows in history.items():
         for row in rows:
@@ -465,6 +491,17 @@ def fleet_history_spans(trigger: str, detail: Dict[str, Any],
                 'attrs': {'replica': url,
                           **{k: v for k, v in row.items()
                              if k != 't'}},
+            })
+    for name, rows in (('fleet.request', request_events),
+                       ('fleet.event', fleet_events)):
+        for row in rows:
+            spans.append({
+                'trace_id': trace_id, 'span_id': os.urandom(8).hex(),
+                'parent_id': root_id,
+                'name': name, 'hop': 'serve-lb',
+                'start': row.get('t', now), 'dur_s': 0.0,
+                'status': 'ok',
+                'attrs': {k: v for k, v in row.items() if k != 't'},
             })
     return spans
 

@@ -35,6 +35,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import contextlib
+import hashlib
 import json
 import logging
 import os
@@ -270,6 +271,15 @@ class LoadBalancer:
         '_fleet_lookups': 'event-loop',
         '_fleet_hits': 'event-loop',
         '_pending_donor': 'event-loop',
+        # Incident-replay evidence rings (docs/simulation.md):
+        # appended from handle() and the sync tick, snapshotted into
+        # fleet dumps — all on the loop.
+        '_request_events': 'event-loop',
+        '_fleet_events': 'event-loop',
+        '_prev_ready': 'event-loop',
+        '_recoveries_seen': 'event-loop',
+        '_quarantine_pending': 'event-loop',
+        '_quarantine_dump_at': 'event-loop',
     }
 
     # Per-request chaining cap: at most this many page blocks of the
@@ -284,6 +294,7 @@ class LoadBalancer:
                  fleet_routing: Optional[bool] = None) -> None:
         self.service_name = service_name
         self.policy = lbp.make(policy_name)
+        self._policy_name = policy_name
         # Fleet prefix tier (docs/serving.md "Disaggregated prefill/
         # decode"): on by default; SKY_TPU_LB_FLEET_ROUTING=0 (or the
         # ctor arg — the twin's scenario switch) pins the legacy
@@ -426,6 +437,30 @@ class LoadBalancer:
         # Fleet economics gauges flushed by the controller
         # (state.get_cost_gauges), refreshed on the sync tick.
         self._cost_gauges: Optional[Dict[str, float]] = None
+        # Incident-replay evidence rings (docs/simulation.md): one
+        # SCRUBBED record per /generate arrival (lengths + a one-way
+        # prefix-cohort hash — never token ids, so an exported
+        # incident carries no prompt content) and one record per
+        # fleet event (replica joins/losses, breaker edges,
+        # quarantines, SLO transitions, controller recoveries). Both
+        # snapshot into every fleet dump; the monotonic Ring totals
+        # make wraparound truncation observable at export.
+        self._request_events = stepline_lib.Ring(HISTORY_LEN * 4)
+        self._fleet_events = stepline_lib.Ring(HISTORY_LEN * 2)
+        # Ready-set of the previous sync tick — the edge detector for
+        # replica_ready/replica_lost fleet events. None until the
+        # first tick: a bootstrap (or crash-restarted) LB must not
+        # record the whole fleet as "joining".
+        self._prev_ready: Optional[Set[str]] = None
+        # Controller crash watch: recoveries_total from the service
+        # row (PR 14 journal), sampled on the spec-reload cadence — a
+        # delta is a controller crash-recovery inside the incident
+        # window.
+        self._recoveries_seen: Optional[int] = None
+        # Quarantine edges owed a fleet dump (deferred, never
+        # dropped — the breaker-edge rate-limit rule).
+        self._quarantine_pending: Set[str] = set()
+        self._quarantine_dump_at = 0.0
         self.breaker = retry_lib.CircuitBreaker(
             failure_threshold=int(os.environ.get(
                 'SKY_TPU_LB_BREAKER_THRESHOLD', '3')),
@@ -511,6 +546,22 @@ class LoadBalancer:
             self.breaker.prune(info)
             self._draining_urls = await self._offload(
                 serve_state.draining_replica_urls, self.service_name)
+            # Ready-set edges → fleet events (incident-replay
+            # evidence): losses use the PREVIOUS tick's id map — the
+            # departed url is gone from `info`. The first tick only
+            # sets the baseline (a bootstrap rebuild is not an
+            # incident).
+            ready_now = set(info)
+            if self._prev_ready is not None:
+                for url in sorted(ready_now - self._prev_ready):
+                    self._fleet_event(
+                        'replica_ready', replica=url,
+                        replica_id=info[url]['replica_id'])
+                for url in sorted(self._prev_ready - ready_now):
+                    self._fleet_event(
+                        'replica_lost', replica=url,
+                        replica_id=self._replica_ids.get(url))
+            self._prev_ready = ready_now
             self._replica_ids = {
                 url: row['replica_id'] for url, row in info.items()}
             # Quarantine exclusion set: the DB rows are authoritative,
@@ -574,6 +625,7 @@ class LoadBalancer:
             self._cost_gauges = await self._offload(
                 serve_state.get_cost_gauges, self.service_name)
             await self._dump_breaker_edges()
+            await self._dump_quarantine_edges(now)
         except Exception:  # noqa: BLE001 — keep serving on DB hiccup
             logger.warning('replica sync failed', exc_info=True)
 
@@ -639,6 +691,101 @@ class LoadBalancer:
             pass
         return None
 
+    # -- flight-recorder evidence rings (docs/simulation.md) ---------------
+    def _fleet_event(self, kind: str, **fields) -> None:
+        """Append one control-plane event to the fleet-event ring —
+        the fault-timeline half of an exported incident (the request
+        ring is the arrival half). Timestamps go through the clock
+        seam so twin-grown incidents carry virtual time."""
+        self._fleet_events.append(
+            {'t': round(self._clock.time(), 6), 'kind': kind,
+             **fields})
+
+    def _fleet_dump_spans(self, trigger: str, detail: dict) -> list:
+        """One fleet dump, incident-export grade: the per-replica
+        metrics history PLUS both evidence rings and the LB config the
+        converter needs to rebuild a Scenario (policy, cadences, SLO
+        objectives). Every anomaly dump goes through here so
+        `sky-tpu incident export` works on any of them."""
+        detail = dict(detail)
+        detail.update({
+            'lb_policy': self._policy_name,
+            'sync_interval_s': self.sync_interval_s,
+            'probe_interval_s': self.probe_interval_s,
+            'slo_cfg': self._slo_cfg or [],
+        })
+        return stepline_lib.fleet_history_spans(
+            trigger, detail,
+            {u: list(r) for u, r in self._replica_history.items()},
+            request_events=self._request_events.snapshot(),
+            request_events_total=self._request_events.total,
+            fleet_events=self._fleet_events.snapshot(),
+            fleet_events_total=self._fleet_events.total)
+
+    def _note_request_event(self, payload: Dict[str, object],
+                            tenant: Optional[str],
+                            t_deadline: Optional[float],
+                            t_arrival: float) -> Dict[str, object]:
+        """Record one /generate arrival into the request ring,
+        SCRUBBED at capture time: lengths and a one-way prefix-cohort
+        hash, never token ids or text — an exported incident carries
+        no prompt content by construction, not by a later filter
+        step. Returns the (mutable) ring record so the terminal paths
+        can fill in the outcome; the dump renderer copies attrs at
+        dump time, so a still-in-flight request exports with
+        ``outcome: null``."""
+        toks = payload.get('tokens')
+        if isinstance(toks, list) and toks:
+            prompt_tokens = len(toks)
+            # Same cohort semantics as sim.tracefmt.cohort_key
+            # (inlined: serve/ must not import sim/ — the twin
+            # imports serve/). The only contract is "same leading
+            # block ⇒ same cohort", which materialization relies on.
+            try:
+                head = json.dumps(
+                    [int(t) for t in toks[:16]]).encode()
+                cohort = hashlib.blake2s(
+                    head, digest_size=6).hexdigest()
+            except (TypeError, ValueError):
+                cohort = None
+        else:
+            text = payload.get('prompt')
+            prompt_tokens = (max(1, len(text) // 4)
+                             if isinstance(text, str) else 1)
+            cohort = None
+        try:
+            max_new = int(payload.get('max_new_tokens') or 0) or None
+        except (TypeError, ValueError):
+            max_new = None
+        rec: Dict[str, object] = {
+            't': round(self._clock.time(), 6),
+            'tenant': tenant,
+            'prompt_tokens': prompt_tokens,
+            'max_new_tokens': max_new,
+            'cohort': cohort,
+            'stream': bool(payload.get('stream')),
+            'deadline_s': (round(t_deadline - t_arrival, 6)
+                           if t_deadline is not None else None),
+            'outcome': None,
+            'output_tokens': None,
+            'resumes': 0,
+        }
+        self._request_events.append(rec)
+        return rec
+
+    @staticmethod
+    def _finish_event(rec: Optional[Dict[str, object]], outcome: str,
+                      splice=None) -> None:
+        """Stamp a request ring record's terminal outcome (first
+        writer wins — the splice-exhausted path can race the deadline
+        check)."""
+        if rec is None or rec.get('outcome') is not None:
+            return
+        rec['outcome'] = outcome
+        if splice is not None:
+            rec['output_tokens'] = len(splice.delivered)
+            rec['resumes'] = splice.resumes
+
     async def _dump_breaker_edges(self) -> None:
         """breaker_open anomaly: on a closed→open EDGE, snapshot the
         whole fleet metrics history into the span store (the black
@@ -664,6 +811,13 @@ class LoadBalancer:
                     | self._breaker_pending)
         if not new_open:
             return
+        # Ring entries are written per EDGE, before the dump rate
+        # limit: a deferred dump must still carry the true trip time,
+        # not the time the rate limiter finally let it through.
+        for url in sorted((open_now - self._breaker_open_seen)
+                          - self._breaker_pending):
+            self._fleet_event('breaker_open', replica=url,
+                              replica_id=self._replica_ids.get(url))
         now = self._clock.time()
         min_s = stepline_lib.dump_interval_s()
         if min_s > 0 and now - self._breaker_dump_at < min_s:
@@ -676,9 +830,8 @@ class LoadBalancer:
         self._breaker_dump_at = now
         self._breaker_pending = set()
         self._breaker_open_seen |= new_open & open_now
-        spans = stepline_lib.fleet_history_spans(
-            'breaker_open', {'replicas_open': sorted(new_open)},
-            {u: list(r) for u, r in self._replica_history.items()})
+        spans = self._fleet_dump_spans(
+            'breaker_open', {'replicas_open': sorted(new_open)})
         await self._offload(stepline_lib.write_dump_sync, spans)
 
     # -- golden-probe canaries (docs/robustness.md "Data integrity") -------
@@ -814,6 +967,9 @@ class LoadBalancer:
             return
         self._replicas_quarantined += 1
         self._quarantined_urls.add(url)
+        self._fleet_event('quarantine', replica=url, replica_id=rid,
+                          reason=reason)
+        self._quarantine_pending.add(url)
         logger.warning(
             'replica %d (%s) QUARANTINED: %s — draining from routing '
             'and replacing', rid, url, reason)
@@ -838,6 +994,8 @@ class LoadBalancer:
                 tr['burn_long'])
             if self.slo_transition_hook is not None:
                 self.slo_transition_hook(tr)
+            self._fleet_event('slo_alert', objective=tr['objective'],
+                              tier=tr['tier'], state=tr['state'])
             if tr['tier'] == 'page' and tr['state'] == 'firing':
                 self._slo_pending.add(tr['objective'])
 
@@ -935,9 +1093,25 @@ class LoadBalancer:
             return
         firing, self._slo_pending = sorted(self._slo_pending), set()
         self._slo_dump_at = now
-        spans = stepline_lib.fleet_history_spans(
-            'slo_page', {'objectives': firing},
-            {u: list(r) for u, r in self._replica_history.items()})
+        spans = self._fleet_dump_spans(
+            'slo_page', {'objectives': firing})
+        await self._offload(stepline_lib.write_dump_sync, spans)
+
+    async def _dump_quarantine_edges(self, now: float) -> None:
+        """Quarantine evidence dump (docs/robustness.md "Data
+        integrity"): same owed-edge rate-limit rule as breaker/SLO
+        dumps — a deferred quarantine dump lands on a later tick, the
+        replica names ride in the pending set."""
+        if not self._quarantine_pending:
+            return
+        min_s = stepline_lib.dump_interval_s()
+        if min_s > 0 and now - self._quarantine_dump_at < min_s:
+            return
+        urls, self._quarantine_pending = (
+            sorted(self._quarantine_pending), set())
+        self._quarantine_dump_at = now
+        spans = self._fleet_dump_spans(
+            'quarantine', {'replicas_quarantined': urls})
         await self._offload(stepline_lib.write_dump_sync, spans)
 
     # -- scale-to-zero parking (docs/cost.md "Scale to zero") --------------
@@ -967,6 +1141,19 @@ class LoadBalancer:
             # _load_slo rule): a DB hiccup retries next tick.
             self._wake_reload_tick = (self._sync_tick
                                       + self._SLO_RELOAD_TICKS)
+            # Controller crash-recoveries (PR 14 journal) surface as
+            # `recoveries_total` deltas on the service row we just
+            # read anyway — a free flight-recorder signal, so an
+            # exported incident's timeline shows the control-plane
+            # crash between the reclaim and the page.
+            rec_total = int((record or {}).get('recoveries_total')
+                            or 0)
+            if (self._recoveries_seen is not None
+                    and rec_total > self._recoveries_seen):
+                self._fleet_event(
+                    'controller_recovered',
+                    recoveries=rec_total - self._recoveries_seen)
+            self._recoveries_seen = rec_total
             pol = (((record or {}).get('spec') or {})
                    .get('replica_policy') or {})
             if (pol.get('min_replicas') == 0
@@ -1289,6 +1476,13 @@ class LoadBalancer:
             'probe_failures_total': self._probe_failures,
             'probe_interval_s': self.probe_interval_s,
             'quarantined': sorted(self._quarantined_urls),
+            # Incident replay plane (docs/simulation.md): evidence-
+            # ring write cursors. `.total` is monotonic, so export
+            # tooling (and the no-silent-caps truncation warning)
+            # can tell how much history fell off each ring.
+            'incident_request_events_total': (
+                self._request_events.total),
+            'incident_fleet_events_total': self._fleet_events.total,
             # Fleet prefix tier (docs/serving.md "Disaggregated
             # prefill/decode"): LB routing hit rate + the replica KV
             # streaming counters rolled up from the same sync-tick
@@ -1894,6 +2088,12 @@ class LoadBalancer:
                 t_deadline = t_arrival + float(hdr)
             except ValueError:
                 t_deadline = None   # the replica will 400 it
+        # Flight-recorder arrival record (/generate only): scrubbed
+        # at capture, outcome stamped by whichever terminal path this
+        # request takes below.
+        req_rec = (self._note_request_event(payload, tenant,
+                                            t_deadline, t_arrival)
+                   if payload is not None else None)
         tried: Set[str] = set()
         url = self._select(tried, affinity, chain)
         if url is None and self._wake_cfg is not None:
@@ -1910,6 +2110,7 @@ class LoadBalancer:
                 # does) — an all-replicas-lost outage must burn the
                 # tenant objective too, not read as 100% good.
                 self._tenant(tenant)['no_replica'] += 1
+            self._finish_event(req_rec, 'no_replica')
             return web.Response(
                 status=503,
                 # Capacity usually returns within a sync interval or
@@ -1961,6 +2162,10 @@ class LoadBalancer:
                         self.breaker.record_success(current)
                     else:
                         self.breaker.record_failure(current)
+                    self._finish_event(
+                        req_rec,
+                        'completed' if replica_ok else 'failed',
+                        splice)
                     return resp
                 except _ReplicaSaturated as e:
                     # Overload is not death: release (never fail) the
@@ -2041,6 +2246,7 @@ class LoadBalancer:
                     # replica failure, on the initial and resumed legs
                     # alike. Hand back any half-open probe slot.
                     self.breaker.release(current)
+                    self._finish_event(req_rec, 'disconnect', splice)
                     if splice is not None and splice.resp is not None:
                         return splice.resp
                     return web.Response(status=499)   # never reaches it
@@ -2058,6 +2264,7 @@ class LoadBalancer:
             if splice is not None and splice.resp is not None:
                 # Headers are long gone: report in-band, terminate.
                 self._note_failed(tenant)
+                self._finish_event(req_rec, 'failed', splice)
                 with contextlib.suppress(Exception):
                     await splice.resp.write(json.dumps(
                         {'error': f'all {len(tried)} replica(s) failed '
@@ -2072,6 +2279,7 @@ class LoadBalancer:
                 self._requests_shed += 1
                 if tenant is not None:
                     self._tenant(tenant)['shed'] += 1
+                self._finish_event(req_rec, 'shed', splice)
                 return web.Response(
                     status=saturated.status,
                     body=saturated.body or b'',
@@ -2079,12 +2287,14 @@ class LoadBalancer:
             if (t_deadline is not None
                     and self._clock.monotonic() >= t_deadline):
                 self._note_failed(tenant)
+                self._finish_event(req_rec, 'failed', splice)
                 return web.Response(
                     status=504,
                     text='deadline exceeded before any replica could '
                          'serve the request\n')
             # Every ready replica failed pre-stream.
             self._note_failed(tenant)
+            self._finish_event(req_rec, 'failed', splice)
             cause = last_cause
             return web.Response(
                 status=502,

@@ -38,6 +38,7 @@ from skypilot_tpu.sim.scenarios import (SCENARIOS, KillSpec, Scenario,
                                         crash_sweep, disagg_fleet,
                                         flash_crowd,
                                         fleet_storm_24h,
+                                        incident_page_storm,
                                         reclaim_storm,
                                         regional_failover, sdc_storm,
                                         slow_brownout, wfq_fleet)
@@ -46,6 +47,7 @@ from skypilot_tpu.sim.twin import DigitalTwin, SimReport
 __all__ = ['DigitalTwin', 'KillSpec', 'SCENARIOS', 'Scenario',
            'SimReport', 'breaker_flap', 'crash_controller_mid_storm',
            'crash_lb_mid_stream', 'crash_sweep', 'disagg_fleet',
-           'flash_crowd', 'fleet_storm_24h', 'reclaim_storm',
+           'flash_crowd', 'fleet_storm_24h', 'incident_page_storm',
+           'reclaim_storm',
            'regional_failover', 'run_crash_sweep', 'sdc_storm',
            'slow_brownout', 'wfq_fleet']

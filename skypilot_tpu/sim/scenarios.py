@@ -156,6 +156,12 @@ class Scenario:
     # the PerfModel default. Disagg scenarios lower it so warm-prefix
     # prefill is measurably cheaper than cold.
     prefill_tokens_per_step: Optional[float] = None
+    # Recorded-trace override (docs/simulation.md): a list of
+    # tracefmt.TraceEvent arrivals replayed VERBATIM (offsets from
+    # traffic_start_s) instead of synthesizing from ``tenants`` —
+    # how `sky-tpu simulate --trace` and literal-trace replays drive
+    # the twin. None keeps the loadgen path.
+    trace_events: Optional[List[Any]] = None
 
 
 def reclaim_storm(*, replicas: int = 40, duration_s: float = 2400.0,
@@ -176,6 +182,37 @@ def reclaim_storm(*, replicas: int = 40, duration_s: float = 2400.0,
                           'until': duration_s * 0.75}},
         faults=[Fault(t=storm_t, kind='reclaim_storm',
                       frac=storm_frac, notice_frac=0.5)])
+
+
+def incident_page_storm(*, replicas: int = 4,
+                        duration_s: float = 1500.0,
+                        rps: float = 16.0) -> Scenario:
+    """The incident-replay seed scenario (docs/simulation.md): a
+    3-of-4 reclaim storm under enough load that the surviving replica
+    saturates and the ttft_p99 PAGE fires — which writes an
+    ``slo_page`` fleet dump the converter exports. Every knob the
+    flight recorder does NOT capture (slots, scheduler, perf model)
+    stays at the Scenario DEFAULT, so the converter's reconstruction
+    replays against the same capacity model that grew the dump."""
+    storm_t = duration_s * 0.45
+    return Scenario(
+        name='incident_page_storm', replicas=replicas, use_spot=True,
+        duration_s=duration_s,
+        # Replacements stay out long enough for the 5m page window to
+        # breach (the multi-window rule needs a sustained burn).
+        provision_delay_s=(420.0, 480.0),
+        tenants={'prod': {'rps': rps, 'prompt_mean': 48,
+                          'prompt_max': 256, 'max_new': 32,
+                          'shared_prefix_frac': 0.3,
+                          'until': duration_s * 0.85}},
+        slo=[{'metric': 'ttft_p99', 'threshold_s': 2.0,
+              'target': 0.99},
+             {'metric': 'itl_p99', 'threshold_s': 0.5,
+              'target': 0.99},
+             {'metric': 'availability', 'target': 0.999},
+             {'metric': 'shed_rate', 'target': 0.99}],
+        faults=[Fault(t=storm_t, kind='reclaim_storm', frac=0.75,
+                      notice_frac=0.5)])
 
 
 def flash_crowd(*, base_replicas: int = 2, max_replicas: int = 10,
@@ -541,6 +578,7 @@ def disagg_fleet(*, replicas: int = 1000, duration_s: float = 3600.0,
 
 SCENARIOS = {
     'reclaim_storm': reclaim_storm,
+    'incident_page_storm': incident_page_storm,
     'flash_crowd': flash_crowd,
     'regional_failover': regional_failover,
     'slow_brownout': slow_brownout,

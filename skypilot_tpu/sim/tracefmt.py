@@ -1,0 +1,290 @@
+"""Versioned trace/scenario schema shared by loadgen, the flight
+recorder, and the digital twin (docs/simulation.md).
+
+One JSONL file format for everything that replays through the twin:
+
+- **synthetic traces** (``tests/load_tests/loadgen.py``): full request
+  records with explicit token ids — the byte-exact replay surface the
+  determinism gates compare;
+- **exported incidents** (``skypilot_tpu/observability/incident.py``):
+  request records SCRUBBED to lengths + a prefix-cohort hash (no
+  prompt content leaves the fleet) plus a fault timeline inferred
+  from the LB's evidence rings.
+
+Line 1 is the header: ``{"sky_tpu_trace": 2, "schema_version": 2,
+"kind": ..., "truncated": ..., ...meta}``. Every further line is a
+typed record — ``{"type": "request", ...}``, ``{"type": "fault",
+...}`` or ``{"type": "kill", ...}``. All writes are
+``sort_keys=True`` so a load→save round trip is byte-identical (the
+regression property the compat tests pin).
+
+Version policy, loud by construction:
+
+- ``schema_version`` 2 is current; a file claiming a NEWER version
+  raises (never a silent partial parse of a format we do not know);
+- version-less v1 loadgen headers (``{"sky_tpu_trace": 1, ...}``)
+  keep loading through the compat reader;
+- anything else — a foreign JSONL, a non-JSON first line, an unknown
+  record type — raises ``ValueError`` naming the file and the
+  offending line instead of yielding an empty trace.
+
+Scrubbed records carry ``prompt_tokens`` (a length), ``cohort`` (a
+one-way hash of the leading token block) and ``prefix_tokens``
+instead of token ids; :func:`materialize_tokens` re-mints
+deterministic ids at load time — same cohort ⇒ same leading block, so
+the prefix-cache/affinity structure of the original traffic survives
+the scrub while the content does not.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+SCHEMA_VERSION = 2
+MAGIC = 'sky_tpu_trace'
+# Header keys owned by the format itself; everything else round-trips
+# through ``Trace.meta``.
+_HEADER_KEYS = (MAGIC, 'schema_version', 'kind', 'truncated')
+# Cohort keys hash this many leading token ids — long enough to
+# separate real prefix cohorts, short enough that two prompts sharing
+# a system preamble land in the same cohort.
+COHORT_LEAD = 16
+_RECORD_TYPES = ('request', 'fault', 'kill')
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    """One request arrival (canonical home; ``loadgen.TraceEvent`` is
+    an alias). ``t`` is seconds from trace start."""
+
+    t: float
+    tenant: str
+    tokens: List[int]        # prompt token ids
+    max_new_tokens: int
+    cohort: Optional[str] = None          # shared-prefix cohort label
+    disconnect_after: Optional[int] = None  # hang up after N tokens
+    deadline_s: Optional[float] = None    # per-request budget
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> 'TraceEvent':
+        return cls(t=float(d['t']), tenant=str(d['tenant']),
+                   tokens=[int(x) for x in d['tokens']],
+                   max_new_tokens=int(d['max_new_tokens']),
+                   cohort=d.get('cohort'),
+                   disconnect_after=d.get('disconnect_after'),
+                   deadline_s=d.get('deadline_s'))
+
+
+@dataclasses.dataclass
+class Trace:
+    """A loaded trace: replayable arrivals + the fault timeline."""
+
+    events: List[TraceEvent]
+    # Fault-timeline records (plain dicts): ``{'kind': 'reclaim_storm'
+    # , 't': ..., 'frac': ...}`` rows matching ``scenarios.Fault``
+    # fields, plus ``{'type': 'kill', 'target': ..., 't': ...}``
+    # control-plane crash records.
+    faults: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    kills: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    kind: str = 'trace'          # 'trace' | 'incident'
+    truncated: bool = False      # evidence rings wrapped before export
+    schema_version: int = SCHEMA_VERSION
+    # Raw request records as stored (scrubbed incidents keep outcome /
+    # output_tokens here; ``events`` holds the replayable view).
+    requests: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+
+
+def cohort_key(tokens: List[int], lead: int = COHORT_LEAD) -> str:
+    """One-way prefix-cohort hash of a prompt's leading token block:
+    stable across exports, carries no content (12 hex chars of a
+    keyed blake2s)."""
+    head = json.dumps([int(t) for t in tokens[:lead]]).encode()
+    return hashlib.blake2s(head, digest_size=6).hexdigest()
+
+
+def materialize_tokens(prompt_tokens: int, cohort: Optional[str],
+                       prefix_tokens: int, index: int) -> List[int]:
+    """Deterministic token ids for a scrubbed request: the cohort
+    hash seeds the shared leading block (same cohort ⇒ same prefix —
+    the affinity/prefix-cache structure survives), a per-record seed
+    mints the tail. Ids stay in loadgen's [2, 201] vocab-safe
+    range."""
+    n = max(1, int(prompt_tokens))
+    shared = min(max(0, int(prefix_tokens)), n) if cohort else 0
+    ids: List[int] = []
+    if shared:
+        rng = random.Random(f'sky-tpu-cohort/{cohort}')
+        ids.extend(2 + rng.randrange(200) for _ in range(shared))
+    rng = random.Random(f'sky-tpu-tail/{cohort}/{index}')
+    ids.extend(2 + rng.randrange(200) for _ in range(n - len(ids)))
+    return ids
+
+
+def request_record(ev: TraceEvent) -> Dict[str, Any]:
+    """A full (token-carrying) request record for a synthetic
+    trace."""
+    return {'type': 'request', **ev.to_json()}
+
+
+def scrub_event(ev: TraceEvent) -> Dict[str, Any]:
+    """The privacy projection: lengths + cohort hash, no token
+    ids."""
+    return {
+        'type': 'request', 't': ev.t, 'tenant': ev.tenant,
+        'prompt_tokens': len(ev.tokens),
+        'max_new_tokens': ev.max_new_tokens,
+        'cohort': ev.cohort or cohort_key(ev.tokens),
+        'prefix_tokens': min(COHORT_LEAD, len(ev.tokens)),
+        'deadline_s': ev.deadline_s,
+    }
+
+
+def _event_from_record(rec: Dict[str, Any], index: int,
+                       path: str) -> TraceEvent:
+    if 'tokens' in rec:
+        return TraceEvent.from_json(rec)
+    # Scrubbed record: re-mint deterministic ids.
+    try:
+        tokens = materialize_tokens(
+            int(rec['prompt_tokens']), rec.get('cohort'),
+            int(rec.get('prefix_tokens') or 0), index)
+        return TraceEvent(
+            t=float(rec['t']), tenant=str(rec['tenant']),
+            tokens=tokens,
+            max_new_tokens=int(rec.get('max_new_tokens') or 1),
+            cohort=rec.get('cohort'),
+            disconnect_after=rec.get('disconnect_after'),
+            deadline_s=rec.get('deadline_s'))
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(
+            f'{path}: malformed request record #{index}: {e}')
+
+
+def save(trace: Trace, path: str) -> str:
+    """Write a v{SCHEMA_VERSION} trace file. Deterministic: sorted
+    keys, records in list order — save(load(p)) is byte-identical to
+    p for any v2 file."""
+    header = {MAGIC: SCHEMA_VERSION,
+              'schema_version': trace.schema_version,
+              'kind': trace.kind, 'truncated': bool(trace.truncated),
+              **{k: v for k, v in trace.meta.items()
+                 if k not in _HEADER_KEYS}}
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(json.dumps(header, sort_keys=True) + '\n')
+        requests = trace.requests or [request_record(ev)
+                                      for ev in trace.events]
+        for rec in requests:
+            f.write(json.dumps({'type': 'request', **rec},
+                               sort_keys=True) + '\n')
+        for rec in trace.faults:
+            f.write(json.dumps({'type': 'fault', **rec},
+                               sort_keys=True) + '\n')
+        for rec in trace.kills:
+            f.write(json.dumps({'type': 'kill', **rec},
+                               sort_keys=True) + '\n')
+    return path
+
+
+def save_events(events: List[TraceEvent], path: str,
+                meta: Optional[Dict[str, Any]] = None) -> str:
+    """Loadgen-shaped save: a list of events + free-form meta."""
+    return save(Trace(events=list(events), meta=dict(meta or {})),
+                path)
+
+
+def _parse_header(line: str, path: str) -> Dict[str, Any]:
+    try:
+        header = json.loads(line)
+    except ValueError:
+        raise ValueError(f'{path}: not a sky-tpu trace file '
+                         f'(first line is not JSON)')
+    if not isinstance(header, dict) or MAGIC not in header:
+        raise ValueError(f'{path}: not a sky-tpu trace file '
+                         f'(missing {MAGIC!r} header)')
+    return header
+
+
+def load(path: str) -> Trace:
+    """Load any trace file version this build knows; LOUD on anything
+    else (an unknown newer schema, a foreign JSONL, a malformed
+    record) — a partial parse presented as an empty trace is how
+    replay gates go silently vacuous."""
+    with open(path, encoding='utf-8') as f:
+        header = _parse_header(f.readline(), path)
+        version = header.get(MAGIC)
+        if version == 1:
+            return _load_v1(f, header, path)
+        if version != SCHEMA_VERSION:
+            raise ValueError(
+                f'{path}: trace schema version {version!r} is not '
+                f'supported by this build (reads v1 and '
+                f'v{SCHEMA_VERSION}); re-export the trace or upgrade')
+        declared = header.get('schema_version')
+        if declared != SCHEMA_VERSION:
+            raise ValueError(
+                f'{path}: header schema_version {declared!r} '
+                f'disagrees with {MAGIC}={version}')
+        trace = Trace(
+            events=[], kind=str(header.get('kind') or 'trace'),
+            truncated=bool(header.get('truncated')),
+            schema_version=SCHEMA_VERSION,
+            meta={k: v for k, v in header.items()
+                  if k not in _HEADER_KEYS})
+        for i, line in enumerate(f, start=2):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                raise ValueError(f'{path}:{i}: malformed JSON record')
+            if not isinstance(rec, dict):
+                raise ValueError(f'{path}:{i}: record is not an '
+                                 f'object')
+            kind = rec.pop('type', None)
+            if kind == 'request':
+                trace.requests.append(rec)
+                trace.events.append(_event_from_record(
+                    rec, len(trace.events), path))
+            elif kind == 'fault':
+                trace.faults.append(rec)
+            elif kind == 'kill':
+                trace.kills.append(rec)
+            else:
+                raise ValueError(
+                    f'{path}:{i}: unknown record type {kind!r} '
+                    f'(knows {list(_RECORD_TYPES)})')
+        return trace
+
+
+def _load_v1(f, header: Dict[str, Any], path: str) -> Trace:
+    """Compat reader for version-less loadgen files: a ``{"
+    sky_tpu_trace": 1}`` header followed by bare event lines."""
+    events: List[TraceEvent] = []
+    for i, line in enumerate(f, start=2):
+        if not line.strip():
+            continue
+        try:
+            events.append(TraceEvent.from_json(json.loads(line)))
+        except (ValueError, KeyError, TypeError) as e:
+            raise ValueError(f'{path}:{i}: malformed v1 trace '
+                             f'event: {e}')
+    return Trace(events=events, schema_version=1,
+                 meta={k: v for k, v in header.items()
+                       if k != MAGIC})
+
+
+def load_events(path: str
+                ) -> Tuple[List[TraceEvent], Dict[str, Any]]:
+    """Loadgen-shaped load: (events, header-meta)."""
+    trace = load(path)
+    return trace.events, {MAGIC: trace.schema_version, **trace.meta}

@@ -384,6 +384,11 @@ class DigitalTwin:
 
     # ---- traffic -------------------------------------------------------
     def _synthesize(self) -> list:
+        if self.sc.trace_events is not None:
+            # Recorded trace (docs/simulation.md): replay the
+            # arrivals verbatim — the trace IS the workload, the seed
+            # only drives service-side stochastics.
+            return list(self.sc.trace_events)
         from tests.load_tests import loadgen
         return loadgen.synthesize(
             self.seed, self.sc.tenants,

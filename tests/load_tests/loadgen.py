@@ -14,17 +14,17 @@ drives every draw), and a replay submits those events in a fixed
 order (arrival time, then index). Wall-clock latencies naturally vary
 run to run; the *workload* never does.
 
-Trace-file format (JSONL): line 1 is a header object
-``{"sky_tpu_trace": 1, ...meta}``; each further line is one event —
-``{"t": seconds, "tenant": str, "tokens": [ids], "max_new_tokens": n,
-"cohort": str|null, "disconnect_after": n|null,
-"deadline_s": s|null}`` — sorted by ``t``. ``save_trace`` /
-``load_trace`` round-trip exactly.
+Trace-file format: the shared versioned schema in
+``skypilot_tpu/sim/tracefmt.py`` (docs/simulation.md) — line 1 is a
+``{"sky_tpu_trace": 2, "schema_version": 2, ...meta}`` header, each
+further line a typed record. ``save_trace`` / ``load_trace``
+round-trip byte-exactly; legacy version-less v1 files keep loading
+through tracefmt's compat reader, and an unknown/newer version raises
+instead of yielding an empty trace.
 """
 from __future__ import annotations
 
 import concurrent.futures
-import dataclasses
 import json
 import math
 import random
@@ -33,28 +33,7 @@ import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-
-@dataclasses.dataclass
-class TraceEvent:
-    t: float                 # arrival offset from trace start, seconds
-    tenant: str
-    tokens: List[int]        # prompt token ids
-    max_new_tokens: int
-    cohort: Optional[str] = None          # shared-prefix cohort label
-    disconnect_after: Optional[int] = None  # hang up after N tokens
-    deadline_s: Optional[float] = None    # per-request budget
-
-    def to_json(self) -> Dict[str, Any]:
-        return dataclasses.asdict(self)
-
-    @classmethod
-    def from_json(cls, d: Dict[str, Any]) -> 'TraceEvent':
-        return cls(t=float(d['t']), tenant=str(d['tenant']),
-                   tokens=[int(x) for x in d['tokens']],
-                   max_new_tokens=int(d['max_new_tokens']),
-                   cohort=d.get('cohort'),
-                   disconnect_after=d.get('disconnect_after'),
-                   deadline_s=d.get('deadline_s'))
+from skypilot_tpu.sim.tracefmt import TraceEvent
 
 
 def _block(rng: random.Random, n: int) -> List[int]:
@@ -208,24 +187,14 @@ def synthesize(seed: int, tenants: Dict[str, Dict[str, Any]],
 
 def save_trace(events: List[TraceEvent], path: str,
                meta: Optional[Dict[str, Any]] = None) -> str:
-    with open(path, 'w', encoding='utf-8') as f:
-        f.write(json.dumps({'sky_tpu_trace': 1, **(meta or {})})
-                + '\n')
-        for ev in events:
-            f.write(json.dumps(ev.to_json()) + '\n')
-    return path
+    from skypilot_tpu.sim import tracefmt
+    return tracefmt.save_events(events, path, meta)
 
 
 def load_trace(path: str
                ) -> Tuple[List[TraceEvent], Dict[str, Any]]:
-    with open(path, encoding='utf-8') as f:
-        header = json.loads(f.readline())
-        if header.get('sky_tpu_trace') != 1:
-            raise ValueError(f'{path}: not a sky-tpu trace file '
-                             f'(missing header line)')
-        events = [TraceEvent.from_json(json.loads(line))
-                  for line in f if line.strip()]
-    return events, header
+    from skypilot_tpu.sim import tracefmt
+    return tracefmt.load_events(path)
 
 
 # ---- replay: directly against an engine ------------------------------------

@@ -1,0 +1,46 @@
+"""Permanent incident regression gates (docs/simulation.md).
+
+Every ``tests/sim/incidents/*.jsonl`` file is an exported incident
+trace (``sky-tpu incident export``) promoted to a permanent tier-1
+gate: the twin replays it and must reproduce the recorded anomaly
+class — the same page-alert objectives, in the recorded firing order,
+plus the trigger-specific transitions (breaker edges, quarantines,
+shed activity) ``incident.verify_replay`` checks.
+
+To add one: export the dump from a real (or twin) fleet, drop the
+file here, optionally set ``replay_seed`` in the header. The test is
+collected automatically; there is nothing to register.
+"""
+import logging
+import pathlib
+
+import pytest
+
+from skypilot_tpu.observability import incident
+from skypilot_tpu.sim import tracefmt
+
+pytestmark = pytest.mark.sim
+
+INCIDENT_DIR = pathlib.Path(__file__).parent / 'incidents'
+INCIDENTS = sorted(INCIDENT_DIR.glob('*.jsonl'))
+
+
+def test_incident_corpus_is_nonempty():
+    """The corpus ships with at least the seed incident — an empty
+    glob must fail loudly, not skip silently."""
+    assert INCIDENTS, f'no incident traces in {INCIDENT_DIR}'
+
+
+@pytest.mark.parametrize(
+    'path', INCIDENTS, ids=[p.stem for p in INCIDENTS])
+def test_incident_replay_reproduces(path):
+    trace = tracefmt.load(str(path))
+    assert trace.kind == 'incident'
+    seed = int(trace.meta.get('replay_seed') or 0)
+    logging.disable(logging.WARNING)
+    try:
+        report = incident.replay(trace, seed=seed)
+    finally:
+        logging.disable(logging.NOTSET)
+    problems = incident.verify_replay(trace, report)
+    assert problems == [], f'{path.name}: {problems}'

@@ -1,0 +1,122 @@
+"""Versioned trace schema (skypilot_tpu/sim/tracefmt.py,
+docs/simulation.md): byte round trips, the v1 compat reader, the
+loud-rejection contract, and deterministic scrubbed-token minting."""
+
+import json
+
+import pytest
+
+from skypilot_tpu.sim import tracefmt
+
+
+def _events():
+    return [
+        tracefmt.TraceEvent(t=0.0, tenant='prod',
+                            tokens=[3, 4, 5, 6], max_new_tokens=8),
+        tracefmt.TraceEvent(t=0.25, tenant='batch',
+                            tokens=[3, 4, 9, 9], max_new_tokens=4,
+                            cohort='c0', disconnect_after=2,
+                            deadline_s=1.5),
+    ]
+
+
+def test_v2_round_trip_is_byte_identical(tmp_path):
+    p1 = str(tmp_path / 'a.jsonl')
+    p2 = str(tmp_path / 'b.jsonl')
+    tracefmt.save_events(_events(), p1, meta={'note': 'x'})
+    trace = tracefmt.load(p1)
+    assert [e.to_json() for e in trace.events] == [
+        e.to_json() for e in _events()]
+    assert trace.meta['note'] == 'x'
+    assert trace.schema_version == tracefmt.SCHEMA_VERSION
+    tracefmt.save(trace, p2)
+    with open(p1, 'rb') as a, open(p2, 'rb') as b:
+        assert a.read() == b.read()
+
+
+def test_v1_compat_reader(tmp_path):
+    p = str(tmp_path / 'v1.jsonl')
+    with open(p, 'w') as f:
+        f.write(json.dumps({tracefmt.MAGIC: 1, 'seed': 7}) + '\n')
+        for ev in _events():
+            f.write(json.dumps(ev.to_json()) + '\n')
+    trace = tracefmt.load(p)
+    assert trace.schema_version == 1
+    assert trace.meta['seed'] == 7
+    assert [e.to_json() for e in trace.events] == [
+        e.to_json() for e in _events()]
+    events, meta = tracefmt.load_events(p)
+    assert len(events) == 2 and meta[tracefmt.MAGIC] == 1
+
+
+@pytest.mark.parametrize('first_line,msg', [
+    ('not json at all', 'not JSON'),
+    (json.dumps({'foo': 1}), 'missing'),
+    (json.dumps({tracefmt.MAGIC: 99, 'schema_version': 99}),
+     'not supported'),
+    (json.dumps({tracefmt.MAGIC: 2, 'schema_version': 1}),
+     'disagrees'),
+])
+def test_loud_rejection_of_foreign_headers(tmp_path, first_line,
+                                           msg):
+    p = str(tmp_path / 'bad.jsonl')
+    with open(p, 'w') as f:
+        f.write(first_line + '\n')
+    with pytest.raises(ValueError, match=msg):
+        tracefmt.load(p)
+
+
+def test_loud_rejection_of_bad_records(tmp_path):
+    header = json.dumps({tracefmt.MAGIC: 2, 'schema_version': 2,
+                         'kind': 'trace', 'truncated': False})
+    p = str(tmp_path / 'bad.jsonl')
+    with open(p, 'w') as f:
+        f.write(header + '\n')
+        f.write(json.dumps({'type': 'mystery'}) + '\n')
+    with pytest.raises(ValueError, match='unknown record type'):
+        tracefmt.load(p)
+    with open(p, 'w') as f:
+        f.write(header + '\n')
+        f.write('{broken\n')
+    with pytest.raises(ValueError, match='malformed JSON'):
+        tracefmt.load(p)
+
+
+def test_scrubbed_records_carry_no_tokens_and_rematerialize(
+        tmp_path):
+    ev = _events()[0]
+    rec = tracefmt.scrub_event(ev)
+    assert 'tokens' not in rec
+    assert rec['prompt_tokens'] == len(ev.tokens)
+    assert rec['cohort'] == tracefmt.cohort_key(ev.tokens)
+    p = str(tmp_path / 'scrubbed.jsonl')
+    tracefmt.save(tracefmt.Trace(events=[], requests=[rec],
+                                 kind='incident'), p)
+    t1, t2 = tracefmt.load(p), tracefmt.load(p)
+    assert t1.events[0].tokens == t2.events[0].tokens
+    assert len(t1.events[0].tokens) == len(ev.tokens)
+
+
+def test_cohort_preserves_prefix_structure():
+    a = tracefmt.materialize_tokens(32, 'cohortA', 16, 0)
+    b = tracefmt.materialize_tokens(32, 'cohortA', 16, 1)
+    c = tracefmt.materialize_tokens(32, 'cohortB', 16, 0)
+    assert a[:16] == b[:16]          # same cohort ⇒ same prefix
+    assert a[16:] != b[16:]          # distinct per-record tails
+    assert a[:16] != c[:16]          # different cohort ⇒ different
+    assert all(2 <= t <= 201 for t in a)
+
+
+def test_loadgen_delegates_to_tracefmt(tmp_path):
+    from tests.load_tests import loadgen
+    events = loadgen.synthesize(
+        1, {'t': {'rps': 20.0, 'prompt_mean': 8, 'prompt_max': 16,
+                  'max_new': 4}}, duration_s=1.0)
+    p = str(tmp_path / 'lg.jsonl')
+    loadgen.save_trace(events, p, meta={'seed': 1})
+    with open(p) as f:
+        header = json.loads(f.readline())
+    assert header[tracefmt.MAGIC] == tracefmt.SCHEMA_VERSION
+    back, meta = loadgen.load_trace(p)
+    assert [e.to_json() for e in back] == [
+        e.to_json() for e in events]
